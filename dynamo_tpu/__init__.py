@@ -1,0 +1,17 @@
+"""dynamo-tpu: a TPU-native distributed LLM inference-serving framework.
+
+Capabilities (mirroring NVIDIA Dynamo, see /root/repo/SURVEY.md):
+  - OpenAI-compatible HTTP frontend with streaming SSE
+  - Distributed runtime: Namespace/Component/Endpoint, lease-based discovery,
+    two-plane RPC (request push + call-home streamed responses)
+  - KV-cache-aware routing: global radix-tree index fed by worker events
+  - Disaggregated prefill/decode with a work queue and direct KV-block transfer
+  - Multi-tier KV cache with host-DRAM offload
+  - A native JAX serving engine: paged KV cache, continuous batching,
+    Pallas attention kernels, pjit/shard_map tensor parallelism over a Mesh
+
+The compute path is JAX/XLA/Pallas; the runtime around it is asyncio +
+native-code fast paths.
+"""
+
+__version__ = "0.1.0"

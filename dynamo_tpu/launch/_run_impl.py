@@ -1,0 +1,56 @@
+"""Dispatch for `dynamo-tpu run`: wires input frontends to output engines.
+
+Engine matrix mirrors the reference launcher (reference: launch/dynamo-run/src/opt.rs):
+inputs http/text/batch/dyn endpoints; outputs echo (test engine,
+reference: launch/dynamo-run/src/output/echo_core.rs) and the native JAX engine.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("launch")
+
+
+def run_command(args) -> int:
+    asyncio.run(_run(args))
+    return 0
+
+
+async def _build_engine(args):
+    if args.output == "echo":
+        from dynamo_tpu.llm.echo import EchoEngine
+
+        return EchoEngine()
+    if args.output == "jax":
+        from dynamo_tpu.engine import build_async_engine
+
+        return await build_async_engine(args.model, max_model_len=args.max_model_len)
+    raise ValueError(f"unsupported out={args.output}")
+
+
+async def _run(args) -> None:
+    engine = await _build_engine(args)
+    try:
+        if args.input == "text":
+            from dynamo_tpu.frontends.text import run_text
+
+            await run_text(engine, args)
+        elif args.input == "http":
+            from dynamo_tpu.frontends.http import run_http
+
+            await run_http(engine, args)
+        elif args.input.startswith("batch:"):
+            from dynamo_tpu.frontends.batch import run_batch
+
+            await run_batch(engine, args, args.input.split(":", 1)[1])
+        else:
+            raise ValueError(f"unsupported in={args.input}")
+    finally:
+        shutdown = getattr(engine, "shutdown", None)
+        if shutdown is not None:
+            result = shutdown()
+            if asyncio.iscoroutine(result):
+                await result

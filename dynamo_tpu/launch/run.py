@@ -1,0 +1,36 @@
+"""`dynamo-tpu run` universal CLI (analogue of the reference's dynamo-run,
+reference: launch/dynamo-run/src/lib.rs:75-433).
+
+in={http,text,batch,dyn://...} x out={echo,jax,dyn://...}. Engine wiring lands
+with the JAX engine; this module owns arg parsing and dispatch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="dynamo-tpu", description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+    run = sub.add_parser("run", help="run a serving pipeline")
+    run.add_argument("model", nargs="?", help="model path or registry name")
+    run.add_argument("--in", dest="input", default="text", help="http|text|batch:<file.jsonl>|dyn://<endpoint>")
+    run.add_argument("--out", dest="output", default="echo", help="echo|jax|dyn://<endpoint>")
+    run.add_argument("--http-port", type=int, default=8080)
+    run.add_argument("--max-model-len", type=int, default=None)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command == "run":
+        from dynamo_tpu.launch._run_impl import run_command
+
+        return run_command(args)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,132 @@
+"""Token sequences -> fixed-size blocks with chained sequence hashes.
+
+This is the canonical block identity used for KV reuse and KV-aware routing.
+Semantics mirror the reference (reference: lib/llm/src/tokens.rs:27-388 and
+lib/llm/src/kv_router/indexer.rs:62-133):
+
+  - ``compute_hash(data) = xxh3_64(data, seed=1337)``
+  - block hash  = hash of the block's token ids as little-endian u32 bytes
+  - sequence hash (chained): first full block's sequence hash is its block hash;
+    block i's sequence hash = hash of ``[parent_sequence_hash, block_hash]`` as
+    two little-endian u64s
+  - ``compute_block_hash_for_seq`` = *unchained* per-chunk hashes over complete
+    chunks only (used by the router's radix-tree matching)
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import xxhash
+
+XXH3_SEED = 1337
+
+Token = int
+SequenceHash = int
+BlockHash = int
+
+
+def compute_hash(data: bytes) -> int:
+    return xxhash.xxh3_64_intdigest(data, seed=XXH3_SEED)
+
+
+def _tokens_bytes(tokens: Sequence[int]) -> bytes:
+    return struct.pack(f"<{len(tokens)}I", *tokens)
+
+
+def compute_block_hash(tokens: Sequence[int]) -> BlockHash:
+    return compute_hash(_tokens_bytes(tokens))
+
+
+def compute_block_hash_for_seq(tokens: Sequence[int], kv_block_size: int) -> list[BlockHash]:
+    """Unchained per-chunk hashes of complete chunks (router matching identity).
+
+    Reference: lib/llm/src/kv_router/indexer.rs:123-133.
+    """
+    return [
+        compute_block_hash(tokens[i : i + kv_block_size])
+        for i in range(0, len(tokens) - kv_block_size + 1, kv_block_size)
+    ]
+
+
+def chain_hash(parent: SequenceHash, block_hash: BlockHash) -> SequenceHash:
+    return compute_hash(struct.pack("<QQ", parent, block_hash))
+
+
+@dataclass(frozen=True)
+class TokenBlock:
+    """A complete block of ``block_size`` tokens with its chained identity."""
+
+    tokens: tuple[int, ...]
+    block_hash: BlockHash
+    sequence_hash: SequenceHash
+    parent_sequence_hash: Optional[SequenceHash]
+
+
+@dataclass
+class PartialTokenBlock:
+    """The trailing incomplete block of a sequence."""
+
+    tokens: list[int] = field(default_factory=list)
+    parent_sequence_hash: Optional[SequenceHash] = None
+
+
+class TokenSequence:
+    """Incremental splitter of a token stream into hashed blocks.
+
+    Mirrors reference TokenSequence/split_tokens (lib/llm/src/tokens.rs:180-260):
+    the first block's sequence hash equals its block hash; later blocks chain.
+    """
+
+    def __init__(self, tokens: Sequence[int] = (), block_size: int = 16):
+        if block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        self.block_size = block_size
+        self.blocks: list[TokenBlock] = []
+        self.current = PartialTokenBlock()
+        self.extend(tokens)
+
+    def __len__(self) -> int:
+        return len(self.blocks) * self.block_size + len(self.current.tokens)
+
+    @property
+    def tokens(self) -> list[int]:
+        out: list[int] = []
+        for b in self.blocks:
+            out.extend(b.tokens)
+        out.extend(self.current.tokens)
+        return out
+
+    def push_token(self, token: int) -> Optional[TokenBlock]:
+        """Append one token; returns the newly completed TokenBlock if any."""
+        cur = self.current
+        cur.tokens.append(token)
+        if len(cur.tokens) < self.block_size:
+            return None
+        block_hash = compute_block_hash(cur.tokens)
+        if cur.parent_sequence_hash is None:
+            sequence_hash = block_hash
+        else:
+            sequence_hash = chain_hash(cur.parent_sequence_hash, block_hash)
+        block = TokenBlock(
+            tokens=tuple(cur.tokens),
+            block_hash=block_hash,
+            sequence_hash=sequence_hash,
+            parent_sequence_hash=cur.parent_sequence_hash,
+        )
+        self.blocks.append(block)
+        self.current = PartialTokenBlock(parent_sequence_hash=sequence_hash)
+        return block
+
+    def extend(self, tokens: Sequence[int]) -> list[TokenBlock]:
+        completed = []
+        for t in tokens:
+            block = self.push_token(t)
+            if block is not None:
+                completed.append(block)
+        return completed
+
+    def sequence_hashes(self) -> list[SequenceHash]:
+        return [b.sequence_hash for b in self.blocks]

@@ -1,0 +1,1 @@
+from dynamo_tpu.utils.logging import init_logging, get_logger

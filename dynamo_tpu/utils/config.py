@@ -1,0 +1,89 @@
+"""Layered configuration: dataclass defaults <- file <- env vars.
+
+Mirrors the reference's figment-layered config (reference: lib/runtime/src/config.rs:26-170):
+defaults are overridden by an optional TOML/YAML/JSON file, which is overridden by
+``DYNTPU_<SECTION>_<KEY>`` environment variables.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from pathlib import Path
+from typing import Any, Type, TypeVar
+
+T = TypeVar("T")
+
+
+def _load_file(path: str | Path) -> dict[str, Any]:
+    path = Path(path)
+    text = path.read_text()
+    if path.suffix in (".yaml", ".yml"):
+        import yaml
+
+        return yaml.safe_load(text) or {}
+    if path.suffix == ".toml":
+        import tomllib
+
+        return tomllib.loads(text)
+    return json.loads(text)
+
+
+_BUILTIN_TYPES = {"bool": bool, "int": int, "float": float, "str": str, "list": list, "dict": dict}
+
+
+def _resolve_type(annotation: Any) -> Any:
+    """Map a dataclass field annotation (possibly a string under PEP 563, possibly
+    Optional[...]/list[...]) to the concrete type env values should coerce to."""
+    if isinstance(annotation, type):
+        return annotation
+    name = str(annotation)
+    # Strip Optional wrappers: "int | None", "Optional[int]", "typing.Optional[int]"
+    name = name.replace("typing.", "").replace("Optional[", "").rstrip("]")
+    name = name.replace("| None", "").replace("None |", "").strip()
+    base = name.split("[", 1)[0].strip()
+    return _BUILTIN_TYPES.get(base, str)
+
+
+def _coerce(value: str, typ: Any) -> Any:
+    if typ is bool:
+        return value.lower() in ("1", "true", "yes", "on")
+    if typ is int:
+        return int(value)
+    if typ is float:
+        return float(value)
+    if typ in (list, dict) or str(typ).startswith(("list", "dict", "typing.")):
+        return json.loads(value)
+    return value
+
+
+def from_settings(
+    cls: Type[T],
+    *,
+    env_prefix: str,
+    config_path: str | Path | None = None,
+    overrides: dict[str, Any] | None = None,
+) -> T:
+    """Build a dataclass config with file + env layering.
+
+    Env var name for field ``foo_bar`` with prefix ``DYNTPU_RUNTIME`` is
+    ``DYNTPU_RUNTIME_FOO_BAR``.
+    """
+    assert dataclasses.is_dataclass(cls)
+    values: dict[str, Any] = {}
+    file_path = config_path or os.environ.get(f"{env_prefix}_CONFIG")
+    if file_path and Path(file_path).exists():
+        values.update(_load_file(file_path))
+    if overrides:
+        values.update(overrides)
+
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    kwargs: dict[str, Any] = {}
+    for name, field in fields.items():
+        env_key = f"{env_prefix}_{name.upper()}"
+        if env_key in os.environ:
+            kwargs[name] = _coerce(os.environ[env_key], _resolve_type(field.type))
+        elif name in values:
+            kwargs[name] = values[name]
+    return cls(**kwargs)

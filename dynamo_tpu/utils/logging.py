@@ -1,0 +1,69 @@
+"""Logging init for the framework.
+
+Mirrors the reference's logging surface (reference: lib/runtime/src/logging.rs:20-298):
+env-filtered level via ``DYNTPU_LOG`` (analogue of DYN_LOG), JSON-lines structured
+output via ``DYNTPU_LOG_JSONL`` (analogue of DYN_LOGGING_JSONL).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+
+_INITIALIZED = False
+
+
+class JsonlFormatter(logging.Formatter):
+    """One JSON object per line, fields flattened (reference: logging.rs:172-244)."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        entry = {
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(record.created))
+            + f".{int(record.msecs):03d}Z",
+            "level": record.levelname,
+            "target": record.name,
+            "message": record.getMessage(),
+        }
+        extra = getattr(record, "fields", None)
+        if isinstance(extra, dict):
+            entry.update(extra)
+        if record.exc_info:
+            entry["exception"] = self.formatException(record.exc_info)
+        return json.dumps(entry, default=str)
+
+
+def init_logging(level: str | None = None) -> None:
+    """Logging setup honouring DYNTPU_LOG / DYNTPU_LOG_JSONL.
+
+    Handler installation is idempotent, but an explicit ``level`` always takes
+    effect — modules calling get_logger() at import time must not pin the level.
+    """
+    global _INITIALIZED
+    level_name = (level or os.environ.get("DYNTPU_LOG", "info")).upper()
+    if _INITIALIZED:
+        if level is not None:
+            logging.getLogger("dynamo_tpu").setLevel(getattr(logging, level_name, logging.INFO))
+        return
+    _INITIALIZED = True
+    handler = logging.StreamHandler(sys.stderr)
+    if os.environ.get("DYNTPU_LOG_JSONL", "").lower() in ("1", "true", "yes"):
+        handler.setFormatter(JsonlFormatter())
+    else:
+        handler.setFormatter(
+            logging.Formatter(
+                "%(asctime)s %(levelname).1s %(name)s: %(message)s",
+                datefmt="%H:%M:%S",
+            )
+        )
+    root = logging.getLogger("dynamo_tpu")
+    root.setLevel(getattr(logging, level_name, logging.INFO))
+    root.addHandler(handler)
+    root.propagate = False
+
+
+def get_logger(name: str) -> logging.Logger:
+    init_logging()
+    return logging.getLogger(f"dynamo_tpu.{name}")

@@ -1,0 +1,101 @@
+"""Async object pool with return-handles.
+
+Semantics mirror the reference's pool utility (reference: lib/runtime/src/utils/pool.rs:28-427),
+the basis of KV block reuse: acquiring yields a ``PoolItem`` guard; dropping/releasing the
+guard returns the value to the pool rather than destroying it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import deque
+from typing import Callable, Generic, Optional, TypeVar
+
+V = TypeVar("V")
+
+
+class PoolItem(Generic[V]):
+    """Guard over a pooled value; release() (or async context exit) returns it."""
+
+    def __init__(self, pool: "Pool[V]", value: V):
+        self._pool: Optional[Pool[V]] = pool
+        self.value = value
+
+    def release(self) -> None:
+        if self._pool is not None:
+            pool, self._pool = self._pool, None
+            pool._return(self.value)
+
+    def take(self) -> V:
+        """Detach the value from the pool permanently."""
+        self._pool = None
+        return self.value
+
+    async def __aenter__(self) -> V:
+        return self.value
+
+    async def __aexit__(self, *exc) -> None:
+        self.release()
+
+    def __del__(self):  # safety net mirroring Drop-returns semantics
+        if self._pool is not None:
+            try:
+                self.release()
+            except Exception:
+                pass
+
+
+class Pool(Generic[V]):
+    """FIFO pool of reusable values with an optional factory for lazy growth."""
+
+    def __init__(
+        self,
+        initial: list[V] | None = None,
+        *,
+        factory: Callable[[], V] | None = None,
+        capacity: int | None = None,
+    ):
+        self._items: deque[V] = deque(initial or [])
+        self._factory = factory
+        self._created = len(self._items)
+        self._capacity = capacity
+        self._waiters: deque[asyncio.Future] = deque()
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def try_acquire(self) -> Optional[PoolItem[V]]:
+        if self._items:
+            return PoolItem(self, self._items.popleft())
+        if self._factory and (self._capacity is None or self._created < self._capacity):
+            self._created += 1
+            return PoolItem(self, self._factory())
+        return None
+
+    async def acquire(self) -> PoolItem[V]:
+        item = self.try_acquire()
+        if item is not None:
+            return item
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._waiters.append(fut)
+        try:
+            value = await fut
+        except asyncio.CancelledError:
+            if fut.done() and not fut.cancelled():
+                # _return already handed us the value; put it back for others
+                self._return(fut.result())
+            else:
+                try:
+                    self._waiters.remove(fut)
+                except ValueError:
+                    pass
+            raise
+        return PoolItem(self, value)
+
+    def _return(self, value: V) -> None:
+        while self._waiters:
+            fut = self._waiters.popleft()
+            if not fut.done():
+                fut.set_result(value)
+                return
+        self._items.append(value)

@@ -1,0 +1,61 @@
+"""Block hashing tests (mirrors reference in-module tests, lib/llm/src/tokens.rs bottom)."""
+
+import struct
+
+import xxhash
+
+from dynamo_tpu.llm.tokens import (
+    TokenSequence,
+    chain_hash,
+    compute_block_hash,
+    compute_block_hash_for_seq,
+    compute_hash,
+)
+
+
+def test_hash_is_xxh3_seeded():
+    data = b"hello world"
+    assert compute_hash(data) == xxhash.xxh3_64_intdigest(data, seed=1337)
+
+
+def test_block_hash_le_u32_bytes():
+    tokens = [1, 2, 3, 4]
+    assert compute_block_hash(tokens) == compute_hash(struct.pack("<4I", 1, 2, 3, 4))
+
+
+def test_seq_hashes_unchained_complete_chunks_only():
+    tokens = list(range(10))
+    hashes = compute_block_hash_for_seq(tokens, 4)
+    assert len(hashes) == 2  # trailing partial chunk of 2 ignored
+    assert hashes[0] == compute_block_hash([0, 1, 2, 3])
+    assert hashes[1] == compute_block_hash([4, 5, 6, 7])
+
+
+def test_token_sequence_chaining():
+    seq = TokenSequence(list(range(8)), block_size=4)
+    assert len(seq.blocks) == 2
+    b0, b1 = seq.blocks
+    # First block: sequence hash == block hash.
+    assert b0.sequence_hash == b0.block_hash
+    assert b0.parent_sequence_hash is None
+    # Second block chains: hash([parent_u64, block_u64]).
+    assert b1.parent_sequence_hash == b0.sequence_hash
+    assert b1.sequence_hash == chain_hash(b0.sequence_hash, b1.block_hash)
+
+
+def test_incremental_matches_bulk():
+    tokens = list(range(23))
+    bulk = TokenSequence(tokens, block_size=4)
+    inc = TokenSequence(block_size=4)
+    for t in tokens:
+        inc.push_token(t)
+    assert [b.sequence_hash for b in bulk.blocks] == [b.sequence_hash for b in inc.blocks]
+    assert bulk.current.tokens == inc.current.tokens == list(range(20, 23))
+    assert bulk.tokens == tokens
+
+
+def test_same_prefix_same_hashes():
+    a = TokenSequence([5, 6, 7, 8, 9, 10, 11, 12], block_size=4)
+    b = TokenSequence([5, 6, 7, 8, 100, 200, 300, 400], block_size=4)
+    assert a.blocks[0].sequence_hash == b.blocks[0].sequence_hash
+    assert a.blocks[1].sequence_hash != b.blocks[1].sequence_hash
