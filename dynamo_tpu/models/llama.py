@@ -1,0 +1,259 @@
+"""Llama-family model (Llama 2/3, DeepSeek-R1-Distill-Llama) in pure JAX with a
+paged KV cache.
+
+Design notes (TPU-first):
+  - Layers are scan-stacked: every weight carries a leading ``[L]`` axis and the
+    forward pass is one ``lax.scan`` over layers — a single compiled layer body,
+    fast compiles, and the KV cache naturally threads through as scan xs/ys.
+  - The KV cache is one array ``[L, 2, num_pages, page_size, Hkv, D]`` donated
+    to the step functions, so XLA updates it in place.
+  - Tensor parallelism is expressed purely as NamedSharding on params/cache
+    (head-sharded) + GSPMD propagation; no explicit collectives in model code.
+  - Weight layout is ``[in, out]`` so the hot path is plain ``h @ w`` (MXU).
+
+This is the serving engine slot that the reference fills with external GPU
+engines (reference: lib/llm/src/engines/vllm/worker.rs, SURVEY.md §7 step 3) —
+here it is native.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dynamo_tpu.ops.attention import (
+    gather_pages,
+    attention_with_positions,
+    paged_decode_attention,
+    scatter_kv,
+)
+from dynamo_tpu.ops.norms import rms_norm
+from dynamo_tpu.ops.rotary import apply_rope
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: int = 128
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    tie_word_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @classmethod
+    def from_hf_config(cls, d: dict) -> "LlamaConfig":
+        """Build from a HuggingFace config.json dict."""
+        num_heads = d["num_attention_heads"]
+        return cls(
+            vocab_size=d["vocab_size"],
+            hidden_size=d["hidden_size"],
+            intermediate_size=d["intermediate_size"],
+            num_layers=d["num_hidden_layers"],
+            num_heads=num_heads,
+            num_kv_heads=d.get("num_key_value_heads", num_heads),
+            head_dim=d.get("head_dim", d["hidden_size"] // num_heads),
+            rope_theta=d.get("rope_theta", 10000.0),
+            rms_norm_eps=d.get("rms_norm_eps", 1e-5),
+            tie_word_embeddings=d.get("tie_word_embeddings", False),
+        )
+
+    @classmethod
+    def tiny(cls, **overrides) -> "LlamaConfig":
+        """Small config for tests (runs on the virtual CPU mesh in seconds)."""
+        base = cls(
+            vocab_size=256,
+            hidden_size=64,
+            intermediate_size=128,
+            num_layers=2,
+            num_heads=4,
+            num_kv_heads=2,
+            head_dim=16,
+            dtype=jnp.float32,
+        )
+        return replace(base, **overrides)
+
+
+class LlamaModel:
+    """Stateless forward functions over a params pytree."""
+
+    def __init__(self, config: LlamaConfig):
+        self.config = config
+
+    # ---------------- params ----------------
+
+    def init_params(self, rng: jax.Array) -> dict:
+        c = self.config
+        keys = iter(jax.random.split(rng, 16))
+
+        def dense(key, shape, scale_axis):
+            scale = 1.0 / jnp.sqrt(jnp.float32(shape[scale_axis]))
+            return (jax.random.normal(key, shape, jnp.float32) * scale).astype(c.dtype)
+
+        L, D, H, Hkv, Dh, F, V = (
+            c.num_layers,
+            c.hidden_size,
+            c.num_heads,
+            c.num_kv_heads,
+            c.head_dim,
+            c.intermediate_size,
+            c.vocab_size,
+        )
+        params = {
+            "embed": dense(next(keys), (V, D), 1),
+            "layers": {
+                "input_norm": jnp.ones((L, D), c.dtype),
+                "wq": dense(next(keys), (L, D, H * Dh), 1),
+                "wk": dense(next(keys), (L, D, Hkv * Dh), 1),
+                "wv": dense(next(keys), (L, D, Hkv * Dh), 1),
+                "wo": dense(next(keys), (L, H * Dh, D), 1),
+                "post_norm": jnp.ones((L, D), c.dtype),
+                "gate": dense(next(keys), (L, D, F), 1),
+                "up": dense(next(keys), (L, D, F), 1),
+                "down": dense(next(keys), (L, F, D), 1),
+            },
+            "final_norm": jnp.ones((D,), c.dtype),
+        }
+        if not c.tie_word_embeddings:
+            params["lm_head"] = dense(next(keys), (V, D), 1)
+        return params
+
+    def param_shardings(self, mesh: Mesh, tp_axis: str = "tp") -> dict:
+        """NamedSharding pytree: attention heads and MLP hidden sharded on tp."""
+
+        def ns(*spec):
+            return NamedSharding(mesh, P(*spec))
+
+        shardings = {
+            "embed": ns(None, None),
+            "layers": {
+                "input_norm": ns(None, None),
+                "wq": ns(None, None, tp_axis),
+                "wk": ns(None, None, tp_axis),
+                "wv": ns(None, None, tp_axis),
+                "wo": ns(None, tp_axis, None),
+                "post_norm": ns(None, None),
+                "gate": ns(None, None, tp_axis),
+                "up": ns(None, None, tp_axis),
+                "down": ns(None, tp_axis, None),
+            },
+            "final_norm": ns(None),
+        }
+        if not self.config.tie_word_embeddings:
+            shardings["lm_head"] = ns(tp_axis, None)
+        return shardings
+
+    def kv_cache_shape(self, num_pages: int, page_size: int) -> tuple[int, ...]:
+        c = self.config
+        return (c.num_layers, 2, num_pages, page_size, c.num_kv_heads, c.head_dim)
+
+    def init_kv_cache(self, num_pages: int, page_size: int) -> jnp.ndarray:
+        return jnp.zeros(self.kv_cache_shape(num_pages, page_size), self.config.dtype)
+
+    def kv_cache_sharding(self, mesh: Mesh, tp_axis: str = "tp") -> NamedSharding:
+        return NamedSharding(mesh, P(None, None, None, None, tp_axis, None))
+
+    # ---------------- forward ----------------
+
+    def _unembed(self, params: dict, hidden: jnp.ndarray) -> jnp.ndarray:
+        c = self.config
+        h = rms_norm(hidden, params["final_norm"], c.rms_norm_eps)
+        head = params["embed"] if c.tie_word_embeddings else params["lm_head"]
+        return jnp.einsum("td,vd->tv", h.astype(jnp.float32), head.astype(jnp.float32))
+
+    def _layer(
+        self,
+        lp: dict,
+        hidden: jnp.ndarray,  # [T, D]
+        kv: jnp.ndarray,  # [2, P, ps, Hkv, D]
+        positions: jnp.ndarray,  # [T]
+        phys_pages: jnp.ndarray,  # [T] physical page per token
+        offsets: jnp.ndarray,  # [T]
+        valid: jnp.ndarray,  # [T]
+        attn_fn,
+    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+        c = self.config
+        T = hidden.shape[0]
+        h = rms_norm(hidden, lp["input_norm"], c.rms_norm_eps)
+        q = (h @ lp["wq"]).reshape(T, c.num_heads, c.head_dim)
+        k = (h @ lp["wk"]).reshape(T, c.num_kv_heads, c.head_dim)
+        v = (h @ lp["wv"]).reshape(T, c.num_kv_heads, c.head_dim)
+        q = apply_rope(q, positions, c.rope_theta)
+        k = apply_rope(k, positions, c.rope_theta)
+        k_pages, v_pages = scatter_kv(kv[0], kv[1], k, v, phys_pages, offsets, valid)
+        attn = attn_fn(q, k_pages, v_pages)
+        hidden = hidden + (attn.reshape(T, -1) @ lp["wo"])
+        h = rms_norm(hidden, lp["post_norm"], c.rms_norm_eps)
+        mlp = (jax.nn.silu(h @ lp["gate"]) * (h @ lp["up"])) @ lp["down"]
+        hidden = hidden + mlp
+        return hidden, jnp.stack([k_pages, v_pages])
+
+    def prefill(
+        self,
+        params: dict,
+        kv_cache: jnp.ndarray,  # [L, 2, P, ps, Hkv, D] (donated)
+        tokens: jnp.ndarray,  # [T] padded chunk
+        positions: jnp.ndarray,  # [T] absolute positions
+        page_table: jnp.ndarray,  # [max_pages]
+        valid: jnp.ndarray,  # [T] bool
+        last_idx: jnp.ndarray,  # scalar: index of the final real token in chunk
+    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """One (possibly chunked) prefill pass for a single sequence.
+
+        Returns (logits[V] at last_idx, updated kv_cache).
+        """
+        page_size = kv_cache.shape[3]
+        phys = jnp.where(valid, page_table[positions // page_size], 0)
+        offsets = jnp.where(valid, positions % page_size, 0)
+
+        def attn_fn(q, k_pages, v_pages):
+            k_ctx = gather_pages(k_pages, page_table)
+            v_ctx = gather_pages(v_pages, page_table)
+            return attention_with_positions(q, k_ctx, v_ctx, positions)
+
+        hidden = params["embed"][tokens].astype(self.config.dtype)
+
+        def body(h, xs):
+            lp, kv = xs
+            return self._layer(lp, h, kv, positions, phys, offsets, valid, attn_fn)
+
+        hidden, kv_cache = jax.lax.scan(body, hidden, (params["layers"], kv_cache))
+        logits = self._unembed(params, hidden[last_idx][None, :])[0]
+        return logits, kv_cache
+
+    def decode(
+        self,
+        params: dict,
+        kv_cache: jnp.ndarray,  # [L, 2, P, ps, Hkv, D] (donated)
+        tokens: jnp.ndarray,  # [B] current token per slot
+        positions: jnp.ndarray,  # [B] its absolute position
+        page_tables: jnp.ndarray,  # [B, max_pages]
+        active: jnp.ndarray,  # [B] bool
+    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """One decode step for the whole batch. Returns (logits[B, V], kv_cache)."""
+        page_size = kv_cache.shape[3]
+        B = tokens.shape[0]
+        logical = positions // page_size
+        phys = jnp.where(active, page_tables[jnp.arange(B), logical], 0)
+        offsets = jnp.where(active, positions % page_size, 0)
+
+        def attn_fn(q, k_pages, v_pages):
+            return paged_decode_attention(q, k_pages, v_pages, page_tables, positions)
+
+        hidden = params["embed"][tokens].astype(self.config.dtype)
+
+        def body(h, xs):
+            lp, kv = xs
+            return self._layer(lp, h, kv, positions, phys, offsets, active, attn_fn)
+
+        hidden, kv_cache = jax.lax.scan(body, hidden, (params["layers"], kv_cache))
+        logits = self._unembed(params, hidden)
+        return logits, kv_cache

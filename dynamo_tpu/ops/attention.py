@@ -1,0 +1,133 @@
+"""Paged attention — pure-JAX reference implementations.
+
+The KV cache is paged: per layer, K and V live in ``[num_pages, page_size,
+num_kv_heads, head_dim]`` arrays. A sequence's logical block *i* maps to
+physical page ``page_table[i]``; because gathering ``pages[page_table]``
+restores logical order, the flattened context index *j* IS the token position,
+which keeps all masks trivially computable under jit (static shapes, no
+data-dependent control flow).
+
+Page 0 is reserved as the null/trash page by the allocator
+(dynamo_tpu/engine/page_table.py): padded page-table entries and masked-out
+scatter writes all target page 0, so no valid data is ever clobbered.
+
+The Pallas TPU kernel with the same contract lives in
+dynamo_tpu/ops/pallas/paged_attention.py; this module is the semantic
+reference and the CPU/test path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def scatter_kv(
+    k_pages: jnp.ndarray,  # [P, ps, Hkv, D]
+    v_pages: jnp.ndarray,  # [P, ps, Hkv, D]
+    k_new: jnp.ndarray,  # [T, Hkv, D]
+    v_new: jnp.ndarray,  # [T, Hkv, D]
+    phys_pages: jnp.ndarray,  # [T] int32 physical page per row (0 for dropped rows)
+    offsets: jnp.ndarray,  # [T] int32 offset within page
+    valid: jnp.ndarray,  # [T] bool — False rows write their own old value to page 0
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter new K/V rows into their physical pages."""
+    k_pages = k_pages.at[phys_pages, offsets].set(
+        jnp.where(valid[:, None, None], k_new, k_pages[phys_pages, offsets])
+    )
+    v_pages = v_pages.at[phys_pages, offsets].set(
+        jnp.where(valid[:, None, None], v_new, v_pages[phys_pages, offsets])
+    )
+    return k_pages, v_pages
+
+
+def write_kv_pages(
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+    positions: jnp.ndarray,  # [T] int32 absolute positions
+    page_table: jnp.ndarray,  # [max_pages] int32 physical page ids
+    valid: jnp.ndarray,  # [T] bool
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Position-addressed wrapper over scatter_kv for a single sequence."""
+    page_size = k_pages.shape[1]
+    phys = jnp.where(valid, page_table[positions // page_size], 0)
+    offsets = jnp.where(valid, positions % page_size, 0)
+    return scatter_kv(k_pages, v_pages, k_new, v_new, phys, offsets, valid)
+
+
+def gather_pages(pages: jnp.ndarray, page_table: jnp.ndarray) -> jnp.ndarray:
+    """[P, ps, Hkv, D] gathered by [max_pages] -> [max_pages * ps, Hkv, D]."""
+    max_pages = page_table.shape[0]
+    ps = pages.shape[1]
+    g = pages[page_table]  # [max_pages, ps, Hkv, D]
+    return g.reshape(max_pages * ps, *pages.shape[2:])
+
+
+def _repeat_kv(x: jnp.ndarray, num_q_heads: int) -> jnp.ndarray:
+    """GQA: [S, Hkv, D] -> [S, Hq, D] by repeating each kv head for its group."""
+    num_kv = x.shape[1]
+    if num_kv == num_q_heads:
+        return x
+    group = num_q_heads // num_kv
+    return jnp.repeat(x, group, axis=1)
+
+
+def attention_with_positions(
+    q: jnp.ndarray,  # [T, Hq, D]
+    k_ctx: jnp.ndarray,  # [S, Hkv, D] in logical order (index == position)
+    v_ctx: jnp.ndarray,  # [S, Hkv, D]
+    q_positions: jnp.ndarray,  # [T] int32
+) -> jnp.ndarray:
+    """Causal attention where context index j attends iff j <= q_position[t].
+
+    Softmax in float32; output cast back to q.dtype.
+    """
+    head_dim = q.shape[-1]
+    k = _repeat_kv(k_ctx, q.shape[1])
+    v = _repeat_kv(v_ctx, q.shape[1])
+    scale = 1.0 / jnp.sqrt(jnp.float32(head_dim))
+    scores = jnp.einsum("thd,shd->hts", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    ctx_idx = jnp.arange(k.shape[0], dtype=jnp.int32)
+    mask = ctx_idx[None, :] <= q_positions[:, None]  # [T, S]
+    scores = jnp.where(mask[None, :, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("hts,shd->thd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def paged_prefill_attention(
+    q: jnp.ndarray,  # [T, Hq, D] (padded chunk)
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,  # [max_pages]
+    q_positions: jnp.ndarray,  # [T] absolute positions (pad rows: anything)
+) -> jnp.ndarray:
+    """Chunk attention over all cached context + self (already written to pages)."""
+    k_ctx = gather_pages(k_pages, page_table)
+    v_ctx = gather_pages(v_pages, page_table)
+    return attention_with_positions(q, k_ctx, v_ctx, q_positions)
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,  # [B, Hq, D]
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    page_tables: jnp.ndarray,  # [B, max_pages]
+    positions: jnp.ndarray,  # [B] the query token's absolute position
+) -> jnp.ndarray:
+    """Single-token-per-sequence attention for the decode batch."""
+
+    def one(q_b, pt_b, pos_b):
+        out = attention_with_positions(
+            q_b[None, :, :],
+            gather_pages(k_pages, pt_b),
+            gather_pages(v_pages, pt_b),
+            pos_b[None],
+        )
+        return out[0]
+
+    return jax.vmap(one)(q, page_tables, positions)

@@ -1,0 +1,13 @@
+"""Normalization layers (computed in float32, cast back)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
+    """RMSNorm over the last axis; accumulates in f32 like the TPU-friendly norm."""
+    xf = x.astype(jnp.float32)
+    variance = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * jnp.reciprocal(jnp.sqrt(variance + eps))
+    return (normed * weight.astype(jnp.float32)).astype(x.dtype)

@@ -1,12 +1,23 @@
 """Test config: force an 8-device virtual CPU mesh so multi-chip sharding paths
-(tp/dp/sp) compile and execute without TPU hardware."""
+(tp/dp/sp) compile and execute without TPU hardware.
+
+Environment quirk (see .claude/skills/verify/SKILL.md): sitecustomize
+(/root/.axon_site) imports jax at interpreter startup and registers the axon TPU
+PJRT plugin, so JAX_PLATFORMS env mutations after startup are no-ops — jax read
+the env already. ``jax.config.update("jax_platforms", ...)`` is the only
+reliable way to pin the backend, and keeping the axon backend un-initialized
+also avoids flaky hangs in the TPU relay.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# XLA_FLAGS is read lazily when the CPU client is created, so setting it here
+# (before any jax operation) still works even though jax is already imported.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("DYNTPU_LOG", "warning")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
