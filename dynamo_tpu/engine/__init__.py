@@ -1,0 +1,15 @@
+"""The native JAX serving engine: paged KV cache, continuous batching, TP over a
+device mesh. Fills the slot the reference delegates to external GPU engines
+(reference: lib/llm/src/engines/, SURVEY.md §7 step 3)."""
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.sampling import SamplingParams
+
+
+async def build_async_engine(model_id: str, **overrides):
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+
+    cfg = EngineConfig.for_model(model_id, **{k: v for k, v in overrides.items() if v is not None})
+    engine = AsyncJaxEngine(cfg)
+    await engine.start()
+    return engine

@@ -1,0 +1,42 @@
+"""Engine configuration."""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+
+
+@dataclass
+class EngineConfig:
+    model_id: str = "tiny"
+    # paged KV cache; page_size doubles as the KV block size for hashing/routing
+    page_size: int = 16
+    num_pages: int = 512  # includes the reserved null page 0
+    max_seqs: int = 8  # decode batch slots
+    max_model_len: int = 2048
+    prefill_buckets: tuple = (64, 128, 256, 512)  # padded prefill chunk lengths
+    tp: int = 1  # tensor-parallel degree over the mesh
+    worker_id: str = "worker-0"
+    # fraction of pages that must stay free for decode growth before admitting
+    # a new sequence (simple admission control)
+    watermark: float = 0.05
+
+    @property
+    def max_pages_per_seq(self) -> int:
+        return -(-self.max_model_len // self.page_size)
+
+    @property
+    def max_prefill_chunk(self) -> int:
+        return max(self.prefill_buckets)
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket >= n (n must be <= max bucket)."""
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"chunk {n} exceeds max prefill bucket {self.max_prefill_chunk}")
+
+    @classmethod
+    def for_model(cls, model_id: str | None, **overrides) -> "EngineConfig":
+        return cls(model_id=model_id or "tiny", **overrides)

@@ -1,0 +1,203 @@
+"""AsyncJaxEngine: asyncio facade over the engine step loop.
+
+The step loop runs on a dedicated thread (JAX dispatch blocks); results cross
+back via loop.call_soon_threadsafe into per-request asyncio queues. This is the
+native analogue of the reference's engine subprocess + ZMQ output loop
+(reference: lib/llm/src/engines/vllm/worker.rs _output_loop) with the process
+boundary removed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import queue as thread_queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import AsyncIterator, Callable, Optional
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.page_table import PageAllocator
+from dynamo_tpu.engine.scheduler import EngineRequest, Scheduler, StepOutput
+from dynamo_tpu.llm.kv_events import KvCacheEvent
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("engine")
+
+
+@dataclass
+class ForwardPassMetrics:
+    """Worker load metrics for the KV router
+    (reference: lib/llm/src/kv_router/protocols.rs:19-33)."""
+
+    request_active_slots: int = 0
+    request_total_slots: int = 0
+    kv_active_blocks: int = 0
+    kv_total_blocks: int = 0
+    num_requests_waiting: int = 0
+    gpu_cache_usage_perc: float = 0.0  # name kept for wire compat; TPU HBM here
+    gpu_prefix_cache_hit_rate: float = 0.0
+
+    def to_wire(self) -> dict:
+        return self.__dict__.copy()
+
+
+class AsyncJaxEngine:
+    """Tokens-in/tokens-out streaming engine (the ExecutionContext contract)."""
+
+    def __init__(self, config: EngineConfig, kv_event_sink: Optional[Callable[[KvCacheEvent], None]] = None):
+        self.config = config
+        self._extra_kv_sink = kv_event_sink
+        self._kv_events: list[KvCacheEvent] = []
+        self._inbox: thread_queue.Queue = thread_queue.Queue()
+        self._cancel_box: thread_queue.Queue = thread_queue.Queue()
+        self._outputs: dict[str, asyncio.Queue] = {}
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stopping = threading.Event()
+        self._started = False
+        self.scheduler: Optional[Scheduler] = None
+        self.allocator: Optional[PageAllocator] = None
+        self.runner = None
+        self.model = None
+        self.step_count = 0
+
+    # ---------------- lifecycle ----------------
+
+    async def start(self) -> None:
+        if self._started:
+            return
+        self._loop = asyncio.get_running_loop()
+        await self._loop.run_in_executor(None, self._initialize)
+        self._thread = threading.Thread(target=self._run_loop, name="engine-loop", daemon=True)
+        self._thread.start()
+        self._started = True
+
+    def _initialize(self) -> None:
+        from dynamo_tpu.engine.model_runner import ModelRunner
+        from dynamo_tpu.models.registry import load_model
+
+        t0 = time.monotonic()
+        self.model, params = load_model(self.config.model_id)
+        self.runner = ModelRunner(self.config, self.model, params)
+        self.allocator = PageAllocator(
+            self.config.num_pages, self.config.page_size, event_sink=self._on_kv_event
+        )
+        self.scheduler = Scheduler(self.config, self.runner, self.allocator)
+        log.info(
+            "engine ready: model=%s tp=%d pages=%d (%.1fs)",
+            self.config.model_id,
+            self.config.tp,
+            self.config.num_pages,
+            time.monotonic() - t0,
+        )
+
+    async def shutdown(self) -> None:
+        self._stopping.set()
+        if self._thread is not None:
+            await asyncio.get_running_loop().run_in_executor(None, self._thread.join)
+
+    # ---------------- request API ----------------
+
+    async def generate(self, request: EngineRequest) -> AsyncIterator[StepOutput]:
+        """Submit a request; yields StepOutputs until finished."""
+        if not self._started:
+            raise RuntimeError("engine not started")
+        out_q: asyncio.Queue = asyncio.Queue()
+        # Capture the caller's loop per request: generate() may be called from a
+        # different event loop than start() (each call_soon_threadsafe must
+        # target the loop that owns the queue).
+        self._outputs[request.request_id] = (asyncio.get_running_loop(), out_q)
+        self._inbox.put(request)
+        try:
+            while True:
+                item = await out_q.get()
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+                if item.finished:
+                    return
+        finally:
+            self._outputs.pop(request.request_id, None)
+            self._cancel_box.put(request.request_id)
+
+    # ---------------- metrics / events ----------------
+
+    def metrics(self) -> ForwardPassMetrics:
+        alloc, sched = self.allocator, self.scheduler
+        if alloc is None or sched is None:
+            return ForwardPassMetrics()
+        hit_rate = (
+            alloc.cache_hit_blocks / alloc.cache_query_blocks
+            if alloc.cache_query_blocks
+            else 0.0
+        )
+        return ForwardPassMetrics(
+            request_active_slots=sched.num_running,
+            request_total_slots=self.config.max_seqs,
+            kv_active_blocks=alloc.active_pages,
+            kv_total_blocks=self.config.num_pages - 1,
+            num_requests_waiting=len(sched.waiting),
+            gpu_cache_usage_perc=alloc.used_pages / max(1, self.config.num_pages - 1),
+            gpu_prefix_cache_hit_rate=hit_rate,
+        )
+
+    def _on_kv_event(self, event: KvCacheEvent) -> None:
+        if self._extra_kv_sink is not None:
+            self._extra_kv_sink(event)
+
+    # ---------------- engine thread ----------------
+
+    def _run_loop(self) -> None:
+        while not self._stopping.is_set():
+            did_work = self._drain_inboxes()
+            if self.scheduler.has_work():
+                try:
+                    outputs = self.scheduler.step()
+                    self.step_count += 1
+                except Exception as e:  # engine-step failure: fail all running
+                    log.exception("engine step failed")
+                    self._fail_all(e)
+                    continue
+                for out in outputs:
+                    self._post(out.request_id, out)
+            elif not did_work:
+                try:
+                    req = self._inbox.get(timeout=0.02)
+                    self.scheduler.add_request(req)
+                except thread_queue.Empty:
+                    pass
+
+    def _drain_inboxes(self) -> bool:
+        got = False
+        while True:
+            try:
+                req = self._inbox.get_nowait()
+                self.scheduler.add_request(req)
+                got = True
+            except thread_queue.Empty:
+                break
+        while True:
+            try:
+                rid = self._cancel_box.get_nowait()
+                self.scheduler.cancel(rid)
+            except thread_queue.Empty:
+                break
+        return got
+
+    def _post(self, request_id: str, item) -> None:
+        entry = self._outputs.get(request_id)
+        if entry is None:
+            return
+        loop, q = entry
+        try:
+            loop.call_soon_threadsafe(q.put_nowait, item)
+        except RuntimeError:
+            # caller's loop is gone; treat as cancelled
+            self._outputs.pop(request_id, None)
+            self._cancel_box.put(request_id)
+
+    def _fail_all(self, exc: Exception) -> None:
+        for seq in [s for s in self.scheduler.slots if s is not None]:
+            self.scheduler.cancel(seq.req.request_id)
+            self._post(seq.req.request_id, exc)

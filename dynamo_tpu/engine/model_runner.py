@@ -1,0 +1,129 @@
+"""ModelRunner: owns the device mesh, sharded params, the donated paged KV
+cache, and the jitted prefill/decode+sample step functions.
+
+TPU execution notes:
+  - prefill chunks are padded to config.prefill_buckets so jit caches one
+    executable per bucket (static shapes, no recompiles per request)
+  - the KV cache is donated on every step — XLA aliases it in place
+  - sampling is fused into the step so only the sampled token ids (a few bytes)
+    cross back to host per step
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.sampling import sample_tokens
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("engine.runner")
+
+
+class ModelRunner:
+    def __init__(
+        self,
+        config: EngineConfig,
+        model,
+        params,
+        mesh: Optional[Mesh] = None,
+    ):
+        self.config = config
+        self.model = model
+        if mesh is None:
+            devices = jax.devices()[: config.tp]
+            mesh = Mesh(np.array(devices).reshape(len(devices)), ("tp",))
+        self.mesh = mesh
+        shardings = model.param_shardings(mesh)
+        self.params = jax.device_put(params, shardings)
+        kv_sharding = model.kv_cache_sharding(mesh)
+        self.kv_cache = jax.device_put(
+            model.init_kv_cache(config.num_pages, config.page_size), kv_sharding
+        )
+        self._replicated = NamedSharding(mesh, P())
+        self._key = jax.random.key(0)
+
+        self._prefill = jax.jit(self._prefill_impl, donate_argnums=(1,))
+        self._decode = jax.jit(self._decode_impl, donate_argnums=(1,))
+
+    # ---------------- jitted bodies ----------------
+
+    def _prefill_impl(self, params, kv, tokens, positions, page_table, valid, last_idx, key, temp, top_k, top_p):
+        logits, kv = self.model.prefill(params, kv, tokens, positions, page_table, valid, last_idx)
+        tok = sample_tokens(logits[None, :], key, temp[None], top_k[None], top_p[None])[0]
+        return tok, kv
+
+    def _decode_impl(self, params, kv, tokens, positions, page_tables, active, key, temps, top_ks, top_ps):
+        logits, kv = self.model.decode(params, kv, tokens, positions, page_tables, active)
+        toks = sample_tokens(logits, key, temps, top_ks, top_ps)
+        return toks, kv
+
+    # ---------------- host API (engine thread) ----------------
+
+    def _next_key(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def prefill_chunk(
+        self,
+        tokens: np.ndarray,  # [n] real tokens for this chunk
+        start_pos: int,
+        page_table: np.ndarray,  # [max_pages_per_seq]
+        sample: bool,
+        temperature: float,
+        top_k: int,
+        top_p: float,
+    ) -> Optional[int]:
+        """Run one prefill chunk; returns the sampled next token when `sample`."""
+        n = len(tokens)
+        bucket = self.config.bucket_for(n)
+        buf = np.zeros(bucket, np.int32)
+        buf[:n] = tokens
+        positions = start_pos + np.arange(bucket, dtype=np.int32)
+        valid = np.arange(bucket) < n
+        tok, self.kv_cache = self._prefill(
+            self.params,
+            self.kv_cache,
+            jnp.asarray(buf),
+            jnp.asarray(positions),
+            jnp.asarray(page_table),
+            jnp.asarray(valid),
+            jnp.asarray(n - 1, jnp.int32),
+            self._next_key(),
+            jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(top_k, jnp.int32),
+            jnp.asarray(top_p, jnp.float32),
+        )
+        if sample:
+            return int(jax.device_get(tok))
+        return None
+
+    def decode_step(
+        self,
+        tokens: np.ndarray,  # [B]
+        positions: np.ndarray,  # [B]
+        page_tables: np.ndarray,  # [B, max_pages_per_seq]
+        active: np.ndarray,  # [B] bool
+        temps: np.ndarray,
+        top_ks: np.ndarray,
+        top_ps: np.ndarray,
+    ) -> np.ndarray:
+        toks, self.kv_cache = self._decode(
+            self.params,
+            self.kv_cache,
+            jnp.asarray(tokens),
+            jnp.asarray(positions),
+            jnp.asarray(page_tables),
+            jnp.asarray(active),
+            self._next_key(),
+            jnp.asarray(temps),
+            jnp.asarray(top_ks),
+            jnp.asarray(top_ps),
+        )
+        return np.asarray(jax.device_get(toks))

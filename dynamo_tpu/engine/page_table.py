@@ -1,0 +1,252 @@
+"""Paged KV block allocator with prefix caching and KV event emission.
+
+The worker-side analogue of the reference's KV block manager
+(reference: lib/llm/src/kv/{manager,reuse,reserved}.rs semantics) fused with
+vLLM-style prefix caching, re-designed for the JAX engine:
+
+  - physical page 0 is reserved as the null/trash page (masked writes and
+    page-table padding target it — see dynamo_tpu/ops/attention.py)
+  - full blocks are identified by their chained sequence hash
+    (dynamo_tpu/llm/tokens.py); a completed block's page is registered in the
+    prefix cache and can be shared (refcounted) by later sequences
+  - refcount-0 cached pages form an LRU "reuse pool": they still serve prefix
+    hits but are reclaimed when fresh pages run out
+    (reference: lib/llm/src/kv/reuse.rs:50 AvailableBlocks priority reuse)
+  - block store / evict emit KvCacheEvents for the KV router's global index
+    (reference: lib/llm/src/kv_router/protocols.rs:35-100, publisher.rs:33-74)
+
+Pure Python bookkeeping — device arrays never flow through here; the scheduler
+translates page ids into jnp page tables.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from dynamo_tpu.llm.tokens import TokenBlock, TokenSequence
+from dynamo_tpu.llm.kv_events import KvCacheEvent, StoredBlock
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("engine.pages")
+
+
+@dataclass
+class SequencePages:
+    """Page state for one live sequence."""
+
+    seq_id: str
+    pages: list[int] = field(default_factory=list)  # logical block i -> physical page
+    shared_prefix_pages: int = 0  # leading pages refcounted from the prefix cache
+    token_seq: Optional[TokenSequence] = None  # hashing state (block_size = page_size)
+    registered_hashes: list[int] = field(default_factory=list)  # sequence hashes we cached
+
+    @property
+    def num_pages(self) -> int:
+        return len(self.pages)
+
+
+class PageAllocator:
+    """Physical page allocator + prefix cache for one engine's KV cache."""
+
+    def __init__(
+        self,
+        num_pages: int,
+        page_size: int,
+        event_sink: Optional[Callable[[KvCacheEvent], None]] = None,
+    ):
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is reserved)")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.event_sink = event_sink
+        self._free: list[int] = list(range(num_pages - 1, 0, -1))  # stack; page 0 reserved
+        # sequence_hash -> physical page holding that full block
+        self._cache: dict[int, int] = {}
+        self._cache_meta: dict[int, StoredBlock] = {}  # seq_hash -> event payload
+        self._refcount: dict[int, int] = {}  # physical page -> live users
+        # refcount-0 cached blocks, LRU order (oldest first): seq_hash -> page
+        self._reusable: OrderedDict[int, int] = OrderedDict()
+        self._seqs: dict[str, SequencePages] = {}
+        # stats
+        self.cache_hit_blocks = 0
+        self.cache_query_blocks = 0
+
+    # ------------- capacity -------------
+
+    @property
+    def free_pages(self) -> int:
+        """Immediately + reclaimably free pages."""
+        return len(self._free) + len(self._reusable)
+
+    @property
+    def used_pages(self) -> int:
+        return (self.num_pages - 1) - len(self._free)
+
+    @property
+    def active_pages(self) -> int:
+        """Pages referenced by live sequences."""
+        return (self.num_pages - 1) - len(self._free) - len(self._reusable)
+
+    def _pop_free_page(self) -> int:
+        if self._free:
+            return self._free.pop()
+        # Reclaim the least-recently-used refcount-0 cached block.
+        if self._reusable:
+            seq_hash, page = self._reusable.popitem(last=False)
+            del self._cache[seq_hash]
+            meta = self._cache_meta.pop(seq_hash)
+            self._emit(KvCacheEvent.removed([meta.block_hash]))
+            return page
+        raise MemoryError("out of KV pages")
+
+    # ------------- events -------------
+
+    def _emit(self, event: KvCacheEvent) -> None:
+        if self.event_sink is not None:
+            self.event_sink(event)
+
+    # ------------- sequence lifecycle -------------
+
+    def lookup_prefix(self, prompt_tokens: list[int]) -> int:
+        """Number of leading tokens already cached (block granularity), without
+        allocating. Used by the disagg router's prefix-hit estimate."""
+        ts = TokenSequence(prompt_tokens, self.page_size)
+        hits = 0
+        for block in ts.blocks:
+            if block.sequence_hash in self._cache:
+                hits += 1
+            else:
+                break
+        return hits * self.page_size
+
+    def allocate_sequence(self, seq_id: str, prompt_tokens: list[int]) -> tuple[int, SequencePages]:
+        """Allocate pages for a prompt, reusing cached prefix blocks.
+
+        Returns (cached_len, seq_state): the first cached_len tokens already
+        have KV in shared pages and must NOT be recomputed (except the last
+        token if the full prompt hits, so there is always something to prefill).
+        """
+        if seq_id in self._seqs:
+            raise ValueError(f"sequence {seq_id} already allocated")
+        ts = TokenSequence(prompt_tokens, self.page_size)
+        state = SequencePages(seq_id=seq_id, token_seq=ts)
+
+        # 1. prefix hits: chain of full blocks present in cache
+        cached_pages: list[int] = []
+        for block in ts.blocks:
+            page = self._cache.get(block.sequence_hash)
+            if page is None:
+                break
+            cached_pages.append(page)
+        self.cache_query_blocks += len(ts.blocks)
+        self.cache_hit_blocks += len(cached_pages)
+
+        # Never consume the *entire* prompt from cache: leave at least the last
+        # token to prefill so the model produces next-token logits.
+        if cached_pages and len(cached_pages) * self.page_size >= len(prompt_tokens):
+            cached_pages.pop()
+
+        for page in cached_pages:
+            self._ref_page(page)
+        state.pages.extend(cached_pages)
+        state.shared_prefix_pages = len(cached_pages)
+        cached_len = len(cached_pages) * self.page_size
+
+        # 2. fresh pages for the rest of the prompt
+        try:
+            total_pages_needed = -(-len(prompt_tokens) // self.page_size)
+            while len(state.pages) < total_pages_needed:
+                page = self._pop_free_page()
+                self._refcount[page] = 1
+                state.pages.append(page)
+        except MemoryError:
+            self._rollback(state)
+            raise
+
+        # Blocks completed by the prompt itself (all but what the prefix cache
+        # already holds) get registered once their KV is actually computed —
+        # the scheduler calls commit_prefilled().
+        self._seqs[seq_id] = state
+        return cached_len, state
+
+    def _rollback(self, state: SequencePages) -> None:
+        for page in state.pages:
+            self._unref_page(page, evictable_hash=None)
+
+    def commit_prefilled(self, seq_id: str, prompt_len: int) -> None:
+        """Register all full blocks covered by the (now computed) prompt KV."""
+        state = self._seqs[seq_id]
+        full_blocks = prompt_len // self.page_size
+        for i in range(state.shared_prefix_pages, full_blocks):
+            block = state.token_seq.blocks[i]
+            self._register_block(state, block, state.pages[i])
+
+    def ensure_capacity(self, seq_id: str, length: int) -> bool:
+        """Make sure pages exist to hold `length` tokens. False if OOM."""
+        state = self._seqs[seq_id]
+        needed = -(-length // self.page_size)
+        while state.num_pages < needed:
+            try:
+                page = self._pop_free_page()
+            except MemoryError:
+                return False
+            self._refcount[page] = 1
+            state.pages.append(page)
+        return True
+
+    def append_token(self, seq_id: str, token: int) -> None:
+        """Track a decoded token; registers its block in the cache when full."""
+        state = self._seqs[seq_id]
+        block = state.token_seq.push_token(token)
+        if block is not None:
+            idx = len(state.token_seq.blocks) - 1
+            if idx < len(state.pages):
+                self._register_block(state, block, state.pages[idx])
+
+    def free_sequence(self, seq_id: str) -> None:
+        """Release a sequence. Full cached blocks become reusable (LRU);
+        uncached pages return to the free list immediately."""
+        state = self._seqs.pop(seq_id)
+        page_to_hash = {}
+        for i, block in enumerate(state.token_seq.blocks):
+            if i < len(state.pages) and block.sequence_hash in self._cache and self._cache[block.sequence_hash] == state.pages[i]:
+                page_to_hash[state.pages[i]] = block.sequence_hash
+        for page in state.pages:
+            self._unref_page(page, evictable_hash=page_to_hash.get(page))
+
+    # ------------- internals -------------
+
+    def _ref_page(self, page: int) -> None:
+        self._refcount[page] = self._refcount.get(page, 0) + 1
+        # a cached page in the reusable pool that regains a user leaves the pool
+        for seq_hash, p in list(self._reusable.items()):
+            if p == page:
+                del self._reusable[seq_hash]
+                break
+
+    def _unref_page(self, page: int, evictable_hash: Optional[int]) -> None:
+        rc = self._refcount.get(page, 0) - 1
+        if rc > 0:
+            self._refcount[page] = rc
+            return
+        self._refcount.pop(page, None)
+        if evictable_hash is not None and self._cache.get(evictable_hash) == page:
+            self._reusable[evictable_hash] = page  # cached, reclaimable, LRU tail
+            self._reusable.move_to_end(evictable_hash)
+        else:
+            self._free.append(page)
+
+    def _register_block(self, state: SequencePages, block: TokenBlock, page: int) -> None:
+        if block.sequence_hash in self._cache:
+            return  # dedupe: first writer wins, our copy stays private
+        self._cache[block.sequence_hash] = page
+        meta = StoredBlock(
+            block_hash=block.sequence_hash,
+            tokens_hash=block.block_hash,
+            parent_hash=block.parent_sequence_hash,
+        )
+        self._cache_meta[block.sequence_hash] = meta
+        state.registered_hashes.append(block.sequence_hash)
+        self._emit(KvCacheEvent.stored(parent_hash=block.parent_sequence_hash, blocks=[meta]))

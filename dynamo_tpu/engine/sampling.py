@@ -1,0 +1,65 @@
+"""Sampling under jit: greedy / temperature / top-k / top-p, fully batched.
+
+Per-slot sampling parameters are arrays so one compiled function serves any mix
+of requests (no recompiles on parameter changes, XLA-friendly static shapes).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+@dataclass
+class SamplingParams:
+    """Per-request sampling options (reference: lib/llm/src/protocols/common.rs
+    SamplingOptions/StopConditions)."""
+
+    temperature: float = 0.0  # 0 => greedy
+    top_k: int = 0  # 0 => disabled
+    top_p: float = 1.0  # 1.0 => disabled
+    max_tokens: int = 512
+    stop: Sequence[str] = ()
+    seed: Optional[int] = None
+    ignore_eos: bool = False
+
+
+def sample_tokens(
+    logits: jnp.ndarray,  # [B, V] float32
+    key: jax.Array,
+    temperature: jnp.ndarray,  # [B]
+    top_k: jnp.ndarray,  # [B] int32 (0 = off)
+    top_p: jnp.ndarray,  # [B] (1.0 = off)
+) -> jnp.ndarray:
+    """Sample one token per slot. Greedy where temperature <= 0."""
+    B, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+
+    # Sort once (descending); both top-k and top-p become rank/cdf thresholds.
+    sorted_logits = -jnp.sort(-logits, axis=-1)  # [B, V] descending
+    ranks = jnp.arange(V, dtype=jnp.int32)
+
+    # top-k: keep entries with logit >= k-th largest value
+    k = jnp.where(top_k > 0, jnp.clip(top_k, 1, V), V)
+    kth_value = jnp.take_along_axis(sorted_logits, (k - 1)[:, None], axis=-1)  # [B,1]
+    keep_k = logits >= kth_value
+
+    # top-p: over the sorted distribution (temperature-scaled), keep the prefix
+    # whose cumulative probability is < p (always keeping the first)
+    temp = jnp.where(temperature > 0, temperature, 1.0)[:, None]
+    sorted_probs = jax.nn.softmax(sorted_logits / temp, axis=-1)
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    sorted_keep = (cum - sorted_probs) < top_p[:, None]  # prefix incl. first
+    # threshold value = smallest kept logit in sorted order
+    num_keep = jnp.maximum(jnp.sum(sorted_keep, axis=-1), 1)
+    p_value = jnp.take_along_axis(sorted_logits, (num_keep - 1)[:, None], axis=-1)
+    keep_p = logits >= p_value
+
+    masked = jnp.where(keep_k & keep_p, logits, _NEG_INF)
+    sampled = jax.random.categorical(key, masked / temp)
+    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)

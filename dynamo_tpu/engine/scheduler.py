@@ -1,0 +1,298 @@
+"""Continuous-batching scheduler: admission, chunked prefill with prefix-cache
+reuse, batched decode, preemption.
+
+Policy (round 1, deliberately simple):
+  - admit waiting requests whenever a decode slot and enough pages exist
+    (watermark guard keeps headroom for decode growth)
+  - prefill runs chunk-by-chunk through bucket-padded jit calls; the cached
+    prefix (from the page allocator) is skipped, mirroring the reference's
+    prefix-hit accounting used for routing/disagg decisions
+  - on page exhaustion mid-decode, the most-recently-admitted sequence is
+    preempted back to the waiting queue (prompt = original + generated so far)
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.page_table import PageAllocator
+from dynamo_tpu.engine.sampling import SamplingParams
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("engine.sched")
+
+
+@dataclass
+class EngineRequest:
+    """Tokens-in/tokens-out request (the ExecutionContext contract,
+    reference: lib/llm/src/backend.rs:60-63)."""
+
+    request_id: str
+    token_ids: list[int]
+    sampling: SamplingParams = field(default_factory=SamplingParams)
+    eos_token_ids: tuple[int, ...] = ()
+
+
+@dataclass
+class StepOutput:
+    request_id: str
+    token: Optional[int] = None
+    finished: bool = False
+    finish_reason: Optional[str] = None  # stop | length | error | preempted
+    cached_tokens: int = 0  # prefix-cache hit length (first output only)
+
+
+@dataclass
+class RunningSeq:
+    req: EngineRequest
+    slot: int
+    prompt_len: int
+    cached_len: int
+    generated: list[int] = field(default_factory=list)
+    page_table: np.ndarray = None  # [max_pages_per_seq]
+    admitted_order: int = 0
+
+    @property
+    def pos(self) -> int:
+        """Position of the next token to be decoded."""
+        return self.prompt_len + len(self.generated)
+
+
+class Scheduler:
+    def __init__(self, config: EngineConfig, runner, allocator: PageAllocator):
+        self.config = config
+        self.runner = runner
+        self.allocator = allocator
+        self.waiting: deque[EngineRequest] = deque()
+        self.slots: list[Optional[RunningSeq]] = [None] * config.max_seqs
+        self._admit_counter = 0
+        self.finished_count = 0
+
+    # ---------------- queue ----------------
+
+    def add_request(self, req: EngineRequest) -> None:
+        self.waiting.append(req)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting) or any(s is not None for s in self.slots)
+
+    @property
+    def num_running(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    def cancel(self, request_id: str) -> bool:
+        for i, s in enumerate(self.slots):
+            if s is not None and s.req.request_id == request_id:
+                self.allocator.free_sequence(s.req.request_id)
+                self.slots[i] = None
+                return True
+        for req in list(self.waiting):
+            if req.request_id == request_id:
+                self.waiting.remove(req)
+                return True
+        return False
+
+    # ---------------- main loop step ----------------
+
+    def step(self) -> list[StepOutput]:
+        outputs: list[StepOutput] = []
+        outputs.extend(self._admit())
+        outputs.extend(self._decode())
+        return outputs
+
+    # ---------------- admission + prefill ----------------
+
+    def _free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self.slots):
+            if s is None:
+                return i
+        return None
+
+    def _admit(self) -> list[StepOutput]:
+        outputs = []
+        watermark_pages = int(self.config.watermark * self.config.num_pages)
+        while self.waiting:
+            slot = self._free_slot()
+            if slot is None:
+                break
+            req = self.waiting[0]
+            if len(req.token_ids) > self.config.max_model_len:
+                self.waiting.popleft()
+                outputs.append(
+                    StepOutput(req.request_id, finished=True, finish_reason="error")
+                )
+                continue
+            pages_needed = -(-len(req.token_ids) // self.config.page_size)
+            if self.allocator.free_pages < pages_needed + watermark_pages:
+                break
+            self.waiting.popleft()
+            try:
+                outputs.extend(self._start_sequence(req, slot))
+            except MemoryError:
+                self.waiting.appendleft(req)
+                break
+        return outputs
+
+    def _start_sequence(self, req: EngineRequest, slot: int) -> list[StepOutput]:
+        cached_len, state = self.allocator.allocate_sequence(req.request_id, req.token_ids)
+        prompt_len = len(req.token_ids)
+        page_table = np.zeros(self.config.max_pages_per_seq, np.int32)
+        page_table[: len(state.pages)] = state.pages
+
+        seq = RunningSeq(
+            req=req,
+            slot=slot,
+            prompt_len=prompt_len,
+            cached_len=cached_len,
+            page_table=page_table,
+            admitted_order=self._admit_counter,
+        )
+        self._admit_counter += 1
+
+        # chunked prefill, skipping the cached prefix
+        s = req.sampling
+        first_token: Optional[int] = None
+        start = cached_len
+        max_chunk = self.config.max_prefill_chunk
+        while start < prompt_len:
+            end = min(start + max_chunk, prompt_len)
+            is_last = end == prompt_len
+            tok = self.runner.prefill_chunk(
+                np.asarray(req.token_ids[start:end], np.int32),
+                start_pos=start,
+                page_table=page_table,
+                sample=is_last,
+                temperature=s.temperature,
+                top_k=s.top_k,
+                top_p=s.top_p,
+            )
+            if is_last:
+                first_token = tok
+            start = end
+
+        self.allocator.commit_prefilled(req.request_id, prompt_len)
+        self.slots[slot] = seq
+        return self._emit_token(seq, first_token, cached=cached_len)
+
+    # ---------------- decode ----------------
+
+    def _decode(self) -> list[StepOutput]:
+        outputs: list[StepOutput] = []
+
+        # Each active sequence feeds its last generated token, whose KV lands at
+        # position seq.pos - 1, so the sequence needs capacity for seq.pos tokens.
+        for seq in sorted(
+            [s for s in self.slots if s is not None], key=lambda s: s.admitted_order
+        ):
+            if self.slots[seq.slot] is not seq:
+                continue  # already preempted as a victim this step
+            while self.slots[seq.slot] is seq and not self.allocator.ensure_capacity(
+                seq.req.request_id, seq.pos
+            ):
+                victim = self._pick_victim(exclude=seq)
+                if victim is None:
+                    outputs.extend(self._finish(seq, "error"))
+                    break
+                outputs.extend(self._preempt(victim))
+            if self.slots[seq.slot] is seq:
+                state = self.allocator._seqs[seq.req.request_id]
+                seq.page_table[: len(state.pages)] = state.pages
+
+        active_seqs = [s for s in self.slots if s is not None]
+        if not active_seqs:
+            return outputs
+
+        B = self.config.max_seqs
+        tokens = np.zeros(B, np.int32)
+        positions = np.zeros(B, np.int32)
+        page_tables = np.zeros((B, self.config.max_pages_per_seq), np.int32)
+        active = np.zeros(B, bool)
+        temps = np.zeros(B, np.float32)
+        top_ks = np.zeros(B, np.int32)
+        top_ps = np.ones(B, np.float32)
+
+        for seq in active_seqs:
+            i = seq.slot
+            # Feed the last sampled token: its KV is written at seq.pos - 1,
+            # attention covers <= pos-1, and the step samples the next token.
+            tokens[i] = seq.generated[-1]
+            positions[i] = seq.pos - 1
+            page_tables[i] = seq.page_table
+            active[i] = True
+            temps[i] = seq.req.sampling.temperature
+            top_ks[i] = seq.req.sampling.top_k
+            top_ps[i] = seq.req.sampling.top_p
+
+        new_tokens = self.runner.decode_step(
+            tokens, positions, page_tables, active, temps, top_ks, top_ps
+        )
+
+        for seq in active_seqs:
+            outputs.extend(self._emit_token(seq, int(new_tokens[seq.slot])))
+        return outputs
+
+    # ---------------- helpers ----------------
+
+    def _emit_token(self, seq: RunningSeq, token: Optional[int], cached: int = 0) -> list[StepOutput]:
+        if token is None:
+            return []
+        req = seq.req
+        seq.generated.append(token)
+        self.allocator.append_token(req.request_id, token)
+        finish: Optional[str] = None
+        if (not req.sampling.ignore_eos) and req.eos_token_ids and token in req.eos_token_ids:
+            finish = "stop"
+        elif len(seq.generated) >= req.sampling.max_tokens:
+            finish = "length"
+        elif seq.pos >= self.config.max_model_len:
+            finish = "length"
+        out = StepOutput(req.request_id, token=token, cached_tokens=cached)
+        if finish is not None:
+            out.finished = True
+            out.finish_reason = finish
+            self._release(seq)
+        return [out]
+
+    def _finish(self, seq: RunningSeq, reason: str) -> list[StepOutput]:
+        self._release(seq)
+        return [StepOutput(seq.req.request_id, finished=True, finish_reason=reason)]
+
+    def _release(self, seq: RunningSeq) -> None:
+        self.allocator.free_sequence(seq.req.request_id)
+        self.slots[seq.slot] = None
+        self.finished_count += 1
+
+    def _pick_victim(self, exclude: RunningSeq) -> Optional[RunningSeq]:
+        candidates = [s for s in self.slots if s is not None and s is not exclude]
+        if not candidates:
+            return None
+        return max(candidates, key=lambda s: s.admitted_order)
+
+    def _preempt(self, seq: RunningSeq) -> list[StepOutput]:
+        """Return a sequence to the waiting queue; its work restarts later
+        (prefix cache usually recovers most of it)."""
+        log.info("preempting %s (page pressure)", seq.req.request_id)
+        self.allocator.free_sequence(seq.req.request_id)
+        self.slots[seq.slot] = None
+        new_req = EngineRequest(
+            request_id=seq.req.request_id,
+            token_ids=list(seq.req.token_ids) + seq.generated,
+            sampling=seq.req.sampling,
+            eos_token_ids=seq.req.eos_token_ids,
+        )
+        # already-generated tokens count against max_tokens when it resumes
+        new_req.sampling = SamplingParams(
+            temperature=seq.req.sampling.temperature,
+            top_k=seq.req.sampling.top_k,
+            top_p=seq.req.sampling.top_p,
+            max_tokens=max(1, seq.req.sampling.max_tokens - len(seq.generated)),
+            stop=seq.req.sampling.stop,
+            ignore_eos=seq.req.sampling.ignore_eos,
+        )
+        self.waiting.appendleft(new_req)
+        return []

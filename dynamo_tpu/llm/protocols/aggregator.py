@@ -1,0 +1,80 @@
+"""Stream -> unary aggregation: folds chat/completion chunks into a full
+response object for ``stream=false`` clients.
+
+Mirrors the reference aggregators (reference: lib/llm/src/protocols/openai/
+chat_completions/aggregator.rs:1-462): the service always streams internally
+and aggregates at the edge.
+"""
+
+from __future__ import annotations
+
+from typing import AsyncIterator, Optional
+
+
+def _base_from_chunk(chunk: dict, object_name: str) -> dict:
+    return {
+        "id": chunk.get("id"),
+        "object": object_name,
+        "created": chunk.get("created"),
+        "model": chunk.get("model"),
+        "choices": [],
+    }
+
+
+async def aggregate_chat_stream(chunks: AsyncIterator[dict]) -> dict:
+    """Fold chat.completion.chunk dicts into one chat.completion response."""
+    out: Optional[dict] = None
+    content: list[str] = []
+    role = "assistant"
+    finish_reason = None
+    usage = None
+    async for chunk in chunks:
+        if out is None:
+            out = _base_from_chunk(chunk, "chat.completion")
+        for choice in chunk.get("choices", []):
+            delta = choice.get("delta") or {}
+            if delta.get("role"):
+                role = delta["role"]
+            if delta.get("content"):
+                content.append(delta["content"])
+            if choice.get("finish_reason"):
+                finish_reason = choice["finish_reason"]
+        if chunk.get("usage"):
+            usage = chunk["usage"]
+    if out is None:
+        raise ValueError("empty stream")
+    out["choices"] = [
+        {
+            "index": 0,
+            "message": {"role": role, "content": "".join(content)},
+            "finish_reason": finish_reason,
+        }
+    ]
+    if usage:
+        out["usage"] = usage
+    return out
+
+
+async def aggregate_completion_stream(chunks: AsyncIterator[dict]) -> dict:
+    out: Optional[dict] = None
+    text: list[str] = []
+    finish_reason = None
+    usage = None
+    async for chunk in chunks:
+        if out is None:
+            out = _base_from_chunk(chunk, "text_completion")
+        for choice in chunk.get("choices", []):
+            if choice.get("text"):
+                text.append(choice["text"])
+            if choice.get("finish_reason"):
+                finish_reason = choice["finish_reason"]
+        if chunk.get("usage"):
+            usage = chunk["usage"]
+    if out is None:
+        raise ValueError("empty stream")
+    out["choices"] = [
+        {"index": 0, "text": "".join(text), "finish_reason": finish_reason, "logprobs": None}
+    ]
+    if usage:
+        out["usage"] = usage
+    return out

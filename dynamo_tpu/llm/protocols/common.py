@@ -1,0 +1,80 @@
+"""Engine-facing internal protocols.
+
+Mirrors the reference's common protocol types (reference: lib/llm/src/protocols/
+common/preprocessor.rs:25 PreprocessedRequest, common/llm_backend.rs:27,61
+BackendOutput/LLMEngineOutput, common.rs StopConditions/SamplingOptions).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from dynamo_tpu.engine.sampling import SamplingParams
+
+
+@dataclass
+class PreprocessedRequest:
+    """Tokenized request flowing preprocessor -> router -> engine."""
+
+    request_id: str
+    token_ids: list[int]
+    sampling: SamplingParams = field(default_factory=SamplingParams)
+    eos_token_ids: tuple[int, ...] = ()
+    stop_strings: tuple[str, ...] = ()
+    annotations: tuple[str, ...] = ()
+    model: Optional[str] = None
+
+    def to_wire(self) -> dict:
+        return {
+            "request_id": self.request_id,
+            "token_ids": self.token_ids,
+            "sampling": {
+                "temperature": self.sampling.temperature,
+                "top_k": self.sampling.top_k,
+                "top_p": self.sampling.top_p,
+                "max_tokens": self.sampling.max_tokens,
+                "ignore_eos": self.sampling.ignore_eos,
+                "seed": self.sampling.seed,
+            },
+            "eos_token_ids": list(self.eos_token_ids),
+            "stop_strings": list(self.stop_strings),
+            "annotations": list(self.annotations),
+            "model": self.model,
+        }
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "PreprocessedRequest":
+        s = d.get("sampling", {})
+        return cls(
+            request_id=d["request_id"],
+            token_ids=list(d["token_ids"]),
+            sampling=SamplingParams(
+                temperature=s.get("temperature", 0.0),
+                top_k=s.get("top_k", 0),
+                top_p=s.get("top_p", 1.0),
+                max_tokens=s.get("max_tokens", 512),
+                ignore_eos=s.get("ignore_eos", False),
+                seed=s.get("seed"),
+            ),
+            eos_token_ids=tuple(d.get("eos_token_ids", ())),
+            stop_strings=tuple(d.get("stop_strings", ())),
+            annotations=tuple(d.get("annotations", ())),
+            model=d.get("model"),
+        )
+
+
+@dataclass
+class BackendOutput:
+    """Detokenized stream item: text delta + token ids + finish state."""
+
+    request_id: str
+    text: str = ""
+    token_ids: list[int] = field(default_factory=list)
+    finish_reason: Optional[str] = None  # stop | length | error | cancelled
+    cumulative_tokens: int = 0
+    cached_tokens: int = 0
+
+    @property
+    def finished(self) -> bool:
+        return self.finish_reason is not None

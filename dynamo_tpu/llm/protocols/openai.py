@@ -1,0 +1,235 @@
+"""OpenAI-compatible protocol types: chat completions + completions.
+
+Mirrors the reference's protocol surface (reference: lib/llm/src/protocols/openai/
+chat_completions.rs, completions.rs, and the `nvext` extension) as plain Python
+dataclasses with dict (de)serialization. The extension field is ``ext``
+(accepted under both ``ext`` and ``nvext`` for wire compat): ignore_eos,
+greed-sampling knobs, annotations.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+class ProtocolError(ValueError):
+    """400-level request validation error."""
+
+
+@dataclass
+class ChatMessage:
+    role: str
+    content: str | list | None = None
+    name: Optional[str] = None
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ChatMessage":
+        if not isinstance(d, dict) or "role" not in d:
+            raise ProtocolError("message must be an object with a 'role'")
+        return cls(role=d["role"], content=d.get("content"), name=d.get("name"))
+
+    def to_dict(self) -> dict:
+        out = {"role": self.role, "content": self.content}
+        if self.name:
+            out["name"] = self.name
+        return out
+
+
+@dataclass
+class Ext:
+    """Extension options (analogue of the reference's nvext)."""
+
+    ignore_eos: bool = False
+    top_k: int = 0
+    annotations: list[str] = field(default_factory=list)
+    greedy: bool = False
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "Ext":
+        if not d:
+            return cls()
+        return cls(
+            ignore_eos=bool(d.get("ignore_eos", False)),
+            top_k=int(d.get("top_k", 0)),
+            annotations=list(d.get("annotations", [])),
+            greedy=bool(d.get("greedy", False)),
+        )
+
+
+def _common_fields(d: dict) -> dict:
+    def positive(name, val, maxv=None):
+        if val is not None:
+            if not isinstance(val, (int, float)) or val < 0:
+                raise ProtocolError(f"{name} must be a non-negative number")
+            if maxv is not None and val > maxv:
+                raise ProtocolError(f"{name} must be <= {maxv}")
+        return val
+
+    stop = d.get("stop")
+    if stop is None:
+        stop = []
+    elif isinstance(stop, str):
+        stop = [stop]
+    elif isinstance(stop, list):
+        if not all(isinstance(s, str) for s in stop):
+            raise ProtocolError("stop must be a string or list of strings")
+    else:
+        raise ProtocolError("stop must be a string or list of strings")
+
+    return dict(
+        model=d.get("model"),
+        stream=bool(d.get("stream", False)),
+        max_tokens=d.get("max_completion_tokens", d.get("max_tokens")),
+        temperature=positive("temperature", d.get("temperature"), 2.0),
+        top_p=positive("top_p", d.get("top_p"), 1.0),
+        seed=d.get("seed"),
+        stop=stop,
+        n=int(d.get("n", 1)),
+        logprobs=d.get("logprobs"),
+        user=d.get("user"),
+        ext=Ext.from_dict(d.get("ext") or d.get("nvext")),
+    )
+
+
+@dataclass
+class ChatCompletionRequest:
+    messages: list[ChatMessage]
+    model: Optional[str] = None
+    stream: bool = False
+    max_tokens: Optional[int] = None
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    seed: Optional[int] = None
+    stop: list[str] = field(default_factory=list)
+    n: int = 1
+    logprobs: Any = None
+    user: Optional[str] = None
+    ext: Ext = field(default_factory=Ext)
+    tools: Optional[list] = None
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ChatCompletionRequest":
+        msgs = d.get("messages")
+        if not isinstance(msgs, list) or not msgs:
+            raise ProtocolError("messages must be a non-empty array")
+        common = _common_fields(d)
+        if common["n"] != 1:
+            raise ProtocolError("n > 1 is not supported")
+        return cls(messages=[ChatMessage.from_dict(m) for m in msgs], tools=d.get("tools"), **common)
+
+
+@dataclass
+class CompletionRequest:
+    prompt: str | list
+    model: Optional[str] = None
+    stream: bool = False
+    max_tokens: Optional[int] = None
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    seed: Optional[int] = None
+    stop: list[str] = field(default_factory=list)
+    n: int = 1
+    logprobs: Any = None
+    user: Optional[str] = None
+    ext: Ext = field(default_factory=Ext)
+    echo: bool = False
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "CompletionRequest":
+        prompt = d.get("prompt")
+        if prompt is None:
+            raise ProtocolError("prompt is required")
+        common = _common_fields(d)
+        if common["n"] != 1:
+            raise ProtocolError("n > 1 is not supported")
+        return cls(prompt=prompt, echo=bool(d.get("echo", False)), **common)
+
+
+# ---------------------------------------------------------------- responses
+
+
+def _now() -> int:
+    return int(time.time())
+
+
+def new_id(prefix: str) -> str:
+    return f"{prefix}-{uuid.uuid4().hex[:24]}"
+
+
+@dataclass
+class Usage:
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "prompt_tokens": self.prompt_tokens,
+            "completion_tokens": self.completion_tokens,
+            "total_tokens": self.prompt_tokens + self.completion_tokens,
+        }
+
+
+class ChatDeltaGenerator:
+    """Builds chat.completion.chunk dicts for a streaming response
+    (reference: lib/llm/src/protocols/openai/chat_completions/delta.rs)."""
+
+    def __init__(self, model: str, request_id: Optional[str] = None):
+        self.id = request_id or new_id("chatcmpl")
+        self.model = model
+        self.created = _now()
+        self._sent_role = False
+
+    def _chunk(self, delta: dict, finish_reason: Optional[str] = None) -> dict:
+        return {
+            "id": self.id,
+            "object": "chat.completion.chunk",
+            "created": self.created,
+            "model": self.model,
+            "choices": [
+                {"index": 0, "delta": delta, "finish_reason": finish_reason}
+            ],
+        }
+
+    def role_chunk(self) -> dict:
+        self._sent_role = True
+        return self._chunk({"role": "assistant", "content": ""})
+
+    def text_chunk(self, text: str) -> dict:
+        delta: dict = {"content": text}
+        if not self._sent_role:
+            delta["role"] = "assistant"
+            self._sent_role = True
+        return self._chunk(delta)
+
+    def finish_chunk(self, finish_reason: str, usage: Optional[Usage] = None) -> dict:
+        out = self._chunk({}, finish_reason=finish_reason)
+        if usage is not None:
+            out["usage"] = usage.to_dict()
+        return out
+
+
+class CompletionDeltaGenerator:
+    def __init__(self, model: str, request_id: Optional[str] = None):
+        self.id = request_id or new_id("cmpl")
+        self.model = model
+        self.created = _now()
+
+    def text_chunk(self, text: str, finish_reason: Optional[str] = None) -> dict:
+        return {
+            "id": self.id,
+            "object": "text_completion",
+            "created": self.created,
+            "model": self.model,
+            "choices": [
+                {"index": 0, "text": text, "finish_reason": finish_reason, "logprobs": None}
+            ],
+        }
+
+    def finish_chunk(self, finish_reason: str, usage: Optional[Usage] = None) -> dict:
+        out = self.text_chunk("", finish_reason=finish_reason)
+        if usage is not None:
+            out["usage"] = usage.to_dict()
+        return out

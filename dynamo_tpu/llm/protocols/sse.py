@@ -1,0 +1,93 @@
+"""Server-Sent Events codec for OpenAI streaming responses.
+
+Mirrors the reference's SSE codec + Annotated envelope
+(reference: lib/llm/src/protocols/codec.rs:1-754, lib/runtime/src/protocols/annotated.rs):
+``data:`` lines carry JSON payloads, ``event:`` lines carry annotation events,
+``:`` lines are comments, and the stream terminates with ``data: [DONE]``.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Iterator, Optional
+
+DONE = "[DONE]"
+
+
+@dataclass
+class SseMessage:
+    data: Optional[str] = None
+    event: Optional[str] = None
+    id: Optional[str] = None
+    comments: list[str] = field(default_factory=list)
+
+    @property
+    def is_done(self) -> bool:
+        return self.data is not None and self.data.strip() == DONE
+
+    def json(self) -> Any:
+        return json.loads(self.data) if self.data else None
+
+
+def encode_data(payload: Any) -> bytes:
+    """One data frame (payload JSON-encoded unless already a string)."""
+    text = payload if isinstance(payload, str) else json.dumps(payload, separators=(",", ":"))
+    return f"data: {text}\n\n".encode()
+
+
+def encode_event(event: str, payload: Any = None) -> bytes:
+    out = f"event: {event}\n"
+    if payload is not None:
+        out += f"data: {json.dumps(payload, separators=(',', ':'))}\n"
+    return (out + "\n").encode()
+
+
+def encode_comment(comment: str) -> bytes:
+    return f": {comment}\n\n".encode()
+
+
+def encode_done() -> bytes:
+    return f"data: {DONE}\n\n".encode()
+
+
+class SseDecoder:
+    """Incremental decoder: feed bytes, yields SseMessages at blank lines."""
+
+    def __init__(self) -> None:
+        self._buf = b""
+        self._current = SseMessage()
+
+    def feed(self, chunk: bytes) -> Iterator[SseMessage]:
+        self._buf += chunk
+        while b"\n" in self._buf:
+            line, self._buf = self._buf.split(b"\n", 1)
+            text = line.decode("utf-8", errors="replace").rstrip("\r")
+            if text == "":
+                if (
+                    self._current.data is not None
+                    or self._current.event is not None
+                    or self._current.comments
+                ):
+                    msg, self._current = self._current, SseMessage()
+                    yield msg
+                continue
+            if text.startswith(":"):
+                self._current.comments.append(text[1:].lstrip())
+            elif text.startswith("data:"):
+                value = text[5:].lstrip()
+                if self._current.data is None:
+                    self._current.data = value
+                else:  # multi-line data concatenates with newline per SSE spec
+                    self._current.data += "\n" + value
+            elif text.startswith("event:"):
+                self._current.event = text[6:].strip()
+            elif text.startswith("id:"):
+                self._current.id = text[3:].strip()
+
+
+async def decode_stream(byte_iter: AsyncIterator[bytes]) -> AsyncIterator[SseMessage]:
+    decoder = SseDecoder()
+    async for chunk in byte_iter:
+        for msg in decoder.feed(chunk):
+            yield msg

@@ -1,0 +1,114 @@
+"""Tokenizer wrappers + incremental streaming detokenization.
+
+Mirrors the reference's tokenizer layer (reference: lib/llm/src/tokenizers.rs,
+tokenizers/hf.rs, and the DecodeStream used by the backend, backend.rs:111).
+
+Implementations:
+  - ``HfTokenizer``: HuggingFace (transformers AutoTokenizer), incl. jinja chat
+    templates from tokenizer_config.json
+  - ``ByteTokenizer``: hermetic test tokenizer (utf-8 bytes + bos/eos), so the
+    full serving path runs with no model files (the reference ships vendored
+    tokenizer fixtures for the same reason, lib/llm/tests/data/sample-models/)
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional, Protocol, Sequence
+
+
+class Tokenizer(Protocol):
+    vocab_size: int
+    eos_token_ids: tuple[int, ...]
+
+    def encode(self, text: str) -> list[int]: ...
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str: ...
+
+    def apply_chat_template(self, messages: list[dict], add_generation_prompt: bool = True) -> str: ...
+
+
+class ByteTokenizer:
+    """utf-8 byte-level tokenizer: ids 0..255 bytes, 256 bos, 257 eos."""
+
+    BOS = 256
+    EOS = 257
+
+    def __init__(self):
+        self.vocab_size = 258
+        self.eos_token_ids = (self.EOS,)
+
+    def encode(self, text: str) -> list[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        data = bytes(i for i in ids if i < 256)
+        return data.decode("utf-8", errors="replace")
+
+    def apply_chat_template(self, messages: list[dict], add_generation_prompt: bool = True) -> str:
+        parts = [f"<{m['role']}>{m.get('content') or ''}</{m['role']}>" for m in messages]
+        if add_generation_prompt:
+            parts.append("<assistant>")
+        return "\n".join(parts)
+
+
+class HfTokenizer:
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(path)
+        self.vocab_size = len(self._tok)
+        eos = self._tok.eos_token_id
+        ids = []
+        if eos is not None:
+            ids.append(eos)
+        # some models define additional end ids in generation config (e.g.
+        # llama-3 <|eot_id|>); include any token literally named like an end tag
+        self.eos_token_ids = tuple(ids)
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text, add_special_tokens=False)
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        return self._tok.decode(ids, skip_special_tokens=skip_special_tokens)
+
+    def apply_chat_template(self, messages: list[dict], add_generation_prompt: bool = True) -> str:
+        return self._tok.apply_chat_template(
+            messages, tokenize=False, add_generation_prompt=add_generation_prompt
+        )
+
+
+def get_tokenizer(spec: str) -> Tokenizer:
+    """'byte' -> ByteTokenizer; anything else -> HF from local path."""
+    if spec == "byte":
+        return ByteTokenizer()
+    if Path(spec).exists():
+        return HfTokenizer(spec)
+    raise ValueError(f"unknown tokenizer spec {spec!r} (no egress: must be local)")
+
+
+class DecodeStream:
+    """Incremental detokenizer that never emits partial UTF-8/merge artifacts.
+
+    Standard sliding-window scheme: decode(ids[prefix:]) vs decode(ids[prefix:read])
+    and emit the suffix once it stabilizes (no trailing replacement char).
+    """
+
+    def __init__(self, tokenizer: Tokenizer, prompt_ids: Sequence[int] = ()):
+        self.tokenizer = tokenizer
+        self.ids: list[int] = list(prompt_ids)
+        self.prefix_offset = len(self.ids)
+        self.read_offset = len(self.ids)
+
+    def step(self, token_id: int) -> Optional[str]:
+        self.ids.append(token_id)
+        prefix_text = self.tokenizer.decode(self.ids[self.prefix_offset : self.read_offset])
+        new_text = self.tokenizer.decode(self.ids[self.prefix_offset :])
+        if new_text.endswith("�"):
+            return None  # mid-codepoint; wait for more tokens
+        if len(new_text) > len(prefix_text):
+            delta = new_text[len(prefix_text) :]
+            self.prefix_offset = self.read_offset
+            self.read_offset = len(self.ids)
+            return delta
+        return None

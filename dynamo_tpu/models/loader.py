@@ -1,0 +1,117 @@
+"""HF checkpoint -> scan-stacked JAX param loading (safetensors / torch .bin).
+
+Weight name mapping follows the HF Llama convention; our layout is [in, out]
+(HF nn.Linear stores [out, in]) with all layers stacked on a leading axis.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+import jax.numpy as jnp
+
+from dynamo_tpu.models.llama import LlamaModel
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("models.loader")
+
+
+def _iter_checkpoint_tensors(path: Path):
+    """Yield (name, np.ndarray) from safetensors shards or torch .bin files."""
+    st_files = sorted(path.glob("*.safetensors"))
+    if st_files:
+        from safetensors import safe_open
+
+        for f in st_files:
+            with safe_open(str(f), framework="np") as sf:
+                for name in sf.keys():
+                    yield name, sf.get_tensor(name)
+        return
+    bin_files = sorted(path.glob("pytorch_model*.bin"))
+    if bin_files:
+        import torch
+
+        for f in bin_files:
+            state = torch.load(str(f), map_location="cpu", weights_only=True)
+            for name, t in state.items():
+                yield name, t.float().numpy()
+        return
+    raise FileNotFoundError(f"no safetensors or pytorch_model*.bin under {path}")
+
+
+def load_llama_weights(model: LlamaModel, path: Path) -> dict:
+    c = model.config
+    dt = c.dtype
+    L = c.num_layers
+
+    def alloc(shape):
+        return np.zeros(shape, dtype=np.float32)
+
+    H, Hkv, Dh, D, F, V = (
+        c.num_heads,
+        c.num_kv_heads,
+        c.head_dim,
+        c.hidden_size,
+        c.intermediate_size,
+        c.vocab_size,
+    )
+    layers = {
+        "input_norm": alloc((L, D)),
+        "wq": alloc((L, D, H * Dh)),
+        "wk": alloc((L, D, Hkv * Dh)),
+        "wv": alloc((L, D, Hkv * Dh)),
+        "wo": alloc((L, H * Dh, D)),
+        "post_norm": alloc((L, D)),
+        "gate": alloc((L, D, F)),
+        "up": alloc((L, D, F)),
+        "down": alloc((L, F, D)),
+    }
+    params = {"embed": None, "final_norm": None}
+
+    per_layer = {
+        "input_layernorm.weight": ("input_norm", False),
+        "self_attn.q_proj.weight": ("wq", True),
+        "self_attn.k_proj.weight": ("wk", True),
+        "self_attn.v_proj.weight": ("wv", True),
+        "self_attn.o_proj.weight": ("wo", True),
+        "post_attention_layernorm.weight": ("post_norm", False),
+        "mlp.gate_proj.weight": ("gate", True),
+        "mlp.up_proj.weight": ("up", True),
+        "mlp.down_proj.weight": ("down", True),
+    }
+
+    for name, tensor in _iter_checkpoint_tensors(path):
+        if name == "model.embed_tokens.weight":
+            params["embed"] = tensor
+        elif name == "model.norm.weight":
+            params["final_norm"] = tensor
+        elif name == "lm_head.weight":
+            params["lm_head"] = tensor
+        elif name.startswith("model.layers."):
+            rest = name[len("model.layers.") :]
+            layer_str, sub = rest.split(".", 1)
+            mapping = per_layer.get(sub)
+            if mapping is None:
+                log.debug("skipping unmapped weight %s", name)
+                continue
+            key, transpose = mapping
+            t = tensor.T if transpose else tensor
+            layers[key][int(layer_str)] = t.astype(np.float32)
+        else:
+            log.debug("skipping unmapped weight %s", name)
+
+    if params["embed"] is None:
+        raise ValueError("checkpoint missing model.embed_tokens.weight")
+    out = {
+        "embed": jnp.asarray(params["embed"], dt),
+        "layers": {k: jnp.asarray(v, dt) for k, v in layers.items()},
+        "final_norm": jnp.asarray(params["final_norm"], dt),
+    }
+    if not c.tie_word_embeddings:
+        head = params.get("lm_head")
+        if head is None:
+            head = params["embed"]
+        out["lm_head"] = jnp.asarray(head, dt)
+    return out

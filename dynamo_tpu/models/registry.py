@@ -1,0 +1,50 @@
+"""Model registry: model_id -> (model, params).
+
+Supported ids:
+  - ``tiny`` / ``tiny:<json-overrides>``: random-weight test model
+  - a local HuggingFace checkpoint directory (config.json [+ safetensors])
+
+The reference resolves models from HF repos via its model-deployment-card
+machinery (reference: lib/llm/src/model_card/create.rs, launch/dynamo-run/src/hub.rs);
+here local directories fill that role (zero-egress environment).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import jax
+
+from dynamo_tpu.models.llama import LlamaConfig, LlamaModel
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("models.registry")
+
+
+def load_model(model_id: str, seed: int = 0):
+    """Returns (model, params) on host (unsharded); caller places onto mesh."""
+    if model_id is None or model_id == "tiny" or model_id.startswith("tiny:"):
+        overrides = {}
+        if model_id and ":" in model_id:
+            overrides = json.loads(model_id.split(":", 1)[1])
+        cfg = LlamaConfig.tiny(**overrides)
+        model = LlamaModel(cfg)
+        with jax.default_device(jax.local_devices()[0]):
+            params = model.init_params(jax.random.key(seed))
+        return model, params
+
+    path = Path(model_id)
+    if path.is_dir() and (path / "config.json").exists():
+        hf_cfg = json.loads((path / "config.json").read_text())
+        arch = (hf_cfg.get("architectures") or ["LlamaForCausalLM"])[0]
+        if "Llama" not in arch:
+            raise ValueError(f"unsupported architecture {arch} (Llama family only for now)")
+        cfg = LlamaConfig.from_hf_config(hf_cfg)
+        model = LlamaModel(cfg)
+        from dynamo_tpu.models.loader import load_llama_weights
+
+        params = load_llama_weights(model, path)
+        return model, params
+
+    raise ValueError(f"unknown model id {model_id!r} (not 'tiny' and not a local checkpoint dir)")

@@ -1,0 +1,168 @@
+"""End-to-end engine tests on the tiny model (virtual CPU devices)."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import AsyncJaxEngine
+from dynamo_tpu.engine.sampling import SamplingParams
+from dynamo_tpu.engine.scheduler import EngineRequest
+
+from tests.test_llama_model import naive_forward
+
+
+def tiny_engine_config(**over) -> EngineConfig:
+    defaults = dict(
+        model_id="tiny",
+        page_size=4,
+        num_pages=64,
+        max_seqs=4,
+        max_model_len=64,
+        prefill_buckets=(8, 16, 32),
+        tp=1,
+    )
+    defaults.update(over)
+    return EngineConfig(**defaults)
+
+
+def greedy_reference(engine, prompt, n):
+    """Greedy continuation using the naive dense forward on engine weights."""
+    cfg = engine.model.config
+    params = jax.device_get(engine.runner.params)
+    toks = list(prompt)
+    out = []
+    for _ in range(n):
+        logits = naive_forward(cfg, params, toks)
+        nxt = int(jnp.argmax(logits[-1]))
+        toks.append(nxt)
+        out.append(nxt)
+    return out
+
+
+async def _collect(engine, req):
+    toks = []
+    finish = None
+    cached = 0
+    async for out in engine.generate(req):
+        if out.token is not None:
+            toks.append(out.token)
+        cached = max(cached, out.cached_tokens)
+        if out.finished:
+            finish = out.finish_reason
+    return toks, finish, cached
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = AsyncJaxEngine(tiny_engine_config())
+
+    async def boot():
+        await eng.start()
+
+    asyncio.run(boot())
+    yield eng
+    asyncio.run(eng.shutdown())
+
+
+def test_greedy_matches_naive(engine):
+    prompt = [5, 9, 2, 77, 31]
+    req = EngineRequest(
+        request_id="r1",
+        token_ids=prompt,
+        sampling=SamplingParams(temperature=0.0, max_tokens=8),
+    )
+
+    async def run():
+        return await _collect(engine, req)
+
+    toks, finish, _ = asyncio.run(run())
+    assert finish == "length"
+    assert toks == greedy_reference(engine, prompt, 8)
+
+
+def test_concurrent_requests_isolated(engine):
+    prompts = [[5, 9, 2], [100, 101, 102, 103], [7, 7, 7, 7, 7, 7]]
+
+    async def run():
+        reqs = [
+            EngineRequest(
+                request_id=f"c{i}",
+                token_ids=p,
+                sampling=SamplingParams(temperature=0.0, max_tokens=6),
+            )
+            for i, p in enumerate(prompts)
+        ]
+        return await asyncio.gather(*[_collect(engine, r) for r in reqs])
+
+    results = asyncio.run(run())
+    for (toks, finish, _), prompt in zip(results, prompts):
+        assert finish == "length"
+        assert toks == greedy_reference(engine, prompt, 6), f"prompt {prompt}"
+
+
+def test_prefix_cache_reuse_across_requests(engine):
+    prompt = [11, 12, 13, 14, 15, 16, 17, 18, 19]
+
+    async def run(rid):
+        req = EngineRequest(
+            request_id=rid,
+            token_ids=list(prompt),
+            sampling=SamplingParams(temperature=0.0, max_tokens=4),
+        )
+        return await _collect(engine, req)
+
+    toks1, _, cached1 = asyncio.run(run("p1"))
+    toks2, _, cached2 = asyncio.run(run("p2"))
+    assert toks1 == toks2
+    assert cached1 == 0
+    assert cached2 >= 4  # second run reuses cached prefix blocks
+    m = engine.metrics()
+    assert m.gpu_prefix_cache_hit_rate > 0
+
+
+def test_eos_stops(engine):
+    prompt = [5, 9, 2, 77, 31]
+    first = greedy_reference(engine, prompt, 1)[0]
+    req = EngineRequest(
+        request_id="eos1",
+        token_ids=prompt,
+        sampling=SamplingParams(temperature=0.0, max_tokens=50),
+        eos_token_ids=(first,),
+    )
+
+    async def run():
+        return await _collect(engine, req)
+
+    toks, finish, _ = asyncio.run(run())
+    assert finish == "stop"
+    assert toks == [first]
+
+
+def test_max_model_len_enforced(engine):
+    req = EngineRequest(
+        request_id="long1",
+        token_ids=list(np.random.default_rng(0).integers(1, 200, 60)),
+        sampling=SamplingParams(temperature=0.0, max_tokens=50),
+    )
+
+    async def run():
+        return await _collect(engine, req)
+
+    toks, finish, _ = asyncio.run(run())
+    assert finish == "length"
+    assert len(toks) <= 4  # 64 max_model_len - 60 prompt
+
+
+def test_oversized_prompt_errors(engine):
+    req = EngineRequest(request_id="big", token_ids=list(range(100)))
+
+    async def run():
+        return await _collect(engine, req)
+
+    toks, finish, _ = asyncio.run(run())
+    assert finish == "error"
+    assert toks == []

@@ -1,0 +1,105 @@
+"""PageAllocator: prefix cache, refcounting, LRU reuse, KV events."""
+
+import pytest
+
+from dynamo_tpu.engine.page_table import PageAllocator
+from dynamo_tpu.llm.tokens import TokenSequence
+
+PS = 4
+
+
+def make(num_pages=8, events=None):
+    sink = events.append if events is not None else None
+    return PageAllocator(num_pages, PS, event_sink=sink)
+
+
+def test_basic_allocation_and_free():
+    a = make()
+    cached, st = a.allocate_sequence("s1", list(range(10)))  # 3 pages
+    assert cached == 0
+    assert len(st.pages) == 3
+    assert 0 not in st.pages  # null page never allocated
+    assert a.active_pages == 3
+    a.commit_prefilled("s1", 10)
+    a.free_sequence("s1")
+    assert a.active_pages == 0
+    # 2 full blocks stay cached (reusable), 1 partial page freed
+    assert a.free_pages == 7
+
+
+def test_prefix_cache_hit_and_sharing():
+    events = []
+    a = make(events=events)
+    prompt = list(range(8))  # 2 full blocks
+    a.allocate_sequence("s1", prompt + [99, 98])
+    a.commit_prefilled("s1", 10)
+    stored = [e for e in events if e.kind == "stored"]
+    assert len(stored) == 2  # two full blocks registered
+
+    # second sequence with the same 8-token prefix
+    cached, st2 = a.allocate_sequence("s2", prompt + [55, 44, 33, 22, 11])
+    assert cached == 8
+    st1 = a._seqs["s1"]
+    assert st2.pages[:2] == st1.pages[:2]  # physical sharing
+    assert a._refcount[st1.pages[0]] == 2
+
+    a.free_sequence("s1")
+    # shared pages still referenced by s2
+    assert a._refcount[st2.pages[0]] == 1
+    a.free_sequence("s2")
+
+
+def test_full_prompt_cache_hit_leaves_one_block_to_prefill():
+    a = make()
+    prompt = list(range(8))
+    a.allocate_sequence("s1", prompt)
+    a.commit_prefilled("s1", 8)
+    a.free_sequence("s1")
+    cached, st = a.allocate_sequence("s2", prompt)
+    assert cached == 4  # not 8: last block must be prefilled for logits
+
+
+def test_lru_eviction_emits_removed():
+    events = []
+    a = make(num_pages=6, events=events)  # 5 usable pages
+    a.allocate_sequence("s1", list(range(8)))  # 2 pages, both full blocks
+    a.commit_prefilled("s1", 8)
+    a.free_sequence("s1")  # both pages now reusable
+    assert a.free_pages == 5
+
+    # allocating 5 pages forces reclaim of the cached blocks (LRU order)
+    a.allocate_sequence("s2", list(range(100, 120)))  # 5 pages
+    removed = [e for e in events if e.kind == "removed"]
+    assert len(removed) == 2
+    assert a.free_pages == 0
+
+    with pytest.raises(MemoryError):
+        a.allocate_sequence("s3", [1, 2, 3, 4])
+
+
+def test_decode_block_completion_registers():
+    events = []
+    a = make(events=events)
+    a.allocate_sequence("s1", [1, 2, 3])  # partial block
+    a.commit_prefilled("s1", 3)
+    assert not [e for e in events if e.kind == "stored"]
+    a.append_token("s1", 4)  # completes block 0
+    stored = [e for e in events if e.kind == "stored"]
+    assert len(stored) == 1
+    ts = TokenSequence([1, 2, 3, 4], PS)
+    assert stored[0].blocks[0].block_hash == ts.blocks[0].sequence_hash
+
+
+def test_ensure_capacity_grows_and_fails():
+    a = make(num_pages=4)  # 3 usable
+    a.allocate_sequence("s1", [1, 2, 3, 4])
+    assert a.ensure_capacity("s1", 12)  # 3 pages
+    assert not a.ensure_capacity("s1", 13)  # would need a 4th
+
+
+def test_oom_rollback_restores_state():
+    a = make(num_pages=4)
+    with pytest.raises(MemoryError):
+        a.allocate_sequence("big", list(range(100)))
+    assert a.free_pages == 3
+    assert "big" not in a._seqs
