@@ -1,0 +1,9 @@
+"""Control plane: a single built-in broker replacing the reference's
+etcd + NATS pair (discovery/leases/watches + request plane/events/queues,
+reference: lib/runtime/src/transports/{etcd.rs,nats.rs}).
+
+Hardware-agnostic by design — the data plane (KV blocks, response streams)
+never flows through here.
+"""
+
+from dynamo_tpu.cplane.client import CplaneClient

@@ -1,0 +1,397 @@
+"""The control-plane broker: KV + leases + watches + pub/sub + work queues in
+one asyncio TCP server.
+
+Fills the role of the reference's infra pair (reference: deploy/docker-compose.yml
+runs etcd + nats-server -js):
+
+  - KV with create-if-absent txn, prefix get, prefix watch
+    (reference: lib/runtime/src/transports/etcd.rs:52-431)
+  - leases with TTL + keepalive; expiry deletes attached keys and notifies
+    watchers (reference: lib/runtime/src/transports/etcd/lease.rs)
+  - subjects: fire-and-forget publish to subscribers; request/reply with a
+    single responder (the request plane, reference: transports/nats.rs)
+  - durable work queues with pull + ack/nack semantics (the prefill queue,
+    reference: examples/llm/utils/nats_queue.py JetStream work-queue)
+
+Run standalone:  python -m dynamo_tpu.cplane.broker --port 4222
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import itertools
+import time
+from collections import defaultdict, deque
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from dynamo_tpu.cplane.wire import read_frame, write_frame
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("cplane.broker")
+
+DEFAULT_LEASE_TTL = 10.0
+
+# sentinel: handler parked the request and will respond later (queue pulls)
+DEFER = object()
+
+
+@dataclass
+class _Conn:
+    conn_id: int
+    writer: asyncio.StreamWriter
+    send_queue: asyncio.Queue = field(default_factory=asyncio.Queue)
+    subscriptions: set[str] = field(default_factory=set)
+    watches: dict[int, str] = field(default_factory=dict)  # watch_id -> prefix
+    leases: set[int] = field(default_factory=set)
+    closed: bool = False
+
+
+@dataclass
+class _Lease:
+    lease_id: int
+    ttl: float
+    conn_id: int
+    expires_at: float
+    keys: set[str] = field(default_factory=set)
+
+
+@dataclass
+class _QueueMsg:
+    msg_id: int
+    payload: Any
+    delivered_to: Optional[int] = None  # conn_id while in-flight
+
+
+class Broker:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conns: dict[int, _Conn] = {}
+        self._conn_ids = itertools.count(1)
+        self._lease_ids = itertools.count(0x1000)
+        self._watch_event_ids = itertools.count(1)
+        self._msg_ids = itertools.count(1)
+
+        self._kv: dict[str, dict] = {}  # key -> {value, lease_id, revision}
+        self._revision = 0
+        self._leases: dict[int, _Lease] = {}
+        self._subs: dict[str, set[int]] = defaultdict(set)  # subject -> conn ids
+        self._queues: dict[str, deque[_QueueMsg]] = defaultdict(deque)
+        self._inflight: dict[tuple[str, int], _QueueMsg] = {}
+        self._queue_waiters: dict[str, deque] = defaultdict(deque)
+        self._stopped = asyncio.Event()
+
+    # ------------- lifecycle -------------
+
+    async def start(self) -> int:
+        self._server = await asyncio.start_server(self._handle_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        asyncio.create_task(self._lease_reaper())
+        log.info("broker listening on %s:%d", self.host, self.port)
+        return self.port
+
+    async def stop(self) -> None:
+        self._stopped.set()
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+        for conn in list(self._conns.values()):
+            conn.writer.close()
+
+    async def serve_forever(self) -> None:
+        await self.start()
+        await self._stopped.wait()
+
+    # ------------- connection handling -------------
+
+    async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        conn = _Conn(conn_id=next(self._conn_ids), writer=writer)
+        self._conns[conn.conn_id] = conn
+        sender = asyncio.create_task(self._sender(conn))
+        try:
+            while True:
+                msg = await read_frame(reader)
+                await self._dispatch(conn, msg)
+        except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError):
+            pass
+        except Exception:
+            log.exception("connection %d error", conn.conn_id)
+        finally:
+            conn.closed = True
+            self._drop_conn(conn)
+            sender.cancel()
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _sender(self, conn: _Conn) -> None:
+        try:
+            while True:
+                msg = await conn.send_queue.get()
+                await write_frame(conn.writer, msg)
+        except (asyncio.CancelledError, ConnectionResetError, BrokenPipeError):
+            pass
+
+    def _send(self, conn: _Conn, msg: dict) -> None:
+        if not conn.closed:
+            conn.send_queue.put_nowait(msg)
+
+    def _drop_conn(self, conn: _Conn) -> None:
+        self._conns.pop(conn.conn_id, None)
+        for subject in conn.subscriptions:
+            self._subs[subject].discard(conn.conn_id)
+        # expire this connection's leases immediately (process death semantics)
+        for lease_id in list(conn.leases):
+            self._expire_lease(lease_id, reason="conn-closed")
+        # nack any in-flight queue messages it held
+        for (qname, msg_id), msg in list(self._inflight.items()):
+            if msg.delivered_to == conn.conn_id:
+                del self._inflight[(qname, msg_id)]
+                msg.delivered_to = None
+                self._queues[qname].appendleft(msg)
+                self._kick_queue(qname)
+
+    # ------------- dispatch -------------
+
+    async def _dispatch(self, conn: _Conn, msg: dict) -> None:
+        op = msg.get("op")
+        rid = msg.get("rid")
+        handler = getattr(self, f"_op_{op}", None)
+        if handler is None:
+            self._send(conn, {"rid": rid, "ok": False, "error": f"unknown op {op}"})
+            return
+        try:
+            result = handler(conn, msg)
+            if asyncio.iscoroutine(result):
+                result = await result
+            if result is DEFER:
+                return
+            if result is not None:
+                self._send(conn, {"rid": rid, "ok": True, **result})
+            else:
+                self._send(conn, {"rid": rid, "ok": True})
+        except Exception as e:
+            self._send(conn, {"rid": rid, "ok": False, "error": str(e)})
+
+    # ------------- KV ops -------------
+
+    def _notify_watchers(self, key: str, value: Optional[bytes], kind: str, lease_id: int) -> None:
+        for conn in self._conns.values():
+            for watch_id, prefix in conn.watches.items():
+                if key.startswith(prefix):
+                    self._send(
+                        conn,
+                        {
+                            "event": "watch",
+                            "watch_id": watch_id,
+                            "kind": kind,  # put | delete
+                            "key": key,
+                            "value": value,
+                            "lease_id": lease_id,
+                            "revision": self._revision,
+                        },
+                    )
+
+    def _op_kv_put(self, conn: _Conn, msg: dict) -> dict:
+        key, value = msg["key"], msg["value"]
+        lease_id = msg.get("lease_id", 0)
+        if lease_id:
+            lease = self._leases.get(lease_id)
+            if lease is None:
+                raise ValueError(f"lease {lease_id} not found")
+            lease.keys.add(key)
+        self._revision += 1
+        self._kv[key] = {"value": value, "lease_id": lease_id, "revision": self._revision}
+        self._notify_watchers(key, value, "put", lease_id)
+        return {"revision": self._revision}
+
+    def _op_kv_create(self, conn: _Conn, msg: dict) -> dict:
+        """Create-if-absent txn (reference: etcd.rs kv_create)."""
+        if msg["key"] in self._kv:
+            raise ValueError("key exists")
+        return self._op_kv_put(conn, msg)
+
+    def _op_kv_get(self, conn: _Conn, msg: dict) -> dict:
+        entry = self._kv.get(msg["key"])
+        if entry is None:
+            return {"found": False}
+        return {"found": True, "value": entry["value"], "lease_id": entry["lease_id"]}
+
+    def _op_kv_get_prefix(self, conn: _Conn, msg: dict) -> dict:
+        prefix = msg["prefix"]
+        items = [
+            {"key": k, "value": v["value"], "lease_id": v["lease_id"]}
+            for k, v in sorted(self._kv.items())
+            if k.startswith(prefix)
+        ]
+        return {"items": items, "revision": self._revision}
+
+    def _op_kv_delete(self, conn: _Conn, msg: dict) -> dict:
+        entry = self._kv.pop(msg["key"], None)
+        if entry is not None:
+            self._revision += 1
+            self._notify_watchers(msg["key"], None, "delete", entry["lease_id"])
+        return {"deleted": entry is not None}
+
+    def _op_watch(self, conn: _Conn, msg: dict) -> dict:
+        watch_id = msg["watch_id"]
+        conn.watches[watch_id] = msg["prefix"]
+        # initial snapshot mirrors kv_get_and_watch_prefix
+        items = [
+            {"key": k, "value": v["value"], "lease_id": v["lease_id"]}
+            for k, v in sorted(self._kv.items())
+            if k.startswith(msg["prefix"])
+        ]
+        return {"items": items}
+
+    def _op_unwatch(self, conn: _Conn, msg: dict) -> dict:
+        conn.watches.pop(msg["watch_id"], None)
+        return {}
+
+    # ------------- leases -------------
+
+    def _op_lease_create(self, conn: _Conn, msg: dict) -> dict:
+        ttl = float(msg.get("ttl", DEFAULT_LEASE_TTL))
+        lease_id = next(self._lease_ids)
+        self._leases[lease_id] = _Lease(
+            lease_id=lease_id, ttl=ttl, conn_id=conn.conn_id, expires_at=time.monotonic() + ttl
+        )
+        conn.leases.add(lease_id)
+        return {"lease_id": lease_id, "ttl": ttl}
+
+    def _op_lease_keepalive(self, conn: _Conn, msg: dict) -> dict:
+        lease = self._leases.get(msg["lease_id"])
+        if lease is None:
+            raise ValueError("lease expired")
+        lease.expires_at = time.monotonic() + lease.ttl
+        return {"ttl": lease.ttl}
+
+    def _op_lease_revoke(self, conn: _Conn, msg: dict) -> dict:
+        self._expire_lease(msg["lease_id"], reason="revoked")
+        return {}
+
+    def _expire_lease(self, lease_id: int, reason: str) -> None:
+        lease = self._leases.pop(lease_id, None)
+        if lease is None:
+            return
+        conn = self._conns.get(lease.conn_id)
+        if conn:
+            conn.leases.discard(lease_id)
+        for key in lease.keys:
+            entry = self._kv.pop(key, None)
+            if entry is not None:
+                self._revision += 1
+                self._notify_watchers(key, None, "delete", lease_id)
+        log.debug("lease %x expired (%s), %d keys removed", lease_id, reason, len(lease.keys))
+
+    async def _lease_reaper(self) -> None:
+        while not self._stopped.is_set():
+            now = time.monotonic()
+            for lease_id, lease in list(self._leases.items()):
+                if lease.expires_at < now:
+                    self._expire_lease(lease_id, reason="ttl")
+            await asyncio.sleep(0.5)
+
+    # ------------- subjects (pub/sub + request) -------------
+
+    def _op_subscribe(self, conn: _Conn, msg: dict) -> dict:
+        subject = msg["subject"]
+        conn.subscriptions.add(subject)
+        self._subs[subject].add(conn.conn_id)
+        return {}
+
+    def _op_unsubscribe(self, conn: _Conn, msg: dict) -> dict:
+        subject = msg["subject"]
+        conn.subscriptions.discard(subject)
+        self._subs[subject].discard(conn.conn_id)
+        return {}
+
+    def _op_publish(self, conn: _Conn, msg: dict) -> dict:
+        subject = msg["subject"]
+        n = 0
+        for conn_id in list(self._subs.get(subject, ())):
+            target = self._conns.get(conn_id)
+            if target is not None:
+                self._send(
+                    target,
+                    {"event": "message", "subject": subject, "payload": msg["payload"],
+                     "reply": msg.get("reply")},
+                )
+                n += 1
+        return {"delivered": n}
+
+    # ------------- work queues -------------
+
+    def _kick_queue(self, qname: str) -> None:
+        q = self._queues[qname]
+        waiters = self._queue_waiters[qname]
+        while q and waiters:
+            conn_id, rid = waiters.popleft()
+            conn = self._conns.get(conn_id)
+            if conn is None or conn.closed:
+                continue
+            m = q.popleft()
+            m.delivered_to = conn_id
+            self._inflight[(qname, m.msg_id)] = m
+            self._send(conn, {"rid": rid, "ok": True, "msg_id": m.msg_id, "payload": m.payload})
+
+    def _op_queue_push(self, conn: _Conn, msg: dict) -> dict:
+        qname = msg["queue"]
+        m = _QueueMsg(msg_id=next(self._msg_ids), payload=msg["payload"])
+        self._queues[qname].append(m)
+        self._kick_queue(qname)
+        return {"msg_id": m.msg_id, "depth": len(self._queues[qname])}
+
+    def _op_queue_pull(self, conn: _Conn, msg: dict):
+        """Pull one message; parks the request until a message is available."""
+        qname = msg["queue"]
+        q = self._queues[qname]
+        if q:
+            m = q.popleft()
+            m.delivered_to = conn.conn_id
+            self._inflight[(qname, m.msg_id)] = m
+            return {"msg_id": m.msg_id, "payload": m.payload}
+        self._queue_waiters[qname].append((conn.conn_id, msg.get("rid")))
+        return DEFER  # response sent by _kick_queue when a message arrives
+
+    def _op_queue_ack(self, conn: _Conn, msg: dict) -> dict:
+        self._inflight.pop((msg["queue"], msg["msg_id"]), None)
+        return {}
+
+    def _op_queue_nack(self, conn: _Conn, msg: dict) -> dict:
+        m = self._inflight.pop((msg["queue"], msg["msg_id"]), None)
+        if m is not None:
+            m.delivered_to = None
+            self._queues[msg["queue"]].appendleft(m)
+            self._kick_queue(msg["queue"])
+        return {}
+
+    def _op_queue_depth(self, conn: _Conn, msg: dict) -> dict:
+        return {"depth": len(self._queues[msg["queue"]]),
+                "inflight": sum(1 for (q, _) in self._inflight if q == msg["queue"])}
+
+    def _op_ping(self, conn: _Conn, msg: dict) -> dict:
+        return {"now": time.time()}
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="dynamo-tpu control-plane broker")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=4222)
+    args = parser.parse_args()
+
+    async def run():
+        broker = Broker(args.host, args.port)
+        port = await broker.start()
+        print(f"listening on {args.host}:{port}", flush=True)
+        await broker._stopped.wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,284 @@
+"""Async client for the control-plane broker.
+
+API surface mirrors what the runtime needs from etcd+NATS
+(reference: lib/runtime/src/transports/etcd.rs:52-431, nats.rs:44-831):
+kv_create/kv_put/kv_get_prefix/kv_get_and_watch_prefix, leases with keepalive
+coupled to a cancellation callback, publish/subscribe/request, work queues.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Callable, Optional
+
+from dynamo_tpu.cplane.wire import read_frame, write_frame
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("cplane.client")
+
+
+@dataclass
+class WatchEvent:
+    kind: str  # put | delete
+    key: str
+    value: Optional[bytes]
+    lease_id: int = 0
+
+
+@dataclass
+class KvItem:
+    key: str
+    value: bytes
+    lease_id: int = 0
+
+
+@dataclass
+class QueueMessage:
+    msg_id: int
+    payload: Any
+
+
+class PrefixWatcher:
+    """Initial snapshot + live event stream for a key prefix."""
+
+    def __init__(self, watch_id: int, items: list[KvItem], queue: asyncio.Queue, client: "CplaneClient"):
+        self.watch_id = watch_id
+        self.initial = items
+        self._queue = queue
+        self._client = client
+
+    async def __aiter__(self) -> AsyncIterator[WatchEvent]:
+        while True:
+            ev = await self._queue.get()
+            if ev is None:
+                return
+            yield ev
+
+    async def events(self) -> AsyncIterator[WatchEvent]:
+        async for ev in self.__aiter__():
+            yield ev
+
+    async def stop(self) -> None:
+        await self._client._unwatch(self.watch_id)
+
+
+class Lease:
+    def __init__(self, client: "CplaneClient", lease_id: int, ttl: float):
+        self.client = client
+        self.lease_id = lease_id
+        self.ttl = ttl
+        self._task: Optional[asyncio.Task] = None
+        self.on_expired: Optional[Callable[[], None]] = None
+
+    def start_keepalive(self) -> None:
+        self._task = asyncio.create_task(self._keepalive_loop())
+
+    async def _keepalive_loop(self) -> None:
+        interval = max(0.2, self.ttl / 3)
+        try:
+            while True:
+                await asyncio.sleep(interval)
+                try:
+                    await self.client._request({"op": "lease_keepalive", "lease_id": self.lease_id})
+                except Exception as e:
+                    log.warning("lease %x keepalive failed: %s", self.lease_id, e)
+                    if self.on_expired:
+                        self.on_expired()
+                    return
+        except asyncio.CancelledError:
+            pass
+
+    async def revoke(self) -> None:
+        if self._task:
+            self._task.cancel()
+        try:
+            await self.client._request({"op": "lease_revoke", "lease_id": self.lease_id})
+        except Exception:
+            pass
+
+
+class CplaneClient:
+    def __init__(self, address: str = "127.0.0.1:4222"):
+        host, _, port = address.rpartition(":")
+        self.host, self.port = host or "127.0.0.1", int(port)
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._rids = itertools.count(1)
+        self._watch_ids = itertools.count(1)
+        self._pending: dict[int, asyncio.Future] = {}
+        self._watch_queues: dict[int, asyncio.Queue] = {}
+        self._sub_handlers: dict[str, Callable[[dict], None]] = {}
+        self._reader_task: Optional[asyncio.Task] = None
+        self._closed = False
+        self.on_disconnect: Optional[Callable[[], None]] = None
+
+    # ------------- lifecycle -------------
+
+    async def connect(self) -> "CplaneClient":
+        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        self._reader_task = asyncio.create_task(self._read_loop())
+        return self
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._reader_task:
+            self._reader_task.cancel()
+        if self._writer:
+            self._writer.close()
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                msg = await read_frame(self._reader)
+                self._handle(msg)
+        except (asyncio.IncompleteReadError, ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError("broker connection lost"))
+            self._pending.clear()
+            for q in self._watch_queues.values():
+                q.put_nowait(None)
+            if not self._closed and self.on_disconnect:
+                self.on_disconnect()
+
+    def _handle(self, msg: dict) -> None:
+        if "rid" in msg and msg["rid"] is not None:
+            fut = self._pending.pop(msg["rid"], None)
+            if fut is not None and not fut.done():
+                if msg.get("ok"):
+                    fut.set_result(msg)
+                else:
+                    fut.set_exception(RuntimeError(msg.get("error", "broker error")))
+            return
+        event = msg.get("event")
+        if event == "watch":
+            q = self._watch_queues.get(msg["watch_id"])
+            if q is not None:
+                q.put_nowait(
+                    WatchEvent(
+                        kind=msg["kind"], key=msg["key"], value=msg.get("value"),
+                        lease_id=msg.get("lease_id", 0),
+                    )
+                )
+        elif event == "message":
+            handler = self._sub_handlers.get(msg["subject"])
+            if handler is not None:
+                handler(msg)
+
+    async def _request(self, msg: dict) -> dict:
+        rid = next(self._rids)
+        msg["rid"] = rid
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        await write_frame(self._writer, msg)
+        return await fut
+
+    # ------------- KV -------------
+
+    async def kv_put(self, key: str, value: bytes, lease_id: int = 0) -> int:
+        r = await self._request({"op": "kv_put", "key": key, "value": value, "lease_id": lease_id})
+        return r["revision"]
+
+    async def kv_create(self, key: str, value: bytes, lease_id: int = 0) -> bool:
+        """Create-if-absent; returns False if the key already exists."""
+        try:
+            await self._request({"op": "kv_create", "key": key, "value": value, "lease_id": lease_id})
+            return True
+        except RuntimeError as e:
+            if "exists" in str(e):
+                return False
+            raise
+
+    async def kv_get(self, key: str) -> Optional[bytes]:
+        r = await self._request({"op": "kv_get", "key": key})
+        return r["value"] if r.get("found") else None
+
+    async def kv_get_prefix(self, prefix: str) -> list[KvItem]:
+        r = await self._request({"op": "kv_get_prefix", "prefix": prefix})
+        return [KvItem(key=i["key"], value=i["value"], lease_id=i["lease_id"]) for i in r["items"]]
+
+    async def kv_delete(self, key: str) -> bool:
+        r = await self._request({"op": "kv_delete", "key": key})
+        return r["deleted"]
+
+    async def kv_get_and_watch_prefix(self, prefix: str) -> PrefixWatcher:
+        watch_id = next(self._watch_ids)
+        q: asyncio.Queue = asyncio.Queue()
+        self._watch_queues[watch_id] = q
+        r = await self._request({"op": "watch", "watch_id": watch_id, "prefix": prefix})
+        items = [KvItem(key=i["key"], value=i["value"], lease_id=i["lease_id"]) for i in r["items"]]
+        return PrefixWatcher(watch_id, items, q, self)
+
+    async def _unwatch(self, watch_id: int) -> None:
+        self._watch_queues.pop(watch_id, None)
+        await self._request({"op": "unwatch", "watch_id": watch_id})
+
+    # ------------- leases -------------
+
+    async def lease_create(self, ttl: float = 10.0) -> Lease:
+        r = await self._request({"op": "lease_create", "ttl": ttl})
+        lease = Lease(self, r["lease_id"], r["ttl"])
+        lease.start_keepalive()
+        return lease
+
+    # ------------- subjects -------------
+
+    async def subscribe(self, subject: str, handler: Callable[[dict], None]) -> None:
+        self._sub_handlers[subject] = handler
+        await self._request({"op": "subscribe", "subject": subject})
+
+    async def unsubscribe(self, subject: str) -> None:
+        self._sub_handlers.pop(subject, None)
+        await self._request({"op": "unsubscribe", "subject": subject})
+
+    async def publish(self, subject: str, payload: Any, reply: Optional[str] = None) -> int:
+        r = await self._request({"op": "publish", "subject": subject, "payload": payload, "reply": reply})
+        return r["delivered"]
+
+    async def request_subject(self, subject: str, payload: Any, timeout: float = 30.0) -> Any:
+        """NATS-style request/reply over an inbox subject."""
+        inbox = f"_INBOX.{uuid.uuid4().hex}"
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+
+        def on_reply(msg: dict) -> None:
+            if not fut.done():
+                fut.set_result(msg["payload"])
+
+        await self.subscribe(inbox, on_reply)
+        try:
+            delivered = await self.publish(subject, payload, reply=inbox)
+            if delivered == 0:
+                raise ConnectionError(f"no responders on {subject}")
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            await self.unsubscribe(inbox)
+
+    # ------------- queues -------------
+
+    async def queue_push(self, queue: str, payload: Any) -> int:
+        r = await self._request({"op": "queue_push", "queue": queue, "payload": payload})
+        return r["msg_id"]
+
+    async def queue_pull(self, queue: str, timeout: Optional[float] = None) -> QueueMessage:
+        coro = self._request({"op": "queue_pull", "queue": queue})
+        r = await (asyncio.wait_for(coro, timeout) if timeout else coro)
+        return QueueMessage(msg_id=r["msg_id"], payload=r["payload"])
+
+    async def queue_ack(self, queue: str, msg_id: int) -> None:
+        await self._request({"op": "queue_ack", "queue": queue, "msg_id": msg_id})
+
+    async def queue_nack(self, queue: str, msg_id: int) -> None:
+        await self._request({"op": "queue_nack", "queue": queue, "msg_id": msg_id})
+
+    async def queue_depth(self, queue: str) -> int:
+        r = await self._request({"op": "queue_depth", "queue": queue})
+        return r["depth"]
+
+    async def ping(self) -> float:
+        r = await self._request({"op": "ping"})
+        return r["now"]

@@ -1,0 +1,30 @@
+"""Broker wire protocol: 4-byte big-endian length prefix + msgpack payload."""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Any
+
+import msgpack
+
+MAX_FRAME = 64 * 1024 * 1024
+
+
+def pack(obj: Any) -> bytes:
+    payload = msgpack.packb(obj, use_bin_type=True)
+    return struct.pack(">I", len(payload)) + payload
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Any:
+    header = await reader.readexactly(4)
+    (length,) = struct.unpack(">I", header)
+    if length > MAX_FRAME:
+        raise ValueError(f"frame too large: {length}")
+    payload = await reader.readexactly(length)
+    return msgpack.unpackb(payload, raw=False)
+
+
+async def write_frame(writer: asyncio.StreamWriter, obj: Any) -> None:
+    writer.write(pack(obj))
+    await writer.drain()

@@ -6,10 +6,9 @@ from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.engine.sampling import SamplingParams
 
 
-async def build_async_engine(model_id: str, **overrides):
+async def build_async_engine(config: EngineConfig):
     from dynamo_tpu.engine.engine import AsyncJaxEngine
 
-    cfg = EngineConfig.for_model(model_id, **{k: v for k, v in overrides.items() if v is not None})
-    engine = AsyncJaxEngine(cfg)
+    engine = AsyncJaxEngine(config)
     await engine.start()
     return engine

@@ -1,0 +1,79 @@
+"""in=batch:<file.jsonl>: run prompts concurrently, write outputs, print a perf
+summary (mirrors the reference batch mode, reference: launch/dynamo-run/src/
+input/batch.rs:1-288)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from pathlib import Path
+
+import numpy as np
+
+from dynamo_tpu.frontends.pipeline import build_pipeline, card_for_model
+from dynamo_tpu.llm.protocols.openai import ChatCompletionRequest
+
+
+async def run_batch(engine, args, input_path: str) -> None:
+    card = card_for_model(args.model, getattr(args, "max_model_len", None))
+    pipeline = build_pipeline(engine, card)
+    prompts = []
+    for line in Path(input_path).read_text().splitlines():
+        line = line.strip()
+        if line:
+            prompts.append(json.loads(line))
+
+    results = [None] * len(prompts)
+    t_start = time.monotonic()
+
+    async def one(i: int, entry: dict):
+        text = entry.get("text") or entry.get("prompt") or ""
+        req = ChatCompletionRequest.from_dict(
+            {
+                "messages": [{"role": "user", "content": text}],
+                "max_tokens": entry.get("max_tokens", args and getattr(args, "max_tokens", None) or 128),
+            }
+        )
+        pre, _ = pipeline.preprocessor.preprocess_chat(req)
+        t0 = time.monotonic()
+        ttft = None
+        chunks = []
+        n_tokens = 0
+        async for out in pipeline.backend.generate(pre):
+            if ttft is None and (out.text or out.token_ids):
+                ttft = time.monotonic() - t0
+            chunks.append(out.text)
+            n_tokens = out.cumulative_tokens
+        results[i] = {
+            "prompt": text,
+            "output": "".join(chunks),
+            "tokens_in": len(pre.token_ids),
+            "tokens_out": n_tokens,
+            "ttft_s": ttft or 0.0,
+            "latency_s": time.monotonic() - t0,
+        }
+
+    await asyncio.gather(*[one(i, e) for i, e in enumerate(prompts)])
+    elapsed = time.monotonic() - t_start
+
+    out_path = Path(input_path).with_suffix(".out.jsonl")
+    with out_path.open("w") as f:
+        for r in results:
+            f.write(json.dumps(r) + "\n")
+
+    total_out = sum(r["tokens_out"] for r in results)
+    lat = np.array([r["latency_s"] for r in results])
+    ttfts = np.array([r["ttft_s"] for r in results])
+    summary = {
+        "requests": len(results),
+        "elapsed_s": round(elapsed, 3),
+        "output_tokens": total_out,
+        "output_tok_per_s": round(total_out / elapsed, 2) if elapsed else 0,
+        "ttft_p50_ms": round(float(np.percentile(ttfts, 50)) * 1e3, 1),
+        "ttft_p99_ms": round(float(np.percentile(ttfts, 99)) * 1e3, 1),
+        "latency_p50_s": round(float(np.percentile(lat, 50)), 3),
+        "latency_p99_s": round(float(np.percentile(lat, 99)), 3),
+        "output_file": str(out_path),
+    }
+    print(json.dumps(summary))

@@ -1,0 +1,30 @@
+"""in=http: serve the local pipeline over the OpenAI HTTP frontend."""
+
+from __future__ import annotations
+
+import asyncio
+
+from dynamo_tpu.frontends.pipeline import build_pipeline, card_for_model
+from dynamo_tpu.llm.http.service import HttpService
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("frontends.http")
+
+
+async def run_http(engine, args) -> None:
+    card = card_for_model(args.model, getattr(args, "max_model_len", None))
+    pipeline = build_pipeline(engine, card)
+
+    def extra_metrics() -> str:
+        m = getattr(engine, "metrics", None)
+        if m is None:
+            return ""
+        fm = m()
+        lines = []
+        for k, v in fm.to_wire().items():
+            lines.append(f"llm_worker_{k} {v}")
+        return "\n".join(lines) + "\n"
+
+    service = HttpService(port=args.http_port, extra_metrics=extra_metrics)
+    service.manager.add(pipeline)
+    await service.run_forever()

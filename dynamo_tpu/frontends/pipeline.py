@@ -1,0 +1,33 @@
+"""Build the local serving pipeline (preprocessor -> backend -> engine) from a
+model deployment card, mirroring the reference's pipeline link chain for core
+engines (reference: launch/dynamo-run/src/input/http.rs:95-101)."""
+
+from __future__ import annotations
+
+from dynamo_tpu.llm.backend import Backend
+from dynamo_tpu.llm.http.service import ModelPipeline
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.llm.tokenizer import get_tokenizer
+
+
+def build_pipeline(engine, card: ModelDeploymentCard) -> ModelPipeline:
+    tokenizer = get_tokenizer(card.tokenizer)
+    preprocessor = OpenAIPreprocessor(
+        tokenizer,
+        model_name=card.display_name,
+        max_model_len=card.context_length,
+    )
+    backend = Backend(engine, tokenizer)
+    return ModelPipeline(card.display_name, preprocessor, backend, model_type="both")
+
+
+def card_for_model(model_id: str | None, max_model_len: int | None = None) -> ModelDeploymentCard:
+    if model_id is None or model_id == "tiny" or model_id.startswith("tiny:"):
+        card = ModelDeploymentCard.for_tiny(model_id or "tiny")
+        card.model_path = model_id or "tiny"
+    else:
+        card = ModelDeploymentCard.from_local_path(model_id)
+    if max_model_len:
+        card.context_length = max_model_len
+    return card

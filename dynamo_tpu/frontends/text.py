@@ -1,0 +1,31 @@
+"""in=text: interactive chat REPL against the local pipeline."""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+
+from dynamo_tpu.frontends.pipeline import build_pipeline, card_for_model
+from dynamo_tpu.llm.protocols.openai import ChatCompletionRequest
+
+
+async def run_text(engine, args) -> None:
+    card = card_for_model(args.model, getattr(args, "max_model_len", None))
+    pipeline = build_pipeline(engine, card)
+    print(f"model: {card.display_name} — type a prompt, Ctrl-D to exit", flush=True)
+    loop = asyncio.get_running_loop()
+    while True:
+        print("> ", end="", flush=True)
+        line = await loop.run_in_executor(None, sys.stdin.readline)
+        if not line:
+            break
+        line = line.strip()
+        if not line:
+            continue
+        req = ChatCompletionRequest.from_dict(
+            {"messages": [{"role": "user", "content": line}], "stream": True}
+        )
+        pre, _ = pipeline.preprocessor.preprocess_chat(req)
+        async for out in pipeline.backend.generate(pre):
+            print(out.text, end="", flush=True)
+        print()

@@ -19,15 +19,43 @@ def run_command(args) -> int:
     return 0
 
 
+def engine_config_for(args):
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.frontends.pipeline import card_for_model
+
+    card = card_for_model(args.model, getattr(args, "max_model_len", None))
+    is_tiny = card.model_path.startswith("tiny")
+    if is_tiny:
+        return EngineConfig(
+            model_id=card.model_path,
+            page_size=card.kv_block_size,
+            num_pages=getattr(args, "num_pages", None) or 128,
+            max_seqs=getattr(args, "max_seqs", None) or 4,
+            max_model_len=card.context_length,
+            prefill_buckets=(16, 32),
+            tp=getattr(args, "tp", None) or 1,
+        )
+    return EngineConfig(
+        model_id=card.model_path,
+        page_size=card.kv_block_size,
+        num_pages=getattr(args, "num_pages", None) or 2048,
+        max_seqs=getattr(args, "max_seqs", None) or 16,
+        max_model_len=card.context_length,
+        tp=getattr(args, "tp", None) or 1,
+    )
+
+
 async def _build_engine(args):
     if args.output == "echo":
         from dynamo_tpu.llm.echo import EchoEngine
 
         return EchoEngine()
     if args.output == "jax":
-        from dynamo_tpu.engine import build_async_engine
+        from dynamo_tpu.engine.engine import AsyncJaxEngine
 
-        return await build_async_engine(args.model, max_model_len=args.max_model_len)
+        engine = AsyncJaxEngine(engine_config_for(args))
+        await engine.start()
+        return engine
     raise ValueError(f"unsupported out={args.output}")
 
 

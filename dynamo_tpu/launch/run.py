@@ -20,6 +20,10 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--out", dest="output", default="echo", help="echo|jax|dyn://<endpoint>")
     run.add_argument("--http-port", type=int, default=8080)
     run.add_argument("--max-model-len", type=int, default=None)
+    run.add_argument("--num-pages", type=int, default=None, help="KV cache pages")
+    run.add_argument("--max-seqs", type=int, default=None, help="decode batch slots")
+    run.add_argument("--tp", type=int, default=None, help="tensor-parallel degree")
+    run.add_argument("--max-tokens", type=int, default=None, help="batch mode default max_tokens")
     return p
 
 

@@ -1,0 +1,122 @@
+"""Detokenizing backend: engine token stream -> text deltas with stop-condition
+jailing and finish reasons.
+
+Mirrors the reference Backend (reference: lib/llm/src/backend.rs:66-508):
+wraps a tokens-in/tokens-out engine, performs incremental detokenization via a
+DecodeStream, holds back ("jails") text that could be the start of a stop
+sequence, and truncates at the stop match.
+"""
+
+from __future__ import annotations
+
+from typing import AsyncIterator
+
+from dynamo_tpu.engine.scheduler import EngineRequest
+from dynamo_tpu.llm.protocols.common import BackendOutput, PreprocessedRequest
+from dynamo_tpu.llm.tokenizer import DecodeStream, Tokenizer
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("llm.backend")
+
+
+class _StopJail:
+    """Holds back text while it could still be completing a stop string."""
+
+    def __init__(self, stops: tuple[str, ...]):
+        self.stops = [s for s in stops if s]
+        self.pending = ""
+
+    def push(self, text: str) -> tuple[str, bool]:
+        """Returns (emit_now, stopped)."""
+        if not self.stops:
+            return text, False
+        self.pending += text
+        # full stop match: emit everything before it and signal stop
+        best = None
+        for s in self.stops:
+            idx = self.pending.find(s)
+            if idx != -1 and (best is None or idx < best[0]):
+                best = (idx, s)
+        if best is not None:
+            return self.pending[: best[0]], True
+        # hold back the longest tail that is a proper prefix of any stop string
+        hold = 0
+        for s in self.stops:
+            for k in range(min(len(s) - 1, len(self.pending)), 0, -1):
+                if self.pending.endswith(s[:k]):
+                    hold = max(hold, k)
+                    break
+        emit = self.pending[: len(self.pending) - hold] if hold else self.pending
+        self.pending = self.pending[len(emit) :]
+        return emit, False
+
+    def flush(self) -> str:
+        out, self.pending = self.pending, ""
+        return out
+
+
+class Backend:
+    """ExecutionContext wrapper: PreprocessedRequest -> BackendOutput stream."""
+
+    def __init__(self, engine, tokenizer: Tokenizer):
+        self.engine = engine
+        self.tokenizer = tokenizer
+
+    async def generate(self, request: PreprocessedRequest) -> AsyncIterator[BackendOutput]:
+        eos_ids = tuple(request.eos_token_ids) or tuple(self.tokenizer.eos_token_ids)
+        engine_req = EngineRequest(
+            request_id=request.request_id,
+            token_ids=list(request.token_ids),
+            sampling=request.sampling,
+            eos_token_ids=eos_ids,
+        )
+        decoder = DecodeStream(self.tokenizer, prompt_ids=request.token_ids)
+        jail = _StopJail(request.stop_strings)
+        count = 0
+        cached = 0
+        async for step in self.engine.generate(engine_req):
+            text = ""
+            ids: list[int] = []
+            if step.token is not None:
+                count += 1
+                ids = [step.token]
+                # suppress eos token text
+                if not (step.finish_reason == "stop" and step.token in eos_ids):
+                    delta = decoder.step(step.token)
+                    if delta:
+                        text = delta
+            cached = max(cached, step.cached_tokens)
+
+            emit, stopped = jail.push(text) if text else ("", False)
+            if stopped:
+                yield BackendOutput(
+                    request_id=request.request_id,
+                    text=emit,
+                    token_ids=ids,
+                    finish_reason="stop",
+                    cumulative_tokens=count,
+                    cached_tokens=cached,
+                )
+                return
+            if step.finished:
+                # flush only if no stop strings were configured mid-jail; a
+                # partial stop prefix at end-of-stream is emitted (it never
+                # completed the stop sequence)
+                emit += jail.flush()
+                yield BackendOutput(
+                    request_id=request.request_id,
+                    text=emit,
+                    token_ids=ids,
+                    finish_reason=step.finish_reason,
+                    cumulative_tokens=count,
+                    cached_tokens=cached,
+                )
+                return
+            if emit or ids:
+                yield BackendOutput(
+                    request_id=request.request_id,
+                    text=emit,
+                    token_ids=ids,
+                    cumulative_tokens=count,
+                    cached_tokens=cached,
+                )

@@ -1,0 +1,41 @@
+"""Echo engines for tests/debugging: stream the prompt back.
+
+Mirrors the reference echo engines (reference: launch/dynamo-run/src/output/
+echo_core.rs:1-70 — token-level echo used to exercise the full pre/post
+processing pipeline with no model).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import AsyncIterator
+
+from dynamo_tpu.engine.scheduler import EngineRequest, StepOutput
+
+
+class EchoEngine:
+    """Token-level echo: emits the prompt tokens back one by one."""
+
+    def __init__(self, delay_s: float = 0.0):
+        self.delay_s = delay_s
+
+    async def generate(self, request: EngineRequest) -> AsyncIterator[StepOutput]:
+        n = min(len(request.token_ids), request.sampling.max_tokens)
+        for i, tok in enumerate(request.token_ids[:n]):
+            if self.delay_s:
+                await asyncio.sleep(self.delay_s)
+            last = i == n - 1
+            yield StepOutput(
+                request_id=request.request_id,
+                token=int(tok),
+                finished=last,
+                finish_reason="length" if last else None,
+            )
+
+    async def shutdown(self) -> None:
+        return None
+
+    def metrics(self):
+        from dynamo_tpu.engine.engine import ForwardPassMetrics
+
+        return ForwardPassMetrics()

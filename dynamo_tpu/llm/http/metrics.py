@@ -1,0 +1,96 @@
+"""Prometheus metrics for the HTTP service (hand-rolled text exposition, no
+external client library).
+
+Metric names mirror the reference (reference: lib/llm/src/http/service/
+metrics.rs:82-120): ``llm_http_service_requests_total``,
+``llm_http_service_inflight_requests``, ``llm_http_service_request_duration_seconds``
+labeled by model/endpoint/request_type/status.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class Metrics:
+    PREFIX = "llm_http_service"
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[tuple, float] = defaultdict(float)
+        self._inflight: dict[tuple, int] = defaultdict(int)
+        self._hist_counts: dict[tuple, list[int]] = {}
+        self._hist_sum: dict[tuple, float] = defaultdict(float)
+        self._hist_total: dict[tuple, int] = defaultdict(int)
+
+    def inc_request(self, model: str, endpoint: str, request_type: str, status: str) -> None:
+        key = (model, endpoint, request_type, status)
+        with self._lock:
+            self._counters[key] += 1
+
+    def inflight(self, model: str, delta: int) -> None:
+        with self._lock:
+            self._inflight[(model,)] += delta
+
+    def observe_duration(self, model: str, endpoint: str, seconds: float) -> None:
+        key = (model, endpoint)
+        with self._lock:
+            if key not in self._hist_counts:
+                self._hist_counts[key] = [0] * len(_BUCKETS)
+            for i, b in enumerate(_BUCKETS):
+                if seconds <= b:
+                    self._hist_counts[key][i] += 1
+            self._hist_sum[key] += seconds
+            self._hist_total[key] += 1
+
+    def render(self, extra: str = "") -> str:
+        p = self.PREFIX
+        lines = [
+            f"# HELP {p}_requests_total total requests by model/endpoint/type/status",
+            f"# TYPE {p}_requests_total counter",
+        ]
+        with self._lock:
+            for (model, endpoint, rtype, status), v in sorted(self._counters.items()):
+                labels = _fmt_labels(
+                    {"model": model, "endpoint": endpoint, "request_type": rtype, "status": status}
+                )
+                lines.append(f"{p}_requests_total{labels} {int(v)}")
+            lines += [
+                f"# HELP {p}_inflight_requests currently in-flight requests",
+                f"# TYPE {p}_inflight_requests gauge",
+            ]
+            for (model,), v in sorted(self._inflight.items()):
+                lines.append(f"{p}_inflight_requests{_fmt_labels({'model': model})} {v}")
+            lines += [
+                f"# HELP {p}_request_duration_seconds request duration",
+                f"# TYPE {p}_request_duration_seconds histogram",
+            ]
+            for (model, endpoint), counts in sorted(self._hist_counts.items()):
+                base = {"model": model, "endpoint": endpoint}
+                for b, c in zip(_BUCKETS, counts):
+                    labels = _fmt_labels({**base, "le": repr(b)})
+                    lines.append(f"{p}_request_duration_seconds_bucket{labels} {c}")
+                labels = _fmt_labels({**base, "le": "+Inf"})
+                lines.append(
+                    f"{p}_request_duration_seconds_bucket{labels} {self._hist_total[(model, endpoint)]}"
+                )
+                lines.append(
+                    f"{p}_request_duration_seconds_sum{_fmt_labels(base)} {self._hist_sum[(model, endpoint)]:.6f}"
+                )
+                lines.append(
+                    f"{p}_request_duration_seconds_count{_fmt_labels(base)} {self._hist_total[(model, endpoint)]}"
+                )
+        out = "\n".join(lines) + "\n"
+        if extra:
+            out += extra
+        return out

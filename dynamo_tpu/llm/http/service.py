@@ -1,0 +1,253 @@
+"""OpenAI-compatible HTTP service (aiohttp).
+
+Mirrors the reference HTTP service (reference: lib/llm/src/http/service/
+service_v2.rs:24-90, openai.rs:132,214, service.rs:58 ModelManager): models
+attach/detach dynamically; requests always stream internally and are
+aggregated for ``stream=false``; SSE framing with a final ``data: [DONE]``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from dataclasses import dataclass
+from typing import AsyncIterator, Callable, Optional
+
+from aiohttp import web
+
+from dynamo_tpu.llm.protocols.aggregator import (
+    aggregate_chat_stream,
+    aggregate_completion_stream,
+)
+from dynamo_tpu.llm.protocols.openai import (
+    ChatCompletionRequest,
+    ChatDeltaGenerator,
+    CompletionDeltaGenerator,
+    CompletionRequest,
+    ProtocolError,
+    Usage,
+)
+from dynamo_tpu.llm.http.metrics import Metrics
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("http")
+
+
+class ModelPipeline:
+    """Everything needed to serve one model: preprocessor + backend."""
+
+    def __init__(self, name: str, preprocessor, backend, model_type: str = "chat"):
+        self.name = name
+        self.preprocessor = preprocessor
+        self.backend = backend
+        self.model_type = model_type  # chat | completion | both
+
+    @property
+    def serves_chat(self) -> bool:
+        return self.model_type in ("chat", "both")
+
+    @property
+    def serves_completion(self) -> bool:
+        return self.model_type in ("completion", "both")
+
+
+class ModelManager:
+    def __init__(self):
+        self._models: dict[str, ModelPipeline] = {}
+
+    def add(self, pipeline: ModelPipeline) -> None:
+        self._models[pipeline.name] = pipeline
+
+    def remove(self, name: str) -> Optional[ModelPipeline]:
+        return self._models.pop(name, None)
+
+    def get(self, name: Optional[str]) -> Optional[ModelPipeline]:
+        if name in self._models:
+            return self._models[name]
+        if name is None and len(self._models) == 1:
+            return next(iter(self._models.values()))
+        return None
+
+    def list_models(self) -> list[str]:
+        return sorted(self._models)
+
+
+class HttpService:
+    def __init__(
+        self,
+        manager: Optional[ModelManager] = None,
+        host: str = "0.0.0.0",
+        port: int = 8080,
+        extra_metrics: Optional[Callable[[], str]] = None,
+    ):
+        self.manager = manager or ModelManager()
+        self.host = host
+        self.port = port
+        self.metrics = Metrics()
+        self._extra_metrics = extra_metrics
+        self._runner: Optional[web.AppRunner] = None
+        self.app = web.Application()
+        self.app.router.add_post("/v1/chat/completions", self._chat)
+        self.app.router.add_post("/v1/completions", self._completions)
+        self.app.router.add_get("/v1/models", self._models)
+        self.app.router.add_get("/metrics", self._metrics)
+        self.app.router.add_get("/health", self._health)
+        self.app.router.add_get("/live", self._health)
+
+    # ---------------- lifecycle ----------------
+
+    async def start(self) -> int:
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        log.info("http service listening on %s:%d", self.host, self.port)
+        return self.port
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    async def run_forever(self) -> None:
+        await self.start()
+        while True:
+            await asyncio.sleep(3600)
+
+    # ---------------- handlers ----------------
+
+    async def _health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok", "models": self.manager.list_models()})
+
+    async def _models(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "object": "list",
+                "data": [
+                    {"id": name, "object": "model", "owned_by": "dynamo-tpu"}
+                    for name in self.manager.list_models()
+                ],
+            }
+        )
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        extra = self._extra_metrics() if self._extra_metrics else ""
+        return web.Response(text=self.metrics.render(extra), content_type="text/plain")
+
+    def _error(self, status: int, message: str) -> web.Response:
+        return web.json_response(
+            {"error": {"message": message, "type": "invalid_request_error"}}, status=status
+        )
+
+    async def _chat(self, request: web.Request) -> web.StreamResponse:
+        return await self._handle(request, kind="chat")
+
+    async def _completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._handle(request, kind="completion")
+
+    async def _handle(self, request: web.Request, kind: str) -> web.StreamResponse:
+        endpoint = "chat_completions" if kind == "chat" else "completions"
+        t0 = time.monotonic()
+        try:
+            body = await request.json()
+        except Exception:
+            self.metrics.inc_request("unknown", endpoint, "unary", "400")
+            return self._error(400, "invalid JSON body")
+        try:
+            req = (
+                ChatCompletionRequest.from_dict(body)
+                if kind == "chat"
+                else CompletionRequest.from_dict(body)
+            )
+        except ProtocolError as e:
+            self.metrics.inc_request(str(body.get("model")), endpoint, "unary", "400")
+            return self._error(400, str(e))
+
+        pipeline = self.manager.get(req.model)
+        if pipeline is None:
+            self.metrics.inc_request(str(req.model), endpoint, "unary", "404")
+            return self._error(404, f"model {req.model!r} not found")
+        if kind == "chat" and not pipeline.serves_chat:
+            return self._error(400, f"model {req.model!r} does not serve chat")
+        if kind == "completion" and not pipeline.serves_completion:
+            return self._error(400, f"model {req.model!r} does not serve completions")
+
+        model = pipeline.name
+        rtype = "stream" if req.stream else "unary"
+        try:
+            if kind == "chat":
+                pre, annotations = pipeline.preprocessor.preprocess_chat(req)
+            else:
+                pre, annotations = pipeline.preprocessor.preprocess_completion(req)
+        except ProtocolError as e:
+            self.metrics.inc_request(model, endpoint, rtype, "400")
+            return self._error(400, str(e))
+
+        chunks = self._generate_chunks(pipeline, pre, kind, model, annotations)
+        self.metrics.inflight(model, 1)
+        try:
+            if req.stream:
+                return await self._stream_response(request, chunks, model, endpoint, t0)
+            if kind == "chat":
+                result = await aggregate_chat_stream(chunks)
+            else:
+                result = await aggregate_completion_stream(chunks)
+            self.metrics.inc_request(model, endpoint, rtype, "200")
+            return web.json_response(result)
+        except Exception:
+            log.exception("request failed")
+            self.metrics.inc_request(model, endpoint, rtype, "500")
+            return self._error(500, "internal error")
+        finally:
+            self.metrics.inflight(model, -1)
+            self.metrics.observe_duration(model, endpoint, time.monotonic() - t0)
+
+    async def _generate_chunks(
+        self, pipeline: ModelPipeline, pre, kind: str, model: str, annotations: dict
+    ) -> AsyncIterator[dict]:
+        gen = (
+            ChatDeltaGenerator(model) if kind == "chat" else CompletionDeltaGenerator(model)
+        )
+        usage = Usage(prompt_tokens=len(pre.token_ids))
+        # annotation events surface as comment-style chunks with an `annotation` key
+        async for out in pipeline.backend.generate(pre):
+            usage.completion_tokens = out.cumulative_tokens
+            if out.finished:
+                if out.text:
+                    yield gen.text_chunk(out.text)
+                yield gen.finish_chunk(out.finish_reason or "stop", usage)
+                return
+            if out.text:
+                yield gen.text_chunk(out.text)
+
+    async def _stream_response(
+        self, request: web.Request, chunks: AsyncIterator[dict], model: str, endpoint: str, t0: float
+    ) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            },
+        )
+        await resp.prepare(request)
+        status = "200"
+        try:
+            async for chunk in chunks:
+                await resp.write(f"data: {json.dumps(chunk, separators=(',', ':'))}\n\n".encode())
+            await resp.write(b"data: [DONE]\n\n")
+        except (asyncio.CancelledError, ConnectionResetError):
+            status = "499"
+            raise
+        except Exception:
+            log.exception("stream failed")
+            status = "500"
+            await resp.write(
+                b'data: {"error": {"message": "internal error"}}\n\ndata: [DONE]\n\n'
+            )
+        finally:
+            self.metrics.inc_request(model, endpoint, "stream", status)
+        await resp.write_eof()
+        return resp

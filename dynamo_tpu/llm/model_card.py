@@ -1,0 +1,68 @@
+"""Model Deployment Card (MDC): the metadata bundle a worker publishes so
+frontends/routers can serve its model.
+
+Mirrors the reference MDC (reference: lib/llm/src/model_card/model.rs:94,
+create.rs): model info (config.json), tokenizer kind, prompt formatter,
+context length, kv block size, service slug.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+
+def slugify(name: str) -> str:
+    return re.sub(r"[^a-zA-Z0-9_.-]+", "-", name).strip("-").lower()
+
+
+@dataclass
+class ModelDeploymentCard:
+    display_name: str
+    service_name: str
+    model_path: str = ""
+    tokenizer: str = "byte"  # tokenizer spec for get_tokenizer()
+    context_length: int = 2048
+    kv_block_size: int = 16
+    model_type: str = "chat"  # chat | completion | both
+    architecture: str = "llama"
+    revision: int = 0
+
+    @classmethod
+    def from_local_path(cls, path: str, name: Optional[str] = None) -> "ModelDeploymentCard":
+        p = Path(path)
+        display = name or p.name
+        card = cls(display_name=display, service_name=slugify(display), model_path=str(p))
+        cfg_file = p / "config.json"
+        if cfg_file.exists():
+            cfg = json.loads(cfg_file.read_text())
+            card.context_length = int(
+                cfg.get("max_position_embeddings", card.context_length)
+            )
+            archs = cfg.get("architectures") or []
+            if archs:
+                card.architecture = archs[0]
+        if (p / "tokenizer.json").exists() or (p / "tokenizer_config.json").exists():
+            card.tokenizer = str(p)
+        return card
+
+    @classmethod
+    def for_tiny(cls, name: str = "tiny") -> "ModelDeploymentCard":
+        return cls(
+            display_name=name,
+            service_name=slugify(name),
+            model_path=name,
+            tokenizer="byte",
+            context_length=64,
+            kv_block_size=4,
+        )
+
+    def to_wire(self) -> dict:
+        return self.__dict__.copy()
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "ModelDeploymentCard":
+        return cls(**{k: v for k, v in d.items() if k in cls.__dataclass_fields__})

@@ -1,0 +1,106 @@
+"""OpenAI request -> tokenized PreprocessedRequest.
+
+Mirrors the reference preprocessor (reference: lib/llm/src/preprocessor.rs:63-200,
+preprocessor/prompt/): renders the chat template (tokenizer-owned jinja),
+tokenizes, applies model defaults, maps sampling options, and surfaces
+``formatted_prompt`` / ``token_ids`` annotations when requested via ext.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Optional
+
+from dynamo_tpu.engine.sampling import SamplingParams
+from dynamo_tpu.llm.protocols.common import PreprocessedRequest
+from dynamo_tpu.llm.protocols.openai import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    ProtocolError,
+)
+from dynamo_tpu.llm.tokenizer import Tokenizer
+
+ANNOTATION_FORMATTED_PROMPT = "formatted_prompt"
+ANNOTATION_TOKEN_IDS = "token_ids"
+
+
+class OpenAIPreprocessor:
+    def __init__(
+        self,
+        tokenizer: Tokenizer,
+        model_name: str,
+        max_model_len: int = 2048,
+        default_max_tokens: Optional[int] = None,
+        default_temperature: float = 1.0,
+    ):
+        self.tokenizer = tokenizer
+        self.model_name = model_name
+        self.max_model_len = max_model_len
+        self.default_max_tokens = default_max_tokens
+        self.default_temperature = default_temperature
+
+    # ---------------- internals ----------------
+
+    def _sampling(self, req, prompt_len: int) -> SamplingParams:
+        temperature = req.temperature
+        if temperature is None:
+            temperature = self.default_temperature
+        if req.ext.greedy:
+            temperature = 0.0
+        budget = max(1, self.max_model_len - prompt_len)
+        max_tokens = req.max_tokens
+        if max_tokens is None:
+            max_tokens = self.default_max_tokens or budget
+        max_tokens = min(max_tokens, budget)
+        return SamplingParams(
+            temperature=float(temperature),
+            top_k=int(req.ext.top_k or 0),
+            top_p=float(req.top_p if req.top_p is not None else 1.0),
+            max_tokens=int(max_tokens),
+            stop=tuple(req.stop),
+            seed=req.seed,
+            ignore_eos=req.ext.ignore_eos,
+        )
+
+    def _build(self, req, prompt_text: str, token_ids: list[int]) -> tuple[PreprocessedRequest, dict]:
+        if not token_ids:
+            raise ProtocolError("prompt tokenized to zero tokens")
+        if len(token_ids) >= self.max_model_len:
+            raise ProtocolError(
+                f"prompt length {len(token_ids)} exceeds model context {self.max_model_len}"
+            )
+        annotations = {}
+        if ANNOTATION_FORMATTED_PROMPT in req.ext.annotations:
+            annotations[ANNOTATION_FORMATTED_PROMPT] = prompt_text
+        if ANNOTATION_TOKEN_IDS in req.ext.annotations:
+            annotations[ANNOTATION_TOKEN_IDS] = token_ids
+        pre = PreprocessedRequest(
+            request_id=uuid.uuid4().hex,
+            token_ids=token_ids,
+            sampling=self._sampling(req, len(token_ids)),
+            eos_token_ids=tuple(self.tokenizer.eos_token_ids),
+            stop_strings=tuple(req.stop),
+            annotations=tuple(req.ext.annotations),
+            model=req.model or self.model_name,
+        )
+        return pre, annotations
+
+    # ---------------- API ----------------
+
+    def preprocess_chat(self, req: ChatCompletionRequest) -> tuple[PreprocessedRequest, dict]:
+        prompt = self.tokenizer.apply_chat_template(
+            [m.to_dict() for m in req.messages], add_generation_prompt=True
+        )
+        token_ids = self.tokenizer.encode(prompt)
+        return self._build(req, prompt, token_ids)
+
+    def preprocess_completion(self, req: CompletionRequest) -> tuple[PreprocessedRequest, dict]:
+        if isinstance(req.prompt, str):
+            token_ids = self.tokenizer.encode(req.prompt)
+            prompt_text = req.prompt
+        elif isinstance(req.prompt, list) and all(isinstance(t, int) for t in req.prompt):
+            token_ids = list(req.prompt)
+            prompt_text = ""
+        else:
+            raise ProtocolError("prompt must be a string or a list of token ids")
+        return self._build(req, prompt_text, token_ids)

@@ -69,6 +69,13 @@ class LlamaConfig:
     @classmethod
     def tiny(cls, **overrides) -> "LlamaConfig":
         """Small config for tests (runs on the virtual CPU mesh in seconds)."""
+        if isinstance(overrides.get("dtype"), str):
+            overrides["dtype"] = {
+                "bf16": jnp.bfloat16,
+                "bfloat16": jnp.bfloat16,
+                "f32": jnp.float32,
+                "float32": jnp.float32,
+            }[overrides["dtype"]]
         base = cls(
             vocab_size=256,
             hidden_size=64,

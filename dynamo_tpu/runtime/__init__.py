@@ -1,0 +1,10 @@
+"""Distributed runtime: Namespace/Component/Endpoint model, lease-based
+discovery, two-plane RPC (request push over the control plane + call-home TCP
+response streams).
+
+The Python/asyncio re-design of the reference's dynamo-runtime crate
+(reference: lib/runtime/src/, SURVEY.md §2.1).
+"""
+
+from dynamo_tpu.runtime.runtime import Runtime, CancellationToken
+from dynamo_tpu.runtime.distributed import DistributedRuntime

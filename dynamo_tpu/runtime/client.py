@@ -1,0 +1,159 @@
+"""Client: endpoint discovery + routed streaming RPC.
+
+Mirrors the reference Client + AddressedPushRouter (reference: lib/runtime/src/
+component/client.rs:52-256, pipeline/network/egress/push.rs:62-181): watches
+the instance prefix, routes random/round-robin/direct, pushes the request over
+the control plane with the caller's ConnectionInfo, and returns the call-home
+response stream.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random as _random
+from typing import Any, AsyncIterator, Optional
+
+import msgpack
+
+from dynamo_tpu.runtime.component import EndpointInfo, INSTANCE_PREFIX
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("runtime.client")
+
+
+class NoInstancesError(ConnectionError):
+    pass
+
+
+class Client:
+    def __init__(self, drt, namespace: str, component: str, endpoint: str):
+        self._drt = drt
+        self.namespace = namespace
+        self.component = component
+        self.endpoint = endpoint
+        self._instances: dict[int, EndpointInfo] = {}
+        self._rr_index = 0
+        self._watcher = None
+        self._watch_task: Optional[asyncio.Task] = None
+        self._instances_changed = asyncio.Event()
+
+    @property
+    def prefix(self) -> str:
+        return f"{INSTANCE_PREFIX}/{self.namespace}/components/{self.component}/{self.endpoint}:"
+
+    # ---------------- discovery ----------------
+
+    async def start(self) -> "Client":
+        self._watcher = await self._drt.cplane.kv_get_and_watch_prefix(self.prefix)
+        for item in self._watcher.initial:
+            info = EndpointInfo.from_wire(msgpack.unpackb(item.value, raw=False))
+            self._instances[info.instance_id] = info
+        self._watch_task = asyncio.create_task(self._watch_loop())
+        return self
+
+    async def stop(self) -> None:
+        if self._watch_task:
+            self._watch_task.cancel()
+        if self._watcher:
+            try:
+                await self._watcher.stop()
+            except Exception:
+                pass
+
+    async def _watch_loop(self) -> None:
+        try:
+            async for ev in self._watcher.events():
+                if ev.kind == "put":
+                    info = EndpointInfo.from_wire(msgpack.unpackb(ev.value, raw=False))
+                    self._instances[info.instance_id] = info
+                elif ev.kind == "delete":
+                    # key suffix after ':' is the lease hex
+                    instance_id = int(ev.key.rsplit(":", 1)[1], 16)
+                    self._instances.pop(instance_id, None)
+                self._instances_changed.set()
+                self._instances_changed = asyncio.Event()
+        except asyncio.CancelledError:
+            pass
+
+    def instance_ids(self) -> list[int]:
+        return sorted(self._instances)
+
+    async def wait_for_instances(self, timeout: float = 30.0) -> list[int]:
+        deadline = asyncio.get_running_loop().time() + timeout
+        while not self._instances:
+            remaining = deadline - asyncio.get_running_loop().time()
+            if remaining <= 0:
+                raise NoInstancesError(f"no instances for {self.prefix}")
+            changed = self._instances_changed
+            try:
+                await asyncio.wait_for(changed.wait(), remaining)
+            except asyncio.TimeoutError:
+                pass
+        return self.instance_ids()
+
+    # ---------------- routing ----------------
+
+    def _pick_random(self) -> EndpointInfo:
+        if not self._instances:
+            raise NoInstancesError(f"no instances for {self.prefix}")
+        return self._instances[_random.choice(list(self._instances))]
+
+    def _pick_round_robin(self) -> EndpointInfo:
+        if not self._instances:
+            raise NoInstancesError(f"no instances for {self.prefix}")
+        ids = sorted(self._instances)
+        info = self._instances[ids[self._rr_index % len(ids)]]
+        self._rr_index += 1
+        return info
+
+    def _pick_direct(self, instance_id: int) -> EndpointInfo:
+        info = self._instances.get(instance_id)
+        if info is None:
+            raise NoInstancesError(f"instance {instance_id:x} not found for {self.prefix}")
+        return info
+
+    # ---------------- RPC ----------------
+
+    async def generate(
+        self, request: Any, instance_id: Optional[int] = None, routing: str = "random"
+    ) -> AsyncIterator[Any]:
+        """Routed streaming call; yields deserialized response items."""
+        if instance_id is not None:
+            info = self._pick_direct(instance_id)
+        elif routing == "round_robin":
+            info = self._pick_round_robin()
+        else:
+            info = self._pick_random()
+        return await self._generate_to(info, request)
+
+    async def random(self, request: Any) -> AsyncIterator[Any]:
+        return await self.generate(request, routing="random")
+
+    async def round_robin(self, request: Any) -> AsyncIterator[Any]:
+        return await self.generate(request, routing="round_robin")
+
+    async def direct(self, request: Any, instance_id: int) -> AsyncIterator[Any]:
+        return await self.generate(request, instance_id=instance_id)
+
+    async def _generate_to(self, info: EndpointInfo, request: Any) -> AsyncIterator[Any]:
+        drt = self._drt
+        await drt.ensure_tcp_server()
+        conn_info, receiver = drt.tcp_server.register()
+        payload = {
+            "conn_info": conn_info.to_wire(),
+            "request": msgpack.packb(request, use_bin_type=True),
+        }
+        try:
+            delivered = await drt.cplane.publish(info.subject, payload)
+            if delivered == 0:
+                raise NoInstancesError(f"instance {info.instance_id:x} is gone")
+            await asyncio.wait_for(receiver.prologue_ok, timeout=30.0)
+        except Exception:
+            drt.tcp_server.unregister(conn_info.context_id)
+            raise
+
+        async def stream() -> AsyncIterator[Any]:
+            async for raw in receiver:
+                yield msgpack.unpackb(raw, raw=False)
+
+        return stream()

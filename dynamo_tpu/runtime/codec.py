@@ -1,0 +1,69 @@
+"""TwoPart wire codec for the RPC planes.
+
+Same framing concept as the reference (reference: lib/runtime/src/pipeline/
+network/codec/two_part.rs:23-160): a 24-byte prefix
+``u64 header_len | u64 body_len | u64 xxh3(header||body)`` followed by header
+bytes then body bytes. Header carries control messages (JSON/msgpack); body
+carries the request/response payload.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from dataclasses import dataclass
+
+import xxhash
+
+PREFIX = struct.Struct("<QQQ")
+MAX_PART = 256 * 1024 * 1024
+
+
+class CodecError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class TwoPartMessage:
+    header: bytes = b""
+    body: bytes = b""
+
+
+def encode(msg: TwoPartMessage) -> bytes:
+    checksum = xxhash.xxh3_64_intdigest(msg.header + msg.body)
+    return PREFIX.pack(len(msg.header), len(msg.body), checksum) + msg.header + msg.body
+
+
+def decode(data: bytes) -> tuple[TwoPartMessage, bytes]:
+    """Decode one message; returns (message, remaining_bytes). Raises
+    IncompleteError via returning None is avoided — caller ensures enough data."""
+    if len(data) < PREFIX.size:
+        raise CodecError("short prefix")
+    hlen, blen, checksum = PREFIX.unpack_from(data)
+    if hlen > MAX_PART or blen > MAX_PART:
+        raise CodecError("part too large")
+    end = PREFIX.size + hlen + blen
+    if len(data) < end:
+        raise CodecError("short payload")
+    header = data[PREFIX.size : PREFIX.size + hlen]
+    body = data[PREFIX.size + hlen : end]
+    if xxhash.xxh3_64_intdigest(header + body) != checksum:
+        raise CodecError("checksum mismatch")
+    return TwoPartMessage(header=header, body=body), data[end:]
+
+
+async def read_message(reader: asyncio.StreamReader) -> TwoPartMessage:
+    prefix = await reader.readexactly(PREFIX.size)
+    hlen, blen, checksum = PREFIX.unpack(prefix)
+    if hlen > MAX_PART or blen > MAX_PART:
+        raise CodecError("part too large")
+    header = await reader.readexactly(hlen) if hlen else b""
+    body = await reader.readexactly(blen) if blen else b""
+    if xxhash.xxh3_64_intdigest(header + body) != checksum:
+        raise CodecError("checksum mismatch")
+    return TwoPartMessage(header=header, body=body)
+
+
+async def write_message(writer: asyncio.StreamWriter, msg: TwoPartMessage) -> None:
+    writer.write(encode(msg))
+    await writer.drain()

@@ -1,0 +1,98 @@
+"""DistributedRuntime: the cluster handle.
+
+Mirrors the reference DistributedRuntime (reference: lib/runtime/src/
+distributed.rs:31-155): control-plane client + primary lease (liveness: lease
+expiry => shutdown, shutdown => lease revoke) + lazy TCP response-plane server
++ namespace/component factory.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from typing import Optional
+
+from dynamo_tpu.cplane.client import CplaneClient, Lease
+from dynamo_tpu.runtime.client import Client
+from dynamo_tpu.runtime.component import Namespace
+from dynamo_tpu.runtime.runtime import CancellationToken, Runtime
+from dynamo_tpu.runtime.tcp import TcpStreamServer
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("runtime.distributed")
+
+DEFAULT_CPLANE = "127.0.0.1:4222"
+
+
+class DistributedRuntime:
+    def __init__(
+        self,
+        runtime: Optional[Runtime] = None,
+        cplane_address: Optional[str] = None,
+        lease_ttl: float = 10.0,
+    ):
+        self.runtime = runtime or Runtime()
+        self.cplane_address = cplane_address or os.environ.get("DYNTPU_CPLANE", DEFAULT_CPLANE)
+        self.lease_ttl = lease_ttl
+        self.cplane: Optional[CplaneClient] = None
+        self.primary_lease: Optional[Lease] = None
+        self.tcp_server = TcpStreamServer()
+        self._clients: list[Client] = []
+        self._connected = False
+
+    @classmethod
+    async def from_settings(cls, runtime: Optional[Runtime] = None) -> "DistributedRuntime":
+        drt = cls(runtime=runtime)
+        await drt.connect()
+        return drt
+
+    # ---------------- lifecycle ----------------
+
+    async def connect(self) -> "DistributedRuntime":
+        if self._connected:
+            return self
+        self.cplane = CplaneClient(self.cplane_address)
+        await self.cplane.connect()
+        self.primary_lease = await self.cplane.lease_create(ttl=self.lease_ttl)
+        # liveness coupling, both directions (reference: etcd.rs:76-110)
+        self.primary_lease.on_expired = self.runtime.shutdown
+        self.cplane.on_disconnect = self.runtime.shutdown
+        self.runtime.on_shutdown(self._shutdown_hook)
+        self._connected = True
+        return self
+
+    async def _shutdown_hook(self) -> None:
+        for client in self._clients:
+            await client.stop()
+        if self.primary_lease is not None:
+            await self.primary_lease.revoke()
+        await self.tcp_server.stop()
+        if self.cplane is not None:
+            await self.cplane.close()
+
+    async def ensure_tcp_server(self) -> None:
+        await self.tcp_server.start()
+
+    @property
+    def cancellation(self) -> CancellationToken:
+        return self.runtime.cancellation
+
+    # ---------------- factories ----------------
+
+    def namespace(self, name: str) -> Namespace:
+        return Namespace(self, name)
+
+    async def client(self, namespace: str, component: str, endpoint: str) -> Client:
+        c = Client(self, namespace, component, endpoint)
+        await c.start()
+        self._clients.append(c)
+        return c
+
+    async def endpoint_client(self, address: str) -> Client:
+        """'dyn://ns.comp.endpoint' address form (reference: protocols.rs:30)."""
+        if address.startswith("dyn://"):
+            address = address[len("dyn://") :]
+        parts = address.split(".")
+        if len(parts) != 3:
+            raise ValueError(f"bad endpoint address {address!r} (want ns.comp.endpoint)")
+        return await self.client(*parts)

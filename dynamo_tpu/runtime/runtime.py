@@ -1,0 +1,130 @@
+"""Runtime core: cancellation tokens, signal handling, graceful shutdown.
+
+Mirrors the reference Runtime/Worker (reference: lib/runtime/src/runtime.rs:38-118,
+worker.rs:16-45): a root CancellationToken with child tokens, SIGINT/SIGTERM
+graceful shutdown with a timeout (DYNTPU_GRACEFUL_SHUTDOWN_TIMEOUT) and exit
+code 911 if the timeout is exceeded.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import sys
+from typing import Awaitable, Callable, Optional
+
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("runtime")
+
+EXIT_TIMEOUT = 911
+
+
+class CancellationToken:
+    """Hierarchical cancellation: cancelling a parent cancels all children."""
+
+    def __init__(self, parent: Optional["CancellationToken"] = None):
+        self._event = asyncio.Event()
+        self._children: list[CancellationToken] = []
+        self._callbacks: list[Callable[[], None]] = []
+        if parent is not None:
+            parent._children.append(self)
+            if parent.is_cancelled():
+                self.cancel()
+
+    def child_token(self) -> "CancellationToken":
+        return CancellationToken(parent=self)
+
+    def cancel(self) -> None:
+        if self._event.is_set():
+            return
+        self._event.set()
+        for cb in self._callbacks:
+            try:
+                cb()
+            except Exception:
+                log.exception("cancellation callback failed")
+        for child in self._children:
+            child.cancel()
+
+    def is_cancelled(self) -> bool:
+        return self._event.is_set()
+
+    async def cancelled(self) -> None:
+        await self._event.wait()
+
+    def on_cancel(self, cb: Callable[[], None]) -> None:
+        if self.is_cancelled():
+            cb()
+        else:
+            self._callbacks.append(cb)
+
+
+class Runtime:
+    """Owns the loop's root cancellation token and shutdown sequencing."""
+
+    def __init__(self):
+        self.cancellation = CancellationToken()
+        self._shutdown_hooks: list[Callable[[], Awaitable[None]]] = []
+
+    def child_token(self) -> CancellationToken:
+        return self.cancellation.child_token()
+
+    def on_shutdown(self, hook: Callable[[], Awaitable[None]]) -> None:
+        self._shutdown_hooks.append(hook)
+
+    def shutdown(self) -> None:
+        self.cancellation.cancel()
+
+    async def run_shutdown_hooks(self) -> None:
+        for hook in reversed(self._shutdown_hooks):
+            try:
+                await hook()
+            except Exception:
+                log.exception("shutdown hook failed")
+
+
+class Worker:
+    """Entrypoint wrapper: installs signal handlers, runs the app coroutine,
+    enforces the graceful-shutdown timeout."""
+
+    @staticmethod
+    def execute(app: Callable[[Runtime], Awaitable[None]]) -> None:
+        timeout = float(os.environ.get("DYNTPU_GRACEFUL_SHUTDOWN_TIMEOUT", "30"))
+
+        async def main() -> None:
+            runtime = Runtime()
+            loop = asyncio.get_running_loop()
+
+            def on_signal(signame: str) -> None:
+                log.info("received %s; shutting down", signame)
+                runtime.shutdown()
+
+            for sig in (signal.SIGINT, signal.SIGTERM):
+                try:
+                    loop.add_signal_handler(sig, on_signal, sig.name)
+                except NotImplementedError:
+                    pass
+
+            app_task = asyncio.create_task(app(runtime))
+            cancel_task = asyncio.create_task(runtime.cancellation.cancelled())
+            done, _ = await asyncio.wait(
+                [app_task, cancel_task], return_when=asyncio.FIRST_COMPLETED
+            )
+            runtime.shutdown()
+            try:
+                await asyncio.wait_for(runtime.run_shutdown_hooks(), timeout)
+                if app_task not in done:
+                    app_task.cancel()
+                    try:
+                        await asyncio.wait_for(app_task, timeout)
+                    except (asyncio.CancelledError, asyncio.TimeoutError):
+                        pass
+                if app_task in done and app_task.exception() is not None:
+                    raise app_task.exception()
+            except asyncio.TimeoutError:
+                log.error("graceful shutdown timed out; exiting 911")
+                sys.exit(EXIT_TIMEOUT)
+
+        asyncio.run(main())

@@ -1,0 +1,67 @@
+"""Service stats scraping: broadcast a stats request to every endpoint of a
+component's service group and gather replies within a deadline.
+
+Mirrors the reference's NATS $SRV.STATS scrape (reference: lib/runtime/src/
+service.rs:32-242, transports/nats.rs scrape_service).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from dataclasses import dataclass, field
+from typing import Any
+
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("runtime.service")
+
+
+@dataclass
+class EndpointStats:
+    instance_id: int
+    endpoint: str
+    subject: str
+    data: dict = field(default_factory=dict)
+
+
+@dataclass
+class ServiceSet:
+    endpoints: list[EndpointStats] = field(default_factory=list)
+
+
+async def collect_service_stats(
+    cplane,
+    namespace: str,
+    component: str,
+    timeout: float = 0.5,
+) -> ServiceSet:
+    """Broadcast to $SRV.STATS.{ns}|{comp}; every live endpoint replies."""
+    subject = f"$SRV.STATS.{namespace}|{component}"
+    inbox = f"_INBOX.{uuid.uuid4().hex}"
+    replies: list[dict] = []
+    done = asyncio.Event()
+
+    def on_reply(msg: dict) -> None:
+        replies.append(msg["payload"])
+
+    await cplane.subscribe(inbox, on_reply)
+    try:
+        await cplane.publish(subject, {"scrape": True}, reply=inbox)
+        try:
+            await asyncio.wait_for(done.wait(), timeout)
+        except asyncio.TimeoutError:
+            pass
+    finally:
+        await cplane.unsubscribe(inbox)
+    return ServiceSet(
+        endpoints=[
+            EndpointStats(
+                instance_id=r["instance_id"],
+                endpoint=r["endpoint"],
+                subject=r["subject"],
+                data=r.get("data") or {},
+            )
+            for r in replies
+        ]
+    )

@@ -1,0 +1,207 @@
+"""Call-home TCP response plane.
+
+Request flow (mirrors reference: lib/runtime/src/pipeline/network/tcp/server.rs:74-614,
+egress/push.rs, ingress/push_handler.rs): the CALLER runs a TCP server and
+registers a pending stream, obtaining ConnectionInfo{address, context_id}. The
+request (pushed over the control plane) carries that ConnectionInfo; the WORKER
+connects back ("calls home"), sends a handshake + prologue (ok or error), then
+streams data frames and a final sentinel.
+
+Frames are TwoPart messages: header = msgpack control
+{kind: handshake|prologue|data|sentinel|error, ...}; body = payload bytes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import socket
+from dataclasses import dataclass
+from typing import AsyncIterator, Optional
+
+import msgpack
+
+from dynamo_tpu.runtime.codec import TwoPartMessage, read_message, write_message
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("runtime.tcp")
+
+
+class ResponseStreamError(RuntimeError):
+    """Remote prologue/stream error surfaced to the caller."""
+
+
+@dataclass(frozen=True)
+class ConnectionInfo:
+    address: str  # host:port of the caller's stream server
+    context_id: str
+
+    def to_wire(self) -> dict:
+        return {"address": self.address, "context_id": self.context_id}
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "ConnectionInfo":
+        return cls(address=d["address"], context_id=d["context_id"])
+
+
+class StreamReceiver:
+    """Caller-side view of one response stream."""
+
+    def __init__(self, context_id: str):
+        self.context_id = context_id
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self.prologue_ok: Optional[asyncio.Future] = None
+
+    async def __aiter__(self) -> AsyncIterator[bytes]:
+        while True:
+            item = await self._queue.get()
+            if item is None:
+                return
+            if isinstance(item, Exception):
+                raise item
+            yield item
+
+
+class TcpStreamServer:
+    """Caller-side server; one per process, lazily started
+    (reference: DistributedRuntime's lazy tcp server, distributed.rs:31-128)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, advertise_host: Optional[str] = None):
+        self.host = host
+        self.port = port
+        self.advertise_host = advertise_host or host
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._pending: dict[str, tuple[asyncio.Future, StreamReceiver]] = {}
+        self._ctx_ids = itertools.count(1)
+
+    async def start(self) -> None:
+        if self._server is not None:
+            return
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        if self.advertise_host in ("0.0.0.0", "::"):
+            self.advertise_host = socket.gethostname()
+        log.debug("tcp response plane on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    @property
+    def address(self) -> str:
+        return f"{self.advertise_host}:{self.port}"
+
+    def register(self, context_id: Optional[str] = None) -> tuple[ConnectionInfo, StreamReceiver]:
+        """Register a pending response stream before sending the request."""
+        assert self._server is not None, "server not started"
+        if context_id is None:
+            context_id = f"ctx-{next(self._ctx_ids)}"
+        receiver = StreamReceiver(context_id)
+        connected: asyncio.Future = asyncio.get_running_loop().create_future()
+        receiver.prologue_ok = connected
+        self._pending[context_id] = (connected, receiver)
+        return ConnectionInfo(address=self.address, context_id=context_id), receiver
+
+    def unregister(self, context_id: str) -> None:
+        entry = self._pending.pop(context_id, None)
+        if entry is not None:
+            fut, receiver = entry
+            if not fut.done():
+                fut.set_exception(ResponseStreamError("request cancelled"))
+            receiver._queue.put_nowait(None)
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        context_id = None
+        try:
+            handshake = await read_message(reader)
+            ctrl = msgpack.unpackb(handshake.header, raw=False)
+            if ctrl.get("kind") != "handshake":
+                raise ResponseStreamError("expected handshake")
+            context_id = ctrl["context_id"]
+            entry = self._pending.get(context_id)
+            if entry is None:
+                log.warning("handshake for unknown context %s", context_id)
+                return
+            connected, receiver = entry
+
+            prologue = await read_message(reader)
+            pctrl = msgpack.unpackb(prologue.header, raw=False)
+            if pctrl.get("kind") == "error":
+                err = ResponseStreamError(pctrl.get("message", "remote error"))
+                if not connected.done():
+                    connected.set_exception(err)
+                receiver._queue.put_nowait(None)
+                return
+            if pctrl.get("kind") != "prologue":
+                raise ResponseStreamError("expected prologue")
+            if not connected.done():
+                connected.set_result(True)
+
+            while True:
+                frame = await read_message(reader)
+                fctrl = msgpack.unpackb(frame.header, raw=False) if frame.header else {"kind": "data"}
+                kind = fctrl.get("kind")
+                if kind == "data":
+                    receiver._queue.put_nowait(frame.body)
+                elif kind == "sentinel":
+                    receiver._queue.put_nowait(None)
+                    return
+                elif kind == "error":
+                    receiver._queue.put_nowait(
+                        ResponseStreamError(fctrl.get("message", "remote stream error"))
+                    )
+                    return
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            if context_id and context_id in self._pending:
+                _, receiver = self._pending[context_id]
+                receiver._queue.put_nowait(ResponseStreamError("connection lost"))
+        finally:
+            if context_id:
+                self._pending.pop(context_id, None)
+            writer.close()
+
+
+class StreamSender:
+    """Worker-side sender for one response stream."""
+
+    def __init__(self, writer: asyncio.StreamWriter):
+        self._writer = writer
+
+    async def send(self, payload: bytes) -> None:
+        await write_message(
+            self._writer,
+            TwoPartMessage(header=msgpack.packb({"kind": "data"}), body=payload),
+        )
+
+    async def close(self, error: Optional[str] = None) -> None:
+        try:
+            if error is not None:
+                header = msgpack.packb({"kind": "error", "message": error})
+            else:
+                header = msgpack.packb({"kind": "sentinel"})
+            await write_message(self._writer, TwoPartMessage(header=header))
+        finally:
+            self._writer.close()
+
+
+async def call_home(conn_info: ConnectionInfo, error: Optional[str] = None) -> Optional[StreamSender]:
+    """Worker side: connect back to the caller and send handshake + prologue.
+
+    With error set, sends an error prologue and returns None.
+    """
+    host, _, port = conn_info.address.rpartition(":")
+    reader, writer = await asyncio.open_connection(host, int(port))
+    await write_message(
+        writer,
+        TwoPartMessage(header=msgpack.packb({"kind": "handshake", "context_id": conn_info.context_id})),
+    )
+    if error is not None:
+        await write_message(
+            writer, TwoPartMessage(header=msgpack.packb({"kind": "error", "message": error}))
+        )
+        writer.close()
+        return None
+    await write_message(writer, TwoPartMessage(header=msgpack.packb({"kind": "prologue"})))
+    return StreamSender(writer)

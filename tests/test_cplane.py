@@ -1,0 +1,184 @@
+"""Control-plane broker: KV/lease/watch/pubsub/queue semantics.
+
+Mirrors the reference's binding tests that exercise real etcd+nats
+(reference: lib/bindings/python/tests/test_kv_bindings.py fixture pattern) —
+here the broker runs in-process.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.cplane.broker import Broker
+from dynamo_tpu.cplane.client import CplaneClient
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def with_broker(fn):
+    broker = Broker()
+    port = await broker.start()
+    clients = []
+
+    async def client():
+        c = CplaneClient(f"127.0.0.1:{port}")
+        await c.connect()
+        clients.append(c)
+        return c
+
+    try:
+        return await fn(client)
+    finally:
+        for c in clients:
+            await c.close()
+        await broker.stop()
+
+
+def test_kv_put_get_prefix_delete():
+    async def body(client):
+        c = await client()
+        await c.kv_put("ns/a/1", b"v1")
+        await c.kv_put("ns/a/2", b"v2")
+        await c.kv_put("ns/b/1", b"v3")
+        assert await c.kv_get("ns/a/1") == b"v1"
+        assert await c.kv_get("nope") is None
+        items = await c.kv_get_prefix("ns/a/")
+        assert [(i.key, i.value) for i in items] == [("ns/a/1", b"v1"), ("ns/a/2", b"v2")]
+        assert await c.kv_delete("ns/a/1")
+        assert not await c.kv_delete("ns/a/1")
+
+    run(with_broker(body))
+
+
+def test_kv_create_if_absent():
+    async def body(client):
+        c = await client()
+        assert await c.kv_create("k", b"1")
+        assert not await c.kv_create("k", b"2")
+        assert await c.kv_get("k") == b"1"
+
+    run(with_broker(body))
+
+
+def test_watch_sees_puts_and_deletes():
+    async def body(client):
+        c1, c2 = await client(), await client()
+        await c1.kv_put("w/initial", b"x")
+        watcher = await c2.kv_get_and_watch_prefix("w/")
+        assert [i.key for i in watcher.initial] == ["w/initial"]
+        await c1.kv_put("w/new", b"y")
+        await c1.kv_delete("w/initial")
+        events = []
+        async for ev in watcher.events():
+            events.append(ev)
+            if len(events) == 2:
+                break
+        assert (events[0].kind, events[0].key, events[0].value) == ("put", "w/new", b"y")
+        assert (events[1].kind, events[1].key) == ("delete", "w/initial")
+
+    run(with_broker(body))
+
+
+def test_lease_keys_vanish_on_disconnect():
+    async def body(client):
+        c1, c2 = await client(), await client()
+        lease = await c2.lease_create(ttl=5.0)
+        await c2.kv_put("inst/ep:1", b"me", lease_id=lease.lease_id)
+        assert await c1.kv_get("inst/ep:1") == b"me"
+
+        watcher = await c1.kv_get_and_watch_prefix("inst/")
+        await c2.close()  # process death => lease release => key delete
+        ev = await asyncio.wait_for(watcher._queue.get(), 3)
+        assert ev.kind == "delete" and ev.key == "inst/ep:1"
+        assert await c1.kv_get("inst/ep:1") is None
+
+    run(with_broker(body))
+
+
+def test_lease_ttl_expiry():
+    async def body(client):
+        c1, c2 = await client(), await client()
+        lease = await c2.lease_create(ttl=0.6)
+        lease._task.cancel()  # stop keepalives -> ttl expiry in the broker
+        await c2.kv_put("ttl/k", b"v", lease_id=lease.lease_id)
+        assert await c1.kv_get("ttl/k") == b"v"
+        await asyncio.sleep(1.5)
+        assert await c1.kv_get("ttl/k") is None
+
+    run(with_broker(body))
+
+
+def test_pubsub_and_request_reply():
+    async def body(client):
+        c1, c2 = await client(), await client()
+        got = asyncio.Queue()
+
+        def handler(msg):
+            got.put_nowait(msg)
+
+        await c2.subscribe("events.test", handler)
+        n = await c1.publish("events.test", {"x": 1})
+        assert n == 1
+        msg = await asyncio.wait_for(got.get(), 2)
+        assert msg["payload"] == {"x": 1}
+
+        # request/reply: responder echoes on the reply subject
+        async def responder(msg):
+            await c2.publish(msg["reply"], {"echo": msg["payload"]})
+
+        def responder_cb(msg):
+            asyncio.ensure_future(responder(msg))
+
+        await c2.subscribe("svc.echo", responder_cb)
+        result = await c1.request_subject("svc.echo", "hello", timeout=2)
+        assert result == {"echo": "hello"}
+
+        with pytest.raises(ConnectionError):
+            await c1.request_subject("svc.missing", "x", timeout=1)
+
+    run(with_broker(body))
+
+
+def test_queue_push_pull_ack_nack():
+    async def body(client):
+        c1, c2 = await client(), await client()
+        await c1.queue_push("q1", {"job": 1})
+        m = await c2.queue_pull("q1", timeout=2)
+        assert m.payload == {"job": 1}
+        # nack requeues at the front
+        await c2.queue_nack("q1", m.msg_id)
+        m2 = await c2.queue_pull("q1", timeout=2)
+        assert m2.payload == {"job": 1}
+        await c2.queue_ack("q1", m2.msg_id)
+        assert await c1.queue_depth("q1") == 0
+
+    run(with_broker(body))
+
+
+def test_queue_blocking_pull_and_redelivery_on_consumer_death():
+    async def body(client):
+        c1, c2, c3 = await client(), await client(), await client()
+        pull_task = asyncio.ensure_future(c2.queue_pull("jobs"))
+        await asyncio.sleep(0.05)
+        await c1.queue_push("jobs", "work")
+        m = await asyncio.wait_for(pull_task, 2)
+        assert m.payload == "work"
+        # consumer dies without ack -> message redelivered to another consumer
+        await c2.close()
+        m2 = await asyncio.wait_for(c3.queue_pull("jobs"), 2)
+        assert m2.payload == "work"
+
+    run(with_broker(body))
+
+
+def test_queue_fifo_across_consumers():
+    async def body(client):
+        c = await client()
+        for i in range(5):
+            await c.queue_push("fifo", i)
+        got = [(await c.queue_pull("fifo")).payload for _ in range(5)]
+        assert got == [0, 1, 2, 3, 4]
+
+    run(with_broker(body))

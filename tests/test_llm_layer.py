@@ -1,0 +1,161 @@
+"""LLM protocol layer: backend detokenization + stop jailing, SSE codec,
+aggregators, preprocessor validation."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.engine.sampling import SamplingParams
+from dynamo_tpu.engine.scheduler import EngineRequest, StepOutput
+from dynamo_tpu.llm.backend import Backend
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.llm.protocols.aggregator import aggregate_chat_stream
+from dynamo_tpu.llm.protocols.common import PreprocessedRequest
+from dynamo_tpu.llm.protocols.openai import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    ProtocolError,
+)
+from dynamo_tpu.llm.protocols.sse import SseDecoder, encode_data, encode_done
+from dynamo_tpu.llm.tokenizer import ByteTokenizer, DecodeStream
+
+
+class ScriptedEngine:
+    """Emits a fixed token list, one StepOutput per token."""
+
+    def __init__(self, tokens, finish_reason="length"):
+        self.tokens = tokens
+        self.finish_reason = finish_reason
+
+    async def generate(self, request: EngineRequest):
+        for i, tok in enumerate(self.tokens):
+            last = i == len(self.tokens) - 1
+            yield StepOutput(
+                request_id=request.request_id,
+                token=tok,
+                finished=last,
+                finish_reason=self.finish_reason if last else None,
+            )
+
+
+def run_backend(tokens, stop=(), finish="length"):
+    tok = ByteTokenizer()
+    backend = Backend(ScriptedEngine(tokens, finish), tok)
+    req = PreprocessedRequest(
+        request_id="t1", token_ids=tok.encode("hi"), stop_strings=tuple(stop)
+    )
+
+    async def go():
+        outs = []
+        async for o in backend.generate(req):
+            outs.append(o)
+        return outs
+
+    return asyncio.run(go())
+
+
+def test_backend_detokenizes_text():
+    outs = run_backend(list(b"hello"))
+    assert "".join(o.text for o in outs) == "hello"
+    assert outs[-1].finish_reason == "length"
+    assert outs[-1].cumulative_tokens == 5
+
+
+def test_backend_stop_string_truncates():
+    outs = run_backend(list(b"hello world and more"), stop=["world"])
+    assert "".join(o.text for o in outs) == "hello "
+    assert outs[-1].finish_reason == "stop"
+
+
+def test_backend_stop_prefix_jail_released_at_eos():
+    # 'wor' is a prefix of the stop string but never completes -> must be emitted
+    outs = run_backend(list(b"hello wor"), stop=["world"])
+    assert "".join(o.text for o in outs) == "hello wor"
+    assert outs[-1].finish_reason == "length"
+
+
+def test_backend_multibyte_utf8_boundary():
+    # é = 0xC3 0xA9 split across steps must not emit replacement chars
+    outs = run_backend(list("café".encode("utf-8")))
+    text = "".join(o.text for o in outs)
+    assert text == "café"
+    assert "�" not in text
+
+
+def test_decode_stream_waits_for_codepoint():
+    tok = ByteTokenizer()
+    ds = DecodeStream(tok)
+    assert ds.step(0xC3) is None
+    assert ds.step(0xA9) == "é"
+
+
+def test_sse_roundtrip():
+    dec = SseDecoder()
+    frames = encode_data({"a": 1}) + encode_data("x") + encode_done()
+    msgs = list(dec.feed(frames))
+    assert msgs[0].json() == {"a": 1}
+    assert msgs[1].data == "x"
+    assert msgs[2].is_done
+
+
+def test_sse_incremental_feed():
+    dec = SseDecoder()
+    frames = encode_data({"k": "v"})
+    out = []
+    for i in range(len(frames)):
+        out.extend(dec.feed(frames[i : i + 1]))
+    assert len(out) == 1 and out[0].json() == {"k": "v"}
+
+
+def test_aggregator_chat():
+    async def chunks():
+        yield {"id": "c1", "created": 1, "model": "m",
+               "choices": [{"index": 0, "delta": {"role": "assistant", "content": "he"}}]}
+        yield {"id": "c1", "created": 1, "model": "m",
+               "choices": [{"index": 0, "delta": {"content": "llo"}}]}
+        yield {"id": "c1", "created": 1, "model": "m",
+               "choices": [{"index": 0, "delta": {}, "finish_reason": "stop"}],
+               "usage": {"prompt_tokens": 2, "completion_tokens": 2, "total_tokens": 4}}
+
+    out = asyncio.run(aggregate_chat_stream(chunks()))
+    assert out["object"] == "chat.completion"
+    assert out["choices"][0]["message"]["content"] == "hello"
+    assert out["choices"][0]["finish_reason"] == "stop"
+    assert out["usage"]["total_tokens"] == 4
+
+
+def test_protocol_validation():
+    with pytest.raises(ProtocolError):
+        ChatCompletionRequest.from_dict({"messages": []})
+    with pytest.raises(ProtocolError):
+        ChatCompletionRequest.from_dict({"messages": [{"role": "user", "content": "x"}], "n": 2})
+    with pytest.raises(ProtocolError):
+        ChatCompletionRequest.from_dict(
+            {"messages": [{"role": "user", "content": "x"}], "temperature": -1}
+        )
+    with pytest.raises(ProtocolError):
+        CompletionRequest.from_dict({})
+    r = ChatCompletionRequest.from_dict(
+        {"messages": [{"role": "user", "content": "x"}], "stop": "end",
+         "nvext": {"ignore_eos": True, "top_k": 5}}
+    )
+    assert r.stop == ["end"] and r.ext.ignore_eos and r.ext.top_k == 5
+
+
+def test_preprocessor_chat_and_limits():
+    tok = ByteTokenizer()
+    pre = OpenAIPreprocessor(tok, "m", max_model_len=64)
+    req = ChatCompletionRequest.from_dict(
+        {"messages": [{"role": "user", "content": "hi"}], "max_tokens": 1000,
+         "temperature": 0}
+    )
+    p, ann = pre.preprocess_chat(req)
+    assert p.sampling.max_tokens + len(p.token_ids) <= 64
+    assert p.sampling.temperature == 0.0
+    assert p.eos_token_ids == (ByteTokenizer.EOS,)
+
+    long_req = ChatCompletionRequest.from_dict(
+        {"messages": [{"role": "user", "content": "x" * 100}]}
+    )
+    with pytest.raises(ProtocolError):
+        pre.preprocess_chat(long_req)
