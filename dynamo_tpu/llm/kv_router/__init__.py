@@ -1,0 +1,6 @@
+"""KV-cache-aware routing: global radix index fed by worker events + cost-based
+worker selection (reference: lib/llm/src/kv_router/)."""
+
+from dynamo_tpu.llm.kv_router.indexer import KvIndexer, OverlapScores, RadixTree, RouterEvent
+from dynamo_tpu.llm.kv_router.scheduler import KvScheduler, ProcessedEndpoints, WorkerLoad
+from dynamo_tpu.llm.kv_router.router import KvRouter

@@ -1,0 +1,158 @@
+"""Global radix/prefix tree over cached KV blocks, built solely from worker
+events.
+
+Semantics mirror the reference indexer (reference: lib/llm/src/kv_router/
+indexer.rs:187-560):
+  - tree children are keyed by the *unchained* tokens hash (LocalBlockHash);
+    worker sets live on each node
+  - a per-worker lookup table block_hash -> node allows events to attach
+    children at any depth in O(1)
+  - ``find_matches`` walks a sequence of local hashes accumulating
+    OverlapScores {worker_id -> matched block count}, with optional early exit
+    and optional frequency tracking with expiry
+  - ``remove_worker`` drops a worker from every node it appears on
+
+The reference pins its Rc/RefCell tree to a dedicated single-threaded runtime;
+here the tree is plain Python owned by the asyncio loop (single-threaded by
+construction) — same concurrency-by-isolation property.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from dynamo_tpu.llm.kv_events import KvCacheEvent
+from dynamo_tpu.llm.tokens import compute_block_hash_for_seq
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("kv_router.indexer")
+
+WorkerId = int
+
+
+@dataclass
+class RouterEvent:
+    """A KvCacheEvent attributed to a worker (reference: indexer.rs:139)."""
+
+    worker_id: WorkerId
+    event: KvCacheEvent
+
+    def to_wire(self) -> dict:
+        return {"worker_id": self.worker_id, "event": self.event.to_wire()}
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "RouterEvent":
+        return cls(worker_id=d["worker_id"], event=KvCacheEvent.from_wire(d["event"]))
+
+
+@dataclass
+class OverlapScores:
+    scores: dict[WorkerId, int] = field(default_factory=dict)
+    frequencies: list[int] = field(default_factory=list)
+
+    def update(self, workers: set[WorkerId]) -> None:
+        for w in workers:
+            self.scores[w] = self.scores.get(w, 0) + 1
+
+
+class _Node:
+    __slots__ = ("children", "workers", "recent_uses")
+
+    def __init__(self):
+        self.children: dict[int, _Node] = {}  # tokens_hash -> node
+        self.workers: set[WorkerId] = set()
+        self.recent_uses: deque[float] = deque()
+
+
+class RadixTree:
+    def __init__(self, expiration_duration: Optional[float] = None):
+        self.root = _Node()
+        # worker -> block_hash (engine identity) -> node
+        self.lookup: dict[WorkerId, dict[int, _Node]] = {}
+        self.expiration_duration = expiration_duration
+
+    # ---------------- matching ----------------
+
+    def find_matches(self, sequence: Sequence[int], early_exit: bool = False) -> OverlapScores:
+        scores = OverlapScores()
+        current = self.root
+        now = time.monotonic()
+        for tokens_hash in sequence:
+            node = current.children.get(tokens_hash)
+            if node is None:
+                break
+            scores.update(node.workers)
+            if self.expiration_duration is not None:
+                while node.recent_uses and now - node.recent_uses[0] > self.expiration_duration:
+                    node.recent_uses.popleft()
+                scores.frequencies.append(len(node.recent_uses))
+                node.recent_uses.append(now)
+            if early_exit and len(node.workers) == 1:
+                break
+            current = node
+        return scores
+
+    # ---------------- event application ----------------
+
+    def apply_event(self, event: RouterEvent) -> None:
+        worker = event.worker_id
+        ev = event.event
+        worker_lookup = self.lookup.setdefault(worker, {})
+        if ev.kind == "stored":
+            if ev.parent_hash is None:
+                parent = self.root
+            else:
+                parent = worker_lookup.get(ev.parent_hash)
+                if parent is None:
+                    log.debug(
+                        "worker %x stored event with unknown parent %x; attaching to root",
+                        worker,
+                        ev.parent_hash,
+                    )
+                    parent = self.root
+            for block in ev.blocks:
+                node = parent.children.get(block.tokens_hash)
+                if node is None:
+                    node = _Node()
+                    parent.children[block.tokens_hash] = node
+                node.workers.add(worker)
+                worker_lookup[block.block_hash] = node
+                parent = node
+        elif ev.kind == "removed":
+            for block_hash in ev.block_hashes:
+                node = worker_lookup.pop(block_hash, None)
+                if node is not None:
+                    node.workers.discard(worker)
+
+    def remove_worker(self, worker: WorkerId) -> None:
+        table = self.lookup.pop(worker, None)
+        if not table:
+            return
+        for node in table.values():
+            node.workers.discard(worker)
+
+
+class KvIndexer:
+    """Event-driven index facade (reference: indexer.rs:499 KvIndexer)."""
+
+    def __init__(self, kv_block_size: int, expiration_duration: Optional[float] = None):
+        self.kv_block_size = kv_block_size
+        self.tree = RadixTree(expiration_duration)
+
+    def apply_event(self, event: RouterEvent) -> None:
+        self.tree.apply_event(event)
+
+    def remove_worker(self, worker: WorkerId) -> None:
+        self.tree.remove_worker(worker)
+
+    def find_matches(self, sequence: Sequence[int], early_exit: bool = False) -> OverlapScores:
+        return self.tree.find_matches(sequence, early_exit)
+
+    def find_matches_for_request(self, token_ids: Sequence[int], early_exit: bool = False) -> OverlapScores:
+        """Token ids -> local block hashes -> radix walk
+        (reference: indexer.rs:648 find_matches_for_request)."""
+        hashes = compute_block_hash_for_seq(token_ids, self.kv_block_size)
+        return self.find_matches(hashes, early_exit)

@@ -1,0 +1,74 @@
+"""Router-side metrics aggregation: periodically scrape every worker's
+ForwardPassMetrics via the stats broadcast.
+
+Mirrors the reference aggregator (reference: lib/llm/src/kv_router/
+metrics_aggregator.rs:1-171 collect_endpoints_task).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from dynamo_tpu.llm.kv_router.scheduler import WorkerLoad
+from dynamo_tpu.runtime.service import collect_service_stats
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("kv_router.metrics")
+
+
+class KvMetricsAggregator:
+    def __init__(
+        self,
+        cplane,
+        namespace: str,
+        component: str,
+        interval: float = 1.0,
+        scrape_timeout: float = 0.3,
+    ):
+        self.cplane = cplane
+        self.namespace = namespace
+        self.component = component
+        self.interval = interval
+        self.scrape_timeout = scrape_timeout
+        self._latest: list[WorkerLoad] = []
+        self._task: Optional[asyncio.Task] = None
+        self._on_update = None
+
+    def on_update(self, cb) -> None:
+        self._on_update = cb
+
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+
+    async def scrape_once(self) -> list[WorkerLoad]:
+        stats = await collect_service_stats(
+            self.cplane, self.namespace, self.component, timeout=self.scrape_timeout
+        )
+        loads = []
+        for ep in stats.endpoints:
+            kv = ep.data.get("kv_metrics")
+            if kv is not None:
+                loads.append(WorkerLoad.from_wire(ep.instance_id, kv))
+        self._latest = loads
+        if self._on_update is not None:
+            self._on_update(loads)
+        return loads
+
+    def get_metrics(self) -> list[WorkerLoad]:
+        return list(self._latest)
+
+    async def _loop(self) -> None:
+        try:
+            while True:
+                try:
+                    await self.scrape_once()
+                except Exception:
+                    log.exception("metrics scrape failed")
+                await asyncio.sleep(self.interval)
+        except asyncio.CancelledError:
+            pass

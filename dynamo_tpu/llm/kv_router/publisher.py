@@ -1,0 +1,58 @@
+"""Worker-side publishers: KV cache events + load metrics.
+
+Mirrors the reference publisher pair (reference: lib/llm/src/kv_router/
+publisher.rs:33-130): KvEventPublisher forwards engine block store/evict events
+onto the component's ``kv_events`` subject; KvMetricsPublisher exposes
+ForwardPassMetrics through the endpoint's stats handler so the aggregator's
+$SRV.STATS scrape picks them up.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, Optional
+
+from dynamo_tpu.llm.kv_events import KvCacheEvent
+from dynamo_tpu.llm.kv_router.indexer import RouterEvent
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("kv_router.publisher")
+
+
+class KvEventPublisher:
+    """Bridges engine KV events (any thread) onto the cplane subject."""
+
+    def __init__(self, cplane, subject: str, worker_id: int, loop: Optional[asyncio.AbstractEventLoop] = None):
+        self.cplane = cplane
+        self.subject = subject
+        self.worker_id = worker_id
+        self._loop = loop or asyncio.get_event_loop()
+
+    def publish(self, event: KvCacheEvent) -> None:
+        """Thread-safe fire-and-forget publish (engine thread calls this)."""
+        wire = RouterEvent(worker_id=self.worker_id, event=event).to_wire()
+
+        def _go() -> None:
+            asyncio.ensure_future(self.cplane.publish(self.subject, wire))
+
+        self._loop.call_soon_threadsafe(_go)
+
+    # direct coroutine form for same-loop callers
+    async def publish_async(self, event: KvCacheEvent) -> None:
+        wire = RouterEvent(worker_id=self.worker_id, event=event).to_wire()
+        await self.cplane.publish(self.subject, wire)
+
+
+class KvMetricsPublisher:
+    """Holds the latest ForwardPassMetrics; plugs into the endpoint stats
+    handler (reference: publisher.rs:76 create_endpoint w/ stats handler)."""
+
+    def __init__(self, metrics_fn: Callable[[], dict]):
+        self.metrics_fn = metrics_fn
+
+    def stats_handler(self) -> dict:
+        try:
+            return {"kv_metrics": self.metrics_fn()}
+        except Exception:
+            log.exception("metrics_fn failed")
+            return {}

@@ -1,0 +1,112 @@
+"""KvRouter: the KV-aware worker-selection service.
+
+Mirrors the reference KvRouter (reference: lib/llm/src/kv_router.rs:57-143):
+subscribes to the component's ``kv_events`` subject feeding the radix indexer,
+keeps a load snapshot via the metrics aggregator, and schedules requests with
+the cost function. Worker death (instance key deletion) removes the worker
+from the index.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional, Sequence
+
+from dynamo_tpu.llm.kv_router.indexer import KvIndexer, RouterEvent
+from dynamo_tpu.llm.kv_router.metrics_aggregator import KvMetricsAggregator
+from dynamo_tpu.llm.kv_router.scheduler import KVHitRateEvent, KvScheduler, WorkerLoad
+from dynamo_tpu.runtime.component import INSTANCE_PREFIX
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("kv_router")
+
+KV_HIT_RATE_SUBJECT = "kv-hit-rate"
+
+
+class KvRouter:
+    def __init__(
+        self,
+        drt,
+        namespace: str,
+        component: str,
+        kv_block_size: int = 16,
+        metrics_interval: float = 1.0,
+    ):
+        self.drt = drt
+        self.namespace = namespace
+        self.component = component
+        self.kv_block_size = kv_block_size
+        self.indexer = KvIndexer(kv_block_size)
+        self.scheduler = KvScheduler(kv_block_size, event_sink=self._emit_hit_rate)
+        self.aggregator = KvMetricsAggregator(
+            drt.cplane, namespace, component, interval=metrics_interval
+        )
+        self.aggregator.on_update(self.scheduler.update_endpoints)
+        self._watcher = None
+        self._watch_task: Optional[asyncio.Task] = None
+
+    # ---------------- lifecycle ----------------
+
+    async def start(self) -> "KvRouter":
+        subject = f"{self.namespace}|{self.component}.kv_events"
+        await self.drt.cplane.subscribe(subject, self._on_kv_event)
+        await self.aggregator.start()
+        # instance watch: remove dead workers from the index
+        prefix = f"{INSTANCE_PREFIX}/{self.namespace}/components/{self.component}/"
+        self._watcher = await self.drt.cplane.kv_get_and_watch_prefix(prefix)
+        self._watch_task = asyncio.create_task(self._watch_instances())
+        return self
+
+    async def stop(self) -> None:
+        await self.aggregator.stop()
+        if self._watch_task:
+            self._watch_task.cancel()
+        if self._watcher:
+            try:
+                await self._watcher.stop()
+            except Exception:
+                pass
+
+    # ---------------- event feeds ----------------
+
+    def _on_kv_event(self, msg: dict) -> None:
+        try:
+            self.indexer.apply_event(RouterEvent.from_wire(msg["payload"]))
+        except Exception:
+            log.exception("bad kv event")
+
+    async def _watch_instances(self) -> None:
+        try:
+            async for ev in self._watcher.events():
+                if ev.kind == "delete":
+                    worker_id = int(ev.key.rsplit(":", 1)[1], 16)
+                    log.info("worker %x gone; pruning index", worker_id)
+                    self.indexer.remove_worker(worker_id)
+        except asyncio.CancelledError:
+            pass
+
+    def _emit_hit_rate(self, event: KVHitRateEvent) -> None:
+        asyncio.ensure_future(
+            self.drt.cplane.publish(
+                f"{self.namespace}.{KV_HIT_RATE_SUBJECT}",
+                {
+                    "worker_id": event.worker_id,
+                    "isl_blocks": event.isl_blocks,
+                    "overlap_blocks": event.overlap_blocks,
+                },
+            )
+        )
+
+    # ---------------- scheduling ----------------
+
+    async def schedule(self, token_ids: Sequence[int]) -> int:
+        """Pick the best worker for these prompt tokens
+        (reference: kv_router.rs:131 schedule)."""
+        overlap = self.indexer.find_matches_for_request(token_ids)
+        if not self.scheduler.endpoints.workers:
+            await self.aggregator.scrape_once()
+        return self.scheduler.schedule(len(token_ids), overlap)
+
+    def prefix_hit_tokens(self, token_ids: Sequence[int], worker_id: int) -> int:
+        overlap = self.indexer.find_matches_for_request(token_ids)
+        return overlap.scores.get(worker_id, 0) * self.kv_block_size

@@ -1,0 +1,174 @@
+"""Cost-based worker selection for KV-aware routing.
+
+Formula mirrors the reference (reference: lib/llm/src/kv_router/scheduler.rs:215-316):
+
+  cost = alpha * load_deviation + (1 - alpha) * normalized_new_tokens
+         + gamma * request_load_ratio
+
+with alpha = 0.7 when in balance mode (load_std > 0.1 * load_avg) else 0.3,
+gamma = 0.1; workers at slot or block capacity are excluded; the chosen
+worker's counters are bumped optimistically; a KVHitRateEvent is emitted.
+
+One deliberate fix vs the reference: load_avg/load_std are computed over KV
+*usage ratios* (the reference mixes absolute block counts into an average that
+is then compared against ratios, scoring.rs:32-49).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+from dynamo_tpu.llm.kv_router.indexer import OverlapScores, WorkerId
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("kv_router.scheduler")
+
+BALANCE_THRESHOLD = 0.1
+ALPHA_BALANCE = 0.7
+ALPHA_NORMAL = 0.3
+GAMMA = 0.1
+
+
+class NoWorkersError(RuntimeError):
+    pass
+
+
+class AllWorkersBusyError(RuntimeError):
+    pass
+
+
+@dataclass
+class WorkerLoad:
+    """ForwardPassMetrics snapshot for one worker
+    (reference: kv_router/protocols.rs:19-33)."""
+
+    worker_id: WorkerId
+    request_active_slots: int = 0
+    request_total_slots: int = 1
+    kv_active_blocks: int = 0
+    kv_total_blocks: int = 1
+    num_requests_waiting: int = 0
+    gpu_cache_usage_perc: float = 0.0
+    gpu_prefix_cache_hit_rate: float = 0.0
+
+    @property
+    def kv_load_ratio(self) -> float:
+        return self.kv_active_blocks / max(1, self.kv_total_blocks)
+
+    @property
+    def request_load_ratio(self) -> float:
+        return self.request_active_slots / max(1, self.request_total_slots)
+
+    @classmethod
+    def from_wire(cls, worker_id: int, d: dict) -> "WorkerLoad":
+        return cls(worker_id=worker_id, **{
+            k: d[k] for k in (
+                "request_active_slots", "request_total_slots", "kv_active_blocks",
+                "kv_total_blocks", "num_requests_waiting", "gpu_cache_usage_perc",
+                "gpu_prefix_cache_hit_rate",
+            ) if k in d
+        })
+
+
+@dataclass
+class ProcessedEndpoints:
+    """Load snapshot + aggregate stats (reference: kv_router/scoring.rs)."""
+
+    workers: list[WorkerLoad] = field(default_factory=list)
+    load_avg: float = 0.0
+    load_std: float = 0.0
+
+    @classmethod
+    def new(cls, workers: Sequence[WorkerLoad]) -> "ProcessedEndpoints":
+        loads = [w.kv_load_ratio for w in workers]
+        if loads:
+            avg = sum(loads) / len(loads)
+            std = math.sqrt(sum((x - avg) ** 2 for x in loads) / len(loads))
+        else:
+            avg = std = 0.0
+        return cls(workers=list(workers), load_avg=avg, load_std=std)
+
+
+@dataclass
+class KVHitRateEvent:
+    worker_id: WorkerId
+    isl_blocks: int
+    overlap_blocks: int
+
+
+def select_worker(
+    endpoints: ProcessedEndpoints,
+    isl_tokens: int,
+    overlap: OverlapScores,
+    kv_block_size: int,
+    event_sink: Optional[Callable[[KVHitRateEvent], None]] = None,
+) -> WorkerId:
+    if not endpoints.workers:
+        raise NoWorkersError("no endpoints")
+
+    balance_mode = endpoints.load_std > BALANCE_THRESHOLD * endpoints.load_avg
+    alpha = ALPHA_BALANCE if balance_mode else ALPHA_NORMAL
+
+    best: Optional[WorkerLoad] = None
+    best_cost = math.inf
+    for w in endpoints.workers:
+        if w.request_active_slots >= w.request_total_slots:
+            continue
+        if w.kv_active_blocks >= w.kv_total_blocks:
+            continue
+        load_deviation = w.kv_load_ratio - endpoints.load_avg
+        overlap_tokens = overlap.scores.get(w.worker_id, 0) * kv_block_size
+        new_tokens = max(0, isl_tokens - overlap_tokens)
+        normalized_new_tokens = new_tokens / max(1, isl_tokens)
+        cost = (
+            alpha * load_deviation
+            + (1.0 - alpha) * normalized_new_tokens
+            + GAMMA * w.request_load_ratio
+        )
+        log.debug(
+            "worker %x: dev=%.3f new=%.3f req=%.3f cost=%.4f",
+            w.worker_id, load_deviation, normalized_new_tokens, w.request_load_ratio, cost,
+        )
+        if cost < best_cost:
+            best_cost = cost
+            best = w
+
+    if best is None:
+        raise AllWorkersBusyError("all workers at capacity")
+
+    # optimistic bump until the next metrics scrape refreshes the snapshot
+    best.request_active_slots += 1
+    best.kv_active_blocks += max(1, isl_tokens // kv_block_size)
+
+    if event_sink is not None:
+        event_sink(
+            KVHitRateEvent(
+                worker_id=best.worker_id,
+                isl_blocks=isl_tokens // kv_block_size,
+                overlap_blocks=overlap.scores.get(best.worker_id, 0),
+            )
+        )
+    return best.worker_id
+
+
+class KvScheduler:
+    """Holds the rolling load snapshot and applies select_worker."""
+
+    def __init__(self, kv_block_size: int, event_sink: Optional[Callable[[KVHitRateEvent], None]] = None):
+        self.kv_block_size = kv_block_size
+        self.event_sink = event_sink
+        self._endpoints = ProcessedEndpoints()
+
+    def update_endpoints(self, workers: Sequence[WorkerLoad]) -> None:
+        self._endpoints = ProcessedEndpoints.new(workers)
+
+    @property
+    def endpoints(self) -> ProcessedEndpoints:
+        return self._endpoints
+
+    def schedule(self, isl_tokens: int, overlap: OverlapScores) -> WorkerId:
+        return select_worker(
+            self._endpoints, isl_tokens, overlap, self.kv_block_size, self.event_sink
+        )

@@ -1,0 +1,199 @@
+"""KV router: radix indexer, cost scheduler, and the full routed path over the
+broker (engine allocator events -> indexer -> schedule)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.engine.page_table import PageAllocator
+from dynamo_tpu.llm.kv_events import KvCacheEvent, StoredBlock
+from dynamo_tpu.llm.kv_router.indexer import KvIndexer, OverlapScores, RouterEvent
+from dynamo_tpu.llm.kv_router.scheduler import (
+    AllWorkersBusyError,
+    KvScheduler,
+    ProcessedEndpoints,
+    WorkerLoad,
+    select_worker,
+)
+from dynamo_tpu.llm.tokens import compute_block_hash_for_seq
+
+BS = 4  # kv block size
+
+
+def stored(worker, indexer, parent, blocks):
+    """blocks: list of (block_hash, tokens_hash)."""
+    indexer.apply_event(
+        RouterEvent(
+            worker_id=worker,
+            event=KvCacheEvent.stored(
+                parent_hash=parent,
+                blocks=[StoredBlock(block_hash=b, tokens_hash=t) for b, t in blocks],
+            ),
+        )
+    )
+
+
+def test_indexer_basic_match_and_removal():
+    idx = KvIndexer(BS)
+    # worker 1 caches blocks A->B; worker 2 caches A only
+    stored(1, idx, None, [(100, 10), (101, 11)])
+    stored(2, idx, None, [(200, 10)])
+
+    scores = idx.find_matches([10, 11])
+    assert scores.scores == {1: 2, 2: 1}
+    scores = idx.find_matches([10, 99])
+    assert scores.scores == {1: 1, 2: 1}
+    scores = idx.find_matches([99])
+    assert scores.scores == {}
+
+    # removed event drops only that worker's claim
+    idx.apply_event(RouterEvent(worker_id=1, event=KvCacheEvent.removed([100])))
+    scores = idx.find_matches([10, 11])
+    assert scores.scores == {2: 1, 1: 1}  # worker 1 still owns depth-2 block
+
+    idx.remove_worker(2)
+    assert idx.find_matches([10]).scores == {}
+
+
+def test_indexer_parent_chaining_mid_tree():
+    idx = KvIndexer(BS)
+    stored(1, idx, None, [(100, 10)])
+    # attach at depth 1 via parent block_hash
+    stored(1, idx, 100, [(101, 11)])
+    assert idx.find_matches([10, 11]).scores == {1: 2}
+    # a different worker with same content hashes shares nodes
+    stored(2, idx, None, [(300, 10)])
+    stored(2, idx, 300, [(301, 11)])
+    assert idx.find_matches([10, 11]).scores == {1: 2, 2: 2}
+
+
+def test_indexer_from_allocator_events():
+    """Engine-side PageAllocator events drive the router index end-to-end."""
+    events = []
+    alloc = PageAllocator(32, BS, event_sink=events.append)
+    prompt = list(range(12))  # 3 full blocks
+    alloc.allocate_sequence("s1", prompt)
+    alloc.commit_prefilled("s1", 12)
+
+    idx = KvIndexer(BS)
+    for ev in events:
+        idx.apply_event(RouterEvent(worker_id=7, event=ev))
+
+    scores = idx.find_matches_for_request(prompt)
+    assert scores.scores == {7: 3}
+    # a longer prompt sharing 2 blocks
+    scores = idx.find_matches_for_request(prompt[:8] + [99, 98, 97, 96])
+    assert scores.scores == {7: 2}
+
+
+def load(worker_id, active=0, total=10, kv_active=0, kv_total=100):
+    return WorkerLoad(
+        worker_id=worker_id,
+        request_active_slots=active,
+        request_total_slots=total,
+        kv_active_blocks=kv_active,
+        kv_total_blocks=kv_total,
+    )
+
+
+def test_scheduler_prefers_overlap():
+    eps = ProcessedEndpoints.new([load(1), load(2)])
+    overlap = OverlapScores(scores={2: 8})  # 8 blocks cached on worker 2
+    picked = select_worker(eps, isl_tokens=64, overlap=overlap, kv_block_size=BS)
+    assert picked == 2
+
+
+def test_scheduler_balance_mode_avoids_loaded_worker():
+    # worker 1 has the overlap but is heavily loaded; balance mode weighs load
+    eps = ProcessedEndpoints.new(
+        [load(1, kv_active=90), load(2, kv_active=5)]
+    )
+    overlap = OverlapScores(scores={1: 2})  # small overlap on the loaded one
+    picked = select_worker(eps, isl_tokens=64, overlap=overlap, kv_block_size=BS)
+    assert picked == 2
+
+
+def test_scheduler_excludes_full_workers():
+    eps = ProcessedEndpoints.new([load(1, active=10), load(2)])
+    picked = select_worker(eps, 16, OverlapScores(scores={1: 4}), BS)
+    assert picked == 2
+    eps = ProcessedEndpoints.new([load(1, active=10), load(2, kv_active=100)])
+    with pytest.raises(AllWorkersBusyError):
+        select_worker(eps, 16, OverlapScores(), BS)
+
+
+def test_scheduler_optimistic_bump():
+    sched = KvScheduler(BS)
+    sched.update_endpoints([load(1, total=2), load(2, total=2)])
+    first = sched.schedule(16, OverlapScores(scores={1: 4}))
+    assert first == 1
+    # after two more schedules worker 1 fills up (bumped to 2 slots), so 2 wins
+    sched.schedule(16, OverlapScores(scores={1: 4}))
+    third = sched.schedule(16, OverlapScores(scores={1: 4}))
+    assert third == 2
+
+
+def test_kv_router_over_broker():
+    from dynamo_tpu.cplane.broker import Broker
+    from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher, KvMetricsPublisher
+    from dynamo_tpu.llm.kv_router.router import KvRouter
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    async def body():
+        broker = Broker()
+        port = await broker.start()
+        worker = DistributedRuntime(cplane_address=f"127.0.0.1:{port}")
+        await worker.connect()
+        router_rt = DistributedRuntime(cplane_address=f"127.0.0.1:{port}")
+        await router_rt.connect()
+        try:
+            wid = worker.primary_lease.lease_id
+
+            # worker serves an endpoint exposing kv metrics via stats handler
+            async def handler(req):
+                yield {"ok": True}
+
+            metrics = KvMetricsPublisher(
+                lambda: {
+                    "request_active_slots": 0,
+                    "request_total_slots": 4,
+                    "kv_active_blocks": 0,
+                    "kv_total_blocks": 100,
+                }
+            )
+            ep = worker.namespace("ns").component("backend").endpoint("generate")
+            await ep.serve_endpoint(handler, metrics=metrics.stats_handler)
+
+            router = KvRouter(router_rt, "ns", "backend", kv_block_size=BS)
+            await router.start()
+
+            # engine-side: allocator events flow through the publisher
+            pub = KvEventPublisher(
+                worker.cplane, "ns|backend.kv_events", wid, loop=asyncio.get_running_loop()
+            )
+            alloc = PageAllocator(32, BS, event_sink=lambda e: asyncio.ensure_future(
+                pub.publish_async(e)
+            ))
+            prompt = list(range(16))
+            alloc.allocate_sequence("s1", prompt)
+            alloc.commit_prefilled("s1", 16)
+            await asyncio.sleep(0.2)  # let events propagate
+
+            assert router.indexer.find_matches_for_request(prompt).scores == {wid: 4}
+            picked = await router.schedule(prompt)
+            assert picked == wid
+            assert router.prefix_hit_tokens(prompt, wid) == 16
+
+            # worker death prunes the index
+            await worker._shutdown_hook()
+            for _ in range(100):
+                if not router.indexer.find_matches_for_request(prompt).scores:
+                    break
+                await asyncio.sleep(0.02)
+            assert router.indexer.find_matches_for_request(prompt).scores == {}
+            await router.stop()
+        finally:
+            await router_rt._shutdown_hook()
+            await broker.stop()
+
+    asyncio.run(body())
