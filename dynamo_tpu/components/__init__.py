@@ -1,0 +1,6 @@
+"""Deployable service components: frontend (HTTP + model discovery), processor
+(preprocess + KV-aware routing), worker (JAX engine), prefill worker.
+
+These are the building blocks the reference ships as examples/llm components +
+the standalone http/metrics binaries (reference: components/http, components/
+metrics, examples/llm/components/)."""

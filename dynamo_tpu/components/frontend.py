@@ -1,0 +1,112 @@
+"""Frontend service: OpenAI HTTP + model discovery.
+
+Watches the control-plane ``models/`` prefix; for every registered ModelEntry
+it builds a remote pipeline (local preprocessor from the model card + a remote
+backend that streams from the entry's endpoint) and attaches it to the HTTP
+service. Models detach when their registration disappears.
+
+Mirrors the reference standalone http frontend + discovery watcher
+(reference: components/http/src/main.rs:29-101, lib/llm/src/http/service/
+discovery.rs:1-145).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import AsyncIterator, Optional
+
+import msgpack
+
+from dynamo_tpu.llm.http.service import HttpService, ModelPipeline
+from dynamo_tpu.llm.model_registry import MODELS_PREFIX, ModelEntry
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.llm.protocols.common import BackendOutput, PreprocessedRequest
+from dynamo_tpu.llm.tokenizer import get_tokenizer
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("components.frontend")
+
+
+class RemoteBackend:
+    """Backend facade that streams BackendOutputs from a runtime endpoint."""
+
+    def __init__(self, drt, endpoint: str):
+        self.drt = drt
+        self.endpoint = endpoint
+        self._client = None
+
+    async def _ensure_client(self):
+        if self._client is None:
+            self._client = await self.drt.endpoint_client(self.endpoint)
+            await self._client.wait_for_instances(timeout=10)
+        return self._client
+
+    async def generate(self, request: PreprocessedRequest) -> AsyncIterator[BackendOutput]:
+        client = await self._ensure_client()
+        stream = await client.random(request.to_wire())
+        async for item in stream:
+            yield BackendOutput(
+                request_id=item.get("request_id", request.request_id),
+                text=item.get("text", ""),
+                token_ids=list(item.get("token_ids", [])),
+                finish_reason=item.get("finish_reason"),
+                cumulative_tokens=item.get("cumulative_tokens", 0),
+                cached_tokens=item.get("cached_tokens", 0),
+            )
+
+
+class FrontendService:
+    def __init__(self, drt, host: str = "0.0.0.0", port: int = 8080):
+        self.drt = drt
+        self.service = HttpService(host=host, port=port)
+        self._watcher = None
+        self._watch_task: Optional[asyncio.Task] = None
+        self._entries: dict[str, ModelEntry] = {}
+
+    async def start(self) -> int:
+        port = await self.service.start()
+        self._watcher = await self.drt.cplane.kv_get_and_watch_prefix(MODELS_PREFIX + "/")
+        for item in self._watcher.initial:
+            self._attach(ModelEntry.from_wire(item.value))
+        self._watch_task = asyncio.create_task(self._watch_loop())
+        return port
+
+    async def stop(self) -> None:
+        if self._watch_task:
+            self._watch_task.cancel()
+        if self._watcher:
+            try:
+                await self._watcher.stop()
+            except Exception:
+                pass
+        await self.service.stop()
+
+    async def _watch_loop(self) -> None:
+        try:
+            async for ev in self._watcher.events():
+                if ev.kind == "put":
+                    self._attach(ModelEntry.from_wire(ev.value))
+                elif ev.kind == "delete":
+                    name = ev.key.rsplit("/", 1)[1]
+                    entry = self._entries.pop(name, None)
+                    if entry is not None:
+                        self.service.manager.remove(entry.name)
+                        log.info("model detached: %s", name)
+        except asyncio.CancelledError:
+            pass
+
+    def _attach(self, entry: ModelEntry) -> None:
+        card = entry.card
+        if card is None:
+            log.warning("model %s has no deployment card; skipping", entry.name)
+            return
+        tokenizer = get_tokenizer(card.tokenizer)
+        preprocessor = OpenAIPreprocessor(
+            tokenizer, model_name=entry.name, max_model_len=card.context_length
+        )
+        backend = RemoteBackend(self.drt, entry.endpoint)
+        self.service.manager.add(
+            ModelPipeline(entry.name, preprocessor, backend, model_type="both")
+        )
+        self._entries[entry.name] = entry
+        log.info("model attached: %s -> %s", entry.name, entry.endpoint)

@@ -1,0 +1,110 @@
+"""Worker service: the JAX engine behind a runtime endpoint.
+
+Tokens-in/tokens-out over the wire: PreprocessedRequest dict -> stream of
+BackendOutput dicts (detokenization happens here, next to the engine, so text
+deltas stream back ready to serve — reference: examples/llm/components/
+worker.py VllmWorker, lib/llm/src/backend.rs).
+
+Publishes KV events (kv_events subject) and ForwardPassMetrics (stats handler)
+so KV routers can target it. Optionally wraps the engine in the disagg decode
+path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import AsyncJaxEngine
+from dynamo_tpu.llm.backend import Backend
+from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.model_registry import ModelEntry, register_model
+from dynamo_tpu.llm.protocols.common import PreprocessedRequest
+from dynamo_tpu.llm.tokenizer import get_tokenizer
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("components.worker")
+
+GENERATE_ENDPOINT = "generate"
+
+
+class WorkerService:
+    def __init__(
+        self,
+        drt,
+        namespace: str,
+        component: str,
+        card: ModelDeploymentCard,
+        engine_config: EngineConfig,
+        enable_disagg_decode: bool = False,
+        register: bool = True,
+    ):
+        self.drt = drt
+        self.namespace = namespace
+        self.component = component
+        self.card = card
+        self.engine_config = engine_config
+        self.enable_disagg_decode = enable_disagg_decode
+        self.register = register
+        self.engine = None  # AsyncJaxEngine or DisaggDecodeEngine
+        self.backend: Optional[Backend] = None
+        self._served = None
+        self._kv_publisher: Optional[KvEventPublisher] = None
+
+    async def start(self) -> "WorkerService":
+        loop = asyncio.get_running_loop()
+        worker_id = self.drt.primary_lease.lease_id
+        subject = f"{self.namespace}|{self.component}.kv_events"
+        self._kv_publisher = KvEventPublisher(self.drt.cplane, subject, worker_id, loop=loop)
+
+        inner = AsyncJaxEngine(self.engine_config, kv_event_sink=self._kv_publisher.publish)
+        await inner.start()
+        engine = inner
+        if self.enable_disagg_decode:
+            from dynamo_tpu.disagg.decode_worker import DisaggDecodeEngine
+
+            engine = DisaggDecodeEngine(
+                inner, self.drt, self.namespace, self.component, self.card.display_name
+            )
+            await engine.start()
+        self.engine = engine
+        self._inner_engine = inner
+
+        tokenizer = get_tokenizer(self.card.tokenizer)
+        self.backend = Backend(engine, tokenizer)
+
+        ep = self.drt.namespace(self.namespace).component(self.component).endpoint(GENERATE_ENDPOINT)
+        self._served = await ep.serve_endpoint(self._handle, metrics=self._stats)
+
+        if self.register:
+            entry = ModelEntry(
+                name=self.card.display_name,
+                endpoint=f"dyn://{self.namespace}.{self.component}.{GENERATE_ENDPOINT}",
+                model_type="chat",
+                card=self.card,
+            )
+            await register_model(self.drt.cplane, entry)
+        return self
+
+    async def stop(self) -> None:
+        if self._served is not None:
+            await self._served.stop()
+        if self.engine is not None:
+            await self.engine.shutdown()
+
+    def _stats(self) -> dict:
+        return {"kv_metrics": self._inner_engine.metrics().to_wire()}
+
+    async def _handle(self, request: dict):
+        pre = PreprocessedRequest.from_wire(request)
+        async for out in self.backend.generate(pre):
+            yield {
+                "request_id": out.request_id,
+                "text": out.text,
+                "token_ids": out.token_ids,
+                "finish_reason": out.finish_reason,
+                "cumulative_tokens": out.cumulative_tokens,
+                "cached_tokens": out.cached_tokens,
+            }

@@ -1,0 +1,5 @@
+"""Disaggregated prefill/decode serving (reference: docs/disagg_serving.md,
+examples/llm/components/{worker,prefill_worker}.py, the NIXL patch)."""
+
+from dynamo_tpu.disagg.decode_worker import DisaggDecodeEngine
+from dynamo_tpu.disagg.prefill_worker import PrefillWorker

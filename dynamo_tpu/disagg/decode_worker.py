@@ -1,0 +1,151 @@
+"""Decode-side disaggregation: the DisaggDecodeEngine wraps a local
+AsyncJaxEngine and conditionally offloads prefill to remote prefill workers.
+
+Flow (mirrors reference: examples/llm/components/worker.py:148-189):
+  1. estimate prefix-cache hit; ask the DisaggregatedRouter local-vs-remote
+  2. remote: allocate decode-side pages, push a RemotePrefillRequest onto the
+     broker work queue, await the PrefillResult on our ``prefill_result``
+     endpoint (KV rides the TCP call-home data plane — the NIXL WRITE +
+     notification analogue), inject + adopt
+  3. local: plain engine.generate
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import AsyncIterator, Optional
+
+from dynamo_tpu.engine.engine import AsyncJaxEngine
+from dynamo_tpu.engine.scheduler import EngineRequest, StepOutput
+from dynamo_tpu.llm.disagg_router import DisaggregatedRouter
+from dynamo_tpu.llm.remote_prefill import (
+    PrefillResult,
+    RemotePrefillRequest,
+    prefill_queue_name,
+)
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("disagg.decode")
+
+PREFILL_RESULT_ENDPOINT = "prefill_result"
+
+
+class DisaggDecodeEngine:
+    """Same generate() contract as AsyncJaxEngine; routes prefill conditionally."""
+
+    def __init__(
+        self,
+        engine: AsyncJaxEngine,
+        drt,
+        namespace: str,
+        component: str,
+        model: str,
+        disagg_router: Optional[DisaggregatedRouter] = None,
+        remote_prefill_timeout: float = 120.0,
+    ):
+        self.engine = engine
+        self.drt = drt
+        self.namespace = namespace
+        self.component = component
+        self.model = model
+        self.router = disagg_router or DisaggregatedRouter(model, cplane=drt.cplane)
+        self.queue_name = prefill_queue_name(namespace, model)
+        self.remote_prefill_timeout = remote_prefill_timeout
+        self._pending: dict[str, asyncio.Future] = {}
+        self._served = None
+        # disagg stats
+        self.remote_prefills = 0
+        self.local_prefills = 0
+
+    # ---------------- lifecycle ----------------
+
+    async def start(self) -> "DisaggDecodeEngine":
+        """Serve the prefill_result endpoint prefill workers call home to."""
+        ep = (
+            self.drt.namespace(self.namespace)
+            .component(self.component)
+            .endpoint(PREFILL_RESULT_ENDPOINT)
+        )
+        self._served = await ep.serve_endpoint(self._on_prefill_result)
+        await self.router.start_watching()
+        return self
+
+    async def shutdown(self) -> None:
+        if self._served is not None:
+            await self._served.stop()
+        await self.router.stop()
+        await self.engine.shutdown()
+
+    @property
+    def worker_id(self) -> int:
+        return self.drt.primary_lease.lease_id
+
+    def metrics(self):
+        return self.engine.metrics()
+
+    # ---------------- prefill result ingestion ----------------
+
+    async def _on_prefill_result(self, request: dict):
+        result = PrefillResult.from_wire(request)
+        fut = self._pending.pop(result.request_id, None)
+        if fut is None:
+            log.warning("prefill result for unknown request %s", result.request_id)
+            yield {"ok": False, "error": "unknown request"}
+            return
+        fut.set_result(result)
+        yield {"ok": True}
+
+    # ---------------- generate ----------------
+
+    async def generate(self, request: EngineRequest) -> AsyncIterator[StepOutput]:
+        prompt = list(request.token_ids)
+        prefix_hit = await self.engine.run_on_engine(
+            lambda: self.engine.sync_lookup_prefix(prompt)
+        )
+        try:
+            queue_depth = await self.drt.cplane.queue_depth(self.queue_name)
+        except Exception:
+            queue_depth = 0
+
+        if not self.router.prefill_remote(len(prompt), prefix_hit, queue_depth):
+            self.local_prefills += 1
+            async for out in self.engine.generate(request):
+                yield out
+            return
+
+        self.remote_prefills += 1
+        log.debug(
+            "remote prefill for %s (len=%d hit=%d depth=%d)",
+            request.request_id, len(prompt), prefix_hit, queue_depth,
+        )
+        rid = request.request_id
+        cached_len, shared_pages = await self.engine.run_on_engine(
+            lambda: self.engine.sync_allocate_remote(rid, prompt)
+        )
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        self.engine._register_stream(rid)
+        try:
+            rp = RemotePrefillRequest(
+                request_id=rid,
+                token_ids=prompt,
+                temperature=request.sampling.temperature,
+                top_k=request.sampling.top_k,
+                top_p=request.sampling.top_p,
+                decode_worker_id=self.worker_id,
+                decode_endpoint=f"dyn://{self.namespace}.{self.component}.{PREFILL_RESULT_ENDPOINT}",
+                skip_leading_tokens=shared_pages * self.engine.config.page_size,
+            )
+            await self.drt.cplane.queue_push(self.queue_name, rp.to_wire())
+            result: PrefillResult = await asyncio.wait_for(fut, self.remote_prefill_timeout)
+            await self.engine.run_on_engine(
+                lambda: self.engine.sync_adopt_prefilled(request, result, cached_len)
+            )
+        except Exception:
+            self._pending.pop(rid, None)
+            await self.engine.run_on_engine(lambda: self.engine.sync_abort_remote(rid))
+            self.engine._outputs.pop(rid, None)
+            raise
+
+        async for out in self.engine._drain_stream(rid):
+            yield out

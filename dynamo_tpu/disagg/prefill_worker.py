@@ -1,0 +1,81 @@
+"""Prefill worker: consumes the remote-prefill work queue, runs prefill on its
+engine, and pushes KV + first token to the decode worker.
+
+Mirrors the reference prefill worker loop (reference: examples/llm/components/
+prefill_worker.py:84-137 prefill_queue_handler).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from dynamo_tpu.engine.engine import AsyncJaxEngine
+from dynamo_tpu.llm.remote_prefill import RemotePrefillRequest, prefill_queue_name
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("disagg.prefill")
+
+
+class PrefillWorker:
+    def __init__(
+        self,
+        engine: AsyncJaxEngine,
+        drt,
+        namespace: str,
+        model: str,
+    ):
+        self.engine = engine
+        self.drt = drt
+        self.namespace = namespace
+        self.model = model
+        self.queue_name = prefill_queue_name(namespace, model)
+        self._task: Optional[asyncio.Task] = None
+        self._clients: dict[str, object] = {}
+        self.completed = 0
+
+    async def start(self) -> "PrefillWorker":
+        self._task = asyncio.create_task(self._loop())
+        return self
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+
+    async def _client_for(self, endpoint: str):
+        client = self._clients.get(endpoint)
+        if client is None:
+            client = await self.drt.endpoint_client(endpoint)
+            await client.wait_for_instances(timeout=10)
+            self._clients[endpoint] = client
+        return client
+
+    async def _loop(self) -> None:
+        log.info("prefill worker consuming %s", self.queue_name)
+        try:
+            while True:
+                msg = await self.drt.cplane.queue_pull(self.queue_name)
+                try:
+                    await self._handle(RemotePrefillRequest.from_wire(msg.payload))
+                    await self.drt.cplane.queue_ack(self.queue_name, msg.msg_id)
+                    self.completed += 1
+                except Exception:
+                    log.exception("remote prefill failed; nacking")
+                    try:
+                        await self.drt.cplane.queue_nack(self.queue_name, msg.msg_id)
+                    except Exception:
+                        pass
+        except asyncio.CancelledError:
+            pass
+
+    async def _handle(self, rp: RemotePrefillRequest) -> None:
+        result = await self.engine.run_on_engine(
+            lambda: self.engine.sync_remote_prefill(rp)
+        )
+        client = await self._client_for(rp.decode_endpoint)
+        # deliver directly to the requesting decode worker (KV over the TCP
+        # call-home data plane; the RDMA-WRITE + notify analogue)
+        stream = await client.direct(result.to_wire(), rp.decode_worker_id)
+        async for ack in stream:
+            if not ack.get("ok"):
+                raise RuntimeError(f"decode worker rejected prefill result: {ack}")

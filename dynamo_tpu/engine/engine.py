@@ -13,6 +13,8 @@ import asyncio
 import queue as thread_queue
 import threading
 import time
+
+import numpy as np
 from dataclasses import dataclass, field
 from typing import AsyncIterator, Callable, Optional
 
@@ -23,6 +25,15 @@ from dynamo_tpu.llm.kv_events import KvCacheEvent
 from dynamo_tpu.utils import get_logger
 
 log = get_logger("engine")
+
+
+def _resolve(fut: asyncio.Future, result, exc) -> None:
+    if fut.done():
+        return
+    if exc is not None:
+        fut.set_exception(exc)
+    else:
+        fut.set_result(result)
 
 
 @dataclass
@@ -51,6 +62,7 @@ class AsyncJaxEngine:
         self._kv_events: list[KvCacheEvent] = []
         self._inbox: thread_queue.Queue = thread_queue.Queue()
         self._cancel_box: thread_queue.Queue = thread_queue.Queue()
+        self._cmd_box: thread_queue.Queue = thread_queue.Queue()
         self._outputs: dict[str, asyncio.Queue] = {}
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
@@ -101,14 +113,24 @@ class AsyncJaxEngine:
 
     async def generate(self, request: EngineRequest) -> AsyncIterator[StepOutput]:
         """Submit a request; yields StepOutputs until finished."""
+        self._register_stream(request.request_id)
+        self._inbox.put(request)
+        async for item in self._drain_stream(request.request_id):
+            yield item
+
+    def _register_stream(self, request_id: str) -> None:
+        """Open the output channel for a request without scheduling it (the
+        disagg decode path schedules via adoption instead)."""
         if not self._started:
             raise RuntimeError("engine not started")
         out_q: asyncio.Queue = asyncio.Queue()
         # Capture the caller's loop per request: generate() may be called from a
         # different event loop than start() (each call_soon_threadsafe must
         # target the loop that owns the queue).
-        self._outputs[request.request_id] = (asyncio.get_running_loop(), out_q)
-        self._inbox.put(request)
+        self._outputs[request_id] = (asyncio.get_running_loop(), out_q)
+
+    async def _drain_stream(self, request_id: str) -> AsyncIterator[StepOutput]:
+        _, out_q = self._outputs[request_id]
         try:
             while True:
                 item = await out_q.get()
@@ -118,8 +140,92 @@ class AsyncJaxEngine:
                 if item.finished:
                     return
         finally:
-            self._outputs.pop(request.request_id, None)
-            self._cancel_box.put(request.request_id)
+            self._outputs.pop(request_id, None)
+            self._cancel_box.put(request_id)
+
+    async def run_on_engine(self, fn):
+        """Run fn() on the engine thread (it owns the KV cache/allocator/
+        scheduler). fn may return (value, [StepOutput...]) to also emit stream
+        items; returns the value."""
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._cmd_box.put((fn, loop, fut))
+        return await fut
+
+    # ---------------- disaggregation (run via run_on_engine) ----------------
+    # The decode side allocates pages and adopts; the prefill side computes KV
+    # in its own cache and extracts blocks. See dynamo_tpu/disagg/.
+
+    def sync_lookup_prefix(self, token_ids: list[int]) -> int:
+        return self.allocator.lookup_prefix(token_ids)
+
+    def sync_allocate_remote(self, request_id: str, token_ids: list[int]) -> tuple[int, int]:
+        """Decode side: allocate pages for a remote-prefill sequence.
+        Returns (cached_len, shared_prefix_pages)."""
+        cached_len, state = self.allocator.allocate_sequence(request_id, token_ids)
+        return cached_len, state.shared_prefix_pages
+
+    def sync_abort_remote(self, request_id: str) -> None:
+        if request_id in self.allocator._seqs:
+            self.allocator.free_sequence(request_id)
+
+    def sync_remote_prefill(self, rp) -> "object":
+        """Prefill side: full chunked prefill in our own cache (prefix cache
+        applies), then extract the requested block range to host."""
+        from dynamo_tpu.engine.sampling import SamplingParams
+        from dynamo_tpu.llm.remote_prefill import PrefillResult
+
+        rid = f"rp-{rp.request_id}"
+        prompt_len = len(rp.token_ids)
+        cached_len, state = self.allocator.allocate_sequence(rid, list(rp.token_ids))
+        try:
+            page_table = self._page_table_for(state)
+            req = EngineRequest(
+                request_id=rid,
+                token_ids=list(rp.token_ids),
+                sampling=SamplingParams(
+                    temperature=rp.temperature, top_k=rp.top_k, top_p=rp.top_p, max_tokens=1
+                ),
+            )
+            first_token = self.scheduler.run_prefill_chunks(req, page_table, cached_len, prompt_len)
+            self.allocator.commit_prefilled(rid, prompt_len)
+
+            ps = self.config.page_size
+            start_page = rp.skip_leading_tokens // ps
+            n_pages = -(-prompt_len // ps)
+            ids = state.pages[start_page:n_pages]
+            data = self.runner.extract_pages(np.asarray(ids, np.int32)) if ids else None
+        finally:
+            self.allocator.free_sequence(rid)  # full blocks stay cached for reuse
+
+        return PrefillResult(
+            request_id=rp.request_id,
+            first_token=int(first_token),
+            prompt_len=prompt_len,
+            skip_leading_tokens=start_page * ps,
+            kv_shape=tuple(data.shape) if data is not None else (),
+            kv_dtype=str(data.dtype) if data is not None else "",
+            kv_bytes=data.tobytes() if data is not None else b"",
+        )
+
+    def sync_adopt_prefilled(self, req: EngineRequest, result, cached_len: int):
+        """Decode side: inject received KV blocks into the pre-allocated pages
+        and enter the sequence into decode."""
+        state = self.allocator._seqs[req.request_id]
+        ps = self.config.page_size
+        if result.kv_bytes:
+            start_page = result.skip_leading_tokens // ps
+            n_pages = -(-result.prompt_len // ps)
+            ids = state.pages[start_page:n_pages]
+            self.runner.inject_pages(np.asarray(ids, np.int32), result.kv_array())
+        self.allocator.commit_prefilled(req.request_id, result.prompt_len)
+        outputs = self.scheduler.adopt_prefilled(req, result.first_token, cached_len)
+        return None, outputs  # (value, stream outputs) convention
+
+    def _page_table_for(self, state) -> "np.ndarray":
+        page_table = np.zeros(self.config.max_pages_per_seq, np.int32)
+        page_table[: len(state.pages)] = state.pages
+        return page_table
 
     # ---------------- metrics / events ----------------
 
@@ -175,6 +281,23 @@ class AsyncJaxEngine:
                 req = self._inbox.get_nowait()
                 self.scheduler.add_request(req)
                 got = True
+            except thread_queue.Empty:
+                break
+        while True:
+            try:
+                fn, loop, fut = self._cmd_box.get_nowait()
+                got = True
+                try:
+                    result = fn()
+                    outputs = []
+                    if isinstance(result, tuple) and len(result) == 2 and isinstance(result[1], list):
+                        result, outputs = result
+                    for out in outputs:
+                        self._post(out.request_id, out)
+                    loop.call_soon_threadsafe(_resolve, fut, result, None)
+                except Exception as e:
+                    log.exception("engine command failed")
+                    loop.call_soon_threadsafe(_resolve, fut, None, e)
             except thread_queue.Empty:
                 break
         while True:

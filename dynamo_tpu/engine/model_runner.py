@@ -51,6 +51,12 @@ class ModelRunner:
 
         self._prefill = jax.jit(self._prefill_impl, donate_argnums=(1,))
         self._decode = jax.jit(self._decode_impl, donate_argnums=(1,))
+        # block-granularity KV IO for disaggregation / offload
+        # (the NIXL-slot replacement, reference: patch nixl.py register_kv_caches)
+        self._gather_pages = jax.jit(lambda kv, ids: kv[:, :, ids])
+        self._scatter_pages = jax.jit(
+            lambda kv, ids, data: kv.at[:, :, ids].set(data), donate_argnums=(0,)
+        )
 
     # ---------------- jitted bodies ----------------
 
@@ -103,6 +109,23 @@ class ModelRunner:
         if sample:
             return int(jax.device_get(tok))
         return None
+
+    def extract_pages(self, page_ids: np.ndarray) -> np.ndarray:
+        """Pull KV blocks to host: [L, 2, n, page_size, Hkv, D] numpy.
+
+        The device gather runs jitted; the host copy is the DCN-transfer
+        staging step (same-pod ICI transfers skip this path).
+        """
+        out = self._gather_pages(self.kv_cache, jnp.asarray(page_ids, jnp.int32))
+        return np.asarray(jax.device_get(out))
+
+    def inject_pages(self, page_ids: np.ndarray, data: np.ndarray) -> None:
+        """Write KV blocks received from a peer into our pages (donated scatter)."""
+        self.kv_cache = self._scatter_pages(
+            self.kv_cache,
+            jnp.asarray(page_ids, jnp.int32),
+            jnp.asarray(data, self.kv_cache.dtype),
+        )
 
     def decode_step(
         self,

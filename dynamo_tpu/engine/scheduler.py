@@ -69,6 +69,7 @@ class Scheduler:
         self.runner = runner
         self.allocator = allocator
         self.waiting: deque[EngineRequest] = deque()
+        self.adopted_waiting: deque[RunningSeq] = deque()  # prefilled remotely, need a slot
         self.slots: list[Optional[RunningSeq]] = [None] * config.max_seqs
         self._admit_counter = 0
         self.finished_count = 0
@@ -79,7 +80,11 @@ class Scheduler:
         self.waiting.append(req)
 
     def has_work(self) -> bool:
-        return bool(self.waiting) or any(s is not None for s in self.slots)
+        return (
+            bool(self.waiting)
+            or bool(self.adopted_waiting)
+            or any(s is not None for s in self.slots)
+        )
 
     @property
     def num_running(self) -> int:
@@ -90,6 +95,11 @@ class Scheduler:
             if s is not None and s.req.request_id == request_id:
                 self.allocator.free_sequence(s.req.request_id)
                 self.slots[i] = None
+                return True
+        for s in list(self.adopted_waiting):
+            if s.req.request_id == request_id:
+                self.allocator.free_sequence(request_id)
+                self.adopted_waiting.remove(s)
                 return True
         for req in list(self.waiting):
             if req.request_id == request_id:
@@ -116,6 +126,15 @@ class Scheduler:
     def _admit(self) -> list[StepOutput]:
         outputs = []
         watermark_pages = int(self.config.watermark * self.config.num_pages)
+        # adopted sequences first: their pages are already allocated and their
+        # first token already emitted — they only need a decode slot
+        while self.adopted_waiting:
+            slot = self._free_slot()
+            if slot is None:
+                break
+            seq = self.adopted_waiting.popleft()
+            seq.slot = slot
+            self.slots[slot] = seq
         while self.waiting:
             slot = self._free_slot()
             if slot is None:
@@ -154,7 +173,17 @@ class Scheduler:
         )
         self._admit_counter += 1
 
-        # chunked prefill, skipping the cached prefix
+        first_token = self.run_prefill_chunks(req, page_table, cached_len, prompt_len)
+        self.allocator.commit_prefilled(req.request_id, prompt_len)
+        self.slots[slot] = seq
+        return self._emit_token(seq, first_token, cached=cached_len)
+
+    def run_prefill_chunks(
+        self, req: EngineRequest, page_table: np.ndarray, cached_len: int, prompt_len: int
+    ) -> int:
+        """Chunked bucket-padded prefill, skipping the cached prefix; samples
+        and returns the first output token. Shared by local admission and the
+        disagg prefill worker."""
         s = req.sampling
         first_token: Optional[int] = None
         start = cached_len
@@ -174,9 +203,35 @@ class Scheduler:
             if is_last:
                 first_token = tok
             start = end
+        return first_token
 
-        self.allocator.commit_prefilled(req.request_id, prompt_len)
-        self.slots[slot] = seq
+    def adopt_prefilled(
+        self, req: EngineRequest, first_token: int, cached_len: int = 0
+    ) -> list[StepOutput]:
+        """Adopt a sequence whose prompt KV was produced remotely (disagg path).
+
+        Pages must already be allocated in the allocator under req.request_id
+        and the KV injected; this emits the first token and queues the sequence
+        for a decode slot.
+        """
+        state = self.allocator._seqs[req.request_id]
+        page_table = np.zeros(self.config.max_pages_per_seq, np.int32)
+        page_table[: len(state.pages)] = state.pages
+        seq = RunningSeq(
+            req=req,
+            slot=-1,
+            prompt_len=len(req.token_ids),
+            cached_len=cached_len,
+            page_table=page_table,
+            admitted_order=self._admit_counter,
+        )
+        self._admit_counter += 1
+        slot = self._free_slot()
+        if slot is not None:
+            seq.slot = slot
+            self.slots[slot] = seq
+        else:
+            self.adopted_waiting.append(seq)
         return self._emit_token(seq, first_token, cached=cached_len)
 
     # ---------------- decode ----------------
@@ -264,7 +319,10 @@ class Scheduler:
 
     def _release(self, seq: RunningSeq) -> None:
         self.allocator.free_sequence(seq.req.request_id)
-        self.slots[seq.slot] = None
+        if seq.slot >= 0 and self.slots[seq.slot] is seq:
+            self.slots[seq.slot] = None
+        elif seq in self.adopted_waiting:
+            self.adopted_waiting.remove(seq)
         self.finished_count += 1
 
     def _pick_victim(self, exclude: RunningSeq) -> Optional[RunningSeq]:

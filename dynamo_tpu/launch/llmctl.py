@@ -1,0 +1,86 @@
+"""llmctl: register/list/remove model -> endpoint mappings in the control plane
+that HTTP frontends watch.
+
+Mirrors the reference llmctl (reference: launch/llmctl/src/main.rs:115-442).
+
+    python -m dynamo_tpu.launch.llmctl http add chat-model tiny dyn://ns.backend.generate
+    python -m dynamo_tpu.launch.llmctl http list
+    python -m dynamo_tpu.launch.llmctl http remove chat-model tiny
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+
+from dynamo_tpu.cplane.client import CplaneClient
+from dynamo_tpu.frontends.pipeline import card_for_model
+from dynamo_tpu.llm.model_registry import (
+    ModelEntry,
+    list_models,
+    register_model,
+    unregister_model,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="llmctl", description=__doc__)
+    p.add_argument("--cplane", default=None, help="broker address host:port")
+    sub = p.add_subparsers(dest="plane", required=True)
+    http = sub.add_parser("http", help="manage http-served models")
+    hsub = http.add_subparsers(dest="action", required=True)
+
+    add = hsub.add_parser("add")
+    add.add_argument("kind", choices=["chat-model", "completion-model"])
+    add.add_argument("name")
+    add.add_argument("endpoint", help="dyn://ns.comp.endpoint")
+    add.add_argument("--model-path", default=None, help="local path for card/tokenizer")
+
+    rm = hsub.add_parser("remove")
+    rm.add_argument("kind", choices=["chat-model", "completion-model"])
+    rm.add_argument("name")
+
+    hsub.add_parser("list")
+    return p
+
+
+def _model_type(kind: str) -> str:
+    return "chat" if kind == "chat-model" else "completion"
+
+
+async def _run(args) -> int:
+    import os
+
+    address = args.cplane or os.environ.get("DYNTPU_CPLANE", "127.0.0.1:4222")
+    client = CplaneClient(address)
+    await client.connect()
+    try:
+        if args.action == "add":
+            card = card_for_model(args.model_path or args.name)
+            card.display_name = args.name
+            entry = ModelEntry(
+                name=args.name,
+                endpoint=args.endpoint,
+                model_type=_model_type(args.kind),
+                card=card,
+            )
+            await register_model(client, entry)
+            print(f"registered {args.name} -> {args.endpoint}")
+        elif args.action == "remove":
+            ok = await unregister_model(client, _model_type(args.kind), args.name)
+            print("removed" if ok else "not found")
+        elif args.action == "list":
+            for entry in await list_models(client):
+                print(json.dumps({"name": entry.name, "endpoint": entry.endpoint, "type": entry.model_type}))
+        return 0
+    finally:
+        await client.close()
+
+
+def main(argv=None) -> int:
+    return asyncio.run(_run(build_parser().parse_args(argv)))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,100 @@
+"""Disaggregation decision: local vs remote prefill.
+
+Mirrors the reference DisaggregatedRouter (reference: lib/llm/src/
+disagg_router.rs:38-259): prefill goes remote iff
+
+    prefill_length - prefix_hit_length > max_local_prefill_length
+
+and (queue not too deep). The threshold is live-reloadable via a control-plane
+watch at ``disagg_router/models/chat/{model}`` (reference threshold key:
+public/components/disagg_router/models/chat/<model>).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from dataclasses import dataclass
+from typing import Optional
+
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("disagg_router")
+
+
+def config_key(model: str) -> str:
+    return f"disagg_router/models/chat/{model}"
+
+
+@dataclass
+class DisaggRouterConf:
+    max_local_prefill_length: int = 512
+    max_prefill_queue_size: int = 64
+
+    @classmethod
+    def from_wire(cls, raw: bytes) -> "DisaggRouterConf":
+        d = json.loads(raw)
+        return cls(
+            max_local_prefill_length=int(d.get("max_local_prefill_length", 512)),
+            max_prefill_queue_size=int(d.get("max_prefill_queue_size", 64)),
+        )
+
+
+class DisaggregatedRouter:
+    def __init__(
+        self,
+        model: str,
+        conf: Optional[DisaggRouterConf] = None,
+        cplane=None,
+    ):
+        self.model = model
+        self.conf = conf or DisaggRouterConf()
+        self._cplane = cplane
+        self._watcher = None
+        self._watch_task: Optional[asyncio.Task] = None
+
+    async def start_watching(self) -> "DisaggregatedRouter":
+        """Live-reload the threshold from the control plane
+        (reference: disagg_router.rs from_etcd_with_watcher)."""
+        if self._cplane is None:
+            return self
+        key = config_key(self.model)
+        raw = await self._cplane.kv_get(key)
+        if raw:
+            self.conf = DisaggRouterConf.from_wire(raw)
+        self._watcher = await self._cplane.kv_get_and_watch_prefix(key)
+        self._watch_task = asyncio.create_task(self._watch_loop())
+        return self
+
+    async def stop(self) -> None:
+        if self._watch_task:
+            self._watch_task.cancel()
+        if self._watcher:
+            try:
+                await self._watcher.stop()
+            except Exception:
+                pass
+
+    async def _watch_loop(self) -> None:
+        try:
+            async for ev in self._watcher.events():
+                if ev.kind == "put" and ev.value:
+                    try:
+                        self.conf = DisaggRouterConf.from_wire(ev.value)
+                        log.info(
+                            "disagg threshold reloaded: local<=%d queue<=%d",
+                            self.conf.max_local_prefill_length,
+                            self.conf.max_prefill_queue_size,
+                        )
+                    except Exception:
+                        log.exception("bad disagg config")
+        except asyncio.CancelledError:
+            pass
+
+    def prefill_remote(
+        self, prefill_length: int, prefix_hit_length: int, queue_depth: int = 0
+    ) -> bool:
+        """reference: disagg_router.rs:239-249 prefill_remote."""
+        if queue_depth >= self.conf.max_prefill_queue_size:
+            return False
+        return prefill_length - prefix_hit_length > self.conf.max_local_prefill_length

@@ -1,0 +1,60 @@
+"""Model registration in the control plane: the glue between workers/llmctl and
+HTTP frontends.
+
+Mirrors the reference's etcd ModelEntry registrations that the http frontend
+watches (reference: launch/llmctl/src/main.rs:115-310, lib/llm/src/http/
+service/discovery.rs:1-145). Keys:
+
+    models/{model_type}/{name} -> msgpack ModelEntry{name, endpoint, card}
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import msgpack
+
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+
+MODELS_PREFIX = "models"
+
+
+@dataclass
+class ModelEntry:
+    name: str
+    endpoint: str  # dyn://ns.comp.ep serving PreprocessedRequest -> BackendOutput
+    model_type: str = "chat"  # chat | completion
+    card: Optional[ModelDeploymentCard] = None
+
+    def key(self) -> str:
+        return f"{MODELS_PREFIX}/{self.model_type}/{self.name}"
+
+    def to_wire(self) -> bytes:
+        return msgpack.packb(
+            {
+                "name": self.name,
+                "endpoint": self.endpoint,
+                "model_type": self.model_type,
+                "card": self.card.to_wire() if self.card else None,
+            }
+        )
+
+    @classmethod
+    def from_wire(cls, raw: bytes) -> "ModelEntry":
+        d = msgpack.unpackb(raw, raw=False)
+        card = ModelDeploymentCard.from_wire(d["card"]) if d.get("card") else None
+        return cls(name=d["name"], endpoint=d["endpoint"], model_type=d["model_type"], card=card)
+
+
+async def register_model(cplane, entry: ModelEntry, lease_id: int = 0) -> None:
+    await cplane.kv_put(entry.key(), entry.to_wire(), lease_id=lease_id)
+
+
+async def unregister_model(cplane, model_type: str, name: str) -> bool:
+    return await cplane.kv_delete(f"{MODELS_PREFIX}/{model_type}/{name}")
+
+
+async def list_models(cplane) -> list[ModelEntry]:
+    items = await cplane.kv_get_prefix(MODELS_PREFIX + "/")
+    return [ModelEntry.from_wire(i.value) for i in items]

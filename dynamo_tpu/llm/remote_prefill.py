@@ -1,0 +1,89 @@
+"""Remote-prefill protocol: decode worker <-> prefill worker.
+
+Mirrors the reference protocol (reference: patch remote_prefill.py
+RemotePrefillRequest{request_id, prompt_token_ids, sampling_params, block_ids,
+engine_id} + completion notification). The KV payload itself travels over the
+TCP call-home data plane to the decode worker's ``prefill_result`` endpoint —
+the ICI/DCN replacement for NIXL RDMA WRITE + notification.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+
+@dataclass
+class RemotePrefillRequest:
+    request_id: str
+    token_ids: list[int]
+    # sampling for the single first token the prefill worker produces
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    # where the result must land
+    decode_worker_id: int = 0
+    decode_endpoint: str = ""  # dyn://ns.comp.endpoint of the decode worker's prefill_result
+    # pages allocated on the decode side that must receive KV (logical order),
+    # excluding any shared prefix pages the decode side already has
+    skip_leading_tokens: int = 0
+
+    def to_wire(self) -> dict:
+        return self.__dict__.copy()
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "RemotePrefillRequest":
+        return cls(**{k: v for k, v in d.items() if k in cls.__dataclass_fields__})
+
+
+@dataclass
+class PrefillResult:
+    request_id: str
+    first_token: int
+    prompt_len: int
+    skip_leading_tokens: int
+    kv_shape: tuple  # [L, 2, n_pages, page_size, Hkv, D]
+    kv_dtype: str
+    kv_bytes: bytes
+
+    def to_wire(self) -> dict:
+        return {
+            "request_id": self.request_id,
+            "first_token": self.first_token,
+            "prompt_len": self.prompt_len,
+            "skip_leading_tokens": self.skip_leading_tokens,
+            "kv_shape": list(self.kv_shape),
+            "kv_dtype": self.kv_dtype,
+            "kv_bytes": self.kv_bytes,
+        }
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "PrefillResult":
+        return cls(
+            request_id=d["request_id"],
+            first_token=d["first_token"],
+            prompt_len=d["prompt_len"],
+            skip_leading_tokens=d["skip_leading_tokens"],
+            kv_shape=tuple(d["kv_shape"]),
+            kv_dtype=d["kv_dtype"],
+            kv_bytes=d["kv_bytes"],
+        )
+
+    def kv_array(self) -> np.ndarray:
+        return np.frombuffer(self.kv_bytes, dtype=_np_dtype(self.kv_dtype)).reshape(self.kv_shape)
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # bfloat16 et al (jax dependency)
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def prefill_queue_name(namespace: str, model: str) -> str:
+    """reference: examples/llm/utils/prefill_queue.py queue naming."""
+    return f"{namespace}.prefill_queue.{model}"

@@ -1,0 +1,140 @@
+"""Disaggregated prefill/decode end-to-end: decode worker + prefill worker over
+a live broker, KV blocks transferred over the TCP data plane.
+
+Correctness bar: greedy generation through the disagg path must be token-exact
+with a purely local engine (same weights), proving the injected KV equals the
+locally-computed KV."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.cplane.broker import Broker
+from dynamo_tpu.disagg.decode_worker import DisaggDecodeEngine
+from dynamo_tpu.disagg.prefill_worker import PrefillWorker
+from dynamo_tpu.engine.engine import AsyncJaxEngine
+from dynamo_tpu.engine.sampling import SamplingParams
+from dynamo_tpu.engine.scheduler import EngineRequest
+from dynamo_tpu.llm.disagg_router import DisaggregatedRouter, DisaggRouterConf
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+from tests.test_engine import tiny_engine_config
+
+
+async def collect(engine, req):
+    toks = []
+    finish = None
+    async for out in engine.generate(req):
+        if out.token is not None:
+            toks.append(out.token)
+        if out.finished:
+            finish = out.finish_reason
+    return toks, finish
+
+
+def req_for(rid, prompt, n=6):
+    return EngineRequest(
+        request_id=rid,
+        token_ids=list(prompt),
+        sampling=SamplingParams(temperature=0.0, max_tokens=n),
+    )
+
+
+LONG_PROMPT = [5, 9, 2, 77, 31, 8, 100, 42, 17, 3, 60, 61]  # 12 tokens > threshold 6
+SHORT_PROMPT = [5, 9, 2]
+
+
+def test_disagg_matches_local():
+    async def body():
+        broker = Broker()
+        port = await broker.start()
+        addr = f"127.0.0.1:{port}"
+
+        decode_rt = DistributedRuntime(cplane_address=addr)
+        await decode_rt.connect()
+        prefill_rt = DistributedRuntime(cplane_address=addr)
+        await prefill_rt.connect()
+
+        decode_inner = AsyncJaxEngine(tiny_engine_config())
+        await decode_inner.start()
+        prefill_engine = AsyncJaxEngine(tiny_engine_config())
+        await prefill_engine.start()
+        local_engine = AsyncJaxEngine(tiny_engine_config())
+        await local_engine.start()
+
+        router = DisaggregatedRouter(
+            "tiny", conf=DisaggRouterConf(max_local_prefill_length=6)
+        )
+        decode = DisaggDecodeEngine(
+            decode_inner, decode_rt, "ns", "decoder", "tiny", disagg_router=router
+        )
+        await decode.start()
+        prefill_worker = PrefillWorker(prefill_engine, prefill_rt, "ns", "tiny")
+        await prefill_worker.start()
+
+        try:
+            # long prompt -> remote prefill path
+            expected, _ = await collect(local_engine, req_for("ref1", LONG_PROMPT))
+            got, finish = await collect(decode, req_for("d1", LONG_PROMPT))
+            assert got == expected, f"disagg {got} != local {expected}"
+            assert finish == "length"
+            assert decode.remote_prefills == 1
+            assert prefill_worker.completed == 1
+
+            # short prompt stays local
+            expected_s, _ = await collect(local_engine, req_for("ref2", SHORT_PROMPT))
+            got_s, _ = await collect(decode, req_for("d2", SHORT_PROMPT))
+            assert got_s == expected_s
+            assert decode.local_prefills == 1
+
+            # second long request: decode-side prefix cache now holds the
+            # prompt blocks, so the disagg router sees a high prefix hit and
+            # keeps it local
+            got2, _ = await collect(decode, req_for("d3", LONG_PROMPT))
+            assert got2 == expected
+            assert decode.remote_prefills == 1  # unchanged: went local via cache
+        finally:
+            await prefill_worker.stop()
+            await decode.shutdown()
+            await prefill_engine.shutdown()
+            await local_engine.shutdown()
+            await decode_rt._shutdown_hook()
+            await prefill_rt._shutdown_hook()
+            await broker.stop()
+
+    asyncio.run(body())
+
+
+def test_disagg_router_decision_and_live_reload():
+    async def body():
+        broker = Broker()
+        port = await broker.start()
+        from dynamo_tpu.cplane.client import CplaneClient
+        from dynamo_tpu.llm.disagg_router import config_key
+
+        c = CplaneClient(f"127.0.0.1:{port}")
+        await c.connect()
+        router = DisaggregatedRouter(
+            "m", conf=DisaggRouterConf(max_local_prefill_length=100), cplane=c
+        )
+        await router.start_watching()
+        try:
+            assert not router.prefill_remote(100, 0)
+            assert router.prefill_remote(101, 0)
+            assert not router.prefill_remote(150, 60)  # prefix hit reduces work
+            assert not router.prefill_remote(500, 0, queue_depth=64)  # queue full
+
+            # live threshold reload via control-plane put
+            await c.kv_put(config_key("m"), b'{"max_local_prefill_length": 10}')
+            for _ in range(50):
+                if router.conf.max_local_prefill_length == 10:
+                    break
+                await asyncio.sleep(0.02)
+            assert router.conf.max_local_prefill_length == 10
+            assert router.prefill_remote(11, 0)
+        finally:
+            await router.stop()
+            await c.close()
+            await broker.stop()
+
+    asyncio.run(body())
