@@ -1,0 +1,11 @@
+"""Python SDK: declarative service graphs.
+
+Mirrors the reference SDK surface (reference: deploy/dynamo/sdk/src/dynamo/sdk/
+lib/{service.py,decorators.py,dependency.py}): ``@service`` classes with
+``@endpoint`` streaming methods, ``depends()`` edges resolved to runtime
+clients, YAML-configured, launched by the ``dynamo-tpu serve`` supervisor.
+"""
+
+from dynamo_tpu.sdk.decorators import service, endpoint, async_on_start
+from dynamo_tpu.sdk.dependency import depends
+from dynamo_tpu.sdk.config import ServiceConfig

@@ -1,0 +1,64 @@
+"""Service configuration: YAML sections per service class + CLI overrides.
+
+reference: the SDK's YAML config + --Service.key=value overrides injected as
+DYNAMO_SERVICE_CONFIG env JSON (deploy/dynamo/sdk/src/dynamo/sdk/lib/
+service.py:111-118, docs/guides/dynamo_serve.md:157-219). Ours uses
+DYNTPU_SERVICE_CONFIG.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Any, Optional
+
+ENV_KEY = "DYNTPU_SERVICE_CONFIG"
+
+
+class ServiceConfig:
+    _instance: Optional["ServiceConfig"] = None
+
+    def __init__(self, data: Optional[dict] = None):
+        self.data = data or {}
+
+    @classmethod
+    def load(cls) -> "ServiceConfig":
+        if cls._instance is None:
+            raw = os.environ.get(ENV_KEY)
+            cls._instance = cls(json.loads(raw) if raw else {})
+        return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._instance = None
+
+    def for_service(self, name: str) -> dict:
+        return dict(self.data.get(name, {}))
+
+    def get(self, service: str, key: str, default: Any = None) -> Any:
+        return self.data.get(service, {}).get(key, default)
+
+    @classmethod
+    def from_yaml_and_overrides(
+        cls, yaml_path: Optional[str], overrides: list[str]
+    ) -> dict:
+        """Build the config dict: YAML file plus --Service.key=value overrides."""
+        data: dict[str, dict] = {}
+        if yaml_path:
+            import yaml
+
+            loaded = yaml.safe_load(Path(yaml_path).read_text()) or {}
+            for svc, cfg in loaded.items():
+                data[svc] = dict(cfg or {})
+        for ov in overrides:
+            if "=" not in ov or "." not in ov.split("=", 1)[0]:
+                raise ValueError(f"override must be Service.key=value: {ov!r}")
+            target, value = ov.split("=", 1)
+            svc, key = target.lstrip("-").split(".", 1)
+            try:
+                value = json.loads(value)
+            except json.JSONDecodeError:
+                pass
+            data.setdefault(svc, {})[key] = value
+        return data

@@ -1,0 +1,73 @@
+"""@service / @endpoint / @async_on_start decorators.
+
+reference: deploy/dynamo/sdk/src/dynamo/sdk/lib/service.py:66-110 (@service),
+lib/decorators.py:27-59 (@dynamo_endpoint, @async_on_start).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+
+@dataclass
+class ServiceMeta:
+    namespace: str = "dynamo"
+    component: str = ""
+    workers: int = 1
+    resources: dict = field(default_factory=dict)  # e.g. {"tpu": 1}
+    config_key: str = ""  # YAML section name (defaults to class name)
+
+
+def service(
+    _cls=None,
+    *,
+    namespace: str = "dynamo",
+    component: Optional[str] = None,
+    workers: int = 1,
+    resources: Optional[dict] = None,
+):
+    """Class decorator marking a deployable service."""
+
+    def wrap(cls):
+        meta = ServiceMeta(
+            namespace=namespace,
+            component=component or cls.__name__.lower(),
+            workers=workers,
+            resources=resources or {},
+            config_key=cls.__name__,
+        )
+        cls.__dynamo_service__ = meta
+        # walk the MRO so subclassed services inherit endpoints/hooks
+        endpoints: dict[str, dict] = {}
+        on_start: list[str] = []
+        for name in dir(cls):
+            if name.startswith("__"):
+                continue
+            fn = getattr(cls, name, None)
+            if not callable(fn):
+                continue
+            if hasattr(fn, "__dynamo_endpoint__"):
+                endpoints[name] = fn.__dynamo_endpoint__
+            if getattr(fn, "__dynamo_on_start__", False):
+                on_start.append(name)
+        cls.__dynamo_endpoints__ = endpoints
+        cls.__dynamo_on_start__ = on_start
+        return cls
+
+    return wrap(_cls) if _cls is not None else wrap
+
+
+def endpoint(_fn=None, *, name: Optional[str] = None):
+    """Marks an async-generator method as a served endpoint."""
+
+    def wrap(fn):
+        fn.__dynamo_endpoint__ = {"name": name or fn.__name__}
+        return fn
+
+    return wrap(_fn) if _fn is not None else wrap
+
+
+def async_on_start(fn: Callable) -> Callable:
+    fn.__dynamo_on_start__ = True
+    return fn

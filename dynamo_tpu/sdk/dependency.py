@@ -1,0 +1,72 @@
+"""depends(): service-graph edges resolved to runtime clients at runtime.
+
+reference: deploy/dynamo/sdk/src/dynamo/sdk/lib/dependency.py:28-80.
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, Optional
+
+
+class DynamoClient:
+    """Lazy client to another service's endpoint(s)."""
+
+    def __init__(self, target_cls):
+        self.target_cls = target_cls
+        self._drt = None
+        self._clients: dict[str, Any] = {}
+
+    def bind_runtime(self, drt) -> None:
+        self._drt = drt
+
+    @property
+    def meta(self):
+        return self.target_cls.__dynamo_service__
+
+    async def _client(self, endpoint: str):
+        if self._drt is None:
+            raise RuntimeError("dependency not bound to a runtime yet")
+        c = self._clients.get(endpoint)
+        if c is None:
+            c = await self._drt.client(self.meta.namespace, self.meta.component, endpoint)
+            await c.wait_for_instances(timeout=60)
+            self._clients[endpoint] = c
+        return c
+
+    async def stream(self, payload: Any, endpoint: str = "generate", **kw) -> AsyncIterator[Any]:
+        client = await self._client(endpoint)
+        return await client.generate(payload, **kw)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        async def call(payload: Any, **kw):
+            return await self.stream(payload, endpoint=name, **kw)
+
+        return call
+
+
+class _Depends:
+    """Class-attribute marker replaced per-instance with a DynamoClient."""
+
+    def __init__(self, target_cls):
+        self.target_cls = target_cls
+
+    def __set_name__(self, owner, name):
+        self.attr = name
+        deps = getattr(owner, "__dynamo_depends__", {})
+        deps = dict(deps)
+        deps[name] = self.target_cls
+        owner.__dynamo_depends__ = deps
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        client = DynamoClient(self.target_cls)
+        setattr(obj, self.attr, client)
+        return client
+
+
+def depends(target_cls) -> _Depends:
+    return _Depends(target_cls)

@@ -1,0 +1,170 @@
+"""`dynamo-tpu serve` supervisor: launch a whole service graph from one entry.
+
+reference: deploy/dynamo/sdk/src/dynamo/sdk/cli/{serve.py,serving.py} — the
+circus-based process-per-service supervisor. Ours: discover the dependency
+graph from the entry @service class, optionally start an embedded broker,
+spawn one subprocess per service (x workers), restart on failure, tear down
+on SIGINT.
+
+    python -m dynamo_tpu.sdk.serve examples.graphs.agg:Frontend -f agg.yaml
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+from dynamo_tpu.sdk.config import ENV_KEY, ServiceConfig
+from dynamo_tpu.sdk.serve_worker import load_class
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("sdk.serve")
+
+
+def discover_graph(entry_cls) -> list[type]:
+    """Entry class + transitive depends() targets, dependency-first order."""
+    seen: dict[type, None] = {}
+
+    def visit(cls):
+        if cls in seen:
+            return
+        for target in getattr(cls, "__dynamo_depends__", {}).values():
+            visit(target)
+        seen[cls] = None
+
+    visit(entry_cls)
+    return list(seen)
+
+
+def class_spec(cls) -> str:
+    return f"{cls.__module__}:{cls.__name__}"
+
+
+def _port_open(address: str) -> bool:
+    host, _, port = address.rpartition(":")
+    try:
+        with socket.create_connection((host or "127.0.0.1", int(port)), timeout=0.5):
+            return True
+    except OSError:
+        return False
+
+
+class Supervisor:
+    def __init__(self, entry_spec: str, config: dict, cplane: str, restart: bool = True):
+        self.entry_spec = entry_spec
+        self.config = config
+        self.cplane = cplane
+        self.restart = restart
+        self.children: dict[str, subprocess.Popen] = {}
+        self.broker_proc = None
+        self._stopping = False
+
+    def _env(self) -> dict:
+        env = dict(os.environ)
+        env[ENV_KEY] = json.dumps(self.config)
+        env["DYNTPU_CPLANE"] = self.cplane
+        return env
+
+    def ensure_broker(self) -> None:
+        if _port_open(self.cplane):
+            log.info("control plane already running at %s", self.cplane)
+            return
+        host, _, port = self.cplane.rpartition(":")
+        self.broker_proc = subprocess.Popen(
+            [sys.executable, "-m", "dynamo_tpu.cplane.broker", "--host", host or "127.0.0.1",
+             "--port", port],
+            env=self._env(),
+        )
+        for _ in range(50):
+            if _port_open(self.cplane):
+                return
+            time.sleep(0.1)
+        raise RuntimeError(f"broker failed to start on {self.cplane}")
+
+    def spawn(self, cls, replica: int) -> None:
+        spec = class_spec(cls)
+        name = f"{cls.__name__}-{replica}"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "dynamo_tpu.sdk.serve_worker", spec],
+            env=self._env(),
+        )
+        self.children[name] = proc
+        log.info("spawned %s (pid %d)", name, proc.pid)
+
+    def run(self) -> int:
+        entry_cls = load_class(self.entry_spec)
+        graph = discover_graph(entry_cls)
+        log.info("service graph: %s", " -> ".join(c.__name__ for c in graph))
+        self.ensure_broker()
+        for cls in graph:
+            workers = self.config.get(cls.__name__, {}).get(
+                "workers", cls.__dynamo_service__.workers
+            )
+            for i in range(workers):
+                self.spawn(cls, i)
+
+        def on_signal(signum, frame):
+            self._stopping = True
+
+        signal.signal(signal.SIGINT, on_signal)
+        signal.signal(signal.SIGTERM, on_signal)
+
+        exit_code = 0
+        try:
+            while not self._stopping:
+                time.sleep(0.5)
+                for name, proc in list(self.children.items()):
+                    rc = proc.poll()
+                    if rc is None:
+                        continue
+                    if self.restart and not self._stopping:
+                        log.warning("%s exited rc=%s; restarting", name, rc)
+                        cls_name, replica = name.rsplit("-", 1)
+                        cls = next(c for c in discover_graph(load_class(self.entry_spec))
+                                   if c.__name__ == cls_name)
+                        self.spawn(cls, int(replica))
+                    else:
+                        log.error("%s exited rc=%s", name, rc)
+                        exit_code = rc or 1
+                        self._stopping = True
+                        break
+        finally:
+            self.shutdown()
+        return exit_code
+
+    def shutdown(self) -> None:
+        self._stopping = True
+        for name, proc in self.children.items():
+            if proc.poll() is None:
+                proc.terminate()
+        deadline = time.time() + 10
+        for proc in self.children.values():
+            try:
+                proc.wait(max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        if self.broker_proc is not None and self.broker_proc.poll() is None:
+            self.broker_proc.terminate()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="dynamo-tpu serve", description=__doc__)
+    parser.add_argument("entry", help="module.path:ServiceClass")
+    parser.add_argument("-f", "--file", default=None, help="YAML service config")
+    parser.add_argument("--cplane", default=os.environ.get("DYNTPU_CPLANE", "127.0.0.1:4222"))
+    parser.add_argument("--no-restart", action="store_true")
+    parser.add_argument("overrides", nargs="*", help="--Service.key=value overrides")
+    args = parser.parse_args(argv)
+    config = ServiceConfig.from_yaml_and_overrides(args.file, args.overrides)
+    sup = Supervisor(args.entry, config, args.cplane, restart=not args.no_restart)
+    return sup.run()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

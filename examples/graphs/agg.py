@@ -1,0 +1,98 @@
+"""Aggregated serving graph: Frontend -> Processor -> TpuWorker.
+
+The analogue of the reference's agg graph (reference: examples/llm/graphs/
+agg.py + examples/llm/components/). Launch:
+
+    python -m dynamo_tpu.sdk.serve examples.graphs.agg:Frontend -f examples/configs/agg.yaml
+"""
+
+from __future__ import annotations
+
+from dynamo_tpu.sdk import async_on_start, depends, service
+from dynamo_tpu.frontends.pipeline import card_for_model
+from dynamo_tpu.launch._run_impl import engine_config_for
+
+
+class _Args:
+    def __init__(self, d):
+        self.__dict__.update(d)
+
+    def __getattr__(self, k):
+        return None
+
+
+@service(namespace="dynamo", component="backend", resources={"tpu": 1})
+class TpuWorker:
+    """JAX engine worker (tokens in -> detokenized stream out)."""
+
+    @async_on_start
+    async def boot(self):
+        from dynamo_tpu.components.worker import WorkerService
+
+        cfg = self.config
+        model = cfg.get("model", "tiny")
+        card = card_for_model(model, cfg.get("max_model_len"))
+        engine_cfg = engine_config_for(_Args({"model": model, **cfg}))
+        self.worker = WorkerService(
+            self.runtime, "dynamo", "backend", card, engine_cfg, register=False
+        )
+        await self.worker.start()
+
+    async def on_shutdown(self):
+        await self.worker.stop()
+
+
+@service(namespace="dynamo", component="processor")
+class Processor:
+    """KV-aware routing tier."""
+
+    worker = depends(TpuWorker)
+
+    @async_on_start
+    async def boot(self):
+        from dynamo_tpu.components.processor import ProcessorService
+
+        cfg = self.config
+        self.processor = ProcessorService(
+            self.runtime,
+            "dynamo",
+            worker_component="backend",
+            kv_block_size=cfg.get("kv_block_size", 4),
+            routing=cfg.get("routing", "kv"),
+        )
+        await self.processor.start()
+
+    async def on_shutdown(self):
+        await self.processor.stop()
+
+
+@service(namespace="dynamo", component="frontend")
+class Frontend:
+    """OpenAI HTTP frontend with model discovery."""
+
+    processor = depends(Processor)
+
+    @async_on_start
+    async def boot(self):
+        from dynamo_tpu.components.frontend import FrontendService
+        from dynamo_tpu.llm.model_registry import ModelEntry, register_model
+
+        cfg = self.config
+        model = cfg.get("model", "tiny")
+        card = card_for_model(model, cfg.get("max_model_len"))
+        card.display_name = cfg.get("served_model_name", card.display_name)
+        entry = ModelEntry(
+            name=card.display_name,
+            endpoint="dyn://dynamo.processor.generate",
+            model_type="chat",
+            card=card,
+        )
+        await register_model(self.runtime.cplane, entry)
+        self.frontend = FrontendService(
+            self.runtime, host=cfg.get("host", "0.0.0.0"), port=cfg.get("port", 8080)
+        )
+        port = await self.frontend.start()
+        print(f"frontend listening on :{port}", flush=True)
+
+    async def on_shutdown(self):
+        await self.frontend.stop()

@@ -1,0 +1,71 @@
+"""Disaggregated serving graph: Frontend -> Processor -> DecodeWorker, with
+PrefillWorkers consuming the remote-prefill queue.
+
+The analogue of the reference's disagg graph (reference: examples/llm/graphs/
+disagg.py). Launch:
+
+    python -m dynamo_tpu.sdk.serve examples.graphs.disagg:Frontend -f examples/configs/disagg.yaml
+"""
+
+from __future__ import annotations
+
+from dynamo_tpu.sdk import async_on_start, depends, service
+from dynamo_tpu.frontends.pipeline import card_for_model
+from dynamo_tpu.launch._run_impl import engine_config_for
+from examples.graphs.agg import Frontend as AggFrontend, Processor as AggProcessor, _Args
+
+
+@service(namespace="dynamo", component="backend", resources={"tpu": 1})
+class DecodeWorker:
+    """Decode-side engine with conditional remote prefill."""
+
+    @async_on_start
+    async def boot(self):
+        from dynamo_tpu.components.worker import WorkerService
+
+        cfg = self.config
+        model = cfg.get("model", "tiny")
+        card = card_for_model(model, cfg.get("max_model_len"))
+        engine_cfg = engine_config_for(_Args({"model": model, **cfg}))
+        self.worker = WorkerService(
+            self.runtime, "dynamo", "backend", card, engine_cfg,
+            enable_disagg_decode=True, register=False,
+        )
+        await self.worker.start()
+
+    async def on_shutdown(self):
+        await self.worker.stop()
+
+
+@service(namespace="dynamo", component="prefill", resources={"tpu": 1})
+class PrefillWorker:
+    """Prefill-side engine consuming the remote-prefill work queue."""
+
+    @async_on_start
+    async def boot(self):
+        from dynamo_tpu.disagg.prefill_worker import PrefillWorker as PW
+        from dynamo_tpu.engine.engine import AsyncJaxEngine
+
+        cfg = self.config
+        model = cfg.get("model", "tiny")
+        engine_cfg = engine_config_for(_Args({"model": model, **cfg}))
+        self.engine = AsyncJaxEngine(engine_cfg)
+        await self.engine.start()
+        card = card_for_model(model, cfg.get("max_model_len"))
+        self.pw = PW(self.engine, self.runtime, "dynamo", card.display_name)
+        await self.pw.start()
+
+    async def on_shutdown(self):
+        await self.pw.stop()
+        await self.engine.shutdown()
+
+
+@service(namespace="dynamo", component="processor")
+class Processor(AggProcessor):
+    worker = depends(DecodeWorker)
+
+
+@service(namespace="dynamo", component="frontend")
+class Frontend(AggFrontend):
+    processor = depends(Processor)
+    prefill = depends(PrefillWorker)

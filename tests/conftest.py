@@ -17,6 +17,10 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("DYNTPU_LOG", "warning")
+# Subprocesses spawned by tests (sdk serve supervisor etc.) must not register
+# the axon TPU plugin (hangs when the relay is down) and must run on CPU.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax
 
