@@ -21,6 +21,8 @@ class EngineConfig:
     # fraction of pages that must stay free for decode growth before admitting
     # a new sequence (simple admission control)
     watermark: float = 0.05
+    # host-DRAM KV offload tier capacity in blocks (0 = disabled)
+    host_cache_blocks: int = 0
 
     @property
     def max_pages_per_seq(self) -> int:

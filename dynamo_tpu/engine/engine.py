@@ -92,8 +92,17 @@ class AsyncJaxEngine:
         t0 = time.monotonic()
         self.model, params = load_model(self.config.model_id)
         self.runner = ModelRunner(self.config, self.model, params)
+        offload = None
+        if self.config.host_cache_blocks > 0:
+            from dynamo_tpu.engine.offload import HostKvPool
+
+            offload = HostKvPool(self.runner, self.config.host_cache_blocks)
+        self.offload = offload
         self.allocator = PageAllocator(
-            self.config.num_pages, self.config.page_size, event_sink=self._on_kv_event
+            self.config.num_pages,
+            self.config.page_size,
+            event_sink=self._on_kv_event,
+            offload=offload,
         )
         self.scheduler = Scheduler(self.config, self.runner, self.allocator)
         log.info(

@@ -1,0 +1,76 @@
+"""Host-DRAM KV offload tier.
+
+The TPU analogue of the reference's multi-tier KV block manager (reference:
+lib/llm/src/kv/{manager,storage,layer}.rs — CUDA pinned-host staging +
+copy streams; docs/architecture.md:91-96 claims +40% TTFT from system-memory
+offload). On TPU-VM the host tier is plain numpy arrays in process memory;
+device<->host movement goes through the runner's jitted block gather/scatter
+(dynamo_tpu/engine/model_runner.py extract_pages/inject_pages).
+
+Flow:
+  - when the device prefix cache must reclaim a refcount-0 cached block, the
+    block's KV is saved to the host pool instead of being dropped
+  - allocate_sequence() consults the host pool after device-cache misses:
+    hits are injected back into freshly-allocated device pages and count as
+    cached prefix (no recompute)
+  - the host pool is LRU-bounded; dropping a block there emits the `removed`
+    KV event (the block is now gone from every tier)
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("engine.offload")
+
+
+class HostKvPool:
+    """LRU pool of KV blocks in host DRAM, keyed by chained sequence hash."""
+
+    def __init__(self, runner, capacity_blocks: int = 0):
+        self.runner = runner
+        self.capacity_blocks = capacity_blocks
+        self._blocks: OrderedDict[int, np.ndarray] = OrderedDict()  # seq_hash -> [L,2,1,ps,H,D]
+        self.saves = 0
+        self.loads = 0
+        self.drops = 0
+
+    def __len__(self) -> int:
+        return len(self._blocks)
+
+    def __contains__(self, seq_hash: int) -> bool:
+        return seq_hash in self._blocks
+
+    def save(self, seq_hash: int, page_id: int) -> list[int]:
+        """Copy a device page to host. Returns seq hashes dropped from the pool
+        (for removed-event emission)."""
+        if self.capacity_blocks <= 0:
+            return [seq_hash]  # offload disabled: block is simply gone
+        data = self.runner.extract_pages(np.asarray([page_id], np.int32))
+        self._blocks[seq_hash] = data
+        self._blocks.move_to_end(seq_hash)
+        self.saves += 1
+        dropped = []
+        while len(self._blocks) > self.capacity_blocks:
+            victim, _ = self._blocks.popitem(last=False)
+            dropped.append(victim)
+            self.drops += 1
+        return dropped
+
+    def load(self, seq_hash: int, page_id: int) -> bool:
+        """Inject a host block into a device page. True on hit."""
+        data = self._blocks.get(seq_hash)
+        if data is None:
+            return False
+        self._blocks.move_to_end(seq_hash)
+        self.runner.inject_pages(np.asarray([page_id], np.int32), data)
+        self.loads += 1
+        return True
+
+    def discard(self, seq_hash: int) -> None:
+        self._blocks.pop(seq_hash, None)

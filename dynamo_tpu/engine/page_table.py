@@ -55,12 +55,15 @@ class PageAllocator:
         num_pages: int,
         page_size: int,
         event_sink: Optional[Callable[[KvCacheEvent], None]] = None,
+        offload=None,  # Optional[HostKvPool]: host-DRAM tier (engine/offload.py)
     ):
         if num_pages < 2:
             raise ValueError("need at least 2 pages (page 0 is reserved)")
         self.num_pages = num_pages
         self.page_size = page_size
         self.event_sink = event_sink
+        self.offload = offload
+        self._offloaded_meta: dict[int, StoredBlock] = {}  # host-tier blocks
         self._free: list[int] = list(range(num_pages - 1, 0, -1))  # stack; page 0 reserved
         # sequence_hash -> physical page holding that full block
         self._cache: dict[int, int] = {}
@@ -92,12 +95,25 @@ class PageAllocator:
     def _pop_free_page(self) -> int:
         if self._free:
             return self._free.pop()
-        # Reclaim the least-recently-used refcount-0 cached block.
+        # Reclaim the least-recently-used refcount-0 cached block; with a host
+        # tier configured its KV is offloaded instead of dropped.
         if self._reusable:
             seq_hash, page = self._reusable.popitem(last=False)
             del self._cache[seq_hash]
             meta = self._cache_meta.pop(seq_hash)
-            self._emit(KvCacheEvent.removed([meta.block_hash]))
+            if self.offload is not None:
+                dropped = self.offload.save(seq_hash, page)
+                if seq_hash not in dropped:
+                    self._offloaded_meta[seq_hash] = meta
+                removed = []
+                for victim in dropped:
+                    vm = meta if victim == seq_hash else self._offloaded_meta.pop(victim, None)
+                    if vm is not None:
+                        removed.append(vm.block_hash)
+                if removed:
+                    self._emit(KvCacheEvent.removed(removed))
+            else:
+                self._emit(KvCacheEvent.removed([meta.block_hash]))
             return page
         raise MemoryError("out of KV pages")
 
@@ -110,12 +126,13 @@ class PageAllocator:
     # ------------- sequence lifecycle -------------
 
     def lookup_prefix(self, prompt_tokens: list[int]) -> int:
-        """Number of leading tokens already cached (block granularity), without
-        allocating. Used by the disagg router's prefix-hit estimate."""
+        """Number of leading tokens already cached in ANY tier (block
+        granularity), without allocating. Disagg routing's prefix-hit estimate."""
         ts = TokenSequence(prompt_tokens, self.page_size)
         hits = 0
         for block in ts.blocks:
-            if block.sequence_hash in self._cache:
+            h = block.sequence_hash
+            if h in self._cache or (self.offload is not None and h in self.offload):
                 hits += 1
             else:
                 break
@@ -133,29 +150,59 @@ class PageAllocator:
         ts = TokenSequence(prompt_tokens, self.page_size)
         state = SequencePages(seq_id=seq_id, token_seq=ts)
 
-        # 1. prefix hits: chain of full blocks present in cache
-        cached_pages: list[int] = []
+        # 1. device-tier prefix hits: chain of full blocks present in cache
+        device_hits: list[int] = []
         for block in ts.blocks:
             page = self._cache.get(block.sequence_hash)
             if page is None:
                 break
-            cached_pages.append(page)
+            device_hits.append(page)
+
+        # 2. host-tier hits continuing the chain: each costs a fresh device
+        # page + a host->device block copy, but no recompute
+        host_hit_hashes: list[int] = []
+        if self.offload is not None:
+            for block in ts.blocks[len(device_hits) :]:
+                if block.sequence_hash in self.offload:
+                    host_hit_hashes.append(block.sequence_hash)
+                else:
+                    break
+
         self.cache_query_blocks += len(ts.blocks)
-        self.cache_hit_blocks += len(cached_pages)
+        self.cache_hit_blocks += len(device_hits) + len(host_hit_hashes)
 
         # Never consume the *entire* prompt from cache: leave at least the last
         # token to prefill so the model produces next-token logits.
-        if cached_pages and len(cached_pages) * self.page_size >= len(prompt_tokens):
-            cached_pages.pop()
+        total_hit = len(device_hits) + len(host_hit_hashes)
+        if total_hit and total_hit * self.page_size >= len(prompt_tokens):
+            if host_hit_hashes:
+                host_hit_hashes.pop()
+            else:
+                device_hits.pop()
 
-        for page in cached_pages:
+        for page in device_hits:
             self._ref_page(page)
-        state.pages.extend(cached_pages)
-        state.shared_prefix_pages = len(cached_pages)
-        cached_len = len(cached_pages) * self.page_size
+        state.pages.extend(device_hits)
+        state.shared_prefix_pages = len(device_hits)
 
-        # 2. fresh pages for the rest of the prompt
         try:
+            # host-tier blocks: fresh page + inject; re-registered on-device so
+            # later sequences share them again
+            for i, seq_hash in enumerate(host_hit_hashes):
+                page = self._pop_free_page()
+                self._refcount[page] = 1
+                state.pages.append(page)
+                self.offload.load(seq_hash, page)
+                self.offload.discard(seq_hash)
+                meta = self._offloaded_meta.pop(seq_hash, None)
+                if meta is not None:
+                    self._cache[seq_hash] = page
+                    self._cache_meta[seq_hash] = meta
+                    state.registered_hashes.append(seq_hash)
+
+            cached_len = (len(device_hits) + len(host_hit_hashes)) * self.page_size
+
+            # 3. fresh pages for the rest of the prompt
             total_pages_needed = -(-len(prompt_tokens) // self.page_size)
             while len(state.pages) < total_pages_needed:
                 page = self._pop_free_page()
@@ -172,8 +219,14 @@ class PageAllocator:
         return cached_len, state
 
     def _rollback(self, state: SequencePages) -> None:
+        """Undo a failed allocation. Cache-registered pages (shared prefix hits
+        and host-tier reloads) return to the reusable pool — their on-device
+        data is still valid; only uncached fresh pages go back to the free list."""
+        pages = set(state.pages)
+        page_to_hash = {p: h for h, p in self._cache.items() if p in pages}
         for page in state.pages:
-            self._unref_page(page, evictable_hash=None)
+            self._unref_page(page, evictable_hash=page_to_hash.get(page))
+        state.pages.clear()
 
     def commit_prefilled(self, seq_id: str, prompt_len: int) -> None:
         """Register all full blocks covered by the (now computed) prompt KV."""
