@@ -36,6 +36,12 @@ class ModelRunner:
     ):
         self.config = config
         self.model = model
+        if config.tp > 1:
+            # the Pallas decode kernel is not yet shard_map-wrapped for TP;
+            # GSPMD cannot partition a pallas_call, so fall back to the XLA path
+            import os
+
+            os.environ.setdefault("DYNTPU_PALLAS", "0")
         if mesh is None:
             devices = jax.devices()[: config.tp]
             mesh = Mesh(np.array(devices).reshape(len(devices)), ("tp",))

@@ -28,7 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from dynamo_tpu.ops.attention import (
     gather_pages,
     attention_with_positions,
-    paged_decode_attention,
+    dispatch_paged_decode_attention,
     scatter_kv,
 )
 from dynamo_tpu.ops.norms import rms_norm
@@ -253,7 +253,7 @@ class LlamaModel:
         offsets = jnp.where(active, positions % page_size, 0)
 
         def attn_fn(q, k_pages, v_pages):
-            return paged_decode_attention(q, k_pages, v_pages, page_tables, positions)
+            return dispatch_paged_decode_attention(q, k_pages, v_pages, page_tables, positions)
 
         hidden = params["embed"][tokens].astype(self.config.dtype)
 

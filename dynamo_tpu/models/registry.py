@@ -30,8 +30,10 @@ def load_model(model_id: str, seed: int = 0):
             overrides = json.loads(model_id.split(":", 1)[1])
         cfg = LlamaConfig.tiny(**overrides)
         model = LlamaModel(cfg)
-        with jax.default_device(jax.local_devices()[0]):
-            params = model.init_params(jax.random.key(seed))
+        # single jitted init: one compile for the whole tree (matters on TPU
+        # backends where every compile round-trips a remote-compile service)
+        params = jax.jit(lambda key: model.init_params(key))(jax.random.key(seed))
+        jax.block_until_ready(params)
         return model, params
 
     path = Path(model_id)

@@ -131,3 +131,31 @@ def paged_decode_attention(
         return out[0]
 
     return jax.vmap(one)(q, page_tables, positions)
+
+
+def use_pallas_decode(head_dim: int, num_kv_heads: int) -> bool:
+    """Trace-time choice of the Pallas decode kernel.
+
+    DYNTPU_PALLAS=1 forces on (interpret on CPU), =0 forces off; default: on
+    for real TPU backends with lane-aligned head_dim.
+    """
+    import os
+
+    flag = os.environ.get("DYNTPU_PALLAS")
+    if flag == "0":
+        return False
+    if flag == "1":
+        return True
+    return jax.default_backend() == "tpu" and head_dim % 128 == 0
+
+
+def dispatch_paged_decode_attention(q, k_pages, v_pages, page_tables, positions):
+    """Pallas kernel on TPU, pure-JAX reference elsewhere (same contract)."""
+    if use_pallas_decode(q.shape[-1], k_pages.shape[2]):
+        from dynamo_tpu.ops.pallas.paged_attention import paged_decode_attention_pallas
+
+        interpret = jax.default_backend() != "tpu"
+        return paged_decode_attention_pallas(
+            q, k_pages, v_pages, page_tables, positions, interpret=interpret
+        )
+    return paged_decode_attention(q, k_pages, v_pages, page_tables, positions)

@@ -1,0 +1,152 @@
+"""Pallas TPU kernel: paged decode attention.
+
+One query token per sequence attends over its paged KV context. The page
+table rides in as scalar-prefetch (available before the kernel body, so page
+DMAs can be issued from dynamic indices), K/V page pools stay in HBM, and
+pages stream through a double-buffered VMEM scratch overlapping DMA with
+compute (pallas_guide.md: PrefetchScalarGridSpec + double buffering).
+
+Contract matches the pure-JAX reference (dynamo_tpu/ops/attention.py
+paged_decode_attention): q [B, Hq, D], pages [P, ps, Hkv, D],
+page_tables [B, max_pages], positions [B] (query position; context length =
+position + 1). GQA folded as [Hkv, G, D] per-kv-head batched matmuls.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _kernel(
+    # scalar prefetch
+    page_tables_ref,  # [B, max_pages] SMEM
+    lengths_ref,  # [B] SMEM
+    # inputs
+    q_ref,  # [1, Hq, D] VMEM (this sequence's query)
+    k_hbm,  # [P, ps, Hkv, D] HBM
+    v_hbm,  # [P, ps, Hkv, D] HBM
+    # output
+    out_ref,  # [1, Hq, D] VMEM
+    # scratch
+    k_scratch,  # [2, ps, Hkv, D] VMEM
+    v_scratch,  # [2, ps, Hkv, D] VMEM
+    sems,  # DMA sems [2, 2]
+    *,
+    page_size: int,
+    max_pages: int,
+):
+    b = pl.program_id(0)
+    length = lengths_ref[b]
+    n_pages = jnp.maximum(1, pl.cdiv(length, page_size))
+
+    Hq, D = q_ref.shape[1], q_ref.shape[2]
+    Hkv = k_hbm.shape[2]
+    G = Hq // Hkv
+
+    q = q_ref[0].astype(jnp.float32).reshape(Hkv, G, D)
+    scale = 1.0 / jnp.sqrt(jnp.float32(D))
+
+    def k_dma(slot, i):
+        return pltpu.make_async_copy(
+            k_hbm.at[page_tables_ref[b, i]], k_scratch.at[slot], sems.at[slot, 0]
+        )
+
+    def v_dma(slot, i):
+        return pltpu.make_async_copy(
+            v_hbm.at[page_tables_ref[b, i]], v_scratch.at[slot], sems.at[slot, 1]
+        )
+
+    # warm up buffer 0
+    k_dma(0, 0).start()
+    v_dma(0, 0).start()
+
+    def body(i, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(i, 2)
+        next_slot = jax.lax.rem(i + 1, 2)
+
+        @pl.when(i + 1 < n_pages)
+        def _():
+            k_dma(next_slot, i + 1).start()
+            v_dma(next_slot, i + 1).start()
+
+        k_dma(slot, i).wait()
+        v_dma(slot, i).wait()
+
+        k_page = k_scratch[slot].astype(jnp.float32)  # [ps, Hkv, D]
+        v_page = v_scratch[slot].astype(jnp.float32)
+        kt = jnp.transpose(k_page, (1, 0, 2))  # [Hkv, ps, D]
+        vt = jnp.transpose(v_page, (1, 0, 2))
+
+        # [Hkv, G, ps] = [Hkv, G, D] x [Hkv, ps, D]
+        scores = jax.lax.dot_general(
+            q, kt, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
+        ) * scale
+
+        idx = i * page_size + jax.lax.broadcasted_iota(jnp.int32, (1, 1, page_size), 2)
+        scores = jnp.where(idx < length, scores, _NEG_INF)
+
+        chunk_max = jnp.max(scores, axis=-1)  # [Hkv, G]
+        new_m = jnp.maximum(m, chunk_max)
+        corr = jnp.exp(m - new_m)
+        probs = jnp.exp(scores - new_m[..., None])  # [Hkv, G, ps]
+        new_l = l * corr + jnp.sum(probs, axis=-1)
+        # [Hkv, G, D] = [Hkv, G, ps] x [Hkv, ps, D]
+        chunk_out = jax.lax.dot_general(
+            probs, vt, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
+        )
+        new_acc = acc * corr[..., None] + chunk_out
+        return new_m, new_l, new_acc
+
+    m0 = jnp.full((Hkv, G), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((Hkv, G), jnp.float32)
+    acc0 = jnp.zeros((Hkv, G, D), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_pages, body, (m0, l0, acc0))
+
+    out = acc / jnp.maximum(l, 1e-20)[..., None]
+    out_ref[0] = out.reshape(Hq, D).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_decode_attention_pallas(
+    q: jnp.ndarray,  # [B, Hq, D]
+    k_pages: jnp.ndarray,  # [P, ps, Hkv, D]
+    v_pages: jnp.ndarray,  # [P, ps, Hkv, D]
+    page_tables: jnp.ndarray,  # [B, max_pages] int32
+    positions: jnp.ndarray,  # [B] int32 query positions
+    interpret: bool = False,
+) -> jnp.ndarray:
+    B, Hq, D = q.shape
+    P, ps, Hkv, _ = k_pages.shape
+    max_pages = page_tables.shape[1]
+    lengths = positions.astype(jnp.int32) + 1
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),  # k pages stay in HBM
+            pl.BlockSpec(memory_space=pl.ANY),  # v pages stay in HBM
+        ],
+        out_specs=pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, ps, Hkv, D), k_pages.dtype),
+            pltpu.VMEM((2, ps, Hkv, D), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    kernel = pl.pallas_call(
+        functools.partial(_kernel, page_size=ps, max_pages=max_pages),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )
+    return kernel(page_tables.astype(jnp.int32), lengths, q, k_pages, v_pages)
