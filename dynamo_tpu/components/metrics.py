@@ -1,0 +1,138 @@
+"""Standalone metrics component: scrapes ForwardPassMetrics from a component's
+workers, aggregates (avg/min/max + KV-hit-rate percent), and exposes
+Prometheus.
+
+Mirrors the reference metrics binary (reference: components/metrics/src/
+{main.rs:115-272,lib.rs:125-633}); the mock worker analogue lives in
+tests (reference: components/metrics/src/bin/mock_worker.rs).
+
+    python -m dynamo_tpu.components.metrics --namespace dynamo --component backend --port 9091
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+from typing import Optional
+
+from aiohttp import web
+
+from dynamo_tpu.llm.kv_router.metrics_aggregator import KvMetricsAggregator
+from dynamo_tpu.llm.kv_router.router import KV_HIT_RATE_SUBJECT
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("components.metrics")
+
+
+class MetricsService:
+    def __init__(
+        self,
+        drt,
+        namespace: str,
+        component: str,
+        host: str = "0.0.0.0",
+        port: int = 9091,
+        interval: float = 2.0,
+    ):
+        self.drt = drt
+        self.namespace = namespace
+        self.component = component
+        self.host = host
+        self.port = port
+        self.aggregator = KvMetricsAggregator(
+            drt.cplane, namespace, component, interval=interval
+        )
+        # cumulative KV hit-rate from router events
+        self._isl_blocks = 0
+        self._overlap_blocks = 0
+        self._runner: Optional[web.AppRunner] = None
+
+    async def start(self) -> int:
+        await self.aggregator.start()
+        await self.drt.cplane.subscribe(
+            f"{self.namespace}.{KV_HIT_RATE_SUBJECT}", self._on_hit_rate
+        )
+        app = web.Application()
+        app.router.add_get("/metrics", self._metrics)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        log.info("metrics on %s:%d scraping %s/%s", self.host, self.port, self.namespace, self.component)
+        return self.port
+
+    async def stop(self) -> None:
+        await self.aggregator.stop()
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    def _on_hit_rate(self, msg: dict) -> None:
+        p = msg["payload"]
+        self._isl_blocks += p.get("isl_blocks", 0)
+        self._overlap_blocks += p.get("overlap_blocks", 0)
+
+    def render(self) -> str:
+        loads = self.aggregator.get_metrics()
+        base = {"namespace": self.namespace, "component": self.component}
+
+        def fmt(name, value, extra=None):
+            labels = dict(base)
+            if extra:
+                labels.update(extra)
+            inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+            return f"llm_kv_{name}{{{inner}}} {value}"
+
+        lines = [
+            "# HELP llm_kv_* worker KV/load metrics aggregated by the metrics component",
+            fmt("workers", len(loads)),
+        ]
+        for field in (
+            "request_active_slots",
+            "request_total_slots",
+            "kv_active_blocks",
+            "kv_total_blocks",
+            "num_requests_waiting",
+            "gpu_cache_usage_perc",
+            "gpu_prefix_cache_hit_rate",
+        ):
+            values = [getattr(w, field) for w in loads]
+            if values:
+                lines.append(fmt(f"{field}_avg", sum(values) / len(values)))
+                lines.append(fmt(f"{field}_min", min(values)))
+                lines.append(fmt(f"{field}_max", max(values)))
+            for w in loads:
+                lines.append(fmt(field, getattr(w, field), {"worker_id": f"{w.worker_id:x}"}))
+        pct = 100.0 * self._overlap_blocks / self._isl_blocks if self._isl_blocks else 0.0
+        lines.append(fmt("hit_rate_percent", round(pct, 3)))
+        lines.append(fmt("hit_rate_isl_blocks_total", self._isl_blocks))
+        lines.append(fmt("hit_rate_overlap_blocks_total", self._overlap_blocks))
+        return "\n".join(lines) + "\n"
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        return web.Response(text=self.render(), content_type="text/plain")
+
+
+async def _main(args) -> None:
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    drt = DistributedRuntime(cplane_address=args.cplane)
+    await drt.connect()
+    svc = MetricsService(drt, args.namespace, args.component, args.host, args.port)
+    await svc.start()
+    while True:
+        await asyncio.sleep(3600)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--component", default="backend")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=9091)
+    p.add_argument("--cplane", default=None)
+    asyncio.run(_main(p.parse_args(argv)))
+
+
+if __name__ == "__main__":
+    main()

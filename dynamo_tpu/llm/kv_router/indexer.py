@@ -136,11 +136,43 @@ class RadixTree:
 
 
 class KvIndexer:
-    """Event-driven index facade (reference: indexer.rs:499 KvIndexer)."""
+    """Event-driven index facade (reference: indexer.rs:499 KvIndexer).
 
-    def __init__(self, kv_block_size: int, expiration_duration: Optional[float] = None):
+    Uses the native C++ tree (native/src/radix_tree.cc via ctypes) when built
+    and frequency tracking is off; the pure-Python tree otherwise.
+    """
+
+    def __init__(
+        self,
+        kv_block_size: int,
+        expiration_duration: Optional[float] = None,
+        use_native: Optional[bool] = None,
+    ):
         self.kv_block_size = kv_block_size
-        self.tree = RadixTree(expiration_duration)
+        if use_native is None:
+            use_native = expiration_duration is None and self._native_available()
+        if use_native:
+            from dynamo_tpu.llm.kv_router.native_indexer import NativeRadixTree
+
+            self.tree = NativeRadixTree()
+        else:
+            self.tree = RadixTree(expiration_duration)
+
+    @staticmethod
+    def _native_available() -> bool:
+        try:
+            from dynamo_tpu.llm.kv_router.native_indexer import native_available
+
+            return native_available()
+        except Exception:
+            return False
+
+    def stats(self) -> tuple[int, int]:
+        """(approx nodes, workers) — emptiness/health probe."""
+        if hasattr(self.tree, "stats"):
+            return self.tree.stats()
+        tree = self.tree
+        return (sum(len(d) for d in tree.lookup.values()), len(tree.lookup))
 
     def apply_event(self, event: RouterEvent) -> None:
         self.tree.apply_event(event)

@@ -1,0 +1,33 @@
+"""Build the native shared library (g++) with on-disk caching.
+
+Called lazily on first import of the native bindings; rebuilds when sources
+change (mtime)."""
+
+from __future__ import annotations
+
+import subprocess
+from pathlib import Path
+
+NATIVE_DIR = Path(__file__).parent
+SRC = NATIVE_DIR / "src"
+OUT = NATIVE_DIR / "libdynamo_tpu_native.so"
+
+SOURCES = [SRC / "radix_tree.cc"]
+
+
+def build(force: bool = False) -> Path:
+    if not force and OUT.exists():
+        newest_src = max(s.stat().st_mtime for s in SOURCES)
+        if OUT.stat().st_mtime >= newest_src:
+            return OUT
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+        *[str(s) for s in SOURCES],
+        "-o", str(OUT),
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    return OUT
+
+
+if __name__ == "__main__":
+    print(build(force=True))

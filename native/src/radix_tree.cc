@@ -1,0 +1,126 @@
+// Native radix/prefix tree for KV-aware routing — the hot lookup path of the
+// router (C++ analogue of the reference's Rust indexer,
+// reference: lib/llm/src/kv_router/indexer.rs:187-560).
+//
+// Exposed as a C ABI consumed from Python via ctypes
+// (dynamo_tpu/llm/kv_router/native_indexer.py). All hashes are precomputed
+// u64s (xxh3, computed by the caller); the tree itself is hash-keyed:
+//   - children keyed by tokens_hash (unchained local chunk hash)
+//   - per-worker lookup table block_hash -> node for O(1) event attachment
+//
+// Single-threaded by contract: the owning Python side calls from one event
+// loop (concurrency-by-isolation, same as the reference's dedicated runtime).
+
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <memory>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+struct Node {
+  std::unordered_map<uint64_t, Node*> children;  // tokens_hash -> child
+  std::unordered_set<int64_t> workers;
+};
+
+struct Tree {
+  Node root;
+  // worker -> block_hash -> node
+  std::unordered_map<int64_t, std::unordered_map<uint64_t, Node*>> lookup;
+  std::deque<Node> arena;  // stable addresses; nodes are never freed until reset
+
+  Node* alloc() {
+    arena.emplace_back();
+    return &arena.back();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* rtree_new() { return new Tree(); }
+
+void rtree_free(void* h) { delete static_cast<Tree*>(h); }
+
+// Stored event: attach a chain of blocks for `worker` under `parent`
+// (parent_hash valid iff has_parent != 0; otherwise the root).
+void rtree_apply_stored(void* h, int64_t worker, uint64_t parent_hash,
+                        int has_parent, int64_t n, const uint64_t* block_hashes,
+                        const uint64_t* tokens_hashes) {
+  Tree* t = static_cast<Tree*>(h);
+  auto& wl = t->lookup[worker];
+  Node* parent = &t->root;
+  if (has_parent) {
+    auto it = wl.find(parent_hash);
+    if (it != wl.end()) parent = it->second;
+  }
+  for (int64_t i = 0; i < n; i++) {
+    Node*& child = parent->children[tokens_hashes[i]];
+    if (child == nullptr) child = t->alloc();
+    child->workers.insert(worker);
+    wl[block_hashes[i]] = child;
+    parent = child;
+  }
+}
+
+void rtree_apply_removed(void* h, int64_t worker, int64_t n,
+                         const uint64_t* block_hashes) {
+  Tree* t = static_cast<Tree*>(h);
+  auto wit = t->lookup.find(worker);
+  if (wit == t->lookup.end()) return;
+  auto& wl = wit->second;
+  for (int64_t i = 0; i < n; i++) {
+    auto it = wl.find(block_hashes[i]);
+    if (it != wl.end()) {
+      it->second->workers.erase(worker);
+      wl.erase(it);
+    }
+  }
+}
+
+void rtree_remove_worker(void* h, int64_t worker) {
+  Tree* t = static_cast<Tree*>(h);
+  auto wit = t->lookup.find(worker);
+  if (wit == t->lookup.end()) return;
+  for (auto& [bh, node] : wit->second) node->workers.erase(worker);
+  t->lookup.erase(wit);
+}
+
+// Walk the tree along tokens_hashes accumulating per-worker matched-block
+// counts. Writes up to max_out (worker, score) pairs; returns the count, or
+// -1 if max_out was too small.
+int64_t rtree_find_matches(void* h, int64_t n, const uint64_t* tokens_hashes,
+                           int early_exit, int64_t* out_workers,
+                           int64_t* out_scores, int64_t max_out) {
+  Tree* t = static_cast<Tree*>(h);
+  std::unordered_map<int64_t, int64_t> scores;
+  Node* current = &t->root;
+  for (int64_t i = 0; i < n; i++) {
+    auto it = current->children.find(tokens_hashes[i]);
+    if (it == current->children.end()) break;
+    Node* node = it->second;
+    for (int64_t w : node->workers) scores[w] += 1;
+    if (early_exit && node->workers.size() == 1) break;
+    current = node;
+  }
+  if (static_cast<int64_t>(scores.size()) > max_out) return -1;
+  int64_t k = 0;
+  for (auto& [w, s] : scores) {
+    out_workers[k] = w;
+    out_scores[k] = s;
+    k++;
+  }
+  return k;
+}
+
+void rtree_stats(void* h, int64_t* out_nodes, int64_t* out_workers) {
+  Tree* t = static_cast<Tree*>(h);
+  *out_nodes = static_cast<int64_t>(t->arena.size());
+  *out_workers = static_cast<int64_t>(t->lookup.size());
+}
+
+}  // extern "C"

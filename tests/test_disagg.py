@@ -105,6 +105,54 @@ def test_disagg_matches_local():
     asyncio.run(body())
 
 
+def test_disagg_tp_mismatch_prefill2_decode1():
+    """Prefill worker at tp=2, decode worker at tp=1: the host-staged block
+    transfer is layout-canonical, so differing mesh shardings reshard on
+    placement — the analogue of the reference's tp_multiplier + kv_rearrange
+    Triton path (reference: patch nixl.py _get_block_descs_ids, kv_rearrange.py)."""
+
+    async def body():
+        broker = Broker()
+        port = await broker.start()
+        addr = f"127.0.0.1:{port}"
+        decode_rt = DistributedRuntime(cplane_address=addr)
+        await decode_rt.connect()
+        prefill_rt = DistributedRuntime(cplane_address=addr)
+        await prefill_rt.connect()
+
+        decode_inner = AsyncJaxEngine(tiny_engine_config(tp=1))
+        await decode_inner.start()
+        prefill_engine = AsyncJaxEngine(tiny_engine_config(tp=2))
+        await prefill_engine.start()
+        local_engine = AsyncJaxEngine(tiny_engine_config(tp=1))
+        await local_engine.start()
+
+        router = DisaggregatedRouter(
+            "tiny", conf=DisaggRouterConf(max_local_prefill_length=6)
+        )
+        decode = DisaggDecodeEngine(
+            decode_inner, decode_rt, "ns2", "decoder", "tiny", disagg_router=router
+        )
+        await decode.start()
+        pw = PrefillWorker(prefill_engine, prefill_rt, "ns2", "tiny")
+        await pw.start()
+        try:
+            expected, _ = await collect(local_engine, req_for("ref", LONG_PROMPT))
+            got, _ = await collect(decode, req_for("d1", LONG_PROMPT))
+            assert got == expected, f"tp-mismatch disagg {got} != local {expected}"
+            assert decode.remote_prefills == 1
+        finally:
+            await pw.stop()
+            await decode.shutdown()
+            await prefill_engine.shutdown()
+            await local_engine.shutdown()
+            await decode_rt._shutdown_hook()
+            await prefill_rt._shutdown_hook()
+            await broker.stop()
+
+    asyncio.run(body())
+
+
 def test_disagg_router_decision_and_live_reload():
     async def body():
         broker = Broker()

@@ -20,6 +20,20 @@ from dynamo_tpu.llm.tokens import compute_block_hash_for_seq
 BS = 4  # kv block size
 
 
+@pytest.fixture(params=["python", "native"])
+def make_indexer(request):
+    if request.param == "native":
+        from dynamo_tpu.llm.kv_router.native_indexer import native_available
+
+        if not native_available():
+            pytest.skip("native library not buildable")
+
+    def make():
+        return KvIndexer(BS, use_native=request.param == "native")
+
+    return make
+
+
 def stored(worker, indexer, parent, blocks):
     """blocks: list of (block_hash, tokens_hash)."""
     indexer.apply_event(
@@ -33,8 +47,8 @@ def stored(worker, indexer, parent, blocks):
     )
 
 
-def test_indexer_basic_match_and_removal():
-    idx = KvIndexer(BS)
+def test_indexer_basic_match_and_removal(make_indexer):
+    idx = make_indexer()
     # worker 1 caches blocks A->B; worker 2 caches A only
     stored(1, idx, None, [(100, 10), (101, 11)])
     stored(2, idx, None, [(200, 10)])
@@ -55,8 +69,8 @@ def test_indexer_basic_match_and_removal():
     assert idx.find_matches([10]).scores == {}
 
 
-def test_indexer_parent_chaining_mid_tree():
-    idx = KvIndexer(BS)
+def test_indexer_parent_chaining_mid_tree(make_indexer):
+    idx = make_indexer()
     stored(1, idx, None, [(100, 10)])
     # attach at depth 1 via parent block_hash
     stored(1, idx, 100, [(101, 11)])
@@ -67,7 +81,7 @@ def test_indexer_parent_chaining_mid_tree():
     assert idx.find_matches([10, 11]).scores == {1: 2, 2: 2}
 
 
-def test_indexer_from_allocator_events():
+def test_indexer_from_allocator_events(make_indexer):
     """Engine-side PageAllocator events drive the router index end-to-end."""
     events = []
     alloc = PageAllocator(32, BS, event_sink=events.append)
@@ -75,7 +89,7 @@ def test_indexer_from_allocator_events():
     alloc.allocate_sequence("s1", prompt)
     alloc.commit_prefilled("s1", 12)
 
-    idx = KvIndexer(BS)
+    idx = make_indexer()
     for ev in events:
         idx.apply_event(RouterEvent(worker_id=7, event=ev))
 

@@ -1,0 +1,72 @@
+"""Metrics component scraping mock workers (reference: components/metrics
+tests with mock_worker.rs)."""
+
+import asyncio
+
+import aiohttp
+
+from dynamo_tpu.cplane.broker import Broker
+from dynamo_tpu.components.metrics import MetricsService
+from dynamo_tpu.llm.kv_router.publisher import KvMetricsPublisher
+from dynamo_tpu.llm.kv_router.router import KV_HIT_RATE_SUBJECT
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+
+def test_metrics_component_scrape_and_prometheus():
+    async def body():
+        broker = Broker()
+        port = await broker.start()
+        addr = f"127.0.0.1:{port}"
+
+        # two mock workers publishing ForwardPassMetrics via stats handlers
+        workers = []
+        for i in range(2):
+            rt = DistributedRuntime(cplane_address=addr)
+            await rt.connect()
+            pub = KvMetricsPublisher(
+                lambda i=i: {
+                    "request_active_slots": i + 1,
+                    "request_total_slots": 8,
+                    "kv_active_blocks": 10 * (i + 1),
+                    "kv_total_blocks": 100,
+                    "gpu_prefix_cache_hit_rate": 0.5,
+                }
+            )
+
+            async def handler(req):
+                yield {"ok": True}
+
+            ep = rt.namespace("m").component("backend").endpoint("generate")
+            await ep.serve_endpoint(handler, metrics=pub.stats_handler)
+            workers.append(rt)
+
+        mon_rt = DistributedRuntime(cplane_address=addr)
+        await mon_rt.connect()
+        svc = MetricsService(mon_rt, "m", "backend", host="127.0.0.1", port=0, interval=0.2)
+        mport = await svc.start()
+
+        # emit a hit-rate event like the KV scheduler does
+        await mon_rt.cplane.publish(
+            f"m.{KV_HIT_RATE_SUBJECT}", {"isl_blocks": 10, "overlap_blocks": 4}
+        )
+        await asyncio.sleep(0.6)  # let a scrape cycle run
+
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{mport}/metrics") as resp:
+                assert resp.status == 200
+                text = await resp.text()
+
+        try:
+            assert 'llm_kv_workers{component="backend",namespace="m"} 2' in text
+            assert "llm_kv_kv_active_blocks_avg" in text
+            assert "llm_kv_request_active_slots_max" in text
+            assert "llm_kv_hit_rate_percent" in text and "40.0" in text
+            assert text.count('worker_id="') >= 2
+        finally:
+            await svc.stop()
+            for rt in workers:
+                await rt._shutdown_hook()
+            await mon_rt._shutdown_hook()
+            await broker.stop()
+
+    asyncio.run(body())

@@ -135,9 +135,10 @@ def test_graph_stream_and_kv_routing(graph):
         # the worker's kv events flowed into the processor's radix index;
         # by the second identical request the router saw prefix overlap
         await asyncio.sleep(0.2)
-        return len(processor.router.indexer.tree.root.children)
+        return processor.router.indexer.stats()
 
-    assert loop.run_until_complete(check_router()) > 0
+    nodes, workers = loop.run_until_complete(check_router())
+    assert nodes > 0 and workers == 1
 
 
 def test_graph_model_discovery_detach(graph):
