@@ -23,7 +23,7 @@ def build_pipeline(engine, card: ModelDeploymentCard) -> ModelPipeline:
 
 
 def card_for_model(model_id: str | None, max_model_len: int | None = None) -> ModelDeploymentCard:
-    if model_id is None or model_id == "tiny" or model_id.startswith("tiny:"):
+    if model_id is None or model_id.startswith(("tiny", "tiny-moe")):
         card = ModelDeploymentCard.for_tiny(model_id or "tiny")
         card.model_path = model_id or "tiny"
     else:

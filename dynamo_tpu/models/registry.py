@@ -24,6 +24,16 @@ log = get_logger("models.registry")
 
 def load_model(model_id: str, seed: int = 0):
     """Returns (model, params) on host (unsharded); caller places onto mesh."""
+    if model_id is not None and (model_id == "tiny-moe" or model_id.startswith("tiny-moe:")):
+        from dynamo_tpu.models.mixtral import MixtralConfig, MixtralModel
+
+        overrides = json.loads(model_id.split(":", 1)[1]) if ":" in model_id else {}
+        cfg = MixtralConfig.tiny_moe(**overrides)
+        model = MixtralModel(cfg)
+        params = jax.jit(lambda key: model.init_params(key))(jax.random.key(seed))
+        jax.block_until_ready(params)
+        return model, params
+
     if model_id is None or model_id == "tiny" or model_id.startswith("tiny:"):
         overrides = {}
         if model_id and ":" in model_id:
@@ -40,8 +50,14 @@ def load_model(model_id: str, seed: int = 0):
     if path.is_dir() and (path / "config.json").exists():
         hf_cfg = json.loads((path / "config.json").read_text())
         arch = (hf_cfg.get("architectures") or ["LlamaForCausalLM"])[0]
-        if "Llama" not in arch:
-            raise ValueError(f"unsupported architecture {arch} (Llama family only for now)")
+        if "Mixtral" in arch:
+            from dynamo_tpu.models.mixtral import MixtralConfig, MixtralModel
+
+            cfg = MixtralConfig.from_hf_config(hf_cfg)
+            model = MixtralModel(cfg)
+            raise NotImplementedError("Mixtral checkpoint loading lands in a later round")
+        if "Llama" not in arch and "Qwen" not in arch:
+            raise ValueError(f"unsupported architecture {arch}")
         cfg = LlamaConfig.from_hf_config(hf_cfg)
         model = LlamaModel(cfg)
         from dynamo_tpu.models.loader import load_llama_weights

@@ -1,0 +1,70 @@
+"""Sparse Mixture-of-Experts block (Mixtral-style top-k routing).
+
+TPU-first formulation: GShard-style capacity-based dispatch — one-hot dispatch/
+combine einsums turn token->expert routing into dense batched matmuls (MXU
+friendly, static shapes), and the expert axis shards over the mesh's "ep"
+axis so each chip holds E/ep experts (reference has no MoE of its own,
+SURVEY.md §2.8 — only engine-delegated; this is the native design).
+
+capacity = ceil(T * K / E * capacity_factor); tokens beyond an expert's
+capacity are dropped (their weight is renormalized away). For exactness in
+tests use capacity_factor large enough that nothing drops.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def topk_routing(
+    router_logits: jnp.ndarray,  # [T, E] float32
+    k: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (weights [T, K] — softmax over the selected k, indices [T, K])."""
+    top_logits, top_idx = jax.lax.top_k(router_logits, k)
+    weights = jax.nn.softmax(top_logits, axis=-1)
+    return weights, top_idx
+
+
+def moe_block(
+    hidden: jnp.ndarray,  # [T, D]
+    router_w: jnp.ndarray,  # [D, E]
+    w_gate: jnp.ndarray,  # [E, D, F]
+    w_up: jnp.ndarray,  # [E, D, F]
+    w_down: jnp.ndarray,  # [E, F, D]
+    num_experts_per_tok: int,
+    capacity_factor: float = 2.0,
+) -> jnp.ndarray:
+    T, D = hidden.shape
+    E = router_w.shape[1]
+    K = num_experts_per_tok
+    capacity = max(1, int(-(-T * K * capacity_factor // E)))
+
+    logits = (hidden.astype(jnp.float32) @ router_w.astype(jnp.float32))  # [T, E]
+    weights, idx = topk_routing(logits, K)  # [T, K]
+
+    # one-hot over experts per routing slot: [T, K, E]
+    onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+    # position of each (token, slot) within its expert queue: [T, K, E]
+    # flatten routing slots in (slot-major, token-minor) order for the cumsum
+    flat = onehot.transpose(1, 0, 2).reshape(K * T, E)
+    pos_flat = jnp.cumsum(flat, axis=0) - flat  # position within expert
+    pos = pos_flat.reshape(K, T, E).transpose(1, 0, 2)  # [T, K, E]
+    keep = (pos < capacity) * onehot  # drop overflow
+    pos_clamped = jnp.minimum(pos, capacity - 1).astype(jnp.int32)
+
+    # dispatch[t, e, c]: token t occupies slot c of expert e
+    cap_onehot = jax.nn.one_hot(pos_clamped, capacity, dtype=jnp.float32)  # [T,K,E,C]
+    dispatch = jnp.einsum("tke,tkec->tec", keep, cap_onehot)
+    combine = jnp.einsum("tk,tke,tkec->tec", weights, keep, cap_onehot)
+
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, hidden.astype(jnp.float32))
+    expert_in = expert_in.astype(hidden.dtype)
+    # batched expert FFN: [E, C, D] x [E, D, F]
+    gated = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, w_gate))
+    up = jnp.einsum("ecd,edf->ecf", expert_in, w_up)
+    expert_out = jnp.einsum("ecf,efd->ecd", gated * up, w_down)  # [E, C, D]
+
+    out = jnp.einsum("tec,ecd->td", combine, expert_out.astype(jnp.float32))
+    return out.astype(hidden.dtype)
