@@ -10,24 +10,40 @@ from pathlib import Path
 
 NATIVE_DIR = Path(__file__).parent
 SRC = NATIVE_DIR / "src"
-OUT = NATIVE_DIR / "libdynamo_tpu_native.so"
 
-SOURCES = [SRC / "radix_tree.cc"]
+LIBS = {
+    "libdynamo_tpu_native.so": [SRC / "radix_tree.cc"],
+    # engine-embeddable C ABI for KV event publication (llm_capi.cc docstring)
+    "libdynamo_tpu_llm.so": [SRC / "llm_capi.cc"],
+}
+
+
+def _build_one(out: Path, sources: list[Path], force: bool) -> Path:
+    if not force and out.exists():
+        newest_src = max(s.stat().st_mtime for s in sources)
+        if out.stat().st_mtime >= newest_src:
+            return out
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+        *[str(s) for s in sources],
+        "-o", str(out),
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    return out
 
 
 def build(force: bool = False) -> Path:
-    if not force and OUT.exists():
-        newest_src = max(s.stat().st_mtime for s in SOURCES)
-        if OUT.stat().st_mtime >= newest_src:
-            return OUT
-    cmd = [
-        "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-        *[str(s) for s in SOURCES],
-        "-o", str(OUT),
+    """Build all native libs; returns the radix-tree library path (primary)."""
+    outs = [
+        _build_one(NATIVE_DIR / name, sources, force) for name, sources in LIBS.items()
     ]
-    subprocess.run(cmd, check=True, capture_output=True)
-    return OUT
+    return outs[0]
+
+
+def build_llm_capi(force: bool = False) -> Path:
+    return _build_one(NATIVE_DIR / "libdynamo_tpu_llm.so", LIBS["libdynamo_tpu_llm.so"], force)
 
 
 if __name__ == "__main__":
-    print(build(force=True))
+    for name, sources in LIBS.items():
+        print(_build_one(NATIVE_DIR / name, sources, force=True))

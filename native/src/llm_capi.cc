@@ -1,0 +1,241 @@
+// C ABI for engine-embedded KV event publication — the analogue of the
+// reference's libdynamo_llm C FFI (reference: lib/bindings/c/src/lib.rs:52-318:
+// dynamo_llm_init / dynamo_kv_event_publish_stored / _removed / _shutdown).
+//
+// A foreign engine process (any language) loads this library, calls init with
+// the control-plane address + its worker identity, and publishes KV cache
+// events straight onto the `{ns}|{comp}.kv_events` subject that KV routers
+// subscribe to. Self-contained: speaks the broker's wire protocol (4-byte BE
+// length prefix + msgpack) with a built-in minimal msgpack encoder/decoder —
+// no external dependencies.
+//
+// Block identities are the caller-computed u64 hashes (chained block_hash +
+// unchained tokens_hash, xxh3 seed 1337 — see dynamo_tpu/llm/tokens.py).
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+// ---------------- minimal msgpack writer ----------------
+
+struct Packer {
+  std::vector<uint8_t> buf;
+
+  void u8(uint8_t b) { buf.push_back(b); }
+  void raw(const void* p, size_t n) {
+    const uint8_t* b = static_cast<const uint8_t*>(p);
+    buf.insert(buf.end(), b, b + n);
+  }
+  void be16(uint16_t v) { uint16_t x = htons(v); raw(&x, 2); }
+  void be32(uint32_t v) { uint32_t x = htonl(v); raw(&x, 4); }
+  void be64(uint64_t v) {
+    for (int i = 7; i >= 0; i--) u8(static_cast<uint8_t>(v >> (8 * i)));
+  }
+
+  void pack_nil() { u8(0xc0); }
+  void pack_uint(uint64_t v) {
+    if (v < 0x80) u8(static_cast<uint8_t>(v));
+    else if (v <= 0xff) { u8(0xcc); u8(static_cast<uint8_t>(v)); }
+    else if (v <= 0xffff) { u8(0xcd); be16(static_cast<uint16_t>(v)); }
+    else if (v <= 0xffffffffULL) { u8(0xce); be32(static_cast<uint32_t>(v)); }
+    else { u8(0xcf); be64(v); }
+  }
+  void pack_int(int64_t v) {
+    if (v >= 0) { pack_uint(static_cast<uint64_t>(v)); return; }
+    if (v >= -32) { u8(static_cast<uint8_t>(v)); return; }
+    u8(0xd3); be64(static_cast<uint64_t>(v));
+  }
+  void pack_str(const std::string& s) {
+    size_t n = s.size();
+    if (n < 32) u8(0xa0 | static_cast<uint8_t>(n));
+    else if (n <= 0xff) { u8(0xd9); u8(static_cast<uint8_t>(n)); }
+    else { u8(0xda); be16(static_cast<uint16_t>(n)); }
+    raw(s.data(), n);
+  }
+  void pack_map(uint32_t n) {
+    if (n < 16) u8(0x80 | static_cast<uint8_t>(n));
+    else { u8(0xde); be16(static_cast<uint16_t>(n)); }
+  }
+  void pack_array(uint32_t n) {
+    if (n < 16) u8(0x90 | static_cast<uint8_t>(n));
+    else { u8(0xdc); be16(static_cast<uint16_t>(n)); }
+  }
+};
+
+// ---------------- minimal msgpack skipper (for replies) ----------------
+// We only need to consume reply frames; a full decoder is unnecessary.
+
+// ---------------- client state ----------------
+
+struct Client {
+  int fd = -1;
+  std::string subject;
+  int64_t worker_id = 0;
+  uint64_t next_rid = 1;
+  std::mutex mu;
+};
+
+Client* g_client = nullptr;
+std::mutex g_init_mu;
+
+int send_frame(Client* c, const Packer& p) {
+  uint32_t len = htonl(static_cast<uint32_t>(p.buf.size()));
+  uint8_t header[4];
+  std::memcpy(header, &len, 4);
+  if (::send(c->fd, header, 4, MSG_NOSIGNAL) != 4) return -1;
+  size_t off = 0;
+  while (off < p.buf.size()) {
+    ssize_t n = ::send(c->fd, p.buf.data() + off, p.buf.size() - off, MSG_NOSIGNAL);
+    if (n <= 0) return -1;
+    off += static_cast<size_t>(n);
+  }
+  return 0;
+}
+
+int read_exact(int fd, uint8_t* out, size_t n) {
+  size_t off = 0;
+  while (off < n) {
+    ssize_t r = ::recv(fd, out + off, n - off, 0);
+    if (r <= 0) return -1;
+    off += static_cast<size_t>(r);
+  }
+  return 0;
+}
+
+// Consume one reply frame (we send strictly sequentially, so the next frame
+// is our ack; watch events are not subscribed on this connection).
+int consume_reply(Client* c) {
+  uint8_t header[4];
+  if (read_exact(c->fd, header, 4) != 0) return -1;
+  uint32_t len;
+  std::memcpy(&len, header, 4);
+  len = ntohl(len);
+  if (len > (64u << 20)) return -1;
+  std::vector<uint8_t> payload(len);
+  return read_exact(c->fd, payload.data(), len);
+}
+
+int request(Client* c, const Packer& p) {
+  std::lock_guard<std::mutex> lock(c->mu);
+  if (send_frame(c, p) != 0) return -1;
+  return consume_reply(c);
+}
+
+void pack_event_header(Packer& p, Client* c, const char* extra_key_count_note) {
+  (void)extra_key_count_note;
+  p.pack_map(5);
+  p.pack_str("op"); p.pack_str("publish");
+  p.pack_str("rid"); p.pack_uint(c->next_rid++);
+  p.pack_str("subject"); p.pack_str(c->subject);
+  p.pack_str("reply"); p.pack_nil();
+  p.pack_str("payload");
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success. cplane_addr: "host:port".
+int dynamo_tpu_llm_init(const char* cplane_addr, const char* ns,
+                        const char* component, int64_t worker_id,
+                        uint32_t kv_block_size) {
+  (void)kv_block_size;
+  std::lock_guard<std::mutex> lock(g_init_mu);
+  if (g_client != nullptr) return 0;
+
+  std::string addr(cplane_addr);
+  auto colon = addr.rfind(':');
+  if (colon == std::string::npos) return -1;
+  std::string host = addr.substr(0, colon);
+  std::string port = addr.substr(colon + 1);
+
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  if (getaddrinfo(host.c_str(), port.c_str(), &hints, &res) != 0) return -2;
+  int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd < 0 || ::connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+    freeaddrinfo(res);
+    if (fd >= 0) ::close(fd);
+    return -3;
+  }
+  freeaddrinfo(res);
+
+  Client* c = new Client();
+  c->fd = fd;
+  c->worker_id = worker_id;
+  c->subject = std::string(ns) + "|" + component + ".kv_events";
+  g_client = c;
+  return 0;
+}
+
+int dynamo_tpu_llm_kv_event_publish_stored(uint64_t event_id,
+                                           uint64_t parent_hash, int has_parent,
+                                           int64_t num_blocks,
+                                           const uint64_t* block_hashes,
+                                           const uint64_t* tokens_hashes) {
+  Client* c = g_client;
+  if (c == nullptr) return -1;
+  Packer p;
+  pack_event_header(p, c, nullptr);
+  // payload = RouterEvent wire format (dynamo_tpu/llm/kv_router/indexer.py)
+  p.pack_map(2);
+  p.pack_str("worker_id"); p.pack_int(c->worker_id);
+  p.pack_str("event");
+  p.pack_map(2);
+  p.pack_str("event_id"); p.pack_uint(event_id);
+  p.pack_str("stored");
+  p.pack_map(2);
+  p.pack_str("parent_hash");
+  if (has_parent) p.pack_uint(parent_hash); else p.pack_nil();
+  p.pack_str("blocks");
+  p.pack_array(static_cast<uint32_t>(num_blocks));
+  for (int64_t i = 0; i < num_blocks; i++) {
+    p.pack_map(2);
+    p.pack_str("block_hash"); p.pack_uint(block_hashes[i]);
+    p.pack_str("tokens_hash"); p.pack_uint(tokens_hashes[i]);
+  }
+  return request(c, p);
+}
+
+int dynamo_tpu_llm_kv_event_publish_removed(uint64_t event_id,
+                                            const uint64_t* block_hashes,
+                                            int64_t num_blocks) {
+  Client* c = g_client;
+  if (c == nullptr) return -1;
+  Packer p;
+  pack_event_header(p, c, nullptr);
+  p.pack_map(2);
+  p.pack_str("worker_id"); p.pack_int(c->worker_id);
+  p.pack_str("event");
+  p.pack_map(2);
+  p.pack_str("event_id"); p.pack_uint(event_id);
+  p.pack_str("removed");
+  p.pack_map(1);
+  p.pack_str("block_hashes");
+  p.pack_array(static_cast<uint32_t>(num_blocks));
+  for (int64_t i = 0; i < num_blocks; i++) p.pack_uint(block_hashes[i]);
+  return request(c, p);
+}
+
+int dynamo_tpu_llm_shutdown() {
+  std::lock_guard<std::mutex> lock(g_init_mu);
+  if (g_client != nullptr) {
+    ::close(g_client->fd);
+    delete g_client;
+    g_client = nullptr;
+  }
+  return 0;
+}
+
+}  // extern "C"

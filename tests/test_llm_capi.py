@@ -1,0 +1,100 @@
+"""C-ABI embedding library: a foreign engine publishes KV events through
+libdynamo_tpu_llm.so and a KvRouter (subscribed over the broker) indexes them.
+
+Mirrors the reference C FFI path (reference: lib/bindings/c/src/lib.rs ->
+NATS kv_events -> indexer, SURVEY.md §3.4)."""
+
+import asyncio
+import ctypes
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from dynamo_tpu.cplane.broker import Broker
+from dynamo_tpu.llm.kv_router.router import KvRouter
+from dynamo_tpu.llm.tokens import TokenSequence
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(scope="module")
+def capi():
+    sys.path.insert(0, str(REPO / "native"))
+    try:
+        import build as native_build
+    finally:
+        sys.path.pop(0)
+    try:
+        path = native_build.build_llm_capi()
+    except Exception as e:
+        pytest.skip(f"native toolchain unavailable: {e}")
+    lib = ctypes.CDLL(str(path))
+    lib.dynamo_tpu_llm_init.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint32,
+    ]
+    lib.dynamo_tpu_llm_kv_event_publish_stored.argtypes = [
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.dynamo_tpu_llm_kv_event_publish_removed.argtypes = [
+        ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64,
+    ]
+    return lib
+
+
+def test_capi_events_reach_router(capi):
+    async def body():
+        broker = Broker()
+        port = await broker.start()
+        rt = DistributedRuntime(cplane_address=f"127.0.0.1:{port}")
+        await rt.connect()
+        router = KvRouter(rt, "cns", "cworker", kv_block_size=4)
+        await router.start()
+        try:
+            worker_id = 0x77
+            rc = capi.dynamo_tpu_llm_init(
+                f"127.0.0.1:{port}".encode(), b"cns", b"cworker", worker_id, 4
+            )
+            assert rc == 0
+
+            # blocks for tokens [0..8) with the canonical hash scheme
+            prompt = list(range(8))
+            ts = TokenSequence(prompt, 4)
+            b = ts.blocks
+            arr = lambda vals: (ctypes.c_uint64 * len(vals))(*vals)
+            loop = asyncio.get_running_loop()
+            rc = await loop.run_in_executor(
+                None,
+                lambda: capi.dynamo_tpu_llm_kv_event_publish_stored(
+                    1, 0, 0, 2,
+                    arr([blk.sequence_hash for blk in b]),
+                    arr([blk.block_hash for blk in b]),
+                ),
+            )
+            assert rc == 0
+            await asyncio.sleep(0.2)
+
+            scores = router.indexer.find_matches_for_request(prompt)
+            assert scores.scores == {worker_id: 2}
+
+            rc = await loop.run_in_executor(
+                None,
+                lambda: capi.dynamo_tpu_llm_kv_event_publish_removed(
+                    2, arr([b[1].sequence_hash]), 1
+                ),
+            )
+            assert rc == 0
+            await asyncio.sleep(0.2)
+            scores = router.indexer.find_matches_for_request(prompt)
+            assert scores.scores == {worker_id: 1}
+
+            assert capi.dynamo_tpu_llm_shutdown() == 0
+        finally:
+            await router.stop()
+            await rt._shutdown_hook()
+            await broker.stop()
+
+    asyncio.run(body())
