@@ -18,7 +18,12 @@ def build_pipeline(engine, card: ModelDeploymentCard) -> ModelPipeline:
         model_name=card.display_name,
         max_model_len=card.context_length,
     )
-    backend = Backend(engine, tokenizer)
+    from dynamo_tpu.launch._remote import RemoteEngineProxy, RemoteTextBackend
+
+    if isinstance(engine, RemoteEngineProxy):
+        backend = RemoteTextBackend(engine)  # remote worker already detokenizes
+    else:
+        backend = Backend(engine, tokenizer)
     return ModelPipeline(card.display_name, preprocessor, backend, model_type="both")
 
 

@@ -1,0 +1,157 @@
+"""dyn:// endpoint support for the run CLI.
+
+out=dyn://ns.comp.ep — RemoteEngineProxy: a local engine facade that forwards
+tokens-in/tokens-out requests to a distributed worker endpoint (the frontends
+keep using the same Backend/engine contract).
+
+in=dyn://ns.comp.ep — serve_engine_endpoint: expose the local engine (jax or
+echo) as a worker endpoint speaking the same wire protocol as
+components/worker.py, so a remote frontend can drive it.
+
+Mirrors the reference launcher's dyn:// in/out modes
+(reference: launch/dynamo-run/src/{input,output} dyn endpoints).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import AsyncIterator
+
+from dynamo_tpu.engine.scheduler import EngineRequest, StepOutput
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("launch.remote")
+
+
+class RemoteEngineProxy:
+    """Engine facade forwarding to a remote worker endpoint.
+
+    The remote worker detokenizes (worker wire protocol), so this proxy
+    surfaces text via StepOutput extension — the local Backend sees token ids
+    and passes text through untouched when present.
+    """
+
+    def __init__(self, endpoint: str):
+        self.endpoint = endpoint
+        self.drt: DistributedRuntime | None = None
+        self._client = None
+
+    async def start(self) -> None:
+        self.drt = DistributedRuntime()
+        await self.drt.connect()
+        self._client = await self.drt.endpoint_client(self.endpoint)
+        await self._client.wait_for_instances(timeout=60)
+
+    async def shutdown(self) -> None:
+        if self.drt is not None:
+            await self.drt._shutdown_hook()
+
+    async def generate(self, request: EngineRequest) -> AsyncIterator[StepOutput]:
+        wire = {
+            "request_id": request.request_id,
+            "token_ids": list(request.token_ids),
+            "sampling": {
+                "temperature": request.sampling.temperature,
+                "top_k": request.sampling.top_k,
+                "top_p": request.sampling.top_p,
+                "max_tokens": request.sampling.max_tokens,
+                "ignore_eos": request.sampling.ignore_eos,
+            },
+            "eos_token_ids": list(request.eos_token_ids),
+        }
+        stream = await self._client.random(wire)
+        async for item in stream:
+            token = None
+            ids = item.get("token_ids") or []
+            if ids:
+                token = int(ids[0])
+            out = StepOutput(
+                request_id=request.request_id,
+                token=token,
+                finished=item.get("finish_reason") is not None,
+                finish_reason=item.get("finish_reason"),
+                cached_tokens=item.get("cached_tokens", 0),
+            )
+            out.text = item.get("text", "")  # pass-through for RemoteTextBackend
+            yield out
+
+
+class RemoteTextBackend:
+    """Backend facade over RemoteEngineProxy: the remote worker already
+    detokenized, so text passes straight through (no local DecodeStream)."""
+
+    def __init__(self, proxy: RemoteEngineProxy):
+        self.proxy = proxy
+
+    async def generate(self, request):
+        from dynamo_tpu.llm.protocols.common import BackendOutput
+
+        engine_req = EngineRequest(
+            request_id=request.request_id,
+            token_ids=list(request.token_ids),
+            sampling=request.sampling,
+            eos_token_ids=tuple(request.eos_token_ids),
+        )
+        count = 0
+        async for out in self.proxy.generate(engine_req):
+            if out.token is not None:
+                count += 1
+            yield BackendOutput(
+                request_id=request.request_id,
+                text=getattr(out, "text", ""),
+                token_ids=[out.token] if out.token is not None else [],
+                finish_reason=out.finish_reason,
+                cumulative_tokens=count,
+                cached_tokens=out.cached_tokens,
+            )
+            if out.finished:
+                return
+
+
+async def serve_engine_endpoint(engine, args) -> None:
+    """Expose the local engine at dyn://ns.comp.ep (tokens in/out)."""
+    from dynamo_tpu.frontends.pipeline import card_for_model
+    from dynamo_tpu.llm.backend import Backend
+    from dynamo_tpu.llm.model_registry import ModelEntry, register_model
+    from dynamo_tpu.llm.protocols.common import PreprocessedRequest
+    from dynamo_tpu.llm.tokenizer import get_tokenizer
+
+    address = args.input[len("dyn://") :]
+    ns, comp, ep_name = address.split(".")
+    card = card_for_model(args.model, getattr(args, "max_model_len", None))
+    tokenizer = get_tokenizer(card.tokenizer)
+    backend = Backend(engine, tokenizer)
+
+    drt = DistributedRuntime()
+    await drt.connect()
+
+    async def handle(request: dict):
+        pre = PreprocessedRequest.from_wire(request)
+        async for out in backend.generate(pre):
+            yield {
+                "request_id": out.request_id,
+                "text": out.text,
+                "token_ids": out.token_ids,
+                "finish_reason": out.finish_reason,
+                "cumulative_tokens": out.cumulative_tokens,
+                "cached_tokens": out.cached_tokens,
+            }
+
+    def stats():
+        m = getattr(engine, "metrics", None)
+        return {"kv_metrics": m().to_wire()} if m else {}
+
+    served = await drt.namespace(ns).component(comp).endpoint(ep_name).serve_endpoint(
+        handle, metrics=stats
+    )
+    entry = ModelEntry(
+        name=card.display_name, endpoint=args.input, model_type="chat", card=card
+    )
+    await register_model(drt.cplane, entry, lease_id=drt.primary_lease.lease_id)
+    log.info("engine served at %s (model %s)", args.input, card.display_name)
+    try:
+        await drt.runtime.cancellation.cancelled()
+    finally:
+        await served.stop()
+        await drt._shutdown_hook()

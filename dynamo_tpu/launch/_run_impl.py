@@ -56,6 +56,14 @@ async def _build_engine(args):
         engine = AsyncJaxEngine(engine_config_for(args))
         await engine.start()
         return engine
+    if args.output.startswith("dyn://"):
+        # remote engine: forward EngineRequests to a distributed endpoint that
+        # speaks the worker wire protocol (reference: dynamo-run out=dyn://)
+        from dynamo_tpu.launch._remote import RemoteEngineProxy
+
+        proxy = RemoteEngineProxy(args.output)
+        await proxy.start()
+        return proxy
     raise ValueError(f"unsupported out={args.output}")
 
 
@@ -74,6 +82,11 @@ async def _run(args) -> None:
             from dynamo_tpu.frontends.batch import run_batch
 
             await run_batch(engine, args, args.input.split(":", 1)[1])
+        elif args.input.startswith("dyn://"):
+            # expose this engine as a distributed endpoint (worker mode)
+            from dynamo_tpu.launch._remote import serve_engine_endpoint
+
+            await serve_engine_endpoint(engine, args)
         else:
             raise ValueError(f"unsupported in={args.input}")
     finally:

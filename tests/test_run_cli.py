@@ -1,0 +1,109 @@
+"""run CLI: dyn:// worker/frontend split + batch mode.
+
+Mirrors the reference dynamo-run matrix (reference: launch/dynamo-run in=/out=
+combinations)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_run_cli_dyn_split(tmp_path):
+    """worker: run --in dyn://d.worker.gen --out jax
+    frontend: run --in http --out dyn://d.worker.gen"""
+    cplane_port = _free_port()
+    http_port = _free_port()
+    env = dict(os.environ)
+    env["DYNTPU_CPLANE"] = f"127.0.0.1:{cplane_port}"
+
+    broker = subprocess.Popen(
+        [sys.executable, "-m", "dynamo_tpu.cplane.broker", "--port", str(cplane_port)],
+        env=env, cwd="/root/repo",
+    )
+    worker = frontend = None
+    try:
+        time.sleep(1.0)
+        worker = subprocess.Popen(
+            [sys.executable, "-m", "dynamo_tpu.launch.run", "run", "tiny",
+             "--in", "dyn://d.worker.gen", "--out", "jax"],
+            env=env, cwd="/root/repo",
+        )
+        frontend = subprocess.Popen(
+            [sys.executable, "-m", "dynamo_tpu.launch.run", "run", "tiny",
+             "--in", "http", "--out", "dyn://d.worker.gen",
+             "--http-port", str(http_port)],
+            env=env, cwd="/root/repo",
+        )
+        body = json.dumps({
+            "model": "tiny",
+            "messages": [{"role": "user", "content": "over the wire"}],
+            "max_tokens": 5,
+            "temperature": 0,
+        }).encode()
+        deadline = time.time() + 120
+        last = None
+        while time.time() < deadline:
+            for proc, name in ((broker, "broker"), (worker, "worker"), (frontend, "frontend")):
+                if proc.poll() is not None:
+                    pytest.fail(f"{name} died rc={proc.returncode}")
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{http_port}/v1/chat/completions",
+                    data=body, headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    result = json.loads(resp.read())
+                assert result["usage"]["completion_tokens"] == 5
+                assert isinstance(result["choices"][0]["message"]["content"], str)
+                return
+            except Exception as e:
+                last = e
+                time.sleep(1.0)
+        pytest.fail(f"never became ready: {last}")
+    finally:
+        for proc in (frontend, worker, broker):
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+        for proc in (frontend, worker, broker):
+            if proc is not None:
+                try:
+                    proc.wait(10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+
+
+@pytest.mark.slow
+def test_run_cli_batch_mode(tmp_path):
+    batch_file = tmp_path / "prompts.jsonl"
+    batch_file.write_text(
+        "\n".join(json.dumps({"text": f"prompt {i}", "max_tokens": 4}) for i in range(3))
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "dynamo_tpu.launch.run", "run", "tiny",
+         "--in", f"batch:{batch_file}", "--out", "jax"],
+        capture_output=True, text=True, timeout=180, cwd="/root/repo",
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["requests"] == 3
+    assert summary["output_tokens"] == 12
+    out_file = Path(summary["output_file"])
+    assert out_file.exists()
+    lines = [json.loads(l) for l in out_file.read_text().splitlines()]
+    assert all(r["tokens_out"] == 4 for r in lines)
