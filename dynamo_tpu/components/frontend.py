@@ -110,3 +110,29 @@ class FrontendService:
         )
         self._entries[entry.name] = entry
         log.info("model attached: %s -> %s", entry.name, entry.endpoint)
+
+
+async def _main(args) -> None:
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    drt = DistributedRuntime(cplane_address=args.cplane)
+    await drt.connect()
+    frontend = FrontendService(drt, host=args.host, port=args.port)
+    port = await frontend.start()
+    log.info("standalone frontend on :%d", port)
+    await drt.runtime.cancellation.cancelled()
+
+
+def main(argv=None) -> None:
+    """Standalone OpenAI frontend (reference: components/http/src/main.rs)."""
+    import argparse
+
+    p = argparse.ArgumentParser(description=main.__doc__)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--cplane", default=None)
+    asyncio.run(_main(p.parse_args(argv)))
+
+
+if __name__ == "__main__":
+    main()

@@ -47,12 +47,14 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     rms_norm_eps: float = 1e-5
     tie_word_embeddings: bool = False
+    attention_bias: bool = False  # Qwen2-style qkv biases
     dtype: Any = jnp.bfloat16
 
     @classmethod
     def from_hf_config(cls, d: dict) -> "LlamaConfig":
-        """Build from a HuggingFace config.json dict."""
+        """Build from a HuggingFace config.json dict (Llama / Qwen2 families)."""
         num_heads = d["num_attention_heads"]
+        is_qwen = "qwen" in str(d.get("model_type", "")).lower()
         return cls(
             vocab_size=d["vocab_size"],
             hidden_size=d["hidden_size"],
@@ -64,6 +66,7 @@ class LlamaConfig:
             rope_theta=d.get("rope_theta", 10000.0),
             rms_norm_eps=d.get("rms_norm_eps", 1e-5),
             tie_word_embeddings=d.get("tie_word_embeddings", False),
+            attention_bias=d.get("attention_bias", is_qwen),
         )
 
     @classmethod
@@ -129,6 +132,10 @@ class LlamaModel:
             },
             "final_norm": jnp.ones((D,), c.dtype),
         }
+        if c.attention_bias:
+            params["layers"]["bq"] = dense(next(keys), (L, H * Dh), 0)
+            params["layers"]["bk"] = dense(next(keys), (L, Hkv * Dh), 0)
+            params["layers"]["bv"] = dense(next(keys), (L, Hkv * Dh), 0)
         if not c.tie_word_embeddings:
             params["lm_head"] = dense(next(keys), (V, D), 1)
         return params
@@ -154,6 +161,10 @@ class LlamaModel:
             },
             "final_norm": ns(None),
         }
+        if self.config.attention_bias:
+            shardings["layers"]["bq"] = ns(None, tp_axis)
+            shardings["layers"]["bk"] = ns(None, tp_axis)
+            shardings["layers"]["bv"] = ns(None, tp_axis)
         if not self.config.tie_word_embeddings:
             shardings["lm_head"] = ns(tp_axis, None)
         return shardings
@@ -190,9 +201,16 @@ class LlamaModel:
         c = self.config
         T = hidden.shape[0]
         h = rms_norm(hidden, lp["input_norm"], c.rms_norm_eps)
-        q = (h @ lp["wq"]).reshape(T, c.num_heads, c.head_dim)
-        k = (h @ lp["wk"]).reshape(T, c.num_kv_heads, c.head_dim)
-        v = (h @ lp["wv"]).reshape(T, c.num_kv_heads, c.head_dim)
+        q_flat = h @ lp["wq"]
+        k_flat = h @ lp["wk"]
+        v_flat = h @ lp["wv"]
+        if c.attention_bias:
+            q_flat = q_flat + lp["bq"]
+            k_flat = k_flat + lp["bk"]
+            v_flat = v_flat + lp["bv"]
+        q = q_flat.reshape(T, c.num_heads, c.head_dim)
+        k = k_flat.reshape(T, c.num_kv_heads, c.head_dim)
+        v = v_flat.reshape(T, c.num_kv_heads, c.head_dim)
         q = apply_rope(q, positions, c.rope_theta)
         k = apply_rope(k, positions, c.rope_theta)
         k_pages, v_pages = scatter_kv(kv[0], kv[1], k, v, phys_pages, offsets, valid)

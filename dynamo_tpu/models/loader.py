@@ -68,6 +68,10 @@ def load_llama_weights(model: LlamaModel, path: Path) -> dict:
         "up": alloc((L, D, F)),
         "down": alloc((L, F, D)),
     }
+    if c.attention_bias:
+        layers["bq"] = alloc((L, H * Dh))
+        layers["bk"] = alloc((L, Hkv * Dh))
+        layers["bv"] = alloc((L, Hkv * Dh))
     params = {"embed": None, "final_norm": None}
 
     per_layer = {
@@ -76,6 +80,9 @@ def load_llama_weights(model: LlamaModel, path: Path) -> dict:
         "self_attn.k_proj.weight": ("wk", True),
         "self_attn.v_proj.weight": ("wv", True),
         "self_attn.o_proj.weight": ("wo", True),
+        "self_attn.q_proj.bias": ("bq", False),
+        "self_attn.k_proj.bias": ("bk", False),
+        "self_attn.v_proj.bias": ("bv", False),
         "post_attention_layernorm.weight": ("post_norm", False),
         "mlp.gate_proj.weight": ("gate", True),
         "mlp.up_proj.weight": ("up", True),

@@ -1,0 +1,82 @@
+"""Device mesh construction + multihost bootstrap.
+
+The TPU replacement for the reference's multi-node engine bootstrap
+(reference: lib/llm/src/engines/vllm/ray.rs leader/follower + NCCL env,
+SURVEY.md §2.8 row "Multi-node engine bootstrap"): on TPU pods a single SPMD
+program spans hosts after ``jax.distributed.initialize``; there is no Ray and
+no NCCL — XLA collectives ride ICI/DCN.
+
+Axes convention (any subset may be 1):
+  dp — engine replicas (data parallel; usually separate processes instead)
+  tp — tensor parallel (attention heads / MLP hidden)
+  sp — sequence/context parallel (ring attention prefill)
+  ep — expert parallel (MoE expert banks)
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("parallel.mesh")
+
+
+@dataclass
+class MeshConfig:
+    tp: int = 1
+    dp: int = 1
+    sp: int = 1
+    ep: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.tp * self.dp * self.sp * self.ep
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {"dp": self.dp, "sp": self.sp, "ep": self.ep, "tp": self.tp}
+
+
+def init_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialize the JAX distributed runtime across TPU hosts.
+
+    No-ops on a single host. Values default from DYNTPU_COORDINATOR /
+    DYNTPU_NUM_PROCESSES / DYNTPU_PROCESS_ID (set by the serve supervisor or
+    the pod launcher).
+    """
+    coordinator_address = coordinator_address or os.environ.get("DYNTPU_COORDINATOR")
+    if not coordinator_address:
+        return
+    num_processes = num_processes or int(os.environ.get("DYNTPU_NUM_PROCESSES", "1"))
+    process_id = process_id if process_id is not None else int(os.environ.get("DYNTPU_PROCESS_ID", "0"))
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    log.info(
+        "multihost initialized: process %d/%d, %d global devices",
+        process_id, num_processes, len(jax.devices()),
+    )
+
+
+def build_mesh(config: MeshConfig, devices=None) -> Mesh:
+    """Mesh with axes (dp, sp, ep, tp); tp innermost so it lands on the
+    fastest ICI neighbor links."""
+    if devices is None:
+        devices = jax.devices()
+    n = config.num_devices
+    if n > len(devices):
+        raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
+    arr = np.array(devices[:n]).reshape(config.dp, config.sp, config.ep, config.tp)
+    return Mesh(arr, ("dp", "sp", "ep", "tp"))

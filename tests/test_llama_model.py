@@ -139,3 +139,47 @@ def test_tp_sharded_prefill_matches(setup):
     )
     ref = naive_forward(cfg, params, PROMPT)[-1]
     np.testing.assert_allclose(np.asarray(logits_sh), np.asarray(ref), atol=1e-4)
+
+
+def test_qwen2_style_bias_model():
+    """attention_bias=True (Qwen2 family): paged prefill matches a naive
+    dense forward with biases."""
+    from dataclasses import replace
+
+    cfg = replace(LlamaConfig.tiny(), attention_bias=True)
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.key(3))
+
+    def naive_bias(tokens):
+        T = len(tokens)
+        pos = jnp.arange(T)
+        h = params["embed"][jnp.array(tokens)].astype(cfg.dtype)
+        for l in range(cfg.num_layers):
+            lp = jax.tree.map(lambda x: x[l], params["layers"])
+            x = rms_norm(h, lp["input_norm"], cfg.rms_norm_eps)
+            q = apply_rope(((x @ lp["wq"]) + lp["bq"]).reshape(T, cfg.num_heads, cfg.head_dim), pos, cfg.rope_theta)
+            k = apply_rope(((x @ lp["wk"]) + lp["bk"]).reshape(T, cfg.num_kv_heads, cfg.head_dim), pos, cfg.rope_theta)
+            v = ((x @ lp["wv"]) + lp["bv"]).reshape(T, cfg.num_kv_heads, cfg.head_dim)
+            g = cfg.num_heads // cfg.num_kv_heads
+            kr = jnp.repeat(k, g, axis=1)
+            vr = jnp.repeat(v, g, axis=1)
+            s = jnp.einsum("thd,shd->hts", q.astype(jnp.float32), kr.astype(jnp.float32)) / np.sqrt(cfg.head_dim)
+            s = jnp.where(jnp.tril(jnp.ones((T, T), bool))[None], s, -1e30)
+            a = jnp.einsum("hts,shd->thd", jax.nn.softmax(s, -1), vr.astype(jnp.float32)).astype(cfg.dtype)
+            h = h + a.reshape(T, -1) @ lp["wo"]
+            x = rms_norm(h, lp["post_norm"], cfg.rms_norm_eps)
+            h = h + (jax.nn.silu(x @ lp["gate"]) * (x @ lp["up"])) @ lp["down"]
+        x = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+        return jnp.einsum("td,vd->tv", x.astype(jnp.float32), params["lm_head"].astype(jnp.float32))
+
+    ref = naive_bias(PROMPT)[-1]
+    Tn, T_pad = len(PROMPT), 8
+    tokens = np.zeros(T_pad, np.int32)
+    tokens[:Tn] = PROMPT
+    positions = np.arange(T_pad, dtype=np.int32)
+    kv = model.init_kv_cache(NUM_PAGES, PAGE_SIZE)
+    logits, _ = model.prefill(
+        params, kv, jnp.array(tokens), jnp.array(positions),
+        jnp.array(PAGE_TABLE), jnp.array(positions < Tn), jnp.array(Tn - 1),
+    )
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref), atol=1e-4)

@@ -129,3 +129,15 @@ def test_serve_supervisor_agg_graph(tmp_path):
             proc.wait(15)
         except subprocess.TimeoutExpired:
             proc.kill()
+
+
+def test_build_mesh_axes():
+    import jax
+
+    from dynamo_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    mesh = build_mesh(MeshConfig(tp=2, dp=2, sp=1, ep=2))
+    assert mesh.axis_names == ("dp", "sp", "ep", "tp")
+    assert mesh.devices.shape == (2, 1, 2, 2)
+    with pytest.raises(ValueError):
+        build_mesh(MeshConfig(tp=16))
