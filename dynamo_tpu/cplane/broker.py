@@ -89,12 +89,14 @@ class Broker:
     async def start(self) -> int:
         self._server = await asyncio.start_server(self._handle_conn, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
-        asyncio.create_task(self._lease_reaper())
+        self._reaper_task = asyncio.create_task(self._lease_reaper())
         log.info("broker listening on %s:%d", self.host, self.port)
         return self.port
 
     async def stop(self) -> None:
         self._stopped.set()
+        if getattr(self, "_reaper_task", None) is not None:
+            self._reaper_task.cancel()
         if self._server:
             self._server.close()
             await self._server.wait_closed()
@@ -289,12 +291,15 @@ class Broker:
         log.debug("lease %x expired (%s), %d keys removed", lease_id, reason, len(lease.keys))
 
     async def _lease_reaper(self) -> None:
-        while not self._stopped.is_set():
-            now = time.monotonic()
-            for lease_id, lease in list(self._leases.items()):
-                if lease.expires_at < now:
-                    self._expire_lease(lease_id, reason="ttl")
-            await asyncio.sleep(0.5)
+        try:
+            while not self._stopped.is_set():
+                now = time.monotonic()
+                for lease_id, lease in list(self._leases.items()):
+                    if lease.expires_at < now:
+                        self._expire_lease(lease_id, reason="ttl")
+                await asyncio.sleep(0.5)
+        except asyncio.CancelledError:
+            pass
 
     # ------------- subjects (pub/sub + request) -------------
 

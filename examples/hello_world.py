@@ -1,0 +1,61 @@
+"""Three-stage SDK pipeline: Frontend -> Middle -> Backend.
+
+The analogue of the reference hello_world example (reference: examples/
+hello_world/hello_world.py:20-80) — demonstrates @service/@endpoint/depends
+streaming composition without any model.
+
+    python -m dynamo_tpu.sdk.serve examples.hello_world:Frontend
+    curl localhost:8099/generate?text=world
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from aiohttp import web
+
+from dynamo_tpu.sdk import async_on_start, depends, endpoint, service
+
+
+@service(namespace="hello", component="backend")
+class Backend:
+    @endpoint
+    async def generate(self, text: str):
+        for word in f"hello {text}!".split():
+            yield word
+
+
+@service(namespace="hello", component="middle")
+class Middle:
+    backend = depends(Backend)
+
+    @endpoint
+    async def generate(self, text: str):
+        stream = await self.backend.stream(text.upper())
+        async for word in stream:
+            yield f"[{word}]"
+
+
+@service(namespace="hello", component="frontend")
+class Frontend:
+    middle = depends(Middle)
+
+    @async_on_start
+    async def boot(self):
+        app = web.Application()
+        app.router.add_get("/generate", self._handle)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", int(self.config.get("port", 8099)))
+        await site.start()
+        self._runner = runner
+        print("hello_world frontend on :8099", flush=True)
+
+    async def _handle(self, request: web.Request) -> web.Response:
+        text = request.query.get("text", "world")
+        stream = await self.middle.stream(text)
+        words = [w async for w in stream]
+        return web.json_response({"result": " ".join(words)})
+
+    async def on_shutdown(self):
+        await self._runner.cleanup()

@@ -1,0 +1,50 @@
+"""Replay-based protocol tests: recorded SSE streams decoded and aggregated
+into stable snapshots (the reference's replay tier, reference: lib/llm/tests/
+data/replays + tests/aggregators.rs insta snapshots)."""
+
+import asyncio
+import json
+from pathlib import Path
+
+from dynamo_tpu.llm.protocols.aggregator import aggregate_chat_stream
+from dynamo_tpu.llm.protocols.sse import SseDecoder
+
+DATA = Path(__file__).parent / "data" / "replays"
+
+
+def replay_chunks(name: str):
+    """Parse a recorded SSE byte stream into chunk dicts."""
+    raw = (DATA / name).read_bytes()
+    dec = SseDecoder()
+    chunks = []
+    for msg in dec.feed(raw):
+        if msg.is_done:
+            break
+        if msg.data:
+            chunks.append(json.loads(msg.data))
+    return chunks
+
+
+def test_recorded_stream_aggregates_to_snapshot():
+    chunks = replay_chunks("chat_stream_basic.sse")
+
+    async def gen():
+        for c in chunks:
+            yield c
+
+    out = asyncio.run(aggregate_chat_stream(gen()))
+    snapshot = json.loads((DATA / "chat_stream_basic.expected.json").read_text())
+    assert out == snapshot
+
+
+def test_recorded_stream_handles_comments_and_split_frames():
+    raw = (DATA / "chat_stream_basic.sse").read_bytes()
+    dec = SseDecoder()
+    msgs = []
+    # feed one byte at a time — decoder must be fully incremental
+    for i in range(len(raw)):
+        msgs.extend(dec.feed(raw[i : i + 1]))
+    datas = [m for m in msgs if m.data and not m.is_done]
+    comments = [c for m in msgs for c in m.comments]
+    assert len(datas) == 4
+    assert any("keepalive" in c for c in comments)
