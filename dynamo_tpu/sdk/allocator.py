@@ -1,0 +1,130 @@
+"""TPU chip assignment for the serve supervisor.
+
+reference: deploy/dynamo/sdk/src/dynamo/sdk/cli/allocator.py:33-134
+(ResourceAllocator.assign_gpus / get_worker_env). Ours allocates TPU chips
+instead of CUDA devices: each worker process gets a disjoint chip set via
+`TPU_VISIBLE_DEVICES` (libtpu honours it the way CUDA honours
+CUDA_VISIBLE_DEVICES); services that request no TPU are pinned to
+`JAX_PLATFORMS=cpu` so importing jax in them never grabs the chips.
+
+Fractional requests (e.g. {"tpu": 0.5}) co-locate workers on a shared chip —
+the workers see the same TPU_VISIBLE_DEVICES and must coordinate HBM use
+(time-sliced; there is no TPU MIG equivalent).
+
+Set DYNTPU_DISABLE_TPU_ALLOCATION=1 to manage visibility manually, and
+DYNTPU_DEPLOYMENT_ENV for K8s replica mode (every replica gets the same
+assignment; the pod boundary provides isolation) — mirrors
+DYNAMO_DISABLE_GPU_ALLOCATION / DYNAMO_DEPLOYMENT_ENV.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import warnings
+
+DISABLE_TPU_ALLOCATION_ENV = "DYNTPU_DISABLE_TPU_ALLOCATION"
+DEPLOYMENT_ENV = "DYNTPU_DEPLOYMENT_ENV"
+NUM_CHIPS_ENV = "DYNTPU_TPU_CHIPS"  # override detection, e.g. =4
+
+
+def detect_tpu_chips() -> int:
+    """Count local TPU chips without importing jax (cheap, fork-safe)."""
+    if NUM_CHIPS_ENV in os.environ:
+        return int(os.environ[NUM_CHIPS_ENV])
+    # TPU VM runtimes expose one /dev/accel<N> (or vfio group) per chip.
+    accel = glob.glob("/dev/accel[0-9]*")
+    if accel:
+        return len(accel)
+    vfio = [p for p in glob.glob("/dev/vfio/[0-9]*")]
+    return len(vfio)
+
+
+class ResourceAllocator:
+    """Splits the host's TPU chips across service workers."""
+
+    def __init__(self, total_chips: int | None = None) -> None:
+        self.total_chips = detect_tpu_chips() if total_chips is None else total_chips
+        self.remaining_chips: float = float(self.total_chips)
+        # each entry: (remaining_fraction, fragment_unit)
+        self._chips: list[tuple[float, float]] = [(1.0, 1.0)] * self.total_chips
+
+    def assign_chips(self, count: float) -> list[int]:
+        """Assign `count` chips (fractional => shared chip). Returns chip ids."""
+        if count > 1 and int(count) != count:
+            raise ValueError("fractional TPU requests above 1 chip are not supported")
+        if count > self.remaining_chips:
+            warnings.warn(
+                f"Requested {count} TPU chips, but only {self.remaining_chips} remain. "
+                f"Serving may fail; set {DISABLE_TPU_ALLOCATION_ENV}=1 to manage "
+                "chip visibility manually.",
+                ResourceWarning,
+                stacklevel=3,
+            )
+        self.remaining_chips = max(0.0, self.remaining_chips - count)
+        if count < 1:  # fractional: co-locate on a chip already split this way
+            try:
+                chip = next(
+                    i for i, (rem, unit) in enumerate(self._chips)
+                    if rem > 0 and unit == count
+                )
+            except StopIteration:
+                try:
+                    chip = next(i for i, (rem, _) in enumerate(self._chips) if rem == 1.0)
+                except StopIteration:
+                    chip = len(self._chips)
+                    self._chips.append((1.0, count))
+            remaining = self._chips[chip][0] - count
+            self._chips[chip] = (remaining if remaining >= count else 0.0, count)
+            return [chip]
+        count = int(count)
+        free = [i for i, (rem, unit) in enumerate(self._chips) if rem > 0 and unit == 1.0]
+        if len(free) < count:
+            warnings.warn(
+                f"Not enough TPU chips: {count} requested", ResourceWarning, stacklevel=3
+            )
+            while len(free) < count:
+                free.append(len(self._chips))
+                self._chips.append((1.0, 1.0))
+        for chip in free[:count]:
+            self._chips[chip] = (0.0, 1.0)
+        return free[:count]
+
+    def get_worker_env(self, meta, config: dict) -> tuple[int, list[dict[str, str]]]:
+        """(num_workers, per-worker env) for a service.
+
+        `meta` is the ServiceMeta from @service; `config` the service's YAML
+        section (may override workers/resources).
+        """
+        resources = config["resources"] if "resources" in config else meta.resources
+        resources = resources or {}
+        num_chips = resources.get("tpu", 0)
+        workers = config.get("workers", meta.workers)
+        if workers == "cpu_count":
+            workers = os.cpu_count() or 1
+            num_chips = 0
+        num_workers = int(workers)
+
+        if not num_chips or os.environ.get(DISABLE_TPU_ALLOCATION_ENV):
+            # No chips for this service: keep jax off the TPU entirely.
+            env = {"JAX_PLATFORMS": "cpu"} if not num_chips else {}
+            return num_workers, [dict(env) for _ in range(num_workers)]
+
+        if self.total_chips == 0:
+            # No local chips detected (dev box, or TPU attached via a tunnel
+            # that /dev scanning can't see): leave visibility untouched.
+            return num_workers, [{} for _ in range(num_workers)]
+
+        if os.environ.get(DEPLOYMENT_ENV):
+            # K8s replicas: every replica pod gets the same visible set.
+            assigned = self.assign_chips(num_chips)
+            vis = ",".join(map(str, assigned))
+            return num_workers, [
+                {"TPU_VISIBLE_DEVICES": vis} for _ in range(num_workers)
+            ]
+
+        worker_env = []
+        for _ in range(num_workers):
+            assigned = self.assign_chips(num_chips)
+            worker_env.append({"TPU_VISIBLE_DEVICES": ",".join(map(str, assigned))})
+        return num_workers, worker_env

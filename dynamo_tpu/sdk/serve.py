@@ -20,6 +20,7 @@ import subprocess
 import sys
 import time
 
+from dynamo_tpu.sdk.allocator import ResourceAllocator
 from dynamo_tpu.sdk.config import ENV_KEY, ServiceConfig
 from dynamo_tpu.sdk.serve_worker import load_class
 from dynamo_tpu.utils import get_logger
@@ -64,6 +65,8 @@ class Supervisor:
         self.children: dict[str, subprocess.Popen] = {}
         self.broker_proc = None
         self._stopping = False
+        self.allocator = ResourceAllocator()
+        self._worker_envs: dict[str, dict[str, str]] = {}
 
     def _env(self) -> dict:
         env = dict(os.environ)
@@ -87,12 +90,16 @@ class Supervisor:
             time.sleep(0.1)
         raise RuntimeError(f"broker failed to start on {self.cplane}")
 
-    def spawn(self, cls, replica: int) -> None:
+    def spawn(self, cls, replica: int, extra_env: dict[str, str] | None = None) -> None:
         spec = class_spec(cls)
         name = f"{cls.__name__}-{replica}"
+        if extra_env is not None:
+            self._worker_envs[name] = extra_env
+        env = self._env()
+        env.update(self._worker_envs.get(name, {}))
         proc = subprocess.Popen(
             [sys.executable, "-m", "dynamo_tpu.sdk.serve_worker", spec],
-            env=self._env(),
+            env=env,
         )
         self.children[name] = proc
         log.info("spawned %s (pid %d)", name, proc.pid)
@@ -103,11 +110,12 @@ class Supervisor:
         log.info("service graph: %s", " -> ".join(c.__name__ for c in graph))
         self.ensure_broker()
         for cls in graph:
-            workers = self.config.get(cls.__name__, {}).get(
-                "workers", cls.__dynamo_service__.workers
+            meta = cls.__dynamo_service__
+            num_workers, worker_envs = self.allocator.get_worker_env(
+                meta, self.config.get(cls.__name__, {})
             )
-            for i in range(workers):
-                self.spawn(cls, i)
+            for i in range(num_workers):
+                self.spawn(cls, i, worker_envs[i])
 
         def on_signal(signum, frame):
             self._stopping = True

@@ -10,7 +10,6 @@ streaming composition without any model.
 
 from __future__ import annotations
 
-import asyncio
 
 from aiohttp import web
 

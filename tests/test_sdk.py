@@ -141,3 +141,58 @@ def test_build_mesh_axes():
     assert mesh.devices.shape == (2, 1, 2, 2)
     with pytest.raises(ValueError):
         build_mesh(MeshConfig(tp=16))
+
+
+def test_resource_allocator_whole_chips():
+    from dynamo_tpu.sdk.allocator import ResourceAllocator
+    from dynamo_tpu.sdk.decorators import ServiceMeta
+
+    alloc = ResourceAllocator(total_chips=4)
+    meta = ServiceMeta(workers=2, resources={"tpu": 1})
+    n, envs = alloc.get_worker_env(meta, {})
+    assert n == 2
+    assert envs[0]["TPU_VISIBLE_DEVICES"] == "0"
+    assert envs[1]["TPU_VISIBLE_DEVICES"] == "1"
+    # a second service gets the remaining chips, disjoint from the first
+    n, envs = alloc.get_worker_env(ServiceMeta(workers=1, resources={"tpu": 2}), {})
+    assert envs[0]["TPU_VISIBLE_DEVICES"] == "2,3"
+
+
+def test_resource_allocator_fractional_shares_chip():
+    from dynamo_tpu.sdk.allocator import ResourceAllocator
+    from dynamo_tpu.sdk.decorators import ServiceMeta
+
+    alloc = ResourceAllocator(total_chips=2)
+    meta = ServiceMeta(workers=2, resources={"tpu": 0.5})
+    _, envs = alloc.get_worker_env(meta, {})
+    # both half-chip workers co-locate on chip 0
+    assert envs[0]["TPU_VISIBLE_DEVICES"] == envs[1]["TPU_VISIBLE_DEVICES"] == "0"
+
+
+def test_resource_allocator_cpu_service_pinned_off_tpu():
+    from dynamo_tpu.sdk.allocator import ResourceAllocator
+    from dynamo_tpu.sdk.decorators import ServiceMeta
+
+    alloc = ResourceAllocator(total_chips=4)
+    _, envs = alloc.get_worker_env(ServiceMeta(workers=1), {})
+    assert envs[0] == {"JAX_PLATFORMS": "cpu"}
+    # YAML config overrides meta resources/workers
+    n, envs = alloc.get_worker_env(
+        ServiceMeta(workers=1), {"workers": 3, "resources": {"tpu": 1}}
+    )
+    assert n == 3
+    assert len({e["TPU_VISIBLE_DEVICES"] for e in envs}) == 3
+
+
+def test_resource_allocator_overcommit_warns():
+    import warnings as _w
+
+    from dynamo_tpu.sdk.allocator import ResourceAllocator
+    from dynamo_tpu.sdk.decorators import ServiceMeta
+
+    alloc = ResourceAllocator(total_chips=1)
+    with _w.catch_warnings(record=True) as caught:
+        _w.simplefilter("always")
+        _, envs = alloc.get_worker_env(ServiceMeta(workers=2, resources={"tpu": 1}), {})
+    assert any(issubclass(c.category, ResourceWarning) for c in caught)
+    assert len(envs) == 2
