@@ -29,6 +29,7 @@ from dynamo_tpu.llm.protocols.openai import (
     Usage,
 )
 from dynamo_tpu.llm.http.metrics import Metrics
+from dynamo_tpu.llm.tools import ToolCallError, ToolCallingMatcher
 from dynamo_tpu.utils import get_logger
 
 log = get_logger("http")
@@ -184,7 +185,33 @@ class HttpService:
             self.metrics.inc_request(model, endpoint, rtype, "400")
             return self._error(400, str(e))
 
-        chunks = self._generate_chunks(pipeline, pre, kind, model, annotations)
+        tool_matcher = None
+        if kind == "chat" and req.tool_choice not in (None, "none") and not req.tools:
+            self.metrics.inc_request(model, endpoint, rtype, "400")
+            return self._error(400, "tool_choice requires a non-empty tools list")
+        if kind == "chat" and req.tools and req.tool_choice != "none":
+            try:
+                tool_matcher = ToolCallingMatcher(req.tool_choice)
+            except ValueError as e:
+                self.metrics.inc_request(model, endpoint, rtype, "400")
+                return self._error(400, str(e))
+            if tool_matcher.forced_name is not None:
+                known = {
+                    (t.get("function") or {}).get("name")
+                    for t in req.tools
+                    if isinstance(t, dict)
+                }
+                if tool_matcher.forced_name not in known:
+                    self.metrics.inc_request(model, endpoint, rtype, "400")
+                    return self._error(
+                        400,
+                        f"tool_choice function {tool_matcher.forced_name!r} "
+                        "is not in tools",
+                    )
+
+        chunks = self._generate_chunks(
+            pipeline, pre, kind, model, annotations, tool_matcher
+        )
         self.metrics.inflight(model, 1)
         try:
             if req.stream:
@@ -195,6 +222,10 @@ class HttpService:
                 result = await aggregate_completion_stream(chunks)
             self.metrics.inc_request(model, endpoint, rtype, "200")
             return web.json_response(result)
+        except ToolCallError as e:
+            # model output did not satisfy a required/forced tool choice
+            self.metrics.inc_request(model, endpoint, rtype, "422")
+            return self._error(422, str(e))
         except Exception:
             log.exception("request failed")
             self.metrics.inc_request(model, endpoint, rtype, "500")
@@ -204,22 +235,41 @@ class HttpService:
             self.metrics.observe_duration(model, endpoint, time.monotonic() - t0)
 
     async def _generate_chunks(
-        self, pipeline: ModelPipeline, pre, kind: str, model: str, annotations: dict
+        self,
+        pipeline: ModelPipeline,
+        pre,
+        kind: str,
+        model: str,
+        annotations: dict,
+        tool_matcher: Optional[ToolCallingMatcher] = None,
     ) -> AsyncIterator[dict]:
         gen = (
             ChatDeltaGenerator(model) if kind == "chat" else CompletionDeltaGenerator(model)
         )
         usage = Usage(prompt_tokens=len(pre.token_ids))
-        # annotation events surface as comment-style chunks with an `annotation` key
+        # With tools active the full text must be buffered so a tool-call JSON
+        # response never leaks as content deltas (tool calls are matched on
+        # complete messages, llm/tools.py).
+        buffered: list[str] = []
         async for out in pipeline.backend.generate(pre):
             usage.completion_tokens = out.cumulative_tokens
-            if out.finished:
-                if out.text:
-                    yield gen.text_chunk(out.text)
-                yield gen.finish_chunk(out.finish_reason or "stop", usage)
-                return
             if out.text:
-                yield gen.text_chunk(out.text)
+                if tool_matcher is not None:
+                    buffered.append(out.text)
+                else:
+                    yield gen.text_chunk(out.text)
+            if out.finished:
+                finish = out.finish_reason or "stop"
+                if tool_matcher is not None:
+                    text = "".join(buffered)
+                    calls = tool_matcher.get_calls(text)
+                    if calls:
+                        yield gen.tool_calls_chunk(calls)
+                        finish = "tool_calls"
+                    elif text:
+                        yield gen.text_chunk(text)
+                yield gen.finish_chunk(finish, usage)
+                return
 
     async def _stream_response(
         self, request: web.Request, chunks: AsyncIterator[dict], model: str, endpoint: str, t0: float
@@ -241,6 +291,10 @@ class HttpService:
         except (asyncio.CancelledError, ConnectionResetError):
             status = "499"
             raise
+        except ToolCallError as e:
+            status = "422"
+            err = json.dumps({"error": {"message": str(e), "type": "tool_call_error"}})
+            await resp.write(f"data: {err}\n\ndata: [DONE]\n\n".encode())
         except Exception:
             log.exception("stream failed")
             status = "500"

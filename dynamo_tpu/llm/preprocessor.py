@@ -88,9 +88,17 @@ class OpenAIPreprocessor:
     # ---------------- API ----------------
 
     def preprocess_chat(self, req: ChatCompletionRequest) -> tuple[PreprocessedRequest, dict]:
-        prompt = self.tokenizer.apply_chat_template(
-            [m.to_dict() for m in req.messages], add_generation_prompt=True
-        )
+        # tools render into the chat template unless tool_choice forbids them
+        # (reference: preprocessor/tools/request.rs ToolChoice::None)
+        tools = req.tools if req.tools and req.tool_choice != "none" else None
+        messages = [m.to_dict() for m in req.messages]
+        if tools is None:
+            # keep the no-tools call signature-compatible with bare tokenizers
+            prompt = self.tokenizer.apply_chat_template(messages, add_generation_prompt=True)
+        else:
+            prompt = self.tokenizer.apply_chat_template(
+                messages, add_generation_prompt=True, tools=tools
+            )
         token_ids = self.tokenizer.encode(prompt)
         return self._build(req, prompt, token_ids)
 

@@ -25,6 +25,7 @@ async def aggregate_chat_stream(chunks: AsyncIterator[dict]) -> dict:
     """Fold chat.completion.chunk dicts into one chat.completion response."""
     out: Optional[dict] = None
     content: list[str] = []
+    tool_calls: list[dict] = []
     role = "assistant"
     finish_reason = None
     usage = None
@@ -37,18 +38,21 @@ async def aggregate_chat_stream(chunks: AsyncIterator[dict]) -> dict:
                 role = delta["role"]
             if delta.get("content"):
                 content.append(delta["content"])
+            for call in delta.get("tool_calls") or []:
+                tool_calls.append({k: v for k, v in call.items() if k != "index"})
             if choice.get("finish_reason"):
                 finish_reason = choice["finish_reason"]
         if chunk.get("usage"):
             usage = chunk["usage"]
     if out is None:
         raise ValueError("empty stream")
+    message: dict = {"role": role, "content": "".join(content)}
+    if tool_calls:
+        message["tool_calls"] = tool_calls
+        if not message["content"]:
+            message["content"] = None
     out["choices"] = [
-        {
-            "index": 0,
-            "message": {"role": role, "content": "".join(content)},
-            "finish_reason": finish_reason,
-        }
+        {"index": 0, "message": message, "finish_reason": finish_reason}
     ]
     if usage:
         out["usage"] = usage

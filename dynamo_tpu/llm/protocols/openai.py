@@ -24,17 +24,31 @@ class ChatMessage:
     role: str
     content: str | list | None = None
     name: Optional[str] = None
+    # multi-turn tool use: assistant turns carry tool_calls, tool-result
+    # turns (role "tool") carry the tool_call_id they answer
+    tool_calls: Optional[list] = None
+    tool_call_id: Optional[str] = None
 
     @classmethod
     def from_dict(cls, d: dict) -> "ChatMessage":
         if not isinstance(d, dict) or "role" not in d:
             raise ProtocolError("message must be an object with a 'role'")
-        return cls(role=d["role"], content=d.get("content"), name=d.get("name"))
+        return cls(
+            role=d["role"],
+            content=d.get("content"),
+            name=d.get("name"),
+            tool_calls=d.get("tool_calls"),
+            tool_call_id=d.get("tool_call_id"),
+        )
 
     def to_dict(self) -> dict:
         out = {"role": self.role, "content": self.content}
         if self.name:
             out["name"] = self.name
+        if self.tool_calls:
+            out["tool_calls"] = self.tool_calls
+        if self.tool_call_id:
+            out["tool_call_id"] = self.tool_call_id
         return out
 
 
@@ -109,6 +123,7 @@ class ChatCompletionRequest:
     user: Optional[str] = None
     ext: Ext = field(default_factory=Ext)
     tools: Optional[list] = None
+    tool_choice: Any = None  # None|"none"|"auto"|"required"|{"type":"function",...}
 
     @classmethod
     def from_dict(cls, d: dict) -> "ChatCompletionRequest":
@@ -118,7 +133,12 @@ class ChatCompletionRequest:
         common = _common_fields(d)
         if common["n"] != 1:
             raise ProtocolError("n > 1 is not supported")
-        return cls(messages=[ChatMessage.from_dict(m) for m in msgs], tools=d.get("tools"), **common)
+        return cls(
+            messages=[ChatMessage.from_dict(m) for m in msgs],
+            tools=d.get("tools"),
+            tool_choice=d.get("tool_choice"),
+            **common,
+        )
 
 
 @dataclass
@@ -199,6 +219,15 @@ class ChatDeltaGenerator:
 
     def text_chunk(self, text: str) -> dict:
         delta: dict = {"content": text}
+        if not self._sent_role:
+            delta["role"] = "assistant"
+            self._sent_role = True
+        return self._chunk(delta)
+
+    def tool_calls_chunk(self, tool_calls: list[dict]) -> dict:
+        delta: dict = {
+            "tool_calls": [dict(c, index=i) for i, c in enumerate(tool_calls)]
+        }
         if not self._sent_role:
             delta["role"] = "assistant"
             self._sent_role = True

@@ -25,7 +25,12 @@ class Tokenizer(Protocol):
 
     def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str: ...
 
-    def apply_chat_template(self, messages: list[dict], add_generation_prompt: bool = True) -> str: ...
+    def apply_chat_template(
+        self,
+        messages: list[dict],
+        add_generation_prompt: bool = True,
+        tools: Optional[list] = None,
+    ) -> str: ...
 
 
 class ByteTokenizer:
@@ -45,8 +50,17 @@ class ByteTokenizer:
         data = bytes(i for i in ids if i < 256)
         return data.decode("utf-8", errors="replace")
 
-    def apply_chat_template(self, messages: list[dict], add_generation_prompt: bool = True) -> str:
+    def apply_chat_template(
+        self,
+        messages: list[dict],
+        add_generation_prompt: bool = True,
+        tools: Optional[list] = None,
+    ) -> str:
         parts = [f"<{m['role']}>{m.get('content') or ''}</{m['role']}>" for m in messages]
+        if tools:
+            import json as _json
+
+            parts.insert(0, f"<tools>{_json.dumps(tools, separators=(',', ':'))}</tools>")
         if add_generation_prompt:
             parts.append("<assistant>")
         return "\n".join(parts)
@@ -72,9 +86,19 @@ class HfTokenizer:
     def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
         return self._tok.decode(ids, skip_special_tokens=skip_special_tokens)
 
-    def apply_chat_template(self, messages: list[dict], add_generation_prompt: bool = True) -> str:
+    def apply_chat_template(
+        self,
+        messages: list[dict],
+        add_generation_prompt: bool = True,
+        tools: Optional[list] = None,
+    ) -> str:
+        # only forward tools when present: older transformers lack the kwarg
+        kwargs = {"tools": tools} if tools is not None else {}
         return self._tok.apply_chat_template(
-            messages, tokenize=False, add_generation_prompt=add_generation_prompt
+            messages,
+            tokenize=False,
+            add_generation_prompt=add_generation_prompt,
+            **kwargs,
         )
 
 

@@ -1,0 +1,106 @@
+"""Tool (function) calling: request-side choice parsing + response matching.
+
+reference: lib/llm/src/preprocessor/tools.rs (ToolCallingMatcher.get_call,
+CalledFunctionParameters/CalledFunctionArguments forms) and
+preprocessor/tools/request.rs (ToolChoice none | auto | forced tool).
+
+The matcher parses a completed model response as JSON in any of four shapes —
+``{"name", "parameters"}``, ``{"name", "arguments"}``, or a list of either —
+and normalizes to OpenAI ``tool_calls`` entries. Parsing happens on the full
+generated text (the reference does the same: tool calls are matched on
+complete messages, not streamed argument fragments).
+"""
+
+from __future__ import annotations
+
+import json
+import uuid
+from typing import Any, Optional
+
+TOOL_CHOICE_NONE = "none"
+TOOL_CHOICE_AUTO = "auto"
+TOOL_CHOICE_REQUIRED = "required"
+
+
+class ToolCallError(ValueError):
+    """Raised when a forced tool choice produced no parseable call."""
+
+
+def parse_tool_choice(raw: Any) -> tuple[str, Optional[str]]:
+    """Normalize an OpenAI ``tool_choice`` value.
+
+    Returns (mode, forced_name): mode is none|auto|required; forced_name is
+    set when a specific function was requested (mode becomes ``required``).
+    """
+    if raw is None or raw == TOOL_CHOICE_AUTO:
+        return TOOL_CHOICE_AUTO, None
+    if raw == TOOL_CHOICE_NONE:
+        return TOOL_CHOICE_NONE, None
+    if raw == TOOL_CHOICE_REQUIRED:
+        return TOOL_CHOICE_REQUIRED, None
+    if isinstance(raw, dict):
+        name = (raw.get("function") or {}).get("name")
+        if raw.get("type") == "function" and name:
+            return TOOL_CHOICE_REQUIRED, name
+    raise ValueError(f"invalid tool_choice: {raw!r}")
+
+
+def _normalize_one(obj: Any) -> Optional[dict]:
+    """{"name", "parameters"|"arguments"} -> tool_calls entry, else None."""
+    if not isinstance(obj, dict) or not isinstance(obj.get("name"), str):
+        return None
+    args = obj.get("parameters") if "parameters" in obj else obj.get("arguments")
+    if not isinstance(args, dict):
+        return None
+    return {
+        "id": f"call-{uuid.uuid4()}",
+        "type": "function",
+        "function": {
+            "name": obj["name"],
+            "arguments": json.dumps(args, separators=(",", ":")),
+        },
+    }
+
+
+class ToolCallingMatcher:
+    """Matches tool-call patterns in completed LLM responses."""
+
+    def __init__(self, tool_choice: Any = TOOL_CHOICE_AUTO):
+        self.mode, self.forced_name = parse_tool_choice(tool_choice)
+
+    def get_calls(self, message: str) -> list[dict]:
+        """Parse ``message`` into tool_calls entries ([] when none match).
+
+        Raises ToolCallError when the choice demanded a call (required /
+        forced tool) but the text is not a tool call.
+        """
+        calls: list[dict] = []
+        if self.mode != TOOL_CHOICE_NONE:
+            text = message.strip()
+            # models frequently wrap the JSON in a markdown fence
+            if text.startswith("```"):
+                text = text.strip("`")
+                if text.startswith("json"):
+                    text = text[4:]
+                text = text.strip()
+            try:
+                parsed = json.loads(text)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                parsed = None
+            if isinstance(parsed, list):
+                normalized = [_normalize_one(o) for o in parsed]
+                if normalized and all(c is not None for c in normalized):
+                    calls = normalized
+            else:
+                one = _normalize_one(parsed)
+                if one is not None:
+                    calls = [one]
+        if self.mode == TOOL_CHOICE_REQUIRED and not calls:
+            raise ToolCallError("tool choice was required but no tools were called")
+        if self.forced_name and all(
+            c["function"]["name"] != self.forced_name for c in calls
+        ):
+            raise ToolCallError(
+                f"tool choice required a call to {self.forced_name!r}"
+            )
+        return calls

@@ -1,0 +1,281 @@
+"""Tool calling: matcher parsing semantics + E2E over the HTTP service.
+
+Mirrors reference lib/llm/src/preprocessor/tools.rs (four accepted JSON
+shapes, forced-choice failure) plus the request-side template rendering.
+"""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.llm.tools import ToolCallError, ToolCallingMatcher, parse_tool_choice
+
+WEATHER_CALL = {"name": "get_weather", "parameters": {"city": "SF", "unit": "C"}}
+
+
+def test_matcher_single_parameters_form():
+    calls = ToolCallingMatcher("auto").get_calls(json.dumps(WEATHER_CALL))
+    assert len(calls) == 1
+    call = calls[0]
+    assert call["id"].startswith("call-")
+    assert call["type"] == "function"
+    assert call["function"]["name"] == "get_weather"
+    assert json.loads(call["function"]["arguments"]) == WEATHER_CALL["parameters"]
+
+
+def test_matcher_arguments_form_and_list():
+    msg = json.dumps([{"name": "a", "arguments": {"x": 1}}, {"name": "b", "arguments": {}}])
+    calls = ToolCallingMatcher("auto").get_calls(msg)
+    assert [c["function"]["name"] for c in calls] == ["a", "b"]
+
+
+def test_matcher_plain_text_is_not_a_call():
+    assert ToolCallingMatcher("auto").get_calls("hello there") == []
+    # JSON that is not a call shape
+    assert ToolCallingMatcher("auto").get_calls('{"foo": 1}') == []
+
+
+def test_matcher_none_choice_disables():
+    assert ToolCallingMatcher("none").get_calls(json.dumps(WEATHER_CALL)) == []
+
+
+def test_matcher_markdown_fenced_json():
+    msg = "```json\n" + json.dumps(WEATHER_CALL) + "\n```"
+    calls = ToolCallingMatcher("auto").get_calls(msg)
+    assert calls and calls[0]["function"]["name"] == "get_weather"
+
+
+def test_matcher_forced_choice_errors():
+    forced = {"type": "function", "function": {"name": "get_weather"}}
+    with pytest.raises(ToolCallError):
+        ToolCallingMatcher(forced).get_calls("no call here")
+    with pytest.raises(ToolCallError):
+        ToolCallingMatcher(forced).get_calls(json.dumps({"name": "other", "parameters": {}}))
+    calls = ToolCallingMatcher(forced).get_calls(json.dumps(WEATHER_CALL))
+    assert calls[0]["function"]["name"] == "get_weather"
+    with pytest.raises(ToolCallError):
+        ToolCallingMatcher("required").get_calls("just text")
+
+
+def test_parse_tool_choice_forms():
+    assert parse_tool_choice(None) == ("auto", None)
+    assert parse_tool_choice("auto") == ("auto", None)
+    assert parse_tool_choice("none") == ("none", None)
+    assert parse_tool_choice("required") == ("required", None)
+    assert parse_tool_choice({"type": "function", "function": {"name": "f"}}) == (
+        "required",
+        "f",
+    )
+    with pytest.raises(ValueError):
+        parse_tool_choice({"type": "function"})
+
+
+def test_preprocessor_renders_tools_into_template():
+    from dynamo_tpu.frontends.pipeline import card_for_model
+    from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.llm.protocols.openai import ChatCompletionRequest
+    from dynamo_tpu.llm.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    pre = OpenAIPreprocessor(tok, "tiny", max_model_len=2048)
+    tools = [{"type": "function", "function": {"name": "get_weather"}}]
+    req = ChatCompletionRequest.from_dict(
+        {
+            "messages": [{"role": "user", "content": "hi"}],
+            "tools": tools,
+            "ext": {"annotations": ["formatted_prompt"]},
+        }
+    )
+    _, annotations = pre.preprocess_chat(req)
+    prompt = annotations["formatted_prompt"]
+    assert "get_weather" in prompt and prompt.startswith("<tools>")
+
+    # tool_choice "none" suppresses tool rendering
+    req.tool_choice = "none"
+    _, annotations = pre.preprocess_chat(req)
+    assert "get_weather" not in annotations["formatted_prompt"]
+
+
+class ScriptedEngine:
+    """Emits a fixed utf-8 text as byte tokens (ByteTokenizer ids)."""
+
+    def __init__(self, text: str):
+        self.token_ids = list(text.encode("utf-8"))
+
+    async def generate(self, request):
+        from dynamo_tpu.engine.scheduler import StepOutput
+
+        for i, tok in enumerate(self.token_ids):
+            yield StepOutput(
+                request_id=request.request_id,
+                token=tok,
+                finished=i == len(self.token_ids) - 1,
+                finish_reason="stop" if i == len(self.token_ids) - 1 else None,
+            )
+
+    async def shutdown(self):
+        return None
+
+    def metrics(self):
+        from dynamo_tpu.engine.engine import ForwardPassMetrics
+
+        return ForwardPassMetrics()
+
+
+@pytest.fixture(scope="module")
+def tool_server():
+    from dynamo_tpu.frontends.pipeline import build_pipeline, card_for_model
+    from dynamo_tpu.llm.http.service import HttpService
+
+    loop = asyncio.new_event_loop()
+
+    async def boot():
+        service = HttpService(host="127.0.0.1", port=0)
+        card = card_for_model("tiny", max_model_len=2048)
+        card.display_name = "caller"
+        service.manager.add(build_pipeline(ScriptedEngine(json.dumps(WEATHER_CALL)), card))
+        plain = card_for_model("tiny", max_model_len=2048)
+        plain.display_name = "talker"
+        service.manager.add(build_pipeline(ScriptedEngine("plain words"), plain))
+        port = await service.start()
+        return service, f"http://127.0.0.1:{port}"
+
+    service, url = loop.run_until_complete(boot())
+    yield loop, url
+    loop.run_until_complete(service.stop())
+    loop.close()
+
+
+def _post(loop, url, body):
+    async def go():
+        async with aiohttp.ClientSession() as s:
+            async with s.post(url + "/v1/chat/completions", json=body) as resp:
+                return resp.status, await resp.json()
+
+    return loop.run_until_complete(go())
+
+
+TOOLS = [
+    {
+        "type": "function",
+        "function": {"name": "get_weather", "parameters": {"type": "object"}},
+    }
+]
+
+
+def test_e2e_unary_tool_call(tool_server):
+    loop, url = tool_server
+    status, body = _post(
+        loop,
+        url,
+        {
+            "model": "caller",
+            "messages": [{"role": "user", "content": "weather?"}],
+            "tools": TOOLS,
+        },
+    )
+    assert status == 200
+    choice = body["choices"][0]
+    assert choice["finish_reason"] == "tool_calls"
+    assert choice["message"]["content"] is None
+    call = choice["message"]["tool_calls"][0]
+    assert call["function"]["name"] == "get_weather"
+    assert json.loads(call["function"]["arguments"])["city"] == "SF"
+
+
+def test_e2e_stream_tool_call(tool_server):
+    loop, url = tool_server
+
+    async def go():
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                url + "/v1/chat/completions",
+                json={
+                    "model": "caller",
+                    "messages": [{"role": "user", "content": "weather?"}],
+                    "tools": TOOLS,
+                    "stream": True,
+                },
+            ) as resp:
+                assert resp.status == 200
+                chunks = []
+                async for line in resp.content:
+                    line = line.decode().strip()
+                    if line.startswith("data: ") and line != "data: [DONE]":
+                        chunks.append(json.loads(line[6:]))
+                return chunks
+
+    chunks = loop.run_until_complete(go())
+    deltas = [c["choices"][0]["delta"] for c in chunks]
+    # no content deltas leak when the response is a tool call
+    assert not any(d.get("content") for d in deltas)
+    calls = [d for d in deltas if d.get("tool_calls")]
+    assert calls and calls[0]["tool_calls"][0]["function"]["name"] == "get_weather"
+    assert chunks[-1]["choices"][0]["finish_reason"] == "tool_calls"
+
+
+def test_e2e_text_response_with_tools_active(tool_server):
+    loop, url = tool_server
+    status, body = _post(
+        loop,
+        url,
+        {
+            "model": "talker",
+            "messages": [{"role": "user", "content": "hi"}],
+            "tools": TOOLS,
+        },
+    )
+    assert status == 200
+    choice = body["choices"][0]
+    assert choice["finish_reason"] == "stop"
+    assert choice["message"]["content"] == "plain words"
+    assert "tool_calls" not in choice["message"]
+
+
+def test_e2e_tool_choice_without_tools_is_400(tool_server):
+    loop, url = tool_server
+    status, body = _post(
+        loop,
+        url,
+        {
+            "model": "talker",
+            "messages": [{"role": "user", "content": "hi"}],
+            "tool_choice": "required",
+        },
+    )
+    assert status == 400
+    assert "tools" in body["error"]["message"]
+
+
+def test_e2e_forced_name_not_in_tools_is_400(tool_server):
+    loop, url = tool_server
+    status, body = _post(
+        loop,
+        url,
+        {
+            "model": "talker",
+            "messages": [{"role": "user", "content": "hi"}],
+            "tools": TOOLS,
+            "tool_choice": {"type": "function", "function": {"name": "unknown_fn"}},
+        },
+    )
+    assert status == 400
+    assert "unknown_fn" in body["error"]["message"]
+
+
+def test_e2e_required_choice_unsatisfied_is_422(tool_server):
+    loop, url = tool_server
+    status, body = _post(
+        loop,
+        url,
+        {
+            "model": "talker",  # emits prose, not a tool call
+            "messages": [{"role": "user", "content": "hi"}],
+            "tools": TOOLS,
+            "tool_choice": "required",
+        },
+    )
+    assert status == 422
+    assert "required" in body["error"]["message"]
