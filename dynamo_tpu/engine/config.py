@@ -23,6 +23,11 @@ class EngineConfig:
     watermark: float = 0.05
     # host-DRAM KV offload tier capacity in blocks (0 = disabled)
     host_cache_blocks: int = 0
+    # decode steps fused into one device call (lax.scan over steps with the
+    # sampled-token feedback kept on device); amortizes dispatch + host<->device
+    # transfer overhead. 1 = classic one-step decode. Streaming granularity and
+    # worst-case wasted decode past EOS both scale with this.
+    decode_steps: int = 8
 
     @property
     def max_pages_per_seq(self) -> int:

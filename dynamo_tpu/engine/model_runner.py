@@ -56,7 +56,9 @@ class ModelRunner:
         self._key = jax.random.key(0)
 
         self._prefill = jax.jit(self._prefill_impl, donate_argnums=(1,))
-        self._decode = jax.jit(self._decode_impl, donate_argnums=(1,))
+        self._decode_multi = jax.jit(
+            self._decode_multi_impl, donate_argnums=(1,), static_argnums=(5,)
+        )
         # block-granularity KV IO for disaggregation / offload
         # (the NIXL-slot replacement, reference: patch nixl.py register_kv_caches)
         self._gather_pages = jax.jit(lambda kv, ids: kv[:, :, ids])
@@ -66,15 +68,53 @@ class ModelRunner:
 
     # ---------------- jitted bodies ----------------
 
-    def _prefill_impl(self, params, kv, tokens, positions, page_table, valid, last_idx, key, temp, top_k, top_p):
-        logits, kv = self.model.prefill(params, kv, tokens, positions, page_table, valid, last_idx)
-        tok = sample_tokens(logits[None, :], key, temp[None], top_k[None], top_p[None])[0]
+    def _prefill_impl(self, params, kv, ints, flts, key):
+        """ints [bucket + max_pages + 3] = token buf, page table, then
+        (start_pos, n_real, top_k); flts [2] = (temperature, top_p). Positions
+        and the valid mask derive on device — one packed H2D per chunk."""
+        mp = self.config.max_pages_per_seq
+        bucket = ints.shape[0] - mp - 3
+        tokens = ints[:bucket]
+        page_table = ints[bucket : bucket + mp]
+        start_pos = ints[bucket + mp]
+        n = ints[bucket + mp + 1]
+        top_k = ints[bucket + mp + 2]
+        positions = start_pos + jnp.arange(bucket, dtype=jnp.int32)
+        valid = jnp.arange(bucket) < n
+        logits, kv = self.model.prefill(params, kv, tokens, positions, page_table, valid, n - 1)
+        tok = sample_tokens(logits[None, :], key, flts[:1], top_k[None], flts[1:])[0]
         return tok, kv
 
-    def _decode_impl(self, params, kv, tokens, positions, page_tables, active, key, temps, top_ks, top_ps):
-        logits, kv = self.model.decode(params, kv, tokens, positions, page_tables, active)
-        toks = sample_tokens(logits, key, temps, top_ks, top_ps)
-        return toks, kv
+    def _decode_multi_impl(self, params, kv, ints, flts, key, num_steps):
+        """num_steps fused decode steps; the sampled-token feedback loop stays
+        on device (one host round-trip per num_steps tokens).
+
+        All small per-slot inputs ride in two packed arrays (one H2D transfer
+        each — per-call transfer latency dominates on tunneled platforms):
+        ``ints`` [5 + max_pages, B] = tokens, positions, limits, active,
+        top_ks, then the transposed page tables; ``flts`` [2, B] = temps,
+        top_ps. Page tables are static across the window — the host
+        pre-allocates pages to cover positions + num_steps - 1 before calling,
+        and a sequence freezes once its fed position would pass ``limits``
+        (no writes past its capacity)."""
+        tokens, positions, limits = ints[0], ints[1], ints[2]
+        active = ints[3].astype(bool)
+        top_ks = ints[4]
+        page_tables = ints[5:].T  # [B, max_pages]
+        temps, top_ps = flts[0], flts[1]
+        keys = jax.random.split(key, num_steps)
+
+        def body(carry, k):
+            kv, tokens, positions, act = carry
+            logits, kv = self.model.decode(params, kv, tokens, positions, page_tables, act)
+            toks = sample_tokens(logits, k, temps, top_ks, top_ps)
+            tokens = jnp.where(act, toks, tokens)
+            positions = positions + act.astype(positions.dtype)
+            act = act & (positions <= limits)
+            return (kv, tokens, positions, act), toks
+
+        (kv, _, _, _), all_toks = jax.lax.scan(body, (kv, tokens, positions, active), keys)
+        return all_toks, kv  # [num_steps, B]
 
     # ---------------- host API (engine thread) ----------------
 
@@ -95,22 +135,20 @@ class ModelRunner:
         """Run one prefill chunk; returns the sampled next token when `sample`."""
         n = len(tokens)
         bucket = self.config.bucket_for(n)
-        buf = np.zeros(bucket, np.int32)
-        buf[:n] = tokens
-        positions = start_pos + np.arange(bucket, dtype=np.int32)
-        valid = np.arange(bucket) < n
+        mp = self.config.max_pages_per_seq
+        ints = np.zeros(bucket + mp + 3, np.int32)
+        ints[:n] = tokens
+        ints[bucket : bucket + mp] = page_table[:mp]
+        ints[bucket + mp] = start_pos
+        ints[bucket + mp + 1] = n
+        ints[bucket + mp + 2] = top_k
+        flts = np.array([temperature, top_p], np.float32)
         tok, self.kv_cache = self._prefill(
             self.params,
             self.kv_cache,
-            jnp.asarray(buf),
-            jnp.asarray(positions),
-            jnp.asarray(page_table),
-            jnp.asarray(valid),
-            jnp.asarray(n - 1, jnp.int32),
+            jnp.asarray(ints),
+            jnp.asarray(flts),
             self._next_key(),
-            jnp.asarray(temperature, jnp.float32),
-            jnp.asarray(top_k, jnp.int32),
-            jnp.asarray(top_p, jnp.float32),
         )
         if sample:
             return int(jax.device_get(tok))
@@ -133,26 +171,34 @@ class ModelRunner:
             jnp.asarray(data, self.kv_cache.dtype),
         )
 
-    def decode_step(
+    def decode_steps(
         self,
         tokens: np.ndarray,  # [B]
         positions: np.ndarray,  # [B]
         page_tables: np.ndarray,  # [B, max_pages_per_seq]
         active: np.ndarray,  # [B] bool
+        limits: np.ndarray,  # [B] max fed-token position per slot
         temps: np.ndarray,
         top_ks: np.ndarray,
         top_ps: np.ndarray,
+        num_steps: int,
     ) -> np.ndarray:
-        toks, self.kv_cache = self._decode(
+        """Fused multi-step decode: returns [num_steps, B] sampled tokens."""
+        B = tokens.shape[0]
+        ints = np.empty((5 + page_tables.shape[1], B), np.int32)
+        ints[0] = tokens
+        ints[1] = positions
+        ints[2] = limits
+        ints[3] = active
+        ints[4] = top_ks
+        ints[5:] = page_tables.T
+        flts = np.stack([temps, top_ps]).astype(np.float32)
+        toks, self.kv_cache = self._decode_multi(
             self.params,
             self.kv_cache,
-            jnp.asarray(tokens),
-            jnp.asarray(positions),
-            jnp.asarray(page_tables),
-            jnp.asarray(active),
+            jnp.asarray(ints),
+            jnp.asarray(flts),
             self._next_key(),
-            jnp.asarray(temps),
-            jnp.asarray(top_ks),
-            jnp.asarray(top_ps),
+            num_steps,
         )
         return np.asarray(jax.device_get(toks))

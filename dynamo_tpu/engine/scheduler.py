@@ -238,19 +238,32 @@ class Scheduler:
 
     def _decode(self) -> list[StepOutput]:
         outputs: list[StepOutput] = []
+        K = max(1, self.config.decode_steps)
 
         # Each active sequence feeds its last generated token, whose KV lands at
-        # position seq.pos - 1, so the sequence needs capacity for seq.pos tokens.
+        # position seq.pos - 1; over a window of W fused steps writes reach
+        # seq.pos + W - 2, so capacity for seq.pos + W - 1 tokens must exist up
+        # front — page tables are static inside the fused call. W is clipped to
+        # the request's remaining max_tokens budget (no pages reserved or
+        # device steps spent on tokens that can never be emitted), and under
+        # page pressure with no preemption victim the window shrinks to
+        # whatever fits (limits[] freezes the sequence on device) instead of
+        # failing the request.
         for seq in sorted(
             [s for s in self.slots if s is not None], key=lambda s: s.admitted_order
         ):
             if self.slots[seq.slot] is not seq:
                 continue  # already preempted as a victim this step
+            need = self._window_need(seq, K)
             while self.slots[seq.slot] is seq and not self.allocator.ensure_capacity(
-                seq.req.request_id, seq.pos
+                seq.req.request_id, need
             ):
                 victim = self._pick_victim(exclude=seq)
                 if victim is None:
+                    if need > seq.pos and self.allocator.ensure_capacity(
+                        seq.req.request_id, seq.pos
+                    ):
+                        break  # shorter window; device freezes at capacity
                     outputs.extend(self._finish(seq, "error"))
                     break
                 outputs.extend(self._preempt(victim))
@@ -267,6 +280,7 @@ class Scheduler:
         positions = np.zeros(B, np.int32)
         page_tables = np.zeros((B, self.config.max_pages_per_seq), np.int32)
         active = np.zeros(B, bool)
+        limits = np.zeros(B, np.int32)  # max fed-token position per slot
         temps = np.zeros(B, np.float32)
         top_ks = np.zeros(B, np.int32)
         top_ps = np.ones(B, np.float32)
@@ -279,17 +293,34 @@ class Scheduler:
             positions[i] = seq.pos - 1
             page_tables[i] = seq.page_table
             active[i] = True
+            # freeze at whichever bound is tightest: fused window, model
+            # length, remaining token budget, or actually-allocated capacity
+            cap_tokens = self.allocator._seqs[seq.req.request_id].num_pages * self.config.page_size
+            limits[i] = min(self._window_need(seq, K), cap_tokens) - 1
             temps[i] = seq.req.sampling.temperature
             top_ks[i] = seq.req.sampling.top_k
             top_ps[i] = seq.req.sampling.top_p
 
-        new_tokens = self.runner.decode_step(
-            tokens, positions, page_tables, active, temps, top_ks, top_ps
-        )
+        new_tokens = self.runner.decode_steps(
+            tokens, positions, page_tables, active, limits, temps, top_ks, top_ps, K
+        )  # [K, B]
 
+        # Emit per fused step; a sequence that finishes mid-window ignores the
+        # remaining steps (the device kept decoding — wasted-work bound = K-1).
         for seq in active_seqs:
-            outputs.extend(self._emit_token(seq, int(new_tokens[seq.slot])))
+            for j in range(new_tokens.shape[0]):
+                out = self._emit_token(seq, int(new_tokens[j, seq.slot]))
+                outputs.extend(out)
+                if out and out[-1].finished:
+                    break
         return outputs
+
+    def _window_need(self, seq: RunningSeq, K: int) -> int:
+        """Token capacity a fused K-step window needs for `seq`: write positions
+        run seq.pos - 1 .. seq.pos + W - 2 where W = min(K, remaining budget)."""
+        remaining = max(1, seq.req.sampling.max_tokens - len(seq.generated))
+        window = min(K, remaining)
+        return min(seq.pos + window - 1, self.config.max_model_len)
 
     # ---------------- helpers ----------------
 

@@ -22,10 +22,13 @@ def _base_from_chunk(chunk: dict, object_name: str) -> dict:
 
 
 async def aggregate_chat_stream(chunks: AsyncIterator[dict]) -> dict:
-    """Fold chat.completion.chunk dicts into one chat.completion response."""
+    """Fold chat.completion.chunk dicts into one chat.completion response.
+
+    Tool-call deltas merge by ``index``: OpenAI streams fragment one call
+    across many chunks (id/name arrive once, function.arguments in pieces)."""
     out: Optional[dict] = None
     content: list[str] = []
-    tool_calls: list[dict] = []
+    calls_by_index: dict[int, dict] = {}
     role = "assistant"
     finish_reason = None
     usage = None
@@ -38,8 +41,20 @@ async def aggregate_chat_stream(chunks: AsyncIterator[dict]) -> dict:
                 role = delta["role"]
             if delta.get("content"):
                 content.append(delta["content"])
-            for call in delta.get("tool_calls") or []:
-                tool_calls.append({k: v for k, v in call.items() if k != "index"})
+            for frag in delta.get("tool_calls") or []:
+                idx = frag.get("index", 0)
+                call = calls_by_index.setdefault(
+                    idx, {"id": None, "type": "function", "function": {"name": None, "arguments": ""}}
+                )
+                if frag.get("id"):
+                    call["id"] = frag["id"]
+                if frag.get("type"):
+                    call["type"] = frag["type"]
+                fn = frag.get("function") or {}
+                if fn.get("name"):
+                    call["function"]["name"] = fn["name"]
+                if fn.get("arguments"):
+                    call["function"]["arguments"] += fn["arguments"]
             if choice.get("finish_reason"):
                 finish_reason = choice["finish_reason"]
         if chunk.get("usage"):
@@ -47,8 +62,8 @@ async def aggregate_chat_stream(chunks: AsyncIterator[dict]) -> dict:
     if out is None:
         raise ValueError("empty stream")
     message: dict = {"role": role, "content": "".join(content)}
-    if tool_calls:
-        message["tool_calls"] = tool_calls
+    if calls_by_index:
+        message["tool_calls"] = [calls_by_index[i] for i in sorted(calls_by_index)]
         if not message["content"]:
             message["content"] = None
     out["choices"] = [

@@ -133,6 +133,18 @@ def paged_decode_attention(
     return jax.vmap(one)(q, page_tables, positions)
 
 
+def _on_tpu() -> bool:
+    """True when the default backend drives real TPU hardware. The backend
+    *name* is not always "tpu" (tunneled PJRT plugins register under their own
+    platform name), so check the device kind too."""
+    if jax.default_backend() == "tpu":
+        return True
+    try:
+        return "TPU" in jax.devices()[0].device_kind.upper()
+    except Exception:
+        return False
+
+
 def use_pallas_decode(head_dim: int, num_kv_heads: int) -> bool:
     """Trace-time choice of the Pallas decode kernel.
 
@@ -146,7 +158,7 @@ def use_pallas_decode(head_dim: int, num_kv_heads: int) -> bool:
         return False
     if flag == "1":
         return True
-    return jax.default_backend() == "tpu" and head_dim % 128 == 0
+    return _on_tpu() and head_dim % 128 == 0
 
 
 def dispatch_paged_decode_attention(q, k_pages, v_pages, page_tables, positions):
@@ -154,7 +166,7 @@ def dispatch_paged_decode_attention(q, k_pages, v_pages, page_tables, positions)
     if use_pallas_decode(q.shape[-1], k_pages.shape[2]):
         from dynamo_tpu.ops.pallas.paged_attention import paged_decode_attention_pallas
 
-        interpret = jax.default_backend() != "tpu"
+        interpret = not _on_tpu()
         return paged_decode_attention_pallas(
             q, k_pages, v_pages, page_tables, positions, interpret=interpret
         )

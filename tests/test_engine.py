@@ -166,3 +166,54 @@ def test_oversized_prompt_errors(engine):
     toks, finish, _ = asyncio.run(run())
     assert finish == "error"
     assert toks == []
+
+
+def test_multi_step_matches_single_step():
+    """The fused decode window (decode_steps>1) is token-identical to
+    one-step-at-a-time decode: the sampled-token feedback loop on device must
+    reproduce the host loop exactly (greedy)."""
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+
+    def run_with(k):
+        eng = AsyncJaxEngine(tiny_engine_config(decode_steps=k))
+
+        async def go():
+            await eng.start()
+            req = EngineRequest(
+                request_id=f"k{k}",
+                token_ids=list(prompt),
+                sampling=SamplingParams(temperature=0.0, max_tokens=9),
+            )
+            out = await _collect(eng, req)
+            await eng.shutdown()
+            return out
+
+        return asyncio.run(go())
+
+    toks1, fin1, _ = run_with(1)
+    toks3, fin3, _ = run_with(3)
+    assert fin1 == fin3 == "length"
+    assert toks1 == toks3
+    assert len(toks1) == 9  # 9 tokens through a K=3 window: 3 full windows
+
+
+def test_multi_step_window_freezes_at_max_model_len():
+    """A sequence whose window crosses max_model_len freezes on device (no
+    out-of-capacity KV writes) and finishes with reason=length exactly at the
+    boundary."""
+    eng = AsyncJaxEngine(tiny_engine_config(decode_steps=8, max_model_len=16))
+
+    async def go():
+        await eng.start()
+        req = EngineRequest(
+            request_id="edge",
+            token_ids=[2, 4, 6, 8, 10, 12, 14, 16, 18, 20, 22],  # 11 tokens
+            sampling=SamplingParams(temperature=0.0, max_tokens=50),
+        )
+        out = await _collect(eng, req)
+        await eng.shutdown()
+        return out
+
+    toks, finish, _ = asyncio.run(go())
+    assert finish == "length"
+    assert len(toks) == 16 - 11  # decode to the model-length boundary, not past

@@ -124,6 +124,35 @@ def test_aggregator_chat():
     assert out["usage"]["total_tokens"] == 4
 
 
+def test_aggregator_chat_merges_fragmented_tool_calls():
+    """Spec-conformant streams split one tool call across chunks (id/name once,
+    arguments in pieces, all under the same index) — they must merge."""
+
+    async def chunks():
+        yield {"id": "c1", "created": 1, "model": "m",
+               "choices": [{"index": 0, "delta": {"role": "assistant", "tool_calls": [
+                   {"index": 0, "id": "call_1", "type": "function",
+                    "function": {"name": "get_weather", "arguments": "{\"ci"}}]}}]}
+        yield {"id": "c1", "created": 1, "model": "m",
+               "choices": [{"index": 0, "delta": {"tool_calls": [
+                   {"index": 0, "function": {"arguments": "ty\": \"SF\"}"}}]}}]}
+        yield {"id": "c1", "created": 1, "model": "m",
+               "choices": [{"index": 0, "delta": {"tool_calls": [
+                   {"index": 1, "id": "call_2", "type": "function",
+                    "function": {"name": "get_time", "arguments": "{}"}}]}}]}
+        yield {"id": "c1", "created": 1, "model": "m",
+               "choices": [{"index": 0, "delta": {}, "finish_reason": "tool_calls"}]}
+
+    out = asyncio.run(aggregate_chat_stream(chunks()))
+    calls = out["choices"][0]["message"]["tool_calls"]
+    assert len(calls) == 2
+    assert calls[0] == {"id": "call_1", "type": "function",
+                        "function": {"name": "get_weather", "arguments": "{\"city\": \"SF\"}"}}
+    assert calls[1]["function"]["name"] == "get_time"
+    assert out["choices"][0]["message"]["content"] is None
+    assert out["choices"][0]["finish_reason"] == "tool_calls"
+
+
 def test_protocol_validation():
     with pytest.raises(ProtocolError):
         ChatCompletionRequest.from_dict({"messages": []})
