@@ -58,11 +58,41 @@ def json_model_id() -> str:
     return "tiny:" + json.dumps(cfg)
 
 
+def _probe_pallas() -> None:
+    """Try the Pallas decode kernel on tiny shapes; fall back to the pure-XLA
+    path for the whole bench if it fails on this platform."""
+    import os
+
+    if os.environ.get("DYNTPU_PALLAS") is not None:
+        return
+    try:
+        import jax.numpy as jnp
+        from dynamo_tpu.ops.attention import dispatch_paged_decode_attention, use_pallas_decode
+
+        if not use_pallas_decode(128, 8):
+            return
+        # probe with the bench model's exact head config (16 q / 8 kv, D=128)
+        out = dispatch_paged_decode_attention(
+            jnp.zeros((BATCH, 16, 128), jnp.bfloat16),
+            jnp.zeros((4, 16, 8, 128), jnp.bfloat16),
+            jnp.zeros((4, 16, 8, 128), jnp.bfloat16),
+            jnp.zeros((BATCH, 2), jnp.int32),
+            jnp.zeros(BATCH, jnp.int32),
+        )
+        out.block_until_ready()
+    except Exception as e:  # kernel unsupported here: use the XLA reference path
+        import sys
+
+        print(f"pallas probe failed ({type(e).__name__}); DYNTPU_PALLAS=0", file=sys.stderr, flush=True)
+        os.environ["DYNTPU_PALLAS"] = "0"
+
+
 async def run() -> dict:
     from dynamo_tpu.engine.engine import AsyncJaxEngine
     from dynamo_tpu.engine.sampling import SamplingParams
     from dynamo_tpu.engine.scheduler import EngineRequest
 
+    _probe_pallas()
     engine = AsyncJaxEngine(bench_config())
     await engine.start()
 

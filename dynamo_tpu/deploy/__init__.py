@@ -1,0 +1,22 @@
+"""Deploy plane: typed deployment specs, reconciler-style manifest
+generation, and a REST deployment API server.
+
+Fills the reference's §2.7 slot (K8s operator CRDs + reconcilers in Go,
+reference: deploy/dynamo/operator/api/v1alpha1/, API server at
+deploy/dynamo/api-server/api/) with a Python-native equivalent: the CRD
+types are dataclasses, the reconciler is a pure spec -> manifests function
+(testable without a cluster, like the operator's resource unit tests), and
+the API server stores deployments + revision history behind a pluggable
+store.
+"""
+
+from dynamo_tpu.deploy.crd import DeploymentSpec, ServiceSpec, Autoscaling
+from dynamo_tpu.deploy.reconciler import render_manifests, reconcile
+
+__all__ = [
+    "DeploymentSpec",
+    "ServiceSpec",
+    "Autoscaling",
+    "render_manifests",
+    "reconcile",
+]

@@ -1,0 +1,241 @@
+"""Deployment API server: REST CRUD over deployments with revision history.
+
+The reference ships a Go API server (clusters/deployments/revisions CRUD,
+Postgres-backed, deploys via the operator — reference:
+deploy/dynamo/api-server/api/{routes,controllers,services}/). This is the
+Python-native slot: aiohttp routes over a pluggable store (in-memory or
+file-backed JSON — the fixture-backend pattern of the reference's
+integration suite, reference: api-server/tests/integration/fixtures/
+backendStorage.go), and "deploy" renders the reconciler's manifests instead
+of calling a live cluster.
+
+Routes (all JSON):
+  GET    /healthz
+  GET    /api/v1/clusters                      static single-cluster info
+  GET    /api/v1/deployments                   list
+  POST   /api/v1/deployments                   create (spec in body)
+  GET    /api/v1/deployments/{name}            current spec + revision meta
+  PUT    /api/v1/deployments/{name}            update -> new revision
+  DELETE /api/v1/deployments/{name}
+  GET    /api/v1/deployments/{name}/revisions  history (newest first)
+  POST   /api/v1/deployments/{name}/rollback/{rev}
+  GET    /api/v1/deployments/{name}/manifests  rendered k8s objects
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Optional
+
+from aiohttp import web
+
+from dynamo_tpu.deploy.crd import DeploymentSpec, SpecError
+from dynamo_tpu.deploy.reconciler import render_manifests
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("deploy.api")
+
+
+class DeploymentStore:
+    """In-memory store: name -> list of revision records (oldest first)."""
+
+    def __init__(self):
+        self._data: dict[str, list[dict]] = {}
+
+    def list(self) -> list[str]:
+        return sorted(self._data)
+
+    def revisions(self, name: str) -> list[dict]:
+        return list(self._data.get(name, []))
+
+    def head(self, name: str) -> Optional[dict]:
+        revs = self._data.get(name)
+        return revs[-1] if revs else None
+
+    def put(self, name: str, spec: dict) -> dict:
+        revs = self._data.setdefault(name, [])
+        record = {
+            "revision": (revs[-1]["revision"] + 1) if revs else 1,
+            "created_at": time.time(),
+            "spec": spec,
+        }
+        revs.append(record)
+        self._flush()
+        return record
+
+    def delete(self, name: str) -> bool:
+        existed = name in self._data
+        self._data.pop(name, None)
+        self._flush()
+        return existed
+
+    def _flush(self) -> None:
+        pass
+
+
+class FileDeploymentStore(DeploymentStore):
+    """JSON-file-backed store (the DB slot; swap for a real DB in prod)."""
+
+    def __init__(self, path: str | Path):
+        super().__init__()
+        self._path = Path(path)
+        if self._path.exists():
+            self._data = json.loads(self._path.read_text())
+
+    def _flush(self) -> None:
+        self._path.write_text(json.dumps(self._data))
+
+
+class DeployApiServer:
+    def __init__(self, store: Optional[DeploymentStore] = None):
+        self.store = store or DeploymentStore()
+        self.app = web.Application()
+        self.app.add_routes(
+            [
+                web.get("/healthz", self._health),
+                web.get("/api/v1/clusters", self._clusters),
+                web.get("/api/v1/deployments", self._list),
+                web.post("/api/v1/deployments", self._create),
+                web.get("/api/v1/deployments/{name}", self._get),
+                web.put("/api/v1/deployments/{name}", self._update),
+                web.delete("/api/v1/deployments/{name}", self._delete),
+                web.get("/api/v1/deployments/{name}/revisions", self._revisions),
+                web.post("/api/v1/deployments/{name}/rollback/{rev}", self._rollback),
+                web.get("/api/v1/deployments/{name}/manifests", self._manifests),
+            ]
+        )
+        self._runner: Optional[web.AppRunner] = None
+        self.port: Optional[int] = None
+
+    # ---------------- lifecycle ----------------
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        log.info("deploy api listening on %s:%d", host, self.port)
+        return self.port
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # ---------------- handlers ----------------
+
+    async def _health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def _clusters(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"clusters": [{"name": "default", "accelerator": "tpu", "deployments": len(self.store.list())}]}
+        )
+
+    async def _list(self, request: web.Request) -> web.Response:
+        items = []
+        for name in self.store.list():
+            head = self.store.head(name)
+            items.append({"name": name, "revision": head["revision"], "created_at": head["created_at"]})
+        return web.json_response({"deployments": items})
+
+    async def _parse_spec(self, request: web.Request) -> DeploymentSpec:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError as e:
+            raise web.HTTPBadRequest(text=json.dumps({"error": f"bad json: {e}"}), content_type="application/json")
+        try:
+            return DeploymentSpec.from_dict(body)
+        except SpecError as e:
+            raise web.HTTPUnprocessableEntity(text=json.dumps({"error": str(e)}), content_type="application/json")
+
+    async def _create(self, request: web.Request) -> web.Response:
+        spec = await self._parse_spec(request)
+        if self.store.head(spec.name) is not None:
+            return web.json_response({"error": f"deployment {spec.name} exists"}, status=409)
+        record = self.store.put(spec.name, spec.to_dict())
+        return web.json_response({"name": spec.name, "revision": record["revision"]}, status=201)
+
+    def _head_or_404(self, request: web.Request) -> tuple[str, dict]:
+        name = request.match_info["name"]
+        head = self.store.head(name)
+        if head is None:
+            raise web.HTTPNotFound(text=json.dumps({"error": f"deployment {name} not found"}), content_type="application/json")
+        return name, head
+
+    async def _get(self, request: web.Request) -> web.Response:
+        name, head = self._head_or_404(request)
+        return web.json_response({"name": name, "revision": head["revision"], "spec": head["spec"]})
+
+    async def _update(self, request: web.Request) -> web.Response:
+        name, _ = self._head_or_404(request)
+        spec = await self._parse_spec(request)
+        if spec.name != name:
+            return web.json_response({"error": "spec name must match path"}, status=422)
+        record = self.store.put(name, spec.to_dict())
+        return web.json_response({"name": name, "revision": record["revision"]})
+
+    async def _delete(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        if not self.store.delete(name):
+            raise web.HTTPNotFound(text=json.dumps({"error": f"deployment {name} not found"}), content_type="application/json")
+        return web.json_response({"deleted": name})
+
+    async def _revisions(self, request: web.Request) -> web.Response:
+        name, _ = self._head_or_404(request)
+        revs = [
+            {"revision": r["revision"], "created_at": r["created_at"]}
+            for r in reversed(self.store.revisions(name))
+        ]
+        return web.json_response({"name": name, "revisions": revs})
+
+    async def _rollback(self, request: web.Request) -> web.Response:
+        name, _ = self._head_or_404(request)
+        try:
+            rev = int(request.match_info["rev"])
+        except ValueError:
+            return web.json_response({"error": "revision must be an integer"}, status=422)
+        target = next((r for r in self.store.revisions(name) if r["revision"] == rev), None)
+        if target is None:
+            return web.json_response({"error": f"revision {rev} not found"}, status=404)
+        record = self.store.put(name, target["spec"])
+        return web.json_response({"name": name, "revision": record["revision"], "rolled_back_to": rev})
+
+    async def _manifests(self, request: web.Request) -> web.Response:
+        name, head = self._head_or_404(request)
+        spec = DeploymentSpec.from_dict(head["spec"])
+        return web.json_response({"name": name, "manifests": render_manifests(spec)})
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    import argparse
+    import asyncio
+
+    ap = argparse.ArgumentParser("dynamo-tpu-deploy-api")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8090)
+    ap.add_argument("--store", default=None, help="path to a JSON file store (default: in-memory)")
+    args = ap.parse_args(argv)
+
+    async def run():
+        store = FileDeploymentStore(args.store) if args.store else DeploymentStore()
+        server = DeployApiServer(store)
+        port = await server.start(args.host, args.port)
+        print(json.dumps({"listening": f"{args.host}:{port}"}), flush=True)
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await server.stop()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,132 @@
+"""Deployment CRD types (the DynamoDeployment / DynamoNimDeployment analogue).
+
+reference: deploy/dynamo/operator/api/v1alpha1/ defines DynamoDeployment (a
+graph of services) and DynamoNimDeployment (one component: replicas,
+resources, autoscaling, ingress). Here both collapse into one typed spec: a
+`DeploymentSpec` carries the graph, each `ServiceSpec` a component. TPU
+resources replace GPU counts (`tpu_chips` -> `google.com/tpu` limits).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field, asdict
+from typing import Any, Optional
+
+_NAME_RE = re.compile(r"^[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?$")  # dns-1123 label
+
+
+class SpecError(ValueError):
+    pass
+
+
+@dataclass
+class Autoscaling:
+    """HPA-shaped autoscaling block (reference: DynamoNimDeployment
+    spec.autoscaling)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 1
+    # scale on the frontend's inflight-requests gauge (custom metric) or cpu
+    metric: str = "cpu"
+    target: int = 80
+
+    def validate(self) -> None:
+        if self.min_replicas < 0 or self.max_replicas < self.min_replicas:
+            raise SpecError("autoscaling: need 0 <= min_replicas <= max_replicas")
+        if self.metric not in ("cpu", "inflight_requests"):
+            raise SpecError(f"autoscaling: unknown metric {self.metric!r}")
+
+
+@dataclass
+class ServiceSpec:
+    """One component of the serving graph (frontend/processor/worker/...)."""
+
+    name: str
+    command: list[str] = field(default_factory=list)  # container args
+    replicas: int = 1
+    tpu_chips: int = 0  # google.com/tpu resource limit per pod
+    port: Optional[int] = None  # exposes a Service when set
+    env: dict[str, str] = field(default_factory=dict)
+    config: dict[str, Any] = field(default_factory=dict)  # DYNTPU_SERVICE_CONFIG section
+    autoscaling: Optional[Autoscaling] = None
+    # multihost TPU slice: pods-per-slice; >1 renders a headless service +
+    # per-pod DYNTPU_PROCESS_ID wiring (dynamo_tpu/parallel/mesh.py)
+    hosts_per_slice: int = 1
+
+    def validate(self) -> None:
+        if not _NAME_RE.match(self.name):
+            raise SpecError(f"service name {self.name!r} is not a dns-1123 label")
+        if self.replicas < 0:
+            raise SpecError(f"{self.name}: replicas < 0")
+        if self.tpu_chips < 0:
+            raise SpecError(f"{self.name}: tpu_chips < 0")
+        if self.hosts_per_slice < 1:
+            raise SpecError(f"{self.name}: hosts_per_slice < 1")
+        if self.port is not None and not (0 < self.port < 65536):
+            raise SpecError(f"{self.name}: bad port {self.port}")
+        if self.autoscaling is not None:
+            self.autoscaling.validate()
+
+
+@dataclass
+class DeploymentSpec:
+    """The full graph deployment (DynamoDeployment analogue)."""
+
+    name: str
+    image: str = "dynamo-tpu:latest"
+    namespace: str = "default"
+    services: list[ServiceSpec] = field(default_factory=list)
+    # control-plane broker address injected into every service; "managed"
+    # renders the built-in cplane Deployment too
+    cplane: str = "managed"
+
+    def validate(self) -> None:
+        if not _NAME_RE.match(self.name):
+            raise SpecError(f"deployment name {self.name!r} is not a dns-1123 label")
+        if not self.services:
+            raise SpecError("deployment has no services")
+        seen = set()
+        for svc in self.services:
+            svc.validate()
+            if svc.name in seen:
+                raise SpecError(f"duplicate service {svc.name!r}")
+            seen.add(svc.name)
+
+    # ---------------- (de)serialization ----------------
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DeploymentSpec":
+        try:
+            services = []
+            for s in d.get("services", []):
+                s = dict(s)
+                auto = s.pop("autoscaling", None)
+                svc = ServiceSpec(**s)
+                if auto:
+                    svc.autoscaling = Autoscaling(**auto)
+                services.append(svc)
+            spec = cls(
+                name=d["name"],
+                image=d.get("image", "dynamo-tpu:latest"),
+                namespace=d.get("namespace", "default"),
+                services=services,
+                cplane=d.get("cplane", "managed"),
+            )
+        except (KeyError, TypeError) as e:
+            raise SpecError(f"bad deployment spec: {e}") from e
+        spec.validate()
+        return spec
+
+    @classmethod
+    def from_yaml(cls, path_or_text: str) -> "DeploymentSpec":
+        import yaml
+        from pathlib import Path
+
+        text = path_or_text
+        if "\n" not in path_or_text and Path(path_or_text).exists():
+            text = Path(path_or_text).read_text()
+        return cls.from_dict(yaml.safe_load(text))

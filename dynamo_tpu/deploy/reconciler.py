@@ -1,0 +1,261 @@
+"""Reconciler: DeploymentSpec -> Kubernetes manifests + desired/live diff.
+
+The reference's operator reconciles DynamoNimDeployment CRs into
+Deployments/Services/HPAs (reference:
+deploy/dynamo/operator/internal/controller/dynamonimdeployment_controller.go).
+Here reconciliation is a pure function: `render_manifests` produces the
+desired objects, `reconcile` diffs them against a live snapshot into
+create/update/delete actions — the same semantics, testable with no cluster
+(mirrors the operator's resource unit tests, reference:
+deploy/dynamo/operator/internal/controller_common/resource_test.go).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from dynamo_tpu.deploy.crd import DeploymentSpec, ServiceSpec
+
+CPLANE_PORT = 4222
+MANAGED_BY = "dynamo-tpu-deploy"
+
+
+def _meta(spec: DeploymentSpec, name: str, component: str) -> dict:
+    return {
+        "name": name,
+        "namespace": spec.namespace,
+        "labels": {
+            "app.kubernetes.io/name": name,
+            "app.kubernetes.io/part-of": spec.name,
+            "app.kubernetes.io/managed-by": MANAGED_BY,
+            "dynamo-tpu/component": component,
+        },
+    }
+
+
+def _cplane_address(spec: DeploymentSpec) -> str:
+    if spec.cplane == "managed":
+        return f"{spec.name}-cplane:{CPLANE_PORT}"
+    return spec.cplane
+
+
+def _cplane_manifests(spec: DeploymentSpec) -> list[dict]:
+    name = f"{spec.name}-cplane"
+    meta = _meta(spec, name, "cplane")
+    selector = {"app.kubernetes.io/name": name}
+    return [
+        {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": meta,
+            "spec": {
+                "replicas": 1,
+                "selector": {"matchLabels": selector},
+                "template": {
+                    "metadata": {"labels": dict(meta["labels"])},
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "cplane",
+                                "image": spec.image,
+                                "command": [
+                                    "python", "-m", "dynamo_tpu.cplane.broker",
+                                    "--port", str(CPLANE_PORT),
+                                ],
+                                "ports": [{"containerPort": CPLANE_PORT}],
+                            }
+                        ]
+                    },
+                },
+            },
+        },
+        {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": meta,
+            "spec": {
+                "selector": selector,
+                "ports": [{"port": CPLANE_PORT, "targetPort": CPLANE_PORT}],
+            },
+        },
+    ]
+
+
+def _service_manifests(spec: DeploymentSpec, svc: ServiceSpec) -> list[dict]:
+    name = f"{spec.name}-{svc.name}"
+    meta = _meta(spec, name, svc.name)
+    selector = {"app.kubernetes.io/name": name}
+    env = [{"name": "DYNTPU_CPLANE", "value": _cplane_address(spec)}]
+    if svc.config:
+        env.append(
+            {"name": "DYNTPU_SERVICE_CONFIG", "value": json.dumps({svc.name: svc.config})}
+        )
+    env.extend({"name": k, "value": v} for k, v in sorted(svc.env.items()))
+
+    container: dict[str, Any] = {
+        "name": svc.name,
+        "image": spec.image,
+        "command": list(svc.command),
+        "env": env,
+    }
+    if svc.port is not None:
+        container["ports"] = [{"containerPort": svc.port}]
+    if svc.tpu_chips > 0:
+        container["resources"] = {"limits": {"google.com/tpu": str(svc.tpu_chips)}}
+
+    objs: list[dict] = []
+    if svc.hosts_per_slice > 1:
+        # multihost slice: a StatefulSet gives each host a stable ordinal that
+        # becomes DYNTPU_PROCESS_ID; the headless service is the coordinator
+        # address (pod-0) — see dynamo_tpu/parallel/mesh.py
+        container["env"] = env + [
+            {"name": "DYNTPU_NUM_PROCESSES", "value": str(svc.hosts_per_slice)},
+            {
+                "name": "DYNTPU_COORDINATOR",
+                "value": f"{name}-0.{name}.{spec.namespace}.svc:8476",
+            },
+            {
+                "name": "DYNTPU_PROCESS_ID",
+                "valueFrom": {
+                    "fieldRef": {"fieldPath": "metadata.labels['apps.kubernetes.io/pod-index']"}
+                },
+            },
+        ]
+        objs.append(
+            {
+                "apiVersion": "apps/v1",
+                "kind": "StatefulSet",
+                "metadata": meta,
+                "spec": {
+                    "replicas": svc.hosts_per_slice * max(1, svc.replicas),
+                    "serviceName": name,
+                    "selector": {"matchLabels": selector},
+                    "template": {
+                        "metadata": {"labels": dict(meta["labels"])},
+                        "spec": {"containers": [container]},
+                    },
+                },
+            }
+        )
+        objs.append(
+            {
+                "apiVersion": "v1",
+                "kind": "Service",
+                "metadata": meta,
+                "spec": {"clusterIP": "None", "selector": selector, "ports": [{"port": 8476}]},
+            }
+        )
+        return objs
+
+    objs.append(
+        {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": meta,
+            "spec": {
+                "replicas": svc.replicas,
+                "selector": {"matchLabels": selector},
+                "template": {
+                    "metadata": {"labels": dict(meta["labels"])},
+                    "spec": {"containers": [container]},
+                },
+            },
+        }
+    )
+    if svc.port is not None:
+        objs.append(
+            {
+                "apiVersion": "v1",
+                "kind": "Service",
+                "metadata": meta,
+                "spec": {
+                    "selector": selector,
+                    "ports": [{"port": svc.port, "targetPort": svc.port}],
+                },
+            }
+        )
+    if svc.autoscaling is not None and svc.autoscaling.max_replicas > svc.autoscaling.min_replicas:
+        a = svc.autoscaling
+        if a.metric == "cpu":
+            metrics = [
+                {
+                    "type": "Resource",
+                    "resource": {
+                        "name": "cpu",
+                        "target": {"type": "Utilization", "averageUtilization": a.target},
+                    },
+                }
+            ]
+        else:
+            metrics = [
+                {
+                    "type": "Pods",
+                    "pods": {
+                        "metric": {"name": "llm_http_service_inflight_requests"},
+                        "target": {"type": "AverageValue", "averageValue": str(a.target)},
+                    },
+                }
+            ]
+        objs.append(
+            {
+                "apiVersion": "autoscaling/v2",
+                "kind": "HorizontalPodAutoscaler",
+                "metadata": meta,
+                "spec": {
+                    "scaleTargetRef": {"apiVersion": "apps/v1", "kind": "Deployment", "name": name},
+                    "minReplicas": a.min_replicas,
+                    "maxReplicas": a.max_replicas,
+                    "metrics": metrics,
+                },
+            }
+        )
+    return objs
+
+
+def render_manifests(spec: DeploymentSpec) -> list[dict]:
+    """Desired Kubernetes objects for a deployment spec (deterministic order:
+    cplane infra first, then services in spec order)."""
+    spec.validate()
+    objs: list[dict] = []
+    if spec.cplane == "managed":
+        objs.extend(_cplane_manifests(spec))
+    for svc in spec.services:
+        objs.extend(_service_manifests(spec, svc))
+    return objs
+
+
+def manifests_yaml(spec: DeploymentSpec) -> str:
+    import yaml
+
+    return "\n---\n".join(yaml.safe_dump(o, sort_keys=False) for o in render_manifests(spec))
+
+
+def _key(obj: dict) -> tuple:
+    return (obj["kind"], obj["metadata"]["namespace"], obj["metadata"]["name"])
+
+
+def reconcile(spec: DeploymentSpec, live: list[dict]) -> dict[str, list[dict]]:
+    """Diff desired state against a live snapshot.
+
+    Returns {"create": [...], "update": [...], "delete": [...], "unchanged":
+    [...]}: update = same kind/name but different content; delete = live
+    objects managed by this deployment that the spec no longer wants."""
+    desired = {_key(o): o for o in render_manifests(spec)}
+    live_by_key = {
+        _key(o): o
+        for o in live
+        if o.get("metadata", {}).get("labels", {}).get("app.kubernetes.io/part-of") == spec.name
+    }
+    actions: dict[str, list[dict]] = {"create": [], "update": [], "delete": [], "unchanged": []}
+    for key, obj in desired.items():
+        if key not in live_by_key:
+            actions["create"].append(obj)
+        elif live_by_key[key] != obj:
+            actions["update"].append(obj)
+        else:
+            actions["unchanged"].append(obj)
+    for key, obj in live_by_key.items():
+        if key not in desired:
+            actions["delete"].append(obj)
+    return actions

@@ -305,11 +305,17 @@ class Scheduler:
             tokens, positions, page_tables, active, limits, temps, top_ks, top_ps, K
         )  # [K, B]
 
-        # Emit per fused step; a sequence that finishes mid-window ignores the
-        # remaining steps (the device kept decoding — wasted-work bound = K-1).
+        # Emit per fused step, but never past the slot's device freeze point
+        # (limits): steps j run on device only while positions[i] + j <=
+        # limits[i] — tokens past that are sampled from frozen state with no
+        # KV written behind them and must not reach the client or the
+        # allocator's block hashes. A sequence that finishes mid-window
+        # ignores the remaining steps (wasted-work bound = K-1).
         for seq in active_seqs:
-            for j in range(new_tokens.shape[0]):
-                out = self._emit_token(seq, int(new_tokens[j, seq.slot]))
+            i = seq.slot
+            real_steps = int(limits[i] - positions[i] + 1)
+            for j in range(min(real_steps, new_tokens.shape[0])):
+                out = self._emit_token(seq, int(new_tokens[j, i]))
                 outputs.extend(out)
                 if out and out[-1].finished:
                     break

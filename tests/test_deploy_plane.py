@@ -1,0 +1,222 @@
+"""Deploy plane: CRD validation, reconciler manifests/diff, API server CRUD.
+
+Mirrors the reference's operator resource unit tests and api-server
+integration suite with fixture storage (reference:
+deploy/dynamo/operator/internal/controller_common/resource_test.go,
+deploy/dynamo/api-server/tests/integration/api_test.go).
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from dynamo_tpu.deploy import DeploymentSpec, ServiceSpec, Autoscaling, render_manifests, reconcile
+from dynamo_tpu.deploy.crd import SpecError
+from dynamo_tpu.deploy.api_server import DeployApiServer, FileDeploymentStore
+
+
+def sample_spec(**over) -> DeploymentSpec:
+    d = dict(
+        name="llama-agg",
+        image="dynamo-tpu:v1",
+        services=[
+            ServiceSpec(
+                name="frontend",
+                command=["python", "-m", "dynamo_tpu.components.frontend"],
+                port=8080,
+                autoscaling=Autoscaling(min_replicas=1, max_replicas=4, metric="inflight_requests", target=32),
+            ),
+            ServiceSpec(
+                name="worker",
+                command=["python", "-m", "dynamo_tpu.launch.run", "run", "/models/llama", "--out", "jax"],
+                tpu_chips=4,
+                config={"tp": 4, "num_pages": 4096},
+            ),
+        ],
+    )
+    d.update(over)
+    return DeploymentSpec(**d)
+
+
+# ---------------- CRD ----------------
+
+
+def test_spec_roundtrip_and_validation():
+    spec = sample_spec()
+    spec.validate()
+    again = DeploymentSpec.from_dict(spec.to_dict())
+    assert again == spec
+
+    with pytest.raises(SpecError):
+        DeploymentSpec(name="Bad_Name", services=[ServiceSpec(name="x")]).validate()
+    with pytest.raises(SpecError):
+        DeploymentSpec(name="ok", services=[]).validate()
+    with pytest.raises(SpecError):
+        DeploymentSpec(
+            name="ok", services=[ServiceSpec(name="a"), ServiceSpec(name="a")]
+        ).validate()
+    with pytest.raises(SpecError):
+        ServiceSpec(name="w", autoscaling=Autoscaling(min_replicas=3, max_replicas=1)).validate()
+
+
+def test_spec_from_yaml():
+    yaml_text = """
+name: demo
+image: dynamo-tpu:v2
+services:
+  - name: frontend
+    port: 8080
+    command: [python, -m, dynamo_tpu.components.frontend]
+  - name: worker
+    tpu_chips: 8
+    hosts_per_slice: 2
+"""
+    spec = DeploymentSpec.from_yaml(yaml_text)
+    assert spec.image == "dynamo-tpu:v2"
+    assert spec.services[1].hosts_per_slice == 2
+
+
+# ---------------- reconciler ----------------
+
+
+def test_render_manifests_shapes():
+    objs = render_manifests(sample_spec())
+    kinds = [(o["kind"], o["metadata"]["name"]) for o in objs]
+    # managed cplane (Deployment+Service), frontend (Deployment+Service+HPA), worker (Deployment)
+    assert ("Deployment", "llama-agg-cplane") in kinds
+    assert ("Service", "llama-agg-cplane") in kinds
+    assert ("Deployment", "llama-agg-frontend") in kinds
+    assert ("Service", "llama-agg-frontend") in kinds
+    assert ("HorizontalPodAutoscaler", "llama-agg-frontend") in kinds
+    assert ("Deployment", "llama-agg-worker") in kinds
+
+    worker = next(o for o in objs if o["metadata"]["name"] == "llama-agg-worker")
+    ctr = worker["spec"]["template"]["spec"]["containers"][0]
+    assert ctr["resources"]["limits"]["google.com/tpu"] == "4"
+    env = {e["name"]: e.get("value") for e in ctr["env"]}
+    assert env["DYNTPU_CPLANE"] == "llama-agg-cplane:4222"
+    assert json.loads(env["DYNTPU_SERVICE_CONFIG"]) == {"worker": {"tp": 4, "num_pages": 4096}}
+
+    hpa = next(o for o in objs if o["kind"] == "HorizontalPodAutoscaler")
+    assert hpa["spec"]["metrics"][0]["pods"]["metric"]["name"] == "llm_http_service_inflight_requests"
+
+
+def test_render_external_cplane_skips_managed_broker():
+    spec = sample_spec(cplane="nats.infra:4222")
+    objs = render_manifests(spec)
+    assert not any("cplane" in o["metadata"]["name"] for o in objs)
+    worker = next(o for o in objs if o["metadata"]["name"] == "llama-agg-worker")
+    env = {e["name"]: e.get("value") for e in worker["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["DYNTPU_CPLANE"] == "nats.infra:4222"
+
+
+def test_render_multihost_statefulset():
+    spec = DeploymentSpec(
+        name="mh",
+        services=[ServiceSpec(name="worker", tpu_chips=4, hosts_per_slice=2, replicas=3)],
+    )
+    objs = render_manifests(spec)
+    sts = next(o for o in objs if o["kind"] == "StatefulSet")
+    assert sts["spec"]["replicas"] == 6  # hosts_per_slice * replicas
+    env = {e["name"]: e for e in sts["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["DYNTPU_NUM_PROCESSES"]["value"] == "2"
+    assert "DYNTPU_COORDINATOR" in env and "DYNTPU_PROCESS_ID" in env
+    headless = next(o for o in objs if o["kind"] == "Service" and o["metadata"]["name"] == "mh-worker")
+    assert headless["spec"]["clusterIP"] == "None"
+
+
+def test_reconcile_diff():
+    spec = sample_spec()
+    desired = render_manifests(spec)
+
+    # empty cluster: everything is created
+    actions = reconcile(spec, live=[])
+    assert len(actions["create"]) == len(desired)
+    assert not actions["update"] and not actions["delete"]
+
+    # live == desired: no-op
+    actions = reconcile(spec, live=[json.loads(json.dumps(o)) for o in desired])
+    assert not actions["create"] and not actions["update"] and not actions["delete"]
+    assert len(actions["unchanged"]) == len(desired)
+
+    # scale change -> update; dropped service -> delete; foreign objects ignored
+    spec2 = sample_spec()
+    spec2.services[0].replicas = 3
+    spec2.services = spec2.services[:1]
+    foreign = {"kind": "Deployment", "metadata": {"name": "other", "namespace": "default", "labels": {}}}
+    actions = reconcile(spec2, live=desired + [foreign])
+    updated = {o["metadata"]["name"] for o in actions["update"]}
+    deleted = {o["metadata"]["name"] for o in actions["delete"]}
+    assert "llama-agg-frontend" in updated
+    assert "llama-agg-worker" in deleted
+    assert "other" not in deleted
+
+
+# ---------------- API server ----------------
+
+
+async def _json(client_fn, method, url, body=None):
+    import aiohttp
+
+    async with aiohttp.ClientSession() as s:
+        async with s.request(method, url, json=body) as resp:
+            return resp.status, await resp.json()
+
+
+def test_api_server_crud(tmp_path):
+    async def run():
+        server = DeployApiServer(FileDeploymentStore(tmp_path / "db.json"))
+        port = await server.start()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            spec = sample_spec().to_dict()
+
+            status, body = await _json(None, "POST", f"{base}/api/v1/deployments", spec)
+            assert (status, body["revision"]) == (201, 1)
+
+            status, _ = await _json(None, "POST", f"{base}/api/v1/deployments", spec)
+            assert status == 409  # duplicate
+
+            status, body = await _json(None, "GET", f"{base}/api/v1/deployments/llama-agg")
+            assert status == 200 and body["spec"]["image"] == "dynamo-tpu:v1"
+
+            spec["image"] = "dynamo-tpu:v2"
+            status, body = await _json(None, "PUT", f"{base}/api/v1/deployments/llama-agg", spec)
+            assert (status, body["revision"]) == (200, 2)
+
+            status, body = await _json(None, "GET", f"{base}/api/v1/deployments/llama-agg/revisions")
+            assert [r["revision"] for r in body["revisions"]] == [2, 1]
+
+            status, body = await _json(
+                None, "POST", f"{base}/api/v1/deployments/llama-agg/rollback/1"
+            )
+            assert (status, body["revision"], body["rolled_back_to"]) == (200, 3, 1)
+            status, body = await _json(None, "GET", f"{base}/api/v1/deployments/llama-agg")
+            assert body["spec"]["image"] == "dynamo-tpu:v1"
+
+            status, body = await _json(None, "GET", f"{base}/api/v1/deployments/llama-agg/manifests")
+            assert status == 200 and any(m["kind"] == "Deployment" for m in body["manifests"])
+
+            # invalid spec -> 422
+            status, _ = await _json(None, "POST", f"{base}/api/v1/deployments", {"name": "x"})
+            assert status == 422
+
+            status, body = await _json(None, "DELETE", f"{base}/api/v1/deployments/llama-agg")
+            assert status == 200
+            status, _ = await _json(None, "GET", f"{base}/api/v1/deployments/llama-agg")
+            assert status == 404
+        finally:
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_file_store_persists(tmp_path):
+    path = tmp_path / "db.json"
+    store = FileDeploymentStore(path)
+    store.put("a", {"name": "a"})
+    store.put("a", {"name": "a", "v": 2})
+    store2 = FileDeploymentStore(path)
+    assert store2.head("a")["revision"] == 2
+    assert [r["revision"] for r in store2.revisions("a")] == [1, 2]

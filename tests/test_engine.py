@@ -217,3 +217,41 @@ def test_multi_step_window_freezes_at_max_model_len():
     toks, finish, _ = asyncio.run(go())
     assert finish == "length"
     assert len(toks) == 16 - 11  # decode to the model-length boundary, not past
+
+
+def test_capacity_freeze_no_phantom_tokens():
+    """Under page exhaustion with no preemption victim the window shrinks and
+    the device freezes the slot; emitted tokens must still match the K=1
+    schedule exactly — no phantom tokens sampled from frozen state."""
+
+    def run_with(k):
+        # 1 slot, 16 usable pages * page_size 4 = 64 token capacity but
+        # max_model_len 128: the sequence exhausts physical pages mid-decode
+        # with no preemption victim, forcing the shrunk-window fallback and an
+        # eventual OOM finish — both schedules must agree token-for-token.
+        eng = AsyncJaxEngine(
+            tiny_engine_config(
+                decode_steps=k, max_seqs=1, num_pages=17, max_model_len=128, watermark=0.0
+            )
+        )
+
+        async def go():
+            await eng.start()
+            req = EngineRequest(
+                request_id=f"cap{k}",
+                token_ids=[9, 8, 7, 6, 5, 4],
+                sampling=SamplingParams(temperature=0.0, max_tokens=1000, ignore_eos=True),
+            )
+            out = await _collect(eng, req)
+            await eng.shutdown()
+            return out
+
+        return asyncio.run(go())
+
+    toks1, fin1, _ = run_with(1)
+    toks8, fin8, _ = run_with(8)
+    assert toks8 == toks1
+    assert fin8 == fin1 == "error"  # true OOM, past the shrunk-window fallback
+    # 58 fed decode tokens (KV positions 6..63) + the prefill-sampled first
+    # token = 59: decoded exactly to physical capacity, never past it
+    assert len(toks1) == 64 - 6 + 1
