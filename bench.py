@@ -40,6 +40,8 @@ def bench_config():
         max_model_len=1024,
         prefill_buckets=(128, 256, 512),
         tp=1,
+        decode_steps=16,
+        pipeline_depth=3,
     )
 
 

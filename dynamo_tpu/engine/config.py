@@ -28,6 +28,10 @@ class EngineConfig:
     # transfer overhead. 1 = classic one-step decode. Streaming granularity and
     # worst-case wasted decode past EOS both scale with this.
     decode_steps: int = 8
+    # decode windows dispatched ahead of result materialization (dispatch-ahead
+    # pipelining; the token feedback lives on device so window N+1 never waits
+    # for window N's tokens to reach the host). 1 = fully synchronous.
+    pipeline_depth: int = 3
 
     @property
     def max_pages_per_seq(self) -> int:

@@ -1,5 +1,6 @@
 """ModelRunner: owns the device mesh, sharded params, the donated paged KV
-cache, and the jitted prefill/decode+sample step functions.
+cache, the device-resident token-feedback buffer, and the jitted
+prefill/decode+sample step functions.
 
 TPU execution notes:
   - prefill chunks are padded to config.prefill_buckets so jit caches one
@@ -7,6 +8,13 @@ TPU execution notes:
   - the KV cache is donated on every step — XLA aliases it in place
   - sampling is fused into the step so only the sampled token ids (a few bytes)
     cross back to host per step
+  - the last sampled token per slot lives in a donated device buffer
+    (``tokens_dev``): a sampling prefill writes its slot's first token there,
+    and decode windows read/update it on device. The host therefore never has
+    to sync on a window's results before dispatching the next one — the
+    scheduler runs windows dispatch-ahead and reconciles token results as they
+    arrive (hides dispatch/transfer latency entirely; on tunneled PJRT
+    platforms that latency is ~100 ms per round trip)
 """
 
 from __future__ import annotations
@@ -54,53 +62,81 @@ class ModelRunner:
         )
         self._replicated = NamedSharding(mesh, P())
         self._key = jax.random.key(0)
+        # device-resident last-token-per-slot feedback buffer
+        self.tokens_dev = jnp.zeros(config.max_seqs, jnp.int32)
 
-        self._prefill = jax.jit(self._prefill_impl, donate_argnums=(1,))
-        self._decode_multi = jax.jit(
-            self._decode_multi_impl, donate_argnums=(1,), static_argnums=(5,)
+        self._prefill = jax.jit(self._prefill_impl, donate_argnums=(1, 2))
+        self._decode_window = jax.jit(
+            self._decode_window_impl, donate_argnums=(1, 2), static_argnums=(6,)
+        )
+        self._write_tokens = jax.jit(
+            lambda td, idx, vals: td.at[idx].set(vals, mode="drop"),
+            donate_argnums=(0,),
         )
         # block-granularity KV IO for disaggregation / offload
-        # (the NIXL-slot replacement, reference: patch nixl.py register_kv_caches)
-        self._gather_pages = jax.jit(lambda kv, ids: kv[:, :, ids])
+        # (the NIXL-slot replacement, reference: patch nixl.py register_kv_caches).
+        # The wire format stays [L, 2, n, ps, Hkv, D] (canonical layout for DCN
+        # transfer / host offload); on device the pools are flat [L*P, ...].
+        L = model.config.num_layers
+        Pn = config.num_pages
+
+        def _flat_ids(ids):  # [n] logical -> [L, n] flat
+            return ids[None, :] + (jnp.arange(L, dtype=jnp.int32) * Pn)[:, None]
+
+        self._gather_pages = jax.jit(
+            lambda kv, ids: jnp.stack(
+                [kv["k"][_flat_ids(ids)], kv["v"][_flat_ids(ids)]], axis=1
+            )
+        )
         self._scatter_pages = jax.jit(
-            lambda kv, ids, data: kv.at[:, :, ids].set(data), donate_argnums=(0,)
+            lambda kv, ids, data: {
+                "k": kv["k"].at[_flat_ids(ids)].set(data[:, 0]),
+                "v": kv["v"].at[_flat_ids(ids)].set(data[:, 1]),
+            },
+            donate_argnums=(0,),
         )
 
     # ---------------- jitted bodies ----------------
 
-    def _prefill_impl(self, params, kv, ints, flts, key):
-        """ints [bucket + max_pages + 3] = token buf, page table, then
-        (start_pos, n_real, top_k); flts [2] = (temperature, top_p). Positions
-        and the valid mask derive on device — one packed H2D per chunk."""
+    def _prefill_impl(self, params, kv, tokens_dev, ints, flts, key):
+        """ints [bucket + max_pages + 4] = token buf, page table, then
+        (start_pos, n_real, top_k, slot); flts [2] = (temperature, top_p).
+        Positions and the valid mask derive on device — one packed H2D per
+        chunk. The sampled token is written into ``tokens_dev[slot]`` (slot >=
+        max_seqs drops the write) so a following decode window can consume it
+        without any host round trip."""
         mp = self.config.max_pages_per_seq
-        bucket = ints.shape[0] - mp - 3
+        bucket = ints.shape[0] - mp - 4
         tokens = ints[:bucket]
         page_table = ints[bucket : bucket + mp]
         start_pos = ints[bucket + mp]
         n = ints[bucket + mp + 1]
         top_k = ints[bucket + mp + 2]
+        slot = ints[bucket + mp + 3]
         positions = start_pos + jnp.arange(bucket, dtype=jnp.int32)
         valid = jnp.arange(bucket) < n
         logits, kv = self.model.prefill(params, kv, tokens, positions, page_table, valid, n - 1)
         tok = sample_tokens(logits[None, :], key, flts[:1], top_k[None], flts[1:])[0]
-        return tok, kv
+        tokens_dev = tokens_dev.at[slot].set(tok, mode="drop")
+        return tok, kv, tokens_dev
 
-    def _decode_multi_impl(self, params, kv, ints, flts, key, num_steps):
-        """num_steps fused decode steps; the sampled-token feedback loop stays
-        on device (one host round-trip per num_steps tokens).
+    def _decode_window_impl(self, params, kv, tokens_dev, ints, flts, key, num_steps):
+        """num_steps fused decode steps; the sampled-token feedback loop starts
+        from the device-resident ``tokens_dev`` buffer, so the host can
+        dispatch windows back-to-back without reading any results in between.
 
         All small per-slot inputs ride in two packed arrays (one H2D transfer
         each — per-call transfer latency dominates on tunneled platforms):
-        ``ints`` [5 + max_pages, B] = tokens, positions, limits, active,
-        top_ks, then the transposed page tables; ``flts`` [2, B] = temps,
-        top_ps. Page tables are static across the window — the host
-        pre-allocates pages to cover positions + num_steps - 1 before calling,
-        and a sequence freezes once its fed position would pass ``limits``
-        (no writes past its capacity)."""
-        tokens, positions, limits = ints[0], ints[1], ints[2]
-        active = ints[3].astype(bool)
-        top_ks = ints[4]
-        page_tables = ints[5:].T  # [B, max_pages]
+        ``ints`` [4 + max_pages, B] = positions, limits, active, top_ks, then
+        the transposed page tables; ``flts`` [2, B] = temps, top_ps. Page
+        tables are static across the window — the host pre-allocates pages to
+        cover positions + num_steps - 1 before calling, and a sequence freezes
+        once its fed position would pass ``limits`` (no writes past its
+        capacity)."""
+        positions, limits = ints[0], ints[1]
+        active = ints[2].astype(bool)
+        top_ks = ints[3]
+        page_tables = ints[4:].T  # [B, max_pages]
         temps, top_ps = flts[0], flts[1]
         keys = jax.random.split(key, num_steps)
 
@@ -113,8 +149,10 @@ class ModelRunner:
             act = act & (positions <= limits)
             return (kv, tokens, positions, act), toks
 
-        (kv, _, _, _), all_toks = jax.lax.scan(body, (kv, tokens, positions, active), keys)
-        return all_toks, kv  # [num_steps, B]
+        (kv, tokens, _, _), all_toks = jax.lax.scan(
+            body, (kv, tokens_dev, positions, active), keys
+        )
+        return all_toks, kv, tokens  # [num_steps, B], donated kv, tokens_dev
 
     # ---------------- host API (engine thread) ----------------
 
@@ -131,28 +169,90 @@ class ModelRunner:
         temperature: float,
         top_k: int,
         top_p: float,
-    ) -> Optional[int]:
-        """Run one prefill chunk; returns the sampled next token when `sample`."""
+        slot: int = -1,  # decode slot to seed with the sampled token (device side)
+        sync: bool = True,
+    ):
+        """Run one prefill chunk.
+
+        When ``sample``: returns the sampled next token — as a host int when
+        ``sync``, else as a device scalar (dispatch-ahead mode; an async
+        device-to-host copy is already in flight). When ``slot >= 0`` the token
+        is also written into ``tokens_dev[slot]`` on device so decode windows
+        can start without waiting for the host to see it."""
         n = len(tokens)
         bucket = self.config.bucket_for(n)
         mp = self.config.max_pages_per_seq
-        ints = np.zeros(bucket + mp + 3, np.int32)
+        ints = np.zeros(bucket + mp + 4, np.int32)
         ints[:n] = tokens
         ints[bucket : bucket + mp] = page_table[:mp]
         ints[bucket + mp] = start_pos
         ints[bucket + mp + 1] = n
         ints[bucket + mp + 2] = top_k
+        # out-of-bounds slot => scatter mode="drop" skips the tokens_dev write
+        ints[bucket + mp + 3] = slot if (sample and slot >= 0) else self.config.max_seqs
         flts = np.array([temperature, top_p], np.float32)
-        tok, self.kv_cache = self._prefill(
+        tok, self.kv_cache, self.tokens_dev = self._prefill(
             self.params,
             self.kv_cache,
+            self.tokens_dev,
             jnp.asarray(ints),
             jnp.asarray(flts),
             self._next_key(),
         )
-        if sample:
+        if not sample:
+            return None
+        if sync:
             return int(jax.device_get(tok))
-        return None
+        try:
+            tok.copy_to_host_async()
+        except Exception:
+            pass
+        return tok
+
+    def write_token_slots(self, slots: np.ndarray, tokens: np.ndarray) -> None:
+        """Host-known tokens (e.g. disagg adoption) -> tokens_dev[slots]."""
+        self.tokens_dev = self._write_tokens(
+            self.tokens_dev, jnp.asarray(slots, jnp.int32), jnp.asarray(tokens, jnp.int32)
+        )
+
+    def dispatch_decode_window(
+        self,
+        positions: np.ndarray,  # [B] fed-token position per slot
+        page_tables: np.ndarray,  # [B, max_pages_per_seq]
+        active: np.ndarray,  # [B] bool
+        limits: np.ndarray,  # [B] max fed-token position per slot
+        temps: np.ndarray,
+        top_ks: np.ndarray,
+        top_ps: np.ndarray,
+        num_steps: int,
+    ):
+        """Dispatch one fused decode window WITHOUT waiting for results.
+
+        Returns the [num_steps, B] device token array with an async
+        device-to-host copy already started; the caller materializes it later
+        (np.asarray) while further windows run on device."""
+        B = positions.shape[0]
+        ints = np.empty((4 + page_tables.shape[1], B), np.int32)
+        ints[0] = positions
+        ints[1] = limits
+        ints[2] = active
+        ints[3] = top_ks
+        ints[4:] = page_tables.T
+        flts = np.stack([temps, top_ps]).astype(np.float32)
+        toks, self.kv_cache, self.tokens_dev = self._decode_window(
+            self.params,
+            self.kv_cache,
+            self.tokens_dev,
+            jnp.asarray(ints),
+            jnp.asarray(flts),
+            self._next_key(),
+            num_steps,
+        )
+        try:
+            toks.copy_to_host_async()
+        except Exception:
+            pass
+        return toks
 
     def extract_pages(self, page_ids: np.ndarray) -> np.ndarray:
         """Pull KV blocks to host: [L, 2, n, page_size, Hkv, D] numpy.
@@ -168,7 +268,7 @@ class ModelRunner:
         self.kv_cache = self._scatter_pages(
             self.kv_cache,
             jnp.asarray(page_ids, jnp.int32),
-            jnp.asarray(data, self.kv_cache.dtype),
+            jnp.asarray(data, self.kv_cache["k"].dtype),
         )
 
     def decode_steps(
@@ -183,22 +283,11 @@ class ModelRunner:
         top_ps: np.ndarray,
         num_steps: int,
     ) -> np.ndarray:
-        """Fused multi-step decode: returns [num_steps, B] sampled tokens."""
+        """Synchronous fused multi-step decode with host-provided feed tokens:
+        seeds tokens_dev, runs one window, returns [num_steps, B] tokens."""
         B = tokens.shape[0]
-        ints = np.empty((5 + page_tables.shape[1], B), np.int32)
-        ints[0] = tokens
-        ints[1] = positions
-        ints[2] = limits
-        ints[3] = active
-        ints[4] = top_ks
-        ints[5:] = page_tables.T
-        flts = np.stack([temps, top_ps]).astype(np.float32)
-        toks, self.kv_cache = self._decode_multi(
-            self.params,
-            self.kv_cache,
-            jnp.asarray(ints),
-            jnp.asarray(flts),
-            self._next_key(),
-            num_steps,
+        self.write_token_slots(np.arange(B, dtype=np.int32), tokens)
+        toks = self.dispatch_decode_window(
+            positions, page_tables, active, limits, temps, top_ks, top_ps, num_steps
         )
         return np.asarray(jax.device_get(toks))

@@ -1,14 +1,28 @@
 """Continuous-batching scheduler: admission, chunked prefill with prefix-cache
-reuse, batched decode, preemption.
+reuse, pipelined batched decode, preemption.
 
-Policy (round 1, deliberately simple):
+Policy (deliberately simple admission; aggressive latency hiding):
   - admit waiting requests whenever a decode slot and enough pages exist
     (watermark guard keeps headroom for decode growth)
   - prefill runs chunk-by-chunk through bucket-padded jit calls; the cached
     prefix (from the page allocator) is skipped, mirroring the reference's
     prefix-hit accounting used for routing/disagg decisions
-  - on page exhaustion mid-decode, the most-recently-admitted sequence is
-    preempted back to the waiting queue (prompt = original + generated so far)
+  - decode runs as fused K-step windows dispatched **ahead** of result
+    materialization (config.pipeline_depth windows in flight): the sampled
+    token feedback lives on device (ModelRunner.tokens_dev), so the host never
+    syncs between windows. Results are reconciled in dispatch order; EOS is
+    therefore discovered up to (pipeline_depth * K) steps late, and the device
+    wastes at most that much work per finished sequence — the price of hiding
+    per-call dispatch/transfer latency, which dominates on tunneled platforms.
+  - on page exhaustion mid-decode the pipeline is drained, then the
+    most-recently-admitted sequence is preempted back to the waiting queue
+    (prompt = original + generated so far)
+
+Scheduled-vs-materialized positions: `seq.sched_len` counts tokens that exist
+in the *scheduled* timeline (prefill's first token + every window step), while
+`seq.generated` holds materialized tokens only. Device-side positions are
+deterministic given the dispatched control arrays, so the host tracks them
+exactly without reading anything back.
 """
 
 from __future__ import annotations
@@ -53,14 +67,37 @@ class RunningSeq:
     slot: int
     prompt_len: int
     cached_len: int
-    generated: list[int] = field(default_factory=list)
+    generated: list[int] = field(default_factory=list)  # materialized tokens
     page_table: np.ndarray = None  # [max_pages_per_seq]
     admitted_order: int = 0
+    sched_len: int = 0  # tokens in the scheduled timeline (>= len(generated))
+    finished: bool = False
 
     @property
     def pos(self) -> int:
-        """Position of the next token to be decoded."""
+        """Materialized position of the next token to be decoded."""
         return self.prompt_len + len(self.generated)
+
+    @property
+    def next_fed_pos(self) -> int:
+        """Position where the next scheduled window's first KV write lands."""
+        return self.prompt_len + self.sched_len - 1
+
+
+@dataclass
+class _InFlight:
+    kind: str  # "first" | "window"
+    dev: object  # device array (async copy already started)
+    # first: (seq, cached_len); window: [(seq, slot_idx, steps), ...]
+    seqs: list = field(default_factory=list)
+    cached_len: int = 0
+
+
+def _is_ready(arr) -> bool:
+    try:
+        return bool(arr.is_ready())
+    except Exception:
+        return False
 
 
 class Scheduler:
@@ -71,6 +108,7 @@ class Scheduler:
         self.waiting: deque[EngineRequest] = deque()
         self.adopted_waiting: deque[RunningSeq] = deque()  # prefilled remotely, need a slot
         self.slots: list[Optional[RunningSeq]] = [None] * config.max_seqs
+        self.in_flight: deque[_InFlight] = deque()
         self._admit_counter = 0
         self.finished_count = 0
 
@@ -83,6 +121,7 @@ class Scheduler:
         return (
             bool(self.waiting)
             or bool(self.adopted_waiting)
+            or bool(self.in_flight)
             or any(s is not None for s in self.slots)
         )
 
@@ -93,11 +132,11 @@ class Scheduler:
     def cancel(self, request_id: str) -> bool:
         for i, s in enumerate(self.slots):
             if s is not None and s.req.request_id == request_id:
-                self.allocator.free_sequence(s.req.request_id)
-                self.slots[i] = None
+                self._release(s, count_finished=False)
                 return True
         for s in list(self.adopted_waiting):
             if s.req.request_id == request_id:
+                s.finished = True
                 self.allocator.free_sequence(request_id)
                 self.adopted_waiting.remove(s)
                 return True
@@ -111,9 +150,16 @@ class Scheduler:
 
     def step(self) -> list[StepOutput]:
         outputs: list[StepOutput] = []
+        outputs.extend(self._reconcile(block=False))
         outputs.extend(self._admit())
-        outputs.extend(self._decode())
+        dispatched = self._dispatch_windows(outputs)
+        pipeline_full = self._windows_in_flight() >= max(1, self.config.pipeline_depth)
+        if pipeline_full or (self.in_flight and not dispatched and not outputs):
+            outputs.extend(self._reconcile(block=True))
         return outputs
+
+    def _windows_in_flight(self) -> int:
+        return sum(1 for e in self.in_flight if e.kind == "window")
 
     # ---------------- admission + prefill ----------------
 
@@ -135,6 +181,10 @@ class Scheduler:
             seq = self.adopted_waiting.popleft()
             seq.slot = slot
             self.slots[slot] = seq
+            # seed the device token-feedback buffer with its last token
+            self.runner.write_token_slots(
+                np.array([slot], np.int32), np.array([seq.generated[-1]], np.int32)
+            )
         while self.waiting:
             slot = self._free_slot()
             if slot is None:
@@ -151,13 +201,13 @@ class Scheduler:
                 break
             self.waiting.popleft()
             try:
-                outputs.extend(self._start_sequence(req, slot))
+                self._start_sequence(req, slot)
             except MemoryError:
                 self.waiting.appendleft(req)
                 break
         return outputs
 
-    def _start_sequence(self, req: EngineRequest, slot: int) -> list[StepOutput]:
+    def _start_sequence(self, req: EngineRequest, slot: int) -> None:
         cached_len, state = self.allocator.allocate_sequence(req.request_id, req.token_ids)
         prompt_len = len(req.token_ids)
         page_table = np.zeros(self.config.max_pages_per_seq, np.int32)
@@ -170,20 +220,51 @@ class Scheduler:
             cached_len=cached_len,
             page_table=page_table,
             admitted_order=self._admit_counter,
+            sched_len=1,  # the prefill's sampled token enters the timeline now
         )
         self._admit_counter += 1
 
-        first_token = self.run_prefill_chunks(req, page_table, cached_len, prompt_len)
+        # dispatch-ahead: chunks run without any host sync; the final chunk
+        # samples, seeds tokens_dev[slot] on device, and async-copies the token
+        tok_dev = self._dispatch_prefill_chunks(
+            req, page_table, cached_len, prompt_len, slot=slot
+        )
         self.allocator.commit_prefilled(req.request_id, prompt_len)
         self.slots[slot] = seq
-        return self._emit_token(seq, first_token, cached=cached_len)
+        self.in_flight.append(
+            _InFlight(kind="first", dev=tok_dev, seqs=[seq], cached_len=cached_len)
+        )
+
+    def _dispatch_prefill_chunks(
+        self, req: EngineRequest, page_table: np.ndarray, cached_len: int,
+        prompt_len: int, slot: int,
+    ):
+        s = req.sampling
+        start = cached_len
+        max_chunk = self.config.max_prefill_chunk
+        tok_dev = None
+        while start < prompt_len:
+            end = min(start + max_chunk, prompt_len)
+            is_last = end == prompt_len
+            tok_dev = self.runner.prefill_chunk(
+                np.asarray(req.token_ids[start:end], np.int32),
+                start_pos=start,
+                page_table=page_table,
+                sample=is_last,
+                temperature=s.temperature,
+                top_k=s.top_k,
+                top_p=s.top_p,
+                slot=slot if is_last else -1,
+                sync=False,
+            )
+            start = end
+        return tok_dev
 
     def run_prefill_chunks(
         self, req: EngineRequest, page_table: np.ndarray, cached_len: int, prompt_len: int
     ) -> int:
-        """Chunked bucket-padded prefill, skipping the cached prefix; samples
-        and returns the first output token. Shared by local admission and the
-        disagg prefill worker."""
+        """Synchronous chunked prefill (disagg prefill worker path): samples and
+        returns the first output token as a host int."""
         s = req.sampling
         first_token: Optional[int] = None
         start = cached_len
@@ -224,117 +305,150 @@ class Scheduler:
             cached_len=cached_len,
             page_table=page_table,
             admitted_order=self._admit_counter,
+            sched_len=1,
         )
         self._admit_counter += 1
         slot = self._free_slot()
         if slot is not None:
             seq.slot = slot
             self.slots[slot] = seq
+            self.runner.write_token_slots(
+                np.array([slot], np.int32), np.array([first_token], np.int32)
+            )
         else:
             self.adopted_waiting.append(seq)
         return self._emit_token(seq, first_token, cached=cached_len)
 
-    # ---------------- decode ----------------
+    # ---------------- pipelined decode ----------------
 
-    def _decode(self) -> list[StepOutput]:
-        outputs: list[StepOutput] = []
+    def _dispatch_windows(self, outputs: list[StepOutput]) -> int:
+        count = 0
+        while self._windows_in_flight() < max(1, self.config.pipeline_depth):
+            if not self._dispatch_one_window(outputs):
+                break
+            count += 1
+        return count
+
+    def _plan_steps(self, seq: RunningSeq, K: int) -> int:
+        """Steps this window can run for `seq` before budget/length bounds."""
+        budget = seq.req.sampling.max_tokens - seq.sched_len
+        length = self.config.max_model_len - seq.next_fed_pos
+        return max(0, min(K, budget, length))
+
+    def _dispatch_one_window(self, outputs: list[StepOutput]) -> bool:
         K = max(1, self.config.decode_steps)
 
-        # Each active sequence feeds its last generated token, whose KV lands at
-        # position seq.pos - 1; over a window of W fused steps writes reach
-        # seq.pos + W - 2, so capacity for seq.pos + W - 1 tokens must exist up
-        # front — page tables are static inside the fused call. W is clipped to
-        # the request's remaining max_tokens budget (no pages reserved or
-        # device steps spent on tokens that can never be emitted), and under
-        # page pressure with no preemption victim the window shrinks to
-        # whatever fits (limits[] freezes the sequence on device) instead of
-        # failing the request.
+        # capacity pass: every participant needs pages for its planned writes
+        # (fed positions next_fed_pos .. next_fed_pos + steps - 1); page tables
+        # are static inside the window
         for seq in sorted(
             [s for s in self.slots if s is not None], key=lambda s: s.admitted_order
         ):
-            if self.slots[seq.slot] is not seq:
-                continue  # already preempted as a victim this step
-            need = self._window_need(seq, K)
+            steps = self._plan_steps(seq, K)
+            if steps <= 0:
+                continue
+            need = seq.next_fed_pos + steps
             while self.slots[seq.slot] is seq and not self.allocator.ensure_capacity(
                 seq.req.request_id, need
             ):
+                # page pressure: drain the pipeline (may free pages via EOS),
+                # then preempt the most recent victim
+                if self.in_flight:
+                    outputs.extend(self._reconcile(block=True, drain=True))
+                    continue
                 victim = self._pick_victim(exclude=seq)
                 if victim is None:
-                    if need > seq.pos and self.allocator.ensure_capacity(
-                        seq.req.request_id, seq.pos
-                    ):
-                        break  # shorter window; device freezes at capacity
+                    cap = self.allocator._seqs[seq.req.request_id].num_pages * \
+                        self.config.page_size
+                    if cap > seq.next_fed_pos:
+                        break  # shorter window; limits[] freezes at capacity
                     outputs.extend(self._finish(seq, "error"))
                     break
-                outputs.extend(self._preempt(victim))
+                self._preempt(victim)
             if self.slots[seq.slot] is seq:
                 state = self.allocator._seqs[seq.req.request_id]
                 seq.page_table[: len(state.pages)] = state.pages
 
-        active_seqs = [s for s in self.slots if s is not None]
-        if not active_seqs:
-            return outputs
+        participants = []
+        for seq in self.slots:
+            if seq is None or seq.finished:
+                continue
+            steps = self._plan_steps(seq, K)
+            if steps <= 0:
+                continue
+            cap = self.allocator._seqs[seq.req.request_id].num_pages * self.config.page_size
+            steps = min(steps, cap - seq.next_fed_pos)
+            if steps <= 0:
+                continue
+            participants.append((seq, steps))
+        if not participants:
+            return False
 
         B = self.config.max_seqs
-        tokens = np.zeros(B, np.int32)
         positions = np.zeros(B, np.int32)
         page_tables = np.zeros((B, self.config.max_pages_per_seq), np.int32)
         active = np.zeros(B, bool)
-        limits = np.zeros(B, np.int32)  # max fed-token position per slot
+        limits = np.zeros(B, np.int32)
         temps = np.zeros(B, np.float32)
         top_ks = np.zeros(B, np.int32)
         top_ps = np.ones(B, np.float32)
 
-        for seq in active_seqs:
+        snapshot = []
+        for seq, steps in participants:
             i = seq.slot
-            # Feed the last sampled token: its KV is written at seq.pos - 1,
-            # attention covers <= pos-1, and the step samples the next token.
-            tokens[i] = seq.generated[-1]
-            positions[i] = seq.pos - 1
+            positions[i] = seq.next_fed_pos
             page_tables[i] = seq.page_table
             active[i] = True
-            # freeze at whichever bound is tightest: fused window, model
-            # length, remaining token budget, or actually-allocated capacity
-            cap_tokens = self.allocator._seqs[seq.req.request_id].num_pages * self.config.page_size
-            limits[i] = min(self._window_need(seq, K), cap_tokens) - 1
+            limits[i] = seq.next_fed_pos + steps - 1  # max fed position
             temps[i] = seq.req.sampling.temperature
             top_ks[i] = seq.req.sampling.top_k
             top_ps[i] = seq.req.sampling.top_p
+            snapshot.append((seq, i, steps))
+            seq.sched_len += steps
 
-        new_tokens = self.runner.decode_steps(
-            tokens, positions, page_tables, active, limits, temps, top_ks, top_ps, K
-        )  # [K, B]
+        toks_dev = self.runner.dispatch_decode_window(
+            positions, page_tables, active, limits, temps, top_ks, top_ps, K
+        )
+        self.in_flight.append(_InFlight(kind="window", dev=toks_dev, seqs=snapshot))
+        return True
 
-        # Emit per fused step, but never past the slot's device freeze point
-        # (limits): steps j run on device only while positions[i] + j <=
-        # limits[i] — tokens past that are sampled from frozen state with no
-        # KV written behind them and must not reach the client or the
-        # allocator's block hashes. A sequence that finishes mid-window
-        # ignores the remaining steps (wasted-work bound = K-1).
-        for seq in active_seqs:
-            i = seq.slot
-            real_steps = int(limits[i] - positions[i] + 1)
-            for j in range(min(real_steps, new_tokens.shape[0])):
-                out = self._emit_token(seq, int(new_tokens[j, i]))
-                outputs.extend(out)
-                if out and out[-1].finished:
-                    break
+    def _reconcile(self, block: bool, drain: bool = False) -> list[StepOutput]:
+        """Materialize arrived results in dispatch order and emit tokens.
+
+        block: wait for (at least) the oldest entry. drain: wait for all."""
+        outputs: list[StepOutput] = []
+        while self.in_flight:
+            entry = self.in_flight[0]
+            if not (block or drain) and not _is_ready(entry.dev):
+                break
+            self.in_flight.popleft()
+            data = np.asarray(entry.dev)
+            block = False
+            if entry.kind == "first":
+                seq = entry.seqs[0]
+                if seq.finished:
+                    continue
+                outputs.extend(
+                    self._emit_token(seq, int(data), cached=entry.cached_len)
+                )
+            else:
+                for seq, slot_idx, steps in entry.seqs:
+                    if seq.finished:
+                        continue  # EOS/cancel discovered earlier; zombie tokens
+                    for j in range(min(steps, data.shape[0])):
+                        outputs.extend(self._emit_token(seq, int(data[j, slot_idx])))
+                        if seq.finished:
+                            break
         return outputs
-
-    def _window_need(self, seq: RunningSeq, K: int) -> int:
-        """Token capacity a fused K-step window needs for `seq`: write positions
-        run seq.pos - 1 .. seq.pos + W - 2 where W = min(K, remaining budget)."""
-        remaining = max(1, seq.req.sampling.max_tokens - len(seq.generated))
-        window = min(K, remaining)
-        return min(seq.pos + window - 1, self.config.max_model_len)
 
     # ---------------- helpers ----------------
 
     def _emit_token(self, seq: RunningSeq, token: Optional[int], cached: int = 0) -> list[StepOutput]:
-        if token is None:
+        if token is None or seq.finished:
             return []
         req = seq.req
         seq.generated.append(token)
+        seq.sched_len = max(seq.sched_len, len(seq.generated))
         self.allocator.append_token(req.request_id, token)
         finish: Optional[str] = None
         if (not req.sampling.ignore_eos) and req.eos_token_ids and token in req.eos_token_ids:
@@ -354,13 +468,15 @@ class Scheduler:
         self._release(seq)
         return [StepOutput(seq.req.request_id, finished=True, finish_reason=reason)]
 
-    def _release(self, seq: RunningSeq) -> None:
+    def _release(self, seq: RunningSeq, count_finished: bool = True) -> None:
+        seq.finished = True
         self.allocator.free_sequence(seq.req.request_id)
         if seq.slot >= 0 and self.slots[seq.slot] is seq:
             self.slots[seq.slot] = None
         elif seq in self.adopted_waiting:
             self.adopted_waiting.remove(seq)
-        self.finished_count += 1
+        if count_finished:
+            self.finished_count += 1
 
     def _pick_victim(self, exclude: RunningSeq) -> Optional[RunningSeq]:
         candidates = [s for s in self.slots if s is not None and s is not exclude]
@@ -368,26 +484,27 @@ class Scheduler:
             return None
         return max(candidates, key=lambda s: s.admitted_order)
 
-    def _preempt(self, seq: RunningSeq) -> list[StepOutput]:
+    def _preempt(self, seq: RunningSeq) -> None:
         """Return a sequence to the waiting queue; its work restarts later
-        (prefix cache usually recovers most of it)."""
+        (prefix cache usually recovers most of it). Callers must drain the
+        pipeline first so seq.generated is complete."""
         log.info("preempting %s (page pressure)", seq.req.request_id)
+        seq.finished = True  # stray in-flight snapshots must skip it
         self.allocator.free_sequence(seq.req.request_id)
-        self.slots[seq.slot] = None
+        if seq.slot >= 0 and self.slots[seq.slot] is seq:
+            self.slots[seq.slot] = None
         new_req = EngineRequest(
             request_id=seq.req.request_id,
             token_ids=list(seq.req.token_ids) + seq.generated,
-            sampling=seq.req.sampling,
+            sampling=SamplingParams(
+                temperature=seq.req.sampling.temperature,
+                top_k=seq.req.sampling.top_k,
+                top_p=seq.req.sampling.top_p,
+                # already-generated tokens count against max_tokens on resume
+                max_tokens=max(1, seq.req.sampling.max_tokens - len(seq.generated)),
+                stop=seq.req.sampling.stop,
+                ignore_eos=seq.req.sampling.ignore_eos,
+            ),
             eos_token_ids=seq.req.eos_token_ids,
         )
-        # already-generated tokens count against max_tokens when it resumes
-        new_req.sampling = SamplingParams(
-            temperature=seq.req.sampling.temperature,
-            top_k=seq.req.sampling.top_k,
-            top_p=seq.req.sampling.top_p,
-            max_tokens=max(1, seq.req.sampling.max_tokens - len(seq.generated)),
-            stop=seq.req.sampling.stop,
-            ignore_eos=seq.req.sampling.ignore_eos,
-        )
         self.waiting.appendleft(new_req)
-        return []

@@ -3,10 +3,14 @@ paged KV cache.
 
 Design notes (TPU-first):
   - Layers are scan-stacked: every weight carries a leading ``[L]`` axis and the
-    forward pass is one ``lax.scan`` over layers — a single compiled layer body,
-    fast compiles, and the KV cache naturally threads through as scan xs/ys.
-  - The KV cache is one array ``[L, 2, num_pages, page_size, Hkv, D]`` donated
-    to the step functions, so XLA updates it in place.
+    forward pass is one ``lax.scan`` over layers — a single compiled layer body
+    and fast compiles.
+  - The KV cache is a **flat page pool** ``{"k","v"}`` of shape
+    ``[num_layers * num_pages, page_size, Hkv, D]`` each (layer l's page p at
+    flat index ``l * num_pages + p``), carried through the layer scan and
+    donated to the step functions so XLA scatters new tokens in place. See
+    dynamo_tpu/ops/attention.py for why flat beats a per-layer [L, ...] cache
+    threaded through scan xs/ys (3x decode step time on v5e).
   - Tensor parallelism is expressed purely as NamedSharding on params/cache
     (head-sharded) + GSPMD propagation; no explicit collectives in model code.
   - Weight layout is ``[in, out]`` so the hot path is plain ``h @ w`` (MXU).
@@ -170,14 +174,24 @@ class LlamaModel:
         return shardings
 
     def kv_cache_shape(self, num_pages: int, page_size: int) -> tuple[int, ...]:
+        """Shape of each of the two flat page pools (the "k" and "v" leaves)."""
         c = self.config
-        return (c.num_layers, 2, num_pages, page_size, c.num_kv_heads, c.head_dim)
+        return (c.num_layers * num_pages, page_size, c.num_kv_heads, c.head_dim)
 
-    def init_kv_cache(self, num_pages: int, page_size: int) -> jnp.ndarray:
-        return jnp.zeros(self.kv_cache_shape(num_pages, page_size), self.config.dtype)
+    def init_kv_cache(self, num_pages: int, page_size: int) -> dict:
+        shape = self.kv_cache_shape(num_pages, page_size)
+        return {
+            "k": jnp.zeros(shape, self.config.dtype),
+            "v": jnp.zeros(shape, self.config.dtype),
+        }
 
-    def kv_cache_sharding(self, mesh: Mesh, tp_axis: str = "tp") -> NamedSharding:
-        return NamedSharding(mesh, P(None, None, None, None, tp_axis, None))
+    def kv_cache_sharding(self, mesh: Mesh, tp_axis: str = "tp") -> dict:
+        ns = NamedSharding(mesh, P(None, None, tp_axis, None))
+        return {"k": ns, "v": ns}
+
+    def _layer_offsets(self, num_pages: int) -> jnp.ndarray:
+        """[L] flat-pool offset of each layer's page 0 (its trash page)."""
+        return jnp.arange(self.config.num_layers, dtype=jnp.int32) * num_pages
 
     # ---------------- forward ----------------
 
@@ -185,19 +199,23 @@ class LlamaModel:
         c = self.config
         h = rms_norm(hidden, params["final_norm"], c.rms_norm_eps)
         head = params["embed"] if c.tie_word_embeddings else params["lm_head"]
-        return jnp.einsum("td,vd->tv", h.astype(jnp.float32), head.astype(jnp.float32))
+        # bf16 MXU matmul with f32 accumulation — no materialized f32 cast of
+        # the [V, D] head (bf16 products are exact in the f32 accumulator)
+        return jax.lax.dot_general(
+            h, head, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
 
     def _layer(
         self,
         lp: dict,
         hidden: jnp.ndarray,  # [T, D]
-        kv: jnp.ndarray,  # [2, P, ps, Hkv, D]
+        k_pool: jnp.ndarray,  # [LP, ps, Hkv, D] full flat pool (carried)
+        v_pool: jnp.ndarray,  # [LP, ps, Hkv, D]
         positions: jnp.ndarray,  # [T]
-        phys_pages: jnp.ndarray,  # [T] physical page per token
+        flat_phys: jnp.ndarray,  # [T] flat page per token (layer trash for invalid)
         offsets: jnp.ndarray,  # [T]
-        valid: jnp.ndarray,  # [T]
         attn_fn,
-    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         c = self.config
         T = hidden.shape[0]
         h = rms_norm(hidden, lp["input_norm"], c.rms_norm_eps)
@@ -213,72 +231,94 @@ class LlamaModel:
         v = v_flat.reshape(T, c.num_kv_heads, c.head_dim)
         q = apply_rope(q, positions, c.rope_theta)
         k = apply_rope(k, positions, c.rope_theta)
-        k_pages, v_pages = scatter_kv(kv[0], kv[1], k, v, phys_pages, offsets, valid)
-        attn = attn_fn(q, k_pages, v_pages)
+        k_pool, v_pool = scatter_kv(k_pool, v_pool, k, v, flat_phys, offsets)
+        attn = attn_fn(q, k_pool, v_pool)
         hidden = hidden + (attn.reshape(T, -1) @ lp["wo"])
         h = rms_norm(hidden, lp["post_norm"], c.rms_norm_eps)
         mlp = (jax.nn.silu(h @ lp["gate"]) * (h @ lp["up"])) @ lp["down"]
         hidden = hidden + mlp
-        return hidden, jnp.stack([k_pages, v_pages])
+        return hidden, k_pool, v_pool
 
     def prefill(
         self,
         params: dict,
-        kv_cache: jnp.ndarray,  # [L, 2, P, ps, Hkv, D] (donated)
+        kv_cache: dict,  # {"k","v"} flat pools (donated)
         tokens: jnp.ndarray,  # [T] padded chunk
         positions: jnp.ndarray,  # [T] absolute positions
-        page_table: jnp.ndarray,  # [max_pages]
+        page_table: jnp.ndarray,  # [max_pages] logical (per-layer) page ids
         valid: jnp.ndarray,  # [T] bool
         last_idx: jnp.ndarray,  # scalar: index of the final real token in chunk
-    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    ) -> tuple[jnp.ndarray, dict]:
         """One (possibly chunked) prefill pass for a single sequence.
 
         Returns (logits[V] at last_idx, updated kv_cache).
         """
-        page_size = kv_cache.shape[3]
+        c = self.config
+        k_pool, v_pool = kv_cache["k"], kv_cache["v"]
+        page_size = k_pool.shape[1]
+        num_pages = k_pool.shape[0] // c.num_layers
         phys = jnp.where(valid, page_table[positions // page_size], 0)
         offsets = jnp.where(valid, positions % page_size, 0)
 
-        def attn_fn(q, k_pages, v_pages):
-            k_ctx = gather_pages(k_pages, page_table)
-            v_ctx = gather_pages(v_pages, page_table)
-            return attention_with_positions(q, k_ctx, v_ctx, positions)
+        hidden = params["embed"][tokens].astype(c.dtype)
 
-        hidden = params["embed"][tokens].astype(self.config.dtype)
+        def body(carry, xs):
+            h, kp, vp = carry
+            lp, off = xs
 
-        def body(h, xs):
-            lp, kv = xs
-            return self._layer(lp, h, kv, positions, phys, offsets, valid, attn_fn)
+            def attn_fn(q, kp_, vp_):
+                k_ctx = gather_pages(kp_, off + page_table)
+                v_ctx = gather_pages(vp_, off + page_table)
+                return attention_with_positions(q, k_ctx, v_ctx, positions)
 
-        hidden, kv_cache = jax.lax.scan(body, hidden, (params["layers"], kv_cache))
+            h, kp, vp = self._layer(lp, h, kp, vp, positions, off + phys, offsets, attn_fn)
+            return (h, kp, vp), None
+
+        (hidden, k_pool, v_pool), _ = jax.lax.scan(
+            body,
+            (hidden, k_pool, v_pool),
+            (params["layers"], self._layer_offsets(num_pages)),
+        )
         logits = self._unembed(params, hidden[last_idx][None, :])[0]
-        return logits, kv_cache
+        return logits, {"k": k_pool, "v": v_pool}
 
     def decode(
         self,
         params: dict,
-        kv_cache: jnp.ndarray,  # [L, 2, P, ps, Hkv, D] (donated)
+        kv_cache: dict,  # {"k","v"} flat pools (donated)
         tokens: jnp.ndarray,  # [B] current token per slot
         positions: jnp.ndarray,  # [B] its absolute position
-        page_tables: jnp.ndarray,  # [B, max_pages]
+        page_tables: jnp.ndarray,  # [B, max_pages] logical (per-layer) page ids
         active: jnp.ndarray,  # [B] bool
-    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    ) -> tuple[jnp.ndarray, dict]:
         """One decode step for the whole batch. Returns (logits[B, V], kv_cache)."""
-        page_size = kv_cache.shape[3]
+        c = self.config
+        k_pool, v_pool = kv_cache["k"], kv_cache["v"]
+        page_size = k_pool.shape[1]
+        num_pages = k_pool.shape[0] // c.num_layers
         B = tokens.shape[0]
         logical = positions // page_size
         phys = jnp.where(active, page_tables[jnp.arange(B), logical], 0)
         offsets = jnp.where(active, positions % page_size, 0)
 
-        def attn_fn(q, k_pages, v_pages):
-            return dispatch_paged_decode_attention(q, k_pages, v_pages, page_tables, positions)
+        hidden = params["embed"][tokens].astype(c.dtype)
 
-        hidden = params["embed"][tokens].astype(self.config.dtype)
+        def body(carry, xs):
+            h, kp, vp = carry
+            lp, off = xs
 
-        def body(h, xs):
-            lp, kv = xs
-            return self._layer(lp, h, kv, positions, phys, offsets, active, attn_fn)
+            def attn_fn(q, kp_, vp_):
+                return dispatch_paged_decode_attention(
+                    q, kp_, vp_, off + page_tables, positions
+                )
 
-        hidden, kv_cache = jax.lax.scan(body, hidden, (params["layers"], kv_cache))
+            h, kp, vp = self._layer(lp, h, kp, vp, positions, off + phys, offsets, attn_fn)
+            return (h, kp, vp), None
+
+        (hidden, k_pool, v_pool), _ = jax.lax.scan(
+            body,
+            (hidden, k_pool, v_pool),
+            (params["layers"], self._layer_offsets(num_pages)),
+        )
         logits = self._unembed(params, hidden)
-        return logits, kv_cache
+        return logits, {"k": k_pool, "v": v_pool}

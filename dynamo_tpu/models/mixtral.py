@@ -88,7 +88,7 @@ class MixtralModel(LlamaModel):
         layers["w_down"] = ns(None, ep, None, None)
         return shardings
 
-    def _layer(self, lp, hidden, kv, positions, phys_pages, offsets, valid, attn_fn):
+    def _layer(self, lp, hidden, k_pool, v_pool, positions, flat_phys, offsets, attn_fn):
         c = self.config
         T = hidden.shape[0]
         # attention sublayer identical to Llama
@@ -99,8 +99,8 @@ class MixtralModel(LlamaModel):
         q = apply_rope((h @ lp["wq"]).reshape(T, c.num_heads, c.head_dim), positions, c.rope_theta)
         k = apply_rope((h @ lp["wk"]).reshape(T, c.num_kv_heads, c.head_dim), positions, c.rope_theta)
         v = (h @ lp["wv"]).reshape(T, c.num_kv_heads, c.head_dim)
-        k_pages, v_pages = scatter_kv(kv[0], kv[1], k, v, phys_pages, offsets, valid)
-        attn = attn_fn(q, k_pages, v_pages)
+        k_pool, v_pool = scatter_kv(k_pool, v_pool, k, v, flat_phys, offsets)
+        attn = attn_fn(q, k_pool, v_pool)
         hidden = hidden + (attn.reshape(T, -1) @ lp["wo"])
 
         # sparse MoE sublayer
@@ -115,4 +115,4 @@ class MixtralModel(LlamaModel):
             capacity_factor=c.moe_capacity_factor,
         )
         hidden = hidden + moe_out
-        return hidden, jnp.stack([k_pages, v_pages])
+        return hidden, k_pool, v_pool
