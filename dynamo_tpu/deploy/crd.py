@@ -67,6 +67,11 @@ class ServiceSpec:
             raise SpecError(f"{self.name}: bad port {self.port}")
         if self.autoscaling is not None:
             self.autoscaling.validate()
+            if self.hosts_per_slice > 1:
+                raise SpecError(
+                    f"{self.name}: autoscaling is not supported for multihost "
+                    "slices (hosts_per_slice > 1); scale with `replicas` instead"
+                )
 
 
 @dataclass

@@ -106,61 +106,103 @@ def _service_manifests(spec: DeploymentSpec, svc: ServiceSpec) -> list[dict]:
 
     objs: list[dict] = []
     if svc.hosts_per_slice > 1:
-        # multihost slice: a StatefulSet gives each host a stable ordinal that
-        # becomes DYNTPU_PROCESS_ID; the headless service is the coordinator
-        # address (pod-0) — see dynamo_tpu/parallel/mesh.py
-        container["env"] = env + [
-            {"name": "DYNTPU_NUM_PROCESSES", "value": str(svc.hosts_per_slice)},
-            {
-                "name": "DYNTPU_COORDINATOR",
-                "value": f"{name}-0.{name}.{spec.namespace}.svc:8476",
-            },
-            {
-                "name": "DYNTPU_PROCESS_ID",
-                "valueFrom": {
-                    "fieldRef": {"fieldPath": "metadata.labels['apps.kubernetes.io/pod-index']"}
+        # (autoscaling + multihost is rejected by ServiceSpec.validate)
+        # Multihost slices: one StatefulSet PER slice replica. Within a
+        # StatefulSet the pod ordinal IS the host index (DYNTPU_PROCESS_ID in
+        # [0, hosts_per_slice)), and each slice gets its own coordinator
+        # (its pod-0) — see dynamo_tpu/parallel/mesh.py. A single StatefulSet
+        # of hosts*replicas pods would hand out ordinals >= hosts_per_slice
+        # and share one coordinator across slices, which can never form a mesh.
+        for r in range(max(1, svc.replicas)):
+            rname = f"{name}-s{r}"
+            rmeta = _meta(spec, rname, svc.name)
+            rselector = {"app.kubernetes.io/name": rname}
+            rcontainer = dict(container)
+            rcontainer["env"] = env + [
+                {"name": "DYNTPU_NUM_PROCESSES", "value": str(svc.hosts_per_slice)},
+                {
+                    "name": "DYNTPU_COORDINATOR",
+                    "value": f"{rname}-0.{rname}.{spec.namespace}.svc:8476",
                 },
-            },
-        ]
-        objs.append(
-            {
-                "apiVersion": "apps/v1",
-                "kind": "StatefulSet",
-                "metadata": meta,
-                "spec": {
-                    "replicas": svc.hosts_per_slice * max(1, svc.replicas),
-                    "serviceName": name,
-                    "selector": {"matchLabels": selector},
-                    "template": {
-                        "metadata": {"labels": dict(meta["labels"])},
-                        "spec": {"containers": [container]},
+                {
+                    "name": "DYNTPU_PROCESS_ID",
+                    "valueFrom": {
+                        "fieldRef": {
+                            "fieldPath": "metadata.labels['apps.kubernetes.io/pod-index']"
+                        }
                     },
                 },
-            }
-        )
-        objs.append(
-            {
-                "apiVersion": "v1",
-                "kind": "Service",
-                "metadata": meta,
-                "spec": {"clusterIP": "None", "selector": selector, "ports": [{"port": 8476}]},
-            }
-        )
+            ]
+            objs.append(
+                {
+                    "apiVersion": "apps/v1",
+                    "kind": "StatefulSet",
+                    "metadata": rmeta,
+                    "spec": {
+                        "replicas": svc.hosts_per_slice,
+                        "serviceName": rname,
+                        "selector": {"matchLabels": rselector},
+                        "template": {
+                            "metadata": {"labels": dict(rmeta["labels"])},
+                            "spec": {"containers": [rcontainer]},
+                        },
+                    },
+                }
+            )
+            # per-slice headless service: gives pods stable DNS + the
+            # coordinator address
+            objs.append(
+                {
+                    "apiVersion": "v1",
+                    "kind": "Service",
+                    "metadata": rmeta,
+                    "spec": {
+                        "clusterIP": "None",
+                        "selector": rselector,
+                        "ports": [{"port": 8476}],
+                    },
+                }
+            )
+        if svc.port is not None:
+            # cross-slice ClusterIP service exposing the serving port (selects
+            # every slice's pods via the shared component label)
+            objs.append(
+                {
+                    "apiVersion": "v1",
+                    "kind": "Service",
+                    "metadata": meta,
+                    "spec": {
+                        "selector": {
+                            "app.kubernetes.io/part-of": spec.name,
+                            "dynamo-tpu/component": svc.name,
+                        },
+                        "ports": [{"port": svc.port, "targetPort": svc.port}],
+                    },
+                }
+            )
         return objs
 
+    has_hpa = (
+        svc.autoscaling is not None
+        and svc.autoscaling.max_replicas > svc.autoscaling.min_replicas
+    )
+    deployment_spec: dict[str, Any] = {
+        "selector": {"matchLabels": selector},
+        "template": {
+            "metadata": {"labels": dict(meta["labels"])},
+            "spec": {"containers": [container]},
+        },
+    }
+    # when an HPA owns the scale, pinning spec.replicas would reset the
+    # autoscaler's decision on every apply — omit the field
+    if not has_hpa:
+        deployment_spec["replicas"] = svc.replicas
     objs.append(
         {
             "apiVersion": "apps/v1",
             "kind": "Deployment",
             "metadata": meta,
-            "spec": {
-                "replicas": svc.replicas,
-                "selector": {"matchLabels": selector},
-                "template": {
-                    "metadata": {"labels": dict(meta["labels"])},
-                    "spec": {"containers": [container]},
-                },
-            },
+            "spec": deployment_spec,
         }
     )
     if svc.port is not None:
@@ -175,7 +217,7 @@ def _service_manifests(spec: DeploymentSpec, svc: ServiceSpec) -> list[dict]:
                 },
             }
         )
-    if svc.autoscaling is not None and svc.autoscaling.max_replicas > svc.autoscaling.min_replicas:
+    if has_hpa:
         a = svc.autoscaling
         if a.metric == "cpu":
             metrics = [
@@ -242,11 +284,17 @@ def reconcile(spec: DeploymentSpec, live: list[dict]) -> dict[str, list[dict]]:
     [...]}: update = same kind/name but different content; delete = live
     objects managed by this deployment that the spec no longer wants."""
     desired = {_key(o): o for o in render_manifests(spec)}
-    live_by_key = {
-        _key(o): o
-        for o in live
-        if o.get("metadata", {}).get("labels", {}).get("app.kubernetes.io/part-of") == spec.name
-    }
+
+    def _ours(o: dict) -> bool:
+        labels = o.get("metadata", {}).get("labels", {})
+        # ownership requires BOTH labels: part-of is a shared convention other
+        # tools also set, managed-by marks objects this reconciler created
+        return (
+            labels.get("app.kubernetes.io/part-of") == spec.name
+            and labels.get("app.kubernetes.io/managed-by") == MANAGED_BY
+        )
+
+    live_by_key = {_key(o): o for o in live if _ours(o)}
     actions: dict[str, list[dict]] = {"create": [], "update": [], "delete": [], "unchanged": []}
     for key, obj in desired.items():
         if key not in live_by_key:

@@ -284,10 +284,28 @@ class ModelRunner:
         num_steps: int,
     ) -> np.ndarray:
         """Synchronous fused multi-step decode with host-provided feed tokens:
-        seeds tokens_dev, runs one window, returns [num_steps, B] tokens."""
+        seeds tokens_dev, runs one window, returns [num_steps, B] tokens.
+
+        Accepts any B <= max_seqs; inputs are padded to the max_seqs batch the
+        window executable is compiled for (extra slots inactive)."""
         B = tokens.shape[0]
-        self.write_token_slots(np.arange(B, dtype=np.int32), tokens)
+        S = self.config.max_seqs
+        if B > S:
+            raise ValueError(f"batch {B} exceeds max_seqs {S}")
+        if B < S:
+            pad = S - B
+            tokens = np.concatenate([tokens, np.zeros(pad, tokens.dtype)])
+            positions = np.concatenate([positions, np.zeros(pad, positions.dtype)])
+            page_tables = np.concatenate(
+                [page_tables, np.zeros((pad, page_tables.shape[1]), page_tables.dtype)]
+            )
+            active = np.concatenate([active, np.zeros(pad, bool)])
+            limits = np.concatenate([limits, np.zeros(pad, limits.dtype)])
+            temps = np.concatenate([temps, np.zeros(pad, temps.dtype)])
+            top_ks = np.concatenate([top_ks, np.zeros(pad, top_ks.dtype)])
+            top_ps = np.concatenate([top_ps, np.ones(pad, top_ps.dtype)])
+        self.write_token_slots(np.arange(S, dtype=np.int32), tokens)
         toks = self.dispatch_decode_window(
             positions, page_tables, active, limits, temps, top_ks, top_ps, num_steps
         )
-        return np.asarray(jax.device_get(toks))
+        return np.asarray(jax.device_get(toks))[:, :B]

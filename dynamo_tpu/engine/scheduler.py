@@ -239,34 +239,26 @@ class Scheduler:
         self, req: EngineRequest, page_table: np.ndarray, cached_len: int,
         prompt_len: int, slot: int,
     ):
-        s = req.sampling
-        start = cached_len
-        max_chunk = self.config.max_prefill_chunk
-        tok_dev = None
-        while start < prompt_len:
-            end = min(start + max_chunk, prompt_len)
-            is_last = end == prompt_len
-            tok_dev = self.runner.prefill_chunk(
-                np.asarray(req.token_ids[start:end], np.int32),
-                start_pos=start,
-                page_table=page_table,
-                sample=is_last,
-                temperature=s.temperature,
-                top_k=s.top_k,
-                top_p=s.top_p,
-                slot=slot if is_last else -1,
-                sync=False,
-            )
-            start = end
-        return tok_dev
+        """Dispatch-ahead chunked prefill: no host sync; the final chunk seeds
+        tokens_dev[slot] and returns the token as a device scalar."""
+        return self.run_prefill_chunks(
+            req, page_table, cached_len, prompt_len, slot=slot, sync=False
+        )
 
     def run_prefill_chunks(
-        self, req: EngineRequest, page_table: np.ndarray, cached_len: int, prompt_len: int
-    ) -> int:
-        """Synchronous chunked prefill (disagg prefill worker path): samples and
-        returns the first output token as a host int."""
+        self,
+        req: EngineRequest,
+        page_table: np.ndarray,
+        cached_len: int,
+        prompt_len: int,
+        slot: int = -1,
+        sync: bool = True,
+    ):
+        """Bucket-chunked prefill, skipping the cached prefix; samples the first
+        output token on the final chunk. sync=True (disagg prefill-worker path)
+        returns it as a host int; sync=False returns the device scalar."""
         s = req.sampling
-        first_token: Optional[int] = None
+        first_token = None
         start = cached_len
         max_chunk = self.config.max_prefill_chunk
         while start < prompt_len:
@@ -280,6 +272,8 @@ class Scheduler:
                 temperature=s.temperature,
                 top_k=s.top_k,
                 top_p=s.top_p,
+                slot=slot if is_last else -1,
+                sync=sync,
             )
             if is_last:
                 first_token = tok

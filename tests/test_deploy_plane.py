@@ -114,16 +114,64 @@ def test_render_external_cplane_skips_managed_broker():
 def test_render_multihost_statefulset():
     spec = DeploymentSpec(
         name="mh",
-        services=[ServiceSpec(name="worker", tpu_chips=4, hosts_per_slice=2, replicas=3)],
+        services=[
+            ServiceSpec(
+                name="worker", tpu_chips=4, hosts_per_slice=2, replicas=3, port=8080
+            )
+        ],
     )
     objs = render_manifests(spec)
-    sts = next(o for o in objs if o["kind"] == "StatefulSet")
-    assert sts["spec"]["replicas"] == 6  # hosts_per_slice * replicas
-    env = {e["name"]: e for e in sts["spec"]["template"]["spec"]["containers"][0]["env"]}
-    assert env["DYNTPU_NUM_PROCESSES"]["value"] == "2"
-    assert "DYNTPU_COORDINATOR" in env and "DYNTPU_PROCESS_ID" in env
-    headless = next(o for o in objs if o["kind"] == "Service" and o["metadata"]["name"] == "mh-worker")
-    assert headless["spec"]["clusterIP"] == "None"
+    # one StatefulSet per slice replica: pod ordinals stay in
+    # [0, hosts_per_slice) so DYNTPU_PROCESS_ID < DYNTPU_NUM_PROCESSES, and
+    # each slice forms its mesh against its own pod-0 coordinator
+    stss = [o for o in objs if o["kind"] == "StatefulSet"]
+    assert [s["metadata"]["name"] for s in stss] == [
+        "mh-worker-s0", "mh-worker-s1", "mh-worker-s2"
+    ]
+    for i, sts in enumerate(stss):
+        assert sts["spec"]["replicas"] == 2  # hosts_per_slice
+        env = {e["name"]: e for e in sts["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert env["DYNTPU_NUM_PROCESSES"]["value"] == "2"
+        assert env["DYNTPU_COORDINATOR"]["value"].startswith(f"mh-worker-s{i}-0.mh-worker-s{i}.")
+        assert "DYNTPU_PROCESS_ID" in env
+        headless = next(
+            o for o in objs
+            if o["kind"] == "Service" and o["metadata"]["name"] == f"mh-worker-s{i}"
+        )
+        assert headless["spec"]["clusterIP"] == "None"
+    # the serving port is exposed by a cross-slice ClusterIP service
+    port_svc = next(
+        o for o in objs if o["kind"] == "Service" and o["metadata"]["name"] == "mh-worker"
+    )
+    assert port_svc["spec"]["ports"] == [{"port": 8080, "targetPort": 8080}]
+    assert port_svc["spec"]["selector"]["dynamo-tpu/component"] == "worker"
+
+    # autoscaling cannot own a multihost slice's scale — rejected at
+    # validate() time so the API server 422s instead of 500ing on render
+    bad = ServiceSpec(
+        name="w",
+        hosts_per_slice=2,
+        autoscaling=Autoscaling(min_replicas=1, max_replicas=2),
+    )
+    with pytest.raises(SpecError):
+        bad.validate()
+    with pytest.raises(SpecError):
+        render_manifests(DeploymentSpec(name="mh2", services=[bad]))
+
+
+def test_hpa_owned_deployment_omits_replicas():
+    objs = render_manifests(sample_spec())
+    frontend = next(
+        o for o in objs
+        if o["kind"] == "Deployment" and o["metadata"]["name"] == "llama-agg-frontend"
+    )
+    # the HPA owns the scale; pinning replicas would reset it on every apply
+    assert "replicas" not in frontend["spec"]
+    worker = next(
+        o for o in objs
+        if o["kind"] == "Deployment" and o["metadata"]["name"] == "llama-agg-worker"
+    )
+    assert worker["spec"]["replicas"] == 1
 
 
 def test_reconcile_diff():
@@ -140,17 +188,28 @@ def test_reconcile_diff():
     assert not actions["create"] and not actions["update"] and not actions["delete"]
     assert len(actions["unchanged"]) == len(desired)
 
-    # scale change -> update; dropped service -> delete; foreign objects ignored
+    # env change -> update; dropped service -> delete; foreign objects ignored
     spec2 = sample_spec()
-    spec2.services[0].replicas = 3
+    spec2.services[0].env = {"LOG": "debug"}
     spec2.services = spec2.services[:1]
     foreign = {"kind": "Deployment", "metadata": {"name": "other", "namespace": "default", "labels": {}}}
-    actions = reconcile(spec2, live=desired + [foreign])
+    # part-of alone (a shared label other tools also set) must NOT mark an
+    # object as ours — only part-of + managed-by together do
+    part_of_only = {
+        "kind": "Ingress",
+        "metadata": {
+            "name": "helm-ingress",
+            "namespace": "default",
+            "labels": {"app.kubernetes.io/part-of": "llama-agg"},
+        },
+    }
+    actions = reconcile(spec2, live=desired + [foreign, part_of_only])
     updated = {o["metadata"]["name"] for o in actions["update"]}
     deleted = {o["metadata"]["name"] for o in actions["delete"]}
     assert "llama-agg-frontend" in updated
     assert "llama-agg-worker" in deleted
     assert "other" not in deleted
+    assert "helm-ingress" not in deleted
 
 
 # ---------------- API server ----------------
