@@ -61,6 +61,8 @@ class DisaggDecodeEngine:
 
     async def start(self) -> "DisaggDecodeEngine":
         """Serve the prefill_result endpoint prefill workers call home to."""
+        from dynamo_tpu.disagg import ici
+
         ep = (
             self.drt.namespace(self.namespace)
             .component(self.component)
@@ -68,9 +70,15 @@ class DisaggDecodeEngine:
         )
         self._served = await ep.serve_endpoint(self._on_prefill_result)
         await self.router.start_watching()
+        # same-pod prefill workers discover us here and use the device-to-device
+        # (ICI) KV handoff instead of host-staged bytes
+        ici.register_worker(self.worker_id)
         return self
 
     async def shutdown(self) -> None:
+        from dynamo_tpu.disagg import ici
+
+        ici.unregister_worker(self.worker_id)
         if self._served is not None:
             await self._served.stop()
         await self.router.stop()
@@ -125,6 +133,7 @@ class DisaggDecodeEngine:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
         self.engine._register_stream(rid)
+        adopted = False
         try:
             rp = RemotePrefillRequest(
                 request_id=rid,
@@ -141,11 +150,18 @@ class DisaggDecodeEngine:
             await self.engine.run_on_engine(
                 lambda: self.engine.sync_adopt_prefilled(request, result, cached_len)
             )
-        except Exception:
-            self._pending.pop(rid, None)
-            await self.engine.run_on_engine(lambda: self.engine.sync_abort_remote(rid))
-            self.engine._outputs.pop(rid, None)
-            raise
+            adopted = True
+        finally:
+            # finally (not except Exception): client cancellation raises
+            # CancelledError, which must run the same cleanup — including
+            # dropping a parked ICI transfer delivered but never adopted
+            if not adopted:
+                from dynamo_tpu.disagg import ici
+
+                self._pending.pop(rid, None)
+                ici.pop_transfer(ici.transfer_key(self.worker_id, rid))
+                await self.engine.run_on_engine(lambda: self.engine.sync_abort_remote(rid))
+                self.engine._outputs.pop(rid, None)
 
         async for out in self.engine._drain_stream(rid):
             yield out

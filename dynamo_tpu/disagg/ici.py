@@ -1,0 +1,73 @@
+"""Same-pod (ICI) KV transfer for disaggregated prefill/decode.
+
+When the prefill and decode engines share a process (one TPU pod host serving
+both roles on different mesh slices, or colocated workers), KV blocks never
+need to touch host memory or the network data plane: the prefill side gathers
+the blocks into a device array (ModelRunner.extract_pages_device) and the
+decode side reshards it onto its own mesh with jax.device_put — on multi-chip
+hardware that transfer rides the inter-chip interconnect (ICI), the analogue
+of the reference's NIXL RDMA WRITE between GPUs (reference: patch
+vllm/distributed/device_communicators/nixl.py). The control message
+(PrefillResult) still travels the normal response plane; only the bulk KV
+payload is handed off in-process.
+
+The hub is a process-local registry: decode engines register under their
+worker id; a prefill worker that finds its target here uses the device path
+and parks the gathered array under the request id until the decode side
+adopts it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_local_workers: set[int] = set()  # decode worker ids served in this process
+_transfers: dict[str, object] = {}  # transfer key -> device array
+_total = 0  # device transfers ever started (observability/tests)
+
+
+def register_worker(worker_id: int) -> None:
+    with _lock:
+        _local_workers.add(worker_id)
+
+
+def unregister_worker(worker_id: int) -> None:
+    with _lock:
+        _local_workers.discard(worker_id)
+
+
+def is_local(worker_id: int) -> bool:
+    with _lock:
+        return worker_id in _local_workers
+
+
+def transfer_key(decode_worker_id: int, request_id: str) -> str:
+    """Request ids are only unique per decode worker; the key namespaces them
+    so colocated decode workers can never collide."""
+    return f"{decode_worker_id}/{request_id}"
+
+
+def put_transfer(transfer_id: str, data) -> None:
+    global _total
+    with _lock:
+        _transfers[transfer_id] = data
+        _total += 1
+
+
+def pop_transfer(transfer_id: str):
+    with _lock:
+        return _transfers.pop(transfer_id, None)
+
+
+def transfer_count() -> int:
+    """Parked (not yet adopted) transfers."""
+    with _lock:
+        return len(_transfers)
+
+
+def total_transfers() -> int:
+    """Device transfers ever started."""
+    with _lock:
+        return _total

@@ -69,13 +69,27 @@ class PrefillWorker:
             pass
 
     async def _handle(self, rp: RemotePrefillRequest) -> None:
+        from dynamo_tpu.disagg import ici
+
+        # same-pod decode worker? hand the KV off as a device array (ICI path:
+        # blocks reshard onto the decode mesh without touching host memory);
+        # otherwise stage to host and ship bytes over the data plane (DCN path)
+        device = ici.is_local(rp.decode_worker_id)
         result = await self.engine.run_on_engine(
-            lambda: self.engine.sync_remote_prefill(rp)
+            lambda: self.engine.sync_remote_prefill(rp, device=device)
         )
-        client = await self._client_for(rp.decode_endpoint)
-        # deliver directly to the requesting decode worker (KV over the TCP
-        # call-home data plane; the RDMA-WRITE + notify analogue)
-        stream = await client.direct(result.to_wire(), rp.decode_worker_id)
-        async for ack in stream:
-            if not ack.get("ok"):
-                raise RuntimeError(f"decode worker rejected prefill result: {ack}")
+        delivered = False
+        try:
+            client = await self._client_for(rp.decode_endpoint)
+            # deliver directly to the requesting decode worker (the RDMA-WRITE
+            # + notify analogue)
+            stream = await client.direct(result.to_wire(), rp.decode_worker_id)
+            async for ack in stream:
+                if not ack.get("ok"):
+                    raise RuntimeError(f"decode worker rejected prefill result: {ack}")
+            delivered = True
+        finally:
+            # finally (not except Exception): task cancellation must not leak
+            # the parked device array either
+            if not delivered and result.kv_transfer_id:
+                ici.pop_transfer(result.kv_transfer_id)

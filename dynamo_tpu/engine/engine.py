@@ -178,9 +178,15 @@ class AsyncJaxEngine:
         if request_id in self.allocator._seqs:
             self.allocator.free_sequence(request_id)
 
-    def sync_remote_prefill(self, rp) -> "object":
+    def sync_remote_prefill(self, rp, device: bool = False) -> "object":
         """Prefill side: full chunked prefill in our own cache (prefix cache
-        applies), then extract the requested block range to host."""
+        applies), then extract the requested block range.
+
+        device=False (DCN path): KV staged to host, returned as bytes in the
+        PrefillResult. device=True (same-pod ICI path): KV gathered into a
+        device array parked in the ici hub under the request id; the result
+        carries kv_transfer_id instead of bytes."""
+        from dynamo_tpu.disagg import ici
         from dynamo_tpu.engine.sampling import SamplingParams
         from dynamo_tpu.llm.remote_prefill import PrefillResult
 
@@ -203,10 +209,19 @@ class AsyncJaxEngine:
             start_page = rp.skip_leading_tokens // ps
             n_pages = -(-prompt_len // ps)
             ids = state.pages[start_page:n_pages]
-            data = self.runner.extract_pages(np.asarray(ids, np.int32)) if ids else None
+            data = None
+            if ids:
+                if device:
+                    data = self.runner.extract_pages_device(np.asarray(ids, np.int32))
+                else:
+                    data = self.runner.extract_pages(np.asarray(ids, np.int32))
         finally:
             self.allocator.free_sequence(rid)  # full blocks stay cached for reuse
 
+        transfer_id = ""
+        if device and data is not None:
+            transfer_id = ici.transfer_key(rp.decode_worker_id, rp.request_id)
+            ici.put_transfer(transfer_id, data)
         return PrefillResult(
             request_id=rp.request_id,
             first_token=int(first_token),
@@ -214,19 +229,32 @@ class AsyncJaxEngine:
             skip_leading_tokens=start_page * ps,
             kv_shape=tuple(data.shape) if data is not None else (),
             kv_dtype=str(data.dtype) if data is not None else "",
-            kv_bytes=data.tobytes() if data is not None else b"",
+            kv_bytes=data.tobytes() if (data is not None and not device) else b"",
+            kv_transfer_id=transfer_id,
         )
 
     def sync_adopt_prefilled(self, req: EngineRequest, result, cached_len: int):
         """Decode side: inject received KV blocks into the pre-allocated pages
-        and enter the sequence into decode."""
+        and enter the sequence into decode. KV arrives either as wire bytes
+        (DCN path) or as a device array via the ici hub (same-pod path)."""
+        from dynamo_tpu.disagg import ici
+
         state = self.allocator._seqs[req.request_id]
         ps = self.config.page_size
-        if result.kv_bytes:
+        data = None
+        if result.kv_transfer_id:
+            data = ici.pop_transfer(result.kv_transfer_id)
+            if data is None:
+                raise RuntimeError(
+                    f"ici transfer {result.kv_transfer_id} missing for {req.request_id}"
+                )
+        elif result.kv_bytes:
+            data = result.kv_array()
+        if data is not None:
             start_page = result.skip_leading_tokens // ps
             n_pages = -(-result.prompt_len // ps)
             ids = state.pages[start_page:n_pages]
-            self.runner.inject_pages(np.asarray(ids, np.int32), result.kv_array())
+            self.runner.inject_pages(np.asarray(ids, np.int32), data)
         self.allocator.commit_prefilled(req.request_id, result.prompt_len)
         outputs = self.scheduler.adopt_prefilled(req, result.first_token, cached_len)
         return None, outputs  # (value, stream outputs) convention

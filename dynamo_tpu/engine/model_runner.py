@@ -254,21 +254,34 @@ class ModelRunner:
             pass
         return toks
 
+    def extract_pages_device(self, page_ids: np.ndarray) -> jax.Array:
+        """Gather KV blocks into a device array [L, 2, n, page_size, Hkv, D]
+        WITHOUT a host copy — the same-pod (ICI) transfer path: the consumer
+        reshards it onto its own mesh with jax.device_put, so on multi-chip
+        hardware the blocks ride the interconnect, never host DRAM."""
+        return self._gather_pages(self.kv_cache, jnp.asarray(page_ids, jnp.int32))
+
     def extract_pages(self, page_ids: np.ndarray) -> np.ndarray:
         """Pull KV blocks to host: [L, 2, n, page_size, Hkv, D] numpy.
 
         The device gather runs jitted; the host copy is the DCN-transfer
-        staging step (same-pod ICI transfers skip this path).
+        staging step (same-pod ICI transfers use extract_pages_device).
         """
-        out = self._gather_pages(self.kv_cache, jnp.asarray(page_ids, jnp.int32))
-        return np.asarray(jax.device_get(out))
+        return np.asarray(jax.device_get(self.extract_pages_device(page_ids)))
 
-    def inject_pages(self, page_ids: np.ndarray, data: np.ndarray) -> None:
-        """Write KV blocks received from a peer into our pages (donated scatter)."""
+    def inject_pages(self, page_ids: np.ndarray, data) -> None:
+        """Write KV blocks received from a peer into our pages (donated
+        scatter). ``data`` may be host numpy (DCN path) or a device array from
+        a peer engine (ICI path) — device_put reshards it onto our mesh."""
+        if isinstance(data, jax.Array):
+            data = jax.device_put(
+                data, NamedSharding(self.mesh, P(None, None, None, None, "tp", None))
+            )
+            data = data.astype(self.kv_cache["k"].dtype)
+        else:
+            data = jnp.asarray(data, self.kv_cache["k"].dtype)
         self.kv_cache = self._scatter_pages(
-            self.kv_cache,
-            jnp.asarray(page_ids, jnp.int32),
-            jnp.asarray(data, self.kv_cache["k"].dtype),
+            self.kv_cache, jnp.asarray(page_ids, jnp.int32), data
         )
 
     def decode_steps(

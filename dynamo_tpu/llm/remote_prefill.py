@@ -47,6 +47,10 @@ class PrefillResult:
     kv_shape: tuple  # [L, 2, n_pages, page_size, Hkv, D]
     kv_dtype: str
     kv_bytes: bytes
+    # same-pod (ICI) handoff: when set, the KV payload is a device array parked
+    # in dynamo_tpu.disagg.ici under this id and kv_bytes stays empty — the
+    # decode side reshards it onto its mesh instead of deserializing bytes
+    kv_transfer_id: str = ""
 
     def to_wire(self) -> dict:
         return {
@@ -57,6 +61,7 @@ class PrefillResult:
             "kv_shape": list(self.kv_shape),
             "kv_dtype": self.kv_dtype,
             "kv_bytes": self.kv_bytes,
+            "kv_transfer_id": self.kv_transfer_id,
         }
 
     @classmethod
@@ -69,6 +74,7 @@ class PrefillResult:
             kv_shape=tuple(d["kv_shape"]),
             kv_dtype=d["kv_dtype"],
             kv_bytes=d["kv_bytes"],
+            kv_transfer_id=d.get("kv_transfer_id", ""),
         )
 
     def kv_array(self) -> np.ndarray:

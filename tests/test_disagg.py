@@ -44,7 +44,16 @@ LONG_PROMPT = [5, 9, 2, 77, 31, 8, 100, 42, 17, 3, 60, 61]  # 12 tokens > thresh
 SHORT_PROMPT = [5, 9, 2]
 
 
-def test_disagg_matches_local():
+@pytest.mark.parametrize("force_dcn", [False, True], ids=["ici", "dcn"])
+def test_disagg_matches_local(force_dcn, monkeypatch):
+    """force_dcn=False: same-process workers use the device (ICI) KV handoff.
+    force_dcn=True: the decode engine looks remote, so KV is host-staged and
+    shipped as bytes over the data plane (the cross-pod DCN path)."""
+    if force_dcn:
+        from dynamo_tpu.disagg import ici
+
+        monkeypatch.setattr(ici, "is_local", lambda worker_id: False)
+
     async def body():
         broker = Broker()
         port = await broker.start()
@@ -73,6 +82,9 @@ def test_disagg_matches_local():
         await prefill_worker.start()
 
         try:
+            from dynamo_tpu.disagg import ici
+
+            transfers_before = ici.total_transfers()
             # long prompt -> remote prefill path
             expected, _ = await collect(local_engine, req_for("ref1", LONG_PROMPT))
             got, finish = await collect(decode, req_for("d1", LONG_PROMPT))
@@ -80,6 +92,14 @@ def test_disagg_matches_local():
             assert finish == "length"
             assert decode.remote_prefills == 1
             assert prefill_worker.completed == 1
+            # same-process workers take the device (ICI) handoff, and the
+            # parked array is consumed on adoption; with the hub bypassed the
+            # KV must have travelled as bytes instead
+            if force_dcn:
+                assert ici.total_transfers() == transfers_before
+            else:
+                assert ici.total_transfers() == transfers_before + 1
+            assert ici.transfer_count() == 0
 
             # short prompt stays local
             expected_s, _ = await collect(local_engine, req_for("ref2", SHORT_PROMPT))
