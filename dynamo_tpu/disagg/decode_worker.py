@@ -126,15 +126,24 @@ class DisaggDecodeEngine:
             "remote prefill for %s (len=%d hit=%d depth=%d)",
             request.request_id, len(prompt), prefix_hit, queue_depth,
         )
+        from dynamo_tpu.disagg import ici
+
         rid = request.request_id
-        cached_len, shared_pages = await self.engine.run_on_engine(
-            lambda: self.engine.sync_allocate_remote(rid, prompt)
-        )
+        tkey = ici.transfer_key(self.worker_id, rid)
+        # a retry reusing this request id must not be swallowed by a tombstone
+        # left behind by an earlier cancelled attempt
+        ici.clear_tombstone(tkey)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
         self.engine._register_stream(rid)
         adopted = False
         try:
+            # inside the protected region: the engine thread allocates pages
+            # even if this coroutine is cancelled mid-await, and the abort in
+            # the finally is queued behind it (FIFO), so it always cleans up
+            cached_len, shared_pages = await self.engine.run_on_engine(
+                lambda: self.engine.sync_allocate_remote(rid, prompt)
+            )
             rp = RemotePrefillRequest(
                 request_id=rid,
                 token_ids=prompt,
@@ -153,13 +162,13 @@ class DisaggDecodeEngine:
             adopted = True
         finally:
             # finally (not except Exception): client cancellation raises
-            # CancelledError, which must run the same cleanup — including
-            # dropping a parked ICI transfer delivered but never adopted
+            # CancelledError, which must run the same cleanup — dropping any
+            # parked (or still in-flight) ICI transfer and aborting through
+            # the scheduler, since adoption may have completed on the engine
+            # thread even though our await was cancelled
             if not adopted:
-                from dynamo_tpu.disagg import ici
-
                 self._pending.pop(rid, None)
-                ici.pop_transfer(ici.transfer_key(self.worker_id, rid))
+                ici.discard_transfer(tkey)
                 await self.engine.run_on_engine(lambda: self.engine.sync_abort_remote(rid))
                 self.engine._outputs.pop(rid, None)
 

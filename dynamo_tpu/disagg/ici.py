@@ -25,6 +25,7 @@ from typing import Optional
 _lock = threading.Lock()
 _local_workers: set[int] = set()  # decode worker ids served in this process
 _transfers: dict[str, object] = {}  # transfer key -> device array
+_tombstones: set[str] = set()  # abandoned keys whose park is still in flight
 _total = 0  # device transfers ever started (observability/tests)
 
 
@@ -49,16 +50,42 @@ def transfer_key(decode_worker_id: int, request_id: str) -> str:
     return f"{decode_worker_id}/{request_id}"
 
 
-def put_transfer(transfer_id: str, data) -> None:
+def put_transfer(transfer_id: str, data) -> bool:
+    """Park a gathered device array. Returns False (and drops the data) when
+    the consumer already abandoned the request — its discard_transfer left a
+    tombstone because cancellation can land while the prefill engine thread is
+    still producing, i.e. before there is anything to pop."""
     global _total
     with _lock:
+        if transfer_id in _tombstones:
+            _tombstones.discard(transfer_id)
+            return False
         _transfers[transfer_id] = data
         _total += 1
+        return True
 
 
 def pop_transfer(transfer_id: str):
     with _lock:
         return _transfers.pop(transfer_id, None)
+
+
+def discard_transfer(transfer_id: str) -> None:
+    """Consumer-side abandon: drop the parked array now, or leave a tombstone
+    so a park that is still in flight on the producer side gets dropped on
+    arrival instead of leaking device memory."""
+    with _lock:
+        if _transfers.pop(transfer_id, None) is None:
+            if len(_tombstones) > 10000:  # degraded mode: cap growth, accept leaks
+                _tombstones.clear()
+            _tombstones.add(transfer_id)
+
+
+def clear_tombstone(transfer_id: str) -> None:
+    """Called when a request id is (re)used for a fresh remote prefill so a
+    stale tombstone from an earlier cancelled attempt can't swallow its KV."""
+    with _lock:
+        _tombstones.discard(transfer_id)
 
 
 def transfer_count() -> int:

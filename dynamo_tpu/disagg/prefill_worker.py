@@ -75,21 +75,32 @@ class PrefillWorker:
         # blocks reshard onto the decode mesh without touching host memory);
         # otherwise stage to host and ship bytes over the data plane (DCN path)
         device = ici.is_local(rp.decode_worker_id)
-        result = await self.engine.run_on_engine(
-            lambda: self.engine.sync_remote_prefill(rp, device=device)
-        )
+        tkey = ici.transfer_key(rp.decode_worker_id, rp.request_id) if device else ""
         delivered = False
         try:
+            # the engine thread parks the transfer even if this coroutine is
+            # cancelled mid-await, so the key is computed up front and the
+            # finally discards it (or tombstones a park still in flight)
+            result = await self.engine.run_on_engine(
+                lambda: self.engine.sync_remote_prefill(rp, device=device)
+            )
             client = await self._client_for(rp.decode_endpoint)
             # deliver directly to the requesting decode worker (the RDMA-WRITE
             # + notify analogue)
             stream = await client.direct(result.to_wire(), rp.decode_worker_id)
             async for ack in stream:
                 if not ack.get("ok"):
-                    raise RuntimeError(f"decode worker rejected prefill result: {ack}")
+                    # permanent rejection (request cancelled/unknown on the
+                    # decode side): drop the work — nacking would redeliver a
+                    # poisoned message forever and starve the queue
+                    log.warning(
+                        "decode worker rejected prefill result for %s: %s",
+                        rp.request_id, ack,
+                    )
+                    return
             delivered = True
         finally:
             # finally (not except Exception): task cancellation must not leak
             # the parked device array either
-            if not delivered and result.kv_transfer_id:
-                ici.pop_transfer(result.kv_transfer_id)
+            if not delivered and tkey:
+                ici.discard_transfer(tkey)

@@ -175,8 +175,14 @@ class AsyncJaxEngine:
         return cached_len, state.shared_prefix_pages
 
     def sync_abort_remote(self, request_id: str) -> None:
-        if request_id in self.allocator._seqs:
-            self.allocator.free_sequence(request_id)
+        """Abort a remote-prefill request at ANY stage: adoption may already
+        have completed on this thread even though the caller saw a
+        cancellation, in which case the sequence sits in a decode slot and
+        only scheduler.cancel releases both the slot and its pages (freeing
+        pages while the slot keeps decoding would corrupt their next owner)."""
+        if not self.scheduler.cancel(request_id):
+            if request_id in self.allocator._seqs:
+                self.allocator.free_sequence(request_id)
 
     def sync_remote_prefill(self, rp, device: bool = False) -> "object":
         """Prefill side: full chunked prefill in our own cache (prefix cache
@@ -221,7 +227,8 @@ class AsyncJaxEngine:
         transfer_id = ""
         if device and data is not None:
             transfer_id = ici.transfer_key(rp.decode_worker_id, rp.request_id)
-            ici.put_transfer(transfer_id, data)
+            if not ici.put_transfer(transfer_id, data):
+                transfer_id = ""  # consumer abandoned the request already
         return PrefillResult(
             request_id=rp.request_id,
             first_token=int(first_token),

@@ -44,16 +44,14 @@ class ModelRunner:
     ):
         self.config = config
         self.model = model
-        if config.tp > 1:
-            # the Pallas decode kernel is not yet shard_map-wrapped for TP;
-            # GSPMD cannot partition a pallas_call, so fall back to the XLA path
-            import os
-
-            os.environ.setdefault("DYNTPU_PALLAS", "0")
         if mesh is None:
             devices = jax.devices()[: config.tp]
             mesh = Mesh(np.array(devices).reshape(len(devices)), ("tp",))
         self.mesh = mesh
+        if config.tp > 1:
+            # the Pallas decode kernel runs under shard_map on this mesh
+            # (attention is head-parallel; no collectives inside)
+            model.attn_mesh = mesh
         shardings = model.param_shardings(mesh)
         self.params = jax.device_put(params, shardings)
         kv_sharding = model.kv_cache_sharding(mesh)

@@ -101,6 +101,9 @@ class LlamaModel:
 
     def __init__(self, config: LlamaConfig):
         self.config = config
+        # set by ModelRunner for tp>1 so the Pallas decode kernel can run
+        # under shard_map (GSPMD cannot partition a pallas_call)
+        self.attn_mesh = None
 
     # ---------------- params ----------------
 
@@ -309,7 +312,7 @@ class LlamaModel:
 
             def attn_fn(q, kp_, vp_):
                 return dispatch_paged_decode_attention(
-                    q, kp_, vp_, off + page_tables, positions
+                    q, kp_, vp_, off + page_tables, positions, mesh=self.attn_mesh
                 )
 
             h, kp, vp = self._layer(lp, h, kp, vp, positions, off + phys, offsets, attn_fn)

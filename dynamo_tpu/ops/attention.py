@@ -171,12 +171,47 @@ def use_pallas_decode(head_dim: int, num_kv_heads: int) -> bool:
     return _on_tpu() and head_dim % 128 == 0
 
 
-def dispatch_paged_decode_attention(q, k_pages, v_pages, page_tables, positions):
-    """Pallas kernel on TPU, pure-JAX reference elsewhere (same contract)."""
+def dispatch_paged_decode_attention(q, k_pages, v_pages, page_tables, positions, mesh=None):
+    """Pallas kernel on TPU, pure-JAX reference elsewhere (same contract).
+
+    With a tensor-parallel mesh the kernel runs under shard_map: attention is
+    head-parallel, so each device handles its Hq/Hkv shard with no
+    communication (GSPMD cannot partition a pallas_call by itself)."""
     if use_pallas_decode(q.shape[-1], k_pages.shape[2]):
         from dynamo_tpu.ops.pallas.paged_attention import paged_decode_attention_pallas
 
         interpret = not _on_tpu()
+        tp = 1 if mesh is None else mesh.shape.get("tp", 1)
+        if tp > 1:
+            import functools
+
+            from jax.sharding import PartitionSpec as P
+
+            try:
+                from jax import shard_map as _sm
+
+                # pallas_call outputs carry no vma info; disable the check
+                shard_map = functools.partial(_sm, check_vma=False)
+            except ImportError:  # older jax
+                from jax.experimental.shard_map import shard_map as _sm_old
+
+                shard_map = functools.partial(_sm_old, check_rep=False)
+
+            if q.shape[1] % tp or k_pages.shape[2] % tp:
+                return paged_decode_attention(q, k_pages, v_pages, page_tables, positions)
+            fn = functools.partial(paged_decode_attention_pallas, interpret=interpret)
+            return shard_map(
+                fn,
+                mesh=mesh,
+                in_specs=(
+                    P(None, "tp", None),  # q: heads sharded
+                    P(None, None, "tp", None),  # k pages: kv heads sharded
+                    P(None, None, "tp", None),  # v pages
+                    P(None, None),  # page tables replicated
+                    P(None),  # positions replicated
+                ),
+                out_specs=P(None, "tp", None),
+            )(q, k_pages, v_pages, page_tables, positions)
         return paged_decode_attention_pallas(
             q, k_pages, v_pages, page_tables, positions, interpret=interpret
         )

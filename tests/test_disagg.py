@@ -206,3 +206,68 @@ def test_disagg_router_decision_and_live_reload():
             await broker.stop()
 
     asyncio.run(body())
+
+
+def test_disagg_cancellation_no_leaks():
+    """Cancelling generate() mid-remote-prefill must leak neither decode-side
+    pages nor parked ICI transfers, and the engine must keep serving."""
+
+    async def body():
+        broker = Broker()
+        port = await broker.start()
+        addr = f"127.0.0.1:{port}"
+        decode_rt = DistributedRuntime(cplane_address=addr)
+        await decode_rt.connect()
+        prefill_rt = DistributedRuntime(cplane_address=addr)
+        await prefill_rt.connect()
+
+        decode_inner = AsyncJaxEngine(tiny_engine_config())
+        await decode_inner.start()
+        prefill_engine = AsyncJaxEngine(tiny_engine_config())
+        await prefill_engine.start()
+
+        router = DisaggregatedRouter(
+            "tiny", conf=DisaggRouterConf(max_local_prefill_length=6)
+        )
+        decode = DisaggDecodeEngine(
+            decode_inner, decode_rt, "ns", "decoder", "tiny", disagg_router=router
+        )
+        await decode.start()
+        prefill_worker = PrefillWorker(prefill_engine, prefill_rt, "ns", "tiny")
+        await prefill_worker.start()
+
+        from dynamo_tpu.disagg import ici
+
+        try:
+            for delay in (0.0, 0.05, 0.3):
+                task = asyncio.create_task(
+                    collect(decode, req_for(f"c{delay}", LONG_PROMPT))
+                )
+                await asyncio.sleep(delay)
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, TimeoutError):
+                    # wait_for's cancellation bookkeeping can surface either;
+                    # a late cancel may even let the request complete normally
+                    pass
+                await asyncio.sleep(0.3)  # let cleanup + zombie reconcile run
+                assert ici.transfer_count() == 0, "parked ICI transfer leaked"
+                # decode-side sequence state must be fully released
+                seqs = await decode_inner.run_on_engine(
+                    lambda: list(decode_inner.allocator._seqs.keys())
+                )
+                assert not [s for s in seqs if s.startswith("c")], f"leaked seqs {seqs}"
+
+            # engine still serves correctly after the cancellations
+            expected, _ = await collect(decode, req_for("after", LONG_PROMPT))
+            assert len(expected) == 6
+        finally:
+            await prefill_worker.stop()
+            await decode.shutdown()
+            await prefill_engine.shutdown()
+            await decode_rt._shutdown_hook()
+            await prefill_rt._shutdown_hook()
+            await broker.stop()
+
+    asyncio.run(body())
