@@ -1,0 +1,16 @@
+{{- define "dynamo-tpu.cplaneAddress" -}}
+{{- if .Values.cplane.enabled -}}
+{{ .Release.Name }}-cplane:{{ .Values.cplane.port }}
+{{- else -}}
+{{ required "cplane.address is required when cplane.enabled=false" .Values.cplane.address }}
+{{- end -}}
+{{- end }}
+
+{{- define "dynamo-tpu.labels" -}}
+app.kubernetes.io/part-of: {{ .Release.Name }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{- end }}
+
+{{- define "dynamo-tpu.image" -}}
+{{ .Values.image.repository }}:{{ .Values.image.tag }}
+{{- end }}

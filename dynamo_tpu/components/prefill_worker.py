@@ -1,0 +1,68 @@
+"""Standalone prefill worker: a JAX engine consuming the remote-prefill work
+queue for a model (reference: examples/llm/components/prefill_worker.py — the
+NATS-JetStream prefill consumer loop).
+
+    python -m dynamo_tpu.components.prefill_worker /models/llama-3-8b \
+        --namespace dynamo --tp 4
+
+The SDK graph variant lives in examples/graphs/disagg.py; this module is the
+plain-process deployment entry (helm: prefill-worker.yaml).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("components.prefill")
+
+
+async def _main(args) -> None:
+    from dynamo_tpu.disagg.prefill_worker import PrefillWorker
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    drt = DistributedRuntime(cplane_address=args.cplane)
+    await drt.connect()
+
+    if args.model.startswith("tiny"):
+        card = ModelDeploymentCard.for_tiny(args.model)
+    else:
+        card = ModelDeploymentCard.from_local_path(args.model)
+    engine = AsyncJaxEngine(
+        EngineConfig.for_model(
+            args.model,
+            tp=args.tp,
+            num_pages=args.num_pages,
+            max_seqs=args.max_seqs,
+        )
+    )
+    await engine.start()
+    worker = PrefillWorker(engine, drt, args.namespace, card.display_name)
+    await worker.start()
+    log.info("prefill worker up: model=%s namespace=%s", card.display_name, args.namespace)
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    finally:
+        await worker.stop()
+        await engine.shutdown()
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("model", help="model path or tiny:{...} spec")
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--num-pages", type=int, default=512)
+    p.add_argument("--max-seqs", type=int, default=8)
+    p.add_argument("--cplane", default=None)
+    asyncio.run(_main(p.parse_args(argv)))
+
+
+if __name__ == "__main__":
+    main()

@@ -22,11 +22,25 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+import time
+
 _lock = threading.Lock()
 _local_workers: set[int] = set()  # decode worker ids served in this process
 _transfers: dict[str, object] = {}  # transfer key -> device array
-_tombstones: set[str] = set()  # abandoned keys whose park is still in flight
+# abandoned keys whose park may still be in flight -> tombstone timestamp.
+# TTL'd: a park that hasn't landed within the TTL never will (it's queued on
+# an engine thread in this process), so stale entries are pruned instead of
+# ever clearing the whole set (which could drop live tombstones and leak).
+_tombstones: dict[str, float] = {}
+_TOMBSTONE_TTL_S = 600.0
 _total = 0  # device transfers ever started (observability/tests)
+
+
+def _prune_tombstones_locked(now: float) -> None:
+    if len(_tombstones) > 1024:
+        dead = [k for k, t in _tombstones.items() if now - t > _TOMBSTONE_TTL_S]
+        for k in dead:
+            del _tombstones[k]
 
 
 def register_worker(worker_id: int) -> None:
@@ -58,7 +72,7 @@ def put_transfer(transfer_id: str, data) -> bool:
     global _total
     with _lock:
         if transfer_id in _tombstones:
-            _tombstones.discard(transfer_id)
+            del _tombstones[transfer_id]
             return False
         _transfers[transfer_id] = data
         _total += 1
@@ -74,18 +88,18 @@ def discard_transfer(transfer_id: str) -> None:
     """Consumer-side abandon: drop the parked array now, or leave a tombstone
     so a park that is still in flight on the producer side gets dropped on
     arrival instead of leaking device memory."""
+    now = time.monotonic()
     with _lock:
         if _transfers.pop(transfer_id, None) is None:
-            if len(_tombstones) > 10000:  # degraded mode: cap growth, accept leaks
-                _tombstones.clear()
-            _tombstones.add(transfer_id)
+            _prune_tombstones_locked(now)
+            _tombstones[transfer_id] = now
 
 
 def clear_tombstone(transfer_id: str) -> None:
     """Called when a request id is (re)used for a fresh remote prefill so a
     stale tombstone from an earlier cancelled attempt can't swallow its KV."""
     with _lock:
-        _tombstones.discard(transfer_id)
+        _tombstones.pop(transfer_id, None)
 
 
 def transfer_count() -> int:

@@ -76,11 +76,14 @@ class PrefillWorker:
         # otherwise stage to host and ship bytes over the data plane (DCN path)
         device = ici.is_local(rp.decode_worker_id)
         tkey = ici.transfer_key(rp.decode_worker_id, rp.request_id) if device else ""
+        if tkey:
+            # a redelivered message must not be swallowed by a tombstone a
+            # cancelled earlier attempt (possibly a colocated sibling worker)
+            # left behind
+            ici.clear_tombstone(tkey)
+        result = None
         delivered = False
         try:
-            # the engine thread parks the transfer even if this coroutine is
-            # cancelled mid-await, so the key is computed up front and the
-            # finally discards it (or tombstones a park still in flight)
             result = await self.engine.run_on_engine(
                 lambda: self.engine.sync_remote_prefill(rp, device=device)
             )
@@ -99,8 +102,15 @@ class PrefillWorker:
                     )
                     return
             delivered = True
-        finally:
-            # finally (not except Exception): task cancellation must not leak
-            # the parked device array either
-            if not delivered and tkey:
+        except BaseException:
+            if tkey and result is None:
+                # cancelled (or failed) while the engine thread may still be
+                # producing: the park could land after us, so tombstone it.
+                # An ordinary exception from sync_remote_prefill means nothing
+                # was parked and the tombstone is TTL-pruned harmlessly.
                 ici.discard_transfer(tkey)
+            raise
+        finally:
+            if not delivered and result is not None and result.kv_transfer_id:
+                # park happened but delivery/ack failed: drop the real array
+                ici.pop_transfer(result.kv_transfer_id)

@@ -257,11 +257,19 @@ class AsyncJaxEngine:
                 )
         elif result.kv_bytes:
             data = result.kv_array()
+        start_page = result.skip_leading_tokens // ps
+        n_pages = -(-result.prompt_len // ps)
+        ids = state.pages[start_page:n_pages]
         if data is not None:
-            start_page = result.skip_leading_tokens // ps
-            n_pages = -(-result.prompt_len // ps)
-            ids = state.pages[start_page:n_pages]
             self.runner.inject_pages(np.asarray(ids, np.int32), data)
+        elif ids:
+            # pages were expected to be filled remotely but the result carried
+            # no KV (e.g. a swallowed transfer): adopting would decode from
+            # uninitialized pages — fail the request loudly instead
+            raise RuntimeError(
+                f"prefill result for {req.request_id} carried no KV for "
+                f"{len(ids)} pending pages"
+            )
         self.allocator.commit_prefilled(req.request_id, result.prompt_len)
         outputs = self.scheduler.adopt_prefilled(req, result.first_token, cached_len)
         return None, outputs  # (value, stream outputs) convention
