@@ -73,8 +73,8 @@ class ModelRunner:
         )
         # block-granularity KV IO for disaggregation / offload
         # (the NIXL-slot replacement, reference: patch nixl.py register_kv_caches).
-        # The wire format stays [L, 2, n, ps, Hkv, D] (canonical layout for DCN
-        # transfer / host offload); on device the pools are flat [L*P, ...].
+        # The model defines its canonical wire layout (llama: [L,2,n,ps,Hkv,D];
+        # MLA: [L,n,ps,latent]); on device the pools are flat [L*P, ...].
         L = model.config.num_layers
         Pn = config.num_pages
 
@@ -82,15 +82,10 @@ class ModelRunner:
             return ids[None, :] + (jnp.arange(L, dtype=jnp.int32) * Pn)[:, None]
 
         self._gather_pages = jax.jit(
-            lambda kv, ids: jnp.stack(
-                [kv["k"][_flat_ids(ids)], kv["v"][_flat_ids(ids)]], axis=1
-            )
+            lambda kv, ids: model.gather_pages_wire(kv, _flat_ids(ids))
         )
         self._scatter_pages = jax.jit(
-            lambda kv, ids, data: {
-                "k": kv["k"].at[_flat_ids(ids)].set(data[:, 0]),
-                "v": kv["v"].at[_flat_ids(ids)].set(data[:, 1]),
-            },
+            lambda kv, ids, data: model.scatter_pages_wire(kv, _flat_ids(ids), data),
             donate_argnums=(0,),
         )
 
@@ -271,13 +266,12 @@ class ModelRunner:
         """Write KV blocks received from a peer into our pages (donated
         scatter). ``data`` may be host numpy (DCN path) or a device array from
         a peer engine (ICI path) — device_put reshards it onto our mesh."""
+        dt = jax.tree.leaves(self.kv_cache)[0].dtype
         if isinstance(data, jax.Array):
-            data = jax.device_put(
-                data, NamedSharding(self.mesh, P(None, None, None, None, "tp", None))
-            )
-            data = data.astype(self.kv_cache["k"].dtype)
+            data = jax.device_put(data, self.model.wire_sharding(self.mesh))
+            data = data.astype(dt)
         else:
-            data = jnp.asarray(data, self.kv_cache["k"].dtype)
+            data = jnp.asarray(data, dt)
         self.kv_cache = self._scatter_pages(
             self.kv_cache, jnp.asarray(page_ids, jnp.int32), data
         )

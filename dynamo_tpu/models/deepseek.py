@@ -1,0 +1,479 @@
+"""DeepSeek-V2/V3-family model: Multi-head Latent Attention (MLA) + MoE with
+shared experts, in pure JAX with a paged *latent* KV cache.
+
+Why this family matters here: the reference's disaggregation patch explicitly
+extends vLLM's deepseek_v2 model for MLA + disagg (reference: patch
+`+++ b/vllm/model_executor/models/deepseek_v2.py`, SURVEY.md §2.4/§2.8), and
+MLA is the strongest long-context lever available: the cache stores one
+``kv_lora_rank + qk_rope_head_dim`` latent vector per token instead of
+``2 * Hkv * head_dim`` — ~10-25x less HBM per token, which multiplies the
+usable context length / batch on a TPU chip.
+
+TPU-first design:
+  - **Absorbed (weight-folded) attention everywhere**: scores are computed
+    directly against the cached latents (q folded through the k-up projection,
+    outputs folded through the v-up projection), so decode is two dense
+    einsums over ``[S, d_c + d_r]`` — MXU-shaped, no per-head KV expansion and
+    no gather of materialized K/V.
+  - The latent cache is a flat page pool ``{"ckv": [L*P, ps, d_c + d_r]}``
+    carried through the layer scans and donated (same in-place scatter
+    property as the Llama pool; see dynamo_tpu/ops/attention.py).
+  - Layers are scan-stacked in two homogeneous groups (DeepSeek interleaves
+    dense and MoE layers: the first ``first_k_dense_replace`` are dense MLP,
+    the rest are shared-expert + routed-expert MoE), one compiled body each.
+  - Tensor parallelism: per-head projections (q up, k-up, v-up, o) shard on
+    the ``tp`` axis; the latent path (down-projections, cache) is replicated —
+    it is head-independent by construction. Routed experts shard on ``ep``.
+
+Cache-content convention: the pool row for a token stores
+``[rms_norm(c_latent), rope(k_rope)]`` — the normalized latent and the
+position-rotated shared rope key, i.e. exactly what the absorbed score needs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dynamo_tpu.ops.moe import moe_block
+from dynamo_tpu.ops.norms import rms_norm
+from dynamo_tpu.ops.rotary import apply_rope
+
+_NEG_INF = -1e30
+
+
+@dataclass(frozen=True)
+class DeepseekConfig:
+    vocab_size: int = 102400
+    hidden_size: int = 5120
+    intermediate_size: int = 12288  # dense layers' MLP width
+    num_layers: int = 60
+    num_heads: int = 128
+    # MLA geometry
+    q_lora_rank: Optional[int] = 1536  # None => plain q projection
+    kv_lora_rank: int = 512  # d_c
+    qk_nope_head_dim: int = 128  # d_n
+    qk_rope_head_dim: int = 64  # d_r
+    v_head_dim: int = 128  # d_v
+    # MoE geometry
+    n_routed_experts: int = 160
+    num_experts_per_tok: int = 6
+    n_shared_experts: int = 2
+    moe_intermediate_size: int = 1536
+    first_k_dense_replace: int = 1
+    moe_capacity_factor: float = 2.0
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def latent_dim(self) -> int:
+        """Cache row width: latent + shared rope key."""
+        return self.kv_lora_rank + self.qk_rope_head_dim
+
+    @classmethod
+    def from_hf_config(cls, d: dict) -> "DeepseekConfig":
+        """Build from a HuggingFace deepseek_v2/v3 config.json dict."""
+        return cls(
+            vocab_size=d["vocab_size"],
+            hidden_size=d["hidden_size"],
+            intermediate_size=d["intermediate_size"],
+            num_layers=d["num_hidden_layers"],
+            num_heads=d["num_attention_heads"],
+            q_lora_rank=d.get("q_lora_rank"),
+            kv_lora_rank=d.get("kv_lora_rank", 512),
+            qk_nope_head_dim=d.get("qk_nope_head_dim", 128),
+            qk_rope_head_dim=d.get("qk_rope_head_dim", 64),
+            v_head_dim=d.get("v_head_dim", 128),
+            n_routed_experts=d.get("n_routed_experts", 64),
+            num_experts_per_tok=d.get("num_experts_per_tok", 6),
+            n_shared_experts=d.get("n_shared_experts", 2),
+            moe_intermediate_size=d.get("moe_intermediate_size", 1408),
+            first_k_dense_replace=d.get("first_k_dense_replace", 1),
+            rope_theta=d.get("rope_theta", 10000.0),
+            rms_norm_eps=d.get("rms_norm_eps", 1e-6),
+        )
+
+    @classmethod
+    def tiny_mla(cls, **overrides) -> "DeepseekConfig":
+        """Small config for tests (1 dense + 1 MoE layer)."""
+        from dynamo_tpu.models.llama import parse_dtype
+
+        if "dtype" in overrides:
+            overrides["dtype"] = parse_dtype(overrides["dtype"])
+        base = cls(
+            vocab_size=256,
+            hidden_size=64,
+            intermediate_size=128,
+            num_layers=2,
+            num_heads=4,
+            q_lora_rank=None,
+            kv_lora_rank=32,
+            qk_nope_head_dim=16,
+            qk_rope_head_dim=8,
+            v_head_dim=16,
+            n_routed_experts=4,
+            num_experts_per_tok=2,
+            n_shared_experts=1,
+            moe_intermediate_size=32,
+            first_k_dense_replace=1,
+            moe_capacity_factor=8.0,  # exact (no drops) at test scale
+            dtype=jnp.float32,
+        )
+        return replace(base, **overrides)
+
+
+class DeepseekModel:
+    """Stateless forward functions over a params pytree (MLA + MoE)."""
+
+    def __init__(self, config: DeepseekConfig):
+        self.config = config
+        self.attn_mesh = None  # parity with LlamaModel; MLA uses the XLA path
+
+    # ---------------- params ----------------
+
+    def _attn_params(self, keys, L: int) -> dict:
+        c = self.config
+
+        def dense(key, shape, scale_axis):
+            scale = 1.0 / jnp.sqrt(jnp.float32(shape[scale_axis]))
+            return (jax.random.normal(key, shape, jnp.float32) * scale).astype(c.dtype)
+
+        D, H = c.hidden_size, c.num_heads
+        dn, dr, dv, dc = (
+            c.qk_nope_head_dim,
+            c.qk_rope_head_dim,
+            c.v_head_dim,
+            c.kv_lora_rank,
+        )
+        p = {
+            "input_norm": jnp.ones((L, D), c.dtype),
+            "w_dkv": dense(next(keys), (L, D, dc + dr), 1),
+            "kv_norm": jnp.ones((L, dc), c.dtype),
+            # k-up and v-up projections from the latent, per head
+            "w_kb": dense(next(keys), (L, dc, H, dn), 1),
+            "w_vb": dense(next(keys), (L, dc, H, dv), 1),
+            "wo": dense(next(keys), (L, H * dv, D), 1),
+            "post_norm": jnp.ones((L, D), c.dtype),
+        }
+        if c.q_lora_rank:
+            p["w_dq"] = dense(next(keys), (L, D, c.q_lora_rank), 1)
+            p["q_norm"] = jnp.ones((L, c.q_lora_rank), c.dtype)
+            p["w_uq"] = dense(next(keys), (L, c.q_lora_rank, H * (dn + dr)), 1)
+        else:
+            p["w_q"] = dense(next(keys), (L, D, H * (dn + dr)), 1)
+        return p
+
+    def init_params(self, rng: jax.Array) -> dict:
+        c = self.config
+        keys = iter(jax.random.split(rng, 48))
+
+        def dense(key, shape, scale_axis):
+            scale = 1.0 / jnp.sqrt(jnp.float32(shape[scale_axis]))
+            return (jax.random.normal(key, shape, jnp.float32) * scale).astype(c.dtype)
+
+        D, F, V, E = (
+            c.hidden_size,
+            c.intermediate_size,
+            c.vocab_size,
+            c.n_routed_experts,
+        )
+        Fm, Fs = c.moe_intermediate_size, c.n_shared_experts * c.moe_intermediate_size
+        Ld, Lm = c.first_k_dense_replace, c.num_layers - c.first_k_dense_replace
+
+        dense_layers = self._attn_params(keys, Ld)
+        dense_layers.update(
+            {
+                "gate": dense(next(keys), (Ld, D, F), 1),
+                "up": dense(next(keys), (Ld, D, F), 1),
+                "down": dense(next(keys), (Ld, F, D), 1),
+            }
+        )
+        moe_layers = self._attn_params(keys, Lm)
+        moe_layers.update(
+            {
+                "router": dense(next(keys), (Lm, D, E), 1).astype(jnp.float32),
+                "w_gate": dense(next(keys), (Lm, E, D, Fm), 2),
+                "w_up": dense(next(keys), (Lm, E, D, Fm), 2),
+                "w_down": dense(next(keys), (Lm, E, Fm, D), 2),
+                "shared_gate": dense(next(keys), (Lm, D, Fs), 1),
+                "shared_up": dense(next(keys), (Lm, D, Fs), 1),
+                "shared_down": dense(next(keys), (Lm, Fs, D), 1),
+            }
+        )
+        return {
+            "embed": dense(next(keys), (V, D), 1),
+            "dense_layers": dense_layers,
+            "moe_layers": moe_layers,
+            "final_norm": jnp.ones((D,), c.dtype),
+            "lm_head": dense(next(keys), (V, D), 1),
+        }
+
+    def param_shardings(self, mesh: Mesh, tp_axis: str = "tp", ep_axis: str = "ep") -> dict:
+        c = self.config
+        tp = tp_axis if tp_axis in mesh.axis_names else None
+        ep = ep_axis if ep_axis in mesh.axis_names else None
+
+        def ns(*spec):
+            return NamedSharding(mesh, P(*spec))
+
+        def attn():
+            p = {
+                "input_norm": ns(None, None),
+                "w_dkv": ns(None, None, None),
+                "kv_norm": ns(None, None),
+                "w_kb": ns(None, None, tp, None),
+                "w_vb": ns(None, None, tp, None),
+                "wo": ns(None, tp, None),
+                "post_norm": ns(None, None),
+            }
+            if c.q_lora_rank:
+                p["w_dq"] = ns(None, None, None)
+                p["q_norm"] = ns(None, None)
+                p["w_uq"] = ns(None, None, tp)
+            else:
+                p["w_q"] = ns(None, None, tp)
+            return p
+
+        dense_layers = attn()
+        dense_layers.update(
+            {"gate": ns(None, None, tp), "up": ns(None, None, tp), "down": ns(None, tp, None)}
+        )
+        moe_layers = attn()
+        moe_layers.update(
+            {
+                "router": ns(None, None, None),
+                "w_gate": ns(None, ep, None, None),
+                "w_up": ns(None, ep, None, None),
+                "w_down": ns(None, ep, None, None),
+                "shared_gate": ns(None, None, tp),
+                "shared_up": ns(None, None, tp),
+                "shared_down": ns(None, tp, None),
+            }
+        )
+        return {
+            "embed": ns(None, None),
+            "dense_layers": dense_layers,
+            "moe_layers": moe_layers,
+            "final_norm": ns(None),
+            "lm_head": ns(tp, None),
+        }
+
+    # ---------------- KV cache (paged latents) ----------------
+
+    def kv_cache_shape(self, num_pages: int, page_size: int) -> tuple[int, ...]:
+        c = self.config
+        return (c.num_layers * num_pages, page_size, c.latent_dim)
+
+    def init_kv_cache(self, num_pages: int, page_size: int) -> dict:
+        return {"ckv": jnp.zeros(self.kv_cache_shape(num_pages, page_size), self.config.dtype)}
+
+    def kv_cache_sharding(self, mesh: Mesh, tp_axis: str = "tp") -> dict:
+        # the latent cache is head-independent: replicated across tp
+        return {"ckv": NamedSharding(mesh, P(None, None, None))}
+
+    def _layer_offsets(self, num_pages: int, start_layer: int, n_layers: int) -> jnp.ndarray:
+        return (start_layer + jnp.arange(n_layers, dtype=jnp.int32)) * num_pages
+
+    # ---------------- disagg / offload wire format ----------------
+
+    def gather_pages_wire(self, kv: dict, flat_ids: jnp.ndarray) -> jnp.ndarray:
+        """[L, n] flat page ids -> wire array [L, n, ps, latent_dim]."""
+        return kv["ckv"][flat_ids]
+
+    def scatter_pages_wire(self, kv: dict, flat_ids: jnp.ndarray, data: jnp.ndarray) -> dict:
+        return {"ckv": kv["ckv"].at[flat_ids].set(data.astype(kv["ckv"].dtype))}
+
+    def wire_sharding(self, mesh: Mesh) -> NamedSharding:
+        return NamedSharding(mesh, P(None, None, None, None))
+
+    # ---------------- attention core ----------------
+
+    def _queries(self, lp: dict, h: jnp.ndarray, positions: jnp.ndarray):
+        """h [T, D] -> (q_nope [T, H, dn], q_rope [T, H, dr] roped)."""
+        c = self.config
+        T = h.shape[0]
+        H, dn, dr = c.num_heads, c.qk_nope_head_dim, c.qk_rope_head_dim
+        if c.q_lora_rank:
+            ql = rms_norm(h @ lp["w_dq"], lp["q_norm"], c.rms_norm_eps)
+            q = (ql @ lp["w_uq"]).reshape(T, H, dn + dr)
+        else:
+            q = (h @ lp["w_q"]).reshape(T, H, dn + dr)
+        q_nope, q_rope = q[..., :dn], q[..., dn:]
+        q_rope = apply_rope(q_rope, positions, c.rope_theta)
+        return q_nope, q_rope
+
+    def _cache_rows(self, lp: dict, h: jnp.ndarray, positions: jnp.ndarray) -> jnp.ndarray:
+        """h [T, D] -> cache rows [T, latent_dim] = [norm(latent), roped k_rope]."""
+        c = self.config
+        dc = c.kv_lora_rank
+        ckv = h @ lp["w_dkv"]  # [T, dc + dr]
+        latent = rms_norm(ckv[:, :dc], lp["kv_norm"], c.rms_norm_eps)
+        k_rope = apply_rope(ckv[:, None, dc:], positions, c.rope_theta)[:, 0]
+        return jnp.concatenate([latent, k_rope], axis=-1).astype(c.dtype)
+
+    def _absorbed_attention(
+        self,
+        lp: dict,
+        q_nope: jnp.ndarray,  # [T, H, dn]
+        q_rope: jnp.ndarray,  # [T, H, dr] (roped)
+        ctx: jnp.ndarray,  # [S, latent_dim] gathered cache rows (logical order)
+        q_positions: jnp.ndarray,  # [T]
+    ) -> jnp.ndarray:
+        """Causal attention against cached latents; returns [T, H*dv]."""
+        c = self.config
+        dc = c.kv_lora_rank
+        scale = 1.0 / jnp.sqrt(jnp.float32(c.qk_nope_head_dim + c.qk_rope_head_dim))
+        latents = ctx[:, :dc].astype(jnp.float32)  # [S, dc]
+        k_rope = ctx[:, dc:].astype(jnp.float32)  # [S, dr]
+
+        # fold q through the k-up projection: [T, H, dc]
+        q_eff = jnp.einsum(
+            "thn,chn->thc", q_nope.astype(jnp.float32), lp["w_kb"].astype(jnp.float32)
+        )
+        scores = (
+            jnp.einsum("thc,sc->hts", q_eff, latents)
+            + jnp.einsum("thr,sr->hts", q_rope.astype(jnp.float32), k_rope)
+        ) * scale
+        ctx_idx = jnp.arange(ctx.shape[0], dtype=jnp.int32)
+        mask = ctx_idx[None, :] <= q_positions[:, None]  # [T, S]
+        scores = jnp.where(mask[None, :, :], scores, _NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)  # [H, T, S]
+        # attend in latent space, then fold through the v-up projection
+        a_lat = jnp.einsum("hts,sc->thc", probs, latents)  # [T, H, dc]
+        out = jnp.einsum(
+            "thc,chv->thv", a_lat, lp["w_vb"].astype(jnp.float32)
+        )  # [T, H, dv]
+        return out.astype(self.config.dtype).reshape(out.shape[0], -1)
+
+    def _layer(
+        self,
+        lp: dict,
+        hidden: jnp.ndarray,  # [T, D]
+        pool: jnp.ndarray,  # [LP, ps, latent_dim] (carried)
+        positions: jnp.ndarray,
+        flat_phys: jnp.ndarray,
+        offsets: jnp.ndarray,
+        gather_tables: jnp.ndarray,  # [max_pages] or [B, max_pages] flat ids
+        moe: bool,
+    ):
+        c = self.config
+        T = hidden.shape[0]
+        h = rms_norm(hidden, lp["input_norm"], c.rms_norm_eps)
+        q_nope, q_rope = self._queries(lp, h, positions)
+        rows = self._cache_rows(lp, h, positions)
+        pool = pool.at[flat_phys, offsets].set(rows)
+
+        if gather_tables.ndim == 1:
+            ps = pool.shape[1]
+            ctx = pool[gather_tables].reshape(gather_tables.shape[0] * ps, c.latent_dim)
+            attn = self._absorbed_attention(lp, q_nope, q_rope, ctx, positions)
+        else:
+            ps = pool.shape[1]
+
+            def one(qn_b, qr_b, pt_b, pos_b):
+                ctx = pool[pt_b].reshape(pt_b.shape[0] * ps, c.latent_dim)
+                return self._absorbed_attention(
+                    lp, qn_b[None], qr_b[None], ctx, pos_b[None]
+                )[0]
+
+            attn = jax.vmap(one)(q_nope, q_rope, gather_tables, positions)
+
+        hidden = hidden + attn @ lp["wo"]
+        h = rms_norm(hidden, lp["post_norm"], c.rms_norm_eps)
+        if moe:
+            shared = (jax.nn.silu(h @ lp["shared_gate"]) * (h @ lp["shared_up"])) @ lp[
+                "shared_down"
+            ]
+            routed = moe_block(
+                h,
+                lp["router"],
+                lp["w_gate"],
+                lp["w_up"],
+                lp["w_down"],
+                num_experts_per_tok=c.num_experts_per_tok,
+                capacity_factor=c.moe_capacity_factor,
+            )
+            hidden = hidden + shared + routed
+        else:
+            mlp = (jax.nn.silu(h @ lp["gate"]) * (h @ lp["up"])) @ lp["down"]
+            hidden = hidden + mlp
+        return hidden, pool
+
+    def _unembed(self, params: dict, hidden: jnp.ndarray) -> jnp.ndarray:
+        h = rms_norm(hidden, params["final_norm"], self.config.rms_norm_eps)
+        return jax.lax.dot_general(
+            h, params["lm_head"], (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    def _forward(
+        self,
+        params: dict,
+        pool: jnp.ndarray,
+        hidden: jnp.ndarray,
+        positions: jnp.ndarray,
+        phys: jnp.ndarray,  # logical phys page per token (trash=0)
+        offsets: jnp.ndarray,
+        tables: jnp.ndarray,  # [max_pages] or [B, max_pages] logical ids
+        num_pages: int,
+    ):
+        c = self.config
+        Ld = c.first_k_dense_replace
+
+        def group(hidden, pool, lp_group, start, n, moe):
+            offs = self._layer_offsets(num_pages, start, n)
+
+            def body(carry, xs):
+                h, pl = carry
+                lp, off = xs
+                h, pl = self._layer(
+                    lp, h, pl, positions, off + phys, offsets, off + tables, moe
+                )
+                return (h, pl), None
+
+            (hidden, pool), _ = jax.lax.scan(body, (hidden, pool), (lp_group, offs))
+            return hidden, pool
+
+        if Ld > 0:
+            hidden, pool = group(hidden, pool, params["dense_layers"], 0, Ld, False)
+        if c.num_layers - Ld > 0:
+            hidden, pool = group(
+                hidden, pool, params["moe_layers"], Ld, c.num_layers - Ld, True
+            )
+        return hidden, pool
+
+    # ---------------- public forward API (ModelRunner contract) ----------------
+
+    def prefill(self, params, kv_cache, tokens, positions, page_table, valid, last_idx):
+        c = self.config
+        pool = kv_cache["ckv"]
+        page_size = pool.shape[1]
+        num_pages = pool.shape[0] // c.num_layers
+        phys = jnp.where(valid, page_table[positions // page_size], 0)
+        offsets = jnp.where(valid, positions % page_size, 0)
+        hidden = params["embed"][tokens].astype(c.dtype)
+        hidden, pool = self._forward(
+            params, pool, hidden, positions, phys, offsets, page_table, num_pages
+        )
+        logits = self._unembed(params, hidden[last_idx][None, :])[0]
+        return logits, {"ckv": pool}
+
+    def decode(self, params, kv_cache, tokens, positions, page_tables, active):
+        c = self.config
+        pool = kv_cache["ckv"]
+        page_size = pool.shape[1]
+        num_pages = pool.shape[0] // c.num_layers
+        B = tokens.shape[0]
+        logical = positions // page_size
+        phys = jnp.where(active, page_tables[jnp.arange(B), logical], 0)
+        offsets = jnp.where(active, positions % page_size, 0)
+        hidden = params["embed"][tokens].astype(c.dtype)
+        hidden, pool = self._forward(
+            params, pool, hidden, positions, phys, offsets, page_tables, num_pages
+        )
+        logits = self._unembed(params, hidden)
+        return logits, {"ckv": pool}

@@ -39,6 +39,18 @@ from dynamo_tpu.ops.norms import rms_norm
 from dynamo_tpu.ops.rotary import apply_rope
 
 
+def parse_dtype(value) -> Any:
+    """Accept a jnp dtype or its string alias in tiny:{...} config overrides."""
+    if isinstance(value, str):
+        return {
+            "bf16": jnp.bfloat16,
+            "bfloat16": jnp.bfloat16,
+            "f32": jnp.float32,
+            "float32": jnp.float32,
+        }[value]
+    return value
+
+
 @dataclass(frozen=True)
 class LlamaConfig:
     vocab_size: int = 32000
@@ -76,13 +88,8 @@ class LlamaConfig:
     @classmethod
     def tiny(cls, **overrides) -> "LlamaConfig":
         """Small config for tests (runs on the virtual CPU mesh in seconds)."""
-        if isinstance(overrides.get("dtype"), str):
-            overrides["dtype"] = {
-                "bf16": jnp.bfloat16,
-                "bfloat16": jnp.bfloat16,
-                "f32": jnp.float32,
-                "float32": jnp.float32,
-            }[overrides["dtype"]]
+        if "dtype" in overrides:
+            overrides["dtype"] = parse_dtype(overrides["dtype"])
         base = cls(
             vocab_size=256,
             hidden_size=64,
@@ -195,6 +202,24 @@ class LlamaModel:
     def _layer_offsets(self, num_pages: int) -> jnp.ndarray:
         """[L] flat-pool offset of each layer's page 0 (its trash page)."""
         return jnp.arange(self.config.num_layers, dtype=jnp.int32) * num_pages
+
+    # ---------------- disagg / offload wire format ----------------
+    # The wire layout is the model's canonical block serialization for DCN
+    # transfer and host offload; flat_ids is [L, n] (per-layer flat page ids).
+
+    def gather_pages_wire(self, kv: dict, flat_ids: jnp.ndarray) -> jnp.ndarray:
+        """-> [L, 2, n, page_size, Hkv, D]."""
+        return jnp.stack([kv["k"][flat_ids], kv["v"][flat_ids]], axis=1)
+
+    def scatter_pages_wire(self, kv: dict, flat_ids: jnp.ndarray, data: jnp.ndarray) -> dict:
+        dt = kv["k"].dtype
+        return {
+            "k": kv["k"].at[flat_ids].set(data[:, 0].astype(dt)),
+            "v": kv["v"].at[flat_ids].set(data[:, 1].astype(dt)),
+        }
+
+    def wire_sharding(self, mesh: Mesh, tp_axis: str = "tp") -> NamedSharding:
+        return NamedSharding(mesh, P(None, None, None, None, tp_axis, None))
 
     # ---------------- forward ----------------
 

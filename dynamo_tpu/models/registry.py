@@ -34,6 +34,16 @@ def load_model(model_id: str, seed: int = 0):
         jax.block_until_ready(params)
         return model, params
 
+    if model_id is not None and (model_id == "tiny-mla" or model_id.startswith("tiny-mla:")):
+        from dynamo_tpu.models.deepseek import DeepseekConfig, DeepseekModel
+
+        overrides = json.loads(model_id.split(":", 1)[1]) if ":" in model_id else {}
+        cfg = DeepseekConfig.tiny_mla(**overrides)
+        model = DeepseekModel(cfg)
+        params = jax.jit(lambda key: model.init_params(key))(jax.random.key(seed))
+        jax.block_until_ready(params)
+        return model, params
+
     if model_id is None or model_id == "tiny" or model_id.startswith("tiny:"):
         overrides = {}
         if model_id and ":" in model_id:
@@ -56,6 +66,12 @@ def load_model(model_id: str, seed: int = 0):
             cfg = MixtralConfig.from_hf_config(hf_cfg)
             model = MixtralModel(cfg)
             raise NotImplementedError("Mixtral checkpoint loading lands in a later round")
+        if "Deepseek" in arch:
+            from dynamo_tpu.models.deepseek import DeepseekConfig, DeepseekModel
+
+            cfg = DeepseekConfig.from_hf_config(hf_cfg)
+            model = DeepseekModel(cfg)
+            raise NotImplementedError("Deepseek checkpoint loading lands in a later round")
         if "Llama" not in arch and "Qwen" not in arch:
             raise ValueError(f"unsupported architecture {arch}")
         cfg = LlamaConfig.from_hf_config(hf_cfg)

@@ -1,0 +1,235 @@
+"""DeepSeek MLA correctness: paged absorbed-attention prefill/decode vs a naive
+dense transformer that materializes per-head K/V from the latents (the
+standard, non-absorbed formulation). Token-exactness through the engine proves
+the weight-folding math and the latent page pool.
+
+Also checks the headline property: the latent cache is an order of magnitude
+smaller per token than an equivalent full-KV cache.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.models.deepseek import DeepseekConfig, DeepseekModel
+from dynamo_tpu.ops.moe import moe_block
+from dynamo_tpu.ops.norms import rms_norm
+from dynamo_tpu.ops.rotary import apply_rope
+
+PAGE_SIZE = 4
+NUM_PAGES = 16
+PROMPT = np.array([5, 9, 2, 77, 31, 8, 100], dtype=np.int32)
+PAGE_TABLE = np.array([3, 5, 7, 0, 0, 0, 0, 0], dtype=np.int32)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = DeepseekConfig.tiny_mla()
+    model = DeepseekModel(cfg)
+    params = model.init_params(jax.random.key(1))
+    return cfg, model, params
+
+
+def naive_forward(cfg, params, tokens):
+    """Dense MLA with explicit K/V expansion: k_h = [W_kb_h c ; k_rope],
+    v_h = W_vb_h c, then standard multi-head causal attention."""
+    T = len(tokens)
+    pos = jnp.arange(T)
+    h = params["embed"][jnp.array(tokens)].astype(cfg.dtype)
+    dn, dr, dv, dc = (
+        cfg.qk_nope_head_dim,
+        cfg.qk_rope_head_dim,
+        cfg.v_head_dim,
+        cfg.kv_lora_rank,
+    )
+    H = cfg.num_heads
+
+    def layer(h, lp, moe):
+        x = rms_norm(h, lp["input_norm"], cfg.rms_norm_eps)
+        q = (x @ lp["w_q"]).reshape(T, H, dn + dr)
+        q_nope, q_rope = q[..., :dn], q[..., dn:]
+        q_rope = apply_rope(q_rope, pos, cfg.rope_theta)
+
+        ckv = x @ lp["w_dkv"]
+        latent = rms_norm(ckv[:, :dc], lp["kv_norm"], cfg.rms_norm_eps)
+        k_rope = apply_rope(ckv[:, None, dc:], pos, cfg.rope_theta)[:, 0]
+
+        # materialize per-head K/V from the latent (non-absorbed)
+        k_nope = jnp.einsum("sc,chn->shn", latent, lp["w_kb"])  # [S, H, dn]
+        v = jnp.einsum("sc,chv->shv", latent, lp["w_vb"])  # [S, H, dv]
+        k = jnp.concatenate(
+            [k_nope, jnp.broadcast_to(k_rope[:, None, :], (T, H, dr))], axis=-1
+        )
+        qf = jnp.concatenate([q_nope, q_rope], axis=-1)  # [T, H, dn+dr]
+
+        s = jnp.einsum("thd,shd->hts", qf.astype(jnp.float32), k.astype(jnp.float32))
+        s = s / np.sqrt(dn + dr)
+        s = jnp.where(jnp.tril(jnp.ones((T, T), bool))[None], s, -1e30)
+        a = jnp.einsum(
+            "hts,shv->thv", jax.nn.softmax(s, -1), v.astype(jnp.float32)
+        ).astype(cfg.dtype)
+        h = h + a.reshape(T, -1) @ lp["wo"]
+
+        x = rms_norm(h, lp["post_norm"], cfg.rms_norm_eps)
+        if moe:
+            shared = (
+                jax.nn.silu(x @ lp["shared_gate"]) * (x @ lp["shared_up"])
+            ) @ lp["shared_down"]
+            routed = moe_block(
+                x,
+                lp["router"],
+                lp["w_gate"],
+                lp["w_up"],
+                lp["w_down"],
+                num_experts_per_tok=cfg.num_experts_per_tok,
+                capacity_factor=cfg.moe_capacity_factor,
+            )
+            h = h + shared + routed
+        else:
+            h = h + (jax.nn.silu(x @ lp["gate"]) * (x @ lp["up"])) @ lp["down"]
+        return h
+
+    Ld = cfg.first_k_dense_replace
+    for l in range(Ld):
+        h = layer(h, jax.tree.map(lambda x: x[l], params["dense_layers"]), False)
+    for l in range(cfg.num_layers - Ld):
+        h = layer(h, jax.tree.map(lambda x: x[l], params["moe_layers"]), True)
+    x = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    return jnp.einsum("td,vd->tv", x.astype(jnp.float32), params["lm_head"].astype(jnp.float32))
+
+
+def test_prefill_matches_naive(setup):
+    cfg, model, params = setup
+    ref = naive_forward(cfg, params, PROMPT)[-1]
+    Tn, T_pad = len(PROMPT), 8
+    tokens = np.zeros(T_pad, np.int32)
+    tokens[:Tn] = PROMPT
+    positions = np.arange(T_pad, dtype=np.int32)
+    kv = model.init_kv_cache(NUM_PAGES, PAGE_SIZE)
+    logits, _ = model.prefill(
+        params, kv, jnp.array(tokens), jnp.array(positions),
+        jnp.array(PAGE_TABLE), jnp.array(positions < Tn), jnp.array(Tn - 1),
+    )
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref), atol=2e-4)
+
+
+def test_prefill_then_decode_matches_full_prefill(setup):
+    cfg, model, params = setup
+    Tn, T_pad = len(PROMPT), 8
+    tokens = np.zeros(T_pad, np.int32)
+    tokens[:Tn] = PROMPT
+    positions = np.arange(T_pad, dtype=np.int32)
+
+    kv1 = model.init_kv_cache(NUM_PAGES, PAGE_SIZE)
+    logits_a, kv1 = model.prefill(
+        params, kv1, jnp.array(tokens), jnp.array(positions),
+        jnp.array(PAGE_TABLE), jnp.array(positions < Tn), jnp.array(Tn - 1),
+    )
+
+    kv2 = model.init_kv_cache(NUM_PAGES, PAGE_SIZE)
+    logits_b, kv2 = model.prefill(
+        params, kv2, jnp.array(tokens), jnp.array(positions),
+        jnp.array(PAGE_TABLE), jnp.array(positions < 3), jnp.array(2),
+    )
+    pts = np.zeros((2, 8), np.int32)
+    pts[0] = PAGE_TABLE
+    for i in range(3, Tn):
+        logits_dec, kv2 = model.decode(
+            params, kv2,
+            jnp.array([PROMPT[i], 0], jnp.int32),
+            jnp.array([i, 0], jnp.int32),
+            jnp.array(pts),
+            jnp.array([True, False]),
+        )
+        logits_b = logits_dec[0]
+    np.testing.assert_allclose(np.asarray(logits_a), np.asarray(logits_b), atol=2e-4)
+
+    owned = np.asarray(PAGE_TABLE[:2])
+    flat = (owned[None, :] + np.arange(cfg.num_layers)[:, None] * NUM_PAGES).ravel()
+    np.testing.assert_allclose(
+        np.asarray(kv1["ckv"][flat]), np.asarray(kv2["ckv"][flat]), atol=2e-4
+    )
+
+
+def test_engine_serves_mla_model():
+    """Full engine stack (paged allocator, pipelined decode windows, prefix
+    cache) over the MLA model."""
+    import asyncio
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.engine.sampling import SamplingParams
+    from dynamo_tpu.engine.scheduler import EngineRequest
+
+    async def body():
+        eng = AsyncJaxEngine(
+            EngineConfig(
+                model_id="tiny-mla",
+                page_size=4,
+                num_pages=32,
+                max_seqs=2,
+                max_model_len=64,
+                prefill_buckets=(16,),
+            )
+        )
+        await eng.start()
+        req = EngineRequest(
+            request_id="mla1",
+            token_ids=list(PROMPT),
+            sampling=SamplingParams(temperature=0.0, max_tokens=8),
+        )
+        toks = []
+        async for out in eng.generate(req):
+            if out.token is not None:
+                toks.append(out.token)
+        # greedy continuation must match teacher-forced naive logits argmax
+        cfg = DeepseekConfig.tiny_mla()
+        model = DeepseekModel(cfg)
+        params = model.init_params(jax.random.key(0))
+        seq = list(PROMPT)
+        want = []
+        for _ in range(8):
+            lg = naive_forward(cfg, params, np.asarray(seq, np.int32))[-1]
+            nxt = int(jnp.argmax(lg))
+            want.append(nxt)
+            seq.append(nxt)
+        await eng.shutdown()
+        return toks, want
+
+    toks, want = asyncio.run(body())
+    assert toks == want, f"engine {toks} != naive {want}"
+
+
+def test_latent_cache_is_small(setup):
+    """The MLA pool is ~an order of magnitude smaller than an equivalent
+    full-KV cache with the same head geometry."""
+    cfg, model, _ = setup
+    latent_row = cfg.latent_dim  # per token
+    full_row = 2 * cfg.num_heads * (cfg.qk_nope_head_dim + cfg.qk_rope_head_dim)
+    assert latent_row * 3 < full_row
+
+
+def test_tp_sharded_prefill_matches(setup):
+    """Same prefill under a tp=2 mesh (head-sharded up-projections, replicated
+    latent cache) must produce identical logits."""
+    from jax.sharding import Mesh
+
+    cfg, model, params = setup
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(2), ("tp",))
+    params_sh = jax.device_put(params, model.param_shardings(mesh))
+    kv = jax.device_put(
+        model.init_kv_cache(NUM_PAGES, PAGE_SIZE), model.kv_cache_sharding(mesh)
+    )
+    Tn, T_pad = len(PROMPT), 8
+    tokens = np.zeros(T_pad, np.int32)
+    tokens[:Tn] = PROMPT
+    positions = np.arange(T_pad, dtype=np.int32)
+    logits_sh, _ = jax.jit(model.prefill)(
+        params_sh, kv, jnp.array(tokens), jnp.array(positions),
+        jnp.array(PAGE_TABLE), jnp.array(positions < Tn), jnp.array(Tn - 1),
+    )
+    ref = naive_forward(cfg, params, PROMPT)[-1]
+    np.testing.assert_allclose(np.asarray(logits_sh), np.asarray(ref), atol=2e-4)
