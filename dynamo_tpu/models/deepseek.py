@@ -66,6 +66,11 @@ class DeepseekConfig:
     moe_intermediate_size: int = 1536
     first_k_dense_replace: int = 1
     moe_capacity_factor: float = 2.0
+    # routed-expert output scale (DeepSeek-V2 uses 16.0; V2-Lite 1.0)
+    routed_scaling_factor: float = 1.0
+    # False (DeepSeek default): top-k probs taken from the full softmax,
+    # not renormalized over the selected k
+    norm_topk_prob: bool = False
     rope_theta: float = 10000.0
     rms_norm_eps: float = 1e-6
     dtype: Any = jnp.bfloat16
@@ -77,7 +82,23 @@ class DeepseekConfig:
 
     @classmethod
     def from_hf_config(cls, d: dict) -> "DeepseekConfig":
-        """Build from a HuggingFace deepseek_v2/v3 config.json dict."""
+        """Build from a HuggingFace deepseek_v2/v3 config.json dict.
+
+        Raises for checkpoint features this implementation does not model yet
+        (wrong numerics would otherwise be silent): sigmoid routing with
+        correction bias (V3), group-limited top-k, and yarn rope scaling."""
+        unsupported = []
+        if d.get("scoring_func", "softmax") != "softmax":
+            unsupported.append(f"scoring_func={d['scoring_func']!r} (V3 sigmoid routing)")
+        if d.get("topk_method", "greedy") not in ("greedy", None):
+            unsupported.append(f"topk_method={d['topk_method']!r} (group-limited top-k)")
+        if d.get("rope_scaling"):
+            unsupported.append("rope_scaling (yarn + mscale)")
+        if unsupported:
+            raise ValueError(
+                "deepseek checkpoint needs unsupported features: "
+                + ", ".join(unsupported)
+            )
         return cls(
             vocab_size=d["vocab_size"],
             hidden_size=d["hidden_size"],
@@ -94,6 +115,8 @@ class DeepseekConfig:
             n_shared_experts=d.get("n_shared_experts", 2),
             moe_intermediate_size=d.get("moe_intermediate_size", 1408),
             first_k_dense_replace=d.get("first_k_dense_replace", 1),
+            routed_scaling_factor=d.get("routed_scaling_factor", 1.0),
+            norm_topk_prob=d.get("norm_topk_prob", False),
             rope_theta=d.get("rope_theta", 10000.0),
             rms_norm_eps=d.get("rms_norm_eps", 1e-6),
         )
@@ -397,8 +420,9 @@ class DeepseekModel:
                 lp["w_down"],
                 num_experts_per_tok=c.num_experts_per_tok,
                 capacity_factor=c.moe_capacity_factor,
+                renormalize=c.norm_topk_prob,
             )
-            hidden = hidden + shared + routed
+            hidden = hidden + shared + c.routed_scaling_factor * routed
         else:
             mlp = (jax.nn.silu(h @ lp["gate"]) * (h @ lp["up"])) @ lp["down"]
             hidden = hidden + mlp

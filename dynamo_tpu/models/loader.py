@@ -1,7 +1,11 @@
 """HF checkpoint -> scan-stacked JAX param loading (safetensors / torch .bin).
 
-Weight name mapping follows the HF Llama convention; our layout is [in, out]
-(HF nn.Linear stores [out, in]) with all layers stacked on a leading axis.
+Weight name mapping follows the HF conventions per family; our layout is
+[in, out] (HF nn.Linear stores [out, in]) with all layers stacked on a leading
+axis. Allocation comes from ``jax.eval_shape(model.init_params, ...)`` so the
+loader can never drift from the model's param tree: shapes, dtypes, and
+presence of optional leaves (biases, tied lm_head) are all derived from the
+single source of truth.
 """
 
 from __future__ import annotations
@@ -10,6 +14,7 @@ import json
 from pathlib import Path
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from dynamo_tpu.models.llama import LlamaModel
@@ -41,38 +46,27 @@ def _iter_checkpoint_tensors(path: Path):
     raise FileNotFoundError(f"no safetensors or pytorch_model*.bin under {path}")
 
 
+def _alloc_like(model):
+    """(numpy f32 arrays, ShapeDtypeStruct tree) matching model.init_params."""
+    shapes = jax.eval_shape(model.init_params, jax.random.key(0))
+    arrays = jax.tree.map(lambda s: np.zeros(s.shape, np.float32), shapes)
+    return arrays, shapes
+
+
+def _finish(arrays, shapes):
+    """Cast the filled numpy arrays to the model's exact leaf dtypes."""
+    return jax.tree.map(lambda a, s: jnp.asarray(a, s.dtype), arrays, shapes)
+
+
+def _set_layer(group: dict, key: str, layer: int, tensor: np.ndarray, transpose: bool):
+    t = tensor.T if transpose else tensor
+    group[key][layer] = t.astype(np.float32)
+
+
 def load_llama_weights(model: LlamaModel, path: Path) -> dict:
     c = model.config
-    dt = c.dtype
-    L = c.num_layers
-
-    def alloc(shape):
-        return np.zeros(shape, dtype=np.float32)
-
-    H, Hkv, Dh, D, F, V = (
-        c.num_heads,
-        c.num_kv_heads,
-        c.head_dim,
-        c.hidden_size,
-        c.intermediate_size,
-        c.vocab_size,
-    )
-    layers = {
-        "input_norm": alloc((L, D)),
-        "wq": alloc((L, D, H * Dh)),
-        "wk": alloc((L, D, Hkv * Dh)),
-        "wv": alloc((L, D, Hkv * Dh)),
-        "wo": alloc((L, H * Dh, D)),
-        "post_norm": alloc((L, D)),
-        "gate": alloc((L, D, F)),
-        "up": alloc((L, D, F)),
-        "down": alloc((L, F, D)),
-    }
-    if c.attention_bias:
-        layers["bq"] = alloc((L, H * Dh))
-        layers["bk"] = alloc((L, Hkv * Dh))
-        layers["bv"] = alloc((L, Hkv * Dh))
-    params = {"embed": None, "final_norm": None}
+    arrays, shapes = _alloc_like(model)
+    layers = arrays["layers"]
 
     per_layer = {
         "input_layernorm.weight": ("input_norm", False),
@@ -89,36 +83,163 @@ def load_llama_weights(model: LlamaModel, path: Path) -> dict:
         "mlp.down_proj.weight": ("down", True),
     }
 
+    seen_embed = seen_head = False
     for name, tensor in _iter_checkpoint_tensors(path):
         if name == "model.embed_tokens.weight":
-            params["embed"] = tensor
+            arrays["embed"][:] = tensor.astype(np.float32)
+            seen_embed = True
         elif name == "model.norm.weight":
-            params["final_norm"] = tensor
-        elif name == "lm_head.weight":
-            params["lm_head"] = tensor
+            arrays["final_norm"][:] = tensor.astype(np.float32)
+        elif name == "lm_head.weight" and "lm_head" in arrays:
+            arrays["lm_head"][:] = tensor.astype(np.float32)
+            seen_head = True
         elif name.startswith("model.layers."):
             rest = name[len("model.layers.") :]
             layer_str, sub = rest.split(".", 1)
+            l = int(layer_str)
+            mapping = per_layer.get(sub)
+            if mapping is None or mapping[0] not in layers or l >= c.num_layers:
+                log.debug("skipping unmapped weight %s", name)
+                continue
+            _set_layer(layers, mapping[0], l, tensor, mapping[1])
+        else:
+            log.debug("skipping unmapped weight %s", name)
+
+    if not seen_embed:
+        raise ValueError("checkpoint missing model.embed_tokens.weight")
+    if "lm_head" in arrays and not seen_head:
+        arrays["lm_head"][:] = arrays["embed"]
+    return _finish(arrays, shapes)
+
+
+def load_mixtral_weights(model, path: Path) -> dict:
+    """HF Mixtral convention: attention matches Llama; the sparse MLP stores
+    block_sparse_moe.gate (router) + per-expert w1 (gate), w2 (down), w3 (up)."""
+    c = model.config
+    arrays, shapes = _alloc_like(model)
+    layers = arrays["layers"]
+
+    per_layer = {
+        "input_layernorm.weight": ("input_norm", False),
+        "self_attn.q_proj.weight": ("wq", True),
+        "self_attn.k_proj.weight": ("wk", True),
+        "self_attn.v_proj.weight": ("wv", True),
+        "self_attn.o_proj.weight": ("wo", True),
+        "post_attention_layernorm.weight": ("post_norm", False),
+        "block_sparse_moe.gate.weight": ("router", True),
+    }
+    expert_map = {"w1": "w_gate", "w3": "w_up", "w2": "w_down"}
+
+    seen_embed = seen_head = False
+    for name, tensor in _iter_checkpoint_tensors(path):
+        if name == "model.embed_tokens.weight":
+            arrays["embed"][:] = tensor.astype(np.float32)
+            seen_embed = True
+        elif name == "model.norm.weight":
+            arrays["final_norm"][:] = tensor.astype(np.float32)
+        elif name == "lm_head.weight" and "lm_head" in arrays:
+            arrays["lm_head"][:] = tensor.astype(np.float32)
+            seen_head = True
+        elif name.startswith("model.layers."):
+            rest = name[len("model.layers.") :]
+            layer_str, sub = rest.split(".", 1)
+            l = int(layer_str)
+            if l >= c.num_layers:
+                log.debug("skipping out-of-range layer weight %s", name)
+                continue
+            if sub.startswith("block_sparse_moe.experts."):
+                _, _, e_str, w_name, _ = sub.split(".")
+                layers[expert_map[w_name]][l, int(e_str)] = tensor.T.astype(np.float32)
+                continue
             mapping = per_layer.get(sub)
             if mapping is None:
                 log.debug("skipping unmapped weight %s", name)
                 continue
-            key, transpose = mapping
-            t = tensor.T if transpose else tensor
-            layers[key][int(layer_str)] = t.astype(np.float32)
+            _set_layer(layers, mapping[0], l, tensor, mapping[1])
         else:
             log.debug("skipping unmapped weight %s", name)
 
-    if params["embed"] is None:
+    if not seen_embed:
         raise ValueError("checkpoint missing model.embed_tokens.weight")
-    out = {
-        "embed": jnp.asarray(params["embed"], dt),
-        "layers": {k: jnp.asarray(v, dt) for k, v in layers.items()},
-        "final_norm": jnp.asarray(params["final_norm"], dt),
+    if "lm_head" in arrays and not seen_head:
+        arrays["lm_head"][:] = arrays["embed"]
+    return _finish(arrays, shapes)
+
+
+def load_deepseek_weights(model, path: Path) -> dict:
+    """HF deepseek_v2/v3 convention -> the MLA param layout of
+    dynamo_tpu/models/deepseek.py. kv_b_proj [H*(dn+dv), dc] splits into the
+    k-up (w_kb) and v-up (w_vb) banks; layers partition into the leading dense
+    group and the MoE group (first_k_dense_replace boundary). Names with a
+    layer index >= num_layers (e.g. DeepSeek-V3's multi-token-prediction
+    layer) are skipped, as are auxiliary tensors this serving stack doesn't
+    model."""
+    c = model.config
+    arrays, shapes = _alloc_like(model)
+    dn, dv, dc = c.qk_nope_head_dim, c.v_head_dim, c.kv_lora_rank
+    H = c.num_heads
+    Ld = c.first_k_dense_replace
+
+    attn_map = {
+        "input_layernorm.weight": ("input_norm", False),
+        "self_attn.q_proj.weight": ("w_q", True),
+        "self_attn.q_a_proj.weight": ("w_dq", True),
+        "self_attn.q_a_layernorm.weight": ("q_norm", False),
+        "self_attn.q_b_proj.weight": ("w_uq", True),
+        "self_attn.kv_a_proj_with_mqa.weight": ("w_dkv", True),
+        "self_attn.kv_a_layernorm.weight": ("kv_norm", False),
+        "self_attn.o_proj.weight": ("wo", True),
+        "post_attention_layernorm.weight": ("post_norm", False),
+        "mlp.gate_proj.weight": ("gate", True),
+        "mlp.up_proj.weight": ("up", True),
+        "mlp.down_proj.weight": ("down", True),
+        "mlp.gate.weight": ("router", True),
+        "mlp.shared_experts.gate_proj.weight": ("shared_gate", True),
+        "mlp.shared_experts.up_proj.weight": ("shared_up", True),
+        "mlp.shared_experts.down_proj.weight": ("shared_down", True),
     }
-    if not c.tie_word_embeddings:
-        head = params.get("lm_head")
-        if head is None:
-            head = params["embed"]
-        out["lm_head"] = jnp.asarray(head, dt)
-    return out
+    expert_map = {"gate_proj": "w_gate", "up_proj": "w_up", "down_proj": "w_down"}
+
+    seen_embed = seen_head = False
+    for name, tensor in _iter_checkpoint_tensors(path):
+        if name == "model.embed_tokens.weight":
+            arrays["embed"][:] = tensor.astype(np.float32)
+            seen_embed = True
+        elif name == "model.norm.weight":
+            arrays["final_norm"][:] = tensor.astype(np.float32)
+        elif name == "lm_head.weight":
+            arrays["lm_head"][:] = tensor.astype(np.float32)
+            seen_head = True
+        elif name.startswith("model.layers."):
+            rest = name[len("model.layers.") :]
+            layer_str, sub = rest.split(".", 1)
+            l = int(layer_str)
+            if l >= c.num_layers:
+                log.debug("skipping out-of-range layer weight %s", name)
+                continue
+            group, gl = (
+                (arrays["dense_layers"], l) if l < Ld else (arrays["moe_layers"], l - Ld)
+            )
+            if sub == "self_attn.kv_b_proj.weight":
+                # [H*(dn+dv), dc] -> [dc, H, dn+dv] -> split k-up / v-up
+                t = tensor.T.reshape(dc, H, dn + dv).astype(np.float32)
+                group["w_kb"][gl] = t[..., :dn]
+                group["w_vb"][gl] = t[..., dn:]
+                continue
+            if sub.startswith("mlp.experts."):
+                _, _, e_str, w_name, _ = sub.split(".")
+                group[expert_map[w_name]][gl, int(e_str)] = tensor.T.astype(np.float32)
+                continue
+            mapping = attn_map.get(sub)
+            if mapping is None or mapping[0] not in group:
+                log.debug("skipping unmapped weight %s", name)
+                continue
+            _set_layer(group, mapping[0], gl, tensor, mapping[1])
+        else:
+            log.debug("skipping unmapped weight %s", name)
+
+    if not seen_embed:
+        raise ValueError("checkpoint missing model.embed_tokens.weight")
+    if not seen_head:
+        arrays["lm_head"][:] = arrays["embed"]
+    return _finish(arrays, shapes)

@@ -61,17 +61,19 @@ def load_model(model_id: str, seed: int = 0):
         hf_cfg = json.loads((path / "config.json").read_text())
         arch = (hf_cfg.get("architectures") or ["LlamaForCausalLM"])[0]
         if "Mixtral" in arch:
+            from dynamo_tpu.models.loader import load_mixtral_weights
             from dynamo_tpu.models.mixtral import MixtralConfig, MixtralModel
 
             cfg = MixtralConfig.from_hf_config(hf_cfg)
             model = MixtralModel(cfg)
-            raise NotImplementedError("Mixtral checkpoint loading lands in a later round")
+            return model, load_mixtral_weights(model, path)
         if "Deepseek" in arch:
             from dynamo_tpu.models.deepseek import DeepseekConfig, DeepseekModel
+            from dynamo_tpu.models.loader import load_deepseek_weights
 
             cfg = DeepseekConfig.from_hf_config(hf_cfg)
             model = DeepseekModel(cfg)
-            raise NotImplementedError("Deepseek checkpoint loading lands in a later round")
+            return model, load_deepseek_weights(model, path)
         if "Llama" not in arch and "Qwen" not in arch:
             raise ValueError(f"unsupported architecture {arch}")
         cfg = LlamaConfig.from_hf_config(hf_cfg)

@@ -20,10 +20,19 @@ import jax.numpy as jnp
 def topk_routing(
     router_logits: jnp.ndarray,  # [T, E] float32
     k: int,
+    renormalize: bool = True,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Returns (weights [T, K] — softmax over the selected k, indices [T, K])."""
+    """Returns (weights [T, K], indices [T, K]).
+
+    renormalize=True (Mixtral, norm_topk_prob): softmax over the selected k.
+    renormalize=False (DeepSeek default): softmax over ALL experts, top-k
+    probabilities used as-is."""
     top_logits, top_idx = jax.lax.top_k(router_logits, k)
-    weights = jax.nn.softmax(top_logits, axis=-1)
+    if renormalize:
+        weights = jax.nn.softmax(top_logits, axis=-1)
+    else:
+        probs = jax.nn.softmax(router_logits, axis=-1)
+        weights = jnp.take_along_axis(probs, top_idx, axis=-1)
     return weights, top_idx
 
 
@@ -35,6 +44,7 @@ def moe_block(
     w_down: jnp.ndarray,  # [E, F, D]
     num_experts_per_tok: int,
     capacity_factor: float = 2.0,
+    renormalize: bool = True,
 ) -> jnp.ndarray:
     T, D = hidden.shape
     E = router_w.shape[1]
@@ -42,7 +52,7 @@ def moe_block(
     capacity = max(1, int(-(-T * K * capacity_factor // E)))
 
     logits = (hidden.astype(jnp.float32) @ router_w.astype(jnp.float32))  # [T, E]
-    weights, idx = topk_routing(logits, K)  # [T, K]
+    weights, idx = topk_routing(logits, K, renormalize=renormalize)  # [T, K]
 
     # one-hot over experts per routing slot: [T, K, E]
     onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)

@@ -86,8 +86,9 @@ def naive_forward(cfg, params, tokens):
                 lp["w_down"],
                 num_experts_per_tok=cfg.num_experts_per_tok,
                 capacity_factor=cfg.moe_capacity_factor,
+                renormalize=cfg.norm_topk_prob,
             )
-            h = h + shared + routed
+            h = h + shared + cfg.routed_scaling_factor * routed
         else:
             h = h + (jax.nn.silu(x @ lp["gate"]) * (x @ lp["up"])) @ lp["down"]
         return h
@@ -233,3 +234,32 @@ def test_tp_sharded_prefill_matches(setup):
     )
     ref = naive_forward(cfg, params, PROMPT)[-1]
     np.testing.assert_allclose(np.asarray(logits_sh), np.asarray(ref), atol=2e-4)
+
+
+def test_unsupported_hf_features_raise():
+    base = {
+        "vocab_size": 128, "hidden_size": 32, "intermediate_size": 48,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+    }
+    with pytest.raises(ValueError, match="sigmoid"):
+        DeepseekConfig.from_hf_config({**base, "scoring_func": "sigmoid"})
+    with pytest.raises(ValueError, match="group-limited"):
+        DeepseekConfig.from_hf_config({**base, "topk_method": "group_limited_greedy"})
+    with pytest.raises(ValueError, match="rope_scaling"):
+        DeepseekConfig.from_hf_config(
+            {**base, "rope_scaling": {"type": "yarn", "factor": 40}}
+        )
+
+
+def test_unrenormalized_topk_routing():
+    """renormalize=False (DeepSeek default) takes top-k probs from the full
+    softmax; renormalize=True (Mixtral) softmaxes over the selected k."""
+    from dynamo_tpu.ops.moe import topk_routing
+
+    logits = jnp.array([[2.0, 1.0, 0.0, -1.0]])
+    w_full, idx = topk_routing(logits, 2, renormalize=False)
+    probs = np.asarray(jax.nn.softmax(logits[0]))
+    np.testing.assert_allclose(np.asarray(w_full[0]), probs[[0, 1]], rtol=1e-6)
+    assert np.asarray(w_full[0]).sum() < 1.0  # not renormalized
+    w_renorm, _ = topk_routing(logits, 2, renormalize=True)
+    np.testing.assert_allclose(np.asarray(w_renorm[0]).sum(), 1.0, rtol=1e-6)
