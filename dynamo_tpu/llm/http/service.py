@@ -16,6 +16,7 @@ from typing import AsyncIterator, Callable, Optional
 
 from aiohttp import web
 
+from dynamo_tpu.runtime.context import new_context, use_context
 from dynamo_tpu.llm.protocols.aggregator import (
     aggregate_chat_stream,
     aggregate_completion_stream,
@@ -209,17 +210,27 @@ class HttpService:
                         "is not in tools",
                     )
 
-        chunks = self._generate_chunks(
-            pipeline, pre, kind, model, annotations, tool_matcher
-        )
+        # ambient request context: the trace/request ids stamped here ride
+        # every downstream hop this request makes (workers, routers — see
+        # dynamo_tpu/runtime/context.py); use_context resets on exit so
+        # keep-alive connections (same task across requests) can't leak it
+        meta = {"endpoint": endpoint, "model": model}
+        if request.headers.get("x-request-id"):
+            meta["x-request-id"] = request.headers["x-request-id"]
+        ctx = new_context(request_id=getattr(pre, "request_id", None), metadata=meta)
+
         self.metrics.inflight(model, 1)
         try:
-            if req.stream:
-                return await self._stream_response(request, chunks, model, endpoint, t0)
-            if kind == "chat":
-                result = await aggregate_chat_stream(chunks)
-            else:
-                result = await aggregate_completion_stream(chunks)
+            with use_context(ctx):
+                chunks = self._generate_chunks(
+                    pipeline, pre, kind, model, annotations, tool_matcher
+                )
+                if req.stream:
+                    return await self._stream_response(request, chunks, model, endpoint, t0)
+                if kind == "chat":
+                    result = await aggregate_chat_stream(chunks)
+                else:
+                    result = await aggregate_completion_stream(chunks)
             self.metrics.inc_request(model, endpoint, rtype, "200")
             return web.json_response(result)
         except ToolCallError as e:

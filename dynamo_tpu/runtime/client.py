@@ -16,6 +16,7 @@ from typing import Any, AsyncIterator, Optional
 import msgpack
 
 from dynamo_tpu.runtime.component import EndpointInfo, INSTANCE_PREFIX
+from dynamo_tpu.runtime.context import RequestContext, current_context
 from dynamo_tpu.utils import get_logger
 
 log = get_logger("runtime.client")
@@ -115,16 +116,23 @@ class Client:
     # ---------------- RPC ----------------
 
     async def generate(
-        self, request: Any, instance_id: Optional[int] = None, routing: str = "random"
+        self,
+        request: Any,
+        instance_id: Optional[int] = None,
+        routing: str = "random",
+        context: Optional[RequestContext] = None,
     ) -> AsyncIterator[Any]:
-        """Routed streaming call; yields deserialized response items."""
+        """Routed streaming call; yields deserialized response items.
+
+        ``context`` (or, when absent, the ambient request context) rides the
+        request envelope so its metadata reaches the remote handler."""
         if instance_id is not None:
             info = self._pick_direct(instance_id)
         elif routing == "round_robin":
             info = self._pick_round_robin()
         else:
             info = self._pick_random()
-        return await self._generate_to(info, request)
+        return await self._generate_to(info, request, context)
 
     async def random(self, request: Any) -> AsyncIterator[Any]:
         return await self.generate(request, routing="random")
@@ -135,14 +143,19 @@ class Client:
     async def direct(self, request: Any, instance_id: int) -> AsyncIterator[Any]:
         return await self.generate(request, instance_id=instance_id)
 
-    async def _generate_to(self, info: EndpointInfo, request: Any) -> AsyncIterator[Any]:
+    async def _generate_to(
+        self, info: EndpointInfo, request: Any, context: Optional[RequestContext] = None
+    ) -> AsyncIterator[Any]:
         drt = self._drt
         await drt.ensure_tcp_server()
         conn_info, receiver = drt.tcp_server.register()
+        ctx = context if context is not None else current_context()
         payload = {
             "conn_info": conn_info.to_wire(),
             "request": msgpack.packb(request, use_bin_type=True),
         }
+        if ctx is not None:
+            payload["context"] = ctx.to_wire()
         try:
             delivered = await drt.cplane.publish(info.subject, payload)
             if delivered == 0:

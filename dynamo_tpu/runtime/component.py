@@ -21,6 +21,7 @@ from typing import Any, AsyncIterator, Callable, Optional
 
 import msgpack
 
+from dynamo_tpu.runtime.context import RequestContext, use_context
 from dynamo_tpu.runtime.tcp import ConnectionInfo, call_home
 from dynamo_tpu.utils import get_logger
 
@@ -200,6 +201,11 @@ class ServedEndpoint:
     async def _handle_request(self, payload: dict) -> None:
         conn_info = ConnectionInfo.from_wire(payload["conn_info"])
         request = msgpack.unpackb(payload["request"], raw=False)
+        ctx = RequestContext.from_wire(payload["context"]) if payload.get("context") else None
+        with use_context(ctx):
+            await self._run_handler(conn_info, request)
+
+    async def _run_handler(self, conn_info, request) -> None:
 
         # Drive the handler to its first item BEFORE calling home: setup-time
         # failures ride the prologue (reference: network.rs:64-73 — first frame

@@ -1,0 +1,55 @@
+"""Ambient request context: an id + metadata bag that flows through pipeline
+stages and across network hops (reference: lib/runtime/src/pipeline/context.rs
+Context<T>/StreamContext — request id and metadata ride every hop).
+
+Propagation model (Python-native): a contextvar. The server side sets the
+context around handler execution; any downstream ``Client.generate`` made
+while handling picks it up automatically and ships it in the request envelope,
+so metadata injected at the edge (e.g. a trace id stamped by the HTTP
+frontend) is visible in every worker a request touches, with no plumbing
+through handler signatures.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import uuid
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+
+@dataclass
+class RequestContext:
+    request_id: str
+    metadata: dict = field(default_factory=dict)
+
+    def to_wire(self) -> dict:
+        return {"request_id": self.request_id, "metadata": dict(self.metadata)}
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "RequestContext":
+        return cls(request_id=d.get("request_id", ""), metadata=dict(d.get("metadata") or {}))
+
+
+_current: contextvars.ContextVar[Optional[RequestContext]] = contextvars.ContextVar(
+    "dyntpu_request_context", default=None
+)
+
+
+def current_context() -> Optional[RequestContext]:
+    """The ambient request context, or None outside a request."""
+    return _current.get()
+
+
+def new_context(request_id: Optional[str] = None, metadata: Optional[dict] = None) -> RequestContext:
+    return RequestContext(request_id=request_id or uuid.uuid4().hex, metadata=dict(metadata or {}))
+
+
+@contextlib.contextmanager
+def use_context(ctx: Optional[RequestContext]) -> Iterator[None]:
+    token = _current.set(ctx)
+    try:
+        yield
+    finally:
+        _current.reset(token)

@@ -192,3 +192,54 @@ def test_dyn_endpoint_address():
         assert results[0]["doubled"] == 14
 
     run(with_cluster(body))
+
+
+def test_request_context_propagates_across_hops():
+    """The metadata bag injected at the edge reaches the first-hop handler via
+    the envelope, and flows AMBIENTLY into a second hop the handler makes
+    without any explicit plumbing (reference: pipeline/context.rs — Context
+    rides every network hop)."""
+    from dynamo_tpu.runtime.context import current_context, new_context, use_context
+
+    async def body(drt):
+        backend, middle, caller = await drt(), await drt(), await drt()
+
+        async def backend_handler(request):
+            ctx = current_context()
+            yield {
+                "trace": ctx.metadata.get("trace") if ctx else None,
+                "rid": ctx.request_id if ctx else None,
+            }
+
+        ep = backend.namespace("ctx").component("backend").endpoint("gen")
+        await ep.serve_endpoint(backend_handler)
+
+        async def middle_handler(request):
+            # no explicit context arg: the ambient context must carry over
+            client = await middle.client("ctx", "backend", "gen")
+            await client.wait_for_instances(timeout=5)
+            stream = await client.random({"hop": 2})
+            async for item in stream:
+                ctx = current_context()
+                item["middle_saw"] = ctx.metadata.get("trace") if ctx else None
+                yield item
+
+        ep2 = middle.namespace("ctx").component("middle").endpoint("gen")
+        await ep2.serve_endpoint(middle_handler)
+
+        client = await caller.client("ctx", "middle", "gen")
+        await client.wait_for_instances(timeout=5)
+        ctx = new_context(request_id="req-42", metadata={"trace": "abc123"})
+        with use_context(ctx):
+            stream = await client.random({"hop": 1})
+        results = [item async for item in stream]
+        assert results == [
+            {"trace": "abc123", "rid": "req-42", "middle_saw": "abc123"}
+        ]
+
+        # no ambient context -> handler sees None
+        stream = await client.random({"hop": 1})
+        results = [item async for item in stream]
+        assert results[0]["trace"] is None
+
+    run(with_cluster(body))
