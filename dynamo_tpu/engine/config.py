@@ -17,6 +17,10 @@ class EngineConfig:
     max_model_len: int = 2048
     prefill_buckets: tuple = (64, 128, 256, 512)  # padded prefill chunk lengths
     tp: int = 1  # tensor-parallel degree over the mesh
+    # sequence-parallel degree: >1 runs whole-prompt prefill as ring attention
+    # over an "sp" mesh axis (long-context path; decode is unaffected).
+    # Currently composes with tp=1 only.
+    sp: int = 1
     worker_id: str = "worker-0"
     # fraction of pages that must stay free for decode growth before admitting
     # a new sequence (simple admission control)

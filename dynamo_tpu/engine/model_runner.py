@@ -44,9 +44,17 @@ class ModelRunner:
     ):
         self.config = config
         self.model = model
+        if config.sp > 1 and config.tp > 1:
+            raise ValueError("sp and tp cannot both exceed 1 yet")
+        if config.sp > 1 and not hasattr(model, "prefill_sp"):
+            raise ValueError(f"model {type(model).__name__} has no sequence-parallel prefill")
         if mesh is None:
-            devices = jax.devices()[: config.tp]
-            mesh = Mesh(np.array(devices).reshape(len(devices)), ("tp",))
+            if config.sp > 1:
+                devices = jax.devices()[: config.sp]
+                mesh = Mesh(np.array(devices).reshape(len(devices)), ("sp",))
+            else:
+                devices = jax.devices()[: config.tp]
+                mesh = Mesh(np.array(devices).reshape(len(devices)), ("tp",))
         self.mesh = mesh
         if config.tp > 1:
             # the Pallas decode kernel runs under shard_map on this mesh
@@ -64,6 +72,9 @@ class ModelRunner:
         self.tokens_dev = jnp.zeros(config.max_seqs, jnp.int32)
 
         self._prefill = jax.jit(self._prefill_impl, donate_argnums=(1, 2))
+        if config.sp > 1:
+            # sequence-parallel whole-prompt prefill (ring attention over sp)
+            self._prefill_sp = jax.jit(self._prefill_sp_impl, donate_argnums=(1, 2))
         self._decode_window = jax.jit(
             self._decode_window_impl, donate_argnums=(1, 2), static_argnums=(6,)
         )
@@ -109,6 +120,26 @@ class ModelRunner:
         positions = start_pos + jnp.arange(bucket, dtype=jnp.int32)
         valid = jnp.arange(bucket) < n
         logits, kv = self.model.prefill(params, kv, tokens, positions, page_table, valid, n - 1)
+        tok = sample_tokens(logits[None, :], key, flts[:1], top_k[None], flts[1:])[0]
+        tokens_dev = tokens_dev.at[slot].set(tok, mode="drop")
+        return tok, kv, tokens_dev
+
+    def _prefill_sp_impl(self, params, kv, tokens_dev, ints, flts, key):
+        """Same packed-ints contract as _prefill_impl, but the whole-prompt
+        chunk runs sequence-parallel (model.prefill_sp: ring attention over
+        the sp mesh axis). Only called with start_pos == 0."""
+        mp = self.config.max_pages_per_seq
+        bucket = ints.shape[0] - mp - 4
+        tokens = ints[:bucket]
+        page_table = ints[bucket : bucket + mp]
+        n = ints[bucket + mp + 1]
+        top_k = ints[bucket + mp + 2]
+        slot = ints[bucket + mp + 3]
+        positions = jnp.arange(bucket, dtype=jnp.int32)
+        valid = positions < n
+        logits, kv = self.model.prefill_sp(
+            params, kv, tokens, positions, page_table, valid, n - 1, mesh=self.mesh
+        )
         tok = sample_tokens(logits[None, :], key, flts[:1], top_k[None], flts[1:])[0]
         tokens_dev = tokens_dev.at[slot].set(tok, mode="drop")
         return tok, kv, tokens_dev
@@ -184,7 +215,11 @@ class ModelRunner:
         # out-of-bounds slot => scatter mode="drop" skips the tokens_dev write
         ints[bucket + mp + 3] = slot if (sample and slot >= 0) else self.config.max_seqs
         flts = np.array([temperature, top_p], np.float32)
-        tok, self.kv_cache, self.tokens_dev = self._prefill(
+        # whole-prompt chunks go sequence-parallel when configured (ring
+        # attention assumes the chunk starts at position 0)
+        use_sp = self.config.sp > 1 and start_pos == 0 and bucket % self.config.sp == 0
+        prefill_fn = self._prefill_sp if use_sp else self._prefill
+        tok, self.kv_cache, self.tokens_dev = prefill_fn(
             self.params,
             self.kv_cache,
             self.tokens_dev,

@@ -39,6 +39,16 @@ from dynamo_tpu.ops.norms import rms_norm
 from dynamo_tpu.ops.rotary import apply_rope
 
 
+def _resolve_tp_axis(mesh: Mesh, tp_axis: str):
+    """tp axis name if present; None for sp-only meshes (params replicated by
+    design there); otherwise keep the name so NamedSharding raises loudly."""
+    if tp_axis in mesh.axis_names:
+        return tp_axis
+    if "sp" in mesh.axis_names:
+        return None
+    return tp_axis  # unknown axis -> NamedSharding raises
+
+
 def parse_dtype(value) -> Any:
     """Accept a jnp dtype or its string alias in tiny:{...} config overrides."""
     if isinstance(value, str):
@@ -155,7 +165,10 @@ class LlamaModel:
         return params
 
     def param_shardings(self, mesh: Mesh, tp_axis: str = "tp") -> dict:
-        """NamedSharding pytree: attention heads and MLP hidden sharded on tp."""
+        """NamedSharding pytree: attention heads and MLP hidden sharded on tp
+        (replicated when the mesh is sp-only; any other missing axis raises
+        so a misnamed tp mesh can't silently replicate a real model)."""
+        tp_axis = _resolve_tp_axis(mesh, tp_axis)
 
         def ns(*spec):
             return NamedSharding(mesh, P(*spec))
@@ -196,6 +209,7 @@ class LlamaModel:
         }
 
     def kv_cache_sharding(self, mesh: Mesh, tp_axis: str = "tp") -> dict:
+        tp_axis = _resolve_tp_axis(mesh, tp_axis)
         ns = NamedSharding(mesh, P(None, None, tp_axis, None))
         return {"k": ns, "v": ns}
 
@@ -219,6 +233,7 @@ class LlamaModel:
         }
 
     def wire_sharding(self, mesh: Mesh, tp_axis: str = "tp") -> NamedSharding:
+        tp_axis = _resolve_tp_axis(mesh, tp_axis)
         return NamedSharding(mesh, P(None, None, None, None, tp_axis, None))
 
     # ---------------- forward ----------------
@@ -260,7 +275,9 @@ class LlamaModel:
         q = apply_rope(q, positions, c.rope_theta)
         k = apply_rope(k, positions, c.rope_theta)
         k_pool, v_pool = scatter_kv(k_pool, v_pool, k, v, flat_phys, offsets)
-        attn = attn_fn(q, k_pool, v_pool)
+        # attn_fn sees both the updated pools (paged paths) and the chunk's
+        # fresh rows (ring/SP path, which never reads the pool)
+        attn = attn_fn(q, k, v, k_pool, v_pool)
         hidden = hidden + (attn.reshape(T, -1) @ lp["wo"])
         h = rms_norm(hidden, lp["post_norm"], c.rms_norm_eps)
         mlp = (jax.nn.silu(h @ lp["gate"]) * (h @ lp["up"])) @ lp["down"]
@@ -294,10 +311,62 @@ class LlamaModel:
             h, kp, vp = carry
             lp, off = xs
 
-            def attn_fn(q, kp_, vp_):
+            def attn_fn(q, k_new, v_new, kp_, vp_):
                 k_ctx = gather_pages(kp_, off + page_table)
                 v_ctx = gather_pages(vp_, off + page_table)
                 return attention_with_positions(q, k_ctx, v_ctx, positions)
+
+            h, kp, vp = self._layer(lp, h, kp, vp, positions, off + phys, offsets, attn_fn)
+            return (h, kp, vp), None
+
+        (hidden, k_pool, v_pool), _ = jax.lax.scan(
+            body,
+            (hidden, k_pool, v_pool),
+            (params["layers"], self._layer_offsets(num_pages)),
+        )
+        logits = self._unembed(params, hidden[last_idx][None, :])[0]
+        return logits, {"k": k_pool, "v": v_pool}
+
+    def prefill_sp(
+        self,
+        params: dict,
+        kv_cache: dict,  # {"k","v"} flat pools (donated)
+        tokens: jnp.ndarray,  # [T] padded FULL prompt, T % sp == 0, start at pos 0
+        positions: jnp.ndarray,  # [T] == arange(T)
+        page_table: jnp.ndarray,  # [max_pages]
+        valid: jnp.ndarray,  # [T] bool
+        last_idx: jnp.ndarray,
+        mesh: Mesh,
+        sp_axis: str = "sp",
+    ) -> tuple[jnp.ndarray, dict]:
+        """Sequence-parallel prefill: the chunk's attention runs as ring
+        attention over the ``sp`` mesh axis (K/V shards rotate via ppermute on
+        ICI; no chip ever holds the full sequence's working set — the
+        long-context path the reference lacks, SURVEY.md §2.8). The per-token
+        projections stay GSPMD-sharded on the token axis; the paged-pool
+        scatter reshards rows automatically. Only whole-prompt chunks
+        (cached_len 0) qualify — ring attention derives global positions from
+        ring offsets, so the chunk must start at position 0.
+
+        Returns (logits[V] at last_idx, updated kv_cache)."""
+        from dynamo_tpu.ops.ring_attention import ring_attention
+
+        c = self.config
+        k_pool, v_pool = kv_cache["k"], kv_cache["v"]
+        page_size = k_pool.shape[1]
+        num_pages = k_pool.shape[0] // c.num_layers
+        phys = jnp.where(valid, page_table[positions // page_size], 0)
+        offsets = jnp.where(valid, positions % page_size, 0)
+        hidden = params["embed"][tokens].astype(c.dtype)
+
+        def body(carry, xs):
+            h, kp, vp = carry
+            lp, off = xs
+
+            def attn_fn(q, k_new, v_new, kp_, vp_):
+                # ring attention consumes the chunk's own fresh K/V rows
+                # directly; the pool is write-only on this path
+                return ring_attention(q, k_new, v_new, mesh, axis=sp_axis)
 
             h, kp, vp = self._layer(lp, h, kp, vp, positions, off + phys, offsets, attn_fn)
             return (h, kp, vp), None
@@ -335,7 +404,7 @@ class LlamaModel:
             h, kp, vp = carry
             lp, off = xs
 
-            def attn_fn(q, kp_, vp_):
+            def attn_fn(q, k_new, v_new, kp_, vp_):
                 return dispatch_paged_decode_attention(
                     q, kp_, vp_, off + page_tables, positions, mesh=self.attn_mesh
                 )

@@ -100,7 +100,7 @@ class MixtralModel(LlamaModel):
         k = apply_rope((h @ lp["wk"]).reshape(T, c.num_kv_heads, c.head_dim), positions, c.rope_theta)
         v = (h @ lp["wv"]).reshape(T, c.num_kv_heads, c.head_dim)
         k_pool, v_pool = scatter_kv(k_pool, v_pool, k, v, flat_phys, offsets)
-        attn = attn_fn(q, k_pool, v_pool)
+        attn = attn_fn(q, k, v, k_pool, v_pool)
         hidden = hidden + (attn.reshape(T, -1) @ lp["wo"])
 
         # sparse MoE sublayer

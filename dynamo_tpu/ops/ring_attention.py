@@ -39,8 +39,9 @@ def _ring_attention_local(
     Tc, Hq, D = q.shape
     scale = 1.0 / jnp.sqrt(jnp.float32(D))
 
-    k = _repeat_kv(k, Hq)
-    v = _repeat_kv(v, Hq)
+    # K/V rotate around the ring in their compact GQA form ([Tc, Hkv, D]);
+    # expansion to Hq happens per hop just before the matmul, so ring traffic
+    # and resident K/V stay Hq/Hkv times smaller
     qf = q.astype(jnp.float32)
 
     q_pos = my * Tc + jnp.arange(Tc, dtype=jnp.int32)  # [Tc] global positions
@@ -54,7 +55,7 @@ def _ring_attention_local(
         kv_pos = src * Tc + local_idx  # [Tc]
 
         scores = jnp.einsum(
-            "thd,shd->hts", qf, k_cur.astype(jnp.float32)
+            "thd,shd->hts", qf, _repeat_kv(k_cur, Hq).astype(jnp.float32)
         ) * scale  # [H, Tq, Tk]
         mask = kv_pos[None, :] <= q_pos[:, None]  # [Tq, Tk] causal on global pos
         scores = jnp.where(mask[None], scores, _NEG_INF)
@@ -65,7 +66,9 @@ def _ring_attention_local(
         correction = jnp.exp(m - new_m)  # [H, Tq]
         probs = jnp.exp(scores - new_m[..., None])  # [H, Tq, Tk]
         new_l = l * correction + jnp.sum(probs, axis=-1)
-        chunk_out = jnp.einsum("hts,shd->htd", probs, v_cur.astype(jnp.float32))
+        chunk_out = jnp.einsum(
+            "hts,shd->htd", probs, _repeat_kv(v_cur, Hq).astype(jnp.float32)
+        )
         new_acc = acc * correction[..., None] + chunk_out
 
         # rotate kv to the next device (skipped compute on the last hop would
