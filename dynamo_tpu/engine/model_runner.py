@@ -46,8 +46,20 @@ class ModelRunner:
         self.model = model
         if config.sp > 1 and config.tp > 1:
             raise ValueError("sp and tp cannot both exceed 1 yet")
-        if config.sp > 1 and not hasattr(model, "prefill_sp"):
-            raise ValueError(f"model {type(model).__name__} has no sequence-parallel prefill")
+        if config.sp > 1:
+            if not hasattr(model, "prefill_sp"):
+                raise ValueError(
+                    f"model {type(model).__name__} has no sequence-parallel prefill"
+                )
+            if len(jax.devices()) < config.sp:
+                raise ValueError(
+                    f"sp={config.sp} but only {len(jax.devices())} devices available"
+                )
+            if not any(b % config.sp == 0 for b in config.prefill_buckets):
+                raise ValueError(
+                    f"sp={config.sp} divides none of prefill_buckets="
+                    f"{config.prefill_buckets}; SP prefill would never engage"
+                )
         if mesh is None:
             if config.sp > 1:
                 devices = jax.devices()[: config.sp]

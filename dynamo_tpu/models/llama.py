@@ -284,6 +284,36 @@ class LlamaModel:
         hidden = hidden + mlp
         return hidden, k_pool, v_pool
 
+    def _prefill_common(
+        self, params, kv_cache, tokens, positions, page_table, valid, last_idx, make_attn_fn
+    ) -> tuple[jnp.ndarray, dict]:
+        """Shared prefill machinery; make_attn_fn(off) -> attn_fn for a layer
+        (off = the layer's flat-pool offset)."""
+        c = self.config
+        k_pool, v_pool = kv_cache["k"], kv_cache["v"]
+        page_size = k_pool.shape[1]
+        num_pages = k_pool.shape[0] // c.num_layers
+        phys = jnp.where(valid, page_table[positions // page_size], 0)
+        offsets = jnp.where(valid, positions % page_size, 0)
+
+        hidden = params["embed"][tokens].astype(c.dtype)
+
+        def body(carry, xs):
+            h, kp, vp = carry
+            lp, off = xs
+            h, kp, vp = self._layer(
+                lp, h, kp, vp, positions, off + phys, offsets, make_attn_fn(off)
+            )
+            return (h, kp, vp), None
+
+        (hidden, k_pool, v_pool), _ = jax.lax.scan(
+            body,
+            (hidden, k_pool, v_pool),
+            (params["layers"], self._layer_offsets(num_pages)),
+        )
+        logits = self._unembed(params, hidden[last_idx][None, :])[0]
+        return logits, {"k": k_pool, "v": v_pool}
+
     def prefill(
         self,
         params: dict,
@@ -298,34 +328,18 @@ class LlamaModel:
 
         Returns (logits[V] at last_idx, updated kv_cache).
         """
-        c = self.config
-        k_pool, v_pool = kv_cache["k"], kv_cache["v"]
-        page_size = k_pool.shape[1]
-        num_pages = k_pool.shape[0] // c.num_layers
-        phys = jnp.where(valid, page_table[positions // page_size], 0)
-        offsets = jnp.where(valid, positions % page_size, 0)
 
-        hidden = params["embed"][tokens].astype(c.dtype)
-
-        def body(carry, xs):
-            h, kp, vp = carry
-            lp, off = xs
-
+        def make_attn_fn(off):
             def attn_fn(q, k_new, v_new, kp_, vp_):
                 k_ctx = gather_pages(kp_, off + page_table)
                 v_ctx = gather_pages(vp_, off + page_table)
                 return attention_with_positions(q, k_ctx, v_ctx, positions)
 
-            h, kp, vp = self._layer(lp, h, kp, vp, positions, off + phys, offsets, attn_fn)
-            return (h, kp, vp), None
+            return attn_fn
 
-        (hidden, k_pool, v_pool), _ = jax.lax.scan(
-            body,
-            (hidden, k_pool, v_pool),
-            (params["layers"], self._layer_offsets(num_pages)),
+        return self._prefill_common(
+            params, kv_cache, tokens, positions, page_table, valid, last_idx, make_attn_fn
         )
-        logits = self._unembed(params, hidden[last_idx][None, :])[0]
-        return logits, {"k": k_pool, "v": v_pool}
 
     def prefill_sp(
         self,
@@ -351,33 +365,17 @@ class LlamaModel:
         Returns (logits[V] at last_idx, updated kv_cache)."""
         from dynamo_tpu.ops.ring_attention import ring_attention
 
-        c = self.config
-        k_pool, v_pool = kv_cache["k"], kv_cache["v"]
-        page_size = k_pool.shape[1]
-        num_pages = k_pool.shape[0] // c.num_layers
-        phys = jnp.where(valid, page_table[positions // page_size], 0)
-        offsets = jnp.where(valid, positions % page_size, 0)
-        hidden = params["embed"][tokens].astype(c.dtype)
-
-        def body(carry, xs):
-            h, kp, vp = carry
-            lp, off = xs
-
+        def make_attn_fn(off):
             def attn_fn(q, k_new, v_new, kp_, vp_):
                 # ring attention consumes the chunk's own fresh K/V rows
                 # directly; the pool is write-only on this path
                 return ring_attention(q, k_new, v_new, mesh, axis=sp_axis)
 
-            h, kp, vp = self._layer(lp, h, kp, vp, positions, off + phys, offsets, attn_fn)
-            return (h, kp, vp), None
+            return attn_fn
 
-        (hidden, k_pool, v_pool), _ = jax.lax.scan(
-            body,
-            (hidden, k_pool, v_pool),
-            (params["layers"], self._layer_offsets(num_pages)),
+        return self._prefill_common(
+            params, kv_cache, tokens, positions, page_table, valid, last_idx, make_attn_fn
         )
-        logits = self._unembed(params, hidden[last_idx][None, :])[0]
-        return logits, {"k": k_pool, "v": v_pool}
 
     def decode(
         self,
