@@ -97,7 +97,7 @@ class ModelRunner:
         # block-granularity KV IO for disaggregation / offload
         # (the NIXL-slot replacement, reference: patch nixl.py register_kv_caches).
         # The model defines its canonical wire layout (llama: [L,2,n,ps,Hkv,D];
-        # MLA: [L,n,ps,latent]); on device the pools are flat [L*P, ...].
+        # MLA: [L,n,ps,latent_padded]); on device the pools are flat [L*P, ...].
         L = model.config.num_layers
         Pn = config.num_pages
 

@@ -155,12 +155,9 @@ def _on_tpu() -> bool:
         return False
 
 
-def use_pallas_decode(head_dim: int, num_kv_heads: int) -> bool:
-    """Trace-time choice of the Pallas decode kernel.
-
-    DYNTPU_PALLAS=1 forces on (interpret on CPU), =0 forces off; default: on
-    for real TPU backends with lane-aligned head_dim.
-    """
+def pallas_flag():
+    """DYNTPU_PALLAS override: True (forced on; interpret off-TPU), False
+    (forced off), or None (kernel-specific default)."""
     import os
 
     flag = os.environ.get("DYNTPU_PALLAS")
@@ -168,6 +165,18 @@ def use_pallas_decode(head_dim: int, num_kv_heads: int) -> bool:
         return False
     if flag == "1":
         return True
+    return None
+
+
+def use_pallas_decode(head_dim: int, num_kv_heads: int) -> bool:
+    """Trace-time choice of the Pallas decode kernel.
+
+    DYNTPU_PALLAS=1 forces on (interpret on CPU), =0 forces off; default: on
+    for real TPU backends with lane-aligned head_dim.
+    """
+    flag = pallas_flag()
+    if flag is not None:
+        return flag
     return _on_tpu() and head_dim % 128 == 0
 
 

@@ -1,0 +1,133 @@
+"""Pallas TPU kernel: paged MLA (latent) decode attention.
+
+The absorbed MLA formulation makes decode attention a pure latent-space
+operation: with q_cat = [q_nope·W_kb ; q_rope] (computed outside, where the
+MXU-shaped einsums belong) and each cached row = [norm(latent) ; rope(k_rope)],
+the score is a single dot product over latent_dim = d_c + d_r, and the output
+is the probability-weighted sum of the latent part only — the per-head v-up
+projection also happens outside. So the kernel streams latent pages from HBM
+(page-table scalar prefetch, double-buffered VMEM scratch) exactly like the
+GQA kernel in paged_attention.py, but with one fused [H, latent] x [latent,
+ps] matmul per page and an accumulator over rows' first d_c dims.
+
+Contract (matches DeepseekModel._absorbed_attention's decode path):
+  q_cat [B, H, d_c + d_r] — PRE-SCALED by 1/sqrt(d_n + d_r)
+  pages [P, ps, d_c + d_r], page_tables [B, max_pages], positions [B]
+  -> a_lat [B, H, d_c] (unprojected attention output in latent space)
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _kernel(
+    # scalar prefetch
+    page_tables_ref,  # [B, max_pages] SMEM
+    lengths_ref,  # [B] SMEM
+    # inputs
+    q_ref,  # [1, H, latent] VMEM (this sequence's pre-scaled folded query)
+    pages_hbm,  # [P, ps, latent] HBM
+    # output
+    out_ref,  # [1, H, d_c] VMEM
+    # scratch
+    scratch,  # [2, ps, latent] VMEM
+    sems,  # DMA sems [2]
+    *,
+    page_size: int,
+    d_c: int,
+):
+    b = pl.program_id(0)
+    length = lengths_ref[b]
+    n_pages = jnp.maximum(1, pl.cdiv(length, page_size))
+
+    q = q_ref[0].astype(jnp.float32)  # [H, latent]
+
+    def dma(slot, i):
+        return pltpu.make_async_copy(
+            pages_hbm.at[page_tables_ref[b, i]], scratch.at[slot], sems.at[slot]
+        )
+
+    dma(0, 0).start()
+
+    def body(i, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(i, 2)
+        next_slot = jax.lax.rem(i + 1, 2)
+
+        @pl.when(i + 1 < n_pages)
+        def _():
+            dma(next_slot, i + 1).start()
+
+        dma(slot, i).wait()
+        rows = scratch[slot].astype(jnp.float32)  # [ps, latent]
+
+        # [H, ps] = [H, latent] x [latent, ps]
+        scores = jax.lax.dot_general(
+            q, rows, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        idx = i * page_size + jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
+        scores = jnp.where(idx < length, scores, _NEG_INF)
+
+        chunk_max = jnp.max(scores, axis=-1)  # [H]
+        new_m = jnp.maximum(m, chunk_max)
+        corr = jnp.exp(m - new_m)
+        probs = jnp.exp(scores - new_m[:, None])  # [H, ps]
+        new_l = l * corr + jnp.sum(probs, axis=-1)
+        # accumulate over the latent part of the rows: [H, d_c]
+        chunk_out = jax.lax.dot_general(
+            probs, rows[:, :d_c], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        new_acc = acc * corr[:, None] + chunk_out
+        return new_m, new_l, new_acc
+
+    H = q_ref.shape[1]
+    m0 = jnp.full((H,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((H,), jnp.float32)
+    acc0 = jnp.zeros((H, d_c), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_pages, body, (m0, l0, acc0))
+
+    out_ref[0] = (acc / jnp.maximum(l, 1e-20)[:, None]).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("d_c", "interpret"))
+def paged_mla_decode_attention_pallas(
+    q_cat: jnp.ndarray,  # [B, H, latent] pre-scaled
+    pages: jnp.ndarray,  # [P, ps, latent]
+    page_tables: jnp.ndarray,  # [B, max_pages] int32
+    positions: jnp.ndarray,  # [B] int32 query positions
+    d_c: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    B, H, latent = q_cat.shape
+    P, ps, _ = pages.shape
+    lengths = positions.astype(jnp.int32) + 1
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, H, latent), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),  # latent pages stay in HBM
+        ],
+        out_specs=pl.BlockSpec((1, H, d_c), lambda b, *_: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, ps, latent), pages.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    kernel = pl.pallas_call(
+        functools.partial(_kernel, page_size=ps, d_c=d_c),
+        out_shape=jax.ShapeDtypeStruct((B, H, d_c), q_cat.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )
+    return kernel(page_tables.astype(jnp.int32), lengths, q_cat, pages)
