@@ -102,7 +102,8 @@ class FrontendService:
             return
         tokenizer = get_tokenizer(card.tokenizer)
         preprocessor = OpenAIPreprocessor(
-            tokenizer, model_name=entry.name, max_model_len=card.context_length
+            tokenizer, model_name=entry.name, max_model_len=card.context_length,
+            mm=card.mm,
         )
         backend = RemoteBackend(self.drt, entry.endpoint)
         self.service.manager.add(

@@ -115,7 +115,12 @@ class DisaggDecodeEngine:
         except Exception:
             queue_depth = 0
 
-        if not self.router.prefill_remote(len(prompt), prefix_hit, queue_depth):
+        # multimodal prompts prefill locally: the remote-prefill wire protocol
+        # carries token ids only, and image prefixes dedupe via their virtual
+        # ids in the local prefix cache anyway
+        if request.images or not self.router.prefill_remote(
+            len(prompt), prefix_hit, queue_depth
+        ):
             self.local_prefills += 1
             async for out in self.engine.generate(request):
                 yield out

@@ -84,6 +84,13 @@ class ModelRunner:
         self.tokens_dev = jnp.zeros(config.max_seqs, jnp.int32)
 
         self._prefill = jax.jit(self._prefill_impl, donate_argnums=(1, 2))
+        # multimodal vision encode (compiled lazily; text-only models never
+        # pay for it — the mm prefill variant is _prefill traced with embeds)
+        self._encode_images = jax.jit(
+            lambda params, patches, rows, cols, valid: self.model.encode_images(
+                params, patches, rows, cols, valid
+            )
+        )
         if config.sp > 1:
             # sequence-parallel whole-prompt prefill (ring attention over sp)
             self._prefill_sp = jax.jit(self._prefill_sp_impl, donate_argnums=(1, 2))
@@ -114,13 +121,17 @@ class ModelRunner:
 
     # ---------------- jitted bodies ----------------
 
-    def _prefill_impl(self, params, kv, tokens_dev, ints, flts, key):
+    def _prefill_impl(self, params, kv, tokens_dev, ints, flts, key, embeds=None, emask=None):
         """ints [bucket + max_pages + 4] = token buf, page table, then
         (start_pos, n_real, top_k, slot); flts [2] = (temperature, top_p).
         Positions and the valid mask derive on device — one packed H2D per
         chunk. The sampled token is written into ``tokens_dev[slot]`` (slot >=
         max_seqs drops the write) so a following decode window can consume it
-        without any host round trip."""
+        without any host round trip.
+
+        Multimodal chunks pass ``embeds`` [bucket, D] + ``emask`` [bucket]
+        (a second trace of this same jit): vision-tower outputs replace the
+        masked tokens' embeddings."""
         mp = self.config.max_pages_per_seq
         bucket = ints.shape[0] - mp - 4
         tokens = ints[:bucket]
@@ -131,7 +142,10 @@ class ModelRunner:
         slot = ints[bucket + mp + 3]
         positions = start_pos + jnp.arange(bucket, dtype=jnp.int32)
         valid = jnp.arange(bucket) < n
-        logits, kv = self.model.prefill(params, kv, tokens, positions, page_table, valid, n - 1)
+        logits, kv = self.model.prefill(
+            params, kv, tokens, positions, page_table, valid, n - 1,
+            input_embeds=embeds, embeds_mask=emask,
+        )
         tok = sample_tokens(logits[None, :], key, flts[:1], top_k[None], flts[1:])[0]
         tokens_dev = tokens_dev.at[slot].set(tok, mode="drop")
         return tok, kv, tokens_dev
@@ -207,6 +221,8 @@ class ModelRunner:
         top_p: float,
         slot: int = -1,  # decode slot to seed with the sampled token (device side)
         sync: bool = True,
+        embeds: Optional[np.ndarray] = None,  # [n, D] mm overrides for this chunk
+        embeds_mask: Optional[np.ndarray] = None,  # [n] bool
     ):
         """Run one prefill chunk.
 
@@ -227,9 +243,23 @@ class ModelRunner:
         # out-of-bounds slot => scatter mode="drop" skips the tokens_dev write
         ints[bucket + mp + 3] = slot if (sample and slot >= 0) else self.config.max_seqs
         flts = np.array([temperature, top_p], np.float32)
+        mm_args = ()
+        if embeds is not None:
+            # multimodal chunk: embeds-override trace of _prefill (paged path
+            # only; the sp/ring path is text-only for now)
+            emb = np.zeros((bucket, embeds.shape[1]), np.float32)
+            emb[:n] = embeds
+            msk = np.zeros(bucket, bool)
+            msk[:n] = embeds_mask
+            mm_args = (jnp.asarray(emb), jnp.asarray(msk))
         # whole-prompt chunks go sequence-parallel when configured (ring
         # attention assumes the chunk starts at position 0)
-        use_sp = self.config.sp > 1 and start_pos == 0 and bucket % self.config.sp == 0
+        use_sp = (
+            embeds is None
+            and self.config.sp > 1
+            and start_pos == 0
+            and bucket % self.config.sp == 0
+        )
         prefill_fn = self._prefill_sp if use_sp else self._prefill
         tok, self.kv_cache, self.tokens_dev = prefill_fn(
             self.params,
@@ -238,6 +268,7 @@ class ModelRunner:
             jnp.asarray(ints),
             jnp.asarray(flts),
             self._next_key(),
+            *mm_args,
         )
         if not sample:
             return None
@@ -248,6 +279,36 @@ class ModelRunner:
         except Exception:
             pass
         return tok
+
+    VISION_BUCKETS = (64, 256, 1024, 4096, 16384)
+
+    def encode_images(self, images: list) -> list[np.ndarray]:
+        """Run the vision tower over each ImageInput; returns per-image
+        [num_tokens, D] float32 embeddings. Patch counts pad to static buckets
+        (one executable per bucket; the validity mask hides padding)."""
+        out = []
+        for im in images:
+            n = im.patches.shape[0]
+            bucket = next((b for b in self.VISION_BUCKETS if b >= n), None)
+            if bucket is None:
+                raise ValueError(f"image has {n} patches > max bucket")
+            patches = np.zeros((bucket, im.patches.shape[1]), np.float32)
+            patches[:n] = im.patches
+            rows = np.zeros(bucket, np.int32)
+            cols = np.zeros(bucket, np.int32)
+            rows[:n] = im.rows
+            cols[:n] = im.cols
+            valid = np.zeros(bucket, bool)
+            valid[:n] = True
+            emb = self._encode_images(
+                self.params,
+                jnp.asarray(patches),
+                jnp.asarray(rows),
+                jnp.asarray(cols),
+                jnp.asarray(valid),
+            )
+            out.append(np.asarray(jax.device_get(emb), np.float32)[: im.num_tokens])
+        return out
 
     def write_token_slots(self, slots: np.ndarray, tokens: np.ndarray) -> None:
         """Host-known tokens (e.g. disagg adoption) -> tokens_dev[slots]."""

@@ -50,6 +50,11 @@ class EngineRequest:
     token_ids: list[int]
     sampling: SamplingParams = field(default_factory=SamplingParams)
     eos_token_ids: tuple[int, ...] = ()
+    # multimodal: images (llm/multimodal.py ImageInput, offsets into token_ids
+    # where each image's virtual-token run sits) + their encoded embeddings
+    # ([num_tokens, D] float32 each), filled by the engine at admission
+    images: list = field(default_factory=list)
+    mm_embeds: Optional[list] = None
 
 
 @dataclass
@@ -91,6 +96,28 @@ class _InFlight:
     # first: (seq, cached_len); window: [(seq, slot_idx, steps), ...]
     seqs: list = field(default_factory=list)
     cached_len: int = 0
+
+
+def _mm_chunk_overrides(req: EngineRequest, start: int, end: int):
+    """Dense [n, D] embedding overrides + mask for the chunk [start, end):
+    rows from every image whose virtual-token run intersects the chunk."""
+    if not req.images or req.mm_embeds is None:
+        return None, None
+    n = end - start
+    embeds = None
+    mask = np.zeros(n, bool)
+    for im, emb in zip(req.images, req.mm_embeds):
+        lo = max(start, im.offset)
+        hi = min(end, im.offset + im.num_tokens)
+        if lo >= hi:
+            continue
+        if embeds is None:
+            embeds = np.zeros((n, emb.shape[1]), np.float32)
+        embeds[lo - start : hi - start] = emb[lo - im.offset : hi - im.offset]
+        mask[lo - start : hi - start] = True
+    if embeds is None:
+        return None, None  # pure-text chunk: reuse the text prefill executable
+    return embeds, mask
 
 
 def _is_ready(arr) -> bool:
@@ -261,9 +288,17 @@ class Scheduler:
         first_token = None
         start = cached_len
         max_chunk = self.config.max_prefill_chunk
+        needs_vision = req.images and any(
+            im.offset + im.num_tokens > cached_len for im in req.images
+        )
+        if needs_vision and req.mm_embeds is None:
+            # skipped entirely when every image run sits inside the cached
+            # prefix — a repeat request never re-runs the vision tower
+            req.mm_embeds = self.runner.encode_images(req.images)
         while start < prompt_len:
             end = min(start + max_chunk, prompt_len)
             is_last = end == prompt_len
+            embeds, embeds_mask = _mm_chunk_overrides(req, start, end)
             tok = self.runner.prefill_chunk(
                 np.asarray(req.token_ids[start:end], np.int32),
                 start_pos=start,
@@ -274,6 +309,8 @@ class Scheduler:
                 top_p=s.top_p,
                 slot=slot if is_last else -1,
                 sync=sync,
+                embeds=embeds,
+                embeds_mask=embeds_mask,
             )
             if is_last:
                 first_token = tok
@@ -490,6 +527,8 @@ class Scheduler:
         new_req = EngineRequest(
             request_id=seq.req.request_id,
             token_ids=list(seq.req.token_ids) + seq.generated,
+            images=seq.req.images,
+            mm_embeds=seq.req.mm_embeds,  # offsets are prompt-relative: still valid
             sampling=SamplingParams(
                 temperature=seq.req.sampling.temperature,
                 top_k=seq.req.sampling.top_k,

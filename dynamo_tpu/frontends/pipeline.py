@@ -17,6 +17,7 @@ def build_pipeline(engine, card: ModelDeploymentCard) -> ModelPipeline:
         tokenizer,
         model_name=card.display_name,
         max_model_len=card.context_length,
+        mm=card.mm,
     )
     from dynamo_tpu.launch._remote import RemoteEngineProxy, RemoteTextBackend
 

@@ -60,6 +60,8 @@ class RemoteEngineProxy:
             },
             "eos_token_ids": list(request.eos_token_ids),
         }
+        if request.images:
+            wire["images"] = [im.to_wire() for im in request.images]
         stream = await self._client.random(wire)
         async for item in stream:
             token = None
@@ -92,6 +94,7 @@ class RemoteTextBackend:
             token_ids=list(request.token_ids),
             sampling=request.sampling,
             eos_token_ids=tuple(request.eos_token_ids),
+            images=list(getattr(request, "images", ()) or ()),
         )
         count = 0
         async for out in self.proxy.generate(engine_req):

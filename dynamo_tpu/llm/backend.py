@@ -69,6 +69,7 @@ class Backend:
             token_ids=list(request.token_ids),
             sampling=request.sampling,
             eos_token_ids=eos_ids,
+            images=list(request.images),
         )
         decoder = DecodeStream(self.tokenizer, prompt_ids=request.token_ids)
         jail = _StopJail(request.stop_strings)

@@ -30,6 +30,10 @@ class ModelDeploymentCard:
     model_type: str = "chat"  # chat | completion | both
     architecture: str = "llama"
     revision: int = 0
+    # multimodal: {"patch_size", "merge_size", "vocab_size"} when the model
+    # has a vision tower (None for text-only); the preprocessor needs these to
+    # patchify images and expand their virtual-token runs
+    mm: Optional[dict] = None
 
     @classmethod
     def from_local_path(cls, path: str, name: Optional[str] = None) -> "ModelDeploymentCard":
@@ -45,13 +49,21 @@ class ModelDeploymentCard:
             archs = cfg.get("architectures") or []
             if archs:
                 card.architecture = archs[0]
+            vis = cfg.get("vision_config")
+            if vis is not None or cfg.get("model_type") == "qwen2_vl":
+                vis = vis or {}
+                card.mm = {
+                    "patch_size": int(vis.get("patch_size", 14)),
+                    "merge_size": int(vis.get("spatial_merge_size", 2)),
+                    "vocab_size": int(cfg.get("vocab_size", 1 << 30)),
+                }
         if (p / "tokenizer.json").exists() or (p / "tokenizer_config.json").exists():
             card.tokenizer = str(p)
         return card
 
     @classmethod
     def for_tiny(cls, name: str = "tiny") -> "ModelDeploymentCard":
-        return cls(
+        card = cls(
             display_name=name,
             service_name=slugify(name),
             model_path=name,
@@ -59,6 +71,11 @@ class ModelDeploymentCard:
             context_length=64,
             kv_block_size=4,
         )
+        if name.startswith("tiny-vl"):
+            # VisionConfig.tiny + LlamaConfig.tiny geometry
+            card.context_length = 256
+            card.mm = {"patch_size": 4, "merge_size": 2, "vocab_size": 256}
+        return card
 
     def to_wire(self) -> dict:
         return self.__dict__.copy()

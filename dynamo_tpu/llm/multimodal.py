@@ -1,0 +1,284 @@
+"""Multimodal input handling: images in OpenAI chat content parts.
+
+The reference serves vision models by delegating to its engines (vLLM et al.);
+here the whole path is native. This module owns everything between an OpenAI
+``image_url`` content part and the vision tower's patch arrays:
+
+  - decoding images (base64 data URIs, local file paths under an allowlisted
+    root, or ``data:application/x-npy`` raw-array URIs for hermetic tests)
+  - smart-resize to patch-grid multiples with a pixel budget
+  - patchify in merge-group order (the layout VisionModel.encode expects)
+  - expansion of each image into its run of **virtual token ids** in the
+    language sequence
+
+Virtual token ids: every image-slot position gets a token id derived from the
+image's content hash (``xxh3(image_hash || position)``, reduced into the
+vocab). The embedding rows of these ids are overridden by the vision
+embeddings during prefill, so their values never reach the forward math — but
+they make the existing KV block hashing, prefix-cache reuse, and KV-aware
+routing treat identical images as identical prefixes and different images as
+different ones, with zero multimodal special-casing anywhere in that machinery.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+from dataclasses import dataclass, field
+
+import numpy as np
+import xxhash
+
+from dynamo_tpu.llm.tokens import XXH3_SEED
+
+# CLIP-style normalization
+IMAGE_MEAN = np.array([0.48145466, 0.4578275, 0.40821073], np.float32)
+IMAGE_STD = np.array([0.26862954, 0.26130258, 0.27577711], np.float32)
+
+
+@dataclass
+class ImageInput:
+    """One image, patchified for the vision tower, placed in the prompt.
+
+    offset: index in token_ids where this image's virtual-token run starts.
+    patches: [N, C*ps*ps] float32, merge-group order. rows/cols: [N] int32.
+    num_tokens: N / merge^2 — virtual tokens this image occupies.
+    """
+
+    offset: int
+    patches: np.ndarray
+    rows: np.ndarray
+    cols: np.ndarray
+    grid: tuple[int, int]
+    num_tokens: int
+    content_hash: int
+
+    def to_wire(self) -> dict:
+        return {
+            "offset": self.offset,
+            "patches": base64.b64encode(
+                self.patches.astype(np.float32).tobytes()
+            ).decode(),
+            "patch_dim": int(self.patches.shape[1]),
+            "rows": self.rows.tolist(),
+            "cols": self.cols.tolist(),
+            "grid": list(self.grid),
+            "num_tokens": self.num_tokens,
+            "content_hash": self.content_hash,
+        }
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "ImageInput":
+        pd = int(d["patch_dim"])
+        buf = np.frombuffer(base64.b64decode(d["patches"]), np.float32)
+        return cls(
+            offset=int(d["offset"]),
+            patches=buf.reshape(-1, pd).copy(),
+            rows=np.asarray(d["rows"], np.int32),
+            cols=np.asarray(d["cols"], np.int32),
+            grid=(int(d["grid"][0]), int(d["grid"][1])),
+            num_tokens=int(d["num_tokens"]),
+            content_hash=int(d["content_hash"]),
+        )
+
+
+def image_content_hash(pixels: np.ndarray) -> int:
+    return xxhash.xxh3_64_intdigest(
+        np.ascontiguousarray(pixels, np.float32).tobytes(), seed=XXH3_SEED
+    )
+
+
+def virtual_token_ids(content_hash: int, num_tokens: int, vocab_size: int) -> list[int]:
+    """Deterministic per-(image, position) ids inside the vocab. See module
+    docstring: these exist for block hashing; embeddings are overridden."""
+    return [
+        xxhash.xxh3_64_intdigest(
+            content_hash.to_bytes(8, "little") + j.to_bytes(4, "little"),
+            seed=XXH3_SEED,
+        )
+        % max(1, vocab_size)
+        for j in range(num_tokens)
+    ]
+
+
+def smart_resize(
+    h: int, w: int, factor: int, min_pixels: int = 56 * 56, max_pixels: int = 14 * 14 * 4 * 1280
+) -> tuple[int, int]:
+    """Resize target: dimensions divisible by ``factor`` (patch * merge), area
+    within [min_pixels, max_pixels], aspect ratio preserved."""
+    if h <= 0 or w <= 0:
+        raise ValueError(f"degenerate image size {h}x{w}")
+    if max(h, w) / min(h, w) > 200:
+        raise ValueError(f"absurd aspect ratio {h}x{w}")
+    rh = max(factor, round(h / factor) * factor)
+    rw = max(factor, round(w / factor) * factor)
+    if rh * rw > max_pixels:
+        beta = (h * w / max_pixels) ** 0.5
+        rh = max(factor, int(h / beta / factor) * factor)
+        rw = max(factor, int(w / beta / factor) * factor)
+    elif rh * rw < min_pixels:
+        beta = (min_pixels / (h * w)) ** 0.5
+        rh = int(np.ceil(h * beta / factor)) * factor
+        rw = int(np.ceil(w * beta / factor)) * factor
+    return rh, rw
+
+
+def load_image(url: str, root: str | None = None) -> np.ndarray:
+    """Decode an image source into float32 [H, W, 3] in [0, 1].
+
+    Supports ``data:image/*;base64,``, ``data:application/x-npy;base64,``
+    (raw float array — the hermetic test path), and plain file paths (only when
+    ``root`` is configured; zero-egress, so no http fetches).
+    """
+    if url.startswith("data:"):
+        head, _, payload = url.partition(",")
+        raw = base64.b64decode(payload)
+        if "application/x-npy" in head:
+            arr = np.load(io.BytesIO(raw), allow_pickle=False)
+            return np.asarray(arr, np.float32)
+        from PIL import Image
+
+        img = Image.open(io.BytesIO(raw)).convert("RGB")
+        return np.asarray(img, np.float32) / 255.0
+    if url.startswith("http://") or url.startswith("https://"):
+        raise ValueError("remote image URLs are not supported (zero-egress)")
+    if root is None:
+        raise ValueError("file image paths require a configured media root")
+    import os
+
+    path = os.path.realpath(os.path.join(root, url.lstrip("/")))
+    if not path.startswith(os.path.realpath(root) + os.sep):
+        raise ValueError("image path escapes the media root")
+    from PIL import Image
+
+    img = Image.open(path).convert("RGB")
+    return np.asarray(img, np.float32) / 255.0
+
+
+def patchify(
+    pixels: np.ndarray, patch_size: int, merge_size: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, tuple[int, int]]:
+    """float32 [H, W, 3] -> (patches [N, 3*ps*ps], rows [N], cols [N], grid).
+
+    Output order is merge-group major: for each (merged row, merged col), its
+    merge^2 member patches are contiguous — VisionModel.encode's merger relies
+    on this (reshape-based 2x2 concat).
+    """
+    factor = patch_size * merge_size
+    h, w = pixels.shape[:2]
+    rh, rw = smart_resize(h, w, factor)
+    if (rh, rw) != (h, w):
+        pixels = _resize_bilinear(pixels, rh, rw)
+    pixels = (pixels - IMAGE_MEAN) / IMAGE_STD
+    gh, gw = rh // patch_size, rw // patch_size
+    # [gh, gw, ps, ps, C] patch grid
+    grid = pixels.reshape(gh, patch_size, gw, patch_size, 3).transpose(0, 2, 1, 3, 4)
+    m = merge_size
+    # merge-group order: (GH, GW, m, m) leading axes
+    grouped = grid.reshape(gh // m, m, gw // m, m, patch_size, patch_size, 3)
+    grouped = grouped.transpose(0, 2, 1, 3, 4, 5, 6)
+    patches = grouped.reshape(gh * gw, -1).astype(np.float32)
+    rr, cc = np.meshgrid(np.arange(gh), np.arange(gw), indexing="ij")
+    rr = rr.reshape(gh // m, m, gw // m, m).transpose(0, 2, 1, 3).reshape(-1)
+    cc = cc.reshape(gh // m, m, gw // m, m).transpose(0, 2, 1, 3).reshape(-1)
+    return patches, rr.astype(np.int32), cc.astype(np.int32), (gh, gw)
+
+
+def _resize_bilinear(img: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
+    """Minimal bilinear resize (numpy; runs once per image on host)."""
+    h, w = img.shape[:2]
+    ys = (np.arange(out_h) + 0.5) * h / out_h - 0.5
+    xs = (np.arange(out_w) + 0.5) * w / out_w - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, h - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, w - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = np.clip(ys - y0, 0.0, 1.0)[:, None, None]
+    wx = np.clip(xs - x0, 0.0, 1.0)[None, :, None]
+    top = img[y0][:, x0] * (1 - wx) + img[y0][:, x1] * wx
+    bot = img[y1][:, x0] * (1 - wx) + img[y1][:, x1] * wx
+    return (top * (1 - wy) + bot * wy).astype(np.float32)
+
+
+# ---------------- chat-content parsing ----------------
+
+_SENTINEL = "\x00dynimg:{i}\x00"
+
+
+def extract_content_parts(messages: list[dict], media_root: str | None = None):
+    """Flatten OpenAI content-part messages for template rendering.
+
+    Returns (messages_with_sentinels, images) where each image content part is
+    replaced by a unique sentinel string inside the message text; after
+    rendering + around-sentinel tokenization the sentinels become virtual-token
+    runs. images = list of float32 pixel arrays in content order.
+    """
+    out_messages = []
+    images: list[np.ndarray] = []
+    for m in messages:
+        content = m.get("content")
+        if not isinstance(content, list):
+            out_messages.append(m)
+            continue
+        pieces = []
+        for part in content:
+            ptype = part.get("type")
+            if ptype == "text":
+                # NUL never survives: user text must not be able to forge the
+                # image-placement sentinels spliced in below
+                pieces.append(part.get("text", "").replace("\x00", ""))
+            elif ptype == "image_url":
+                url = part.get("image_url")
+                if isinstance(url, dict):
+                    url = url.get("url", "")
+                pixels = load_image(url, root=media_root)
+                pieces.append(_SENTINEL.format(i=len(images)))
+                images.append(pixels)
+            else:
+                raise ValueError(f"unsupported content part type: {ptype}")
+        m2 = dict(m)
+        m2["content"] = "".join(pieces)
+        out_messages.append(m2)
+    return out_messages, images
+
+
+def tokenize_with_images(
+    rendered: str,
+    images: list[np.ndarray],
+    encode,
+    patch_size: int,
+    merge_size: int,
+    vocab_size: int,
+) -> tuple[list[int], list[ImageInput]]:
+    """Split the rendered prompt on image sentinels, encode text segments, and
+    splice each image's virtual-token run in between. Returns (token_ids,
+    image_inputs with offsets)."""
+    token_ids: list[int] = []
+    mm: list[ImageInput] = []
+    cursor = 0
+    for i, pixels in enumerate(images):
+        sentinel = _SENTINEL.format(i=i)
+        idx = rendered.find(sentinel, cursor)
+        if idx < 0:
+            raise ValueError(f"image {i} sentinel missing after template render")
+        if idx > cursor:
+            token_ids.extend(encode(rendered[cursor:idx]))
+        patches, rows, cols, grid = patchify(pixels, patch_size, merge_size)
+        n_tokens = patches.shape[0] // (merge_size * merge_size)
+        chash = image_content_hash(pixels)
+        mm.append(
+            ImageInput(
+                offset=len(token_ids),
+                patches=patches,
+                rows=rows,
+                cols=cols,
+                grid=grid,
+                num_tokens=n_tokens,
+                content_hash=chash,
+            )
+        )
+        token_ids.extend(virtual_token_ids(chash, n_tokens, vocab_size))
+        cursor = idx + len(sentinel)
+    if cursor < len(rendered):
+        token_ids.extend(encode(rendered[cursor:]))
+    return token_ids, mm

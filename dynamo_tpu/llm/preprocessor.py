@@ -32,12 +32,18 @@ class OpenAIPreprocessor:
         max_model_len: int = 2048,
         default_max_tokens: Optional[int] = None,
         default_temperature: float = 1.0,
+        mm: Optional[dict] = None,  # model card mm block (multimodal models)
+        media_root: Optional[str] = None,  # allowlisted root for file image paths
     ):
+        import os
+
         self.tokenizer = tokenizer
         self.model_name = model_name
         self.max_model_len = max_model_len
         self.default_max_tokens = default_max_tokens
         self.default_temperature = default_temperature
+        self.mm = mm
+        self.media_root = media_root or os.environ.get("DYNTPU_MEDIA_ROOT")
 
     # ---------------- internals ----------------
 
@@ -92,6 +98,31 @@ class OpenAIPreprocessor:
         # (reference: preprocessor/tools/request.rs ToolChoice::None)
         tools = req.tools if req.tools and req.tool_choice != "none" else None
         messages = [m.to_dict() for m in req.messages]
+        images = []
+        if any(isinstance(m.get("content"), list) for m in messages):
+            has_images = any(
+                isinstance(p, dict) and p.get("type") == "image_url"
+                for m in messages
+                if isinstance(m.get("content"), list)
+                for p in m["content"]
+            )
+            if has_images and self.mm is None:
+                raise ProtocolError(
+                    f"model {self.model_name} does not accept image content parts"
+                )
+            from dynamo_tpu.llm import multimodal
+
+            # flattens text-only part lists too (OpenAI SDKs send those for
+            # plain text); any decode failure (bad base64, non-image payload,
+            # degenerate shapes) is the client's fault -> protocol error
+            try:
+                messages, images = multimodal.extract_content_parts(
+                    messages, media_root=self.media_root
+                )
+            except ProtocolError:
+                raise
+            except Exception as e:
+                raise ProtocolError(f"invalid image content: {e}")
         if tools is None:
             # keep the no-tools call signature-compatible with bare tokenizers
             prompt = self.tokenizer.apply_chat_template(messages, add_generation_prompt=True)
@@ -99,6 +130,21 @@ class OpenAIPreprocessor:
             prompt = self.tokenizer.apply_chat_template(
                 messages, add_generation_prompt=True, tools=tools
             )
+        if images:
+            try:
+                token_ids, image_inputs = multimodal.tokenize_with_images(
+                    prompt,
+                    images,
+                    self.tokenizer.encode,
+                    patch_size=self.mm["patch_size"],
+                    merge_size=self.mm["merge_size"],
+                    vocab_size=self.mm["vocab_size"],
+                )
+            except Exception as e:
+                raise ProtocolError(f"invalid image content: {e}")
+            pre, annotations = self._build(req, prompt, token_ids)
+            pre.images = image_inputs
+            return pre, annotations
         token_ids = self.tokenizer.encode(prompt)
         return self._build(req, prompt, token_ids)
 

@@ -24,9 +24,12 @@ class PreprocessedRequest:
     stop_strings: tuple[str, ...] = ()
     annotations: tuple[str, ...] = ()
     model: Optional[str] = None
+    # multimodal: ImageInput list (llm/multimodal.py); the image-slot positions
+    # in token_ids hold content-hash virtual ids
+    images: list = field(default_factory=list)
 
     def to_wire(self) -> dict:
-        return {
+        out = {
             "request_id": self.request_id,
             "token_ids": self.token_ids,
             "sampling": {
@@ -42,11 +45,20 @@ class PreprocessedRequest:
             "annotations": list(self.annotations),
             "model": self.model,
         }
+        if self.images:
+            out["images"] = [im.to_wire() for im in self.images]
+        return out
 
     @classmethod
     def from_wire(cls, d: dict) -> "PreprocessedRequest":
         s = d.get("sampling", {})
+        images = []
+        if d.get("images"):
+            from dynamo_tpu.llm.multimodal import ImageInput
+
+            images = [ImageInput.from_wire(x) for x in d["images"]]
         return cls(
+            images=images,
             request_id=d["request_id"],
             token_ids=list(d["token_ids"]),
             sampling=SamplingParams(

@@ -285,10 +285,13 @@ class LlamaModel:
         return hidden, k_pool, v_pool
 
     def _prefill_common(
-        self, params, kv_cache, tokens, positions, page_table, valid, last_idx, make_attn_fn
+        self, params, kv_cache, tokens, positions, page_table, valid, last_idx, make_attn_fn,
+        input_embeds=None, embeds_mask=None,
     ) -> tuple[jnp.ndarray, dict]:
         """Shared prefill machinery; make_attn_fn(off) -> attn_fn for a layer
-        (off = the layer's flat-pool offset)."""
+        (off = the layer's flat-pool offset). input_embeds [T, D] + embeds_mask
+        [T] override the token embeddings where the mask is set (multimodal:
+        vision-tower outputs replace image-slot virtual tokens)."""
         c = self.config
         k_pool, v_pool = kv_cache["k"], kv_cache["v"]
         page_size = k_pool.shape[1]
@@ -297,6 +300,8 @@ class LlamaModel:
         offsets = jnp.where(valid, positions % page_size, 0)
 
         hidden = params["embed"][tokens].astype(c.dtype)
+        if input_embeds is not None:
+            hidden = jnp.where(embeds_mask[:, None], input_embeds.astype(c.dtype), hidden)
 
         def body(carry, xs):
             h, kp, vp = carry
@@ -323,6 +328,8 @@ class LlamaModel:
         page_table: jnp.ndarray,  # [max_pages] logical (per-layer) page ids
         valid: jnp.ndarray,  # [T] bool
         last_idx: jnp.ndarray,  # scalar: index of the final real token in chunk
+        input_embeds: jnp.ndarray | None = None,  # [T, D] mm embedding overrides
+        embeds_mask: jnp.ndarray | None = None,  # [T] bool
     ) -> tuple[jnp.ndarray, dict]:
         """One (possibly chunked) prefill pass for a single sequence.
 
@@ -338,7 +345,8 @@ class LlamaModel:
             return attn_fn
 
         return self._prefill_common(
-            params, kv_cache, tokens, positions, page_table, valid, last_idx, make_attn_fn
+            params, kv_cache, tokens, positions, page_table, valid, last_idx, make_attn_fn,
+            input_embeds=input_embeds, embeds_mask=embeds_mask,
         )
 
     def prefill_sp(

@@ -243,3 +243,109 @@ def load_deepseek_weights(model, path: Path) -> dict:
     if not seen_head:
         arrays["lm_head"][:] = arrays["embed"]
     return _finish(arrays, shapes)
+
+
+def load_qwen2_vl_weights(model, path: Path) -> dict:
+    """HF qwen2_vl convention: text half matches Qwen2 (llama layout + qkv
+    biases under ``model.``); the vision tower lives under ``visual.``:
+    conv patch embed (conv3d over 2 duplicated temporal frames — folded into a
+    single linear by summing the temporal taps, exact for static images),
+    fused ``attn.qkv``, LayerNorm ``norm1``/``norm2``, ``mlp.fc1/fc2``, and
+    the ``merger`` (ln_q + 2-layer MLP into the LLM hidden size)."""
+    c = model.config
+    arrays, shapes = _alloc_like(model)
+    vis = arrays["vision"]
+    vlayers = vis["layers"]
+    vc = c.vision
+
+    text_arrays = {k: v for k, v in arrays.items() if k != "vision"}
+
+    per_layer = {
+        "input_layernorm.weight": ("input_norm", False),
+        "self_attn.q_proj.weight": ("wq", True),
+        "self_attn.k_proj.weight": ("wk", True),
+        "self_attn.v_proj.weight": ("wv", True),
+        "self_attn.o_proj.weight": ("wo", True),
+        "self_attn.q_proj.bias": ("bq", False),
+        "self_attn.k_proj.bias": ("bk", False),
+        "self_attn.v_proj.bias": ("bv", False),
+        "post_attention_layernorm.weight": ("post_norm", False),
+        "mlp.gate_proj.weight": ("gate", True),
+        "mlp.up_proj.weight": ("up", True),
+        "mlp.down_proj.weight": ("down", True),
+    }
+    vis_per_layer = {
+        "norm1.weight": ("norm1", False),
+        "norm1.bias": ("norm1_b", False),
+        "attn.qkv.weight": ("wqkv", True),
+        "attn.qkv.bias": ("bqkv", False),
+        "attn.proj.weight": ("wo", True),
+        "attn.proj.bias": ("bo", False),
+        "norm2.weight": ("norm2", False),
+        "norm2.bias": ("norm2_b", False),
+        "mlp.fc1.weight": ("fc1", True),
+        "mlp.fc1.bias": ("bfc1", False),
+        "mlp.fc2.weight": ("fc2", True),
+        "mlp.fc2.bias": ("bfc2", False),
+    }
+    merger_map = {
+        "merger.ln_q.weight": "merger_norm",
+        "merger.ln_q.bias": "merger_norm_b",
+        "merger.mlp.0.bias": "merger_bfc1",
+        "merger.mlp.2.bias": "merger_bfc2",
+    }
+
+    seen_embed = seen_head = False
+    for name, tensor in _iter_checkpoint_tensors(path):
+        if name == "model.embed_tokens.weight":
+            text_arrays["embed"][:] = tensor.astype(np.float32)
+            seen_embed = True
+        elif name == "model.norm.weight":
+            text_arrays["final_norm"][:] = tensor.astype(np.float32)
+        elif name == "lm_head.weight" and "lm_head" in text_arrays:
+            text_arrays["lm_head"][:] = tensor.astype(np.float32)
+            seen_head = True
+        elif name.startswith("model.layers."):
+            rest = name[len("model.layers.") :]
+            layer_str, sub = rest.split(".", 1)
+            l = int(layer_str)
+            mapping = per_layer.get(sub)
+            if mapping is None or mapping[0] not in text_arrays["layers"] or l >= c.num_layers:
+                log.debug("skipping unmapped weight %s", name)
+                continue
+            _set_layer(text_arrays["layers"], mapping[0], l, tensor, mapping[1])
+        elif name == "visual.patch_embed.proj.weight":
+            t = tensor.astype(np.float32)
+            if t.ndim == 5:  # conv3d [D, C, T, ps, ps]: sum temporal taps
+                t = t.sum(axis=2)
+            # conv2d [D, C, ps, ps] -> linear [C*ps*ps, D] matching patchify's
+            # pixel order (ps, ps, C) per patch
+            t = t.transpose(2, 3, 1, 0).reshape(-1, t.shape[0])
+            if t.shape != vis["patch_embed"].shape:
+                raise ValueError(
+                    f"patch_embed shape {t.shape} != {vis['patch_embed'].shape}"
+                )
+            vis["patch_embed"][:] = t
+        elif name.startswith("visual.blocks."):
+            rest = name[len("visual.blocks.") :]
+            layer_str, sub = rest.split(".", 1)
+            l = int(layer_str)
+            mapping = vis_per_layer.get(sub)
+            if mapping is None or l >= vc.num_layers:
+                log.debug("skipping unmapped weight %s", name)
+                continue
+            _set_layer(vlayers, mapping[0], l, tensor, mapping[1])
+        elif name == "visual.merger.mlp.0.weight":
+            vis["merger_fc1"][:] = tensor.T.astype(np.float32)
+        elif name == "visual.merger.mlp.2.weight":
+            vis["merger_fc2"][:] = tensor.T.astype(np.float32)
+        elif name[len("visual.") :] in merger_map and name.startswith("visual."):
+            vis[merger_map[name[len("visual.") :]]][:] = tensor.astype(np.float32)
+        else:
+            log.debug("skipping unmapped weight %s", name)
+
+    if not seen_embed:
+        raise ValueError("checkpoint missing model.embed_tokens.weight")
+    if "lm_head" in text_arrays and not seen_head:
+        text_arrays["lm_head"][:] = text_arrays["embed"]
+    return _finish(arrays, shapes)

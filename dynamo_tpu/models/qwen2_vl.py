@@ -1,0 +1,74 @@
+"""Qwen2-VL-style multimodal model: ViT vision tower + Qwen2 language model.
+
+The reference serves this family through its engine adapters (the vLLM patch's
+model zoo); here it is native. The language half IS LlamaModel (Qwen2 = llama
+geometry + qkv biases), so the paged KV cache, Pallas decode kernel, TP
+shardings, disagg block extraction, and prefix caching all apply unchanged.
+The vision half runs as a separate jitted encode (models/vision.py) whose
+outputs override the embedding rows of the image-slot virtual tokens during
+prefill (llm/multimodal.py explains the virtual-token scheme).
+
+Decode is pure text — images only affect prefill — so the decode hot path is
+byte-identical to the text family's.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+import jax
+from jax.sharding import Mesh
+
+from dynamo_tpu.models.llama import LlamaConfig, LlamaModel, parse_dtype
+from dynamo_tpu.models.vision import VisionConfig, VisionModel
+
+
+@dataclass(frozen=True)
+class Qwen2VLConfig(LlamaConfig):
+    vision: VisionConfig = field(default_factory=VisionConfig)
+
+    @classmethod
+    def from_hf_config(cls, d: dict) -> "Qwen2VLConfig":
+        base = LlamaConfig.from_hf_config(d)
+        vision = VisionConfig.from_hf_config(
+            d.get("vision_config", {}), out_hidden_size=base.hidden_size
+        )
+        return cls(**{f: getattr(base, f) for f in base.__dataclass_fields__}, vision=vision)
+
+    @classmethod
+    def tiny_vl(cls, **overrides) -> "Qwen2VLConfig":
+        if "dtype" in overrides:
+            overrides["dtype"] = parse_dtype(overrides["dtype"])
+        text = LlamaConfig.tiny(attention_bias=True)
+        base = cls(
+            **{f: getattr(text, f) for f in text.__dataclass_fields__},
+            vision=VisionConfig.tiny(out_hidden_size=text.hidden_size),
+        )
+        return replace(base, **overrides)
+
+
+class Qwen2VLModel(LlamaModel):
+    """LlamaModel + a vision tower under params["vision"]."""
+
+    def __init__(self, config: Qwen2VLConfig):
+        super().__init__(config)
+        self.vision = VisionModel(config.vision)
+
+    @property
+    def is_multimodal(self) -> bool:
+        return True
+
+    def init_params(self, rng: jax.Array) -> dict:
+        k_text, k_vis = jax.random.split(rng)
+        params = super().init_params(k_text)
+        params["vision"] = self.vision.init_params(k_vis)
+        return params
+
+    def param_shardings(self, mesh: Mesh, tp_axis: str = "tp") -> dict:
+        shardings = super().param_shardings(mesh, tp_axis)
+        shardings["vision"] = self.vision.param_shardings(mesh, tp_axis)
+        return shardings
+
+    def encode_images(self, params, patches, rows, cols, valid):
+        """[N, patch_dim] padded patches -> [N/merge^2, hidden] embeddings."""
+        return self.vision.encode(params["vision"], patches, rows, cols, valid)

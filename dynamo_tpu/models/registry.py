@@ -44,6 +44,16 @@ def load_model(model_id: str, seed: int = 0):
         jax.block_until_ready(params)
         return model, params
 
+    if model_id is not None and (model_id == "tiny-vl" or model_id.startswith("tiny-vl:")):
+        from dynamo_tpu.models.qwen2_vl import Qwen2VLConfig, Qwen2VLModel
+
+        overrides = json.loads(model_id.split(":", 1)[1]) if ":" in model_id else {}
+        cfg = Qwen2VLConfig.tiny_vl(**overrides)
+        model = Qwen2VLModel(cfg)
+        params = jax.jit(lambda key: model.init_params(key))(jax.random.key(seed))
+        jax.block_until_ready(params)
+        return model, params
+
     if model_id is None or model_id == "tiny" or model_id.startswith("tiny:"):
         overrides = {}
         if model_id and ":" in model_id:
@@ -74,6 +84,13 @@ def load_model(model_id: str, seed: int = 0):
             cfg = DeepseekConfig.from_hf_config(hf_cfg)
             model = DeepseekModel(cfg)
             return model, load_deepseek_weights(model, path)
+        if "Qwen2VL" in arch or hf_cfg.get("model_type") == "qwen2_vl":
+            from dynamo_tpu.models.loader import load_qwen2_vl_weights
+            from dynamo_tpu.models.qwen2_vl import Qwen2VLConfig, Qwen2VLModel
+
+            cfg = Qwen2VLConfig.from_hf_config(hf_cfg)
+            model = Qwen2VLModel(cfg)
+            return model, load_qwen2_vl_weights(model, path)
         if "Llama" not in arch and "Qwen" not in arch:
             raise ValueError(f"unsupported architecture {arch}")
         cfg = LlamaConfig.from_hf_config(hf_cfg)

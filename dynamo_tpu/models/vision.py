@@ -1,0 +1,262 @@
+"""Vision tower: ViT encoder over image patches, Qwen2-VL style.
+
+Fills the vision half of the multimodal model family slot (the reference
+delegates multimodal serving to its engines — e.g. Qwen2-VL via vLLM; here the
+tower is native JAX). TPU-first design:
+
+  - the encoder consumes **pre-patchified** pixels ``[N, C*ps*ps]`` padded to a
+    static patch bucket (one executable per bucket, no per-image recompiles);
+    a validity mask handles padding
+  - 2D rotary positions in the exact HF qwen2_vl layout (row/col angle halves
+    with rotate_half pairing across the full head dim) so checkpoints load 1:1
+  - layers are scan-stacked like the LLM (single compiled layer body)
+  - a 2x2 spatial merger concatenates neighbouring patch features and projects
+    into the LLM's hidden size, so each merged patch becomes ONE token in the
+    language sequence (``tokens_per_image = (h/m) * (w/m)`` for an h x w patch
+    grid with merge m)
+  - everything is bf16 matmuls on the MXU; attention over patches is
+    bidirectional (no causal mask)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dynamo_tpu.models.llama import parse_dtype
+
+
+def layer_norm(x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray, eps: float) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps) * weight + bias).astype(x.dtype)
+
+
+def quick_gelu(x: jnp.ndarray) -> jnp.ndarray:
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+_ACTS = {
+    "quick_gelu": quick_gelu,
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+    "gelu_new": jax.nn.gelu,
+    "silu": jax.nn.silu,
+}
+
+
+@dataclass(frozen=True)
+class VisionConfig:
+    patch_size: int = 14
+    in_channels: int = 3
+    spatial_merge_size: int = 2
+    hidden_size: int = 1280
+    intermediate_size: int = 3420
+    num_layers: int = 32
+    num_heads: int = 16
+    out_hidden_size: int = 3584  # LLM hidden size
+    hidden_act: str = "quick_gelu"  # HF qwen2_vl vision default
+    layer_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def patch_dim(self) -> int:
+        return self.in_channels * self.patch_size * self.patch_size
+
+    @classmethod
+    def from_hf_config(cls, d: dict, out_hidden_size: int) -> "VisionConfig":
+        """From a HF qwen2_vl ``vision_config`` dict."""
+        hidden = d.get("embed_dim", d.get("hidden_size", 1280))
+        depth = d.get("depth", d.get("num_hidden_layers", 32))
+        return cls(
+            patch_size=d.get("patch_size", 14),
+            in_channels=d.get("in_channels", d.get("in_chans", 3)),
+            spatial_merge_size=d.get("spatial_merge_size", 2),
+            hidden_size=hidden,
+            intermediate_size=d.get(
+                "intermediate_size", int(hidden * d.get("mlp_ratio", 4.0))
+            ),
+            num_layers=depth,
+            num_heads=d.get("num_heads", d.get("num_attention_heads", 16)),
+            out_hidden_size=out_hidden_size,
+            hidden_act=d.get("hidden_act", "quick_gelu"),
+        )
+
+    @classmethod
+    def tiny(cls, out_hidden_size: int = 64, **overrides) -> "VisionConfig":
+        if "dtype" in overrides:
+            overrides["dtype"] = parse_dtype(overrides["dtype"])
+        base = cls(
+            patch_size=4,
+            in_channels=3,
+            spatial_merge_size=2,
+            hidden_size=32,
+            intermediate_size=64,
+            num_layers=2,
+            num_heads=2,
+            out_hidden_size=out_hidden_size,
+            dtype=jnp.float32,
+        )
+        return replace(base, **overrides)
+
+
+def rope_2d(x: jnp.ndarray, rows: jnp.ndarray, cols: jnp.ndarray, theta: float) -> jnp.ndarray:
+    """2D rotary embedding, HF qwen2_vl layout: the angle vector over the
+    first half of the head dim is ``[row_angles (D/4) | col_angles (D/4)]``
+    (each with inv_freq ``theta^(-j/(D/4))``), duplicated to the second half,
+    and dim i pairs with dim i + D/2 (rotate_half over the full head dim) — so
+    loaded checkpoints see exactly the rotation they were trained with.
+
+    x: [N, H, D] (D divisible by 4), rows/cols: [N] int32.
+    """
+    D = x.shape[-1]
+    quarter = D // 4
+    inv_freq = theta ** (-jnp.arange(quarter, dtype=jnp.float32) / quarter)
+    ang = jnp.concatenate(
+        [rows[:, None].astype(jnp.float32) * inv_freq,
+         cols[:, None].astype(jnp.float32) * inv_freq],
+        axis=-1,
+    )  # [N, D/2]
+    cos = jnp.cos(ang)[:, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[:, None, :].astype(x.dtype)
+    x1, x2 = x[..., : D // 2], x[..., D // 2 :]
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
+class VisionModel:
+    """Stateless ViT forward over a params pytree (one image per call)."""
+
+    def __init__(self, config: VisionConfig):
+        self.config = config
+
+    def init_params(self, rng: jax.Array) -> dict:
+        c = self.config
+        keys = iter(jax.random.split(rng, 12))
+
+        def dense(key, shape):
+            scale = 1.0 / jnp.sqrt(jnp.float32(shape[0]))
+            return (jax.random.normal(key, shape, jnp.float32) * scale).astype(c.dtype)
+
+        L, D, F = c.num_layers, c.hidden_size, c.intermediate_size
+        m2 = c.spatial_merge_size * c.spatial_merge_size
+        # LayerNorm (weight+bias) and biased projections: the exact HF
+        # qwen2_vl vision-tower parameterization, loadable 1:1
+        return {
+            "patch_embed": dense(next(keys), (c.patch_dim, D)),
+            "layers": {
+                "norm1": jnp.ones((L, D), c.dtype),
+                "norm1_b": jnp.zeros((L, D), c.dtype),
+                "wqkv": dense(next(keys), (L, D, 3 * D)),
+                "bqkv": jnp.zeros((L, 3 * D), c.dtype),
+                "wo": dense(next(keys), (L, D, D)),
+                "bo": jnp.zeros((L, D), c.dtype),
+                "norm2": jnp.ones((L, D), c.dtype),
+                "norm2_b": jnp.zeros((L, D), c.dtype),
+                "fc1": dense(next(keys), (L, D, F)),
+                "bfc1": jnp.zeros((L, F), c.dtype),
+                "fc2": dense(next(keys), (L, F, D)),
+                "bfc2": jnp.zeros((L, D), c.dtype),
+            },
+            "merger_norm": jnp.ones((D,), c.dtype),
+            "merger_norm_b": jnp.zeros((D,), c.dtype),
+            "merger_fc1": dense(next(keys), (m2 * D, m2 * D)),
+            "merger_bfc1": jnp.zeros((m2 * D,), c.dtype),
+            "merger_fc2": dense(next(keys), (m2 * D, c.out_hidden_size)),
+            "merger_bfc2": jnp.zeros((c.out_hidden_size,), c.dtype),
+        }
+
+    def param_shardings(self, mesh: Mesh, tp_axis: str = "tp") -> dict:
+        """Vision tower is small next to the LLM: MLP/attention projections are
+        tp-sharded on the output axis, everything else replicated."""
+        tp = tp_axis if tp_axis in mesh.axis_names else None
+
+        def ns(*spec):
+            return NamedSharding(mesh, P(*spec))
+
+        return {
+            "patch_embed": ns(None, None),
+            "layers": {
+                "norm1": ns(None, None),
+                "norm1_b": ns(None, None),
+                "wqkv": ns(None, None, None),
+                "bqkv": ns(None, None),
+                "wo": ns(None, None, None),
+                "bo": ns(None, None),
+                "norm2": ns(None, None),
+                "norm2_b": ns(None, None),
+                "fc1": ns(None, None, tp),
+                "bfc1": ns(None, tp),
+                "fc2": ns(None, tp, None),
+                "bfc2": ns(None, None),
+            },
+            "merger_norm": ns(None),
+            "merger_norm_b": ns(None),
+            "merger_fc1": ns(None, None),
+            "merger_bfc1": ns(None),
+            "merger_fc2": ns(None, None),
+            "merger_bfc2": ns(None),
+        }
+
+    def encode(
+        self,
+        params: dict,
+        patches: jnp.ndarray,  # [N, patch_dim] pre-patchified pixels (padded)
+        rows: jnp.ndarray,  # [N] patch row index (0 for padding)
+        cols: jnp.ndarray,  # [N] patch col index
+        valid: jnp.ndarray,  # [N] bool
+    ) -> jnp.ndarray:
+        """-> [N // merge^2, out_hidden_size] merged patch embeddings.
+
+        Patches must be laid out in merge-group order (all merge^2 members of a
+        merged token contiguous) — llm/multimodal.py's patchify produces this.
+        """
+        c = self.config
+        N = patches.shape[0]
+        act = _ACTS[c.hidden_act]
+        h = (patches.astype(c.dtype) @ params["patch_embed"])  # [N, D]
+
+        neg = jnp.asarray(jnp.finfo(jnp.float32).min, jnp.float32)
+        attn_bias = jnp.where(valid[None, :], 0.0, neg)  # [1, N]
+
+        def body(hidden, lp):
+            x = layer_norm(hidden, lp["norm1"], lp["norm1_b"], c.layer_norm_eps)
+            qkv = x @ lp["wqkv"] + lp["bqkv"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(N, c.num_heads, c.head_dim)
+            k = k.reshape(N, c.num_heads, c.head_dim)
+            v = v.reshape(N, c.num_heads, c.head_dim)
+            q = rope_2d(q, rows, cols, c.rope_theta)
+            k = rope_2d(k, rows, cols, c.rope_theta)
+            scores = jnp.einsum("qhd,khd->hqk", q, k, preferred_element_type=jnp.float32)
+            scores = scores / jnp.sqrt(jnp.float32(c.head_dim)) + attn_bias[None]
+            probs = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
+            attn = jnp.einsum("hqk,khd->qhd", probs, v)
+            hidden = hidden + attn.reshape(N, -1) @ lp["wo"] + lp["bo"]
+            x = layer_norm(hidden, lp["norm2"], lp["norm2_b"], c.layer_norm_eps)
+            hidden = hidden + (act(x @ lp["fc1"] + lp["bfc1"]) @ lp["fc2"] + lp["bfc2"])
+            return hidden, None
+
+        h, _ = jax.lax.scan(body, h, params["layers"])
+
+        # 2x2 spatial merge: groups are contiguous rows -> concat features
+        m2 = c.spatial_merge_size * c.spatial_merge_size
+        h = layer_norm(h, params["merger_norm"], params["merger_norm_b"], c.layer_norm_eps)
+        h = h.reshape(N // m2, m2 * c.hidden_size)
+        h = (
+            jax.nn.gelu(
+                h.astype(c.dtype) @ params["merger_fc1"] + params["merger_bfc1"],
+                approximate=False,
+            )
+            @ params["merger_fc2"]
+            + params["merger_bfc2"]
+        )
+        return h
