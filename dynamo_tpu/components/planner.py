@@ -1,0 +1,243 @@
+"""Planner: dynamic worker scaling from live serving signals.
+
+The reference names the Planner as a headline capability but ships it as
+aspiration only (reference: docs/architecture.md:47 — "empower the Planner to
+make intelligent, zero-downtime adjustments"; no planner code exists in the
+snapshot). Here it is a working component:
+
+  - **decode pool**: scales on slot pressure (mean request-slot utilization,
+    queued requests) and KV pressure (mean page-pool utilization) scraped from
+    every worker's ForwardPassMetrics.
+  - **prefill pool**: scales on the disagg work-queue depth — the reference's
+    motivating example (long-ISL surges back up the prefill queue long before
+    decode slots saturate).
+
+Decisions are sustained-signal + cooldown gated (no flapping) and published to
+the control-plane KV at ``planner/{namespace}/desired/{component}``. Consumers:
+the sdk serve supervisor polls these keys when started with
+``--planner-scaling`` and spawns/terminates worker processes
+(dynamo_tpu/sdk/serve.py _apply_planner_scaling — the single-host loop), and a
+K8s controller can feed them into dynamo_tpu/deploy/reconciler.py's
+DeploymentSpec replicas. The policy core is pure (observe() in, decisions out)
+so it is testable without a cluster.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("components.planner")
+
+
+@dataclass
+class PoolPolicy:
+    """Scaling envelope + thresholds for one worker pool."""
+
+    min_replicas: int = 1
+    max_replicas: int = 8
+    # scale up when the pressure signal exceeds this for `sustain` observations
+    up_threshold: float = 0.8
+    # scale down when it stays below this for `sustain` observations
+    down_threshold: float = 0.3
+    sustain: int = 3
+    cooldown_s: float = 30.0
+
+
+@dataclass
+class ScaleDecision:
+    component: str
+    current: int
+    desired: int
+    reason: str
+
+    @property
+    def is_change(self) -> bool:
+        return self.desired != self.current
+
+
+@dataclass
+class _PoolState:
+    above: int = 0
+    below: int = 0
+    last_change: float = float("-inf")  # no cooldown before the first change
+
+
+class Planner:
+    """Pure scaling policy. Feed observations; get decisions."""
+
+    def __init__(
+        self,
+        decode_policy: PoolPolicy | None = None,
+        prefill_policy: PoolPolicy | None = None,
+        # queue depth that saturates the prefill pressure signal per replica
+        prefill_queue_per_worker: int = 4,
+    ):
+        self.decode_policy = decode_policy or PoolPolicy()
+        self.prefill_policy = prefill_policy or PoolPolicy()
+        self.prefill_queue_per_worker = prefill_queue_per_worker
+        self._decode = _PoolState()
+        self._prefill = _PoolState()
+
+    # ---------------- signals ----------------
+
+    @staticmethod
+    def decode_pressure(loads) -> float:
+        """Max of slot-, queue- and KV-pressure across the decode pool (any
+        one of them saturating means the pool needs help)."""
+        if not loads:
+            return 0.0
+        n = len(loads)
+        slot = sum(w.request_load_ratio for w in loads) / n
+        kv = sum(w.kv_load_ratio for w in loads) / n
+        waiting = sum(w.num_requests_waiting for w in loads)
+        total_slots = sum(max(1, w.request_total_slots) for w in loads)
+        queue = min(1.0, waiting / total_slots)
+        return max(slot, kv, queue)
+
+    def prefill_pressure(self, queue_depth: int, replicas: int) -> float:
+        cap = max(1, replicas) * self.prefill_queue_per_worker
+        return min(1.0, queue_depth / cap)
+
+    # ---------------- policy ----------------
+
+    def _evaluate(
+        self, state: _PoolState, policy: PoolPolicy, component: str,
+        current: int, pressure: float, now: float,
+    ) -> ScaleDecision:
+        if pressure >= policy.up_threshold:
+            state.above += 1
+            state.below = 0
+        elif pressure <= policy.down_threshold:
+            state.below += 1
+            state.above = 0
+        else:
+            state.above = state.below = 0
+
+        desired = current
+        reason = f"pressure={pressure:.2f} steady"
+        in_cooldown = (now - state.last_change) < policy.cooldown_s
+        if state.above >= policy.sustain and not in_cooldown:
+            desired = min(policy.max_replicas, current + 1)
+            reason = f"pressure={pressure:.2f} >= {policy.up_threshold} x{state.above}"
+        elif state.below >= policy.sustain and not in_cooldown:
+            desired = max(policy.min_replicas, current - 1)
+            reason = f"pressure={pressure:.2f} <= {policy.down_threshold} x{state.below}"
+        desired = max(policy.min_replicas, min(policy.max_replicas, desired))
+        if desired != current:
+            state.last_change = now
+            state.above = state.below = 0
+        return ScaleDecision(component, current, desired, reason)
+
+    def observe(
+        self,
+        decode_loads,  # list[WorkerLoad] scraped from the decode pool
+        prefill_queue_depth: int,
+        decode_replicas: int,
+        prefill_replicas: int,
+        now: Optional[float] = None,
+        decode_component: str = "worker",
+        prefill_component: str = "prefill-worker",
+    ) -> list[ScaleDecision]:
+        now = time.monotonic() if now is None else now
+        return [
+            self._evaluate(
+                self._decode, self.decode_policy, decode_component,
+                decode_replicas, self.decode_pressure(decode_loads), now,
+            ),
+            self._evaluate(
+                self._prefill, self.prefill_policy, prefill_component,
+                prefill_replicas, self.prefill_pressure(prefill_queue_depth, prefill_replicas), now,
+            ),
+        ]
+
+
+def desired_replicas_key(namespace: str, component: str) -> str:
+    return f"planner/{namespace}/desired/{component}"
+
+
+class PlannerService:
+    """Scrapes signals, runs the policy, publishes desired replicas to the
+    control-plane KV (watchable by the reconciler / serve supervisor)."""
+
+    def __init__(
+        self,
+        drt,
+        namespace: str,
+        decode_component: str = "worker",
+        prefill_component: str = "prefill-worker",
+        prefill_queue: Optional[str] = None,
+        planner: Optional[Planner] = None,
+        interval: float = 5.0,
+    ):
+        from dynamo_tpu.llm.kv_router.metrics_aggregator import KvMetricsAggregator
+
+        self.drt = drt
+        self.namespace = namespace
+        self.decode_component = decode_component
+        self.prefill_component = prefill_component
+        self.prefill_queue = prefill_queue or f"{namespace}.prefill"
+        self.planner = planner or Planner()
+        self.interval = interval
+        self.aggregator = KvMetricsAggregator(drt.cplane, namespace, decode_component)
+        self._task: Optional[asyncio.Task] = None
+        self.decisions: list[ScaleDecision] = []  # latest round
+
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+
+    async def _replica_count(self, component: str) -> int:
+        prefix = f"instances/{self.namespace}/components/{component}/"
+        try:
+            kvs = await self.drt.cplane.kv_get_prefix(prefix)
+            return max(1, len(kvs))
+        except Exception:
+            return 1
+
+    async def step(self) -> list[ScaleDecision]:
+        loads = await self.aggregator.scrape_once()
+        try:
+            depth = await self.drt.cplane.queue_depth(self.prefill_queue)
+        except Exception:
+            depth = 0
+        decisions = self.planner.observe(
+            loads,
+            depth,
+            await self._replica_count(self.decode_component),
+            await self._replica_count(self.prefill_component),
+            decode_component=self.decode_component,
+            prefill_component=self.prefill_component,
+        )
+        self.decisions = decisions
+        for d in decisions:
+            await self.drt.cplane.kv_put(
+                desired_replicas_key(self.namespace, d.component),
+                json.dumps(
+                    {"replicas": d.desired, "reason": d.reason, "ts": time.time()}
+                ).encode(),
+            )
+            if d.is_change:
+                log.info(
+                    "scale %s: %d -> %d (%s)", d.component, d.current, d.desired, d.reason
+                )
+        return decisions
+
+    async def _loop(self) -> None:
+        try:
+            while True:
+                try:
+                    await self.step()
+                except Exception:
+                    log.exception("planner step failed")
+                await asyncio.sleep(self.interval)
+        except asyncio.CancelledError:
+            pass

@@ -57,7 +57,15 @@ def _port_open(address: str) -> bool:
 
 
 class Supervisor:
-    def __init__(self, entry_spec: str, config: dict, cplane: str, restart: bool = True):
+    def __init__(
+        self,
+        entry_spec: str,
+        config: dict,
+        cplane: str,
+        restart: bool = True,
+        planner_scaling: bool = False,
+        planner_poll_s: float = 5.0,
+    ):
         self.entry_spec = entry_spec
         self.config = config
         self.cplane = cplane
@@ -67,6 +75,14 @@ class Supervisor:
         self._stopping = False
         self.allocator = ResourceAllocator()
         self._worker_envs: dict[str, dict[str, str]] = {}
+        # planner-driven scaling (components/planner.py publishes desired
+        # replica counts; the supervisor is the single-host consumer — the
+        # deploy reconciler is the K8s one)
+        self.planner_scaling = planner_scaling
+        self.planner_poll_s = planner_poll_s
+        self.desired: dict[str, int] = {}  # class name -> replica count
+        self._class_info: dict[str, tuple] = {}  # name -> (cls, meta, envs)
+        self._last_planner_poll = 0.0
 
     def _env(self) -> dict:
         env = dict(os.environ)
@@ -114,6 +130,8 @@ class Supervisor:
             num_workers, worker_envs = self.allocator.get_worker_env(
                 meta, self.config.get(cls.__name__, {})
             )
+            self.desired[cls.__name__] = num_workers
+            self._class_info[cls.__name__] = (cls, meta, worker_envs)
             for i in range(num_workers):
                 self.spawn(cls, i, worker_envs[i])
 
@@ -127,13 +145,19 @@ class Supervisor:
         try:
             while not self._stopping:
                 time.sleep(0.5)
+                if self.planner_scaling:
+                    self._apply_planner_scaling()
                 for name, proc in list(self.children.items()):
                     rc = proc.poll()
                     if rc is None:
                         continue
+                    cls_name, replica = name.rsplit("-", 1)
+                    if int(replica) >= self.desired.get(cls_name, 0):
+                        # scaled-down replica exiting after terminate()
+                        self.children.pop(name, None)
+                        continue
                     if self.restart and not self._stopping:
                         log.warning("%s exited rc=%s; restarting", name, rc)
-                        cls_name, replica = name.rsplit("-", 1)
                         cls = next(c for c in discover_graph(load_class(self.entry_spec))
                                    if c.__name__ == cls_name)
                         self.spawn(cls, int(replica))
@@ -145,6 +169,78 @@ class Supervisor:
         finally:
             self.shutdown()
         return exit_code
+
+    # ---------------- planner-driven scaling ----------------
+
+    def _read_planner_desired(self) -> dict[str, int]:
+        """Fetch planner/{ns}/desired/{component} keys from the control plane.
+        Returns {key: replicas}. One short-lived connection per poll."""
+        import asyncio
+
+        async def fetch():
+            from dynamo_tpu.cplane.client import CplaneClient
+
+            client = CplaneClient(self.cplane)
+            await client.connect()
+            try:
+                items = await client.kv_get_prefix("planner/")
+                out = {}
+                for i in items:
+                    if "/desired/" not in i.key:
+                        continue
+                    try:
+                        out[i.key] = int(json.loads(i.value)["replicas"])
+                    except Exception:
+                        log.warning("malformed planner key %s", i.key)
+                return out
+            finally:
+                await client.close()
+
+        async def bounded():
+            # the monitor loop also does crash-restarts: a hung control plane
+            # must not stall it
+            return await asyncio.wait_for(fetch(), timeout=3.0)
+
+        return asyncio.run(bounded())
+
+    def _apply_planner_scaling(self) -> None:
+        now = time.time()
+        if now - self._last_planner_poll < self.planner_poll_s:
+            return
+        self._last_planner_poll = now
+        try:
+            desired_by_key = self._read_planner_desired()
+        except Exception as e:
+            log.debug("planner poll failed: %s", e)
+            return
+        for cls_name, (cls, meta, envs) in self._class_info.items():
+            key = f"planner/{meta.namespace}/desired/{meta.component}"
+            want = desired_by_key.get(key)
+            if want is None or want == self.desired.get(cls_name):
+                continue
+            have = self.desired[cls_name]
+            log.info("planner: scaling %s %d -> %d", cls_name, have, want)
+            self.desired[cls_name] = want
+            for i in range(have, want):  # scale up
+                # a replica of this index terminated by an earlier scale-down
+                # may still be exiting: reap it before reusing the name (two
+                # live processes must not share chip assignments)
+                old = self.children.pop(f"{cls_name}-{i}", None)
+                if old is not None and old.poll() is None:
+                    try:
+                        old.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        old.kill()
+                        old.wait()
+                # replicas beyond the initial allocation share its chip
+                # assignments round-robin (time-sliced on chip; see allocator)
+                env = envs[i % len(envs)] if envs else None
+                self.spawn(cls, i, env)
+            for i in range(want, have):  # scale down, highest index first
+                name = f"{cls_name}-{i}"
+                proc = self.children.get(name)
+                if proc is not None and proc.poll() is None:
+                    proc.terminate()
 
     def shutdown(self) -> None:
         self._stopping = True
@@ -167,10 +263,17 @@ def main(argv=None) -> int:
     parser.add_argument("-f", "--file", default=None, help="YAML service config")
     parser.add_argument("--cplane", default=os.environ.get("DYNTPU_CPLANE", "127.0.0.1:4222"))
     parser.add_argument("--no-restart", action="store_true")
+    parser.add_argument(
+        "--planner-scaling", action="store_true",
+        help="scale service replicas from the planner's desired-replica keys",
+    )
     parser.add_argument("overrides", nargs="*", help="--Service.key=value overrides")
     args = parser.parse_args(argv)
     config = ServiceConfig.from_yaml_and_overrides(args.file, args.overrides)
-    sup = Supervisor(args.entry, config, args.cplane, restart=not args.no_restart)
+    sup = Supervisor(
+        args.entry, config, args.cplane, restart=not args.no_restart,
+        planner_scaling=args.planner_scaling,
+    )
     return sup.run()
 
 
