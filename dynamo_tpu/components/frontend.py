@@ -52,6 +52,7 @@ class RemoteBackend:
                 finish_reason=item.get("finish_reason"),
                 cumulative_tokens=item.get("cumulative_tokens", 0),
                 cached_tokens=item.get("cached_tokens", 0),
+                logprobs=item.get("logprobs"),
             )
 
 

@@ -107,4 +107,5 @@ class WorkerService:
                 "finish_reason": out.finish_reason,
                 "cumulative_tokens": out.cumulative_tokens,
                 "cached_tokens": out.cached_tokens,
+                "logprobs": out.logprobs,
             }

@@ -115,10 +115,11 @@ class DisaggDecodeEngine:
         except Exception:
             queue_depth = 0
 
-        # multimodal prompts prefill locally: the remote-prefill wire protocol
-        # carries token ids only, and image prefixes dedupe via their virtual
-        # ids in the local prefix cache anyway
-        if request.images or not self.router.prefill_remote(
+        # multimodal and logprobs prompts prefill locally: the remote-prefill
+        # wire protocol carries token ids only (no pixel data, no first-token
+        # logprobs — a remote first token would leave the logprobs array
+        # misaligned by one entry)
+        if request.images or request.logprobs is not None or not self.router.prefill_remote(
             len(prompt), prefix_hit, queue_depth
         ):
             self.local_prefills += 1

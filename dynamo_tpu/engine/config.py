@@ -21,6 +21,11 @@ class EngineConfig:
     # over an "sp" mesh axis (long-context path; decode is unaffected).
     # Currently composes with tp=1 only.
     sp: int = 1
+    # pipeline-parallel stages: >1 shards the layer stack (and its KV pages)
+    # over a "pp" mesh axis and runs GPipe microbatch rotation for both
+    # prefill and decode (dynamo_tpu/parallel/pipeline.py). Exclusive with
+    # tp/sp for now; requires num_layers % pp == 0.
+    pp: int = 1
     worker_id: str = "worker-0"
     # fraction of pages that must stay free for decode growth before admitting
     # a new sequence (simple admission control)

@@ -106,9 +106,11 @@ class AsyncJaxEngine:
         )
         self.scheduler = Scheduler(self.config, self.runner, self.allocator)
         log.info(
-            "engine ready: model=%s tp=%d pages=%d (%.1fs)",
+            "engine ready: model=%s tp=%d pp=%d sp=%d pages=%d (%.1fs)",
             self.config.model_id,
             self.config.tp,
+            self.config.pp,
+            self.config.sp,
             self.config.num_pages,
             time.monotonic() - t0,
         )
@@ -373,6 +375,14 @@ class AsyncJaxEngine:
             self._cancel_box.put(request_id)
 
     def _fail_all(self, exc: Exception) -> None:
-        for seq in [s for s in self.scheduler.slots if s is not None]:
-            self.scheduler.cancel(seq.req.request_id)
-            self._post(seq.req.request_id, exc)
+        """Fail every request the scheduler knows about. Includes the waiting
+        queue: a step can die while admitting (e.g. a trace error on the very
+        first prefill), before the request ever reaches a slot — those callers
+        must not be left waiting forever."""
+        sched = self.scheduler
+        rids = {s.req.request_id for s in sched.slots if s is not None}
+        rids.update(s.req.request_id for s in sched.adopted_waiting)
+        rids.update(r.request_id for r in sched.waiting)
+        for rid in rids:
+            sched.cancel(rid)
+            self._post(rid, exc)

@@ -28,7 +28,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dynamo_tpu.engine.config import EngineConfig
-from dynamo_tpu.engine.sampling import sample_tokens
+from dynamo_tpu.engine.sampling import sample_tokens, sample_tokens_with_logprobs
 from dynamo_tpu.utils import get_logger
 
 log = get_logger("engine.runner")
@@ -46,6 +46,23 @@ class ModelRunner:
         self.model = model
         if config.sp > 1 and config.tp > 1:
             raise ValueError("sp and tp cannot both exceed 1 yet")
+        if config.pp > 1:
+            if config.tp > 1 or config.sp > 1:
+                raise ValueError("pp is exclusive with tp/sp for now")
+            if model.config.num_layers % config.pp:
+                raise ValueError(
+                    f"num_layers={model.config.num_layers} not divisible by pp={config.pp}"
+                )
+            if len(jax.devices()) < config.pp:
+                raise ValueError(
+                    f"pp={config.pp} but only {len(jax.devices())} devices available"
+                )
+            if any(b % config.pp for b in config.prefill_buckets):
+                raise ValueError(
+                    f"every prefill bucket must divide into pp={config.pp} microbatches"
+                )
+            if config.max_seqs % config.pp:
+                raise ValueError(f"max_seqs must be divisible by pp={config.pp}")
         if config.sp > 1:
             if not hasattr(model, "prefill_sp"):
                 raise ValueError(
@@ -61,7 +78,10 @@ class ModelRunner:
                     f"{config.prefill_buckets}; SP prefill would never engage"
                 )
         if mesh is None:
-            if config.sp > 1:
+            if config.pp > 1:
+                devices = jax.devices()[: config.pp]
+                mesh = Mesh(np.array(devices).reshape(len(devices)), ("pp",))
+            elif config.sp > 1:
                 devices = jax.devices()[: config.sp]
                 mesh = Mesh(np.array(devices).reshape(len(devices)), ("sp",))
             else:
@@ -72,9 +92,26 @@ class ModelRunner:
             # the Pallas decode kernel runs under shard_map on this mesh
             # (attention is head-parallel; no collectives inside)
             model.attn_mesh = mesh
-        shardings = model.param_shardings(mesh)
+        if config.pp > 1:
+            # stage sharding: layer stack + layer-major KV pool split over pp
+            from dynamo_tpu.parallel.pipeline import (
+                stage_kv_sharding,
+                stage_param_shardings,
+            )
+
+            shardings = stage_param_shardings(model, mesh)
+            kv_sharding = stage_kv_sharding(mesh)
+            probe = jax.eval_shape(
+                lambda: model.init_kv_cache(config.num_pages, config.page_size)
+            )
+            if set(probe) != {"k", "v"}:
+                raise ValueError(
+                    "pp currently supports the k/v page-pool model families"
+                )
+        else:
+            shardings = model.param_shardings(mesh)
+            kv_sharding = model.kv_cache_sharding(mesh)
         self.params = jax.device_put(params, shardings)
-        kv_sharding = model.kv_cache_sharding(mesh)
         self.kv_cache = jax.device_put(
             model.init_kv_cache(config.num_pages, config.page_size), kv_sharding
         )
@@ -95,7 +132,7 @@ class ModelRunner:
             # sequence-parallel whole-prompt prefill (ring attention over sp)
             self._prefill_sp = jax.jit(self._prefill_sp_impl, donate_argnums=(1, 2))
         self._decode_window = jax.jit(
-            self._decode_window_impl, donate_argnums=(1, 2), static_argnums=(6,)
+            self._decode_window_impl, donate_argnums=(1, 2), static_argnums=(6, 7)
         )
         self._write_tokens = jax.jit(
             lambda td, idx, vals: td.at[idx].set(vals, mode="drop"),
@@ -121,6 +158,29 @@ class ModelRunner:
 
     # ---------------- jitted bodies ----------------
 
+    def _model_prefill(self, params, kv, tokens, positions, page_table, valid, last, embeds=None, emask=None):
+        """model.prefill, or its GPipe-pipelined form when pp > 1."""
+        if self.config.pp > 1:
+            from dynamo_tpu.parallel.pipeline import prefill_pipelined
+
+            return prefill_pipelined(
+                self.model, params, kv, tokens, positions, page_table, valid, last,
+                self.mesh, input_embeds=embeds, embeds_mask=emask,
+            )
+        return self.model.prefill(
+            params, kv, tokens, positions, page_table, valid, last,
+            input_embeds=embeds, embeds_mask=emask,
+        )
+
+    def _model_decode(self, params, kv, tokens, positions, page_tables, active):
+        if self.config.pp > 1:
+            from dynamo_tpu.parallel.pipeline import decode_pipelined
+
+            return decode_pipelined(
+                self.model, params, kv, tokens, positions, page_tables, active, self.mesh
+            )
+        return self.model.decode(params, kv, tokens, positions, page_tables, active)
+
     def _prefill_impl(self, params, kv, tokens_dev, ints, flts, key, embeds=None, emask=None):
         """ints [bucket + max_pages + 4] = token buf, page table, then
         (start_pos, n_real, top_k, slot); flts [2] = (temperature, top_p).
@@ -142,13 +202,16 @@ class ModelRunner:
         slot = ints[bucket + mp + 3]
         positions = start_pos + jnp.arange(bucket, dtype=jnp.int32)
         valid = jnp.arange(bucket) < n
-        logits, kv = self.model.prefill(
+        logits, kv = self._model_prefill(
             params, kv, tokens, positions, page_table, valid, n - 1,
-            input_embeds=embeds, embeds_mask=emask,
+            embeds=embeds, emask=emask,
         )
-        tok = sample_tokens(logits[None, :], key, flts[:1], top_k[None], flts[1:])[0]
+        toks, chosen, tids, tvals = sample_tokens_with_logprobs(
+            logits[None, :], key, flts[:1], top_k[None], flts[1:]
+        )
+        tok = toks[0]
         tokens_dev = tokens_dev.at[slot].set(tok, mode="drop")
-        return tok, kv, tokens_dev
+        return tok, (chosen[0], tids[0], tvals[0]), kv, tokens_dev
 
     def _prefill_sp_impl(self, params, kv, tokens_dev, ints, flts, key):
         """Same packed-ints contract as _prefill_impl, but the whole-prompt
@@ -166,11 +229,14 @@ class ModelRunner:
         logits, kv = self.model.prefill_sp(
             params, kv, tokens, positions, page_table, valid, n - 1, mesh=self.mesh
         )
-        tok = sample_tokens(logits[None, :], key, flts[:1], top_k[None], flts[1:])[0]
+        toks, chosen, tids, tvals = sample_tokens_with_logprobs(
+            logits[None, :], key, flts[:1], top_k[None], flts[1:]
+        )
+        tok = toks[0]
         tokens_dev = tokens_dev.at[slot].set(tok, mode="drop")
-        return tok, kv, tokens_dev
+        return tok, (chosen[0], tids[0], tvals[0]), kv, tokens_dev
 
-    def _decode_window_impl(self, params, kv, tokens_dev, ints, flts, key, num_steps):
+    def _decode_window_impl(self, params, kv, tokens_dev, ints, flts, key, num_steps, want_lp=False):
         """num_steps fused decode steps; the sampled-token feedback loop starts
         from the device-resident ``tokens_dev`` buffer, so the host can
         dispatch windows back-to-back without reading any results in between.
@@ -192,17 +258,29 @@ class ModelRunner:
 
         def body(carry, k):
             kv, tokens, positions, act = carry
-            logits, kv = self.model.decode(params, kv, tokens, positions, page_tables, act)
-            toks = sample_tokens(logits, k, temps, top_ks, top_ps)
+            logits, kv = self._model_decode(params, kv, tokens, positions, page_tables, act)
+            if want_lp:
+                toks, chosen, tids, tvals = sample_tokens_with_logprobs(
+                    logits, k, temps, top_ks, top_ps
+                )
+                ys = (toks, chosen, tids, tvals)
+            else:
+                # logprobs gated out of the trace: no full-vocab log_softmax or
+                # top_k rides the hot path unless some request asked for them
+                toks = sample_tokens(logits, k, temps, top_ks, top_ps)
+                ys = (toks,)
             tokens = jnp.where(act, toks, tokens)
             positions = positions + act.astype(positions.dtype)
             act = act & (positions <= limits)
-            return (kv, tokens, positions, act), toks
+            return (kv, tokens, positions, act), ys
 
-        (kv, tokens, _, _), all_toks = jax.lax.scan(
+        (kv, tokens, _, _), ys = jax.lax.scan(
             body, (kv, tokens_dev, positions, active), keys
         )
-        return all_toks, kv, tokens  # [num_steps, B], donated kv, tokens_dev
+        all_toks = ys[0]
+        lp = (ys[1], ys[2], ys[3]) if want_lp else None
+        # [num_steps, B] tokens (+ ([num_steps, B], [num_steps, B, K] x2) lp)
+        return all_toks, lp, kv, tokens
 
     # ---------------- host API (engine thread) ----------------
 
@@ -223,6 +301,7 @@ class ModelRunner:
         sync: bool = True,
         embeds: Optional[np.ndarray] = None,  # [n, D] mm overrides for this chunk
         embeds_mask: Optional[np.ndarray] = None,  # [n] bool
+        want_logprobs: bool = False,  # sync=False only: also return lp arrays
     ):
         """Run one prefill chunk.
 
@@ -261,7 +340,7 @@ class ModelRunner:
             and bucket % self.config.sp == 0
         )
         prefill_fn = self._prefill_sp if use_sp else self._prefill
-        tok, self.kv_cache, self.tokens_dev = prefill_fn(
+        tok, lp, self.kv_cache, self.tokens_dev = prefill_fn(
             self.params,
             self.kv_cache,
             self.tokens_dev,
@@ -278,6 +357,8 @@ class ModelRunner:
             tok.copy_to_host_async()
         except Exception:
             pass
+        if want_logprobs:
+            return tok, lp
         return tok
 
     VISION_BUCKETS = (64, 256, 1024, 4096, 16384)
@@ -326,6 +407,7 @@ class ModelRunner:
         top_ks: np.ndarray,
         top_ps: np.ndarray,
         num_steps: int,
+        want_logprobs: bool = False,
     ):
         """Dispatch one fused decode window WITHOUT waiting for results.
 
@@ -340,7 +422,7 @@ class ModelRunner:
         ints[3] = top_ks
         ints[4:] = page_tables.T
         flts = np.stack([temps, top_ps]).astype(np.float32)
-        toks, self.kv_cache, self.tokens_dev = self._decode_window(
+        toks, lp, self.kv_cache, self.tokens_dev = self._decode_window(
             self.params,
             self.kv_cache,
             self.tokens_dev,
@@ -348,12 +430,16 @@ class ModelRunner:
             jnp.asarray(flts),
             self._next_key(),
             num_steps,
+            want_logprobs,
         )
         try:
             toks.copy_to_host_async()
+            if want_logprobs:
+                for a in lp:
+                    a.copy_to_host_async()
         except Exception:
             pass
-        return toks
+        return (toks, lp) if want_logprobs else toks
 
     def extract_pages_device(self, page_ids: np.ndarray) -> jax.Array:
         """Gather KV blocks into a device array [L, 2, n, page_size, Hkv, D]

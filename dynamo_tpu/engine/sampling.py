@@ -63,3 +63,27 @@ def sample_tokens(
     masked = jnp.where(keep_k & keep_p, logits, _NEG_INF)
     sampled = jax.random.categorical(key, masked / temp)
     return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+
+
+LOGPROBS_K = 20  # top alternatives computed on device (= the OpenAI API max)
+
+
+def sample_tokens_with_logprobs(
+    logits: jnp.ndarray,  # [B, V] float32
+    key: jax.Array,
+    temperature: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """sample_tokens + OpenAI-style logprobs of the model distribution.
+
+    Returns (tokens [B], chosen_logprob [B], topk_ids [B, K], topk_logprobs
+    [B, K]). Logprobs are log-softmax of the raw (untempered) logits — the
+    model's distribution, matching the OpenAI API semantic; sampling itself
+    still applies temperature/top-k/top-p.
+    """
+    tokens = sample_tokens(logits, key, temperature, top_k, top_p)
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    chosen = jnp.take_along_axis(logprobs, tokens[:, None].astype(jnp.int32), -1)[:, 0]
+    top_vals, top_ids = jax.lax.top_k(logprobs, LOGPROBS_K)
+    return tokens, chosen, top_ids.astype(jnp.int32), top_vals

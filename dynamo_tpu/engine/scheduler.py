@@ -55,6 +55,9 @@ class EngineRequest:
     # ([num_tokens, D] float32 each), filled by the engine at admission
     images: list = field(default_factory=list)
     mm_embeds: Optional[list] = None
+    # OpenAI logprobs: None = off, 0 = chosen token only, n>0 = n top
+    # alternatives per token (capped at sampling.LOGPROBS_K on device)
+    logprobs: Optional[int] = None
 
 
 @dataclass
@@ -64,6 +67,8 @@ class StepOutput:
     finished: bool = False
     finish_reason: Optional[str] = None  # stop | length | error | preempted
     cached_tokens: int = 0  # prefix-cache hit length (first output only)
+    logprob: Optional[float] = None  # chosen-token logprob (when requested)
+    top_logprobs: Optional[list] = None  # [(token_id, logprob), ...]
 
 
 @dataclass
@@ -96,6 +101,7 @@ class _InFlight:
     # first: (seq, cached_len); window: [(seq, slot_idx, steps), ...]
     seqs: list = field(default_factory=list)
     cached_len: int = 0
+    lp: object = None  # (chosen, top_ids, top_lps) device arrays, if requested
 
 
 def _mm_chunk_overrides(req: EngineRequest, start: int, end: int):
@@ -232,6 +238,18 @@ class Scheduler:
             except MemoryError:
                 self.waiting.appendleft(req)
                 break
+            except Exception:
+                # admission died mid-flight (e.g. a trace error on the first
+                # prefill): fail THIS request — it is in no queue or slot
+                # anymore, so nothing else would ever answer its caller
+                log.exception("admission failed for %s", req.request_id)
+                if req.request_id in self.allocator._seqs:
+                    self.allocator.free_sequence(req.request_id)
+                if self.slots[slot] is not None and self.slots[slot].req is req:
+                    self.slots[slot] = None
+                outputs.append(
+                    StepOutput(req.request_id, finished=True, finish_reason="error")
+                )
         return outputs
 
     def _start_sequence(self, req: EngineRequest, slot: int) -> None:
@@ -253,13 +271,14 @@ class Scheduler:
 
         # dispatch-ahead: chunks run without any host sync; the final chunk
         # samples, seeds tokens_dev[slot] on device, and async-copies the token
-        tok_dev = self._dispatch_prefill_chunks(
+        result = self._dispatch_prefill_chunks(
             req, page_table, cached_len, prompt_len, slot=slot
         )
+        tok_dev, lp = result if isinstance(result, tuple) else (result, None)
         self.allocator.commit_prefilled(req.request_id, prompt_len)
         self.slots[slot] = seq
         self.in_flight.append(
-            _InFlight(kind="first", dev=tok_dev, seqs=[seq], cached_len=cached_len)
+            _InFlight(kind="first", dev=tok_dev, seqs=[seq], cached_len=cached_len, lp=lp)
         )
 
     def _dispatch_prefill_chunks(
@@ -269,7 +288,8 @@ class Scheduler:
         """Dispatch-ahead chunked prefill: no host sync; the final chunk seeds
         tokens_dev[slot] and returns the token as a device scalar."""
         return self.run_prefill_chunks(
-            req, page_table, cached_len, prompt_len, slot=slot, sync=False
+            req, page_table, cached_len, prompt_len, slot=slot, sync=False,
+            want_logprobs=req.logprobs is not None,
         )
 
     def run_prefill_chunks(
@@ -280,6 +300,7 @@ class Scheduler:
         prompt_len: int,
         slot: int = -1,
         sync: bool = True,
+        want_logprobs: bool = False,
     ):
         """Bucket-chunked prefill, skipping the cached prefix; samples the first
         output token on the final chunk. sync=True (disagg prefill-worker path)
@@ -311,6 +332,7 @@ class Scheduler:
                 sync=sync,
                 embeds=embeds,
                 embeds_mask=embeds_mask,
+                want_logprobs=want_logprobs and not sync,
             )
             if is_last:
                 first_token = tok
@@ -437,10 +459,13 @@ class Scheduler:
             snapshot.append((seq, i, steps))
             seq.sched_len += steps
 
-        toks_dev = self.runner.dispatch_decode_window(
-            positions, page_tables, active, limits, temps, top_ks, top_ps, K
+        want_lp = any(seq.req.logprobs is not None for seq, _ in participants)
+        result = self.runner.dispatch_decode_window(
+            positions, page_tables, active, limits, temps, top_ks, top_ps, K,
+            want_logprobs=want_lp,
         )
-        self.in_flight.append(_InFlight(kind="window", dev=toks_dev, seqs=snapshot))
+        toks_dev, lp = result if want_lp else (result, None)
+        self.in_flight.append(_InFlight(kind="window", dev=toks_dev, seqs=snapshot, lp=lp))
         return True
 
     def _reconcile(self, block: bool, drain: bool = False) -> list[StepOutput]:
@@ -454,27 +479,40 @@ class Scheduler:
                 break
             self.in_flight.popleft()
             data = np.asarray(entry.dev)
+            lp = None
+            if entry.lp is not None:
+                lp = tuple(np.asarray(a) for a in entry.lp)
             block = False
             if entry.kind == "first":
                 seq = entry.seqs[0]
                 if seq.finished:
                     continue
                 outputs.extend(
-                    self._emit_token(seq, int(data), cached=entry.cached_len)
+                    self._emit_token(
+                        seq, int(data), cached=entry.cached_len,
+                        lp=(lp[0][()], lp[1], lp[2]) if lp is not None else None,
+                    )
                 )
             else:
                 for seq, slot_idx, steps in entry.seqs:
                     if seq.finished:
                         continue  # EOS/cancel discovered earlier; zombie tokens
                     for j in range(min(steps, data.shape[0])):
-                        outputs.extend(self._emit_token(seq, int(data[j, slot_idx])))
+                        step_lp = None
+                        if lp is not None:
+                            step_lp = (lp[0][j, slot_idx], lp[1][j, slot_idx], lp[2][j, slot_idx])
+                        outputs.extend(
+                            self._emit_token(seq, int(data[j, slot_idx]), lp=step_lp)
+                        )
                         if seq.finished:
                             break
         return outputs
 
     # ---------------- helpers ----------------
 
-    def _emit_token(self, seq: RunningSeq, token: Optional[int], cached: int = 0) -> list[StepOutput]:
+    def _emit_token(
+        self, seq: RunningSeq, token: Optional[int], cached: int = 0, lp=None
+    ) -> list[StepOutput]:
         if token is None or seq.finished:
             return []
         req = seq.req
@@ -489,6 +527,14 @@ class Scheduler:
         elif seq.pos >= self.config.max_model_len:
             finish = "length"
         out = StepOutput(req.request_id, token=token, cached_tokens=cached)
+        if lp is not None and req.logprobs is not None:
+            chosen, top_ids, top_vals = lp
+            out.logprob = float(chosen)
+            n = min(req.logprobs, len(top_ids))
+            if n > 0:
+                out.top_logprobs = [
+                    (int(top_ids[i]), float(top_vals[i])) for i in range(n)
+                ]
         if finish is not None:
             out.finished = True
             out.finish_reason = finish
@@ -529,6 +575,7 @@ class Scheduler:
             token_ids=list(seq.req.token_ids) + seq.generated,
             images=seq.req.images,
             mm_embeds=seq.req.mm_embeds,  # offsets are prompt-relative: still valid
+            logprobs=seq.req.logprobs,
             sampling=SamplingParams(
                 temperature=seq.req.sampling.temperature,
                 top_k=seq.req.sampling.top_k,

@@ -59,6 +59,7 @@ class RemoteEngineProxy:
                 "ignore_eos": request.sampling.ignore_eos,
             },
             "eos_token_ids": list(request.eos_token_ids),
+            "logprobs": request.logprobs,
         }
         if request.images:
             wire["images"] = [im.to_wire() for im in request.images]
@@ -76,6 +77,7 @@ class RemoteEngineProxy:
                 cached_tokens=item.get("cached_tokens", 0),
             )
             out.text = item.get("text", "")  # pass-through for RemoteTextBackend
+            out.lp_entries = item.get("logprobs")  # already OpenAI-shaped
             yield out
 
 
@@ -95,6 +97,7 @@ class RemoteTextBackend:
             sampling=request.sampling,
             eos_token_ids=tuple(request.eos_token_ids),
             images=list(getattr(request, "images", ()) or ()),
+            logprobs=getattr(request, "logprobs", None),
         )
         count = 0
         async for out in self.proxy.generate(engine_req):
@@ -107,6 +110,7 @@ class RemoteTextBackend:
                 finish_reason=out.finish_reason,
                 cumulative_tokens=count,
                 cached_tokens=out.cached_tokens,
+                logprobs=getattr(out, "lp_entries", None),
             )
             if out.finished:
                 return
@@ -139,6 +143,7 @@ async def serve_engine_endpoint(engine, args) -> None:
                 "finish_reason": out.finish_reason,
                 "cumulative_tokens": out.cumulative_tokens,
                 "cached_tokens": out.cached_tokens,
+                "logprobs": out.logprobs,
             }
 
     def stats():

@@ -34,6 +34,7 @@ def engine_config_for(args):
             max_model_len=card.context_length,
             prefill_buckets=(16, 32),
             tp=getattr(args, "tp", None) or 1,
+            pp=getattr(args, "pp", None) or 1,
         )
     return EngineConfig(
         model_id=card.model_path,
@@ -42,6 +43,7 @@ def engine_config_for(args):
         max_seqs=getattr(args, "max_seqs", None) or 16,
         max_model_len=card.context_length,
         tp=getattr(args, "tp", None) or 1,
+        pp=getattr(args, "pp", None) or 1,
     )
 
 

@@ -23,6 +23,7 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--num-pages", type=int, default=None, help="KV cache pages")
     run.add_argument("--max-seqs", type=int, default=None, help="decode batch slots")
     run.add_argument("--tp", type=int, default=None, help="tensor-parallel degree")
+    run.add_argument("--pp", type=int, default=None, help="pipeline-parallel stages")
     run.add_argument("--max-tokens", type=int, default=None, help="batch mode default max_tokens")
     return p
 

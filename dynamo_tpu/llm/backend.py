@@ -62,6 +62,23 @@ class Backend:
         self.engine = engine
         self.tokenizer = tokenizer
 
+    def _token_repr(self, token_id: int) -> tuple[str, list[int]]:
+        text = self.tokenizer.decode([token_id], skip_special_tokens=False)
+        return text, list(text.encode("utf-8"))
+
+    def _logprob_entry(self, step) -> dict:
+        """StepOutput logprobs -> OpenAI-shaped entry (token strings decoded
+        here, next to the tokenizer)."""
+        tok_str, tok_bytes = self._token_repr(step.token)
+        entry = {"token": tok_str, "logprob": step.logprob, "bytes": tok_bytes}
+        if step.top_logprobs is not None:
+            top = []
+            for tid, lp in step.top_logprobs:
+                t_str, t_bytes = self._token_repr(tid)
+                top.append({"token": t_str, "logprob": lp, "bytes": t_bytes})
+            entry["top"] = top
+        return entry
+
     async def generate(self, request: PreprocessedRequest) -> AsyncIterator[BackendOutput]:
         eos_ids = tuple(request.eos_token_ids) or tuple(self.tokenizer.eos_token_ids)
         engine_req = EngineRequest(
@@ -70,6 +87,7 @@ class Backend:
             sampling=request.sampling,
             eos_token_ids=eos_ids,
             images=list(request.images),
+            logprobs=request.logprobs,
         )
         decoder = DecodeStream(self.tokenizer, prompt_ids=request.token_ids)
         jail = _StopJail(request.stop_strings)
@@ -78,6 +96,7 @@ class Backend:
         async for step in self.engine.generate(engine_req):
             text = ""
             ids: list[int] = []
+            lp_entries = None
             if step.token is not None:
                 count += 1
                 ids = [step.token]
@@ -86,6 +105,8 @@ class Backend:
                     delta = decoder.step(step.token)
                     if delta:
                         text = delta
+                if step.logprob is not None:
+                    lp_entries = [self._logprob_entry(step)]
             cached = max(cached, step.cached_tokens)
 
             emit, stopped = jail.push(text) if text else ("", False)
@@ -97,6 +118,7 @@ class Backend:
                     finish_reason="stop",
                     cumulative_tokens=count,
                     cached_tokens=cached,
+                    logprobs=lp_entries,
                 )
                 return
             if step.finished:
@@ -111,6 +133,7 @@ class Backend:
                     finish_reason=step.finish_reason,
                     cumulative_tokens=count,
                     cached_tokens=cached,
+                    logprobs=lp_entries,
                 )
                 return
             if emit or ids:
@@ -120,4 +143,5 @@ class Backend:
                     token_ids=ids,
                     cumulative_tokens=count,
                     cached_tokens=cached,
+                    logprobs=lp_entries,
                 )

@@ -264,11 +264,11 @@ class HttpService:
         buffered: list[str] = []
         async for out in pipeline.backend.generate(pre):
             usage.completion_tokens = out.cumulative_tokens
-            if out.text:
-                if tool_matcher is not None:
+            if tool_matcher is not None:
+                if out.text:
                     buffered.append(out.text)
-                else:
-                    yield gen.text_chunk(out.text)
+            elif out.text or out.logprobs:
+                yield gen.text_chunk(out.text, logprobs=out.logprobs)
             if out.finished:
                 finish = out.finish_reason or "stop"
                 if tool_matcher is not None:

@@ -217,6 +217,12 @@ def extract_content_parts(messages: list[dict], media_root: str | None = None):
     images: list[np.ndarray] = []
     for m in messages:
         content = m.get("content")
+        if isinstance(content, str) and "\x00" in content:
+            # string contents must not be able to forge the image-placement
+            # sentinels either (same sanitization as text parts below)
+            m = dict(m, content=content.replace("\x00", ""))
+            out_messages.append(m)
+            continue
         if not isinstance(content, list):
             out_messages.append(m)
             continue

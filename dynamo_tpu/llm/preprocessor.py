@@ -88,8 +88,25 @@ class OpenAIPreprocessor:
             stop_strings=tuple(req.stop),
             annotations=tuple(req.ext.annotations),
             model=req.model or self.model_name,
+            logprobs=self._logprobs(req),
         )
         return pre, annotations
+
+    @staticmethod
+    def _logprobs(req) -> Optional[int]:
+        """OpenAI request fields -> engine logprobs count. Completions:
+        ``logprobs`` is the alternatives count (0-5). Chat: ``logprobs`` is a
+        bool gate and ``top_logprobs`` the count (0-20)."""
+        lp = req.logprobs
+        if lp is None or lp is False:
+            return None
+        if lp is True:
+            return int(getattr(req, "top_logprobs", None) or 0)
+        if isinstance(lp, int):
+            if not 0 <= lp <= 20:
+                raise ProtocolError("logprobs must be in [0, 20]")
+            return lp
+        raise ProtocolError("logprobs must be a boolean or integer")
 
     # ---------------- API ----------------
 

@@ -32,10 +32,13 @@ async def aggregate_chat_stream(chunks: AsyncIterator[dict]) -> dict:
     role = "assistant"
     finish_reason = None
     usage = None
+    lp_content: list[dict] = []
     async for chunk in chunks:
         if out is None:
             out = _base_from_chunk(chunk, "chat.completion")
         for choice in chunk.get("choices", []):
+            if choice.get("logprobs"):
+                lp_content.extend(choice["logprobs"].get("content") or [])
             delta = choice.get("delta") or {}
             if delta.get("role"):
                 role = delta["role"]
@@ -66,9 +69,10 @@ async def aggregate_chat_stream(chunks: AsyncIterator[dict]) -> dict:
         message["tool_calls"] = [calls_by_index[i] for i in sorted(calls_by_index)]
         if not message["content"]:
             message["content"] = None
-    out["choices"] = [
-        {"index": 0, "message": message, "finish_reason": finish_reason}
-    ]
+    choice = {"index": 0, "message": message, "finish_reason": finish_reason}
+    if lp_content:
+        choice["logprobs"] = {"content": lp_content}
+    out["choices"] = [choice]
     if usage:
         out["usage"] = usage
     return out
@@ -79,12 +83,16 @@ async def aggregate_completion_stream(chunks: AsyncIterator[dict]) -> dict:
     text: list[str] = []
     finish_reason = None
     usage = None
+    lp = {"tokens": [], "token_logprobs": [], "top_logprobs": [], "text_offset": []}
     async for chunk in chunks:
         if out is None:
             out = _base_from_chunk(chunk, "text_completion")
         for choice in chunk.get("choices", []):
             if choice.get("text"):
                 text.append(choice["text"])
+            if choice.get("logprobs"):
+                for k in lp:
+                    lp[k].extend(choice["logprobs"].get(k) or [])
             if choice.get("finish_reason"):
                 finish_reason = choice["finish_reason"]
         if chunk.get("usage"):
@@ -92,7 +100,8 @@ async def aggregate_completion_stream(chunks: AsyncIterator[dict]) -> dict:
     if out is None:
         raise ValueError("empty stream")
     out["choices"] = [
-        {"index": 0, "text": "".join(text), "finish_reason": finish_reason, "logprobs": None}
+        {"index": 0, "text": "".join(text), "finish_reason": finish_reason,
+         "logprobs": lp if lp["tokens"] else None}
     ]
     if usage:
         out["usage"] = usage

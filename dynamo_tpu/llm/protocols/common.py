@@ -27,6 +27,9 @@ class PreprocessedRequest:
     # multimodal: ImageInput list (llm/multimodal.py); the image-slot positions
     # in token_ids hold content-hash virtual ids
     images: list = field(default_factory=list)
+    # OpenAI logprobs: None = off, n >= 0 = chosen-token logprob + n top
+    # alternatives per sampled token
+    logprobs: Optional[int] = None
 
     def to_wire(self) -> dict:
         out = {
@@ -44,6 +47,7 @@ class PreprocessedRequest:
             "stop_strings": list(self.stop_strings),
             "annotations": list(self.annotations),
             "model": self.model,
+            "logprobs": self.logprobs,
         }
         if self.images:
             out["images"] = [im.to_wire() for im in self.images]
@@ -59,6 +63,7 @@ class PreprocessedRequest:
             images = [ImageInput.from_wire(x) for x in d["images"]]
         return cls(
             images=images,
+            logprobs=d.get("logprobs"),
             request_id=d["request_id"],
             token_ids=list(d["token_ids"]),
             sampling=SamplingParams(
@@ -86,6 +91,10 @@ class BackendOutput:
     finish_reason: Optional[str] = None  # stop | length | error | cancelled
     cumulative_tokens: int = 0
     cached_tokens: int = 0
+    # per-token logprobs entries for this delta (when the request asked):
+    # {"token": str, "logprob": float, "bytes": [int], "top": [{"token",
+    # "logprob", "bytes"}]}
+    logprobs: Optional[list] = None
 
     @property
     def finished(self) -> bool:

@@ -120,6 +120,7 @@ class ChatCompletionRequest:
     stop: list[str] = field(default_factory=list)
     n: int = 1
     logprobs: Any = None
+    top_logprobs: Optional[int] = None
     user: Optional[str] = None
     ext: Ext = field(default_factory=Ext)
     tools: Optional[list] = None
@@ -133,10 +134,14 @@ class ChatCompletionRequest:
         common = _common_fields(d)
         if common["n"] != 1:
             raise ProtocolError("n > 1 is not supported")
+        top_lp = d.get("top_logprobs")
+        if top_lp is not None and (not isinstance(top_lp, int) or not 0 <= top_lp <= 20):
+            raise ProtocolError("top_logprobs must be an integer in [0, 20]")
         return cls(
             messages=[ChatMessage.from_dict(m) for m in msgs],
             tools=d.get("tools"),
             tool_choice=d.get("tool_choice"),
+            top_logprobs=top_lp,
             **common,
         )
 
@@ -192,6 +197,20 @@ class Usage:
         }
 
 
+def _chat_logprob(entry: dict) -> dict:
+    """Backend logprobs entry -> chat-API content entry."""
+    out = {
+        "token": entry["token"],
+        "logprob": entry["logprob"],
+        "bytes": entry.get("bytes"),
+        "top_logprobs": [
+            {"token": t["token"], "logprob": t["logprob"], "bytes": t.get("bytes")}
+            for t in entry.get("top", ())
+        ],
+    }
+    return out
+
+
 class ChatDeltaGenerator:
     """Builds chat.completion.chunk dicts for a streaming response
     (reference: lib/llm/src/protocols/openai/chat_completions/delta.rs)."""
@@ -217,12 +236,17 @@ class ChatDeltaGenerator:
         self._sent_role = True
         return self._chunk({"role": "assistant", "content": ""})
 
-    def text_chunk(self, text: str) -> dict:
+    def text_chunk(self, text: str, logprobs: Optional[list] = None) -> dict:
         delta: dict = {"content": text}
         if not self._sent_role:
             delta["role"] = "assistant"
             self._sent_role = True
-        return self._chunk(delta)
+        out = self._chunk(delta)
+        if logprobs:
+            out["choices"][0]["logprobs"] = {
+                "content": [_chat_logprob(e) for e in logprobs]
+            }
+        return out
 
     def tool_calls_chunk(self, tool_calls: list[dict]) -> dict:
         delta: dict = {
@@ -245,15 +269,32 @@ class CompletionDeltaGenerator:
         self.id = request_id or new_id("cmpl")
         self.model = model
         self.created = _now()
+        self._text_offset = 0  # running offset for logprobs text_offset
 
-    def text_chunk(self, text: str, finish_reason: Optional[str] = None) -> dict:
+    def text_chunk(
+        self, text: str, finish_reason: Optional[str] = None,
+        logprobs: Optional[list] = None,
+    ) -> dict:
+        lp_obj = None
+        if logprobs:
+            lp_obj = {
+                "tokens": [e["token"] for e in logprobs],
+                "token_logprobs": [e["logprob"] for e in logprobs],
+                "top_logprobs": [
+                    {t["token"]: t["logprob"] for t in e["top"]} if "top" in e else None
+                    for e in logprobs
+                ],
+                "text_offset": [self._text_offset for _ in logprobs],
+            }
+        self._text_offset += len(text)
         return {
             "id": self.id,
             "object": "text_completion",
             "created": self.created,
             "model": self.model,
             "choices": [
-                {"index": 0, "text": text, "finish_reason": finish_reason, "logprobs": None}
+                {"index": 0, "text": text, "finish_reason": finish_reason,
+                 "logprobs": lp_obj}
             ],
         }
 

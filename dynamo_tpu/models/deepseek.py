@@ -552,7 +552,8 @@ class DeepseekModel:
 
     # ---------------- public forward API (ModelRunner contract) ----------------
 
-    def prefill(self, params, kv_cache, tokens, positions, page_table, valid, last_idx):
+    def prefill(self, params, kv_cache, tokens, positions, page_table, valid, last_idx,
+                input_embeds=None, embeds_mask=None):
         c = self.config
         pool = kv_cache["ckv"]
         page_size = pool.shape[1]
@@ -560,6 +561,8 @@ class DeepseekModel:
         phys = jnp.where(valid, page_table[positions // page_size], 0)
         offsets = jnp.where(valid, positions % page_size, 0)
         hidden = params["embed"][tokens].astype(c.dtype)
+        if input_embeds is not None:  # multimodal embedding overrides
+            hidden = jnp.where(embeds_mask[:, None], input_embeds.astype(c.dtype), hidden)
         hidden, pool = self._forward(
             params, pool, hidden, positions, phys, offsets, page_table, num_pages
         )

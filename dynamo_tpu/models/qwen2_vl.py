@@ -29,10 +29,22 @@ class Qwen2VLConfig(LlamaConfig):
 
     @classmethod
     def from_hf_config(cls, d: dict) -> "Qwen2VLConfig":
-        base = LlamaConfig.from_hf_config(d)
+        rope_scaling = d.get("rope_scaling") or {}
+        if rope_scaling.get("type", rope_scaling.get("rope_type")) == "mrope":
+            # honest guard (like deepseek.py's unsupported-feature checks):
+            # shipping Qwen2-VL checkpoints are trained with M-RoPE (3D
+            # positions for image tokens); serving them with 1D RoPE would
+            # silently corrupt positional encodings. M-RoPE needs 3D position
+            # tracking through the engine — not implemented yet.
+            raise ValueError(
+                "qwen2_vl checkpoint uses rope_scaling type 'mrope', which this "
+                "engine does not implement yet; refusing to serve it with plain "
+                "1D RoPE (positions would differ from training)"
+            )
         vision = VisionConfig.from_hf_config(
-            d.get("vision_config", {}), out_hidden_size=base.hidden_size
+            d.get("vision_config", {}), out_hidden_size=d["hidden_size"]
         )
+        base = LlamaConfig.from_hf_config(d)
         return cls(**{f: getattr(base, f) for f in base.__dataclass_fields__}, vision=vision)
 
     @classmethod

@@ -11,6 +11,7 @@ Axes convention (any subset may be 1):
   tp — tensor parallel (attention heads / MLP hidden)
   sp — sequence/context parallel (ring attention prefill)
   ep — expert parallel (MoE expert banks)
+  pp — pipeline stages (GPipe rotation, parallel/pipeline.py)
 """
 
 from __future__ import annotations
@@ -34,13 +35,14 @@ class MeshConfig:
     dp: int = 1
     sp: int = 1
     ep: int = 1
+    pp: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.tp * self.dp * self.sp * self.ep
+        return self.tp * self.dp * self.sp * self.ep * self.pp
 
     def axis_sizes(self) -> dict[str, int]:
-        return {"dp": self.dp, "sp": self.sp, "ep": self.ep, "tp": self.tp}
+        return {"dp": self.dp, "pp": self.pp, "sp": self.sp, "ep": self.ep, "tp": self.tp}
 
 
 def init_multihost(
@@ -71,12 +73,14 @@ def init_multihost(
 
 
 def build_mesh(config: MeshConfig, devices=None) -> Mesh:
-    """Mesh with axes (dp, sp, ep, tp); tp innermost so it lands on the
+    """Mesh with axes (dp, pp, sp, ep, tp); tp innermost so it lands on the
     fastest ICI neighbor links."""
     if devices is None:
         devices = jax.devices()
     n = config.num_devices
     if n > len(devices):
         raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
-    arr = np.array(devices[:n]).reshape(config.dp, config.sp, config.ep, config.tp)
-    return Mesh(arr, ("dp", "sp", "ep", "tp"))
+    arr = np.array(devices[:n]).reshape(
+        config.dp, config.pp, config.sp, config.ep, config.tp
+    )
+    return Mesh(arr, ("dp", "pp", "sp", "ep", "tp"))

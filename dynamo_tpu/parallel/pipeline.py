@@ -1,0 +1,260 @@
+"""Pipeline parallelism: GPipe-style stage rotation over a ``pp`` mesh axis.
+
+The reference explicitly leaves pipeline parallel unsupported (forced to 1 in
+its disagg path — reference: examples/llm/components/worker.py:76-78); here it
+is a first-class scheme, designed around this framework's scan-stacked layers
+and flat KV page pool:
+
+  - **Stage sharding is just array sharding.** Every layer weight carries a
+    leading ``[L]`` axis and the KV pool is layer-major ``[L * num_pages, ...]``,
+    so sharding that leading axis over ``pp`` puts each stage's weights AND its
+    layers' KV pages on the same device with no layout change (L % pp == 0).
+  - **GPipe microbatch rotation under shard_map.** Prefill splits the token
+    chunk into M microbatches; decode splits the batch slots. Each of the
+    M + S - 1 rotation steps runs every stage's local layer scan on its
+    current microbatch, then ``ppermute``s activations to the next stage over
+    ICI. Bubble fraction = (S-1)/(M+S-1).
+  - **Causality across token microbatches is free.** Microbatch m's attention
+    gathers K/V from the (stage-local) page pool, where microbatches < m have
+    already scattered their rows — the position mask does the rest. No
+    cross-microbatch attention plumbing at all.
+
+All control flow is static (masked writes route to each layer's trash page
+when a stage is idle in the ramp-up/ramp-down steps), so the whole pipeline is
+ONE compiled program per shape bucket.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dynamo_tpu.ops.attention import paged_decode_attention, paged_prefill_attention
+
+
+def stage_param_shardings(model, mesh: Mesh, pp_axis: str = "pp") -> dict:
+    """NamedSharding pytree: layer-stacked leaves sharded on their leading [L]
+    axis over pp; embed/head/final-norm replicated (they are needed at the
+    pipeline's edges, which run outside shard_map)."""
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    shapes = jax.eval_shape(model.init_params, jax.random.key(0))
+    shardings = jax.tree.map(lambda _: ns(), shapes)
+    # only the layer stack is stage-sharded
+    shardings["layers"] = jax.tree.map(
+        lambda leaf: ns(*((pp_axis,) + (None,) * (len(leaf.shape) - 1))),
+        shapes["layers"],
+    )
+    return shardings
+
+
+def stage_kv_sharding(mesh: Mesh, pp_axis: str = "pp") -> dict:
+    ns = NamedSharding(mesh, P(pp_axis, None, None, None))
+    return {"k": ns, "v": ns}
+
+
+def _local_layer_scan(model, local_layers, kp, vp, hidden, positions, phys, offsets, attn_maker, num_pages):
+    """Run this stage's layer slice over one microbatch. phys holds per-token
+    LOGICAL page ids (trash-routed already); layer offsets are stage-local."""
+    L_loc = kp.shape[0] // num_pages
+    layer_offsets = jnp.arange(L_loc, dtype=jnp.int32) * num_pages
+
+    def body(carry, xs):
+        h, kp_, vp_ = carry
+        lp, off = xs
+        h, kp_, vp_ = model._layer(
+            lp, h, kp_, vp_, positions, off + phys, offsets, attn_maker(off)
+        )
+        return (h, kp_, vp_), None
+
+    (hidden, kp, vp), _ = jax.lax.scan(
+        body, (hidden, kp, vp), (local_layers, layer_offsets)
+    )
+    return hidden, kp, vp
+
+
+def _gpipe_rotate(mesh, pp_axis, S, M, run_mb, hidden_mbs, kp, vp):
+    """The rotation loop shared by prefill and decode.
+
+    run_mb(m_clipped, active, x, kp, vp) -> (y, kp, vp) runs this stage's
+    layers on microbatch index m (clipped; ``active`` masks ramp steps).
+    Returns (outputs [M, ...] from the last stage, psum-replicated; kp; vp).
+    """
+    stage = jax.lax.axis_index(pp_axis)
+    outputs = jnp.zeros_like(hidden_mbs)
+    x_recv = jnp.zeros_like(hidden_mbs[0])
+
+    def step(carry, t):
+        x_recv, kp, vp, outputs = carry
+        m = t - stage
+        active = (m >= 0) & (m < M)
+        mc = jnp.clip(m, 0, M - 1)
+        x = jnp.where(stage == 0, hidden_mbs[mc], x_recv)
+        y, kp, vp = run_mb(mc, active, x, kp, vp)
+        write = (stage == S - 1) & active
+        outputs = outputs.at[mc].set(jnp.where(write, y, outputs[mc]))
+        x_next = jax.lax.ppermute(y, pp_axis, [(i, (i + 1) % S) for i in range(S)])
+        return (x_next, kp, vp, outputs), None
+
+    (x_recv, kp, vp, outputs), _ = jax.lax.scan(
+        step, (x_recv, kp, vp, outputs), jnp.arange(M + S - 1, dtype=jnp.int32)
+    )
+    # only the last stage holds real outputs; psum replicates them so the
+    # result can leave shard_map with a replicated spec
+    outputs = jax.lax.psum(outputs, pp_axis)
+    return outputs, kp, vp
+
+
+def prefill_pipelined(
+    model,
+    params: dict,
+    kv_cache: dict,  # {"k","v"} flat pools sharded stage-major (donated)
+    tokens: jnp.ndarray,  # [T] padded chunk, T % M == 0
+    positions: jnp.ndarray,  # [T]
+    page_table: jnp.ndarray,  # [max_pages] logical page ids
+    valid: jnp.ndarray,  # [T]
+    last_idx: jnp.ndarray,
+    mesh: Mesh,
+    pp_axis: str = "pp",
+    num_microbatches: int | None = None,
+    input_embeds: jnp.ndarray | None = None,  # [T, D] mm overrides
+    embeds_mask: jnp.ndarray | None = None,  # [T]
+) -> tuple[jnp.ndarray, dict]:
+    """Pipelined single-sequence prefill. Returns (logits[V] at last_idx, kv)."""
+    c = model.config
+    S = mesh.shape[pp_axis]
+    M = num_microbatches or S
+    T = tokens.shape[0]
+    assert c.num_layers % S == 0, f"L={c.num_layers} not divisible by pp={S}"
+    assert T % M == 0, f"chunk {T} not divisible by microbatches {M}"
+    Tm = T // M
+
+    k_pool, v_pool = kv_cache["k"], kv_cache["v"]
+    page_size = k_pool.shape[1]
+    num_pages = k_pool.shape[0] // c.num_layers
+    phys = jnp.where(valid, page_table[positions // page_size], 0)
+    offsets = jnp.where(valid, positions % page_size, 0)
+
+    hidden = params["embed"][tokens].astype(c.dtype)
+    if input_embeds is not None:
+        hidden = jnp.where(embeds_mask[:, None], input_embeds.astype(c.dtype), hidden)
+    hidden_mbs = hidden.reshape(M, Tm, -1)
+    pos_mbs = positions.reshape(M, Tm)
+    phys_mbs = phys.reshape(M, Tm)
+    off_mbs = offsets.reshape(M, Tm)
+
+    spec_pool = P(pp_axis, None, None, None)
+    rep = P()
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(pp_axis), spec_pool, spec_pool, rep, rep, rep, rep, rep),
+        out_specs=(rep, spec_pool, spec_pool),
+        check_vma=False,
+    )
+    def run(local_layers, kp, vp, hidden_mbs, pos_mbs, phys_mbs, off_mbs, page_table):
+        def run_mb(mc, active, x, kp, vp):
+            pos = pos_mbs[mc]
+            # idle ramp steps write to the layer trash page (logical 0)
+            phys_mb = jnp.where(active, phys_mbs[mc], 0)
+            off_mb = jnp.where(active, off_mbs[mc], 0)
+
+            def attn_maker(off):
+                def attn_fn(q, k_new, v_new, kp_, vp_):
+                    return paged_prefill_attention(q, kp_, vp_, off + page_table, pos)
+
+                return attn_fn
+
+            return _local_layer_scan(
+                model, local_layers, kp, vp, x, pos, phys_mb, off_mb, attn_maker, num_pages
+            )
+
+        return _gpipe_rotate(mesh, pp_axis, S, M, run_mb, hidden_mbs, kp, vp)
+
+    outputs, k_pool, v_pool = run(
+        params["layers"], k_pool, v_pool, hidden_mbs, pos_mbs, phys_mbs, off_mbs, page_table
+    )
+    hidden_out = outputs.reshape(T, -1)
+    logits = model._unembed(params, hidden_out[last_idx][None, :])[0]
+    return logits, {"k": k_pool, "v": v_pool}
+
+
+def decode_pipelined(
+    model,
+    params: dict,
+    kv_cache: dict,
+    tokens: jnp.ndarray,  # [B]
+    positions: jnp.ndarray,  # [B]
+    page_tables: jnp.ndarray,  # [B, max_pages]
+    active: jnp.ndarray,  # [B]
+    mesh: Mesh,
+    pp_axis: str = "pp",
+    num_microbatches: int | None = None,
+) -> tuple[jnp.ndarray, dict]:
+    """Pipelined batched decode step: batch slots split into microbatches.
+    Returns (logits [B, V], kv)."""
+    c = model.config
+    S = mesh.shape[pp_axis]
+    M = num_microbatches or S
+    B = tokens.shape[0]
+    assert c.num_layers % S == 0
+    assert B % M == 0, f"batch {B} not divisible by microbatches {M}"
+    Bm = B // M
+
+    k_pool, v_pool = kv_cache["k"], kv_cache["v"]
+    page_size = k_pool.shape[1]
+    num_pages = k_pool.shape[0] // c.num_layers
+    logical = positions // page_size
+    phys = jnp.where(active, page_tables[jnp.arange(B), logical], 0)
+    offsets = jnp.where(active, positions % page_size, 0)
+
+    hidden = params["embed"][tokens].astype(c.dtype)
+    hidden_mbs = hidden.reshape(M, Bm, -1)
+    pos_mbs = positions.reshape(M, Bm)
+    phys_mbs = phys.reshape(M, Bm)
+    off_mbs = offsets.reshape(M, Bm)
+    pt_mbs = page_tables.reshape(M, Bm, -1)
+    act_mbs = active.reshape(M, Bm)
+
+    spec_pool = P(pp_axis, None, None, None)
+    rep = P()
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(pp_axis), spec_pool, spec_pool) + (rep,) * 6,
+        out_specs=(rep, spec_pool, spec_pool),
+        check_vma=False,
+    )
+    def run(local_layers, kp, vp, hidden_mbs, pos_mbs, phys_mbs, off_mbs, pt_mbs, act_mbs):
+        def run_mb(mc, pipe_active, x, kp, vp):
+            pos = pos_mbs[mc]
+            row_active = act_mbs[mc] & pipe_active
+            phys_mb = jnp.where(row_active, phys_mbs[mc], 0)
+            off_mb = jnp.where(row_active, off_mbs[mc], 0)
+            pts = pt_mbs[mc]
+
+            def attn_maker(off):
+                def attn_fn(q, k_new, v_new, kp_, vp_):
+                    return paged_decode_attention(q, kp_, vp_, off + pts, pos)
+
+                return attn_fn
+
+            return _local_layer_scan(
+                model, local_layers, kp, vp, x, pos, phys_mb, off_mb, attn_maker, num_pages
+            )
+
+        return _gpipe_rotate(mesh, pp_axis, S, M, run_mb, hidden_mbs, kp, vp)
+
+    outputs, k_pool, v_pool = run(
+        params["layers"], k_pool, v_pool, hidden_mbs, pos_mbs, phys_mbs, off_mbs, pt_mbs, act_mbs
+    )
+    hidden_out = outputs.reshape(B, -1)
+    logits = model._unembed(params, hidden_out)
+    return logits, {"k": k_pool, "v": v_pool}

@@ -255,3 +255,36 @@ def test_capacity_freeze_no_phantom_tokens():
     # 58 fed decode tokens (KV positions 6..63) + the prefill-sampled first
     # token = 59: decoded exactly to physical capacity, never past it
     assert len(toks1) == 64 - 6 + 1
+
+
+def test_step_failure_fails_waiting_requests():
+    """A trace/step error during admission must fail the request (not leave
+    its caller waiting forever) — the request may not have reached a slot yet
+    when the step dies."""
+    eng = AsyncJaxEngine(tiny_engine_config())
+
+    async def go():
+        await eng.start()
+
+        def boom(*a, **k):
+            raise RuntimeError("injected step failure")
+
+        eng.runner.prefill_chunk = boom
+        req = EngineRequest(
+            request_id="fail0",
+            token_ids=[1, 2, 3],
+            sampling=SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True),
+        )
+        finish = None
+        try:
+            async for out in eng.generate(req):
+                if out.finished:
+                    finish = out.finish_reason
+        except RuntimeError as e:
+            finish = f"exc:{e}"
+        finally:
+            await eng.shutdown()
+        return finish
+
+    finish = asyncio.run(asyncio.wait_for(go(), timeout=60))
+    assert finish == "error"

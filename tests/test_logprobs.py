@@ -1,0 +1,184 @@
+"""OpenAI logprobs: engine-level correctness + HTTP rendering (chat and
+completions, streaming and aggregated)."""
+
+import asyncio
+import json
+
+import aiohttp
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import AsyncJaxEngine
+from dynamo_tpu.engine.sampling import SamplingParams
+from dynamo_tpu.engine.scheduler import EngineRequest
+
+from tests.test_llama_model import naive_forward
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = EngineConfig(
+        model_id="tiny",
+        page_size=4,
+        num_pages=64,
+        max_seqs=4,
+        max_model_len=64,
+        prefill_buckets=(8, 16, 32),
+    )
+    e = AsyncJaxEngine(cfg)
+    loop = asyncio.new_event_loop()
+    loop.run_until_complete(e.start())
+    yield e, loop
+    loop.run_until_complete(e.shutdown())
+    loop.close()
+
+
+async def _collect(engine, req):
+    outs = []
+    async for out in engine.generate(req):
+        if out.token is not None:
+            outs.append(out)
+    return outs
+
+
+def test_engine_logprobs_match_reference(engine):
+    """Greedy: chosen logprob equals the naive forward's log-softmax max, and
+    top-1 alternative is the chosen token itself."""
+    e, loop = engine
+    prompt = [5, 9, 2, 77, 31, 8, 100]
+    req = EngineRequest(
+        request_id="lp1",
+        token_ids=list(prompt),
+        sampling=SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True),
+        logprobs=3,
+    )
+    outs = loop.run_until_complete(_collect(e, req))
+    assert len(outs) == 4
+
+    cfg = e.model.config
+    params = jax.device_get(e.runner.params)
+    toks = list(prompt)
+    for out in outs:
+        logits = naive_forward(cfg, params, toks)[-1]
+        ref_lp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        assert out.logprob is not None
+        assert out.token == int(jnp.argmax(logits))
+        np.testing.assert_allclose(out.logprob, float(ref_lp[out.token]), rtol=1e-3, atol=1e-3)
+        # top alternatives: 3 requested, sorted descending, top-1 == chosen
+        assert len(out.top_logprobs) == 3
+        ids = [t for t, _ in out.top_logprobs]
+        lps = [l for _, l in out.top_logprobs]
+        assert ids[0] == out.token
+        assert lps == sorted(lps, reverse=True)
+        toks.append(out.token)
+
+
+def test_engine_no_logprobs_by_default(engine):
+    e, loop = engine
+    req = EngineRequest(
+        request_id="lp0",
+        token_ids=[3, 1, 4, 1, 5],
+        sampling=SamplingParams(temperature=0.0, max_tokens=2, ignore_eos=True),
+    )
+    outs = loop.run_until_complete(_collect(e, req))
+    assert all(o.logprob is None and o.top_logprobs is None for o in outs)
+
+
+# ---------------- HTTP rendering ----------------
+
+
+@pytest.fixture(scope="module")
+def http_server():
+    from dynamo_tpu.frontends.pipeline import build_pipeline, card_for_model
+    from dynamo_tpu.llm.http.service import HttpService
+
+    async def setup():
+        cfg = EngineConfig(
+            model_id="tiny",
+            page_size=4,
+            num_pages=64,
+            max_seqs=4,
+            max_model_len=64,
+            prefill_buckets=(8, 16, 32),
+        )
+        e = AsyncJaxEngine(cfg)
+        await e.start()
+        card = card_for_model("tiny")
+        svc = HttpService(host="127.0.0.1", port=0)
+        svc.manager.add(build_pipeline(e, card))
+        port = await svc.start()
+        return e, svc, port
+
+    loop = asyncio.new_event_loop()
+    e, svc, port = loop.run_until_complete(setup())
+    yield port, loop
+    loop.run_until_complete(svc.stop())
+    loop.run_until_complete(e.shutdown())
+    loop.close()
+
+
+def _post(loop, port, path, body):
+    async def go():
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"http://127.0.0.1:{port}{path}", json=body) as resp:
+                return resp.status, await resp.text()
+
+    return loop.run_until_complete(go())
+
+
+def test_http_completions_logprobs(http_server):
+    port, loop = http_server
+    status, text = _post(
+        loop, port, "/v1/completions",
+        {"model": "tiny", "prompt": "hi", "max_tokens": 3, "temperature": 0.0,
+         "logprobs": 2, "ext": {"ignore_eos": True}},
+    )
+    assert status == 200
+    lp = json.loads(text)["choices"][0]["logprobs"]
+    assert lp is not None
+    assert len(lp["tokens"]) == 3
+    assert len(lp["token_logprobs"]) == 3
+    assert all(isinstance(x, float) for x in lp["token_logprobs"])
+    assert all(len(d) == 2 for d in lp["top_logprobs"])
+    assert lp["text_offset"] == sorted(lp["text_offset"])
+
+
+def test_http_chat_logprobs_stream_and_unary(http_server):
+    port, loop = http_server
+    body = {
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "hello"}],
+        "max_tokens": 3, "temperature": 0.0,
+        "logprobs": True, "top_logprobs": 2,
+        "ext": {"ignore_eos": True},
+    }
+    status, text = _post(loop, port, "/v1/chat/completions", body)
+    assert status == 200
+    lp = json.loads(text)["choices"][0]["logprobs"]
+    assert lp is not None and len(lp["content"]) == 3
+    entry = lp["content"][0]
+    assert {"token", "logprob", "bytes", "top_logprobs"} <= set(entry)
+    assert len(entry["top_logprobs"]) == 2
+
+    status, text = _post(loop, port, "/v1/chat/completions", dict(body, stream=True))
+    assert status == 200
+    frames = [json.loads(l[6:]) for l in text.splitlines() if l.startswith("data: {")]
+    lp_frames = [
+        f for f in frames
+        if f["choices"] and (f["choices"][0].get("logprobs") or {}).get("content")
+    ]
+    assert sum(len(f["choices"][0]["logprobs"]["content"]) for f in lp_frames) == 3
+
+
+def test_http_chat_no_logprobs_field_absent(http_server):
+    port, loop = http_server
+    status, text = _post(
+        loop, port, "/v1/chat/completions",
+        {"model": "tiny", "messages": [{"role": "user", "content": "hello"}],
+         "max_tokens": 2, "temperature": 0.0, "ext": {"ignore_eos": True}},
+    )
+    assert status == 200
+    assert "logprobs" not in json.loads(text)["choices"][0]

@@ -1,0 +1,159 @@
+"""Pipeline parallelism: GPipe stage rotation parity + engine e2e (pp mesh on
+the virtual CPU devices)."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from dynamo_tpu.models.llama import LlamaConfig, LlamaModel
+from dynamo_tpu.parallel.pipeline import (
+    decode_pipelined,
+    prefill_pipelined,
+    stage_kv_sharding,
+    stage_param_shardings,
+)
+
+NUM_PAGES, PAGE_SIZE = 16, 4
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = LlamaConfig.tiny(num_layers=4)
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.key(0))
+    return cfg, model, params
+
+
+@pytest.mark.parametrize("pp,microbatches", [(2, 2), (4, 4), (4, 2)])
+def test_prefill_and_decode_parity(setup, pp, microbatches):
+    cfg, model, params = setup
+    mesh = Mesh(np.array(jax.devices()[:pp]), ("pp",))
+    params_pp = jax.device_put(params, stage_param_shardings(model, mesh))
+    kv_pp = jax.device_put(
+        model.init_kv_cache(NUM_PAGES, PAGE_SIZE), stage_kv_sharding(mesh)
+    )
+
+    T = 16
+    prompt = np.array([5, 9, 2, 77, 31, 8, 100, 3, 44, 12, 7, 60, 2, 9, 1, 30], np.int32)
+    pt = np.array([3, 5, 7, 9, 11, 0, 0, 0], np.int32)
+    pos = np.arange(T, dtype=np.int32)
+    valid = np.ones(T, bool)
+
+    ref_logits, ref_kv = model.prefill(
+        params, model.init_kv_cache(NUM_PAGES, PAGE_SIZE),
+        jnp.asarray(prompt), jnp.asarray(pos), jnp.asarray(pt),
+        jnp.asarray(valid), jnp.asarray(T - 1),
+    )
+    pp_logits, kv_pp = jax.jit(
+        lambda p, kv: prefill_pipelined(
+            model, p, kv, jnp.asarray(prompt), jnp.asarray(pos), jnp.asarray(pt),
+            jnp.asarray(valid), jnp.asarray(T - 1), mesh,
+            num_microbatches=microbatches,
+        ),
+        donate_argnums=(1,),
+    )(params_pp, kv_pp)
+    np.testing.assert_allclose(
+        np.asarray(pp_logits), np.asarray(ref_logits), rtol=2e-4, atol=2e-4
+    )
+
+    B = 4
+    toks = np.zeros(B, np.int32)
+    toks[0] = 42
+    dpos = np.zeros(B, np.int32)
+    dpos[0] = T
+    pts = np.zeros((B, 8), np.int32)
+    pts[0] = pt
+    act = np.zeros(B, bool)
+    act[0] = True
+    ref_dlog, _ = model.decode(
+        params, ref_kv, jnp.asarray(toks), jnp.asarray(dpos), jnp.asarray(pts), jnp.asarray(act)
+    )
+    pp_dlog, _ = jax.jit(
+        lambda p, kv: decode_pipelined(
+            model, p, kv, jnp.asarray(toks), jnp.asarray(dpos), jnp.asarray(pts),
+            jnp.asarray(act), mesh, num_microbatches=microbatches,
+        ),
+        donate_argnums=(1,),
+    )(params_pp, kv_pp)
+    np.testing.assert_allclose(
+        np.asarray(pp_dlog)[0], np.asarray(ref_dlog)[0], rtol=2e-4, atol=2e-4
+    )
+
+
+# ---------------- engine e2e: pp=2 tokens match pp=1 ----------------
+
+
+def _engine_config(pp):
+    from dynamo_tpu.engine.config import EngineConfig
+
+    return EngineConfig(
+        model_id="tiny",
+        page_size=4,
+        num_pages=64,
+        max_seqs=4,
+        max_model_len=64,
+        prefill_buckets=(8, 16, 32),
+        pp=pp,
+    )
+
+
+async def _greedy(engine, rid, prompt, n):
+    from dynamo_tpu.engine.sampling import SamplingParams
+    from dynamo_tpu.engine.scheduler import EngineRequest
+
+    req = EngineRequest(
+        request_id=rid,
+        token_ids=list(prompt),
+        sampling=SamplingParams(temperature=0.0, max_tokens=n, ignore_eos=True),
+    )
+    toks = []
+    async for out in engine.generate(req):
+        if out.token is not None:
+            toks.append(out.token)
+    return toks
+
+
+def test_engine_pp_matches_single_device():
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+
+    prompts = [
+        [5, 9, 2, 77, 31, 8, 100],
+        [44, 12, 7, 60, 2, 9, 1, 30, 17, 3],
+    ]
+
+    async def run(pp):
+        engine = AsyncJaxEngine(_engine_config(pp))
+        await engine.start()
+        outs = [await _greedy(engine, f"r{i}", p, 8) for i, p in enumerate(prompts)]
+        await engine.shutdown()
+        return outs
+
+    loop = asyncio.new_event_loop()
+    try:
+        ref = loop.run_until_complete(run(pp=1))
+        got = loop.run_until_complete(run(pp=2))
+    finally:
+        loop.close()
+    assert got == ref
+
+
+def test_pp_config_validation():
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.model_runner import ModelRunner
+    from dynamo_tpu.models.registry import load_model
+
+    model, params = load_model("tiny")  # 2 layers
+    with pytest.raises(ValueError, match="not divisible by pp"):
+        ModelRunner(
+            EngineConfig(model_id="tiny", pp=3, prefill_buckets=(9,), max_seqs=3),
+            model, params,
+        )
+    with pytest.raises(ValueError, match="prefill bucket"):
+        ModelRunner(
+            EngineConfig(model_id="tiny", pp=2, prefill_buckets=(9,), max_seqs=2),
+            model, params,
+        )

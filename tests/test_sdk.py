@@ -137,8 +137,8 @@ def test_build_mesh_axes():
     from dynamo_tpu.parallel.mesh import MeshConfig, build_mesh
 
     mesh = build_mesh(MeshConfig(tp=2, dp=2, sp=1, ep=2))
-    assert mesh.axis_names == ("dp", "sp", "ep", "tp")
-    assert mesh.devices.shape == (2, 1, 2, 2)
+    assert mesh.axis_names == ("dp", "pp", "sp", "ep", "tp")
+    assert mesh.devices.shape == (2, 1, 1, 2, 2)
     with pytest.raises(ValueError):
         build_mesh(MeshConfig(tp=16))
 
