@@ -120,7 +120,9 @@ class ModelRunner:
         # device-resident last-token-per-slot feedback buffer
         self.tokens_dev = jnp.zeros(config.max_seqs, jnp.int32)
 
-        self._prefill = jax.jit(self._prefill_impl, donate_argnums=(1, 2))
+        self._prefill = jax.jit(
+            self._prefill_impl, donate_argnums=(1, 2), static_argnames=("want_lp",)
+        )
         # multimodal vision encode (compiled lazily; text-only models never
         # pay for it — the mm prefill variant is _prefill traced with embeds)
         self._encode_images = jax.jit(
@@ -130,7 +132,9 @@ class ModelRunner:
         )
         if config.sp > 1:
             # sequence-parallel whole-prompt prefill (ring attention over sp)
-            self._prefill_sp = jax.jit(self._prefill_sp_impl, donate_argnums=(1, 2))
+            self._prefill_sp = jax.jit(
+                self._prefill_sp_impl, donate_argnums=(1, 2), static_argnames=("want_lp",)
+            )
         self._decode_window = jax.jit(
             self._decode_window_impl, donate_argnums=(1, 2), static_argnums=(6, 7)
         )
@@ -158,7 +162,7 @@ class ModelRunner:
 
     # ---------------- jitted bodies ----------------
 
-    def _model_prefill(self, params, kv, tokens, positions, page_table, valid, last, embeds=None, emask=None):
+    def _model_prefill(self, params, kv, tokens, positions, page_table, valid, last, embeds=None, emask=None, rope_pos=None):
         """model.prefill, or its GPipe-pipelined form when pp > 1."""
         if self.config.pp > 1:
             from dynamo_tpu.parallel.pipeline import prefill_pipelined
@@ -166,22 +170,26 @@ class ModelRunner:
             return prefill_pipelined(
                 self.model, params, kv, tokens, positions, page_table, valid, last,
                 self.mesh, input_embeds=embeds, embeds_mask=emask,
+                rope_positions=rope_pos,
             )
         return self.model.prefill(
             params, kv, tokens, positions, page_table, valid, last,
-            input_embeds=embeds, embeds_mask=emask,
+            input_embeds=embeds, embeds_mask=emask, rope_positions=rope_pos,
         )
 
-    def _model_decode(self, params, kv, tokens, positions, page_tables, active):
+    def _model_decode(self, params, kv, tokens, positions, page_tables, active, rope_deltas=None):
         if self.config.pp > 1:
             from dynamo_tpu.parallel.pipeline import decode_pipelined
 
             return decode_pipelined(
-                self.model, params, kv, tokens, positions, page_tables, active, self.mesh
+                self.model, params, kv, tokens, positions, page_tables, active,
+                self.mesh, rope_deltas=rope_deltas,
             )
-        return self.model.decode(params, kv, tokens, positions, page_tables, active)
+        return self.model.decode(
+            params, kv, tokens, positions, page_tables, active, rope_deltas=rope_deltas
+        )
 
-    def _prefill_impl(self, params, kv, tokens_dev, ints, flts, key, embeds=None, emask=None):
+    def _prefill_impl(self, params, kv, tokens_dev, ints, flts, key, embeds=None, emask=None, rope_pos=None, want_lp=False):
         """ints [bucket + max_pages + 4] = token buf, page table, then
         (start_pos, n_real, top_k, slot); flts [2] = (temperature, top_p).
         Positions and the valid mask derive on device — one packed H2D per
@@ -204,16 +212,23 @@ class ModelRunner:
         valid = jnp.arange(bucket) < n
         logits, kv = self._model_prefill(
             params, kv, tokens, positions, page_table, valid, n - 1,
-            embeds=embeds, emask=emask,
+            embeds=embeds, emask=emask, rope_pos=rope_pos,
         )
-        toks, chosen, tids, tvals = sample_tokens_with_logprobs(
-            logits[None, :], key, flts[:1], top_k[None], flts[1:]
-        )
+        if want_lp:
+            toks, chosen, tids, tvals = sample_tokens_with_logprobs(
+                logits[None, :], key, flts[:1], top_k[None], flts[1:]
+            )
+            lp = (chosen[0], tids[0], tvals[0])
+        else:
+            # same gating as the decode window: no full-vocab log_softmax or
+            # top_k in the trace unless the request asked for logprobs
+            toks = sample_tokens(logits[None, :], key, flts[:1], top_k[None], flts[1:])
+            lp = None
         tok = toks[0]
         tokens_dev = tokens_dev.at[slot].set(tok, mode="drop")
-        return tok, (chosen[0], tids[0], tvals[0]), kv, tokens_dev
+        return tok, lp, kv, tokens_dev
 
-    def _prefill_sp_impl(self, params, kv, tokens_dev, ints, flts, key):
+    def _prefill_sp_impl(self, params, kv, tokens_dev, ints, flts, key, want_lp=False):
         """Same packed-ints contract as _prefill_impl, but the whole-prompt
         chunk runs sequence-parallel (model.prefill_sp: ring attention over
         the sp mesh axis). Only called with start_pos == 0."""
@@ -229,12 +244,17 @@ class ModelRunner:
         logits, kv = self.model.prefill_sp(
             params, kv, tokens, positions, page_table, valid, n - 1, mesh=self.mesh
         )
-        toks, chosen, tids, tvals = sample_tokens_with_logprobs(
-            logits[None, :], key, flts[:1], top_k[None], flts[1:]
-        )
+        if want_lp:
+            toks, chosen, tids, tvals = sample_tokens_with_logprobs(
+                logits[None, :], key, flts[:1], top_k[None], flts[1:]
+            )
+            lp = (chosen[0], tids[0], tvals[0])
+        else:
+            toks = sample_tokens(logits[None, :], key, flts[:1], top_k[None], flts[1:])
+            lp = None
         tok = toks[0]
         tokens_dev = tokens_dev.at[slot].set(tok, mode="drop")
-        return tok, (chosen[0], tids[0], tvals[0]), kv, tokens_dev
+        return tok, lp, kv, tokens_dev
 
     def _decode_window_impl(self, params, kv, tokens_dev, ints, flts, key, num_steps, want_lp=False):
         """num_steps fused decode steps; the sampled-token feedback loop starts
@@ -243,8 +263,9 @@ class ModelRunner:
 
         All small per-slot inputs ride in two packed arrays (one H2D transfer
         each — per-call transfer latency dominates on tunneled platforms):
-        ``ints`` [4 + max_pages, B] = positions, limits, active, top_ks, then
-        the transposed page tables; ``flts`` [2, B] = temps, top_ps. Page
+        ``ints`` [5 + max_pages, B] = positions, limits, active, top_ks,
+        rope_deltas, then the transposed page tables; ``flts`` [2, B] =
+        temps, top_ps. Page
         tables are static across the window — the host pre-allocates pages to
         cover positions + num_steps - 1 before calling, and a sequence freezes
         once its fed position would pass ``limits`` (no writes past its
@@ -252,13 +273,17 @@ class ModelRunner:
         positions, limits = ints[0], ints[1]
         active = ints[2].astype(bool)
         top_ks = ints[3]
-        page_tables = ints[4:].T  # [B, max_pages]
+        rope_deltas = ints[4]  # M-RoPE per-slot offsets (zeros for text models)
+        page_tables = ints[5:].T  # [B, max_pages]
         temps, top_ps = flts[0], flts[1]
         keys = jax.random.split(key, num_steps)
 
         def body(carry, k):
             kv, tokens, positions, act = carry
-            logits, kv = self._model_decode(params, kv, tokens, positions, page_tables, act)
+            logits, kv = self._model_decode(
+                params, kv, tokens, positions, page_tables, act,
+                rope_deltas=rope_deltas if getattr(self.model.config, "mrope_section", None) is not None else None,
+            )
             if want_lp:
                 toks, chosen, tids, tvals = sample_tokens_with_logprobs(
                     logits, k, temps, top_ks, top_ps
@@ -301,6 +326,7 @@ class ModelRunner:
         sync: bool = True,
         embeds: Optional[np.ndarray] = None,  # [n, D] mm overrides for this chunk
         embeds_mask: Optional[np.ndarray] = None,  # [n] bool
+        rope_pos: Optional[np.ndarray] = None,  # [n, 3] M-RoPE positions
         want_logprobs: bool = False,  # sync=False only: also return lp arrays
     ):
         """Run one prefill chunk.
@@ -323,18 +349,28 @@ class ModelRunner:
         ints[bucket + mp + 3] = slot if (sample and slot >= 0) else self.config.max_seqs
         flts = np.array([temperature, top_p], np.float32)
         mm_args = ()
-        if embeds is not None:
-            # multimodal chunk: embeds-override trace of _prefill (paged path
-            # only; the sp/ring path is text-only for now)
-            emb = np.zeros((bucket, embeds.shape[1]), np.float32)
-            emb[:n] = embeds
+        if embeds is not None or rope_pos is not None:
+            # multimodal chunk: embeds/rope-override trace of _prefill (paged
+            # path only; the sp/ring path is text-only for now)
+            D = embeds.shape[1] if embeds is not None else 1
+            emb = np.zeros((bucket, D), np.float32)
             msk = np.zeros(bucket, bool)
-            msk[:n] = embeds_mask
-            mm_args = (jnp.asarray(emb), jnp.asarray(msk))
+            if embeds is not None:
+                emb[:n] = embeds
+                msk[:n] = embeds_mask
+            rp = None
+            if rope_pos is not None:
+                rp_pad = np.zeros((bucket, 3), np.int32)
+                rp_pad[:n] = rope_pos
+                rp = jnp.asarray(rp_pad)
+            mm_args = (jnp.asarray(emb) if embeds is not None else None,
+                       jnp.asarray(msk) if embeds is not None else None,
+                       rp)
         # whole-prompt chunks go sequence-parallel when configured (ring
         # attention assumes the chunk starts at position 0)
         use_sp = (
             embeds is None
+            and rope_pos is None
             and self.config.sp > 1
             and start_pos == 0
             and bucket % self.config.sp == 0
@@ -348,6 +384,8 @@ class ModelRunner:
             jnp.asarray(flts),
             self._next_key(),
             *mm_args,
+            # only the sampling (final) chunk's logprobs are ever consumed
+            want_lp=want_logprobs and sample,
         )
         if not sample:
             return None
@@ -355,6 +393,9 @@ class ModelRunner:
             return int(jax.device_get(tok))
         try:
             tok.copy_to_host_async()
+            if lp is not None:
+                for a in lp:
+                    a.copy_to_host_async()
         except Exception:
             pass
         if want_logprobs:
@@ -408,6 +449,7 @@ class ModelRunner:
         top_ps: np.ndarray,
         num_steps: int,
         want_logprobs: bool = False,
+        rope_deltas: np.ndarray | None = None,  # [B] M-RoPE offsets
     ):
         """Dispatch one fused decode window WITHOUT waiting for results.
 
@@ -415,12 +457,13 @@ class ModelRunner:
         device-to-host copy already started; the caller materializes it later
         (np.asarray) while further windows run on device."""
         B = positions.shape[0]
-        ints = np.empty((4 + page_tables.shape[1], B), np.int32)
+        ints = np.empty((5 + page_tables.shape[1], B), np.int32)
         ints[0] = positions
         ints[1] = limits
         ints[2] = active
         ints[3] = top_ks
-        ints[4:] = page_tables.T
+        ints[4] = rope_deltas if rope_deltas is not None else 0
+        ints[5:] = page_tables.T
         flts = np.stack([temps, top_ps]).astype(np.float32)
         toks, lp, self.kv_cache, self.tokens_dev = self._decode_window(
             self.params,

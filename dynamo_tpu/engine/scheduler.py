@@ -58,6 +58,10 @@ class EngineRequest:
     # OpenAI logprobs: None = off, 0 = chosen token only, n>0 = n top
     # alternatives per token (capped at sampling.LOGPROBS_K on device)
     logprobs: Optional[int] = None
+    # M-RoPE (filled at admission for mrope models with images): [T, 3]
+    # positions for the prompt + the scalar decode-time offset
+    mrope_pos: Optional[object] = None
+    mrope_delta: int = 0
 
 
 @dataclass
@@ -316,10 +320,19 @@ class Scheduler:
             # skipped entirely when every image run sits inside the cached
             # prefix — a repeat request never re-runs the vision tower
             req.mm_embeds = self.runner.encode_images(req.images)
+        mcfg = getattr(self.runner.model.config, "mrope_section", None)
+        if req.images and mcfg is not None and req.mrope_pos is None:
+            from dynamo_tpu.llm.multimodal import mrope_positions
+
+            req.mrope_pos, req.mrope_delta = mrope_positions(
+                prompt_len, req.images,
+                self.runner.model.config.vision.spatial_merge_size,
+            )
         while start < prompt_len:
             end = min(start + max_chunk, prompt_len)
             is_last = end == prompt_len
             embeds, embeds_mask = _mm_chunk_overrides(req, start, end)
+            rope_pos = req.mrope_pos[start:end] if req.mrope_pos is not None else None
             tok = self.runner.prefill_chunk(
                 np.asarray(req.token_ids[start:end], np.int32),
                 start_pos=start,
@@ -332,6 +345,7 @@ class Scheduler:
                 sync=sync,
                 embeds=embeds,
                 embeds_mask=embeds_mask,
+                rope_pos=rope_pos,
                 want_logprobs=want_logprobs and not sync,
             )
             if is_last:
@@ -445,6 +459,7 @@ class Scheduler:
         temps = np.zeros(B, np.float32)
         top_ks = np.zeros(B, np.int32)
         top_ps = np.ones(B, np.float32)
+        rope_deltas = np.zeros(B, np.int32)
 
         snapshot = []
         for seq, steps in participants:
@@ -456,13 +471,14 @@ class Scheduler:
             temps[i] = seq.req.sampling.temperature
             top_ks[i] = seq.req.sampling.top_k
             top_ps[i] = seq.req.sampling.top_p
+            rope_deltas[i] = seq.req.mrope_delta
             snapshot.append((seq, i, steps))
             seq.sched_len += steps
 
         want_lp = any(seq.req.logprobs is not None for seq, _ in participants)
         result = self.runner.dispatch_decode_window(
             positions, page_tables, active, limits, temps, top_ks, top_ps, K,
-            want_logprobs=want_lp,
+            want_logprobs=want_lp, rope_deltas=rope_deltas,
         )
         toks_dev, lp = result if want_lp else (result, None)
         self.in_flight.append(_InFlight(kind="window", dev=toks_dev, seqs=snapshot, lp=lp))
@@ -576,6 +592,8 @@ class Scheduler:
             images=seq.req.images,
             mm_embeds=seq.req.mm_embeds,  # offsets are prompt-relative: still valid
             logprobs=seq.req.logprobs,
+            # mrope_pos covers the OLD prompt length only: left None so it is
+            # recomputed over prompt+generated at re-admission (delta included)
             sampling=SamplingParams(
                 temperature=seq.req.sampling.temperature,
                 top_k=seq.req.sampling.top_k,

@@ -288,3 +288,43 @@ def tokenize_with_images(
     if cursor < len(rendered):
         token_ids.extend(encode(rendered[cursor:]))
     return token_ids, mm
+
+
+def mrope_positions(
+    num_tokens: int, images: list[ImageInput], merge_size: int
+) -> tuple[np.ndarray, int]:
+    """M-RoPE position components for a prompt (Qwen2-VL semantics).
+
+    Text tokens advance a shared scalar p: components (p, p, p). An image's
+    tokens (row-major over its merged gh' x gw' grid) get (base, base + r,
+    base + c) where base is the position after the preceding text; the next
+    text position is base + max(gh', gw'). Returns (positions3 [T, 3] int32,
+    rope_delta) where rope_delta + seq_pos gives every component's decode-time
+    rope position (generated text advances all components equally).
+    """
+    pos3 = np.zeros((num_tokens, 3), np.int32)
+    by_offset = sorted(images, key=lambda im: im.offset)
+    p = 0
+    cursor = 0
+    for im in by_offset:
+        for i in range(cursor, im.offset):  # text run before the image
+            pos3[i] = p
+            p += 1
+        ghm, gwm = im.grid[0] // merge_size, im.grid[1] // merge_size
+        if im.num_tokens != ghm * gwm:
+            raise ValueError(
+                f"image at offset {im.offset}: {im.num_tokens} tokens != "
+                f"merged grid {ghm}x{gwm}"
+            )
+        base = p
+        for j in range(im.num_tokens):
+            r, c = divmod(j, gwm)
+            pos3[im.offset + j] = (base, base + r, base + c)
+        p = base + max(ghm, gwm)
+        cursor = im.offset + im.num_tokens
+    for i in range(cursor, num_tokens):
+        pos3[i] = p
+        p += 1
+    # decode continues at rope position p, p+1, ... while the sequential KV
+    # position continues at num_tokens: delta aligns the two timelines
+    return pos3, p - num_tokens

@@ -99,6 +99,8 @@ class OpenAIPreprocessor:
         bool gate and ``top_logprobs`` the count (0-20)."""
         lp = req.logprobs
         if lp is None or lp is False:
+            if getattr(req, "top_logprobs", None) is not None:
+                raise ProtocolError("top_logprobs requires logprobs to be true")
             return None
         if lp is True:
             return int(getattr(req, "top_logprobs", None) or 0)

@@ -553,7 +553,9 @@ class DeepseekModel:
     # ---------------- public forward API (ModelRunner contract) ----------------
 
     def prefill(self, params, kv_cache, tokens, positions, page_table, valid, last_idx,
-                input_embeds=None, embeds_mask=None):
+                input_embeds=None, embeds_mask=None, rope_positions=None):
+        # rope_positions (M-RoPE) is accepted for runner-contract parity but
+        # unused: no multimodal MLA family exists
         c = self.config
         pool = kv_cache["ckv"]
         page_size = pool.shape[1]
@@ -569,7 +571,7 @@ class DeepseekModel:
         logits = self._unembed(params, hidden[last_idx][None, :])[0]
         return logits, {"ckv": pool}
 
-    def decode(self, params, kv_cache, tokens, positions, page_tables, active):
+    def decode(self, params, kv_cache, tokens, positions, page_tables, active, rope_deltas=None):
         c = self.config
         pool = kv_cache["ckv"]
         page_size = pool.shape[1]

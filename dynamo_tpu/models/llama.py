@@ -36,7 +36,7 @@ from dynamo_tpu.ops.attention import (
     scatter_kv,
 )
 from dynamo_tpu.ops.norms import rms_norm
-from dynamo_tpu.ops.rotary import apply_rope
+from dynamo_tpu.ops.rotary import apply_mrope, apply_rope
 
 
 def _resolve_tp_axis(mesh: Mesh, tp_axis: str):
@@ -74,6 +74,10 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     tie_word_embeddings: bool = False
     attention_bias: bool = False  # Qwen2-style qkv biases
+    # M-RoPE (Qwen2-VL): (temporal, row, col) frequency sections summing to
+    # head_dim // 2. None = plain 1D RoPE. With equal position components
+    # (all text) M-RoPE reduces exactly to 1D RoPE (ops/rotary.py).
+    mrope_section: Any = None
     dtype: Any = jnp.bfloat16
 
     @classmethod
@@ -254,10 +258,11 @@ class LlamaModel:
         hidden: jnp.ndarray,  # [T, D]
         k_pool: jnp.ndarray,  # [LP, ps, Hkv, D] full flat pool (carried)
         v_pool: jnp.ndarray,  # [LP, ps, Hkv, D]
-        positions: jnp.ndarray,  # [T]
+        positions: jnp.ndarray,  # [T] sequential positions (KV addressing)
         flat_phys: jnp.ndarray,  # [T] flat page per token (layer trash for invalid)
         offsets: jnp.ndarray,  # [T]
         attn_fn,
+        rope_positions: jnp.ndarray | None = None,  # [T, 3] M-RoPE components
     ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         c = self.config
         T = hidden.shape[0]
@@ -272,8 +277,17 @@ class LlamaModel:
         q = q_flat.reshape(T, c.num_heads, c.head_dim)
         k = k_flat.reshape(T, c.num_kv_heads, c.head_dim)
         v = v_flat.reshape(T, c.num_kv_heads, c.head_dim)
-        q = apply_rope(q, positions, c.rope_theta)
-        k = apply_rope(k, positions, c.rope_theta)
+        if c.mrope_section is not None:
+            pos3 = (
+                rope_positions
+                if rope_positions is not None
+                else jnp.stack([positions] * 3, axis=-1)
+            )
+            q = apply_mrope(q, pos3, tuple(c.mrope_section), c.rope_theta)
+            k = apply_mrope(k, pos3, tuple(c.mrope_section), c.rope_theta)
+        else:
+            q = apply_rope(q, positions, c.rope_theta)
+            k = apply_rope(k, positions, c.rope_theta)
         k_pool, v_pool = scatter_kv(k_pool, v_pool, k, v, flat_phys, offsets)
         # attn_fn sees both the updated pools (paged paths) and the chunk's
         # fresh rows (ring/SP path, which never reads the pool)
@@ -286,7 +300,7 @@ class LlamaModel:
 
     def _prefill_common(
         self, params, kv_cache, tokens, positions, page_table, valid, last_idx, make_attn_fn,
-        input_embeds=None, embeds_mask=None,
+        input_embeds=None, embeds_mask=None, rope_positions=None,
     ) -> tuple[jnp.ndarray, dict]:
         """Shared prefill machinery; make_attn_fn(off) -> attn_fn for a layer
         (off = the layer's flat-pool offset). input_embeds [T, D] + embeds_mask
@@ -307,7 +321,8 @@ class LlamaModel:
             h, kp, vp = carry
             lp, off = xs
             h, kp, vp = self._layer(
-                lp, h, kp, vp, positions, off + phys, offsets, make_attn_fn(off)
+                lp, h, kp, vp, positions, off + phys, offsets, make_attn_fn(off),
+                rope_positions=rope_positions,
             )
             return (h, kp, vp), None
 
@@ -330,6 +345,7 @@ class LlamaModel:
         last_idx: jnp.ndarray,  # scalar: index of the final real token in chunk
         input_embeds: jnp.ndarray | None = None,  # [T, D] mm embedding overrides
         embeds_mask: jnp.ndarray | None = None,  # [T] bool
+        rope_positions: jnp.ndarray | None = None,  # [T, 3] M-RoPE components
     ) -> tuple[jnp.ndarray, dict]:
         """One (possibly chunked) prefill pass for a single sequence.
 
@@ -347,6 +363,7 @@ class LlamaModel:
         return self._prefill_common(
             params, kv_cache, tokens, positions, page_table, valid, last_idx, make_attn_fn,
             input_embeds=input_embeds, embeds_mask=embeds_mask,
+            rope_positions=rope_positions,
         )
 
     def prefill_sp(
@@ -393,8 +410,14 @@ class LlamaModel:
         positions: jnp.ndarray,  # [B] its absolute position
         page_tables: jnp.ndarray,  # [B, max_pages] logical (per-layer) page ids
         active: jnp.ndarray,  # [B] bool
+        rope_deltas: jnp.ndarray | None = None,  # [B] M-RoPE position offsets
     ) -> tuple[jnp.ndarray, dict]:
-        """One decode step for the whole batch. Returns (logits[B, V], kv_cache)."""
+        """One decode step for the whole batch. Returns (logits[B, V], kv_cache).
+
+        rope_deltas (M-RoPE models): the decode rope position is
+        ``positions + rope_deltas`` on every component — the per-sequence
+        offset between sequential KV positions and the 3D rope timeline that
+        image grids introduced during prefill."""
         c = self.config
         k_pool, v_pool = kv_cache["k"], kv_cache["v"]
         page_size = k_pool.shape[1]
@@ -405,6 +428,10 @@ class LlamaModel:
         offsets = jnp.where(active, positions % page_size, 0)
 
         hidden = params["embed"][tokens].astype(c.dtype)
+        rope_pos3 = None
+        if c.mrope_section is not None and rope_deltas is not None:
+            rp = positions + rope_deltas
+            rope_pos3 = jnp.stack([rp] * 3, axis=-1)
 
         def body(carry, xs):
             h, kp, vp = carry
@@ -415,7 +442,10 @@ class LlamaModel:
                     q, kp_, vp_, off + page_tables, positions, mesh=self.attn_mesh
                 )
 
-            h, kp, vp = self._layer(lp, h, kp, vp, positions, off + phys, offsets, attn_fn)
+            h, kp, vp = self._layer(
+                lp, h, kp, vp, positions, off + phys, offsets, attn_fn,
+                rope_positions=rope_pos3,
+            )
             return (h, kp, vp), None
 
         (hidden, k_pool, v_pool), _ = jax.lax.scan(

@@ -88,7 +88,10 @@ class MixtralModel(LlamaModel):
         layers["w_down"] = ns(None, ep, None, None)
         return shardings
 
-    def _layer(self, lp, hidden, k_pool, v_pool, positions, flat_phys, offsets, attn_fn):
+    def _layer(self, lp, hidden, k_pool, v_pool, positions, flat_phys, offsets, attn_fn,
+               rope_positions=None):
+        # rope_positions (M-RoPE) accepted for base-class contract parity;
+        # Mixtral is text-only so plain 1D RoPE always applies
         c = self.config
         T = hidden.shape[0]
         # attention sublayer identical to Llama

@@ -29,29 +29,29 @@ class Qwen2VLConfig(LlamaConfig):
 
     @classmethod
     def from_hf_config(cls, d: dict) -> "Qwen2VLConfig":
-        rope_scaling = d.get("rope_scaling") or {}
-        if rope_scaling.get("type", rope_scaling.get("rope_type")) == "mrope":
-            # honest guard (like deepseek.py's unsupported-feature checks):
-            # shipping Qwen2-VL checkpoints are trained with M-RoPE (3D
-            # positions for image tokens); serving them with 1D RoPE would
-            # silently corrupt positional encodings. M-RoPE needs 3D position
-            # tracking through the engine — not implemented yet.
-            raise ValueError(
-                "qwen2_vl checkpoint uses rope_scaling type 'mrope', which this "
-                "engine does not implement yet; refusing to serve it with plain "
-                "1D RoPE (positions would differ from training)"
-            )
         vision = VisionConfig.from_hf_config(
             d.get("vision_config", {}), out_hidden_size=d["hidden_size"]
         )
         base = LlamaConfig.from_hf_config(d)
-        return cls(**{f: getattr(base, f) for f in base.__dataclass_fields__}, vision=vision)
+        fields = {f: getattr(base, f) for f in base.__dataclass_fields__}
+        rope_scaling = d.get("rope_scaling") or {}
+        if rope_scaling.get("type", rope_scaling.get("rope_type")) == "mrope":
+            section = tuple(rope_scaling["mrope_section"])
+            if sum(section) != base.head_dim // 2 or len(section) != 3:
+                raise ValueError(
+                    f"mrope_section {section} must be 3 values summing to "
+                    f"head_dim//2 = {base.head_dim // 2}"
+                )
+            fields["mrope_section"] = section
+        return cls(**fields, vision=vision)
 
     @classmethod
     def tiny_vl(cls, **overrides) -> "Qwen2VLConfig":
         if "dtype" in overrides:
             overrides["dtype"] = parse_dtype(overrides["dtype"])
-        text = LlamaConfig.tiny(attention_bias=True)
+        # mrope on by default: the real qwen2_vl parameterization (head_dim 16
+        # -> sections (2, 3, 3) summing to 8)
+        text = LlamaConfig.tiny(attention_bias=True, mrope_section=(2, 3, 3))
         base = cls(
             **{f: getattr(text, f) for f in text.__dataclass_fields__},
             vision=VisionConfig.tiny(out_hidden_size=text.hidden_size),

@@ -25,3 +25,33 @@ def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndar
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     return rotated.astype(x.dtype)
+
+
+def apply_mrope(
+    x: jnp.ndarray,  # [T, num_heads, head_dim]
+    positions3: jnp.ndarray,  # [T, 3] (temporal, row, col) position per token
+    sections: tuple[int, int, int],  # frequency split, sums to head_dim // 2
+    theta: float,
+) -> jnp.ndarray:
+    """Multimodal RoPE (Qwen2-VL): the inverse-frequency vector is split into
+    (temporal, row, col) sections; frequency j takes its angle from the
+    position component its section belongs to. Text tokens carry equal
+    components, for which this reduces EXACTLY to apply_rope — so text-only
+    prompts match the plain path bit-for-bit.
+
+    x: [T, H, D]; positions3: [T, 3] int32. sections must sum to D // 2.
+    """
+    head_dim = x.shape[-1]
+    inv_freq = rope_frequencies(head_dim, theta)  # [D/2]
+    # component selector per frequency: 0 (temporal) | 1 (row) | 2 (col)
+    comp = jnp.repeat(
+        jnp.arange(3, dtype=jnp.int32), jnp.asarray(sections, jnp.int32),
+        total_repeat_length=head_dim // 2,
+    )
+    pos = positions3.astype(jnp.float32)[:, comp]  # [T, D/2]
+    angles = pos * inv_freq[None, :]
+    cos = jnp.cos(angles)[:, None, :]
+    sin = jnp.sin(angles)[:, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return rotated.astype(x.dtype)

@@ -58,7 +58,7 @@ def stage_kv_sharding(mesh: Mesh, pp_axis: str = "pp") -> dict:
     return {"k": ns, "v": ns}
 
 
-def _local_layer_scan(model, local_layers, kp, vp, hidden, positions, phys, offsets, attn_maker, num_pages):
+def _local_layer_scan(model, local_layers, kp, vp, hidden, positions, phys, offsets, attn_maker, num_pages, rope_positions=None):
     """Run this stage's layer slice over one microbatch. phys holds per-token
     LOGICAL page ids (trash-routed already); layer offsets are stage-local."""
     L_loc = kp.shape[0] // num_pages
@@ -68,7 +68,8 @@ def _local_layer_scan(model, local_layers, kp, vp, hidden, positions, phys, offs
         h, kp_, vp_ = carry
         lp, off = xs
         h, kp_, vp_ = model._layer(
-            lp, h, kp_, vp_, positions, off + phys, offsets, attn_maker(off)
+            lp, h, kp_, vp_, positions, off + phys, offsets, attn_maker(off),
+            rope_positions=rope_positions,
         )
         return (h, kp_, vp_), None
 
@@ -124,6 +125,7 @@ def prefill_pipelined(
     num_microbatches: int | None = None,
     input_embeds: jnp.ndarray | None = None,  # [T, D] mm overrides
     embeds_mask: jnp.ndarray | None = None,  # [T]
+    rope_positions: jnp.ndarray | None = None,  # [T, 3] M-RoPE components
 ) -> tuple[jnp.ndarray, dict]:
     """Pipelined single-sequence prefill. Returns (logits[V] at last_idx, kv)."""
     c = model.config
@@ -147,6 +149,13 @@ def prefill_pipelined(
     pos_mbs = positions.reshape(M, Tm)
     phys_mbs = phys.reshape(M, Tm)
     off_mbs = offsets.reshape(M, Tm)
+    # M-RoPE components ride alongside (equal components for pure text)
+    rp3 = (
+        rope_positions
+        if rope_positions is not None
+        else jnp.stack([positions] * 3, axis=-1)
+    )
+    rp_mbs = rp3.reshape(M, Tm, 3)
 
     spec_pool = P(pp_axis, None, None, None)
     rep = P()
@@ -154,11 +163,11 @@ def prefill_pipelined(
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(P(pp_axis), spec_pool, spec_pool, rep, rep, rep, rep, rep),
+        in_specs=(P(pp_axis), spec_pool, spec_pool, rep, rep, rep, rep, rep, rep),
         out_specs=(rep, spec_pool, spec_pool),
         check_vma=False,
     )
-    def run(local_layers, kp, vp, hidden_mbs, pos_mbs, phys_mbs, off_mbs, page_table):
+    def run(local_layers, kp, vp, hidden_mbs, pos_mbs, phys_mbs, off_mbs, rp_mbs, page_table):
         def run_mb(mc, active, x, kp, vp):
             pos = pos_mbs[mc]
             # idle ramp steps write to the layer trash page (logical 0)
@@ -172,13 +181,14 @@ def prefill_pipelined(
                 return attn_fn
 
             return _local_layer_scan(
-                model, local_layers, kp, vp, x, pos, phys_mb, off_mb, attn_maker, num_pages
+                model, local_layers, kp, vp, x, pos, phys_mb, off_mb, attn_maker, num_pages,
+                rope_positions=rp_mbs[mc],
             )
 
         return _gpipe_rotate(mesh, pp_axis, S, M, run_mb, hidden_mbs, kp, vp)
 
     outputs, k_pool, v_pool = run(
-        params["layers"], k_pool, v_pool, hidden_mbs, pos_mbs, phys_mbs, off_mbs, page_table
+        params["layers"], k_pool, v_pool, hidden_mbs, pos_mbs, phys_mbs, off_mbs, rp_mbs, page_table
     )
     hidden_out = outputs.reshape(T, -1)
     logits = model._unembed(params, hidden_out[last_idx][None, :])[0]
@@ -196,6 +206,7 @@ def decode_pipelined(
     mesh: Mesh,
     pp_axis: str = "pp",
     num_microbatches: int | None = None,
+    rope_deltas: jnp.ndarray | None = None,  # [B] M-RoPE offsets
 ) -> tuple[jnp.ndarray, dict]:
     """Pipelined batched decode step: batch slots split into microbatches.
     Returns (logits [B, V], kv)."""
@@ -221,6 +232,8 @@ def decode_pipelined(
     off_mbs = offsets.reshape(M, Bm)
     pt_mbs = page_tables.reshape(M, Bm, -1)
     act_mbs = active.reshape(M, Bm)
+    rp = positions + (rope_deltas if rope_deltas is not None else 0)
+    rp_mbs = jnp.stack([rp] * 3, axis=-1).reshape(M, Bm, 3)
 
     spec_pool = P(pp_axis, None, None, None)
     rep = P()
@@ -228,11 +241,11 @@ def decode_pipelined(
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(P(pp_axis), spec_pool, spec_pool) + (rep,) * 6,
+        in_specs=(P(pp_axis), spec_pool, spec_pool) + (rep,) * 7,
         out_specs=(rep, spec_pool, spec_pool),
         check_vma=False,
     )
-    def run(local_layers, kp, vp, hidden_mbs, pos_mbs, phys_mbs, off_mbs, pt_mbs, act_mbs):
+    def run(local_layers, kp, vp, hidden_mbs, pos_mbs, phys_mbs, off_mbs, pt_mbs, act_mbs, rp_mbs):
         def run_mb(mc, pipe_active, x, kp, vp):
             pos = pos_mbs[mc]
             row_active = act_mbs[mc] & pipe_active
@@ -247,13 +260,14 @@ def decode_pipelined(
                 return attn_fn
 
             return _local_layer_scan(
-                model, local_layers, kp, vp, x, pos, phys_mb, off_mb, attn_maker, num_pages
+                model, local_layers, kp, vp, x, pos, phys_mb, off_mb, attn_maker, num_pages,
+                rope_positions=rp_mbs[mc],
             )
 
         return _gpipe_rotate(mesh, pp_axis, S, M, run_mb, hidden_mbs, kp, vp)
 
     outputs, k_pool, v_pool = run(
-        params["layers"], k_pool, v_pool, hidden_mbs, pos_mbs, phys_mbs, off_mbs, pt_mbs, act_mbs
+        params["layers"], k_pool, v_pool, hidden_mbs, pos_mbs, phys_mbs, off_mbs, pt_mbs, act_mbs, rp_mbs
     )
     hidden_out = outputs.reshape(B, -1)
     logits = model._unembed(params, hidden_out)

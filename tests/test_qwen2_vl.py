@@ -14,6 +14,7 @@ from dynamo_tpu.llm.multimodal import (
     ImageInput,
     extract_content_parts,
     image_content_hash,
+    mrope_positions,
     patchify,
     smart_resize,
     tokenize_with_images,
@@ -21,7 +22,7 @@ from dynamo_tpu.llm.multimodal import (
 )
 from dynamo_tpu.models.qwen2_vl import Qwen2VLConfig, Qwen2VLModel
 from dynamo_tpu.ops.norms import rms_norm
-from dynamo_tpu.ops.rotary import apply_rope
+from dynamo_tpu.ops.rotary import apply_mrope, apply_rope
 
 
 def rng_image(seed=0, h=24, w=16):
@@ -88,8 +89,9 @@ def test_vision_padding_invariance():
 # ---------------- mm prefill vs naive dense reference ----------------
 
 
-def naive_mm_forward(cfg, params, tokens, embeds, mask):
-    """Dense causal transformer with qkv biases + embedding override."""
+def naive_mm_forward(cfg, params, tokens, embeds, mask, pos3=None):
+    """Dense causal transformer with qkv biases + embedding override; applies
+    M-RoPE when the config has mrope_section and pos3 is given."""
     T = len(tokens)
     pos = jnp.arange(T)
     h = params["embed"][jnp.array(tokens)].astype(cfg.dtype)
@@ -100,8 +102,12 @@ def naive_mm_forward(cfg, params, tokens, embeds, mask):
         q = (x @ lp["wq"] + lp["bq"]).reshape(T, cfg.num_heads, cfg.head_dim)
         k = (x @ lp["wk"] + lp["bk"]).reshape(T, cfg.num_kv_heads, cfg.head_dim)
         v = (x @ lp["wv"] + lp["bv"]).reshape(T, cfg.num_kv_heads, cfg.head_dim)
-        q = apply_rope(q, pos, cfg.rope_theta)
-        k = apply_rope(k, pos, cfg.rope_theta)
+        if cfg.mrope_section is not None and pos3 is not None:
+            q = apply_mrope(q, jnp.asarray(pos3), tuple(cfg.mrope_section), cfg.rope_theta)
+            k = apply_mrope(k, jnp.asarray(pos3), tuple(cfg.mrope_section), cfg.rope_theta)
+        else:
+            q = apply_rope(q, pos, cfg.rope_theta)
+            k = apply_rope(k, pos, cfg.rope_theta)
         g = cfg.num_heads // cfg.num_kv_heads
         kr = jnp.repeat(k, g, axis=1)
         vr = jnp.repeat(v, g, axis=1)
@@ -315,8 +321,11 @@ async def _collect(engine, req):
 
 def test_engine_mm_generate(vl_engine):
     engine, loop = vl_engine
-    img_a = rng_image(21, h=16, w=16)
-    img_b = rng_image(22, h=16, w=16)
+    # structurally distinct images (solid dark vs bright gradient): the tiny
+    # random model must not be allowed to coincidentally produce the same
+    # greedy chain for both
+    img_a = np.zeros((16, 16, 3), np.float32) + 0.05
+    img_b = np.linspace(0, 1, 16 * 16 * 3, dtype=np.float32).reshape(16, 16, 3)
 
     toks_a, _ = loop.run_until_complete(_collect(engine, _mm_request(engine, "a", img_a)))
     toks_b, _ = loop.run_until_complete(_collect(engine, _mm_request(engine, "b", img_b)))
@@ -352,6 +361,10 @@ def test_engine_mm_matches_naive(vl_engine):
     )
     toks = list(req.token_ids)
     n_img = req.images[0].num_tokens
+    T0 = len(toks)
+    pos3_prompt, delta = mrope_positions(
+        T0, req.images, cfg.vision.spatial_merge_size
+    )
     out = []
     for _ in range(4):
         T = len(toks)
@@ -359,8 +372,59 @@ def test_engine_mm_matches_naive(vl_engine):
         embeds[2 : 2 + n_img] = emb
         mask = np.zeros(T, bool)
         mask[2 : 2 + n_img] = True
-        logits = naive_mm_forward(cfg, params, toks, embeds, mask)
+        # generated tail: all components advance together from the delta
+        tail = np.array([[t + delta] * 3 for t in range(T0, T)], np.int32).reshape(-1, 3)
+        pos3 = np.concatenate([pos3_prompt, tail]) if T > T0 else pos3_prompt
+        logits = naive_mm_forward(cfg, params, toks, embeds, mask, pos3=pos3)
         nxt = int(jnp.argmax(logits[-1]))
         toks.append(nxt)
         out.append(nxt)
     assert engine_toks == out
+
+
+# ---------------- M-RoPE ----------------
+
+
+def test_mrope_config_from_hf():
+    d = {
+        "architectures": ["Qwen2VLForConditionalGeneration"],
+        "model_type": "qwen2_vl",
+        "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "num_key_value_heads": 2, "head_dim": 8,
+        "rope_scaling": {"type": "mrope", "mrope_section": [1, 1, 2]},
+        "vision_config": {"patch_size": 4, "embed_dim": 16, "depth": 1, "num_heads": 2},
+    }
+    cfg = Qwen2VLConfig.from_hf_config(d)
+    assert cfg.mrope_section == (1, 1, 2)
+    import pytest as _pytest
+
+    bad = dict(d, rope_scaling={"type": "mrope", "mrope_section": [1, 1, 1]})
+    with _pytest.raises(ValueError, match="mrope_section"):
+        Qwen2VLConfig.from_hf_config(bad)
+
+
+def test_mrope_text_only_reduces_to_1d_rope():
+    """Same weights, text-only prompt: the mrope model must match a plain-rope
+    control bit-for-bit (equal position components reduce M-RoPE to RoPE)."""
+    from dataclasses import replace as _replace
+
+    cfg_m = Qwen2VLConfig.tiny_vl()
+    cfg_1d = _replace(cfg_m, mrope_section=None)
+    model_m, model_1 = Qwen2VLModel(cfg_m), Qwen2VLModel(cfg_1d)
+    params = model_m.init_params(jax.random.key(5))
+
+    T = 8
+    toks = np.array([3, 9, 1, 44, 7, 2, 60, 12], np.int32)
+    pos = np.arange(T, dtype=np.int32)
+    pt = np.array([1, 2, 0, 0], np.int32)
+    valid = np.ones(T, bool)
+    la, _ = model_m.prefill(
+        params, model_m.init_kv_cache(8, 16), jnp.asarray(toks), jnp.asarray(pos),
+        jnp.asarray(pt), jnp.asarray(valid), jnp.asarray(T - 1),
+    )
+    lb, _ = model_1.prefill(
+        params, model_1.init_kv_cache(8, 16), jnp.asarray(toks), jnp.asarray(pos),
+        jnp.asarray(pt), jnp.asarray(valid), jnp.asarray(T - 1),
+    )
+    np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=1e-6, atol=1e-6)
