@@ -72,7 +72,11 @@ def _probe_pallas() -> None:
         return
     try:
         import jax.numpy as jnp
-        from dynamo_tpu.ops.attention import dispatch_paged_decode_attention, use_pallas_decode
+        from dynamo_tpu.ops.attention import (
+            dispatch_paged_decode_attention,
+            dispatch_paged_prefill_attention,
+            use_pallas_decode,
+        )
 
         if not use_pallas_decode(128, 8):
             return
@@ -83,6 +87,14 @@ def _probe_pallas() -> None:
             jnp.zeros((4, 16, 8, 128), jnp.bfloat16),
             jnp.zeros((BATCH, 2), jnp.int32),
             jnp.zeros(BATCH, jnp.int32),
+        )
+        out.block_until_ready()
+        out = dispatch_paged_prefill_attention(
+            jnp.zeros((128, 16, 128), jnp.bfloat16),
+            jnp.zeros((16, 16, 8, 128), jnp.bfloat16),
+            jnp.zeros((16, 16, 8, 128), jnp.bfloat16),
+            jnp.zeros(8, jnp.int32),
+            jnp.arange(128, dtype=jnp.int32),
         )
         out.block_until_ready()
     except Exception as e:  # kernel unsupported here: use the XLA reference path

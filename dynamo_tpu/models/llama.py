@@ -30,9 +30,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dynamo_tpu.ops.attention import (
-    gather_pages,
-    attention_with_positions,
     dispatch_paged_decode_attention,
+    dispatch_paged_prefill_attention,
     scatter_kv,
 )
 from dynamo_tpu.ops.norms import rms_norm
@@ -354,9 +353,9 @@ class LlamaModel:
 
         def make_attn_fn(off):
             def attn_fn(q, k_new, v_new, kp_, vp_):
-                k_ctx = gather_pages(kp_, off + page_table)
-                v_ctx = gather_pages(vp_, off + page_table)
-                return attention_with_positions(q, k_ctx, v_ctx, positions)
+                return dispatch_paged_prefill_attention(
+                    q, kp_, vp_, off + page_table, positions, mesh=self.attn_mesh
+                )
 
             return attn_fn
 

@@ -180,6 +180,24 @@ def use_pallas_decode(head_dim: int, num_kv_heads: int) -> bool:
     return _on_tpu() and head_dim % 128 == 0
 
 
+
+def _tp_shard_map(fn, mesh, in_specs, out_specs):
+    """shard_map wrapper for pallas dispatchers (kernel outputs carry no vma
+    info, so the replication check is disabled; handles the pre-jax-0.8
+    import path)."""
+    import functools
+
+    try:
+        from jax import shard_map as _sm
+
+        sm = functools.partial(_sm, check_vma=False)
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map as _sm_old
+
+        sm = functools.partial(_sm_old, check_rep=False)
+    return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
 def dispatch_paged_decode_attention(q, k_pages, v_pages, page_tables, positions, mesh=None):
     """Pallas kernel on TPU, pure-JAX reference elsewhere (same contract).
 
@@ -196,22 +214,12 @@ def dispatch_paged_decode_attention(q, k_pages, v_pages, page_tables, positions,
 
             from jax.sharding import PartitionSpec as P
 
-            try:
-                from jax import shard_map as _sm
-
-                # pallas_call outputs carry no vma info; disable the check
-                shard_map = functools.partial(_sm, check_vma=False)
-            except ImportError:  # older jax
-                from jax.experimental.shard_map import shard_map as _sm_old
-
-                shard_map = functools.partial(_sm_old, check_rep=False)
-
             if q.shape[1] % tp or k_pages.shape[2] % tp:
                 return paged_decode_attention(q, k_pages, v_pages, page_tables, positions)
             fn = functools.partial(paged_decode_attention_pallas, interpret=interpret)
-            return shard_map(
+            return _tp_shard_map(
                 fn,
-                mesh=mesh,
+                mesh,
                 in_specs=(
                     P(None, "tp", None),  # q: heads sharded
                     P(None, None, "tp", None),  # k pages: kv heads sharded
@@ -225,3 +233,60 @@ def dispatch_paged_decode_attention(q, k_pages, v_pages, page_tables, positions,
             q, k_pages, v_pages, page_tables, positions, interpret=interpret
         )
     return paged_decode_attention(q, k_pages, v_pages, page_tables, positions)
+
+
+def use_pallas_prefill(head_dim: int, chunk_len: int, block_q: int = 128) -> bool:
+    """Trace-time choice of the Pallas prefill kernel: DYNTPU_PALLAS override,
+    else on for real TPU with lane-aligned head_dim and block-divisible
+    chunks (buckets are multiples of 128 in practice)."""
+    if chunk_len % block_q:
+        return False
+    flag = pallas_flag()
+    if flag is not None:
+        return flag
+    return _on_tpu() and head_dim % 128 == 0
+
+
+def dispatch_paged_prefill_attention(
+    q, k_pages, v_pages, page_table, positions, mesh=None
+):
+    """Chunked-prefill attention: Pallas flash kernel on TPU (context pages
+    streamed HBM->VMEM, online softmax, causal work bound per query block),
+    gather-based pure-JAX reference elsewhere. Under tensor parallelism the
+    kernel runs per-head-shard via shard_map like the decode kernel.
+
+    Kernel precondition (stricter than the reference): ``positions`` must be
+    UNIT-STRIDE within the chunk (positions[i] = positions[0] + i), which is
+    exactly what the engine's bucket-padded chunks provide. The reference
+    path only needs monotone positions."""
+    if use_pallas_prefill(q.shape[-1], q.shape[0]):
+        from dynamo_tpu.ops.pallas.prefill_attention import (
+            paged_prefill_attention_pallas,
+        )
+
+        interpret = not _on_tpu()
+        tp = 1 if mesh is None else mesh.shape.get("tp", 1)
+        if tp > 1:
+            import functools
+
+            from jax.sharding import PartitionSpec as P
+
+            if q.shape[1] % tp or k_pages.shape[2] % tp:
+                return paged_prefill_attention(q, k_pages, v_pages, page_table, positions)
+            fn = functools.partial(paged_prefill_attention_pallas, interpret=interpret)
+            return _tp_shard_map(
+                fn,
+                mesh,
+                in_specs=(
+                    P(None, "tp", None),
+                    P(None, None, "tp", None),
+                    P(None, None, "tp", None),
+                    P(None),
+                    P(None),
+                ),
+                out_specs=P(None, "tp", None),
+            )(q, k_pages, v_pages, page_table, positions)
+        return paged_prefill_attention_pallas(
+            q, k_pages, v_pages, page_table, positions, interpret=interpret
+        )
+    return paged_prefill_attention(q, k_pages, v_pages, page_table, positions)

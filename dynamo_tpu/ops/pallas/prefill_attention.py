@@ -1,0 +1,220 @@
+"""Pallas TPU kernel: chunked-prefill flash attention over the paged KV pool.
+
+A prefill chunk's queries attend causally over the sequence's paged context
+(which already contains the chunk's own rows — the model scatters before
+attending). The XLA reference path (ops/attention.py paged_prefill_attention)
+materializes the whole gathered context ``[max_pages * ps, Hkv, D]`` plus a
+``[Hq, T, S]`` score tensor per layer; this kernel streams context pages
+HBM -> VMEM in multi-page tiles with double buffering and keeps the online
+softmax in VMEM, so HBM traffic is one pass over the needed pages and no
+score/gather materialization at all. Causality additionally bounds work per
+query block: block b only loops over tiles up to its last query position.
+
+Contract: q [T, Hq, D] (bucket-padded chunk), k/v pages [P, ps, Hkv, D],
+page_table [max_pages] (this sequence's logical pages, trash page 0 padding),
+positions [T] absolute and **unit-stride** (positions[i] = positions[0] + i —
+the mask derives row positions from positions[block_start] + row offset;
+engine chunks always satisfy this; the XLA reference only needs monotone).
+GQA folds as [Hkv, G*Bq, D] batched matmuls.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _kernel(
+    # scalar prefetch
+    page_table_ref,  # [max_pages] SMEM
+    positions_ref,  # [T] SMEM
+    # inputs
+    q_ref,  # [Bq, Hq, D] VMEM (this query block)
+    k_hbm,  # [P, ps, Hkv, D] HBM
+    v_hbm,  # [P, ps, Hkv, D] HBM
+    # output
+    out_ref,  # [Bq, Hq, D] VMEM
+    # scratch
+    k_scratch,  # [2, TP, ps, Hkv, D] VMEM
+    v_scratch,  # [2, TP, ps, Hkv, D] VMEM
+    sems,  # DMA sems [2, 2, TP]
+    *,
+    page_size: int,
+    max_pages: int,
+    tile_pages: int,
+    block_q: int,
+):
+    qb = pl.program_id(0)
+    Bq, Hq, D = q_ref.shape
+    Hkv = k_hbm.shape[2]
+    G = Hq // Hkv
+    TP = tile_pages
+    S = TP * page_size  # context tile length
+
+    # this block's query positions and causal context bound
+    q_start = qb * block_q
+    last_pos = positions_ref[q_start + Bq - 1]
+    ctx_len = last_pos + 1
+    n_tiles = jnp.minimum(
+        pl.cdiv(ctx_len, S), pl.cdiv(jnp.int32(max_pages * page_size), S)
+    )
+
+    # [Hkv, G*Bq, D] query layout: head-major groups so each kv head's block
+    # is one batched matmul operand
+    q = (
+        q_ref[...]
+        .astype(jnp.float32)
+        .reshape(Bq, Hkv, G, D)
+        .transpose(1, 2, 0, 3)
+        .reshape(Hkv, G * Bq, D)
+    )
+    scale = 1.0 / jnp.sqrt(jnp.float32(D))
+
+    def tile_dma(buf, tile):
+        """Start/wait helpers for one context tile (TP page copies)."""
+        copies = []
+        for p in range(TP):
+            # clamp: the final tile may run past max_pages; masked below
+            idx = jnp.minimum(tile * TP + p, max_pages - 1)
+            copies.append(
+                (
+                    pltpu.make_async_copy(
+                        k_hbm.at[page_table_ref[idx]], k_scratch.at[buf, p],
+                        sems.at[buf, 0, p],
+                    ),
+                    pltpu.make_async_copy(
+                        v_hbm.at[page_table_ref[idx]], v_scratch.at[buf, p],
+                        sems.at[buf, 1, p],
+                    ),
+                )
+            )
+        return copies
+
+    def start(buf, tile):
+        for kc, vc in tile_dma(buf, tile):
+            kc.start()
+            vc.start()
+
+    def wait(buf, tile):
+        for kc, vc in tile_dma(buf, tile):
+            kc.wait()
+            vc.wait()
+
+    start(0, 0)
+
+    # causal mask geometry, built directly in 2D [G*Bq, S] (Mosaic rejects 1D
+    # vector reshapes): row i is block-row i % Bq; its query position is
+    # positions[q_start] + (i % Bq)
+    pos0 = positions_ref[q_start]
+    iota_row = jax.lax.broadcasted_iota(jnp.int32, (G * Bq, S), 0)
+    iota_col = jax.lax.broadcasted_iota(jnp.int32, (G * Bq, S), 1)
+    q_pos_2d = pos0 + jax.lax.rem(iota_row, Bq)  # [G*Bq, S]
+
+    def body(t, carry):
+        m, l, acc = carry
+        buf = jax.lax.rem(t, 2)
+
+        @pl.when(t + 1 < n_tiles)
+        def _():
+            start(jax.lax.rem(t + 1, 2), t + 1)
+
+        wait(buf, t)
+
+        kt = (
+            k_scratch[buf]
+            .astype(jnp.float32)
+            .reshape(S, Hkv, D)
+            .transpose(1, 0, 2)
+        )  # [Hkv, S, D]
+        vt = (
+            v_scratch[buf]
+            .astype(jnp.float32)
+            .reshape(S, Hkv, D)
+            .transpose(1, 0, 2)
+        )
+
+        # [Hkv, G*Bq, S]
+        scores = (
+            jax.lax.dot_general(
+                q, kt, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
+            )
+            * scale
+        )
+        ctx_idx = t * S + iota_col
+        # causal, and never beyond the page table (the final tile clamps its
+        # page indices to max_pages - 1, which would alias earlier content)
+        mask = (ctx_idx <= q_pos_2d) & (ctx_idx < max_pages * page_size)
+        scores = jnp.where(mask[None], scores, _NEG_INF)
+
+        chunk_max = jnp.max(scores, axis=-1)  # [Hkv, G*Bq]
+        new_m = jnp.maximum(m, chunk_max)
+        corr = jnp.exp(m - new_m)
+        probs = jnp.exp(scores - new_m[..., None])
+        new_l = l * corr + jnp.sum(probs, axis=-1)
+        chunk_out = jax.lax.dot_general(
+            probs, vt, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
+        )
+        new_acc = acc * corr[..., None] + chunk_out
+        return new_m, new_l, new_acc
+
+    m0 = jnp.full((Hkv, G * Bq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((Hkv, G * Bq), jnp.float32)
+    acc0 = jnp.zeros((Hkv, G * Bq, D), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_tiles, body, (m0, l0, acc0))
+
+    out = acc / jnp.maximum(l, 1e-20)[..., None]  # [Hkv, G*Bq, D]
+    out_ref[...] = (
+        out.reshape(Hkv, G, Bq, D).transpose(2, 0, 1, 3).reshape(Bq, Hq, D)
+    ).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "block_q"))
+def paged_prefill_attention_pallas(
+    q: jnp.ndarray,  # [T, Hq, D] bucket-padded chunk
+    k_pages: jnp.ndarray,  # [P, ps, Hkv, D]
+    v_pages: jnp.ndarray,  # [P, ps, Hkv, D]
+    page_table: jnp.ndarray,  # [max_pages] int32
+    positions: jnp.ndarray,  # [T] int32 absolute positions (unit-stride)
+    block_q: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    T, Hq, D = q.shape
+    P, ps, Hkv, _ = k_pages.shape
+    max_pages = page_table.shape[0]
+    assert T % block_q == 0, f"chunk {T} % block_q {block_q}"
+    tile_pages = max(1, 128 // ps)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(T // block_q,),
+        in_specs=[
+            pl.BlockSpec((block_q, Hq, D), lambda qb, *_: (qb, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((block_q, Hq, D), lambda qb, *_: (qb, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, tile_pages, ps, Hkv, D), k_pages.dtype),
+            pltpu.VMEM((2, tile_pages, ps, Hkv, D), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2, tile_pages)),
+        ],
+    )
+    kernel = pl.pallas_call(
+        functools.partial(
+            _kernel,
+            page_size=ps,
+            max_pages=max_pages,
+            tile_pages=tile_pages,
+            block_q=block_q,
+        ),
+        out_shape=jax.ShapeDtypeStruct((T, Hq, D), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )
+    return kernel(page_table.astype(jnp.int32), positions.astype(jnp.int32), q, k_pages, v_pages)
