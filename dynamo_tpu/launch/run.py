@@ -25,10 +25,33 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--tp", type=int, default=None, help="tensor-parallel degree")
     run.add_argument("--pp", type=int, default=None, help="pipeline-parallel stages")
     run.add_argument("--max-tokens", type=int, default=None, help="batch mode default max_tokens")
+    # serve/build/deploy are dispatched on argv[0] in main() (their argv is
+    # forwarded verbatim — argparse REMAINDER can't capture leading options);
+    # registered here so they show in --help
+    for name, help_ in (
+        ("serve", "launch a service graph (process-per-service supervisor)"),
+        ("build", "package a service graph into a deployable artifact"),
+        ("deploy", "manage deployments on the deploy API server"),
+    ):
+        sub.add_parser(name, help=help_, add_help=False)
     return p
 
 
 def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    # forward delegated subcommands verbatim (options and all)
+    if argv and argv[0] == "serve":
+        from dynamo_tpu.sdk.serve import main as serve_main
+
+        return serve_main(argv[1:])
+    if argv and argv[0] == "build":
+        from dynamo_tpu.sdk.build import main as build_main
+
+        return build_main(argv[1:])
+    if argv and argv[0] == "deploy":
+        from dynamo_tpu.sdk.deploy import main as deploy_main
+
+        return deploy_main(argv[1:])
     args = build_parser().parse_args(argv)
     if args.command == "run":
         from dynamo_tpu.launch._run_impl import run_command

@@ -30,6 +30,7 @@ from dynamo_tpu.llm.protocols.openai import (
     Usage,
 )
 from dynamo_tpu.llm.http.metrics import Metrics
+from dynamo_tpu.llm.protocols import sse
 from dynamo_tpu.llm.tools import ToolCallError, ToolCallingMatcher
 from dynamo_tpu.utils import get_logger
 
@@ -258,12 +259,21 @@ class HttpService:
             ChatDeltaGenerator(model) if kind == "chat" else CompletionDeltaGenerator(model)
         )
         usage = Usage(prompt_tokens=len(pre.token_ids))
+        # requested annotations ride the SSE stream as named events, ahead of
+        # the first delta (reference: protocols/annotated.rs envelope)
+        for name, value in annotations.items():
+            yield {"__event__": name, "data": value}
+        want_timing = "timing" in pre.annotations
+        t_start = time.monotonic()
+        t_first = None
         # With tools active the full text must be buffered so a tool-call JSON
         # response never leaks as content deltas (tool calls are matched on
         # complete messages, llm/tools.py).
         buffered: list[str] = []
         async for out in pipeline.backend.generate(pre):
             usage.completion_tokens = out.cumulative_tokens
+            if t_first is None and out.token_ids:
+                t_first = time.monotonic()
             if tool_matcher is not None:
                 if out.text:
                     buffered.append(out.text)
@@ -279,6 +289,24 @@ class HttpService:
                         finish = "tool_calls"
                     elif text:
                         yield gen.text_chunk(text)
+                if want_timing:
+                    total = time.monotonic() - t_start
+                    ttft = (t_first - t_start) if t_first is not None else None
+                    decode_s = (time.monotonic() - t_first) if t_first is not None else 0.0
+                    yield {
+                        "__event__": "timing",
+                        "data": {
+                            "ttft_ms": round(ttft * 1e3, 1) if ttft is not None else None,
+                            "total_ms": round(total * 1e3, 1),
+                            "output_tokens": usage.completion_tokens,
+                            "cached_tokens": out.cached_tokens,
+                            "decode_tok_per_s": (
+                                round((usage.completion_tokens - 1) / decode_s, 1)
+                                if usage.completion_tokens > 1 and decode_s > 0
+                                else None
+                            ),
+                        },
+                    }
                 yield gen.finish_chunk(finish, usage)
                 return
 
@@ -297,8 +325,11 @@ class HttpService:
         status = "200"
         try:
             async for chunk in chunks:
-                await resp.write(f"data: {json.dumps(chunk, separators=(',', ':'))}\n\n".encode())
-            await resp.write(b"data: [DONE]\n\n")
+                if "__event__" in chunk:
+                    await resp.write(sse.encode_event(chunk["__event__"], chunk.get("data")))
+                    continue
+                await resp.write(sse.encode_data(chunk))
+            await resp.write(sse.encode_done())
         except (asyncio.CancelledError, ConnectionResetError):
             status = "499"
             raise

@@ -34,6 +34,8 @@ async def aggregate_chat_stream(chunks: AsyncIterator[dict]) -> dict:
     usage = None
     lp_content: list[dict] = []
     async for chunk in chunks:
+        if "__event__" in chunk:
+            continue  # annotation/timing events don't aggregate
         if out is None:
             out = _base_from_chunk(chunk, "chat.completion")
         for choice in chunk.get("choices", []):
@@ -85,6 +87,8 @@ async def aggregate_completion_stream(chunks: AsyncIterator[dict]) -> dict:
     usage = None
     lp = {"tokens": [], "token_logprobs": [], "top_logprobs": [], "text_offset": []}
     async for chunk in chunks:
+        if "__event__" in chunk:
+            continue  # annotation/timing events don't aggregate
         if out is None:
             out = _base_from_chunk(chunk, "text_completion")
         for choice in chunk.get("choices", []):

@@ -1,0 +1,123 @@
+"""`dynamo-tpu build`: package a service graph into a deployable artifact.
+
+The reference's `dynamo build` packages a graph as a bento (BentoML-derived
+archive with Rust binaries inside — reference: deploy/dynamo/sdk/src/dynamo/
+sdk/cli, pyproject.toml bento packaging). The native analogue is leaner: the
+framework is a single Python package, so the artifact is the **deployment
+contract**, not a code archive:
+
+  artifact/
+    manifest.json     — entry point, graph, per-service meta (the build record)
+    deployment.yaml   — a deploy-plane DeploymentSpec (dynamo_tpu/deploy/crd.py)
+                        rendered from the graph: `dynamo-tpu deploy create` or
+                        the K8s reconciler consume it directly
+    config.yaml       — the service YAML config, copied verbatim (when given)
+
+Per-service replicas/chips resolve exactly like the serve supervisor does
+(meta defaults overridden by the YAML section), so a built artifact deploys
+what `serve` would have run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import sys
+from pathlib import Path
+
+from dynamo_tpu.deploy.crd import DeploymentSpec, ServiceSpec
+from dynamo_tpu.llm.model_card import slugify
+from dynamo_tpu.sdk.config import ServiceConfig
+from dynamo_tpu.sdk.serve import class_spec, discover_graph
+from dynamo_tpu.sdk.serve_worker import load_class
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("sdk.build")
+
+
+def build_spec(entry_spec: str, config: dict, name: str | None = None,
+               image: str = "dynamo-tpu:latest") -> tuple[DeploymentSpec, list[dict]]:
+    """Resolve the graph and render a DeploymentSpec + per-service build info."""
+    entry_cls = load_class(entry_spec)
+    graph = discover_graph(entry_cls)
+    services = []
+    info = []
+    for cls in graph:
+        meta = cls.__dynamo_service__
+        section = config.get(cls.__name__, {})
+        resources = section.get("resources", meta.resources) or {}
+        workers = section.get("workers", meta.workers)
+        workers = 1 if workers == "cpu_count" else int(workers)
+        svc = ServiceSpec(
+            name=slugify(cls.__name__),
+            command=[
+                "python", "-m", "dynamo_tpu.sdk.serve_worker", class_spec(cls),
+            ],
+            replicas=workers,
+            tpu_chips=int(resources.get("tpu", 0) or 0),
+            config=section,
+        )
+        services.append(svc)
+        info.append(
+            {
+                "class": class_spec(cls),
+                "namespace": meta.namespace,
+                "component": meta.component,
+                "workers": workers,
+                "resources": resources,
+            }
+        )
+    dep_name = name or slugify(entry_cls.__name__)
+    spec = DeploymentSpec(name=dep_name, image=image, services=services)
+    spec.validate()
+    return spec, info
+
+
+def build_artifact(
+    entry_spec: str,
+    output_dir: str,
+    config_file: str | None = None,
+    name: str | None = None,
+    image: str = "dynamo-tpu:latest",
+) -> Path:
+    import yaml
+
+    config = ServiceConfig.from_yaml_and_overrides(config_file, [])
+    spec, info = build_spec(entry_spec, config, name=name, image=image)
+
+    out = Path(output_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    (out / "manifest.json").write_text(
+        json.dumps(
+            {
+                "entry": entry_spec,
+                "deployment": spec.name,
+                "image": image,
+                "services": info,
+            },
+            indent=2,
+        )
+    )
+    (out / "deployment.yaml").write_text(yaml.safe_dump(spec.to_dict(), sort_keys=False))
+    if config_file:
+        shutil.copyfile(config_file, out / "config.yaml")
+    log.info("built %s -> %s (%d services)", entry_spec, out, len(spec.services))
+    return out
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="dynamo-tpu build", description=__doc__)
+    parser.add_argument("entry", help="module.path:ServiceClass")
+    parser.add_argument("-f", "--file", default=None, help="YAML service config")
+    parser.add_argument("-o", "--output", default="./build", help="artifact directory")
+    parser.add_argument("--name", default=None, help="deployment name (default: entry class)")
+    parser.add_argument("--image", default="dynamo-tpu:latest", help="container image ref")
+    args = parser.parse_args(argv)
+    build_artifact(args.entry, args.output, config_file=args.file, name=args.name,
+                   image=args.image)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

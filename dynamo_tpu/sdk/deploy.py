@@ -1,0 +1,127 @@
+"""`dynamo-tpu deploy`: manage deployments against the deploy API server.
+
+The reference's `dynamo deploy` pushes built artifacts to its cloud API
+server (reference: deploy/dynamo/api-server REST CRUD); this is the client
+CLI for the native analogue (dynamo_tpu/deploy/api_server.py):
+
+    dynamo-tpu deploy create  build/deployment.yaml  --server http://host:port
+    dynamo-tpu deploy list | get NAME | delete NAME
+    dynamo-tpu deploy revisions NAME | rollback NAME REV | manifests NAME
+
+Accepts either a built artifact directory (uses its deployment.yaml) or a
+DeploymentSpec YAML/JSON file directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+
+class DeployClient:
+    def __init__(self, server: str):
+        self.base = server.rstrip("/")
+
+    def _req(self, method: str, path: str, body: dict | None = None) -> dict | list:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            f"{self.base}{path}", data=data, method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                payload = resp.read()
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")
+            raise SystemExit(f"{method} {path} -> HTTP {e.code}: {detail}")
+        return json.loads(payload) if payload else {}
+
+    def create(self, spec: dict):
+        return self._req("POST", "/api/v1/deployments", spec)
+
+    def update(self, name: str, spec: dict):
+        return self._req("PUT", f"/api/v1/deployments/{name}", spec)
+
+    def list(self):
+        return self._req("GET", "/api/v1/deployments")
+
+    def get(self, name: str):
+        return self._req("GET", f"/api/v1/deployments/{name}")
+
+    def delete(self, name: str):
+        return self._req("DELETE", f"/api/v1/deployments/{name}")
+
+    def revisions(self, name: str):
+        return self._req("GET", f"/api/v1/deployments/{name}/revisions")
+
+    def rollback(self, name: str, rev: int):
+        return self._req("POST", f"/api/v1/deployments/{name}/rollback/{rev}")
+
+    def manifests(self, name: str):
+        return self._req("GET", f"/api/v1/deployments/{name}/manifests")
+
+
+def load_spec(path: str) -> dict:
+    """Spec dict from a built artifact dir, a YAML file, or a JSON file."""
+    import yaml
+
+    p = Path(path)
+    if p.is_dir():
+        p = p / "deployment.yaml"
+    text = p.read_text()
+    return yaml.safe_load(text)
+
+
+def main(argv=None) -> int:
+    # --server accepted before OR after the action (parents= shares it with
+    # every subparser)
+    common = argparse.ArgumentParser(add_help=False)
+    # SUPPRESS: a subparser must not clobber a --server given before the
+    # action with its own default
+    common.add_argument("--server", default=argparse.SUPPRESS, help="deploy API server")
+    parser = argparse.ArgumentParser(
+        prog="dynamo-tpu deploy", description=__doc__, parents=[common]
+    )
+    sub = parser.add_subparsers(dest="action", required=True)
+    c = sub.add_parser("create", parents=[common],
+                       help="create/update a deployment from a spec or artifact")
+    c.add_argument("spec", help="artifact dir or DeploymentSpec yaml/json")
+    u = sub.add_parser("update", parents=[common], help="update an existing deployment")
+    u.add_argument("spec")
+    sub.add_parser("list", parents=[common], help="list deployments")
+    for act in ("get", "delete", "revisions", "manifests"):
+        a = sub.add_parser(act, parents=[common])
+        a.add_argument("name")
+    r = sub.add_parser("rollback", parents=[common])
+    r.add_argument("name")
+    r.add_argument("rev", type=int)
+    args = parser.parse_args(argv)
+
+    client = DeployClient(getattr(args, "server", "http://127.0.0.1:8180"))
+    if args.action == "create":
+        out = client.create(load_spec(args.spec))
+    elif args.action == "update":
+        spec = load_spec(args.spec)
+        out = client.update(spec["name"], spec)
+    elif args.action == "list":
+        out = client.list()
+    elif args.action == "get":
+        out = client.get(args.name)
+    elif args.action == "delete":
+        out = client.delete(args.name)
+    elif args.action == "revisions":
+        out = client.revisions(args.name)
+    elif args.action == "manifests":
+        out = client.manifests(args.name)
+    elif args.action == "rollback":
+        out = client.rollback(args.name, args.rev)
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

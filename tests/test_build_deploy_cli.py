@@ -1,0 +1,102 @@
+"""`dynamo-tpu build` artifact packaging + `deploy` CLI against a live API
+server (reference: dynamo build/deploy against the cloud api-server)."""
+
+import asyncio
+import json
+import threading
+
+import pytest
+import yaml
+
+from dynamo_tpu.deploy.api_server import DeployApiServer
+from dynamo_tpu.deploy.crd import DeploymentSpec
+from dynamo_tpu.sdk.build import build_artifact
+from dynamo_tpu.sdk.deploy import DeployClient, load_spec
+
+
+def test_build_artifact_from_example_graph(tmp_path):
+    out = build_artifact(
+        "examples.graphs.agg:Frontend",
+        str(tmp_path / "art"),
+        config_file="examples/configs/agg.yaml",
+        name="agg-demo",
+    )
+    manifest = json.loads((out / "manifest.json").read_text())
+    assert manifest["deployment"] == "agg-demo"
+    classes = {s["class"].rsplit(":", 1)[1] for s in manifest["services"]}
+    assert {"Frontend", "Processor", "TpuWorker"} <= classes
+
+    spec = DeploymentSpec.from_yaml(str(out / "deployment.yaml"))
+    assert spec.name == "agg-demo"
+    by_name = {s.name: s for s in spec.services}
+    assert by_name["tpuworker"].tpu_chips == 1  # resources={"tpu": 1} on the graph
+    assert by_name["tpuworker"].command[-1].endswith(":TpuWorker")
+    assert (out / "config.yaml").exists()
+
+
+def test_build_config_overrides_workers(tmp_path):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(yaml.safe_dump({"TpuWorker": {"workers": 3, "resources": {"tpu": 0}}}))
+    out = build_artifact(
+        "examples.graphs.agg:Frontend", str(tmp_path / "art"), config_file=str(cfg)
+    )
+    spec = DeploymentSpec.from_yaml(str(out / "deployment.yaml"))
+    worker = next(s for s in spec.services if s.name == "tpuworker")
+    assert worker.replicas == 3 and worker.tpu_chips == 0
+
+
+def test_deploy_cli_roundtrip(tmp_path):
+    """build -> create -> get/revisions -> update -> rollback -> delete against
+    a live in-process API server."""
+    art = build_artifact(
+        "examples.graphs.agg:Frontend", str(tmp_path / "art"), name="roundtrip"
+    )
+
+    loop = asyncio.new_event_loop()
+    server = DeployApiServer()
+    port = loop.run_until_complete(server.start())
+    runner = threading.Thread(target=loop.run_forever, daemon=True)
+    runner.start()
+    try:
+        client = DeployClient(f"http://127.0.0.1:{port}")
+        spec = load_spec(str(art))
+        created = client.create(spec)
+        assert created["name"] == "roundtrip"
+
+        got = client.get("roundtrip")
+        assert {s["name"] for s in got["spec"]["services"]} >= {"frontend", "tpuworker"}
+
+        spec2 = dict(spec)
+        spec2["services"] = [
+            dict(s, replicas=2) if s["name"] == "tpuworker" else s
+            for s in spec["services"]
+        ]
+        client.update("roundtrip", spec2)
+        revs = client.revisions("roundtrip")
+        assert len(revs) == 2
+
+        client.rollback("roundtrip", 1)
+        got = client.get("roundtrip")
+        worker = next(s for s in got["spec"]["services"] if s["name"] == "tpuworker")
+        assert worker["replicas"] == 1
+
+        manifests = client.manifests("roundtrip")
+        kinds = {m["kind"] for m in manifests["manifests"]}
+        assert "Deployment" in kinds
+
+        client.delete("roundtrip")
+        assert client.list() == [] or "roundtrip" not in client.list()
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+        runner.join(timeout=5)
+
+
+def test_cli_dispatch(tmp_path, capsys):
+    from dynamo_tpu.launch.run import main
+
+    rc = main([
+        "build", "examples.graphs.agg:Frontend", "-o", str(tmp_path / "a"),
+        "--name", "cli-built",
+    ])
+    assert rc == 0
+    assert (tmp_path / "a" / "deployment.yaml").exists()

@@ -154,3 +154,56 @@ def test_models_and_metrics(server):
     assert "llm_http_service_requests_total" in text
     assert 'model="tiny"' in text
     assert "llm_worker_request_total_slots" in text
+
+
+def test_annotation_and_timing_events_in_stream(server):
+    """ext.annotations ride the SSE stream as named events (reference:
+    Annotated envelope); "timing" adds a per-request latency breakdown."""
+    loop, url, _engine = server
+    import aiohttp
+
+    async def go():
+        body = {
+            "model": "tiny",
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 3,
+            "temperature": 0.0,
+            "stream": True,
+            "ext": {"annotations": ["formatted_prompt", "token_ids", "timing"],
+                    "ignore_eos": True},
+        }
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{url}/v1/chat/completions", json=body) as resp:
+                assert resp.status == 200
+                return (await resp.read()).decode()
+
+    text = loop.run_until_complete(go())
+    events = {}
+    lines = text.splitlines()
+    for i, line in enumerate(lines):
+        if line.startswith("event: "):
+            events[line[7:]] = json.loads(lines[i + 1][6:])
+    assert "formatted_prompt" in events
+    assert isinstance(events["token_ids"], list) and events["token_ids"]
+    timing = events["timing"]
+    assert timing["output_tokens"] == 3
+    assert timing["total_ms"] > 0
+    assert timing["ttft_ms"] is None or timing["ttft_ms"] <= timing["total_ms"]
+
+    # unary with annotations: response aggregates cleanly, no event leakage
+    async def unary():
+        body = {
+            "model": "tiny",
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 2,
+            "temperature": 0.0,
+            "ext": {"annotations": ["timing"], "ignore_eos": True},
+        }
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{url}/v1/chat/completions", json=body) as resp:
+                return resp.status, await resp.json()
+
+    status, out = loop.run_until_complete(unary())
+    assert status == 200
+    assert out["object"] == "chat.completion"
+    assert out["id"] is not None
