@@ -411,14 +411,7 @@ class DeepseekModel:
 
         c = self.config
         dc = c.kv_lora_rank
-        scale = 1.0 / jnp.sqrt(jnp.float32(c.qk_nope_head_dim + c.qk_rope_head_dim))
-        q_eff = jnp.einsum(
-            "bhn,chn->bhc", q_nope.astype(jnp.float32), lp["w_kb"].astype(jnp.float32)
-        )
-        q_cat = jnp.concatenate([q_eff, q_rope.astype(jnp.float32)], axis=-1) * scale
-        pad = c.latent_dim_padded - c.latent_dim
-        if pad:
-            q_cat = jnp.pad(q_cat, ((0, 0), (0, 0), (0, pad)))
+        q_cat = self._fold_q(lp, q_nope, q_rope)
         import functools
 
         kernel = functools.partial(
@@ -430,17 +423,11 @@ class DeepseekModel:
             # GSPMD cannot partition a pallas_call: run per-head-shard under
             # shard_map (attention is head-parallel; the latent pool and page
             # tables are replicated)
-            try:
-                from jax import shard_map as _sm
+            from dynamo_tpu.ops.attention import _tp_shard_map
 
-                sm = functools.partial(_sm, check_vma=False)
-            except ImportError:
-                from jax.experimental.shard_map import shard_map as _sm_old
-
-                sm = functools.partial(_sm_old, check_rep=False)
-            a_lat = sm(
+            a_lat = _tp_shard_map(
                 kernel,
-                mesh=mesh,
+                mesh,
                 in_specs=(P(None, "tp", None), P(None, None, None), P(None, None), P(None)),
                 out_specs=P(None, "tp", None),
             )(q_cat, pool, page_tables, positions)
@@ -448,6 +435,59 @@ class DeepseekModel:
             a_lat = kernel(q_cat, pool, page_tables, positions)
         out = jnp.einsum(
             "bhc,chv->bhv", a_lat.astype(jnp.float32), lp["w_vb"].astype(jnp.float32)
+        )
+        return out.astype(c.dtype).reshape(out.shape[0], -1)
+
+    def _fold_q(self, lp, q_nope, q_rope):
+        """(q_nope, q_rope) -> pre-scaled q_cat [.., H, latent_padded] for the
+        latent-space kernels (the MXU-shaped fold through w_kb stays outside
+        the pallas_call)."""
+        c = self.config
+        scale = 1.0 / jnp.sqrt(jnp.float32(c.qk_nope_head_dim + c.qk_rope_head_dim))
+        q_eff = jnp.einsum(
+            "...hn,chn->...hc", q_nope.astype(jnp.float32), lp["w_kb"].astype(jnp.float32)
+        )
+        q_cat = jnp.concatenate([q_eff, q_rope.astype(jnp.float32)], axis=-1) * scale
+        pad = c.latent_dim_padded - c.latent_dim
+        if pad:
+            widths = [(0, 0)] * (q_cat.ndim - 1) + [(0, pad)]
+            q_cat = jnp.pad(q_cat, widths)
+        return q_cat
+
+    def _mla_prefill_pallas(
+        self, lp, q_nope, q_rope, pool, page_table, positions
+    ) -> jnp.ndarray:
+        """Chunked-prefill attention via the latent flash kernel; the v-up
+        fold happens outside. Returns [T, H*dv]."""
+        from dynamo_tpu.ops.attention import _on_tpu
+        from dynamo_tpu.ops.pallas.mla_attention import (
+            paged_mla_prefill_attention_pallas,
+        )
+
+        c = self.config
+        q_cat = self._fold_q(lp, q_nope, q_rope)
+        import functools
+
+        kernel = functools.partial(
+            paged_mla_prefill_attention_pallas,
+            d_c=c.kv_lora_rank,
+            interpret=not _on_tpu(),
+        )
+        mesh = self.attn_mesh
+        tp = 1 if mesh is None else mesh.shape.get("tp", 1)
+        if tp > 1 and q_cat.shape[1] % tp == 0:
+            from dynamo_tpu.ops.attention import _tp_shard_map
+
+            a_lat = _tp_shard_map(
+                kernel,
+                mesh,
+                in_specs=(P(None, "tp", None), P(None, None, None), P(None), P(None)),
+                out_specs=P(None, "tp", None),
+            )(q_cat, pool, page_table, positions)
+        else:
+            a_lat = kernel(q_cat, pool, page_table, positions)
+        out = jnp.einsum(
+            "thc,chv->thv", a_lat.astype(jnp.float32), lp["w_vb"].astype(jnp.float32)
         )
         return out.astype(c.dtype).reshape(out.shape[0], -1)
 
@@ -470,9 +510,16 @@ class DeepseekModel:
         pool = pool.at[flat_phys, offsets].set(rows)
 
         if gather_tables.ndim == 1:
-            ps = pool.shape[1]
-            ctx = pool[gather_tables].reshape(gather_tables.shape[0] * ps, c.latent_dim_padded)
-            attn = self._absorbed_attention(lp, q_nope, q_rope, ctx, positions)
+            if _use_pallas_mla() and T % 128 == 0:
+                attn = self._mla_prefill_pallas(
+                    lp, q_nope, q_rope, pool, gather_tables, positions
+                )
+            else:
+                ps = pool.shape[1]
+                ctx = pool[gather_tables].reshape(
+                    gather_tables.shape[0] * ps, c.latent_dim_padded
+                )
+                attn = self._absorbed_attention(lp, q_nope, q_rope, ctx, positions)
         elif _use_pallas_mla():
             attn = self._mla_decode_pallas(lp, q_nope, q_rope, pool, gather_tables, positions)
         else:
