@@ -115,12 +115,17 @@ class DisaggDecodeEngine:
         except Exception:
             queue_depth = 0
 
-        # multimodal and logprobs prompts prefill locally: the remote-prefill
-        # wire protocol carries token ids only (no pixel data, no first-token
-        # logprobs — a remote first token would leave the logprobs array
-        # misaligned by one entry)
-        if request.images or request.logprobs is not None or not self.router.prefill_remote(
-            len(prompt), prefix_hit, queue_depth
+        # multimodal, logprobs, penalty, and seeded prompts prefill locally:
+        # the remote-prefill wire protocol carries token ids only (no pixel
+        # data, no first-token logprobs) and the remote engine has no access
+        # to this worker's per-slot penalty state or seed stream
+        if (
+            request.images
+            or request.logprobs is not None
+            or request.sampling.needs_penalties
+            or request.sampling.seed
+            or request.sampling.min_p > 0  # remote wire carries no min_p
+            or not self.router.prefill_remote(len(prompt), prefix_hit, queue_depth)
         ):
             self.local_prefills += 1
             async for out in self.engine.generate(request):

@@ -8,8 +8,9 @@ TPU execution notes:
   - the KV cache is donated on every step — XLA aliases it in place
   - sampling is fused into the step so only the sampled token ids (a few bytes)
     cross back to host per step
-  - the last sampled token per slot lives in a donated device buffer
-    (``tokens_dev``): a sampling prefill writes its slot's first token there,
+  - the last sampled token per slot lives in a donated device state bundle
+    (``slot_state``, with the penalty counters): a sampling prefill writes
+    its slot's first token there,
     and decode windows read/update it on device. The host therefore never has
     to sync on a window's results before dispatching the next one — the
     scheduler runs windows dispatch-ahead and reconciles token results as they
@@ -28,7 +29,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dynamo_tpu.engine.config import EngineConfig
-from dynamo_tpu.engine.sampling import sample_tokens, sample_tokens_with_logprobs
+from dynamo_tpu.engine.sampling import MAX_EOS_IDS, apply_penalties, fold_seed, sample_tokens, sample_tokens_with_logprobs
 from dynamo_tpu.utils import get_logger
 
 log = get_logger("engine.runner")
@@ -117,11 +118,19 @@ class ModelRunner:
         )
         self._replicated = NamedSharding(mesh, P())
         self._key = jax.random.key(0)
-        # device-resident last-token-per-slot feedback buffer
-        self.tokens_dev = jnp.zeros(config.max_seqs, jnp.int32)
+        # device-resident per-slot state, donated through every step:
+        #   tokens — last sampled token (the decode feedback loop)
+        #   counts — output-token occurrence counts (frequency/presence)
+        #   seen   — token appeared in prompt or output (repetition)
+        # counts/seen ([max_seqs, V] — up to tens of MB for large vocabs) are
+        # allocated lazily on the first penalty-enabled request; until then the
+        # bundle is just the token feedback buffer and penalty-free traffic
+        # never pays the HBM or donation traffic.
+        self.slot_state = {"tokens": jnp.zeros(config.max_seqs, jnp.int32)}
 
         self._prefill = jax.jit(
-            self._prefill_impl, donate_argnums=(1, 2), static_argnames=("want_lp",)
+            self._prefill_impl, donate_argnums=(1, 2),
+            static_argnames=("want_lp", "want_pen", "want_seed", "want_eos_mask"),
         )
         # multimodal vision encode (compiled lazily; text-only models never
         # pay for it — the mm prefill variant is _prefill traced with embeds)
@@ -133,15 +142,29 @@ class ModelRunner:
         if config.sp > 1:
             # sequence-parallel whole-prompt prefill (ring attention over sp)
             self._prefill_sp = jax.jit(
-                self._prefill_sp_impl, donate_argnums=(1, 2), static_argnames=("want_lp",)
+                self._prefill_sp_impl, donate_argnums=(1, 2),
+                static_argnames=("want_lp", "want_pen", "want_seed", "want_eos_mask"),
             )
         self._decode_window = jax.jit(
-            self._decode_window_impl, donate_argnums=(1, 2), static_argnums=(6, 7)
+            self._decode_window_impl, donate_argnums=(1, 2),
+            static_argnames=("num_steps", "want_lp", "want_pen", "want_seed", "want_eos_mask"),
         )
-        self._write_tokens = jax.jit(
-            lambda td, idx, vals: td.at[idx].set(vals, mode="drop"),
-            donate_argnums=(0,),
-        )
+        def _write_tokens_impl(st, idx, vals):
+            return dict(st, tokens=st["tokens"].at[idx].set(vals, mode="drop"))
+
+        self._write_tokens = jax.jit(_write_tokens_impl, donate_argnums=(0,))
+
+        def _seed_pen_impl2(st, slot, prompt_ids, output_ids):
+            # reset the slot's penalty state, mark prompt+output tokens seen,
+            # and restore output occurrence counts (preemption resume); both
+            # id arrays are bucket-padded with V (dropped by the OOB scatter)
+            counts = st["counts"].at[slot].set(0)
+            counts = counts.at[slot, output_ids].add(1, mode="drop")
+            seen = st["seen"].at[slot].set(False)
+            seen = seen.at[slot, prompt_ids].set(True, mode="drop")
+            return dict(st, counts=counts, seen=seen)
+
+        self._seed_pen = jax.jit(_seed_pen_impl2, donate_argnums=(0,))
         # block-granularity KV IO for disaggregation / offload
         # (the NIXL-slot replacement, reference: patch nixl.py register_kv_caches).
         # The model defines its canonical wire layout (llama: [L,2,n,ps,Hkv,D];
@@ -189,83 +212,115 @@ class ModelRunner:
             params, kv, tokens, positions, page_tables, active, rope_deltas=rope_deltas
         )
 
-    def _prefill_impl(self, params, kv, tokens_dev, ints, flts, key, embeds=None, emask=None, rope_pos=None, want_lp=False):
-        """ints [bucket + max_pages + 4] = token buf, page table, then
-        (start_pos, n_real, top_k, slot); flts [2] = (temperature, top_p).
-        Positions and the valid mask derive on device — one packed H2D per
-        chunk. The sampled token is written into ``tokens_dev[slot]`` (slot >=
-        max_seqs drops the write) so a following decode window can consume it
-        without any host round trip.
+    def _prefill_impl(self, params, kv, slot_state, ints, flts, key, embeds=None, emask=None, rope_pos=None, want_lp=False, want_pen=False, want_seed=False, want_eos_mask=False):
+        """ints [bucket + max_pages + 5 + MAX_EOS_IDS] = token buf, page
+        table, (start_pos, n_real, top_k, slot, seed), then the request's EOS
+        ids (V-padded); flts [6] = (temperature, top_p, min_p, presence,
+        frequency, repetition). Positions and the valid mask derive on device
+        — one packed H2D per chunk. The sampled token is written into
+        ``slot_state["tokens"][slot]`` (slot >= max_seqs drops the write) so a
+        following decode window can consume it without any host round trip.
 
-        Multimodal chunks pass ``embeds`` [bucket, D] + ``emask`` [bucket]
-        (a second trace of this same jit): vision-tower outputs replace the
-        masked tokens' embeddings."""
+        Multimodal chunks pass ``embeds`` [bucket, D] + ``emask`` [bucket];
+        want_lp/want_pen/want_seed/want_eos_mask gate logprobs, penalties,
+        seeded streams, and min_tokens EOS suppression out of the default
+        trace."""
         mp = self.config.max_pages_per_seq
-        bucket = ints.shape[0] - mp - 4
+        bucket = ints.shape[0] - mp - 5 - MAX_EOS_IDS
         tokens = ints[:bucket]
         page_table = ints[bucket : bucket + mp]
         start_pos = ints[bucket + mp]
         n = ints[bucket + mp + 1]
         top_k = ints[bucket + mp + 2]
         slot = ints[bucket + mp + 3]
+        seed = ints[bucket + mp + 4]
+        eos_ids = ints[bucket + mp + 5 :]
         positions = start_pos + jnp.arange(bucket, dtype=jnp.int32)
         valid = jnp.arange(bucket) < n
         logits, kv = self._model_prefill(
             params, kv, tokens, positions, page_table, valid, n - 1,
             embeds=embeds, emask=emask, rope_pos=rope_pos,
         )
+        tok, lp, slot_state = self._sample_one(
+            logits, key, flts, top_k, slot, seed, start_pos + n - 1, slot_state,
+            want_lp, want_pen, want_seed,
+            eos_ids=eos_ids if want_eos_mask else None,
+        )
+        return tok, lp, kv, slot_state
+
+    def _sample_one(self, logits, key, flts, top_k, slot, seed, sample_pos,
+                    slot_state, want_lp, want_pen, want_seed, eos_ids=None):
+        """Shared prefill-side sampling tail: penalties (against the slot's
+        state), logprobs, seeded streams, token feedback write. ``eos_ids``
+        (min_tokens requests): the first sampled token is generation #1, so
+        EOS logits are suppressed outright here."""
+        if eos_ids is not None:
+            logits = logits.at[eos_ids].add(jnp.float32(-1e30), mode="drop")
+        logits_b = logits[None, :]
+        if want_pen:
+            counts = slot_state["counts"][slot][None]
+            seen = slot_state["seen"][slot][None]
+            logits_b = apply_penalties(
+                logits_b, counts, seen, flts[3:4], flts[4:5], flts[5:6]
+            )
+        kwargs = {}
+        if want_seed:
+            kwargs = dict(seeds=seed[None], positions=sample_pos[None])
         if want_lp:
             toks, chosen, tids, tvals = sample_tokens_with_logprobs(
-                logits[None, :], key, flts[:1], top_k[None], flts[1:]
+                logits_b, key, flts[:1], top_k[None], flts[1:2], min_p=flts[2:3], **kwargs
             )
             lp = (chosen[0], tids[0], tvals[0])
         else:
-            # same gating as the decode window: no full-vocab log_softmax or
-            # top_k in the trace unless the request asked for logprobs
-            toks = sample_tokens(logits[None, :], key, flts[:1], top_k[None], flts[1:])
+            toks = sample_tokens(
+                logits_b, key, flts[:1], top_k[None], flts[1:2], min_p=flts[2:3], **kwargs
+            )
             lp = None
         tok = toks[0]
-        tokens_dev = tokens_dev.at[slot].set(tok, mode="drop")
-        return tok, lp, kv, tokens_dev
+        tokens = slot_state["tokens"].at[slot].set(tok, mode="drop")
+        slot_state = dict(slot_state, tokens=tokens)
+        if want_pen:
+            counts = slot_state["counts"].at[slot, tok].add(1, mode="drop")
+            seen = slot_state["seen"].at[slot, tok].set(True, mode="drop")
+            slot_state = dict(slot_state, counts=counts, seen=seen)
+        return tok, lp, slot_state
 
-    def _prefill_sp_impl(self, params, kv, tokens_dev, ints, flts, key, want_lp=False):
+    def _prefill_sp_impl(self, params, kv, slot_state, ints, flts, key, want_lp=False, want_pen=False, want_seed=False, want_eos_mask=False):
         """Same packed-ints contract as _prefill_impl, but the whole-prompt
         chunk runs sequence-parallel (model.prefill_sp: ring attention over
         the sp mesh axis). Only called with start_pos == 0."""
         mp = self.config.max_pages_per_seq
-        bucket = ints.shape[0] - mp - 4
+        bucket = ints.shape[0] - mp - 5 - MAX_EOS_IDS
         tokens = ints[:bucket]
         page_table = ints[bucket : bucket + mp]
         n = ints[bucket + mp + 1]
         top_k = ints[bucket + mp + 2]
         slot = ints[bucket + mp + 3]
+        seed = ints[bucket + mp + 4]
+        eos_ids = ints[bucket + mp + 5 :]
         positions = jnp.arange(bucket, dtype=jnp.int32)
         valid = positions < n
         logits, kv = self.model.prefill_sp(
             params, kv, tokens, positions, page_table, valid, n - 1, mesh=self.mesh
         )
-        if want_lp:
-            toks, chosen, tids, tvals = sample_tokens_with_logprobs(
-                logits[None, :], key, flts[:1], top_k[None], flts[1:]
-            )
-            lp = (chosen[0], tids[0], tvals[0])
-        else:
-            toks = sample_tokens(logits[None, :], key, flts[:1], top_k[None], flts[1:])
-            lp = None
-        tok = toks[0]
-        tokens_dev = tokens_dev.at[slot].set(tok, mode="drop")
-        return tok, lp, kv, tokens_dev
+        tok, lp, slot_state = self._sample_one(
+            logits, key, flts, top_k, slot, seed, n - 1, slot_state,
+            want_lp, want_pen, want_seed,
+            eos_ids=eos_ids if want_eos_mask else None,
+        )
+        return tok, lp, kv, slot_state
 
-    def _decode_window_impl(self, params, kv, tokens_dev, ints, flts, key, num_steps, want_lp=False):
+    def _decode_window_impl(self, params, kv, slot_state, ints, flts, key, num_steps=1, want_lp=False, want_pen=False, want_seed=False, want_eos_mask=False):
         """num_steps fused decode steps; the sampled-token feedback loop starts
-        from the device-resident ``tokens_dev`` buffer, so the host can
+        from the device-resident ``slot_state["tokens"]`` buffer, so the host can
         dispatch windows back-to-back without reading any results in between.
 
         All small per-slot inputs ride in two packed arrays (one H2D transfer
         each — per-call transfer latency dominates on tunneled platforms):
-        ``ints`` [5 + max_pages, B] = positions, limits, active, top_ks,
-        rope_deltas, then the transposed page tables; ``flts`` [2, B] =
-        temps, top_ps. Page
+        ``ints`` [7 + MAX_EOS_IDS + max_pages, B] = positions, limits, active,
+        top_ks, rope_deltas, seeds, eos_allowed_from, the per-slot EOS id rows
+        (V-padded), then the transposed page tables; ``flts`` [6, B] = temps,
+        top_ps, min_ps, presence, frequency, repetition. Page
         tables are static across the window — the host pre-allocates pages to
         cover positions + num_steps - 1 before calling, and a sequence freezes
         once its fed position would pass ``limits`` (no writes past its
@@ -274,38 +329,61 @@ class ModelRunner:
         active = ints[2].astype(bool)
         top_ks = ints[3]
         rope_deltas = ints[4]  # M-RoPE per-slot offsets (zeros for text models)
-        page_tables = ints[5:].T  # [B, max_pages]
-        temps, top_ps = flts[0], flts[1]
+        seeds = ints[5]  # per-request sampling seeds (0 = unseeded)
+        eos_allowed_from = ints[6]  # fed position where EOS unblocks (min_tokens)
+        eos_ids = ints[7 : 7 + MAX_EOS_IDS].T  # [B, MAX_EOS_IDS], V-padded
+        page_tables = ints[7 + MAX_EOS_IDS :].T  # [B, max_pages]
+        temps, top_ps, min_ps = flts[0], flts[1], flts[2]
+        pres, freq, reps = flts[3], flts[4], flts[5]
         keys = jax.random.split(key, num_steps)
 
         def body(carry, k):
-            kv, tokens, positions, act = carry
+            kv, st, positions, act = carry
             logits, kv = self._model_decode(
-                params, kv, tokens, positions, page_tables, act,
+                params, kv, st["tokens"], positions, page_tables, act,
                 rope_deltas=rope_deltas if getattr(self.model.config, "mrope_section", None) is not None else None,
             )
+            if want_pen:
+                logits = apply_penalties(logits, st["counts"], st["seen"], pres, freq, reps)
+            if want_eos_mask:
+                # min_tokens: ban the slot's EOS ids until its fed position
+                # reaches eos_allowed_from
+                rows = jnp.arange(logits.shape[0])[:, None]
+                pen = jnp.where(positions >= eos_allowed_from, 0.0, -1e30)
+                logits = logits.at[rows, eos_ids].add(pen[:, None], mode="drop")
+            kwargs = dict(min_p=min_ps)
+            if want_seed:
+                kwargs.update(seeds=seeds, positions=positions)
             if want_lp:
                 toks, chosen, tids, tvals = sample_tokens_with_logprobs(
-                    logits, k, temps, top_ks, top_ps
+                    logits, k, temps, top_ks, top_ps, **kwargs
                 )
                 ys = (toks, chosen, tids, tvals)
             else:
                 # logprobs gated out of the trace: no full-vocab log_softmax or
                 # top_k rides the hot path unless some request asked for them
-                toks = sample_tokens(logits, k, temps, top_ks, top_ps)
+                toks = sample_tokens(logits, k, temps, top_ks, top_ps, **kwargs)
                 ys = (toks,)
-            tokens = jnp.where(act, toks, tokens)
+            tokens = jnp.where(act, toks, st["tokens"])
+            st = dict(st, tokens=tokens)
+            if want_pen:
+                rows = jnp.arange(tokens.shape[0])
+                counts = st["counts"].at[rows, toks].add(act.astype(jnp.int32))
+                # keep `seen` exact: only rows that actually emitted this step
+                seen_tok = st["seen"].at[rows, toks].get() | act
+                seen = st["seen"].at[rows, toks].set(seen_tok)
+                st = dict(st, counts=counts, seen=seen)
             positions = positions + act.astype(positions.dtype)
             act = act & (positions <= limits)
-            return (kv, tokens, positions, act), ys
+            return (kv, st, positions, act), ys
 
-        (kv, tokens, _, _), ys = jax.lax.scan(
-            body, (kv, tokens_dev, positions, active), keys
+        (kv, slot_state, _, _), ys = jax.lax.scan(
+            body, (kv, slot_state, positions, active), keys
         )
         all_toks = ys[0]
         lp = (ys[1], ys[2], ys[3]) if want_lp else None
         # [num_steps, B] tokens (+ ([num_steps, B], [num_steps, B, K] x2) lp)
-        return all_toks, lp, kv, tokens
+        return all_toks, lp, kv, slot_state
 
     # ---------------- host API (engine thread) ----------------
 
@@ -328,26 +406,58 @@ class ModelRunner:
         embeds_mask: Optional[np.ndarray] = None,  # [n] bool
         rope_pos: Optional[np.ndarray] = None,  # [n, 3] M-RoPE positions
         want_logprobs: bool = False,  # sync=False only: also return lp arrays
+        sampling=None,  # SamplingParams: penalties / min_p / seed (optional)
+        eos_ids=None,  # request EOS ids (min_tokens device-side suppression)
     ):
         """Run one prefill chunk.
 
         When ``sample``: returns the sampled next token — as a host int when
         ``sync``, else as a device scalar (dispatch-ahead mode; an async
         device-to-host copy is already in flight). When ``slot >= 0`` the token
-        is also written into ``tokens_dev[slot]`` on device so decode windows
+        is also written into ``slot_state["tokens"][slot]`` on device so decode windows
         can start without waiting for the host to see it."""
         n = len(tokens)
         bucket = self.config.bucket_for(n)
         mp = self.config.max_pages_per_seq
-        ints = np.zeros(bucket + mp + 4, np.int32)
+        V = self.model.config.vocab_size
+        ints = np.full(bucket + mp + 5 + MAX_EOS_IDS, V, np.int32)  # tail = eos pad
+        ints[:bucket] = 0
         ints[:n] = tokens
         ints[bucket : bucket + mp] = page_table[:mp]
         ints[bucket + mp] = start_pos
         ints[bucket + mp + 1] = n
         ints[bucket + mp + 2] = top_k
-        # out-of-bounds slot => scatter mode="drop" skips the tokens_dev write
+        # out-of-bounds slot => scatter mode="drop" skips the token write
         ints[bucket + mp + 3] = slot if (sample and slot >= 0) else self.config.max_seqs
-        flts = np.array([temperature, top_p], np.float32)
+        ints[bucket + mp + 4] = fold_seed(sampling.seed) if sampling is not None else 0
+        want_pen = sampling is not None and sampling.needs_penalties
+        want_seed = sampling is not None and bool(sampling.seed)
+        # min_tokens: the first sampled token must not be EOS -> suppress the
+        # request's EOS logits on device
+        want_eos = bool(
+            sample
+            and eos_ids is not None
+            and len(eos_ids) > 0
+            and sampling is not None
+            and sampling.min_tokens > 1
+            and not sampling.ignore_eos
+        )
+        if want_eos:
+            ids = np.asarray(eos_ids, np.int32)[:MAX_EOS_IDS]
+            ints[bucket + mp + 5 : bucket + mp + 5 + len(ids)] = ids
+        if want_pen:
+            self._ensure_penalty_state()
+        flts = np.array(
+            [
+                temperature,
+                top_p,
+                sampling.min_p if sampling is not None else 0.0,
+                sampling.presence_penalty if sampling is not None else 0.0,
+                sampling.frequency_penalty if sampling is not None else 0.0,
+                sampling.repetition_penalty if sampling is not None else 1.0,
+            ],
+            np.float32,
+        )
         mm_args = ()
         if embeds is not None or rope_pos is not None:
             # multimodal chunk: embeds/rope-override trace of _prefill (paged
@@ -376,16 +486,19 @@ class ModelRunner:
             and bucket % self.config.sp == 0
         )
         prefill_fn = self._prefill_sp if use_sp else self._prefill
-        tok, lp, self.kv_cache, self.tokens_dev = prefill_fn(
+        tok, lp, self.kv_cache, self.slot_state = prefill_fn(
             self.params,
             self.kv_cache,
-            self.tokens_dev,
+            self.slot_state,
             jnp.asarray(ints),
             jnp.asarray(flts),
             self._next_key(),
             *mm_args,
-            # only the sampling (final) chunk's logprobs are ever consumed
+            # only the sampling (final) chunk's outputs are ever consumed
             want_lp=want_logprobs and sample,
+            want_pen=want_pen and sample,
+            want_seed=want_seed and sample,
+            want_eos_mask=want_eos,
         )
         if not sample:
             return None
@@ -433,9 +546,47 @@ class ModelRunner:
         return out
 
     def write_token_slots(self, slots: np.ndarray, tokens: np.ndarray) -> None:
-        """Host-known tokens (e.g. disagg adoption) -> tokens_dev[slots]."""
-        self.tokens_dev = self._write_tokens(
-            self.tokens_dev, jnp.asarray(slots, jnp.int32), jnp.asarray(tokens, jnp.int32)
+        """Host-known tokens (e.g. disagg adoption) -> slot token feedback."""
+        self.slot_state = self._write_tokens(
+            self.slot_state, jnp.asarray(slots, jnp.int32), jnp.asarray(tokens, jnp.int32)
+        )
+
+    def _ensure_penalty_state(self) -> None:
+        if "counts" not in self.slot_state:
+            V = self.model.config.vocab_size
+            B = self.config.max_seqs
+            self.slot_state = dict(
+                self.slot_state,
+                counts=jnp.zeros((B, V), jnp.int32),
+                seen=jnp.zeros((B, V), bool),
+            )
+
+    def _pad_ids_bucket(self, ids: np.ndarray) -> np.ndarray:
+        """Pad an id list to a prefill bucket with V (OOB -> scatter-dropped)
+        so _seed_pen compiles once per bucket, not per prompt length."""
+        V = self.model.config.vocab_size
+        n = len(ids)
+        size = next(
+            (b for b in self.config.prefill_buckets if b >= n),
+            max(self.config.max_model_len, n),
+        )
+        out = np.full(size, V, np.int32)
+        out[:n] = ids
+        return out
+
+    def seed_penalty_slot(self, slot: int, token_ids, output_from: int | None = None) -> None:
+        """Reset a slot's penalty state: mark all of ``token_ids`` seen; count
+        the tail from ``output_from`` as output occurrences (a preempted
+        request's prompt embeds its prior output — restoring the counts keeps
+        presence/frequency penalties continuous across preemption)."""
+        self._ensure_penalty_state()
+        ids = np.asarray(token_ids, np.int32)
+        out_ids = ids[output_from:] if output_from is not None else ids[:0]
+        self.slot_state = self._seed_pen(
+            self.slot_state,
+            jnp.asarray(slot, jnp.int32),
+            jnp.asarray(self._pad_ids_bucket(ids)),
+            jnp.asarray(self._pad_ids_bucket(out_ids)),
         )
 
     def dispatch_decode_window(
@@ -450,6 +601,11 @@ class ModelRunner:
         num_steps: int,
         want_logprobs: bool = False,
         rope_deltas: np.ndarray | None = None,  # [B] M-RoPE offsets
+        min_ps: np.ndarray | None = None,  # [B]
+        penalties: np.ndarray | None = None,  # [3, B] presence/frequency/repetition
+        seeds: np.ndarray | None = None,  # [B] int32 (0 = unseeded)
+        eos_allowed_from: np.ndarray | None = None,  # [B] fed pos (min_tokens)
+        eos_ids: np.ndarray | None = None,  # [B, MAX_EOS_IDS] V-padded
     ):
         """Dispatch one fused decode window WITHOUT waiting for results.
 
@@ -457,23 +613,39 @@ class ModelRunner:
         device-to-host copy already started; the caller materializes it later
         (np.asarray) while further windows run on device."""
         B = positions.shape[0]
-        ints = np.empty((5 + page_tables.shape[1], B), np.int32)
+        V = self.model.config.vocab_size
+        ints = np.empty((7 + MAX_EOS_IDS + page_tables.shape[1], B), np.int32)
         ints[0] = positions
         ints[1] = limits
         ints[2] = active
         ints[3] = top_ks
         ints[4] = rope_deltas if rope_deltas is not None else 0
-        ints[5:] = page_tables.T
-        flts = np.stack([temps, top_ps]).astype(np.float32)
-        toks, lp, self.kv_cache, self.tokens_dev = self._decode_window(
+        ints[5] = seeds if seeds is not None else 0
+        ints[6] = eos_allowed_from if eos_allowed_from is not None else 0
+        ints[7 : 7 + MAX_EOS_IDS] = eos_ids.T if eos_ids is not None else V
+        ints[7 + MAX_EOS_IDS :] = page_tables.T
+        flts = np.empty((6, B), np.float32)
+        flts[0] = temps
+        flts[1] = top_ps
+        flts[2] = min_ps if min_ps is not None else 0.0
+        flts[3:6] = penalties if penalties is not None else np.array([[0.0], [0.0], [1.0]])
+        want_pen = penalties is not None
+        want_seed = seeds is not None and bool(np.any(seeds))
+        want_eos = eos_ids is not None
+        if want_pen:
+            self._ensure_penalty_state()
+        toks, lp, self.kv_cache, self.slot_state = self._decode_window(
             self.params,
             self.kv_cache,
-            self.tokens_dev,
+            self.slot_state,
             jnp.asarray(ints),
             jnp.asarray(flts),
             self._next_key(),
-            num_steps,
-            want_logprobs,
+            num_steps=num_steps,
+            want_lp=want_logprobs,
+            want_pen=want_pen,
+            want_seed=want_seed,
+            want_eos_mask=want_eos,
         )
         try:
             toks.copy_to_host_async()
@@ -526,7 +698,7 @@ class ModelRunner:
         num_steps: int,
     ) -> np.ndarray:
         """Synchronous fused multi-step decode with host-provided feed tokens:
-        seeds tokens_dev, runs one window, returns [num_steps, B] tokens.
+        seeds the token feedback, runs one window, returns [num_steps, B] tokens.
 
         Accepts any B <= max_seqs; inputs are padded to the max_seqs batch the
         window executable is compiled for (extra slots inactive)."""

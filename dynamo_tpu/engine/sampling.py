@@ -14,6 +14,17 @@ import jax.numpy as jnp
 
 _NEG_INF = -1e30
 
+MAX_EOS_IDS = 8  # per-slot EOS ids carried on device for min_tokens masking
+
+
+def fold_seed(seed) -> int:
+    """Any user seed (64-bit, negative, ...) -> nonzero int31 device seed;
+    0 stays 0 (= unseeded). One folding used by prefill AND decode so a
+    request's stream is consistent across both."""
+    if not seed:
+        return 0
+    return (int(seed) % 0x7FFFFFFE) + 1
+
 
 @dataclass
 class SamplingParams:
@@ -23,10 +34,44 @@ class SamplingParams:
     temperature: float = 0.0  # 0 => greedy
     top_k: int = 0  # 0 => disabled
     top_p: float = 1.0  # 1.0 => disabled
+    min_p: float = 0.0  # 0 => disabled; keep tokens with p >= min_p * p_max
     max_tokens: int = 512
+    min_tokens: int = 0  # EOS suppressed until this many tokens generated
     stop: Sequence[str] = ()
-    seed: Optional[int] = None
+    seed: Optional[int] = None  # per-request deterministic sampling stream
     ignore_eos: bool = False
+    # vLLM-semantics penalties (the reference's engine behavior):
+    # presence/frequency over OUTPUT tokens, repetition over prompt + output
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    repetition_penalty: float = 1.0
+
+    @property
+    def needs_penalties(self) -> bool:
+        return (
+            self.presence_penalty != 0.0
+            or self.frequency_penalty != 0.0
+            or self.repetition_penalty != 1.0
+        )
+
+
+def apply_penalties(
+    logits: jnp.ndarray,  # [B, V] float32
+    counts: jnp.ndarray,  # [B, V] int32 output-token counts
+    seen: jnp.ndarray,  # [B, V] bool, token in prompt or output
+    presence: jnp.ndarray,  # [B]
+    frequency: jnp.ndarray,  # [B]
+    repetition: jnp.ndarray,  # [B] (1.0 = off)
+) -> jnp.ndarray:
+    """vLLM-semantics sampling penalties (what the reference's engines do):
+    presence/frequency subtract over output-token occurrences; repetition
+    divides positive / multiplies negative logits of any seen token."""
+    cf = counts.astype(jnp.float32)
+    logits = logits - frequency[:, None] * cf
+    logits = logits - presence[:, None] * (cf > 0)
+    rep = repetition[:, None]
+    penalized = jnp.where(logits > 0, logits / rep, logits * rep)
+    return jnp.where(seen, penalized, logits)
 
 
 def sample_tokens(
@@ -35,14 +80,20 @@ def sample_tokens(
     temperature: jnp.ndarray,  # [B]
     top_k: jnp.ndarray,  # [B] int32 (0 = off)
     top_p: jnp.ndarray,  # [B] (1.0 = off)
+    min_p: jnp.ndarray | None = None,  # [B] (0 = off)
+    seeds: jnp.ndarray | None = None,  # [B] int32, 0 = unseeded
+    positions: jnp.ndarray | None = None,  # [B] sampling-step index per slot
 ) -> jnp.ndarray:
-    """Sample one token per slot. Greedy where temperature <= 0."""
+    """Sample one token per slot. Greedy where temperature <= 0.
+
+    Seeded slots (seeds != 0) draw from a per-request stream keyed by
+    (seed, position) — deterministic across retries, preemption, and batch
+    composition. Unseeded slots share the engine's key stream."""
     B, V = logits.shape
     greedy = jnp.argmax(logits, axis=-1)
 
     # Sort once (descending); both top-k and top-p become rank/cdf thresholds.
     sorted_logits = -jnp.sort(-logits, axis=-1)  # [B, V] descending
-    ranks = jnp.arange(V, dtype=jnp.int32)
 
     # top-k: keep entries with logit >= k-th largest value
     k = jnp.where(top_k > 0, jnp.clip(top_k, 1, V), V)
@@ -60,8 +111,34 @@ def sample_tokens(
     p_value = jnp.take_along_axis(sorted_logits, (num_keep - 1)[:, None], axis=-1)
     keep_p = logits >= p_value
 
-    masked = jnp.where(keep_k & keep_p, logits, _NEG_INF)
-    sampled = jax.random.categorical(key, masked / temp)
+    keep = keep_k & keep_p
+    if min_p is not None:
+        # keep tokens whose (tempered) prob >= min_p * max prob: in logit
+        # space, logit/temp >= max/temp + log(min_p)
+        max_l = jnp.max(logits, axis=-1, keepdims=True)
+        thresh = max_l / temp + jnp.log(jnp.maximum(min_p, 1e-10))[:, None]
+        keep_m = (logits / temp) >= thresh
+        keep = keep & jnp.where(min_p[:, None] > 0, keep_m, True)
+
+    masked = jnp.where(keep, logits, _NEG_INF)
+    if seeds is None:
+        sampled = jax.random.categorical(key, masked / temp)
+    else:
+        # per-slot keys: seeded slots fold (seed, position) off a fixed base
+        # so their stream ignores batch placement; unseeded fold the slot
+        # index off the engine's window key
+        base = jax.random.key(0x5EED)
+        pos = positions if positions is not None else jnp.zeros(B, jnp.int32)
+
+        def slot_key(i, seed, p):
+            seeded = jax.random.fold_in(jax.random.fold_in(base, seed), p)
+            unseeded = jax.random.fold_in(key, i)
+            return jax.lax.cond(seed != 0, lambda: seeded, lambda: unseeded)
+
+        keys = jax.vmap(slot_key)(jnp.arange(B, dtype=jnp.int32), seeds, pos)
+        sampled = jax.vmap(
+            lambda k_, row: jax.random.categorical(k_, row)
+        )(keys, masked / temp)
     return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
 
 
@@ -74,15 +151,16 @@ def sample_tokens_with_logprobs(
     temperature: jnp.ndarray,
     top_k: jnp.ndarray,
     top_p: jnp.ndarray,
+    **kwargs,  # min_p / seeds / positions, forwarded to sample_tokens
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """sample_tokens + OpenAI-style logprobs of the model distribution.
 
     Returns (tokens [B], chosen_logprob [B], topk_ids [B, K], topk_logprobs
     [B, K]). Logprobs are log-softmax of the raw (untempered) logits — the
     model's distribution, matching the OpenAI API semantic; sampling itself
-    still applies temperature/top-k/top-p.
+    still applies temperature/top-k/top-p (and any forwarded filters).
     """
-    tokens = sample_tokens(logits, key, temperature, top_k, top_p)
+    tokens = sample_tokens(logits, key, temperature, top_k, top_p, **kwargs)
     logprobs = jax.nn.log_softmax(logits, axis=-1)
     chosen = jnp.take_along_axis(logprobs, tokens[:, None].astype(jnp.int32), -1)[:, 0]
     top_vals, top_ids = jax.lax.top_k(logprobs, LOGPROBS_K)

@@ -27,6 +27,7 @@ exactly without reading anything back.
 
 from __future__ import annotations
 
+import dataclasses
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional
@@ -35,7 +36,7 @@ import numpy as np
 
 from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.engine.page_table import PageAllocator
-from dynamo_tpu.engine.sampling import SamplingParams
+from dynamo_tpu.engine.sampling import MAX_EOS_IDS, SamplingParams, fold_seed
 from dynamo_tpu.utils import get_logger
 
 log = get_logger("engine.sched")
@@ -62,6 +63,10 @@ class EngineRequest:
     # positions for the prompt + the scalar decode-time offset
     mrope_pos: Optional[object] = None
     mrope_delta: int = 0
+    # preemption resume: token_ids[penalty_output_from:] were previously
+    # GENERATED (their occurrence counts restore at re-admission so
+    # presence/frequency penalties stay continuous)
+    penalty_output_from: Optional[int] = None
 
 
 @dataclass
@@ -320,6 +325,13 @@ class Scheduler:
             # skipped entirely when every image run sits inside the cached
             # prefix — a repeat request never re-runs the vision tower
             req.mm_embeds = self.runner.encode_images(req.images)
+        if req.sampling.needs_penalties and slot >= 0:
+            # reset + prompt-seed this slot's on-device penalty state before
+            # any sampling against it (restoring prior-output counts after a
+            # preemption)
+            self.runner.seed_penalty_slot(
+                slot, req.token_ids, output_from=req.penalty_output_from
+            )
         mcfg = getattr(self.runner.model.config, "mrope_section", None)
         if req.images and mcfg is not None and req.mrope_pos is None:
             from dynamo_tpu.llm.multimodal import mrope_positions
@@ -347,6 +359,8 @@ class Scheduler:
                 embeds_mask=embeds_mask,
                 rope_pos=rope_pos,
                 want_logprobs=want_logprobs and not sync,
+                sampling=s,
+                eos_ids=() if s.ignore_eos else req.eos_token_ids,
             )
             if is_last:
                 first_token = tok
@@ -460,6 +474,12 @@ class Scheduler:
         top_ks = np.zeros(B, np.int32)
         top_ps = np.ones(B, np.float32)
         rope_deltas = np.zeros(B, np.int32)
+        min_ps = np.zeros(B, np.float32)
+        penalties = np.tile(np.array([[0.0], [0.0], [1.0]], np.float32), (1, B))
+        seeds = np.zeros(B, np.int32)
+        eos_allowed_from = np.zeros(B, np.int32)
+        eos_rows = np.full((B, MAX_EOS_IDS), self.runner.model.config.vocab_size, np.int32)
+        any_eos_mask = False
 
         snapshot = []
         for seq, steps in participants:
@@ -472,13 +492,30 @@ class Scheduler:
             top_ks[i] = seq.req.sampling.top_k
             top_ps[i] = seq.req.sampling.top_p
             rope_deltas[i] = seq.req.mrope_delta
+            min_ps[i] = seq.req.sampling.min_p
+            penalties[0, i] = seq.req.sampling.presence_penalty
+            penalties[1, i] = seq.req.sampling.frequency_penalty
+            penalties[2, i] = seq.req.sampling.repetition_penalty
+            seeds[i] = fold_seed(seq.req.sampling.seed)
+            sam = seq.req.sampling
+            if sam.min_tokens > 0 and seq.req.eos_token_ids and not sam.ignore_eos:
+                # EOS allowed from the fed position of generation #min_tokens
+                eos_allowed_from[i] = seq.prompt_len + sam.min_tokens - 1
+                ids = np.asarray(seq.req.eos_token_ids[:MAX_EOS_IDS], np.int32)
+                eos_rows[i, : len(ids)] = ids
+                any_eos_mask = True
             snapshot.append((seq, i, steps))
             seq.sched_len += steps
 
         want_lp = any(seq.req.logprobs is not None for seq, _ in participants)
+        want_pen = any(seq.req.sampling.needs_penalties for seq, _ in participants)
         result = self.runner.dispatch_decode_window(
             positions, page_tables, active, limits, temps, top_ks, top_ps, K,
-            want_logprobs=want_lp, rope_deltas=rope_deltas,
+            want_logprobs=want_lp, rope_deltas=rope_deltas, min_ps=min_ps,
+            penalties=penalties if want_pen else None,
+            seeds=seeds if np.any(seeds) else None,
+            eos_allowed_from=eos_allowed_from if any_eos_mask else None,
+            eos_ids=eos_rows if any_eos_mask else None,
         )
         toks_dev, lp = result if want_lp else (result, None)
         self.in_flight.append(_InFlight(kind="window", dev=toks_dev, seqs=snapshot, lp=lp))
@@ -536,7 +573,12 @@ class Scheduler:
         seq.sched_len = max(seq.sched_len, len(seq.generated))
         self.allocator.append_token(req.request_id, token)
         finish: Optional[str] = None
-        if (not req.sampling.ignore_eos) and req.eos_token_ids and token in req.eos_token_ids:
+        if (
+            (not req.sampling.ignore_eos)
+            and req.eos_token_ids
+            and token in req.eos_token_ids
+            and len(seq.generated) >= max(1, req.sampling.min_tokens)
+        ):
             finish = "stop"
         elif len(seq.generated) >= req.sampling.max_tokens:
             finish = "length"
@@ -592,16 +634,21 @@ class Scheduler:
             images=seq.req.images,
             mm_embeds=seq.req.mm_embeds,  # offsets are prompt-relative: still valid
             logprobs=seq.req.logprobs,
+            # prior output starts where the ORIGINAL prompt ended (earlier
+            # preemptions included: the original split carries forward)
+            penalty_output_from=(
+                seq.req.penalty_output_from
+                if seq.req.penalty_output_from is not None
+                else seq.prompt_len
+            ),
             # mrope_pos covers the OLD prompt length only: left None so it is
             # recomputed over prompt+generated at re-admission (delta included)
-            sampling=SamplingParams(
-                temperature=seq.req.sampling.temperature,
-                top_k=seq.req.sampling.top_k,
-                top_p=seq.req.sampling.top_p,
-                # already-generated tokens count against max_tokens on resume
+            # already-generated tokens count against max_tokens on resume;
+            # every other sampling field (penalties, seed, min_p, ...) carries
+            sampling=dataclasses.replace(
+                seq.req.sampling,
                 max_tokens=max(1, seq.req.sampling.max_tokens - len(seq.generated)),
-                stop=seq.req.sampling.stop,
-                ignore_eos=seq.req.sampling.ignore_eos,
+                min_tokens=max(0, seq.req.sampling.min_tokens - len(seq.generated)),
             ),
             eos_token_ids=seq.req.eos_token_ids,
         )

@@ -48,15 +48,22 @@ class RemoteEngineProxy:
             await self.drt._shutdown_hook()
 
     async def generate(self, request: EngineRequest) -> AsyncIterator[StepOutput]:
+        s = request.sampling
         wire = {
             "request_id": request.request_id,
             "token_ids": list(request.token_ids),
             "sampling": {
-                "temperature": request.sampling.temperature,
-                "top_k": request.sampling.top_k,
-                "top_p": request.sampling.top_p,
-                "max_tokens": request.sampling.max_tokens,
-                "ignore_eos": request.sampling.ignore_eos,
+                "temperature": s.temperature,
+                "top_k": s.top_k,
+                "top_p": s.top_p,
+                "min_p": s.min_p,
+                "max_tokens": s.max_tokens,
+                "min_tokens": s.min_tokens,
+                "ignore_eos": s.ignore_eos,
+                "seed": s.seed,
+                "presence_penalty": s.presence_penalty,
+                "frequency_penalty": s.frequency_penalty,
+                "repetition_penalty": s.repetition_penalty,
             },
             "eos_token_ids": list(request.eos_token_ids),
             "logprobs": request.logprobs,

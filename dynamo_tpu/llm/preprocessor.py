@@ -62,10 +62,17 @@ class OpenAIPreprocessor:
             temperature=float(temperature),
             top_k=int(req.ext.top_k or 0),
             top_p=float(req.top_p if req.top_p is not None else 1.0),
+            min_p=float(req.min_p or 0.0),
             max_tokens=int(max_tokens),
+            min_tokens=int(req.min_tokens or 0),
             stop=tuple(req.stop),
             seed=req.seed,
             ignore_eos=req.ext.ignore_eos,
+            presence_penalty=float(req.presence_penalty or 0.0),
+            frequency_penalty=float(req.frequency_penalty or 0.0),
+            repetition_penalty=float(
+                req.repetition_penalty if req.repetition_penalty is not None else 1.0
+            ),
         )
 
     def _build(self, req, prompt_text: str, token_ids: list[int]) -> tuple[PreprocessedRequest, dict]:

@@ -39,9 +39,14 @@ class PreprocessedRequest:
                 "temperature": self.sampling.temperature,
                 "top_k": self.sampling.top_k,
                 "top_p": self.sampling.top_p,
+                "min_p": self.sampling.min_p,
                 "max_tokens": self.sampling.max_tokens,
+                "min_tokens": self.sampling.min_tokens,
                 "ignore_eos": self.sampling.ignore_eos,
                 "seed": self.sampling.seed,
+                "presence_penalty": self.sampling.presence_penalty,
+                "frequency_penalty": self.sampling.frequency_penalty,
+                "repetition_penalty": self.sampling.repetition_penalty,
             },
             "eos_token_ids": list(self.eos_token_ids),
             "stop_strings": list(self.stop_strings),
@@ -70,9 +75,14 @@ class PreprocessedRequest:
                 temperature=s.get("temperature", 0.0),
                 top_k=s.get("top_k", 0),
                 top_p=s.get("top_p", 1.0),
+                min_p=s.get("min_p", 0.0),
                 max_tokens=s.get("max_tokens", 512),
+                min_tokens=s.get("min_tokens", 0),
                 ignore_eos=s.get("ignore_eos", False),
                 seed=s.get("seed"),
+                presence_penalty=s.get("presence_penalty", 0.0),
+                frequency_penalty=s.get("frequency_penalty", 0.0),
+                repetition_penalty=s.get("repetition_penalty", 1.0),
             ),
             eos_token_ids=tuple(d.get("eos_token_ids", ())),
             stop_strings=tuple(d.get("stop_strings", ())),

@@ -93,6 +93,12 @@ def _common_fields(d: dict) -> dict:
     else:
         raise ProtocolError("stop must be a string or list of strings")
 
+    def bounded(name, val, lo, hi):
+        if val is not None:
+            if not isinstance(val, (int, float)) or not lo <= val <= hi:
+                raise ProtocolError(f"{name} must be a number in [{lo}, {hi}]")
+        return val
+
     return dict(
         model=d.get("model"),
         stream=bool(d.get("stream", False)),
@@ -104,6 +110,14 @@ def _common_fields(d: dict) -> dict:
         n=int(d.get("n", 1)),
         logprobs=d.get("logprobs"),
         user=d.get("user"),
+        # OpenAI penalties + common sampling extensions (vLLM-compatible
+        # top-level names; the reference's SamplingOptions carries the same
+        # set — common.rs presence/frequency/repetition/min_p/seed)
+        presence_penalty=bounded("presence_penalty", d.get("presence_penalty"), -2.0, 2.0),
+        frequency_penalty=bounded("frequency_penalty", d.get("frequency_penalty"), -2.0, 2.0),
+        repetition_penalty=bounded("repetition_penalty", d.get("repetition_penalty"), 0.01, 10.0),
+        min_p=bounded("min_p", d.get("min_p"), 0.0, 1.0),
+        min_tokens=positive("min_tokens", d.get("min_tokens")),
         ext=Ext.from_dict(d.get("ext") or d.get("nvext")),
     )
 
@@ -122,6 +136,11 @@ class ChatCompletionRequest:
     logprobs: Any = None
     top_logprobs: Optional[int] = None
     user: Optional[str] = None
+    presence_penalty: Optional[float] = None
+    frequency_penalty: Optional[float] = None
+    repetition_penalty: Optional[float] = None
+    min_p: Optional[float] = None
+    min_tokens: Optional[int] = None
     ext: Ext = field(default_factory=Ext)
     tools: Optional[list] = None
     tool_choice: Any = None  # None|"none"|"auto"|"required"|{"type":"function",...}
@@ -159,6 +178,11 @@ class CompletionRequest:
     n: int = 1
     logprobs: Any = None
     user: Optional[str] = None
+    presence_penalty: Optional[float] = None
+    frequency_penalty: Optional[float] = None
+    repetition_penalty: Optional[float] = None
+    min_p: Optional[float] = None
+    min_tokens: Optional[int] = None
     ext: Ext = field(default_factory=Ext)
     echo: bool = False
 

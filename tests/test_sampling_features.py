@@ -1,0 +1,261 @@
+"""Sampling parity features: penalties (presence/frequency/repetition), min_p,
+per-request seeds, min_tokens (reference: lib/llm/src/protocols/common.rs
+SamplingOptions; penalty semantics follow its vLLM engines)."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import AsyncJaxEngine
+from dynamo_tpu.engine.sampling import SamplingParams, apply_penalties, sample_tokens
+from dynamo_tpu.engine.scheduler import EngineRequest
+
+
+# ---------------- pure sampler units ----------------
+
+
+def test_apply_penalties_semantics():
+    logits = jnp.array([[2.0, -1.0, 0.5, 3.0]])
+    counts = jnp.array([[2, 0, 1, 0]], jnp.int32)
+    seen = jnp.array([[True, True, True, False]])  # token 1 from the prompt
+    out = apply_penalties(
+        logits, counts, seen,
+        presence=jnp.array([0.5]), frequency=jnp.array([0.25]), repetition=jnp.array([2.0]),
+    )
+    # token0: 2.0 - 0.25*2 - 0.5 = 1.0, then /2 (seen, positive) = 0.5
+    # token1: -1.0 (no output counts), *2 (seen, negative) = -2.0
+    # token2: 0.5 - 0.25 - 0.5 = -0.25, *2 = -0.5
+    # token3: unseen, untouched
+    np.testing.assert_allclose(np.asarray(out[0]), [0.5, -2.0, -0.5, 3.0], atol=1e-6)
+
+
+def test_min_p_filters_tail():
+    # two strong tokens, long tail; min_p=0.5 must keep only the top token(s)
+    logits = jnp.array([[10.0, 9.0] + [0.0] * 62])
+    toks = set()
+    for i in range(30):
+        t = sample_tokens(
+            logits, jax.random.key(i),
+            jnp.array([1.0]), jnp.array([0], jnp.int32), jnp.array([1.0]),
+            min_p=jnp.array([0.5]),
+        )
+        toks.add(int(t[0]))
+    assert toks <= {0, 1}
+
+
+def test_seeded_sampling_is_deterministic_and_batch_independent():
+    V = 64
+    logits_row = jax.random.normal(jax.random.key(9), (V,))
+
+    def draw(slot, B, seed, key_int, pos=0):
+        logits = jnp.tile(logits_row[None], (B, 1))
+        toks = sample_tokens(
+            logits, jax.random.key(key_int),
+            jnp.full(B, 1.0), jnp.zeros(B, jnp.int32), jnp.ones(B, jnp.float32),
+            min_p=jnp.zeros(B),
+            seeds=jnp.full(B, 0, jnp.int32).at[slot].set(seed),
+            positions=jnp.full(B, pos, jnp.int32),
+        )
+        return int(toks[slot])
+
+    # same seed+position -> same token regardless of engine key or batch slot
+    a = draw(slot=0, B=1, seed=1234, key_int=0)
+    b = draw(slot=2, B=4, seed=1234, key_int=77)
+    assert a == b
+    # different position -> (almost surely) advances the stream
+    c = [draw(slot=0, B=1, seed=1234, key_int=0, pos=p) for p in range(8)]
+    assert len(set(c)) > 1
+
+
+# ---------------- engine end-to-end ----------------
+
+
+def _engine(**over):
+    defaults = dict(
+        model_id="tiny",
+        page_size=4,
+        num_pages=64,
+        max_seqs=4,
+        max_model_len=64,
+        prefill_buckets=(8, 16, 32),
+    )
+    defaults.update(over)
+    return AsyncJaxEngine(EngineConfig(**defaults))
+
+
+async def _gen(engine, rid, prompt, sampling):
+    req = EngineRequest(request_id=rid, token_ids=list(prompt), sampling=sampling)
+    toks = []
+    async for out in engine.generate(req):
+        if out.token is not None:
+            toks.append(out.token)
+    return toks
+
+
+def test_engine_repetition_penalty_breaks_loops():
+    """Greedy tiny-model decoding loops on a few tokens; a strong repetition
+    penalty must produce strictly more distinct tokens."""
+    async def body():
+        eng = _engine()
+        await eng.start()
+        prompt = [5, 9, 2, 77, 31]
+        plain = await _gen(eng, "plain", prompt, SamplingParams(
+            temperature=0.0, max_tokens=16, ignore_eos=True))
+        pen = await _gen(eng, "pen", prompt, SamplingParams(
+            temperature=0.0, max_tokens=16, ignore_eos=True, repetition_penalty=5.0))
+        await eng.shutdown()
+        return plain, pen
+
+    plain, pen = asyncio.new_event_loop().run_until_complete(body())
+    assert len(pen) == 16
+    assert len(set(pen)) > len(set(plain))
+
+
+def test_engine_seeded_requests_reproduce():
+    async def body():
+        eng = _engine()
+        await eng.start()
+        prompt = [3, 1, 4, 1, 5]
+        sp = lambda: SamplingParams(temperature=1.0, max_tokens=10, ignore_eos=True, seed=42)
+        a = await _gen(eng, "a", prompt, sp())
+        b = await _gen(eng, "b", prompt, sp())
+        other = await _gen(eng, "c", prompt, SamplingParams(
+            temperature=1.0, max_tokens=10, ignore_eos=True, seed=43))
+        await eng.shutdown()
+        return a, b, other
+
+    a, b, other = asyncio.new_event_loop().run_until_complete(body())
+    assert a == b
+    assert a != other  # different seed diverges (overwhelmingly likely)
+
+
+def test_engine_min_tokens_suppresses_early_eos():
+    async def body():
+        eng = _engine()
+        await eng.start()
+        prompt = [5, 9, 2]
+        # force immediate "EOS": make every token an eos token
+        req = EngineRequest(
+            request_id="mt",
+            token_ids=prompt,
+            sampling=SamplingParams(temperature=0.0, max_tokens=12, min_tokens=6),
+            eos_token_ids=tuple(range(256)),
+        )
+        toks = []
+        finish = None
+        async for out in eng.generate(req):
+            if out.token is not None:
+                toks.append(out.token)
+            if out.finished:
+                finish = out.finish_reason
+        await eng.shutdown()
+        return toks, finish
+
+    toks, finish = asyncio.new_event_loop().run_until_complete(body())
+    assert finish == "stop"
+    assert len(toks) == 6  # eos honored exactly at min_tokens, not before
+
+
+def test_http_sampling_params_parse():
+    from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.llm.protocols.openai import ChatCompletionRequest, ProtocolError
+    from dynamo_tpu.llm.tokenizer import get_tokenizer
+
+    pre = OpenAIPreprocessor(get_tokenizer("byte"), "tiny", max_model_len=256)
+    req = ChatCompletionRequest.from_dict({
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "hi"}],
+        "presence_penalty": 0.5, "frequency_penalty": -0.25,
+        "repetition_penalty": 1.3, "min_p": 0.1, "min_tokens": 4, "seed": 7,
+    })
+    p, _ = pre.preprocess_chat(req)
+    s = p.sampling
+    assert (s.presence_penalty, s.frequency_penalty) == (0.5, -0.25)
+    assert s.repetition_penalty == 1.3 and s.min_p == 0.1
+    assert s.min_tokens == 4 and s.seed == 7
+    assert s.needs_penalties
+
+    # wire roundtrip carries everything
+    from dynamo_tpu.llm.protocols.common import PreprocessedRequest
+    s2 = PreprocessedRequest.from_wire(p.to_wire()).sampling
+    assert s2 == s
+
+    with pytest.raises(ProtocolError):
+        ChatCompletionRequest.from_dict({
+            "model": "tiny", "messages": [{"role": "user", "content": "x"}],
+            "presence_penalty": 3.0,
+        })
+
+
+def test_engine_min_tokens_greedy_emits_no_early_eos():
+    """Device-side EOS masking: with greedy decoding whose argmax IS an EOS
+    token, min_tokens must yield non-EOS content tokens until the threshold
+    (not a stream of suppressed EOS ids)."""
+    async def body():
+        eng = _engine()
+        await eng.start()
+        # discover the natural greedy continuation; its first token becomes EOS
+        probe = await _gen(eng, "probe", [5, 9, 2], SamplingParams(
+            temperature=0.0, max_tokens=1, ignore_eos=True))
+        eos = probe[0]
+        req = EngineRequest(
+            request_id="mask",
+            token_ids=[5, 9, 2],
+            sampling=SamplingParams(temperature=0.0, max_tokens=12, min_tokens=5),
+            eos_token_ids=(eos,),
+        )
+        toks = []
+        async for out in eng.generate(req):
+            if out.token is not None:
+                toks.append(out.token)
+        await eng.shutdown()
+        return eos, toks
+
+    eos, toks = asyncio.new_event_loop().run_until_complete(body())
+    # tokens before the threshold must not be the banned EOS id
+    assert all(t != eos for t in toks[:4])
+
+
+def test_engine_penalties_survive_preemption():
+    """Frequency-penalty counts restore after preemption: a run that preempts
+    mid-stream produces the same tokens as one that never preempts."""
+    prompt = [5, 9, 2, 77]
+    sp = lambda: SamplingParams(
+        temperature=0.0, max_tokens=14, ignore_eos=True,
+        frequency_penalty=0.9, presence_penalty=0.4,
+    )
+
+    async def run(num_pages):
+        eng = _engine(num_pages=num_pages, max_seqs=2, decode_steps=2,
+                      pipeline_depth=1, max_model_len=64)
+        await eng.start()
+        if num_pages < 64:
+            # a second long-running request forces page pressure -> preemption
+            bg = asyncio.create_task(_gen(eng, "bg", [1, 2, 3], SamplingParams(
+                temperature=0.0, max_tokens=30, ignore_eos=True)))
+            out = await _gen(eng, "fg", prompt, sp())
+            await bg
+        else:
+            out = await _gen(eng, "fg", prompt, sp())
+        await eng.shutdown()
+        return out
+
+    loop = asyncio.new_event_loop()
+    ref = loop.run_until_complete(run(64))
+    tight = loop.run_until_complete(run(18))
+    loop.close()
+    assert tight == ref
+
+
+def test_fold_seed_out_of_range():
+    from dynamo_tpu.engine.sampling import fold_seed
+
+    assert fold_seed(0) == 0 and fold_seed(None) == 0
+    for s in (3_000_000_000, -5, 2**63 - 1, -(2**31)):
+        v = fold_seed(s)
+        assert 0 < v < 2**31
+    assert fold_seed(42) == fold_seed(42)
