@@ -125,6 +125,8 @@ class DisaggDecodeEngine:
             or request.sampling.needs_penalties
             or request.sampling.seed
             or request.sampling.min_p > 0  # remote wire carries no min_p
+            # ...nor EOS suppression state for min_tokens' first token
+            or (request.sampling.min_tokens > 1 and not request.sampling.ignore_eos)
             or not self.router.prefill_remote(len(prompt), prefix_hit, queue_depth)
         ):
             self.local_prefills += 1

@@ -254,6 +254,7 @@ class ModelRunner:
         state), logprobs, seeded streams, token feedback write. ``eos_ids``
         (min_tokens requests): the first sampled token is generation #1, so
         EOS logits are suppressed outright here."""
+        raw_b = logits[None, :]
         if eos_ids is not None:
             logits = logits.at[eos_ids].add(jnp.float32(-1e30), mode="drop")
         logits_b = logits[None, :]
@@ -268,7 +269,8 @@ class ModelRunner:
             kwargs = dict(seeds=seed[None], positions=sample_pos[None])
         if want_lp:
             toks, chosen, tids, tvals = sample_tokens_with_logprobs(
-                logits_b, key, flts[:1], top_k[None], flts[1:2], min_p=flts[2:3], **kwargs
+                logits_b, key, flts[:1], top_k[None], flts[1:2],
+                raw_logits=raw_b, min_p=flts[2:3], **kwargs
             )
             lp = (chosen[0], tids[0], tvals[0])
         else:
@@ -343,6 +345,7 @@ class ModelRunner:
                 params, kv, st["tokens"], positions, page_tables, act,
                 rope_deltas=rope_deltas if getattr(self.model.config, "mrope_section", None) is not None else None,
             )
+            raw_logits = logits
             if want_pen:
                 logits = apply_penalties(logits, st["counts"], st["seen"], pres, freq, reps)
             if want_eos_mask:
@@ -356,7 +359,7 @@ class ModelRunner:
                 kwargs.update(seeds=seeds, positions=positions)
             if want_lp:
                 toks, chosen, tids, tvals = sample_tokens_with_logprobs(
-                    logits, k, temps, top_ks, top_ps, **kwargs
+                    logits, k, temps, top_ks, top_ps, raw_logits=raw_logits, **kwargs
                 )
                 ys = (toks, chosen, tids, tvals)
             else:
@@ -443,6 +446,12 @@ class ModelRunner:
             and not sampling.ignore_eos
         )
         if want_eos:
+            if len(eos_ids) > MAX_EOS_IDS:
+                log.warning(
+                    "min_tokens: %d EOS ids exceed the device limit %d; ids "
+                    "beyond the limit are not suppressed",
+                    len(eos_ids), MAX_EOS_IDS,
+                )
             ids = np.asarray(eos_ids, np.int32)[:MAX_EOS_IDS]
             ints[bucket + mp + 5 : bucket + mp + 5 + len(ids)] = ids
         if want_pen:

@@ -63,15 +63,17 @@ def apply_penalties(
     frequency: jnp.ndarray,  # [B]
     repetition: jnp.ndarray,  # [B] (1.0 = off)
 ) -> jnp.ndarray:
-    """vLLM-semantics sampling penalties (what the reference's engines do):
-    presence/frequency subtract over output-token occurrences; repetition
-    divides positive / multiplies negative logits of any seen token."""
+    """vLLM-semantics sampling penalties (what the reference's engines do),
+    in vLLM's order: repetition divides positive / multiplies negative RAW
+    logits of any seen token FIRST, then presence/frequency subtract over
+    output-token occurrences."""
+    rep = repetition[:, None]
+    penalized = jnp.where(logits > 0, logits / rep, logits * rep)
+    logits = jnp.where(seen, penalized, logits)
     cf = counts.astype(jnp.float32)
     logits = logits - frequency[:, None] * cf
     logits = logits - presence[:, None] * (cf > 0)
-    rep = repetition[:, None]
-    penalized = jnp.where(logits > 0, logits / rep, logits * rep)
-    return jnp.where(seen, penalized, logits)
+    return logits
 
 
 def sample_tokens(
@@ -146,22 +148,26 @@ LOGPROBS_K = 20  # top alternatives computed on device (= the OpenAI API max)
 
 
 def sample_tokens_with_logprobs(
-    logits: jnp.ndarray,  # [B, V] float32
+    logits: jnp.ndarray,  # [B, V] float32, possibly penalized/masked
     key: jax.Array,
     temperature: jnp.ndarray,
     top_k: jnp.ndarray,
     top_p: jnp.ndarray,
+    raw_logits: jnp.ndarray | None = None,  # pre-penalty/mask model logits
     **kwargs,  # min_p / seeds / positions, forwarded to sample_tokens
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """sample_tokens + OpenAI-style logprobs of the model distribution.
 
     Returns (tokens [B], chosen_logprob [B], topk_ids [B, K], topk_logprobs
-    [B, K]). Logprobs are log-softmax of the raw (untempered) logits — the
+    [B, K]). Logprobs are log-softmax of the RAW model logits (pass
+    ``raw_logits`` when sampling from penalized/EOS-masked ones) — the
     model's distribution, matching the OpenAI API semantic; sampling itself
-    still applies temperature/top-k/top-p (and any forwarded filters).
+    applies temperature/top-k/top-p (and any forwarded filters).
     """
     tokens = sample_tokens(logits, key, temperature, top_k, top_p, **kwargs)
-    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    logprobs = jax.nn.log_softmax(
+        logits if raw_logits is None else raw_logits, axis=-1
+    )
     chosen = jnp.take_along_axis(logprobs, tokens[:, None].astype(jnp.int32), -1)[:, 0]
     top_vals, top_ids = jax.lax.top_k(logprobs, LOGPROBS_K)
     return tokens, chosen, top_ids.astype(jnp.int32), top_vals

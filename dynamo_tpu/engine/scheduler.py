@@ -498,9 +498,17 @@ class Scheduler:
             penalties[2, i] = seq.req.sampling.repetition_penalty
             seeds[i] = fold_seed(seq.req.sampling.seed)
             sam = seq.req.sampling
-            if sam.min_tokens > 0 and seq.req.eos_token_ids and not sam.ignore_eos:
-                # EOS allowed from the fed position of generation #min_tokens
-                eos_allowed_from[i] = seq.prompt_len + sam.min_tokens - 1
+            if sam.min_tokens > 1 and seq.req.eos_token_ids and not sam.ignore_eos:
+                # the decode step sampling generation #k feeds position
+                # prompt_len + k - 2 (prefill sampled #1); EOS may BE
+                # generation #min_tokens, so it unblocks one step earlier
+                eos_allowed_from[i] = seq.prompt_len + sam.min_tokens - 2
+                if len(seq.req.eos_token_ids) > MAX_EOS_IDS:
+                    log.warning(
+                        "min_tokens: %d EOS ids exceed the device limit %d for "
+                        "%s; the excess are not suppressed",
+                        len(seq.req.eos_token_ids), MAX_EOS_IDS, seq.req.request_id,
+                    )
                 ids = np.asarray(seq.req.eos_token_ids[:MAX_EOS_IDS], np.int32)
                 eos_rows[i, : len(ids)] = ids
                 any_eos_mask = True

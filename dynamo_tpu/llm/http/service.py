@@ -223,8 +223,19 @@ class HttpService:
         self.metrics.inflight(model, 1)
         try:
             with use_context(ctx):
+                # completions echo: the prompt text leads the output stream
+                # (token-id prompts echo their detokenization)
+                echo_text = None
+                if kind == "completion" and getattr(req, "echo", False):
+                    if isinstance(req.prompt, str):
+                        echo_text = req.prompt
+                    else:
+                        echo_text = pipeline.preprocessor.tokenizer.decode(
+                            pre.token_ids
+                        )
                 chunks = self._generate_chunks(
-                    pipeline, pre, kind, model, annotations, tool_matcher
+                    pipeline, pre, kind, model, annotations, tool_matcher,
+                    echo_text=echo_text,
                 )
                 if req.stream:
                     return await self._stream_response(request, chunks, model, endpoint, t0)
@@ -254,6 +265,7 @@ class HttpService:
         model: str,
         annotations: dict,
         tool_matcher: Optional[ToolCallingMatcher] = None,
+        echo_text: Optional[str] = None,
     ) -> AsyncIterator[dict]:
         gen = (
             ChatDeltaGenerator(model) if kind == "chat" else CompletionDeltaGenerator(model)
@@ -263,6 +275,8 @@ class HttpService:
         # the first delta (reference: protocols/annotated.rs envelope)
         for name, value in annotations.items():
             yield {"__event__": name, "data": value}
+        if echo_text:
+            yield gen.text_chunk(echo_text)
         want_timing = "timing" in pre.annotations
         t_start = time.monotonic()
         t_first = None

@@ -207,3 +207,19 @@ def test_annotation_and_timing_events_in_stream(server):
     assert status == 200
     assert out["object"] == "chat.completion"
     assert out["id"] is not None
+
+
+def test_completions_echo_param(server):
+    loop, url, _engine = server
+    status, out = _post(loop, url, "/v1/completions", {
+        "model": "tiny", "prompt": "hello-prompt", "max_tokens": 3,
+        "temperature": 0.0, "echo": True, "ext": {"ignore_eos": True},
+    })
+    assert status == 200
+    text = out["choices"][0]["text"]
+    assert text.startswith("hello-prompt")
+    status, plain = _post(loop, url, "/v1/completions", {
+        "model": "tiny", "prompt": "hello-prompt", "max_tokens": 3,
+        "temperature": 0.0, "ext": {"ignore_eos": True},
+    })
+    assert text == "hello-prompt" + plain["choices"][0]["text"]

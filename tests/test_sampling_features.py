@@ -26,11 +26,12 @@ def test_apply_penalties_semantics():
         logits, counts, seen,
         presence=jnp.array([0.5]), frequency=jnp.array([0.25]), repetition=jnp.array([2.0]),
     )
-    # token0: 2.0 - 0.25*2 - 0.5 = 1.0, then /2 (seen, positive) = 0.5
-    # token1: -1.0 (no output counts), *2 (seen, negative) = -2.0
-    # token2: 0.5 - 0.25 - 0.5 = -0.25, *2 = -0.5
-    # token3: unseen, untouched
-    np.testing.assert_allclose(np.asarray(out[0]), [0.5, -2.0, -0.5, 3.0], atol=1e-6)
+    # vLLM order: repetition on raw logits first, then freq/presence subtract
+    # token0: 2.0/2 (seen, positive) = 1.0, then -0.25*2 - 0.5 = 0.0
+    # token1: -1.0*2 (seen, negative) = -2.0; no output counts
+    # token2: 0.5/2 = 0.25, then -0.25 - 0.5 = -0.5
+    # token3: unseen, no counts: untouched
+    np.testing.assert_allclose(np.asarray(out[0]), [0.0, -2.0, -0.5, 3.0], atol=1e-6)
 
 
 def test_min_p_filters_tail():
