@@ -126,7 +126,11 @@ class DisaggDecodeEngine:
             or request.sampling.seed
             or request.sampling.min_p > 0  # remote wire carries no min_p
             # ...nor EOS suppression state for min_tokens' first token
-            or (request.sampling.min_tokens > 1 and not request.sampling.ignore_eos)
+            or (
+                request.sampling.min_tokens > 1
+                and not request.sampling.ignore_eos
+                and bool(request.eos_token_ids)
+            )
             or not self.router.prefill_remote(len(prompt), prefix_hit, queue_depth)
         ):
             self.local_prefills += 1

@@ -135,8 +135,8 @@ class ModelRunner:
         # multimodal vision encode (compiled lazily; text-only models never
         # pay for it — the mm prefill variant is _prefill traced with embeds)
         self._encode_images = jax.jit(
-            lambda params, patches, rows, cols, valid: self.model.encode_images(
-                params, patches, rows, cols, valid
+            lambda params, patches, rows, cols, valid, segments: self.model.encode_images(
+                params, patches, rows, cols, valid, segments=segments
             )
         )
         if config.sp > 1:
@@ -527,32 +527,62 @@ class ModelRunner:
     VISION_BUCKETS = (64, 256, 1024, 4096, 16384)
 
     def encode_images(self, images: list) -> list[np.ndarray]:
-        """Run the vision tower over each ImageInput; returns per-image
-        [num_tokens, D] float32 embeddings. Patch counts pad to static buckets
-        (one executable per bucket; the validity mask hides padding)."""
-        out = []
-        for im in images:
+        """Run the vision tower over a request's ImageInputs; returns per-image
+        [num_tokens, D] float32 embeddings.
+
+        All images pack into ONE bucket-padded call (attention is masked
+        block-diagonal via segment ids), so a multi-image prompt costs a
+        single dispatch — on tunneled PJRT platforms per-call latency
+        dominates the tower itself. Falls back to per-image calls only when
+        the combined patch count exceeds the largest bucket."""
+        if not images:
+            return []
+        total = sum(im.patches.shape[0] for im in images)
+        bucket = next((b for b in self.VISION_BUCKETS if b >= total), None)
+        if bucket is None:
+            if len(images) == 1:
+                raise ValueError(f"image has {total} patches > max bucket")
+            # too big combined: split the batch in half recursively
+            mid = len(images) // 2
+            return self.encode_images(images[:mid]) + self.encode_images(images[mid:])
+        patch_dim = images[0].patches.shape[1]
+        patches = np.zeros((bucket, patch_dim), np.float32)
+        rows = np.zeros(bucket, np.int32)
+        cols = np.zeros(bucket, np.int32)
+        valid = np.zeros(bucket, bool)
+        # single image: skip the pairwise segment mask entirely (it would be
+        # an [N, N] f32 bias held across every tower layer)
+        segments = None if len(images) == 1 else np.full(bucket, -1, np.int32)
+        offset = 0
+        spans = []
+        for idx, im in enumerate(images):
             n = im.patches.shape[0]
-            bucket = next((b for b in self.VISION_BUCKETS if b >= n), None)
-            if bucket is None:
-                raise ValueError(f"image has {n} patches > max bucket")
-            patches = np.zeros((bucket, im.patches.shape[1]), np.float32)
-            patches[:n] = im.patches
-            rows = np.zeros(bucket, np.int32)
-            cols = np.zeros(bucket, np.int32)
-            rows[:n] = im.rows
-            cols[:n] = im.cols
-            valid = np.zeros(bucket, bool)
-            valid[:n] = True
-            emb = self._encode_images(
-                self.params,
-                jnp.asarray(patches),
-                jnp.asarray(rows),
-                jnp.asarray(cols),
-                jnp.asarray(valid),
-            )
-            out.append(np.asarray(jax.device_get(emb), np.float32)[: im.num_tokens])
-        return out
+            patches[offset : offset + n] = im.patches
+            rows[offset : offset + n] = im.rows
+            cols[offset : offset + n] = im.cols
+            valid[offset : offset + n] = True
+            if segments is not None:
+                segments[offset : offset + n] = idx
+            spans.append((offset, n))
+            offset += n
+        emb = np.asarray(
+            jax.device_get(
+                self._encode_images(
+                    self.params,
+                    jnp.asarray(patches),
+                    jnp.asarray(rows),
+                    jnp.asarray(cols),
+                    jnp.asarray(valid),
+                    jnp.asarray(segments) if segments is not None else None,
+                )
+            ),
+            np.float32,
+        )
+        m2 = self.model.config.vision.spatial_merge_size ** 2
+        return [
+            emb[off // m2 : off // m2 + im.num_tokens]
+            for (off, n), im in zip(spans, images)
+        ]
 
     def write_token_slots(self, slots: np.ndarray, tokens: np.ndarray) -> None:
         """Host-known tokens (e.g. disagg adoption) -> slot token feedback."""

@@ -325,6 +325,16 @@ class Scheduler:
             # skipped entirely when every image run sits inside the cached
             # prefix — a repeat request never re-runs the vision tower
             req.mm_embeds = self.runner.encode_images(req.images)
+        if (
+            req.sampling.min_tokens > 1
+            and not req.sampling.ignore_eos
+            and len(req.eos_token_ids) > MAX_EOS_IDS
+        ):
+            log.warning(
+                "min_tokens: %d EOS ids exceed the device limit %d for %s; "
+                "the excess are not suppressed on device",
+                len(req.eos_token_ids), MAX_EOS_IDS, req.request_id,
+            )
         if req.sampling.needs_penalties and slot >= 0:
             # reset + prompt-seed this slot's on-device penalty state before
             # any sampling against it (restoring prior-output counts after a
@@ -503,12 +513,6 @@ class Scheduler:
                 # prompt_len + k - 2 (prefill sampled #1); EOS may BE
                 # generation #min_tokens, so it unblocks one step earlier
                 eos_allowed_from[i] = seq.prompt_len + sam.min_tokens - 2
-                if len(seq.req.eos_token_ids) > MAX_EOS_IDS:
-                    log.warning(
-                        "min_tokens: %d EOS ids exceed the device limit %d for "
-                        "%s; the excess are not suppressed",
-                        len(seq.req.eos_token_ids), MAX_EOS_IDS, seq.req.request_id,
-                    )
                 ids = np.asarray(seq.req.eos_token_ids[:MAX_EOS_IDS], np.int32)
                 eos_rows[i, : len(ids)] = ids
                 any_eos_mask = True

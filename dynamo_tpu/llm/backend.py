@@ -89,7 +89,11 @@ class Backend:
             images=list(request.images),
             logprobs=request.logprobs,
         )
-        decoder = DecodeStream(self.tokenizer, prompt_ids=request.token_ids)
+        decoder = DecodeStream(
+            self.tokenizer,
+            prompt_ids=request.token_ids,
+            skip_special_tokens=getattr(request, "skip_special_tokens", True),
+        )
         jail = _StopJail(request.stop_strings)
         count = 0
         cached = 0

@@ -231,7 +231,8 @@ class HttpService:
                         echo_text = req.prompt
                     else:
                         echo_text = pipeline.preprocessor.tokenizer.decode(
-                            pre.token_ids
+                            pre.token_ids,
+                            skip_special_tokens=pre.skip_special_tokens,
                         )
                 chunks = self._generate_chunks(
                     pipeline, pre, kind, model, annotations, tool_matcher,

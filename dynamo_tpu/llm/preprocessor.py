@@ -96,6 +96,7 @@ class OpenAIPreprocessor:
             annotations=tuple(req.ext.annotations),
             model=req.model or self.model_name,
             logprobs=self._logprobs(req),
+            skip_special_tokens=req.ext.skip_special_tokens,
         )
         return pre, annotations
 

@@ -30,6 +30,8 @@ class PreprocessedRequest:
     # OpenAI logprobs: None = off, n >= 0 = chosen-token logprob + n top
     # alternatives per sampled token
     logprobs: Optional[int] = None
+    # output option: detokenize with special tokens hidden (default) or kept
+    skip_special_tokens: bool = True
 
     def to_wire(self) -> dict:
         out = {
@@ -53,6 +55,7 @@ class PreprocessedRequest:
             "annotations": list(self.annotations),
             "model": self.model,
             "logprobs": self.logprobs,
+            "skip_special_tokens": self.skip_special_tokens,
         }
         if self.images:
             out["images"] = [im.to_wire() for im in self.images]
@@ -69,6 +72,7 @@ class PreprocessedRequest:
         return cls(
             images=images,
             logprobs=d.get("logprobs"),
+            skip_special_tokens=d.get("skip_special_tokens", True),
             request_id=d["request_id"],
             token_ids=list(d["token_ids"]),
             sampling=SamplingParams(

@@ -60,6 +60,8 @@ class Ext:
     top_k: int = 0
     annotations: list[str] = field(default_factory=list)
     greedy: bool = False
+    # output option (reference: common.rs OutputOptions.skip_special_tokens)
+    skip_special_tokens: bool = True
 
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> "Ext":
@@ -70,6 +72,7 @@ class Ext:
             top_k=int(d.get("top_k", 0)),
             annotations=list(d.get("annotations", [])),
             greedy=bool(d.get("greedy", False)),
+            skip_special_tokens=bool(d.get("skip_special_tokens", True)),
         )
 
 

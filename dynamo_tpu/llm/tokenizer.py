@@ -118,16 +118,24 @@ class DecodeStream:
     and emit the suffix once it stabilizes (no trailing replacement char).
     """
 
-    def __init__(self, tokenizer: Tokenizer, prompt_ids: Sequence[int] = ()):
+    def __init__(self, tokenizer: Tokenizer, prompt_ids: Sequence[int] = (),
+                 skip_special_tokens: bool = True):
         self.tokenizer = tokenizer
         self.ids: list[int] = list(prompt_ids)
         self.prefix_offset = len(self.ids)
         self.read_offset = len(self.ids)
+        self.skip_special_tokens = skip_special_tokens
 
     def step(self, token_id: int) -> Optional[str]:
         self.ids.append(token_id)
-        prefix_text = self.tokenizer.decode(self.ids[self.prefix_offset : self.read_offset])
-        new_text = self.tokenizer.decode(self.ids[self.prefix_offset :])
+        prefix_text = self.tokenizer.decode(
+            self.ids[self.prefix_offset : self.read_offset],
+            skip_special_tokens=self.skip_special_tokens,
+        )
+        new_text = self.tokenizer.decode(
+            self.ids[self.prefix_offset :],
+            skip_special_tokens=self.skip_special_tokens,
+        )
         if new_text.endswith("�"):
             return None  # mid-codepoint; wait for more tokens
         if len(new_text) > len(prefix_text):

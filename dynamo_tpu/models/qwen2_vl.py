@@ -81,6 +81,8 @@ class Qwen2VLModel(LlamaModel):
         shardings["vision"] = self.vision.param_shardings(mesh, tp_axis)
         return shardings
 
-    def encode_images(self, params, patches, rows, cols, valid):
+    def encode_images(self, params, patches, rows, cols, valid, segments=None):
         """[N, patch_dim] padded patches -> [N/merge^2, hidden] embeddings."""
-        return self.vision.encode(params["vision"], patches, rows, cols, valid)
+        return self.vision.encode(
+            params["vision"], patches, rows, cols, valid, segments=segments
+        )

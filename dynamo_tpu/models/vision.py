@@ -213,11 +213,14 @@ class VisionModel:
         rows: jnp.ndarray,  # [N] patch row index (0 for padding)
         cols: jnp.ndarray,  # [N] patch col index
         valid: jnp.ndarray,  # [N] bool
+        segments: jnp.ndarray | None = None,  # [N] image id per patch
     ) -> jnp.ndarray:
         """-> [N // merge^2, out_hidden_size] merged patch embeddings.
 
         Patches must be laid out in merge-group order (all merge^2 members of a
         merged token contiguous) — llm/multimodal.py's patchify produces this.
+        ``segments`` batches several images through one call: attention is
+        masked block-diagonal so patches never attend across images.
         """
         c = self.config
         N = patches.shape[0]
@@ -226,6 +229,10 @@ class VisionModel:
 
         neg = jnp.asarray(jnp.finfo(jnp.float32).min, jnp.float32)
         attn_bias = jnp.where(valid[None, :], 0.0, neg)  # [1, N]
+        if segments is not None:
+            attn_bias = attn_bias + jnp.where(
+                segments[:, None] == segments[None, :], 0.0, neg
+            )
 
         def body(hidden, lp):
             x = layer_norm(hidden, lp["norm1"], lp["norm1_b"], c.layer_norm_eps)

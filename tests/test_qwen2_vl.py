@@ -428,3 +428,69 @@ def test_mrope_text_only_reduces_to_1d_rope():
         jnp.asarray(pt), jnp.asarray(valid), jnp.asarray(T - 1),
     )
     np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=1e-6, atol=1e-6)
+
+
+def test_batched_multi_image_encode_matches_per_image():
+    """Runner packs a request's images into ONE segment-masked vision call;
+    results must equal per-image encodes."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.model_runner import ModelRunner
+    from dynamo_tpu.models.registry import load_model
+
+    model, params = load_model("tiny-vl")
+    cfg = EngineConfig(
+        model_id="tiny-vl", page_size=4, num_pages=64, max_seqs=2,
+        max_model_len=256, prefill_buckets=(32, 64, 128),
+    )
+    runner = ModelRunner(cfg, model, params)
+
+    imgs = [rng_image(40 + i, h=16 + 8 * i, w=16) for i in range(3)]
+    inputs = []
+    off = 0
+    for img in imgs:
+        patches, rows, cols, grid = patchify(
+            img, model.config.vision.patch_size, model.config.vision.spatial_merge_size
+        )
+        n_tok = patches.shape[0] // model.config.vision.spatial_merge_size**2
+        inputs.append(ImageInput(
+            offset=off, patches=patches, rows=rows, cols=cols, grid=grid,
+            num_tokens=n_tok, content_hash=image_content_hash(img),
+        ))
+        off += n_tok
+
+    batched = runner.encode_images(inputs)
+    singles = [runner.encode_images([im])[0] for im in inputs]
+    assert len(batched) == 3
+    for b, s, im in zip(batched, singles, inputs):
+        assert b.shape == (im.num_tokens, model.config.hidden_size)
+        np.testing.assert_allclose(b, s, rtol=2e-4, atol=2e-4)
+
+
+def test_engine_two_image_prompt(vl_engine):
+    """A prompt with two images generates (both runs spliced)."""
+    from dynamo_tpu.engine.sampling import SamplingParams
+    from dynamo_tpu.engine.scheduler import EngineRequest
+
+    engine, loop = vl_engine
+    cfg = engine.model.config
+    ims = []
+    toks = [1]
+    for i, img in enumerate([rng_image(61, h=16, w=16), rng_image(62, h=16, w=16)]):
+        patches, rows, cols, grid = patchify(
+            img, cfg.vision.patch_size, cfg.vision.spatial_merge_size
+        )
+        n_tok = patches.shape[0] // cfg.vision.spatial_merge_size**2
+        chash = image_content_hash(img)
+        ims.append(ImageInput(
+            offset=len(toks), patches=patches, rows=rows, cols=cols, grid=grid,
+            num_tokens=n_tok, content_hash=chash,
+        ))
+        toks += virtual_token_ids(chash, n_tok, cfg.vocab_size)
+        toks.append(2)
+    req = EngineRequest(
+        request_id="two-img", token_ids=toks,
+        sampling=SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True),
+        images=ims,
+    )
+    out, _ = loop.run_until_complete(_collect(engine, req))
+    assert len(out) == 4
