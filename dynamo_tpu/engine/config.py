@@ -41,6 +41,11 @@ class EngineConfig:
     # pipelining; the token feedback lives on device so window N+1 never waits
     # for window N's tokens to reach the host). 1 = fully synchronous.
     pipeline_depth: int = 3
+    # pre-compile the decode-window trace variants (default / extras /
+    # logprobs) at startup so the first feature-bearing request never hits a
+    # cold multi-second XLA compile mid-serving. Off by default: tests and
+    # short-lived engines shouldn't pay several extra compiles.
+    warmup: bool = False
 
     @property
     def max_pages_per_seq(self) -> int:

@@ -105,6 +105,8 @@ class AsyncJaxEngine:
             offload=offload,
         )
         self.scheduler = Scheduler(self.config, self.runner, self.allocator)
+        if self.config.warmup:
+            self.runner.warmup()
         log.info(
             "engine ready: model=%s tp=%d pp=%d sp=%d pages=%d (%.1fs)",
             self.config.model_id,

@@ -29,7 +29,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dynamo_tpu.engine.config import EngineConfig
-from dynamo_tpu.engine.sampling import MAX_EOS_IDS, apply_penalties, fold_seed, sample_tokens, sample_tokens_with_logprobs
+from dynamo_tpu.engine.sampling import MAX_EOS_IDS, SamplingParams, apply_penalties, fold_seed, sample_tokens, sample_tokens_with_logprobs
 from dynamo_tpu.utils import get_logger
 
 log = get_logger("engine.runner")
@@ -435,14 +435,16 @@ class ModelRunner:
         ints[bucket + mp + 4] = fold_seed(sampling.seed) if sampling is not None else 0
         want_pen = sampling is not None and sampling.needs_penalties
         want_seed = sampling is not None and bool(sampling.seed)
-        # min_tokens: the first sampled token must not be EOS -> suppress the
-        # request's EOS logits on device
+        # min_tokens >= 1: the first sampled token (generation #1) must not be
+        # EOS -> suppress the request's EOS logits on device. Matches vLLM:
+        # EOS is suppressed while generated < min_tokens, so min_tokens=1
+        # guarantees one non-EOS token.
         want_eos = bool(
             sample
             and eos_ids is not None
             and len(eos_ids) > 0
             and sampling is not None
-            and sampling.min_tokens > 1
+            and sampling.min_tokens >= 1
             and not sampling.ignore_eos
         )
         if want_eos:
@@ -454,8 +456,6 @@ class ModelRunner:
                 )
             ids = np.asarray(eos_ids, np.int32)[:MAX_EOS_IDS]
             ints[bucket + mp + 5 : bucket + mp + 5 + len(ids)] = ids
-        if want_pen:
-            self._ensure_penalty_state()
         flts = np.array(
             [
                 temperature,
@@ -495,6 +495,11 @@ class ModelRunner:
             and bucket % self.config.sp == 0
         )
         prefill_fn = self._prefill_sp if use_sp else self._prefill
+        # same trace collapse as dispatch_decode_window: penalties/seeds/EOS
+        # masking share one feature-bearing variant (neutral inputs are no-ops)
+        want_extras = bool((want_pen and sample) or (want_seed and sample) or want_eos)
+        if want_extras:
+            self._ensure_penalty_state()
         tok, lp, self.kv_cache, self.slot_state = prefill_fn(
             self.params,
             self.kv_cache,
@@ -505,9 +510,9 @@ class ModelRunner:
             *mm_args,
             # only the sampling (final) chunk's outputs are ever consumed
             want_lp=want_logprobs and sample,
-            want_pen=want_pen and sample,
-            want_seed=want_seed and sample,
-            want_eos_mask=want_eos,
+            want_pen=want_extras,
+            want_seed=want_extras,
+            want_eos_mask=want_extras,
         )
         if not sample:
             return None
@@ -668,10 +673,17 @@ class ModelRunner:
         flts[1] = top_ps
         flts[2] = min_ps if min_ps is not None else 0.0
         flts[3:6] = penalties if penalties is not None else np.array([[0.0], [0.0], [1.0]])
-        want_pen = penalties is not None
-        want_seed = seeds is not None and bool(np.any(seeds))
-        want_eos = eos_ids is not None
-        if want_pen:
+        # penalties / seeded streams / min_tokens EOS masking collapse into ONE
+        # feature-bearing trace: all their neutral inputs are no-ops (penalty
+        # (0,0,1), seed 0, V-padded EOS rows dropped by the OOB scatter), so
+        # 2^3 flag combinations become 2 and a request introducing a new
+        # combination mid-serving can't hit a multi-second cold XLA compile.
+        want_extras = (
+            penalties is not None
+            or (seeds is not None and bool(np.any(seeds)))
+            or eos_ids is not None
+        )
+        if want_extras:
             self._ensure_penalty_state()
         toks, lp, self.kv_cache, self.slot_state = self._decode_window(
             self.params,
@@ -682,9 +694,9 @@ class ModelRunner:
             self._next_key(),
             num_steps=num_steps,
             want_lp=want_logprobs,
-            want_pen=want_pen,
-            want_seed=want_seed,
-            want_eos_mask=want_eos,
+            want_pen=want_extras,
+            want_seed=want_extras,
+            want_eos_mask=want_extras,
         )
         try:
             toks.copy_to_host_async()
@@ -694,6 +706,54 @@ class ModelRunner:
         except Exception:
             pass
         return (toks, lp) if want_logprobs else toks
+
+    def warmup(self) -> None:
+        """Pre-compile the decode-window trace variants — (default, extras,
+        logprobs, logprobs+extras) — plus the smallest prefill bucket's default
+        and extras traces. All slots are inactive / writes target the reserved
+        null page 0, so the calls execute harmlessly; what matters is that the
+        XLA executables land in the jit cache before live traffic."""
+        import time as _time
+
+        t0 = _time.monotonic()
+        # Allocate the penalty buffers FIRST: slot_state's pytree structure is
+        # part of the jit cache key, so every variant must compile against the
+        # final (counts-bearing) structure or live traffic re-traces them all.
+        self._ensure_penalty_state()
+        B = self.config.max_seqs
+        mp = self.config.max_pages_per_seq
+        zeros_i = np.zeros(B, np.int32)
+        pt = np.zeros((B, mp), np.int32)
+        inactive = np.zeros(B, bool)
+        temps = np.zeros(B, np.float32)
+        ones_f = np.ones(B, np.float32)
+        neutral_pen = np.tile(np.array([[0.0], [0.0], [1.0]], np.float32), (1, B))
+        K = self.config.decode_steps
+        for kwargs in (
+            {},
+            {"penalties": neutral_pen},
+            {"want_logprobs": True},
+            {"want_logprobs": True, "penalties": neutral_pen},
+        ):
+            out = self.dispatch_decode_window(
+                zeros_i, pt, inactive, zeros_i, temps, zeros_i, ones_f, K, **kwargs
+            )
+            jax.block_until_ready(out)
+        bucket = self.config.prefill_buckets[0]
+        for sampling, want_lp in (
+            (None, False),
+            (None, True),
+            (SamplingParams(presence_penalty=0.1, min_tokens=1), False),
+        ):
+            out = self.prefill_chunk(
+                np.zeros(bucket, np.int32), 0, pt[0], sample=True,
+                temperature=0.0, top_k=0, top_p=1.0, slot=-1, sync=not want_lp,
+                want_logprobs=want_lp, sampling=sampling,
+                eos_ids=(0,) if sampling is not None else None,
+            )
+            if want_lp:
+                jax.block_until_ready(out)
+        log.info("warmup: trace variants compiled in %.1fs", _time.monotonic() - t0)
 
     def extract_pages_device(self, page_ids: np.ndarray) -> jax.Array:
         """Gather KV blocks into a device array [L, 2, n, page_size, Hkv, D]

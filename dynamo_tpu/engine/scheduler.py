@@ -326,7 +326,7 @@ class Scheduler:
             # prefix — a repeat request never re-runs the vision tower
             req.mm_embeds = self.runner.encode_images(req.images)
         if (
-            req.sampling.min_tokens > 1
+            req.sampling.min_tokens >= 1
             and not req.sampling.ignore_eos
             and len(req.eos_token_ids) > MAX_EOS_IDS
         ):
@@ -338,10 +338,19 @@ class Scheduler:
         if req.sampling.needs_penalties and slot >= 0:
             # reset + prompt-seed this slot's on-device penalty state before
             # any sampling against it (restoring prior-output counts after a
-            # preemption)
-            self.runner.seed_penalty_slot(
-                slot, req.token_ids, output_from=req.penalty_output_from
-            )
+            # preemption). Image virtual-token runs are excluded: their ids are
+            # hash-derived arbitrary vocab ids, and seeding them would penalize
+            # unrelated real tokens.
+            pen_ids = np.asarray(req.token_ids, np.int32)
+            pen_from = req.penalty_output_from
+            if req.images:
+                keep = np.ones(len(pen_ids), bool)
+                for im in req.images:
+                    keep[im.offset : im.offset + im.num_tokens] = False
+                if pen_from is not None:
+                    pen_from = int(keep[:pen_from].sum())
+                pen_ids = pen_ids[keep]
+            self.runner.seed_penalty_slot(slot, pen_ids, output_from=pen_from)
         mcfg = getattr(self.runner.model.config, "mrope_section", None)
         if req.images and mcfg is not None and req.mrope_pos is None:
             from dynamo_tpu.llm.multimodal import mrope_positions
@@ -510,9 +519,11 @@ class Scheduler:
             sam = seq.req.sampling
             if sam.min_tokens > 1 and seq.req.eos_token_ids and not sam.ignore_eos:
                 # the decode step sampling generation #k feeds position
-                # prompt_len + k - 2 (prefill sampled #1); EOS may BE
-                # generation #min_tokens, so it unblocks one step earlier
-                eos_allowed_from[i] = seq.prompt_len + sam.min_tokens - 2
+                # prompt_len + k - 2 (prefill sampled #1); EOS is suppressed
+                # while sampling generation #k for k <= min_tokens (vLLM
+                # semantics: min_tokens non-EOS tokens are guaranteed), so it
+                # unblocks at fed position prompt_len + min_tokens - 1
+                eos_allowed_from[i] = seq.prompt_len + sam.min_tokens - 1
                 ids = np.asarray(seq.req.eos_token_ids[:MAX_EOS_IDS], np.int32)
                 eos_rows[i, : len(ids)] = ids
                 any_eos_mask = True
@@ -589,7 +600,7 @@ class Scheduler:
             (not req.sampling.ignore_eos)
             and req.eos_token_ids
             and token in req.eos_token_ids
-            and len(seq.generated) >= max(1, req.sampling.min_tokens)
+            and len(seq.generated) > req.sampling.min_tokens
         ):
             finish = "stop"
         elif len(seq.generated) >= req.sampling.max_tokens:

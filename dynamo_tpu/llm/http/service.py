@@ -227,6 +227,15 @@ class HttpService:
                 # (token-id prompts echo their detokenization)
                 echo_text = None
                 if kind == "completion" and getattr(req, "echo", False):
+                    if pre.logprobs is not None:
+                        # OpenAI returns logprobs for echoed prompt tokens;
+                        # prompt logprobs aren't computed here, so reject the
+                        # combination explicitly rather than return a response
+                        # that silently omits them
+                        self.metrics.inc_request(model, endpoint, rtype, "400")
+                        return self._error(
+                            400, "echo with logprobs is not supported"
+                        )
                     if isinstance(req.prompt, str):
                         echo_text = req.prompt
                     else:
@@ -285,6 +294,7 @@ class HttpService:
         # response never leaks as content deltas (tool calls are matched on
         # complete messages, llm/tools.py).
         buffered: list[str] = []
+        buffered_lp: list = []
         async for out in pipeline.backend.generate(pre):
             usage.completion_tokens = out.cumulative_tokens
             if t_first is None and out.token_ids:
@@ -292,6 +302,8 @@ class HttpService:
             if tool_matcher is not None:
                 if out.text:
                     buffered.append(out.text)
+                if out.logprobs:
+                    buffered_lp.extend(out.logprobs)
             elif out.text or out.logprobs:
                 yield gen.text_chunk(out.text, logprobs=out.logprobs)
             if out.finished:
@@ -303,7 +315,7 @@ class HttpService:
                         yield gen.tool_calls_chunk(calls)
                         finish = "tool_calls"
                     elif text:
-                        yield gen.text_chunk(text)
+                        yield gen.text_chunk(text, logprobs=buffered_lp or None)
                 if want_timing:
                     total = time.monotonic() - t_start
                     ttft = (t_first - t_start) if t_first is not None else None

@@ -57,6 +57,16 @@ class ModelDeploymentCard:
                     "merge_size": int(vis.get("spatial_merge_size", 2)),
                     "vocab_size": int(cfg.get("vocab_size", 1 << 30)),
                 }
+                # Trained vision delimiters (Qwen2-VL: <|vision_start|> /
+                # <|vision_end|>): when present, the preprocessor wraps each
+                # image's virtual-token run with them so real checkpoints see
+                # the prompt structure they were trained on.
+                for key, cfg_key in (
+                    ("vision_start_id", "vision_start_token_id"),
+                    ("vision_end_id", "vision_end_token_id"),
+                ):
+                    if cfg.get(cfg_key) is not None:
+                        card.mm[key] = int(cfg[cfg_key])
         if (p / "tokenizer.json").exists() or (p / "tokenizer_config.json").exists():
             card.tokenizer = str(p)
         return card

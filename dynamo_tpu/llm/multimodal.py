@@ -255,10 +255,21 @@ def tokenize_with_images(
     patch_size: int,
     merge_size: int,
     vocab_size: int,
+    vision_start_id: int | None = None,
+    vision_end_id: int | None = None,
 ) -> tuple[list[int], list[ImageInput]]:
     """Split the rendered prompt on image sentinels, encode text segments, and
     splice each image's virtual-token run in between. Returns (token_ids,
-    image_inputs with offsets)."""
+    image_inputs with offsets).
+
+    When the checkpoint defines vision delimiter tokens (Qwen2-VL's
+    ``<|vision_start|>`` / ``<|vision_end|>``, config.json
+    ``vision_start_token_id`` / ``vision_end_token_id``), each virtual-token
+    run is wrapped with them: those are real trained tokens whose embeddings
+    DO reach the forward math, so real checkpoints see the prompt structure
+    they were trained on. The run itself stays hash-derived virtual ids
+    (embeddings overridden by vision output; the ids exist for KV block
+    hashing and prefix-cache identity)."""
     token_ids: list[int] = []
     mm: list[ImageInput] = []
     cursor = 0
@@ -269,6 +280,8 @@ def tokenize_with_images(
             raise ValueError(f"image {i} sentinel missing after template render")
         if idx > cursor:
             token_ids.extend(encode(rendered[cursor:idx]))
+        if vision_start_id is not None:
+            token_ids.append(int(vision_start_id))
         patches, rows, cols, grid = patchify(pixels, patch_size, merge_size)
         n_tokens = patches.shape[0] // (merge_size * merge_size)
         chash = image_content_hash(pixels)
@@ -284,6 +297,8 @@ def tokenize_with_images(
             )
         )
         token_ids.extend(virtual_token_ids(chash, n_tokens, vocab_size))
+        if vision_end_id is not None:
+            token_ids.append(int(vision_end_id))
         cursor = idx + len(sentinel)
     if cursor < len(rendered):
         token_ids.extend(encode(rendered[cursor:]))

@@ -166,6 +166,8 @@ class OpenAIPreprocessor:
                     patch_size=self.mm["patch_size"],
                     merge_size=self.mm["merge_size"],
                     vocab_size=self.mm["vocab_size"],
+                    vision_start_id=self.mm.get("vision_start_id"),
+                    vision_end_id=self.mm.get("vision_end_id"),
                 )
             except Exception as e:
                 raise ProtocolError(f"invalid image content: {e}")

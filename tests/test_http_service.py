@@ -223,3 +223,16 @@ def test_completions_echo_param(server):
         "temperature": 0.0, "ext": {"ignore_eos": True},
     })
     assert text == "hello-prompt" + plain["choices"][0]["text"]
+
+
+def test_completions_echo_with_logprobs_rejected(server):
+    """OpenAI returns prompt-token logprobs for echo+logprobs; we don't compute
+    prompt logprobs, so the combination is rejected explicitly rather than
+    silently omitting them."""
+    loop, url, _engine = server
+    status, out = _post(loop, url, "/v1/completions", {
+        "model": "tiny", "prompt": "hello", "max_tokens": 3,
+        "echo": True, "logprobs": 2,
+    })
+    assert status == 400
+    assert "echo" in out["error"]["message"]

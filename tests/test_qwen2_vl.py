@@ -224,6 +224,64 @@ def test_preprocessor_content_parts():
     assert p3.token_ids != p.token_ids
 
 
+def test_preprocessor_wraps_runs_with_vision_delimiters():
+    """When the checkpoint defines vision delimiter tokens (Qwen2-VL
+    vision_start/end), every image's virtual-token run must be wrapped with
+    them — real trained tokens the model sees around image content."""
+    from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.llm.protocols.openai import ChatCompletionRequest
+    from dynamo_tpu.llm.tokenizer import get_tokenizer
+
+    VS, VE = 250, 251
+    pre = OpenAIPreprocessor(
+        get_tokenizer("byte"), "tiny-vl", max_model_len=512,
+        mm={"patch_size": 4, "merge_size": 2, "vocab_size": 256,
+            "vision_start_id": VS, "vision_end_id": VE},
+    )
+    img = rng_image(7, h=16, w=16)
+    req = ChatCompletionRequest.from_dict({
+        "model": "tiny-vl",
+        "messages": [{
+            "role": "user",
+            "content": [
+                {"type": "text", "text": "a: "},
+                {"type": "image_url", "image_url": {"url": npy_data_uri(img)}},
+                {"type": "image_url", "image_url": {"url": npy_data_uri(img + 0.1)}},
+            ],
+        }],
+    })
+    p, _ = pre.preprocess_chat(req)
+    assert len(p.images) == 2
+    for im in p.images:
+        run = p.token_ids[im.offset : im.offset + im.num_tokens]
+        assert run == virtual_token_ids(im.content_hash, im.num_tokens, 256)
+        assert p.token_ids[im.offset - 1] == VS
+        assert p.token_ids[im.offset + im.num_tokens] == VE
+
+
+def test_model_card_captures_vision_delimiters(tmp_path):
+    import json
+
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+
+    (tmp_path / "config.json").write_text(json.dumps({
+        "architectures": ["Qwen2VLForConditionalGeneration"],
+        "model_type": "qwen2_vl",
+        "vocab_size": 152064,
+        "vision_config": {"patch_size": 14, "spatial_merge_size": 2},
+        "vision_start_token_id": 151652,
+        "vision_end_token_id": 151653,
+        "max_position_embeddings": 32768,
+    }))
+    card = ModelDeploymentCard.from_local_path(str(tmp_path))
+    assert card.mm is not None
+    assert card.mm["vision_start_id"] == 151652
+    assert card.mm["vision_end_id"] == 151653
+    # wire roundtrip keeps the mm block
+    card2 = ModelDeploymentCard.from_wire(card.to_wire())
+    assert card2.mm == card.mm
+
+
 def test_preprocessor_rejects_images_for_text_model():
     from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
     from dynamo_tpu.llm.protocols.openai import ChatCompletionRequest, ProtocolError

@@ -158,7 +158,9 @@ def test_engine_min_tokens_suppresses_early_eos():
 
     toks, finish = asyncio.new_event_loop().run_until_complete(body())
     assert finish == "stop"
-    assert len(toks) == 6  # eos honored exactly at min_tokens, not before
+    # vLLM semantics: min_tokens guarantees 6 non-stopping tokens; the first
+    # EOS that may finish the stream is generation #7
+    assert len(toks) == 7
 
 
 def test_http_sampling_params_parse():
@@ -217,8 +219,34 @@ def test_engine_min_tokens_greedy_emits_no_early_eos():
         return eos, toks
 
     eos, toks = asyncio.new_event_loop().run_until_complete(body())
-    # tokens before the threshold must not be the banned EOS id
-    assert all(t != eos for t in toks[:4])
+    # the min_tokens guaranteed tokens must not be the banned EOS id
+    assert all(t != eos for t in toks[:5])
+
+
+def test_engine_min_tokens_one_is_meaningful():
+    """min_tokens=1 guarantees one non-EOS token even when the greedy argmax
+    of the prompt IS an EOS id (vLLM parity; previously a no-op)."""
+    async def body():
+        eng = _engine()
+        await eng.start()
+        probe = await _gen(eng, "probe1", [5, 9, 2], SamplingParams(
+            temperature=0.0, max_tokens=1, ignore_eos=True))
+        eos = probe[0]
+        req = EngineRequest(
+            request_id="mt1",
+            token_ids=[5, 9, 2],
+            sampling=SamplingParams(temperature=0.0, max_tokens=8, min_tokens=1),
+            eos_token_ids=(eos,),
+        )
+        toks = []
+        async for out in eng.generate(req):
+            if out.token is not None:
+                toks.append(out.token)
+        await eng.shutdown()
+        return eos, toks
+
+    eos, toks = asyncio.new_event_loop().run_until_complete(body())
+    assert toks and toks[0] != eos
 
 
 def test_engine_penalties_survive_preemption():
@@ -260,3 +288,24 @@ def test_fold_seed_out_of_range():
         v = fold_seed(s)
         assert 0 < v < 2**31
     assert fold_seed(42) == fold_seed(42)
+
+
+def test_engine_warmup_precompiles_trace_variants():
+    """warmup=True pre-compiles the decode/prefill trace variants; serving a
+    feature-bearing request afterwards must not change behavior (and a seeded
+    run stays deterministic through the collapsed extras trace)."""
+    async def body():
+        eng = _engine(warmup=True)
+        await eng.start()
+        a = await _gen(eng, "w1", [5, 9, 2], SamplingParams(
+            temperature=0.8, max_tokens=6, seed=42))
+        b = await _gen(eng, "w2", [5, 9, 2], SamplingParams(
+            temperature=0.8, max_tokens=6, seed=42))
+        plain = await _gen(eng, "w3", [5, 9, 2], SamplingParams(
+            temperature=0.0, max_tokens=6))
+        await eng.shutdown()
+        return a, b, plain
+
+    a, b, plain = asyncio.new_event_loop().run_until_complete(body())
+    assert a == b
+    assert len(plain) == 6
