@@ -744,6 +744,7 @@ class ModelRunner:
             (None, False),
             (None, True),
             (SamplingParams(presence_penalty=0.1, min_tokens=1), False),
+            (SamplingParams(presence_penalty=0.1, min_tokens=1), True),
         ):
             out = self.prefill_chunk(
                 np.zeros(bucket, np.int32), 0, pt[0], sample=True,

@@ -92,40 +92,12 @@ def sample_tokens(
     (seed, position) — deterministic across retries, preemption, and batch
     composition. Unseeded slots share the engine's key stream."""
     B, V = logits.shape
-    greedy = jnp.argmax(logits, axis=-1)
-
-    # Sort once (descending); both top-k and top-p become rank/cdf thresholds.
-    sorted_logits = -jnp.sort(-logits, axis=-1)  # [B, V] descending
-
-    # top-k: keep entries with logit >= k-th largest value
-    k = jnp.where(top_k > 0, jnp.clip(top_k, 1, V), V)
-    kth_value = jnp.take_along_axis(sorted_logits, (k - 1)[:, None], axis=-1)  # [B,1]
-    keep_k = logits >= kth_value
-
-    # top-p: over the sorted distribution (temperature-scaled), keep the prefix
-    # whose cumulative probability is < p (always keeping the first)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     temp = jnp.where(temperature > 0, temperature, 1.0)[:, None]
-    sorted_probs = jax.nn.softmax(sorted_logits / temp, axis=-1)
-    cum = jnp.cumsum(sorted_probs, axis=-1)
-    sorted_keep = (cum - sorted_probs) < top_p[:, None]  # prefix incl. first
-    # threshold value = smallest kept logit in sorted order
-    num_keep = jnp.maximum(jnp.sum(sorted_keep, axis=-1), 1)
-    p_value = jnp.take_along_axis(sorted_logits, (num_keep - 1)[:, None], axis=-1)
-    keep_p = logits >= p_value
 
-    keep = keep_k & keep_p
-    if min_p is not None:
-        # keep tokens whose (tempered) prob >= min_p * max prob: in logit
-        # space, logit/temp >= max/temp + log(min_p)
-        max_l = jnp.max(logits, axis=-1, keepdims=True)
-        thresh = max_l / temp + jnp.log(jnp.maximum(min_p, 1e-10))[:, None]
-        keep_m = (logits / temp) >= thresh
-        keep = keep & jnp.where(min_p[:, None] > 0, keep_m, True)
-
-    masked = jnp.where(keep, logits, _NEG_INF)
-    if seeds is None:
-        sampled = jax.random.categorical(key, masked / temp)
-    else:
+    def draw(masked):
+        if seeds is None:
+            return jax.random.categorical(key, masked / temp).astype(jnp.int32)
         # per-slot keys: seeded slots fold (seed, position) off a fixed base
         # so their stream ignores batch placement; unseeded fold the slot
         # index off the engine's window key
@@ -138,10 +110,53 @@ def sample_tokens(
             return jax.lax.cond(seed != 0, lambda: seeded, lambda: unseeded)
 
         keys = jax.vmap(slot_key)(jnp.arange(B, dtype=jnp.int32), seeds, pos)
-        sampled = jax.vmap(
+        return jax.vmap(
             lambda k_, row: jax.random.categorical(k_, row)
-        )(keys, masked / temp)
-    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+        )(keys, masked / temp).astype(jnp.int32)
+
+    def filtered():
+        # Sort once (descending); top-k and top-p become rank/cdf thresholds.
+        sorted_logits = -jnp.sort(-logits, axis=-1)  # [B, V] descending
+
+        # top-k: keep entries with logit >= k-th largest value
+        k = jnp.where(top_k > 0, jnp.clip(top_k, 1, V), V)
+        kth_value = jnp.take_along_axis(sorted_logits, (k - 1)[:, None], axis=-1)
+        keep_k = logits >= kth_value
+
+        # top-p: over the sorted distribution (temperature-scaled), keep the
+        # prefix whose cumulative probability is < p (always keeping the first)
+        sorted_probs = jax.nn.softmax(sorted_logits / temp, axis=-1)
+        cum = jnp.cumsum(sorted_probs, axis=-1)
+        sorted_keep = (cum - sorted_probs) < top_p[:, None]  # prefix incl. first
+        num_keep = jnp.maximum(jnp.sum(sorted_keep, axis=-1), 1)
+        p_value = jnp.take_along_axis(sorted_logits, (num_keep - 1)[:, None], axis=-1)
+        keep_p = logits >= p_value
+
+        keep = keep_k & keep_p
+        if min_p is not None:
+            # keep tokens whose (tempered) prob >= min_p * max prob: in logit
+            # space, logit/temp >= max/temp + log(min_p)
+            max_l = jnp.max(logits, axis=-1, keepdims=True)
+            thresh = max_l / temp + jnp.log(jnp.maximum(min_p, 1e-10))[:, None]
+            keep_m = (logits / temp) >= thresh
+            keep = keep & jnp.where(min_p[:, None] > 0, keep_m, True)
+        return draw(jnp.where(keep, logits, _NEG_INF))
+
+    # Runtime-gated fast paths (lax.cond executes one branch on TPU): the
+    # full-vocab sort/cumsum machinery only runs when some slot has an active
+    # filter (with none, the keep-mask is all-true, so `draw(logits)` is
+    # bit-identical), and RNG runs only when some slot actually samples.
+    need_filter = jnp.any((top_k > 0) | (top_p < 1.0))
+    if min_p is not None:
+        need_filter |= jnp.any(min_p > 0)
+    any_sampling = jnp.any(temperature > 0)
+
+    sampled = jax.lax.cond(
+        any_sampling,
+        lambda: jax.lax.cond(need_filter, filtered, lambda: draw(logits)),
+        lambda: greedy,
+    )
+    return jnp.where(temperature > 0, sampled, greedy)
 
 
 LOGPROBS_K = 20  # top alternatives computed on device (= the OpenAI API max)

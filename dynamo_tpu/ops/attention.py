@@ -205,8 +205,19 @@ def dispatch_paged_decode_attention(q, k_pages, v_pages, page_tables, positions,
     head-parallel, so each device handles its Hq/Hkv shard with no
     communication (GSPMD cannot partition a pallas_call by itself)."""
     if use_pallas_decode(q.shape[-1], k_pages.shape[2]):
-        from dynamo_tpu.ops.pallas.paged_attention import paged_decode_attention_pallas
+        import os
 
+        from dynamo_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention_pallas,
+            paged_decode_attention_pallas_chunked,
+        )
+
+        # perseq (default): one grid program per sequence, double-buffered
+        # per-page DMA — fastest on v5e across bs 8-128 (A/B'd on chip).
+        # chunked: C pages per DMA group + larger matmuls (kept for A/B;
+        # VMEM-safe, unlike a full cross-sequence batching of the scratch).
+        if os.environ.get("DYNTPU_DECODE_KERNEL", "perseq") == "chunked":
+            paged_decode_attention_pallas = paged_decode_attention_pallas_chunked
         interpret = not _on_tpu()
         tp = 1 if mesh is None else mesh.shape.get("tp", 1)
         if tp > 1:

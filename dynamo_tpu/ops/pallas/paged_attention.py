@@ -114,6 +114,157 @@ def _kernel(
     out_ref[0] = out.reshape(Hq, D).astype(out_ref.dtype)
 
 
+def _kernel_chunked(
+    # scalar prefetch
+    page_tables_ref,  # [B, max_pages] SMEM
+    lengths_ref,  # [B] SMEM
+    # inputs
+    q_ref,  # [1, Hq, D] VMEM (this sequence's query)
+    k_hbm,  # [P, ps, Hkv, D] HBM
+    v_hbm,  # [P, ps, Hkv, D] HBM
+    # output
+    out_ref,  # [1, Hq, D] VMEM
+    # scratch
+    k_scratch,  # [2, C, ps, Hkv, D] VMEM
+    v_scratch,  # [2, C, ps, Hkv, D] VMEM
+    sems,  # DMA sems [2, C, 2]
+    *,
+    page_size: int,
+    chunk: int,
+):
+    """Per-sequence grid, C pages per loop iteration: the C k/v DMAs of a
+    chunk are all in flight together (hides HBM latency) and the softmax
+    update contracts [Hkv, G, D] x [Hkv, C*ps, D] — C*ps context positions
+    per MXU call instead of ps (the one-page version's 2x16 dots use a
+    vanishing fraction of the 128x128 MXU tile and run overhead-bound)."""
+    b = pl.program_id(0)
+    length = lengths_ref[b]
+    n_pages = jnp.maximum(1, pl.cdiv(length, page_size))
+    C = chunk
+    n_chunks = pl.cdiv(n_pages, C)
+
+    Hq, D = q_ref.shape[1], q_ref.shape[2]
+    Hkv = k_hbm.shape[2]
+    G = Hq // Hkv
+    q = q_ref[0].reshape(Hkv, G, D)  # native dtype: MXU takes bf16 directly
+    scale = 1.0 / jnp.sqrt(jnp.float32(D))
+
+    def dma(slot, j, page_idx, which):
+        hbm, scratch = (k_hbm, k_scratch) if which == 0 else (v_hbm, v_scratch)
+        return pltpu.make_async_copy(
+            hbm.at[page_tables_ref[b, page_idx]],
+            scratch.at[slot, j],
+            sems.at[slot, j, which],
+        )
+
+    def start_chunk(slot, c):
+        for j in range(C):  # static unroll
+            @pl.when(c * C + j < n_pages)
+            def _(j=j):
+                dma(slot, j, c * C + j, 0).start()
+                dma(slot, j, c * C + j, 1).start()
+
+    def wait_chunk(slot, c):
+        for j in range(C):
+            @pl.when(c * C + j < n_pages)
+            def _(j=j):
+                dma(slot, j, c * C + j, 0).wait()
+                dma(slot, j, c * C + j, 1).wait()
+
+    start_chunk(0, 0)
+
+    def body(c, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(c, 2)
+        next_slot = jax.lax.rem(c + 1, 2)
+
+        @pl.when(c + 1 < n_chunks)
+        def _():
+            start_chunk(next_slot, c + 1)
+
+        wait_chunk(slot, c)
+
+        N = C * page_size
+        # [C, ps, Hkv, D] -> [N, Hkv, D] (leading-dim merge: layout-preserving)
+        # -> [Hkv, N, D] (one bf16 relayout per chunk)
+        kt = jnp.transpose(k_scratch[slot].reshape(N, Hkv, D), (1, 0, 2))
+        vt = jnp.transpose(v_scratch[slot].reshape(N, Hkv, D), (1, 0, 2))
+        idx = c * N + jax.lax.broadcasted_iota(jnp.int32, (1, 1, N), 2)
+        vidx = c * N + jax.lax.broadcasted_iota(jnp.int32, (1, N, 1), 1)
+
+        # [Hkv, G, N] = [Hkv, G, D] x [Hkv, N, D]
+        scores = jax.lax.dot_general(
+            q, kt, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
+        ) * scale
+        # beyond-length/unfetched tail: mask K scores outright and zero V so
+        # 0-weight garbage (or uninitialized first-call VMEM) can't poison
+        # acc via 0 * NaN
+        scores = jnp.where(idx < length, scores, _NEG_INF)
+        vt = jnp.where(vidx < length, vt, 0)
+
+        chunk_max = jnp.max(scores, axis=-1)  # [Hkv, G]
+        new_m = jnp.maximum(m, chunk_max)
+        corr = jnp.exp(m - new_m)
+        probs = jnp.exp(scores - new_m[..., None])  # [Hkv, G, N]
+        new_l = l * corr + jnp.sum(probs, axis=-1)
+        # [Hkv, G, D] = [Hkv, G, N] x [Hkv, N, D]; probs in the pages' dtype
+        chunk_out = jax.lax.dot_general(
+            probs.astype(kt.dtype), vt, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        new_acc = acc * corr[..., None] + chunk_out
+        return new_m, new_l, new_acc
+
+    m0 = jnp.full((Hkv, G), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((Hkv, G), jnp.float32)
+    acc0 = jnp.zeros((Hkv, G, D), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_chunks, body, (m0, l0, acc0))
+
+    out = acc / jnp.maximum(l, 1e-20)[..., None]
+    out_ref[0] = out.reshape(Hq, D).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_decode_attention_pallas_chunked(
+    q: jnp.ndarray,  # [B, Hq, D]
+    k_pages: jnp.ndarray,  # [P, ps, Hkv, D]
+    v_pages: jnp.ndarray,  # [P, ps, Hkv, D]
+    page_tables: jnp.ndarray,  # [B, max_pages] int32
+    positions: jnp.ndarray,  # [B] int32 query positions
+    interpret: bool = False,
+) -> jnp.ndarray:
+    B, Hq, D = q.shape
+    P, ps, Hkv, _ = k_pages.shape
+    max_pages = page_tables.shape[1]
+    lengths = positions.astype(jnp.int32) + 1
+    # ~256 context positions per chunk: MXU-worthy contraction length while
+    # 2 x 2 x C pages of scratch stay tiny vs VMEM
+    chunk = max(1, min(max_pages, -(-256 // ps)))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, chunk, ps, Hkv, D), k_pages.dtype),
+            pltpu.VMEM((2, chunk, ps, Hkv, D), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, chunk, 2)),
+        ],
+    )
+    kernel = pl.pallas_call(
+        functools.partial(_kernel_chunked, page_size=ps, chunk=chunk),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )
+    return kernel(page_tables.astype(jnp.int32), lengths, q, k_pages, v_pages)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def paged_decode_attention_pallas(
     q: jnp.ndarray,  # [B, Hq, D]

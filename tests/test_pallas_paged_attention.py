@@ -177,3 +177,18 @@ def test_prefill_dispatch_tp2_shard_map(monkeypatch):
         lambda *a: dispatch_paged_prefill_attention(*a, mesh=mesh)
     )(q, k, v, pt, pos)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5)
+
+
+def test_pallas_chunked_matches_reference():
+    from dynamo_tpu.ops.pallas.paged_attention import (
+        paged_decode_attention_pallas_chunked,
+    )
+
+    for B, Hq, Hkv, seed in [(3, 4, 2, 0), (8, 16, 8, 1), (2, 8, 8, 5)]:
+        q, k, v, pt, pos = make_case(B=B, Hq=Hq, Hkv=Hkv, seed=seed)
+        pos = jnp.asarray(np.random.default_rng(seed).integers(0, 15, B), jnp.int32)
+        ref = paged_decode_attention(q, k, v, pt, pos)
+        got = paged_decode_attention_pallas_chunked(q, k, v, pt, pos, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), atol=2e-5, err_msg=f"B={B} Hq={Hq}"
+        )

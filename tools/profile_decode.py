@@ -1,0 +1,147 @@
+"""Decompose decode-window time on the real chip.
+
+Times, per decode step at the bench config (1.3B llama-shaped, bs=8):
+  window   — full dispatch_decode_window (model + sampling + feedback)
+  model    — scan of model.decode alone (argmax feedback, no sampler)
+  sampler  — scan of sample_tokens alone on [B, V] logits
+  matmul   — weight-streaming floor: one scan step touching all params
+
+Usage: python tools/profile_decode.py  (on the default/TPU backend)
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+import bench  # noqa: E402  (repo-root bench config = single source of truth)
+
+
+def timed(fn, n=3):
+    import jax
+
+    fn()  # compile
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.monotonic()
+        jax.block_until_ready(fn())
+        best = min(best, time.monotonic() - t0)
+    return best
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.model_runner import ModelRunner
+    from dynamo_tpu.engine.sampling import sample_tokens
+    from dynamo_tpu.models.registry import load_model
+
+    bench._probe_pallas()
+    cfg = bench.bench_config()
+    K = cfg.decode_steps
+    B = cfg.max_seqs
+    model, params = load_model(cfg.model_id)
+    runner = ModelRunner(cfg, model, params)
+    V = model.config.vocab_size
+    ctx = bench.PROMPT_LEN + bench.DECODE_TOKENS // 2
+
+    pages_per_seq = -(-ctx // cfg.page_size)
+    pt = np.zeros((B, cfg.max_pages_per_seq), np.int32)
+    for i in range(B):
+        pt[i, :pages_per_seq] = 1 + i * pages_per_seq + np.arange(pages_per_seq)
+    positions = np.full(B, ctx, np.int32)
+    active = np.ones(B, bool)
+    limits = np.full(B, ctx + K, np.int32)
+    temps = np.zeros(B, np.float32)
+    top_ks = np.zeros(B, np.int32)
+    top_ps = np.ones(B, np.float32)
+
+    # ---- full window through the runner (greedy, like the bench) ----
+    def window():
+        out = runner.dispatch_decode_window(
+            positions, pt, active, limits, temps, top_ks, top_ps, K
+        )
+        return out
+
+    t_window = timed(window)
+
+    # ---- model.decode alone, argmax feedback ----
+    pt_j = jnp.asarray(pt)
+    pos0 = jnp.asarray(positions)
+    act = jnp.asarray(active)
+
+    def model_only(params, kv, toks0):
+        def body(carry, _):
+            toks, pos = carry
+            logits, _kv = model.decode(params, kv, toks, pos, pt_j, act)
+            toks = jnp.argmax(logits, -1).astype(jnp.int32)
+            return (toks, pos + 1), ()
+
+        (toks, _), _ = jax.lax.scan(body, (toks0, pos0), None, length=K)
+        return toks
+
+    model_jit = jax.jit(model_only)
+    toks0 = jnp.zeros(B, jnp.int32)
+    t_model = timed(lambda: model_jit(runner.params, runner.kv_cache, toks0))
+
+    # ---- sampler alone (greedy path, same trace as the bench) ----
+    logits = jnp.asarray(np.random.default_rng(0).normal(size=(B, V)), jnp.float32)
+
+    def sampler_only(logits, key):
+        def body(key, _):
+            key, sub = jax.random.split(key)
+            toks = sample_tokens(
+                logits, sub,
+                jnp.zeros(B, jnp.float32), jnp.zeros(B, jnp.int32),
+                jnp.ones(B, jnp.float32), min_p=jnp.zeros(B, jnp.float32),
+            )
+            return key, toks
+
+        _, toks = jax.lax.scan(body, key, None, length=K)
+        return toks
+
+    sampler_jit = jax.jit(sampler_only)
+    t_sampler = timed(lambda: sampler_jit(logits, jax.random.key(0)))
+
+    # ---- weight-streaming floor: dot every param against a vector ----
+    flat = jax.tree_util.tree_leaves(runner.params)
+    total_bytes = sum(l.size * l.dtype.itemsize for l in flat)
+
+    def touch(params, x):
+        def body(acc, _):
+            s = acc
+            for l in jax.tree_util.tree_leaves(params):
+                s = s + jnp.sum(l.reshape(-1, l.shape[-1]).astype(jnp.bfloat16) @ x[: l.shape[-1]])
+            return s, ()
+
+        s, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), None, length=K)
+        return s
+
+    x = jnp.ones((8192, 1), jnp.bfloat16)
+    touch_jit = jax.jit(touch)
+    t_touch = timed(lambda: touch_jit(runner.params, x))
+
+    ms = lambda t: round(t / K * 1e3, 3)
+    out = {
+        "per_step_ms": {
+            "window": ms(t_window),
+            "model_only": ms(t_model),
+            "sampler_only": ms(t_sampler),
+            "weight_touch_floor": ms(t_touch),
+        },
+        "window_tok_s_bs8": round(B * K / t_window, 1),
+        "param_bytes": total_bytes,
+        "hbm_roofline_steps_s": round(819e9 / total_bytes, 1),
+        "K": K,
+        "B": B,
+        "ctx": ctx,
+    }
+    print(out)
+
+
+if __name__ == "__main__":
+    main()
