@@ -178,23 +178,174 @@ async def run_config(batch: int, page_size: int, rounds: int = 3) -> dict:
     }
 
 
+async def _request(eng, rid, prompt, max_tokens=8):
+    from dynamo_tpu.engine.sampling import SamplingParams
+    from dynamo_tpu.engine.scheduler import EngineRequest
+
+    req = EngineRequest(
+        request_id=rid, token_ids=list(prompt),
+        sampling=SamplingParams(temperature=0.0, max_tokens=max_tokens, ignore_eos=True),
+    )
+    t0 = time.monotonic()
+    ttft, toks, cached = None, [], 0
+    async for out in eng.generate(req):
+        if out.token is not None and ttft is None:
+            ttft = time.monotonic() - t0
+        if out.token is not None:
+            toks.append(out.token)
+        cached = max(cached, out.cached_tokens)
+    if ttft is None:
+        raise RuntimeError(f"bench request {rid} yielded no tokens")
+    return toks, ttft, cached
+
+
+def _parity_config(**over):
+    from dynamo_tpu.engine.config import EngineConfig
+
+    d = dict(
+        model_id=json_model_id(), page_size=64, num_pages=384, max_seqs=4,
+        max_model_len=4096, prefill_buckets=(512, 1024, 2048),
+        decode_steps=8, pipeline_depth=2,
+    )
+    d.update(over)
+    return EngineConfig(**d)
+
+
+async def run_routing_parity(n_workers=2, sessions=4, turns=3) -> dict:
+    """BASELINE.md parity checkpoint: KV-aware routing vs random on
+    prefix-heavy multi-turn traffic across two colocated engines.
+
+    Reports both wall TTFT (compressed on this testbed by the ~100 ms tunnel
+    RTT floor every request pays) and recomputed prefill tokens — the actual
+    TTFT driver the reference's 3x claim comes from."""
+    import gc
+    import random
+
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.llm.kv_router.indexer import KvIndexer, RouterEvent
+
+    async def workload(kv_aware: bool):
+        indexer = KvIndexer(kv_block_size=64)
+        engines = []
+        for i in range(n_workers):
+            sink = (lambda wid: (
+                lambda ev: indexer.apply_event(RouterEvent(worker_id=wid, event=ev))
+            ))(i)
+            eng = AsyncJaxEngine(_parity_config(), kv_event_sink=sink)
+            await eng.start()
+            engines.append(eng)
+        rng = random.Random(7)
+        rr = np.random.default_rng(3)
+        hist = {s: rr.integers(1, 31000, 1536).tolist() for s in range(sessions)}
+        for s in range(sessions):
+            await _request(engines[s % n_workers], f"seed{kv_aware}-{s}", hist[s])
+        ttfts, recompute = [], 0
+        for t in range(turns):
+            for s in range(sessions):
+                prompt = hist[s]
+                if kv_aware:
+                    scores = indexer.find_matches_for_request(prompt).scores
+                    wid = max(scores, key=scores.get) if scores else rng.randrange(n_workers)
+                else:
+                    wid = rng.randrange(n_workers)
+                toks, ttft, cached = await _request(engines[wid], f"{kv_aware}r{t}-{s}", prompt)
+                ttfts.append(ttft)
+                recompute += len(prompt) - cached
+                hist[s] = (prompt + toks + [11 + t])[:2048]
+        for e in engines:
+            await e.shutdown()
+        engines.clear()
+        gc.collect()
+        return float(np.median(ttfts)), recompute
+
+    t_kv, rc_kv = await workload(True)
+    t_rand, rc_rand = await workload(False)
+    return {
+        "ttft_kv_aware_ms": round(t_kv * 1e3, 1),
+        "ttft_random_ms": round(t_rand * 1e3, 1),
+        "ttft_ratio": round(t_rand / t_kv, 2),
+        "recomputed_prefill_tokens_kv_aware": rc_kv,
+        "recomputed_prefill_tokens_random": rc_rand,
+        "recompute_ratio": round(rc_rand / max(1, rc_kv), 1),
+        "target": "recompute_ratio >= 3 (BASELINE.md: reference claims 3x TTFT)",
+        "note": "wall TTFT compressed by ~100ms tunneled-PJRT RTT floor per request",
+    }
+
+
+async def run_offload_parity(sessions=3, plen=512) -> dict:
+    """BASELINE.md parity checkpoint: host-DRAM KV offload on multi-turn
+    revisit traffic, device pool sized so revisits need the host tier.
+
+    On this testbed host<->device block movement rides the PJRT tunnel
+    (~13 MB/s vs local PCIe on a real TPU-VM), so wall TTFT is reported but
+    the honest signal is restored-vs-recomputed prefix tokens."""
+    import gc
+
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+
+    async def workload(host_blocks: int):
+        eng = AsyncJaxEngine(_parity_config(
+            num_pages=20, max_seqs=2, max_model_len=1024,
+            prefill_buckets=(512,), host_cache_blocks=host_blocks,
+        ))
+        await eng.start()
+        rr = np.random.default_rng(5)
+        prompts = {s: rr.integers(1, 31000, plen).tolist() for s in range(sessions)}
+        for s in range(sessions):
+            await _request(eng, f"h{host_blocks}-v1-{s}", prompts[s])
+        ttfts, cacheds = [], []
+        for s in range(sessions):
+            _, ttft, cached = await _request(eng, f"h{host_blocks}-v2-{s}", prompts[s])
+            ttfts.append(ttft)
+            cacheds.append(cached)
+        loads = eng.offload.loads if eng.offload else 0
+        await eng.shutdown()
+        del eng
+        gc.collect()
+        return float(np.median(ttfts)), int(np.sum(cacheds)), loads
+
+    t_on, cached_on, loads = await workload(256)
+    t_off, cached_off, _ = await workload(0)
+    return {
+        "ttft_offload_ms": round(t_on * 1e3, 1),
+        "ttft_no_offload_ms": round(t_off * 1e3, 1),
+        "revisit_tokens_restored_with_offload": cached_on,
+        "revisit_tokens_restored_without": cached_off,
+        "host_block_loads": loads,
+        "target": "restored > 0 vs 0 (BASELINE.md: reference claims 1.4x TTFT)",
+        "note": (
+            "host<->device KV bytes ride the PJRT tunnel here (~13 MB/s); on a "
+            "real TPU-VM this is local PCIe and the restore wins over recompute"
+        ),
+    }
+
+
 async def run() -> dict:
+    import os
+
     _probe_pallas(HEADLINE[1])
     head = await run_config(*HEADLINE)
     cont = await run_config(*CONTINUITY)
+    detail = {
+        "headline_bs%d_ps%d" % HEADLINE: head,
+        "continuity_bs%d_ps%d" % CONTINUITY: cont,
+        "prompt_len": PROMPT_LEN,
+        "decode_tokens": DECODE_TOKENS,
+        "devices": 1,
+        "r01_value_bs8": 1341.84,
+    }
+    if os.environ.get("DYNTPU_BENCH_PARITY", "1") != "0":
+        import gc
+
+        gc.collect()
+        detail["parity_kv_routing"] = await run_routing_parity()
+        detail["parity_host_offload"] = await run_offload_parity()
     return {
         "metric": "engine_decode_throughput_llama1.3b_bf16",
         "value": head["tok_s"],
         "unit": "out_tok/s/chip",
         "vs_baseline": round(head["tok_s"] / PARITY_TARGET_TOK_S, 3),
-        "detail": {
-            "headline_bs%d_ps%d" % HEADLINE: head,
-            "continuity_bs%d_ps%d" % CONTINUITY: cont,
-            "prompt_len": PROMPT_LEN,
-            "decode_tokens": DECODE_TOKENS,
-            "devices": 1,
-            "r01_value_bs8": 1341.84,
-        },
+        "detail": detail,
     }
 
 

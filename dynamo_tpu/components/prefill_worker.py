@@ -39,6 +39,8 @@ async def _main(args) -> None:
             tp=args.tp,
             num_pages=args.num_pages,
             max_seqs=args.max_seqs,
+            page_size=args.page_size,
+            max_model_len=args.max_model_len,
         )
     )
     await engine.start()
@@ -60,6 +62,8 @@ def main(argv=None) -> None:
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--num-pages", type=int, default=512)
     p.add_argument("--max-seqs", type=int, default=8)
+    p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--max-model-len", type=int, default=2048)
     p.add_argument("--cplane", default=None)
     asyncio.run(_main(p.parse_args(argv)))
 

@@ -95,7 +95,13 @@ class WorkerService:
             await self.engine.shutdown()
 
     def _stats(self) -> dict:
-        return {"kv_metrics": self._inner_engine.metrics().to_wire()}
+        stats = {"kv_metrics": self._inner_engine.metrics().to_wire()}
+        if self.enable_disagg_decode and self.engine is not None:
+            stats["disagg"] = {
+                "remote_prefills": self.engine.remote_prefills,
+                "local_prefills": self.engine.local_prefills,
+            }
+        return stats
 
     async def _handle(self, request: dict):
         pre = PreprocessedRequest.from_wire(request)
@@ -109,3 +115,65 @@ class WorkerService:
                 "cached_tokens": out.cached_tokens,
                 "logprobs": out.logprobs,
             }
+
+
+async def _main(args) -> None:
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    drt = DistributedRuntime(cplane_address=args.cplane)
+    await drt.connect()
+    if args.model.startswith("tiny"):
+        card = ModelDeploymentCard.for_tiny(args.model)
+    else:
+        card = ModelDeploymentCard.from_local_path(args.model)
+    svc = WorkerService(
+        drt,
+        args.namespace,
+        args.component,
+        card,
+        EngineConfig.for_model(
+            args.model,
+            tp=args.tp,
+            page_size=args.page_size,
+            num_pages=args.num_pages,
+            max_seqs=args.max_seqs,
+            max_model_len=args.max_model_len,
+        ),
+        enable_disagg_decode=args.disagg,
+    )
+    await svc.start()
+    log.info(
+        "worker up: model=%s endpoint=dyn://%s.%s.%s disagg=%s",
+        card.display_name, args.namespace, args.component, GENERATE_ENDPOINT, args.disagg,
+    )
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    finally:
+        await svc.stop()
+
+
+def main(argv=None) -> None:
+    """Plain-process decode/aggregated worker (helm: worker.yaml; the SDK
+    graph variants live in examples/graphs/)."""
+    import argparse
+    import os
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("model", help="model path or tiny:{...} spec")
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--component", default="backend")
+    p.add_argument("--cplane", default=os.environ.get("DYNTPU_CPLANE", "127.0.0.1:4222"))
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--num-pages", type=int, default=512)
+    p.add_argument("--max-seqs", type=int, default=8)
+    p.add_argument("--max-model-len", type=int, default=2048)
+    p.add_argument("--disagg", action="store_true", help="wrap in the disagg decode path")
+    args = p.parse_args(argv)
+    asyncio.run(_main(args))
+
+
+if __name__ == "__main__":
+    main()

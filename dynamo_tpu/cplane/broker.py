@@ -377,7 +377,9 @@ class Broker:
 
     def _op_queue_depth(self, conn: _Conn, msg: dict) -> dict:
         return {"depth": len(self._queues[msg["queue"]]),
-                "inflight": sum(1 for (q, _) in self._inflight if q == msg["queue"])}
+                "inflight": sum(1 for (q, _) in self._inflight if q == msg["queue"]),
+                # parked pulls: readiness signal that a consumer is listening
+                "waiters": len(self._queue_waiters[msg["queue"]])}
 
     def _op_ping(self, conn: _Conn, msg: dict) -> dict:
         return {"now": time.time()}

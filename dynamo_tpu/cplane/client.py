@@ -276,8 +276,12 @@ class CplaneClient:
         await self._request({"op": "queue_nack", "queue": queue, "msg_id": msg_id})
 
     async def queue_depth(self, queue: str) -> int:
-        r = await self._request({"op": "queue_depth", "queue": queue})
-        return r["depth"]
+        return (await self.queue_info(queue))["depth"]
+
+    async def queue_info(self, queue: str) -> dict:
+        """{depth, inflight, waiters} — waiters counts parked pulls (a live
+        consumer is listening)."""
+        return await self._request({"op": "queue_depth", "queue": queue})
 
     async def ping(self) -> float:
         r = await self._request({"op": "ping"})

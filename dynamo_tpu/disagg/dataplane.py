@@ -1,0 +1,179 @@
+"""Dedicated KV-block data plane for cross-process disaggregation.
+
+The reference moves KV blocks between engine processes with NIXL RDMA WRITEs
+plus completion notifications, off the control plane (reference: container/
+deps/vllm/vllm_v0.7.2-dynamo-kv-disagg-patch.patch ``nixl.py`` —
+``read_blocks``/``get_notifs``; docs/disagg_serving.md:83 non-blocking
+property). The TPU-native analogue: bulk KV bytes ride a dedicated TCP
+socket between the prefill and decode processes — never inside the
+control-plane response message — and land in a per-request mailbox whose
+future IS the completion notification. On-pod (same-process) transfers keep
+using the device-array hub (dynamo_tpu/disagg/ici.py); this module is the
+cross-process / cross-host path.
+
+Wire format per transfer (one stream, sequential transfers per connection):
+
+    u32 header_len | msgpack header | payload bytes
+
+    header = {request_id, shape, dtype, xxh3}  (xxh3 of the payload)
+
+The server never blocks the sender on the consumer: payloads for requests
+nobody expects (cancelled, duplicate) are received and dropped.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Optional
+
+import msgpack
+import numpy as np
+import xxhash
+
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("disagg.dataplane")
+
+_LEN = struct.Struct("<I")
+MAX_HEADER = 1 << 20
+
+
+class KvDataPlaneServer:
+    """Decode-side listener: framed KV payloads -> per-request futures."""
+
+    def __init__(self, host: str = "0.0.0.0", advertise_host: Optional[str] = None):
+        self.host = host
+        self.advertise_host = advertise_host
+        self.port: Optional[int] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._expected: dict[str, asyncio.Future] = {}
+        self.received = 0
+        self.dropped = 0
+
+    @property
+    def address(self) -> str:
+        host = self.advertise_host
+        if host is None:
+            if self.host in ("0.0.0.0", "::"):
+                # wildcard bind: advertise a cross-host-reachable name (same
+                # policy as the response plane, runtime/tcp.py)
+                import socket
+
+                host = socket.gethostname()
+            else:
+                host = self.host
+        return f"{host}:{self.port}"
+
+    async def start(self, port: int = 0) -> "KvDataPlaneServer":
+        self._server = await asyncio.start_server(self._on_conn, self.host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.info("kv data plane listening on %s", self.address)
+        return self
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for fut in self._expected.values():
+            if not fut.done():
+                fut.cancel()
+        self._expected.clear()
+
+    # ---------------- consumer API ----------------
+
+    def expect(self, request_id: str) -> None:
+        """Register interest BEFORE the remote prefill is requested, so an
+        early-arriving payload parks instead of being dropped."""
+        if request_id not in self._expected:
+            self._expected[request_id] = asyncio.get_running_loop().create_future()
+
+    async def receive(self, request_id: str, timeout: float = 120.0) -> np.ndarray:
+        fut = self._expected.get(request_id)
+        if fut is None:
+            raise RuntimeError(f"receive() without expect() for {request_id}")
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._expected.pop(request_id, None)
+
+    def abandon(self, request_id: str) -> None:
+        """Cancellation: stop waiting; a late payload is received and dropped."""
+        fut = self._expected.pop(request_id, None)
+        if fut is not None and not fut.done():
+            fut.cancel()
+
+    # ---------------- wire ----------------
+
+    async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        peer = writer.get_extra_info("peername")
+        try:
+            while True:
+                raw = await reader.readexactly(_LEN.size)
+                (hlen,) = _LEN.unpack(raw)
+                if hlen > MAX_HEADER:
+                    raise ValueError(f"kv header too large: {hlen}")
+                header = msgpack.unpackb(await reader.readexactly(hlen))
+                dtype = np.dtype(header["dtype"])
+                shape = tuple(header["shape"])
+                nbytes = dtype.itemsize * int(np.prod(shape))
+                payload = await reader.readexactly(nbytes)
+                if xxhash.xxh3_64_intdigest(payload) != header["xxh3"]:
+                    raise ValueError("kv payload checksum mismatch")
+                rid = header["request_id"]
+                fut = self._expected.get(rid)
+                if fut is not None and not fut.done():
+                    fut.set_result(np.frombuffer(payload, dtype).reshape(shape))
+                    self.received += 1
+                else:
+                    self.dropped += 1
+                    log.debug("dropping unexpected kv payload for %s", rid)
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        except Exception:
+            log.exception("kv data plane connection from %s failed", peer)
+        finally:
+            writer.close()
+
+
+class KvDataPlaneClient:
+    """Prefill-side sender with pooled connections per destination."""
+
+    def __init__(self):
+        self._conns: dict[str, tuple[asyncio.StreamReader, asyncio.StreamWriter]] = {}
+        self._locks: dict[str, asyncio.Lock] = {}
+        self.sent = 0
+
+    async def send(self, address: str, request_id: str, array: np.ndarray) -> None:
+        lock = self._locks.setdefault(address, asyncio.Lock())
+        async with lock:  # one in-flight transfer per destination connection
+            payload = np.ascontiguousarray(array).tobytes()
+            header = msgpack.packb(
+                {
+                    "request_id": request_id,
+                    "shape": list(array.shape),
+                    "dtype": str(array.dtype),
+                    "xxh3": xxhash.xxh3_64_intdigest(payload),
+                }
+            )
+            for attempt in (0, 1):  # one reconnect on a stale pooled socket
+                try:
+                    conn = self._conns.get(address)
+                    if conn is None:
+                        host, _, port = address.rpartition(":")
+                        conn = await asyncio.open_connection(host, int(port))
+                        self._conns[address] = conn
+                    _, writer = conn
+                    writer.write(_LEN.pack(len(header)) + header + payload)
+                    await writer.drain()
+                    self.sent += 1
+                    return
+                except (ConnectionError, OSError):
+                    self._conns.pop(address, None)
+                    if attempt:
+                        raise
+
+    async def close(self) -> None:
+        for _, writer in self._conns.values():
+            writer.close()
+        self._conns.clear()

@@ -53,6 +53,7 @@ class DisaggDecodeEngine:
         self.remote_prefill_timeout = remote_prefill_timeout
         self._pending: dict[str, asyncio.Future] = {}
         self._served = None
+        self.kv_server = None  # KvDataPlaneServer, started in start()
         # disagg stats
         self.remote_prefills = 0
         self.local_prefills = 0
@@ -62,6 +63,7 @@ class DisaggDecodeEngine:
     async def start(self) -> "DisaggDecodeEngine":
         """Serve the prefill_result endpoint prefill workers call home to."""
         from dynamo_tpu.disagg import ici
+        from dynamo_tpu.disagg.dataplane import KvDataPlaneServer
 
         ep = (
             self.drt.namespace(self.namespace)
@@ -70,6 +72,9 @@ class DisaggDecodeEngine:
         )
         self._served = await ep.serve_endpoint(self._on_prefill_result)
         await self.router.start_watching()
+        # dedicated bulk-KV listener: cross-process prefill workers stream
+        # block payloads here, off the control plane (disagg/dataplane.py)
+        self.kv_server = await KvDataPlaneServer().start()
         # same-pod prefill workers discover us here and use the device-to-device
         # (ICI) KV handoff instead of host-staged bytes
         ici.register_worker(self.worker_id)
@@ -81,6 +86,8 @@ class DisaggDecodeEngine:
         ici.unregister_worker(self.worker_id)
         if self._served is not None:
             await self._served.stop()
+        if self.kv_server is not None:
+            await self.kv_server.stop()
         await self.router.stop()
         await self.engine.shutdown()
 
@@ -152,6 +159,9 @@ class DisaggDecodeEngine:
         ici.clear_tombstone(tkey)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
+        # register interest on the data plane BEFORE the work is queued, so a
+        # fast prefill worker's payload parks instead of being dropped
+        self.kv_server.expect(rid)
         self.engine._register_stream(rid)
         adopted = False
         try:
@@ -170,11 +180,21 @@ class DisaggDecodeEngine:
                 decode_worker_id=self.worker_id,
                 decode_endpoint=f"dyn://{self.namespace}.{self.component}.{PREFILL_RESULT_ENDPOINT}",
                 skip_leading_tokens=shared_pages * self.engine.config.page_size,
+                kv_addr=self.kv_server.address,
             )
             await self.drt.cplane.queue_push(self.queue_name, rp.to_wire())
             result: PrefillResult = await asyncio.wait_for(fut, self.remote_prefill_timeout)
+            kv_data = None
+            if result.kv_mode == "socket" and result.kv_shape:
+                # the result message is the notification; the payload rides
+                # the dedicated socket and may land just after it
+                kv_data = await self.kv_server.receive(
+                    rid, timeout=self.remote_prefill_timeout
+                )
             await self.engine.run_on_engine(
-                lambda: self.engine.sync_adopt_prefilled(request, result, cached_len)
+                lambda: self.engine.sync_adopt_prefilled(
+                    request, result, cached_len, kv_data=kv_data
+                )
             )
             adopted = True
         finally:
@@ -183,6 +203,7 @@ class DisaggDecodeEngine:
             # parked (or still in-flight) ICI transfer and aborting through
             # the scheduler, since adoption may have completed on the engine
             # thread even though our await was cancelled
+            self.kv_server.abandon(rid)
             if not adopted:
                 self._pending.pop(rid, None)
                 ici.discard_transfer(tkey)

@@ -33,6 +33,9 @@ class PrefillWorker:
         self._task: Optional[asyncio.Task] = None
         self._clients: dict[str, object] = {}
         self.completed = 0
+        from dynamo_tpu.disagg.dataplane import KvDataPlaneClient
+
+        self.kv_client = KvDataPlaneClient()
 
     async def start(self) -> "PrefillWorker":
         self._task = asyncio.create_task(self._loop())
@@ -41,6 +44,7 @@ class PrefillWorker:
     async def stop(self) -> None:
         if self._task:
             self._task.cancel()
+        await self.kv_client.close()
 
     async def _client_for(self, endpoint: str):
         client = self._clients.get(endpoint)
@@ -71,10 +75,13 @@ class PrefillWorker:
     async def _handle(self, rp: RemotePrefillRequest) -> None:
         from dynamo_tpu.disagg import ici
 
-        # same-pod decode worker? hand the KV off as a device array (ICI path:
-        # blocks reshard onto the decode mesh without touching host memory);
-        # otherwise stage to host and ship bytes over the data plane (DCN path)
+        # same-process decode worker? hand the KV off as a device array (ICI
+        # path: blocks reshard onto the decode mesh without touching host
+        # memory). Cross-process with a kv_addr: bulk bytes ride the dedicated
+        # data-plane socket and the control message is the completion
+        # notification. Neither: legacy inline bytes in the result.
         device = ici.is_local(rp.decode_worker_id)
+        mode = "ici" if device else ("socket" if rp.kv_addr else "inline")
         tkey = ici.transfer_key(rp.decode_worker_id, rp.request_id) if device else ""
         if tkey:
             # a redelivered message must not be swallowed by a tombstone a
@@ -84,23 +91,37 @@ class PrefillWorker:
         result = None
         delivered = False
         try:
-            result = await self.engine.run_on_engine(
-                lambda: self.engine.sync_remote_prefill(rp, device=device)
+            result, host_data = await self.engine.run_on_engine(
+                lambda: self.engine.sync_remote_prefill(rp, mode=mode)
             )
             client = await self._client_for(rp.decode_endpoint)
-            # deliver directly to the requesting decode worker (the RDMA-WRITE
-            # + notify analogue)
-            stream = await client.direct(result.to_wire(), rp.decode_worker_id)
-            async for ack in stream:
-                if not ack.get("ok"):
-                    # permanent rejection (request cancelled/unknown on the
-                    # decode side): drop the work — nacking would redeliver a
-                    # poisoned message forever and starve the queue
-                    log.warning(
-                        "decode worker rejected prefill result for %s: %s",
-                        rp.request_id, ack,
-                    )
-                    return
+
+            async def deliver():
+                # deliver directly to the requesting decode worker (the
+                # RDMA-WRITE + notify analogue)
+                stream = await client.direct(result.to_wire(), rp.decode_worker_id)
+                async for ack in stream:
+                    if not ack.get("ok"):
+                        # permanent rejection (request cancelled/unknown on
+                        # the decode side): drop the work — nacking would
+                        # redeliver a poisoned message forever
+                        log.warning(
+                            "decode worker rejected prefill result for %s: %s",
+                            rp.request_id, ack,
+                        )
+                        return False
+                return True
+
+            if host_data is not None:
+                # payload BEFORE notification: a delivered result then implies
+                # the payload is on the wire, so a socket failure surfaces
+                # here (-> nack + redelivery) instead of stranding the decode
+                # side in a full receive() timeout after a notification whose
+                # payload will never arrive
+                await self.kv_client.send(rp.kv_addr, rp.request_id, host_data)
+            ok = await deliver()
+            if not ok:
+                return
             delivered = True
         except BaseException:
             if tkey and result is None:

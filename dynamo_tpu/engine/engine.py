@@ -188,18 +188,25 @@ class AsyncJaxEngine:
             if request_id in self.allocator._seqs:
                 self.allocator.free_sequence(request_id)
 
-    def sync_remote_prefill(self, rp, device: bool = False) -> "object":
+    def sync_remote_prefill(self, rp, device: bool = False, mode: str | None = None):
         """Prefill side: full chunked prefill in our own cache (prefix cache
         applies), then extract the requested block range.
 
-        device=False (DCN path): KV staged to host, returned as bytes in the
-        PrefillResult. device=True (same-pod ICI path): KV gathered into a
-        device array parked in the ici hub under the request id; the result
-        carries kv_transfer_id instead of bytes."""
+        Returns ``(PrefillResult, host_data_or_None)``. mode:
+          - "inline" — KV staged to host and serialized into the result
+            (legacy / tiny transfers)
+          - "ici" — same-process handoff: KV gathered into a device array
+            parked in the ici hub; result carries kv_transfer_id
+          - "socket" — KV staged to host and RETURNED alongside the result;
+            the caller ships it over the dedicated data plane
+            (disagg/dataplane.py) while the result message becomes the
+            completion notification"""
         from dynamo_tpu.disagg import ici
         from dynamo_tpu.engine.sampling import SamplingParams
         from dynamo_tpu.llm.remote_prefill import PrefillResult
 
+        if mode is None:
+            mode = "ici" if device else "inline"
         rid = f"rp-{rp.request_id}"
         prompt_len = len(rp.token_ids)
         cached_len, state = self.allocator.allocate_sequence(rid, list(rp.token_ids))
@@ -221,7 +228,7 @@ class AsyncJaxEngine:
             ids = state.pages[start_page:n_pages]
             data = None
             if ids:
-                if device:
+                if mode == "ici":
                     data = self.runner.extract_pages_device(np.asarray(ids, np.int32))
                 else:
                     data = self.runner.extract_pages(np.asarray(ids, np.int32))
@@ -229,37 +236,41 @@ class AsyncJaxEngine:
             self.allocator.free_sequence(rid)  # full blocks stay cached for reuse
 
         transfer_id = ""
-        if device and data is not None:
+        if mode == "ici" and data is not None:
             transfer_id = ici.transfer_key(rp.decode_worker_id, rp.request_id)
             if not ici.put_transfer(transfer_id, data):
                 transfer_id = ""  # consumer abandoned the request already
-        return PrefillResult(
+        result = PrefillResult(
             request_id=rp.request_id,
             first_token=int(first_token),
             prompt_len=prompt_len,
             skip_leading_tokens=start_page * ps,
             kv_shape=tuple(data.shape) if data is not None else (),
             kv_dtype=str(data.dtype) if data is not None else "",
-            kv_bytes=data.tobytes() if (data is not None and not device) else b"",
+            kv_bytes=data.tobytes() if (data is not None and mode == "inline") else b"",
             kv_transfer_id=transfer_id,
+            kv_mode=mode if data is not None else "inline",
         )
+        return result, (data if mode == "socket" else None)
 
-    def sync_adopt_prefilled(self, req: EngineRequest, result, cached_len: int):
+    def sync_adopt_prefilled(self, req: EngineRequest, result, cached_len: int, kv_data=None):
         """Decode side: inject received KV blocks into the pre-allocated pages
-        and enter the sequence into decode. KV arrives either as wire bytes
-        (DCN path) or as a device array via the ici hub (same-pod path)."""
+        and enter the sequence into decode. KV arrives as wire bytes (inline),
+        as a device array via the ici hub (same-pod path), or as a host array
+        the caller already pulled off the dedicated data-plane socket
+        (``kv_data``)."""
         from dynamo_tpu.disagg import ici
 
         state = self.allocator._seqs[req.request_id]
         ps = self.config.page_size
-        data = None
-        if result.kv_transfer_id:
+        data = kv_data
+        if data is None and result.kv_transfer_id:
             data = ici.pop_transfer(result.kv_transfer_id)
             if data is None:
                 raise RuntimeError(
                     f"ici transfer {result.kv_transfer_id} missing for {req.request_id}"
                 )
-        elif result.kv_bytes:
+        elif data is None and result.kv_bytes:
             data = result.kv_array()
         start_page = result.skip_leading_tokens // ps
         n_pages = -(-result.prompt_len // ps)

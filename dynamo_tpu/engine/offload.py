@@ -72,5 +72,41 @@ class HostKvPool:
         self.loads += 1
         return True
 
+    def load_many(self, pairs: list[tuple[int, int]]) -> set[int]:
+        """Inject host blocks into device pages with ONE device call.
+
+        The per-block path pays a full dispatch + host->device transfer round
+        trip per block — on a prefix-restore of N blocks that serializes N
+        round trips directly into TTFT. Only the CONTIGUOUS leading run of
+        hits is injected (a block may have been LRU-dropped between the
+        caller's membership check and this call — e.g. by a save() triggered
+        while allocating the destination pages — and blocks past the first
+        miss can't count as cached prefix anyway). Returns the hit hashes."""
+        hits: list[tuple[int, int]] = []
+        for h, p in pairs:
+            if h not in self._blocks:
+                break
+            hits.append((h, p))
+        if not hits:
+            return set()
+        axis = getattr(self.runner.model, "wire_n_axis", 2)
+        # pad the batch to a power of two so the donated scatter compiles a
+        # handful of shapes, not one per distinct prefix length; pad ids are
+        # out of range -> dropped by the scatter
+        n = len(hits)
+        bucket = 1 << (n - 1).bit_length()
+        data = np.concatenate([self._blocks[h] for h, _ in hits], axis=axis)
+        ids = np.full(bucket, np.iinfo(np.int32).max // 2, np.int32)
+        ids[:n] = [p for _, p in hits]
+        if bucket > n:
+            pad_shape = list(data.shape)
+            pad_shape[axis] = bucket - n
+            data = np.concatenate([data, np.zeros(pad_shape, data.dtype)], axis=axis)
+        self.runner.inject_pages(ids, data)
+        for h, _ in hits:
+            self._blocks.move_to_end(h)
+        self.loads += n
+        return {h for h, _ in hits}
+
     def discard(self, seq_hash: int) -> None:
         self._blocks.pop(seq_hash, None)

@@ -186,13 +186,27 @@ class PageAllocator:
         state.shared_prefix_pages = len(device_hits)
 
         try:
-            # host-tier blocks: fresh page + inject; re-registered on-device so
-            # later sequences share them again
-            for i, seq_hash in enumerate(host_hit_hashes):
+            # host-tier blocks: fresh pages first, then ONE batched inject for
+            # the whole prefix restore (the per-block path pays a dispatch +
+            # transfer round trip per block, serialized into TTFT);
+            # re-registered on-device so later sequences share them again
+            host_pairs: list[tuple[int, int]] = []
+            for seq_hash in host_hit_hashes:
                 page = self._pop_free_page()
                 self._refcount[page] = 1
                 state.pages.append(page)
-                self.offload.load(seq_hash, page)
+                host_pairs.append((seq_hash, page))
+            hit_hashes = self.offload.load_many(host_pairs) if host_pairs else set()
+            # only the contiguous restored prefix counts as cached: a block may
+            # have been LRU-dropped from the host pool while its destination
+            # page was being allocated (a save() can evict — load_many injects
+            # the leading run only); pages past the first miss just get
+            # overwritten by the prefill recompute
+            restored = 0
+            for seq_hash, page in host_pairs:
+                if seq_hash not in hit_hashes:
+                    break
+                restored += 1
                 self.offload.discard(seq_hash)
                 meta = self._offloaded_meta.pop(seq_hash, None)
                 if meta is not None:
@@ -200,7 +214,7 @@ class PageAllocator:
                     self._cache_meta[seq_hash] = meta
                     state.registered_hashes.append(seq_hash)
 
-            cached_len = (len(device_hits) + len(host_hit_hashes)) * self.page_size
+            cached_len = (len(device_hits) + restored) * self.page_size
 
             # 3. fresh pages for the rest of the prompt
             total_pages_needed = -(-len(prompt_tokens) // self.page_size)

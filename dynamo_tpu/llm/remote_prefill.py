@@ -29,6 +29,11 @@ class RemotePrefillRequest:
     # pages allocated on the decode side that must receive KV (logical order),
     # excluding any shared prefix pages the decode side already has
     skip_leading_tokens: int = 0
+    # decode worker's dedicated KV data-plane listener (host:port). When set
+    # and the prefill worker is NOT in the same process, the bulk KV payload
+    # rides this socket (disagg/dataplane.py) instead of the control-plane
+    # result message — the NIXL RDMA-WRITE analogue. Empty = legacy inline.
+    kv_addr: str = ""
 
     def to_wire(self) -> dict:
         return self.__dict__.copy()
@@ -51,6 +56,11 @@ class PrefillResult:
     # in dynamo_tpu.disagg.ici under this id and kv_bytes stays empty — the
     # decode side reshards it onto its mesh instead of deserializing bytes
     kv_transfer_id: str = ""
+    # how the KV payload travels: "inline" (kv_bytes in this message — legacy
+    # / tiny transfers), "ici" (device-array hub, same process), or "socket"
+    # (dedicated data-plane TCP stream; this message is the completion
+    # notification for a payload arriving on the decode worker's kv_addr)
+    kv_mode: str = "inline"
 
     def to_wire(self) -> dict:
         return {
@@ -62,6 +72,7 @@ class PrefillResult:
             "kv_dtype": self.kv_dtype,
             "kv_bytes": self.kv_bytes,
             "kv_transfer_id": self.kv_transfer_id,
+            "kv_mode": self.kv_mode,
         }
 
     @classmethod
@@ -75,6 +86,7 @@ class PrefillResult:
             kv_dtype=d["kv_dtype"],
             kv_bytes=d["kv_bytes"],
             kv_transfer_id=d.get("kv_transfer_id", ""),
+            kv_mode=d.get("kv_mode", "ici" if d.get("kv_transfer_id") else "inline"),
         )
 
     def kv_array(self) -> np.ndarray:

@@ -325,6 +325,8 @@ class DeepseekModel:
 
     # ---------------- disagg / offload wire format ----------------
 
+    wire_n_axis = 1  # see LlamaModel.wire_n_axis
+
     def gather_pages_wire(self, kv: dict, flat_ids: jnp.ndarray) -> jnp.ndarray:
         """[L, n] flat page ids -> wire array [L, n, ps, latent_dim_padded]
         (the physical 128-aligned row width; receivers must size buffers from

@@ -224,6 +224,10 @@ class LlamaModel:
     # The wire layout is the model's canonical block serialization for DCN
     # transfer and host offload; flat_ids is [L, n] (per-layer flat page ids).
 
+    # axis of the per-page (n) dimension in the wire arrays below — batched
+    # host-tier restores concatenate single-page blocks along it
+    wire_n_axis = 2
+
     def gather_pages_wire(self, kv: dict, flat_ids: jnp.ndarray) -> jnp.ndarray:
         """-> [L, 2, n, page_size, Hkv, D]."""
         return jnp.stack([kv["k"][flat_ids], kv["v"][flat_ids]], axis=1)

@@ -1,0 +1,180 @@
+"""Cross-PROCESS disaggregation: prefill worker and decode worker as separate
+OS processes — the topology the helm chart deploys (prefill-worker.yaml +
+worker.yaml) — with the broker between them and bulk KV riding the dedicated
+data-plane socket (disagg/dataplane.py), not the control-plane result message.
+
+Correctness bar: greedy generation through the 2-process disagg path is
+token-exact vs a single local engine (reference property:
+docs/disagg_serving.md — non-blocking block transfer + notification).
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dynamo_tpu.cplane.broker import Broker
+from dynamo_tpu.engine.sampling import SamplingParams
+from dynamo_tpu.engine.scheduler import EngineRequest
+from dynamo_tpu.llm.disagg_router import config_key
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+from tests.test_engine import _collect, tiny_engine_config
+
+pytestmark = pytest.mark.slow
+
+NS = "mp"
+ENGINE_ARGS = [
+    "--page-size", "4", "--num-pages", "128", "--max-seqs", "4",
+    "--max-model-len", "64",
+]
+
+
+def _spawn(module: str, *args: str) -> subprocess.Popen:
+    env = dict(os.environ)
+    env.setdefault("DYNTPU_LOG", "info")
+    env["PYTHONUNBUFFERED"] = "1"
+    return subprocess.Popen(
+        [sys.executable, "-m", module, *args],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+async def _wait_queue_consumer(cplane, queue: str, timeout: float = 90.0) -> None:
+    """The prefill worker is ready once it holds a parked pull on the queue."""
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        try:
+            info = await cplane.queue_info(queue)
+            if info.get("waiters", 0) > 0:
+                return
+        except Exception:
+            pass
+        await asyncio.sleep(0.25)
+    raise TimeoutError(f"no consumer on {queue}")
+
+
+def test_two_process_disagg_token_exact_and_cancel():
+    loop = asyncio.new_event_loop()
+    procs: list[subprocess.Popen] = []
+
+    async def body():
+        broker = Broker()
+        bport = await broker.start()
+        addr = f"127.0.0.1:{bport}"
+
+        drt = DistributedRuntime(cplane_address=addr)
+        await drt.connect()
+        # force every prompt longer than one block down the remote path
+        await drt.cplane.kv_put(
+            config_key("tiny"),
+            json.dumps({"max_local_prefill_length": 4, "max_prefill_queue_size": 64}).encode(),
+        )
+
+        procs.append(_spawn(
+            "dynamo_tpu.components.worker", "tiny", "--disagg",
+            "--namespace", NS, "--component", "backend", "--cplane", addr,
+            *ENGINE_ARGS,
+        ))
+        procs.append(_spawn(
+            "dynamo_tpu.components.prefill_worker", "tiny",
+            "--namespace", NS, "--cplane", addr, *ENGINE_ARGS,
+        ))
+
+        print("STAGE: workers spawned", flush=True)
+        client = await drt.endpoint_client(f"dyn://{NS}.backend.generate")
+        await client.wait_for_instances(timeout=120)
+        print("STAGE: instances up", flush=True)
+        await _wait_queue_consumer(drt.cplane, f"{NS}.prefill_queue.tiny")
+        print("STAGE: queue consumer up", flush=True)
+
+        # ---- token-exact vs a local engine ----
+        prompt = [7, 3, 9, 11, 2, 5, 8, 13, 21, 34, 6, 17, 25, 1, 4, 19]
+        pre = {
+            "request_id": "mp-1",
+            "token_ids": prompt,
+            "sampling": {"temperature": 0.0, "max_tokens": 8, "ignore_eos": True},
+            "model": "tiny",
+        }
+        got = []
+        print("STAGE: sending request", flush=True)
+        async for out in await client.random(pre):
+            got.extend(out.get("token_ids") or [])
+
+        from dynamo_tpu.engine.engine import AsyncJaxEngine
+
+        print("STAGE: got tokens", got, flush=True)
+        local = AsyncJaxEngine(tiny_engine_config())
+        await local.start()
+        expected, _, _ = await _collect(local, EngineRequest(
+            request_id="local-1", token_ids=list(prompt),
+            sampling=SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True),
+        ))
+        await local.shutdown()
+        assert got == expected, f"2-process disagg {got} != local {expected}"
+        print("STAGE: token-exact ok", flush=True)
+
+        # ---- the remote path (and the socket data plane) actually ran ----
+        from dynamo_tpu.runtime.service import collect_service_stats
+
+        stats = await collect_service_stats(drt.cplane, NS, "backend", timeout=2.0)
+        disagg = next(
+            (e.data.get("disagg") for e in stats.endpoints if e.data.get("disagg")), None
+        )
+        assert disagg is not None, "worker did not report disagg stats"
+        assert disagg["remote_prefills"] >= 1, disagg
+        print("STAGE: stats ok", flush=True)
+
+        # ---- cancellation does not leak (a later request still works) ----
+        pre2 = dict(pre, request_id="mp-cancel", sampling={
+            "temperature": 0.0, "max_tokens": 64, "ignore_eos": True,
+        })
+        stream = await client.random(dict(pre2, token_ids=prompt[:12]))
+        agen = stream.__aiter__()
+        await agen.__anext__()  # first payload arrived; now abandon mid-stream
+        await agen.aclose()
+        print("STAGE: cancel ok", flush=True)
+
+        got3 = []
+        async for out in await client.random(dict(pre, request_id="mp-3")):
+            got3.extend(out.get("token_ids") or [])
+        assert got3 == expected
+        print("STAGE: post-cancel ok", flush=True)
+
+        # children first: broker.stop() waits on live connections
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.wait(timeout=10)
+        await drt._shutdown_hook()
+        await broker.stop()
+
+    try:
+        loop.run_until_complete(asyncio.wait_for(body(), 300))
+    except Exception:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                out = p.communicate(timeout=10)[0]
+                print(f"--- worker process output ---\n{out[-4000:]}")
+            except Exception:
+                p.kill()
+        raise
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
+        loop.close()

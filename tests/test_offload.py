@@ -116,3 +116,35 @@ def test_offload_tier_is_the_differentiator():
             await eng.shutdown()
 
     asyncio.run(body())
+
+
+def test_offload_batched_restore_odd_block_count():
+    """A 3-block restore pads the batched inject to the 4-bucket (pad ids are
+    dropped by the scatter) and must stay token-exact."""
+    async def body():
+        eng = AsyncJaxEngine(
+            tiny_engine_config(num_pages=21, max_seqs=2, host_cache_blocks=64)
+        )
+        await eng.start()
+        try:
+            # 4 full blocks: the full-hit trim leaves 3 to restore -> padded
+            prompt = [31 + j for j in range(16)]
+            req = lambda rid: EngineRequest(
+                request_id=rid, token_ids=list(prompt),
+                sampling=SamplingParams(temperature=0.0, max_tokens=4),
+            )
+            toks1, _, _ = await _collect(eng, req("p1"))
+            for i in range(6):  # evict through the tiny pool
+                await _collect(eng, EngineRequest(
+                    request_id=f"f{i}", token_ids=[150 + 20 * i + j for j in range(16)],
+                    sampling=SamplingParams(temperature=0.0, max_tokens=2),
+                ))
+            assert eng.offload.saves > 0
+            toks2, _, cached = await _collect(eng, req("p2"))
+            assert eng.offload.loads >= 3
+            assert cached >= 12  # host-tier prefix hit
+            assert toks2 == toks1
+        finally:
+            await eng.shutdown()
+
+    asyncio.run(body())

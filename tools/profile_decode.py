@@ -1,6 +1,7 @@
 """Decompose decode-window time on the real chip.
 
-Times, per decode step at the bench config (1.3B llama-shaped, bs=8):
+Times, per decode step at the bench config (1.3B llama-shaped; batch and
+page size come from bench.bench_config() — check the printed B):
   window   — full dispatch_decode_window (model + sampling + feedback)
   model    — scan of model.decode alone (argmax feedback, no sampler)
   sampler  — scan of sample_tokens alone on [B, V] logits
@@ -133,7 +134,7 @@ def main():
             "sampler_only": ms(t_sampler),
             "weight_touch_floor": ms(t_touch),
         },
-        "window_tok_s_bs8": round(B * K / t_window, 1),
+        "window_tok_s": round(B * K / t_window, 1),
         "param_bytes": total_bytes,
         "hbm_roofline_steps_s": round(819e9 / total_bytes, 1),
         "K": K,
