@@ -20,6 +20,9 @@ log = get_logger("components.prefill")
 
 
 async def _main(args) -> None:
+    from dynamo_tpu.parallel.mesh import init_multihost
+
+    init_multihost()  # no-op unless DYNTPU_COORDINATOR is set
     from dynamo_tpu.disagg.prefill_worker import PrefillWorker
     from dynamo_tpu.engine.config import EngineConfig
     from dynamo_tpu.engine.engine import AsyncJaxEngine

@@ -121,6 +121,10 @@ async def _main(args) -> None:
     from dynamo_tpu.engine.config import EngineConfig
     from dynamo_tpu.runtime.distributed import DistributedRuntime
 
+    from dynamo_tpu.parallel.mesh import init_multihost
+
+    init_multihost()  # no-op unless DYNTPU_COORDINATOR is set
+
     drt = DistributedRuntime(cplane_address=args.cplane)
     await drt.connect()
     if args.model.startswith("tiny"):

@@ -65,9 +65,11 @@ class _QueueMsg:
 
 
 class Broker:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, persist_path: Optional[str] = None):
         self.host = host
         self.port = port
+        self.persist_path = persist_path
+        self._persist_file = None
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: dict[int, _Conn] = {}
         self._conn_ids = itertools.count(1)
@@ -87,6 +89,8 @@ class Broker:
     # ------------- lifecycle -------------
 
     async def start(self) -> int:
+        if self.persist_path:
+            self._load_persist()
         self._server = await asyncio.start_server(self._handle_conn, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
         self._reaper_task = asyncio.create_task(self._lease_reaper())
@@ -99,9 +103,90 @@ class Broker:
             self._reaper_task.cancel()
         if self._server:
             self._server.close()
-            await self._server.wait_closed()
+        # close live connections BEFORE wait_closed(): on 3.12+ wait_closed
+        # blocks until every connection handler finishes
         for conn in list(self._conns.values()):
             conn.writer.close()
+        if self._server:
+            await self._server.wait_closed()
+        if self._persist_file is not None:
+            self._persist_file.close()
+            self._persist_file = None
+
+    # ------------- persistence (append-log + compaction on load) -------------
+    #
+    # Durable state = non-lease KV and work-queue contents (the reference's
+    # etcd raft log + JetStream file store, transports/etcd.rs / nats.rs).
+    # Lease-attached keys are deliberately NOT persisted: leases die with
+    # their connections, and owners re-register through the client's
+    # reconnect hooks.
+
+    def _load_persist(self) -> None:
+        import os
+
+        import msgpack
+
+        records = []
+        if os.path.exists(self.persist_path):
+            with open(self.persist_path, "rb") as f:
+                unpacker = msgpack.Unpacker(f, raw=False)
+                try:
+                    for rec in unpacker:
+                        records.append(rec)
+                except Exception:
+                    log.warning("persist log tail truncated; recovering prefix")
+        max_msg_id = 0
+        for rec in records:
+            op = rec.get("op")
+            if op == "kv_put":
+                self._revision += 1
+                self._kv[rec["key"]] = {
+                    "value": rec["value"], "lease_id": 0, "revision": self._revision
+                }
+            elif op == "kv_delete":
+                self._kv.pop(rec["key"], None)
+            elif op == "queue_push":
+                m = _QueueMsg(msg_id=rec["msg_id"], payload=rec["payload"])
+                self._queues[rec["queue"]].append(m)
+                max_msg_id = max(max_msg_id, rec["msg_id"])
+            elif op == "queue_ack":
+                q = self._queues[rec["queue"]]
+                for m in list(q):
+                    if m.msg_id == rec["msg_id"]:
+                        q.remove(m)
+                        break
+        if max_msg_id:
+            self._msg_ids = itertools.count(max_msg_id + 1)
+        # compact: rewrite current state as a fresh log so growth is bounded
+        # by live state per restart, not by history
+        tmp = self.persist_path + ".tmp"
+        with open(tmp, "wb") as f:
+            for key, entry in self._kv.items():
+                if entry["lease_id"] == 0:
+                    f.write(msgpack.packb({"op": "kv_put", "key": key, "value": entry["value"]}))
+            for qname, q in self._queues.items():
+                for m in q:
+                    f.write(msgpack.packb(
+                        {"op": "queue_push", "queue": qname, "msg_id": m.msg_id, "payload": m.payload}
+                    ))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.persist_path)
+        self._persist_file = open(self.persist_path, "ab")
+        if records:
+            log.info(
+                "persist: recovered %d kv keys, %d queued messages",
+                len(self._kv), sum(len(q) for q in self._queues.values()),
+            )
+
+    def _log_persist(self, rec: dict) -> None:
+        if self._persist_file is None and self.persist_path:
+            self._persist_file = open(self.persist_path, "ab")
+        if self._persist_file is not None:
+            import msgpack
+
+            self._persist_file.write(msgpack.packb(rec))
+            self._persist_file.flush()
 
     async def serve_forever(self) -> None:
         await self.start()
@@ -156,6 +241,11 @@ class Broker:
                 msg.delivered_to = None
                 self._queues[qname].appendleft(msg)
                 self._kick_queue(qname)
+        # purge its parked pulls so the waiters readiness count stays honest
+        for qname, waiters in self._queue_waiters.items():
+            self._queue_waiters[qname] = deque(
+                (cid, rid) for cid, rid in waiters if cid != conn.conn_id
+            )
 
     # ------------- dispatch -------------
 
@@ -206,8 +296,15 @@ class Broker:
             if lease is None:
                 raise ValueError(f"lease {lease_id} not found")
             lease.keys.add(key)
+        prev = self._kv.get(key)
         self._revision += 1
         self._kv[key] = {"value": value, "lease_id": lease_id, "revision": self._revision}
+        if lease_id == 0:
+            self._log_persist({"op": "kv_put", "key": key, "value": value})
+        elif prev is not None and prev["lease_id"] == 0:
+            # persisted key transitions to lease-attached: tombstone the old
+            # record or a restart would resurrect the stale non-lease value
+            self._log_persist({"op": "kv_delete", "key": key})
         self._notify_watchers(key, value, "put", lease_id)
         return {"revision": self._revision}
 
@@ -236,6 +333,8 @@ class Broker:
         entry = self._kv.pop(msg["key"], None)
         if entry is not None:
             self._revision += 1
+            if entry["lease_id"] == 0:
+                self._log_persist({"op": "kv_delete", "key": msg["key"]})
             self._notify_watchers(msg["key"], None, "delete", entry["lease_id"])
         return {"deleted": entry is not None}
 
@@ -258,7 +357,32 @@ class Broker:
 
     def _op_lease_create(self, conn: _Conn, msg: dict) -> dict:
         ttl = float(msg.get("ttl", DEFAULT_LEASE_TTL))
-        lease_id = next(self._lease_ids)
+        lease_id = msg.get("lease_id") or next(self._lease_ids)
+        if msg.get("lease_id"):
+            # keep the id generator ahead of reattached ids (which came from a
+            # previous broker incarnation's counter)
+            nxt = next(self._lease_ids)
+            self._lease_ids = itertools.count(max(lease_id + 1, nxt))
+        existing = self._leases.get(lease_id)
+        if existing is not None:
+            # reattach after a reconnect: a lease id is an identity (it names
+            # endpoint subjects/instances), so its owner re-adopts it on a new
+            # connection. If an older connection still appears live, it is a
+            # half-open leftover of the same client (the id is the proof of
+            # ownership): move the lease FIRST — so the old conn's teardown
+            # can't expire it — then force the stale conn closed.
+            old = self._conns.get(existing.conn_id)
+            if old is not None and existing.conn_id != conn.conn_id:
+                old.leases.discard(lease_id)
+                try:
+                    old.writer.close()
+                except Exception:
+                    pass
+            existing.conn_id = conn.conn_id
+            existing.ttl = ttl
+            existing.expires_at = time.monotonic() + ttl
+            conn.leases.add(lease_id)
+            return {"lease_id": lease_id, "ttl": ttl}
         self._leases[lease_id] = _Lease(
             lease_id=lease_id, ttl=ttl, conn_id=conn.conn_id, expires_at=time.monotonic() + ttl
         )
@@ -348,6 +472,9 @@ class Broker:
         qname = msg["queue"]
         m = _QueueMsg(msg_id=next(self._msg_ids), payload=msg["payload"])
         self._queues[qname].append(m)
+        self._log_persist(
+            {"op": "queue_push", "queue": qname, "msg_id": m.msg_id, "payload": m.payload}
+        )
         self._kick_queue(qname)
         return {"msg_id": m.msg_id, "depth": len(self._queues[qname])}
 
@@ -365,6 +492,7 @@ class Broker:
 
     def _op_queue_ack(self, conn: _Conn, msg: dict) -> dict:
         self._inflight.pop((msg["queue"], msg["msg_id"]), None)
+        self._log_persist({"op": "queue_ack", "queue": msg["queue"], "msg_id": msg["msg_id"]})
         return {}
 
     def _op_queue_nack(self, conn: _Conn, msg: dict) -> dict:

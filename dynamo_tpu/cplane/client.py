@@ -78,22 +78,50 @@ class Lease:
 
     async def _keepalive_loop(self) -> None:
         interval = max(0.2, self.ttl / 3)
+        failures_since = None
         try:
             while True:
                 await asyncio.sleep(interval)
                 try:
                     await self.client._request({"op": "lease_keepalive", "lease_id": self.lease_id})
+                    failures_since = None
                 except Exception as e:
-                    log.warning("lease %x keepalive failed: %s", self.lease_id, e)
-                    if self.on_expired:
-                        self.on_expired()
-                    return
+                    if isinstance(e, RuntimeError) and "expired" in str(e):
+                        # broker is up but forgot the lease (TTL'd out during
+                        # a stall): re-adopt it under its original id — the id
+                        # names endpoint subjects — and re-register owners
+                        try:
+                            await self.client._request(
+                                {"op": "lease_create", "ttl": self.ttl, "lease_id": self.lease_id}
+                            )
+                            for hook in list(self.client.reconnect_hooks):
+                                await hook()
+                            log.warning("lease %x re-established after expiry", self.lease_id)
+                            failures_since = None
+                            continue
+                        except Exception:
+                            pass
+                    # transient: the client's reconnect re-attaches this lease
+                    # under its original id; declare it dead only after the
+                    # reconnect window has clearly elapsed without healing
+                    now = asyncio.get_running_loop().time()
+                    if failures_since is None:
+                        failures_since = now
+                    elapsed = now - failures_since
+                    log.warning(
+                        "lease %x keepalive failed (%.0fs): %s", self.lease_id, elapsed, e
+                    )
+                    if elapsed > self.client.reconnect_window:
+                        if self.on_expired:
+                            self.on_expired()
+                        return
         except asyncio.CancelledError:
             pass
 
     async def revoke(self) -> None:
         if self._task:
             self._task.cancel()
+        self.client._leases.pop(self.lease_id, None)
         try:
             await self.client._request({"op": "lease_revoke", "lease_id": self.lease_id})
         except Exception:
@@ -101,31 +129,54 @@ class Lease:
 
 
 class CplaneClient:
-    def __init__(self, address: str = "127.0.0.1:4222"):
+    def __init__(
+        self,
+        address: str = "127.0.0.1:4222",
+        reconnect_window: float = 30.0,
+    ):
         host, _, port = address.rpartition(":")
         self.host, self.port = host or "127.0.0.1", int(port)
+        self.reconnect_window = reconnect_window
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._rids = itertools.count(1)
         self._watch_ids = itertools.count(1)
         self._pending: dict[int, asyncio.Future] = {}
         self._watch_queues: dict[int, asyncio.Queue] = {}
+        self._watch_prefixes: dict[int, str] = {}
+        self._watch_seen: dict[int, set[str]] = {}
         self._sub_handlers: dict[str, Callable[[dict], None]] = {}
+        self._leases: dict[int, "Lease"] = {}
         self._reader_task: Optional[asyncio.Task] = None
+        self._reconnect_task: Optional[asyncio.Task] = None
+        self._up: Optional[asyncio.Event] = None
         self._closed = False
+        self._dead = False  # reconnect window exhausted or closed
+        # called when the broker connection is lost FOR GOOD (reconnect window
+        # exhausted); transient drops are healed transparently
         self.on_disconnect: Optional[Callable[[], None]] = None
+        # async hooks run after a successful reconnect + state replay (e.g.
+        # ServedEndpoint re-registration)
+        self.reconnect_hooks: list[Callable] = []
 
     # ------------- lifecycle -------------
 
     async def connect(self) -> "CplaneClient":
         self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        self._up = asyncio.Event()
+        self._up.set()
         self._reader_task = asyncio.create_task(self._read_loop())
         return self
 
     async def close(self) -> None:
         self._closed = True
+        self._dead = True
+        if self._up is not None:
+            self._up.set()  # release any parked _request() waiters
         if self._reader_task:
             self._reader_task.cancel()
+        if self._reconnect_task:
+            self._reconnect_task.cancel()
         if self._writer:
             self._writer.close()
 
@@ -141,10 +192,79 @@ class CplaneClient:
                 if not fut.done():
                     fut.set_exception(ConnectionError("broker connection lost"))
             self._pending.clear()
-            for q in self._watch_queues.values():
-                q.put_nowait(None)
-            if not self._closed and self.on_disconnect:
-                self.on_disconnect()
+            if not self._closed:
+                self._up.clear()
+                self._reconnect_task = asyncio.create_task(self._reconnect())
+
+    def _give_up(self) -> None:
+        self._dead = True
+        if self._up is not None:
+            self._up.set()  # release parked _request() waiters to fail fast
+        for q in self._watch_queues.values():
+            q.put_nowait(None)
+        if not self._closed and self.on_disconnect:
+            self.on_disconnect()
+
+    async def _reconnect(self) -> None:
+        """Heal the broker connection: backoff-retry within reconnect_window,
+        then replay session state — subscriptions, watches (with a
+        seen-key diff so missed deletes surface as synthetic events), and
+        leases (re-attached under their original ids, which name endpoint
+        subjects) — and finally run the registered reconnect hooks
+        (reference: etcd.rs lease keep-alive + client retry semantics)."""
+        deadline = asyncio.get_running_loop().time() + self.reconnect_window
+        delay = 0.2
+        while not self._closed:
+            try:
+                self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+                break
+            except OSError:
+                if asyncio.get_running_loop().time() + delay > deadline:
+                    log.warning(
+                        "broker %s:%d unreachable for %.0fs; giving up",
+                        self.host, self.port, self.reconnect_window,
+                    )
+                    self._give_up()
+                    return
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, 2.0)
+        if self._closed:
+            return
+        self._reader_task = asyncio.create_task(self._read_loop())
+        self._up.set()
+        try:
+            for lease in list(self._leases.values()):
+                await self._request(
+                    {"op": "lease_create", "ttl": lease.ttl, "lease_id": lease.lease_id}
+                )
+            for subject in list(self._sub_handlers):
+                await self._request({"op": "subscribe", "subject": subject})
+            for watch_id, prefix in list(self._watch_prefixes.items()):
+                r = await self._request(
+                    {"op": "watch", "watch_id": watch_id, "prefix": prefix}
+                )
+                q = self._watch_queues.get(watch_id)
+                if q is None:
+                    continue
+                now = {i["key"]: i for i in r["items"]}
+                seen = self._watch_seen.setdefault(watch_id, set())
+                for key in seen - now.keys():
+                    q.put_nowait(WatchEvent(kind="delete", key=key, value=None))
+                for key, item in now.items():
+                    q.put_nowait(
+                        WatchEvent(kind="put", key=key, value=item["value"],
+                                   lease_id=item["lease_id"])
+                    )
+                self._watch_seen[watch_id] = set(now)
+            for hook in list(self.reconnect_hooks):
+                await hook()
+            log.info("broker connection healed (%s:%d)", self.host, self.port)
+        except Exception:
+            log.exception("reconnect replay failed; retrying")
+            try:
+                self._writer.close()
+            except Exception:
+                pass
 
     def _handle(self, msg: dict) -> None:
         if "rid" in msg and msg["rid"] is not None:
@@ -159,6 +279,11 @@ class CplaneClient:
         if event == "watch":
             q = self._watch_queues.get(msg["watch_id"])
             if q is not None:
+                seen = self._watch_seen.setdefault(msg["watch_id"], set())
+                if msg["kind"] == "put":
+                    seen.add(msg["key"])
+                else:
+                    seen.discard(msg["key"])
                 q.put_nowait(
                     WatchEvent(
                         kind=msg["kind"], key=msg["key"], value=msg.get("value"),
@@ -171,6 +296,14 @@ class CplaneClient:
                 handler(msg)
 
     async def _request(self, msg: dict) -> dict:
+        if self._up is not None and not self._up.is_set() and not self._closed:
+            # connection is healing: park briefly instead of failing fast
+            try:
+                await asyncio.wait_for(self._up.wait(), self.reconnect_window)
+            except asyncio.TimeoutError:
+                raise ConnectionError("broker connection lost")
+        if self._dead or self._closed:
+            raise ConnectionError("broker connection lost")
         rid = next(self._rids)
         msg["rid"] = rid
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
@@ -210,12 +343,16 @@ class CplaneClient:
         watch_id = next(self._watch_ids)
         q: asyncio.Queue = asyncio.Queue()
         self._watch_queues[watch_id] = q
+        self._watch_prefixes[watch_id] = prefix
         r = await self._request({"op": "watch", "watch_id": watch_id, "prefix": prefix})
         items = [KvItem(key=i["key"], value=i["value"], lease_id=i["lease_id"]) for i in r["items"]]
+        self._watch_seen[watch_id] = {i.key for i in items}
         return PrefixWatcher(watch_id, items, q, self)
 
     async def _unwatch(self, watch_id: int) -> None:
         self._watch_queues.pop(watch_id, None)
+        self._watch_prefixes.pop(watch_id, None)
+        self._watch_seen.pop(watch_id, None)
         await self._request({"op": "unwatch", "watch_id": watch_id})
 
     # ------------- leases -------------
@@ -223,6 +360,7 @@ class CplaneClient:
     async def lease_create(self, ttl: float = 10.0) -> Lease:
         r = await self._request({"op": "lease_create", "ttl": ttl})
         lease = Lease(self, r["lease_id"], r["ttl"])
+        self._leases[lease.lease_id] = lease
         lease.start_keepalive()
         return lease
 

@@ -48,6 +48,7 @@ class KvDataPlaneServer:
         self.port: Optional[int] = None
         self._server: Optional[asyncio.AbstractServer] = None
         self._expected: dict[str, asyncio.Future] = {}
+        self._writers: set[asyncio.StreamWriter] = set()
         self.received = 0
         self.dropped = 0
 
@@ -74,6 +75,11 @@ class KvDataPlaneServer:
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
+            # close accepted connections BEFORE wait_closed(): on 3.12+ it
+            # blocks until every connection handler returns, and prefill-side
+            # pooled senders hold their sockets open indefinitely
+            for w in list(self._writers):
+                w.close()
             await self._server.wait_closed()
         for fut in self._expected.values():
             if not fut.done():
@@ -107,6 +113,7 @@ class KvDataPlaneServer:
 
     async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         peer = writer.get_extra_info("peername")
+        self._writers.add(writer)
         try:
             while True:
                 raw = await reader.readexactly(_LEN.size)
@@ -114,7 +121,9 @@ class KvDataPlaneServer:
                 if hlen > MAX_HEADER:
                     raise ValueError(f"kv header too large: {hlen}")
                 header = msgpack.unpackb(await reader.readexactly(hlen))
-                dtype = np.dtype(header["dtype"])
+                from dynamo_tpu.llm.remote_prefill import _np_dtype
+
+                dtype = _np_dtype(header["dtype"])  # handles bfloat16 et al
                 shape = tuple(header["shape"])
                 nbytes = dtype.itemsize * int(np.prod(shape))
                 payload = await reader.readexactly(nbytes)
@@ -133,6 +142,7 @@ class KvDataPlaneServer:
         except Exception:
             log.exception("kv data plane connection from %s failed", peer)
         finally:
+            self._writers.discard(writer)
             writer.close()
 
 
@@ -147,7 +157,11 @@ class KvDataPlaneClient:
     async def send(self, address: str, request_id: str, array: np.ndarray) -> None:
         lock = self._locks.setdefault(address, asyncio.Lock())
         async with lock:  # one in-flight transfer per destination connection
-            payload = np.ascontiguousarray(array).tobytes()
+            # zero-copy payload: write a memoryview of the contiguous array
+            # (KV payloads are tens of MB; bytes-concatenation would copy them
+            # again and stall the event loop)
+            arr = np.ascontiguousarray(array)
+            payload = memoryview(arr.view(np.uint8).reshape(-1))
             header = msgpack.packb(
                 {
                     "request_id": request_id,
@@ -164,7 +178,9 @@ class KvDataPlaneClient:
                         conn = await asyncio.open_connection(host, int(port))
                         self._conns[address] = conn
                     _, writer = conn
-                    writer.write(_LEN.pack(len(header)) + header + payload)
+                    writer.write(_LEN.pack(len(header)))
+                    writer.write(header)
+                    writer.write(payload)
                     await writer.drain()
                     self.sent += 1
                     return

@@ -54,7 +54,12 @@ async def _build_engine(args):
         return EchoEngine()
     if args.output == "jax":
         from dynamo_tpu.engine.engine import AsyncJaxEngine
+        from dynamo_tpu.parallel.mesh import init_multihost
 
+        # multi-host pod slice (helm worker.yaml sets DYNTPU_COORDINATOR /
+        # NUM_PROCESSES / PROCESS_ID): join the SPMD program before any
+        # backend use; no-op on a single host
+        init_multihost()
         engine = AsyncJaxEngine(engine_config_for(args))
         await engine.start()
         return engine

@@ -72,6 +72,23 @@ def init_multihost(
     )
 
 
+def place_global(tree, shardings):
+    """Place a host pytree onto (possibly multi-process) shardings.
+
+    Single process: plain device_put. Multi-process SPMD: every process holds
+    the full host value (same PRNG seed / same checkpoint) and contributes its
+    addressable shards via make_array_from_callback — device_put cannot
+    target non-addressable devices."""
+    if jax.process_count() == 1:
+        return jax.device_put(tree, shardings)
+
+    def place(x, s):
+        x = np.asarray(x)
+        return jax.make_array_from_callback(x.shape, s, lambda idx: x[idx])
+
+    return jax.tree.map(place, tree, shardings)
+
+
 def build_mesh(config: MeshConfig, devices=None) -> Mesh:
     """Mesh with axes (dp, pp, sp, ep, tp); tp innermost so it lands on the
     fastest ICI neighbor links."""

@@ -157,16 +157,28 @@ class ServedEndpoint:
         client = self._drt.cplane
         await client.subscribe(self.info.subject, self._on_request)
         await client.subscribe(self._stats_subject, self._on_stats)
+        await self._register()
+        # broker outage or lease expiry: re-register once the connection (and
+        # the lease, under its original id) is healed — subscriptions are
+        # replayed by the client itself
+        client.reconnect_hooks.append(self._register)
+        log.info("serving %s (instance %x)", self.info.subject, self.info.instance_id)
+
+    async def _register(self) -> None:
         key = instance_key(
             self.info.namespace, self.info.component, self.info.endpoint, self.info.instance_id
         )
-        await client.kv_create(
+        # put (not create-if-absent): re-registration after a heal must win
+        await self._drt.cplane.kv_put(
             key, msgpack.packb(self.info.to_wire()), lease_id=self._drt.primary_lease.lease_id
         )
-        log.info("serving %s (instance %x)", self.info.subject, self.info.instance_id)
 
     async def stop(self) -> None:
         client = self._drt.cplane
+        try:
+            client.reconnect_hooks.remove(self._register)
+        except ValueError:
+            pass
         await client.unsubscribe(self.info.subject)
         key = instance_key(
             self.info.namespace, self.info.component, self.info.endpoint, self.info.instance_id

@@ -33,17 +33,22 @@ ENGINE_ARGS = [
 ]
 
 
-def _spawn(module: str, *args: str) -> subprocess.Popen:
+def _spawn(module: str, *args: str, log_path: str) -> subprocess.Popen:
     env = dict(os.environ)
     env.setdefault("DYNTPU_LOG", "info")
     env["PYTHONUNBUFFERED"] = "1"
-    return subprocess.Popen(
+    # log to a file, not a PIPE: an undrained pipe blocks the child once the
+    # ~64KB buffer fills, which presents as an unrelated-looking test timeout
+    logf = open(log_path, "w")
+    p = subprocess.Popen(
         [sys.executable, "-m", module, *args],
         env=env,
-        stdout=subprocess.PIPE,
+        stdout=logf,
         stderr=subprocess.STDOUT,
         text=True,
     )
+    p._log_path = log_path
+    return p
 
 
 async def _wait_queue_consumer(cplane, queue: str, timeout: float = 90.0) -> None:
@@ -60,7 +65,7 @@ async def _wait_queue_consumer(cplane, queue: str, timeout: float = 90.0) -> Non
     raise TimeoutError(f"no consumer on {queue}")
 
 
-def test_two_process_disagg_token_exact_and_cancel():
+def test_two_process_disagg_token_exact_and_cancel(tmp_path):
     loop = asyncio.new_event_loop()
     procs: list[subprocess.Popen] = []
 
@@ -80,11 +85,12 @@ def test_two_process_disagg_token_exact_and_cancel():
         procs.append(_spawn(
             "dynamo_tpu.components.worker", "tiny", "--disagg",
             "--namespace", NS, "--component", "backend", "--cplane", addr,
-            *ENGINE_ARGS,
+            *ENGINE_ARGS, log_path=str(tmp_path / "worker.log"),
         ))
         procs.append(_spawn(
             "dynamo_tpu.components.prefill_worker", "tiny",
             "--namespace", NS, "--cplane", addr, *ENGINE_ARGS,
+            log_path=str(tmp_path / "prefill.log"),
         ))
 
         print("STAGE: workers spawned", flush=True)
@@ -163,10 +169,14 @@ def test_two_process_disagg_token_exact_and_cancel():
                 p.terminate()
         for p in procs:
             try:
-                out = p.communicate(timeout=10)[0]
-                print(f"--- worker process output ---\n{out[-4000:]}")
+                p.wait(timeout=10)
             except Exception:
                 p.kill()
+            try:
+                with open(p._log_path) as f:
+                    print(f"--- {p._log_path} ---\n{f.read()[-4000:]}")
+            except Exception:
+                pass
         raise
     finally:
         for p in procs:
