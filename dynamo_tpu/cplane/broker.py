@@ -73,7 +73,11 @@ class Broker:
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: dict[int, _Conn] = {}
         self._conn_ids = itertools.count(1)
-        self._lease_ids = itertools.count(0x1000)
+        # lease ids must be unique ACROSS broker incarnations: a reconnecting
+        # client re-adopts its lease by id (proof of ownership), so a restarted
+        # broker handing out the same small ids again would let that reattach
+        # hijack a new client's lease. Seed the counter from wall time.
+        self._lease_ids = itertools.count(((int(time.time()) & 0xFFFFFFFF) << 16) | 0x1000)
         self._watch_event_ids = itertools.count(1)
         self._msg_ids = itertools.count(1)
 
@@ -180,13 +184,21 @@ class Broker:
             )
 
     def _log_persist(self, rec: dict) -> None:
+        # flush() only (no per-append fsync): deliberate tradeoff — records
+        # survive a broker PROCESS crash, not a host power loss. The control
+        # plane re-derives liveness state anyway, and per-append fsync would
+        # serialize every kv_put/queue_push on disk latency. Set
+        # DYNTPU_BROKER_FSYNC=1 for full durability.
         if self._persist_file is None and self.persist_path:
             self._persist_file = open(self.persist_path, "ab")
         if self._persist_file is not None:
             import msgpack
+            import os
 
             self._persist_file.write(msgpack.packb(rec))
             self._persist_file.flush()
+            if os.environ.get("DYNTPU_BROKER_FSYNC") == "1":
+                os.fsync(self._persist_file.fileno())
 
     async def serve_forever(self) -> None:
         await self.start()

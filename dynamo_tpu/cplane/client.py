@@ -152,6 +152,10 @@ class CplaneClient:
         self._up: Optional[asyncio.Event] = None
         self._closed = False
         self._dead = False  # reconnect window exhausted or closed
+        # one deadline for the WHOLE outage: replay failures re-enter
+        # _reconnect without resetting it, so a deterministic replay error
+        # can't retry forever
+        self._heal_deadline: Optional[float] = None
         # called when the broker connection is lost FOR GOOD (reconnect window
         # exhausted); transient drops are healed transparently
         self.on_disconnect: Optional[Callable[[], None]] = None
@@ -212,14 +216,17 @@ class CplaneClient:
         leases (re-attached under their original ids, which name endpoint
         subjects) — and finally run the registered reconnect hooks
         (reference: etcd.rs lease keep-alive + client retry semantics)."""
-        deadline = asyncio.get_running_loop().time() + self.reconnect_window
+        loop = asyncio.get_running_loop()
+        if self._heal_deadline is None:
+            self._heal_deadline = loop.time() + self.reconnect_window
+        deadline = self._heal_deadline
         delay = 0.2
         while not self._closed:
             try:
                 self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
                 break
             except OSError:
-                if asyncio.get_running_loop().time() + delay > deadline:
+                if loop.time() + delay > deadline:
                     log.warning(
                         "broker %s:%d unreachable for %.0fs; giving up",
                         self.host, self.port, self.reconnect_window,
@@ -258,9 +265,15 @@ class CplaneClient:
                 self._watch_seen[watch_id] = set(now)
             for hook in list(self.reconnect_hooks):
                 await hook()
+            self._heal_deadline = None  # fully healed: next outage gets a fresh window
             log.info("broker connection healed (%s:%d)", self.host, self.port)
         except Exception:
+            if loop.time() > deadline:
+                log.exception("reconnect replay kept failing past the window; giving up")
+                self._give_up()
+                return
             log.exception("reconnect replay failed; retrying")
+            await asyncio.sleep(min(1.0, max(0.2, delay)))
             try:
                 self._writer.close()
             except Exception:

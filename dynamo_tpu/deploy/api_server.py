@@ -43,9 +43,17 @@ class DeploymentStore:
 
     def __init__(self):
         self._data: dict[str, list[dict]] = {}
+        self._status: dict[str, dict] = {}  # controller-written status
 
     def list(self) -> list[str]:
         return sorted(self._data)
+
+    def set_status(self, name: str, status: dict) -> None:
+        """Controller status writeback (the CR .status slot)."""
+        self._status[name] = status
+
+    def get_status(self, name: str) -> Optional[dict]:
+        return self._status.get(name)
 
     def revisions(self, name: str) -> list[dict]:
         return list(self._data.get(name, []))
@@ -68,6 +76,7 @@ class DeploymentStore:
     def delete(self, name: str) -> bool:
         existed = name in self._data
         self._data.pop(name, None)
+        self._status.pop(name, None)
         self._flush()
         return existed
 
@@ -89,8 +98,11 @@ class FileDeploymentStore(DeploymentStore):
 
 
 class DeployApiServer:
-    def __init__(self, store: Optional[DeploymentStore] = None):
+    def __init__(self, store: Optional[DeploymentStore] = None, controller=None):
         self.store = store or DeploymentStore()
+        # optional live DeployController: spec mutations kick an immediate
+        # converge instead of waiting for the next periodic pass
+        self.controller = controller
         self.app = web.Application()
         self.app.add_routes(
             [
@@ -104,10 +116,15 @@ class DeployApiServer:
                 web.get("/api/v1/deployments/{name}/revisions", self._revisions),
                 web.post("/api/v1/deployments/{name}/rollback/{rev}", self._rollback),
                 web.get("/api/v1/deployments/{name}/manifests", self._manifests),
+                web.get("/api/v1/deployments/{name}/status", self._status),
             ]
         )
         self._runner: Optional[web.AppRunner] = None
         self.port: Optional[int] = None
+
+    def _kick(self) -> None:
+        if self.controller is not None:
+            self.controller.kick()
 
     # ---------------- lifecycle ----------------
 
@@ -157,6 +174,7 @@ class DeployApiServer:
         if self.store.head(spec.name) is not None:
             return web.json_response({"error": f"deployment {spec.name} exists"}, status=409)
         record = self.store.put(spec.name, spec.to_dict())
+        self._kick()
         return web.json_response({"name": spec.name, "revision": record["revision"]}, status=201)
 
     def _head_or_404(self, request: web.Request) -> tuple[str, dict]:
@@ -176,12 +194,14 @@ class DeployApiServer:
         if spec.name != name:
             return web.json_response({"error": "spec name must match path"}, status=422)
         record = self.store.put(name, spec.to_dict())
+        self._kick()
         return web.json_response({"name": name, "revision": record["revision"]})
 
     async def _delete(self, request: web.Request) -> web.Response:
         name = request.match_info["name"]
         if not self.store.delete(name):
             raise web.HTTPNotFound(text=json.dumps({"error": f"deployment {name} not found"}), content_type="application/json")
+        self._kick()
         return web.json_response({"deleted": name})
 
     async def _revisions(self, request: web.Request) -> web.Response:
@@ -202,7 +222,16 @@ class DeployApiServer:
         if target is None:
             return web.json_response({"error": f"revision {rev} not found"}, status=404)
         record = self.store.put(name, target["spec"])
+        self._kick()
         return web.json_response({"name": name, "revision": record["revision"], "rolled_back_to": rev})
+
+    async def _status(self, request: web.Request) -> web.Response:
+        name, head = self._head_or_404(request)
+        return web.json_response({
+            "name": name,
+            "revision": head["revision"],
+            "status": self.store.get_status(name) or {"observed_revision": None},
+        })
 
     async def _manifests(self, request: web.Request) -> web.Response:
         name, head = self._head_or_404(request)

@@ -1,0 +1,261 @@
+"""Deploy controller: the live reconcile loop over desired state.
+
+The reference's operator runs a controller-runtime loop — watch
+DynamoNimDeployment CRs, render owned resources, converge the cluster,
+write status back (reference: deploy/dynamo/operator/internal/controller/
+dynamonimdeployment_controller.go Reconcile/ownership semantics). This is
+that loop for the TPU stack: desired state comes from the DeploymentStore
+(the API server's revision history), the cluster side is a pluggable
+``ClusterApi`` (an in-memory fake for tests, a kubectl shim for real
+clusters), and each pass repairs drift — objects deleted or mutated out from
+under the controller converge back to the rendered manifests on the next
+tick. Deleted deployments are garbage-collected by ownership labels.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional, Protocol
+
+from dynamo_tpu.deploy.crd import DeploymentSpec
+from dynamo_tpu.deploy.reconciler import MANAGED_BY, reconcile
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("deploy.controller")
+
+
+class ClusterApi(Protocol):
+    """The minimal cluster surface the controller converges against."""
+
+    async def list_objects(self, namespace: str) -> list[dict]: ...
+
+    async def apply(self, obj: dict) -> None: ...
+
+    async def delete(self, kind: str, namespace: str, name: str) -> None: ...
+
+
+class FakeCluster:
+    """In-memory ClusterApi: unit-testable stand-in for a k8s API server.
+
+    Tests inject drift by mutating/deleting entries in ``objects`` directly
+    (the out-of-band actor) and can consult ``applied``/``deleted`` action
+    logs to assert what the controller did."""
+
+    def __init__(self):
+        self.objects: dict[tuple, dict] = {}  # (kind, ns, name) -> object
+        self.applied: list[tuple] = []
+        self.deleted: list[tuple] = []
+
+    @staticmethod
+    def _key(obj: dict) -> tuple:
+        return (obj["kind"], obj["metadata"]["namespace"], obj["metadata"]["name"])
+
+    async def list_objects(self, namespace: str) -> list[dict]:
+        import copy
+
+        return [
+            copy.deepcopy(o)
+            for (kind, ns, _), o in self.objects.items()
+            if ns == namespace
+        ]
+
+    async def apply(self, obj: dict) -> None:
+        import copy
+
+        key = self._key(obj)
+        self.objects[key] = copy.deepcopy(obj)
+        self.applied.append(key)
+
+    async def delete(self, kind: str, namespace: str, name: str) -> None:
+        self.objects.pop((kind, namespace, name), None)
+        self.deleted.append((kind, namespace, name))
+
+
+class KubectlCluster:
+    """ClusterApi over kubectl (server-side apply); the real-cluster shim."""
+
+    def __init__(self, kubectl: str = "kubectl"):
+        self.kubectl = kubectl
+
+    async def _run(self, *args: str, stdin: Optional[bytes] = None) -> bytes:
+        proc = await asyncio.create_subprocess_exec(
+            self.kubectl, *args,
+            stdin=asyncio.subprocess.PIPE if stdin is not None else None,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE,
+        )
+        out, err = await proc.communicate(stdin)
+        if proc.returncode != 0:
+            raise RuntimeError(f"kubectl {' '.join(args)}: {err.decode()[-500:]}")
+        return out
+
+    async def list_objects(self, namespace: str) -> list[dict]:
+        import json
+
+        out = await self._run(
+            "get", "deployments,statefulsets,services,horizontalpodautoscalers",
+            "-n", namespace, "-l", f"app.kubernetes.io/managed-by={MANAGED_BY}",
+            "-o", "json",
+        )
+        return json.loads(out).get("items", [])
+
+    async def apply(self, obj: dict) -> None:
+        import json
+
+        await self._run(
+            "apply", "-f", "-", "--server-side", "--field-manager", MANAGED_BY,
+            stdin=json.dumps(obj).encode(),
+        )
+
+    async def delete(self, kind: str, namespace: str, name: str) -> None:
+        await self._run("delete", kind.lower(), name, "-n", namespace, "--ignore-not-found")
+
+
+class DeployController:
+    """Poll the store's head revisions, converge the cluster, write status."""
+
+    def __init__(self, store, cluster: ClusterApi, interval: float = 2.0):
+        self.store = store
+        self.cluster = cluster
+        self.interval = interval
+        self._task: Optional[asyncio.Task] = None
+        self._kick = asyncio.Event()
+        # deployments this controller has managed: name -> namespace; needed
+        # to garbage-collect objects after a deployment disappears from the
+        # store (the operator's finalizer/ownership slot)
+        self._managed: dict[str, str] = {}
+        self.passes = 0
+
+    # ---------------- lifecycle ----------------
+
+    async def start(self) -> "DeployController":
+        self._task = asyncio.create_task(self._loop())
+        return self
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    def kick(self) -> None:
+        """Wake the loop immediately (API server calls this on spec changes)."""
+        self._kick.set()
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.converge_once()
+            except Exception:
+                log.exception("converge pass failed")
+            try:
+                await asyncio.wait_for(self._kick.wait(), self.interval)
+            except asyncio.TimeoutError:
+                pass
+            self._kick.clear()
+
+    # ---------------- one reconcile pass ----------------
+
+    async def converge_once(self) -> dict[str, dict]:
+        """Converge every deployment in the store; returns per-name action
+        counts (for tests/observability)."""
+        self.passes += 1
+        summary: dict[str, dict] = {}
+        names = set(self.store.list())
+        for name in sorted(names):
+            head = self.store.head(name)
+            if head is None:
+                continue
+            spec = DeploymentSpec.from_dict(head["spec"])
+            live = await self.cluster.list_objects(spec.namespace)
+            actions = reconcile(spec, live)
+            for obj in actions["create"] + actions["update"]:
+                await self.cluster.apply(obj)
+            for obj in actions["delete"]:
+                meta = obj["metadata"]
+                await self.cluster.delete(obj["kind"], meta["namespace"], meta["name"])
+            self._managed[name] = spec.namespace
+            status = {
+                "observed_revision": head["revision"],
+                "created": len(actions["create"]),
+                "updated": len(actions["update"]),
+                "deleted": len(actions["delete"]),
+                "unchanged": len(actions["unchanged"]),
+                "converged": not (actions["create"] or actions["update"] or actions["delete"]),
+                "last_reconcile": time.time(),
+            }
+            self.store.set_status(name, status)
+            summary[name] = status
+        # garbage-collect by OWNERSHIP LABELS, not in-process memory: any
+        # managed object whose part-of deployment is absent from the store is
+        # an orphan — this also catches deployments deleted while the
+        # controller was down (a restarted controller's _managed starts empty).
+        sweep_namespaces = set(self._managed.values()) | {"default"}
+        for name in list(self._managed):
+            if name not in names:
+                del self._managed[name]
+        for head_name in names:
+            head = self.store.head(head_name)
+            if head is not None:
+                sweep_namespaces.add(head["spec"].get("namespace", "default"))
+        for ns in sorted(sweep_namespaces):
+            for obj in await self.cluster.list_objects(ns):
+                labels = obj.get("metadata", {}).get("labels", {})
+                owner = labels.get("app.kubernetes.io/part-of")
+                if (
+                    labels.get("app.kubernetes.io/managed-by") == MANAGED_BY
+                    and owner is not None
+                    and owner not in names
+                ):
+                    meta = obj["metadata"]
+                    await self.cluster.delete(obj["kind"], meta["namespace"], meta["name"])
+                    summary[owner] = {"garbage_collected": True}
+        return summary
+
+
+def main(argv: Optional[list] = None) -> int:
+    """Run the controller as a daemon: API server + converge loop in one
+    process (the operator deployment slot). --kubectl targets a real cluster;
+    default is a FakeCluster (dry-run mode that logs actions)."""
+    import argparse
+
+    ap = argparse.ArgumentParser("dynamo-tpu-deploy-controller")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8090)
+    ap.add_argument("--interval", type=float, default=5.0)
+    ap.add_argument("--store", default=None, help="JSON file store path")
+    ap.add_argument("--kubectl", action="store_true", help="apply to the real cluster via kubectl")
+    args = ap.parse_args(argv)
+
+    async def run():
+        from dynamo_tpu.deploy.api_server import (
+            DeployApiServer,
+            DeploymentStore,
+            FileDeploymentStore,
+        )
+
+        store = FileDeploymentStore(args.store) if args.store else DeploymentStore()
+        cluster = KubectlCluster() if args.kubectl else FakeCluster()
+        ctrl = await DeployController(store, cluster, interval=args.interval).start()
+        server = DeployApiServer(store, controller=ctrl)
+        port = await server.start(args.host, args.port)
+        log.info("deploy controller up: api=%s:%d cluster=%s", args.host, port,
+                 "kubectl" if args.kubectl else "fake")
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await server.stop()
+            await ctrl.stop()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

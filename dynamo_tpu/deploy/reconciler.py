@@ -277,6 +277,25 @@ def _key(obj: dict) -> tuple:
     return (obj["kind"], obj["metadata"]["namespace"], obj["metadata"]["name"])
 
 
+def _subset(desired, live) -> bool:
+    """True when every field the reconciler renders matches the live object.
+
+    A real API server decorates objects with uid/creationTimestamp/status and
+    defaulted spec fields; whole-dict equality would flag every object as
+    drifted forever. Dicts compare desired-keys-only, lists compare
+    element-wise (length must match — k8s list fields are replaced, not
+    merged, by server-side apply with our field manager)."""
+    if isinstance(desired, dict):
+        if not isinstance(live, dict):
+            return False
+        return all(k in live and _subset(v, live[k]) for k, v in desired.items())
+    if isinstance(desired, list):
+        if not isinstance(live, list) or len(desired) != len(live):
+            return False
+        return all(_subset(d, l) for d, l in zip(desired, live))
+    return desired == live
+
+
 def reconcile(spec: DeploymentSpec, live: list[dict]) -> dict[str, list[dict]]:
     """Diff desired state against a live snapshot.
 
@@ -299,7 +318,7 @@ def reconcile(spec: DeploymentSpec, live: list[dict]) -> dict[str, list[dict]]:
     for key, obj in desired.items():
         if key not in live_by_key:
             actions["create"].append(obj)
-        elif live_by_key[key] != obj:
+        elif not _subset(obj, live_by_key[key]):
             actions["update"].append(obj)
         else:
             actions["unchanged"].append(obj)

@@ -279,3 +279,156 @@ def test_file_store_persists(tmp_path):
     store2 = FileDeploymentStore(path)
     assert store2.head("a")["revision"] == 2
     assert [r["revision"] for r in store2.revisions("a")] == [1, 2]
+
+
+# ---------------- controller loop (watch -> converge -> drift) ----------------
+
+
+def test_controller_converges_and_repairs_drift():
+    from dynamo_tpu.deploy.api_server import DeploymentStore
+    from dynamo_tpu.deploy.controller import DeployController, FakeCluster
+
+    async def run():
+        store = DeploymentStore()
+        cluster = FakeCluster()
+        ctrl = DeployController(store, cluster, interval=3600)  # manual ticks
+
+        # watch -> converge: new deployment materializes every object
+        store.put("llama-agg", sample_spec().to_dict())
+        summary = await ctrl.converge_once()
+        assert summary["llama-agg"]["created"] > 0
+        n_objects = len(cluster.objects)
+        assert n_objects > 0
+        assert store.get_status("llama-agg")["converged"] is False  # had work
+
+        # steady state: second pass is a no-op
+        summary = await ctrl.converge_once()
+        assert summary["llama-agg"]["converged"] is True
+        assert len(cluster.objects) == n_objects
+
+        # drift 1: a worker Deployment deleted out from under the controller
+        key = ("Deployment", "default", "llama-agg-worker")
+        assert key in cluster.objects
+        del cluster.objects[key]
+        summary = await ctrl.converge_once()
+        assert summary["llama-agg"]["created"] == 1
+        assert key in cluster.objects
+
+        # drift 2: replicas mutated out-of-band converge back to desired
+        cluster.objects[key]["spec"]["replicas"] = 17
+        summary = await ctrl.converge_once()
+        assert summary["llama-agg"]["updated"] == 1
+        assert cluster.objects[key]["spec"]["replicas"] == 1
+
+        # unmanaged objects in the namespace are never touched
+        stranger = {
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": "other", "namespace": "default", "labels": {}},
+            "spec": {"replicas": 3},
+        }
+        cluster.objects[("Deployment", "default", "other")] = stranger
+        await ctrl.converge_once()
+        assert cluster.objects[("Deployment", "default", "other")]["spec"]["replicas"] == 3
+
+        # spec update: scale the worker; converge applies exactly that change
+        spec2 = sample_spec()
+        spec2.services[1].replicas = 3
+        store.put("llama-agg", spec2.to_dict())
+        summary = await ctrl.converge_once()
+        assert summary["llama-agg"]["updated"] >= 1
+        assert cluster.objects[key]["spec"]["replicas"] == 3
+        assert store.get_status("llama-agg")["observed_revision"] == 2
+
+        # deployment removed from the store: objects garbage-collected,
+        # the stranger survives
+        store.delete("llama-agg")
+        summary = await ctrl.converge_once()
+        assert summary["llama-agg"] == {"garbage_collected": True}
+        remaining = [k for k in cluster.objects if k[2].startswith("llama-agg")]
+        assert remaining == []
+        assert ("Deployment", "default", "other") in cluster.objects
+
+    asyncio.new_event_loop().run_until_complete(run())
+
+
+def test_controller_rollback_mid_flight_and_api_status(tmp_path):
+    """Rollback through the API while the controller loop is live: the
+    cluster converges back to revision 1's content and /status reports it."""
+    import aiohttp
+
+    from dynamo_tpu.deploy.api_server import DeploymentStore
+    from dynamo_tpu.deploy.controller import DeployController, FakeCluster
+
+    async def run():
+        store = DeploymentStore()
+        cluster = FakeCluster()
+        ctrl = await DeployController(store, cluster, interval=0.1).start()
+        server = DeployApiServer(store, controller=ctrl)
+        port = await server.start()
+        base = f"http://127.0.0.1:{port}/api/v1"
+        key = ("Deployment", "default", "llama-agg-worker")
+
+        async def wait_converged(rev, timeout=10.0):
+            deadline = asyncio.get_running_loop().time() + timeout
+            while asyncio.get_running_loop().time() < deadline:
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(f"{base}/deployments/llama-agg/status") as r:
+                        body = await r.json()
+                st = body.get("status") or {}
+                if st.get("observed_revision") == rev and st.get("converged"):
+                    return st
+                await asyncio.sleep(0.05)
+            raise TimeoutError(f"never converged to rev {rev}")
+
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(f"{base}/deployments", json=sample_spec().to_dict()) as r:
+                    assert r.status == 201
+            await wait_converged(1)
+            assert cluster.objects[key]["spec"]["replicas"] == 1
+
+            spec2 = sample_spec()
+            spec2.services[1].replicas = 5
+            async with aiohttp.ClientSession() as s:
+                async with s.put(f"{base}/deployments/llama-agg", json=spec2.to_dict()) as r:
+                    assert r.status == 200
+            await wait_converged(2)
+            assert cluster.objects[key]["spec"]["replicas"] == 5
+
+            # rollback mid-flight -> revision 3 with revision 1's spec
+            async with aiohttp.ClientSession() as s:
+                async with s.post(f"{base}/deployments/llama-agg/rollback/1") as r:
+                    assert r.status == 200
+            st = await wait_converged(3)
+            assert cluster.objects[key]["spec"]["replicas"] == 1
+            assert st["converged"] is True
+        finally:
+            await server.stop()
+            await ctrl.stop()
+
+    asyncio.new_event_loop().run_until_complete(run())
+
+
+def test_controller_restart_gcs_deployments_deleted_while_down():
+    """A deployment deleted while the controller was down must still be
+    garbage-collected: ownership labels, not in-process memory, drive GC."""
+    from dynamo_tpu.deploy.api_server import DeploymentStore
+    from dynamo_tpu.deploy.controller import DeployController, FakeCluster
+
+    async def run():
+        store = DeploymentStore()
+        cluster = FakeCluster()
+        ctrl1 = DeployController(store, cluster, interval=3600)
+        store.put("llama-agg", sample_spec().to_dict())
+        store.put("other-dep", sample_spec(name="other-dep").to_dict())
+        await ctrl1.converge_once()
+        assert any(k[2].startswith("llama-agg") for k in cluster.objects)
+
+        # controller dies; deployment deleted while it is down
+        store.delete("llama-agg")
+        ctrl2 = DeployController(store, cluster, interval=3600)  # fresh memory
+        await ctrl2.converge_once()
+        assert not any(k[2].startswith("llama-agg") for k in cluster.objects)
+        assert any(k[2].startswith("other-dep") for k in cluster.objects)
+
+    asyncio.new_event_loop().run_until_complete(run())
