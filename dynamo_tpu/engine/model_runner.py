@@ -101,7 +101,9 @@ class ModelRunner:
             )
 
             shardings = stage_param_shardings(model, mesh)
-            kv_sharding = stage_kv_sharding(mesh)
+            kv_sharding = stage_kv_sharding(
+                mesh, folded=getattr(model.config, "kv_folded", False)
+            )
             probe = jax.eval_shape(
                 lambda: model.init_kv_cache(config.num_pages, config.page_size)
             )

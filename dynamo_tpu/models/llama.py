@@ -79,6 +79,16 @@ class LlamaConfig:
     mrope_section: Any = None
     dtype: Any = jnp.bfloat16
 
+    @property
+    def kv_folded(self) -> bool:
+        """KV page rows store heads FOLDED into the lane dim ([ps, Hkv*D]
+        instead of [ps, Hkv, D]) when head_dim isn't 128-lane aligned:
+        Mosaic cannot DMA-slice an HBM pool whose minor dim is under the
+        128-lane tile, and reshaping the (donated, scatter-updated) pool at
+        attention time materializes a full-pool copy per layer per step.
+        TinyLlama / Qwen2-small shapes (D=64) hit this; D=128 models don't."""
+        return self.head_dim % 128 != 0
+
     @classmethod
     def from_hf_config(cls, d: dict) -> "LlamaConfig":
         """Build from a HuggingFace config.json dict (Llama / Qwen2 families)."""
@@ -200,8 +210,11 @@ class LlamaModel:
         return shardings
 
     def kv_cache_shape(self, num_pages: int, page_size: int) -> tuple[int, ...]:
-        """Shape of each of the two flat page pools (the "k" and "v" leaves)."""
+        """Shape of each of the two flat page pools (the "k" and "v" leaves).
+        See LlamaConfig.kv_folded for the folded (sub-128 head_dim) layout."""
         c = self.config
+        if c.kv_folded:
+            return (c.num_layers * num_pages, page_size, c.num_kv_heads * c.head_dim)
         return (c.num_layers * num_pages, page_size, c.num_kv_heads, c.head_dim)
 
     def init_kv_cache(self, num_pages: int, page_size: int) -> dict:
@@ -213,7 +226,12 @@ class LlamaModel:
 
     def kv_cache_sharding(self, mesh: Mesh, tp_axis: str = "tp") -> dict:
         tp_axis = _resolve_tp_axis(mesh, tp_axis)
-        ns = NamedSharding(mesh, P(None, None, tp_axis, None))
+        if self.config.kv_folded:
+            # folded lane dim is head-major, so a tp split that divides Hkv
+            # stays head-aligned
+            ns = NamedSharding(mesh, P(None, None, tp_axis))
+        else:
+            ns = NamedSharding(mesh, P(None, None, tp_axis, None))
         return {"k": ns, "v": ns}
 
     def _layer_offsets(self, num_pages: int) -> jnp.ndarray:
@@ -229,7 +247,8 @@ class LlamaModel:
     wire_n_axis = 2
 
     def gather_pages_wire(self, kv: dict, flat_ids: jnp.ndarray) -> jnp.ndarray:
-        """-> [L, 2, n, page_size, Hkv, D]."""
+        """-> [L, 2, n, page_size, Hkv, D] ([..., Hkv*D] when kv_folded —
+        both disagg sides share the model config, so the layouts agree)."""
         return jnp.stack([kv["k"][flat_ids], kv["v"][flat_ids]], axis=1)
 
     def scatter_pages_wire(self, kv: dict, flat_ids: jnp.ndarray, data: jnp.ndarray) -> dict:
@@ -241,6 +260,8 @@ class LlamaModel:
 
     def wire_sharding(self, mesh: Mesh, tp_axis: str = "tp") -> NamedSharding:
         tp_axis = _resolve_tp_axis(mesh, tp_axis)
+        if self.config.kv_folded:
+            return NamedSharding(mesh, P(None, None, None, None, tp_axis))
         return NamedSharding(mesh, P(None, None, None, None, tp_axis, None))
 
     # ---------------- forward ----------------
@@ -291,6 +312,7 @@ class LlamaModel:
         else:
             q = apply_rope(q, positions, c.rope_theta)
             k = apply_rope(k, positions, c.rope_theta)
+        # scatter_kv folds the new rows itself when the pool is lane-folded
         k_pool, v_pool = scatter_kv(k_pool, v_pool, k, v, flat_phys, offsets)
         # attn_fn sees both the updated pools (paged paths) and the chunk's
         # fresh rows (ring/SP path, which never reads the pool)

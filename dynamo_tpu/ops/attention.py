@@ -47,6 +47,11 @@ def scatter_kv(
     docstring), so no old-value gather/select is needed — the scatter stays a
     pure in-place write on donated buffers.
     """
+    if k_pages.ndim == 3 and k_new.ndim == 3:
+        # folded pool (see LlamaConfig.kv_folded): fold the NEW rows — tiny —
+        # never the pool (reshaping a donated, scatter-updated pool copies it)
+        k_new = k_new.reshape(k_new.shape[0], -1)
+        v_new = v_new.reshape(v_new.shape[0], -1)
     k_pages = k_pages.at[phys_pages, offsets].set(k_new)
     v_pages = v_pages.at[phys_pages, offsets].set(v_new)
     return k_pages, v_pages
@@ -69,12 +74,21 @@ def write_kv_pages(
     return scatter_kv(k_pages, v_pages, k_new, v_new, phys, offsets)
 
 
-def gather_pages(pages: jnp.ndarray, page_table: jnp.ndarray) -> jnp.ndarray:
-    """[P, ps, Hkv, D] gathered by [max_pages] -> [max_pages * ps, Hkv, D]."""
+def gather_pages(pages: jnp.ndarray, page_table: jnp.ndarray, head_dim: int | None = None) -> jnp.ndarray:
+    """[P, ps, Hkv, D] gathered by [max_pages] -> [max_pages * ps, Hkv, D].
+
+    Folded pools ([P, ps, Hkv*D], see LlamaConfig.kv_folded) unfold here —
+    the GATHERED context is small, so the reshape is cheap, unlike reshaping
+    the pool itself."""
     max_pages = page_table.shape[0]
     ps = pages.shape[1]
-    g = pages[page_table]  # [max_pages, ps, Hkv, D]
-    return g.reshape(max_pages * ps, *pages.shape[2:])
+    g = pages[page_table]  # [max_pages, ps, ...]
+    out = g.reshape(max_pages * ps, *pages.shape[2:])
+    if out.ndim == 2:  # folded: [S, Hkv*D] -> [S, Hkv, D]
+        if head_dim is None:
+            raise ValueError("folded pages need head_dim to unfold")
+        return out.reshape(out.shape[0], -1, head_dim)
+    return out
 
 
 def _repeat_kv(x: jnp.ndarray, num_q_heads: int) -> jnp.ndarray:
@@ -117,8 +131,9 @@ def paged_prefill_attention(
     q_positions: jnp.ndarray,  # [T] absolute positions (pad rows: anything)
 ) -> jnp.ndarray:
     """Chunk attention over all cached context + self (already written to pages)."""
-    k_ctx = gather_pages(k_pages, page_table)
-    v_ctx = gather_pages(v_pages, page_table)
+    D = q.shape[-1]
+    k_ctx = gather_pages(k_pages, page_table, head_dim=D)
+    v_ctx = gather_pages(v_pages, page_table, head_dim=D)
     return attention_with_positions(q, k_ctx, v_ctx, q_positions)
 
 
@@ -130,12 +145,13 @@ def paged_decode_attention(
     positions: jnp.ndarray,  # [B] the query token's absolute position
 ) -> jnp.ndarray:
     """Single-token-per-sequence attention for the decode batch."""
+    D = q.shape[-1]
 
     def one(q_b, pt_b, pos_b):
         out = attention_with_positions(
             q_b[None, :, :],
-            gather_pages(k_pages, pt_b),
-            gather_pages(v_pages, pt_b),
+            gather_pages(k_pages, pt_b, head_dim=D),
+            gather_pages(v_pages, pt_b, head_dim=D),
             pos_b[None],
         )
         return out[0]
@@ -172,12 +188,15 @@ def use_pallas_decode(head_dim: int, num_kv_heads: int) -> bool:
     """Trace-time choice of the Pallas decode kernel.
 
     DYNTPU_PALLAS=1 forces on (interpret on CPU), =0 forces off; default: on
-    for real TPU backends with lane-aligned head_dim.
-    """
+    for real TPU backends when either the head_dim is lane-aligned (128) or
+    the folded-heads variant applies (head_dim < 128 with Hkv*D
+    lane-aligned — TinyLlama/Qwen2-small shapes)."""
     flag = pallas_flag()
     if flag is not None:
         return flag
-    return _on_tpu() and head_dim % 128 == 0
+    if not _on_tpu():
+        return False
+    return head_dim % 128 == 0 or (num_kv_heads * head_dim) % 128 == 0
 
 
 
@@ -204,19 +223,28 @@ def dispatch_paged_decode_attention(q, k_pages, v_pages, page_tables, positions,
     With a tensor-parallel mesh the kernel runs under shard_map: attention is
     head-parallel, so each device handles its Hq/Hkv shard with no
     communication (GSPMD cannot partition a pallas_call by itself)."""
-    if use_pallas_decode(q.shape[-1], k_pages.shape[2]):
+    num_kv_heads = (
+        k_pages.shape[2] // q.shape[-1] if k_pages.ndim == 3 else k_pages.shape[2]
+    )
+    if use_pallas_decode(q.shape[-1], num_kv_heads):
         import os
 
         from dynamo_tpu.ops.pallas.paged_attention import (
             paged_decode_attention_pallas,
             paged_decode_attention_pallas_chunked,
+            paged_decode_attention_pallas_folded,
         )
 
         # perseq (default): one grid program per sequence, double-buffered
         # per-page DMA — fastest on v5e across bs 8-128 (A/B'd on chip).
         # chunked: C pages per DMA group + larger matmuls (kept for A/B;
         # VMEM-safe, unlike a full cross-sequence batching of the scratch).
-        if os.environ.get("DYNTPU_DECODE_KERNEL", "perseq") == "chunked":
+        # folded: head_dim < 128 shapes (Mosaic can't DMA-slice sub-128-lane
+        # pools; heads live folded into the lane dim — see kv_folded).
+        folded = k_pages.ndim == 3
+        if folded or q.shape[-1] % 128 != 0:
+            paged_decode_attention_pallas = paged_decode_attention_pallas_folded
+        elif os.environ.get("DYNTPU_DECODE_KERNEL", "perseq") == "chunked":
             paged_decode_attention_pallas = paged_decode_attention_pallas_chunked
         interpret = not _on_tpu()
         tp = 1 if mesh is None else mesh.shape.get("tp", 1)
@@ -225,16 +253,24 @@ def dispatch_paged_decode_attention(q, k_pages, v_pages, page_tables, positions,
 
             from jax.sharding import PartitionSpec as P
 
-            if q.shape[1] % tp or k_pages.shape[2] % tp:
+            shard_lanes_ok = (
+                not folded
+                or (num_kv_heads % tp == 0
+                    and (num_kv_heads // tp) * q.shape[-1] % 128 == 0)
+            )
+            if q.shape[1] % tp or num_kv_heads % tp or not shard_lanes_ok:
+                # per-shard folded lanes must stay 128-aligned or the shard
+                # kernel would face the very sub-128 pool this path avoids
                 return paged_decode_attention(q, k_pages, v_pages, page_tables, positions)
+            pool_spec = P(None, None, "tp") if folded else P(None, None, "tp", None)
             fn = functools.partial(paged_decode_attention_pallas, interpret=interpret)
             return _tp_shard_map(
                 fn,
                 mesh,
                 in_specs=(
                     P(None, "tp", None),  # q: heads sharded
-                    P(None, None, "tp", None),  # k pages: kv heads sharded
-                    P(None, None, "tp", None),  # v pages
+                    pool_spec,  # k pages: kv heads sharded
+                    pool_spec,  # v pages
                     P(None, None),  # page tables replicated
                     P(None),  # positions replicated
                 ),
@@ -270,6 +306,11 @@ def dispatch_paged_prefill_attention(
     UNIT-STRIDE within the chunk (positions[i] = positions[0] + i), which is
     exactly what the engine's bucket-padded chunks provide. The reference
     path only needs monotone positions."""
+    if k_pages.ndim == 3:
+        # folded pool (sub-128 head_dim): the prefill kernel has no folded
+        # variant yet — the gather reference unfolds the (small) gathered
+        # context instead
+        return paged_prefill_attention(q, k_pages, v_pages, page_table, positions)
     if use_pallas_prefill(q.shape[-1], q.shape[0]):
         from dynamo_tpu.ops.pallas.prefill_attention import (
             paged_prefill_attention_pallas,

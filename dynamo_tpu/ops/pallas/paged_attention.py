@@ -114,6 +114,174 @@ def _kernel(
     out_ref[0] = out.reshape(Hq, D).astype(out_ref.dtype)
 
 
+def _kernel_folded(
+    # scalar prefetch
+    page_tables_ref,  # [B, max_pages] SMEM
+    lengths_ref,  # [B] SMEM
+    # inputs
+    q_ref,  # [1, Hq, D] VMEM (this sequence's query)
+    k_hbm,  # [P, ps, Hkv*D] HBM (heads folded into lanes)
+    v_hbm,  # [P, ps, Hkv*D] HBM
+    # output
+    out_ref,  # [1, Hq, D] VMEM
+    # scratch
+    k_scratch,  # [2, ps, Hkv*D] VMEM
+    v_scratch,  # [2, ps, Hkv*D] VMEM
+    sems,  # DMA sems [2, 2]
+    *,
+    page_size: int,
+    num_kv_heads: int,
+    head_dim: int,
+):
+    """Decode attention for head_dim < 128 (e.g. TinyLlama/Qwen2-small: 64).
+
+    Mosaic can't DMA-slice an HBM pool whose minor dim is under the 128-lane
+    tile, so the pools arrive with kv heads FOLDED into the lane dim
+    ([ps, Hkv*D] rows, >= 128 lanes). The per-head math never unfolds in bf16:
+
+      - scores: Q is placed into a zero-padded folded layout (each q head
+        occupies its kv head's D-slice, zeros elsewhere), so one
+        [Hq, Hkv*D] x [ps, Hkv*D] matmul yields exact per-head scores —
+        the zero slices kill every cross-head term.
+      - output: probs @ V_folded gives [Hq, Hkv*D]; each head's true output
+        sits in its kv head's slice, selected with a one-hot contraction in
+        f32 (32-bit ops may reshape the minor dim; bf16 may not).
+    """
+    b = pl.program_id(0)
+    length = lengths_ref[b]
+    n_pages = jnp.maximum(1, pl.cdiv(length, page_size))
+
+    Hq, D = q_ref.shape[1], head_dim
+    Hkv = num_kv_heads
+    G = Hq // Hkv
+    F = Hkv * D  # folded lane width
+
+    q32 = q_ref[0].astype(jnp.float32)  # [Hq, D]
+    # Everything stays 2D — Mosaic (this version) rejects minor-dim reshapes
+    # outright. The folded-lane ownership mask [Hq, F]:
+    #   mask[h, f] = (f // D == h // G)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (Hq, F), 1)
+    head = jax.lax.broadcasted_iota(jnp.int32, (Hq, F), 0)
+    mask = (lane // D == head // G).astype(jnp.float32)
+    # folded q via lane-tiling: concat Hkv copies of q along lanes, zero all
+    # slices a head doesn't own
+    qtile = jnp.concatenate([q32] * Hkv, axis=1)  # [Hq, F]
+    qf = (qtile * mask).astype(q_ref.dtype)
+    scale = 1.0 / jnp.sqrt(jnp.float32(D))
+
+    def dma(slot, i, which):
+        hbm, scratch = (k_hbm, k_scratch) if which == 0 else (v_hbm, v_scratch)
+        return pltpu.make_async_copy(
+            hbm.at[page_tables_ref[b, i]], scratch.at[slot], sems.at[slot, which]
+        )
+
+    dma(0, 0, 0).start()
+    dma(0, 0, 1).start()
+
+    def body(i, carry):
+        m, l, acc = carry  # [Hq], [Hq], [Hq, F] f32
+        slot = jax.lax.rem(i, 2)
+        next_slot = jax.lax.rem(i + 1, 2)
+
+        @pl.when(i + 1 < n_pages)
+        def _():
+            dma(next_slot, i + 1, 0).start()
+            dma(next_slot, i + 1, 1).start()
+
+        dma(slot, i, 0).wait()
+        dma(slot, i, 1).wait()
+
+        k_page = k_scratch[slot]  # [ps, F] bf16
+        v_page = v_scratch[slot]
+        idx = i * page_size + jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
+        vidx = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (page_size, 1), 0
+        )
+
+        # [Hq, ps] exact per-head scores via the folded contraction
+        scores = jax.lax.dot_general(
+            qf, k_page, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        scores = jnp.where(idx < length, scores, _NEG_INF)
+        v_page = jnp.where(vidx < length, v_page, 0)
+
+        chunk_max = jnp.max(scores, axis=-1)  # [Hq]
+        new_m = jnp.maximum(m, chunk_max)
+        corr = jnp.exp(m - new_m)
+        probs = jnp.exp(scores - new_m[:, None])  # [Hq, ps]
+        new_l = l * corr + jnp.sum(probs, axis=-1)
+        # [Hq, F] = [Hq, ps] x [ps, F]
+        chunk_out = jax.lax.dot_general(
+            probs.astype(v_page.dtype), v_page,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        new_acc = acc * corr[:, None] + chunk_out
+        return new_m, new_l, new_acc
+
+    m0 = jnp.full((Hq,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((Hq,), jnp.float32)
+    acc0 = jnp.zeros((Hq, F), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_pages, body, (m0, l0, acc0))
+
+    # select each head's slice: zero un-owned lanes, then fold the Hkv
+    # D-wide lane slices together (only the owned one is nonzero)
+    acc_m = acc * mask
+    out = acc_m[:, 0:D]
+    for j in range(1, Hkv):
+        out = out + acc_m[:, j * D : (j + 1) * D]
+    out = out / jnp.maximum(l, 1e-20)[:, None]
+    out_ref[0] = out.astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_decode_attention_pallas_folded(
+    q: jnp.ndarray,  # [B, Hq, D]
+    k_pages: jnp.ndarray,  # [P, ps, Hkv*D] folded, or [P, ps, Hkv, D]
+    v_pages: jnp.ndarray,
+    page_tables: jnp.ndarray,  # [B, max_pages] int32
+    positions: jnp.ndarray,  # [B] int32 query positions
+    interpret: bool = False,
+) -> jnp.ndarray:
+    B, Hq, D = q.shape
+    lengths = positions.astype(jnp.int32) + 1
+    if k_pages.ndim == 4:
+        # direct-call convenience (tests): fold here. Serving passes pools
+        # ALREADY folded (LlamaConfig.kv_folded) — reshaping a donated,
+        # scatter-updated pool at attention time copies the whole pool.
+        P, ps, Hkv, _ = k_pages.shape
+        k_pages = k_pages.reshape(P, ps, Hkv * D)
+        v_pages = v_pages.reshape(P, ps, Hkv * D)
+    P, ps, F = k_pages.shape
+    Hkv = F // D
+    kf, vf = k_pages, v_pages
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, ps, Hkv * D), k_pages.dtype),
+            pltpu.VMEM((2, ps, Hkv * D), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    kernel = pl.pallas_call(
+        functools.partial(
+            _kernel_folded, page_size=ps, num_kv_heads=Hkv, head_dim=D
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )
+    return kernel(page_tables.astype(jnp.int32), lengths, q, kf, vf)
+
+
 def _kernel_chunked(
     # scalar prefetch
     page_tables_ref,  # [B, max_pages] SMEM

@@ -53,8 +53,11 @@ def stage_param_shardings(model, mesh: Mesh, pp_axis: str = "pp") -> dict:
     return shardings
 
 
-def stage_kv_sharding(mesh: Mesh, pp_axis: str = "pp") -> dict:
-    ns = NamedSharding(mesh, P(pp_axis, None, None, None))
+def stage_kv_sharding(mesh: Mesh, pp_axis: str = "pp", folded: bool = False) -> dict:
+    """Layer-major pool split over pp; `folded` = sub-128 head_dim pools
+    ([LP, ps, Hkv*D], one fewer dim — see LlamaConfig.kv_folded)."""
+    spec = P(pp_axis, None, None) if folded else P(pp_axis, None, None, None)
+    ns = NamedSharding(mesh, spec)
     return {"k": ns, "v": ns}
 
 
@@ -157,7 +160,11 @@ def prefill_pipelined(
     )
     rp_mbs = rp3.reshape(M, Tm, 3)
 
-    spec_pool = P(pp_axis, None, None, None)
+    spec_pool = (
+        P(pp_axis, None, None)
+        if getattr(model.config, "kv_folded", False)
+        else P(pp_axis, None, None, None)
+    )
     rep = P()
 
     @partial(
@@ -235,7 +242,11 @@ def decode_pipelined(
     rp = positions + (rope_deltas if rope_deltas is not None else 0)
     rp_mbs = jnp.stack([rp] * 3, axis=-1).reshape(M, Bm, 3)
 
-    spec_pool = P(pp_axis, None, None, None)
+    spec_pool = (
+        P(pp_axis, None, None)
+        if getattr(model.config, "kv_folded", False)
+        else P(pp_axis, None, None, None)
+    )
     rep = P()
 
     @partial(

@@ -1,0 +1,68 @@
+"""End-to-end HTTP serving of a real HF-FORMAT checkpoint directory: config +
+safetensors weights + a genuine trained BPE tokenizer with a chat template —
+the full OpenAI-frontend path a user of the reference exercises (reference:
+docs/architecture.md serving-stack numbers are HTTP-level, not engine-level).
+"""
+
+import asyncio
+import sys
+
+import pytest
+
+sys.path.insert(0, ".")
+
+pytestmark = pytest.mark.slow
+
+
+def test_serve_synthetic_hf_checkpoint(tmp_path):
+    from tools.make_hf_checkpoint import TINY_GEOMETRY, make_checkpoint
+
+    ckpt = make_checkpoint(str(tmp_path / "ckpt"), TINY_GEOMETRY)
+
+    async def run():
+        import aiohttp
+
+        from dynamo_tpu.engine.config import EngineConfig
+        from dynamo_tpu.engine.engine import AsyncJaxEngine
+        from dynamo_tpu.frontends.pipeline import build_pipeline
+        from dynamo_tpu.llm.http.service import HttpService
+        from dynamo_tpu.llm.model_card import ModelDeploymentCard
+
+        card = ModelDeploymentCard.from_local_path(str(ckpt), name="synth")
+        engine = AsyncJaxEngine(EngineConfig.for_model(
+            str(ckpt), page_size=16, num_pages=64, max_seqs=4,
+            max_model_len=256, prefill_buckets=(32, 64),
+        ))
+        await engine.start()
+        svc = HttpService(host="127.0.0.1", port=0)
+        svc.manager.add(build_pipeline(engine, card))
+        port = await svc.start()
+        base = f"http://127.0.0.1:{port}/v1"
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"http://127.0.0.1:{port}/v1/models") as r:
+                    models = await r.json()
+                assert any(m["id"] == "synth" for m in models["data"])
+                body = {
+                    "model": "synth",
+                    "messages": [{"role": "user", "content": "hello there friend"}],
+                    "max_tokens": 8,
+                    "temperature": 0.0,
+                    "ext": {"ignore_eos": True, "annotations": ["token_ids"]},
+                }
+                async with s.post(f"{base}/chat/completions", json=body) as r:
+                    assert r.status == 200, await r.text()
+                    out = await r.json()
+                text = out["choices"][0]["message"]["content"]
+                assert out["usage"]["completion_tokens"] == 8
+                # the trained BPE tokenizer round-trips: decoded text is
+                # re-encodable and non-empty for 8 real sampled tokens
+                assert isinstance(text, str) and len(text) > 0
+                # deterministic greedy: same request, same answer
+                async with s.post(f"{base}/chat/completions", json=body) as r:
+                    assert (await r.json())["choices"][0]["message"]["content"] == text
+        finally:
+            await svc.stop()
+            await engine.shutdown()
+
+    asyncio.new_event_loop().run_until_complete(run())

@@ -192,3 +192,19 @@ def test_pallas_chunked_matches_reference():
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(ref), atol=2e-5, err_msg=f"B={B} Hq={Hq}"
         )
+
+
+def test_pallas_folded_matches_reference():
+    """head_dim < 128 variant: heads folded into lanes, zero-placed Q."""
+    from dynamo_tpu.ops.pallas.paged_attention import (
+        paged_decode_attention_pallas_folded,
+    )
+
+    for B, Hq, Hkv, D, seed in [(3, 8, 2, 16, 0), (4, 32, 4, 16, 1), (2, 4, 4, 8, 2)]:
+        q, k, v, pt, pos = make_case(B=B, Hq=Hq, Hkv=Hkv, D=D, seed=seed)
+        pos = jnp.asarray(np.random.default_rng(seed).integers(0, 15, B), jnp.int32)
+        ref = paged_decode_attention(q, k, v, pt, pos)
+        got = paged_decode_attention_pallas_folded(q, k, v, pt, pos, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), atol=2e-5, err_msg=f"B={B} Hq={Hq} D={D}"
+        )

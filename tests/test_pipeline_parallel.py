@@ -34,7 +34,7 @@ def test_prefill_and_decode_parity(setup, pp, microbatches):
     mesh = Mesh(np.array(jax.devices()[:pp]), ("pp",))
     params_pp = jax.device_put(params, stage_param_shardings(model, mesh))
     kv_pp = jax.device_put(
-        model.init_kv_cache(NUM_PAGES, PAGE_SIZE), stage_kv_sharding(mesh)
+        model.init_kv_cache(NUM_PAGES, PAGE_SIZE), stage_kv_sharding(mesh, folded=cfg.kv_folded)
     )
 
     T = 16
