@@ -36,7 +36,7 @@ PROMPT_LEN = 128
 DECODE_TOKENS = 128
 
 # (batch, page_size): headline serving config + round-1-comparable config
-HEADLINE = (64, 64)
+HEADLINE = (64, 128)
 CONTINUITY = (8, 16)
 
 
@@ -46,7 +46,7 @@ def bench_config(batch: int = 64, page_size: int = 64):
     return EngineConfig(
         model_id=json_model_id(),
         page_size=page_size,
-        num_pages=max(1024 * 16 // page_size, batch * 20 * 16 // page_size),
+        num_pages=max(1024 * 16 // page_size, batch * 28 * 16 // page_size),
         max_seqs=batch,
         max_model_len=1024,
         prefill_buckets=(128, 256, 512),
@@ -114,24 +114,44 @@ def _probe_pallas(page_size: int = 64) -> None:
         os.environ["DYNTPU_PALLAS"] = "0"
 
 
-async def run_config(batch: int, page_size: int, rounds: int = 3) -> dict:
+async def run_config(
+    batch: int,
+    page_size: int,
+    rounds: int = 3,
+    prompt_len: int = PROMPT_LEN,
+    decode_tokens: int = DECODE_TOKENS,
+    max_model_len: int = 1024,
+) -> dict:
     from dynamo_tpu.engine.engine import AsyncJaxEngine
     from dynamo_tpu.engine.sampling import SamplingParams
     from dynamo_tpu.engine.scheduler import EngineRequest
 
-    engine = AsyncJaxEngine(bench_config(batch, page_size))
+    cfg = bench_config(batch, page_size)
+    if max_model_len != cfg.max_model_len:
+        import dataclasses
+
+        need_pages = batch * (-(-(prompt_len + decode_tokens) // page_size) + 4)
+        cfg = dataclasses.replace(
+            cfg,
+            max_model_len=max_model_len,
+            num_pages=max(cfg.num_pages, need_pages),
+            # 1024 cap: long prompts run as chunked prefill; a 2048-token
+            # bucket compile is heavy enough to flake the remote compiler
+            prefill_buckets=(128, 256, 512, 1024),
+        )
+    engine = AsyncJaxEngine(cfg)
     await engine.start()
 
     rng = np.random.default_rng(0)
-    prompts = [rng.integers(1, 31000, PROMPT_LEN).tolist() for _ in range(batch)]
+    prompts = [rng.integers(1, 31000, prompt_len).tolist() for _ in range(batch)]
 
     async def one(i: int, warmup: bool, rnd: int = 0):
         req = EngineRequest(
             request_id=f"{'w' if warmup else 'b'}{rnd}-{i}",
-            token_ids=prompts[i] if not warmup else rng.integers(1, 31000, PROMPT_LEN).tolist(),
+            token_ids=prompts[i] if not warmup else rng.integers(1, 31000, prompt_len).tolist(),
             sampling=SamplingParams(
                 temperature=0.0,
-                max_tokens=8 if warmup else DECODE_TOKENS,
+                max_tokens=8 if warmup else decode_tokens,
                 ignore_eos=True,
             ),
         )
@@ -155,7 +175,7 @@ async def run_config(batch: int, page_size: int, rounds: int = 3) -> dict:
     round_tok_s = []
     for rnd in range(rounds):
         for i in range(batch):
-            prompts[i] = rng.integers(1, 31000, PROMPT_LEN).tolist()
+            prompts[i] = rng.integers(1, 31000, prompt_len).tolist()
         t0 = time.monotonic()
         results = await asyncio.gather(*[one(i, warmup=False, rnd=rnd) for i in range(batch)])
         elapsed = time.monotonic() - t0
@@ -174,6 +194,8 @@ async def run_config(batch: int, page_size: int, rounds: int = 3) -> dict:
         "ttft_p50_ms": round(float(np.percentile(ttfts, 50)) * 1e3, 1),
         "batch": batch,
         "page_size": page_size,
+        "prompt_len": prompt_len,
+        "decode_tokens": decode_tokens,
         "rounds": round_tok_s,
     }
 
@@ -392,21 +414,24 @@ async def run_http_serving(batch: int = 32, page_size: int = 64) -> dict:
         # (SSE delta count undercounts: multi-token BPE merges coalesce)
         return max_tokens, ttft
 
-    async with aiohttp.ClientSession() as session:
-        await asyncio.gather(*[one(session, i, 0, max_tokens=8) for i in range(batch)])  # warmup
-        best = None
-        for rnd in (1, 2):
-            t0 = _time.monotonic()
-            results = await asyncio.gather(*[one(session, i, rnd) for i in range(batch)])
-            elapsed = _time.monotonic() - t0
-            toks = sum(n for n, _ in results)
-            ttfts = [t for _, t in results if t is not None]
-            if best is None or toks / elapsed > best[0]:
-                best = (toks / elapsed, elapsed, ttfts)
-
-    await svc.stop()
-    await engine.shutdown()
-    gc.collect()
+    try:
+        async with aiohttp.ClientSession() as session:
+            await asyncio.gather(*[one(session, i, 0, max_tokens=8) for i in range(batch)])  # warmup
+            best = None
+            for rnd in (1, 2):
+                t0 = _time.monotonic()
+                results = await asyncio.gather(*[one(session, i, rnd) for i in range(batch)])
+                elapsed = _time.monotonic() - t0
+                toks = sum(n for n, _ in results)
+                ttfts = [t for _, t in results if t is not None]
+                if best is None or toks / elapsed > best[0]:
+                    best = (toks / elapsed, elapsed, ttfts)
+    finally:
+        # a failed round must not leak the engine's HBM into the parity
+        # sections that start their own engines next
+        await svc.stop()
+        await engine.shutdown()
+        gc.collect()
     tok_s, elapsed, ttfts = best
     return {
         "model": "TinyLlama-1.1B geometry (synthetic HF checkpoint)",
@@ -436,6 +461,13 @@ async def run() -> dict:
     if os.environ.get("DYNTPU_BENCH_PARITY", "1") != "0":
         import gc
 
+        gc.collect()
+        # the reference's tracked workload shape (BASELINE.md: 3K ISL /
+        # 150 OSL serving configs)
+        detail["ref_workload_isl3k_osl150"] = await run_config(
+            16, 128, rounds=2, prompt_len=3072, decode_tokens=150,
+            max_model_len=4096,
+        )
         gc.collect()
         detail["http_serving"] = await run_http_serving()
         gc.collect()

@@ -20,7 +20,7 @@ from dynamo_tpu.engine.engine import AsyncJaxEngine
 from dynamo_tpu.llm.backend import Backend
 from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher
 from dynamo_tpu.llm.model_card import ModelDeploymentCard
-from dynamo_tpu.llm.model_registry import ModelEntry, register_model
+from dynamo_tpu.llm.model_registry import ModelEntry, ModelRegistration
 from dynamo_tpu.llm.protocols.common import PreprocessedRequest
 from dynamo_tpu.llm.tokenizer import get_tokenizer
 from dynamo_tpu.utils import get_logger
@@ -85,10 +85,21 @@ class WorkerService:
                 model_type="chat",
                 card=self.card,
             )
-            await register_model(self.drt.cplane, entry)
+            # lease-tied + refreshed: the card dies with this worker's lease
+            # and any surviving co-worker's refresh restores it (MDC TTL
+            # semantics, reference: model_card/model.rs)
+            self._registration = await ModelRegistration(
+                self.drt.cplane, entry, lease_id=self.drt.primary_lease.lease_id
+            ).start()
         return self
 
     async def stop(self) -> None:
+        if getattr(self, "_registration", None) is not None:
+            # unregister=False: the card key is lease-tied, so OUR lease revoke
+            # (DRT shutdown) removes it if we were the owner — while a clean
+            # scale-down of one worker of a multi-worker model must NOT blip
+            # the shared card for the survivors
+            await self._registration.stop(unregister=False)
         if self._served is not None:
             await self._served.stop()
         if self.engine is not None:

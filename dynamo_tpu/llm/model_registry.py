@@ -51,6 +51,58 @@ async def register_model(cplane, entry: ModelEntry, lease_id: int = 0) -> None:
     await cplane.kv_put(entry.key(), entry.to_wire(), lease_id=lease_id)
 
 
+class ModelRegistration:
+    """Keep a model card registered while its worker lives.
+
+    The reference republishes cards into a TTL bucket so a dead engine's card
+    expires (reference: lib/llm/src/model_card/model.rs:70-80). Here the card
+    key is LEASE-TIED (dies with the registering worker's connection) and a
+    refresh loop re-puts it periodically — so when the lease-owning worker of
+    a multi-worker model dies, any surviving worker's next refresh restores
+    the card within one interval instead of leaving it gone (or, with no
+    lease at all, leaving a stale card forever in the durable broker KV)."""
+
+    def __init__(self, cplane, entry: ModelEntry, lease_id: int, interval: float = 5.0):
+        import asyncio
+
+        self._cplane = cplane
+        self.entry = entry
+        self.lease_id = lease_id
+        self.interval = interval
+        self._task: "asyncio.Task | None" = None
+
+    async def start(self) -> "ModelRegistration":
+        import asyncio
+
+        await register_model(self._cplane, self.entry, lease_id=self.lease_id)
+        self._task = asyncio.create_task(self._refresh_loop())
+        return self
+
+    async def _refresh_loop(self) -> None:
+        import asyncio
+
+        from dynamo_tpu.utils import get_logger
+
+        log = get_logger("llm.model_registry")
+        while True:
+            await asyncio.sleep(self.interval)
+            try:
+                await register_model(self._cplane, self.entry, lease_id=self.lease_id)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                log.warning("model card refresh failed for %s: %s", self.entry.name, e)
+
+    async def stop(self, unregister: bool = True) -> None:
+        if self._task is not None:
+            self._task.cancel()
+        if unregister:
+            try:
+                await unregister_model(self._cplane, self.entry.model_type, self.entry.name)
+            except Exception:
+                pass
+
+
 async def unregister_model(cplane, model_type: str, name: str) -> bool:
     return await cplane.kv_delete(f"{MODELS_PREFIX}/{model_type}/{name}")
 

@@ -188,3 +188,48 @@ def test_preprocessor_chat_and_limits():
     )
     with pytest.raises(ProtocolError):
         pre.preprocess_chat(long_req)
+
+
+def test_model_card_survives_owning_worker_death():
+    """Two workers serve one model; the card is lease-tied to worker A. When
+    A dies (lease revoked), the card disappears — and worker B's refresh loop
+    restores it within one interval (the reference's TTL-bucket semantics)."""
+    import asyncio
+
+    from dynamo_tpu.cplane.broker import Broker
+    from dynamo_tpu.cplane.client import CplaneClient
+    from dynamo_tpu.llm.model_registry import (
+        ModelEntry,
+        ModelRegistration,
+        list_models,
+    )
+
+    async def body():
+        broker = Broker()
+        port = await broker.start()
+        a = await CplaneClient(f"127.0.0.1:{port}").connect()
+        b = await CplaneClient(f"127.0.0.1:{port}").connect()
+        lease_a = await a.lease_create(ttl=5.0)
+        lease_b = await b.lease_create(ttl=5.0)
+        entry = ModelEntry(name="m", endpoint="dyn://ns.c.generate")
+        reg_a = await ModelRegistration(a, entry, lease_a.lease_id, interval=0.2).start()
+        reg_b = await ModelRegistration(b, entry, lease_b.lease_id, interval=0.2).start()
+        assert [m.name for m in await list_models(b)] == ["m"]
+
+        # worker A dies; if A's lease owned the key, it is deleted...
+        await reg_a.stop(unregister=False)
+        await a.close()
+        await asyncio.sleep(0.8)  # lease reaped on conn close + B refreshes
+        models = await list_models(b)
+        assert [m.name for m in models] == ["m"], "card not restored by survivor"
+
+        # last worker gone (clean stop unregisters): the card must not be a
+        # permanent ghost in the durable KV
+        await reg_b.stop()
+        await b.close()
+        c = await CplaneClient(f"127.0.0.1:{port}").connect()
+        assert await list_models(c) == []
+        await c.close()
+        await broker.stop()
+
+    asyncio.new_event_loop().run_until_complete(asyncio.wait_for(body(), 30))

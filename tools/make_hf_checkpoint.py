@@ -14,7 +14,7 @@ Default geometry matches TinyLlama-1.1B (2048 hidden, 22 layers, 32 q / 4 kv
 heads, 32000 vocab) so on-chip numbers are comparable to published 1.1B-class
 serving results.
 
-Usage: python tools/make_hf_checkpoint.py OUTDIR [--tiny] [--vocab 32000]
+Usage: python tools/make_hf_checkpoint.py OUTDIR [--tiny] [--seed N]
 """
 
 from __future__ import annotations
