@@ -303,6 +303,13 @@ class Broker:
     def _op_kv_put(self, conn: _Conn, msg: dict) -> dict:
         key, value = msg["key"], msg["value"]
         lease_id = msg.get("lease_id", 0)
+        # ownership MOVES on re-put: a key re-put under another lease (or with
+        # no lease) must leave the previous lease's keys set, or that lease's
+        # later expiry would delete a key it no longer owns (e.g. a shared
+        # model card kept fresh by several workers' refresh loops)
+        for other in self._leases.values():
+            if other.lease_id != lease_id:
+                other.keys.discard(key)
         if lease_id:
             lease = self._leases.get(lease_id)
             if lease is None:
@@ -420,8 +427,10 @@ class Broker:
         if conn:
             conn.leases.discard(lease_id)
         for key in lease.keys:
-            entry = self._kv.pop(key, None)
-            if entry is not None:
+            entry = self._kv.get(key)
+            # belt: only delete keys this lease still OWNS
+            if entry is not None and entry["lease_id"] == lease_id:
+                del self._kv[key]
                 self._revision += 1
                 self._notify_watchers(key, None, "delete", lease_id)
         log.debug("lease %x expired (%s), %d keys removed", lease_id, reason, len(lease.keys))

@@ -95,7 +95,12 @@ class ModelRegistration:
 
     async def stop(self, unregister: bool = True) -> None:
         if self._task is not None:
+            import asyncio
+            import contextlib
+
             self._task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._task
         if unregister:
             try:
                 await unregister_model(self._cplane, self.entry.model_type, self.entry.name)

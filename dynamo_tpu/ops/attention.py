@@ -233,6 +233,7 @@ def dispatch_paged_decode_attention(q, k_pages, v_pages, page_tables, positions,
             paged_decode_attention_pallas,
             paged_decode_attention_pallas_chunked,
             paged_decode_attention_pallas_folded,
+            paged_decode_attention_pallas_grouped,
         )
 
         # perseq (default): one grid program per sequence, double-buffered
@@ -242,10 +243,18 @@ def dispatch_paged_decode_attention(q, k_pages, v_pages, page_tables, positions,
         # folded: head_dim < 128 shapes (Mosaic can't DMA-slice sub-128-lane
         # pools; heads live folded into the lane dim — see kv_folded).
         folded = k_pages.ndim == 3
+        # perseq (default) beat every alternative in on-chip A/Bs (v5e,
+        # bs 8-128, ps 16-128): "chunked" (C pages per DMA group), "grouped"
+        # (several sequences per program — the per-group unrolled compute
+        # costs more than the per-program overhead it saves). Both kept
+        # selectable for future hardware.
+        kernel_choice = os.environ.get("DYNTPU_DECODE_KERNEL", "perseq")
         if folded or q.shape[-1] % 128 != 0:
             paged_decode_attention_pallas = paged_decode_attention_pallas_folded
-        elif os.environ.get("DYNTPU_DECODE_KERNEL", "perseq") == "chunked":
+        elif kernel_choice == "chunked":
             paged_decode_attention_pallas = paged_decode_attention_pallas_chunked
+        elif kernel_choice == "grouped":
+            paged_decode_attention_pallas = paged_decode_attention_pallas_grouped
         interpret = not _on_tpu()
         tp = 1 if mesh is None else mesh.shape.get("tp", 1)
         if tp > 1:

@@ -21,6 +21,10 @@ from dynamo_tpu.runtime.distributed import DistributedRuntime
 from tests.test_engine import tiny_engine_config
 
 
+# compile-heavy JAX e2e: runs in the full matrix, not the <2-min default tier
+pytestmark = pytest.mark.slow
+
+
 async def collect(engine, req):
     toks = []
     finish = None

@@ -15,6 +15,10 @@ from dynamo_tpu.engine.scheduler import EngineRequest
 from tests.test_llama_model import naive_forward
 
 
+# compile-heavy JAX e2e: runs in the full matrix, not the <2-min default tier
+pytestmark = pytest.mark.slow
+
+
 def tiny_engine_config(**over) -> EngineConfig:
     defaults = dict(
         model_id="tiny",

@@ -9,6 +9,10 @@ from dynamo_tpu.models.llama import LlamaConfig, LlamaModel
 from dynamo_tpu.ops.norms import rms_norm
 from dynamo_tpu.ops.rotary import apply_rope
 
+
+# compile-heavy JAX e2e: runs in the full matrix, not the <2-min default tier
+pytestmark = pytest.mark.slow
+
 PAGE_SIZE = 4
 NUM_PAGES = 16
 

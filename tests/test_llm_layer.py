@@ -216,12 +216,28 @@ def test_model_card_survives_owning_worker_death():
         reg_b = await ModelRegistration(b, entry, lease_b.lease_id, interval=0.2).start()
         assert [m.name for m in await list_models(b)] == ["m"]
 
-        # worker A dies; if A's lease owned the key, it is deleted...
+        # make B the current owner deterministically (last re-put wins), then
+        # watch for a blip: A's death must NOT delete the key B owns
+        from dynamo_tpu.llm.model_registry import register_model
+
+        await register_model(b, entry, lease_id=lease_b.lease_id)
+        deletes = []
+        watcher = await b.kv_get_and_watch_prefix("models/")
+
+        async def record():
+            async for ev in watcher.events():
+                if ev.kind == "delete":
+                    deletes.append(ev.key)
+
+        rec = asyncio.get_running_loop().create_task(record())
         await reg_a.stop(unregister=False)
         await a.close()
-        await asyncio.sleep(0.8)  # lease reaped on conn close + B refreshes
+        await asyncio.sleep(0.8)  # A's lease reaped on conn close
         models = await list_models(b)
-        assert [m.name for m in models] == ["m"], "card not restored by survivor"
+        assert [m.name for m in models] == ["m"], "card lost after co-worker death"
+        assert deletes == [], f"shared card blipped: {deletes}"
+        rec.cancel()
+        await watcher.stop()
 
         # last worker gone (clean stop unregisters): the card must not be a
         # permanent ghost in the durable KV

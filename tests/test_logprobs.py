@@ -18,6 +18,10 @@ from dynamo_tpu.engine.scheduler import EngineRequest
 from tests.test_llama_model import naive_forward
 
 
+# compile-heavy JAX e2e: runs in the full matrix, not the <2-min default tier
+pytestmark = pytest.mark.slow
+
+
 @pytest.fixture(scope="module")
 def engine():
     cfg = EngineConfig(

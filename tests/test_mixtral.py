@@ -11,6 +11,10 @@ from dynamo_tpu.ops.moe import moe_block, topk_routing
 from dynamo_tpu.ops.norms import rms_norm
 from dynamo_tpu.ops.rotary import apply_rope
 
+
+# compile-heavy JAX e2e: runs in the full matrix, not the <2-min default tier
+pytestmark = pytest.mark.slow
+
 PAGE_SIZE = 4
 NUM_PAGES = 16
 PROMPT = np.array([5, 9, 2, 77, 31, 8, 100], dtype=np.int32)

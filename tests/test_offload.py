@@ -13,6 +13,10 @@ from dynamo_tpu.engine.scheduler import EngineRequest
 from tests.test_engine import tiny_engine_config, greedy_reference, _collect
 
 
+# compile-heavy JAX e2e: runs in the full matrix, not the <2-min default tier
+pytestmark = pytest.mark.slow
+
+
 @pytest.fixture(scope="module")
 def engine():
     # tiny device pool (12 usable pages) so eviction happens fast; big host tier

@@ -10,6 +10,10 @@ from dynamo_tpu.ops.attention import paged_decode_attention
 from dynamo_tpu.ops.pallas.paged_attention import paged_decode_attention_pallas
 
 
+# compile-heavy JAX e2e: runs in the full matrix, not the <2-min default tier
+pytestmark = pytest.mark.slow
+
+
 def make_case(B=3, Hq=4, Hkv=2, D=16, P=16, ps=4, max_pages=6, seed=0):
     rng = np.random.default_rng(seed)
     q = jnp.asarray(rng.standard_normal((B, Hq, D)), jnp.float32)
@@ -207,4 +211,19 @@ def test_pallas_folded_matches_reference():
         got = paged_decode_attention_pallas_folded(q, k, v, pt, pos, interpret=True)
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(ref), atol=2e-5, err_msg=f"B={B} Hq={Hq} D={D}"
+        )
+
+
+def test_pallas_grouped_matches_reference():
+    from dynamo_tpu.ops.pallas.paged_attention import (
+        paged_decode_attention_pallas_grouped,
+    )
+
+    for B, Hq, Hkv, seed in [(8, 16, 8, 1), (4, 8, 8, 2), (3, 4, 2, 0), (6, 4, 2, 5)]:
+        q, k, v, pt, pos = make_case(B=B, Hq=Hq, Hkv=Hkv, seed=seed)
+        pos = jnp.asarray(np.random.default_rng(seed).integers(0, 15, B), jnp.int32)
+        ref = paged_decode_attention(q, k, v, pt, pos)
+        got = paged_decode_attention_pallas_grouped(q, k, v, pt, pos, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), atol=2e-5, err_msg=f"B={B} Hq={Hq}"
         )

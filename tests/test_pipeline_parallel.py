@@ -17,6 +17,9 @@ from dynamo_tpu.parallel.pipeline import (
     stage_param_shardings,
 )
 
+# compile-heavy JAX e2e: runs in the full matrix, not the <2-min default tier
+pytestmark = pytest.mark.slow
+
 NUM_PAGES, PAGE_SIZE = 16, 4
 
 

@@ -11,6 +11,10 @@ from dynamo_tpu.engine.scheduler import EngineRequest
 from tests.test_engine import tiny_engine_config, greedy_reference, _collect
 
 
+# compile-heavy JAX e2e: runs in the full matrix, not the <2-min default tier
+pytestmark = pytest.mark.slow
+
+
 def test_preemption_under_page_pressure():
     """Two long-running sequences in a pool that cannot hold both: the younger
     gets preempted and resumes later, and BOTH finish with correct greedy

@@ -25,6 +25,10 @@ from dynamo_tpu.ops.norms import rms_norm
 from dynamo_tpu.ops.rotary import apply_mrope, apply_rope
 
 
+# compile-heavy JAX e2e: runs in the full matrix, not the <2-min default tier
+pytestmark = pytest.mark.slow
+
+
 def rng_image(seed=0, h=24, w=16):
     return np.random.default_rng(seed).random((h, w, 3)).astype(np.float32)
 

@@ -15,6 +15,10 @@ from dynamo_tpu.engine.sampling import SamplingParams, apply_penalties, sample_t
 from dynamo_tpu.engine.scheduler import EngineRequest
 
 
+# compile-heavy JAX e2e: runs in the full matrix, not the <2-min default tier
+pytestmark = pytest.mark.slow
+
+
 # ---------------- pure sampler units ----------------
 
 

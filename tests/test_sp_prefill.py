@@ -21,6 +21,10 @@ from dynamo_tpu.models.llama import LlamaConfig, LlamaModel
 
 from tests.test_engine import _collect, tiny_engine_config
 
+
+# compile-heavy JAX e2e: runs in the full matrix, not the <2-min default tier
+pytestmark = pytest.mark.slow
+
 PROMPT = [5, 9, 2, 77, 31, 8, 100, 42, 17, 3, 60, 61, 7, 21, 90, 4]  # 16 tokens
 
 

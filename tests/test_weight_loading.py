@@ -17,6 +17,10 @@ from safetensors.numpy import save_file
 
 from dynamo_tpu.models.registry import load_model
 
+
+# compile-heavy JAX e2e: runs in the full matrix, not the <2-min default tier
+pytestmark = pytest.mark.slow
+
 PROMPT = np.array([5, 9, 2, 77, 31, 8], dtype=np.int32)
 
 
