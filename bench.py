@@ -165,8 +165,13 @@ async def run_config(
                 n += 1
         return n, ttft
 
-    # warmup: compile prefill buckets + decode
+    # warmup: compile prefill buckets + decode, then one full-length pass so
+    # the page allocator reaches its steady-state churn pattern (the first
+    # measured round otherwise under-reports while the pool fills/evicts)
     await asyncio.gather(*[one(i, warmup=True) for i in range(batch)])
+    for i in range(batch):
+        prompts[i] = rng.integers(1, 31000, prompt_len).tolist()
+    await asyncio.gather(*[one(i, warmup=False, rnd=99) for i in range(batch)])
 
     # best of N measured rounds (fresh prompts each round so the prefix cache
     # never helps): the tunneled PJRT link adds multi-ms jitter per round

@@ -303,17 +303,22 @@ class Broker:
     def _op_kv_put(self, conn: _Conn, msg: dict) -> dict:
         key, value = msg["key"], msg["value"]
         lease_id = msg.get("lease_id", 0)
-        # ownership MOVES on re-put: a key re-put under another lease (or with
-        # no lease) must leave the previous lease's keys set, or that lease's
-        # later expiry would delete a key it no longer owns (e.g. a shared
-        # model card kept fresh by several workers' refresh loops)
-        for other in self._leases.values():
-            if other.lease_id != lease_id:
-                other.keys.discard(key)
+        # validate FIRST: a rejected put must not mutate ownership state
+        lease = None
         if lease_id:
             lease = self._leases.get(lease_id)
             if lease is None:
                 raise ValueError(f"lease {lease_id} not found")
+        # ownership MOVES on re-put: a key re-put under another lease (or with
+        # no lease) must leave the previous owner's keys set, or that lease's
+        # later expiry would delete a key it no longer owns (e.g. a shared
+        # model card kept fresh by several workers' refresh loops)
+        prev = self._kv.get(key)
+        if prev is not None and prev["lease_id"] not in (0, lease_id):
+            old = self._leases.get(prev["lease_id"])
+            if old is not None:
+                old.keys.discard(key)
+        if lease is not None:
             lease.keys.add(key)
         prev = self._kv.get(key)
         self._revision += 1

@@ -58,7 +58,24 @@ class PrefillWorker:
         log.info("prefill worker consuming %s", self.queue_name)
         try:
             while True:
-                msg = await self.drt.cplane.queue_pull(self.queue_name)
+                try:
+                    msg = await self.drt.cplane.queue_pull(self.queue_name)
+                except ConnectionError:
+                    if getattr(self.drt.cplane, "_dead", False):
+                        # reconnect window exhausted: the broker is gone for
+                        # good — die loudly, don't impersonate a live consumer
+                        log.error(
+                            "control plane is dead; prefill consumer for %s exiting",
+                            self.queue_name,
+                        )
+                        return
+                    # broker blip: the parked pull died with the connection;
+                    # the cplane client heals in the background — keep
+                    # re-arming the pull instead of letting the consumer die
+                    # (the queue is durable, work survives the restart)
+                    log.warning("queue pull lost connection; re-arming %s", self.queue_name)
+                    await asyncio.sleep(0.5)
+                    continue
                 try:
                     await self._handle(RemotePrefillRequest.from_wire(msg.payload))
                     await self.drt.cplane.queue_ack(self.queue_name, msg.msg_id)

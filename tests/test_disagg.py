@@ -275,3 +275,85 @@ def test_disagg_cancellation_no_leaks():
             await broker.stop()
 
     asyncio.run(body())
+
+
+def test_disagg_survives_broker_restart(tmp_path):
+    """Kill the broker under a live disagg deployment and restart it on the
+    same port: the prefill consumer re-arms its pull, the decode worker's
+    endpoints re-register, and a remote prefill completes token-exact —
+    serving heals without restarting any worker."""
+    async def body():
+        persist = str(tmp_path / "broker.log")
+        broker = Broker(persist_path=persist)
+        port = await broker.start()
+        live_broker = [broker]  # the currently-running broker (stops LAST)
+        addr = f"127.0.0.1:{port}"
+
+        decode_rt = DistributedRuntime(cplane_address=addr)
+        await decode_rt.connect()
+        prefill_rt = DistributedRuntime(cplane_address=addr)
+        await prefill_rt.connect()
+        for rt in (decode_rt, prefill_rt):
+            rt.cplane.reconnect_window = 15.0
+            rt.runtime.shutdown = lambda: None  # observe, don't die
+
+        decode_inner = AsyncJaxEngine(tiny_engine_config())
+        await decode_inner.start()
+        prefill_engine = AsyncJaxEngine(tiny_engine_config())
+        await prefill_engine.start()
+        local_engine = AsyncJaxEngine(tiny_engine_config())
+        await local_engine.start()
+
+        router = DisaggregatedRouter(
+            "tiny", conf=DisaggRouterConf(max_local_prefill_length=6)
+        )
+        decode = DisaggDecodeEngine(
+            decode_inner, decode_rt, "nsr", "decoder", "tiny", disagg_router=router
+        )
+        await decode.start()
+        prefill_worker = PrefillWorker(prefill_engine, prefill_rt, "nsr", "tiny")
+        await prefill_worker.start()
+
+        try:
+            expected, _ = await collect(local_engine, req_for("ref", LONG_PROMPT))
+            got, _ = await collect(decode, req_for("r1", LONG_PROMPT))
+            assert got == expected
+            assert decode.remote_prefills == 1
+
+            # ---- broker dies and comes back on the same port ----
+            await broker.stop()
+            await asyncio.sleep(0.5)
+            broker2 = Broker(port=port, persist_path=persist)
+            await broker2.start()
+            live_broker[0] = broker2
+
+            # a FRESH long prompt (no cached prefix) must go remote again
+            # once the session heals; allow time for reconnect + re-pull
+            prompt2 = [p + 1 for p in LONG_PROMPT]
+            expected2, _ = await collect(local_engine, req_for("ref2", prompt2))
+            deadline = asyncio.get_running_loop().time() + 20
+            got2 = None
+            attempt = 0
+            while asyncio.get_running_loop().time() < deadline:
+                attempt += 1
+                try:
+                    got2, _ = await asyncio.wait_for(
+                        collect(decode, req_for(f"r2-{attempt}", prompt2)), 10
+                    )
+                    break
+                except Exception:
+                    await asyncio.sleep(0.5)
+            assert got2 == expected2, f"post-restart disagg {got2} != {expected2}"
+            # >=: a timed-out-then-retried attempt may have completed too
+            assert decode.remote_prefills >= 2
+            assert prefill_worker.completed >= 2
+        finally:
+            await prefill_worker.stop()
+            await decode.shutdown()
+            await prefill_engine.shutdown()
+            await local_engine.shutdown()
+            await decode_rt._shutdown_hook()
+            await prefill_rt._shutdown_hook()
+            await live_broker[0].stop()
+
+    asyncio.run(asyncio.wait_for(body(), 180))
