@@ -112,6 +112,12 @@ class WorkerService:
                 "remote_prefills": self.engine.remote_prefills,
                 "local_prefills": self.engine.local_prefills,
             }
+            if self.engine.kv_server is not None:
+                stats["disagg"]["kv_dataplane"] = {
+                    "received": self.engine.kv_server.received,
+                    "dropped": self.engine.kv_server.dropped,
+                    "address": self.engine.kv_server.address,
+                }
         return stats
 
     async def _handle(self, request: dict):

@@ -65,10 +65,23 @@ class _QueueMsg:
 
 
 class Broker:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, persist_path: Optional[str] = None):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        persist_path: Optional[str] = None,
+        latency: Optional[tuple[float, float]] = None,
+    ):
+        """latency: (mean_s, jitter_s) injected before every op — the
+        reference's mock-network latency models (NoDelay/Constant/
+        NormalDistribution, lib/runtime/tests/common/mock.rs) slot: lets
+        tests simulate a slow control plane without a cluster. Also settable
+        via DYNTPU_CPLANE_LATENCY_MS / DYNTPU_CPLANE_JITTER_MS on the module
+        main."""
         self.host = host
         self.port = port
         self.persist_path = persist_path
+        self.latency = latency
         self._persist_file = None
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: dict[int, _Conn] = {}
@@ -210,15 +223,43 @@ class Broker:
         conn = _Conn(conn_id=next(self._conn_ids), writer=writer)
         self._conns[conn.conn_id] = conn
         sender = asyncio.create_task(self._sender(conn))
+        delay_line = None
+        delay_worker = None
+        if self.latency is not None:
+            # per-op latency WITHOUT blocking the reader: ops enter a FIFO
+            # delay line stamped with their own deadline, so delays overlap
+            # (no serial compounding across a pipelined burst) while per-conn
+            # ordering is preserved
+            import random
+
+            mean, jitter = self.latency
+            delay_line: asyncio.Queue = asyncio.Queue()
+
+            async def drain():
+                loop = asyncio.get_running_loop()
+                while True:
+                    deadline, m = await delay_line.get()
+                    now = loop.time()
+                    if deadline > now:
+                        await asyncio.sleep(deadline - now)
+                    await self._dispatch(conn, m)
+
+            delay_worker = asyncio.create_task(drain())
         try:
             while True:
                 msg = await read_frame(reader)
-                await self._dispatch(conn, msg)
+                if delay_line is not None:
+                    d = max(0.0, random.gauss(mean, jitter) if jitter else mean)
+                    delay_line.put_nowait((asyncio.get_running_loop().time() + d, msg))
+                else:
+                    await self._dispatch(conn, msg)
         except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError):
             pass
         except Exception:
             log.exception("connection %d error", conn.conn_id)
         finally:
+            if delay_worker is not None:
+                delay_worker.cancel()
             conn.closed = True
             self._drop_conn(conn)
             sender.cancel()
@@ -540,13 +581,19 @@ class Broker:
 
 
 def main() -> None:
+    import os
+
     parser = argparse.ArgumentParser(description="dynamo-tpu control-plane broker")
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=4222)
+    parser.add_argument("--persist", default=os.environ.get("DYNTPU_CPLANE_PERSIST"))
     args = parser.parse_args()
+    lat_ms = float(os.environ.get("DYNTPU_CPLANE_LATENCY_MS", "0"))
+    jit_ms = float(os.environ.get("DYNTPU_CPLANE_JITTER_MS", "0"))
+    latency = (lat_ms / 1e3, jit_ms / 1e3) if lat_ms or jit_ms else None
 
     async def run():
-        broker = Broker(args.host, args.port)
+        broker = Broker(args.host, args.port, persist_path=args.persist, latency=latency)
         port = await broker.start()
         print(f"listening on {args.host}:{port}", flush=True)
         await broker._stopped.wait()
