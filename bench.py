@@ -13,6 +13,11 @@ roofline-derived parity bar for this config on v5e: weights ~2.5 GiB bf16,
 v5e HBM BW 819 GB/s -> ~330 weight-bound steps/s ceiling; at batch 8 a
 well-tuned serving stack should clear ~1000 out tok/s/chip.
 
+Run-to-run variance: the tunneled PJRT link drifts; identical code measured
+2900-5700 tok/s on the headline config across sessions (every section moves
+proportionally — compare the continuity config against r01_value_bs8 to
+separate environment drift from real regressions).
+
 Round-2 profile (jax.profiler on-device, per decode step at bs64/ps64):
 matmul fusions ~2.9 ms (at the weight-read roofline), paged-attention Pallas
 kernel ~4.5 ms (per-DMA scalar-core sequencing + per-grid-program overhead —
