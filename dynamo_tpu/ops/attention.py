@@ -316,9 +316,37 @@ def dispatch_paged_prefill_attention(
     exactly what the engine's bucket-padded chunks provide. The reference
     path only needs monotone positions."""
     if k_pages.ndim == 3:
-        # folded pool (sub-128 head_dim): the prefill kernel has no folded
-        # variant yet — the gather reference unfolds the (small) gathered
-        # context instead
+        # folded pool (sub-128 head_dim): dedicated folded flash kernel when
+        # shapes allow (R = block_q * Hq rows must stay VMEM-sane); the
+        # gather reference (which unfolds the small gathered context) covers
+        # the rest
+        # tp>1 falls back to the gather reference (GSPMD partitions it; it
+        # cannot partition a pallas_call, and no shard_map wiring exists for
+        # this variant yet).
+        tp1 = mesh is None or mesh.shape.get("tp", 1) == 1
+        block_q = 64
+        R = q.shape[1] * block_q  # folded row count per query block
+        F = k_pages.shape[2]
+        # the kernel's working set is several [R, F] f32 buffers; keep their
+        # sum inside the ~16MB scoped-VMEM limit (R*F*4B*~5 buffers)
+        shape_ok = (
+            q.shape[0] % block_q == 0
+            and F % 128 == 0
+            and R * F * 4 * 5 <= 12 * 1024 * 1024
+        )
+        flag = pallas_flag()
+        folded_ok = tp1 and shape_ok and (
+            flag is True or (_on_tpu() and flag is not False)
+        )
+        if folded_ok:
+            from dynamo_tpu.ops.pallas.prefill_attention import (
+                paged_prefill_attention_pallas_folded,
+            )
+
+            return paged_prefill_attention_pallas_folded(
+                q, k_pages, v_pages, page_table, positions, block_q=block_q,
+                interpret=not _on_tpu(),
+            )
         return paged_prefill_attention(q, k_pages, v_pages, page_table, positions)
     if use_pallas_prefill(q.shape[-1], q.shape[0]):
         from dynamo_tpu.ops.pallas.prefill_attention import (

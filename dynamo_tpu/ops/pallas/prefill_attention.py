@@ -30,6 +30,44 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
+def _tile_dma_helpers(page_table_ref, k_hbm, v_hbm, k_scratch, v_scratch, sems,
+                      tile_pages: int, max_pages: int):
+    """Shared double-buffered context-tile DMA scaffolding for the prefill
+    kernels: returns (start, wait), each taking (buf, tile). The final tile
+    clamps page indices to max_pages - 1 (aliased content is masked by the
+    callers' ctx-bound check)."""
+
+    def tile_dma(buf, tile):
+        copies = []
+        for p in range(tile_pages):
+            idx = jnp.minimum(tile * tile_pages + p, max_pages - 1)
+            copies.append(
+                (
+                    pltpu.make_async_copy(
+                        k_hbm.at[page_table_ref[idx]], k_scratch.at[buf, p],
+                        sems.at[buf, 0, p],
+                    ),
+                    pltpu.make_async_copy(
+                        v_hbm.at[page_table_ref[idx]], v_scratch.at[buf, p],
+                        sems.at[buf, 1, p],
+                    ),
+                )
+            )
+        return copies
+
+    def start(buf, tile):
+        for kc, vc in tile_dma(buf, tile):
+            kc.start()
+            vc.start()
+
+    def wait(buf, tile):
+        for kc, vc in tile_dma(buf, tile):
+            kc.wait()
+            vc.wait()
+
+    return start, wait
+
+
 def _kernel(
     # scalar prefetch
     page_table_ref,  # [max_pages] SMEM
@@ -76,36 +114,9 @@ def _kernel(
     )
     scale = 1.0 / jnp.sqrt(jnp.float32(D))
 
-    def tile_dma(buf, tile):
-        """Start/wait helpers for one context tile (TP page copies)."""
-        copies = []
-        for p in range(TP):
-            # clamp: the final tile may run past max_pages; masked below
-            idx = jnp.minimum(tile * TP + p, max_pages - 1)
-            copies.append(
-                (
-                    pltpu.make_async_copy(
-                        k_hbm.at[page_table_ref[idx]], k_scratch.at[buf, p],
-                        sems.at[buf, 0, p],
-                    ),
-                    pltpu.make_async_copy(
-                        v_hbm.at[page_table_ref[idx]], v_scratch.at[buf, p],
-                        sems.at[buf, 1, p],
-                    ),
-                )
-            )
-        return copies
-
-    def start(buf, tile):
-        for kc, vc in tile_dma(buf, tile):
-            kc.start()
-            vc.start()
-
-    def wait(buf, tile):
-        for kc, vc in tile_dma(buf, tile):
-            kc.wait()
-            vc.wait()
-
+    start, wait = _tile_dma_helpers(
+        page_table_ref, k_hbm, v_hbm, k_scratch, v_scratch, sems, TP, max_pages
+    )
     start(0, 0)
 
     # causal mask geometry, built directly in 2D [G*Bq, S] (Mosaic rejects 1D
@@ -172,6 +183,172 @@ def _kernel(
     out_ref[...] = (
         out.reshape(Hkv, G, Bq, D).transpose(2, 0, 1, 3).reshape(Bq, Hq, D)
     ).astype(out_ref.dtype)
+
+
+def _kernel_folded(
+    # scalar prefetch
+    page_table_ref,  # [max_pages] SMEM
+    positions_ref,  # [T] SMEM
+    # inputs
+    q_ref,  # [Bq, Hq, D] VMEM (this query block)
+    k_hbm,  # [P, ps, Hkv*D] HBM (heads folded into lanes)
+    v_hbm,  # [P, ps, Hkv*D] HBM
+    # output
+    out_ref,  # [Bq, Hq, D] VMEM
+    # scratch
+    k_scratch,  # [2, TP, ps, Hkv*D] VMEM
+    v_scratch,  # [2, TP, ps, Hkv*D] VMEM
+    sems,  # DMA sems [2, 2, TP]
+    *,
+    page_size: int,
+    max_pages: int,
+    tile_pages: int,
+    block_q: int,
+    num_kv_heads: int,
+    head_dim: int,
+):
+    """Folded-lane flash prefill for head_dim < 128 (see the decode
+    _kernel_folded in paged_attention.py for the trick): every (query row,
+    head) pair becomes one row of a zero-placed folded Q [Bq*Hq, Hkv*D], so a
+    single [R, F] x [S, F] matmul yields exact per-head scores — the zero
+    slices kill cross-head terms and cost only Hkv x extra MACs on an op
+    that is a rounding error of prefill FLOPs. All shape changes are
+    leading-dim merges/splits (minor dim untouched: Mosaic-legal)."""
+    qb = pl.program_id(0)
+    Bq, Hq, D = q_ref.shape
+    Hkv, F = num_kv_heads, num_kv_heads * head_dim
+    G = Hq // Hkv
+    TP = tile_pages
+    S = TP * page_size
+    R = Bq * Hq
+
+    q_start = qb * block_q
+    last_pos = positions_ref[q_start + Bq - 1]
+    ctx_len = last_pos + 1
+    n_tiles = jnp.minimum(
+        pl.cdiv(ctx_len, S), pl.cdiv(jnp.int32(max_pages * page_size), S)
+    )
+
+    # folded queries [R, F]: row r = (t, h) with t = r // Hq, h = r % Hq;
+    # q[t, h] occupies kv(h) = (h // G)'s D-slice, zeros elsewhere
+    q2 = q_ref[...].reshape(R, D)  # leading merge only
+    lane = jax.lax.broadcasted_iota(jnp.int32, (R, F), 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, (R, F), 0)
+    own = (lane // D == jax.lax.rem(row, Hq) // G).astype(jnp.float32)
+    qtile = jnp.concatenate([q2.astype(jnp.float32)] * Hkv, axis=1)  # [R, F]
+    qf = (qtile * own).astype(q_ref.dtype)
+    scale = 1.0 / jnp.sqrt(jnp.float32(D))
+
+    start, wait = _tile_dma_helpers(
+        page_table_ref, k_hbm, v_hbm, k_scratch, v_scratch, sems, TP, max_pages
+    )
+    start(0, 0)
+
+    # causal geometry: row r's query position = positions[q_start] + r // Hq
+    pos0 = positions_ref[q_start]
+    iota_row = jax.lax.broadcasted_iota(jnp.int32, (R, S), 0)
+    iota_col = jax.lax.broadcasted_iota(jnp.int32, (R, S), 1)
+    q_pos_2d = pos0 + iota_row // Hq  # [R, S]
+
+    def body(t, carry):
+        m, l, acc = carry
+        buf = jax.lax.rem(t, 2)
+
+        @pl.when(t + 1 < n_tiles)
+        def _():
+            start(jax.lax.rem(t + 1, 2), t + 1)
+
+        wait(buf, t)
+
+        kf = k_scratch[buf].reshape(S, F)  # leading merge, bf16
+        vf = v_scratch[buf].reshape(S, F)
+
+        # [R, S] exact per-(row, head) scores via the folded contraction
+        scores = jax.lax.dot_general(
+            qf, kf, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        ctx_idx = t * S + iota_col
+        mask = (ctx_idx <= q_pos_2d) & (ctx_idx < max_pages * page_size)
+        scores = jnp.where(mask, scores, _NEG_INF)
+
+        chunk_max = jnp.max(scores, axis=-1)  # [R]
+        new_m = jnp.maximum(m, chunk_max)
+        corr = jnp.exp(m - new_m)
+        probs = jnp.exp(scores - new_m[:, None])
+        new_l = l * corr + jnp.sum(probs, axis=-1)
+        # [R, F] = [R, S] x [S, F]
+        chunk_out = jax.lax.dot_general(
+            probs.astype(kf.dtype), vf, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        new_acc = acc * corr[:, None] + chunk_out
+        return new_m, new_l, new_acc
+
+    m0 = jnp.full((R,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((R,), jnp.float32)
+    acc0 = jnp.zeros((R, F), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_tiles, body, (m0, l0, acc0))
+
+    # keep each row's owned D-slice: zero the rest and fold the Hkv slices
+    acc_m = acc * own
+    out2 = acc_m[:, 0:D]
+    for j in range(1, Hkv):
+        out2 = out2 + acc_m[:, j * D : (j + 1) * D]
+    out2 = out2 / jnp.maximum(l, 1e-20)[:, None]
+    out_ref[...] = out2.reshape(Bq, Hq, D).astype(out_ref.dtype)  # leading split
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "block_q"))
+def paged_prefill_attention_pallas_folded(
+    q: jnp.ndarray,  # [T, Hq, D] bucket-padded chunk
+    k_pages: jnp.ndarray,  # [P, ps, Hkv*D] folded, or [P, ps, Hkv, D]
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,  # [max_pages] int32
+    positions: jnp.ndarray,  # [T] int32 absolute positions (unit-stride)
+    block_q: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    T, Hq, D = q.shape
+    if k_pages.ndim == 4:  # direct-call convenience (tests)
+        P, ps, Hkv, _ = k_pages.shape
+        k_pages = k_pages.reshape(P, ps, Hkv * D)
+        v_pages = v_pages.reshape(P, ps, Hkv * D)
+    P, ps, F = k_pages.shape
+    Hkv = F // D
+    max_pages = page_table.shape[0]
+    assert T % block_q == 0, f"chunk {T} % block_q {block_q}"
+    tile_pages = max(1, 128 // ps)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(T // block_q,),
+        in_specs=[
+            pl.BlockSpec((block_q, Hq, D), lambda qb, *_: (qb, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((block_q, Hq, D), lambda qb, *_: (qb, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, tile_pages, ps, F), k_pages.dtype),
+            pltpu.VMEM((2, tile_pages, ps, F), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2, tile_pages)),
+        ],
+    )
+    kernel = pl.pallas_call(
+        functools.partial(
+            _kernel_folded,
+            page_size=ps,
+            max_pages=max_pages,
+            tile_pages=tile_pages,
+            block_q=block_q,
+            num_kv_heads=Hkv,
+            head_dim=D,
+        ),
+        out_shape=jax.ShapeDtypeStruct((T, Hq, D), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )
+    return kernel(page_table.astype(jnp.int32), positions.astype(jnp.int32), q, k_pages, v_pages)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret", "block_q"))

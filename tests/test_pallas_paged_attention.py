@@ -227,3 +227,23 @@ def test_pallas_grouped_matches_reference():
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(ref), atol=2e-5, err_msg=f"B={B} Hq={Hq}"
         )
+
+
+def test_prefill_pallas_folded_matches_reference():
+    """Folded-lane flash prefill (head_dim < 128 layouts)."""
+    from dynamo_tpu.ops.pallas.prefill_attention import (
+        paged_prefill_attention_pallas_folded,
+    )
+
+    for T, Hq, Hkv, start, seed in [
+        (128, 4, 2, 0, 0), (256, 8, 2, 0, 1), (128, 4, 4, 57, 3), (128, 8, 4, 9, 4),
+    ]:
+        q, k, v, pt, pos = make_prefill_case(
+            T=T, Hq=Hq, Hkv=Hkv, P=128, max_pages=100, start=start, seed=seed
+        )
+        ref = paged_prefill_attention(q, k, v, pt, pos)
+        got = paged_prefill_attention_pallas_folded(q, k, v, pt, pos, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), atol=2e-5,
+            err_msg=f"T={T} Hq={Hq} Hkv={Hkv} start={start}",
+        )
