@@ -9,6 +9,8 @@ Prints CHECKSUM <value>; the parent asserts both processes print the same.
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 flags = os.environ.get("XLA_FLAGS", "")
 flags = " ".join(f for f in flags.split() if "host_platform_device_count" not in f)
 os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=4").strip()
