@@ -41,6 +41,12 @@ class EngineConfig:
     # pipelining; the token feedback lives on device so window N+1 never waits
     # for window N's tokens to reach the host). 1 = fully synchronous.
     pipeline_depth: int = 3
+    # cross-request prefill packing: chunks of up to this many DISTINCT
+    # sequences ride one prefill call (one weight pass). The effective lane
+    # count per bucket is capped so total rows stay near the MXU/HBM
+    # crossover (~512 rows on v5e) — beyond that prefill is compute-bound
+    # and packing stops paying. 1 = disabled (per-request prefill).
+    prefill_lanes: int = 4
     # pre-compile the decode-window trace variants (default / extras /
     # logprobs) at startup so the first feature-bearing request never hits a
     # cold multi-second XLA compile mid-serving. Off by default: tests and
@@ -54,6 +60,12 @@ class EngineConfig:
     @property
     def max_prefill_chunk(self) -> int:
         return max(self.prefill_buckets)
+
+    def lanes_for(self, bucket: int) -> int:
+        """Packed-prefill lane count for a bucket: bounded by prefill_lanes
+        and a ~512-row budget (the v5e MXU/HBM crossover — past it the call
+        is compute-bound and packing stops amortizing anything)."""
+        return max(1, min(self.prefill_lanes, 512 // bucket))
 
     def bucket_for(self, n: int) -> int:
         """Smallest bucket >= n (n must be <= max bucket)."""

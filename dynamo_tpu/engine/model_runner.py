@@ -134,6 +134,12 @@ class ModelRunner:
             self._prefill_impl, donate_argnums=(1, 2),
             static_argnames=("want_lp", "want_pen", "want_seed", "want_eos_mask"),
         )
+        # cross-request packed prefill (one weight pass for N lanes); one
+        # executable per (N, bucket) actually used
+        self._prefill_packed = jax.jit(
+            self._prefill_packed_impl, donate_argnums=(1, 2),
+            static_argnames=("want_lp", "want_pen", "want_seed", "want_eos_mask"),
+        )
         # multimodal vision encode (compiled lazily; text-only models never
         # pay for it — the mm prefill variant is _prefill traced with embeds)
         self._encode_images = jax.jit(
@@ -288,6 +294,134 @@ class ModelRunner:
             seen = slot_state["seen"].at[slot, tok].set(True, mode="drop")
             slot_state = dict(slot_state, counts=counts, seen=seen)
         return tok, lp, slot_state
+
+    def _prefill_packed_impl(self, params, kv, slot_state, ints, flts, key, want_lp=False, want_pen=False, want_seed=False, want_eos_mask=False):
+        """Cross-request packed prefill: ints [N, bucket + max_pages + 5 +
+        MAX_EOS_IDS] — N lanes of the SAME per-lane row layout as
+        _prefill_impl; flts [6, N]. Every lane's last-row logits are sampled
+        ([N] tokens); the host ignores tokens of lanes that weren't a final
+        chunk (their slot is out-of-range so the feedback write drops too)."""
+        mp = self.config.max_pages_per_seq
+        N = ints.shape[0]
+        bucket = ints.shape[1] - mp - 5 - MAX_EOS_IDS
+        tokens = ints[:, :bucket]
+        page_tables = ints[:, bucket : bucket + mp]
+        start_pos = ints[:, bucket + mp]
+        n = ints[:, bucket + mp + 1]
+        top_ks = ints[:, bucket + mp + 2]
+        slots = ints[:, bucket + mp + 3]
+        seeds = ints[:, bucket + mp + 4]
+        eos_ids = ints[:, bucket + mp + 5 :]  # [N, MAX_EOS_IDS] V-padded
+        positions = start_pos[:, None] + jnp.arange(bucket, dtype=jnp.int32)[None, :]
+        valid = jnp.arange(bucket)[None, :] < n[:, None]
+        logits, kv = self.model.prefill_packed(
+            params, kv, tokens, positions, page_tables, valid, n - 1
+        )
+        raw_b = logits  # [N, V]
+        if want_eos_mask:
+            rows = jnp.arange(N)[:, None]
+            logits = logits.at[rows, eos_ids].add(jnp.float32(-1e30), mode="drop")
+        if want_pen:
+            # out-of-range slots (non-final lanes) clip to an arbitrary row;
+            # their sampled token is discarded, so the penalty values applied
+            # don't matter — only the UPDATE below must drop, and it does.
+            counts = jnp.take(slot_state["counts"], slots, axis=0, mode="clip")
+            seen = jnp.take(slot_state["seen"], slots, axis=0, mode="clip")
+            logits = apply_penalties(
+                logits, counts, seen, flts[3], flts[4], flts[5]
+            )
+        kwargs = dict(min_p=flts[2])
+        if want_seed:
+            kwargs.update(seeds=seeds, positions=start_pos + n - 1)
+        if want_lp:
+            toks, chosen, tids, tvals = sample_tokens_with_logprobs(
+                logits, key, flts[0], top_ks, flts[1], raw_logits=raw_b, **kwargs
+            )
+            lp = (chosen, tids, tvals)
+        else:
+            toks = sample_tokens(logits, key, flts[0], top_ks, flts[1], **kwargs)
+            lp = None
+        slot_state = dict(
+            slot_state, tokens=slot_state["tokens"].at[slots].set(toks, mode="drop")
+        )
+        if want_pen:
+            counts = slot_state["counts"].at[slots, toks].add(1, mode="drop")
+            seen = slot_state["seen"].at[slots, toks].set(True, mode="drop")
+            slot_state = dict(slot_state, counts=counts, seen=seen)
+        return toks, lp, kv, slot_state
+
+    def prefill_chunk_batch(
+        self,
+        lanes: list,  # [(tokens np[int32], start_pos, page_table, slot_or_-1, sampling, eos_ids, is_final)]
+        N: int,  # lane count the executable is compiled for (>= len(lanes))
+        want_logprobs: bool = False,
+    ):
+        """Dispatch ONE packed prefill covering chunks of up to N distinct
+        sequences (pad lanes are all-invalid). Returns the [N] device token
+        array (async copy started) — callers read only final-chunk lanes —
+        plus the logprob arrays when requested."""
+        mp = self.config.max_pages_per_seq
+        V = self.model.config.vocab_size
+        bucket = self.config.bucket_for(max(len(l[0]) for l in lanes))
+        ints = np.full((N, bucket + mp + 5 + MAX_EOS_IDS), V, np.int32)
+        ints[:, :bucket] = 0
+        flts = np.zeros((6, N), np.float32)
+        flts[1] = 1.0  # top_p neutral
+        flts[5] = 1.0  # repetition neutral
+        want_extras = False
+        for j, (tokens, start_pos, page_table, slot, sampling, eos_ids, is_final) in enumerate(lanes):
+            n = len(tokens)
+            ints[j, :n] = tokens
+            ints[j, bucket : bucket + mp] = page_table[:mp]
+            ints[j, bucket + mp] = start_pos
+            ints[j, bucket + mp + 1] = n
+            ints[j, bucket + mp + 2] = sampling.top_k
+            ints[j, bucket + mp + 3] = slot if (is_final and slot >= 0) else self.config.max_seqs
+            ints[j, bucket + mp + 4] = fold_seed(sampling.seed)
+            want_eos = bool(
+                is_final and eos_ids and sampling.min_tokens >= 1
+                and not sampling.ignore_eos
+            )
+            if want_eos:
+                ids = np.asarray(eos_ids, np.int32)[:MAX_EOS_IDS]
+                ints[j, bucket + mp + 5 : bucket + mp + 5 + len(ids)] = ids
+            flts[0, j] = sampling.temperature
+            flts[1, j] = sampling.top_p
+            flts[2, j] = sampling.min_p
+            flts[3, j] = sampling.presence_penalty
+            flts[4, j] = sampling.frequency_penalty
+            flts[5, j] = sampling.repetition_penalty
+            want_extras = want_extras or want_eos or (
+                is_final and (sampling.needs_penalties or bool(sampling.seed))
+            )
+        # pad lanes: n=0 (valid all-False), start 0, page table 0 (every read
+        # lands in the in-bounds trash page — the V fill would DMA out of the
+        # pool), slot out-of-range so the feedback write drops
+        for j in range(len(lanes), N):
+            ints[j, bucket : bucket + mp + 5] = 0
+            ints[j, bucket + mp + 3] = self.config.max_seqs
+        if want_extras:
+            self._ensure_penalty_state()
+        toks, lp, self.kv_cache, self.slot_state = self._prefill_packed(
+            self.params,
+            self.kv_cache,
+            self.slot_state,
+            jnp.asarray(ints),
+            jnp.asarray(flts),
+            self._next_key(),
+            want_lp=want_logprobs,
+            want_pen=want_extras,
+            want_seed=want_extras,
+            want_eos_mask=want_extras,
+        )
+        try:
+            toks.copy_to_host_async()
+            if lp is not None:
+                for a in lp:
+                    a.copy_to_host_async()
+        except Exception:
+            pass
+        return (toks, lp) if want_logprobs else toks
 
     def _prefill_sp_impl(self, params, kv, slot_state, ints, flts, key, want_lp=False, want_pen=False, want_seed=False, want_eos_mask=False):
         """Same packed-ints contract as _prefill_impl, but the whole-prompt

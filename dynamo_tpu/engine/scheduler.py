@@ -91,6 +91,9 @@ class RunningSeq:
     admitted_order: int = 0
     sched_len: int = 0  # tokens in the scheduled timeline (>= len(generated))
     finished: bool = False
+    # packed-prefill progress: next chunk start, or None when all chunks are
+    # dispatched (decode windows only pick up seqs with prefill_pos None)
+    prefill_pos: Optional[int] = None
 
     @property
     def pos(self) -> int:
@@ -194,7 +197,8 @@ class Scheduler:
         outputs: list[StepOutput] = []
         outputs.extend(self._reconcile(block=False))
         outputs.extend(self._admit())
-        dispatched = self._dispatch_windows(outputs)
+        dispatched = self._dispatch_prefill_batches(outputs)
+        dispatched += self._dispatch_windows(outputs)
         pipeline_full = self._windows_in_flight() >= max(1, self.config.pipeline_depth)
         if pipeline_full or (self.in_flight and not dispatched and not outputs):
             outputs.extend(self._reconcile(block=True))
@@ -278,6 +282,21 @@ class Scheduler:
         )
         self._admit_counter += 1
 
+        if (
+            self.config.prefill_lanes > 1
+            and not req.images
+            and self.config.pp == 1
+            and self.config.sp == 1
+            and hasattr(self.runner.model, "prefill_packed")
+        ):
+            # packed path: per-request prep now, chunk dispatch deferred to
+            # _dispatch_prefill_batches so chunks of DIFFERENT sequences can
+            # share one weight pass
+            self._prep_prefill(req, slot, prompt_len)
+            seq.prefill_pos = cached_len
+            self.slots[slot] = seq
+            return
+
         # dispatch-ahead: chunks run without any host sync; the final chunk
         # samples, seeds tokens_dev[slot] on device, and async-copies the token
         result = self._dispatch_prefill_chunks(
@@ -290,40 +309,114 @@ class Scheduler:
             _InFlight(kind="first", dev=tok_dev, seqs=[seq], cached_len=cached_len, lp=lp)
         )
 
-    def _dispatch_prefill_chunks(
-        self, req: EngineRequest, page_table: np.ndarray, cached_len: int,
-        prompt_len: int, slot: int,
-    ):
-        """Dispatch-ahead chunked prefill: no host sync; the final chunk seeds
-        tokens_dev[slot] and returns the token as a device scalar."""
-        return self.run_prefill_chunks(
-            req, page_table, cached_len, prompt_len, slot=slot, sync=False,
-            want_logprobs=req.logprobs is not None,
-        )
+    def _dispatch_prefill_batches(self, outputs: list[StepOutput]) -> int:
+        """Pack pending prefill chunks of distinct sequences into shared
+        prefill calls (one weight pass per call — the reference's engines
+        batch prefills the same way; SURVEY.md §2.4 vLLM scheduler). Each
+        sequence contributes at most one chunk per call (chunk i+1 reads the
+        pages chunk i wrote, so same-sequence chunks ride consecutive calls).
+        Single pending chunks take the per-request path — a packed call pads
+        compute to its full lane count, which a lone request shouldn't pay."""
+        count = 0
+        while True:
+            pending = sorted(
+                (s for s in self.slots
+                 if s is not None and not s.finished and s.prefill_pos is not None),
+                key=lambda s: s.admitted_order,
+            )
+            if not pending:
+                return count
+            max_chunk = self.config.max_prefill_chunk
+            # greedy bucket-aware packing in admission order: grow the lane
+            # set while every taken lane still fits the (possibly enlarged)
+            # bucket's row budget — one long head chunk goes alone, short
+            # chunks pack together
+            chunks = []
+            bucket = 0
+            for s in pending:
+                end = min(s.prefill_pos + max_chunk, s.prompt_len)
+                cand = self.config.bucket_for(max(bucket, end - s.prefill_pos))
+                if chunks and len(chunks) + 1 > self.config.lanes_for(cand):
+                    break
+                chunks.append((s, s.prefill_pos, end))
+                bucket = cand
+            lanes_max = self.config.lanes_for(bucket)
+            if len(chunks) == 1:
+                seq, start, _ = chunks[0]
+                try:
+                    result = self._dispatch_prefill_chunks(
+                        seq.req, seq.page_table, start, seq.prompt_len,
+                        slot=seq.slot, prep=False,
+                    )
+                except Exception:
+                    log.exception("prefill failed for %s", seq.req.request_id)
+                    outputs.extend(self._finish(seq, "error"))
+                    continue
+                tok_dev, lp = result if isinstance(result, tuple) else (result, None)
+                self.allocator.commit_prefilled(seq.req.request_id, seq.prompt_len)
+                seq.prefill_pos = None
+                self.in_flight.append(_InFlight(
+                    kind="first", dev=tok_dev, seqs=[seq],
+                    cached_len=seq.cached_len, lp=lp,
+                ))
+                count += 1
+                continue
+            lanes = []
+            finals = []  # (seq, lane_idx)
+            want_lp = False
+            for j, (seq, start, end) in enumerate(chunks):
+                is_final = end == seq.prompt_len
+                lanes.append((
+                    np.asarray(seq.req.token_ids[start:end], np.int32),
+                    start,
+                    seq.page_table,
+                    seq.slot,
+                    seq.req.sampling,
+                    () if seq.req.sampling.ignore_eos else seq.req.eos_token_ids,
+                    is_final,
+                ))
+                if is_final:
+                    finals.append((seq, j))
+                    want_lp = want_lp or seq.req.logprobs is not None
+            try:
+                result = self.runner.prefill_chunk_batch(
+                    lanes, N=lanes_max, want_logprobs=want_lp
+                )
+            except Exception:
+                log.exception(
+                    "packed prefill failed for %s",
+                    [seq.req.request_id for seq, _, _ in chunks],
+                )
+                for seq, _, _ in chunks:
+                    outputs.extend(self._finish(seq, "error"))
+                continue
+            for j, (seq, start, end) in enumerate(chunks):
+                if end == seq.prompt_len:
+                    self.allocator.commit_prefilled(seq.req.request_id, seq.prompt_len)
+                    seq.prefill_pos = None
+                else:
+                    seq.prefill_pos = end
+            toks_dev, lp = result if want_lp else (result, None)
+            if finals:
+                self.in_flight.append(_InFlight(
+                    kind="first_batch", dev=toks_dev, lp=lp,
+                    seqs=[(seq, j, seq.cached_len) for seq, j in finals],
+                ))
+            count += 1
 
-    def run_prefill_chunks(
-        self,
-        req: EngineRequest,
-        page_table: np.ndarray,
-        cached_len: int,
-        prompt_len: int,
-        slot: int = -1,
-        sync: bool = True,
-        want_logprobs: bool = False,
-    ):
-        """Bucket-chunked prefill, skipping the cached prefix; samples the first
-        output token on the final chunk. sync=True (disagg prefill-worker path)
-        returns it as a host int; sync=False returns the device scalar."""
-        s = req.sampling
-        first_token = None
-        start = cached_len
-        max_chunk = self.config.max_prefill_chunk
+    def _prep_prefill(
+        self, req: EngineRequest, slot: int, prompt_len: int, cached_len: int = 0
+    ) -> None:
+        """Per-request device-state prep that must precede any of its prefill
+        chunks: vision encode (skipped when every image run sits inside the
+        cached prefix — a repeat request never re-runs the vision tower),
+        penalty-slot seeding (restoring prior-output counts after a
+        preemption; image virtual-token runs excluded — their ids are
+        hash-derived arbitrary vocab ids), M-RoPE positions."""
         needs_vision = req.images and any(
             im.offset + im.num_tokens > cached_len for im in req.images
         )
         if needs_vision and req.mm_embeds is None:
-            # skipped entirely when every image run sits inside the cached
-            # prefix — a repeat request never re-runs the vision tower
             req.mm_embeds = self.runner.encode_images(req.images)
         if (
             req.sampling.min_tokens >= 1
@@ -336,11 +429,6 @@ class Scheduler:
                 len(req.eos_token_ids), MAX_EOS_IDS, req.request_id,
             )
         if req.sampling.needs_penalties and slot >= 0:
-            # reset + prompt-seed this slot's on-device penalty state before
-            # any sampling against it (restoring prior-output counts after a
-            # preemption). Image virtual-token runs are excluded: their ids are
-            # hash-derived arbitrary vocab ids, and seeding them would penalize
-            # unrelated real tokens.
             pen_ids = np.asarray(req.token_ids, np.int32)
             pen_from = req.penalty_output_from
             if req.images:
@@ -359,6 +447,39 @@ class Scheduler:
                 prompt_len, req.images,
                 self.runner.model.config.vision.spatial_merge_size,
             )
+
+    def _dispatch_prefill_chunks(
+        self, req: EngineRequest, page_table: np.ndarray, cached_len: int,
+        prompt_len: int, slot: int, prep: bool = True,
+    ):
+        """Dispatch-ahead chunked prefill: no host sync; the final chunk seeds
+        tokens_dev[slot] and returns the token as a device scalar."""
+        return self.run_prefill_chunks(
+            req, page_table, cached_len, prompt_len, slot=slot, sync=False,
+            want_logprobs=req.logprobs is not None, prep=prep,
+        )
+
+    def run_prefill_chunks(
+        self,
+        req: EngineRequest,
+        page_table: np.ndarray,
+        cached_len: int,
+        prompt_len: int,
+        slot: int = -1,
+        sync: bool = True,
+        want_logprobs: bool = False,
+        prep: bool = True,
+    ):
+        """Bucket-chunked prefill, skipping the cached prefix; samples the first
+        output token on the final chunk. sync=True (disagg prefill-worker path)
+        returns it as a host int; sync=False returns the device scalar.
+        prep=False skips _prep_prefill (already run at packed-path admission)."""
+        s = req.sampling
+        first_token = None
+        start = cached_len
+        max_chunk = self.config.max_prefill_chunk
+        if prep:
+            self._prep_prefill(req, slot, prompt_len, cached_len=cached_len)
         while start < prompt_len:
             end = min(start + max_chunk, prompt_len)
             is_last = end == prompt_len
@@ -431,6 +552,8 @@ class Scheduler:
 
     def _plan_steps(self, seq: RunningSeq, K: int) -> int:
         """Steps this window can run for `seq` before budget/length bounds."""
+        if seq.prefill_pos is not None:
+            return 0  # prefill chunks still pending; no sampled token yet
         budget = seq.req.sampling.max_tokens - seq.sched_len
         length = self.config.max_model_len - seq.next_fed_pos
         return max(0, min(K, budget, length))
@@ -569,6 +692,16 @@ class Scheduler:
                         lp=(lp[0][()], lp[1], lp[2]) if lp is not None else None,
                     )
                 )
+            elif entry.kind == "first_batch":
+                for seq, lane, cached in entry.seqs:
+                    if seq.finished:
+                        continue
+                    step_lp = None
+                    if lp is not None and seq.req.logprobs is not None:
+                        step_lp = (lp[0][lane], lp[1][lane], lp[2][lane])
+                    outputs.extend(
+                        self._emit_token(seq, int(data[lane]), cached=cached, lp=step_lp)
+                    )
             else:
                 for seq, slot_idx, steps in entry.seqs:
                     if seq.finished:

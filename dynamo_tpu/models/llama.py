@@ -391,6 +391,71 @@ class LlamaModel:
             rope_positions=rope_positions,
         )
 
+    def prefill_packed(
+        self,
+        params: dict,
+        kv_cache: dict,  # {"k","v"} flat pools (donated)
+        tokens: jnp.ndarray,  # [N, T] bucket-padded chunks, one per lane
+        positions: jnp.ndarray,  # [N, T] absolute positions per lane
+        page_tables: jnp.ndarray,  # [N, max_pages] logical page ids per lane
+        valid: jnp.ndarray,  # [N, T] bool
+        last_idx: jnp.ndarray,  # [N] index of each lane's final real token
+    ) -> tuple[jnp.ndarray, dict]:
+        """Cross-request packed prefill: N lanes (chunks of N DIFFERENT
+        sequences) flattened into one [N*T] token stream so the layer matmuls
+        read the weights ONCE per call instead of once per request — the
+        per-call overhead and weight traffic of N short prefills for the
+        price of one (the reference's engines batch prefills the same way;
+        vLLM scheduler: SURVEY.md §2.4). Lanes must belong to distinct
+        sequences (chunk i+1 of one sequence reads pages chunk i wrote, so
+        same-sequence chunks go in consecutive calls, never one call).
+
+        Returns (logits [N, V] at each lane's last_idx, updated kv_cache)."""
+        c = self.config
+        k_pool, v_pool = kv_cache["k"], kv_cache["v"]
+        page_size = k_pool.shape[1]
+        N, T = tokens.shape
+        lane = jnp.arange(N)
+        phys = jnp.where(valid, page_tables[lane[:, None], positions // page_size], 0)
+        offsets = jnp.where(valid, positions % page_size, 0)
+        pos_flat = positions.reshape(N * T)
+
+        def make_attn_fn(off):
+            def attn_fn(q, k_new, v_new, kp_, vp_):
+                qs = q.reshape(N, T, *q.shape[1:])
+                outs = [
+                    dispatch_paged_prefill_attention(
+                        qs[j], kp_, vp_, off + page_tables[j], positions[j],
+                        mesh=self.attn_mesh,
+                    )
+                    for j in range(N)
+                ]
+                return jnp.concatenate(outs, axis=0)
+
+            return attn_fn
+
+        num_pages = k_pool.shape[0] // c.num_layers
+        hidden = params["embed"][tokens.reshape(N * T)].astype(c.dtype)
+
+        def body(carry, xs):
+            h, kp, vp = carry
+            lp, off = xs
+            h, kp, vp = self._layer(
+                lp, h, kp, vp, pos_flat,
+                off + phys.reshape(N * T), offsets.reshape(N * T),
+                make_attn_fn(off),
+            )
+            return (h, kp, vp), None
+
+        (hidden, k_pool, v_pool), _ = jax.lax.scan(
+            body,
+            (hidden, k_pool, v_pool),
+            (params["layers"], self._layer_offsets(num_pages)),
+        )
+        rows = hidden[lane * T + last_idx]  # [N, D]
+        logits = self._unembed(params, rows)  # [N, V]
+        return logits, {"k": k_pool, "v": v_pool}
+
     def prefill_sp(
         self,
         params: dict,

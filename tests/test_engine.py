@@ -292,3 +292,59 @@ def test_step_failure_fails_waiting_requests():
 
     finish = asyncio.run(asyncio.wait_for(go(), timeout=60))
     assert finish == "error"
+
+
+def test_packed_prefill_matches_unpacked():
+    """Cross-request packed prefill (prefill_lanes > 1) must produce exactly
+    the tokens of the per-request path — including multi-chunk prompts whose
+    chunks interleave across packed calls, and prefix-cache hits."""
+
+    async def run(lanes: int):
+        eng = AsyncJaxEngine(tiny_engine_config(
+            prefill_lanes=lanes, max_model_len=96, num_pages=96,
+        ))
+        await eng.start()
+        rng = np.random.default_rng(42)
+        # mixed lengths: some single-chunk, some spanning 2-3 chunks of the
+        # 32-token max bucket
+        prompts = [rng.integers(1, 200, n).tolist() for n in (7, 30, 50, 70)]
+        # a shared prefix pair (prefix-cache interaction with packing)
+        prompts.append(prompts[3][:40] + [5, 6, 7])
+        reqs = [
+            EngineRequest(
+                request_id=f"p{i}", token_ids=p,
+                sampling=SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True),
+            )
+            for i, p in enumerate(prompts)
+        ]
+        # feature-bearing lanes: penalties, seeded stream, min_tokens EOS
+        # suppression, logprobs — the want_* packed-trace variants
+        reqs.append(EngineRequest(
+            request_id="pen", token_ids=prompts[0],
+            sampling=SamplingParams(
+                temperature=0.0, max_tokens=6, ignore_eos=True,
+                presence_penalty=0.4, frequency_penalty=0.2,
+            ),
+        ))
+        reqs.append(EngineRequest(
+            request_id="seeded", token_ids=prompts[1],
+            sampling=SamplingParams(temperature=0.9, max_tokens=6, seed=7,
+                                    ignore_eos=True),
+        ))
+        reqs.append(EngineRequest(
+            request_id="mintok", token_ids=prompts[2],
+            sampling=SamplingParams(temperature=0.0, max_tokens=6, min_tokens=3),
+            eos_token_ids=(9,),
+        ))
+        reqs.append(EngineRequest(
+            request_id="lp", token_ids=prompts[0][:20],
+            sampling=SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True),
+            logprobs=2,
+        ))
+        outs = await asyncio.gather(*[_collect(eng, r) for r in reqs])
+        await eng.shutdown()
+        return [toks for toks, _, _ in outs]
+
+    packed = asyncio.run(run(4))
+    unpacked = asyncio.run(run(1))
+    assert packed == unpacked

@@ -1,13 +1,19 @@
 """Decompose decode-window time on the real chip.
 
-Times, per decode step at the bench config (1.3B llama-shaped; batch and
-page size come from bench.bench_config() — check the printed B):
-  window   — full dispatch_decode_window (model + sampling + feedback)
-  model    — scan of model.decode alone (argmax feedback, no sampler)
-  sampler  — scan of sample_tokens alone on [B, V] logits
-  matmul   — weight-streaming floor: one scan step touching all params
+Methodology (tunneled-PJRT safe):
+  - Per-step cost = (t(window of 64 steps) - t(window of 8 steps)) / 56 —
+    the tunnel RTT (~75-100 ms/dispatch) cancels in the difference.
+  - Every timed call materializes its (small) token output to host AND
+    mutates donated device state, so the tunnel's executable/result caching
+    cannot short-circuit the run (block_until_ready alone can be served from
+    a cache when inputs are unchanged — measured on this rig).
 
-Usage: python tools/profile_decode.py  (on the default/TPU backend)
+Reports, per decode step at the bench config (1.3B llama-shaped):
+  window   — full dispatch_decode_window (model + sampling + feedback)
+  model    — scan of model.decode alone (argmax feedback, donated kv)
+  attention (separate: tools/profile_attn.py)
+
+Usage: python tools/profile_decode.py [batch] [page_size]
 """
 
 from __future__ import annotations
@@ -21,125 +27,107 @@ sys.path.insert(0, ".")
 import bench  # noqa: E402  (repo-root bench config = single source of truth)
 
 
-def timed(fn, n=3):
-    import jax
-
-    fn()  # compile
-    best = float("inf")
-    for _ in range(n):
-        t0 = time.monotonic()
-        jax.block_until_ready(fn())
-        best = min(best, time.monotonic() - t0)
-    return best
-
-
 def main():
     import jax
     import jax.numpy as jnp
 
     from dynamo_tpu.engine.model_runner import ModelRunner
-    from dynamo_tpu.engine.sampling import sample_tokens
     from dynamo_tpu.models.registry import load_model
 
     bench._probe_pallas()
-    cfg = bench.bench_config()
-    K = cfg.decode_steps
-    B = cfg.max_seqs
+    B = int(sys.argv[1]) if len(sys.argv) > 1 else bench.HEADLINE[0]
+    PS = int(sys.argv[2]) if len(sys.argv) > 2 else bench.HEADLINE[1]
+    cfg = bench.bench_config(B, PS)
     model, params = load_model(cfg.model_id)
     runner = ModelRunner(cfg, model, params)
-    V = model.config.vocab_size
     ctx = bench.PROMPT_LEN + bench.DECODE_TOKENS // 2
 
     pages_per_seq = -(-ctx // cfg.page_size)
     pt = np.zeros((B, cfg.max_pages_per_seq), np.int32)
+    npp = pages_per_seq + 1  # room for the 64-step window's growth
+    if 1 + B * npp > cfg.num_pages:
+        raise SystemExit(f"pool too small: need {1 + B * npp} pages, have {cfg.num_pages}")
     for i in range(B):
-        pt[i, :pages_per_seq] = 1 + i * pages_per_seq + np.arange(pages_per_seq)
+        pt[i, :npp] = 1 + i * npp + np.arange(npp)
     positions = np.full(B, ctx, np.int32)
     active = np.ones(B, bool)
-    limits = np.full(B, ctx + K, np.int32)
+    limits = np.full(B, npp * PS - 2, np.int32)
     temps = np.zeros(B, np.float32)
     top_ks = np.zeros(B, np.int32)
     top_ps = np.ones(B, np.float32)
 
+    def best_wall(fn, reps=4):
+        fn()  # compile
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
     # ---- full window through the runner (greedy, like the bench) ----
-    def window():
-        out = runner.dispatch_decode_window(
-            positions, pt, active, limits, temps, top_ks, top_ps, K
+    def window(num_steps):
+        toks = runner.dispatch_decode_window(
+            positions, pt, active, limits, temps, top_ks, top_ps, num_steps
         )
-        return out
+        return np.asarray(jax.device_get(toks))
 
-    t_window = timed(window)
+    tA = best_wall(lambda: window(8))
+    tB = best_wall(lambda: window(64))
+    per_window = (tB - tA) / 56
 
-    # ---- model.decode alone, argmax feedback ----
+    # ---- model.decode alone, argmax feedback, donated kv/state ----
     pt_j = jnp.asarray(pt)
-    pos0 = jnp.asarray(positions)
     act = jnp.asarray(active)
 
-    def model_only(params, kv, toks0):
+    def model_only_impl(params, kv, toks0, pos0, *, num_steps):
         def body(carry, _):
-            toks, pos = carry
-            logits, _kv = model.decode(params, kv, toks, pos, pt_j, act)
+            kv_, toks, pos = carry
+            logits, kv_ = model.decode(params, kv_, toks, pos, pt_j, act)
             toks = jnp.argmax(logits, -1).astype(jnp.int32)
-            return (toks, pos + 1), ()
+            return (kv_, toks, pos + 1), toks
 
-        (toks, _), _ = jax.lax.scan(body, (toks0, pos0), None, length=K)
-        return toks
+        (kv, _, _), ys = jax.lax.scan(body, (kv, toks0, pos0), None, length=num_steps)
+        return ys, kv
 
-    model_jit = jax.jit(model_only)
-    toks0 = jnp.zeros(B, jnp.int32)
-    t_model = timed(lambda: model_jit(runner.params, runner.kv_cache, toks0))
+    jits = {
+        n: jax.jit(
+            lambda p, kv, t, q, n=n: model_only_impl(p, kv, t, q, num_steps=n),
+            donate_argnums=(1,),
+        )
+        for n in (8, 64)
+    }
 
-    # ---- sampler alone (greedy path, same trace as the bench) ----
-    logits = jnp.asarray(np.random.default_rng(0).normal(size=(B, V)), jnp.float32)
+    def model_only(num_steps):
+        ys, runner.kv_cache = jits[num_steps](
+            runner.params, runner.kv_cache, jnp.zeros(B, jnp.int32),
+            jnp.asarray(positions),
+        )
+        return np.asarray(jax.device_get(ys))
 
-    def sampler_only(logits, key):
-        def body(key, _):
-            key, sub = jax.random.split(key)
-            toks = sample_tokens(
-                logits, sub,
-                jnp.zeros(B, jnp.float32), jnp.zeros(B, jnp.int32),
-                jnp.ones(B, jnp.float32), min_p=jnp.zeros(B, jnp.float32),
-            )
-            return key, toks
+    tA = best_wall(lambda: model_only(8))
+    tB = best_wall(lambda: model_only(64))
+    per_model = (tB - tA) / 56
 
-        _, toks = jax.lax.scan(body, key, None, length=K)
-        return toks
-
-    sampler_jit = jax.jit(sampler_only)
-    t_sampler = timed(lambda: sampler_jit(logits, jax.random.key(0)))
-
-    # ---- weight-streaming floor: dot every param against a vector ----
     flat = jax.tree_util.tree_leaves(runner.params)
     total_bytes = sum(l.size * l.dtype.itemsize for l in flat)
-
-    def touch(params, x):
-        def body(acc, _):
-            s = acc
-            for l in jax.tree_util.tree_leaves(params):
-                s = s + jnp.sum(l.reshape(-1, l.shape[-1]).astype(jnp.bfloat16) @ x[: l.shape[-1]])
-            return s, ()
-
-        s, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), None, length=K)
-        return s
-
-    x = jnp.ones((8192, 1), jnp.bfloat16)
-    touch_jit = jax.jit(touch)
-    t_touch = timed(lambda: touch_jit(runner.params, x))
-
-    ms = lambda t: round(t / K * 1e3, 3)
+    kv_bytes = (
+        B * pages_per_seq * PS * model.config.num_kv_heads * model.config.head_dim
+        * 2 * 2 * model.config.num_layers
+    )
+    floor = (total_bytes + kv_bytes) / 819e9
     out = {
+        "B": B, "page_size": PS, "ctx": ctx,
         "per_step_ms": {
-            "window": ms(t_window),
-            "model_only": ms(t_model),
-            "sampler_only": ms(t_sampler),
-            "weight_touch_floor": ms(t_touch),
+            "window": round(per_window * 1e3, 3),
+            "model_only": round(per_model * 1e3, 3),
+            "sampling_and_feedback": round((per_window - per_model) * 1e3, 3),
         },
-        "window_tok_s": round(B * K / t_window, 1),
+        "window_tok_s": round(B / per_window, 1),
+        "hbm_floor_ms": round(floor * 1e3, 3),
+        "pct_of_roofline": round(100 * floor / per_window, 1),
         "param_bytes": total_bytes,
-        "hbm_roofline_steps_s": round(819e9 / total_bytes, 1),
-        "K": K,
-        "B": B,
-        "ctx": ctx,
+        "kv_bytes_per_step": kv_bytes,
     }
     print(out)
 

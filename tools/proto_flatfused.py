@@ -1,0 +1,434 @@
+"""Prototype: row-flat fused KV pool [P*2*ps, Hkv, D] decode kernel.
+
+Page p: rows [p*2ps, p*2ps+ps) = K, [p*2ps+ps, (p+1)*2ps) = V. Every DMA is a
+plain row-range slice (rank 3), scratch stays rank 4 — the rank >= 5 scratch
+of the earlier fused prototypes is what made Mosaic slow.
+
+Usage: python tools/proto_flatfused.py [parity|perf CONFIG]
+"""
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, ".")
+
+_NEG_INF = -1e30
+
+
+def _kernel_ff(
+    page_tables_ref,  # [B, max_pages] SMEM
+    lengths_ref,  # [B] SMEM
+    q_ref,  # [group, Hq, D] VMEM
+    kv_hbm,  # [P*2*ps, Hkv, D] HBM row-flat fused pool
+    out_ref,  # [group, Hq, D] VMEM
+    kv_scratch,  # [2, group*C*2*ps, Hkv, D] VMEM
+    sems,  # [2, group] DMA
+    *, page_size: int, chunk: int, group: int,
+):
+    ps = page_size
+    rows_page = 2 * ps
+    C = chunk
+    span = C * rows_page
+    P = kv_hbm.shape[0] // rows_page
+    g0 = pl.program_id(0) * group
+    Hq, D = q_ref.shape[1], q_ref.shape[2]
+    Hkv = kv_hbm.shape[1]
+    G = Hq // Hkv
+
+    lengths = [lengths_ref[g0 + j] for j in range(group)]
+    n_pages = [jnp.maximum(1, pl.cdiv(lengths[j], ps)) for j in range(group)]
+    n_chunks = [pl.cdiv(n_pages[j], C) for j in range(group)]
+    max_chunks = n_chunks[0]
+    for j in range(1, group):
+        max_chunks = jnp.maximum(max_chunks, n_chunks[j])
+
+    qs = [q_ref[j].reshape(Hkv, G, D) for j in range(group)]
+    scale = 1.0 / jnp.sqrt(jnp.float32(D))
+
+    def chunk_plan(j, c):
+        first = page_tables_ref[g0 + j, c * C]
+        ok = first + C <= P
+        for t in range(1, C):
+            idx = c * C + t
+            ok &= (idx >= n_pages[j]) | (page_tables_ref[g0 + j, idx] == first + t)
+        return first, ok
+
+    def sweep(slot, c, do):
+        for j in range(group):
+            @pl.when(c < n_chunks[j])
+            def _(j=j):
+                if C == 1:
+                    cp = pltpu.make_async_copy(
+                        kv_hbm.at[pl.ds(page_tables_ref[g0 + j, c] * rows_page, rows_page)],
+                        kv_scratch.at[slot, pl.ds(j * span, rows_page)],
+                        sems.at[slot, j],
+                    )
+                    cp.start() if do == "start" else cp.wait()
+                else:
+                    first, ok = chunk_plan(j, c)
+
+                    @pl.when(ok)
+                    def _():
+                        cp = pltpu.make_async_copy(
+                            kv_hbm.at[pl.ds(first * rows_page, span)],
+                            kv_scratch.at[slot, pl.ds(j * span, span)],
+                            sems.at[slot, j],
+                        )
+                        cp.start() if do == "start" else cp.wait()
+
+                    @pl.when(~ok)
+                    def _():
+                        for t in range(C):
+                            @pl.when(c * C + t < n_pages[j])
+                            def _(t=t):
+                                cp = pltpu.make_async_copy(
+                                    kv_hbm.at[pl.ds(
+                                        page_tables_ref[g0 + j, c * C + t] * rows_page,
+                                        rows_page,
+                                    )],
+                                    kv_scratch.at[slot, pl.ds((j * C + t) * rows_page, rows_page)],
+                                    sems.at[slot, j],
+                                )
+                                cp.start() if do == "start" else cp.wait()
+
+    sweep(0, 0, "start")
+
+    def body(c, carry):
+        m, l, acc = carry  # [group, Hkv, G], ..., [group, Hkv, G, D]
+        slot = jax.lax.rem(c, 2)
+
+        @pl.when(c + 1 < max_chunks)
+        def _():
+            sweep(jax.lax.rem(c + 1, 2), c + 1, "start")
+
+        sweep(slot, c, "wait")
+
+        ms, ls, accs = [], [], []
+        for j in range(group):
+            new_m, new_l, new_acc = m[j], l[j], acc[j]
+            for t in range(C):  # per-page flash update (static unroll)
+                base = (j * C + t) * rows_page
+                k_pg = kv_scratch[slot, base : base + ps]  # [ps, Hkv, D]
+                v_pg = kv_scratch[slot, base + ps : base + rows_page]
+                kt = jnp.transpose(k_pg, (1, 0, 2))  # [Hkv, ps, D]
+                vt = jnp.transpose(v_pg, (1, 0, 2))
+                pidx = (c * C + t) * ps
+                idx = pidx + jax.lax.broadcasted_iota(jnp.int32, (1, 1, ps), 2)
+                vidx = pidx + jax.lax.broadcasted_iota(jnp.int32, (1, ps, 1), 1)
+                scores = jax.lax.dot_general(
+                    qs[j], kt, (((2,), (2,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32,
+                ) * scale
+                scores = jnp.where(idx < lengths[j], scores, _NEG_INF)
+                vt_m = jnp.where(vidx < lengths[j], vt, 0)
+                chunk_max = jnp.max(scores, axis=-1)
+                m2 = jnp.maximum(new_m, chunk_max)
+                corr = jnp.exp(new_m - m2)
+                probs = jnp.exp(scores - m2[..., None])
+                new_l = new_l * corr + jnp.sum(probs, axis=-1)
+                new_acc = new_acc * corr[..., None] + jax.lax.dot_general(
+                    probs.astype(kt.dtype), vt_m, (((2,), (1,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32,
+                )
+                new_m = m2
+            ms.append(new_m)
+            ls.append(new_l)
+            accs.append(new_acc)
+        if group == 1:
+            return ms[0][None], ls[0][None], accs[0][None]
+        return jnp.stack(ms), jnp.stack(ls), jnp.stack(accs)
+
+    m0 = jnp.full((group, Hkv, G), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((group, Hkv, G), jnp.float32)
+    acc0 = jnp.zeros((group, Hkv, G, D), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, max_chunks, body, (m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-20)[..., None]
+    out_ref[...] = out.reshape(group, Hq, D).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("page_size", "interpret", "group", "chunk"))
+def flatfused(q, kv_pool, page_tables, positions, page_size, interpret=False, group=1, chunk=2):
+    B, Hq, D = q.shape
+    R, Hkv, _ = kv_pool.shape
+    lengths = positions.astype(jnp.int32) + 1
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B // group,),
+        in_specs=[
+            pl.BlockSpec((group, Hq, D), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((group, Hq, D), lambda b, *_: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, group * chunk * 2 * page_size, Hkv, D), kv_pool.dtype),
+            pltpu.SemaphoreType.DMA((2, group)),
+        ],
+    )
+    kernel = pl.pallas_call(
+        functools.partial(_kernel_ff, page_size=page_size, chunk=chunk, group=group),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )
+    return kernel(page_tables.astype(jnp.int32), lengths, q, kv_pool)
+
+
+def to_flat(k_pages, v_pages):
+    """[P, ps, Hkv, D] x2 -> [P*2ps, Hkv, D] row-flat fused."""
+    P, ps, Hkv, D = k_pages.shape
+    kv = jnp.concatenate([k_pages, v_pages], axis=1)  # [P, 2ps, Hkv, D]
+    return kv.reshape(P * 2 * ps, Hkv, D)
+
+
+def parity():
+    from dynamo_tpu.ops.attention import paged_decode_attention
+
+    rng = np.random.default_rng(1)
+    B, Hq, Hkv, D, PS, P, MP = 8, 16, 8, 128, 32, 64, 8
+    k = jnp.asarray(rng.standard_normal((P, PS, Hkv, D)) * 0.3, jnp.float32)
+    v = jnp.asarray(rng.standard_normal((P, PS, Hkv, D)) * 0.3, jnp.float32)
+    kv = to_flat(k, v)
+    q = jnp.asarray(rng.standard_normal((B, Hq, D)) * 0.3, jnp.float32)
+    for mode in ["contig", "scatter", "mixed"]:
+        pt = np.zeros((B, MP), np.int32)
+        lengths = rng.integers(1, PS * MP, B)
+        for b in range(B):
+            n = -(-int(lengths[b]) // PS)
+            if mode == "contig":
+                start = rng.integers(1, P - MP)
+                pt[b, :n] = start + np.arange(n)
+            elif mode == "scatter":
+                pt[b, :n] = rng.choice(np.arange(1, P), n, replace=False)
+            else:
+                half = n // 2
+                start = rng.integers(1, P - MP)
+                pt[b, :half] = start + np.arange(half)
+                pt[b, half:n] = rng.choice(np.arange(1, P), n - half, replace=False)
+        positions = jnp.asarray(lengths - 1, jnp.int32)
+        ptj = jnp.asarray(pt)
+        ref = paged_decode_attention(q, k, v, ptj, positions)
+        for g, c in [(1, 1), (1, 2), (1, 4), (2, 2), (4, 1), (4, 2)]:
+            out = flatfused(q, kv, ptj, positions, PS, interpret=True, group=g, chunk=c)
+            err = float(jnp.max(jnp.abs(out - ref)))
+            status = "OK " if err < 1e-3 else "FAIL"
+            print(f"{mode:8s} g={g} c={c}: max_err {err:.2e} {status}", flush=True)
+
+
+def perf(config):
+    g, c = map(int, config.split(","))
+    B, PS, Hq, Hkv, D, L = 64, 128, 16, 8, 128, 24
+    PAGES = 224
+    rng = np.random.default_rng(0)
+    LP = L * PAGES
+    q0 = jnp.asarray(rng.standard_normal((B, Hq, D)) * 0.1, jnp.bfloat16)
+    pt = np.zeros((B, 8), np.int32)
+    nxt = 1
+    for b in range(B):
+        for i in range(3):
+            pt[b, i] = nxt
+            nxt += 1
+    ptj = jnp.asarray(pt)
+    offsets = jnp.arange(L, dtype=jnp.int32) * PAGES
+    pos0 = jnp.full(B, 255, jnp.int32)
+    kvp = jnp.asarray(
+        rng.standard_normal((LP * 2 * PS, Hkv, D)) * 0.1, jnp.bfloat16
+    )
+
+    def kern_harness(num_steps):
+        def fn(q, s, pool):
+            def step(h, _):
+                def layer(hh, off):
+                    o = flatfused(hh, pool, off + ptj, pos0, PS, group=g, chunk=c)
+                    return (hh + 0.0001 * o).astype(hh.dtype), ()
+                h2, _ = jax.lax.scan(layer, h, offsets)
+                return h2, ()
+            qf, _ = jax.lax.scan(step, q * s, None, length=num_steps)
+            return qf
+        return jax.jit(fn)
+
+    import itertools
+    cnt = itertools.count()
+
+    def best_wall(jf, reps=4):
+        t0 = time.perf_counter()
+        np.asarray(jax.device_get(jf(q0, jnp.bfloat16(1.0), kvp)))
+        print(f"  compile+first: {time.perf_counter()-t0:.1f}s", flush=True)
+        best = float("inf")
+        for _ in range(reps):
+            s = jnp.bfloat16(1.0 + 0.0001 * next(cnt))
+            t0 = time.perf_counter()
+            np.asarray(jax.device_get(jf(q0, s, kvp)))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    tA = best_wall(kern_harness(8))
+    tB = best_wall(kern_harness(64))
+    print(f"flatfused g={g} c={c}: N8 {tA*1e3:.1f}ms N64 {tB*1e3:.1f}ms -> {(tB-tA)/56*1e3:6.3f} ms/step", flush=True)
+
+
+# ---- M1: perseq kernel verbatim, single fused DMA per page ----
+def _kernel_m1(
+    page_tables_ref, lengths_ref,
+    q_ref,      # [1, Hq, D]
+    kv_hbm,     # [P*2ps, Hkv, D] row-flat fused
+    out_ref,    # [1, Hq, D]
+    kv_scratch, # [2, 2*ps, Hkv, D]
+    sems,       # [2]
+    *, page_size: int,
+):
+    b = pl.program_id(0)
+    ps = page_size
+    rows_page = 2 * ps
+    length = lengths_ref[b]
+    n_pages = jnp.maximum(1, pl.cdiv(length, ps))
+
+    Hq, D = q_ref.shape[1], q_ref.shape[2]
+    Hkv = kv_hbm.shape[1]
+    G = Hq // Hkv
+
+    q = q_ref[0].reshape(Hkv, G, D)
+    scale = 1.0 / jnp.sqrt(jnp.float32(D))
+
+    def dma(slot, i):
+        return pltpu.make_async_copy(
+            kv_hbm.at[pl.ds(page_tables_ref[b, i] * rows_page, rows_page)],
+            kv_scratch.at[slot],
+            sems.at[slot],
+        )
+
+    dma(0, 0).start()
+
+    def body(i, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(i, 2)
+        next_slot = jax.lax.rem(i + 1, 2)
+
+        @pl.when(i + 1 < n_pages)
+        def _():
+            dma(next_slot, i + 1).start()
+
+        dma(slot, i).wait()
+
+        k_page = kv_scratch[slot, :ps]
+        v_page = kv_scratch[slot, ps:]
+        kt = jnp.transpose(k_page, (1, 0, 2))
+        vt = jnp.transpose(v_page, (1, 0, 2))
+
+        scores = jax.lax.dot_general(
+            q, kt, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
+        ) * scale
+        idx = i * ps + jax.lax.broadcasted_iota(jnp.int32, (1, 1, ps), 2)
+        vidx = i * ps + jax.lax.broadcasted_iota(jnp.int32, (1, ps, 1), 1)
+        scores = jnp.where(idx < length, scores, _NEG_INF)
+        vt = jnp.where(vidx < length, vt, 0)
+
+        chunk_max = jnp.max(scores, axis=-1)
+        new_m = jnp.maximum(m, chunk_max)
+        corr = jnp.exp(m - new_m)
+        probs = jnp.exp(scores - new_m[..., None])
+        new_l = l * corr + jnp.sum(probs, axis=-1)
+        chunk_out = jax.lax.dot_general(
+            probs.astype(kt.dtype), vt, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        new_acc = acc * corr[..., None] + chunk_out
+        return new_m, new_l, new_acc
+
+    m0 = jnp.full((Hkv, G), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((Hkv, G), jnp.float32)
+    acc0 = jnp.zeros((Hkv, G, D), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_pages, body, (m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-20)[..., None]
+    out_ref[0] = out.reshape(Hq, D).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("page_size", "interpret"))
+def m1(q, kv_pool, page_tables, positions, page_size, interpret=False):
+    B, Hq, D = q.shape
+    R, Hkv, _ = kv_pool.shape
+    lengths = positions.astype(jnp.int32) + 1
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, 2 * page_size, Hkv, D), kv_pool.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    kernel = pl.pallas_call(
+        functools.partial(_kernel_m1, page_size=page_size),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )
+    return kernel(page_tables.astype(jnp.int32), lengths, q, kv_pool)
+
+
+def perf_m1():
+    B, PS, Hq, Hkv, D, L = 64, 128, 16, 8, 128, 24
+    PAGES = 224
+    rng = np.random.default_rng(0)
+    LP = L * PAGES
+    q0 = jnp.asarray(rng.standard_normal((B, Hq, D)) * 0.1, jnp.bfloat16)
+    pt = np.zeros((B, 8), np.int32)
+    nxt = 1
+    for b in range(B):
+        for i in range(3):
+            pt[b, i] = nxt
+            nxt += 1
+    ptj = jnp.asarray(pt)
+    offsets = jnp.arange(L, dtype=jnp.int32) * PAGES
+    pos0 = jnp.full(B, 255, jnp.int32)
+    kvp = jnp.asarray(rng.standard_normal((LP * 2 * PS, Hkv, D)) * 0.1, jnp.bfloat16)
+
+    def kern_harness(num_steps):
+        def fn(q, s, pool):
+            def step(h, _):
+                def layer(hh, off):
+                    o = m1(hh, pool, off + ptj, pos0, PS)
+                    return (hh + 0.0001 * o).astype(hh.dtype), ()
+                h2, _ = jax.lax.scan(layer, h, offsets)
+                return h2, ()
+            qf, _ = jax.lax.scan(step, q * s, None, length=num_steps)
+            return qf
+        return jax.jit(fn)
+
+    import itertools
+    cnt = itertools.count()
+
+    def best_wall(jf, reps=4):
+        t0 = time.perf_counter()
+        np.asarray(jax.device_get(jf(q0, jnp.bfloat16(1.0), kvp)))
+        print(f"  compile+first: {time.perf_counter()-t0:.1f}s", flush=True)
+        best = float("inf")
+        for _ in range(reps):
+            s = jnp.bfloat16(1.0 + 0.0001 * next(cnt))
+            t0 = time.perf_counter()
+            np.asarray(jax.device_get(jf(q0, s, kvp)))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    tA = best_wall(kern_harness(8))
+    tB = best_wall(kern_harness(64))
+    print(f"m1: N8 {tA*1e3:.1f}ms N64 {tB*1e3:.1f}ms -> {(tB-tA)/56*1e3:6.3f} ms/step", flush=True)
+
+
+if __name__ == "__main__":
+    if sys.argv[1] == "parity":
+        parity()
+    elif sys.argv[1] == "m1":
+        perf_m1()
+    else:
+        perf(sys.argv[2])

@@ -1,0 +1,258 @@
+"""Prototype: fused-KV pool with K/V folded into the page-row axis
+([P, 2*ps, Hkv, D]) — DMA ranks stay identical to the proven split kernels.
+A/B on chip against the split perseq baseline in an in-situ-style harness.
+
+Usage: python tools/proto_fused2.py
+"""
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, ".")
+from dynamo_tpu.ops.pallas import paged_attention as pa
+
+_NEG_INF = -1e30
+
+B, PS, CTX, Hq, Hkv, D, L = 64, 128, 256, 16, 8, 128, 24
+PAGES = 224
+
+
+def _kernel_f2(
+    page_tables_ref, lengths_ref,
+    q_ref,      # [group, Hq, D]
+    kv_hbm,     # [P, 2*ps, Hkv, D]
+    out_ref,    # [group, Hq, D]
+    kv_scratch, # [2, group, C, 2*ps, Hkv, D]
+    sems,       # [2, group]
+    *, page_size: int, chunk: int, group: int,
+):
+    P = kv_hbm.shape[0]
+    g0 = pl.program_id(0) * group
+    Hq_, D_ = q_ref.shape[1], q_ref.shape[2]
+    Hkv_ = kv_hbm.shape[2]
+    G = Hq_ // Hkv_
+    C = chunk
+    N = C * page_size
+
+    lengths = [lengths_ref[g0 + j] for j in range(group)]
+    n_pages = [jnp.maximum(1, pl.cdiv(lengths[j], page_size)) for j in range(group)]
+    n_chunks = [pl.cdiv(n_pages[j], C) for j in range(group)]
+    max_chunks = n_chunks[0]
+    for j in range(1, group):
+        max_chunks = jnp.maximum(max_chunks, n_chunks[j])
+
+    qs = [q_ref[j].reshape(Hkv_, G, D_) for j in range(group)]
+    scale = 1.0 / jnp.sqrt(jnp.float32(D_))
+
+    def chunk_plan(j, c):
+        first = page_tables_ref[g0 + j, c * C]
+        ok = first + C <= P
+        for t in range(1, C):
+            idx = c * C + t
+            ok &= (idx >= n_pages[j]) | (page_tables_ref[g0 + j, idx] == first + t)
+        return first, ok
+
+    def sweep(slot, c, do):
+        for j in range(group):
+            @pl.when(c < n_chunks[j])
+            def _(j=j):
+                if C == 1:
+                    cp = pltpu.make_async_copy(
+                        kv_hbm.at[page_tables_ref[g0 + j, c]],
+                        kv_scratch.at[slot, j, 0],
+                        sems.at[slot, j],
+                    )
+                    cp.start() if do == "start" else cp.wait()
+                else:
+                    first, ok = chunk_plan(j, c)
+
+                    @pl.when(ok)
+                    def _():
+                        cp = pltpu.make_async_copy(
+                            kv_hbm.at[pl.ds(first, C)],
+                            kv_scratch.at[slot, j],
+                            sems.at[slot, j],
+                        )
+                        cp.start() if do == "start" else cp.wait()
+
+                    @pl.when(~ok)
+                    def _():
+                        for t in range(C):
+                            @pl.when(c * C + t < n_pages[j])
+                            def _(t=t):
+                                cp = pltpu.make_async_copy(
+                                    kv_hbm.at[page_tables_ref[g0 + j, c * C + t]],
+                                    kv_scratch.at[slot, j, t],
+                                    sems.at[slot, j],
+                                )
+                                cp.start() if do == "start" else cp.wait()
+
+    sweep(0, 0, "start")
+
+    def body(c, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(c, 2)
+        next_slot = jax.lax.rem(c + 1, 2)
+
+        @pl.when(c + 1 < max_chunks)
+        def _():
+            sweep(next_slot, c + 1, "start")
+
+        sweep(slot, c, "wait")
+
+        ps = page_size
+        idx = c * N + jax.lax.broadcasted_iota(jnp.int32, (1, 1, N), 2)
+        vidx = c * N + jax.lax.broadcasted_iota(jnp.int32, (1, N, 1), 1)
+        ms, ls, accs = [], [], []
+        for j in range(group):
+            blk = kv_scratch[slot, j]  # [C, 2ps, Hkv, D]
+            k = blk[:, :ps].reshape(N, Hkv_, D_)
+            v = blk[:, ps:].reshape(N, Hkv_, D_)
+            kt = jnp.transpose(k, (1, 0, 2))
+            vt = jnp.transpose(v, (1, 0, 2))
+            scores = jax.lax.dot_general(
+                qs[j], kt, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            scores = jnp.where(idx < lengths[j], scores, _NEG_INF)
+            vt_m = jnp.where(vidx < lengths[j], vt, 0)
+            chunk_max = jnp.max(scores, axis=-1)
+            new_m = jnp.maximum(m[j], chunk_max)
+            corr = jnp.exp(m[j] - new_m)
+            probs = jnp.exp(scores - new_m[..., None])
+            new_l = l[j] * corr + jnp.sum(probs, axis=-1)
+            chunk_out = jax.lax.dot_general(
+                probs.astype(kt.dtype), vt_m, (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            )
+            ms.append(new_m)
+            ls.append(new_l)
+            accs.append(acc[j] * corr[..., None] + chunk_out)
+        if group == 1:
+            return ms[0][None], ls[0][None], accs[0][None]
+        return jnp.stack(ms), jnp.stack(ls), jnp.stack(accs)
+
+    m0 = jnp.full((group, Hkv_, G), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((group, Hkv_, G), jnp.float32)
+    acc0 = jnp.zeros((group, Hkv_, G, D_), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, max_chunks, body, (m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-20)[..., None]
+    out_ref[...] = out.reshape(group, Hq_, D_).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "group", "chunk"))
+def fused2(q, kv_pages, page_tables, positions, interpret=False, group=1, chunk=1):
+    B_, Hq_, D_ = q.shape
+    P, ps2, Hkv_, _ = kv_pages.shape
+    ps = ps2 // 2
+    lengths = positions.astype(jnp.int32) + 1
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B_ // group,),
+        in_specs=[
+            pl.BlockSpec((group, Hq_, D_), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((group, Hq_, D_), lambda b, *_: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, group, chunk, ps2, Hkv_, D_), kv_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, group)),
+        ],
+    )
+    kernel = pl.pallas_call(
+        functools.partial(_kernel_f2, page_size=ps, chunk=chunk, group=group),
+        out_shape=jax.ShapeDtypeStruct((B_, Hq_, D_), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )
+    return kernel(page_tables.astype(jnp.int32), lengths, q, kv_pages)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    LP = L * PAGES
+    q0 = jnp.asarray(rng.standard_normal((B, Hq, D)) * 0.1, jnp.bfloat16)
+    pt = np.zeros((B, 8), np.int32)
+    nxt = 1
+    for b in range(B):
+        for i in range(3):
+            pt[b, i] = nxt
+            nxt += 1
+    ptj = jnp.asarray(pt)
+    offsets = jnp.arange(L, dtype=jnp.int32) * PAGES
+    pos0 = jnp.full(B, CTX - 1, jnp.int32)
+
+    # correctness first (interpret, CPU-friendly shapes reuse the chip shapes)
+    from dynamo_tpu.ops.attention import paged_decode_attention
+
+    kk = jnp.asarray(rng.standard_normal((40, PS, Hkv, D)) * 0.3, jnp.bfloat16)
+    vv = jnp.asarray(rng.standard_normal((40, PS, Hkv, D)) * 0.3, jnp.bfloat16)
+    kv2 = jnp.concatenate([kk, vv], axis=1)  # [P, 2ps, Hkv, D]
+    qq = jnp.asarray(rng.standard_normal((8, Hq, D)) * 0.3, jnp.bfloat16)
+    pts = np.zeros((8, 8), np.int32)
+    lens = rng.integers(1, PS * 6, 8)
+    for b in range(8):
+        n = -(-int(lens[b]) // PS)
+        if b % 2:
+            pts[b, :n] = 1 + b * 4 + np.arange(n)  # contiguous
+        else:
+            pts[b, :n] = rng.choice(np.arange(1, 40), n, replace=False)
+    posn = jnp.asarray(lens - 1, jnp.int32)
+    ref = paged_decode_attention(qq, kk, vv, jnp.asarray(pts), posn)
+    for g, c in [(1, 1), (1, 2), (2, 2), (1, 4)]:
+        out = fused2(qq, kv2, jnp.asarray(pts), posn, interpret=False, group=g, chunk=c)
+        err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32))))
+        print(f"parity g={g} c={c}: {err:.2e}", flush=True)
+
+    def harness(num_steps, g, c):
+        def fn(kvpool, q, pos):
+            def step(carry, _):
+                kvp, qq_, p = carry
+                def layer(carry2, off):
+                    kvp2, h = carry2
+                    phys = off + ptj[jnp.arange(B), p // PS]
+                    rows = h.reshape(B, Hq, D)[:, :Hkv] * 0.01
+                    kvp2 = kvp2.at[phys, p % PS].set(rows)
+                    kvp2 = kvp2.at[phys, PS + p % PS].set(rows)
+                    o = fused2(h, kvp2, off + ptj, p, group=g, chunk=c)
+                    return (kvp2, (h + 0.0001 * o).astype(h.dtype)), ()
+                (kvp, qq_), _ = jax.lax.scan(layer, (kvp, qq_), offsets)
+                return (kvp, qq_, p + 1), ()
+            (kvpool, q, pos), _ = jax.lax.scan(step, (kvpool, q, pos), None, length=num_steps)
+            return q, kvpool
+        return jax.jit(fn, donate_argnums=(0,))
+
+    def best_wall(fn, reps=4):
+        fn()
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    for g, c in [(1, 1), (1, 2), (2, 1), (2, 2), (1, 4)]:
+        st = {"kv": jnp.zeros((LP, 2 * PS, Hkv, D), jnp.bfloat16)}
+        try:
+            f8 = harness(8, g, c)
+            f64 = harness(64, g, c)
+            def run_f(jf):
+                out, st["kv"] = jf(st["kv"], q0, pos0)
+                return np.asarray(jax.device_get(out))
+            tA = best_wall(lambda: run_f(f8))
+            tB = best_wall(lambda: run_f(f64))
+            print(f"fused2 g={g} c={c}: {(tB-tA)/56*1e3:6.3f} ms/step", flush=True)
+        except Exception as e:
+            print(f"fused2 g={g} c={c}: FAILED {type(e).__name__} {str(e)[:150]}", flush=True)
+        del st
+
+
+if __name__ == "__main__":
+    main()
