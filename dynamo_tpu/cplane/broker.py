@@ -55,6 +55,10 @@ class _Lease:
     conn_id: int
     expires_at: float
     keys: set[str] = field(default_factory=set)
+    # client-minted ownership proof: required to re-adopt the lease id on a
+    # new connection (ids are broadcast to watchers; the id alone must not
+    # let a peer hijack another worker's endpoint identity)
+    secret: str = ""
 
 
 @dataclass
@@ -430,6 +434,8 @@ class Broker:
             self._lease_ids = itertools.count(max(lease_id + 1, nxt))
         existing = self._leases.get(lease_id)
         if existing is not None:
+            if existing.secret and msg.get("secret") != existing.secret:
+                raise ValueError(f"lease {lease_id} secret mismatch")
             # reattach after a reconnect: a lease id is an identity (it names
             # endpoint subjects/instances), so its owner re-adopts it on a new
             # connection. If an older connection still appears live, it is a
@@ -449,7 +455,8 @@ class Broker:
             conn.leases.add(lease_id)
             return {"lease_id": lease_id, "ttl": ttl}
         self._leases[lease_id] = _Lease(
-            lease_id=lease_id, ttl=ttl, conn_id=conn.conn_id, expires_at=time.monotonic() + ttl
+            lease_id=lease_id, ttl=ttl, conn_id=conn.conn_id,
+            expires_at=time.monotonic() + ttl, secret=msg.get("secret", ""),
         )
         conn.leases.add(lease_id)
         return {"lease_id": lease_id, "ttl": ttl}

@@ -66,10 +66,16 @@ class PrefixWatcher:
 
 
 class Lease:
-    def __init__(self, client: "CplaneClient", lease_id: int, ttl: float):
+    def __init__(self, client: "CplaneClient", lease_id: int, ttl: float,
+                 secret: str = ""):
+        import secrets as _secrets
+
         self.client = client
         self.lease_id = lease_id
         self.ttl = ttl
+        # ownership proof for re-adoption: lease ids are broadcast to every
+        # watcher, so the bare id must not be enough to hijack the lease
+        self.secret = secret or _secrets.token_hex(16)
         self._task: Optional[asyncio.Task] = None
         self.on_expired: Optional[Callable[[], None]] = None
 
@@ -92,7 +98,8 @@ class Lease:
                         # names endpoint subjects — and re-register owners
                         try:
                             await self.client._request(
-                                {"op": "lease_create", "ttl": self.ttl, "lease_id": self.lease_id}
+                                {"op": "lease_create", "ttl": self.ttl,
+                                 "lease_id": self.lease_id, "secret": self.secret}
                             )
                             for hook in list(self.client.reconnect_hooks):
                                 await hook()
@@ -238,17 +245,25 @@ class CplaneClient:
         if self._closed:
             return
         self._reader_task = asyncio.create_task(self._read_loop())
-        self._up.set()
+        # NOTE: _up stays CLEAR until the replay below (lease re-adoption,
+        # resubscribe, watch resync) finishes — otherwise a lease-attached op
+        # racing the replay (kv_put with lease_id, queue ack) can reach the
+        # broker before its lease exists again and fail with "lease not
+        # found" even though the outage heals moments later. The replay's own
+        # _request calls bypass the park (the socket is live already).
         try:
             for lease in list(self._leases.values()):
                 await self._request(
-                    {"op": "lease_create", "ttl": lease.ttl, "lease_id": lease.lease_id}
+                    {"op": "lease_create", "ttl": lease.ttl,
+                     "lease_id": lease.lease_id, "secret": lease.secret},
+                    _replay=True,
                 )
             for subject in list(self._sub_handlers):
-                await self._request({"op": "subscribe", "subject": subject})
+                await self._request({"op": "subscribe", "subject": subject}, _replay=True)
             for watch_id, prefix in list(self._watch_prefixes.items()):
                 r = await self._request(
-                    {"op": "watch", "watch_id": watch_id, "prefix": prefix}
+                    {"op": "watch", "watch_id": watch_id, "prefix": prefix},
+                    _replay=True,
                 )
                 q = self._watch_queues.get(watch_id)
                 if q is None:
@@ -263,6 +278,8 @@ class CplaneClient:
                                    lease_id=item["lease_id"])
                     )
                 self._watch_seen[watch_id] = set(now)
+            # replay done: release parked requests (hooks below may _request)
+            self._up.set()
             for hook in list(self.reconnect_hooks):
                 await hook()
             self._heal_deadline = None  # fully healed: next outage gets a fresh window
@@ -308,8 +325,8 @@ class CplaneClient:
             if handler is not None:
                 handler(msg)
 
-    async def _request(self, msg: dict) -> dict:
-        if self._up is not None and not self._up.is_set() and not self._closed:
+    async def _request(self, msg: dict, _replay: bool = False) -> dict:
+        if not _replay and self._up is not None and not self._up.is_set() and not self._closed:
             # connection is healing: park briefly instead of failing fast
             try:
                 await asyncio.wait_for(self._up.wait(), self.reconnect_window)
@@ -371,8 +388,11 @@ class CplaneClient:
     # ------------- leases -------------
 
     async def lease_create(self, ttl: float = 10.0) -> Lease:
-        r = await self._request({"op": "lease_create", "ttl": ttl})
-        lease = Lease(self, r["lease_id"], r["ttl"])
+        import secrets as _secrets
+
+        secret = _secrets.token_hex(16)
+        r = await self._request({"op": "lease_create", "ttl": ttl, "secret": secret})
+        lease = Lease(self, r["lease_id"], r["ttl"], secret=secret)
         self._leases[lease.lease_id] = lease
         lease.start_keepalive()
         return lease

@@ -30,6 +30,8 @@ class ClusterApi(Protocol):
 
     async def list_objects(self, namespace: str) -> list[dict]: ...
 
+    async def list_managed_namespaces(self) -> set[str]: ...
+
     async def apply(self, obj: dict) -> None: ...
 
     async def delete(self, kind: str, namespace: str, name: str) -> None: ...
@@ -59,6 +61,15 @@ class FakeCluster:
             for (kind, ns, _), o in self.objects.items()
             if ns == namespace
         ]
+
+    async def list_managed_namespaces(self) -> set[str]:
+        return {
+            ns
+            for (_, ns, _), o in self.objects.items()
+            if o.get("metadata", {}).get("labels", {}).get(
+                "app.kubernetes.io/managed-by"
+            ) == MANAGED_BY
+        }
 
     async def apply(self, obj: dict) -> None:
         import copy
@@ -99,6 +110,19 @@ class KubectlCluster:
             "-o", "json",
         )
         return json.loads(out).get("items", [])
+
+    async def list_managed_namespaces(self) -> set[str]:
+        import json
+
+        out = await self._run(
+            "get", "deployments,statefulsets,services,horizontalpodautoscalers",
+            "--all-namespaces", "-l", f"app.kubernetes.io/managed-by={MANAGED_BY}",
+            "-o", "json",
+        )
+        return {
+            i.get("metadata", {}).get("namespace", "default")
+            for i in json.loads(out).get("items", [])
+        }
 
     async def apply(self, obj: dict) -> None:
         import json
@@ -192,8 +216,14 @@ class DeployController:
         # garbage-collect by OWNERSHIP LABELS, not in-process memory: any
         # managed object whose part-of deployment is absent from the store is
         # an orphan — this also catches deployments deleted while the
-        # controller was down (a restarted controller's _managed starts empty).
+        # controller was down (a restarted controller's _managed starts
+        # empty), in ANY namespace: the cluster-wide label-selector listing
+        # finds managed namespaces no store head or in-process state names.
         sweep_namespaces = set(self._managed.values()) | {"default"}
+        try:
+            sweep_namespaces |= await self.cluster.list_managed_namespaces()
+        except (AttributeError, NotImplementedError):
+            pass  # minimal ClusterApi impls: store/in-process sweep only
         for name in list(self._managed):
             if name not in names:
                 del self._managed[name]

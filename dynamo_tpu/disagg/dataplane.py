@@ -48,9 +48,14 @@ class KvDataPlaneServer:
         self.port: Optional[int] = None
         self._server: Optional[asyncio.AbstractServer] = None
         self._expected: dict[str, asyncio.Future] = {}
+        # per-request nonces: a payload must carry the token expect() minted
+        # (travels to the prefill side inside RemotePrefillRequest), so a
+        # peer that guesses an in-flight request_id can't poison the cache
+        self._tokens: dict[str, str] = {}
         self._writers: set[asyncio.StreamWriter] = set()
         self.received = 0
         self.dropped = 0
+        self.rejected = 0  # bad/missing token
 
     @property
     def address(self) -> str:
@@ -88,11 +93,16 @@ class KvDataPlaneServer:
 
     # ---------------- consumer API ----------------
 
-    def expect(self, request_id: str) -> None:
+    def expect(self, request_id: str) -> str:
         """Register interest BEFORE the remote prefill is requested, so an
-        early-arriving payload parks instead of being dropped."""
+        early-arriving payload parks instead of being dropped. Returns the
+        per-request nonce the sender must echo in its payload header."""
         if request_id not in self._expected:
+            import secrets
+
             self._expected[request_id] = asyncio.get_running_loop().create_future()
+            self._tokens[request_id] = secrets.token_hex(16)
+        return self._tokens[request_id]
 
     async def receive(self, request_id: str, timeout: float = 120.0) -> np.ndarray:
         fut = self._expected.get(request_id)
@@ -102,10 +112,12 @@ class KvDataPlaneServer:
             return await asyncio.wait_for(fut, timeout)
         finally:
             self._expected.pop(request_id, None)
+            self._tokens.pop(request_id, None)
 
     def abandon(self, request_id: str) -> None:
         """Cancellation: stop waiting; a late payload is received and dropped."""
         fut = self._expected.pop(request_id, None)
+        self._tokens.pop(request_id, None)
         if fut is not None and not fut.done():
             fut.cancel()
 
@@ -131,7 +143,13 @@ class KvDataPlaneServer:
                     raise ValueError("kv payload checksum mismatch")
                 rid = header["request_id"]
                 fut = self._expected.get(rid)
-                if fut is not None and not fut.done():
+                want = self._tokens.get(rid)
+                if fut is not None and want is not None and header.get("token") != want:
+                    # wrong/missing nonce: never fulfil the future from an
+                    # unauthenticated peer (checksum is sender-supplied)
+                    self.rejected += 1
+                    log.warning("rejecting kv payload with bad token for %s", rid)
+                elif fut is not None and not fut.done():
                     fut.set_result(np.frombuffer(payload, dtype).reshape(shape))
                     self.received += 1
                 else:
@@ -154,7 +172,9 @@ class KvDataPlaneClient:
         self._locks: dict[str, asyncio.Lock] = {}
         self.sent = 0
 
-    async def send(self, address: str, request_id: str, array: np.ndarray) -> None:
+    async def send(
+        self, address: str, request_id: str, array: np.ndarray, token: str = ""
+    ) -> None:
         lock = self._locks.setdefault(address, asyncio.Lock())
         async with lock:  # one in-flight transfer per destination connection
             # zero-copy payload: write a memoryview of the contiguous array
@@ -168,6 +188,7 @@ class KvDataPlaneClient:
                     "shape": list(array.shape),
                     "dtype": str(array.dtype),
                     "xxh3": xxhash.xxh3_64_intdigest(payload),
+                    "token": token,
                 }
             )
             for attempt in (0, 1):  # one reconnect on a stale pooled socket

@@ -161,7 +161,7 @@ class DisaggDecodeEngine:
         self._pending[rid] = fut
         # register interest on the data plane BEFORE the work is queued, so a
         # fast prefill worker's payload parks instead of being dropped
-        self.kv_server.expect(rid)
+        kv_token = self.kv_server.expect(rid)
         self.engine._register_stream(rid)
         adopted = False
         try:
@@ -181,16 +181,21 @@ class DisaggDecodeEngine:
                 decode_endpoint=f"dyn://{self.namespace}.{self.component}.{PREFILL_RESULT_ENDPOINT}",
                 skip_leading_tokens=shared_pages * self.engine.config.page_size,
                 kv_addr=self.kv_server.address,
+                kv_token=kv_token,
             )
             await self.drt.cplane.queue_push(self.queue_name, rp.to_wire())
+            # one deadline covers BOTH waits (result notification + socket
+            # payload): charging each a full timeout would double the
+            # worst-case stall when the payload connection dies right after
+            # the notification was delivered
+            deadline = asyncio.get_running_loop().time() + self.remote_prefill_timeout
             result: PrefillResult = await asyncio.wait_for(fut, self.remote_prefill_timeout)
             kv_data = None
             if result.kv_mode == "socket" and result.kv_shape:
                 # the result message is the notification; the payload rides
                 # the dedicated socket and may land just after it
-                kv_data = await self.kv_server.receive(
-                    rid, timeout=self.remote_prefill_timeout
-                )
+                remaining = max(0.05, deadline - asyncio.get_running_loop().time())
+                kv_data = await self.kv_server.receive(rid, timeout=remaining)
             await self.engine.run_on_engine(
                 lambda: self.engine.sync_adopt_prefilled(
                     request, result, cached_len, kv_data=kv_data

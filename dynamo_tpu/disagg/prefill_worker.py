@@ -135,7 +135,9 @@ class PrefillWorker:
                 # here (-> nack + redelivery) instead of stranding the decode
                 # side in a full receive() timeout after a notification whose
                 # payload will never arrive
-                await self.kv_client.send(rp.kv_addr, rp.request_id, host_data)
+                await self.kv_client.send(
+                    rp.kv_addr, rp.request_id, host_data, token=rp.kv_token
+                )
             ok = await deliver()
             if not ok:
                 return

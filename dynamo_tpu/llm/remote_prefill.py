@@ -34,6 +34,10 @@ class RemotePrefillRequest:
     # rides this socket (disagg/dataplane.py) instead of the control-plane
     # result message — the NIXL RDMA-WRITE analogue. Empty = legacy inline.
     kv_addr: str = ""
+    # per-request data-plane nonce minted by the decode side's expect(): the
+    # KV server only accepts a payload carrying it, so a network peer that
+    # merely learns a request_id cannot inject KV into the decode cache
+    kv_token: str = ""
 
     def to_wire(self) -> dict:
         return self.__dict__.copy()

@@ -146,3 +146,47 @@ def test_client_gives_up_when_broker_stays_dead():
         await c.close()
 
     asyncio.new_event_loop().run_until_complete(asyncio.wait_for(body(), 30))
+
+def test_lease_readoption_requires_secret():
+    """Lease ids are broadcast to every watcher, so re-adopting one must
+    require the owner's secret — a peer that only knows the id can neither
+    hijack the lease nor force-close the owner's connection (ADVICE r2)."""
+    import asyncio
+
+    from dynamo_tpu.cplane.broker import Broker
+    from dynamo_tpu.cplane.client import CplaneClient
+
+    async def run():
+        broker = Broker()
+        port = await broker.start()
+        owner = await CplaneClient(f"127.0.0.1:{port}").connect()
+        attacker = await CplaneClient(f"127.0.0.1:{port}").connect()
+        try:
+            lease = await owner.lease_create(ttl=5.0)
+            await owner.kv_put("k/own", b"v", lease_id=lease.lease_id)
+
+            # hijack attempt: correct id, wrong secret
+            try:
+                await attacker._request({
+                    "op": "lease_create", "ttl": 5.0,
+                    "lease_id": lease.lease_id, "secret": "not-the-secret",
+                })
+                raise AssertionError("hijack with wrong secret succeeded")
+            except Exception as e:
+                assert "secret" in str(e)
+
+            # owner's lease and key are untouched, connection still live
+            r = await owner._request({"op": "kv_get", "key": "k/own"})
+            assert r["found"]
+
+            # the owner itself re-adopts fine (its secret travels along)
+            await owner._request({
+                "op": "lease_create", "ttl": 5.0,
+                "lease_id": lease.lease_id, "secret": lease.secret,
+            })
+        finally:
+            await owner.close()
+            await attacker.close()
+            await broker.stop()
+
+    asyncio.run(run())

@@ -432,3 +432,34 @@ def test_controller_restart_gcs_deployments_deleted_while_down():
         assert any(k[2].startswith("other-dep") for k in cluster.objects)
 
     asyncio.new_event_loop().run_until_complete(run())
+
+
+def test_restart_gc_sweeps_foreign_namespaces():
+    """A deployment in a NON-default namespace deleted while the controller
+    was down must still be garbage-collected after restart: the cluster-wide
+    managed-by label listing discovers its namespace even though no store
+    head or in-process state names it (ADVICE r2)."""
+    from dynamo_tpu.deploy.controller import DeployController, FakeCluster, MANAGED_BY
+    from dynamo_tpu.deploy.api_server import DeploymentStore
+
+    async def run():
+        cluster = FakeCluster()
+        # orphan left behind in namespace "prod" by a dead deployment
+        cluster.objects[("Deployment", "prod", "ghost-worker")] = {
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {
+                "name": "ghost-worker", "namespace": "prod",
+                "labels": {
+                    "app.kubernetes.io/managed-by": MANAGED_BY,
+                    "app.kubernetes.io/part-of": "ghost",
+                },
+            },
+            "spec": {"replicas": 1},
+        }
+        # fresh controller: empty store, empty in-process state
+        ctrl = DeployController(DeploymentStore(), cluster, interval=3600)
+        await ctrl.converge_once()
+        assert ("Deployment", "prod", "ghost-worker") not in cluster.objects
+        assert ("Deployment", "prod", "ghost-worker") in cluster.deleted
+
+    asyncio.run(run())
