@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import hmac
 import itertools
 import time
 from collections import defaultdict, deque
@@ -434,7 +435,9 @@ class Broker:
             self._lease_ids = itertools.count(max(lease_id + 1, nxt))
         existing = self._leases.get(lease_id)
         if existing is not None:
-            if existing.secret and msg.get("secret") != existing.secret:
+            if existing.secret and not hmac.compare_digest(
+                str(msg.get("secret", "")), existing.secret
+            ):
                 raise ValueError(f"lease {lease_id} secret mismatch")
             # reattach after a reconnect: a lease id is an identity (it names
             # endpoint subjects/instances), so its owner re-adopts it on a new
@@ -461,14 +464,39 @@ class Broker:
         conn.leases.add(lease_id)
         return {"lease_id": lease_id, "ttl": ttl}
 
+    def _check_lease_owner(self, conn: _Conn, lease: _Lease, msg: dict) -> None:
+        # lease ids are broadcast to every watcher, so the bare id must not be
+        # enough to keep a dead worker's lease alive (stale endpoint pinned
+        # forever) or to revoke a live worker's lease (its keys deleted). The
+        # owner proves itself with the create-time secret, or by speaking on
+        # the connection the lease is attached to.
+        if lease.conn_id == conn.conn_id:
+            return
+        if lease.secret and hmac.compare_digest(
+            str(msg.get("secret", "")), lease.secret
+        ):
+            # the owner moved to a new connection: rebind, or the stale
+            # conn's eventual teardown would expire a live owner's lease
+            old = self._conns.get(lease.conn_id)
+            if old is not None:
+                old.leases.discard(lease.lease_id)
+            lease.conn_id = conn.conn_id
+            conn.leases.add(lease.lease_id)
+            return
+        raise ValueError(f"lease {lease.lease_id} not owned by caller")
+
     def _op_lease_keepalive(self, conn: _Conn, msg: dict) -> dict:
         lease = self._leases.get(msg["lease_id"])
         if lease is None:
             raise ValueError("lease expired")
+        self._check_lease_owner(conn, lease, msg)
         lease.expires_at = time.monotonic() + lease.ttl
         return {"ttl": lease.ttl}
 
     def _op_lease_revoke(self, conn: _Conn, msg: dict) -> dict:
+        lease = self._leases.get(msg["lease_id"])
+        if lease is not None:
+            self._check_lease_owner(conn, lease, msg)
         self._expire_lease(msg["lease_id"], reason="revoked")
         return {}
 

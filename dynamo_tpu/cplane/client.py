@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import secrets
 import uuid
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Callable, Optional
@@ -67,15 +68,15 @@ class PrefixWatcher:
 
 class Lease:
     def __init__(self, client: "CplaneClient", lease_id: int, ttl: float,
-                 secret: str = ""):
-        import secrets as _secrets
-
+                 secret: str):
         self.client = client
         self.lease_id = lease_id
         self.ttl = ttl
-        # ownership proof for re-adoption: lease ids are broadcast to every
-        # watcher, so the bare id must not be enough to hijack the lease
-        self.secret = secret or _secrets.token_hex(16)
+        # ownership proof for re-adoption and keepalive/revoke: lease ids are
+        # broadcast to every watcher, so the bare id must not be enough to
+        # hijack the lease. Minted once, in CplaneClient.lease_create — the
+        # broker must see the same secret the Lease object carries.
+        self.secret = secret
         self._task: Optional[asyncio.Task] = None
         self.on_expired: Optional[Callable[[], None]] = None
 
@@ -89,7 +90,10 @@ class Lease:
             while True:
                 await asyncio.sleep(interval)
                 try:
-                    await self.client._request({"op": "lease_keepalive", "lease_id": self.lease_id})
+                    await self.client._request(
+                        {"op": "lease_keepalive", "lease_id": self.lease_id,
+                         "secret": self.secret}
+                    )
                     failures_since = None
                 except Exception as e:
                     if isinstance(e, RuntimeError) and "expired" in str(e):
@@ -130,7 +134,10 @@ class Lease:
             self._task.cancel()
         self.client._leases.pop(self.lease_id, None)
         try:
-            await self.client._request({"op": "lease_revoke", "lease_id": self.lease_id})
+            await self.client._request(
+                {"op": "lease_revoke", "lease_id": self.lease_id,
+                 "secret": self.secret}
+            )
         except Exception:
             pass
 
@@ -388,9 +395,7 @@ class CplaneClient:
     # ------------- leases -------------
 
     async def lease_create(self, ttl: float = 10.0) -> Lease:
-        import secrets as _secrets
-
-        secret = _secrets.token_hex(16)
+        secret = secrets.token_hex(16)
         r = await self._request({"op": "lease_create", "ttl": ttl, "secret": secret})
         lease = Lease(self, r["lease_id"], r["ttl"], secret=secret)
         self._leases[lease.lease_id] = lease

@@ -220,10 +220,17 @@ class DeployController:
         # empty), in ANY namespace: the cluster-wide label-selector listing
         # finds managed namespaces no store head or in-process state names.
         sweep_namespaces = set(self._managed.values()) | {"default"}
-        try:
-            sweep_namespaces |= await self.cluster.list_managed_namespaces()
-        except (AttributeError, NotImplementedError):
-            pass  # minimal ClusterApi impls: store/in-process sweep only
+        # minimal ClusterApi impls may not expose a cluster-wide listing:
+        # detect absence with getattr so an AttributeError raised INSIDE a
+        # real implementation (bad kubectl JSON, etc.) isn't silently eaten
+        list_managed = getattr(self.cluster, "list_managed_namespaces", None)
+        if list_managed is not None:
+            try:
+                sweep_namespaces |= await list_managed()
+            except NotImplementedError:
+                pass  # explicit opt-out: store/in-process sweep only
+            except Exception:
+                log.exception("list_managed_namespaces failed; skipping cluster-wide orphan sweep")
         for name in list(self._managed):
             if name not in names:
                 del self._managed[name]

@@ -146,7 +146,10 @@ class KvDataPlaneServer:
                 want = self._tokens.get(rid)
                 if fut is not None and want is not None and header.get("token") != want:
                     # wrong/missing nonce: never fulfil the future from an
-                    # unauthenticated peer (checksum is sender-supplied)
+                    # unauthenticated peer (checksum is sender-supplied).
+                    # Enforcement is unconditional: tokenless senders (pre-nonce
+                    # peers) are rejected — both sides of a disagg pair must run
+                    # the same protocol version (no mixed-version rollout)
                     self.rejected += 1
                     log.warning("rejecting kv payload with bad token for %s", rid)
                 elif fut is not None and not fut.done():

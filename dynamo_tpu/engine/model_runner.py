@@ -383,6 +383,12 @@ class ModelRunner:
                 and not sampling.ignore_eos
             )
             if want_eos:
+                if len(eos_ids) > MAX_EOS_IDS:
+                    log.warning(
+                        "min_tokens: %d EOS ids exceed the device limit %d; ids "
+                        "beyond the limit are not suppressed",
+                        len(eos_ids), MAX_EOS_IDS,
+                    )
                 ids = np.asarray(eos_ids, np.int32)[:MAX_EOS_IDS]
                 ints[j, bucket + mp + 5 : bucket + mp + 5 + len(ids)] = ids
             flts[0, j] = sampling.temperature

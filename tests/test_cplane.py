@@ -110,6 +110,48 @@ def test_lease_ttl_expiry():
     run(with_broker(body))
 
 
+def test_lease_hijack_rejected():
+    """A peer that learned a lease id (they're broadcast to every watcher)
+    must not be able to revoke it or keep it alive — only the owning
+    connection or the holder of the create-time secret may."""
+
+    async def body(client):
+        owner, attacker = await client(), await client()
+        lease = await owner.lease_create(ttl=5.0)
+        await owner.kv_put("sec/ep:1", b"me", lease_id=lease.lease_id)
+
+        # bare-id revoke from another connection: rejected, key survives
+        with pytest.raises(Exception, match="not owned"):
+            await attacker._request(
+                {"op": "lease_revoke", "lease_id": lease.lease_id}
+            )
+        assert await attacker.kv_get("sec/ep:1") == b"me"
+
+        # bare-id keepalive from another connection: rejected too
+        with pytest.raises(Exception, match="not owned"):
+            await attacker._request(
+                {"op": "lease_keepalive", "lease_id": lease.lease_id}
+            )
+
+        # a keepalive carrying the create-time secret from a NEW connection is
+        # the owner moving: accepted, and the lease rebinds to that connection
+        await attacker._request(
+            {"op": "lease_keepalive", "lease_id": lease.lease_id,
+             "secret": lease.secret}
+        )
+        # rebind back to the owner connection (same secret path)
+        await owner._request(
+            {"op": "lease_keepalive", "lease_id": lease.lease_id,
+             "secret": lease.secret}
+        )
+
+        # the owner itself can still revoke (owning conn, secret attached)
+        await lease.revoke()
+        assert await attacker.kv_get("sec/ep:1") is None
+
+    run(with_broker(body))
+
+
 def test_pubsub_and_request_reply():
     async def body(client):
         c1, c2 = await client(), await client()
