@@ -182,6 +182,8 @@ async def run_config(
 
     rng = np.random.default_rng(0)
     prompts = [rng.integers(1, vocab, prompt_len).tolist() for _ in range(batch)]
+    best = None
+    round_tok_s = []
 
     async def one(i: int, warmup: bool, rnd: int = 0):
         req = EngineRequest(
@@ -203,32 +205,34 @@ async def run_config(
                 n += 1
         return n, ttft
 
-    # warmup: compile prefill buckets + decode, then one full-length pass so
-    # the page allocator reaches its steady-state churn pattern (the first
-    # measured round otherwise under-reports while the pool fills/evicts)
-    await asyncio.gather(*[one(i, warmup=True) for i in range(batch)])
-    for i in range(batch):
-        prompts[i] = rng.integers(1, vocab, prompt_len).tolist()
-    await asyncio.gather(*[one(i, warmup=False, rnd=99) for i in range(batch)])
-
-    # best of N measured rounds (fresh prompts each round so the prefix cache
-    # never helps): the tunneled PJRT link adds multi-ms jitter per round
-    # trip, so a single round under-reports sustained throughput
-    best = None
-    round_tok_s = []
-    for rnd in range(rounds):
+    try:
+        # warmup: compile prefill buckets + decode, then one full-length pass
+        # so the page allocator reaches its steady-state churn pattern (the
+        # first measured round otherwise under-reports while the pool
+        # fills/evicts)
+        await asyncio.gather(*[one(i, warmup=True) for i in range(batch)])
         for i in range(batch):
             prompts[i] = rng.integers(1, vocab, prompt_len).tolist()
-        t0 = time.monotonic()
-        results = await asyncio.gather(*[one(i, warmup=False, rnd=rnd) for i in range(batch)])
-        elapsed = time.monotonic() - t0
-        total_tokens = sum(n for n, _ in results)
-        ttfts = [t for _, t in results if t is not None]
-        round_tok_s.append(round(total_tokens / elapsed, 2))
-        if best is None or total_tokens / elapsed > best[0]:
-            best = (total_tokens / elapsed, total_tokens, elapsed, ttfts)
+        await asyncio.gather(*[one(i, warmup=False, rnd=99) for i in range(batch)])
 
-    await engine.shutdown()
+        # best of N measured rounds (fresh prompts each round so the prefix
+        # cache never helps): the tunneled PJRT link adds multi-ms jitter per
+        # round trip, so a single round under-reports sustained throughput
+        for rnd in range(rounds):
+            for i in range(batch):
+                prompts[i] = rng.integers(1, vocab, prompt_len).tolist()
+            t0 = time.monotonic()
+            results = await asyncio.gather(*[one(i, warmup=False, rnd=rnd) for i in range(batch)])
+            elapsed = time.monotonic() - t0
+            total_tokens = sum(n for n, _ in results)
+            ttfts = [t for _, t in results if t is not None]
+            round_tok_s.append(round(total_tokens / elapsed, 2))
+            if best is None or total_tokens / elapsed > best[0]:
+                best = (total_tokens / elapsed, total_tokens, elapsed, ttfts)
+    finally:
+        # a cancelled/timed-out section must still release the engine (HBM,
+        # device buffers) before the next section starts its own
+        await engine.shutdown()
     tok_s, total_tokens, elapsed, ttfts = best
     return {
         "tok_s": round(tok_s, 2),
@@ -292,35 +296,42 @@ async def run_routing_parity(n_workers=2, sessions=4, turns=3) -> dict:
     async def workload(kv_aware: bool):
         indexer = KvIndexer(kv_block_size=64)
         engines = []
-        for i in range(n_workers):
-            sink = (lambda wid: (
-                lambda ev: indexer.apply_event(RouterEvent(worker_id=wid, event=ev))
-            ))(i)
-            eng = AsyncJaxEngine(_parity_config(), kv_event_sink=sink)
-            await eng.start()
-            engines.append(eng)
-        rng = random.Random(7)
-        rr = np.random.default_rng(3)
-        hist = {s: rr.integers(1, 31000, 1536).tolist() for s in range(sessions)}
-        for s in range(sessions):
-            await _request(engines[s % n_workers], f"seed{kv_aware}-{s}", hist[s])
-        ttfts, recompute = [], 0
-        for t in range(turns):
+        try:
+            for i in range(n_workers):
+                sink = (lambda wid: (
+                    lambda ev: indexer.apply_event(RouterEvent(worker_id=wid, event=ev))
+                ))(i)
+                eng = AsyncJaxEngine(_parity_config(), kv_event_sink=sink)
+                await eng.start()
+                engines.append(eng)
+            rng = random.Random(7)
+            rr = np.random.default_rng(3)
+            hist = {s: rr.integers(1, 31000, 1536).tolist() for s in range(sessions)}
             for s in range(sessions):
-                prompt = hist[s]
-                if kv_aware:
-                    scores = indexer.find_matches_for_request(prompt).scores
-                    wid = max(scores, key=scores.get) if scores else rng.randrange(n_workers)
-                else:
-                    wid = rng.randrange(n_workers)
-                toks, ttft, cached = await _request(engines[wid], f"{kv_aware}r{t}-{s}", prompt)
-                ttfts.append(ttft)
-                recompute += len(prompt) - cached
-                hist[s] = (prompt + toks + [11 + t])[:2048]
-        for e in engines:
-            await e.shutdown()
-        engines.clear()
-        gc.collect()
+                await _request(engines[s % n_workers], f"seed{kv_aware}-{s}", hist[s])
+            ttfts, recompute = [], 0
+            for t in range(turns):
+                for s in range(sessions):
+                    prompt = hist[s]
+                    if kv_aware:
+                        scores = indexer.find_matches_for_request(prompt).scores
+                        wid = max(scores, key=scores.get) if scores else rng.randrange(n_workers)
+                    else:
+                        wid = rng.randrange(n_workers)
+                    toks, ttft, cached = await _request(engines[wid], f"{kv_aware}r{t}-{s}", prompt)
+                    ttfts.append(ttft)
+                    recompute += len(prompt) - cached
+                    hist[s] = (prompt + toks + [11 + t])[:2048]
+        finally:
+            for e in engines:
+                try:
+                    await e.shutdown()
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc()
+            engines.clear()
+            gc.collect()
         return float(np.median(ttfts)), recompute
 
     t_kv, rc_kv = await workload(True)
@@ -354,19 +365,21 @@ async def run_offload_parity(sessions=3, plen=512) -> dict:
             prefill_buckets=(512,), host_cache_blocks=host_blocks,
         ))
         await eng.start()
-        rr = np.random.default_rng(5)
-        prompts = {s: rr.integers(1, 31000, plen).tolist() for s in range(sessions)}
-        for s in range(sessions):
-            await _request(eng, f"h{host_blocks}-v1-{s}", prompts[s])
-        ttfts, cacheds = [], []
-        for s in range(sessions):
-            _, ttft, cached = await _request(eng, f"h{host_blocks}-v2-{s}", prompts[s])
-            ttfts.append(ttft)
-            cacheds.append(cached)
-        loads = eng.offload.loads if eng.offload else 0
-        await eng.shutdown()
-        del eng
-        gc.collect()
+        try:
+            rr = np.random.default_rng(5)
+            prompts = {s: rr.integers(1, 31000, plen).tolist() for s in range(sessions)}
+            for s in range(sessions):
+                await _request(eng, f"h{host_blocks}-v1-{s}", prompts[s])
+            ttfts, cacheds = [], []
+            for s in range(sessions):
+                _, ttft, cached = await _request(eng, f"h{host_blocks}-v2-{s}", prompts[s])
+                ttfts.append(ttft)
+                cacheds.append(cached)
+            loads = eng.offload.loads if eng.offload else 0
+        finally:
+            await eng.shutdown()
+            del eng
+            gc.collect()
         return float(np.median(ttfts)), int(np.sum(cacheds)), loads
 
     t_on, cached_on, loads = await workload(256)
@@ -467,74 +480,90 @@ async def run_disagg_parity(
     # ---- aggregated: one engine, continuous traffic ----
     agg = AsyncJaxEngine(decode_cfg)
     await agg.start()
-    # warmup: compile prefill buckets + window variants
-    await _request(agg, "warm-agg", warm_prompt, max_tokens=4)
-    agg_res = await continuous(agg, "agg")
+    try:
+        # warmup: compile prefill buckets + window variants
+        await _request(agg, "warm-agg", warm_prompt, max_tokens=4)
+        agg_res = await continuous(agg, "agg")
 
-    # ---- component costs on the same engine/executables ----
-    # Wp: M concurrent fresh 1-token requests; the chip serializes their
-    # prefill chunks, so wall/M ~ per-request prefill chip-time (the ~0.1 s
-    # dispatch RTT amortizes over M)
-    t0 = _time.monotonic()
-    await asyncio.gather(*[
-        _request(agg, f"wp-{j}", wp_prompts[j], max_tokens=1)
-        for j in range(M)
-    ])
-    wp = (_time.monotonic() - t0) / M
-    # cd: decode chip-time per request. Round 1 on fresh prompts warms the
-    # prefix cache; round 2 re-sends the SAME prompts, so its prefill is a
-    # cache hit (last token only) and the round is pure batched decode.
-    await asyncio.gather(*[
-        _request(agg, f"cdw-{j}", cd_prompts[j], max_tokens=osl)
-        for j in range(batch)
-    ])
-    t0 = _time.monotonic()
-    res2 = await asyncio.gather(*[
-        _request(agg, f"cd-{j}", cd_prompts[j], max_tokens=osl)
-        for j in range(batch)
-    ])
-    cd = (_time.monotonic() - t0) / batch
-    cache_hits = sum(c for _, _, c in res2)
-    await agg.shutdown()
-    del agg
-    gc.collect()
+        # ---- component costs on the same engine/executables ----
+        # Wp: M concurrent fresh 1-token requests; the chip serializes their
+        # prefill chunks, so wall/M ~ per-request prefill chip-time (the
+        # ~0.1 s dispatch RTT amortizes over M)
+        t0 = _time.monotonic()
+        await asyncio.gather(*[
+            _request(agg, f"wp-{j}", wp_prompts[j], max_tokens=1)
+            for j in range(M)
+        ])
+        wp = (_time.monotonic() - t0) / M
+        # cd: decode chip-time per request. Round 1 on fresh prompts warms the
+        # prefix cache; round 2 re-sends the SAME prompts, so its prefill is a
+        # cache hit (last token only) and the round is pure batched decode.
+        await asyncio.gather(*[
+            _request(agg, f"cdw-{j}", cd_prompts[j], max_tokens=osl)
+            for j in range(batch)
+        ])
+        t0 = _time.monotonic()
+        res2 = await asyncio.gather(*[
+            _request(agg, f"cd-{j}", cd_prompts[j], max_tokens=osl)
+            for j in range(batch)
+        ])
+        cd = (_time.monotonic() - t0) / batch
+        cache_hits = sum(c for _, _, c in res2)
+    finally:
+        await agg.shutdown()
+        del agg
+        gc.collect()
 
     # ---- real two-worker disagg on the one chip ----
-    broker = Broker()
-    port = await broker.start()
-    addr = f"127.0.0.1:{port}"
-    decode_rt = DistributedRuntime(cplane_address=addr)
-    await decode_rt.connect()
-    prefill_rt = DistributedRuntime(cplane_address=addr)
-    await prefill_rt.connect()
-    decode_inner = AsyncJaxEngine(decode_cfg)
-    await decode_inner.start()
-    prefill_engine = AsyncJaxEngine(_parity_config(
-        page_size=page_size, max_seqs=4, max_model_len=4096,
-        num_pages=6 * pages_per_seq + 8,
-        prefill_buckets=(512, 1024), decode_steps=8, pipeline_depth=2,
-    ))
-    await prefill_engine.start()
-    router = DisaggregatedRouter(
-        "bench", conf=DisaggRouterConf(max_local_prefill_length=256)
-    )
-    decode = DisaggDecodeEngine(
-        decode_inner, decode_rt, "bench", "decoder", "bench", disagg_router=router
-    )
-    await decode.start()
-    pw = PrefillWorker(prefill_engine, prefill_rt, "bench", "bench")
-    await pw.start()
+    # teardown stack: anything successfully started gets torn down even when
+    # a later setup step or the measurement itself dies
+    cleanups = []
     try:
+        broker = Broker()
+        port = await broker.start()
+        cleanups.append(broker.stop)
+        addr = f"127.0.0.1:{port}"
+        decode_rt = DistributedRuntime(cplane_address=addr)
+        await decode_rt.connect()
+        cleanups.append(decode_rt._shutdown_hook)
+        prefill_rt = DistributedRuntime(cplane_address=addr)
+        await prefill_rt.connect()
+        cleanups.append(prefill_rt._shutdown_hook)
+        decode_inner = AsyncJaxEngine(decode_cfg)
+        await decode_inner.start()
+        cleanups.append(decode_inner.shutdown)
+        prefill_engine = AsyncJaxEngine(_parity_config(
+            page_size=page_size, max_seqs=4, max_model_len=4096,
+            num_pages=6 * pages_per_seq + 8,
+            prefill_buckets=(512, 1024), decode_steps=8, pipeline_depth=2,
+        ))
+        await prefill_engine.start()
+        cleanups.append(prefill_engine.shutdown)
+        router = DisaggregatedRouter(
+            "bench", conf=DisaggRouterConf(max_local_prefill_length=256)
+        )
+        decode = DisaggDecodeEngine(
+            decode_inner, decode_rt, "bench", "decoder", "bench", disagg_router=router
+        )
+        await decode.start()
+        cleanups.append(decode.shutdown)
+        pw = PrefillWorker(prefill_engine, prefill_rt, "bench", "bench")
+        await pw.start()
+        cleanups.append(pw.stop)
+
         await _request(decode, "warm-dis", warm_prompt, max_tokens=4)
         dis_res = await continuous(decode, "dis")
         remote = decode.remote_prefills
     finally:
-        await pw.stop()
-        await decode.shutdown()
-        await prefill_engine.shutdown()
-        await decode_rt._shutdown_hook()
-        await prefill_rt._shutdown_hook()
-        await broker.stop()
+        for stop in reversed(cleanups):
+            try:
+                await stop()
+            except Exception:
+                # keep tearing the rest down, but leave a trace: a silently
+                # leaked engine/broker corrupts every later section
+                import traceback
+
+                traceback.print_exc()
     gc.collect()
 
     projected = osl / (wp + cd)
@@ -594,6 +623,10 @@ async def run_http_serving(batch: int = 32, page_size: int = 64) -> dict:
         ckpt, page_size=page_size, num_pages=max(320, batch * 20 * 16 // page_size),
         max_seqs=batch, max_model_len=1024, prefill_buckets=(128, 256, 512),
         decode_steps=32, pipeline_depth=3,
+        # pre-compile every decode-window + (packed-)prefill trace variant:
+        # a cold XLA compile mid-HTTP-traffic stalls past client timeouts on
+        # this tunneled platform (r3 post-mortem)
+        warmup=True,
     ))
     await engine.start()
     svc = HttpService(host="127.0.0.1", port=0)
@@ -633,7 +666,14 @@ async def run_http_serving(batch: int = 32, page_size: int = 64) -> dict:
         return max_tokens, ttft
 
     try:
-        async with aiohttp.ClientSession() as session:
+        # no total timeout (aiohttp default 300 s aborted r3's whole bench):
+        # per-request pacing is the sock_read gap between stream chunks, sized
+        # far above worst-case engine stalls; the section-level timeout in
+        # run() is the real backstop
+        client_timeout = aiohttp.ClientTimeout(
+            total=None, sock_connect=60, sock_read=600
+        )
+        async with aiohttp.ClientSession(timeout=client_timeout) as session:
             await asyncio.gather(*[one(session, i, 0, max_tokens=8) for i in range(batch)])  # warmup
             best = None
             for rnd in (1, 2):
@@ -662,66 +702,124 @@ async def run_http_serving(batch: int = 32, page_size: int = 64) -> dict:
     }
 
 
+#: filled section-by-section so a crash in section N never erases sections
+#: 1..N-1 — __main__ prints whatever landed here even on a fatal error
+DETAIL: dict = {}
+ERRORS: dict = {}
+
+
+async def _section(name: str, thunk, timeout_s: float) -> None:
+    """Run one bench section with its own timeout and error isolation.
+
+    A section that times out is cancelled; every section's engines shut down
+    in finally blocks, so the next section starts clean. The failure lands in
+    ERRORS[name] and the bench carries on — a crash in one section must never
+    zero the whole artifact (r3 post-mortem: one aiohttp timeout discarded 10
+    minutes of measured results)."""
+    import gc
+    import sys
+    import traceback
+
+    t0 = time.monotonic()
+    try:
+        DETAIL[name] = await asyncio.wait_for(thunk(), timeout_s)
+        print(f"[bench] section {name} ok in {time.monotonic()-t0:.0f}s",
+              file=sys.stderr, flush=True)
+    except Exception as e:
+        tb = traceback.format_exc(limit=8)
+        ERRORS[name] = {
+            "error": f"{type(e).__name__}: {e}",
+            "elapsed_s": round(time.monotonic() - t0, 1),
+            "traceback_tail": tb[-1500:],
+        }
+        print(f"[bench] section {name} FAILED after {time.monotonic()-t0:.0f}s: "
+              f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)
+    finally:
+        gc.collect()
+
+
 async def run() -> dict:
     import os
 
     _probe_pallas(HEADLINE[1])
-    head = await run_config(*HEADLINE)
-    cont = await run_config(*CONTINUITY)
-    detail = {
-        "headline_bs%d_ps%d" % HEADLINE: head,
-        "continuity_bs%d_ps%d" % CONTINUITY: cont,
+    await _section("headline_bs%d_ps%d" % HEADLINE,
+                   lambda: run_config(*HEADLINE), 1500)
+    await _section("continuity_bs%d_ps%d" % CONTINUITY,
+                   lambda: run_config(*CONTINUITY), 900)
+    DETAIL.update({
         "prompt_len": PROMPT_LEN,
         "decode_tokens": DECODE_TOKENS,
         "devices": 1,
         "r01_value_bs8": 1341.84,
-    }
+    })
     if os.environ.get("DYNTPU_BENCH_PARITY", "1") != "0":
-        import gc
-
-        gc.collect()
         # the reference's tracked workload shape (BASELINE.md: 3K ISL /
         # 150 OSL serving configs)
-        detail["ref_workload_isl3k_osl150"] = await run_config(
+        await _section("ref_workload_isl3k_osl150", lambda: run_config(
             16, 128, rounds=2, prompt_len=3072, decode_tokens=150,
             max_model_len=4096,
-        )
-        gc.collect()
-        detail["http_serving"] = await run_http_serving()
-        gc.collect()
+        ), 1500)
+        await _section("http_serving", run_http_serving, 1800)
         # on-chip decode numbers for the non-Llama families (the vLLM patch
         # exists substantially for DeepSeek MLA — SURVEY.md §2.4)
-        detail["mla_decode"] = {
-            **await run_config(32, 128, rounds=2, model_id=mla_model_id()),
-            "roofline_note": (
-                "~1.3B dense-MLP MLA geometry (kv_lora 512/rope 64): weights "
-                "~2.6 GB bf16 -> ~315 weight-bound steps/s; latent cache is "
-                "1.25 KB/token vs 4 KB for the GQA headline (the MLA win)"
-            ),
-        }
-        gc.collect()
-        detail["moe_decode"] = {
-            **await run_config(32, 128, rounds=2, model_id=moe_model_id()),
-            "roofline_note": (
-                "~2.3B Mixtral-geometry top-2/8: at bs32 nearly every expert "
-                "is active each step -> full ~2.3 GB read -> ~355 steps/s "
-                "weight-bound ceiling"
-            ),
-        }
-        gc.collect()
-        detail["parity_disagg"] = await run_disagg_parity()
-        gc.collect()
-        detail["parity_kv_routing"] = await run_routing_parity()
-        detail["parity_host_offload"] = await run_offload_parity()
-    return {
+
+        async def mla():
+            return {
+                **await run_config(32, 128, rounds=2, model_id=mla_model_id()),
+                "roofline_note": (
+                    "~1.3B dense-MLP MLA geometry (kv_lora 512/rope 64): "
+                    "weights ~2.6 GB bf16 -> ~315 weight-bound steps/s; "
+                    "latent cache is 1.25 KB/token vs 4 KB for the GQA "
+                    "headline (the MLA win)"
+                ),
+            }
+
+        async def moe():
+            return {
+                **await run_config(32, 128, rounds=2, model_id=moe_model_id()),
+                "roofline_note": (
+                    "~2.3B Mixtral-geometry top-2/8: at bs32 nearly every "
+                    "expert is active each step -> full ~2.3 GB read -> ~355 "
+                    "steps/s weight-bound ceiling"
+                ),
+            }
+
+        await _section("mla_decode", mla, 1500)
+        await _section("moe_decode", moe, 1500)
+        await _section("parity_disagg", run_disagg_parity, 2400)
+        await _section("parity_kv_routing", run_routing_parity, 1500)
+        await _section("parity_host_offload", run_offload_parity, 1200)
+    return _result()
+
+
+def _result(extra_errors: dict | None = None) -> dict:
+    """Assemble the one-line artifact from whatever sections landed."""
+    head = DETAIL.get("headline_bs%d_ps%d" % HEADLINE)
+    value = head["tok_s"] if head else 0.0
+    out = {
         "metric": "engine_decode_throughput_llama1.3b_bf16",
-        "value": head["tok_s"],
+        "value": value,
         "unit": "out_tok/s/chip",
-        "vs_baseline": round(head["tok_s"] / PARITY_TARGET_TOK_S, 3),
-        "detail": detail,
+        "vs_baseline": round(value / PARITY_TARGET_TOK_S, 3),
+        "detail": DETAIL,
     }
+    errors = {**ERRORS, **(extra_errors or {})}
+    if errors:
+        out["errors"] = errors
+    return out
 
 
 if __name__ == "__main__":
-    result = asyncio.run(run())
+    import sys
+
+    try:
+        result = asyncio.run(run())
+    except BaseException as e:  # even a fatal crash must emit the sections that finished
+        if isinstance(e, (KeyboardInterrupt, SystemExit)):
+            label = "interrupted"
+        else:
+            label = f"{type(e).__name__}: {e}"
+        result = _result(extra_errors={"__run__": {"error": label}})
+        print(json.dumps(result))
+        sys.exit(0 if result["value"] else 1)
     print(json.dumps(result))

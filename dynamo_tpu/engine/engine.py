@@ -117,10 +117,17 @@ class AsyncJaxEngine:
             time.monotonic() - t0,
         )
 
-    async def shutdown(self) -> None:
+    async def shutdown(self, join_timeout: float = 120.0) -> None:
         self._stopping.set()
         if self._thread is not None:
-            await asyncio.get_running_loop().run_in_executor(None, self._thread.join)
+            await asyncio.get_running_loop().run_in_executor(
+                None, lambda: self._thread.join(join_timeout)
+            )
+            if self._thread.is_alive():
+                # the loop thread is wedged (a hung device op / dead PJRT
+                # relay): it's a daemon thread, so give up on it rather than
+                # hanging the caller's teardown forever
+                log.error("engine loop did not exit within %.0fs; abandoning thread", join_timeout)
 
     # ---------------- request API ----------------
 

@@ -896,6 +896,30 @@ class ModelRunner:
             )
             if want_lp:
                 jax.block_until_ready(out)
+        # packed-prefill executables: one per (N=lanes_for(bucket), bucket)
+        # pair the scheduler's lane packing can actually reach. Without these,
+        # the first packed shape cold-compiles mid-traffic — on a tunneled
+        # PJRT platform that stall exceeds HTTP client timeouts.
+        for b in self.config.prefill_buckets:
+            N = self.config.lanes_for(b)
+            if N <= 1:
+                continue  # single-lane chunks ride _prefill (compiled above)
+            for sampling, want_lp in (
+                (None, False),
+                (None, True),
+                (SamplingParams(presence_penalty=0.1, min_tokens=1), False),
+                (SamplingParams(presence_penalty=0.1, min_tokens=1), True),
+            ):
+                # extras variants need a final lane (slot out-of-range so the
+                # feedback write drops); neutral variants a non-final one
+                lane = (
+                    np.zeros(b, np.int32), 0, pt[0], -1,
+                    sampling or SamplingParams(temperature=0.0),
+                    (0,) if sampling is not None else (),
+                    sampling is not None,
+                )
+                out = self.prefill_chunk_batch([lane], N=N, want_logprobs=want_lp)
+                jax.block_until_ready(out)
         log.info("warmup: trace variants compiled in %.1fs", _time.monotonic() - t0)
 
     def extract_pages_device(self, page_ids: np.ndarray) -> jax.Array:
