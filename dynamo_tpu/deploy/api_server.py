@@ -85,7 +85,8 @@ class DeploymentStore:
 
 
 class FileDeploymentStore(DeploymentStore):
-    """JSON-file-backed store (the DB slot; swap for a real DB in prod)."""
+    """JSON-file-backed store (kept for fixture-style tests; rewrites the
+    whole file per mutation — use SqliteDeploymentStore for durability)."""
 
     def __init__(self, path: str | Path):
         super().__init__()
@@ -95,6 +96,77 @@ class FileDeploymentStore(DeploymentStore):
 
     def _flush(self) -> None:
         self._path.write_text(json.dumps(self._data))
+
+
+class SqliteDeploymentStore(DeploymentStore):
+    """sqlite-backed store — the durable-DB slot (the reference's API server
+    is Postgres-backed, reference: deploy/dynamo/api-server/api/database/
+    database.go). Every mutation is one transactional INSERT/UPDATE/DELETE
+    (WAL mode), not a whole-file rewrite; state survives process restarts."""
+
+    def __init__(self, path: str | Path):
+        import sqlite3
+
+        super().__init__()
+        self._db = sqlite3.connect(str(path))
+        with self._db:
+            self._db.execute("PRAGMA journal_mode=WAL")
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS revisions ("
+                " name TEXT NOT NULL, revision INTEGER NOT NULL,"
+                " created_at REAL NOT NULL, spec TEXT NOT NULL,"
+                " PRIMARY KEY (name, revision))"
+            )
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS status ("
+                " name TEXT PRIMARY KEY, status TEXT NOT NULL)"
+            )
+        for name, revision, created_at, spec in self._db.execute(
+            "SELECT name, revision, created_at, spec FROM revisions"
+            " ORDER BY name, revision"
+        ):
+            self._data.setdefault(name, []).append(
+                {"revision": revision, "created_at": created_at, "spec": json.loads(spec)}
+            )
+        for name, status in self._db.execute("SELECT name, status FROM status"):
+            self._status[name] = json.loads(status)
+
+    def put(self, name: str, spec: dict) -> dict:
+        revs = self._data.setdefault(name, [])
+        record = {
+            "revision": (revs[-1]["revision"] + 1) if revs else 1,
+            "created_at": time.time(),
+            "spec": spec,
+        }
+        with self._db:
+            self._db.execute(
+                "INSERT INTO revisions (name, revision, created_at, spec)"
+                " VALUES (?, ?, ?, ?)",
+                (name, record["revision"], record["created_at"], json.dumps(spec)),
+            )
+        revs.append(record)
+        return record
+
+    def delete(self, name: str) -> bool:
+        existed = name in self._data
+        with self._db:
+            self._db.execute("DELETE FROM revisions WHERE name = ?", (name,))
+            self._db.execute("DELETE FROM status WHERE name = ?", (name,))
+        self._data.pop(name, None)
+        self._status.pop(name, None)
+        return existed
+
+    def set_status(self, name: str, status: dict) -> None:
+        self._status[name] = status
+        with self._db:
+            self._db.execute(
+                "INSERT INTO status (name, status) VALUES (?, ?)"
+                " ON CONFLICT(name) DO UPDATE SET status = excluded.status",
+                (name, json.dumps(status)),
+            )
+
+    def close(self) -> None:
+        self._db.close()
 
 
 class DeployApiServer:
@@ -246,11 +318,30 @@ def main(argv: Optional[list[str]] = None) -> int:
     ap = argparse.ArgumentParser("dynamo-tpu-deploy-api")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8090)
-    ap.add_argument("--store", default=None, help="path to a JSON file store (default: in-memory)")
+    ap.add_argument(
+        "--store", default=None,
+        help="store path: *.json = JSON file store, anything else = sqlite "
+             "(default: in-memory)",
+    )
     args = ap.parse_args(argv)
 
+    def open_store(path):
+        if path is None:
+            return DeploymentStore()
+        p = Path(path)
+        if str(p).endswith(".json"):
+            return FileDeploymentStore(p)
+        if p.exists():
+            # pre-sqlite deployments may hold a JSON store at any path: keep
+            # reading it as one rather than crashing sqlite on JSON text
+            head = p.read_bytes()[:16]
+            if not head.startswith(b"SQLite format 3") and head[:1] in (b"{", b"["):
+                log.warning("store %s holds JSON; using the file store (rename to migrate to sqlite)", p)
+                return FileDeploymentStore(p)
+        return SqliteDeploymentStore(p)
+
     async def run():
-        store = FileDeploymentStore(args.store) if args.store else DeploymentStore()
+        store = open_store(args.store)
         server = DeployApiServer(store)
         port = await server.start(args.host, args.port)
         print(json.dumps({"listening": f"{args.host}:{port}"}), flush=True)

@@ -45,18 +45,17 @@ class ModelRunner:
     ):
         self.config = config
         self.model = model
-        if config.sp > 1 and config.tp > 1:
-            raise ValueError("sp and tp cannot both exceed 1 yet")
+        if config.sp > 1 and (config.tp > 1 or config.pp > 1):
+            raise ValueError("sp composes with neither tp nor pp yet")
         if config.pp > 1:
-            if config.tp > 1 or config.sp > 1:
-                raise ValueError("pp is exclusive with tp/sp for now")
             if model.config.num_layers % config.pp:
                 raise ValueError(
                     f"num_layers={model.config.num_layers} not divisible by pp={config.pp}"
                 )
-            if len(jax.devices()) < config.pp:
+            if len(jax.devices()) < config.pp * config.tp:
                 raise ValueError(
-                    f"pp={config.pp} but only {len(jax.devices())} devices available"
+                    f"pp={config.pp} x tp={config.tp} but only "
+                    f"{len(jax.devices())} devices available"
                 )
             if any(b % config.pp for b in config.prefill_buckets):
                 raise ValueError(
@@ -64,6 +63,23 @@ class ModelRunner:
                 )
             if config.max_seqs % config.pp:
                 raise ValueError(f"max_seqs must be divisible by pp={config.pp}")
+            if config.tp > 1:
+                h = getattr(model.config, "num_heads", 0)
+                hkv = getattr(model.config, "num_kv_heads", 0)
+                if h % config.tp or hkv % config.tp:
+                    raise ValueError(
+                        f"tp={config.tp} must divide num_heads={h} and "
+                        f"num_kv_heads={hkv} for the composed pp x tp mesh"
+                    )
+                import inspect
+
+                if "tp_axis" not in inspect.signature(model._layer).parameters:
+                    # fail at init, not at first traced prefill: the layer
+                    # must run on a head shard with in-layer psums
+                    raise ValueError(
+                        f"model {type(model).__name__} does not support tp "
+                        "inside the pipeline shard_map (no _layer tp_axis)"
+                    )
         if config.sp > 1:
             if not hasattr(model, "prefill_sp"):
                 raise ValueError(
@@ -79,7 +95,14 @@ class ModelRunner:
                     f"{config.prefill_buckets}; SP prefill would never engage"
                 )
         if mesh is None:
-            if config.pp > 1:
+            if config.pp > 1 and config.tp > 1:
+                # composed stage x head mesh: tp is the minor (fastest-
+                # varying) axis so a head shard's peers are ICI neighbors
+                devices = jax.devices()[: config.pp * config.tp]
+                mesh = Mesh(
+                    np.array(devices).reshape(config.pp, config.tp), ("pp", "tp")
+                )
+            elif config.pp > 1:
                 devices = jax.devices()[: config.pp]
                 mesh = Mesh(np.array(devices).reshape(len(devices)), ("pp",))
             elif config.sp > 1:
@@ -89,9 +112,11 @@ class ModelRunner:
                 devices = jax.devices()[: config.tp]
                 mesh = Mesh(np.array(devices).reshape(len(devices)), ("tp",))
         self.mesh = mesh
-        if config.tp > 1:
+        if config.tp > 1 and config.pp == 1:
             # the Pallas decode kernel runs under shard_map on this mesh
-            # (attention is head-parallel; no collectives inside)
+            # (attention is head-parallel; no collectives inside). With pp > 1
+            # attention runs INSIDE the pipeline's own (pp, tp) shard_map on
+            # local pool shards, so the dispatcher must not re-wrap it.
             model.attn_mesh = mesh
         if config.pp > 1:
             # stage sharding: layer stack + layer-major KV pool split over pp

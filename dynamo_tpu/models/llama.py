@@ -43,8 +43,8 @@ def _resolve_tp_axis(mesh: Mesh, tp_axis: str):
     design there); otherwise keep the name so NamedSharding raises loudly."""
     if tp_axis in mesh.axis_names:
         return tp_axis
-    if "sp" in mesh.axis_names:
-        return None
+    if "sp" in mesh.axis_names or "pp" in mesh.axis_names:
+        return None  # sp/pp-only meshes replicate the tp dims by design
     return tp_axis  # unknown axis -> NamedSharding raises
 
 
@@ -287,7 +287,13 @@ class LlamaModel:
         offsets: jnp.ndarray,  # [T]
         attn_fn,
         rope_positions: jnp.ndarray | None = None,  # [T, 3] M-RoPE components
+        tp_axis: str | None = None,  # set inside an explicit (pp, tp) shard_map
     ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """One transformer layer. Under GSPMD (pp == 1) the tp sharding is
+        handled by the compiler; inside an explicit shard_map over a composed
+        (pp, tp) mesh this runs on the LOCAL head shard (wq/wk/wv column
+        shards, wo/down row shards) and ``tp_axis`` names the axis for the
+        two Megatron-style psums that complete each residual branch."""
         c = self.config
         T = hidden.shape[0]
         h = rms_norm(hidden, lp["input_norm"], c.rms_norm_eps)
@@ -298,9 +304,11 @@ class LlamaModel:
             q_flat = q_flat + lp["bq"]
             k_flat = k_flat + lp["bk"]
             v_flat = v_flat + lp["bv"]
-        q = q_flat.reshape(T, c.num_heads, c.head_dim)
-        k = k_flat.reshape(T, c.num_kv_heads, c.head_dim)
-        v = v_flat.reshape(T, c.num_kv_heads, c.head_dim)
+        # head counts from the weight shard, not the config: inside a tp
+        # shard_map each device sees num_heads / tp of them
+        q = q_flat.reshape(T, -1, c.head_dim)
+        k = k_flat.reshape(T, -1, c.head_dim)
+        v = v_flat.reshape(T, -1, c.head_dim)
         if c.mrope_section is not None:
             pos3 = (
                 rope_positions
@@ -317,9 +325,14 @@ class LlamaModel:
         # attn_fn sees both the updated pools (paged paths) and the chunk's
         # fresh rows (ring/SP path, which never reads the pool)
         attn = attn_fn(q, k, v, k_pool, v_pool)
-        hidden = hidden + (attn.reshape(T, -1) @ lp["wo"])
+        attn_out = attn.reshape(T, -1) @ lp["wo"]
+        if tp_axis is not None:
+            attn_out = jax.lax.psum(attn_out, tp_axis)
+        hidden = hidden + attn_out
         h = rms_norm(hidden, lp["post_norm"], c.rms_norm_eps)
         mlp = (jax.nn.silu(h @ lp["gate"]) * (h @ lp["up"])) @ lp["down"]
+        if tp_axis is not None:
+            mlp = jax.lax.psum(mlp, tp_axis)
         hidden = hidden + mlp
         return hidden, k_pool, v_pool
 

@@ -36,43 +36,69 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from dynamo_tpu.ops.attention import paged_decode_attention, paged_prefill_attention
 
 
-def stage_param_shardings(model, mesh: Mesh, pp_axis: str = "pp") -> dict:
-    """NamedSharding pytree: layer-stacked leaves sharded on their leading [L]
-    axis over pp; embed/head/final-norm replicated (they are needed at the
-    pipeline's edges, which run outside shard_map)."""
-    def ns(*spec):
-        return NamedSharding(mesh, P(*spec))
-
+def stage_layer_specs(model, mesh: Mesh, pp_axis: str = "pp"):
+    """PartitionSpec pytree for params["layers"]: leading [L] axis over pp,
+    composed with the model's own tp column/row sharding when the mesh
+    carries a ``tp`` axis (each leaf's spec from model.param_shardings always
+    names the leading L dim explicitly, so dim 0 swaps cleanly)."""
+    if "tp" in mesh.axis_names:
+        base = model.param_shardings(mesh)["layers"]
+        return jax.tree.map(lambda s: P(pp_axis, *s.spec[1:]), base)
     shapes = jax.eval_shape(model.init_params, jax.random.key(0))
-    shardings = jax.tree.map(lambda _: ns(), shapes)
-    # only the layer stack is stage-sharded
-    shardings["layers"] = jax.tree.map(
-        lambda leaf: ns(*((pp_axis,) + (None,) * (len(leaf.shape) - 1))),
+    return jax.tree.map(
+        lambda leaf: P(*((pp_axis,) + (None,) * (len(leaf.shape) - 1))),
         shapes["layers"],
     )
+
+
+def stage_param_shardings(model, mesh: Mesh, pp_axis: str = "pp") -> dict:
+    """NamedSharding pytree: layer-stacked leaves sharded on their leading [L]
+    axis over pp (composed with tp when the mesh has a tp axis); the
+    pipeline-edge leaves (embed / lm_head / final norm) keep the model's own
+    shardings — they run outside the pp shard_map under GSPMD."""
+    def ns(spec):
+        return NamedSharding(mesh, spec)
+
+    if "tp" in mesh.axis_names:
+        shardings = dict(model.param_shardings(mesh))
+    else:
+        shapes = jax.eval_shape(model.init_params, jax.random.key(0))
+        shardings = jax.tree.map(lambda _: ns(P()), shapes)
+    shardings["layers"] = jax.tree.map(ns, stage_layer_specs(model, mesh, pp_axis))
     return shardings
 
 
+def kv_pool_spec(mesh: Mesh, pp_axis: str = "pp", folded: bool = False) -> P:
+    """Flat-pool PartitionSpec: layer-major rows over pp, kv heads over tp
+    (when present). Folded pools carry heads in the lane dim."""
+    tp = "tp" if "tp" in mesh.axis_names else None
+    if folded:
+        return P(pp_axis, None, tp)
+    return P(pp_axis, None, tp, None)
+
+
 def stage_kv_sharding(mesh: Mesh, pp_axis: str = "pp", folded: bool = False) -> dict:
-    """Layer-major pool split over pp; `folded` = sub-128 head_dim pools
-    ([LP, ps, Hkv*D], one fewer dim — see LlamaConfig.kv_folded)."""
-    spec = P(pp_axis, None, None) if folded else P(pp_axis, None, None, None)
-    ns = NamedSharding(mesh, spec)
+    """Layer-major pool split over pp (x tp on heads when the mesh has it);
+    `folded` = sub-128 head_dim pools ([LP, ps, Hkv*D] — LlamaConfig.kv_folded)."""
+    ns = NamedSharding(mesh, kv_pool_spec(mesh, pp_axis, folded))
     return {"k": ns, "v": ns}
 
 
-def _local_layer_scan(model, local_layers, kp, vp, hidden, positions, phys, offsets, attn_maker, num_pages, rope_positions=None):
+def _local_layer_scan(model, local_layers, kp, vp, hidden, positions, phys, offsets, attn_maker, num_pages, rope_positions=None, tp_axis=None):
     """Run this stage's layer slice over one microbatch. phys holds per-token
-    LOGICAL page ids (trash-routed already); layer offsets are stage-local."""
+    LOGICAL page ids (trash-routed already); layer offsets are stage-local.
+    With ``tp_axis`` set the layers run on their local head shard and psum
+    over tp inside model._layer (composed pp x tp shard_map)."""
     L_loc = kp.shape[0] // num_pages
     layer_offsets = jnp.arange(L_loc, dtype=jnp.int32) * num_pages
+    kwargs = {} if tp_axis is None else {"tp_axis": tp_axis}
 
     def body(carry, xs):
         h, kp_, vp_ = carry
         lp, off = xs
         h, kp_, vp_ = model._layer(
             lp, h, kp_, vp_, positions, off + phys, offsets, attn_maker(off),
-            rope_positions=rope_positions,
+            rope_positions=rope_positions, **kwargs,
         )
         return (h, kp_, vp_), None
 
@@ -160,17 +186,16 @@ def prefill_pipelined(
     )
     rp_mbs = rp3.reshape(M, Tm, 3)
 
-    spec_pool = (
-        P(pp_axis, None, None)
-        if getattr(model.config, "kv_folded", False)
-        else P(pp_axis, None, None, None)
-    )
+    folded = getattr(model.config, "kv_folded", False)
+    spec_pool = kv_pool_spec(mesh, pp_axis, folded)
+    tp_axis = "tp" if "tp" in mesh.axis_names else None
+    layer_specs = stage_layer_specs(model, mesh, pp_axis)
     rep = P()
 
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(P(pp_axis), spec_pool, spec_pool, rep, rep, rep, rep, rep, rep),
+        in_specs=(layer_specs, spec_pool, spec_pool, rep, rep, rep, rep, rep, rep),
         out_specs=(rep, spec_pool, spec_pool),
         check_vma=False,
     )
@@ -189,7 +214,7 @@ def prefill_pipelined(
 
             return _local_layer_scan(
                 model, local_layers, kp, vp, x, pos, phys_mb, off_mb, attn_maker, num_pages,
-                rope_positions=rp_mbs[mc],
+                rope_positions=rp_mbs[mc], tp_axis=tp_axis,
             )
 
         return _gpipe_rotate(mesh, pp_axis, S, M, run_mb, hidden_mbs, kp, vp)
@@ -242,17 +267,16 @@ def decode_pipelined(
     rp = positions + (rope_deltas if rope_deltas is not None else 0)
     rp_mbs = jnp.stack([rp] * 3, axis=-1).reshape(M, Bm, 3)
 
-    spec_pool = (
-        P(pp_axis, None, None)
-        if getattr(model.config, "kv_folded", False)
-        else P(pp_axis, None, None, None)
-    )
+    folded = getattr(model.config, "kv_folded", False)
+    spec_pool = kv_pool_spec(mesh, pp_axis, folded)
+    tp_axis = "tp" if "tp" in mesh.axis_names else None
+    layer_specs = stage_layer_specs(model, mesh, pp_axis)
     rep = P()
 
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(P(pp_axis), spec_pool, spec_pool) + (rep,) * 7,
+        in_specs=(layer_specs, spec_pool, spec_pool) + (rep,) * 7,
         out_specs=(rep, spec_pool, spec_pool),
         check_vma=False,
     )
@@ -272,7 +296,7 @@ def decode_pipelined(
 
             return _local_layer_scan(
                 model, local_layers, kp, vp, x, pos, phys_mb, off_mb, attn_maker, num_pages,
-                rope_positions=rp_mbs[mc],
+                rope_positions=rp_mbs[mc], tp_axis=tp_axis,
             )
 
         return _gpipe_rotate(mesh, pp_axis, S, M, run_mb, hidden_mbs, kp, vp)

@@ -281,6 +281,66 @@ def test_file_store_persists(tmp_path):
     assert [r["revision"] for r in store2.revisions("a")] == [1, 2]
 
 
+def test_sqlite_store_durable_across_restart(tmp_path):
+    from dynamo_tpu.deploy.api_server import SqliteDeploymentStore
+
+    path = tmp_path / "deploy.db"
+    store = SqliteDeploymentStore(path)
+    store.put("a", {"name": "a"})
+    store.put("a", {"name": "a", "v": 2})
+    store.put("b", {"name": "b"})
+    store.set_status("a", {"converged": True, "observed_revision": 2})
+    store.delete("b")
+    store.close()
+
+    store2 = SqliteDeploymentStore(path)
+    assert store2.list() == ["a"]
+    assert store2.head("a")["revision"] == 2
+    assert store2.head("a")["spec"]["v"] == 2
+    assert [r["revision"] for r in store2.revisions("a")] == [1, 2]
+    assert store2.get_status("a")["converged"] is True
+    # revisions keep counting after the restart (no id reuse)
+    assert store2.put("a", {"name": "a", "v": 3})["revision"] == 3
+    store2.close()
+
+
+def test_api_server_on_sqlite_store(tmp_path):
+    """The full CRUD surface over the durable store, then a fresh server on
+    the same DB sees the state (the reference's Postgres-backed behavior)."""
+    from dynamo_tpu.deploy.api_server import SqliteDeploymentStore
+
+    path = tmp_path / "deploy.db"
+
+    async def run():
+        server = DeployApiServer(SqliteDeploymentStore(path))
+        port = await server.start()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            spec = sample_spec().to_dict()
+            status, body = await _json(None, "POST", f"{base}/api/v1/deployments", spec)
+            assert (status, body["revision"]) == (201, 1)
+            spec["image"] = "dynamo-tpu:v2"
+            status, body = await _json(None, "PUT", f"{base}/api/v1/deployments/llama-agg", spec)
+            assert (status, body["revision"]) == (200, 2)
+        finally:
+            await server.stop()
+            server.store.close()
+
+        server2 = DeployApiServer(SqliteDeploymentStore(path))
+        port = await server2.start()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            status, body = await _json(None, "GET", f"{base}/api/v1/deployments/llama-agg")
+            assert status == 200 and body["spec"]["image"] == "dynamo-tpu:v2"
+            status, body = await _json(None, "GET", f"{base}/api/v1/deployments/llama-agg/revisions")
+            assert [r["revision"] for r in body["revisions"]] == [2, 1]
+        finally:
+            await server2.stop()
+            server2.store.close()
+
+    asyncio.run(run())
+
+
 # ---------------- controller loop (watch -> converge -> drift) ----------------
 
 

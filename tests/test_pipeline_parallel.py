@@ -87,10 +87,71 @@ def test_prefill_and_decode_parity(setup, pp, microbatches):
     )
 
 
+@pytest.mark.parametrize("pp,tp,microbatches", [(2, 2, 2), (4, 2, 4)])
+def test_prefill_and_decode_parity_composed_tp_pp(setup, pp, tp, microbatches):
+    """Composed (pp, tp) mesh: stage sharding on the layer axis x Megatron
+    head sharding with in-layer psums must match the single-device model."""
+    cfg, model, params = setup
+    if pp * tp > len(jax.devices()):
+        pytest.skip("not enough virtual devices")
+    mesh = Mesh(np.array(jax.devices()[: pp * tp]).reshape(pp, tp), ("pp", "tp"))
+    params_pp = jax.device_put(params, stage_param_shardings(model, mesh))
+    kv_pp = jax.device_put(
+        model.init_kv_cache(NUM_PAGES, PAGE_SIZE),
+        stage_kv_sharding(mesh, folded=cfg.kv_folded),
+    )
+
+    T = 16
+    prompt = np.array([5, 9, 2, 77, 31, 8, 100, 3, 44, 12, 7, 60, 2, 9, 1, 30], np.int32)
+    pt = np.array([3, 5, 7, 9, 11, 0, 0, 0], np.int32)
+    pos = np.arange(T, dtype=np.int32)
+    valid = np.ones(T, bool)
+
+    ref_logits, ref_kv = model.prefill(
+        params, model.init_kv_cache(NUM_PAGES, PAGE_SIZE),
+        jnp.asarray(prompt), jnp.asarray(pos), jnp.asarray(pt),
+        jnp.asarray(valid), jnp.asarray(T - 1),
+    )
+    pp_logits, kv_pp = jax.jit(
+        lambda p, kv: prefill_pipelined(
+            model, p, kv, jnp.asarray(prompt), jnp.asarray(pos), jnp.asarray(pt),
+            jnp.asarray(valid), jnp.asarray(T - 1), mesh,
+            num_microbatches=microbatches,
+        ),
+        donate_argnums=(1,),
+    )(params_pp, kv_pp)
+    np.testing.assert_allclose(
+        np.asarray(pp_logits), np.asarray(ref_logits), rtol=2e-4, atol=2e-4
+    )
+
+    B = 4
+    toks = np.zeros(B, np.int32)
+    toks[0] = 42
+    dpos = np.zeros(B, np.int32)
+    dpos[0] = T
+    pts = np.zeros((B, 8), np.int32)
+    pts[0] = pt
+    act = np.zeros(B, bool)
+    act[0] = True
+    ref_dlog, _ = model.decode(
+        params, ref_kv, jnp.asarray(toks), jnp.asarray(dpos), jnp.asarray(pts), jnp.asarray(act)
+    )
+    pp_dlog, _ = jax.jit(
+        lambda p, kv: decode_pipelined(
+            model, p, kv, jnp.asarray(toks), jnp.asarray(dpos), jnp.asarray(pts),
+            jnp.asarray(act), mesh, num_microbatches=microbatches,
+        ),
+        donate_argnums=(1,),
+    )(params_pp, kv_pp)
+    np.testing.assert_allclose(
+        np.asarray(pp_dlog)[0], np.asarray(ref_dlog)[0], rtol=2e-4, atol=2e-4
+    )
+
+
 # ---------------- engine e2e: pp=2 tokens match pp=1 ----------------
 
 
-def _engine_config(pp):
+def _engine_config(pp, tp=1):
     from dynamo_tpu.engine.config import EngineConfig
 
     return EngineConfig(
@@ -101,6 +162,7 @@ def _engine_config(pp):
         max_model_len=64,
         prefill_buckets=(8, 16, 32),
         pp=pp,
+        tp=tp,
     )
 
 
@@ -139,6 +201,33 @@ def test_engine_pp_matches_single_device():
     try:
         ref = loop.run_until_complete(run(pp=1))
         got = loop.run_until_complete(run(pp=2))
+    finally:
+        loop.close()
+    assert got == ref
+
+
+def test_engine_composed_pp_tp_matches_single_device():
+    """Full engine e2e on a composed pp=2 x tp=2 mesh: greedy tokens must
+    match the single-device engine exactly."""
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+
+    prompts = [
+        [5, 9, 2, 77, 31, 8, 100],
+        [44, 12, 7, 60, 2, 9, 1, 30, 17, 3],
+    ]
+
+    async def run(pp, tp):
+        engine = AsyncJaxEngine(_engine_config(pp, tp))
+        await engine.start()
+        try:
+            return [await _greedy(engine, f"r{i}", p, 8) for i, p in enumerate(prompts)]
+        finally:
+            await engine.shutdown()
+
+    loop = asyncio.new_event_loop()
+    try:
+        ref = loop.run_until_complete(run(pp=1, tp=1))
+        got = loop.run_until_complete(run(pp=2, tp=2))
     finally:
         loop.close()
     assert got == ref
