@@ -113,6 +113,13 @@ class DisaggDecodeEngine:
     # ---------------- generate ----------------
 
     async def generate(self, request: EngineRequest) -> AsyncIterator[StepOutput]:
+        async for batch in self.generate_batched(request):
+            for item in batch:
+                yield item
+
+    async def generate_batched(self, request: EngineRequest) -> AsyncIterator[list[StepOutput]]:
+        """Window-batched variant (see AsyncJaxEngine.generate_batched): the
+        serving Backend consumes this to collapse per-token overhead."""
         prompt = list(request.token_ids)
         prefix_hit = await self.engine.run_on_engine(
             lambda: self.engine.sync_lookup_prefix(prompt)
@@ -141,8 +148,8 @@ class DisaggDecodeEngine:
             or not self.router.prefill_remote(len(prompt), prefix_hit, queue_depth)
         ):
             self.local_prefills += 1
-            async for out in self.engine.generate(request):
-                yield out
+            async for batch in self.engine.generate_batched(request):
+                yield batch
             return
 
         self.remote_prefills += 1
@@ -215,5 +222,5 @@ class DisaggDecodeEngine:
                 await self.engine.run_on_engine(lambda: self.engine.sync_abort_remote(rid))
                 self.engine._outputs.pop(rid, None)
 
-        async for out in self.engine._drain_stream(rid):
-            yield out
+        async for batch in self.engine._drain_stream_batched(rid):
+            yield batch

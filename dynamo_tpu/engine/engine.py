@@ -133,10 +133,21 @@ class AsyncJaxEngine:
 
     async def generate(self, request: EngineRequest) -> AsyncIterator[StepOutput]:
         """Submit a request; yields StepOutputs until finished."""
+        async for batch in self.generate_batched(request):
+            for item in batch:
+                yield item
+
+    async def generate_batched(self, request: EngineRequest) -> AsyncIterator[list[StepOutput]]:
+        """Submit a request; yields LISTS of StepOutputs (one list per decode
+        window arrival). The engine loop reconciles decode_steps tokens per
+        window, so batching here collapses the per-token thread crossings,
+        detokenizer calls, and SSE writes that dominated the serving-stack
+        overhead (reference's HTTP frontend is an explicitly thin layer:
+        lib/llm/src/http/service/openai.rs:132-214)."""
         self._register_stream(request.request_id)
         self._inbox.put(request)
-        async for item in self._drain_stream(request.request_id):
-            yield item
+        async for batch in self._drain_stream_batched(request.request_id):
+            yield batch
 
     def _register_stream(self, request_id: str) -> None:
         """Open the output channel for a request without scheduling it (the
@@ -150,14 +161,27 @@ class AsyncJaxEngine:
         self._outputs[request_id] = (asyncio.get_running_loop(), out_q)
 
     async def _drain_stream(self, request_id: str) -> AsyncIterator[StepOutput]:
+        async for batch in self._drain_stream_batched(request_id):
+            for item in batch:
+                yield item
+
+    async def _drain_stream_batched(self, request_id: str) -> AsyncIterator[list[StepOutput]]:
+        """Queue items are single StepOutputs or lists of them (one decode
+        window's tokens for this request, posted in one thread crossing)."""
         _, out_q = self._outputs[request_id]
         try:
             while True:
                 item = await out_q.get()
                 if isinstance(item, Exception):
                     raise item
-                yield item
-                if item.finished:
+                batch = item if isinstance(item, list) else [item]
+                done = False
+                for i, o in enumerate(batch):
+                    if o.finished:  # belt: nothing rides past a finish
+                        batch, done = batch[: i + 1], True
+                        break
+                yield batch
+                if done:
                     return
         finally:
             self._outputs.pop(request_id, None)
@@ -339,8 +363,7 @@ class AsyncJaxEngine:
                     log.exception("engine step failed")
                     self._fail_all(e)
                     continue
-                for out in outputs:
-                    self._post(out.request_id, out)
+                self._post_grouped(outputs)
             elif not did_work:
                 try:
                     req = self._inbox.get(timeout=0.02)
@@ -366,8 +389,7 @@ class AsyncJaxEngine:
                     outputs = []
                     if isinstance(result, tuple) and len(result) == 2 and isinstance(result[1], list):
                         result, outputs = result
-                    for out in outputs:
-                        self._post(out.request_id, out)
+                    self._post_grouped(outputs)
                     loop.call_soon_threadsafe(_resolve, fut, result, None)
                 except Exception as e:
                     log.exception("engine command failed")
@@ -381,6 +403,18 @@ class AsyncJaxEngine:
             except thread_queue.Empty:
                 break
         return got
+
+    def _post_grouped(self, outputs: list) -> None:
+        """Post a step's outputs grouped per request: one call_soon_threadsafe
+        (and one queue wakeup) per request per decode window instead of per
+        token. Order within a request is preserved (dict insertion order)."""
+        if not outputs:
+            return
+        by_rid: dict[str, list] = {}
+        for out in outputs:
+            by_rid.setdefault(out.request_id, []).append(out)
+        for rid, group in by_rid.items():
+            self._post(rid, group if len(group) > 1 else group[0])
 
     def _post(self, request_id: str, item) -> None:
         entry = self._outputs.get(request_id)

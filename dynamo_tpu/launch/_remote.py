@@ -72,17 +72,19 @@ class RemoteEngineProxy:
             wire["images"] = [im.to_wire() for im in request.images]
         stream = await self._client.random(wire)
         async for item in stream:
-            token = None
-            ids = item.get("token_ids") or []
-            if ids:
-                token = int(ids[0])
+            ids = [int(t) for t in (item.get("token_ids") or [])]
             out = StepOutput(
                 request_id=request.request_id,
-                token=token,
+                # wire items may carry a WINDOW of tokens (the worker-side
+                # Backend batches per decode window); surface the last for
+                # StepOutput consumers, the full list for RemoteTextBackend
+                token=ids[-1] if ids else None,
                 finished=item.get("finish_reason") is not None,
                 finish_reason=item.get("finish_reason"),
                 cached_tokens=item.get("cached_tokens", 0),
             )
+            out.all_token_ids = ids
+            out.cumulative = item.get("cumulative_tokens")
             out.text = item.get("text", "")  # pass-through for RemoteTextBackend
             out.lp_entries = item.get("logprobs")  # already OpenAI-shaped
             yield out
@@ -108,12 +110,14 @@ class RemoteTextBackend:
         )
         count = 0
         async for out in self.proxy.generate(engine_req):
-            if out.token is not None:
-                count += 1
+            ids = getattr(out, "all_token_ids", None)
+            if ids is None:
+                ids = [out.token] if out.token is not None else []
+            count = getattr(out, "cumulative", None) or (count + len(ids))
             yield BackendOutput(
                 request_id=request.request_id,
                 text=getattr(out, "text", ""),
-                token_ids=[out.token] if out.token is not None else [],
+                token_ids=ids,
                 finish_reason=out.finish_reason,
                 cumulative_tokens=count,
                 cached_tokens=out.cached_tokens,

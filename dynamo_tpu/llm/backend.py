@@ -97,21 +97,44 @@ class Backend:
         jail = _StopJail(request.stop_strings)
         count = 0
         cached = 0
-        async for step in self.engine.generate(engine_req):
-            text = ""
+        # engine windows arrive as StepOutput batches (decode_steps tokens per
+        # thread crossing); one detok + one BackendOutput per batch collapses
+        # the per-token overhead that halved HTTP-level throughput. Engines
+        # without a batched API (echo, remote proxies) stream singletons.
+        # Stop strings keep per-token granularity: a stop can complete
+        # mid-window, and token_ids/usage/logprobs must end AT the matching
+        # token, which only the per-token walk can deliver.
+        if hasattr(self.engine, "generate_batched") and not jail.stops:
+            stream = self.engine.generate_batched(engine_req)
+        else:
+            async def _singletons():
+                async for s in self.engine.generate(engine_req):
+                    yield [s]
+
+            stream = _singletons()
+        async for steps in stream:
             ids: list[int] = []
+            detok_ids: list[int] = []
             lp_entries = None
-            if step.token is not None:
-                count += 1
-                ids = [step.token]
-                # suppress eos token text
-                if not (step.finish_reason == "stop" and step.token in eos_ids):
-                    delta = decoder.step(step.token)
-                    if delta:
-                        text = delta
-                if step.logprob is not None:
-                    lp_entries = [self._logprob_entry(step)]
-            cached = max(cached, step.cached_tokens)
+            finished = False
+            finish_reason = None
+            for step in steps:
+                if step.token is not None:
+                    count += 1
+                    ids.append(step.token)
+                    # suppress eos token text
+                    if not (step.finish_reason == "stop" and step.token in eos_ids):
+                        detok_ids.append(step.token)
+                    if step.logprob is not None:
+                        if lp_entries is None:
+                            lp_entries = []
+                        lp_entries.append(self._logprob_entry(step))
+                cached = max(cached, step.cached_tokens)
+                if step.finished:
+                    finished = True
+                    finish_reason = step.finish_reason
+                    break
+            text = (decoder.step_many(detok_ids) or "") if detok_ids else ""
 
             emit, stopped = jail.push(text) if text else ("", False)
             if stopped:
@@ -125,7 +148,7 @@ class Backend:
                     logprobs=lp_entries,
                 )
                 return
-            if step.finished:
+            if finished:
                 # flush only if no stop strings were configured mid-jail; a
                 # partial stop prefix at end-of-stream is emitted (it never
                 # completed the stop sequence)
@@ -134,7 +157,7 @@ class Backend:
                     request_id=request.request_id,
                     text=emit,
                     token_ids=ids,
-                    finish_reason=step.finish_reason,
+                    finish_reason=finish_reason,
                     cumulative_tokens=count,
                     cached_tokens=cached,
                     logprobs=lp_entries,

@@ -128,6 +128,44 @@ class DecodeStream:
 
     def step(self, token_id: int) -> Optional[str]:
         self.ids.append(token_id)
+        return self._emit_stable()
+
+    def step_many(self, token_ids) -> Optional[str]:
+        """Append a window of tokens and emit the stabilized text delta in ONE
+        pair of decode calls (the per-token loop costs two tokenizer crossings
+        per token; windows arrive decode_steps at a time from the engine).
+
+        If the window's tail is mid-codepoint the whole batched delta would be
+        withheld, so fall back to per-token stepping for that window — it
+        emits everything that stabilizes and holds only the dangling bytes,
+        exactly like the per-token path."""
+        token_ids = list(token_ids)
+        if not token_ids:
+            return None
+        if len(token_ids) == 1:
+            return self.step(token_ids[0])
+        mark = len(self.ids)
+        self.ids.extend(token_ids)
+        new_text = self.tokenizer.decode(
+            self.ids[self.prefix_offset :],
+            skip_special_tokens=self.skip_special_tokens,
+        )
+        if not new_text.endswith("�"):
+            prefix_text = self.tokenizer.decode(
+                self.ids[self.prefix_offset : self.read_offset],
+                skip_special_tokens=self.skip_special_tokens,
+            )
+            if len(new_text) > len(prefix_text):
+                delta = new_text[len(prefix_text) :]
+                self.prefix_offset = self.read_offset
+                self.read_offset = len(self.ids)
+                return delta
+            return None
+        del self.ids[mark:]
+        parts = [d for d in (self.step(t) for t in token_ids) if d]
+        return "".join(parts) or None
+
+    def _emit_stable(self) -> Optional[str]:
         prefix_text = self.tokenizer.decode(
             self.ids[self.prefix_offset : self.read_offset],
             skip_special_tokens=self.skip_special_tokens,

@@ -10,6 +10,28 @@ Contract matches the pure-JAX reference (dynamo_tpu/ops/attention.py
 paged_decode_attention): q [B, Hq, D], pages [P, ps, Hkv, D],
 page_tables [B, max_pages], positions [B] (query position; context length =
 position + 1). GQA folded as [Hkv, G, D] per-kv-head batched matmuls.
+
+Kernel A/B record (v5e-1, bench headline geometry B=64 Hq16/Hkv8 D128
+ps=128 ctx=256, 24-layer chained-scan harness, best-of-4 wall time with the
+tunnel RTT cancelled; round 4):
+
+    perseq (this file's default)      4.32 ms/step   <- production
+    perseq at ps=256 (1 page/seq)     5.22 ms/step   (no DMA/compute overlap)
+    grouped ps=128 / ps=256          12.06 / 11.35 ms/step
+    chunked                          12.76 ms/step
+    fused-KV row-flat "m1" proto     10.55 ms/step
+    fused-KV row-flat grouped/chunk  11.1-12.0 ms/step
+    fused-KV [P,2ps,...] proto       17.2-21.6 ms/step
+
+The round-3 fused-pool prototypes (tools/proto_flatfused.py,
+tools/proto_fused2.py — deleted in round 4) were 2.4-5x SLOWER than perseq
+despite issuing half the DMAs: the [ps, Hkv, D] leading-index page DMA that
+perseq issues is the layout Mosaic moves fastest, and the one-page-ahead
+double buffer already hides the latency the fused variants try to batch
+away. Remaining perseq gap vs the pure KV-read floor (~2.0 ms/step at this
+geometry) is per-grid-program overhead (B programs/layer); the grouped
+variant that amortizes it loses more to its statically unrolled per-group
+compute than it saves.
 """
 
 from __future__ import annotations
