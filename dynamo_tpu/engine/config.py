@@ -19,12 +19,14 @@ class EngineConfig:
     tp: int = 1  # tensor-parallel degree over the mesh
     # sequence-parallel degree: >1 runs whole-prompt prefill as ring attention
     # over an "sp" mesh axis (long-context path; decode is unaffected).
-    # Currently composes with tp=1 only.
+    # Composes with tp (each tp head shard runs its own sp ring on the
+    # (sp, tp) mesh); not with pp.
     sp: int = 1
     # pipeline-parallel stages: >1 shards the layer stack (and its KV pages)
     # over a "pp" mesh axis and runs GPipe microbatch rotation for both
-    # prefill and decode (dynamo_tpu/parallel/pipeline.py). Exclusive with
-    # tp/sp for now; requires num_layers % pp == 0.
+    # prefill and decode (dynamo_tpu/parallel/pipeline.py). Composes with tp
+    # (Megatron head split inside each stage on the (pp, tp) mesh); not with
+    # sp. Requires num_layers % pp == 0.
     pp: int = 1
     worker_id: str = "worker-0"
     # fraction of pages that must stay free for decode growth before admitting

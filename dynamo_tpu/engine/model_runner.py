@@ -45,8 +45,16 @@ class ModelRunner:
     ):
         self.config = config
         self.model = model
-        if config.sp > 1 and (config.tp > 1 or config.pp > 1):
-            raise ValueError("sp composes with neither tp nor pp yet")
+        if config.sp > 1 and config.pp > 1:
+            raise ValueError("sp does not compose with pp yet")
+        if config.sp > 1 and config.tp > 1:
+            h = getattr(model.config, "num_heads", 0)
+            hkv = getattr(model.config, "num_kv_heads", 0)
+            if h % config.tp or hkv % config.tp:
+                raise ValueError(
+                    f"tp={config.tp} must divide num_heads={h} and "
+                    f"num_kv_heads={hkv} for the composed sp x tp mesh"
+                )
         if config.pp > 1:
             if model.config.num_layers % config.pp:
                 raise ValueError(
@@ -85,9 +93,10 @@ class ModelRunner:
                 raise ValueError(
                     f"model {type(model).__name__} has no sequence-parallel prefill"
                 )
-            if len(jax.devices()) < config.sp:
+            if len(jax.devices()) < config.sp * config.tp:
                 raise ValueError(
-                    f"sp={config.sp} but only {len(jax.devices())} devices available"
+                    f"sp={config.sp} x tp={config.tp} but only "
+                    f"{len(jax.devices())} devices available"
                 )
             if not any(b % config.sp == 0 for b in config.prefill_buckets):
                 raise ValueError(
@@ -101,6 +110,13 @@ class ModelRunner:
                 devices = jax.devices()[: config.pp * config.tp]
                 mesh = Mesh(
                     np.array(devices).reshape(config.pp, config.tp), ("pp", "tp")
+                )
+            elif config.sp > 1 and config.tp > 1:
+                # composed sequence x head mesh: each tp head shard runs its
+                # own independent sp ring (attention is head-local)
+                devices = jax.devices()[: config.sp * config.tp]
+                mesh = Mesh(
+                    np.array(devices).reshape(config.sp, config.tp), ("sp", "tp")
                 )
             elif config.pp > 1:
                 devices = jax.devices()[: config.pp]

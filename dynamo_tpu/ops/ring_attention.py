@@ -93,13 +93,22 @@ def ring_attention(
     v: jnp.ndarray,  # [T, Hkv, D]
     mesh: Mesh,
     axis: str = "sp",
+    head_axis: str | None = None,
 ) -> jnp.ndarray:
-    """Causal self-attention with the sequence sharded over mesh axis `axis`."""
+    """Causal self-attention with the sequence sharded over mesh axis `axis`.
+
+    On a composed (sp, tp) mesh the head dim additionally shards over
+    ``head_axis`` (auto-detected as "tp" when present): attention is
+    head-local, so each tp shard runs its own independent sp ring — sequence
+    and tensor parallelism compose with no extra collectives."""
+    if head_axis is None and "tp" in mesh.axis_names:
+        head_axis = "tp"
+    spec = P(axis) if head_axis is None else P(axis, head_axis)
     fn = jax.shard_map(
         partial(_ring_attention_local, axis_name=axis),
         mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis)),
-        out_specs=P(axis),
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
         check_vma=False,
     )
     return fn(q, k, v)

@@ -129,6 +129,64 @@ def test_disagg_matches_local(force_dcn, monkeypatch):
     asyncio.run(body())
 
 
+@pytest.mark.parametrize("model_id", ["tiny-mla", "tiny-moe"])
+def test_disagg_matches_local_mla_and_moe(model_id, monkeypatch):
+    """The non-Llama cache layouts cross the disagg data plane byte-exact:
+    DeepSeek MLA's latent wire format ([L, n, ps, latent_padded] — the vLLM
+    patch's deepseek_v2.py section is why the reference patch exists) and
+    Mixtral's k/v pools. Forced DCN so the KV travels as bytes, proving the
+    wire serialization, not just the same-process device handoff."""
+    from dynamo_tpu.disagg import ici
+
+    monkeypatch.setattr(ici, "is_local", lambda worker_id: False)
+
+    async def body():
+        broker = Broker()
+        port = await broker.start()
+        addr = f"127.0.0.1:{port}"
+
+        decode_rt = DistributedRuntime(cplane_address=addr)
+        await decode_rt.connect()
+        prefill_rt = DistributedRuntime(cplane_address=addr)
+        await prefill_rt.connect()
+
+        cfg = tiny_engine_config(model_id=model_id)
+        decode_inner = AsyncJaxEngine(cfg)
+        await decode_inner.start()
+        prefill_engine = AsyncJaxEngine(cfg)
+        await prefill_engine.start()
+        local_engine = AsyncJaxEngine(cfg)
+        await local_engine.start()
+
+        router = DisaggregatedRouter(
+            model_id, conf=DisaggRouterConf(max_local_prefill_length=6)
+        )
+        decode = DisaggDecodeEngine(
+            decode_inner, decode_rt, "ns", "decoder", model_id, disagg_router=router
+        )
+        await decode.start()
+        prefill_worker = PrefillWorker(prefill_engine, prefill_rt, "ns", model_id)
+        await prefill_worker.start()
+
+        try:
+            expected, _ = await collect(local_engine, req_for("ref1", LONG_PROMPT))
+            got, finish = await collect(decode, req_for("d1", LONG_PROMPT))
+            assert got == expected, f"disagg {got} != local {expected}"
+            assert finish == "length"
+            assert decode.remote_prefills == 1
+            assert prefill_worker.completed == 1
+        finally:
+            await prefill_worker.stop()
+            await decode.shutdown()
+            await prefill_engine.shutdown()
+            await local_engine.shutdown()
+            await decode_rt._shutdown_hook()
+            await prefill_rt._shutdown_hook()
+            await broker.stop()
+
+    asyncio.run(body())
+
+
 def test_disagg_tp_mismatch_prefill2_decode1():
     """Prefill worker at tp=2, decode worker at tp=1: the host-staged block
     transfer is layout-canonical, so differing mesh shardings reshard on

@@ -236,3 +236,49 @@ def test_completions_echo_with_logprobs_rejected(server):
     })
     assert status == 400
     assert "echo" in out["error"]["message"]
+
+
+def test_moe_serves_through_http():
+    """A Mixtral-geometry MoE engine behind the full HTTP stack: unary chat
+    and streamed SSE both produce tokens (the reference only reaches MoE
+    models through engine adapters; here the native engine serves them)."""
+    loop = asyncio.new_event_loop()
+
+    async def boot():
+        engine = AsyncJaxEngine(tiny_engine_config(model_id="tiny-moe"))
+        await engine.start()
+        card = card_for_model("tiny-moe")
+        service = HttpService(host="127.0.0.1", port=0)
+        service.manager.add(build_pipeline(engine, card))
+        port = await service.start()
+        return engine, service, f"http://127.0.0.1:{port}"
+
+    engine, service, url = loop.run_until_complete(boot())
+    try:
+        body = {
+            "model": card_for_model("tiny-moe").display_name,
+            "messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 6,
+            "temperature": 0,
+            "ext": {"ignore_eos": True},
+        }
+        status, out = _post(loop, url, "/v1/chat/completions", body)
+        assert status == 200
+        assert out["usage"]["completion_tokens"] == 6
+
+        async def stream():
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    url + "/v1/chat/completions", json={**body, "stream": True}
+                ) as resp:
+                    assert resp.status == 200
+                    raw = await resp.text()
+            assert raw.rstrip().endswith("data: [DONE]")
+            return raw
+
+        raw = loop.run_until_complete(stream())
+        assert '"finish_reason": "length"' in raw or '"finish_reason":"length"' in raw
+    finally:
+        loop.run_until_complete(service.stop())
+        loop.run_until_complete(engine.shutdown())
+        loop.close()

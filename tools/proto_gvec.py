@@ -1,0 +1,252 @@
+"""Prototype: vectorized-group paged decode kernel.
+
+The shipped ``grouped`` kernel amortizes per-grid-program overhead by packing
+g sequences per program but pays for it with a Python-unrolled per-sequence
+compute body (g small matmuls + g flash updates per page step). This variant
+keeps the g-sequence DMA batching and VECTORIZES the compute: one
+[g*Hkv, G, ps] batched dot_general per page step, masks/flash state carried
+as [g, Hkv, G(,D)] arrays. If the unroll (not the DMA pattern) is what made
+``grouped`` lose to ``perseq`` (4.3 vs 12.1 ms/step in the round-4 A/B), this
+should close the gap AND cut program count B -> B/g.
+
+Usage: python tools/proto_gvec.py [parity|perf G]
+"""
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, ".")
+
+_NEG_INF = -1e30
+
+
+def _kernel_gvec(
+    page_tables_ref,  # [B, max_pages] SMEM
+    lengths_ref,  # [B] SMEM
+    q_ref,  # [g, Hq, D] VMEM
+    k_hbm,  # [P, ps, Hkv, D] HBM
+    v_hbm,  # [P, ps, Hkv, D] HBM
+    out_ref,  # [g, Hq, D] VMEM
+    k_scratch,  # [2, g, ps, Hkv, D] VMEM
+    v_scratch,  # [2, g, ps, Hkv, D] VMEM
+    sems,  # [2, g, 2] DMA
+    *,
+    page_size: int,
+    group: int,
+):
+    g0 = pl.program_id(0) * group
+    ps = page_size
+    Hq, D = q_ref.shape[1], q_ref.shape[2]
+    Hkv = k_hbm.shape[2]
+    G = Hq // Hkv
+    g = group
+
+    lengths = [lengths_ref[g0 + j] for j in range(g)]
+    n_pages = [jnp.maximum(1, pl.cdiv(lengths[j], ps)) for j in range(g)]
+    max_n = n_pages[0]
+    for j in range(1, g):
+        max_n = jnp.maximum(max_n, n_pages[j])
+    # [g] vector of lengths for the vectorized masks
+    len_vec = jnp.stack(lengths)
+
+    # q: [g, Hq, D] -> [g, Hkv, G, D] (split a middle dim; minor dim intact)
+    q = q_ref[...].reshape(g, Hkv, G, D)
+    scale = 1.0 / jnp.sqrt(jnp.float32(D))
+
+    def dma(slot, j, i, which):
+        hbm, scratch = (k_hbm, k_scratch) if which == 0 else (v_hbm, v_scratch)
+        return pltpu.make_async_copy(
+            hbm.at[page_tables_ref[g0 + j, i]],
+            scratch.at[slot, j],
+            sems.at[slot, j, which],
+        )
+
+    def start_all(slot, i):
+        for j in range(g):  # static unroll of DMA issue only
+            @pl.when(i < n_pages[j])
+            def _(j=j):
+                dma(slot, j, i, 0).start()
+                dma(slot, j, i, 1).start()
+
+    def wait_all(slot, i):
+        for j in range(g):
+            @pl.when(i < n_pages[j])
+            def _(j=j):
+                dma(slot, j, i, 0).wait()
+                dma(slot, j, i, 1).wait()
+
+    start_all(0, 0)
+
+    def body(i, carry):
+        m, l, acc = carry  # [g, Hkv, G], [g, Hkv, G], [g, Hkv, G, D]
+        slot = jax.lax.rem(i, 2)
+        next_slot = jax.lax.rem(i + 1, 2)
+
+        @pl.when(i + 1 < max_n)
+        def _():
+            start_all(next_slot, i + 1)
+
+        wait_all(slot, i)
+
+        # [g, ps, Hkv, D] -> [g, Hkv, ps, D]: one middle-dim transpose,
+        # NO shape casts (Mosaic rejects merged-dim casts on TPU)
+        kt = jnp.transpose(k_scratch[slot], (0, 2, 1, 3))
+        vt = jnp.transpose(v_scratch[slot], (0, 2, 1, 3))
+
+        # ONE two-batch-dim contraction: [g, Hkv, G, ps]
+        scores = jax.lax.dot_general(
+            q, kt, (((3,), (3,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        idx = i * ps + jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, ps), 3)
+        valid = idx < len_vec[:, None, None, None]
+        scores = jnp.where(valid, scores, _NEG_INF)
+
+        chunk_max = jnp.max(scores, axis=-1)  # [g, Hkv, G]
+        new_m = jnp.maximum(m, chunk_max)
+        corr = jnp.exp(m - new_m)
+        probs = jnp.exp(scores - new_m[..., None])  # [g, Hkv, G, ps]
+        new_l = l * corr + jnp.sum(probs, axis=-1)
+        # zero V rows past the length (stale/uninitialized VMEM must not
+        # poison acc via 0 * NaN)
+        vidx = i * ps + jax.lax.broadcasted_iota(jnp.int32, (1, 1, ps, 1), 2)
+        vmask = vidx < len_vec[:, None, None, None]
+        vt = jnp.where(vmask, vt, 0)
+        # [g, Hkv, G, D] = [g, Hkv, G, ps] x [g, Hkv, ps, D]
+        chunk_out = jax.lax.dot_general(
+            probs.astype(kt.dtype), vt,
+            (((3,), (2,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32,
+        )
+        new_acc = acc * corr[..., None] + chunk_out
+        return new_m, new_l, new_acc
+
+    m0 = jnp.full((g, Hkv, G), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((g, Hkv, G), jnp.float32)
+    acc0 = jnp.zeros((g, Hkv, G, D), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, max_n, body, (m0, l0, acc0))
+
+    out = acc / jnp.maximum(l, 1e-20)[..., None]
+    out_ref[...] = out.reshape(g, Hq, D).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "group"))
+def gvec(q, k_pages, v_pages, page_tables, positions, interpret=False, group=8):
+    B, Hq, D = q.shape
+    P, ps, Hkv, _ = k_pages.shape
+    lengths = positions.astype(jnp.int32) + 1
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B // group,),
+        in_specs=[
+            pl.BlockSpec((group, Hq, D), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((group, Hq, D), lambda b, *_: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, group, ps, Hkv, D), k_pages.dtype),
+            pltpu.VMEM((2, group, ps, Hkv, D), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, group, 2)),
+        ],
+    )
+    kernel = pl.pallas_call(
+        functools.partial(_kernel_gvec, page_size=ps, group=group),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )
+    return kernel(page_tables.astype(jnp.int32), lengths, q, k_pages, v_pages)
+
+
+def parity():
+    from dynamo_tpu.ops.attention import paged_decode_attention
+
+    rng = np.random.default_rng(1)
+    B, Hq, Hkv, D, PS, P, MP = 8, 16, 8, 128, 32, 64, 8
+    k = jnp.asarray(rng.standard_normal((P, PS, Hkv, D)) * 0.3, jnp.float32)
+    v = jnp.asarray(rng.standard_normal((P, PS, Hkv, D)) * 0.3, jnp.float32)
+    q = jnp.asarray(rng.standard_normal((B, Hq, D)) * 0.3, jnp.float32)
+    for mode in ["contig", "scatter"]:
+        pt = np.zeros((B, MP), np.int32)
+        lengths = rng.integers(1, PS * MP, B)
+        for b in range(B):
+            n = -(-int(lengths[b]) // PS)
+            if mode == "contig":
+                start = rng.integers(1, P - MP)
+                pt[b, :n] = start + np.arange(n)
+            else:
+                pt[b, :n] = rng.choice(np.arange(1, P), n, replace=False)
+        positions = jnp.asarray(lengths - 1, jnp.int32)
+        ptj = jnp.asarray(pt)
+        ref = paged_decode_attention(q, k, v, ptj, positions)
+        for g in (2, 4, 8):
+            out = gvec(q, k, v, ptj, positions, interpret=True, group=g)
+            err = float(jnp.max(jnp.abs(out - ref)))
+            status = "OK " if err < 1e-3 else "FAIL"
+            print(f"{mode:8s} g={g}: max_err {err:.2e} {status}", flush=True)
+
+
+def perf(g):
+    import itertools
+
+    B, PS, Hq, Hkv, D, L = 64, 128, 16, 8, 128, 24
+    PAGES = 224
+    rng = np.random.default_rng(0)
+    LP = L * PAGES
+    q0 = jnp.asarray(rng.standard_normal((B, Hq, D)) * 0.1, jnp.bfloat16)
+    pt = np.zeros((B, 8), np.int32)
+    nxt = 1
+    for b in range(B):
+        for i in range(3):
+            pt[b, i] = nxt
+            nxt += 1
+    ptj = jnp.asarray(pt)
+    offsets = jnp.arange(L, dtype=jnp.int32) * PAGES
+    pos0 = jnp.full(B, 255, jnp.int32)
+    kp = jnp.asarray(rng.standard_normal((LP, PS, Hkv, D)) * 0.1, jnp.bfloat16)
+    vp = jnp.asarray(rng.standard_normal((LP, PS, Hkv, D)) * 0.1, jnp.bfloat16)
+
+    def harness(num_steps):
+        def fn(q, s, kpp, vpp):
+            def step(h, _):
+                def layer(hh, off):
+                    o = gvec(hh, kpp, vpp, off + ptj, pos0, group=g)
+                    return (hh + 0.0001 * o).astype(hh.dtype), ()
+                h2, _ = jax.lax.scan(layer, h, offsets)
+                return h2, ()
+            qf, _ = jax.lax.scan(step, q * s, None, length=num_steps)
+            return qf
+        return jax.jit(fn)
+
+    cnt = itertools.count()
+
+    def best_wall(jf, reps=4):
+        t0 = time.perf_counter()
+        np.asarray(jax.device_get(jf(q0, jnp.bfloat16(1.0), kp, vp)))
+        print(f"  compile+first: {time.perf_counter()-t0:.1f}s", flush=True)
+        best = float("inf")
+        for _ in range(reps):
+            s = jnp.bfloat16(1.0 + 0.0001 * next(cnt))
+            t0 = time.perf_counter()
+            np.asarray(jax.device_get(jf(q0, s, kp, vp)))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    tA = best_wall(harness(8))
+    tB = best_wall(harness(64))
+    print(f"gvec g={g}: N8 {tA*1e3:.1f}ms N64 {tB*1e3:.1f}ms -> {(tB-tA)/56*1e3:6.3f} ms/step", flush=True)
+
+
+if __name__ == "__main__":
+    if sys.argv[1] == "parity":
+        parity()
+    else:
+        perf(int(sys.argv[2]))
