@@ -28,10 +28,21 @@ tools/proto_fused2.py — deleted in round 4) were 2.4-5x SLOWER than perseq
 despite issuing half the DMAs: the [ps, Hkv, D] leading-index page DMA that
 perseq issues is the layout Mosaic moves fastest, and the one-page-ahead
 double buffer already hides the latency the fused variants try to batch
-away. Remaining perseq gap vs the pure KV-read floor (~2.0 ms/step at this
-geometry) is per-grid-program overhead (B programs/layer); the grouped
-variant that amortizes it loses more to its statically unrolled per-group
-compute than it saves.
+away.
+
+Round 4 also falsified the "per-grid-program overhead" theory with two more
+prototypes (deleted after measurement): a vectorized-group kernel (batched
+dot_general over g sequences — Mosaic's tpu.matmul supports only ONE batch
+dim, and the merged-dim shape casts that would collapse (g, Hkv) are
+rejected by infer-vector-layout) and a concat-context kernel (g sequences'
+pages in one row-contiguous scratch, one [Hkv, g*G, g*ps] matmul with
+block-diagonal masks; fully Mosaic-legal). The concat variant measured
+10.9 (g=2) and 9.8 (g=4) ms/step — still 2.3x worse than perseq. The
+correct mental model: Mosaic pipelines ACROSS grid programs, so B
+one-sequence programs overlap each other's DMAs and compute for free;
+any within-program grouping trades that away for a serialized group body.
+perseq IS the design point; the remaining ~2x over the KV-read floor is
+the price of 2-page sequences (one chunk of overlap depth).
 """
 
 from __future__ import annotations
