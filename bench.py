@@ -903,7 +903,22 @@ def _result(extra_errors: dict | None = None) -> dict:
 
 
 if __name__ == "__main__":
+    import os
     import sys
+
+    # persistent XLA compilation cache (verified working through the axon
+    # remote compiler): the bench starts 10+ engine instances with identical
+    # geometries — without this every instance re-pays ~25 s per executable
+    # over the tunnel; with it, instance N>1 deserializes from disk
+    try:
+        import jax
+
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/dyntpu_xla_cache"),
+        )
+    except Exception:
+        pass
 
     try:
         result = asyncio.run(run())
