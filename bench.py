@@ -631,6 +631,16 @@ async def run_disagg_parity(
                 import traceback
 
                 traceback.print_exc()
+        # belt: a cancelled request can race its ICI-transfer cleanup; a
+        # parked device array is ~hundreds of MB of HBM the next sections need
+        from dynamo_tpu.disagg import ici as _ici
+
+        dropped = _ici.drain_all()
+        if dropped:
+            import sys as _sys
+
+            print(f"[bench] disagg teardown dropped {dropped} parked ICI transfers",
+                  file=_sys.stderr, flush=True)
     gc.collect()
 
     projected = osl / (wp + cd)

@@ -171,44 +171,54 @@ class DisaggDecodeEngine:
         kv_token = self.kv_server.expect(rid)
         self.engine._register_stream(rid)
         adopted = False
+        pool_full = False
         try:
             # inside the protected region: the engine thread allocates pages
             # even if this coroutine is cancelled mid-await, and the abort in
             # the finally is queued behind it (FIFO), so it always cleans up
-            cached_len, shared_pages = await self.engine.run_on_engine(
-                lambda: self.engine.sync_allocate_remote(rid, prompt)
-            )
-            rp = RemotePrefillRequest(
-                request_id=rid,
-                token_ids=prompt,
-                temperature=request.sampling.temperature,
-                top_k=request.sampling.top_k,
-                top_p=request.sampling.top_p,
-                decode_worker_id=self.worker_id,
-                decode_endpoint=f"dyn://{self.namespace}.{self.component}.{PREFILL_RESULT_ENDPOINT}",
-                skip_leading_tokens=shared_pages * self.engine.config.page_size,
-                kv_addr=self.kv_server.address,
-                kv_token=kv_token,
-            )
-            await self.drt.cplane.queue_push(self.queue_name, rp.to_wire())
-            # one deadline covers BOTH waits (result notification + socket
-            # payload): charging each a full timeout would double the
-            # worst-case stall when the payload connection dies right after
-            # the notification was delivered
-            deadline = asyncio.get_running_loop().time() + self.remote_prefill_timeout
-            result: PrefillResult = await asyncio.wait_for(fut, self.remote_prefill_timeout)
-            kv_data = None
-            if result.kv_mode == "socket" and result.kv_shape:
-                # the result message is the notification; the payload rides
-                # the dedicated socket and may land just after it
-                remaining = max(0.05, deadline - asyncio.get_running_loop().time())
-                kv_data = await self.kv_server.receive(rid, timeout=remaining)
-            await self.engine.run_on_engine(
-                lambda: self.engine.sync_adopt_prefilled(
-                    request, result, cached_len, kv_data=kv_data
+            try:
+                cached_len, shared_pages = await self.engine.run_on_engine(
+                    lambda: self.engine.sync_allocate_remote(rid, prompt)
                 )
-            )
-            adopted = True
+            except MemoryError:
+                # remote-prefill allocation has no admission control (the
+                # pages must exist before the prefill worker writes into
+                # them); under page pressure fall back to the LOCAL path,
+                # whose scheduler queues the request until pages free up
+                # instead of failing it
+                pool_full = True
+            if not pool_full:
+                rp = RemotePrefillRequest(
+                    request_id=rid,
+                    token_ids=prompt,
+                    temperature=request.sampling.temperature,
+                    top_k=request.sampling.top_k,
+                    top_p=request.sampling.top_p,
+                    decode_worker_id=self.worker_id,
+                    decode_endpoint=f"dyn://{self.namespace}.{self.component}.{PREFILL_RESULT_ENDPOINT}",
+                    skip_leading_tokens=shared_pages * self.engine.config.page_size,
+                    kv_addr=self.kv_server.address,
+                    kv_token=kv_token,
+                )
+                await self.drt.cplane.queue_push(self.queue_name, rp.to_wire())
+                # one deadline covers BOTH waits (result notification + socket
+                # payload): charging each a full timeout would double the
+                # worst-case stall when the payload connection dies right
+                # after the notification was delivered
+                deadline = asyncio.get_running_loop().time() + self.remote_prefill_timeout
+                result: PrefillResult = await asyncio.wait_for(fut, self.remote_prefill_timeout)
+                kv_data = None
+                if result.kv_mode == "socket" and result.kv_shape:
+                    # the result message is the notification; the payload
+                    # rides the dedicated socket and may land just after it
+                    remaining = max(0.05, deadline - asyncio.get_running_loop().time())
+                    kv_data = await self.kv_server.receive(rid, timeout=remaining)
+                await self.engine.run_on_engine(
+                    lambda: self.engine.sync_adopt_prefilled(
+                        request, result, cached_len, kv_data=kv_data
+                    )
+                )
+                adopted = True
         finally:
             # finally (not except Exception): client cancellation raises
             # CancelledError, which must run the same cleanup — dropping any
@@ -221,6 +231,16 @@ class DisaggDecodeEngine:
                 ici.discard_transfer(tkey)
                 await self.engine.run_on_engine(lambda: self.engine.sync_abort_remote(rid))
                 self.engine._outputs.pop(rid, None)
+
+        if pool_full:
+            self.remote_prefills -= 1
+            self.local_prefills += 1
+            log.warning(
+                "decode pool full; remote prefill for %s falls back to local", rid
+            )
+            async for batch in self.engine.generate_batched(request):
+                yield batch
+            return
 
         async for batch in self.engine._drain_stream_batched(rid):
             yield batch

@@ -38,6 +38,10 @@ class MixtralConfig(LlamaConfig):
 
     @classmethod
     def tiny_moe(cls, **overrides) -> "MixtralConfig":
+        from dynamo_tpu.models.llama import parse_dtype
+
+        if "dtype" in overrides:
+            overrides["dtype"] = parse_dtype(overrides["dtype"])
         tiny = LlamaConfig.tiny()
         base = cls(
             **{f: getattr(tiny, f) for f in tiny.__dataclass_fields__},

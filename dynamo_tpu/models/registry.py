@@ -21,8 +21,39 @@ from dynamo_tpu.utils import get_logger
 
 log = get_logger("models.registry")
 
+# single-entry params cache for the synthetic "tiny*" families: colocated
+# engines serving the SAME model (disagg prefill+decode pairs, router
+# replicas, the bench's engine fleets) share one set of immutable weight
+# buffers instead of materializing a copy each — params are never donated
+# (only kv/slot_state are), and ModelRunner's device_put is a no-op when the
+# sharding already matches, so sharing is safe. One entry only (loading a
+# different model evicts the previous), and checkpoint DIRECTORIES are never
+# cached: their content can change under the same path, and pinning a real
+# model's host tree for process lifetime is not worth it. Written as one
+# atomic (key, value) tuple: load_model runs on executor threads.
+_cache: tuple | None = None  # ((model_id, seed), (model_cls, config, params))
+
+
+def _cacheable(model_id) -> bool:
+    return model_id is None or str(model_id).startswith("tiny")
+
 
 def load_model(model_id: str, seed: int = 0):
+    """Returns (model, params); for tiny-family models params may be shared
+    with other engines in this process — treat as immutable."""
+    global _cache
+    key = (model_id, seed)
+    entry = _cache
+    if entry is not None and entry[0] == key:
+        model_cls, cfg, params = entry[1]
+        return model_cls(cfg), params  # fresh model object: attn_mesh is per-engine
+    model, params = _load_model_uncached(model_id, seed)
+    if _cacheable(model_id):
+        _cache = (key, (type(model), model.config, params))
+    return model, params
+
+
+def _load_model_uncached(model_id: str, seed: int = 0):
     """Returns (model, params) on host (unsharded); caller places onto mesh."""
     if model_id is not None and (model_id == "tiny-moe" or model_id.startswith("tiny-moe:")):
         from dynamo_tpu.models.mixtral import MixtralConfig, MixtralModel

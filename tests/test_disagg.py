@@ -187,6 +187,67 @@ def test_disagg_matches_local_mla_and_moe(model_id, monkeypatch):
     asyncio.run(body())
 
 
+def test_disagg_pool_exhaustion_falls_back_to_local():
+    """Remote-prefill allocation has no admission control (pages must exist
+    before the prefill worker writes into them), so under page pressure the
+    decode worker must fall back to the LOCAL path — whose scheduler queues
+    the request until pages free — instead of failing the request with
+    MemoryError (r4 bench post-mortem: this killed the disagg parity
+    section and leaked HBM into every later section)."""
+    prompt_a = list(range(5, 25))  # 20 tokens = 5 pages at ps=4
+    prompt_b = list(range(40, 60))
+
+    async def body():
+        broker = Broker()
+        port = await broker.start()
+        addr = f"127.0.0.1:{port}"
+
+        decode_rt = DistributedRuntime(cplane_address=addr)
+        await decode_rt.connect()
+        prefill_rt = DistributedRuntime(cplane_address=addr)
+        await prefill_rt.connect()
+
+        # pool of 8 pages (7 usable): ONE 5-page sequence fits, two do not
+        tight = tiny_engine_config(num_pages=8, max_seqs=2, max_model_len=40)
+        decode_inner = AsyncJaxEngine(tight)
+        await decode_inner.start()
+        prefill_engine = AsyncJaxEngine(tiny_engine_config())
+        await prefill_engine.start()
+        local_engine = AsyncJaxEngine(tiny_engine_config())
+        await local_engine.start()
+
+        router = DisaggregatedRouter(
+            "tiny", conf=DisaggRouterConf(max_local_prefill_length=6)
+        )
+        decode = DisaggDecodeEngine(
+            decode_inner, decode_rt, "ns", "decoder", "tiny", disagg_router=router
+        )
+        await decode.start()
+        prefill_worker = PrefillWorker(prefill_engine, prefill_rt, "ns", "tiny")
+        await prefill_worker.start()
+
+        try:
+            exp_a, _ = await collect(local_engine, req_for("ra", prompt_a))
+            exp_b, _ = await collect(local_engine, req_for("rb", prompt_b))
+            (got_a, _), (got_b, _) = await asyncio.gather(
+                collect(decode, req_for("da", prompt_a)),
+                collect(decode, req_for("db", prompt_b)),
+            )
+            assert got_a == exp_a and got_b == exp_b
+            # at least one request had to take the local-fallback path
+            assert decode.local_prefills >= 1
+        finally:
+            await prefill_worker.stop()
+            await decode.shutdown()
+            await prefill_engine.shutdown()
+            await local_engine.shutdown()
+            await decode_rt._shutdown_hook()
+            await prefill_rt._shutdown_hook()
+            await broker.stop()
+
+    asyncio.run(body())
+
+
 def test_disagg_tp_mismatch_prefill2_decode1():
     """Prefill worker at tp=2, decode worker at tp=1: the host-staged block
     transfer is layout-canonical, so differing mesh shardings reshard on
