@@ -35,7 +35,16 @@ _cache: tuple | None = None  # ((model_id, seed), (model_cls, config, params))
 
 
 def _cacheable(model_id) -> bool:
-    return model_id is None or str(model_id).startswith("tiny")
+    # exactly the synthetic tiny-family forms _load_model_uncached special-
+    # cases — NOT any path that merely starts with "tiny" (a checkpoint dir
+    # named tinyllama-1.1b/ must never be cached: its content can change)
+    if model_id is None:
+        return True
+    s = str(model_id)
+    for fam in ("tiny", "tiny-moe", "tiny-mla", "tiny-vl"):
+        if s == fam or s.startswith(fam + ":"):
+            return True
+    return False
 
 
 def load_model(model_id: str, seed: int = 0):
