@@ -987,15 +987,9 @@ if __name__ == "__main__":
     # remote compiler): the bench starts 10+ engine instances with identical
     # geometries — without this every instance re-pays ~25 s per executable
     # over the tunnel; with it, instance N>1 deserializes from disk
-    try:
-        import jax
+    from dynamo_tpu.utils.xla_cache import enable_compilation_cache
 
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/dyntpu_xla_cache"),
-        )
-    except Exception:
-        pass
+    enable_compilation_cache()
 
     try:
         result = asyncio.run(run())

@@ -21,18 +21,21 @@ log = get_logger("components.prefill")
 
 async def _main(args) -> None:
     from dynamo_tpu.parallel.mesh import init_multihost
+    from dynamo_tpu.utils.xla_cache import enable_compilation_cache
 
+    enable_compilation_cache()  # engine restarts reload executables from disk
     init_multihost()  # no-op unless DYNTPU_COORDINATOR is set
     from dynamo_tpu.disagg.prefill_worker import PrefillWorker
     from dynamo_tpu.engine.config import EngineConfig
     from dynamo_tpu.engine.engine import AsyncJaxEngine
     from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.models.registry import is_tiny_family
     from dynamo_tpu.runtime.distributed import DistributedRuntime
 
     drt = DistributedRuntime(cplane_address=args.cplane)
     await drt.connect()
 
-    if args.model.startswith("tiny"):
+    if is_tiny_family(args.model):
         card = ModelDeploymentCard.for_tiny(args.model)
     else:
         card = ModelDeploymentCard.from_local_path(args.model)

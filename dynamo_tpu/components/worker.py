@@ -137,14 +137,18 @@ class WorkerService:
 async def _main(args) -> None:
     from dynamo_tpu.engine.config import EngineConfig
     from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.utils.xla_cache import enable_compilation_cache
 
     from dynamo_tpu.parallel.mesh import init_multihost
 
+    enable_compilation_cache()  # engine restarts reload executables from disk
     init_multihost()  # no-op unless DYNTPU_COORDINATOR is set
 
     drt = DistributedRuntime(cplane_address=args.cplane)
     await drt.connect()
-    if args.model.startswith("tiny"):
+    from dynamo_tpu.models.registry import is_tiny_family
+
+    if is_tiny_family(args.model):
         card = ModelDeploymentCard.for_tiny(args.model)
     else:
         card = ModelDeploymentCard.from_local_path(args.model)

@@ -29,7 +29,9 @@ def build_pipeline(engine, card: ModelDeploymentCard) -> ModelPipeline:
 
 
 def card_for_model(model_id: str | None, max_model_len: int | None = None) -> ModelDeploymentCard:
-    if model_id is None or model_id.startswith(("tiny", "tiny-moe")):
+    from dynamo_tpu.models.registry import is_tiny_family
+
+    if is_tiny_family(model_id):
         card = ModelDeploymentCard.for_tiny(model_id or "tiny")
         card.model_path = model_id or "tiny"
     else:

@@ -15,6 +15,9 @@ log = get_logger("launch")
 
 
 def run_command(args) -> int:
+    from dynamo_tpu.utils.xla_cache import enable_compilation_cache
+
+    enable_compilation_cache()
     asyncio.run(_run(args))
     return 0
 

@@ -34,10 +34,11 @@ log = get_logger("models.registry")
 _cache: tuple | None = None  # ((model_id, seed), (model_cls, config, params))
 
 
-def _cacheable(model_id) -> bool:
-    # exactly the synthetic tiny-family forms _load_model_uncached special-
-    # cases — NOT any path that merely starts with "tiny" (a checkpoint dir
-    # named tinyllama-1.1b/ must never be cached: its content can change)
+def is_tiny_family(model_id) -> bool:
+    """Exactly the synthetic tiny-family forms this registry special-cases —
+    NOT any path that merely starts with "tiny": a checkpoint directory named
+    tinyllama-1.1b/ is a real model and must be treated as one (not cached
+    here, not given the byte-tokenizer tiny card by callers)."""
     if model_id is None:
         return True
     s = str(model_id)
@@ -45,6 +46,9 @@ def _cacheable(model_id) -> bool:
         if s == fam or s.startswith(fam + ":"):
             return True
     return False
+
+
+_cacheable = is_tiny_family
 
 
 def load_model(model_id: str, seed: int = 0):

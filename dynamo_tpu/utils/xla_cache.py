@@ -1,0 +1,40 @@
+"""Persistent XLA compilation cache bootstrap for engine processes.
+
+An engine restart otherwise re-pays every executable's compile (~25 s per
+executable on remote-compile platforms); with the cache, executables
+deserialize from disk. One shared helper so every long-lived engine
+entrypoint (run CLI, worker, prefill worker) behaves the same.
+"""
+
+from __future__ import annotations
+
+import os
+
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("utils.xla_cache")
+
+
+def enable_compilation_cache() -> None:
+    """Point JAX at a persistent compilation cache directory.
+
+    ``JAX_COMPILATION_CACHE_DIR`` overrides the default (set it empty to
+    disable). The default is per-user: a fixed path in shared /tmp would be
+    unwritable for the second user on a host — and poisonable by the first.
+    """
+    default = os.path.join(
+        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+        "dynamo_tpu", "xla_cache",
+    )
+    path = os.environ.get("JAX_COMPILATION_CACHE_DIR", default)
+    if not path:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+    except Exception:
+        log.warning(
+            "persistent compilation cache unavailable (path %s); engine "
+            "restarts will recompile every executable", path, exc_info=True,
+        )
