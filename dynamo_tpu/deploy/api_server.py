@@ -44,6 +44,20 @@ class DeploymentStore:
     def __init__(self):
         self._data: dict[str, list[dict]] = {}
         self._status: dict[str, dict] = {}  # controller-written status
+        self._builds: dict[str, dict] = {}  # image-build records
+
+    def put_build(self, name: str, record: dict) -> None:
+        self._builds[name] = record
+        self._flush_build(name)
+
+    def get_build(self, name: str) -> Optional[dict]:
+        return self._builds.get(name)
+
+    def list_builds(self) -> list[str]:
+        return sorted(self._builds)
+
+    def _flush_build(self, name: str) -> None:
+        pass
 
     def list(self) -> list[str]:
         return sorted(self._data)
@@ -121,6 +135,12 @@ class SqliteDeploymentStore(DeploymentStore):
                 "CREATE TABLE IF NOT EXISTS status ("
                 " name TEXT PRIMARY KEY, status TEXT NOT NULL)"
             )
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS builds ("
+                " name TEXT PRIMARY KEY, record TEXT NOT NULL)"
+            )
+        for name, record in self._db.execute("SELECT name, record FROM builds"):
+            self._builds[name] = json.loads(record)
         for name, revision, created_at, spec in self._db.execute(
             "SELECT name, revision, created_at, spec FROM revisions"
             " ORDER BY name, revision"
@@ -165,6 +185,14 @@ class SqliteDeploymentStore(DeploymentStore):
                 (name, json.dumps(status)),
             )
 
+    def _flush_build(self, name: str) -> None:
+        with self._db:
+            self._db.execute(
+                "INSERT INTO builds (name, record) VALUES (?, ?)"
+                " ON CONFLICT(name) DO UPDATE SET record = excluded.record",
+                (name, json.dumps(self._builds[name])),
+            )
+
     def close(self) -> None:
         self._db.close()
 
@@ -189,6 +217,9 @@ class DeployApiServer:
                 web.post("/api/v1/deployments/{name}/rollback/{rev}", self._rollback),
                 web.get("/api/v1/deployments/{name}/manifests", self._manifests),
                 web.get("/api/v1/deployments/{name}/status", self._status),
+                web.post("/api/v1/builds", self._create_build),
+                web.get("/api/v1/builds", self._list_builds),
+                web.get("/api/v1/builds/{name}", self._get_build),
             ]
         )
         self._runner: Optional[web.AppRunner] = None
@@ -309,6 +340,74 @@ class DeployApiServer:
         name, head = self._head_or_404(request)
         spec = DeploymentSpec.from_dict(head["spec"])
         return web.json_response({"name": name, "manifests": render_manifests(spec)})
+
+    # ---------------- image builds (the DynamoNimRequest slot) ----------------
+
+    async def _create_build(self, request: web.Request) -> web.Response:
+        """Register an image build for a packaged artifact: records the
+        request and renders the in-cluster build Job (reference:
+        dynamonimrequest_controller.go builds images from packaged
+        artifacts). The controller applies the Job on its next pass."""
+        from dynamo_tpu.deploy.reconciler import render_build_job
+
+        try:
+            body = await request.json()
+        except json.JSONDecodeError as e:
+            return web.json_response({"error": f"bad json: {e}"}, status=400)
+        if not isinstance(body, dict):
+            return web.json_response({"error": "body must be a JSON object"}, status=400)
+        name = body.get("name")
+        image = body.get("image")
+        context = body.get("context")
+        if not (name and image and context):
+            return web.json_response(
+                {"error": "name, image, and context are required"}, status=422
+            )
+        import re
+
+        if not re.fullmatch(r"[a-z0-9]([a-z0-9-]{0,50}[a-z0-9])?", str(name)):
+            # the name becomes a Kubernetes Job name: enforce DNS-1123 here,
+            # or the controller would log an apply error every pass forever
+            return web.json_response(
+                {"error": f"name {name!r} must be DNS-1123 (lowercase alnum + '-')"},
+                status=422,
+            )
+        job = render_build_job(
+            name, image, context,
+            namespace=body.get("namespace", "default"),
+            builder_image=body.get(
+                "builder_image", "gcr.io/kaniko-project/executor:latest"
+            ),
+        )
+        record = {
+            "name": name,
+            "image": image,
+            "context": context,
+            "namespace": body.get("namespace", "default"),
+            "created_at": time.time(),
+            "phase": "pending",
+            "job": job,
+        }
+        self.store.put_build(name, record)
+        self._kick()
+        return web.json_response({"name": name, "phase": "pending"}, status=201)
+
+    async def _list_builds(self, request: web.Request) -> web.Response:
+        items = []
+        for name in self.store.list_builds():
+            rec = self.store.get_build(name)
+            items.append({"name": name, "image": rec["image"], "phase": rec["phase"]})
+        return web.json_response({"builds": items})
+
+    async def _get_build(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        rec = self.store.get_build(name)
+        if rec is None:
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": f"build {name} not found"}),
+                content_type="application/json",
+            )
+        return web.json_response(rec)
 
 
 def main(argv: Optional[list[str]] = None) -> int:

@@ -75,7 +75,16 @@ class FakeCluster:
         import copy
 
         key = self._key(obj)
-        self.objects[key] = copy.deepcopy(obj)
+        obj = copy.deepcopy(obj)
+        if obj["kind"] == "Job" and "status" not in obj:
+            # the fake has no job controller: simulate instant success so the
+            # build reconciler exercises the same condition-reading path a
+            # real cluster drives
+            obj["status"] = {
+                "succeeded": 1,
+                "conditions": [{"type": "Complete", "status": "True"}],
+            }
+        self.objects[key] = obj
         self.applied.append(key)
 
     async def delete(self, kind: str, namespace: str, name: str) -> None:
@@ -105,7 +114,7 @@ class KubectlCluster:
         import json
 
         out = await self._run(
-            "get", "deployments,statefulsets,services,horizontalpodautoscalers",
+            "get", "deployments,statefulsets,services,horizontalpodautoscalers,jobs",
             "-n", namespace, "-l", f"app.kubernetes.io/managed-by={MANAGED_BY}",
             "-o", "json",
         )
@@ -115,7 +124,7 @@ class KubectlCluster:
         import json
 
         out = await self._run(
-            "get", "deployments,statefulsets,services,horizontalpodautoscalers",
+            "get", "deployments,statefulsets,services,horizontalpodautoscalers,jobs",
             "--all-namespaces", "-l", f"app.kubernetes.io/managed-by={MANAGED_BY}",
             "-o", "json",
         )
@@ -183,10 +192,52 @@ class DeployController:
 
     # ---------------- one reconcile pass ----------------
 
+    async def _converge_builds(self) -> None:
+        """Apply pending image-build Jobs and track their completion (the
+        DynamoNimRequest reconcile slot). The Job object's cluster state is
+        the source of truth: once its status reports success the build is
+        complete and the recorded image tag is usable by deployments."""
+        for name in self.store.list_builds():
+            rec = self.store.get_build(name)
+            if rec is None:
+                continue
+            if rec["phase"] == "pending":
+                try:
+                    await self.cluster.apply(rec["job"])
+                except Exception:
+                    log.exception("build job apply failed for %s", name)
+                    continue
+                rec = {**rec, "phase": "building", "job_applied_at": time.time()}
+                self.store.put_build(name, rec)
+            if rec["phase"] == "building":
+                job_name = rec["job"]["metadata"]["name"]
+                ns = rec["job"]["metadata"]["namespace"]
+                for obj in await self.cluster.list_objects(ns):
+                    if (
+                        obj.get("kind") == "Job"
+                        and obj["metadata"]["name"] == job_name
+                    ):
+                        # the Job's terminal CONDITIONS are the signal — pod
+                        # counts lie (a retry that succeeds leaves failed > 0,
+                        # and status is empty before the job controller runs)
+                        conds = {
+                            c.get("type"): c.get("status")
+                            for c in obj.get("status", {}).get("conditions", [])
+                        }
+                        if conds.get("Complete") == "True":
+                            self.store.put_build(
+                                name,
+                                {**rec, "phase": "complete", "completed_at": time.time()},
+                            )
+                        elif conds.get("Failed") == "True":
+                            self.store.put_build(name, {**rec, "phase": "failed"})
+                        break
+
     async def converge_once(self) -> dict[str, dict]:
         """Converge every deployment in the store; returns per-name action
         counts (for tests/observability)."""
         self.passes += 1
+        await self._converge_builds()
         summary: dict[str, dict] = {}
         names = set(self.store.list())
         for name in sorted(names):
@@ -238,6 +289,9 @@ class DeployController:
             head = self.store.head(head_name)
             if head is not None:
                 sweep_namespaces.add(head["spec"].get("namespace", "default"))
+        # image-build Jobs are owned by BUILD records, not deployment heads:
+        # their part-of must not read as an orphaned deployment
+        build_owners = set(self.store.list_builds())
         for ns in sorted(sweep_namespaces):
             for obj in await self.cluster.list_objects(ns):
                 labels = obj.get("metadata", {}).get("labels", {})
@@ -246,6 +300,10 @@ class DeployController:
                     labels.get("app.kubernetes.io/managed-by") == MANAGED_BY
                     and owner is not None
                     and owner not in names
+                    and not (
+                        owner in build_owners
+                        and labels.get("dynamo-tpu/component") == "image-build"
+                    )
                 ):
                     meta = obj["metadata"]
                     await self.cluster.delete(obj["kind"], meta["namespace"], meta["name"])

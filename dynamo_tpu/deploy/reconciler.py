@@ -273,6 +273,58 @@ def manifests_yaml(spec: DeploymentSpec) -> str:
     return "\n---\n".join(yaml.safe_dump(o, sort_keys=False) for o in render_manifests(spec))
 
 
+def render_build_job(
+    name: str,
+    image: str,
+    context: str,
+    namespace: str = "default",
+    builder_image: str = "gcr.io/kaniko-project/executor:latest",
+) -> dict:
+    """In-cluster image-build Job for a packaged artifact (the reference's
+    DynamoNimRequest image-build slot: its operator renders kaniko/buildkit
+    Jobs from packaged artifacts — reference: deploy/dynamo/operator/internal/
+    controller/dynamonimrequest_controller.go). ``context`` is the artifact
+    location (a registry-hosted tar, git URL, or PVC-mounted path) holding
+    the Containerfile `dynamo-tpu build` emitted; ``image`` is the
+    destination tag the deployment's services will run."""
+    job_name = f"{name}-image-build"
+    return {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": {
+            "name": job_name,
+            "namespace": namespace,
+            "labels": {
+                "app.kubernetes.io/name": job_name,
+                "app.kubernetes.io/part-of": name,
+                "app.kubernetes.io/managed-by": MANAGED_BY,
+                "dynamo-tpu/component": "image-build",
+            },
+        },
+        "spec": {
+            "backoffLimit": 2,
+            "ttlSecondsAfterFinished": 3600,
+            "template": {
+                "metadata": {"labels": {"app.kubernetes.io/part-of": name}},
+                "spec": {
+                    "restartPolicy": "Never",
+                    "containers": [
+                        {
+                            "name": "build",
+                            "image": builder_image,
+                            "args": [
+                                f"--context={context}",
+                                "--dockerfile=Containerfile",
+                                f"--destination={image}",
+                            ],
+                        }
+                    ],
+                },
+            },
+        },
+    }
+
+
 def _key(obj: dict) -> tuple:
     return (obj["kind"], obj["metadata"]["namespace"], obj["metadata"]["name"])
 

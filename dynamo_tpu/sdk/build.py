@@ -12,6 +12,13 @@ contract**, not a code archive:
                         rendered from the graph: `dynamo-tpu deploy create` or
                         the K8s reconciler consume it directly
     config.yaml       — the service YAML config, copied verbatim (when given)
+    Containerfile     — image-build recipe for the artifact (the reference's
+                        DynamoNimRequest image-build slot, reference:
+                        deploy/dynamo/operator/internal/controller/
+                        dynamonimrequest_controller.go): `docker build` /
+                        kaniko produce the image every service in
+                        deployment.yaml runs; the deploy API's /builds
+                        endpoint renders the corresponding in-cluster Job
 
 Per-service replicas/chips resolve exactly like the serve supervisor does
 (meta defaults overridden by the YAML section), so a built artifact deploys
@@ -102,8 +109,59 @@ def build_artifact(
     (out / "deployment.yaml").write_text(yaml.safe_dump(spec.to_dict(), sort_keys=False))
     if config_file:
         shutil.copyfile(config_file, out / "config.yaml")
+    _copy_entry_source(entry_spec, out)
+    (out / "Containerfile").write_text(render_containerfile(entry_spec))
+    (out / ".dockerignore").write_text("__pycache__/\n*.pyc\n.git/\n")
     log.info("built %s -> %s (%d services)", entry_spec, out, len(spec.services))
     return out
+
+
+def _copy_entry_source(entry_spec: str, out: Path) -> None:
+    """Vendor the graph's entry code into the artifact under src/: the wheel
+    only ships dynamo_tpu*, so the user's graph module must ride along or
+    the container's `python -m ... <module>` dies with ModuleNotFoundError."""
+    import importlib
+
+    root_pkg = entry_spec.split(":", 1)[0].split(".", 1)[0]
+    if root_pkg.startswith("dynamo_tpu"):
+        return  # already in the installed wheel
+    mod = importlib.import_module(root_pkg)
+    src = Path(mod.__file__)
+    dst = out / "src"
+    dst.mkdir(exist_ok=True)
+    if src.name == "__init__.py":  # package: copy the tree
+        shutil.copytree(
+            src.parent, dst / root_pkg, dirs_exist_ok=True,
+            ignore=shutil.ignore_patterns("__pycache__", "*.pyc"),
+        )
+    else:  # single-module entry
+        shutil.copyfile(src, dst / src.name)
+
+
+def render_containerfile(entry_spec: str) -> str:
+    """Image recipe for the artifact: the framework plus the graph's entry
+    code (vendored under src/ by build_artifact), with per-service commands
+    supplied by the Deployment manifests (deployment.yaml's command fields
+    override CMD). Built by `docker build` locally or by the Job the deploy
+    API renders (POST /api/v1/builds)."""
+    module = entry_spec.split(":", 1)[0]
+    return (
+        "# syntax=docker/dockerfile:1\n"
+        "FROM python:3.12-slim\n"
+        "WORKDIR /app\n"
+        "# the whole artifact (manifest, deployment.yaml, vendored src/,\n"
+        "# optional wheels) — COPY with a glob that can match nothing is a\n"
+        "# hard error in docker/kaniko, so copy the directory and branch\n"
+        "COPY . /app/artifact/\n"
+        "RUN if ls /app/artifact/*.whl >/dev/null 2>&1; then \\\n"
+        "      pip install --no-cache-dir /app/artifact/*.whl; \\\n"
+        "    else \\\n"
+        "      pip install --no-cache-dir dynamo-tpu; \\\n"
+        "    fi\n"
+        "ENV PYTHONUNBUFFERED=1 PYTHONPATH=/app/artifact/src\n"
+        "# default: run the entry service; Deployments override per service\n"
+        f"CMD [\"python\", \"-m\", \"dynamo_tpu.sdk.serve_worker\", \"{module}\"]\n"
+    )
 
 
 def main(argv=None) -> int:

@@ -523,3 +523,65 @@ def test_restart_gc_sweeps_foreign_namespaces():
         assert ("Deployment", "prod", "ghost-worker") in cluster.deleted
 
     asyncio.run(run())
+
+
+def test_image_build_flow(tmp_path):
+    """The DynamoNimRequest slot end-to-end: `dynamo-tpu build` emits a
+    Containerfile into the artifact, POST /api/v1/builds renders the
+    in-cluster kaniko Job, and the controller applies it and tracks the
+    build to completion — durable across an API-server restart."""
+    import asyncio as _asyncio
+
+    from dynamo_tpu.deploy.api_server import SqliteDeploymentStore
+    from dynamo_tpu.deploy.controller import DeployController, FakeCluster
+    from dynamo_tpu.sdk.build import build_artifact
+
+    out = build_artifact("examples.hello_world:Frontend", str(tmp_path / "art"))
+    cf = (out / "Containerfile").read_text()
+    assert "FROM python" in cf and "Containerfile" not in cf.split("FROM")[0]
+    assert (out / "deployment.yaml").exists()
+
+    path = tmp_path / "deploy.db"
+
+    async def run():
+        store = SqliteDeploymentStore(path)
+        cluster = FakeCluster()
+        server = DeployApiServer(store)
+        port = await server.start()
+        base = f"http://127.0.0.1:{port}"
+        controller = DeployController(store, cluster, interval=30.0)
+        try:
+            status, body = await _json(None, "POST", f"{base}/api/v1/builds", {
+                "name": "hello", "image": "registry/hello:v1",
+                "context": f"dir://{out}",
+            })
+            assert (status, body["phase"]) == (201, "pending")
+
+            await controller.converge_once()
+            status, rec = await _json(None, "GET", f"{base}/api/v1/builds/hello")
+            assert status == 200
+            assert rec["phase"] in ("building", "complete")
+            # the rendered Job reached the cluster
+            jobs = [o for o in await cluster.list_objects("default") if o["kind"] == "Job"]
+            assert jobs and jobs[0]["metadata"]["name"] == "hello-image-build"
+            assert any("registry/hello:v1" in a for a in
+                       jobs[0]["spec"]["template"]["spec"]["containers"][0]["args"])
+
+            await controller.converge_once()
+            _, rec = await _json(None, "GET", f"{base}/api/v1/builds/hello")
+            assert rec["phase"] == "complete"
+
+            status, listing = await _json(None, "GET", f"{base}/api/v1/builds")
+            assert [b["name"] for b in listing["builds"]] == ["hello"]
+        finally:
+            await server.stop()
+            store.close()
+
+        # restart: the build record (incl. completion) survives
+        store2 = SqliteDeploymentStore(path)
+        try:
+            assert store2.get_build("hello")["phase"] == "complete"
+        finally:
+            store2.close()
+
+    _asyncio.run(run())
