@@ -14,17 +14,20 @@ v5e HBM BW 819 GB/s -> ~330 weight-bound steps/s ceiling; at batch 8 a
 well-tuned serving stack should clear ~1000 out tok/s/chip.
 
 Run-to-run variance: the tunneled PJRT link drifts; identical code measured
-2900-5700 tok/s on the headline config across sessions (every section moves
-proportionally — compare the continuity config against r01_value_bs8 to
-separate environment drift from real regressions).
+2900-6400 tok/s on the headline config across sessions, with occasional
+multi-second stalls mid-run (every section moves proportionally — compare
+the continuity config against r01_value_bs8 to separate environment drift
+from real regressions). Sections therefore prefer DETERMINISTIC signals
+(recompute token counts, restored-block counts) priced at in-section
+measured rates over raw wall medians wherever a ratio is the deliverable.
 
-Round-2 profile (jax.profiler on-device, per decode step at bs64/ps64):
-matmul fusions ~2.9 ms (at the weight-read roofline), paged-attention Pallas
-kernel ~4.5 ms (per-DMA scalar-core sequencing + per-grid-program overhead —
-the remaining known gap; page_size 16 -> 64 already cut its DMA count 4x),
-sampler ~0 (lax.cond skips sort/RNG for greedy and filterless slots). The
-headline config batches 64 sequences so weight reads amortize; bs=8 is kept
-as a secondary round-over-round continuity metric.
+Round-4 decomposition (tunnel-RTT-cancelling chained scans, per decode step
+at bs64/ps128/ctx192): full window 7.7 ms (65% of the 5.05 ms weight+KV HBM
+floor), sampling+feedback ~0, paged-attention perseq kernel 4.3 ms (vs the
+~2.0 ms pure KV-read floor; every grouped/fused kernel alternative measured
+2.3-5x SLOWER — see ops/pallas/paged_attention.py for the full A/B record).
+The headline config batches 64 sequences so weight reads amortize; bs=8 is
+kept as a secondary round-over-round continuity metric.
 """
 
 from __future__ import annotations
