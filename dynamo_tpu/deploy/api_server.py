@@ -106,10 +106,30 @@ class FileDeploymentStore(DeploymentStore):
         super().__init__()
         self._path = Path(path)
         if self._path.exists():
-            self._data = json.loads(self._path.read_text())
+            loaded = json.loads(self._path.read_text())
+            # new format always writes BOTH keys with revisions a dict; a
+            # legacy file could legitimately hold a deployment named
+            # "revisions" (valid DNS-1123), so require the full shape
+            if (
+                isinstance(loaded, dict)
+                and isinstance(loaded.get("revisions"), dict)
+                and "builds" in loaded
+            ):
+                self._data = loaded["revisions"]
+                self._builds = loaded.get("builds", {})
+            else:
+                # pre-builds format: the whole file is the revisions map
+                self._data = loaded
 
     def _flush(self) -> None:
-        self._path.write_text(json.dumps(self._data))
+        self._path.write_text(
+            json.dumps({"revisions": self._data, "builds": self._builds})
+        )
+
+    def _flush_build(self, name: str) -> None:
+        # builds must survive restarts too (they used to silently vanish:
+        # only revisions were written to the JSON file)
+        self._flush()
 
 
 class SqliteDeploymentStore(DeploymentStore):
@@ -365,16 +385,38 @@ class DeployApiServer:
             )
         import re
 
-        if not re.fullmatch(r"[a-z0-9]([a-z0-9-]{0,50}[a-z0-9])?", str(name)):
+        dns1123 = r"[a-z0-9]([a-z0-9-]{0,50}[a-z0-9])?"
+        # 51-char cap: the rendered Job is named f"{name}-image-build"
+        # (+12 chars) and must stay within Kubernetes' 63-char name/label limit
+        if not re.fullmatch(r"[a-z0-9]([a-z0-9-]{0,49}[a-z0-9])?", str(name)):
             # the name becomes a Kubernetes Job name: enforce DNS-1123 here,
             # or the controller would log an apply error every pass forever
             return web.json_response(
-                {"error": f"name {name!r} must be DNS-1123 (lowercase alnum + '-')"},
+                {"error": f"name {name!r} must be DNS-1123 (lowercase alnum + '-', "
+                          "<= 51 chars)"},
                 status=422,
+            )
+        namespace = body.get("namespace", "default")
+        if not re.fullmatch(dns1123, str(namespace)):
+            # same failure mode as a bad name: the Job's namespace rides
+            # straight into kubectl apply on every controller pass
+            return web.json_response(
+                {"error": f"namespace {namespace!r} must be DNS-1123 (lowercase alnum + '-')"},
+                status=422,
+            )
+        existing = self.store.get_build(name)
+        if existing is not None and existing.get("phase") in ("pending", "building"):
+            # re-POSTing over an in-flight build would reset it to 'pending'
+            # and make the controller re-apply the Job on top of the running
+            # one; terminal builds (failed OR complete) may be replaced — a
+            # rebuild with a fixed Containerfile is a normal workflow
+            return web.json_response(
+                {"error": f"build {name} already exists (phase={existing.get('phase')})"},
+                status=409,
             )
         job = render_build_job(
             name, image, context,
-            namespace=body.get("namespace", "default"),
+            namespace=namespace,
             builder_image=body.get(
                 "builder_image", "gcr.io/kaniko-project/executor:latest"
             ),
@@ -383,7 +425,7 @@ class DeployApiServer:
             "name": name,
             "image": image,
             "context": context,
-            "namespace": body.get("namespace", "default"),
+            "namespace": namespace,
             "created_at": time.time(),
             "phase": "pending",
             "job": job,

@@ -148,10 +148,17 @@ class KubectlCluster:
 class DeployController:
     """Poll the store's head revisions, converge the cluster, write status."""
 
-    def __init__(self, store, cluster: ClusterApi, interval: float = 2.0):
+    def __init__(self, store, cluster: ClusterApi, interval: float = 2.0,
+                 build_job_grace_s: float = 60.0, build_job_max_reapplies: int = 3):
         self.store = store
         self.cluster = cluster
         self.interval = interval
+        # a 'building' record whose Job object vanished (TTL GC while the
+        # controller was down, out-of-band kubectl delete) must not wedge
+        # forever: after the grace period re-apply the Job, and after
+        # max_reapplies give up and mark the build failed
+        self.build_job_grace_s = build_job_grace_s
+        self.build_job_max_reapplies = build_job_max_reapplies
         self._task: Optional[asyncio.Task] = None
         self._kick = asyncio.Event()
         # deployments this controller has managed: name -> namespace; needed
@@ -203,6 +210,11 @@ class DeployController:
                 continue
             if rec["phase"] == "pending":
                 try:
+                    # a replacement build reuses the Job name and k8s Jobs have
+                    # an immutable spec.template: clear any prior Job first
+                    # (delete is ignore-not-found, so fresh builds are a no-op)
+                    meta = rec["job"]["metadata"]
+                    await self.cluster.delete("Job", meta["namespace"], meta["name"])
                     await self.cluster.apply(rec["job"])
                 except Exception:
                     log.exception("build job apply failed for %s", name)
@@ -212,11 +224,13 @@ class DeployController:
             if rec["phase"] == "building":
                 job_name = rec["job"]["metadata"]["name"]
                 ns = rec["job"]["metadata"]["namespace"]
+                found = False
                 for obj in await self.cluster.list_objects(ns):
                     if (
                         obj.get("kind") == "Job"
                         and obj["metadata"]["name"] == job_name
                     ):
+                        found = True
                         # the Job's terminal CONDITIONS are the signal — pod
                         # counts lie (a retry that succeeds leaves failed > 0,
                         # and status is empty before the job controller runs)
@@ -232,6 +246,40 @@ class DeployController:
                         elif conds.get("Failed") == "True":
                             self.store.put_build(name, {**rec, "phase": "failed"})
                         break
+                if not found:
+                    # Job vanished before a terminal condition was observed
+                    # (ttlSecondsAfterFinished GC while the controller was
+                    # down, or out-of-band deletion): after the grace period
+                    # re-apply it; after max_reapplies the build fails rather
+                    # than wedging in 'building' permanently
+                    age = time.time() - rec.get("job_applied_at", 0)
+                    if age > self.build_job_grace_s:
+                        reapplies = rec.get("job_reapplies", 0)
+                        if reapplies >= self.build_job_max_reapplies:
+                            log.warning(
+                                "build %s: job %s/%s missing after %d re-applies; failing",
+                                name, ns, job_name, reapplies,
+                            )
+                            self.store.put_build(
+                                name, {**rec, "phase": "failed",
+                                       "failure": "build Job disappeared before completion"},
+                            )
+                        else:
+                            log.warning(
+                                "build %s: job %s/%s missing %.0fs after apply; re-applying",
+                                name, ns, job_name, age,
+                            )
+                            # count the ATTEMPT before applying: a permanently
+                            # failing apply (namespace gone) must still burn
+                            # through max_reapplies and reach 'failed' rather
+                            # than retrying forever
+                            rec = {**rec, "job_applied_at": time.time(),
+                                   "job_reapplies": reapplies + 1}
+                            self.store.put_build(name, rec)
+                            try:
+                                await self.cluster.apply(rec["job"])
+                            except Exception:
+                                log.exception("build job re-apply failed for %s", name)
 
     async def converge_once(self) -> dict[str, dict]:
         """Converge every deployment in the store; returns per-name action

@@ -48,8 +48,16 @@ class ModelRunner:
         if config.sp > 1 and config.pp > 1:
             raise ValueError("sp does not compose with pp yet")
         if config.sp > 1 and config.tp > 1:
-            h = getattr(model.config, "num_heads", 0)
-            hkv = getattr(model.config, "num_kv_heads", 0)
+            h = getattr(model.config, "num_heads", None)
+            hkv = getattr(model.config, "num_kv_heads", None)
+            if h is None or hkv is None:
+                # a model without per-head attention geometry (e.g. a latent-
+                # attention variant) must fail HERE, not inside a traced
+                # shard_map later — 0 % tp == 0 would slip through the gate
+                raise ValueError(
+                    f"model {type(model).__name__} config lacks num_heads/"
+                    "num_kv_heads; composed sp x tp needs per-head geometry"
+                )
             if h % config.tp or hkv % config.tp:
                 raise ValueError(
                     f"tp={config.tp} must divide num_heads={h} and "
@@ -72,8 +80,13 @@ class ModelRunner:
             if config.max_seqs % config.pp:
                 raise ValueError(f"max_seqs must be divisible by pp={config.pp}")
             if config.tp > 1:
-                h = getattr(model.config, "num_heads", 0)
-                hkv = getattr(model.config, "num_kv_heads", 0)
+                h = getattr(model.config, "num_heads", None)
+                hkv = getattr(model.config, "num_kv_heads", None)
+                if h is None or hkv is None:
+                    raise ValueError(
+                        f"model {type(model).__name__} config lacks num_heads/"
+                        "num_kv_heads; composed pp x tp needs per-head geometry"
+                    )
                 if h % config.tp or hkv % config.tp:
                     raise ValueError(
                         f"tp={config.tp} must divide num_heads={h} and "

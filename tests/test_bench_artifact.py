@@ -1,0 +1,105 @@
+"""The round artifact must be self-contained: the driver keeps only the tail
+of bench stdout (~2000 chars), so the LAST line has to carry every section's
+key number by itself (r4 post-mortem: the full-detail line was truncated and
+BENCH_r04.json lost its own headline)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.fixture()
+def bench_mod():
+    import bench
+
+    saved_detail, saved_errors = dict(bench.DETAIL), dict(bench.ERRORS)
+    bench.DETAIL.clear()
+    bench.ERRORS.clear()
+    yield bench
+    bench.DETAIL.clear()
+    bench.DETAIL.update(saved_detail)
+    bench.ERRORS.clear()
+    bench.ERRORS.update(saved_errors)
+
+
+def _fill_representative(bench):
+    """Populate DETAIL with r4-scale values (worst-case field widths)."""
+    bench.DETAIL["headline_bs%d_ps%d" % bench.HEADLINE] = {
+        "tok_s": 6354.12, "total_output_tokens": 8192, "elapsed_s": 1.289,
+        "ttft_p50_ms": 171.4, "rounds": [6102.44, 6354.12, 6233.91],
+    }
+    bench.DETAIL["continuity_bs%d_ps%d" % bench.CONTINUITY] = {"tok_s": 1402.77}
+    bench.DETAIL["ref_workload_isl3k_osl150"] = {
+        "tok_s": 731.55, "ttft_p50_ms": 1893.2,
+    }
+    bench.DETAIL["http_serving"] = {
+        "tok_s": 3264.18, "engine_loop_tok_s": 3401.02,
+        "http_over_engine_ratio": 0.96, "ttft_p50_ms": 287.3,
+    }
+    bench.DETAIL["mla_decode"] = {"tok_s": 4658.33}
+    bench.DETAIL["moe_decode"] = {"tok_s": 5425.87}
+    bench.DETAIL["parity_disagg"] = {
+        "ratio_measured_1chip": 0.941, "ratio_projected": 1.387,
+    }
+    bench.DETAIL["parity_kv_routing"] = {
+        "ttft_insitu_ratio_measured": 2.79, "ttft_insitu_ratio_derived": 16.14,
+    }
+    bench.DETAIL["parity_host_offload"] = {
+        "projection": {"ttft_ratio_projected": 8.82, "restore_bw_source": "measured"},
+    }
+
+
+def test_summary_line_fits_truncation_budget(bench_mod, tmp_path, monkeypatch):
+    monkeypatch.setenv("DYNTPU_BENCH_DETAIL", str(tmp_path / "detail.json"))
+    _fill_representative(bench_mod)
+    bench_mod.ERRORS["parity_disagg"] = {
+        "error": "TimeoutError: section exceeded 2400s budget on the tunnel",
+        "elapsed_s": 2400.1, "traceback_tail": "x" * 1500,
+    }
+    result = bench_mod._result()
+    line = json.dumps(result)
+    # driver keeps the stdout tail; the whole line must fit comfortably
+    assert len(line) < 1800, f"artifact line too long: {len(line)}"
+    s = result["summary"]
+    assert s["headline_tok_s"] == 6354.12
+    assert result["value"] == 6354.12
+    assert s["ref_workload_isl3k_osl150"]["tok_s"] == 731.55
+    assert s["http_serving"]["http_over_engine_ratio"] == 0.96
+    assert s["mla_decode_tok_s"] == 4658.33
+    assert s["moe_decode_tok_s"] == 5425.87
+    assert s["parity_kv_routing"]["ratio_derived"] == 16.14
+    assert s["parity_host_offload"]["ratio_projected"] == 8.82
+    # errors land compactly (no tracebacks) in the summary itself
+    assert "TimeoutError" in s["errors"]["parity_disagg"]
+    assert "traceback" not in json.dumps(s)
+
+
+def test_detail_lands_in_file_not_stdout(bench_mod, tmp_path, monkeypatch):
+    monkeypatch.setenv("DYNTPU_BENCH_DETAIL", str(tmp_path / "detail.json"))
+    _fill_representative(bench_mod)
+    result = bench_mod._result()
+    line = json.dumps(result)
+    # full detail must NOT ride stdout (it is what got truncated in r4)
+    assert "total_output_tokens" not in line
+    path = result["detail_file"]
+    assert path and os.path.exists(path)
+    with open(path) as f:
+        detail = json.load(f)
+    assert detail["detail"]["headline_bs%d_ps%d" % bench_mod.HEADLINE][
+        "total_output_tokens"] == 8192
+
+
+def test_empty_sections_still_produce_parseable_line(bench_mod, tmp_path, monkeypatch):
+    """A fatal crash before any section lands must still emit valid compact
+    JSON with an errors map (the driver's `parsed` must never be null)."""
+    monkeypatch.setenv("DYNTPU_BENCH_DETAIL", str(tmp_path / "detail.json"))
+    result = bench_mod._result(extra_errors={"__run__": {"error": "boom"}})
+    line = json.dumps(result)
+    parsed = json.loads(line)
+    assert parsed["value"] == 0.0
+    assert parsed["summary"]["errors"]["__run__"] == "boom"
+    assert len(line) < 1800

@@ -585,3 +585,169 @@ def test_image_build_flow(tmp_path):
             store2.close()
 
     _asyncio.run(run())
+
+
+def test_build_conflict_and_namespace_validation(tmp_path):
+    """ADVICE r4: re-POSTing an in-flight/complete build must 409 (not
+    silently reset to pending and re-apply the Job); a failed build MAY be
+    replaced; namespace gets the same DNS-1123 gate as name."""
+    from dynamo_tpu.deploy.api_server import DeploymentStore
+
+    async def run():
+        store = DeploymentStore()
+        server = DeployApiServer(store)
+        port = await server.start()
+        base = f"http://{'127.0.0.1'}:{port}"
+        try:
+            body = {"name": "b1", "image": "r/i:v1", "context": "dir:///tmp/x"}
+            status, _ = await _json(None, "POST", f"{base}/api/v1/builds", body)
+            assert status == 201
+            # duplicate over a pending build -> 409, record untouched
+            status, resp = await _json(None, "POST", f"{base}/api/v1/builds", body)
+            assert status == 409 and "exists" in resp["error"]
+            assert store.get_build("b1")["phase"] == "pending"
+            # a FAILED build may be re-posted (retry path)
+            store.put_build("b1", {**store.get_build("b1"), "phase": "failed"})
+            status, _ = await _json(None, "POST", f"{base}/api/v1/builds", body)
+            assert status == 201
+            assert store.get_build("b1")["phase"] == "pending"
+            # 52+-char name rejected: Job name adds "-image-build" (+12)
+            # and must stay under k8s' 63-char limit
+            status, resp = await _json(None, "POST", f"{base}/api/v1/builds", {
+                "name": "x" * 52, "image": "r/i:v1", "context": "dir:///tmp/x",
+            })
+            assert status == 422
+            # bad namespace rejected up front (it rides into kubectl apply)
+            status, resp = await _json(None, "POST", f"{base}/api/v1/builds", {
+                "name": "b2", "image": "r/i:v1", "context": "dir:///tmp/x",
+                "namespace": "Bad_NS",
+            })
+            assert status == 422 and "namespace" in resp["error"]
+        finally:
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_file_store_persists_builds(tmp_path):
+    """ADVICE r4: build records written through a FileDeploymentStore must
+    survive a restart (they used to inherit the no-op flush and vanish)."""
+    path = tmp_path / "store.json"
+    store = FileDeploymentStore(path)
+    store.put(sample_spec().name, sample_spec().to_dict())
+    store.put_build("bld", {"name": "bld", "phase": "building", "job": {}})
+    store2 = FileDeploymentStore(path)
+    assert store2.get_build("bld")["phase"] == "building"
+    assert store2.head("llama-agg") is not None
+    # pre-builds files (bare revisions map) still load
+    legacy = tmp_path / "legacy.json"
+    legacy.write_text(json.dumps({"old": [{"revision": 1, "created_at": 0.0, "spec": {}}]}))
+    store3 = FileDeploymentStore(legacy)
+    assert store3.head("old")["revision"] == 1
+
+
+def test_vanished_build_job_reapplied_then_failed():
+    """ADVICE r4: a 'building' record whose Job object disappeared (TTL GC /
+    out-of-band delete) must not wedge: after the grace period the controller
+    re-applies the Job, and after max_reapplies it marks the build failed."""
+    import time as _time
+
+    from dynamo_tpu.deploy.api_server import DeploymentStore
+    from dynamo_tpu.deploy.controller import DeployController, FakeCluster
+
+    async def run():
+        store = DeploymentStore()
+        cluster = FakeCluster()
+        ctrl = DeployController(store, cluster, interval=3600,
+                                build_job_grace_s=0.0, build_job_max_reapplies=2)
+        job = {
+            "apiVersion": "batch/v1", "kind": "Job",
+            "metadata": {"name": "b-image-build", "namespace": "default",
+                         "labels": {}},
+        }
+        store.put_build("b", {
+            "name": "b", "image": "r/i:v1", "context": "dir:///x",
+            "namespace": "default", "phase": "building", "job": job,
+            "job_applied_at": _time.time() - 10,
+        })
+
+        class VanishingCluster(FakeCluster):
+            async def apply(self, obj):
+                # record the apply but never retain the Job (simulates GC)
+                self.applied.append(self._key(obj))
+
+        ctrl.cluster = VanishingCluster()
+        await ctrl.converge_once()
+        rec = store.get_build("b")
+        assert rec["phase"] == "building" and rec["job_reapplies"] == 1
+        rec["job_applied_at"] = _time.time() - 10
+        store.put_build("b", rec)
+        await ctrl.converge_once()
+        assert store.get_build("b")["job_reapplies"] == 2
+        rec = store.get_build("b")
+        rec["job_applied_at"] = _time.time() - 10
+        store.put_build("b", rec)
+        await ctrl.converge_once()
+        assert store.get_build("b")["phase"] == "failed"
+        assert "disappeared" in store.get_build("b")["failure"]
+
+    asyncio.run(run())
+
+
+def test_completed_build_may_be_replaced():
+    """A terminal 'complete' build may be re-POSTed (rebuild workflow) — only
+    pending/building conflict."""
+    from dynamo_tpu.deploy.api_server import DeploymentStore
+
+    async def run():
+        store = DeploymentStore()
+        server = DeployApiServer(store)
+        port = await server.start()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            body = {"name": "c1", "image": "r/i:v1", "context": "dir:///tmp/x"}
+            status, _ = await _json(None, "POST", f"{base}/api/v1/builds", body)
+            assert status == 201
+            store.put_build("c1", {**store.get_build("c1"), "phase": "complete"})
+            status, _ = await _json(None, "POST", f"{base}/api/v1/builds",
+                                    {**body, "image": "r/i:v2"})
+            assert status == 201
+            assert store.get_build("c1")["image"] == "r/i:v2"
+        finally:
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_permanently_failing_reapply_reaches_failed():
+    """A re-apply that RAISES every pass (namespace gone) must still burn
+    through max_reapplies and fail, not retry forever (review r5)."""
+    import time as _time
+
+    from dynamo_tpu.deploy.api_server import DeploymentStore
+    from dynamo_tpu.deploy.controller import DeployController, FakeCluster
+
+    class BrokenCluster(FakeCluster):
+        async def apply(self, obj):
+            raise RuntimeError("namespace gone")
+
+    async def run():
+        store = DeploymentStore()
+        ctrl = DeployController(store, BrokenCluster(), interval=3600,
+                                build_job_grace_s=0.0, build_job_max_reapplies=1)
+        job = {"apiVersion": "batch/v1", "kind": "Job",
+               "metadata": {"name": "p-image-build", "namespace": "gone", "labels": {}}}
+        store.put_build("p", {
+            "name": "p", "image": "r/i:v1", "context": "dir:///x",
+            "namespace": "gone", "phase": "building", "job": job,
+            "job_applied_at": _time.time() - 10,
+        })
+        await ctrl.converge_once()
+        assert store.get_build("p")["job_reapplies"] == 1
+        rec = store.get_build("p")
+        rec["job_applied_at"] = _time.time() - 10
+        store.put_build("p", rec)
+        await ctrl.converge_once()
+        assert store.get_build("p")["phase"] == "failed"
+
+    asyncio.run(run())

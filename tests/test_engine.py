@@ -348,3 +348,23 @@ def test_packed_prefill_matches_unpacked():
     packed = asyncio.run(run(4))
     unpacked = asyncio.run(run(1))
     assert packed == unpacked
+
+
+def test_sp_tp_gate_requires_head_geometry():
+    """ADVICE r4: a model config without num_heads/num_kv_heads must fail the
+    composed sp x tp gate AT INIT (0-defaults made `0 % tp == 0` pass and the
+    failure surfaced later inside a traced shard_map)."""
+    import pytest
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.model_runner import ModelRunner
+
+    class HeadlessConfig:
+        num_layers = 2
+
+    class HeadlessModel:
+        config = HeadlessConfig()
+
+    cfg = EngineConfig(sp=2, tp=2)
+    with pytest.raises(ValueError, match="num_heads"):
+        ModelRunner(cfg, HeadlessModel(), params={})
