@@ -402,6 +402,61 @@ async def run_routing_parity(n_workers=2, sessions=4, turns=3, plen=3072) -> dic
     }
 
 
+def _measure_restore(eng) -> dict:
+    """Measure the two restore-path components this rig CAN time:
+
+      scatter (measured): block bytes already device-resident -> jitted
+        scatter into the donated pool. Amortized over a batch to cancel the
+        ~100 ms dispatch RTT. This is the on-chip half of any restore.
+      tunnel (measured): the same batch with host-resident bytes — the wall
+        path on THIS rig (PJRT tunnel). Explains the raw wall TTFT numbers.
+
+    The host-DRAM->HBM transfer of a real TPU-VM cannot be produced here, so
+    the projection prices that leg at an ASSUMED 10 GB/s and labels it."""
+    import time as _time
+
+    import jax.numpy as jnp
+
+    one = eng.runner.extract_pages(np.asarray([1], np.int32))
+    axis = getattr(eng.runner.model, "wire_n_axis", 2)
+    nbytes_block = one.nbytes
+
+    def batch(n):
+        data = np.concatenate([one] * n, axis=axis)
+        ids = np.arange(1, n + 1, dtype=np.int32)
+        return ids, data
+
+    def timed(ids, data, reps=3):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = _time.monotonic()
+            eng.runner.inject_pages(ids, data)
+            # np.asarray forces completion (block_until_ready lies on axon)
+            np.asarray(eng.runner.kv_cache["k"][1, 0, :1])
+            best = min(best, _time.monotonic() - t0)
+        return best
+
+    ids1, d1 = batch(1)
+    ids16, d16 = batch(16)
+    # host-resident bytes: the tunnel path (what this rig's wall TTFT pays)
+    t1 = timed(ids1, d1)
+    t16 = timed(ids16, d16)
+    tunnel_bw = 15 * nbytes_block / max(t16 - t1, 1e-6)
+    # device-staged bytes: the same scatter with no host->device transfer —
+    # the measured on-chip floor of the restore path
+    d1_dev, d16_dev = jnp.asarray(d1), jnp.asarray(d16)
+    np.asarray(d16_dev[..., :1, :, :1])  # staging paid outside the timing
+    s1 = timed(ids1, d1_dev)
+    s16 = timed(ids16, d16_dev)
+    staged_bw = 15 * nbytes_block / max(s16 - s1, 1e-6)
+    return {
+        "block_wire_bytes": int(nbytes_block),
+        "tunnel_bw_MBps_measured": round(tunnel_bw / 1e6, 1),
+        "scatter_bw_GBps_measured_device_staged": round(staged_bw / 1e9, 2),
+        "scatter_s_per_block_measured": max((s16 - s1) / 15, 1e-9),
+    }
+
+
 async def run_offload_parity(sessions=3, plen=512) -> dict:
     """BASELINE.md parity checkpoint: host-DRAM KV offload on multi-turn
     revisit traffic, device pool sized so revisits need the host tier.
@@ -464,30 +519,39 @@ async def run_offload_parity(sessions=3, plen=512) -> dict:
                 ttfts.append(ttft)
                 cacheds.append(cached)
             loads = eng.offload.loads if eng.offload else 0
+            restore = _measure_restore(eng) if host_blocks else None
         finally:
             await eng.shutdown()
             del eng
             gc.collect()
-        return float(np.median(ttfts)), int(np.sum(cacheds)), loads, rtt_floor, recompute_s
+        return (float(np.median(ttfts)), int(np.sum(cacheds)), loads, rtt_floor,
+                recompute_s, restore)
 
-    t_on, cached_on, loads, rtt_on, _ = await workload(256)
-    t_off, cached_off, _, rtt_off, recompute_s = await workload(0)
+
+    t_on, cached_on, loads, rtt_on, _, restore = await workload(256)
+    t_off, cached_off, _, rtt_off, recompute_s, _ = await workload(0)
     eps = 2e-3
     # in-situ revisit TTFTs with the dispatch floor excluded
     ins_on = max(t_on - rtt_on, eps)
     ins_off = max(t_off - rtt_off, eps)
     # Hardware projection for the restore path: on this rig the host tier's
-    # block loads ride the PJRT tunnel (~13 MB/s measured), which buries the
-    # restore under transfer time; on a real TPU-VM the same loads are local
-    # host-DRAM -> HBM copies (~10+ GB/s effective). Project restore cost at
-    # that bandwidth against the measured recompute prefill time.
+    # block loads ride the PJRT tunnel (bandwidth MEASURED in-section above),
+    # which buries the restore under transfer time; on a real TPU-VM the same
+    # loads are local host-DRAM -> HBM copies. The projection's two legs are
+    # labeled by provenance: the on-chip scatter is MEASURED (device-staged
+    # bytes, amortized batch), the host-DRAM transfer is ASSUMED at 10 GB/s
+    # (not producible on this rig).
     mcfg = json.loads(base_cfg.model_id.split(":", 1)[1])
     block_bytes = (
         base_cfg.page_size * mcfg["num_kv_heads"] * mcfg["head_dim"] * 2 * 2
         * mcfg["num_layers"]
     )
     loads_per_revisit = loads / max(1, sessions)
-    restore_s_projected = loads_per_revisit * block_bytes / 10e9
+    transfer_s = loads_per_revisit * block_bytes / 10e9
+    scatter_s = loads_per_revisit * (
+        restore["scatter_s_per_block_measured"] if restore else 0.0
+    )
+    restore_s_projected = transfer_s + scatter_s
     projected_ratio = recompute_s / max(restore_s_projected, eps)
     return {
         "ttft_offload_ms": round(t_on * 1e3, 1),
@@ -498,19 +562,24 @@ async def run_offload_parity(sessions=3, plen=512) -> dict:
         "revisit_tokens_restored_with_offload": cached_on,
         "revisit_tokens_restored_without": cached_off,
         "host_block_loads": loads,
+        "restore_path_measured": restore,
         "projection": {
             "block_bytes": block_bytes,
             "loads_per_revisit": round(loads_per_revisit, 1),
-            "restore_ms_at_10GBps": round(restore_s_projected * 1e3, 1),
+            "transfer_ms_at_10GBps_assumed": round(transfer_s * 1e3, 2),
+            "scatter_ms_measured": round(scatter_s * 1e3, 2),
+            "restore_ms_projected": round(restore_s_projected * 1e3, 2),
             "recompute_ms_measured": round(recompute_s * 1e3, 1),
             "ttft_ratio_projected": round(projected_ratio, 2),
+            "restore_bw_source": "scatter=measured(device-staged); transfer=assumed(10GB/s); wall=tunnel(measured)",
         },
         "target": "ttft_ratio_projected >= 1.4 (BASELINE.md: reference claims 1.4x TTFT)",
         "note": (
-            "restore bytes ride the PJRT tunnel on this rig (~13 MB/s), so "
-            "wall TTFT with offload is transfer-bound; the projection prices "
-            "the measured block loads at TPU-VM host-DRAM bandwidth against "
-            "the measured recompute time"
+            "wall TTFT with offload is tunnel-transfer-bound on this rig "
+            "(tunnel bw measured in restore_path_measured); the projection "
+            "combines the MEASURED on-chip scatter cost with an ASSUMED "
+            "10 GB/s TPU-VM host-DRAM transfer leg, against the measured "
+            "recompute prefill time"
         ),
     }
 

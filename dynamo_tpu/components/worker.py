@@ -40,6 +40,7 @@ class WorkerService:
         engine_config: EngineConfig,
         enable_disagg_decode: bool = False,
         register: bool = True,
+        engine_factory=None,
     ):
         self.drt = drt
         self.namespace = namespace
@@ -48,6 +49,10 @@ class WorkerService:
         self.engine_config = engine_config
         self.enable_disagg_decode = enable_disagg_decode
         self.register = register
+        # optional (kv_event_sink) -> engine: hosts an external engine (e.g.
+        # llm.external.ExternalTokenEngine) behind this worker instead of the
+        # native JAX engine — the reference's engine-agnostic worker slot
+        self.engine_factory = engine_factory
         self.engine = None  # AsyncJaxEngine or DisaggDecodeEngine
         self.backend: Optional[Backend] = None
         self._served = None
@@ -59,8 +64,16 @@ class WorkerService:
         subject = f"{self.namespace}|{self.component}.kv_events"
         self._kv_publisher = KvEventPublisher(self.drt.cplane, subject, worker_id, loop=loop)
 
-        inner = AsyncJaxEngine(self.engine_config, kv_event_sink=self._kv_publisher.publish)
-        await inner.start()
+        if self.engine_factory is not None:
+            inner = self.engine_factory(self._kv_publisher.publish)
+            starter = getattr(inner, "start", None)
+            if starter is not None:
+                result = starter()
+                if asyncio.iscoroutine(result):
+                    await result
+        else:
+            inner = AsyncJaxEngine(self.engine_config, kv_event_sink=self._kv_publisher.publish)
+            await inner.start()
         engine = inner
         if self.enable_disagg_decode:
             from dynamo_tpu.disagg.decode_worker import DisaggDecodeEngine

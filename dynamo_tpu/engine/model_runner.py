@@ -46,7 +46,16 @@ class ModelRunner:
         self.config = config
         self.model = model
         if config.sp > 1 and config.pp > 1:
-            raise ValueError("sp does not compose with pp yet")
+            # composed pp x sp (long-context: depth over pp, length over sp)
+            # runs ring prefill inside the pipeline shard_map; the layer must
+            # support the sp row all-gather before its pool scatter
+            import inspect
+
+            if "sp_axis" not in inspect.signature(model._layer).parameters:
+                raise ValueError(
+                    f"model {type(model).__name__} does not support the "
+                    "composed pp x sp mesh (no _layer sp_axis)"
+                )
         if config.sp > 1 and config.tp > 1:
             h = getattr(model.config, "num_heads", None)
             hkv = getattr(model.config, "num_kv_heads", None)
@@ -106,9 +115,9 @@ class ModelRunner:
                 raise ValueError(
                     f"model {type(model).__name__} has no sequence-parallel prefill"
                 )
-            if len(jax.devices()) < config.sp * config.tp:
+            if len(jax.devices()) < config.pp * config.sp * config.tp:
                 raise ValueError(
-                    f"sp={config.sp} x tp={config.tp} but only "
+                    f"pp={config.pp} x sp={config.sp} x tp={config.tp} but only "
                     f"{len(jax.devices())} devices available"
                 )
             if not any(b % config.sp == 0 for b in config.prefill_buckets):
@@ -117,7 +126,21 @@ class ModelRunner:
                     f"{config.prefill_buckets}; SP prefill would never engage"
                 )
         if mesh is None:
-            if config.pp > 1 and config.tp > 1:
+            if config.pp > 1 and config.sp > 1:
+                # composed stage x sequence (x head) mesh: sp between pp and
+                # tp so a ring's peers stay ICI-adjacent within their stage
+                n = config.pp * config.sp * config.tp
+                devices = jax.devices()[:n]
+                if config.tp > 1:
+                    mesh = Mesh(
+                        np.array(devices).reshape(config.pp, config.sp, config.tp),
+                        ("pp", "sp", "tp"),
+                    )
+                else:
+                    mesh = Mesh(
+                        np.array(devices).reshape(config.pp, config.sp), ("pp", "sp")
+                    )
+            elif config.pp > 1 and config.tp > 1:
                 # composed stage x head mesh: tp is the minor (fastest-
                 # varying) axis so a head shard's peers are ICI neighbors
                 devices = jax.devices()[: config.pp * config.tp]
@@ -498,9 +521,18 @@ class ModelRunner:
         eos_ids = ints[bucket + mp + 5 :]
         positions = jnp.arange(bucket, dtype=jnp.int32)
         valid = positions < n
-        logits, kv = self.model.prefill_sp(
-            params, kv, tokens, positions, page_table, valid, n - 1, mesh=self.mesh
-        )
+        if self.config.pp > 1:
+            # composed pp x sp: ring attention inside the GPipe shard_map
+            from dynamo_tpu.parallel.pipeline import prefill_pipelined_ring
+
+            logits, kv = prefill_pipelined_ring(
+                self.model, params, kv, tokens, positions, page_table, valid,
+                n - 1, self.mesh,
+            )
+        else:
+            logits, kv = self.model.prefill_sp(
+                params, kv, tokens, positions, page_table, valid, n - 1, mesh=self.mesh
+            )
         tok, lp, slot_state = self._sample_one(
             logits, key, flts, top_k, slot, seed, n - 1, slot_state,
             want_lp, want_pen, want_seed,

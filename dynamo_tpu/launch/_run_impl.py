@@ -66,6 +66,13 @@ async def _build_engine(args):
         engine = AsyncJaxEngine(engine_config_for(args))
         await engine.start()
         return engine
+    if args.output.startswith("pytok:"):
+        # user-supplied tokens-in/tokens-out async engine hosted behind the
+        # full stack (reference: dynamo-run out=pytok:file.py, the generic
+        # Python engine at lib/llm/src/engines/python.rs:105-146)
+        from dynamo_tpu.llm.external import ExternalTokenEngine
+
+        return ExternalTokenEngine(args.output[len("pytok:"):])
     if args.output.startswith("dyn://"):
         # remote engine: forward EngineRequests to a distributed endpoint that
         # speaks the worker wire protocol (reference: dynamo-run out=dyn://)

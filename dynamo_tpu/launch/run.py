@@ -17,7 +17,8 @@ def build_parser() -> argparse.ArgumentParser:
     run = sub.add_parser("run", help="run a serving pipeline")
     run.add_argument("model", nargs="?", help="model path or registry name")
     run.add_argument("--in", dest="input", default="text", help="http|text|batch:<file.jsonl>|dyn://<endpoint>")
-    run.add_argument("--out", dest="output", default="echo", help="echo|jax|dyn://<endpoint>")
+    run.add_argument("--out", dest="output", default="echo",
+                     help="echo|jax|pytok:<module>:<fn>|dyn://<endpoint>")
     run.add_argument("--http-port", type=int, default=8080)
     run.add_argument("--max-model-len", type=int, default=None)
     run.add_argument("--num-pages", type=int, default=None, help="KV cache pages")

@@ -1,0 +1,136 @@
+"""External-engine adapter: host an arbitrary user-supplied Python engine
+behind the full serving stack (frontend, preprocessor, router, disagg
+machinery).
+
+The reference's headline identity is engine-agnostic serving: its launcher
+hosts user engines via ``out=pytok:file.py`` / ``out=pystr:file.py`` — a
+Python module exposing an async generator that takes a request and yields
+tokens (reference: lib/llm/src/engines/python.rs:105-146, the generic
+Python engine behind both schemes). dynamo-tpu's native engine is JAX, but
+the same slot exists here: ``out=pytok:module:fn`` resolves ``fn`` in
+``module`` and adapts it to the engine protocol every frontend/router/
+backend component speaks (``generate(EngineRequest) -> AsyncIterator[
+StepOutput]``).
+
+The user function contract (tokens-in/tokens-out):
+
+    async def fn(token_ids: list[int], sampling: dict, request_id: str):
+        yield 42                      # one token id
+        yield [43, 44]                # or several at once
+        yield {"token_ids": [45], "finish_reason": "stop"}  # or a dict
+
+- ints and lists of ints are emitted as generated tokens
+- a dict may carry ``token_ids`` plus an optional ``finish_reason``
+  ("stop" ends the stream even below max_tokens)
+- the adapter enforces ``sampling["max_tokens"]`` and emits the final
+  StepOutput with ``finished=True`` / a finish_reason, so a user engine
+  never has to re-implement the termination bookkeeping
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+from typing import AsyncIterator
+
+from dynamo_tpu.engine.scheduler import EngineRequest, StepOutput
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("llm.external")
+
+
+def resolve_spec(spec: str):
+    """Resolve ``module:qualname`` into the callable it names."""
+    module_name, sep, qualname = spec.partition(":")
+    if not sep or not module_name or not qualname:
+        raise ValueError(
+            f"external engine spec {spec!r} must be 'module:function'"
+        )
+    module = importlib.import_module(module_name)
+    obj = module
+    for part in qualname.split("."):
+        obj = getattr(obj, part)
+    if not callable(obj):
+        raise TypeError(f"external engine {spec!r} resolved to non-callable {obj!r}")
+    return obj
+
+
+class ExternalTokenEngine:
+    """Adapts a user async-generator function to the engine protocol
+    (``pytok:`` scheme — tokens in, tokens out)."""
+
+    def __init__(self, spec_or_fn):
+        if isinstance(spec_or_fn, str):
+            self.fn = resolve_spec(spec_or_fn)
+            self.spec = spec_or_fn
+        else:
+            self.fn = spec_or_fn
+            self.spec = getattr(spec_or_fn, "__name__", repr(spec_or_fn))
+        if not inspect.isasyncgenfunction(self.fn):
+            raise TypeError(
+                f"external engine {self.spec!r} must be an async generator "
+                "function (async def ... with yield)"
+            )
+
+    async def generate(self, request: EngineRequest) -> AsyncIterator[StepOutput]:
+        import dataclasses
+
+        sampling = dataclasses.asdict(request.sampling)
+        max_tokens = request.sampling.max_tokens
+        agen = self.fn(list(request.token_ids), sampling, request.request_id)
+        emitted = 0
+        finish_reason = None
+        try:
+            async for item in agen:
+                if isinstance(item, dict):
+                    tokens = list(item.get("token_ids", ()))
+                    finish_reason = item.get("finish_reason") or finish_reason
+                elif isinstance(item, int):
+                    tokens = [item]
+                else:
+                    tokens = list(item)
+                for j, tok in enumerate(tokens):
+                    emitted += 1
+                    done = emitted >= max_tokens or (
+                        finish_reason is not None and j == len(tokens) - 1
+                    )
+                    yield StepOutput(
+                        request_id=request.request_id,
+                        token=int(tok),
+                        finished=done,
+                        finish_reason=(
+                            (finish_reason or "length") if done else None
+                        ),
+                    )
+                    if done:
+                        return
+                if finish_reason is not None:
+                    # dict carried a finish_reason but no tokens: end now
+                    yield StepOutput(
+                        request_id=request.request_id,
+                        token=None,
+                        finished=True,
+                        finish_reason=finish_reason,
+                    )
+                    return
+        finally:
+            await agen.aclose()
+        # generator exhausted without declaring a reason: natural stop
+        yield StepOutput(
+            request_id=request.request_id,
+            token=None,
+            finished=True,
+            finish_reason=finish_reason or "stop",
+        )
+
+    async def shutdown(self) -> None:
+        closer = getattr(self.fn, "shutdown", None)
+        if closer is not None:
+            result = closer()
+            if inspect.iscoroutine(result):
+                await result
+
+    def metrics(self):
+        from dynamo_tpu.engine.engine import ForwardPassMetrics
+
+        return ForwardPassMetrics()

@@ -288,12 +288,20 @@ class LlamaModel:
         attn_fn,
         rope_positions: jnp.ndarray | None = None,  # [T, 3] M-RoPE components
         tp_axis: str | None = None,  # set inside an explicit (pp, tp) shard_map
+        sp_axis: str | None = None,  # set inside a composed (pp, sp[, tp]) shard_map
     ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         """One transformer layer. Under GSPMD (pp == 1) the tp sharding is
         handled by the compiler; inside an explicit shard_map over a composed
         (pp, tp) mesh this runs on the LOCAL head shard (wq/wk/wv column
         shards, wo/down row shards) and ``tp_axis`` names the axis for the
-        two Megatron-style psums that complete each residual branch."""
+        two Megatron-style psums that complete each residual branch.
+
+        ``sp_axis`` (composed pp x sp ring prefill): the token dim is sharded
+        over sp, so before the pool scatter the fresh K/V rows (+ their page
+        addresses) all-gather over sp — every sp peer writes ALL the chunk's
+        rows and the stage's pool replicas stay bit-identical, which the
+        decode path (replicated over sp) depends on. This mirrors the
+        all-gather GSPMD inserts on the pure-sp path for the same scatter."""
         c = self.config
         T = hidden.shape[0]
         h = rms_norm(hidden, lp["input_norm"], c.rms_norm_eps)
@@ -321,7 +329,14 @@ class LlamaModel:
             q = apply_rope(q, positions, c.rope_theta)
             k = apply_rope(k, positions, c.rope_theta)
         # scatter_kv folds the new rows itself when the pool is lane-folded
-        k_pool, v_pool = scatter_kv(k_pool, v_pool, k, v, flat_phys, offsets)
+        if sp_axis is not None:
+            k_all = jax.lax.all_gather(k, sp_axis, axis=0, tiled=True)
+            v_all = jax.lax.all_gather(v, sp_axis, axis=0, tiled=True)
+            phys_all = jax.lax.all_gather(flat_phys, sp_axis, axis=0, tiled=True)
+            off_all = jax.lax.all_gather(offsets, sp_axis, axis=0, tiled=True)
+            k_pool, v_pool = scatter_kv(k_pool, v_pool, k_all, v_all, phys_all, off_all)
+        else:
+            k_pool, v_pool = scatter_kv(k_pool, v_pool, k, v, flat_phys, offsets)
         # attn_fn sees both the updated pools (paged paths) and the chunk's
         # fresh rows (ring/SP path, which never reads the pool)
         attn = attn_fn(q, k, v, k_pool, v_pool)

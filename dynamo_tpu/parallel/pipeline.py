@@ -84,14 +84,20 @@ def stage_kv_sharding(mesh: Mesh, pp_axis: str = "pp", folded: bool = False) -> 
     return {"k": ns, "v": ns}
 
 
-def _local_layer_scan(model, local_layers, kp, vp, hidden, positions, phys, offsets, attn_maker, num_pages, rope_positions=None, tp_axis=None):
+def _local_layer_scan(model, local_layers, kp, vp, hidden, positions, phys, offsets, attn_maker, num_pages, rope_positions=None, tp_axis=None, sp_axis=None):
     """Run this stage's layer slice over one microbatch. phys holds per-token
     LOGICAL page ids (trash-routed already); layer offsets are stage-local.
     With ``tp_axis`` set the layers run on their local head shard and psum
-    over tp inside model._layer (composed pp x tp shard_map)."""
+    over tp inside model._layer (composed pp x tp shard_map); with
+    ``sp_axis`` set the token dim is sp-sharded and the layer all-gathers
+    fresh K/V rows over sp before the pool scatter (composed pp x sp)."""
     L_loc = kp.shape[0] // num_pages
     layer_offsets = jnp.arange(L_loc, dtype=jnp.int32) * num_pages
-    kwargs = {} if tp_axis is None else {"tp_axis": tp_axis}
+    kwargs = {}
+    if tp_axis is not None:
+        kwargs["tp_axis"] = tp_axis
+    if sp_axis is not None:
+        kwargs["sp_axis"] = sp_axis
 
     def body(carry, xs):
         h, kp_, vp_ = carry
@@ -223,6 +229,95 @@ def prefill_pipelined(
         params["layers"], k_pool, v_pool, hidden_mbs, pos_mbs, phys_mbs, off_mbs, rp_mbs, page_table
     )
     hidden_out = outputs.reshape(T, -1)
+    logits = model._unembed(params, hidden_out[last_idx][None, :])[0]
+    return logits, {"k": k_pool, "v": v_pool}
+
+
+def prefill_pipelined_ring(
+    model,
+    params: dict,
+    kv_cache: dict,  # {"k","v"} flat pools sharded stage-major (donated)
+    tokens: jnp.ndarray,  # [T] padded FULL prompt, start at pos 0, T % sp == 0
+    positions: jnp.ndarray,  # [T] == arange(T)
+    page_table: jnp.ndarray,  # [max_pages] logical page ids
+    valid: jnp.ndarray,  # [T]
+    last_idx: jnp.ndarray,
+    mesh: Mesh,
+    pp_axis: str = "pp",
+    sp_axis: str = "sp",
+) -> tuple[jnp.ndarray, dict]:
+    """Composed pp x sp whole-prompt prefill: GPipe stage rotation over pp
+    with the token axis sharded over sp and ring attention inside each stage
+    (the 70B long-context mesh — depth over pp, length over sp — that the
+    round-4 design left mutually exclusive; no reference analogue, the
+    reference has no sequence parallelism at all).
+
+    Single microbatch (M=1): ring attention consumes the chunk's fresh K/V
+    rows directly, which is only causal when the whole prompt is one
+    microbatch — cross-microbatch attention would need a paged+ring softmax
+    merge. The price is a (S-1)/S pipeline bubble on this one chunk; decode
+    (the throughput phase) microbatches as usual. Fresh K/V rows all-gather
+    over sp inside each layer so every sp peer's stage pool replica stays
+    identical (model._layer sp_axis).
+
+    Returns (logits[V] at last_idx, updated kv)."""
+    from dynamo_tpu.ops.ring_attention import _ring_attention_local
+
+    c = model.config
+    S = mesh.shape[pp_axis]
+    sp = mesh.shape[sp_axis]
+    T = tokens.shape[0]
+    assert c.num_layers % S == 0, f"L={c.num_layers} not divisible by pp={S}"
+    assert T % sp == 0, f"chunk {T} not divisible by sp={sp}"
+
+    k_pool, v_pool = kv_cache["k"], kv_cache["v"]
+    page_size = k_pool.shape[1]
+    num_pages = k_pool.shape[0] // c.num_layers
+    phys = jnp.where(valid, page_table[positions // page_size], 0)
+    offsets = jnp.where(valid, positions % page_size, 0)
+
+    hidden = params["embed"][tokens].astype(c.dtype)
+
+    folded = getattr(model.config, "kv_folded", False)
+    spec_pool = kv_pool_spec(mesh, pp_axis, folded)
+    tp_axis = "tp" if "tp" in mesh.axis_names else None
+    layer_specs = stage_layer_specs(model, mesh, pp_axis)
+    seq = P(sp_axis)  # token-dim sharding over the ring
+    seq2 = P(sp_axis, None)  # [T, D] hidden
+    seq3 = P(None, sp_axis, None)  # [M=1, Tloc, D] rotation outputs
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(layer_specs, spec_pool, spec_pool, seq2, seq, seq, seq),
+        out_specs=(seq3, spec_pool, spec_pool),
+        check_vma=False,
+    )
+    def run(local_layers, kp, vp, hidden_loc, pos_loc, phys_loc, off_loc):
+        def run_mb(mc, active, x, kp, vp):
+            # idle ramp steps write to the layer trash page (logical 0)
+            phys_mb = jnp.where(active, phys_loc, 0)
+            off_mb = jnp.where(active, off_loc, 0)
+
+            def attn_maker(off):
+                def attn_fn(q, k_new, v_new, kp_, vp_):
+                    # ring over the sp axis on the chunk's fresh rows; the
+                    # pool is write-only on this path
+                    return _ring_attention_local(q, k_new, v_new, axis_name=sp_axis)
+
+                return attn_fn
+
+            return _local_layer_scan(
+                model, local_layers, kp, vp, x, pos_loc, phys_mb, off_mb,
+                attn_maker, num_pages, tp_axis=tp_axis, sp_axis=sp_axis,
+            )
+
+        return _gpipe_rotate(mesh, pp_axis, S, 1, run_mb, hidden_loc[None], kp, vp)
+
+    outputs, k_pool, v_pool = run(
+        params["layers"], k_pool, v_pool, hidden, positions, phys, offsets
+    )
+    hidden_out = outputs[0]  # [T, D] (sp-sharded on T under GSPMD outside)
     logits = model._unembed(params, hidden_out[last_idx][None, :])[0]
     return logits, {"k": k_pool, "v": v_pool}
 

@@ -1,0 +1,202 @@
+"""External-engine adapter (`out=pytok:module:fn`): an arbitrary user
+async-generator engine hosted behind the full serving stack.
+
+Mirrors the reference's generic Python engine tests (reference:
+lib/llm/src/engines/python.rs:105-146 — pystr/pytok schemes hosting a
+user module behind the same frontend/router machinery)."""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.engine.sampling import SamplingParams
+from dynamo_tpu.engine.scheduler import EngineRequest
+from dynamo_tpu.llm.external import ExternalTokenEngine, resolve_spec
+
+
+# ---- user engines (resolved by module:fn spec in the tests below) ----
+
+async def ext_echo(token_ids, sampling, request_id):
+    """Echo the prompt tokens back, one per step (pytok contract demo)."""
+    for tok in token_ids:
+        yield tok
+
+
+async def ext_batched_stop(token_ids, sampling, request_id):
+    yield [101, 102]
+    yield {"token_ids": [103], "finish_reason": "stop"}
+    yield 999  # must never be reached
+
+
+async def ext_empty(token_ids, sampling, request_id):
+    if False:
+        yield 0
+
+
+def not_an_async_gen(token_ids, sampling, request_id):
+    return []
+
+
+async def collect(engine, token_ids, max_tokens=16):
+    req = EngineRequest(
+        request_id="r1", token_ids=token_ids,
+        sampling=SamplingParams(max_tokens=max_tokens, ignore_eos=True),
+    )
+    outs = []
+    async for out in engine.generate(req):
+        outs.append(out)
+    return outs
+
+
+def test_adapter_echo_and_max_tokens():
+    eng = ExternalTokenEngine("tests.test_external_engine:ext_echo")
+
+    async def run():
+        outs = await collect(eng, [5, 6, 7, 8], max_tokens=16)
+        assert [o.token for o in outs] == [5, 6, 7, 8, None]
+        assert outs[-1].finished and outs[-1].finish_reason == "stop"
+        # max_tokens truncates and reports length
+        outs = await collect(eng, [5, 6, 7, 8], max_tokens=2)
+        assert [o.token for o in outs] == [5, 6]
+        assert outs[-1].finished and outs[-1].finish_reason == "length"
+
+    asyncio.run(run())
+
+
+def test_adapter_batched_yield_and_finish_reason():
+    eng = ExternalTokenEngine("tests.test_external_engine:ext_batched_stop")
+
+    async def run():
+        outs = await collect(eng, [1])
+        assert [o.token for o in outs] == [101, 102, 103]
+        assert outs[-1].finished and outs[-1].finish_reason == "stop"
+        # an engine that never yields still terminates the stream cleanly
+        outs = await collect(ExternalTokenEngine(ext_empty), [1])
+        assert [o.token for o in outs] == [None]
+        assert outs[-1].finished
+
+    asyncio.run(run())
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError, match="module:function"):
+        resolve_spec("no-colon")
+    with pytest.raises(ModuleNotFoundError):
+        ExternalTokenEngine("definitely_not_a_module:fn")
+    with pytest.raises(TypeError, match="async generator"):
+        ExternalTokenEngine("tests.test_external_engine:not_an_async_gen")
+
+
+def test_cli_dispatch_builds_external_engine():
+    from types import SimpleNamespace
+
+    from dynamo_tpu.launch._run_impl import _build_engine
+
+    args = SimpleNamespace(
+        output="pytok:tests.test_external_engine:ext_echo", model=None,
+    )
+    eng = asyncio.run(_build_engine(args))
+    assert isinstance(eng, ExternalTokenEngine)
+
+    async def run():
+        outs = await collect(eng, [9, 10])
+        assert [o.token for o in outs] == [9, 10, None]
+
+    asyncio.run(run())
+
+
+def test_external_engine_behind_full_serving_graph():
+    """The full distributed graph — HTTP frontend -> processor (router) ->
+    worker — with the EXTERNAL engine in the worker slot: the engine-agnostic
+    serving identity of the reference, proven end-to-end."""
+    from dynamo_tpu.cplane.broker import Broker
+    from dynamo_tpu.components.frontend import FrontendService
+    from dynamo_tpu.components.processor import ProcessorService
+    from dynamo_tpu.components.worker import WorkerService
+    from dynamo_tpu.frontends.pipeline import card_for_model
+    from dynamo_tpu.llm.model_registry import ModelEntry, register_model
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    from tests.test_engine import tiny_engine_config
+
+    NS = "ext"
+
+    async def run():
+        broker = Broker()
+        bport = await broker.start()
+        addr = f"127.0.0.1:{bport}"
+        worker_rt = DistributedRuntime(cplane_address=addr)
+        await worker_rt.connect()
+        proc_rt = DistributedRuntime(cplane_address=addr)
+        await proc_rt.connect()
+        front_rt = DistributedRuntime(cplane_address=addr)
+        await front_rt.connect()
+        cleanups = []
+        try:
+            card = card_for_model("tiny")
+            worker = WorkerService(
+                worker_rt, NS, "backend", card, tiny_engine_config(),
+                register=False,
+                engine_factory=lambda sink: ExternalTokenEngine(
+                    "tests.test_external_engine:ext_echo"
+                ),
+            )
+            await worker.start()
+            cleanups.append(worker.stop)
+            processor = ProcessorService(
+                proc_rt, NS, worker_component="backend", kv_block_size=4,
+                routing="round_robin",
+            )
+            await processor.start()
+            cleanups.append(processor.stop)
+            entry = ModelEntry(
+                name="tiny",
+                endpoint=f"dyn://{NS}.processor.generate",
+                model_type="chat",
+                card=card,
+            )
+            await register_model(front_rt.cplane, entry)
+            frontend = FrontendService(front_rt, host="127.0.0.1", port=0)
+            port = await frontend.start()
+            cleanups.append(frontend.stop)
+            url = f"http://127.0.0.1:{port}"
+            body = {
+                "model": "tiny",
+                "messages": [{"role": "user", "content": "external hello"}],
+                "max_tokens": 6,
+                "temperature": 0,
+            }
+            async with aiohttp.ClientSession() as s:
+                async with s.post(url + "/v1/chat/completions", json=body) as resp:
+                    assert resp.status == 200
+                    out = await resp.json()
+            assert out["usage"]["completion_tokens"] == 6
+            assert out["choices"][0]["message"]["content"] != ""
+            # streaming leg
+            texts = []
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    url + "/v1/chat/completions", json={**body, "stream": True}
+                ) as resp:
+                    assert resp.status == 200
+                    async for line in resp.content:
+                        line = line.decode().strip()
+                        if line.startswith("data:"):
+                            data = line[5:].strip()
+                            if data == "[DONE]":
+                                break
+                            chunk = json.loads(data)
+                            d = chunk["choices"][0]["delta"]
+                            if d.get("content"):
+                                texts.append(d["content"])
+            assert "".join(texts) != ""
+        finally:
+            for stop in reversed(cleanups):
+                await stop()
+            for rt in (worker_rt, proc_rt, front_rt):
+                await rt._shutdown_hook()
+            await broker.stop()
+
+    asyncio.run(run())

@@ -172,3 +172,120 @@ def test_engine_sp_prefill_token_exact():
     assert t2_sp == t2_ref, f"sp {t2_sp} != ref {t2_ref}"
     assert c1_sp == c1_ref == 0
     assert c2_sp == c2_ref > 0  # prefix written by SP prefill is reusable
+
+
+def test_prefill_pipelined_ring_matches_prefill():
+    """Composed pp=2 x sp=2 (VERDICT r4 item 6): ring prefill inside the
+    GPipe shard_map matches the single-device paged prefill — logits AND
+    pool contents (decode reads the pool, so replicas must be real)."""
+    from jax.sharding import Mesh
+
+    from dynamo_tpu.parallel.pipeline import (
+        decode_pipelined,
+        prefill_pipelined_ring,
+        stage_kv_sharding,
+        stage_param_shardings,
+    )
+
+    cfg = LlamaConfig.tiny(num_layers=4)
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.key(0))
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("pp", "sp"))
+    params_pp = jax.device_put(params, stage_param_shardings(model, mesh))
+    NUM_PAGES, PAGE_SIZE = 16, 4
+    kv_pp = jax.device_put(
+        model.init_kv_cache(NUM_PAGES, PAGE_SIZE),
+        stage_kv_sharding(mesh, folded=cfg.kv_folded),
+    )
+
+    T = len(PROMPT)
+    pt = np.array([3, 5, 7, 9, 0, 0, 0, 0], np.int32)
+    pos = np.arange(T, dtype=np.int32)
+    valid = np.ones(T, bool)
+
+    ref_logits, ref_kv = model.prefill(
+        params, model.init_kv_cache(NUM_PAGES, PAGE_SIZE),
+        jnp.asarray(PROMPT, jnp.int32), jnp.asarray(pos), jnp.asarray(pt),
+        jnp.asarray(valid), jnp.asarray(T - 1),
+    )
+    ring_logits, kv_ring = jax.jit(
+        lambda p, kv: prefill_pipelined_ring(
+            model, p, kv, jnp.asarray(PROMPT, jnp.int32), jnp.asarray(pos),
+            jnp.asarray(pt), jnp.asarray(valid), jnp.asarray(T - 1), mesh,
+        ),
+        donate_argnums=(1,),
+    )(params_pp, kv_pp)
+    np.testing.assert_allclose(
+        np.asarray(ring_logits), np.asarray(ref_logits), rtol=2e-4, atol=2e-4
+    )
+    # pool parity on the written pages (all sp replicas must hold ALL rows)
+    owned = pt[:4]
+    flat = (owned[None, :] + np.arange(cfg.num_layers)[:, None] * NUM_PAGES).ravel()
+    for leaf in ("k", "v"):
+        np.testing.assert_allclose(
+            np.asarray(kv_ring[leaf])[flat], np.asarray(ref_kv[leaf])[flat],
+            rtol=2e-4, atol=2e-4,
+        )
+
+    # decode step over the ring-written pool on the same composed mesh
+    B = 4
+    toks = np.zeros(B, np.int32); toks[0] = 42
+    dpos = np.zeros(B, np.int32); dpos[0] = T
+    pts = np.zeros((B, 8), np.int32); pts[0] = pt
+    act = np.zeros(B, bool); act[0] = True
+    ref_dlog, _ = model.decode(
+        params, ref_kv, jnp.asarray(toks), jnp.asarray(dpos),
+        jnp.asarray(pts), jnp.asarray(act),
+    )
+    ring_dlog, _ = jax.jit(
+        lambda p, kv: decode_pipelined(
+            model, p, kv, jnp.asarray(toks), jnp.asarray(dpos), jnp.asarray(pts),
+            jnp.asarray(act), mesh, num_microbatches=2,
+        ),
+        donate_argnums=(1,),
+    )(params_pp, kv_ring)
+    np.testing.assert_allclose(
+        np.asarray(ring_dlog)[0], np.asarray(ref_dlog)[0], rtol=2e-4, atol=2e-4
+    )
+
+
+def test_engine_pp_sp_token_exact():
+    """Engine e2e on the composed pp=2 x sp=2 mesh: greedy tokens match the
+    single-device engine, including a prefix-cache revisit (the long-context
+    mesh — depth over pp, length over sp — lifted from mutual exclusivity)."""
+
+    def run(pp, sp):
+        async def body():
+            eng = AsyncJaxEngine(
+                tiny_engine_config(pp=pp, sp=sp, page_size=4, num_pages=32,
+                                   max_seqs=2, prefill_buckets=(8, 16, 32))
+            )
+            await eng.start()
+            try:
+                toks1, _, _ = await _collect(
+                    eng,
+                    EngineRequest(
+                        request_id="s1",
+                        token_ids=list(PROMPT),
+                        sampling=SamplingParams(temperature=0.0, max_tokens=6),
+                    ),
+                )
+                toks2, _, cached2 = await _collect(
+                    eng,
+                    EngineRequest(
+                        request_id="s2",
+                        token_ids=list(PROMPT) + [33, 44, 55, 66],
+                        sampling=SamplingParams(temperature=0.0, max_tokens=6),
+                    ),
+                )
+                return toks1, toks2, cached2
+            finally:
+                await eng.shutdown()
+
+        return asyncio.run(body())
+
+    t1, t2, c2 = run(2, 2)
+    r1, r2, rc2 = run(1, 1)
+    assert t1 == r1, f"pp x sp {t1} != ref {r1}"
+    assert t2 == r2, f"pp x sp {t2} != ref {r2}"
+    assert c2 == rc2 > 0  # ring-written prefix reusable through the pool

@@ -43,6 +43,212 @@ def timed(fn, *args):
     return best / N_ITERS
 
 
+def _null_kernel(
+    page_tables_ref, lengths_ref, q_ref, k_hbm, v_hbm, out_ref,
+    k_scratch, v_scratch, sems, *, page_size: int,
+):
+    """Null hypothesis: perseq's exact grid + 2-page double-buffered DMA
+    stream with NO attention math — isolates the irreducible DMA cost."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b = pl.program_id(0)
+    length = lengths_ref[b]
+    n_pages = jnp.maximum(1, pl.cdiv(length, page_size))
+
+    def k_dma(slot, i):
+        return pltpu.make_async_copy(
+            k_hbm.at[page_tables_ref[b, i]], k_scratch.at[slot], sems.at[slot, 0]
+        )
+
+    def v_dma(slot, i):
+        return pltpu.make_async_copy(
+            v_hbm.at[page_tables_ref[b, i]], v_scratch.at[slot], sems.at[slot, 1]
+        )
+
+    k_dma(0, 0).start()
+    v_dma(0, 0).start()
+
+    def body(i, acc):
+        slot = jax.lax.rem(i, 2)
+        next_slot = jax.lax.rem(i + 1, 2)
+
+        @pl.when(i + 1 < n_pages)
+        def _():
+            k_dma(next_slot, i + 1).start()
+            v_dma(next_slot, i + 1).start()
+
+        k_dma(slot, i).wait()
+        v_dma(slot, i).wait()
+        # consume one lane per page so the waits can't be elided; no matmuls,
+        # no softmax, no casts
+        return acc + k_scratch[slot, 0].astype(jnp.float32) + v_scratch[slot, 0].astype(jnp.float32)
+
+    Hkv, D = k_hbm.shape[2], k_hbm.shape[3]
+    acc = jax.lax.fori_loop(0, n_pages, body, jnp.zeros((Hkv, D), jnp.float32))
+    out_ref[0] = jnp.broadcast_to(
+        acc[:1] * 1e-6, out_ref.shape[1:]
+    ).astype(out_ref.dtype)
+
+
+def paged_decode_dmaonly(q, k_pages, v_pages, page_tables, positions):
+    import functools as ft
+
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, Hq, D = q.shape
+    P, ps, Hkv, _ = k_pages.shape
+    lengths = positions.astype(jnp.int32) + 1
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, ps, Hkv, D), k_pages.dtype),
+            pltpu.VMEM((2, ps, Hkv, D), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    kernel = pl.pallas_call(
+        ft.partial(_null_kernel, page_size=ps),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+        grid_spec=grid_spec,
+    )
+    return kernel(page_tables.astype(jnp.int32), lengths, q, k_pages, v_pages)
+
+
+def _perseq_variant_kernel(
+    page_tables_ref, lengths_ref, q_ref, k_hbm, v_hbm, out_ref,
+    k_scratch, v_scratch, sems, *, page_size: int, cast_f32: bool):
+    """perseq with the two per-page VPU costs toggled: the f32 casts of the
+    whole K/V page and the [ps,Hkv,D]->[Hkv,ps,D] relayout."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _NEG_INF = -1e30
+    b = pl.program_id(0)
+    length = lengths_ref[b]
+    n_pages = jnp.maximum(1, pl.cdiv(length, page_size))
+
+    Hq, D = q_ref.shape[1], q_ref.shape[2]
+    Hkv = k_hbm.shape[2]
+    G = Hq // Hkv
+
+    q = q_ref[0].reshape(Hkv, G, D)
+    if cast_f32:
+        q = q.astype(jnp.float32)
+    scale = 1.0 / jnp.sqrt(jnp.float32(D))
+
+    def k_dma(slot, i):
+        return pltpu.make_async_copy(
+            k_hbm.at[page_tables_ref[b, i]], k_scratch.at[slot], sems.at[slot, 0]
+        )
+
+    def v_dma(slot, i):
+        return pltpu.make_async_copy(
+            v_hbm.at[page_tables_ref[b, i]], v_scratch.at[slot], sems.at[slot, 1]
+        )
+
+    k_dma(0, 0).start()
+    v_dma(0, 0).start()
+
+    def body(i, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(i, 2)
+        next_slot = jax.lax.rem(i + 1, 2)
+
+        @pl.when(i + 1 < n_pages)
+        def _():
+            k_dma(next_slot, i + 1).start()
+            v_dma(next_slot, i + 1).start()
+
+        k_dma(slot, i).wait()
+        v_dma(slot, i).wait()
+
+        k_page = k_scratch[slot]  # [ps, Hkv, D]
+        v_page = v_scratch[slot]
+        if cast_f32:
+            k_page = k_page.astype(jnp.float32)
+            v_page = v_page.astype(jnp.float32)
+        kt = jnp.transpose(k_page, (1, 0, 2))  # [Hkv, ps, D]
+        vt = jnp.transpose(v_page, (1, 0, 2))
+        scores = jax.lax.dot_general(
+            q, kt, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+        idx = i * page_size + jax.lax.broadcasted_iota(jnp.int32, (1, 1, page_size), 2)
+        scores = jnp.where(idx < length, scores, _NEG_INF)
+
+        chunk_max = jnp.max(scores, axis=-1)
+        new_m = jnp.maximum(m, chunk_max)
+        corr = jnp.exp(m - new_m)
+        probs = jnp.exp(scores - new_m[..., None])  # [Hkv, G, ps] f32
+        new_l = l * corr + jnp.sum(probs, axis=-1)
+        chunk_out = jax.lax.dot_general(
+            probs if cast_f32 else probs.astype(vt.dtype), vt,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        new_acc = acc * corr[..., None] + chunk_out
+        return new_m, new_l, new_acc
+
+    m0 = jnp.full((Hkv, G), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((Hkv, G), jnp.float32)
+    acc0 = jnp.zeros((Hkv, G, D), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_pages, body, (m0, l0, acc0))
+
+    out = acc / jnp.maximum(l, 1e-20)[..., None]
+    out_ref[0] = out.reshape(Hq, D).astype(out_ref.dtype)
+
+
+def make_perseq_variant(cast_f32: bool):
+    import functools as ft
+
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def run(q, k_pages, v_pages, page_tables, positions):
+        B, Hq, D = q.shape
+        P, ps, Hkv, _ = k_pages.shape
+        lengths = positions.astype(jnp.int32) + 1
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B,),
+            in_specs=[
+                pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((2, ps, Hkv, D), k_pages.dtype),
+                pltpu.VMEM((2, ps, Hkv, D), v_pages.dtype),
+                pltpu.SemaphoreType.DMA((2, 2)),
+            ],
+        )
+        kernel = pl.pallas_call(
+            ft.partial(_perseq_variant_kernel, page_size=ps, cast_f32=cast_f32),
+            out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+            grid_spec=grid_spec,
+        )
+        return kernel(page_tables.astype(jnp.int32), lengths, q, k_pages, v_pages)
+
+    return run
+
+
 def main():
     rng = np.random.default_rng(0)
     LP = L * PAGES_PER_LAYER
@@ -62,13 +268,34 @@ def main():
 
     from dynamo_tpu.ops.pallas import paged_attention as pa
 
+    # _nt (no-transpose via dot_general batch dims ((0,),(1,))) variants were
+    # measured Mosaic-ILLEGAL (remote_compile 500: tpu.matmul requires leading
+    # batch dims) — deleted after the r5 A/B; the transpose stays.
     variants = {
         "perseq": pa.paged_decode_attention_pallas,
+        "dmaonly": paged_decode_dmaonly,
+        "perseq_bf16": make_perseq_variant(cast_f32=False),
         "chunked": pa.paged_decode_attention_pallas_chunked,
         "grouped": pa.paged_decode_attention_pallas_grouped,
     }
     if hasattr(pa, "paged_decode_attention_pallas_fused"):
         variants["fused"] = pa.paged_decode_attention_pallas_fused
+
+    # numerics gate: every variant must agree with perseq before its timing
+    # is taken seriously (dmaonly is exempt — it computes garbage by design)
+    ref = np.asarray(
+        variants["perseq"](q, k_pages, v_pages, page_tables, positions),
+        np.float32,
+    )
+    for name, kern in variants.items():
+        if name in ("perseq", "dmaonly"):
+            continue
+        try:
+            out = np.asarray(kern(q, k_pages, v_pages, page_tables, positions), np.float32)
+            err = float(np.max(np.abs(out - ref)))
+            print(f"{name:14s}: max|diff vs perseq| = {err:.4f}", flush=True)
+        except Exception as e:
+            print(f"{name:14s}: NUMERICS FAILED {type(e).__name__}: {str(e)[:160]}", flush=True)
 
     results = {}
     for name, kern in variants.items():
