@@ -801,8 +801,14 @@ async def run_disagg_parity(
             "prefill_s_per_req_marginal_in_mix vs _isolated shows it), so "
             "disaggregation has no interference to remove HERE; the "
             "reference's +30% materializes at >=2 workers where pool "
-            "specialization and prefill/decode isolation apply (BASELINE.md "
-            "checkpoint 3 needs a multi-chip slice this testbed lacks)"
+            "specialization and prefill/decode isolation apply. The "
+            "MECHANISM is demonstrated structurally in CI "
+            "(tests/test_disagg.py::test_disagg_pool_specialization_counters): "
+            "with a prefill worker joined, the decode engine's local prefill "
+            "rows collapse to ~0 (remote_prefills == all long prompts) with "
+            "token-exact outputs and no added page-pressure events — the "
+            "interference the reference's disagg removes, observed in "
+            "counters where single-chip wall time cannot show it"
         ),
     }
 
@@ -848,27 +854,37 @@ async def run_http_serving(batch: int = 32, page_size: int = 64) -> dict:
     ))
     await engine.start()
 
-    # engine-loop leg: the SAME engine and workload shape with the HTTP/
-    # preprocessor/detokenizer/SSE stack removed — the serving-overhead
+    rng = np.random.default_rng(17)
+
+    # engine-loop leg runner: the SAME engine and workload shape with the
+    # HTTP/preprocessor/detokenizer/SSE stack removed — the serving-overhead
     # denominator. Cross-session comparisons are useless here (the tunnel
     # drifts 2x run-to-run); only a same-process ratio is meaningful.
     # 304 tokens = the measured tokenized length of this section's chat
     # prompts, so both legs hit the same prefill bucket/packing shape.
-    rng = np.random.default_rng(17)
-    tok_prompts = [rng.integers(1, 30000, 304).tolist() for _ in range(batch)]
-    await asyncio.gather(*[
-        _request(engine, f"eng-w-{i}", tok_prompts[i], max_tokens=8)
-        for i in range(batch)
-    ])
-    eng_best = 0.0
-    for rnd in range(2):
+    async def engine_round(rnd: int):
         fresh = [rng.integers(1, 30000, 304).tolist() for _ in range(batch)]
         t0 = _time.monotonic()
-        await asyncio.gather(*[
+        res = await asyncio.gather(*[
             _request(engine, f"eng-{rnd}-{i}", fresh[i], max_tokens=DECODE_TOKENS)
             for i in range(batch)
         ])
-        eng_best = max(eng_best, batch * DECODE_TOKENS / (_time.monotonic() - t0))
+        tok_s = batch * DECODE_TOKENS / (_time.monotonic() - t0)
+        return tok_s, [t for _, t, _ in res]
+
+    # symmetric warmup (r4 post-mortem: the engine leg measured BELOW the
+    # HTTP leg — ratio 1.105 > 1 — because it ran first, straight out of
+    # 8-token warmups, paying the allocator's fill/evict transient that
+    # run_config's full-length warmup pass exists to absorb):
+    #   1. both legs get an 8-token compile warmup
+    #   2. both legs get one full-length warmup round (allocator steady state)
+    #   3. measured rounds ALTERNATE engine/HTTP so tunnel drift between legs
+    #      cancels instead of biasing whichever leg ran last
+    await asyncio.gather(*[
+        _request(engine, f"eng-w-{i}", rng.integers(1, 30000, 304).tolist(), max_tokens=8)
+        for i in range(batch)
+    ])
+    await engine_round(99)  # engine full-length warmup
 
     svc = HttpService(host="127.0.0.1", port=0)
     svc.manager.add(build_pipeline(engine, card))
@@ -894,18 +910,27 @@ async def run_http_serving(batch: int = 32, page_size: int = 64) -> dict:
         async with session.post(f"{base}/chat/completions", json=body) as r:
             r.raise_for_status()
             async for line in r.content:
-                if line.startswith(b"data:") and b"content" in line:
+                # first delta chunk of ANY kind: the service now emits the
+                # role chunk at first-token time, so this is true first-token
+                # TTFT — comparable to the engine leg's (first CONTENT can
+                # lag several tokens while byte fragments stabilize)
+                if line.startswith(b"data:") and b'"delta"' in line:
                     if ttft is None:
                         ttft = _time.monotonic() - t0
         if ttft is None:
-            # random-weight sampling can emit a run of byte-fragment tokens
-            # that never stabilizes into visible text (short warmups); the
-            # stream still completed with 200, so fall back to stream end
-            ttft = _time.monotonic() - t0
+            ttft = _time.monotonic() - t0  # stream completed with no delta
         # ignore_eos + max_tokens => the engine generated exactly max_tokens
         # (SSE delta count undercounts: multi-token BPE merges coalesce)
         return max_tokens, ttft
 
+    async def http_round(session, rnd):
+        t0 = _time.monotonic()
+        results = await asyncio.gather(*[one(session, i, rnd) for i in range(batch)])
+        elapsed = _time.monotonic() - t0
+        toks = sum(n for n, _ in results)
+        return toks / elapsed, elapsed, [t for _, t in results if t is not None]
+
+    eng_rounds, http_rounds = [], []
     try:
         # no total timeout (aiohttp default 300 s aborted r3's whole bench):
         # per-request pacing is the sock_read gap between stream chunks, sized
@@ -915,23 +940,22 @@ async def run_http_serving(batch: int = 32, page_size: int = 64) -> dict:
             total=None, sock_connect=60, sock_read=600
         )
         async with aiohttp.ClientSession(timeout=client_timeout) as session:
-            await asyncio.gather(*[one(session, i, 0, max_tokens=8) for i in range(batch)])  # warmup
-            best = None
+            # HTTP leg warmups: compile (8 tok) + one full-length round, so
+            # both legs enter their measured rounds in the same engine state
+            await asyncio.gather(*[one(session, i, 0, max_tokens=8) for i in range(batch)])
+            await http_round(session, 98)
+            # measured rounds alternate legs (tunnel drift cancels)
             for rnd in (1, 2):
-                t0 = _time.monotonic()
-                results = await asyncio.gather(*[one(session, i, rnd) for i in range(batch)])
-                elapsed = _time.monotonic() - t0
-                toks = sum(n for n, _ in results)
-                ttfts = [t for _, t in results if t is not None]
-                if best is None or toks / elapsed > best[0]:
-                    best = (toks / elapsed, elapsed, ttfts)
+                eng_rounds.append(await engine_round(rnd))
+                http_rounds.append(await http_round(session, rnd))
     finally:
         # a failed round must not leak the engine's HBM into the parity
         # sections that start their own engines next
         await svc.stop()
         await engine.shutdown()
         gc.collect()
-    tok_s, elapsed, ttfts = best
+    eng_best, eng_ttfts = max(eng_rounds, key=lambda r: r[0])
+    tok_s, elapsed, ttfts = max(http_rounds, key=lambda r: r[0])
     return {
         "model": "TinyLlama-1.1B geometry (synthetic HF checkpoint)",
         "endpoint": "/v1/chat/completions (stream)",
@@ -939,10 +963,16 @@ async def run_http_serving(batch: int = 32, page_size: int = 64) -> dict:
         "engine_loop_tok_s": round(eng_best, 2),
         "http_over_engine_ratio": round(tok_s / eng_best, 3) if eng_best else None,
         "ttft_p50_ms": round(float(np.percentile(ttfts, 50)) * 1e3, 1),
+        "engine_ttft_p50_ms": round(float(np.percentile(eng_ttfts, 50)) * 1e3, 1),
+        "rounds": {
+            "engine_tok_s": [round(r[0], 1) for r in eng_rounds],
+            "http_tok_s": [round(r[0], 1) for r in http_rounds],
+        },
         "batch": batch,
         "decode_tokens": DECODE_TOKENS,
         "elapsed_s": round(elapsed, 3),
-        "target": "http_over_engine_ratio >= 0.8 (same process, same shapes)",
+        "target": "http_over_engine_ratio in (0.8, 1.0] (same process, same "
+                  "shapes, symmetric warmup, alternating measured rounds)",
     }
 
 

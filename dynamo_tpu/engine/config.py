@@ -49,6 +49,13 @@ class EngineConfig:
     # crossover (~512 rows on v5e) — beyond that prefill is compute-bound
     # and packing stops paying. 1 = disabled (per-request prefill).
     prefill_lanes: int = 4
+    # admission fairness: at most this many (packed) prefill calls dispatch
+    # per scheduler step before decode windows get the chip again. A request
+    # burst otherwise serializes ALL its prefill passes ahead of any decode
+    # window, stalling every running stream's ITL for the whole burst (and
+    # the burst's own later requests gain nothing — their prefills still
+    # queue). 0 = unbounded (pre-r5 behavior).
+    prefill_batches_per_step: int = 2
     # pre-compile the decode-window trace variants (default / extras /
     # logprobs) at startup so the first feature-bearing request never hits a
     # cold multi-second XLA compile mid-serving. Off by default: tests and

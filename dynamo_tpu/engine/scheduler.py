@@ -156,6 +156,13 @@ class Scheduler:
         self.in_flight: deque[_InFlight] = deque()
         self._admit_counter = 0
         self.finished_count = 0
+        # structural interference counters (read by tests/metrics): what a
+        # decode pool pays for colocated prefill work. Pool specialization
+        # (disagg) shows up as these dropping on the decode side while
+        # remote_prefills rises on its DisaggDecodeEngine wrapper.
+        self.preempt_count = 0  # sequences bounced back to waiting (page pressure)
+        self.pressure_drain_count = 0  # pipeline drains forced by ensure_capacity misses
+        self.local_prefill_rows = 0  # prompt tokens prefilled on THIS engine's chip
 
     # ---------------- queue ----------------
 
@@ -231,11 +238,36 @@ class Scheduler:
             self.runner.write_token_slots(
                 np.array([slot], np.int32), np.array([seq.generated[-1]], np.int32)
             )
+        # admission fairness for the PER-REQUEST prefill path (packed path
+        # disabled: pp/sp meshes, multimodal, prefill_lanes=1): starting a
+        # sequence there dispatches its whole prefill chain immediately, so
+        # cap new starts per step like _dispatch_prefill_batches caps packed
+        # calls — a burst must not serialize all its weight passes ahead of
+        # running decode windows
+        cap = self.config.prefill_batches_per_step
+        decode_running = any(
+            s is not None and not s.finished and s.prefill_pos is None
+            for s in self.slots
+        )
+        packed_mode = (
+            self.config.prefill_lanes > 1
+            and self.config.pp == 1
+            and self.config.sp == 1
+            and hasattr(self.runner.model, "prefill_packed")
+        )
+        started = 0
         while self.waiting:
             slot = self._free_slot()
             if slot is None:
                 break
             req = self.waiting[0]
+            if (
+                cap
+                and decode_running
+                and started >= cap
+                and not (packed_mode and not req.images)
+            ):
+                break
             if len(req.token_ids) > self.config.max_model_len:
                 self.waiting.popleft()
                 outputs.append(
@@ -248,6 +280,7 @@ class Scheduler:
             self.waiting.popleft()
             try:
                 self._start_sequence(req, slot)
+                started += 1
             except MemoryError:
                 self.waiting.appendleft(req)
                 break
@@ -316,9 +349,22 @@ class Scheduler:
         sequence contributes at most one chunk per call (chunk i+1 reads the
         pages chunk i wrote, so same-sequence chunks ride consecutive calls).
         Single pending chunks take the per-request path — a packed call pads
-        compute to its full lane count, which a lone request shouldn't pay."""
+        compute to its full lane count, which a lone request shouldn't pay.
+
+        Fairness: dispatches at most ``config.prefill_batches_per_step``
+        calls per invocation when decode work is running, so a burst of new
+        prompts cannot serialize all its weight passes ahead of the decode
+        windows that running streams' ITL depends on (step() alternates back
+        here after the windows dispatch)."""
         count = 0
+        cap = self.config.prefill_batches_per_step
+        decode_running = any(
+            s is not None and not s.finished and s.prefill_pos is None
+            for s in self.slots
+        )
         while True:
+            if cap and decode_running and count >= cap:
+                return count
             pending = sorted(
                 (s for s in self.slots
                  if s is not None and not s.finished and s.prefill_pos is not None),
@@ -378,6 +424,7 @@ class Scheduler:
                 if is_final:
                     finals.append((seq, j))
                     want_lp = want_lp or seq.req.logprobs is not None
+            self.local_prefill_rows += sum(end - start for _, start, end in chunks)
             try:
                 result = self.runner.prefill_chunk_batch(
                     lanes, N=lanes_max, want_logprobs=want_lp
@@ -474,6 +521,7 @@ class Scheduler:
         output token on the final chunk. sync=True (disagg prefill-worker path)
         returns it as a host int; sync=False returns the device scalar.
         prep=False skips _prep_prefill (already run at packed-path admission)."""
+        self.local_prefill_rows += max(0, prompt_len - cached_len)
         s = req.sampling
         first_token = None
         start = cached_len
@@ -577,6 +625,7 @@ class Scheduler:
                 # page pressure: drain the pipeline (may free pages via EOS),
                 # then preempt the most recent victim
                 if self.in_flight:
+                    self.pressure_drain_count += 1
                     outputs.extend(self._reconcile(block=True, drain=True))
                     continue
                 victim = self._pick_victim(exclude=seq)
@@ -780,6 +829,7 @@ class Scheduler:
         (prefix cache usually recovers most of it). Callers must drain the
         pipeline first so seq.generated is complete."""
         log.info("preempting %s (page pressure)", seq.req.request_id)
+        self.preempt_count += 1
         seq.finished = True  # stray in-flight snapshots must skip it
         self.allocator.free_sequence(seq.req.request_id)
         if seq.slot >= 0 and self.slots[seq.slot] is seq:

@@ -299,6 +299,13 @@ class HttpService:
             usage.completion_tokens = out.cumulative_tokens
             if t_first is None and out.token_ids:
                 t_first = time.monotonic()
+                # OpenAI semantics: the role delta leads the stream at first-
+                # token time. Also the client's only honest TTFT signal — the
+                # first CONTENT delta can lag several tokens behind while the
+                # detokenizer waits for a stable byte sequence.
+                role = getattr(gen, "role_chunk", None)
+                if role is not None and not gen._sent_role:
+                    yield role()
             if tool_matcher is not None:
                 if out.text:
                     buffered.append(out.text)

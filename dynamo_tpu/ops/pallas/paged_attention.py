@@ -41,8 +41,30 @@ block-diagonal masks; fully Mosaic-legal). The concat variant measured
 correct mental model: Mosaic pipelines ACROSS grid programs, so B
 one-sequence programs overlap each other's DMAs and compute for free;
 any within-program grouping trades that away for a serialized group body.
-perseq IS the design point; the remaining ~2x over the KV-read floor is
-the price of 2-page sequences (one chunk of overlap depth).
+
+Round 5 re-measured with a corrected harness (tools/profile_attn.py now
+DIFFERENCES two chained-scan lengths — a single wall/N division leaves the
+~100 ms tunnel dispatch RTT in every number and had inflated the r4 record
+by the RTT share) and settled the floor question with a null-hypothesis
+kernel (same grid, same 2-page double-buffered DMA stream, NO attention
+math):
+
+    dmaonly (null)       100.6 us/call    2.41 ms/step   <- measured floor
+    pure KV-read ideal    81.9 us/call    1.97 ms/step   (819 GB/s)
+    perseq               149.6 us/call    3.59 ms/step   <- production
+    perseq bf16-no-cast  402.9 us/call    9.67 ms/step   (2.7x SLOWER)
+    chunked / grouped    477.7 / 459.3 us/call
+
+Conclusions: (1) the DMA stream itself runs at 81% of ideal HBM bandwidth —
+the floor claim is PROVEN by measurement, not prose; (2) perseq carries
+~49 us/call of compute not hidden under DMA (1.49x the measured floor, not
+the 2x the r4 wall/N numbers suggested); (3) dropping the f32 casts makes
+the kernel 2.7x SLOWER — Mosaic relayouts ([ps,Hkv,D]->[Hkv,ps,D]) are far
+cheaper in 32-bit than bf16, so the casts this kernel carries are
+load-bearing, and the no-transpose dot_general variants (batch dim in K's
+middle position) are Mosaic-illegal outright (tpu.matmul requires leading
+batch dims). perseq IS the design point; the remaining headline frontier
+is the ~2.4 ms/step host-side window residue, not this kernel.
 """
 
 from __future__ import annotations

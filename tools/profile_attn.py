@@ -3,8 +3,12 @@
 Headline bench geometry (bench.py): B=64, Hq=16, Hkv=8, D=128, ps=128,
 24-layer flat pool (224 pages/layer), context ~256 tokens (2 pages/seq).
 
-Timing method: chain N kernel calls inside one jitted lax.scan (output q feeds
-the next call), so per-call time excludes the tunneled-PJRT dispatch RTT.
+Timing method: chain kernel calls inside one jitted lax.scan (output q feeds
+the next call) at TWO scan lengths and difference the walls: per-call =
+(t_long - t_short) / (N_long - N_short). The r5 session measured a ~100 ms
+per-dispatch tunnel RTT that a single wall/N division does NOT cancel —
+every variant read ~3.1 ms/call (= RTT/32) while the live engine did whole
+24-layer steps in 10 ms; only the two-length difference isolates execution.
 
 Usage: python tools/profile_attn.py [B] [ps] [ctx]
 """
@@ -28,19 +32,32 @@ Hq, Hkv, D = 16, 8, 128
 L = 24
 PAGES_PER_LAYER = 224
 MAX_PAGES = 8  # max_model_len 1024 / ps 128
-N_ITERS = 32
+N_SHORT = 16
+N_LONG = 144
 
 
-def timed(fn, *args):
-    out = fn(*args)
-    jax.block_until_ready(out)
+def _sync(out):
+    # np.asarray of one element forces completion (block_until_ready can
+    # return early on the axon platform)
+    np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+
+
+def _wall(fn, *args):
+    _sync(fn(*args))  # compile
     best = 1e9
-    for _ in range(3):
+    for _ in range(4):
         t0 = time.perf_counter()
-        out = fn(*args)
-        jax.block_until_ready(out)
+        _sync(fn(*args))
         best = min(best, time.perf_counter() - t0)
-    return best / N_ITERS
+    return best
+
+
+def timed(make_loop, *args):
+    """Per-call execution time with the dispatch RTT cancelled: difference
+    the walls of two scan lengths."""
+    t_short = _wall(make_loop(N_SHORT), *args)
+    t_long = _wall(make_loop(N_LONG), *args)
+    return max(t_long - t_short, 1e-9) / (N_LONG - N_SHORT)
 
 
 def _null_kernel(
@@ -213,6 +230,133 @@ def _perseq_variant_kernel(
     out_ref[0] = out.reshape(Hq, D).astype(out_ref.dtype)
 
 
+def _lookahead_kernel(
+    page_tables_ref, lengths_ref, q_ref, k_hbm, v_hbm, out_ref,
+    k_scratch, v_scratch, sems, *, page_size: int, max_pages_live: int,
+):
+    """Cross-PROGRAM DMA pipelining for short (<= max_pages_live pages)
+    sequences: scratch persists across grid programs, and the page table is
+    scalar-prefetched, so program b issues program b+1's page DMAs into the
+    opposite parity's slot pair while it computes on its own (prefetched by
+    b-1). The per-program DMA-latency exposure at each program boundary —
+    what separates perseq from the dmaonly floor — collapses to one program's
+    worth for the whole grid."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _NEG_INF = -1e30
+    b = pl.program_id(0)
+    nb = pl.num_programs(0)
+    par = jax.lax.rem(b, 2)
+    length = lengths_ref[b]
+    n_pages = jnp.minimum(
+        jnp.maximum(1, pl.cdiv(length, page_size)), max_pages_live
+    )
+
+    Hq, D = q_ref.shape[1], q_ref.shape[2]
+    Hkv = k_hbm.shape[2]
+    G = Hq // Hkv
+    q = q_ref[0].astype(jnp.float32).reshape(Hkv, G, D)
+    scale = 1.0 / jnp.sqrt(jnp.float32(D))
+
+    def dma(parity, j, seq_idx, page_j, which):
+        hbm, scratch = (k_hbm, k_scratch) if which == 0 else (v_hbm, v_scratch)
+        return pltpu.make_async_copy(
+            hbm.at[page_tables_ref[seq_idx, page_j]],
+            scratch.at[parity, j],
+            sems.at[parity, j, which],
+        )
+
+    def issue_for(seq_idx, parity):
+        npg = jnp.minimum(
+            jnp.maximum(1, pl.cdiv(lengths_ref[seq_idx], page_size)),
+            max_pages_live,
+        )
+        for j in range(max_pages_live):  # static unroll: DMA issues only
+            @pl.when(j < npg)
+            def _(j=j):
+                dma(parity, j, seq_idx, j, 0).start()
+                dma(parity, j, seq_idx, j, 1).start()
+
+    @pl.when(b == 0)
+    def _():
+        issue_for(0, 0)
+    # prefetch the NEXT program's pages while this one computes
+    @pl.when(b + 1 < nb)
+    def _():
+        issue_for(b + 1, 1 - par)
+
+    def body(j, carry):
+        m, l, acc = carry
+        dma(par, j, b, j, 0).wait()
+        dma(par, j, b, j, 1).wait()
+        k_page = k_scratch[par, j].astype(jnp.float32)
+        v_page = v_scratch[par, j].astype(jnp.float32)
+        kt = jnp.transpose(k_page, (1, 0, 2))
+        vt = jnp.transpose(v_page, (1, 0, 2))
+        scores = jax.lax.dot_general(
+            q, kt, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
+        ) * scale
+        idx = j * page_size + jax.lax.broadcasted_iota(jnp.int32, (1, 1, page_size), 2)
+        scores = jnp.where(idx < length, scores, _NEG_INF)
+        chunk_max = jnp.max(scores, axis=-1)
+        new_m = jnp.maximum(m, chunk_max)
+        corr = jnp.exp(m - new_m)
+        probs = jnp.exp(scores - new_m[..., None])
+        new_l = l * corr + jnp.sum(probs, axis=-1)
+        chunk_out = jax.lax.dot_general(
+            probs, vt, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
+        )
+        return new_m, new_l, acc * corr[..., None] + chunk_out
+
+    m0 = jnp.full((Hkv, G), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((Hkv, G), jnp.float32)
+    acc0 = jnp.zeros((Hkv, G, D), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_pages, body, (m0, l0, acc0))
+
+    out = acc / jnp.maximum(l, 1e-20)[..., None]
+    out_ref[0] = out.reshape(Hq, D).astype(out_ref.dtype)
+
+
+def make_lookahead(max_pages_live: int = 2):
+    import functools as ft
+
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def run(q, k_pages, v_pages, page_tables, positions):
+        B, Hq, D = q.shape
+        P, ps, Hkv, _ = k_pages.shape
+        lengths = positions.astype(jnp.int32) + 1
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B,),
+            in_specs=[
+                pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((2, max_pages_live, ps, Hkv, D), k_pages.dtype),
+                pltpu.VMEM((2, max_pages_live, ps, Hkv, D), v_pages.dtype),
+                pltpu.SemaphoreType.DMA((2, max_pages_live, 2)),
+            ],
+        )
+        kernel = pl.pallas_call(
+            ft.partial(_lookahead_kernel, page_size=ps,
+                       max_pages_live=max_pages_live),
+            out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+            grid_spec=grid_spec,
+        )
+        return kernel(page_tables.astype(jnp.int32), lengths, q, k_pages, v_pages)
+
+    return run
+
+
 def make_perseq_variant(cast_f32: bool):
     import functools as ft
 
@@ -278,6 +422,9 @@ def main():
         "chunked": pa.paged_decode_attention_pallas_chunked,
         "grouped": pa.paged_decode_attention_pallas_grouped,
     }
+    if -(-CTX // PS) <= 2:
+        # cross-program DMA pipelining (only valid <= 2 pages/seq here)
+        variants["lookahead"] = make_lookahead(2)
     if hasattr(pa, "paged_decode_attention_pallas_fused"):
         variants["fused"] = pa.paged_decode_attention_pallas_fused
 
@@ -287,6 +434,7 @@ def main():
         variants["perseq"](q, k_pages, v_pages, page_tables, positions),
         np.float32,
     )
+    bad = set()
     for name, kern in variants.items():
         if name in ("perseq", "dmaonly"):
             continue
@@ -294,21 +442,29 @@ def main():
             out = np.asarray(kern(q, k_pages, v_pages, page_tables, positions), np.float32)
             err = float(np.max(np.abs(out - ref)))
             print(f"{name:14s}: max|diff vs perseq| = {err:.4f}", flush=True)
+            if err > 0.05:
+                bad.add(name)
         except Exception as e:
             print(f"{name:14s}: NUMERICS FAILED {type(e).__name__}: {str(e)[:160]}", flush=True)
+            bad.add(name)
 
     results = {}
     for name, kern in variants.items():
-        @jax.jit
-        def loop(q0, kp, vp, ptab, pos, kern=kern):
-            def body(qc, _):
-                o = kern(qc, kp, vp, ptab, pos)
-                return o, ()
-            qf, _ = jax.lax.scan(body, q0, None, length=N_ITERS)
-            return qf
+        if name in bad:
+            print(f"{name:10s}: SKIPPED (failed numerics gate)", flush=True)
+            continue
+        def make_loop(n, kern=kern):
+            @jax.jit
+            def loop(q0, kp, vp, ptab, pos):
+                def body(qc, _):
+                    o = kern(qc, kp, vp, ptab, pos)
+                    return o, ()
+                qf, _ = jax.lax.scan(body, q0, None, length=n)
+                return qf
+            return loop
 
         try:
-            t = timed(loop, q, k_pages, v_pages, page_tables, positions)
+            t = timed(make_loop, q, k_pages, v_pages, page_tables, positions)
             results[name] = t
             # per decode STEP (x L layers) attention cost
             print(f"{name:10s}: {t*1e6:8.1f} us/call -> {t*L*1e3:6.2f} ms/step (x{L} layers)", flush=True)
@@ -324,15 +480,17 @@ def main():
     w = jnp.asarray(rng.standard_normal((2048, 5632)) * 0.02, jnp.bfloat16)
     h = jnp.asarray(rng.standard_normal((B, 2048)) * 0.1, jnp.bfloat16)
 
-    @jax.jit
-    def mm_loop(h0, w0):
-        def body(hc, _):
-            o = hc @ w0
-            return (o @ w0.T * 1e-3).astype(jnp.bfloat16), ()
-        hf, _ = jax.lax.scan(body, h0, None, length=N_ITERS)
-        return hf
+    def make_mm_loop(n):
+        @jax.jit
+        def mm_loop(h0, w0):
+            def body(hc, _):
+                o = hc @ w0
+                return (o @ w0.T * 1e-3).astype(jnp.bfloat16), ()
+            hf, _ = jax.lax.scan(body, h0, None, length=n)
+            return hf
+        return mm_loop
 
-    t = timed(mm_loop, h, w)
+    t = timed(make_mm_loop, h, w)
     mm_bytes = 2048 * 5632 * 2 * 2
     print(f"matmul pair [B,2048]x[2048,5632]x2: {t*1e6:.1f} us/iter "
           f"(weight bytes {mm_bytes/1e6:.0f} MB -> floor {mm_bytes/819e9*1e6:.1f} us)")
