@@ -234,23 +234,26 @@ def dispatch_paged_decode_attention(q, k_pages, v_pages, page_tables, positions,
             paged_decode_attention_pallas_chunked,
             paged_decode_attention_pallas_folded,
             paged_decode_attention_pallas_grouped,
+            paged_decode_attention_pallas_lookahead,
         )
 
-        # perseq (default): one grid program per sequence, double-buffered
-        # per-page DMA — fastest on v5e across bs 8-128 (A/B'd on chip).
-        # chunked: C pages per DMA group + larger matmuls (kept for A/B;
-        # VMEM-safe, unlike a full cross-sequence batching of the scratch).
-        # folded: head_dim < 128 shapes (Mosaic can't DMA-slice sub-128-lane
-        # pools; heads live folded into the lane dim — see kv_folded).
+        # lookahead (default): perseq's per-sequence program + double
+        # buffer, plus cross-program DMA prefetch (r5 A/B: at ideal KV-read
+        # bandwidth). perseq: the classic in-program-only double buffer
+        # (the r4 design point; the escape hatch). chunked/grouped: kept
+        # selectable for future hardware — both lost on v5e (bs 8-128,
+        # ps 16-128). folded: head_dim < 128 shapes (Mosaic can't DMA-slice
+        # sub-128-lane pools; heads live folded into the lane dim).
         folded = k_pages.ndim == 3
-        # perseq (default) beat every alternative in on-chip A/Bs (v5e,
-        # bs 8-128, ps 16-128): "chunked" (C pages per DMA group), "grouped"
-        # (several sequences per program — the per-group unrolled compute
-        # costs more than the per-program overhead it saves). Both kept
-        # selectable for future hardware.
-        kernel_choice = os.environ.get("DYNTPU_DECODE_KERNEL", "perseq")
+        # lookahead (default since r5): perseq + cross-program DMA
+        # prefetch — measured AT the ideal KV-read bandwidth (78.9 us/call
+        # vs perseq's 141 at the headline shape); falls back to perseq
+        # internally when the prefetch window would blow the VMEM budget
+        kernel_choice = os.environ.get("DYNTPU_DECODE_KERNEL", "lookahead")
         if folded or q.shape[-1] % 128 != 0:
             paged_decode_attention_pallas = paged_decode_attention_pallas_folded
+        elif kernel_choice == "lookahead":
+            paged_decode_attention_pallas = paged_decode_attention_pallas_lookahead
         elif kernel_choice == "chunked":
             paged_decode_attention_pallas = paged_decode_attention_pallas_chunked
         elif kernel_choice == "grouped":

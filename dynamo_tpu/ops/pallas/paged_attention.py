@@ -15,7 +15,7 @@ Kernel A/B record (v5e-1, bench headline geometry B=64 Hq16/Hkv8 D128
 ps=128 ctx=256, 24-layer chained-scan harness, best-of-4 wall time with the
 tunnel RTT cancelled; round 4):
 
-    perseq (this file's default)      4.32 ms/step   <- production
+    perseq (r4's default)             4.32 ms/step   <- r4 production
     perseq at ps=256 (1 page/seq)     5.22 ms/step   (no DMA/compute overlap)
     grouped ps=128 / ps=256          12.06 / 11.35 ms/step
     chunked                          12.76 ms/step
@@ -63,8 +63,18 @@ the kernel 2.7x SLOWER — Mosaic relayouts ([ps,Hkv,D]->[Hkv,ps,D]) are far
 cheaper in 32-bit than bf16, so the casts this kernel carries are
 load-bearing, and the no-transpose dot_general variants (batch dim in K's
 middle position) are Mosaic-illegal outright (tpu.matmul requires leading
-batch dims). perseq IS the design point; the remaining headline frontier
-is the ~2.4 ms/step host-side window residue, not this kernel.
+batch dims).
+
+The r5 finding that DID pay: the gap between perseq and the floor is the
+per-program DMA-latency exposure at every grid-program boundary, and the
+page table being scalar-prefetched means program b can issue program b+1's
+DMAs — see _kernel_lookahead below:
+
+    lookahead (r5 default)        78.9 us/call    1.89 ms/step
+
+Measured numerically exact, BELOW the null kernel (the boundary latency it
+removes also bounds dmaonly), and worth +14.7%% end-to-end on the serving
+headline (6338 -> 7270 tok/s same session, engine bench).
 """
 
 from __future__ import annotations
@@ -319,6 +329,208 @@ def paged_decode_attention_pallas_grouped(
     )
     kernel = pl.pallas_call(
         functools.partial(_kernel_grouped, page_size=ps, group=group),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )
+    return kernel(page_tables.astype(jnp.int32), lengths, q, k_pages, v_pages)
+
+
+def _kernel_lookahead(
+    # scalar prefetch
+    page_tables_ref,  # [B, max_pages] SMEM
+    lengths_ref,  # [B] SMEM
+    # inputs
+    q_ref,  # [1, Hq, D] VMEM (this sequence's query)
+    k_hbm,  # [P, ps, Hkv, D] HBM
+    v_hbm,  # [P, ps, Hkv, D] HBM
+    # output
+    out_ref,  # [1, Hq, D] VMEM
+    # scratch
+    k_pre,  # [2, W, ps, Hkv, D] VMEM — per-parity prefetch window
+    v_pre,
+    k_tail,  # [2, ps, Hkv, D] VMEM — classic double buffer for pages >= W
+    v_tail,
+    sems_pre,  # DMA sems [2, W, 2]
+    sems_tail,  # DMA sems [2, 2]
+    *,
+    page_size: int,
+    lookahead: int,
+):
+    """perseq with CROSS-PROGRAM DMA pipelining (r5 A/B: 78.9 us/call vs
+    perseq's 141 at the headline shape — below even the dmaonly null kernel,
+    i.e. at ideal KV-read bandwidth).
+
+    Grid programs execute serially on the core, and scratch PERSISTS across
+    them; the page table is scalar-prefetched, so program b can issue program
+    b+1's first ``lookahead`` page DMAs into the opposite parity's slot pair
+    while it computes on its own pages (prefetched by b-1). The per-program
+    DMA-latency exposure at every program boundary — the entire gap between
+    perseq and the measured DMA floor — collapses to one program's worth for
+    the whole grid. Pages >= lookahead (long contexts) stream through the
+    classic in-program double buffer."""
+    b = pl.program_id(0)
+    nb = pl.num_programs(0)
+    par = jax.lax.rem(b, 2)
+    W = lookahead
+    length = lengths_ref[b]
+    n_pages = jnp.maximum(1, pl.cdiv(length, page_size))
+
+    Hq, D = q_ref.shape[1], q_ref.shape[2]
+    Hkv = k_hbm.shape[2]
+    G = Hq // Hkv
+    q = q_ref[0].astype(jnp.float32).reshape(Hkv, G, D)
+    scale = 1.0 / jnp.sqrt(jnp.float32(D))
+
+    def pre_dma(parity, j, seq_idx, which):
+        hbm, scratch = (k_hbm, k_pre) if which == 0 else (v_hbm, v_pre)
+        return pltpu.make_async_copy(
+            hbm.at[page_tables_ref[seq_idx, j]],
+            scratch.at[parity, j],
+            sems_pre.at[parity, j, which],
+        )
+
+    def tail_dma(slot, i, which):
+        hbm, scratch = (k_hbm, k_tail) if which == 0 else (v_hbm, v_tail)
+        return pltpu.make_async_copy(
+            hbm.at[page_tables_ref[b, i]],
+            scratch.at[slot],
+            sems_tail.at[slot, which],
+        )
+
+    def issue_pre(seq_idx, parity):
+        npg = jnp.maximum(1, pl.cdiv(lengths_ref[seq_idx], page_size))
+        for j in range(W):  # static unroll: DMA issues only
+
+            @pl.when(j < npg)
+            def _(j=j):
+                pre_dma(parity, j, seq_idx, 0).start()
+                pre_dma(parity, j, seq_idx, 1).start()
+
+    # program 0 has no predecessor: prefetch its own window
+    @pl.when(b == 0)
+    def _():
+        issue_pre(0, 0)
+
+    # prefetch the NEXT program's window while this one computes
+    @pl.when(b + 1 < nb)
+    def _():
+        issue_pre(b + 1, 1 - par)
+
+    # long-context tail: warm the in-program double buffer for page W
+    @pl.when(W < n_pages)
+    def _():
+        tail_dma(W % 2, W, 0).start()
+        tail_dma(W % 2, W, 1).start()
+
+    def merge(carry, k_page, v_page, j):
+        m, l, acc = carry
+        kt = jnp.transpose(k_page, (1, 0, 2))  # [Hkv, ps, D]
+        vt = jnp.transpose(v_page, (1, 0, 2))
+        scores = jax.lax.dot_general(
+            q, kt, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
+        ) * scale
+        idx = j * page_size + jax.lax.broadcasted_iota(jnp.int32, (1, 1, page_size), 2)
+        scores = jnp.where(idx < length, scores, _NEG_INF)
+        chunk_max = jnp.max(scores, axis=-1)
+        new_m = jnp.maximum(m, chunk_max)
+        corr = jnp.exp(m - new_m)
+        probs = jnp.exp(scores - new_m[..., None])
+        new_l = l * corr + jnp.sum(probs, axis=-1)
+        chunk_out = jax.lax.dot_general(
+            probs, vt, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
+        )
+        return new_m, new_l, acc * corr[..., None] + chunk_out
+
+    def pre_body(j, carry):
+        pre_dma(par, j, b, 0).wait()
+        pre_dma(par, j, b, 1).wait()
+        return merge(
+            carry,
+            k_pre[par, j].astype(jnp.float32),
+            v_pre[par, j].astype(jnp.float32),
+            j,
+        )
+
+    def tail_body(j, carry):
+        slot = jax.lax.rem(j, 2)
+        next_slot = jax.lax.rem(j + 1, 2)
+
+        @pl.when(j + 1 < n_pages)
+        def _():
+            tail_dma(next_slot, j + 1, 0).start()
+            tail_dma(next_slot, j + 1, 1).start()
+
+        tail_dma(slot, j, 0).wait()
+        tail_dma(slot, j, 1).wait()
+        return merge(
+            carry,
+            k_tail[slot].astype(jnp.float32),
+            v_tail[slot].astype(jnp.float32),
+            j,
+        )
+
+    m0 = jnp.full((Hkv, G), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((Hkv, G), jnp.float32)
+    acc0 = jnp.zeros((Hkv, G, D), jnp.float32)
+    carry = jax.lax.fori_loop(0, jnp.minimum(W, n_pages), pre_body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(W, n_pages, tail_body, carry)
+
+    out = acc / jnp.maximum(l, 1e-20)[..., None]
+    out_ref[0] = out.reshape(Hq, D).astype(out_ref.dtype)
+
+
+#: scratch budget for the lookahead window (VMEM is ~16 MB/core scoped)
+_LOOKAHEAD_SCRATCH_BYTES = 6 * 1024 * 1024
+
+
+def lookahead_window(page_size: int, num_kv_heads: int, head_dim: int,
+                     itemsize: int = 2) -> int:
+    """Prefetch window W that fits the scratch budget (0 = kernel not
+    applicable). Scratch = 2 parities x W pages x (k+v) + the 2-slot tail."""
+    page_bytes = page_size * num_kv_heads * head_dim * itemsize
+    budget = _LOOKAHEAD_SCRATCH_BYTES - 2 * 2 * page_bytes  # tail buffers
+    return max(0, min(4, budget // (2 * 2 * page_bytes)))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_decode_attention_pallas_lookahead(
+    q: jnp.ndarray,  # [B, Hq, D]
+    k_pages: jnp.ndarray,  # [P, ps, Hkv, D]
+    v_pages: jnp.ndarray,
+    page_tables: jnp.ndarray,  # [B, max_pages] int32
+    positions: jnp.ndarray,  # [B] int32 query positions
+    interpret: bool = False,
+) -> jnp.ndarray:
+    B, Hq, D = q.shape
+    P, ps, Hkv, _ = k_pages.shape
+    lengths = positions.astype(jnp.int32) + 1
+    W = lookahead_window(ps, Hkv, D, k_pages.dtype.itemsize)
+    if W < 1:
+        return paged_decode_attention_pallas(
+            q, k_pages, v_pages, page_tables, positions, interpret=interpret
+        )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, W, ps, Hkv, D), k_pages.dtype),
+            pltpu.VMEM((2, W, ps, Hkv, D), v_pages.dtype),
+            pltpu.VMEM((2, ps, Hkv, D), k_pages.dtype),
+            pltpu.VMEM((2, ps, Hkv, D), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, W, 2)),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    kernel = pl.pallas_call(
+        functools.partial(_kernel_lookahead, page_size=ps, lookahead=W),
         out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,

@@ -475,3 +475,44 @@ def test_engine_mla_prefill_pallas_token_parity(monkeypatch):
     monkeypatch.setenv("DYNTPU_PALLAS", "1")
     got = run()
     assert got == ref
+
+
+def test_pallas_mla_lookahead_tail_path():
+    """Lengths deep past the prefetch window W (the tail double-buffer path
+    long-context decodes hit in production) + ragged short sequences and odd
+    B for parity alternation — vs the same numpy reference (review r5)."""
+    from dynamo_tpu.ops.pallas.mla_attention import (
+        _mla_lookahead_window,
+        paged_mla_decode_attention_pallas,
+    )
+
+    rng = np.random.default_rng(9)
+    B, H, dc, dr, ps, P, mp = 5, 4, 32, 8, 4, 96, 14
+    latent = dc + dr
+    W = _mla_lookahead_window(ps, latent, 4)
+    assert 1 <= W <= 4
+    assert mp > W  # the tail path really engages
+    q_cat = jnp.asarray(rng.standard_normal((B, H, latent)), jnp.float32)
+    pages = jnp.asarray(rng.standard_normal((P, ps, latent)), jnp.float32)
+    pt = np.zeros((B, mp), np.int32)
+    pool = list(range(1, P))
+    rng.shuffle(pool)
+    for b in range(B):
+        pt[b] = pool[b * mp:(b + 1) * mp]
+    # 1 token; W pages exactly; W pages + 1 token; 14-page tail; 1 page
+    positions = jnp.asarray(
+        [0, W * ps - 1, W * ps, mp * ps - 2, ps - 1], jnp.int32
+    )
+
+    got = paged_mla_decode_attention_pallas(
+        q_cat, pages, jnp.asarray(pt), positions, d_c=dc, interpret=True
+    )
+    for b in range(B):
+        ctx = np.asarray(pages)[pt[b]].reshape(mp * ps, latent)
+        scores = np.asarray(q_cat)[b] @ ctx.T
+        mask = np.arange(mp * ps) <= int(positions[b])
+        scores = np.where(mask[None], scores, -1e30)
+        probs = np.exp(scores - scores.max(-1, keepdims=True))
+        probs /= probs.sum(-1, keepdims=True)
+        want = probs @ ctx[:, :dc]
+        np.testing.assert_allclose(np.asarray(got[b]), want, atol=2e-5)

@@ -247,3 +247,59 @@ def test_prefill_pallas_folded_matches_reference():
             np.asarray(got), np.asarray(ref), atol=2e-5,
             err_msg=f"T={T} Hq={Hq} Hkv={Hkv} start={start}",
         )
+
+
+def test_pallas_lookahead_matches_reference():
+    """Cross-program-prefetch kernel (r5 default): ragged lengths straddling
+    the prefetch window W — some sequences fully inside it, some spilling
+    into the tail double-buffer path — must match the XLA reference."""
+    from dynamo_tpu.ops.pallas.paged_attention import (
+        lookahead_window,
+        paged_decode_attention_pallas_lookahead,
+    )
+
+    q, k, v, pt, pos = make_case()
+    assert lookahead_window(4, 2, 16, 4) >= 1
+    got = paged_decode_attention_pallas_lookahead(q, k, v, pt, pos, interpret=True)
+    want = paged_decode_attention(q, k, v, pt, pos)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_pallas_lookahead_ragged_and_long_tails():
+    """Lengths from 1 token to many pages past the prefetch window, odd B
+    (parity alternation), duplicated shapes across calls."""
+    from dynamo_tpu.ops.pallas.paged_attention import (
+        paged_decode_attention_pallas_lookahead,
+    )
+
+    rng = np.random.default_rng(7)
+    B, Hq, Hkv, D, P, ps, max_pages = 5, 4, 2, 16, 64, 4, 12
+    q = jnp.asarray(rng.standard_normal((B, Hq, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((P, ps, Hkv, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((P, ps, Hkv, D)), jnp.float32)
+    pt = np.zeros((B, max_pages), np.int32)
+    used = set([0])
+    for b in range(B):
+        for j in range(max_pages):
+            p = int(rng.integers(1, P))
+            while p in used:
+                p = int(rng.integers(1, P))
+            used.add(p)
+            pt[b, j] = p
+    # lengths: 1 token; exactly W pages; W pages + 1 token; deep tail; page-1
+    positions = jnp.asarray([0, 2 * ps - 1, 2 * ps, 11 * ps - 1, ps - 1], jnp.int32)
+    got = paged_decode_attention_pallas_lookahead(
+        q, k, v, jnp.asarray(pt), positions, interpret=True
+    )
+    want = paged_decode_attention(q, k, v, jnp.asarray(pt), positions)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_pallas_lookahead_vmem_fallback():
+    """A geometry whose prefetch window would blow the VMEM budget must fall
+    back to perseq (same contract) rather than compile an oversized scratch."""
+    from dynamo_tpu.ops.pallas import paged_attention as pa
+
+    assert pa.lookahead_window(512, 32, 128, 2) == 0
+    # budget-fitting case picks at least 1, capped at 4
+    assert 1 <= pa.lookahead_window(128, 8, 128, 2) <= 4
