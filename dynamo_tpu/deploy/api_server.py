@@ -11,7 +11,7 @@ of calling a live cluster.
 
 Routes (all JSON):
   GET    /healthz
-  GET    /api/v1/clusters                      static single-cluster info
+  GET    /api/v1/clusters                      implicit local + registered
   GET    /api/v1/deployments                   list
   POST   /api/v1/deployments                   create (spec in body)
   GET    /api/v1/deployments/{name}            current spec + revision meta
@@ -20,11 +20,18 @@ Routes (all JSON):
   GET    /api/v1/deployments/{name}/revisions  history (newest first)
   POST   /api/v1/deployments/{name}/rollback/{rev}
   GET    /api/v1/deployments/{name}/manifests  rendered k8s objects
+  GET    /api/v1/deployments/{name}/status     controller status writeback
+  POST   /api/v1/builds                        register an image build (Job)
+  GET    /api/v1/builds[/{name}]               build records / phase
+  GET|POST /api/v1/clusters, GET|DELETE /api/v1/clusters/{name}
+  GET|POST /api/v1/deployment-targets[/{name}], DELETE .../{name}
+  GET|POST /api/v1/components, GET|DELETE /api/v1/components/{name}
 """
 
 from __future__ import annotations
 
 import json
+import re
 import time
 from pathlib import Path
 from typing import Optional
@@ -37,6 +44,14 @@ from dynamo_tpu.utils import get_logger
 
 log = get_logger("deploy.api")
 
+#: kubernetes object-name shape; used for every name that can reach kubectl
+DNS1123 = r"[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?"
+
+
+def _version_key(v: str):
+    """Natural ordering: '1.10' > '1.9', non-numeric parts compare as text."""
+    return [int(t) if t.isdigit() else t for t in re.split(r"(\d+)", v)]
+
 
 class DeploymentStore:
     """In-memory store: name -> list of revision records (oldest first)."""
@@ -45,6 +60,34 @@ class DeploymentStore:
         self._data: dict[str, list[dict]] = {}
         self._status: dict[str, dict] = {}  # controller-written status
         self._builds: dict[str, dict] = {}  # image-build records
+        # registry collections (the reference API server's clusters /
+        # deployment-target / component routes, reference:
+        # deploy/dynamo/api-server/api/routes/{cluster,deployment_target,
+        # dynamo_component}.go): kind -> name -> record
+        self._registry: dict[str, dict[str, dict]] = {
+            "clusters": {}, "deployment_targets": {}, "components": {},
+        }
+
+    # ---- registry collections ----
+
+    def put_item(self, kind: str, name: str, record: dict) -> None:
+        self._registry[kind][name] = record
+        self._flush_registry(kind, name)
+
+    def get_item(self, kind: str, name: str) -> Optional[dict]:
+        return self._registry[kind].get(name)
+
+    def list_items(self, kind: str) -> list[str]:
+        return sorted(self._registry[kind])
+
+    def delete_item(self, kind: str, name: str) -> bool:
+        existed = name in self._registry[kind]
+        self._registry[kind].pop(name, None)
+        self._flush_registry(kind, name)
+        return existed
+
+    def _flush_registry(self, kind: str, name: str) -> None:
+        pass
 
     def put_build(self, name: str, record: dict) -> None:
         self._builds[name] = record
@@ -117,18 +160,24 @@ class FileDeploymentStore(DeploymentStore):
             ):
                 self._data = loaded["revisions"]
                 self._builds = loaded.get("builds", {})
+                for kind, items in loaded.get("registry", {}).items():
+                    self._registry.setdefault(kind, {}).update(items)
             else:
                 # pre-builds format: the whole file is the revisions map
                 self._data = loaded
 
     def _flush(self) -> None:
         self._path.write_text(
-            json.dumps({"revisions": self._data, "builds": self._builds})
+            json.dumps({"revisions": self._data, "builds": self._builds,
+                        "registry": self._registry})
         )
 
     def _flush_build(self, name: str) -> None:
         # builds must survive restarts too (they used to silently vanish:
         # only revisions were written to the JSON file)
+        self._flush()
+
+    def _flush_registry(self, kind: str, name: str) -> None:
         self._flush()
 
 
@@ -159,8 +208,17 @@ class SqliteDeploymentStore(DeploymentStore):
                 "CREATE TABLE IF NOT EXISTS builds ("
                 " name TEXT PRIMARY KEY, record TEXT NOT NULL)"
             )
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS registry ("
+                " kind TEXT NOT NULL, name TEXT NOT NULL, record TEXT NOT NULL,"
+                " PRIMARY KEY (kind, name))"
+            )
         for name, record in self._db.execute("SELECT name, record FROM builds"):
             self._builds[name] = json.loads(record)
+        for kind, name, record in self._db.execute(
+            "SELECT kind, name, record FROM registry"
+        ):
+            self._registry.setdefault(kind, {})[name] = json.loads(record)
         for name, revision, created_at, spec in self._db.execute(
             "SELECT name, revision, created_at, spec FROM revisions"
             " ORDER BY name, revision"
@@ -213,6 +271,20 @@ class SqliteDeploymentStore(DeploymentStore):
                 (name, json.dumps(self._builds[name])),
             )
 
+    def _flush_registry(self, kind: str, name: str) -> None:
+        with self._db:
+            record = self._registry[kind].get(name)
+            if record is None:
+                self._db.execute(
+                    "DELETE FROM registry WHERE kind = ? AND name = ?", (kind, name)
+                )
+            else:
+                self._db.execute(
+                    "INSERT INTO registry (kind, name, record) VALUES (?, ?, ?)"
+                    " ON CONFLICT(kind, name) DO UPDATE SET record = excluded.record",
+                    (kind, name, json.dumps(record)),
+                )
+
     def close(self) -> None:
         self._db.close()
 
@@ -240,6 +312,19 @@ class DeployApiServer:
                 web.post("/api/v1/builds", self._create_build),
                 web.get("/api/v1/builds", self._list_builds),
                 web.get("/api/v1/builds/{name}", self._get_build),
+                # registry collections (reference: api-server routes/
+                # {cluster,deployment_target,dynamo_component}.go)
+                web.post("/api/v1/clusters", self._registry_create("clusters")),
+                web.get("/api/v1/clusters/{name}", self._registry_get("clusters")),
+                web.delete("/api/v1/clusters/{name}", self._registry_delete("clusters")),
+                web.get("/api/v1/deployment-targets", self._registry_list("deployment_targets")),
+                web.post("/api/v1/deployment-targets", self._registry_create("deployment_targets")),
+                web.get("/api/v1/deployment-targets/{name}", self._registry_get("deployment_targets")),
+                web.delete("/api/v1/deployment-targets/{name}", self._registry_delete("deployment_targets")),
+                web.get("/api/v1/components", self._list_components),
+                web.post("/api/v1/components", self._register_component),
+                web.get("/api/v1/components/{name}", self._get_component),
+                web.delete("/api/v1/components/{name}", self._registry_delete("components")),
             ]
         )
         self._runner: Optional[web.AppRunner] = None
@@ -271,9 +356,128 @@ class DeployApiServer:
         return web.json_response({"status": "ok"})
 
     async def _clusters(self, request: web.Request) -> web.Response:
-        return web.json_response(
-            {"clusters": [{"name": "default", "accelerator": "tpu", "deployments": len(self.store.list())}]}
-        )
+        """The implicit local cluster plus every registered one (reference:
+        routes/cluster.go list)."""
+        items = [{"name": "default", "accelerator": "tpu",
+                  "deployments": len(self.store.list())}]
+        for name in self.store.list_items("clusters"):
+            rec = self.store.get_item("clusters", name)
+            items.append({"name": name, **{k: v for k, v in rec.items() if k != "name"}})
+        return web.json_response({"clusters": items})
+
+    # ---- registry collections (clusters / deployment-targets / components) ----
+
+    def _registry_create(self, kind: str):
+        async def handler(request: web.Request) -> web.Response:
+            try:
+                body = await request.json()
+            except json.JSONDecodeError as e:
+                return web.json_response({"error": f"bad json: {e}"}, status=400)
+            if not isinstance(body, dict) or not body.get("name"):
+                return web.json_response({"error": "name is required"}, status=422)
+            name = str(body["name"])
+            if not re.fullmatch(DNS1123, name):
+                return web.json_response(
+                    {"error": f"name {name!r} must be DNS-1123"}, status=422
+                )
+            if kind == "clusters" and name == "default":
+                return web.json_response(
+                    {"error": "cluster 'default' is implicit"}, status=409
+                )
+            if self.store.get_item(kind, name) is not None:
+                return web.json_response(
+                    {"error": f"{kind[:-1]} {name} exists"}, status=409
+                )
+            record = {**body, "created_at": time.time()}
+            self.store.put_item(kind, name, record)
+            return web.json_response({"name": name}, status=201)
+
+        return handler
+
+    def _registry_list(self, kind: str):
+        async def handler(request: web.Request) -> web.Response:
+            items = [
+                self.store.get_item(kind, name)
+                for name in self.store.list_items(kind)
+            ]
+            return web.json_response({kind.replace("_", "-"): items})
+
+        return handler
+
+    def _registry_get(self, kind: str):
+        async def handler(request: web.Request) -> web.Response:
+            name = request.match_info["name"]
+            if kind == "clusters" and name == "default":
+                # the implicit local cluster the list endpoint advertises
+                return web.json_response({
+                    "name": "default", "accelerator": "tpu",
+                    "deployments": len(self.store.list()),
+                })
+            rec = self.store.get_item(kind, name)
+            if rec is None:
+                return web.json_response({"error": "not found"}, status=404)
+            return web.json_response(rec)
+
+        return handler
+
+    def _registry_delete(self, kind: str):
+        async def handler(request: web.Request) -> web.Response:
+            name = request.match_info["name"]
+            if kind == "clusters" and name == "default":
+                return web.json_response(
+                    {"error": "cluster 'default' is implicit"}, status=409
+                )
+            if not self.store.delete_item(kind, name):
+                return web.json_response({"error": "not found"}, status=404)
+            return web.json_response({"deleted": name})
+
+        return handler
+
+    async def _register_component(self, request: web.Request) -> web.Response:
+        """Component registry: versioned artifacts a deployment references
+        (reference: routes/dynamo_component.go — NIM component versions)."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError as e:
+            return web.json_response({"error": f"bad json: {e}"}, status=400)
+        name, version = body.get("name"), body.get("version")
+        if not (name and version):
+            return web.json_response(
+                {"error": "name and version are required"}, status=422
+            )
+        name, version = str(name), str(version)
+        if not re.fullmatch(DNS1123, name):
+            return web.json_response(
+                {"error": f"name {name!r} must be DNS-1123"}, status=422
+            )
+        rec = self.store.get_item("components", name) or {"name": name, "versions": {}}
+        if version in rec["versions"]:
+            return web.json_response(
+                {"error": f"component {name}:{version} exists"}, status=409
+            )
+        rec["versions"][version] = {
+            **{k: v for k, v in body.items() if k not in ("name", "version")},
+            "created_at": time.time(),
+        }
+        # highest by natural order, not most-recently-registered: backfilling
+        # an old version must not downgrade latest
+        rec["latest"] = max(rec["versions"], key=_version_key)
+        self.store.put_item("components", name, rec)
+        return web.json_response({"name": name, "version": str(version)}, status=201)
+
+    async def _list_components(self, request: web.Request) -> web.Response:
+        items = []
+        for name in self.store.list_items("components"):
+            rec = self.store.get_item("components", name)
+            items.append({"name": name, "latest": rec.get("latest"),
+                          "versions": sorted(rec["versions"], key=_version_key)})
+        return web.json_response({"components": items})
+
+    async def _get_component(self, request: web.Request) -> web.Response:
+        rec = self.store.get_item("components", request.match_info["name"])
+        if rec is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response(rec)
 
     async def _list(self, request: web.Request) -> web.Response:
         items = []
@@ -383,9 +587,6 @@ class DeployApiServer:
             return web.json_response(
                 {"error": "name, image, and context are required"}, status=422
             )
-        import re
-
-        dns1123 = r"[a-z0-9]([a-z0-9-]{0,50}[a-z0-9])?"
         # 51-char cap: the rendered Job is named f"{name}-image-build"
         # (+12 chars) and must stay within Kubernetes' 63-char name/label limit
         if not re.fullmatch(r"[a-z0-9]([a-z0-9-]{0,49}[a-z0-9])?", str(name)):
@@ -397,7 +598,7 @@ class DeployApiServer:
                 status=422,
             )
         namespace = body.get("namespace", "default")
-        if not re.fullmatch(dns1123, str(namespace)):
+        if not re.fullmatch(DNS1123, str(namespace)):
             # same failure mode as a bad name: the Job's namespace rides
             # straight into kubectl apply on every controller pass
             return web.json_response(

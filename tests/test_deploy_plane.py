@@ -751,3 +751,94 @@ def test_permanently_failing_reapply_reaches_failed():
         assert store.get_build("p")["phase"] == "failed"
 
     asyncio.run(run())
+
+
+def test_registry_routes_clusters_targets_components(tmp_path):
+    """The reference API server's cluster / deployment-target / component
+    routes (api-server/api/routes/{cluster,deployment_target,
+    dynamo_component}.go): CRUD + conflict/validation + sqlite durability."""
+    from dynamo_tpu.deploy.api_server import SqliteDeploymentStore
+
+    path = tmp_path / "reg.db"
+
+    async def run():
+        store = SqliteDeploymentStore(path)
+        server = DeployApiServer(store)
+        port = await server.start()
+        base = f"http://127.0.0.1:{port}/api/v1"
+        try:
+            # clusters: implicit default + registered
+            status, body = await _json(None, "POST", f"{base}/clusters",
+                                       {"name": "edge-1", "accelerator": "tpu-v5e"})
+            assert status == 201
+            status, _ = await _json(None, "POST", f"{base}/clusters", {"name": "edge-1"})
+            assert status == 409
+            status, _ = await _json(None, "POST", f"{base}/clusters", {"name": "default"})
+            assert status == 409  # implicit
+            status, _ = await _json(None, "POST", f"{base}/clusters", {"name": "Bad_Name"})
+            assert status == 422
+            status, body = await _json(None, "GET", f"{base}/clusters")
+            assert [c["name"] for c in body["clusters"]] == ["default", "edge-1"]
+            status, body = await _json(None, "GET", f"{base}/clusters/edge-1")
+            assert (status, body["accelerator"]) == (200, "tpu-v5e")
+            # the implicit default the list advertises is GETtable too, and
+            # refuses deletion with the same 'implicit' answer as create
+            status, body = await _json(None, "GET", f"{base}/clusters/default")
+            assert (status, body["name"]) == (200, "default")
+            status, _ = await _json(None, "DELETE", f"{base}/clusters/default")
+            assert status == 409
+
+            # deployment targets
+            status, _ = await _json(None, "POST", f"{base}/deployment-targets",
+                                    {"name": "prod-a", "cluster": "edge-1",
+                                     "namespace": "prod"})
+            assert status == 201
+            status, body = await _json(None, "GET", f"{base}/deployment-targets")
+            assert body["deployment-targets"][0]["cluster"] == "edge-1"
+
+            # components: versioned registry
+            status, _ = await _json(None, "POST", f"{base}/components",
+                                    {"name": "frontend", "version": "1.0",
+                                     "image": "reg/frontend:1.0"})
+            assert status == 201
+            status, _ = await _json(None, "POST", f"{base}/components",
+                                    {"name": "frontend", "version": "1.0"})
+            assert status == 409
+            status, _ = await _json(None, "POST", f"{base}/components",
+                                    {"name": "frontend", "version": "1.1",
+                                     "image": "reg/frontend:1.1"})
+            assert status == 201
+            # natural version order: backfilling 1.0.5 after 1.1 must not
+            # downgrade latest, and 1.10 sorts above 1.9, not below
+            status, _ = await _json(None, "POST", f"{base}/components",
+                                    {"name": "frontend", "version": "1.0.5"})
+            assert status == 201
+            status, body = await _json(None, "GET", f"{base}/components")
+            assert body["components"][0]["latest"] == "1.1"
+            assert body["components"][0]["versions"] == ["1.0", "1.0.5", "1.1"]
+            # malformed component names are rejected, not stored unreachable
+            status, _ = await _json(None, "POST", f"{base}/components",
+                                    {"name": "Bad Name", "version": "1"})
+            assert status == 422
+            status, body = await _json(None, "GET", f"{base}/components/frontend")
+            assert body["versions"]["1.0"]["image"] == "reg/frontend:1.0"
+
+            # delete
+            status, _ = await _json(None, "DELETE", f"{base}/deployment-targets/prod-a")
+            assert status == 200
+            status, _ = await _json(None, "GET", f"{base}/deployment-targets/prod-a")
+            assert status == 404
+        finally:
+            await server.stop()
+            store.close()
+
+        # durability across restart
+        store2 = SqliteDeploymentStore(path)
+        try:
+            assert store2.get_item("clusters", "edge-1")["accelerator"] == "tpu-v5e"
+            assert store2.get_item("components", "frontend")["latest"] == "1.1"
+            assert store2.get_item("deployment_targets", "prod-a") is None
+        finally:
+            store2.close()
+
+    asyncio.run(run())
