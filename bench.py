@@ -585,8 +585,8 @@ async def run_offload_parity(sessions=3, plen=512) -> dict:
 
 
 async def run_disagg_parity(
-    clients: int = 24, n_requests: int = 32, plen: int = 3072, osl: int = 150,
-    batch: int = 16, page_size: int = 128,
+    clients: int = 18, n_requests: int = 24, plen: int = 3072, osl: int = 150,
+    batch: int = 12, page_size: int = 128,
 ) -> dict:
     """BASELINE.md parity checkpoint #1: disaggregated prefill/decode vs
     aggregated throughput per chip, reference workload shape (3K ISL/150 OSL;
@@ -621,6 +621,11 @@ async def run_disagg_parity(
     from dynamo_tpu.runtime.distributed import DistributedRuntime
 
     pages_per_seq = -(-(plen + osl) // page_size) + 2
+    # HBM budget (r5 post-mortem: the r4-sized section OOM'd at batch=16 —
+    # decode pool 6.2 GB + prefill pool 2.3 GB + 2x 2.5 GB weights left no
+    # slack, and a mid-section RESOURCE_EXHAUSTED poisons the process's
+    # allocator so every LATER section dies at init; batch=12 keeps the
+    # two-worker phase near 11 GB of the 16 GB chip)
     decode_cfg = _parity_config(
         page_size=page_size, max_seqs=batch, max_model_len=4096,
         num_pages=(batch + 2) * pages_per_seq + 8,

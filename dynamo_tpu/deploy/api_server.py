@@ -440,6 +440,8 @@ class DeployApiServer:
             body = await request.json()
         except json.JSONDecodeError as e:
             return web.json_response({"error": f"bad json: {e}"}, status=400)
+        if not isinstance(body, dict):
+            return web.json_response({"error": "body must be a JSON object"}, status=400)
         name, version = body.get("name"), body.get("version")
         if not (name and version):
             return web.json_response(

@@ -56,11 +56,24 @@ class EngineConfig:
     # the burst's own later requests gain nothing — their prefills still
     # queue). 0 = unbounded (pre-r5 behavior).
     prefill_batches_per_step: int = 2
-    # pre-compile the decode-window trace variants (default / extras /
-    # logprobs) at startup so the first feature-bearing request never hits a
-    # cold multi-second XLA compile mid-serving. Off by default: tests and
-    # short-lived engines shouldn't pay several extra compiles.
-    warmup: bool = False
+    # pre-compile trace variants at startup so the first feature-bearing
+    # request never hits a cold multi-second XLA compile mid-serving.
+    #   False        — lazy (tests, short-lived engines)
+    #   True         — everything blocking before start() returns
+    #   "background" — core traces (default window + every bucket) blocking,
+    #                  feature variants (logprobs/penalties) compiled between
+    #                  serving steps after startup: first deploy of a new
+    #                  geometry reaches readiness in roughly half the cold
+    #                  compile time
+    warmup: bool | str = False
+
+    def __post_init__(self) -> None:
+        if not isinstance(self.warmup, bool) and self.warmup != "background":
+            # any other string would silently degrade to the FULL blocking
+            # warmup (truthy), the opposite of what a typo'd "bg" intended
+            raise ValueError(
+                f"warmup must be True, False, or 'background'; got {self.warmup!r}"
+            )
 
     @property
     def max_pages_per_seq(self) -> int:

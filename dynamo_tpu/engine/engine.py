@@ -84,6 +84,29 @@ class AsyncJaxEngine:
         self._thread = threading.Thread(target=self._run_loop, name="engine-loop", daemon=True)
         self._thread.start()
         self._started = True
+        if self.config.warmup == "background":
+            self._warmup_task = asyncio.create_task(self._background_warmup())
+
+    async def _background_warmup(self) -> None:
+        """Compile the feature trace variants on the engine thread, one per
+        idle gap: each thunk runs via run_on_engine (the thread that owns the
+        donated state), and we yield to live traffic between thunks so a
+        request arriving mid-warmup waits for at most one compile."""
+        for thunk in self.runner.warmup_extra_thunks():
+            while self.scheduler is not None and self.scheduler.has_work():
+                await asyncio.sleep(0.05)
+            if self._stopping.is_set():
+                return
+            try:
+                await self.run_on_engine(thunk)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # one failed variant compile must not kill serving OR abandon
+                # the remaining variants; this one will lazily compile (with
+                # a stall) if traffic ever needs it
+                log.exception("background warmup variant failed; continuing")
+        log.info("background warmup: trace variants compiled")
 
     def _initialize(self) -> None:
         from dynamo_tpu.engine.model_runner import ModelRunner
@@ -105,7 +128,12 @@ class AsyncJaxEngine:
             offload=offload,
         )
         self.scheduler = Scheduler(self.config, self.runner, self.allocator)
-        if self.config.warmup:
+        if self.config.warmup == "background":
+            # readiness waits only for the traces first requests need; the
+            # feature variants (logprobs/penalties, extras prefill) compile
+            # between serving steps via run_on_engine — see start()
+            self.runner.warmup_core()
+        elif self.config.warmup:
             self.runner.warmup()
         log.info(
             "engine ready: model=%s tp=%d pp=%d sp=%d pages=%d (%.1fs)",
@@ -119,6 +147,13 @@ class AsyncJaxEngine:
 
     async def shutdown(self, join_timeout: float = 120.0) -> None:
         self._stopping.set()
+        task = getattr(self, "_warmup_task", None)
+        if task is not None and not task.done():
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
         if self._thread is not None:
             await asyncio.get_running_loop().run_in_executor(
                 None, lambda: self._thread.join(join_timeout)

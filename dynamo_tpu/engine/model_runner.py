@@ -936,11 +936,40 @@ class ModelRunner:
         return (toks, lp) if want_logprobs else toks
 
     def warmup(self) -> None:
-        """Pre-compile the decode-window trace variants — (default, extras,
-        logprobs, logprobs+extras) — plus the smallest prefill bucket's default
-        and extras traces. All slots are inactive / writes target the reserved
-        null page 0, so the calls execute harmlessly; what matters is that the
-        XLA executables land in the jit cache before live traffic."""
+        """Pre-compile every trace variant synchronously (core + extras)."""
+        import time as _time
+
+        t0 = _time.monotonic()
+        self.warmup_core()
+        for thunk in self.warmup_extra_thunks():
+            thunk()
+        log.info("warmup: trace variants compiled in %.1fs", _time.monotonic() - t0)
+
+    def _warmup_shapes(self):
+        B = self.config.max_seqs
+        mp = self.config.max_pages_per_seq
+        return {
+            "zeros_i": np.zeros(B, np.int32),
+            "pt": np.zeros((B, mp), np.int32),
+            "inactive": np.zeros(B, bool),
+            "temps": np.zeros(B, np.float32),
+            "ones_f": np.ones(B, np.float32),
+            "neutral_pen": np.tile(
+                np.array([[0.0], [0.0], [1.0]], np.float32), (1, B)
+            ),
+        }
+
+    def warmup_core(self) -> None:
+        """Blocking pre-compile of the traces the FIRST requests need: the
+        default decode window plus every prefill bucket's default trace (per-
+        request and packed). All slots are inactive / writes target the
+        reserved null page 0, so the calls execute harmlessly; what matters is
+        that the XLA executables land in the jit cache before live traffic.
+
+        Feature variants (logprobs/penalties) compile via
+        ``warmup_extra_thunks`` — in the background on a serving engine
+        (first deploy of a new geometry used to block ~100-174 s cold on the
+        remote compiler for variants most traffic never touches)."""
         import time as _time
 
         t0 = _time.monotonic()
@@ -948,40 +977,85 @@ class ModelRunner:
         # part of the jit cache key, so every variant must compile against the
         # final (counts-bearing) structure or live traffic re-traces them all.
         self._ensure_penalty_state()
-        B = self.config.max_seqs
-        mp = self.config.max_pages_per_seq
-        zeros_i = np.zeros(B, np.int32)
-        pt = np.zeros((B, mp), np.int32)
-        inactive = np.zeros(B, bool)
-        temps = np.zeros(B, np.float32)
-        ones_f = np.ones(B, np.float32)
-        neutral_pen = np.tile(np.array([[0.0], [0.0], [1.0]], np.float32), (1, B))
+        sh = self._warmup_shapes()
         K = self.config.decode_steps
-        for kwargs in (
-            {},
-            {"penalties": neutral_pen},
-            {"want_logprobs": True},
-            {"want_logprobs": True, "penalties": neutral_pen},
-        ):
-            out = self.dispatch_decode_window(
-                zeros_i, pt, inactive, zeros_i, temps, zeros_i, ones_f, K, **kwargs
+        out = self.dispatch_decode_window(
+            sh["zeros_i"], sh["pt"], sh["inactive"], sh["zeros_i"],
+            sh["temps"], sh["zeros_i"], sh["ones_f"], K,
+        )
+        jax.block_until_ready(out)
+        for b in self.config.prefill_buckets:
+            self.prefill_chunk(
+                np.zeros(b, np.int32), 0, sh["pt"][0], sample=True,
+                temperature=0.0, top_k=0, top_p=1.0, slot=-1, sync=True,
             )
-            jax.block_until_ready(out)
+            N = self.config.lanes_for(b)
+            if N > 1:
+                lane = (
+                    np.zeros(b, np.int32), 0, sh["pt"][0], -1,
+                    SamplingParams(temperature=0.0), (), False,
+                )
+                out = self.prefill_chunk_batch([lane], N=N)
+                jax.block_until_ready(out)
+        log.info("warmup(core): compiled in %.1fs", _time.monotonic() - t0)
+
+    def warmup_extra_thunks(self) -> list:
+        """Thunks compiling the feature-bearing trace variants — decode
+        windows with penalties/logprobs, prefill extras/logprobs traces, and
+        the packed equivalents. Each runs harmlessly against inactive slots;
+        a serving engine executes them one by one between steps (via
+        run_on_engine) so readiness never waits on them."""
+        sh = self._warmup_shapes()
+        K = self.config.decode_steps
+        thunks = []
+
+        def window(kwargs):
+            def run():
+                out = self.dispatch_decode_window(
+                    sh["zeros_i"], sh["pt"], sh["inactive"], sh["zeros_i"],
+                    sh["temps"], sh["zeros_i"], sh["ones_f"], K, **kwargs,
+                )
+                jax.block_until_ready(out)
+            return run
+
+        for kwargs in (
+            {"penalties": sh["neutral_pen"]},
+            {"want_logprobs": True},
+            {"want_logprobs": True, "penalties": sh["neutral_pen"]},
+        ):
+            thunks.append(window(kwargs))
+
+        def chunk(bucket, sampling, want_lp):
+            def run():
+                out = self.prefill_chunk(
+                    np.zeros(bucket, np.int32), 0, sh["pt"][0], sample=True,
+                    temperature=0.0, top_k=0, top_p=1.0, slot=-1,
+                    sync=not want_lp, want_logprobs=want_lp, sampling=sampling,
+                    eos_ids=(0,) if sampling is not None else None,
+                )
+                if want_lp:
+                    jax.block_until_ready(out)
+            return run
+
+        def packed(bucket, N, sampling, want_lp):
+            def run():
+                lane = (
+                    np.zeros(bucket, np.int32), 0, sh["pt"][0], -1,
+                    sampling or SamplingParams(temperature=0.0),
+                    (0,) if sampling is not None else (),
+                    sampling is not None,
+                )
+                out = self.prefill_chunk_batch([lane], N=N, want_logprobs=want_lp)
+                jax.block_until_ready(out)
+            return run
+
         bucket = self.config.prefill_buckets[0]
         for sampling, want_lp in (
-            (None, False),
             (None, True),
             (SamplingParams(presence_penalty=0.1, min_tokens=1), False),
             (SamplingParams(presence_penalty=0.1, min_tokens=1), True),
         ):
-            out = self.prefill_chunk(
-                np.zeros(bucket, np.int32), 0, pt[0], sample=True,
-                temperature=0.0, top_k=0, top_p=1.0, slot=-1, sync=not want_lp,
-                want_logprobs=want_lp, sampling=sampling,
-                eos_ids=(0,) if sampling is not None else None,
-            )
-            if want_lp:
-                jax.block_until_ready(out)
+            thunks.append(chunk(bucket, sampling, want_lp))
         # packed-prefill executables: one per (N=lanes_for(bucket), bucket)
         # pair the scheduler's lane packing can actually reach. Without these,
         # the first packed shape cold-compiles mid-traffic — on a tunneled
@@ -991,22 +1065,12 @@ class ModelRunner:
             if N <= 1:
                 continue  # single-lane chunks ride _prefill (compiled above)
             for sampling, want_lp in (
-                (None, False),
                 (None, True),
                 (SamplingParams(presence_penalty=0.1, min_tokens=1), False),
                 (SamplingParams(presence_penalty=0.1, min_tokens=1), True),
             ):
-                # extras variants need a final lane (slot out-of-range so the
-                # feedback write drops); neutral variants a non-final one
-                lane = (
-                    np.zeros(b, np.int32), 0, pt[0], -1,
-                    sampling or SamplingParams(temperature=0.0),
-                    (0,) if sampling is not None else (),
-                    sampling is not None,
-                )
-                out = self.prefill_chunk_batch([lane], N=N, want_logprobs=want_lp)
-                jax.block_until_ready(out)
-        log.info("warmup: trace variants compiled in %.1fs", _time.monotonic() - t0)
+                thunks.append(packed(b, N, sampling, want_lp))
+        return thunks
 
     def extract_pages_device(self, page_ids: np.ndarray) -> jax.Array:
         """Gather KV blocks into a device array [L, 2, n, page_size, Hkv, D]

@@ -47,6 +47,9 @@ def engine_config_for(args):
         max_model_len=card.context_length,
         tp=getattr(args, "tp", None) or 1,
         pp=getattr(args, "pp", None) or 1,
+        # serve as soon as the core traces compile; feature variants land in
+        # the background (halves cold first-deploy readiness time)
+        warmup="background",
     )
 
 

@@ -67,18 +67,37 @@ class ByteTokenizer:
 
 
 class HfTokenizer:
-    def __init__(self, path: str):
-        from transformers import AutoTokenizer
+    """HF fast tokenizers are NOT safe for concurrent encode/template calls
+    (the PyO3 binding raises "Already borrowed" when two threads touch one
+    instance — huggingface/tokenizers#537), and the HTTP service runs
+    preprocessing on a thread pool. Each thread therefore lazily loads its
+    OWN underlying tokenizer (thread-local); vocab/eos metadata comes from
+    the construction-time instance and is immutable."""
 
-        self._tok = AutoTokenizer.from_pretrained(path)
-        self.vocab_size = len(self._tok)
-        eos = self._tok.eos_token_id
+    def __init__(self, path: str):
+        import threading
+
+        self._path = path
+        self._local = threading.local()
+        tok = self._tok
+        self.vocab_size = len(tok)
+        eos = tok.eos_token_id
         ids = []
         if eos is not None:
             ids.append(eos)
         # some models define additional end ids in generation config (e.g.
         # llama-3 <|eot_id|>); include any token literally named like an end tag
         self.eos_token_ids = tuple(ids)
+
+    @property
+    def _tok(self):
+        tok = getattr(self._local, "tok", None)
+        if tok is None:
+            from transformers import AutoTokenizer
+
+            tok = AutoTokenizer.from_pretrained(self._path)
+            self._local.tok = tok
+        return tok
 
     def encode(self, text: str) -> list[int]:
         return self._tok.encode(text, add_special_tokens=False)

@@ -231,11 +231,15 @@ def paged_mla_decode_attention_pallas(
     B, H, latent = q_cat.shape
     P, ps, _ = pages.shape
     lengths = positions.astype(jnp.int32) + 1
-    W = _mla_lookahead_window(ps, latent, pages.dtype.itemsize)
-    # same escape hatch as the GQA dispatcher: DYNTPU_DECODE_KERNEL=perseq
-    # restores the classic in-program double buffer
-    if os.environ.get("DYNTPU_DECODE_KERNEL") == "perseq":
-        W = 0
+    # r5 on-chip A/B (tiny-mla bs32, healthy tunnel, best of 3):
+    #   classic (this default)  4671 tok/s      lookahead  4534 tok/s
+    # — within round noise of each other, so the MLA stream keeps the simpler
+    # classic double buffer (its one small latent DMA per page pipelines well
+    # already); the GQA kernel's +14.7% from cross-program prefetch did NOT
+    # transfer. DYNTPU_DECODE_KERNEL=lookahead opts in for future hardware.
+    W = 0
+    if os.environ.get("DYNTPU_DECODE_KERNEL") == "lookahead":
+        W = _mla_lookahead_window(ps, latent, pages.dtype.itemsize)
 
     if W >= 1:
         grid_spec = pltpu.PrefetchScalarGridSpec(

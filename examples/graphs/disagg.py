@@ -49,6 +49,12 @@ class PrefillWorker:
         cfg = self.config
         model = cfg.get("model", "tiny")
         engine_cfg = engine_config_for(_Args({"model": model, **cfg}))
+        # prefill-only role: background warmup would compile decode-window
+        # variants this engine never dispatches, stalling its prefill work;
+        # its prefill traces compile lazily on the first few requests
+        import dataclasses
+
+        engine_cfg = dataclasses.replace(engine_cfg, warmup=False)
         self.engine = AsyncJaxEngine(engine_cfg)
         await self.engine.start()
         card = card_for_model(model, cfg.get("max_model_len"))

@@ -477,14 +477,18 @@ def test_engine_mla_prefill_pallas_token_parity(monkeypatch):
     assert got == ref
 
 
-def test_pallas_mla_lookahead_tail_path():
+def test_pallas_mla_lookahead_tail_path(monkeypatch):
     """Lengths deep past the prefetch window W (the tail double-buffer path
     long-context decodes hit in production) + ragged short sequences and odd
-    B for parity alternation — vs the same numpy reference (review r5)."""
+    B for parity alternation — vs the same numpy reference (review r5).
+    Lookahead is opt-in for MLA (classic won the on-chip A/B), so force it
+    here to keep the kernel covered."""
     from dynamo_tpu.ops.pallas.mla_attention import (
         _mla_lookahead_window,
         paged_mla_decode_attention_pallas,
     )
+
+    monkeypatch.setenv("DYNTPU_DECODE_KERNEL", "lookahead")
 
     rng = np.random.default_rng(9)
     B, H, dc, dr, ps, P, mp = 5, 4, 32, 8, 4, 96, 14
