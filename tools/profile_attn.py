@@ -230,133 +230,6 @@ def _perseq_variant_kernel(
     out_ref[0] = out.reshape(Hq, D).astype(out_ref.dtype)
 
 
-def _lookahead_kernel(
-    page_tables_ref, lengths_ref, q_ref, k_hbm, v_hbm, out_ref,
-    k_scratch, v_scratch, sems, *, page_size: int, max_pages_live: int,
-):
-    """Cross-PROGRAM DMA pipelining for short (<= max_pages_live pages)
-    sequences: scratch persists across grid programs, and the page table is
-    scalar-prefetched, so program b issues program b+1's page DMAs into the
-    opposite parity's slot pair while it computes on its own (prefetched by
-    b-1). The per-program DMA-latency exposure at each program boundary —
-    what separates perseq from the dmaonly floor — collapses to one program's
-    worth for the whole grid."""
-    import jax
-    import jax.numpy as jnp
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    _NEG_INF = -1e30
-    b = pl.program_id(0)
-    nb = pl.num_programs(0)
-    par = jax.lax.rem(b, 2)
-    length = lengths_ref[b]
-    n_pages = jnp.minimum(
-        jnp.maximum(1, pl.cdiv(length, page_size)), max_pages_live
-    )
-
-    Hq, D = q_ref.shape[1], q_ref.shape[2]
-    Hkv = k_hbm.shape[2]
-    G = Hq // Hkv
-    q = q_ref[0].astype(jnp.float32).reshape(Hkv, G, D)
-    scale = 1.0 / jnp.sqrt(jnp.float32(D))
-
-    def dma(parity, j, seq_idx, page_j, which):
-        hbm, scratch = (k_hbm, k_scratch) if which == 0 else (v_hbm, v_scratch)
-        return pltpu.make_async_copy(
-            hbm.at[page_tables_ref[seq_idx, page_j]],
-            scratch.at[parity, j],
-            sems.at[parity, j, which],
-        )
-
-    def issue_for(seq_idx, parity):
-        npg = jnp.minimum(
-            jnp.maximum(1, pl.cdiv(lengths_ref[seq_idx], page_size)),
-            max_pages_live,
-        )
-        for j in range(max_pages_live):  # static unroll: DMA issues only
-            @pl.when(j < npg)
-            def _(j=j):
-                dma(parity, j, seq_idx, j, 0).start()
-                dma(parity, j, seq_idx, j, 1).start()
-
-    @pl.when(b == 0)
-    def _():
-        issue_for(0, 0)
-    # prefetch the NEXT program's pages while this one computes
-    @pl.when(b + 1 < nb)
-    def _():
-        issue_for(b + 1, 1 - par)
-
-    def body(j, carry):
-        m, l, acc = carry
-        dma(par, j, b, j, 0).wait()
-        dma(par, j, b, j, 1).wait()
-        k_page = k_scratch[par, j].astype(jnp.float32)
-        v_page = v_scratch[par, j].astype(jnp.float32)
-        kt = jnp.transpose(k_page, (1, 0, 2))
-        vt = jnp.transpose(v_page, (1, 0, 2))
-        scores = jax.lax.dot_general(
-            q, kt, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
-        ) * scale
-        idx = j * page_size + jax.lax.broadcasted_iota(jnp.int32, (1, 1, page_size), 2)
-        scores = jnp.where(idx < length, scores, _NEG_INF)
-        chunk_max = jnp.max(scores, axis=-1)
-        new_m = jnp.maximum(m, chunk_max)
-        corr = jnp.exp(m - new_m)
-        probs = jnp.exp(scores - new_m[..., None])
-        new_l = l * corr + jnp.sum(probs, axis=-1)
-        chunk_out = jax.lax.dot_general(
-            probs, vt, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
-        )
-        return new_m, new_l, acc * corr[..., None] + chunk_out
-
-    m0 = jnp.full((Hkv, G), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((Hkv, G), jnp.float32)
-    acc0 = jnp.zeros((Hkv, G, D), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, n_pages, body, (m0, l0, acc0))
-
-    out = acc / jnp.maximum(l, 1e-20)[..., None]
-    out_ref[0] = out.reshape(Hq, D).astype(out_ref.dtype)
-
-
-def make_lookahead(max_pages_live: int = 2):
-    import functools as ft
-
-    import jax
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    def run(q, k_pages, v_pages, page_tables, positions):
-        B, Hq, D = q.shape
-        P, ps, Hkv, _ = k_pages.shape
-        lengths = positions.astype(jnp.int32) + 1
-        grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
-            grid=(B,),
-            in_specs=[
-                pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-            ],
-            out_specs=pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
-            scratch_shapes=[
-                pltpu.VMEM((2, max_pages_live, ps, Hkv, D), k_pages.dtype),
-                pltpu.VMEM((2, max_pages_live, ps, Hkv, D), v_pages.dtype),
-                pltpu.SemaphoreType.DMA((2, max_pages_live, 2)),
-            ],
-        )
-        kernel = pl.pallas_call(
-            ft.partial(_lookahead_kernel, page_size=ps,
-                       max_pages_live=max_pages_live),
-            out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
-            grid_spec=grid_spec,
-        )
-        return kernel(page_tables.astype(jnp.int32), lengths, q, k_pages, v_pages)
-
-    return run
-
-
 def make_perseq_variant(cast_f32: bool):
     import functools as ft
 
@@ -422,9 +295,8 @@ def main():
         "chunked": pa.paged_decode_attention_pallas_chunked,
         "grouped": pa.paged_decode_attention_pallas_grouped,
     }
-    if -(-CTX // PS) <= 2:
-        # cross-program DMA pipelining (only valid <= 2 pages/seq here)
-        variants["lookahead"] = make_lookahead(2)
+    # production cross-program-prefetch kernel (r5 default for GQA decode)
+    variants["lookahead"] = pa.paged_decode_attention_pallas_lookahead
     if hasattr(pa, "paged_decode_attention_pallas_fused"):
         variants["fused"] = pa.paged_decode_attention_pallas_fused
 
