@@ -945,6 +945,18 @@ class ModelRunner:
             thunk()
         log.info("warmup: trace variants compiled in %.1fs", _time.monotonic() - t0)
 
+    @property
+    def packed_prefill_mode(self) -> bool:
+        """True when the scheduler packs prefill chunks through the packed
+        trace (the single definition of the gate; the scheduler adds a
+        per-request `not req.images` condition on top)."""
+        return (
+            self.config.prefill_lanes > 1
+            and self.config.pp == 1
+            and self.config.sp == 1
+            and hasattr(self.model, "prefill_packed")
+        )
+
     def _warmup_shapes(self):
         B = self.config.max_seqs
         mp = self.config.max_pages_per_seq
@@ -985,16 +997,22 @@ class ModelRunner:
         )
         jax.block_until_ready(out)
         for b in self.config.prefill_buckets:
-            self.prefill_chunk(
-                np.zeros(b, np.int32), 0, sh["pt"][0], sample=True,
-                temperature=0.0, top_k=0, top_p=1.0, slot=-1, sync=True,
-            )
-            N = self.config.lanes_for(b)
-            if N > 1:
-                lane = (
-                    np.zeros(b, np.int32), 0, sh["pt"][0], -1,
-                    SamplingParams(temperature=0.0), (), False,
+            if not self.packed_prefill_mode:
+                self.prefill_chunk(
+                    np.zeros(b, np.int32), 0, sh["pt"][0], sample=True,
+                    temperature=0.0, top_k=0, top_p=1.0, slot=-1, sync=True,
                 )
+                continue
+            # the scheduler dispatches packed calls at power-of-two N up to
+            # lanes_for(b); N=1 (lone chunks) and N=lanes_max are the hot
+            # ones — intermediates, feature variants, and the per-request
+            # trace (still reached by disagg remote prefill and image
+            # requests) compile via the extras thunks
+            lane = (
+                np.zeros(b, np.int32), 0, sh["pt"][0], -1,
+                SamplingParams(temperature=0.0), (), False,
+            )
+            for N in {1, self.config.lanes_for(b)}:
                 out = self.prefill_chunk_batch([lane], N=N)
                 jax.block_until_ready(out)
         log.info("warmup(core): compiled in %.1fs", _time.monotonic() - t0)
@@ -1056,20 +1074,39 @@ class ModelRunner:
             (SamplingParams(presence_penalty=0.1, min_tokens=1), True),
         ):
             thunks.append(chunk(bucket, sampling, want_lp))
-        # packed-prefill executables: one per (N=lanes_for(bucket), bucket)
-        # pair the scheduler's lane packing can actually reach. Without these,
-        # the first packed shape cold-compiles mid-traffic — on a tunneled
-        # PJRT platform that stall exceeds HTTP client timeouts.
+        if self.packed_prefill_mode:
+            # the per-request trace is NOT dead in packed mode: disagg remote
+            # prefill (run_prefill_chunks) and image-bearing requests still
+            # dispatch it — compile its default per-bucket traces here
+            def per_request(b):
+                def run():
+                    self.prefill_chunk(
+                        np.zeros(b, np.int32), 0, sh["pt"][0], sample=True,
+                        temperature=0.0, top_k=0, top_p=1.0, slot=-1, sync=True,
+                    )
+                return run
+
+            for b in self.config.prefill_buckets:
+                thunks.append(per_request(b))
+        # packed-prefill executables: each power-of-two N <= lanes_for(b) per
+        # bucket (the scheduler rounds partial packs up to pow2), for the
+        # neutral AND feature-bearing variants (want_* are static jit args —
+        # every combo is a distinct executable). Without these the first
+        # packed shape cold-compiles mid-traffic — on a tunneled PJRT
+        # platform that stall exceeds HTTP client timeouts.
         for b in self.config.prefill_buckets:
-            N = self.config.lanes_for(b)
-            if N <= 1:
-                continue  # single-lane chunks ride _prefill (compiled above)
-            for sampling, want_lp in (
-                (None, True),
-                (SamplingParams(presence_penalty=0.1, min_tokens=1), False),
-                (SamplingParams(presence_penalty=0.1, min_tokens=1), True),
-            ):
-                thunks.append(packed(b, N, sampling, want_lp))
+            lanes_max = self.config.lanes_for(b)
+            n = 1
+            while n <= lanes_max:
+                if n > 1 and n < lanes_max:
+                    thunks.append(packed(b, n, None, False))
+                for sampling, want_lp in (
+                    (None, True),
+                    (SamplingParams(presence_penalty=0.1, min_tokens=1), False),
+                    (SamplingParams(presence_penalty=0.1, min_tokens=1), True),
+                ):
+                    thunks.append(packed(b, n, sampling, want_lp))
+                n *= 2
         return thunks
 
     def extract_pages_device(self, page_ids: np.ndarray) -> jax.Array:

@@ -249,12 +249,7 @@ class Scheduler:
             s is not None and not s.finished and s.prefill_pos is None
             for s in self.slots
         )
-        packed_mode = (
-            self.config.prefill_lanes > 1
-            and self.config.pp == 1
-            and self.config.sp == 1
-            and hasattr(self.runner.model, "prefill_packed")
-        )
+        packed_mode = self.runner.packed_prefill_mode
         started = 0
         while self.waiting:
             slot = self._free_slot()
@@ -315,13 +310,7 @@ class Scheduler:
         )
         self._admit_counter += 1
 
-        if (
-            self.config.prefill_lanes > 1
-            and not req.images
-            and self.config.pp == 1
-            and self.config.sp == 1
-            and hasattr(self.runner.model, "prefill_packed")
-        ):
+        if self.runner.packed_prefill_mode and not req.images:
             # packed path: per-request prep now, chunk dispatch deferred to
             # _dispatch_prefill_batches so chunks of DIFFERENT sequences can
             # share one weight pass
@@ -387,26 +376,11 @@ class Scheduler:
                 chunks.append((s, s.prefill_pos, end))
                 bucket = cand
             lanes_max = self.config.lanes_for(bucket)
-            if len(chunks) == 1:
-                seq, start, _ = chunks[0]
-                try:
-                    result = self._dispatch_prefill_chunks(
-                        seq.req, seq.page_table, start, seq.prompt_len,
-                        slot=seq.slot, prep=False,
-                    )
-                except Exception:
-                    log.exception("prefill failed for %s", seq.req.request_id)
-                    outputs.extend(self._finish(seq, "error"))
-                    continue
-                tok_dev, lp = result if isinstance(result, tuple) else (result, None)
-                self.allocator.commit_prefilled(seq.req.request_id, seq.prompt_len)
-                seq.prefill_pos = None
-                self.in_flight.append(_InFlight(
-                    kind="first", dev=tok_dev, seqs=[seq],
-                    cached_len=seq.cached_len, lp=lp,
-                ))
-                count += 1
-                continue
+            # lone chunks ride the packed trace at N=1 too: measured 33%
+            # faster than the per-request trace for identical work (r5
+            # on-chip, 512-row call: 11.3 vs 16.8 ms). N rounds up to a
+            # power of two so partial packs compile at most log2(lanes_max)
+            # executables per bucket, padding <= 2x on the rare odd sizes.
             lanes = []
             finals = []  # (seq, lane_idx)
             want_lp = False
@@ -425,9 +399,10 @@ class Scheduler:
                     finals.append((seq, j))
                     want_lp = want_lp or seq.req.logprobs is not None
             self.local_prefill_rows += sum(end - start for _, start, end in chunks)
+            N = min(lanes_max, 1 << (len(chunks) - 1).bit_length())
             try:
                 result = self.runner.prefill_chunk_batch(
-                    lanes, N=lanes_max, want_logprobs=want_lp
+                    lanes, N=N, want_logprobs=want_lp
                 )
             except Exception:
                 log.exception(

@@ -273,7 +273,9 @@ def test_step_failure_fails_waiting_requests():
         def boom(*a, **k):
             raise RuntimeError("injected step failure")
 
+        # both prefill entrypoints: lone chunks ride the packed trace now
         eng.runner.prefill_chunk = boom
+        eng.runner.prefill_chunk_batch = boom
         req = EngineRequest(
             request_id="fail0",
             token_ids=[1, 2, 3],
