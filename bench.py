@@ -65,7 +65,8 @@ def bench_config(batch: int = 64, page_size: int = 64, model_id: str | None = No
         prefill_buckets=(128, 256, 512),
         tp=1,
         # swept on v5e: decode_steps x pipeline_depth over {16,32,64} x {2,3,4}
-        # all within ~3% - dispatch latency is hidden; 32x3 best
+        # all within ~3% - dispatch latency is hidden; 32x3 best (re-confirmed
+        # r5 at lookahead-kernel speeds: 32x3 7527 > 16x4 7512 > 64x3 7437)
         decode_steps=32,
         pipeline_depth=3,
     )
