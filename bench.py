@@ -238,6 +238,11 @@ async def run_config(
             round_tok_s.append(round(total_tokens / elapsed, 2))
             if best is None or total_tokens / elapsed > best[0]:
                 best = (total_tokens / elapsed, total_tokens, elapsed, ttfts)
+        # per-stage latency attribution (engine StageStats, cumulative over
+        # warmup + all rounds): lets a round's artifact answer whether TTFT
+        # sits in queue wait, prefill dispatch, or device sync without a
+        # re-run under DYNTPU_TRACE
+        stage = engine.stage_snapshot()
     finally:
         # a cancelled/timed-out section must still release the engine (HBM,
         # device buffers) before the next section starts its own
@@ -253,6 +258,7 @@ async def run_config(
         "prompt_len": prompt_len,
         "decode_tokens": decode_tokens,
         "rounds": round_tok_s,
+        "stage_breakdown": stage,
     }
 
 
@@ -1090,6 +1096,21 @@ def _get(d: dict | None, *path, default=None):
     return cur
 
 
+def _compact_stages(stage: dict | None) -> dict | None:
+    """The artifact-line view of a section's stage_breakdown: cumulative
+    engine seconds per stage (queue wait / prefill dispatch / decode window
+    dispatch / device sync / host-KV offload), ~70 bytes."""
+    if not stage:
+        return None
+    return {
+        "queue": round(stage.get("queue_wait_s", 0.0), 2),
+        "prefill": round(stage.get("prefill_s", 0.0), 2),
+        "decode": round(stage.get("decode_dispatch_s", 0.0), 2),
+        "sync": round(stage.get("reconcile_wait_s", 0.0), 2),
+        "offload": round(stage.get("kv_offload_s", 0.0), 2),
+    }
+
+
 def _summary(errors: dict) -> dict:
     """The compact (<1.5 KB) per-section key numbers for the round artifact.
 
@@ -1113,6 +1134,9 @@ def _summary(errors: dict) -> dict:
         "r01_value_bs8": R01_VALUE_BS8,
         "ref_workload_isl3k_osl150": {
             "tok_s": _get(refw, "tok_s"), "ttft_p50_ms": _get(refw, "ttft_p50_ms"),
+            # the attribution the flat-TTFT investigation needs, from the
+            # artifact alone: engine seconds per stage for this section
+            "stages": _compact_stages(_get(refw, "stage_breakdown")),
         },
         "http_serving": {
             "tok_s": _get(http, "tok_s"),

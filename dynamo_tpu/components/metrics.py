@@ -20,6 +20,7 @@ from aiohttp import web
 from dynamo_tpu.llm.kv_router.metrics_aggregator import KvMetricsAggregator
 from dynamo_tpu.llm.kv_router.router import KV_HIT_RATE_SUBJECT
 from dynamo_tpu.utils import get_logger
+from dynamo_tpu.utils.prometheus import render_family
 
 log = get_logger("components.metrics")
 
@@ -73,20 +74,16 @@ class MetricsService:
         self._overlap_blocks += p.get("overlap_blocks", 0)
 
     def render(self) -> str:
+        """Conformant Prometheus exposition: every metric family carries its
+        own HELP/TYPE pair ahead of its samples (promtool-checkable — one
+        free-text comment covering everything is not)."""
         loads = self.aggregator.get_metrics()
         base = {"namespace": self.namespace, "component": self.component}
-
-        def fmt(name, value, extra=None):
-            labels = dict(base)
-            if extra:
-                labels.update(extra)
-            inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
-            return f"llm_kv_{name}{{{inner}}} {value}"
-
-        lines = [
-            "# HELP llm_kv_* worker KV/load metrics aggregated by the metrics component",
-            fmt("workers", len(loads)),
-        ]
+        out = render_family(
+            "llm_kv_workers", "gauge",
+            "workers currently reporting ForwardPassMetrics",
+            [(base, len(loads))],
+        )
         for field in (
             "request_active_slots",
             "request_total_slots",
@@ -97,17 +94,60 @@ class MetricsService:
             "gpu_prefix_cache_hit_rate",
         ):
             values = [getattr(w, field) for w in loads]
+            samples = [
+                ({**base, "worker_id": f"{w.worker_id:x}"}, getattr(w, field))
+                for w in loads
+            ]
+            out += render_family(
+                f"llm_kv_{field}", "gauge",
+                f"worker {field} (per reporting worker)", samples,
+            )
             if values:
-                lines.append(fmt(f"{field}_avg", sum(values) / len(values)))
-                lines.append(fmt(f"{field}_min", min(values)))
-                lines.append(fmt(f"{field}_max", max(values)))
-            for w in loads:
-                lines.append(fmt(field, getattr(w, field), {"worker_id": f"{w.worker_id:x}"}))
+                for agg, val in (
+                    ("avg", sum(values) / len(values)),
+                    ("min", min(values)),
+                    ("max", max(values)),
+                ):
+                    out += render_family(
+                        f"llm_kv_{field}_{agg}", "gauge",
+                        f"{agg} of {field} across reporting workers",
+                        [(base, val)],
+                    )
         pct = 100.0 * self._overlap_blocks / self._isl_blocks if self._isl_blocks else 0.0
-        lines.append(fmt("hit_rate_percent", round(pct, 3)))
-        lines.append(fmt("hit_rate_isl_blocks_total", self._isl_blocks))
-        lines.append(fmt("hit_rate_overlap_blocks_total", self._overlap_blocks))
-        return "\n".join(lines) + "\n"
+        out += render_family(
+            "llm_kv_hit_rate_percent", "gauge",
+            "cumulative KV prefix-cache hit rate from router events",
+            [(base, str(round(pct, 3)))],
+        )
+        out += render_family(
+            "llm_kv_hit_rate_isl_blocks_total", "counter",
+            "cumulative input-sequence blocks seen by the router",
+            [(base, self._isl_blocks)],
+        )
+        out += render_family(
+            "llm_kv_hit_rate_overlap_blocks_total", "counter",
+            "cumulative cached-prefix blocks matched by the router",
+            [(base, self._overlap_blocks)],
+        )
+        # per-stage engine-time attribution scraped from worker stats
+        # (engine StageStats -> worker stats_handler -> this component)
+        stage_samples = []
+        for instance_id, data in self.aggregator.get_raw():
+            stage = data.get("stage_seconds") or {}
+            for key, value in sorted(stage.items()):
+                if not key.endswith("_s"):
+                    continue  # counts ride the *_n/_rows fields; seconds only
+                stage_samples.append((
+                    {**base, "worker_id": f"{instance_id:x}", "stage": key[:-2]},
+                    value,
+                ))
+        if stage_samples:
+            out += render_family(
+                "llm_engine_stage_seconds_total", "counter",
+                "cumulative engine seconds attributed to each serving stage",
+                stage_samples,
+            )
+        return out
 
     async def _metrics(self, request: web.Request) -> web.Response:
         return web.Response(text=self.render(), content_type="text/plain")

@@ -14,7 +14,7 @@ from typing import Optional
 
 from dynamo_tpu.llm.kv_router.router import KvRouter
 from dynamo_tpu.llm.kv_router.scheduler import AllWorkersBusyError, NoWorkersError
-from dynamo_tpu.utils import get_logger
+from dynamo_tpu.utils import get_logger, tracing
 
 log = get_logger("components.processor")
 
@@ -67,7 +67,9 @@ class ProcessorService:
         instance_id = None
         if self.router is not None:
             try:
-                instance_id = await self.router.schedule(token_ids)
+                # routing-decision time is hop overhead a trace should see
+                with tracing.span("processor.schedule", tokens=len(token_ids)):
+                    instance_id = await self.router.schedule(token_ids)
             except (NoWorkersError, AllWorkersBusyError) as e:
                 log.warning("kv scheduling failed (%s); falling back to random", e)
 

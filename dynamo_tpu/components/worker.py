@@ -120,6 +120,13 @@ class WorkerService:
 
     def _stats(self) -> dict:
         stats = {"kv_metrics": self._inner_engine.metrics().to_wire()}
+        # per-stage latency attribution (scheduler StageStats): scraped by the
+        # standalone metrics component into llm_engine_stage_seconds_total
+        stage = getattr(self._inner_engine, "stage_snapshot", None)
+        if stage is not None:
+            snap = stage()
+            if snap:
+                stats["stage_seconds"] = snap
         if self.enable_disagg_decode and self.engine is not None:
             stats["disagg"] = {
                 "remote_prefills": self.engine.remote_prefills,

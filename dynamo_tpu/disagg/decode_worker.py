@@ -13,6 +13,7 @@ Flow (mirrors reference: examples/llm/components/worker.py:148-189):
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import AsyncIterator, Optional
 
 from dynamo_tpu.engine.engine import AsyncJaxEngine
@@ -23,7 +24,7 @@ from dynamo_tpu.llm.remote_prefill import (
     RemotePrefillRequest,
     prefill_queue_name,
 )
-from dynamo_tpu.utils import get_logger
+from dynamo_tpu.utils import get_logger, tracing
 
 log = get_logger("disagg.decode")
 
@@ -57,6 +58,7 @@ class DisaggDecodeEngine:
         # disagg stats
         self.remote_prefills = 0
         self.local_prefills = 0
+        self.remote_prefill_wait_s = 0.0  # queue push -> KV adopted (transfer leg)
 
     # ---------------- lifecycle ----------------
 
@@ -120,6 +122,10 @@ class DisaggDecodeEngine:
     async def generate_batched(self, request: EngineRequest) -> AsyncIterator[list[StepOutput]]:
         """Window-batched variant (see AsyncJaxEngine.generate_batched): the
         serving Backend consumes this to collapse per-token overhead."""
+        # submission/trace stamps happen HERE (not only in the inner engine):
+        # the remote path adopts via _register_stream and never goes through
+        # engine.generate_batched, yet its queue-wait/TTFT/spans must exist
+        AsyncJaxEngine._stamp_submission(request)
         prompt = list(request.token_ids)
         prefix_hit = await self.engine.run_on_engine(
             lambda: self.engine.sync_lookup_prefix(prompt)
@@ -199,7 +205,9 @@ class DisaggDecodeEngine:
                     skip_leading_tokens=shared_pages * self.engine.config.page_size,
                     kv_addr=self.kv_server.address,
                     kv_token=kv_token,
+                    trace_id=request.trace_id or "",
                 )
+                t_hop = time.monotonic()
                 await self.drt.cplane.queue_push(self.queue_name, rp.to_wire())
                 # one deadline covers BOTH waits (result notification + socket
                 # payload): charging each a full timeout would double the
@@ -212,13 +220,24 @@ class DisaggDecodeEngine:
                     # the result message is the notification; the payload
                     # rides the dedicated socket and may land just after it
                     remaining = max(0.05, deadline - asyncio.get_running_loop().time())
-                    kv_data = await self.kv_server.receive(rid, timeout=remaining)
+                    with tracing.span(
+                        "disagg.kv_receive", request_id=rid,
+                        trace_id=request.trace_id, mode="socket",
+                    ):
+                        kv_data = await self.kv_server.receive(rid, timeout=remaining)
                 await self.engine.run_on_engine(
                     lambda: self.engine.sync_adopt_prefilled(
                         request, result, cached_len, kv_data=kv_data
                     )
                 )
                 adopted = True
+                dt = time.monotonic() - t_hop
+                self.remote_prefill_wait_s += dt
+                tracing.record_span(
+                    "disagg.remote_prefill", t_hop, duration=dt,
+                    request_id=rid, trace_id=request.trace_id,
+                    attrs={"prompt_len": len(prompt), "mode": result.kv_mode},
+                )
         finally:
             # finally (not except Exception): client cancellation raises
             # CancelledError, which must run the same cleanup — dropping any

@@ -12,7 +12,8 @@ from typing import Optional
 
 from dynamo_tpu.engine.engine import AsyncJaxEngine
 from dynamo_tpu.llm.remote_prefill import RemotePrefillRequest, prefill_queue_name
-from dynamo_tpu.utils import get_logger
+from dynamo_tpu.runtime.context import RequestContext, use_context
+from dynamo_tpu.utils import get_logger, tracing
 
 log = get_logger("disagg.prefill")
 
@@ -90,6 +91,21 @@ class PrefillWorker:
             pass
 
     async def _handle(self, rp: RemotePrefillRequest) -> None:
+        # the work queue bypasses the RPC envelope's context propagation, so
+        # re-enter the request context from the message itself: logs stamp the
+        # originating request id and spans land on the edge-stamped trace
+        ctx = RequestContext(
+            request_id=rp.request_id,
+            metadata={"trace_id": rp.trace_id} if rp.trace_id else {},
+        )
+        with use_context(ctx):
+            with tracing.span(
+                "disagg.prefill", prompt_len=len(rp.token_ids),
+                decode_worker=f"{rp.decode_worker_id:x}",
+            ):
+                await self._handle_traced(rp)
+
+    async def _handle_traced(self, rp: RemotePrefillRequest) -> None:
         from dynamo_tpu.disagg import ici
 
         # same-process decode worker? hand the KV off as a device array (ICI
@@ -135,9 +151,12 @@ class PrefillWorker:
                 # here (-> nack + redelivery) instead of stranding the decode
                 # side in a full receive() timeout after a notification whose
                 # payload will never arrive
-                await self.kv_client.send(
-                    rp.kv_addr, rp.request_id, host_data, token=rp.kv_token
-                )
+                with tracing.span(
+                    "disagg.kv_send", bytes=int(host_data.nbytes), mode="socket"
+                ):
+                    await self.kv_client.send(
+                        rp.kv_addr, rp.request_id, host_data, token=rp.kv_token
+                    )
             ok = await deliver()
             if not ok:
                 return

@@ -22,7 +22,8 @@ from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.engine.page_table import PageAllocator
 from dynamo_tpu.engine.scheduler import EngineRequest, Scheduler, StepOutput
 from dynamo_tpu.llm.kv_events import KvCacheEvent
-from dynamo_tpu.utils import get_logger
+from dynamo_tpu.runtime.context import current_context
+from dynamo_tpu.utils import get_logger, tracing
 
 log = get_logger("engine")
 
@@ -179,10 +180,23 @@ class AsyncJaxEngine:
         detokenizer calls, and SSE writes that dominated the serving-stack
         overhead (reference's HTTP frontend is an explicitly thin layer:
         lib/llm/src/http/service/openai.rs:132-214)."""
+        self._stamp_submission(request)
         self._register_stream(request.request_id)
         self._inbox.put(request)
         async for batch in self._drain_stream_batched(request.request_id):
             yield batch
+
+    @staticmethod
+    def _stamp_submission(request: EngineRequest) -> None:
+        """Observability stamps at the engine boundary: submission time (the
+        queue-wait/TTFT zero point) and the edge trace id the engine thread's
+        spans stitch to (the engine loop runs outside the request context)."""
+        if not request.enqueue_ts:
+            request.enqueue_ts = time.monotonic()
+        if request.trace_id is None:
+            ctx = current_context()
+            if ctx is not None:
+                request.trace_id = ctx.trace_id
 
     def _register_stream(self, request_id: str) -> None:
         """Open the output channel for a request without scheduling it (the
@@ -284,6 +298,7 @@ class AsyncJaxEngine:
                 sampling=SamplingParams(
                     temperature=rp.temperature, top_k=rp.top_k, top_p=rp.top_p, max_tokens=1
                 ),
+                trace_id=rp.trace_id or None,
             )
             first_token = self.scheduler.run_prefill_chunks(req, page_table, cached_len, prompt_len)
             self.allocator.commit_prefilled(rid, prompt_len)
@@ -294,10 +309,14 @@ class AsyncJaxEngine:
             ids = state.pages[start_page:n_pages]
             data = None
             if ids:
-                if mode == "ici":
-                    data = self.runner.extract_pages_device(np.asarray(ids, np.int32))
-                else:
-                    data = self.runner.extract_pages(np.asarray(ids, np.int32))
+                with tracing.span(
+                    "disagg.kv_extract", request_id=rp.request_id,
+                    trace_id=req.trace_id, pages=len(ids), mode=mode,
+                ):
+                    if mode == "ici":
+                        data = self.runner.extract_pages_device(np.asarray(ids, np.int32))
+                    else:
+                        data = self.runner.extract_pages(np.asarray(ids, np.int32))
         finally:
             self.allocator.free_sequence(rid)  # full blocks stay cached for reuse
 
@@ -342,7 +361,11 @@ class AsyncJaxEngine:
         n_pages = -(-result.prompt_len // ps)
         ids = state.pages[start_page:n_pages]
         if data is not None:
-            self.runner.inject_pages(np.asarray(ids, np.int32), data)
+            with tracing.span(
+                "disagg.kv_inject", request_id=req.request_id,
+                trace_id=req.trace_id, pages=len(ids), mode=result.kv_mode,
+            ):
+                self.runner.inject_pages(np.asarray(ids, np.int32), data)
         elif ids:
             # pages were expected to be filled remotely but the result carried
             # no KV (e.g. a swallowed transfer): adopting would decode from
@@ -380,6 +403,43 @@ class AsyncJaxEngine:
             gpu_cache_usage_perc=alloc.used_pages / max(1, self.config.num_pages - 1),
             gpu_prefix_cache_hit_rate=hit_rate,
         )
+
+    def stage_snapshot(self) -> dict:
+        """Per-stage latency attribution totals (scheduler StageStats plus the
+        host-KV-offload transfer leg) — the bench artifact's breakdown source."""
+        if self.scheduler is None:
+            return {}
+        snap = self.scheduler.stage.snapshot()
+        offload = getattr(self, "offload", None)
+        if offload is not None:
+            snap["kv_offload_s"] = round(offload.transfer_s, 4)
+            snap["kv_offload_blocks"] = offload.saves + offload.loads
+        return snap
+
+    def render_stage_metrics(self) -> str:
+        """Prometheus text for the engine-stage histograms (queue wait, TTFT,
+        prefill, decode-window dispatch, reconcile wait) + stage-seconds
+        counters; mounted under the serving /metrics endpoint."""
+        if self.scheduler is None:
+            return ""
+        from dynamo_tpu.utils.prometheus import render_family
+
+        parts = [h.render() for h in self.scheduler.stage_hist.values()]
+        stage_seconds = {
+            "queue_wait": self.scheduler.stage.queue_wait_s,
+            "prefill": self.scheduler.stage.prefill_s,
+            "decode_dispatch": self.scheduler.stage.decode_dispatch_s,
+            "reconcile_wait": self.scheduler.stage.reconcile_wait_s,
+        }
+        offload = getattr(self, "offload", None)
+        if offload is not None:
+            stage_seconds["kv_offload"] = offload.transfer_s
+        parts.append(render_family(
+            "dynamo_engine_stage_seconds_total", "counter",
+            "cumulative engine-thread seconds attributed to each stage",
+            [({"stage": k}, v) for k, v in sorted(stage_seconds.items())],
+        ))
+        return "".join(parts)
 
     def _on_kv_event(self, event: KvCacheEvent) -> None:
         if self._extra_kv_sink is not None:

@@ -19,12 +19,13 @@ Flow:
 
 from __future__ import annotations
 
+import time
 from collections import OrderedDict
 from typing import Optional
 
 import numpy as np
 
-from dynamo_tpu.utils import get_logger
+from dynamo_tpu.utils import get_logger, tracing
 
 log = get_logger("engine.offload")
 
@@ -39,6 +40,7 @@ class HostKvPool:
         self.saves = 0
         self.loads = 0
         self.drops = 0
+        self.transfer_s = 0.0  # device<->host block movement (both directions)
 
     def __len__(self) -> int:
         return len(self._blocks)
@@ -51,7 +53,9 @@ class HostKvPool:
         (for removed-event emission)."""
         if self.capacity_blocks <= 0:
             return [seq_hash]  # offload disabled: block is simply gone
+        t0 = time.monotonic()
         data = self.runner.extract_pages(np.asarray([page_id], np.int32))
+        self.transfer_s += time.monotonic() - t0
         self._blocks[seq_hash] = data
         self._blocks.move_to_end(seq_hash)
         self.saves += 1
@@ -68,7 +72,9 @@ class HostKvPool:
         if data is None:
             return False
         self._blocks.move_to_end(seq_hash)
+        t0 = time.monotonic()
         self.runner.inject_pages(np.asarray([page_id], np.int32), data)
+        self.transfer_s += time.monotonic() - t0
         self.loads += 1
         return True
 
@@ -95,6 +101,7 @@ class HostKvPool:
         # out of range -> dropped by the scatter
         n = len(hits)
         bucket = 1 << (n - 1).bit_length()
+        t0 = time.monotonic()
         data = np.concatenate([self._blocks[h] for h, _ in hits], axis=axis)
         ids = np.full(bucket, np.iinfo(np.int32).max // 2, np.int32)
         ids[:n] = [p for _, p in hits]
@@ -103,6 +110,10 @@ class HostKvPool:
             pad_shape[axis] = bucket - n
             data = np.concatenate([data, np.zeros(pad_shape, data.dtype)], axis=axis)
         self.runner.inject_pages(ids, data)
+        dt = time.monotonic() - t0
+        self.transfer_s += dt
+        tracing.record_span("engine.kv_offload.restore", t0, duration=dt,
+                            attrs={"blocks": n})
         for h, _ in hits:
             self._blocks.move_to_end(h)
         self.loads += n

@@ -28,6 +28,7 @@ exactly without reading anything back.
 from __future__ import annotations
 
 import dataclasses
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional
@@ -37,7 +38,8 @@ import numpy as np
 from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.engine.page_table import PageAllocator
 from dynamo_tpu.engine.sampling import MAX_EOS_IDS, SamplingParams, fold_seed
-from dynamo_tpu.utils import get_logger
+from dynamo_tpu.utils import get_logger, tracing
+from dynamo_tpu.utils.prometheus import Histogram
 
 log = get_logger("engine.sched")
 
@@ -67,6 +69,11 @@ class EngineRequest:
     # GENERATED (their occurrence counts restore at re-admission so
     # presence/frequency penalties stay continuous)
     penalty_output_from: Optional[int] = None
+    # observability: monotonic submission time (queue-wait/TTFT attribution)
+    # and the edge-stamped trace id engine spans stitch to — both optional,
+    # filled by AsyncJaxEngine at submission
+    enqueue_ts: float = 0.0
+    trace_id: Optional[str] = None
 
 
 @dataclass
@@ -145,6 +152,85 @@ def _is_ready(arr) -> bool:
         return False
 
 
+@dataclass
+class StageStats:
+    """Cumulative per-stage engine-time attribution (seconds + counts).
+
+    Always on — the cost is a handful of monotonic() reads per window against
+    ms-scale stages — so bench artifacts and worker stats can break a round's
+    wall time into queue wait / prefill / decode dispatch / device sync
+    without enabling tracing. Spans (DYNTPU_TRACE) add the per-request
+    timeline on top of these aggregates.
+    """
+
+    queue_wait_s: float = 0.0
+    queue_wait_n: int = 0
+    prefill_s: float = 0.0  # dispatch time of prefill calls (packed + chained)
+    prefill_calls: int = 0
+    prefill_rows: int = 0
+    decode_dispatch_s: float = 0.0
+    decode_windows: int = 0
+    decode_steps: int = 0
+    reconcile_wait_s: float = 0.0  # host blocked on device results
+    reconcile_waits: int = 0
+    ttft_s: float = 0.0  # submission -> first materialized token
+    ttft_n: int = 0
+
+    def snapshot(self) -> dict:
+        return {
+            "queue_wait_s": round(self.queue_wait_s, 4),
+            "queue_wait_n": self.queue_wait_n,
+            "prefill_s": round(self.prefill_s, 4),
+            "prefill_calls": self.prefill_calls,
+            "prefill_rows": self.prefill_rows,
+            "decode_dispatch_s": round(self.decode_dispatch_s, 4),
+            "decode_windows": self.decode_windows,
+            "decode_steps": self.decode_steps,
+            "reconcile_wait_s": round(self.reconcile_wait_s, 4),
+            "reconcile_waits": self.reconcile_waits,
+            "ttft_s": round(self.ttft_s, 4),
+            "ttft_n": self.ttft_n,
+        }
+
+
+# bucket ladders for the engine-stage histograms: queue wait and TTFT reach
+# into tens of seconds under overload; dispatch/sync stages are ms-scale
+_WAIT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+_STAGE_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                  0.25, 0.5, 1.0, 2.5, 5.0)
+
+
+def _stage_histograms() -> dict[str, Histogram]:
+    return {
+        "queue_wait": Histogram(
+            "dynamo_engine_queue_wait_seconds",
+            "time from engine submission to scheduler admission",
+            _WAIT_BUCKETS,
+        ),
+        "ttft": Histogram(
+            "dynamo_engine_ttft_seconds",
+            "time from engine submission to first materialized token",
+            _WAIT_BUCKETS,
+        ),
+        "prefill": Histogram(
+            "dynamo_engine_prefill_seconds",
+            "per-request prefill dispatch time across all chunks",
+            _STAGE_BUCKETS,
+        ),
+        "decode_window": Histogram(
+            "dynamo_engine_decode_window_dispatch_seconds",
+            "host dispatch time of one fused multi-step decode window",
+            _STAGE_BUCKETS,
+        ),
+        "reconcile": Histogram(
+            "dynamo_engine_reconcile_wait_seconds",
+            "host time blocked waiting on in-flight device results",
+            _STAGE_BUCKETS,
+        ),
+    }
+
+
 class Scheduler:
     def __init__(self, config: EngineConfig, runner, allocator: PageAllocator):
         self.config = config
@@ -163,6 +249,10 @@ class Scheduler:
         self.preempt_count = 0  # sequences bounced back to waiting (page pressure)
         self.pressure_drain_count = 0  # pipeline drains forced by ensure_capacity misses
         self.local_prefill_rows = 0  # prompt tokens prefilled on THIS engine's chip
+        # per-stage latency attribution: cumulative aggregates (always on) +
+        # Prometheus histograms (rendered by the worker's /metrics)
+        self.stage = StageStats()
+        self.stage_hist = _stage_histograms()
 
     # ---------------- queue ----------------
 
@@ -294,6 +384,16 @@ class Scheduler:
         return outputs
 
     def _start_sequence(self, req: EngineRequest, slot: int) -> None:
+        if req.enqueue_ts:
+            now = time.monotonic()
+            wait = max(0.0, now - req.enqueue_ts)
+            self.stage.queue_wait_s += wait
+            self.stage.queue_wait_n += 1
+            self.stage_hist["queue_wait"].observe(wait)
+            tracing.record_span(
+                "engine.queue_wait", now - wait, end=now,
+                request_id=req.request_id, trace_id=req.trace_id,
+            )
         cached_len, state = self.allocator.allocate_sequence(req.request_id, req.token_ids)
         prompt_len = len(req.token_ids)
         page_table = np.zeros(self.config.max_pages_per_seq, np.int32)
@@ -398,8 +498,10 @@ class Scheduler:
                 if is_final:
                     finals.append((seq, j))
                     want_lp = want_lp or seq.req.logprobs is not None
-            self.local_prefill_rows += sum(end - start for _, start, end in chunks)
+            rows = sum(end - start for _, start, end in chunks)
+            self.local_prefill_rows += rows
             N = min(lanes_max, 1 << (len(chunks) - 1).bit_length())
+            t0 = time.monotonic()
             try:
                 result = self.runner.prefill_chunk_batch(
                     lanes, N=N, want_logprobs=want_lp
@@ -412,6 +514,21 @@ class Scheduler:
                 for seq, _, _ in chunks:
                     outputs.extend(self._finish(seq, "error"))
                 continue
+            dt = time.monotonic() - t0
+            self.stage.prefill_s += dt
+            self.stage.prefill_calls += 1
+            self.stage.prefill_rows += rows
+            self.stage_hist["prefill"].observe(dt)
+            if tracing.enabled():
+                tracing.record_span(
+                    "engine.prefill", t0, duration=dt,
+                    request_id=chunks[0][0].req.request_id,
+                    trace_id=chunks[0][0].req.trace_id,
+                    attrs={
+                        "rows": rows, "lanes": N, "packed": True,
+                        "requests": [s.req.request_id for s, _, _ in chunks],
+                    },
+                )
             for j, (seq, start, end) in enumerate(chunks):
                 if end == seq.prompt_len:
                     self.allocator.commit_prefilled(seq.req.request_id, seq.prompt_len)
@@ -496,11 +613,13 @@ class Scheduler:
         output token on the final chunk. sync=True (disagg prefill-worker path)
         returns it as a host int; sync=False returns the device scalar.
         prep=False skips _prep_prefill (already run at packed-path admission)."""
-        self.local_prefill_rows += max(0, prompt_len - cached_len)
+        rows = max(0, prompt_len - cached_len)
+        self.local_prefill_rows += rows
         s = req.sampling
         first_token = None
         start = cached_len
         max_chunk = self.config.max_prefill_chunk
+        t0 = time.monotonic()
         if prep:
             self._prep_prefill(req, slot, prompt_len, cached_len=cached_len)
         while start < prompt_len:
@@ -528,6 +647,16 @@ class Scheduler:
             if is_last:
                 first_token = tok
             start = end
+        dt = time.monotonic() - t0
+        self.stage.prefill_s += dt
+        self.stage.prefill_calls += 1
+        self.stage.prefill_rows += rows
+        self.stage_hist["prefill"].observe(dt)
+        tracing.record_span(
+            "engine.prefill", t0, duration=dt,
+            request_id=req.request_id, trace_id=req.trace_id,
+            attrs={"rows": rows, "cached": cached_len, "sync": sync},
+        )
         return first_token
 
     def adopt_prefilled(
@@ -539,6 +668,19 @@ class Scheduler:
         and the KV injected; this emits the first token and queues the sequence
         for a decode slot.
         """
+        if req.enqueue_ts:
+            # the adopted analogue of admission queue wait: submission (on the
+            # decode worker) -> remote KV adopted into a decode slot
+            now = time.monotonic()
+            wait = max(0.0, now - req.enqueue_ts)
+            self.stage.queue_wait_s += wait
+            self.stage.queue_wait_n += 1
+            self.stage_hist["queue_wait"].observe(wait)
+            tracing.record_span(
+                "engine.queue_wait", now - wait, end=now,
+                request_id=req.request_id, trace_id=req.trace_id,
+                attrs={"adopted": True},
+            )
         state = self.allocator._seqs[req.request_id]
         page_table = np.zeros(self.config.max_pages_per_seq, np.int32)
         page_table[: len(state.pages)] = state.pages
@@ -679,6 +821,7 @@ class Scheduler:
 
         want_lp = any(seq.req.logprobs is not None for seq, _ in participants)
         want_pen = any(seq.req.sampling.needs_penalties for seq, _ in participants)
+        t0 = time.monotonic()
         result = self.runner.dispatch_decode_window(
             positions, page_tables, active, limits, temps, top_ks, top_ps, K,
             want_logprobs=want_lp, rope_deltas=rope_deltas, min_ps=min_ps,
@@ -687,6 +830,23 @@ class Scheduler:
             eos_allowed_from=eos_allowed_from if any_eos_mask else None,
             eos_ids=eos_rows if any_eos_mask else None,
         )
+        dt = time.monotonic() - t0
+        steps_total = sum(steps for _, _, steps in snapshot)
+        self.stage.decode_dispatch_s += dt
+        self.stage.decode_windows += 1
+        self.stage.decode_steps += K
+        self.stage_hist["decode_window"].observe(dt)
+        if tracing.enabled():
+            tracing.record_span(
+                "engine.decode.window", t0, duration=dt,
+                request_id=snapshot[0][0].req.request_id,
+                trace_id=snapshot[0][0].req.trace_id,
+                attrs={
+                    "participants": len(snapshot), "k": K,
+                    "steps_total": steps_total,
+                    "requests": [s.req.request_id for s, _, _ in snapshot],
+                },
+            )
         toks_dev, lp = result if want_lp else (result, None)
         self.in_flight.append(_InFlight(kind="window", dev=toks_dev, seqs=snapshot, lp=lp))
         return True
@@ -698,10 +858,24 @@ class Scheduler:
         outputs: list[StepOutput] = []
         while self.in_flight:
             entry = self.in_flight[0]
-            if not (block or drain) and not _is_ready(entry.dev):
+            ready = _is_ready(entry.dev)
+            if not (block or drain) and not ready:
                 break
             self.in_flight.popleft()
+            t0 = time.monotonic()
             data = np.asarray(entry.dev)
+            if not ready:
+                # host actually blocked on the device: the sync wait the
+                # dispatch-ahead pipeline exists to hide
+                dt = time.monotonic() - t0
+                self.stage.reconcile_wait_s += dt
+                self.stage.reconcile_waits += 1
+                self.stage_hist["reconcile"].observe(dt)
+                if tracing.enabled():
+                    tracing.record_span(
+                        "engine.decode.sync", t0, duration=dt,
+                        attrs={"kind": entry.kind, "drain": drain},
+                    )
             lp = None
             if entry.lp is not None:
                 lp = tuple(np.asarray(a) for a in entry.lp)
@@ -750,6 +924,16 @@ class Scheduler:
             return []
         req = seq.req
         seq.generated.append(token)
+        if len(seq.generated) == 1 and req.enqueue_ts:
+            ttft = max(0.0, time.monotonic() - req.enqueue_ts)
+            self.stage.ttft_s += ttft
+            self.stage.ttft_n += 1
+            self.stage_hist["ttft"].observe(ttft)
+            tracing.record_span(
+                "engine.ttft", req.enqueue_ts, duration=ttft,
+                request_id=req.request_id, trace_id=req.trace_id,
+                attrs={"cached": cached} if cached else None,
+            )
         seq.sched_len = max(seq.sched_len, len(seq.generated))
         self.allocator.append_token(req.request_id, token)
         finish: Optional[str] = None
@@ -812,6 +996,9 @@ class Scheduler:
         new_req = EngineRequest(
             request_id=seq.req.request_id,
             token_ids=list(seq.req.token_ids) + seq.generated,
+            # the resumed wait is a fresh queue-wait period on the same trace
+            enqueue_ts=time.monotonic(),
+            trace_id=seq.req.trace_id,
             images=seq.req.images,
             mm_embeds=seq.req.mm_embeds,  # offsets are prompt-relative: still valid
             logprobs=seq.req.logprobs,

@@ -4,7 +4,14 @@ external client library).
 Metric names mirror the reference (reference: lib/llm/src/http/service/
 metrics.rs:82-120): ``llm_http_service_requests_total``,
 ``llm_http_service_inflight_requests``, ``llm_http_service_request_duration_seconds``
-labeled by model/endpoint/request_type/status.
+labeled by model/endpoint/request_type/status — plus the per-stage serving
+latency histograms the reference frontend publishes:
+``llm_http_service_time_to_first_token_seconds`` and
+``llm_http_service_inter_token_latency_seconds``.
+
+Exposition conformance (promtool-checkable): every family renders its own
+HELP/TYPE pair ahead of its samples, and ``le`` bucket labels use canonical
+float formatting (utils/prometheus.py), never ``repr()``.
 """
 
 from __future__ import annotations
@@ -12,14 +19,15 @@ from __future__ import annotations
 import threading
 from collections import defaultdict
 
+from dynamo_tpu.utils.prometheus import Histogram, fmt_labels, render_family
+
 _BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
-
-
-def _fmt_labels(labels: dict[str, str]) -> str:
-    if not labels:
-        return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
-    return "{" + inner + "}"
+# TTFT spans sub-ms (cache hits on tiny models) to tens of seconds (deep queues)
+_TTFT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+# inter-token latency is ms-scale on healthy decode
+_ITL_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                0.25, 0.5, 1.0, 2.5)
 
 
 class Metrics:
@@ -29,9 +37,22 @@ class Metrics:
         self._lock = threading.Lock()
         self._counters: dict[tuple, float] = defaultdict(float)
         self._inflight: dict[tuple, int] = defaultdict(int)
-        self._hist_counts: dict[tuple, list[int]] = {}
-        self._hist_sum: dict[tuple, float] = defaultdict(float)
-        self._hist_total: dict[tuple, int] = defaultdict(int)
+        p = self.PREFIX
+        self.duration = Histogram(
+            f"{p}_request_duration_seconds", "request duration",
+            _BUCKETS, ("endpoint", "model"),
+        )
+        self.ttft = Histogram(
+            f"{p}_time_to_first_token_seconds",
+            "time from request arrival to the first generated token",
+            _TTFT_BUCKETS, ("model",),
+        )
+        self.itl = Histogram(
+            f"{p}_inter_token_latency_seconds",
+            "per-token latency between successive output chunks "
+            "(chunk gap / tokens in chunk)",
+            _ITL_BUCKETS, ("model",),
+        )
 
     def inc_request(self, model: str, endpoint: str, request_type: str, status: str) -> None:
         key = (model, endpoint, request_type, status)
@@ -43,54 +64,42 @@ class Metrics:
             self._inflight[(model,)] += delta
 
     def observe_duration(self, model: str, endpoint: str, seconds: float) -> None:
-        key = (model, endpoint)
-        with self._lock:
-            if key not in self._hist_counts:
-                self._hist_counts[key] = [0] * len(_BUCKETS)
-            for i, b in enumerate(_BUCKETS):
-                if seconds <= b:
-                    self._hist_counts[key][i] += 1
-            self._hist_sum[key] += seconds
-            self._hist_total[key] += 1
+        self.duration.observe(seconds, (endpoint, model))
+
+    def observe_ttft(self, model: str, seconds: float) -> None:
+        self.ttft.observe(seconds, (model,))
+
+    def observe_itl(self, model: str, seconds: float) -> None:
+        self.itl.observe(seconds, (model,))
 
     def render(self, extra: str = "") -> str:
         p = self.PREFIX
-        lines = [
-            f"# HELP {p}_requests_total total requests by model/endpoint/type/status",
-            f"# TYPE {p}_requests_total counter",
-        ]
         with self._lock:
-            for (model, endpoint, rtype, status), v in sorted(self._counters.items()):
-                labels = _fmt_labels(
-                    {"model": model, "endpoint": endpoint, "request_type": rtype, "status": status}
+            counters = sorted(self._counters.items())
+            inflight = sorted(self._inflight.items())
+        out = render_family(
+            f"{p}_requests_total", "counter",
+            "total requests by model/endpoint/type/status",
+            [
+                (
+                    {"model": m, "endpoint": e, "request_type": t, "status": s},
+                    int(v),
                 )
-                lines.append(f"{p}_requests_total{labels} {int(v)}")
-            lines += [
-                f"# HELP {p}_inflight_requests currently in-flight requests",
-                f"# TYPE {p}_inflight_requests gauge",
-            ]
-            for (model,), v in sorted(self._inflight.items()):
-                lines.append(f"{p}_inflight_requests{_fmt_labels({'model': model})} {v}")
-            lines += [
-                f"# HELP {p}_request_duration_seconds request duration",
-                f"# TYPE {p}_request_duration_seconds histogram",
-            ]
-            for (model, endpoint), counts in sorted(self._hist_counts.items()):
-                base = {"model": model, "endpoint": endpoint}
-                for b, c in zip(_BUCKETS, counts):
-                    labels = _fmt_labels({**base, "le": repr(b)})
-                    lines.append(f"{p}_request_duration_seconds_bucket{labels} {c}")
-                labels = _fmt_labels({**base, "le": "+Inf"})
-                lines.append(
-                    f"{p}_request_duration_seconds_bucket{labels} {self._hist_total[(model, endpoint)]}"
-                )
-                lines.append(
-                    f"{p}_request_duration_seconds_sum{_fmt_labels(base)} {self._hist_sum[(model, endpoint)]:.6f}"
-                )
-                lines.append(
-                    f"{p}_request_duration_seconds_count{_fmt_labels(base)} {self._hist_total[(model, endpoint)]}"
-                )
-        out = "\n".join(lines) + "\n"
+                for (m, e, t, s), v in counters
+            ],
+        )
+        out += render_family(
+            f"{p}_inflight_requests", "gauge", "currently in-flight requests",
+            [({"model": m}, v) for (m,), v in inflight],
+        )
+        out += self.duration.render()
+        out += self.ttft.render()
+        out += self.itl.render()
         if extra:
             out += extra
         return out
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    # kept for callers that built label strings through this module
+    return fmt_labels(labels)

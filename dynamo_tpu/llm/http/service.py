@@ -32,7 +32,7 @@ from dynamo_tpu.llm.protocols.openai import (
 from dynamo_tpu.llm.http.metrics import Metrics
 from dynamo_tpu.llm.protocols import sse
 from dynamo_tpu.llm.tools import ToolCallError, ToolCallingMatcher
-from dynamo_tpu.utils import get_logger
+from dynamo_tpu.utils import get_logger, tracing
 
 log = get_logger("http")
 
@@ -95,6 +95,7 @@ class HttpService:
         self.app.router.add_post("/v1/completions", self._completions)
         self.app.router.add_get("/v1/models", self._models)
         self.app.router.add_get("/metrics", self._metrics)
+        self.app.router.add_get("/trace", self._trace)
         self.app.router.add_get("/health", self._health)
         self.app.router.add_get("/live", self._health)
 
@@ -137,6 +138,18 @@ class HttpService:
     async def _metrics(self, request: web.Request) -> web.Response:
         extra = self._extra_metrics() if self._extra_metrics else ""
         return web.Response(text=self.metrics.render(extra), content_type="text/plain")
+
+    async def _trace(self, request: web.Request) -> web.Response:
+        """Debug endpoint: the in-memory span ring as a Perfetto-loadable
+        Chrome-trace document. ``?trace_id=`` / ``?request_id=`` filter to one
+        request's stitched timeline; empty unless tracing is enabled
+        (DYNTPU_TRACE=<path> or tracing.enable())."""
+        doc = tracing.export()
+        tid = request.query.get("trace_id")
+        rid = request.query.get("request_id")
+        if tid or rid:
+            doc["traceEvents"] = tracing.events(trace_id=tid, request_id=rid)
+        return web.json_response(doc)
 
     def _error(self, status: int, message: str) -> web.Response:
         return web.json_response(
@@ -185,6 +198,7 @@ class HttpService:
             # every stream's first token (r5: ~160 ms of the burst TTFT gap
             # between the HTTP and engine-loop legs at bs32)
             loop = asyncio.get_running_loop()
+            t_pre = time.monotonic()
             if kind == "chat":
                 pre, annotations = await loop.run_in_executor(
                     None, pipeline.preprocessor.preprocess_chat, req
@@ -193,6 +207,7 @@ class HttpService:
                 pre, annotations = await loop.run_in_executor(
                     None, pipeline.preprocessor.preprocess_completion, req
                 )
+            t_pre_end = time.monotonic()
         except ProtocolError as e:
             self.metrics.inc_request(model, endpoint, rtype, "400")
             return self._error(400, str(e))
@@ -229,6 +244,16 @@ class HttpService:
         if request.headers.get("x-request-id"):
             meta["x-request-id"] = request.headers["x-request-id"]
         ctx = new_context(request_id=getattr(pre, "request_id", None), metadata=meta)
+        # the edge stamps the trace id: every downstream hop (processor,
+        # workers) inherits it through the context's metadata bag, so one
+        # request's spans stitch into a single multi-hop timeline
+        ctx.ensure_trace_id()
+        if tracing.enabled():
+            tracing.record_span(
+                "http.preprocess", t_pre, end=t_pre_end,
+                request_id=ctx.request_id, trace_id=ctx.trace_id,
+                attrs={"tokens": len(pre.token_ids)},
+            )
 
         self.metrics.inflight(model, 1)
         try:
@@ -276,6 +301,11 @@ class HttpService:
         finally:
             self.metrics.inflight(model, -1)
             self.metrics.observe_duration(model, endpoint, time.monotonic() - t0)
+            tracing.record_span(
+                "http.request", t0, end=time.monotonic(),
+                request_id=ctx.request_id, trace_id=ctx.trace_id,
+                attrs={"endpoint": endpoint, "model": model},
+            )
 
     async def _generate_chunks(
         self,
@@ -300,6 +330,7 @@ class HttpService:
         want_timing = "timing" in pre.annotations
         t_start = time.monotonic()
         t_first = None
+        t_prev = None  # last output-chunk arrival, for inter-token latency
         # With tools active the full text must be buffered so a tool-call JSON
         # response never leaks as content deltas (tool calls are matched on
         # complete messages, llm/tools.py).
@@ -308,7 +339,8 @@ class HttpService:
         async for out in pipeline.backend.generate(pre):
             usage.completion_tokens = out.cumulative_tokens
             if t_first is None and out.token_ids:
-                t_first = time.monotonic()
+                t_first = t_prev = time.monotonic()
+                self.metrics.observe_ttft(model, t_first - t_start)
                 # OpenAI semantics: the role delta leads the stream at first-
                 # token time. Also the client's only honest TTFT signal — the
                 # first CONTENT delta can lag several tokens behind while the
@@ -316,6 +348,12 @@ class HttpService:
                 role = getattr(gen, "role_chunk", None)
                 if role is not None and not gen._sent_role:
                     yield role()
+            elif t_prev is not None and out.token_ids:
+                # engine windows arrive as multi-token chunks: the honest
+                # per-token number is the chunk gap amortized over its tokens
+                now = time.monotonic()
+                self.metrics.observe_itl(model, (now - t_prev) / len(out.token_ids))
+                t_prev = now
             if tool_matcher is not None:
                 if out.text:
                     buffered.append(out.text)

@@ -32,6 +32,7 @@ class KvMetricsAggregator:
         self.interval = interval
         self.scrape_timeout = scrape_timeout
         self._latest: list[WorkerLoad] = []
+        self._latest_raw: list[tuple[int, dict]] = []  # (instance_id, stats data)
         self._task: Optional[asyncio.Task] = None
         self._on_update = None
 
@@ -55,12 +56,18 @@ class KvMetricsAggregator:
             if kv is not None:
                 loads.append(WorkerLoad.from_wire(ep.instance_id, kv))
         self._latest = loads
+        self._latest_raw = [(ep.instance_id, ep.data) for ep in stats.endpoints]
         if self._on_update is not None:
             self._on_update(loads)
         return loads
 
     def get_metrics(self) -> list[WorkerLoad]:
         return list(self._latest)
+
+    def get_raw(self) -> list[tuple[int, dict]]:
+        """Full stats payloads of the last scrape, beyond kv_metrics — e.g.
+        per-stage latency attribution (stage_seconds) and disagg counters."""
+        return list(self._latest_raw)
 
     async def _loop(self) -> None:
         try:

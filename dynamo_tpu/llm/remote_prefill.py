@@ -38,6 +38,11 @@ class RemotePrefillRequest:
     # KV server only accepts a payload carrying it, so a network peer that
     # merely learns a request_id cannot inject KV into the decode cache
     kv_token: str = ""
+    # observability: the edge-stamped trace id. The work queue bypasses the
+    # RPC envelope's context propagation, so the id rides this message and the
+    # prefill worker re-enters the request context from it — stitching both
+    # workers' spans (and logs) of one request onto one timeline
+    trace_id: str = ""
 
     def to_wire(self) -> dict:
         return self.__dict__.copy()

@@ -17,7 +17,7 @@ import msgpack
 
 from dynamo_tpu.runtime.component import EndpointInfo, INSTANCE_PREFIX
 from dynamo_tpu.runtime.context import RequestContext, current_context
-from dynamo_tpu.utils import get_logger
+from dynamo_tpu.utils import get_logger, tracing
 
 log = get_logger("runtime.client")
 
@@ -155,12 +155,22 @@ class Client:
             "request": msgpack.packb(request, use_bin_type=True),
         }
         if ctx is not None:
+            # the trace id must be IN the metadata bag before serialization so
+            # the remote hop's spans stitch to the same timeline
+            ctx.ensure_trace_id()
             payload["context"] = ctx.to_wire()
         try:
-            delivered = await drt.cplane.publish(info.subject, payload)
-            if delivered == 0:
-                raise NoInstancesError(f"instance {info.instance_id:x} is gone")
-            await asyncio.wait_for(receiver.prologue_ok, timeout=30.0)
+            # hop-overhead span: request push + remote handler setup, up to the
+            # prologue (first-frame ok) — the wire cost a trace attributes to
+            # this hop rather than to compute
+            with tracing.span(
+                f"rpc.push.{self.component}.{self.endpoint}",
+                instance=f"{info.instance_id:x}",
+            ):
+                delivered = await drt.cplane.publish(info.subject, payload)
+                if delivered == 0:
+                    raise NoInstancesError(f"instance {info.instance_id:x} is gone")
+                await asyncio.wait_for(receiver.prologue_ok, timeout=30.0)
         except Exception:
             drt.tcp_server.unregister(conn_info.context_id)
             raise

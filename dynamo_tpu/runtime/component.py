@@ -23,7 +23,7 @@ import msgpack
 
 from dynamo_tpu.runtime.context import RequestContext, use_context
 from dynamo_tpu.runtime.tcp import ConnectionInfo, call_home
-from dynamo_tpu.utils import get_logger
+from dynamo_tpu.utils import get_logger, tracing
 
 log = get_logger("runtime.component")
 
@@ -215,7 +215,13 @@ class ServedEndpoint:
         request = msgpack.unpackb(payload["request"], raw=False)
         ctx = RequestContext.from_wire(payload["context"]) if payload.get("context") else None
         with use_context(ctx):
-            await self._run_handler(conn_info, request)
+            # server-side hop span: the whole handler stream, on the timeline
+            # of whatever trace id the caller shipped in the context
+            with tracing.span(
+                f"rpc.handle.{self.info.endpoint}",
+                component=self.info.component,
+            ):
+                await self._run_handler(conn_info, request)
 
     async def _run_handler(self, conn_info, request) -> None:
 

@@ -24,6 +24,18 @@ class RequestContext:
     request_id: str
     metadata: dict = field(default_factory=dict)
 
+    @property
+    def trace_id(self) -> str:
+        """The id that stitches this request's spans across hops: stamped into
+        the metadata bag at the edge, falling back to the request id (so a
+        context that never crossed an edge still yields one coherent trace)."""
+        return self.metadata.get("trace_id") or self.request_id
+
+    def ensure_trace_id(self) -> str:
+        """Stamp the trace id into the metadata bag (idempotent) so downstream
+        hops inherit it over the wire rather than re-deriving their own."""
+        return self.metadata.setdefault("trace_id", self.request_id)
+
     def to_wire(self) -> dict:
         return {"request_id": self.request_id, "metadata": dict(self.metadata)}
 

@@ -1,1 +1,2 @@
 from dynamo_tpu.utils.logging import init_logging, get_logger
+from dynamo_tpu.utils import tracing

@@ -3,6 +3,11 @@
 Mirrors the reference's logging surface (reference: lib/runtime/src/logging.rs:20-298):
 env-filtered level via ``DYNTPU_LOG`` (analogue of DYN_LOG), JSON-lines structured
 output via ``DYNTPU_LOG_JSONL`` (analogue of DYN_LOGGING_JSONL).
+
+Both formatters auto-stamp the ambient request/trace id (from
+``runtime.context.current_context``) into every record emitted while handling
+a request, so worker logs are joinable against traces (``DYNTPU_TRACE``
+captures) with no per-call-site plumbing.
 """
 
 from __future__ import annotations
@@ -14,6 +19,22 @@ import sys
 import time
 
 _INITIALIZED = False
+_current_context = None  # resolved lazily: runtime imports utils at startup
+
+
+def _ambient_ids() -> tuple:
+    """(request_id, trace_id) of the ambient request, or (None, None)."""
+    global _current_context
+    if _current_context is None:
+        try:
+            from dynamo_tpu.runtime.context import current_context
+        except ImportError:  # mid-bootstrap: no request can be in flight yet
+            return None, None
+        _current_context = current_context
+    ctx = _current_context()
+    if ctx is None:
+        return None, None
+    return ctx.request_id, ctx.metadata.get("trace_id") or ctx.request_id
 
 
 class JsonlFormatter(logging.Formatter):
@@ -27,12 +48,28 @@ class JsonlFormatter(logging.Formatter):
             "target": record.name,
             "message": record.getMessage(),
         }
+        rid, tid = _ambient_ids()
+        if rid is not None:
+            entry["request_id"] = rid
+            if tid != rid:
+                entry["trace_id"] = tid
         extra = getattr(record, "fields", None)
         if isinstance(extra, dict):
             entry.update(extra)
         if record.exc_info:
             entry["exception"] = self.formatException(record.exc_info)
         return json.dumps(entry, default=str)
+
+
+class PlainFormatter(logging.Formatter):
+    """Human format with the ambient request id appended when present."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        base = super().format(record)
+        rid, _ = _ambient_ids()
+        if rid is not None:
+            return f"{base} [rid={rid}]"
+        return base
 
 
 def init_logging(level: str | None = None) -> None:
@@ -53,7 +90,7 @@ def init_logging(level: str | None = None) -> None:
         handler.setFormatter(JsonlFormatter())
     else:
         handler.setFormatter(
-            logging.Formatter(
+            PlainFormatter(
                 "%(asctime)s %(levelname).1s %(name)s: %(message)s",
                 datefmt="%H:%M:%S",
             )

@@ -1,0 +1,239 @@
+"""Minimal Prometheus text-exposition helpers (no client library).
+
+Shared by the HTTP service metrics, the engine stage histograms, and the
+standalone metrics component so every producer emits *conformant* exposition:
+exactly one ``# HELP``/``# TYPE`` pair per metric family (emitted before the
+family's first sample), canonically formatted ``le`` labels (never ``repr()``),
+escaped label values, and cumulative histogram buckets ending at ``+Inf``.
+
+``check_exposition`` is the promtool-style validator the test suite runs
+against every ``/metrics`` surface; keeping it next to the formatters means a
+new producer can't drift from what the checker enforces.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable, Optional, Sequence
+
+_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
+
+
+def fmt_value(v) -> str:
+    """Canonical sample/bucket-bound formatting: shortest float that round-trips
+    for exposition purposes ('0.005', '1', '60', '2.5e-05') — never repr().
+    Pre-formatted strings pass through (callers pinning a decimal width)."""
+    if isinstance(v, str):
+        return v
+    if v != v:  # NaN
+        return "NaN"
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    s = f"{float(v):.12g}"
+    return s
+
+
+def escape_label_value(v) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def fmt_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{escape_label_value(v)}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+class Histogram:
+    """A labeled histogram family rendered in Prometheus text format.
+
+    Buckets are cumulative (le-style); observe() walks a dozen floats so it is
+    cheap enough for per-request hot paths. Thread-safe: the engine loop and
+    the asyncio thread both observe.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        buckets: Sequence[float],
+        label_names: Sequence[str] = (),
+    ):
+        self.name = name
+        self.help = help
+        self.buckets = tuple(buckets)
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+        # labelset tuple -> ([bucket counts], sum, count)
+        self._series: dict[tuple, list] = {}
+
+    def observe(self, value: float, labels: Sequence[str] = ()) -> None:
+        key = tuple(labels)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = [[0] * len(self.buckets), 0.0, 0]
+                self._series[key] = s
+            counts, _, _ = s
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            s[1] += value
+            s[2] += 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return sum(s[2] for s in self._series.values())
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return sum(s[1] for s in self._series.values())
+
+    def render(self) -> str:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} histogram",
+        ]
+        with self._lock:
+            for key in sorted(self._series):
+                counts, total, n = self._series[key]
+                base = dict(zip(self.label_names, key))
+                for b, c in zip(self.buckets, counts):
+                    lines.append(
+                        f"{self.name}_bucket{fmt_labels({**base, 'le': fmt_value(b)})} {c}"
+                    )
+                lines.append(f"{self.name}_bucket{fmt_labels({**base, 'le': '+Inf'})} {n}")
+                lines.append(f"{self.name}_sum{fmt_labels(base)} {total:.6f}")
+                lines.append(f"{self.name}_count{fmt_labels(base)} {n}")
+        return "\n".join(lines) + "\n"
+
+
+def render_family(
+    name: str, mtype: str, help: str, samples: Iterable[tuple[dict, float]]
+) -> str:
+    """One complete family: HELP/TYPE then every (labels, value) sample."""
+    lines = [f"# HELP {name} {help}", f"# TYPE {name} {mtype}"]
+    for labels, value in samples:
+        lines.append(f"{name}{fmt_labels(labels)} {fmt_value(value)}")
+    return "\n".join(lines) + "\n"
+
+
+def _family_of(sample_name: str, histogram_families: set[str]) -> str:
+    for suffix in ("_bucket", "_sum", "_count"):
+        if sample_name.endswith(suffix) and sample_name[: -len(suffix)] in histogram_families:
+            return sample_name[: -len(suffix)]
+    return sample_name
+
+
+def check_exposition(text: str) -> list[str]:
+    """Promtool-style lint of a text exposition. Returns a list of problems
+    (empty = conformant). Enforced rules:
+
+      - every sample belongs to a family with exactly one HELP and one TYPE
+        line, both appearing before the family's first sample
+      - TYPE values are legal; histogram families carry _bucket/_sum/_count
+        samples and every ``le`` is a parseable float or ``+Inf``
+      - sample values parse as floats; label strings are well-formed
+    """
+    problems: list[str] = []
+    helps: dict[str, int] = {}
+    types: dict[str, str] = {}
+    first_sample_seen: set[str] = set()
+    hist_families: set[str] = set()
+    hist_has: dict[str, set] = {}
+
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.rstrip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(None, 3)
+            if len(parts) < 3:
+                problems.append(f"line {lineno}: malformed HELP")
+                continue
+            fam = parts[2]
+            helps[fam] = helps.get(fam, 0) + 1
+            if helps[fam] > 1:
+                problems.append(f"line {lineno}: duplicate HELP for {fam}")
+            if fam in first_sample_seen:
+                problems.append(f"line {lineno}: HELP for {fam} after its samples")
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4:
+                problems.append(f"line {lineno}: malformed TYPE")
+                continue
+            fam, mtype = parts[2], parts[3]
+            if fam in types:
+                problems.append(f"line {lineno}: duplicate TYPE for {fam}")
+            if mtype not in _TYPES:
+                problems.append(f"line {lineno}: illegal TYPE {mtype!r} for {fam}")
+            if fam in first_sample_seen:
+                problems.append(f"line {lineno}: TYPE for {fam} after its samples")
+            types[fam] = mtype
+            if mtype == "histogram":
+                hist_families.add(fam)
+                hist_has[fam] = set()
+            continue
+        if line.startswith("#"):
+            continue  # free-text comment: legal, attaches to nothing
+        # sample line: name{labels} value  |  name value
+        name_part, _, value_part = line.rpartition(" ")
+        if not name_part:
+            problems.append(f"line {lineno}: malformed sample")
+            continue
+        try:
+            float(value_part)
+        except ValueError:
+            problems.append(f"line {lineno}: non-numeric value {value_part!r}")
+        labels: dict[str, str] = {}
+        if "{" in name_part:
+            name, _, rest = name_part.partition("{")
+            if not rest.endswith("}"):
+                problems.append(f"line {lineno}: unterminated label set")
+                continue
+            body = rest[:-1]
+            # simple split: label values in this codebase never contain
+            # escaped quotes followed by commas; good enough for linting
+            for pair in filter(None, body.split(",")):
+                if "=" not in pair:
+                    problems.append(f"line {lineno}: malformed label {pair!r}")
+                    continue
+                k, _, v = pair.partition("=")
+                if not (v.startswith('"') and v.endswith('"')):
+                    problems.append(f"line {lineno}: unquoted label value in {pair!r}")
+                    continue
+                labels[k] = v[1:-1]
+        else:
+            name = name_part
+        fam = _family_of(name, hist_families)
+        first_sample_seen.add(fam)
+        if fam not in types:
+            problems.append(f"line {lineno}: sample {name} has no TYPE for family {fam}")
+        if fam not in helps:
+            problems.append(f"line {lineno}: sample {name} has no HELP for family {fam}")
+        if fam in hist_families:
+            for suffix in ("_bucket", "_sum", "_count"):
+                if name == fam + suffix:
+                    hist_has[fam].add(suffix)
+            if name == fam + "_bucket":
+                le = labels.get("le")
+                if le is None:
+                    problems.append(f"line {lineno}: histogram bucket without le")
+                elif le != "+Inf":
+                    try:
+                        float(le)
+                    except ValueError:
+                        problems.append(f"line {lineno}: unparseable le {le!r}")
+
+    for fam, seen in hist_has.items():
+        missing = {"_bucket", "_sum", "_count"} - seen
+        if fam in first_sample_seen and missing:
+            problems.append(f"histogram {fam} missing {sorted(missing)} samples")
+    return problems

@@ -1,0 +1,214 @@
+"""End-to-end request tracing: a lightweight span recorder with
+Chrome-trace/Perfetto export.
+
+Spans ride the ambient :class:`~dynamo_tpu.runtime.context.RequestContext`
+(the id + metadata bag that already crosses every network hop): the trace id
+stamped at the edge lands in the context's metadata, every hop's handler
+re-enters the context, and every span recorded anywhere in the stack carries
+that trace id — so one request's spans from the HTTP frontend, the
+processor/router, the prefill worker, and the decode worker stitch into a
+single timeline keyed by ``trace_id``.
+
+Off by default: ``span()`` costs one attribute read when disabled, so the hot
+paths (scheduler windows, reconcile) pay nothing. Enable with
+``DYNTPU_TRACE=<path>`` (spans append to the file as JSONL, one Chrome trace
+event per line) or programmatically via :func:`enable` (in-memory ring only
+when no path is given). ``tools/trace_view.py`` summarizes a capture;
+the HTTP service's ``/trace`` endpoint serves the in-memory ring as a
+Perfetto-loadable ``{"traceEvents": [...]}`` document.
+
+Event shape (Chrome trace event format, complete-event ``ph: "X"``)::
+
+    {"name": "engine.prefill", "ph": "X", "cat": "dyntpu",
+     "ts": <epoch µs>, "dur": <µs>, "pid": <os pid>, "tid": <thread id>,
+     "args": {"trace_id": ..., "request_id": ..., "thread": ..., ...}}
+
+``ts`` is epoch-anchored (one monotonic->epoch offset captured at import), so
+events from different processes line up on a shared timeline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Iterator, Optional
+
+
+def _ambient_context():
+    # lazy: the runtime package imports utils during its own bootstrap
+    from dynamo_tpu.runtime.context import current_context
+
+    return current_context()
+
+
+TRACE_ENV = "DYNTPU_TRACE"
+MAX_EVENTS = 65536
+
+# monotonic->epoch anchor: span timers use monotonic, exported ts is epoch µs
+_EPOCH_OFFSET = time.time() - time.monotonic()
+
+_lock = threading.Lock()
+_events: deque = deque(maxlen=MAX_EVENTS)
+_file = None
+_path: Optional[str] = None
+_enabled = bool(os.environ.get(TRACE_ENV))
+if _enabled:
+    _path = os.environ[TRACE_ENV]
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(path: Optional[str] = None) -> None:
+    """Turn the recorder on; ``path`` (or $DYNTPU_TRACE) gets JSONL appends."""
+    global _enabled, _path
+    with _lock:
+        _enabled = True
+        if path is not None:
+            _path = path
+
+
+def disable() -> None:
+    global _enabled, _file, _path
+    with _lock:
+        _enabled = False
+        if _file is not None:
+            try:
+                _file.close()
+            except OSError:
+                pass
+            _file = None
+        # a later bare enable() starts fresh (env-configured path or memory
+        # only) instead of appending to whatever path the last enable() used
+        _path = os.environ.get(TRACE_ENV) or None
+
+
+def clear() -> None:
+    with _lock:
+        _events.clear()
+
+
+def current_trace_id() -> Optional[str]:
+    """Trace id of the ambient request context (metadata-stamped id, falling
+    back to the request id), or None outside a request."""
+    ctx = _ambient_context()
+    if ctx is None:
+        return None
+    return ctx.metadata.get("trace_id") or ctx.request_id
+
+
+def _write_line(ev: dict) -> None:
+    global _file
+    if _path is None:
+        return
+    try:
+        if _file is None:
+            _file = open(_path, "a", buffering=1)
+        _file.write(json.dumps(ev, default=str) + "\n")
+    except OSError:
+        pass  # tracing must never take the serving path down
+
+
+def record_span(
+    name: str,
+    start: float,
+    end: Optional[float] = None,
+    duration: Optional[float] = None,
+    request_id: Optional[str] = None,
+    trace_id: Optional[str] = None,
+    attrs: Optional[dict] = None,
+) -> None:
+    """Record one complete span. ``start``/``end`` are time.monotonic() values;
+    pass ``duration`` instead of ``end`` when more convenient. request/trace
+    ids default to the ambient context's — pass them explicitly on threads
+    that run outside the request context (the engine loop)."""
+    if not _enabled:
+        return
+    if duration is None:
+        duration = (end if end is not None else time.monotonic()) - start
+    if request_id is None or trace_id is None:
+        ctx = _ambient_context()
+        if ctx is not None:
+            if request_id is None:
+                request_id = ctx.request_id
+            if trace_id is None:
+                trace_id = ctx.metadata.get("trace_id") or ctx.request_id
+    if trace_id is None:
+        trace_id = request_id
+    thread = threading.current_thread()
+    args = {"trace_id": trace_id, "request_id": request_id, "thread": thread.name}
+    if attrs:
+        args.update(attrs)
+    ev = {
+        "name": name,
+        "ph": "X",
+        "cat": "dyntpu",
+        "ts": int((start + _EPOCH_OFFSET) * 1e6),
+        "dur": max(0, int(duration * 1e6)),
+        "pid": os.getpid(),
+        "tid": thread.ident or 0,
+        "args": args,
+    }
+    with _lock:
+        _events.append(ev)
+        _write_line(ev)
+
+
+@contextlib.contextmanager
+def span(
+    name: str,
+    request_id: Optional[str] = None,
+    trace_id: Optional[str] = None,
+    **attrs,
+) -> Iterator[None]:
+    """Time a block as one span. No-op (one bool read) when tracing is off.
+    Works across awaits: it measures wall time of the enclosed block."""
+    if not _enabled:
+        yield
+        return
+    t0 = time.monotonic()
+    try:
+        yield
+    finally:
+        record_span(
+            name, t0, end=time.monotonic(),
+            request_id=request_id, trace_id=trace_id, attrs=attrs or None,
+        )
+
+
+def events(
+    trace_id: Optional[str] = None, request_id: Optional[str] = None
+) -> list[dict]:
+    """Snapshot of the in-memory ring, optionally filtered."""
+    with _lock:
+        snap = list(_events)
+    if trace_id is not None:
+        snap = [e for e in snap if e["args"].get("trace_id") == trace_id]
+    if request_id is not None:
+        snap = [e for e in snap if e["args"].get("request_id") == request_id]
+    return snap
+
+
+def trace_ids() -> list[str]:
+    """Distinct trace ids currently in the ring (insertion order)."""
+    seen: dict[str, None] = {}
+    with _lock:
+        for e in _events:
+            tid = e["args"].get("trace_id")
+            if tid:
+                seen.setdefault(tid, None)
+    return list(seen)
+
+
+def export(trace_id: Optional[str] = None) -> dict:
+    """Perfetto/chrome://tracing-loadable document."""
+    return {
+        "displayTimeUnit": "ms",
+        "traceEvents": events(trace_id=trace_id),
+        "otherData": {"source": "dynamo_tpu", "enabled": _enabled},
+    }

@@ -35,6 +35,13 @@ def _fill_representative(bench):
     bench.DETAIL["continuity_bs%d_ps%d" % bench.CONTINUITY] = {"tok_s": 1402.77}
     bench.DETAIL["ref_workload_isl3k_osl150"] = {
         "tok_s": 731.55, "ttft_p50_ms": 1893.2,
+        "stage_breakdown": {
+            "queue_wait_s": 12.3456, "queue_wait_n": 48, "prefill_s": 31.9071,
+            "prefill_calls": 96, "prefill_rows": 147456,
+            "decode_dispatch_s": 55.1203, "decode_windows": 240,
+            "decode_steps": 7680, "reconcile_wait_s": 8.0042,
+            "reconcile_waits": 120, "ttft_s": 90.8, "ttft_n": 48,
+        },
     }
     bench.DETAIL["http_serving"] = {
         "tok_s": 3264.18, "engine_loop_tok_s": 3401.02,
@@ -68,6 +75,12 @@ def test_summary_line_fits_truncation_budget(bench_mod, tmp_path, monkeypatch):
     assert s["headline_tok_s"] == 6354.12
     assert result["value"] == 6354.12
     assert s["ref_workload_isl3k_osl150"]["tok_s"] == 731.55
+    # the per-stage attribution rides the compact line (queue/prefill/decode/
+    # sync seconds), so the flat-TTFT question is answerable from the artifact
+    assert s["ref_workload_isl3k_osl150"]["stages"] == {
+        "queue": 12.35, "prefill": 31.91, "decode": 55.12, "sync": 8.0,
+        "offload": 0.0,
+    }
     assert s["http_serving"]["http_over_engine_ratio"] == 0.96
     assert s["mla_decode_tok_s"] == 4658.33
     assert s["moe_decode_tok_s"] == 5425.87

@@ -1,0 +1,141 @@
+"""Summarize a DYNTPU_TRACE capture: per-stage latency attribution table.
+
+A capture is JSONL (one Chrome trace event per line — what DYNTPU_TRACE=<path>
+appends) or a ``{"traceEvents": [...]}`` document (what the HTTP service's
+``/trace`` endpoint returns). Multiple files merge onto one timeline (each
+serving process writes its own capture; spans share trace ids).
+
+    python tools/trace_view.py trace.jsonl [more.jsonl ...]
+        [--trace-id ID]        only spans of one request's stitched timeline
+        [--per-trace]          also print a per-trace breakdown (slowest first)
+        [--perfetto out.json]  write a Perfetto/chrome://tracing-loadable file
+
+The per-stage table answers the attribution question directly: for each span
+name (engine.queue_wait, engine.prefill, engine.decode.window, rpc.push.*,
+disagg.kv_*, http.request, ...), count / total / mean / p50 / p95 / max ms.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_events(paths: list[str]) -> list[dict]:
+    events: list[dict] = []
+    for path in paths:
+        with open(path) as f:
+            text = f.read()
+        try:
+            # whole-document forms: {"traceEvents": [...]} or a bare array
+            doc = json.loads(text)
+            events.extend(doc.get("traceEvents", []) if isinstance(doc, dict) else doc)
+            continue
+        except json.JSONDecodeError:
+            pass  # JSONL capture: one event per line
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                print(f"warning: skipping malformed line in {path}", file=sys.stderr)
+    return [e for e in events if e.get("ph") == "X" and "dur" in e]
+
+
+def _pct(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def stage_table(events: list[dict]) -> list[tuple]:
+    """[(name, count, total_ms, mean_ms, p50_ms, p95_ms, max_ms)] by total desc."""
+    by_name: dict[str, list[float]] = {}
+    for e in events:
+        by_name.setdefault(e.get("name", "?"), []).append(e["dur"] / 1e3)
+    rows = []
+    for name, durs in by_name.items():
+        durs.sort()
+        total = sum(durs)
+        rows.append((
+            name, len(durs), total, total / len(durs),
+            _pct(durs, 0.5), _pct(durs, 0.95), durs[-1],
+        ))
+    rows.sort(key=lambda r: -r[2])
+    return rows
+
+
+def print_table(rows: list[tuple], out=sys.stdout) -> None:
+    if not rows:
+        print("no spans", file=out)
+        return
+    w = max(len(r[0]) for r in rows)
+    hdr = f"{'span':<{w}}  {'count':>6}  {'total_ms':>10}  {'mean_ms':>8}  {'p50_ms':>8}  {'p95_ms':>8}  {'max_ms':>8}"
+    print(hdr, file=out)
+    print("-" * len(hdr), file=out)
+    for name, n, total, mean, p50, p95, mx in rows:
+        print(
+            f"{name:<{w}}  {n:>6}  {total:>10.1f}  {mean:>8.2f}  {p50:>8.2f}  {p95:>8.2f}  {mx:>8.2f}",
+            file=out,
+        )
+
+
+def per_trace_rows(events: list[dict]) -> list[tuple]:
+    """[(trace_id, span_count, wall_ms, hops)] slowest wall first. wall is the
+    envelope (last end - first start) of the trace's spans across processes."""
+    by_trace: dict[str, list[dict]] = {}
+    for e in events:
+        tid = (e.get("args") or {}).get("trace_id") or "?"
+        by_trace.setdefault(tid, []).append(e)
+    rows = []
+    for tid, evs in by_trace.items():
+        start = min(e["ts"] for e in evs)
+        end = max(e["ts"] + e["dur"] for e in evs)
+        hops = len({e.get("pid") for e in evs})
+        rows.append((tid, len(evs), (end - start) / 1e3, hops))
+    rows.sort(key=lambda r: -r[2])
+    return rows
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__,
+                                formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("captures", nargs="+", help="JSONL capture(s) or /trace JSON dump(s)")
+    p.add_argument("--trace-id", help="filter to one request's stitched timeline")
+    p.add_argument("--per-trace", action="store_true", help="per-trace wall breakdown")
+    p.add_argument("--perfetto", metavar="OUT", help="write a Perfetto-loadable JSON file")
+    args = p.parse_args(argv)
+
+    events = load_events(args.captures)
+    if args.trace_id:
+        events = [
+            e for e in events
+            if (e.get("args") or {}).get("trace_id") == args.trace_id
+        ]
+    if not events:
+        print("no matching spans", file=sys.stderr)
+        return 1
+
+    print(f"{len(events)} spans, "
+          f"{len({(e.get('args') or {}).get('trace_id') for e in events})} traces, "
+          f"{len({e.get('pid') for e in events})} processes\n")
+    print_table(stage_table(events))
+
+    if args.per_trace:
+        print("\nper-trace wall (slowest first):")
+        for tid, n, wall, hops in per_trace_rows(events)[:20]:
+            print(f"  {tid}  spans={n} processes={hops} wall={wall:.1f}ms")
+
+    if args.perfetto:
+        with open(args.perfetto, "w") as f:
+            json.dump({"displayTimeUnit": "ms", "traceEvents": events}, f)
+        print(f"\nwrote {args.perfetto} (load in https://ui.perfetto.dev or chrome://tracing)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
