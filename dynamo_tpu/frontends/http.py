@@ -7,24 +7,35 @@ import asyncio
 from dynamo_tpu.frontends.pipeline import build_pipeline, card_for_model
 from dynamo_tpu.llm.http.service import HttpService
 from dynamo_tpu.utils import get_logger
+from dynamo_tpu.utils.prometheus import render_family
 
 log = get_logger("frontends.http")
+
+
+def engine_metrics_text(engine) -> str:
+    """Prometheus exposition for a colocated engine: ForwardPassMetrics
+    gauges (one conformant family per field) + the per-stage latency
+    histograms (queue wait, TTFT, prefill, decode window, reconcile)."""
+    parts = []
+    m = getattr(engine, "metrics", None)
+    if m is not None:
+        fm = m()
+        for k, v in fm.to_wire().items():
+            parts.append(render_family(
+                f"llm_worker_{k}", "gauge", f"worker {k}", [({}, v)]
+            ))
+    stage = getattr(engine, "render_stage_metrics", None)
+    if stage is not None:
+        parts.append(stage())
+    return "".join(parts)
 
 
 async def run_http(engine, args) -> None:
     card = card_for_model(args.model, getattr(args, "max_model_len", None))
     pipeline = build_pipeline(engine, card)
 
-    def extra_metrics() -> str:
-        m = getattr(engine, "metrics", None)
-        if m is None:
-            return ""
-        fm = m()
-        lines = []
-        for k, v in fm.to_wire().items():
-            lines.append(f"llm_worker_{k} {v}")
-        return "\n".join(lines) + "\n"
-
-    service = HttpService(port=args.http_port, extra_metrics=extra_metrics)
+    service = HttpService(
+        port=args.http_port, extra_metrics=lambda: engine_metrics_text(engine)
+    )
     service.manager.add(pipeline)
     await service.run_forever()
