@@ -184,6 +184,7 @@ async def _main(args) -> None:
             num_pages=args.num_pages,
             max_seqs=args.max_seqs,
             max_model_len=args.max_model_len,
+            quantize=getattr(args, "quantize", None),
         ),
         enable_disagg_decode=args.disagg,
     )
@@ -215,6 +216,8 @@ def main(argv=None) -> None:
     p.add_argument("--num-pages", type=int, default=512)
     p.add_argument("--max-seqs", type=int, default=8)
     p.add_argument("--max-model-len", type=int, default=2048)
+    p.add_argument("--quantize", choices=["int8_wo"], default=None,
+                   help="weight-only quantization applied at load time")
     p.add_argument("--disagg", action="store_true", help="wrap in the disagg decode path")
     args = p.parse_args(argv)
     asyncio.run(_main(args))

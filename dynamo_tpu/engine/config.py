@@ -28,6 +28,14 @@ class EngineConfig:
     # (Megatron head split inside each stage on the (pp, tp) mesh); not with
     # sp. Requires num_layers % pp == 0.
     pp: int = 1
+    # weight-only quantization mode applied at model-load time:
+    #   None      — serve at the model's native dtype (bf16)
+    #   "int8_wo" — big linear weights stored int8 + per-output-channel f32
+    #               scales, dequantized inside the matmul; embeddings /
+    #               lm_head / norms / routers stay bf16. Halves the weight
+    #               HBM stream the decode roofline is made of
+    #               (dynamo_tpu/quant/int8.py).
+    quantize: str | None = None
     worker_id: str = "worker-0"
     # fraction of pages that must stay free for decode growth before admitting
     # a new sequence (simple admission control)
@@ -73,6 +81,13 @@ class EngineConfig:
             raise ValueError(
                 f"warmup must be True, False, or 'background'; got {self.warmup!r}"
             )
+        if self.quantize is not None:
+            from dynamo_tpu.quant import QUANT_MODES
+
+            if self.quantize not in QUANT_MODES:
+                raise ValueError(
+                    f"quantize must be None or one of {QUANT_MODES}; got {self.quantize!r}"
+                )
 
     @property
     def max_pages_per_seq(self) -> int:

@@ -114,7 +114,9 @@ class AsyncJaxEngine:
         from dynamo_tpu.models.registry import load_model
 
         t0 = time.monotonic()
-        self.model, params = load_model(self.config.model_id)
+        self.model, params = load_model(
+            self.config.model_id, quantize=self.config.quantize
+        )
         self.runner = ModelRunner(self.config, self.model, params)
         offload = None
         if self.config.host_cache_blocks > 0:
@@ -137,8 +139,9 @@ class AsyncJaxEngine:
         elif self.config.warmup:
             self.runner.warmup()
         log.info(
-            "engine ready: model=%s tp=%d pp=%d sp=%d pages=%d (%.1fs)",
+            "engine ready: model=%s quantize=%s tp=%d pp=%d sp=%d pages=%d (%.1fs)",
             self.config.model_id,
+            self.config.quantize or "none",
             self.config.tp,
             self.config.pp,
             self.config.sp,

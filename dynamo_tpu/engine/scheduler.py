@@ -346,6 +346,16 @@ class Scheduler:
             if slot is None:
                 break
             req = self.waiting[0]
+            # reject oversized prompts BEFORE the fairness-cap break: the
+            # rejection is pure host work (no chip time), so an oversized
+            # prompt at the queue head must fail now, not stall behind the
+            # per-step prefill cap (and stall everything queued behind it)
+            if len(req.token_ids) > self.config.max_model_len:
+                self.waiting.popleft()
+                outputs.append(
+                    StepOutput(req.request_id, finished=True, finish_reason="error")
+                )
+                continue
             if (
                 cap
                 and decode_running
@@ -353,12 +363,6 @@ class Scheduler:
                 and not (packed_mode and not req.images)
             ):
                 break
-            if len(req.token_ids) > self.config.max_model_len:
-                self.waiting.popleft()
-                outputs.append(
-                    StepOutput(req.request_id, finished=True, finish_reason="error")
-                )
-                continue
             pages_needed = -(-len(req.token_ids) // self.config.page_size)
             if self.allocator.free_pages < pages_needed + watermark_pages:
                 break

@@ -38,6 +38,7 @@ def engine_config_for(args):
             prefill_buckets=(16, 32),
             tp=getattr(args, "tp", None) or 1,
             pp=getattr(args, "pp", None) or 1,
+            quantize=getattr(args, "quantize", None),
         )
     return EngineConfig(
         model_id=card.model_path,
@@ -47,6 +48,7 @@ def engine_config_for(args):
         max_model_len=card.context_length,
         tp=getattr(args, "tp", None) or 1,
         pp=getattr(args, "pp", None) or 1,
+        quantize=getattr(args, "quantize", None),
         # serve as soon as the core traces compile; feature variants land in
         # the background (halves cold first-deploy readiness time)
         warmup="background",

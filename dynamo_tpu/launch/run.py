@@ -25,6 +25,11 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--max-seqs", type=int, default=None, help="decode batch slots")
     run.add_argument("--tp", type=int, default=None, help="tensor-parallel degree")
     run.add_argument("--pp", type=int, default=None, help="pipeline-parallel stages")
+    run.add_argument(
+        "--quantize", choices=["int8_wo"], default=None,
+        help="weight-only quantization applied at load time (int8 weights + "
+             "per-channel scales; embeddings/norms stay bf16)",
+    )
     run.add_argument("--max-tokens", type=int, default=None, help="batch mode default max_tokens")
     # serve/build/deploy are dispatched on argv[0] in main() (their argv is
     # forwarded verbatim — argparse REMAINDER can't capture leading options);

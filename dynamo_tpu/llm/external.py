@@ -91,15 +91,20 @@ class ExternalTokenEngine:
                     tokens = list(item)
                 for j, tok in enumerate(tokens):
                     emitted += 1
-                    done = emitted >= max_tokens or (
-                        finish_reason is not None and j == len(tokens) - 1
-                    )
+                    natural_end = finish_reason is not None and j == len(tokens) - 1
+                    done = emitted >= max_tokens or natural_end
+                    # a user finish_reason only applies when its item was
+                    # FULLY delivered; a stream cut mid-item by max_tokens is
+                    # a truncation and must report "length" even if the
+                    # truncated item carried finish_reason="stop"
                     yield StepOutput(
                         request_id=request.request_id,
                         token=int(tok),
                         finished=done,
                         finish_reason=(
-                            (finish_reason or "length") if done else None
+                            (finish_reason if natural_end else "length")
+                            if done
+                            else None
                         ),
                     )
                     if done:

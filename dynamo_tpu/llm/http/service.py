@@ -196,16 +196,21 @@ class HttpService:
             # CPU-bound (the tokenizer's Rust encode releases the GIL), and a
             # request burst otherwise serializes its preprocessing ahead of
             # every stream's first token (r5: ~160 ms of the burst TTFT gap
-            # between the HTTP and engine-loop legs at bs32)
+            # between the HTTP and engine-loop legs at bs32). The dedicated
+            # small pool (not the default executor) bounds thread-local
+            # tokenizer loads to its worker count — see
+            # llm/tokenizer.py:preprocessing_executor.
+            from dynamo_tpu.llm.tokenizer import preprocessing_executor
+
             loop = asyncio.get_running_loop()
             t_pre = time.monotonic()
             if kind == "chat":
                 pre, annotations = await loop.run_in_executor(
-                    None, pipeline.preprocessor.preprocess_chat, req
+                    preprocessing_executor(), pipeline.preprocessor.preprocess_chat, req
                 )
             else:
                 pre, annotations = await loop.run_in_executor(
-                    None, pipeline.preprocessor.preprocess_completion, req
+                    preprocessing_executor(), pipeline.preprocessor.preprocess_completion, req
                 )
             t_pre_end = time.monotonic()
         except ProtocolError as e:

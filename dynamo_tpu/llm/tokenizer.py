@@ -13,8 +13,36 @@ Implementations:
 
 from __future__ import annotations
 
+import threading
 from pathlib import Path
 from typing import Optional, Protocol, Sequence
+
+_preproc_pool = None
+_preproc_lock = threading.Lock()
+
+
+def preprocessing_executor():
+    """Small dedicated pool for CPU-bound request preprocessing (chat-template
+    render + BPE encode).
+
+    Why not the default executor: HfTokenizer keeps one underlying tokenizer
+    per THREAD (the PyO3 binding is not concurrency-safe — see HfTokenizer),
+    so preprocessing on the default asyncio executor loads one duplicate
+    ``AutoTokenizer.from_pretrained`` copy per executor thread it ever lands
+    on (dozens of threads => dozens of multi-MB tokenizer copies and cold
+    ~100ms loads mid-traffic). A 4-worker pool bounds that to 4 loads while
+    still covering request-burst parallelism (encode releases the GIL).
+    """
+    global _preproc_pool
+    if _preproc_pool is None:
+        with _preproc_lock:
+            if _preproc_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                _preproc_pool = ThreadPoolExecutor(
+                    max_workers=4, thread_name_prefix="dyntpu-preproc"
+                )
+    return _preproc_pool
 
 
 class Tokenizer(Protocol):

@@ -42,6 +42,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from dynamo_tpu.ops.moe import moe_block
 from dynamo_tpu.ops.norms import rms_norm
 from dynamo_tpu.ops.rotary import apply_rope
+from dynamo_tpu.quant import (
+    QUANT_MODES,
+    qlinear,
+    quantize_shardings_int8,
+    quantize_tree_int8,
+)
 
 _NEG_INF = -1e30
 
@@ -85,6 +91,8 @@ class DeepseekConfig:
     norm_topk_prob: bool = False
     rope_theta: float = 10000.0
     rms_norm_eps: float = 1e-6
+    # weight-only quantization mode (None or "int8_wo"); see LlamaConfig
+    quantize: Any = None
     dtype: Any = jnp.bfloat16
 
     @property
@@ -172,6 +180,17 @@ class DeepseekConfig:
 class DeepseekModel:
     """Stateless forward functions over a params pytree (MLA + MoE)."""
 
+    #: quantizable per-layer weights in both layer groups (applied
+    #: by-presence). Deliberately excluded: the k-up/v-up banks w_kb/w_vb
+    #: (3-D per-head einsum operands, ~1% of bytes), norms, and the f32
+    #: router.
+    QUANT_WEIGHT_NAMES = frozenset({
+        "w_q", "w_dq", "w_uq", "w_dkv", "wo",
+        "gate", "up", "down",
+        "w_gate", "w_up", "w_down",
+        "shared_gate", "shared_up", "shared_down",
+    })
+
     def __init__(self, config: DeepseekConfig):
         self.config = config
         # set by ModelRunner for tp>1: the Pallas MLA kernel runs under
@@ -212,7 +231,23 @@ class DeepseekModel:
             p["w_q"] = dense(next(keys), (L, D, H * (dn + dr)), 1)
         return p
 
-    def init_params(self, rng: jax.Array) -> dict:
+    def quantize_params(self, params: dict) -> dict:
+        """Apply config.quantize to both layer groups (no-op when unset)."""
+        mode = self.config.quantize
+        if not mode:
+            return params
+        if mode not in QUANT_MODES:
+            raise ValueError(f"unknown quantize mode {mode!r} (supported: {QUANT_MODES})")
+        params = dict(params)
+        for group in ("dense_layers", "moe_layers"):
+            params[group] = quantize_tree_int8(params[group], self.QUANT_WEIGHT_NAMES)
+        return params
+
+    def init_params(self, rng: jax.Array, quantize: bool = True) -> dict:
+        params = self._init_raw_params(rng)
+        return self.quantize_params(params) if quantize else params
+
+    def _init_raw_params(self, rng: jax.Array) -> dict:
         c = self.config
         keys = iter(jax.random.split(rng, 48))
 
@@ -299,6 +334,9 @@ class DeepseekModel:
                 "shared_down": ns(None, tp, None),
             }
         )
+        if c.quantize:
+            dense_layers = quantize_shardings_int8(dense_layers, self.QUANT_WEIGHT_NAMES)
+            moe_layers = quantize_shardings_int8(moe_layers, self.QUANT_WEIGHT_NAMES)
         return {
             "embed": ns(None, None),
             "dense_layers": dense_layers,
@@ -347,10 +385,10 @@ class DeepseekModel:
         T = h.shape[0]
         H, dn, dr = c.num_heads, c.qk_nope_head_dim, c.qk_rope_head_dim
         if c.q_lora_rank:
-            ql = rms_norm(h @ lp["w_dq"], lp["q_norm"], c.rms_norm_eps)
-            q = (ql @ lp["w_uq"]).reshape(T, H, dn + dr)
+            ql = rms_norm(qlinear(h, lp["w_dq"]), lp["q_norm"], c.rms_norm_eps)
+            q = qlinear(ql, lp["w_uq"]).reshape(T, H, dn + dr)
         else:
-            q = (h @ lp["w_q"]).reshape(T, H, dn + dr)
+            q = qlinear(h, lp["w_q"]).reshape(T, H, dn + dr)
         q_nope, q_rope = q[..., :dn], q[..., dn:]
         q_rope = apply_rope(q_rope, positions, c.rope_theta)
         return q_nope, q_rope
@@ -359,7 +397,7 @@ class DeepseekModel:
         """h [T, D] -> cache rows [T, latent_dim] = [norm(latent), roped k_rope]."""
         c = self.config
         dc = c.kv_lora_rank
-        ckv = h @ lp["w_dkv"]  # [T, dc + dr]
+        ckv = qlinear(h, lp["w_dkv"])  # [T, dc + dr]
         latent = rms_norm(ckv[:, :dc], lp["kv_norm"], c.rms_norm_eps)
         k_rope = apply_rope(ckv[:, None, dc:], positions, c.rope_theta)[:, 0]
         row = jnp.concatenate([latent, k_rope], axis=-1).astype(c.dtype)
@@ -415,9 +453,15 @@ class DeepseekModel:
         dc = c.kv_lora_rank
         q_cat = self._fold_q(lp, q_nope, q_rope)
         import functools
+        import os
 
+        # kernel choice resolved HERE (dispatch level, like ops/attention.py's
+        # GQA dispatcher) and passed as a static argument — not read inside
+        # the jitted kernel where it would freeze at first trace per shape
         kernel = functools.partial(
-            paged_mla_decode_attention_pallas, d_c=dc, interpret=not _on_tpu()
+            paged_mla_decode_attention_pallas, d_c=dc,
+            lookahead=os.environ.get("DYNTPU_DECODE_KERNEL") == "lookahead",
+            interpret=not _on_tpu(),
         )
         mesh = self.attn_mesh
         tp = 1 if mesh is None else mesh.shape.get("tp", 1)
@@ -535,12 +579,13 @@ class DeepseekModel:
 
             attn = jax.vmap(one)(q_nope, q_rope, gather_tables, positions)
 
-        hidden = hidden + attn @ lp["wo"]
+        hidden = hidden + qlinear(attn, lp["wo"])
         h = rms_norm(hidden, lp["post_norm"], c.rms_norm_eps)
         if moe:
-            shared = (jax.nn.silu(h @ lp["shared_gate"]) * (h @ lp["shared_up"])) @ lp[
-                "shared_down"
-            ]
+            shared = qlinear(
+                jax.nn.silu(qlinear(h, lp["shared_gate"])) * qlinear(h, lp["shared_up"]),
+                lp["shared_down"],
+            )
             routed = moe_block(
                 h,
                 lp["router"],
@@ -553,7 +598,7 @@ class DeepseekModel:
             )
             hidden = hidden + shared + c.routed_scaling_factor * routed
         else:
-            mlp = (jax.nn.silu(h @ lp["gate"]) * (h @ lp["up"])) @ lp["down"]
+            mlp = qlinear(jax.nn.silu(qlinear(h, lp["gate"])) * qlinear(h, lp["up"]), lp["down"])
             hidden = hidden + mlp
         return hidden, pool
 

@@ -36,6 +36,12 @@ from dynamo_tpu.ops.attention import (
 )
 from dynamo_tpu.ops.norms import rms_norm
 from dynamo_tpu.ops.rotary import apply_mrope, apply_rope
+from dynamo_tpu.quant import (
+    QUANT_MODES,
+    qlinear,
+    quantize_shardings_int8,
+    quantize_tree_int8,
+)
 
 
 def _resolve_tp_axis(mesh: Mesh, tp_axis: str):
@@ -77,6 +83,11 @@ class LlamaConfig:
     # head_dim // 2. None = plain 1D RoPE. With equal position components
     # (all text) M-RoPE reduces exactly to 1D RoPE (ops/rotary.py).
     mrope_section: Any = None
+    # weight-only quantization mode: None (full precision) or "int8_wo" —
+    # the big linear weights become int8 + per-output-channel f32 scales at
+    # load time; embeddings/lm_head/norms/biases stay at `dtype`
+    # (dynamo_tpu/quant/int8.py)
+    quantize: Any = None
     dtype: Any = jnp.bfloat16
 
     @property
@@ -129,6 +140,11 @@ class LlamaConfig:
 class LlamaModel:
     """Stateless forward functions over a params pytree."""
 
+    #: per-layer weights eligible for weight-only quantization — the decode
+    #: hot path's big matmuls; norms/biases (and embed/lm_head outside the
+    #: layer stack) stay at config.dtype
+    QUANT_WEIGHT_NAMES = frozenset({"wq", "wk", "wv", "wo", "gate", "up", "down"})
+
     def __init__(self, config: LlamaConfig):
         self.config = config
         # set by ModelRunner for tp>1 so the Pallas decode kernel can run
@@ -137,7 +153,40 @@ class LlamaModel:
 
     # ---------------- params ----------------
 
-    def init_params(self, rng: jax.Array) -> dict:
+    def quantize_params(self, params: dict) -> dict:
+        """Apply config.quantize to a full-precision params tree (no-op when
+        unset). Loaders call this after filling checkpoint weights; the
+        subclass's QUANT_WEIGHT_NAMES picks the leaves."""
+        mode = self.config.quantize
+        if not mode:
+            return params
+        if mode not in QUANT_MODES:
+            raise ValueError(f"unknown quantize mode {mode!r} (supported: {QUANT_MODES})")
+        params = dict(params)
+        params["layers"] = quantize_tree_int8(params["layers"], self.QUANT_WEIGHT_NAMES)
+        return params
+
+    def _quantize_shardings(self, shardings: dict) -> dict:
+        """Mirror quantize_params onto the sharding tree: int8 weights keep
+        the bf16 leaf's sharding, scales drop its contracted-axis entry (so
+        they follow the weight's output-channel sharding and replicate over
+        a row-parallel split)."""
+        if not self.config.quantize:
+            return shardings
+        shardings = dict(shardings)
+        shardings["layers"] = quantize_shardings_int8(
+            shardings["layers"], self.QUANT_WEIGHT_NAMES
+        )
+        return shardings
+
+    def init_params(self, rng: jax.Array, quantize: bool = True) -> dict:
+        """quantize=False yields the raw full-precision tree even when the
+        config requests quantization — the loader's allocation template
+        (models/loader.py fills f32 arrays, then quantizes once at the end)."""
+        params = self._init_raw_params(rng)
+        return self.quantize_params(params) if quantize else params
+
+    def _init_raw_params(self, rng: jax.Array) -> dict:
         c = self.config
         keys = iter(jax.random.split(rng, 16))
 
@@ -207,7 +256,7 @@ class LlamaModel:
             shardings["layers"]["bv"] = ns(None, tp_axis)
         if not self.config.tie_word_embeddings:
             shardings["lm_head"] = ns(tp_axis, None)
-        return shardings
+        return self._quantize_shardings(shardings)
 
     def kv_cache_shape(self, num_pages: int, page_size: int) -> tuple[int, ...]:
         """Shape of each of the two flat page pools (the "k" and "v" leaves).
@@ -305,9 +354,11 @@ class LlamaModel:
         c = self.config
         T = hidden.shape[0]
         h = rms_norm(hidden, lp["input_norm"], c.rms_norm_eps)
-        q_flat = h @ lp["wq"]
-        k_flat = h @ lp["wk"]
-        v_flat = h @ lp["wv"]
+        # qlinear == `h @ w` for full-precision weights; int8 weight-only
+        # leaves dequantize inside the fused dot (dynamo_tpu/quant/int8.py)
+        q_flat = qlinear(h, lp["wq"])
+        k_flat = qlinear(h, lp["wk"])
+        v_flat = qlinear(h, lp["wv"])
         if c.attention_bias:
             q_flat = q_flat + lp["bq"]
             k_flat = k_flat + lp["bk"]
@@ -340,12 +391,12 @@ class LlamaModel:
         # attn_fn sees both the updated pools (paged paths) and the chunk's
         # fresh rows (ring/SP path, which never reads the pool)
         attn = attn_fn(q, k, v, k_pool, v_pool)
-        attn_out = attn.reshape(T, -1) @ lp["wo"]
+        attn_out = qlinear(attn.reshape(T, -1), lp["wo"])
         if tp_axis is not None:
             attn_out = jax.lax.psum(attn_out, tp_axis)
         hidden = hidden + attn_out
         h = rms_norm(hidden, lp["post_norm"], c.rms_norm_eps)
-        mlp = (jax.nn.silu(h @ lp["gate"]) * (h @ lp["up"])) @ lp["down"]
+        mlp = qlinear(jax.nn.silu(qlinear(h, lp["gate"])) * qlinear(h, lp["up"]), lp["down"])
         if tp_axis is not None:
             mlp = jax.lax.psum(mlp, tp_axis)
         hidden = hidden + mlp

@@ -47,15 +47,25 @@ def _iter_checkpoint_tensors(path: Path):
 
 
 def _alloc_like(model):
-    """(numpy f32 arrays, ShapeDtypeStruct tree) matching model.init_params."""
-    shapes = jax.eval_shape(model.init_params, jax.random.key(0))
+    """(numpy f32 arrays, ShapeDtypeStruct tree) matching the model's RAW
+    (pre-quantization) param tree — checkpoint tensors fill full-precision
+    buffers; _finish applies the config's quantize mode once at the end."""
+    shapes = jax.eval_shape(
+        lambda key: model.init_params(key, quantize=False), jax.random.key(0)
+    )
     arrays = jax.tree.map(lambda s: np.zeros(s.shape, np.float32), shapes)
     return arrays, shapes
 
 
-def _finish(arrays, shapes):
-    """Cast the filled numpy arrays to the model's exact leaf dtypes."""
-    return jax.tree.map(lambda a, s: jnp.asarray(a, s.dtype), arrays, shapes)
+def _finish(arrays, shapes, model=None):
+    """Cast the filled numpy arrays to the model's exact leaf dtypes, then
+    quantize (quantize="int8_wo" checkpoints: weight-only int8 conversion
+    happens HERE, at load time — the serving stack never sees bf16 copies of
+    the quantized weights)."""
+    params = jax.tree.map(lambda a, s: jnp.asarray(a, s.dtype), arrays, shapes)
+    if model is not None:
+        params = model.quantize_params(params)
+    return params
 
 
 def _set_layer(group: dict, key: str, layer: int, tensor: np.ndarray, transpose: bool):
@@ -109,7 +119,7 @@ def load_llama_weights(model: LlamaModel, path: Path) -> dict:
         raise ValueError("checkpoint missing model.embed_tokens.weight")
     if "lm_head" in arrays and not seen_head:
         arrays["lm_head"][:] = arrays["embed"]
-    return _finish(arrays, shapes)
+    return _finish(arrays, shapes, model)
 
 
 def load_mixtral_weights(model, path: Path) -> dict:
@@ -163,7 +173,7 @@ def load_mixtral_weights(model, path: Path) -> dict:
         raise ValueError("checkpoint missing model.embed_tokens.weight")
     if "lm_head" in arrays and not seen_head:
         arrays["lm_head"][:] = arrays["embed"]
-    return _finish(arrays, shapes)
+    return _finish(arrays, shapes, model)
 
 
 def load_deepseek_weights(model, path: Path) -> dict:
@@ -242,7 +252,7 @@ def load_deepseek_weights(model, path: Path) -> dict:
         raise ValueError("checkpoint missing model.embed_tokens.weight")
     if not seen_head:
         arrays["lm_head"][:] = arrays["embed"]
-    return _finish(arrays, shapes)
+    return _finish(arrays, shapes, model)
 
 
 def load_qwen2_vl_weights(model, path: Path) -> dict:
@@ -348,4 +358,4 @@ def load_qwen2_vl_weights(model, path: Path) -> dict:
         raise ValueError("checkpoint missing model.embed_tokens.weight")
     if "lm_head" in text_arrays and not seen_head:
         text_arrays["lm_head"][:] = text_arrays["embed"]
-    return _finish(arrays, shapes)
+    return _finish(arrays, shapes, model)

@@ -19,6 +19,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from dynamo_tpu.models.llama import LlamaConfig, LlamaModel
 from dynamo_tpu.ops.moe import moe_block
 from dynamo_tpu.ops.norms import rms_norm
+from dynamo_tpu.quant import qlinear, quantize_shardings_int8
 
 
 @dataclass(frozen=True)
@@ -53,12 +54,18 @@ class MixtralConfig(LlamaConfig):
 
 
 class MixtralModel(LlamaModel):
+    #: attention matmuls + the per-expert FFN banks quantize; the router
+    #: stays f32 (routing decisions are precision-sensitive and tiny)
+    QUANT_WEIGHT_NAMES = frozenset(
+        {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"}
+    )
+
     def __init__(self, config: MixtralConfig):
         super().__init__(config)
 
-    def init_params(self, rng: jax.Array) -> dict:
+    def _init_raw_params(self, rng: jax.Array) -> dict:
         c = self.config
-        params = super().init_params(rng)
+        params = super()._init_raw_params(rng)
         keys = iter(jax.random.split(jax.random.fold_in(rng, 1), 8))
 
         def dense(key, shape, scale_axis):
@@ -90,6 +97,12 @@ class MixtralModel(LlamaModel):
         layers["w_gate"] = ns(None, ep, None, None)
         layers["w_up"] = ns(None, ep, None, None)
         layers["w_down"] = ns(None, ep, None, None)
+        # second pass for the expert banks super() hadn't seen yet
+        # (idempotent: the already-wrapped attention leaves skip)
+        if self.config.quantize:
+            shardings["layers"] = quantize_shardings_int8(
+                shardings["layers"], self.QUANT_WEIGHT_NAMES
+            )
         return shardings
 
     def _layer(self, lp, hidden, k_pool, v_pool, positions, flat_phys, offsets, attn_fn,
@@ -103,12 +116,12 @@ class MixtralModel(LlamaModel):
         from dynamo_tpu.ops.attention import scatter_kv
 
         h = rms_norm(hidden, lp["input_norm"], c.rms_norm_eps)
-        q = apply_rope((h @ lp["wq"]).reshape(T, c.num_heads, c.head_dim), positions, c.rope_theta)
-        k = apply_rope((h @ lp["wk"]).reshape(T, c.num_kv_heads, c.head_dim), positions, c.rope_theta)
-        v = (h @ lp["wv"]).reshape(T, c.num_kv_heads, c.head_dim)
+        q = apply_rope(qlinear(h, lp["wq"]).reshape(T, c.num_heads, c.head_dim), positions, c.rope_theta)
+        k = apply_rope(qlinear(h, lp["wk"]).reshape(T, c.num_kv_heads, c.head_dim), positions, c.rope_theta)
+        v = qlinear(h, lp["wv"]).reshape(T, c.num_kv_heads, c.head_dim)
         k_pool, v_pool = scatter_kv(k_pool, v_pool, k, v, flat_phys, offsets)
         attn = attn_fn(q, k, v, k_pool, v_pool)
-        hidden = hidden + (attn.reshape(T, -1) @ lp["wo"])
+        hidden = hidden + qlinear(attn.reshape(T, -1), lp["wo"])
 
         # sparse MoE sublayer
         h = rms_norm(hidden, lp["post_norm"], c.rms_norm_eps)

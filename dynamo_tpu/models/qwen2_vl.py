@@ -70,9 +70,11 @@ class Qwen2VLModel(LlamaModel):
     def is_multimodal(self) -> bool:
         return True
 
-    def init_params(self, rng: jax.Array) -> dict:
+    def init_params(self, rng: jax.Array, quantize: bool = True) -> dict:
+        # only the text layers quantize (LlamaModel.QUANT_WEIGHT_NAMES); the
+        # vision tower is prefill-only and stays full precision
         k_text, k_vis = jax.random.split(rng)
-        params = super().init_params(k_text)
+        params = super().init_params(k_text, quantize=quantize)
         params["vision"] = self.vision.init_params(k_vis)
         return params
 

@@ -51,28 +51,38 @@ def is_tiny_family(model_id) -> bool:
 _cacheable = is_tiny_family
 
 
-def load_model(model_id: str, seed: int = 0):
+def load_model(model_id: str, seed: int = 0, quantize: str | None = None):
     """Returns (model, params); for tiny-family models params may be shared
-    with other engines in this process — treat as immutable."""
+    with other engines in this process — treat as immutable.
+
+    ``quantize`` ("int8_wo") applies weight-only quantization at load time —
+    tiny families quantize their random init, checkpoint models quantize in
+    the loader's _finish step. A quantize mode embedded in a tiny:{...}
+    override JSON works too; the explicit argument wins when both are set."""
     global _cache
-    key = (model_id, seed)
+    key = (model_id, seed, quantize)
     entry = _cache
     if entry is not None and entry[0] == key:
         model_cls, cfg, params = entry[1]
         return model_cls(cfg), params  # fresh model object: attn_mesh is per-engine
-    model, params = _load_model_uncached(model_id, seed)
+    model, params = _load_model_uncached(model_id, seed, quantize)
     if _cacheable(model_id):
         _cache = (key, (type(model), model.config, params))
     return model, params
 
 
-def _load_model_uncached(model_id: str, seed: int = 0):
+def _load_model_uncached(model_id: str, seed: int = 0, quantize: str | None = None):
     """Returns (model, params) on host (unsharded); caller places onto mesh."""
+    import dataclasses
+
+    def with_quant(cfg):
+        return dataclasses.replace(cfg, quantize=quantize) if quantize else cfg
+
     if model_id is not None and (model_id == "tiny-moe" or model_id.startswith("tiny-moe:")):
         from dynamo_tpu.models.mixtral import MixtralConfig, MixtralModel
 
         overrides = json.loads(model_id.split(":", 1)[1]) if ":" in model_id else {}
-        cfg = MixtralConfig.tiny_moe(**overrides)
+        cfg = with_quant(MixtralConfig.tiny_moe(**overrides))
         model = MixtralModel(cfg)
         params = jax.jit(lambda key: model.init_params(key))(jax.random.key(seed))
         jax.block_until_ready(params)
@@ -82,7 +92,7 @@ def _load_model_uncached(model_id: str, seed: int = 0):
         from dynamo_tpu.models.deepseek import DeepseekConfig, DeepseekModel
 
         overrides = json.loads(model_id.split(":", 1)[1]) if ":" in model_id else {}
-        cfg = DeepseekConfig.tiny_mla(**overrides)
+        cfg = with_quant(DeepseekConfig.tiny_mla(**overrides))
         model = DeepseekModel(cfg)
         params = jax.jit(lambda key: model.init_params(key))(jax.random.key(seed))
         jax.block_until_ready(params)
@@ -92,7 +102,7 @@ def _load_model_uncached(model_id: str, seed: int = 0):
         from dynamo_tpu.models.qwen2_vl import Qwen2VLConfig, Qwen2VLModel
 
         overrides = json.loads(model_id.split(":", 1)[1]) if ":" in model_id else {}
-        cfg = Qwen2VLConfig.tiny_vl(**overrides)
+        cfg = with_quant(Qwen2VLConfig.tiny_vl(**overrides))
         model = Qwen2VLModel(cfg)
         params = jax.jit(lambda key: model.init_params(key))(jax.random.key(seed))
         jax.block_until_ready(params)
@@ -102,7 +112,7 @@ def _load_model_uncached(model_id: str, seed: int = 0):
         overrides = {}
         if model_id and ":" in model_id:
             overrides = json.loads(model_id.split(":", 1)[1])
-        cfg = LlamaConfig.tiny(**overrides)
+        cfg = with_quant(LlamaConfig.tiny(**overrides))
         model = LlamaModel(cfg)
         # single jitted init: one compile for the whole tree (matters on TPU
         # backends where every compile round-trips a remote-compile service)
@@ -118,26 +128,26 @@ def _load_model_uncached(model_id: str, seed: int = 0):
             from dynamo_tpu.models.loader import load_mixtral_weights
             from dynamo_tpu.models.mixtral import MixtralConfig, MixtralModel
 
-            cfg = MixtralConfig.from_hf_config(hf_cfg)
+            cfg = with_quant(MixtralConfig.from_hf_config(hf_cfg))
             model = MixtralModel(cfg)
             return model, load_mixtral_weights(model, path)
         if "Deepseek" in arch:
             from dynamo_tpu.models.deepseek import DeepseekConfig, DeepseekModel
             from dynamo_tpu.models.loader import load_deepseek_weights
 
-            cfg = DeepseekConfig.from_hf_config(hf_cfg)
+            cfg = with_quant(DeepseekConfig.from_hf_config(hf_cfg))
             model = DeepseekModel(cfg)
             return model, load_deepseek_weights(model, path)
         if "Qwen2VL" in arch or hf_cfg.get("model_type") == "qwen2_vl":
             from dynamo_tpu.models.loader import load_qwen2_vl_weights
             from dynamo_tpu.models.qwen2_vl import Qwen2VLConfig, Qwen2VLModel
 
-            cfg = Qwen2VLConfig.from_hf_config(hf_cfg)
+            cfg = with_quant(Qwen2VLConfig.from_hf_config(hf_cfg))
             model = Qwen2VLModel(cfg)
             return model, load_qwen2_vl_weights(model, path)
         if "Llama" not in arch and "Qwen" not in arch:
             raise ValueError(f"unsupported architecture {arch}")
-        cfg = LlamaConfig.from_hf_config(hf_cfg)
+        cfg = with_quant(LlamaConfig.from_hf_config(hf_cfg))
         model = LlamaModel(cfg)
         from dynamo_tpu.models.loader import load_llama_weights
 

@@ -16,6 +16,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from dynamo_tpu.quant import qlinear_expert
+
 
 def topk_routing(
     router_logits: jnp.ndarray,  # [T, E] float32
@@ -71,10 +73,11 @@ def moe_block(
 
     expert_in = jnp.einsum("tec,td->ecd", dispatch, hidden.astype(jnp.float32))
     expert_in = expert_in.astype(hidden.dtype)
-    # batched expert FFN: [E, C, D] x [E, D, F]
-    gated = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, w_gate))
-    up = jnp.einsum("ecd,edf->ecf", expert_in, w_up)
-    expert_out = jnp.einsum("ecf,efd->ecd", gated * up, w_down)  # [E, C, D]
+    # batched expert FFN: [E, C, D] x [E, D, F] (banks may be weight-only
+    # int8 — qlinear_expert dequantizes into the einsum)
+    gated = jax.nn.silu(qlinear_expert(expert_in, w_gate))
+    up = qlinear_expert(expert_in, w_up)
+    expert_out = qlinear_expert(gated * up, w_down)  # [E, C, D]
 
     out = jnp.einsum("tec,ecd->td", combine, expert_out.astype(jnp.float32))
     return out.astype(hidden.dtype)

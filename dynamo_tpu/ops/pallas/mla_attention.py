@@ -217,17 +217,16 @@ def _mla_lookahead_window(page_size: int, latent: int, itemsize: int) -> int:
     return max(0, min(4, budget // (2 * page_bytes)))
 
 
-@functools.partial(jax.jit, static_argnames=("d_c", "interpret"))
+@functools.partial(jax.jit, static_argnames=("d_c", "lookahead", "interpret"))
 def paged_mla_decode_attention_pallas(
     q_cat: jnp.ndarray,  # [B, H, latent] pre-scaled
     pages: jnp.ndarray,  # [P, ps, latent]
     page_tables: jnp.ndarray,  # [B, max_pages] int32
     positions: jnp.ndarray,  # [B] int32 query positions
     d_c: int,
+    lookahead: bool = False,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    import os
-
     B, H, latent = q_cat.shape
     P, ps, _ = pages.shape
     lengths = positions.astype(jnp.int32) + 1
@@ -236,10 +235,11 @@ def paged_mla_decode_attention_pallas(
     # — within round noise of each other, so the MLA stream keeps the simpler
     # classic double buffer (its one small latent DMA per page pipelines well
     # already); the GQA kernel's +14.7% from cross-program prefetch did NOT
-    # transfer. DYNTPU_DECODE_KERNEL=lookahead opts in for future hardware.
-    W = 0
-    if os.environ.get("DYNTPU_DECODE_KERNEL") == "lookahead":
-        W = _mla_lookahead_window(ps, latent, pages.dtype.itemsize)
+    # transfer. DYNTPU_DECODE_KERNEL=lookahead opts in for future hardware —
+    # resolved by the DISPATCHER (deepseek._mla_decode_pallas) and passed as
+    # a static jit argument: an os.environ read here would freeze into the
+    # first-traced executable per shape (ADVICE r5).
+    W = _mla_lookahead_window(ps, latent, pages.dtype.itemsize) if lookahead else 0
 
     if W >= 1:
         grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -261,6 +261,12 @@ def paged_mla_decode_attention_pallas(
             functools.partial(_kernel_lookahead, page_size=ps, d_c=d_c, lookahead=W),
             out_shape=jax.ShapeDtypeStruct((B, H, d_c), q_cat.dtype),
             grid_spec=grid_spec,
+            # cross-program scratch persistence (program b prefetches b+1's
+            # pages into the opposite parity's slots) requires the grid to run
+            # SERIALLY — pin it rather than relying on the implicit default
+            compiler_params=pltpu.TPUCompilerParams(
+                dimension_semantics=("arbitrary",)
+            ),
             interpret=interpret,
         )
         return kernel(page_tables.astype(jnp.int32), lengths, q_cat, pages)

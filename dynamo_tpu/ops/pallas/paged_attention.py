@@ -533,6 +533,10 @@ def paged_decode_attention_pallas_lookahead(
         functools.partial(_kernel_lookahead, page_size=ps, lookahead=W),
         out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
         grid_spec=grid_spec,
+        # cross-program scratch persistence (program b prefetches b+1's pages
+        # into the opposite parity's slots) requires the grid to run SERIALLY
+        # — pin it rather than relying on the implicit default
+        compiler_params=pltpu.TPUCompilerParams(dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )
     return kernel(page_tables.astype(jnp.int32), lengths, q, k_pages, v_pages)

@@ -104,11 +104,14 @@ def ring_attention(
     if head_axis is None and "tp" in mesh.axis_names:
         head_axis = "tp"
     spec = P(axis) if head_axis is None else P(axis, head_axis)
-    fn = jax.shard_map(
+    # _tp_shard_map handles the jax.shard_map / jax.experimental.shard_map
+    # API split (pre-0.8 jax has no top-level jax.shard_map)
+    from dynamo_tpu.ops.attention import _tp_shard_map
+
+    fn = _tp_shard_map(
         partial(_ring_attention_local, axis_name=axis),
-        mesh=mesh,
+        mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_vma=False,
     )
     return fn(q, k, v)

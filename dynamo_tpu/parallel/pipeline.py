@@ -33,7 +33,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from dynamo_tpu.ops.attention import paged_decode_attention, paged_prefill_attention
+from dynamo_tpu.ops.attention import (
+    _tp_shard_map,
+    paged_decode_attention,
+    paged_prefill_attention,
+)
 
 
 def stage_layer_specs(model, mesh: Mesh, pp_axis: str = "pp"):
@@ -199,11 +203,10 @@ def prefill_pipelined(
     rep = P()
 
     @partial(
-        jax.shard_map,
+        _tp_shard_map,  # jax.shard_map across the pre/post-0.8 API split
         mesh=mesh,
         in_specs=(layer_specs, spec_pool, spec_pool, rep, rep, rep, rep, rep, rep),
         out_specs=(rep, spec_pool, spec_pool),
-        check_vma=False,
     )
     def run(local_layers, kp, vp, hidden_mbs, pos_mbs, phys_mbs, off_mbs, rp_mbs, page_table):
         def run_mb(mc, active, x, kp, vp):
@@ -287,11 +290,10 @@ def prefill_pipelined_ring(
     seq3 = P(None, sp_axis, None)  # [M=1, Tloc, D] rotation outputs
 
     @partial(
-        jax.shard_map,
+        _tp_shard_map,
         mesh=mesh,
         in_specs=(layer_specs, spec_pool, spec_pool, seq2, seq, seq, seq),
         out_specs=(seq3, spec_pool, spec_pool),
-        check_vma=False,
     )
     def run(local_layers, kp, vp, hidden_loc, pos_loc, phys_loc, off_loc):
         def run_mb(mc, active, x, kp, vp):
@@ -369,11 +371,10 @@ def decode_pipelined(
     rep = P()
 
     @partial(
-        jax.shard_map,
+        _tp_shard_map,
         mesh=mesh,
         in_specs=(layer_specs, spec_pool, spec_pool) + (rep,) * 7,
         out_specs=(rep, spec_pool, spec_pool),
-        check_vma=False,
     )
     def run(local_layers, kp, vp, hidden_mbs, pos_mbs, phys_mbs, off_mbs, pt_mbs, act_mbs, rp_mbs):
         def run_mb(mc, pipe_active, x, kp, vp):

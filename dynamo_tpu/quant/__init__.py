@@ -1,0 +1,27 @@
+"""Weight-only quantization for the serving hot path.
+
+``int8_wo`` (the only mode so far): symmetric per-output-channel int8 weights
+with f32 scales, dequantized into the matmul — see dynamo_tpu/quant/int8.py.
+"""
+
+from dynamo_tpu.quant.int8 import (
+    QUANT_MODES,
+    QuantizedLinear,
+    dequantize_int8,
+    qlinear,
+    qlinear_expert,
+    quantize_int8,
+    quantize_shardings_int8,
+    quantize_tree_int8,
+)
+
+__all__ = [
+    "QUANT_MODES",
+    "QuantizedLinear",
+    "dequantize_int8",
+    "qlinear",
+    "qlinear_expert",
+    "quantize_int8",
+    "quantize_shardings_int8",
+    "quantize_tree_int8",
+]

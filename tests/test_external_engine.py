@@ -30,6 +30,11 @@ async def ext_batched_stop(token_ids, sampling, request_id):
     yield 999  # must never be reached
 
 
+async def ext_multi_stop(token_ids, sampling, request_id):
+    """One multi-token item that also declares the natural stop."""
+    yield {"token_ids": [201, 202, 203], "finish_reason": "stop"}
+
+
 async def ext_empty(token_ids, sampling, request_id):
     if False:
         yield 0
@@ -76,6 +81,28 @@ def test_adapter_batched_yield_and_finish_reason():
         outs = await collect(ExternalTokenEngine(ext_empty), [1])
         assert [o.token for o in outs] == [None]
         assert outs[-1].finished
+
+    asyncio.run(run())
+
+
+def test_adapter_truncation_overrides_user_stop_reason():
+    """max_tokens cutting an item MID-delivery is a truncation: the stream
+    must report finish_reason="length" even though the truncated item carried
+    a user finish_reason="stop" (ADVICE r5 regression)."""
+    eng = ExternalTokenEngine("tests.test_external_engine:ext_multi_stop")
+
+    async def run():
+        # the engine yields ONE item {[201, 202, 203], stop}; max_tokens=2
+        # truncates it mid-delivery -> "length", not the item's "stop"
+        outs = await collect(eng, [1], max_tokens=2)
+        assert [o.token for o in outs] == [201, 202]
+        assert outs[-1].finished and outs[-1].finish_reason == "length"
+
+        # max_tokens=3 lands exactly on the item's final token: the item was
+        # fully delivered, so the user's "stop" stands
+        outs = await collect(eng, [1], max_tokens=3)
+        assert [o.token for o in outs] == [201, 202, 203]
+        assert outs[-1].finished and outs[-1].finish_reason == "stop"
 
     asyncio.run(run())
 

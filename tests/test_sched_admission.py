@@ -1,0 +1,92 @@
+"""Admission-order unit tests (no JAX): the max_model_len rejection is pure
+host work and must run BEFORE the per-step fairness-cap break, so an oversized
+prompt at the queue head fails in the same scheduler step instead of stalling
+behind the cap (ADVICE r5)."""
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.page_table import PageAllocator
+from dynamo_tpu.engine.scheduler import EngineRequest, RunningSeq, Scheduler
+
+
+class _StubRunner:
+    """Just enough runner surface for Scheduler._admit's control flow."""
+
+    packed_prefill_mode = False
+
+    def write_token_slots(self, slots, tokens):  # pragma: no cover
+        pass
+
+
+def _scheduler(max_model_len=64, cap=1):
+    cfg = EngineConfig(
+        model_id="tiny", page_size=4, num_pages=64, max_seqs=4,
+        max_model_len=max_model_len, prefill_batches_per_step=cap,
+    )
+    alloc = PageAllocator(cfg.num_pages, cfg.page_size)
+    return Scheduler(cfg, _StubRunner(), alloc)
+
+
+def _occupy_decode_slot(sched):
+    """A running decode sequence (prefill done) makes the fairness cap bind."""
+    seq = RunningSeq(
+        req=EngineRequest("running", [1, 2, 3]), slot=0, prompt_len=3,
+        cached_len=0, prefill_pos=None,
+    )
+    sched.slots[0] = seq
+    return seq
+
+
+def test_oversized_prompt_rejected_before_fairness_cap(monkeypatch):
+    sched = _scheduler(max_model_len=8, cap=1)
+    _occupy_decode_slot(sched)
+
+    # admission itself stubbed out: this test is about _admit's ORDERING, not
+    # the prefill dispatch it triggers
+    started = []
+
+    def fake_start(req, slot):
+        sched.slots[slot] = RunningSeq(
+            req=req, slot=slot, prompt_len=len(req.token_ids), cached_len=0,
+            prefill_pos=None,
+        )
+        started.append(req.request_id)
+
+    monkeypatch.setattr(sched, "_start_sequence", fake_start)
+
+    sched.add_request(EngineRequest("ok-1", [1] * 4))
+    sched.add_request(EngineRequest("too-long", [1] * 99))  # > max_model_len
+    sched.add_request(EngineRequest("ok-2", [1] * 4))
+
+    outputs = sched._admit()
+
+    # ok-1 consumed the per-step cap; the oversized request must STILL fail in
+    # this same step (pure rejection, no chip work), leaving ok-2 to wait
+    assert started == ["ok-1"]
+    errors = [o for o in outputs if o.finish_reason == "error"]
+    assert [o.request_id for o in errors] == ["too-long"]
+    assert [r.request_id for r in sched.waiting] == ["ok-2"]
+
+
+def test_oversized_rejection_does_not_consume_the_cap(monkeypatch):
+    sched = _scheduler(max_model_len=8, cap=1)
+    _occupy_decode_slot(sched)
+    started = []
+
+    def fake_start(req, slot):
+        sched.slots[slot] = RunningSeq(
+            req=req, slot=slot, prompt_len=len(req.token_ids), cached_len=0,
+            prefill_pos=None,
+        )
+        started.append(req.request_id)
+
+    monkeypatch.setattr(sched, "_start_sequence", fake_start)
+
+    # oversized at the HEAD: rejected immediately, and the request behind it
+    # still gets this step's one capped start
+    sched.add_request(EngineRequest("too-long", [1] * 99))
+    sched.add_request(EngineRequest("ok-1", [1] * 4))
+
+    outputs = sched._admit()
+    assert [o.request_id for o in outputs if o.finish_reason == "error"] == ["too-long"]
+    assert started == ["ok-1"]
+    assert not sched.waiting
