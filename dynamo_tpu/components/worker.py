@@ -185,6 +185,7 @@ async def _main(args) -> None:
             max_seqs=args.max_seqs,
             max_model_len=args.max_model_len,
             quantize=getattr(args, "quantize", None),
+            speculative=getattr(args, "speculative", None),
         ),
         enable_disagg_decode=args.disagg,
     )
@@ -218,6 +219,9 @@ def main(argv=None) -> None:
     p.add_argument("--max-model-len", type=int, default=2048)
     p.add_argument("--quantize", choices=["int8_wo"], default=None,
                    help="weight-only quantization applied at load time")
+    p.add_argument("--speculative", default=None, metavar="ngram:k",
+                   help="speculative decoding: n-gram draft proposals + "
+                        "batched multi-token verification (e.g. ngram:4)")
     p.add_argument("--disagg", action="store_true", help="wrap in the disagg decode path")
     args = p.parse_args(argv)
     asyncio.run(_main(args))

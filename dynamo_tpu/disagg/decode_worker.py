@@ -143,7 +143,7 @@ class DisaggDecodeEngine:
             request.images
             or request.logprobs is not None
             or request.sampling.needs_penalties
-            or request.sampling.seed
+            or request.sampling.seed is not None
             or request.sampling.min_p > 0  # remote wire carries no min_p
             # ...nor EOS suppression state for min_tokens' first token
             or (

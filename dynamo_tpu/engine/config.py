@@ -36,6 +36,14 @@ class EngineConfig:
     #               HBM stream the decode roofline is made of
     #               (dynamo_tpu/quant/int8.py).
     quantize: str | None = None
+    # speculative decoding ("ngram:k", e.g. "ngram:4"): the scheduler proposes
+    # k draft tokens per sequence from its own prompt+output history
+    # (prompt-lookup) and verifies all of them plus one bonus token in ONE
+    # multi-query forward pass, advancing 1..k+1 tokens per round with no
+    # quality change (dynamo_tpu/spec/). None = classic one-token decode.
+    # Requests with penalties, logprobs, min_tokens, or images fall back to
+    # the classic decode windows automatically.
+    speculative: str | None = None
     worker_id: str = "worker-0"
     # fraction of pages that must stay free for decode growth before admitting
     # a new sequence (simple admission control)
@@ -88,6 +96,15 @@ class EngineConfig:
                 raise ValueError(
                     f"quantize must be None or one of {QUANT_MODES}; got {self.quantize!r}"
                 )
+        # a bad speculative spec must fail at config time, not mid-serving
+        self.spec  # noqa: B018 — parse_speculative raises on invalid input
+
+    @property
+    def spec(self):
+        """Parsed SpecConfig for ``speculative`` (None when disabled)."""
+        from dynamo_tpu.spec import parse_speculative
+
+        return parse_speculative(self.speculative)
 
     @property
     def max_pages_per_seq(self) -> int:

@@ -437,11 +437,25 @@ class AsyncJaxEngine:
         offload = getattr(self, "offload", None)
         if offload is not None:
             stage_seconds["kv_offload"] = offload.transfer_s
+        st = self.scheduler.stage
+        if st.spec_rounds:
+            stage_seconds["spec_verify"] = st.spec_dispatch_s
         parts.append(render_family(
             "dynamo_engine_stage_seconds_total", "counter",
             "cumulative engine-thread seconds attributed to each stage",
             [({"stage": k}, v) for k, v in sorted(stage_seconds.items())],
         ))
+        if self.config.speculative is not None:
+            parts.append(render_family(
+                "dynamo_spec_proposed_total", "counter",
+                "draft tokens proposed by the speculative proposer",
+                [({}, st.spec_proposed)],
+            ))
+            parts.append(render_family(
+                "dynamo_spec_accepted_total", "counter",
+                "proposed draft tokens accepted by batched verification",
+                [({}, st.spec_accepted)],
+            ))
         return "".join(parts)
 
     def _on_kv_event(self, event: KvCacheEvent) -> None:

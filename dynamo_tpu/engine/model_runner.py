@@ -29,7 +29,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dynamo_tpu.engine.config import EngineConfig
-from dynamo_tpu.engine.sampling import MAX_EOS_IDS, SamplingParams, apply_penalties, fold_seed, sample_tokens, sample_tokens_with_logprobs
+from dynamo_tpu.engine.sampling import MAX_EOS_IDS, SamplingParams, accept_speculative, apply_penalties, fold_seed, sample_tokens, sample_tokens_with_logprobs
 from dynamo_tpu.utils import get_logger
 
 log = get_logger("engine.runner")
@@ -234,6 +234,11 @@ class ModelRunner:
             self._decode_window_impl, donate_argnums=(1, 2),
             static_argnames=("num_steps", "want_lp", "want_pen", "want_seed", "want_eos_mask"),
         )
+        # speculative verify step (spec subsystem): ONE trace regardless of
+        # sampling features — seeds/filters are neutral-input no-ops, and
+        # penalties/logprobs requests never ride this path (the scheduler
+        # routes them through classic windows)
+        self._verify = jax.jit(self._verify_impl, donate_argnums=(1,))
         def _write_tokens_impl(st, idx, vals):
             return dict(st, tokens=st["tokens"].at[idx].set(vals, mode="drop"))
 
@@ -475,7 +480,7 @@ class ModelRunner:
             flts[4, j] = sampling.frequency_penalty
             flts[5, j] = sampling.repetition_penalty
             want_extras = want_extras or want_eos or (
-                is_final and (sampling.needs_penalties or bool(sampling.seed))
+                is_final and (sampling.needs_penalties or sampling.seed is not None)
             )
         # pad lanes: n=0 (valid all-False), start 0, page table 0 (every read
         # lands in the in-bounds trash page — the V fill would DMA out of the
@@ -616,6 +621,41 @@ class ModelRunner:
         # [num_steps, B] tokens (+ ([num_steps, B], [num_steps, B, K] x2) lp)
         return all_toks, lp, kv, slot_state
 
+    def _verify_impl(self, params, kv, ints, flts, key):
+        """Speculative verify step: every slot feeds its anchor token plus up
+        to K drafts at consecutive positions through the model's multi-query
+        ``verify`` pass, then acceptance runs on device so only the tiny
+        [B, K+1] token matrix and [B] emit counts cross back to the host.
+
+        ``ints`` [5 + (K+1) + max_pages, B] = positions (anchor fed position),
+        active, top_ks, seeds, n_drafts, the K+1 fed-token rows, then the
+        transposed page tables (K is derived from the array shape — one
+        executable per configured k). ``flts`` [3, B] = temps, top_ps, min_ps.
+        Rows beyond a slot's n_drafts scatter their KV to the trash page, so a
+        slot proposing fewer than K drafts never writes past its pages."""
+        mp = self.config.max_pages_per_seq
+        K1 = ints.shape[0] - 5 - mp
+        positions = ints[0]
+        active = ints[1].astype(bool)
+        top_ks = ints[2]
+        seeds = ints[3]
+        n_drafts = ints[4]
+        fed = ints[5 : 5 + K1].T  # [B, K1]
+        page_tables = ints[5 + K1 :].T  # [B, max_pages]
+        temps, top_ps, min_ps = flts[0], flts[1], flts[2]
+        t_idx = jnp.arange(K1, dtype=jnp.int32)
+        pos_mat = positions[:, None] + t_idx[None, :]
+        row_valid = active[:, None] & (t_idx[None, :] <= n_drafts[:, None])
+        logits, kv = self.model.verify(
+            params, kv, fed, pos_mat, page_tables, row_valid
+        )
+        out, n_emit = accept_speculative(
+            logits, fed[:, 1:], n_drafts, key, temps, top_ks, top_ps,
+            min_p=min_ps, seeds=seeds, positions=positions,
+        )
+        n_emit = jnp.where(active, n_emit, 0)
+        return out, n_emit, kv
+
     # ---------------- host API (engine thread) ----------------
 
     def _next_key(self) -> jax.Array:
@@ -662,7 +702,7 @@ class ModelRunner:
         ints[bucket + mp + 3] = slot if (sample and slot >= 0) else self.config.max_seqs
         ints[bucket + mp + 4] = fold_seed(sampling.seed) if sampling is not None else 0
         want_pen = sampling is not None and sampling.needs_penalties
-        want_seed = sampling is not None and bool(sampling.seed)
+        want_seed = sampling is not None and sampling.seed is not None
         # min_tokens >= 1: the first sampled token (generation #1) must not be
         # EOS -> suppress the request's EOS logits on device. Matches vLLM:
         # EOS is suppressed while generated < min_tokens, so min_tokens=1
@@ -935,6 +975,52 @@ class ModelRunner:
             pass
         return (toks, lp) if want_logprobs else toks
 
+    def dispatch_verify(
+        self,
+        positions: np.ndarray,  # [B] anchor fed position per slot
+        page_tables: np.ndarray,  # [B, max_pages_per_seq]
+        active: np.ndarray,  # [B] bool
+        fed_tokens: np.ndarray,  # [B, K+1] anchor + (padded) draft tokens
+        n_drafts: np.ndarray,  # [B] real draft count per slot
+        temps: np.ndarray,
+        top_ks: np.ndarray,
+        top_ps: np.ndarray,
+        min_ps: np.ndarray | None = None,
+        seeds: np.ndarray | None = None,  # [B] int32 (0 = unseeded)
+    ):
+        """Dispatch one speculative verify pass; returns the (tokens [B, K+1],
+        n_emit [B]) device arrays with async host copies already started. The
+        caller materializes both (the proposer needs the accepted tokens
+        before it can draft the next round, so verify rounds are synchronous
+        per slot — the win is k+1 tokens per weight pass, not dispatch-ahead)."""
+        B = positions.shape[0]
+        K1 = fed_tokens.shape[1]
+        ints = np.empty((5 + K1 + page_tables.shape[1], B), np.int32)
+        ints[0] = positions
+        ints[1] = active
+        ints[2] = top_ks
+        ints[3] = seeds if seeds is not None else 0
+        ints[4] = n_drafts
+        ints[5 : 5 + K1] = fed_tokens.T
+        ints[5 + K1 :] = page_tables.T
+        flts = np.empty((3, B), np.float32)
+        flts[0] = temps
+        flts[1] = top_ps
+        flts[2] = min_ps if min_ps is not None else 0.0
+        out, n_emit, self.kv_cache = self._verify(
+            self.params,
+            self.kv_cache,
+            jnp.asarray(ints),
+            jnp.asarray(flts),
+            self._next_key(),
+        )
+        try:
+            out.copy_to_host_async()
+            n_emit.copy_to_host_async()
+        except Exception:
+            pass
+        return out, n_emit
+
     def warmup(self) -> None:
         """Pre-compile every trace variant synchronously (core + extras)."""
         import time as _time
@@ -996,6 +1082,17 @@ class ModelRunner:
             sh["temps"], sh["zeros_i"], sh["ones_f"], K,
         )
         jax.block_until_ready(out)
+        spec = self.config.spec
+        if spec is not None:
+            # one verify executable per configured k (all slots inactive, KV
+            # rows land on the trash page — harmless, compiles the trace)
+            B = self.config.max_seqs
+            out = self.dispatch_verify(
+                sh["zeros_i"], sh["pt"], sh["inactive"],
+                np.zeros((B, spec.k + 1), np.int32), sh["zeros_i"],
+                sh["temps"], sh["zeros_i"], sh["ones_f"],
+            )
+            jax.block_until_ready(out)
         for b in self.config.prefill_buckets:
             if not self.packed_prefill_mode:
                 self.prefill_chunk(

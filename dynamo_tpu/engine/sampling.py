@@ -19,9 +19,11 @@ MAX_EOS_IDS = 8  # per-slot EOS ids carried on device for min_tokens masking
 
 def fold_seed(seed) -> int:
     """Any user seed (64-bit, negative, ...) -> nonzero int31 device seed;
-    0 stays 0 (= unseeded). One folding used by prefill AND decode so a
-    request's stream is consistent across both."""
-    if not seed:
+    only ``None`` maps to 0 (= unseeded). One folding used by prefill AND
+    decode so a request's stream is consistent across both. ``seed=0`` is a
+    real seed (it folds to 1): a user asking for seed 0 gets the same
+    deterministic stream every run, not the engine's shared key stream."""
+    if seed is None:
         return 0
     return (int(seed) % 0x7FFFFFFE) + 1
 
@@ -76,6 +78,46 @@ def apply_penalties(
     return logits
 
 
+def filter_keep_mask(
+    logits: jnp.ndarray,  # [B, V] float32
+    temperature: jnp.ndarray,  # [B]
+    top_k: jnp.ndarray,  # [B] int32 (0 = off)
+    top_p: jnp.ndarray,  # [B] (1.0 = off)
+    min_p: jnp.ndarray | None = None,  # [B] (0 = off)
+) -> jnp.ndarray:
+    """[B, V] bool mask of tokens surviving top-k/top-p/min-p, shared by
+    sample_tokens and speculative acceptance so both paths draw from the
+    identical filtered distribution."""
+    B, V = logits.shape
+    temp = jnp.where(temperature > 0, temperature, 1.0)[:, None]
+    # Sort once (descending); top-k and top-p become rank/cdf thresholds.
+    sorted_logits = -jnp.sort(-logits, axis=-1)  # [B, V] descending
+
+    # top-k: keep entries with logit >= k-th largest value
+    k = jnp.where(top_k > 0, jnp.clip(top_k, 1, V), V)
+    kth_value = jnp.take_along_axis(sorted_logits, (k - 1)[:, None], axis=-1)
+    keep_k = logits >= kth_value
+
+    # top-p: over the sorted distribution (temperature-scaled), keep the
+    # prefix whose cumulative probability is < p (always keeping the first)
+    sorted_probs = jax.nn.softmax(sorted_logits / temp, axis=-1)
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    sorted_keep = (cum - sorted_probs) < top_p[:, None]  # prefix incl. first
+    num_keep = jnp.maximum(jnp.sum(sorted_keep, axis=-1), 1)
+    p_value = jnp.take_along_axis(sorted_logits, (num_keep - 1)[:, None], axis=-1)
+    keep_p = logits >= p_value
+
+    keep = keep_k & keep_p
+    if min_p is not None:
+        # keep tokens whose (tempered) prob >= min_p * max prob: in logit
+        # space, logit/temp >= max/temp + log(min_p)
+        max_l = jnp.max(logits, axis=-1, keepdims=True)
+        thresh = max_l / temp + jnp.log(jnp.maximum(min_p, 1e-10))[:, None]
+        keep_m = (logits / temp) >= thresh
+        keep = keep & jnp.where(min_p[:, None] > 0, keep_m, True)
+    return keep
+
+
 def sample_tokens(
     logits: jnp.ndarray,  # [B, V] float32
     key: jax.Array,
@@ -115,31 +157,7 @@ def sample_tokens(
         )(keys, masked / temp).astype(jnp.int32)
 
     def filtered():
-        # Sort once (descending); top-k and top-p become rank/cdf thresholds.
-        sorted_logits = -jnp.sort(-logits, axis=-1)  # [B, V] descending
-
-        # top-k: keep entries with logit >= k-th largest value
-        k = jnp.where(top_k > 0, jnp.clip(top_k, 1, V), V)
-        kth_value = jnp.take_along_axis(sorted_logits, (k - 1)[:, None], axis=-1)
-        keep_k = logits >= kth_value
-
-        # top-p: over the sorted distribution (temperature-scaled), keep the
-        # prefix whose cumulative probability is < p (always keeping the first)
-        sorted_probs = jax.nn.softmax(sorted_logits / temp, axis=-1)
-        cum = jnp.cumsum(sorted_probs, axis=-1)
-        sorted_keep = (cum - sorted_probs) < top_p[:, None]  # prefix incl. first
-        num_keep = jnp.maximum(jnp.sum(sorted_keep, axis=-1), 1)
-        p_value = jnp.take_along_axis(sorted_logits, (num_keep - 1)[:, None], axis=-1)
-        keep_p = logits >= p_value
-
-        keep = keep_k & keep_p
-        if min_p is not None:
-            # keep tokens whose (tempered) prob >= min_p * max prob: in logit
-            # space, logit/temp >= max/temp + log(min_p)
-            max_l = jnp.max(logits, axis=-1, keepdims=True)
-            thresh = max_l / temp + jnp.log(jnp.maximum(min_p, 1e-10))[:, None]
-            keep_m = (logits / temp) >= thresh
-            keep = keep & jnp.where(min_p[:, None] > 0, keep_m, True)
+        keep = filter_keep_mask(logits, temperature, top_k, top_p, min_p=min_p)
         return draw(jnp.where(keep, logits, _NEG_INF))
 
     # Runtime-gated fast paths (lax.cond executes one branch on TPU): the
@@ -186,3 +204,122 @@ def sample_tokens_with_logprobs(
     chosen = jnp.take_along_axis(logprobs, tokens[:, None].astype(jnp.int32), -1)[:, 0]
     top_vals, top_ids = jax.lax.top_k(logprobs, LOGPROBS_K)
     return tokens, chosen, top_ids.astype(jnp.int32), top_vals
+
+
+def accept_speculative(
+    logits: jnp.ndarray,  # [B, K+1, V] float32; row i predicts position p+i+1
+    drafts: jnp.ndarray,  # [B, K] int32 proposed tokens (pad rows arbitrary)
+    n_drafts: jnp.ndarray,  # [B] int32 real drafts per slot (<= K)
+    key: jax.Array,
+    temperature: jnp.ndarray,  # [B]
+    top_k: jnp.ndarray,  # [B] int32 (0 = off)
+    top_p: jnp.ndarray,  # [B] (1.0 = off)
+    min_p: jnp.ndarray | None = None,  # [B] (0 = off)
+    seeds: jnp.ndarray | None = None,  # [B] int32, 0 = unseeded
+    positions: jnp.ndarray | None = None,  # [B] anchor fed position per slot
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Speculative acceptance over one verify pass: (tokens [B, K+1], n_emit [B]).
+
+    A slot's verify pass fed [t_p, d_1..d_K] at positions p..p+K, so
+    ``logits[:, i]`` is the target distribution for the token at position
+    p+i+1 conditioned on a correct prefix through d_i. Per slot the caller
+    emits ``tokens[:n_emit]``; drafts beyond the first rejection are dead
+    (their KV is overwritten by the next pass at the new anchor).
+
+    Greedy slots (temperature <= 0): a draft is accepted iff it equals the
+    raw-logits argmax, so the emitted chain is token-identical to the
+    non-speculative engine; ``tokens`` are the argmax rows themselves
+    (accepted drafts == their argmax; the row after the last acceptance is
+    the correction/bonus token).
+
+    Sampling slots: distribution-exact rejection sampling (Leviathan et al.)
+    against the degenerate (one-hot) n-gram proposal: accept d_i with
+    probability min(1, p(d_i)); on rejection resample from p with d_i removed
+    (the residual distribution max(0, p - q) renormalized); when every draft
+    is accepted, the bonus token samples from the last row unmodified. p is
+    the FULL filtered distribution (temperature/top-k/top-p/min-p) via
+    filter_keep_mask, so the emitted marginal matches sample_tokens exactly.
+    Seeded slots draw from a (seed, position, row) stream — deterministic
+    across retries and batch composition, but a distinct stream from the
+    non-speculative sampler's (only the distribution is guaranteed equal).
+    """
+    B, K1, V = logits.shape
+    K = K1 - 1
+    flat = logits.reshape(B * K1, V)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K1] raw argmax
+    draft_valid = jnp.arange(K, dtype=jnp.int32)[None, :] < n_drafts[:, None]
+
+    # greedy acceptance: count of leading argmax matches among real drafts
+    g_match = (greedy[:, :K] == drafts) & draft_valid
+    g_acc = jnp.cumprod(g_match.astype(jnp.int32), axis=1).sum(axis=1)  # [B]
+
+    # target distribution: identical filtering to sample_tokens, per row
+    def per_row(a):  # [B] -> [B*K1] slot params broadcast over rows
+        return jnp.repeat(a, K1)
+
+    temps_r = per_row(temperature)
+    keep = filter_keep_mask(
+        flat, temps_r, per_row(top_k), per_row(top_p),
+        min_p=None if min_p is None else per_row(min_p),
+    )
+    temp_r = jnp.where(temps_r > 0, temps_r, 1.0)[:, None]
+    probs = jax.nn.softmax(
+        jnp.where(keep, flat, _NEG_INF) / temp_r, axis=-1
+    ).reshape(B, K1, V)
+    p_draft = jnp.take_along_axis(
+        probs[:, :K], drafts[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]  # [B, K]
+
+    # per-(slot, row) keys: seeded slots fold (seed, anchor position, row) off
+    # a fixed base so their stream ignores batch placement; unseeded fold the
+    # slot index off this round's engine key (same scheme as sample_tokens)
+    base = jax.random.key(0x5EC5)
+    pos = positions if positions is not None else jnp.zeros(B, jnp.int32)
+    sd = seeds if seeds is not None else jnp.zeros(B, jnp.int32)
+
+    def slot_key(i, seed, p):
+        seeded = jax.random.fold_in(jax.random.fold_in(base, seed), p)
+        unseeded = jax.random.fold_in(key, i)
+        return jax.lax.cond(seed != 0, lambda: seeded, lambda: unseeded)
+
+    slot_keys = jax.vmap(slot_key)(jnp.arange(B, dtype=jnp.int32), sd, pos)
+    rows = jnp.arange(K1, dtype=jnp.int32)
+    row_keys = jax.vmap(
+        lambda k_: jax.vmap(lambda t: jax.random.fold_in(k_, t))(rows)
+    )(slot_keys)  # [B, K1] keys
+
+    # rejection test per draft row (computed in parallel; the cumprod makes
+    # acceptance stop at the first rejection, matching the sequential rule)
+    u = jax.vmap(jax.vmap(lambda k_: jax.random.uniform(jax.random.fold_in(k_, 0))))(
+        row_keys[:, :K]
+    )
+    s_match = (u < p_draft) & draft_valid
+    s_acc = jnp.cumprod(s_match.astype(jnp.int32), axis=1).sum(axis=1)  # [B]
+
+    a = jnp.where(temperature > 0, s_acc, g_acc)  # [B] accepted drafts
+
+    # final token: row a's filtered logits; on a rejection (a < n_drafts) the
+    # rejected draft is removed — the residual max(0, p - q) for a one-hot q
+    b_idx = jnp.arange(B)
+    row_logits = jnp.where(keep, flat, _NEG_INF).reshape(B, K1, V)[b_idx, a]
+    rejected = a < n_drafts
+    d_rej = jnp.take_along_axis(
+        drafts, jnp.clip(a, 0, max(K - 1, 0))[:, None], axis=1
+    )[:, 0]
+    row_logits = row_logits.at[b_idx, d_rej].add(
+        jnp.where(rejected, _NEG_INF, 0.0)
+    )
+    final_keys = jax.vmap(lambda k_: jax.random.fold_in(k_, 1))(row_keys[b_idx, a])
+    final = jax.vmap(
+        lambda k_, row, t: jax.random.categorical(k_, row / jnp.where(t > 0, t, 1.0))
+    )(final_keys, row_logits, temperature).astype(jnp.int32)
+
+    drafts_pad = jnp.concatenate(
+        [drafts.astype(jnp.int32), jnp.zeros((B, 1), jnp.int32)], axis=1
+    )
+    out_sampled = jnp.where(
+        jnp.arange(K1, dtype=jnp.int32)[None, :] < a[:, None], drafts_pad,
+        final[:, None],
+    )
+    out = jnp.where(temperature[:, None] > 0, out_sampled, greedy)
+    return out, a + 1

@@ -38,6 +38,7 @@ import numpy as np
 from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.engine.page_table import PageAllocator
 from dynamo_tpu.engine.sampling import MAX_EOS_IDS, SamplingParams, fold_seed
+from dynamo_tpu.spec import make_proposer
 from dynamo_tpu.utils import get_logger, tracing
 from dynamo_tpu.utils.prometheus import Histogram
 
@@ -101,6 +102,12 @@ class RunningSeq:
     # packed-prefill progress: next chunk start, or None when all chunks are
     # dispatched (decode windows only pick up seqs with prefill_pos None)
     prefill_pos: Optional[int] = None
+    # speculative decoding: True = this sequence advances via verify rounds
+    # (spec-eligible request on a spec-enabled engine); False = classic
+    # dispatch-ahead decode windows. Fixed at admission so a sequence never
+    # switches mid-stream between the sync (materialized) and dispatch-ahead
+    # (scheduled) position-tracking regimes.
+    spec_mode: bool = False
 
     @property
     def pos(self) -> int:
@@ -175,9 +182,18 @@ class StageStats:
     reconcile_waits: int = 0
     ttft_s: float = 0.0  # submission -> first materialized token
     ttft_n: int = 0
+    # speculative decoding (spec rounds are synchronous verify passes, so
+    # dispatch + device sync land in one number): draft tokens proposed,
+    # drafts accepted by verification, and tokens actually emitted (accepted
+    # + the per-round correction/bonus token)
+    spec_rounds: int = 0
+    spec_dispatch_s: float = 0.0
+    spec_proposed: int = 0
+    spec_accepted: int = 0
+    spec_emitted: int = 0
 
     def snapshot(self) -> dict:
-        return {
+        snap = {
             "queue_wait_s": round(self.queue_wait_s, 4),
             "queue_wait_n": self.queue_wait_n,
             "prefill_s": round(self.prefill_s, 4),
@@ -191,6 +207,18 @@ class StageStats:
             "ttft_s": round(self.ttft_s, 4),
             "ttft_n": self.ttft_n,
         }
+        if self.spec_rounds:
+            snap.update(
+                spec_rounds=self.spec_rounds,
+                spec_dispatch_s=round(self.spec_dispatch_s, 4),
+                spec_proposed=self.spec_proposed,
+                spec_accepted=self.spec_accepted,
+                spec_emitted=self.spec_emitted,
+                spec_acceptance_rate=round(
+                    self.spec_accepted / max(1, self.spec_proposed), 4
+                ),
+            )
+        return snap
 
 
 # bucket ladders for the engine-stage histograms: queue wait and TTFT reach
@@ -228,6 +256,14 @@ def _stage_histograms() -> dict[str, Histogram]:
             "host time blocked waiting on in-flight device results",
             _STAGE_BUCKETS,
         ),
+        # per-round acceptance: how many draft tokens each participating
+        # request had accepted in one speculative verify round (0 = only the
+        # correction token advanced; k = the whole proposal held)
+        "spec_accept": Histogram(
+            "dynamo_spec_accepted_per_round",
+            "draft tokens accepted per request per speculative verify round",
+            (0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0),
+        ),
     }
 
 
@@ -253,6 +289,10 @@ class Scheduler:
         # Prometheus histograms (rendered by the worker's /metrics)
         self.stage = StageStats()
         self.stage_hist = _stage_histograms()
+        # speculative decoding: parsed config + the draft proposer (history
+        # in, <= k token ids out). None when --speculative is unset.
+        self.spec = config.spec
+        self.proposer = make_proposer(self.spec) if self.spec is not None else None
 
     # ---------------- queue ----------------
 
@@ -295,6 +335,8 @@ class Scheduler:
         outputs.extend(self._reconcile(block=False))
         outputs.extend(self._admit())
         dispatched = self._dispatch_prefill_batches(outputs)
+        if self.spec is not None:
+            dispatched += self._dispatch_spec_round(outputs)
         dispatched += self._dispatch_windows(outputs)
         pipeline_full = self._windows_in_flight() >= max(1, self.config.pipeline_depth)
         if pipeline_full or (self.in_flight and not dispatched and not outputs):
@@ -411,6 +453,7 @@ class Scheduler:
             page_table=page_table,
             admitted_order=self._admit_counter,
             sched_len=1,  # the prefill's sampled token enters the timeline now
+            spec_mode=self._spec_eligible(req),
         )
         self._admit_counter += 1
 
@@ -696,6 +739,7 @@ class Scheduler:
             page_table=page_table,
             admitted_order=self._admit_counter,
             sched_len=1,
+            spec_mode=self._spec_eligible(req),
         )
         self._admit_counter += 1
         slot = self._free_slot()
@@ -708,6 +752,161 @@ class Scheduler:
         else:
             self.adopted_waiting.append(seq)
         return self._emit_token(seq, first_token, cached=cached_len)
+
+    # ---------------- speculative decode (spec rounds) ----------------
+
+    def _spec_eligible(self, req: EngineRequest) -> bool:
+        """Spec-mode eligibility, fixed at admission: penalties and logprobs
+        need the window path's per-slot device state, min_tokens needs its
+        EOS masking, and image requests carry M-RoPE deltas the verify pass
+        doesn't model — all of those ride classic decode windows instead
+        (correct, just not speculated)."""
+        if self.spec is None:
+            return False
+        s = req.sampling
+        return (
+            not req.images
+            and req.logprobs is None
+            and not s.needs_penalties
+            and s.min_tokens <= 0
+        )
+
+    def _dispatch_spec_round(self, outputs: list[StepOutput]) -> int:
+        """One speculative verify round over every spec-mode decode slot.
+
+        Per slot: propose up to k draft tokens from the sequence's own
+        prompt+output history, feed [anchor, drafts...] at consecutive fed
+        positions through ONE multi-query verify pass, and emit the accepted
+        prefix plus the correction/bonus token (1..k+1 tokens). Rounds are
+        synchronous — the next proposal needs this round's accepted tokens —
+        so the host tracks materialized positions exactly; KV written for
+        rejected drafts is overwritten by the next round at the advanced
+        anchor. Returns 1 when a round ran (the step loop's dispatch count)."""
+        K = self.spec.k
+        candidates = []
+        for seq in sorted(
+            [s for s in self.slots if s is not None], key=lambda s: s.admitted_order
+        ):
+            if (
+                seq.finished
+                or not seq.spec_mode
+                or seq.prefill_pos is not None
+                or not seq.generated  # first token still in flight
+            ):
+                continue
+            budget = seq.req.sampling.max_tokens - len(seq.generated)
+            p = seq.prompt_len + len(seq.generated) - 1  # anchor fed position
+            if budget <= 0 or p >= self.config.max_model_len:
+                continue
+            max_d = min(K, budget - 1, self.config.max_model_len - 1 - p)
+            drafts = (
+                self.proposer.propose(seq.req.token_ids + seq.generated, max_d)
+                if max_d > 0
+                else []
+            )
+            # page capacity for the fed rows (anchor..anchor+len(drafts));
+            # same pressure ladder as the window path: drain the pipeline,
+            # then preempt, then shrink the proposal to the allocated pages
+            need = p + len(drafts) + 1
+            while self.slots[seq.slot] is seq and not self.allocator.ensure_capacity(
+                seq.req.request_id, need
+            ):
+                if self.in_flight:
+                    self.pressure_drain_count += 1
+                    outputs.extend(self._reconcile(block=True, drain=True))
+                    continue
+                victim = self._pick_victim(exclude=seq)
+                if victim is None:
+                    cap = self.allocator._seqs[seq.req.request_id].num_pages * \
+                        self.config.page_size
+                    if cap > p:
+                        drafts = drafts[: cap - 1 - p]
+                        break
+                    outputs.extend(self._finish(seq, "error"))
+                    break
+                self._preempt(victim)
+            if self.slots[seq.slot] is not seq or seq.finished:
+                continue
+            state = self.allocator._seqs[seq.req.request_id]
+            seq.page_table[: len(state.pages)] = state.pages
+            candidates.append((seq, p, drafts))
+        # a later candidate's page-pressure preemption can evict an earlier
+        # one mid-pass; only still-live slots ride the verify call
+        candidates = [
+            (s, p, d) for s, p, d in candidates
+            if not s.finished and self.slots[s.slot] is s
+        ]
+        if not candidates:
+            return 0
+
+        B = self.config.max_seqs
+        positions = np.zeros(B, np.int32)
+        page_tables = np.zeros((B, self.config.max_pages_per_seq), np.int32)
+        active = np.zeros(B, bool)
+        fed = np.zeros((B, K + 1), np.int32)
+        n_drafts = np.zeros(B, np.int32)
+        temps = np.zeros(B, np.float32)
+        top_ks = np.zeros(B, np.int32)
+        top_ps = np.ones(B, np.float32)
+        min_ps = np.zeros(B, np.float32)
+        seeds = np.zeros(B, np.int32)
+        snapshot = []
+        for seq, p, drafts in candidates:
+            i = seq.slot
+            positions[i] = p
+            page_tables[i] = seq.page_table
+            active[i] = True
+            fed[i, 0] = seq.generated[-1]
+            if drafts:
+                fed[i, 1 : 1 + len(drafts)] = drafts
+            n_drafts[i] = len(drafts)
+            s = seq.req.sampling
+            temps[i] = s.temperature
+            top_ks[i] = s.top_k
+            top_ps[i] = s.top_p
+            min_ps[i] = s.min_p
+            seeds[i] = fold_seed(s.seed)
+            snapshot.append((seq, i, len(drafts)))
+
+        t0 = time.monotonic()
+        out_dev, n_emit_dev = self.runner.dispatch_verify(
+            positions, page_tables, active, fed, n_drafts, temps, top_ks,
+            top_ps, min_ps=min_ps, seeds=seeds if np.any(seeds) else None,
+        )
+        tokens = np.asarray(out_dev)
+        n_emit = np.asarray(n_emit_dev)
+        dt = time.monotonic() - t0
+        st = self.stage
+        st.spec_rounds += 1
+        st.spec_dispatch_s += dt
+        round_proposed = round_accepted = 0
+        for seq, i, proposed in snapshot:
+            if seq.finished:
+                continue  # EOS/cancel raced in via a drain above
+            emitted = int(n_emit[i])
+            accepted = max(0, emitted - 1)
+            st.spec_proposed += proposed
+            st.spec_accepted += accepted
+            st.spec_emitted += emitted
+            round_proposed += proposed
+            round_accepted += accepted
+            self.stage_hist["spec_accept"].observe(accepted)
+            for j in range(emitted):
+                outputs.extend(self._emit_token(seq, int(tokens[i, j])))
+                if seq.finished:
+                    break  # stop/length mid-chunk: the tail tokens are dead
+        if tracing.enabled():
+            tracing.record_span(
+                "engine.spec.verify", t0, duration=dt,
+                request_id=snapshot[0][0].req.request_id,
+                trace_id=snapshot[0][0].req.trace_id,
+                attrs={
+                    "participants": len(snapshot), "k": K,
+                    "proposed": round_proposed, "accepted": round_accepted,
+                    "requests": [s.req.request_id for s, _, _ in snapshot],
+                },
+            )
+        return 1
 
     # ---------------- pipelined decode ----------------
 
@@ -723,6 +922,8 @@ class Scheduler:
         """Steps this window can run for `seq` before budget/length bounds."""
         if seq.prefill_pos is not None:
             return 0  # prefill chunks still pending; no sampled token yet
+        if seq.spec_mode:
+            return 0  # advances via speculative verify rounds, never windows
         budget = seq.req.sampling.max_tokens - seq.sched_len
         length = self.config.max_model_len - seq.next_fed_pos
         return max(0, min(K, budget, length))

@@ -23,14 +23,30 @@ def run_command(args) -> int:
 
 
 def engine_config_for(args):
+    import json
+
     from dynamo_tpu.engine.config import EngineConfig
     from dynamo_tpu.frontends.pipeline import card_for_model
 
     card = card_for_model(args.model, getattr(args, "max_model_len", None))
     is_tiny = card.model_path.startswith("tiny")
+    model_path = card.model_path
+    speculative = getattr(args, "speculative", None)
+    if is_tiny and ":" in model_path:
+        # engine-level keys may ride the tiny-override JSON (so a single
+        # model string configures a test engine end to end); pop them out
+        # before the registry parses the rest as MODEL config
+        fam, js = model_path.split(":", 1)
+        try:
+            overrides = json.loads(js)
+        except ValueError:
+            overrides = None
+        if isinstance(overrides, dict) and "speculative" in overrides:
+            speculative = speculative or overrides.pop("speculative")
+            model_path = fam + (":" + json.dumps(overrides) if overrides else "")
     if is_tiny:
         return EngineConfig(
-            model_id=card.model_path,
+            model_id=model_path,
             page_size=card.kv_block_size,
             num_pages=getattr(args, "num_pages", None) or 128,
             max_seqs=getattr(args, "max_seqs", None) or 4,
@@ -39,9 +55,10 @@ def engine_config_for(args):
             tp=getattr(args, "tp", None) or 1,
             pp=getattr(args, "pp", None) or 1,
             quantize=getattr(args, "quantize", None),
+            speculative=speculative,
         )
     return EngineConfig(
-        model_id=card.model_path,
+        model_id=model_path,
         page_size=card.kv_block_size,
         num_pages=getattr(args, "num_pages", None) or 2048,
         max_seqs=getattr(args, "max_seqs", None) or 16,
@@ -49,6 +66,7 @@ def engine_config_for(args):
         tp=getattr(args, "tp", None) or 1,
         pp=getattr(args, "pp", None) or 1,
         quantize=getattr(args, "quantize", None),
+        speculative=speculative,
         # serve as soon as the core traces compile; feature variants land in
         # the background (halves cold first-deploy readiness time)
         warmup="background",

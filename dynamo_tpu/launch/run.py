@@ -30,6 +30,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="weight-only quantization applied at load time (int8 weights + "
              "per-channel scales; embeddings/norms stay bf16)",
     )
+    run.add_argument(
+        "--speculative", default=None, metavar="ngram:k",
+        help="speculative decoding: propose k draft tokens per step from the "
+             "sequence's own history (prompt-lookup) and verify them in one "
+             "batched forward pass (e.g. ngram:4)",
+    )
     run.add_argument("--max-tokens", type=int, default=None, help="batch mode default max_tokens")
     # serve/build/deploy are dispatched on argv[0] in main() (their argv is
     # forwarded verbatim — argparse REMAINDER can't capture leading options);

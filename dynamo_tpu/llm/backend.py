@@ -98,13 +98,15 @@ class Backend:
         count = 0
         cached = 0
         # engine windows arrive as StepOutput batches (decode_steps tokens per
-        # thread crossing); one detok + one BackendOutput per batch collapses
-        # the per-token overhead that halved HTTP-level throughput. Engines
+        # thread crossing — and a speculative engine emits whole accepted
+        # chunks); one detok + one BackendOutput per batch collapses the
+        # per-token overhead that halved HTTP-level throughput. Engines
         # without a batched API (echo, remote proxies) stream singletons.
-        # Stop strings keep per-token granularity: a stop can complete
-        # mid-window, and token_ids/usage/logprobs must end AT the matching
-        # token, which only the per-token walk can deliver.
-        if hasattr(self.engine, "generate_batched") and not jail.stops:
+        # Stop strings still ride the batched stream, but scan per token
+        # WITHIN each chunk (see below): a stop can complete on any token of
+        # a multi-token window, and token_ids/usage/logprobs must end AT the
+        # matching token, not at the window boundary.
+        if hasattr(self.engine, "generate_batched"):
             stream = self.engine.generate_batched(engine_req)
         else:
             async def _singletons():
@@ -112,6 +114,12 @@ class Backend:
                     yield [s]
 
             stream = _singletons()
+        if jail.stops:
+            async for out in self._generate_with_stops(
+                request, stream, decoder, jail, eos_ids
+            ):
+                yield out
+            return
         async for steps in stream:
             ids: list[int] = []
             detok_ids: list[int] = []
@@ -167,6 +175,71 @@ class Backend:
                 yield BackendOutput(
                     request_id=request.request_id,
                     text=emit,
+                    token_ids=ids,
+                    cumulative_tokens=count,
+                    cached_tokens=cached,
+                    logprobs=lp_entries,
+                )
+
+    async def _generate_with_stops(
+        self, request, stream, decoder: DecodeStream, jail: _StopJail, eos_ids
+    ) -> AsyncIterator[BackendOutput]:
+        """Stop-string stream: one BackendOutput per engine window, but detok
+        + jail scanning walk token by token WITHIN each multi-token chunk, so
+        a stop sequence completing mid-chunk truncates text, token_ids, and
+        usage at exactly the matching token (never just the newest one)."""
+        count = 0
+        cached = 0
+        async for steps in stream:
+            ids: list[int] = []
+            parts: list[str] = []
+            lp_entries = None
+            finished = False
+            finish_reason = None
+            stopped = False
+            for step in steps:
+                if step.token is not None:
+                    count += 1
+                    ids.append(step.token)
+                    if step.logprob is not None:
+                        if lp_entries is None:
+                            lp_entries = []
+                        lp_entries.append(self._logprob_entry(step))
+                    # suppress eos token text
+                    if step.finish_reason == "stop" and step.token in eos_ids:
+                        piece = None
+                    else:
+                        piece = decoder.step(step.token)
+                    if piece:
+                        emit, stopped = jail.push(piece)
+                        if emit:
+                            parts.append(emit)
+                        if stopped:
+                            break
+                cached = max(cached, step.cached_tokens)
+                if step.finished:
+                    finished = True
+                    finish_reason = step.finish_reason
+                    break
+            if stopped or finished:
+                if finished and not stopped:
+                    # a partial stop prefix at end-of-stream never completed
+                    # the stop sequence: emit it
+                    parts.append(jail.flush())
+                yield BackendOutput(
+                    request_id=request.request_id,
+                    text="".join(parts),
+                    token_ids=ids,
+                    finish_reason="stop" if stopped else finish_reason,
+                    cumulative_tokens=count,
+                    cached_tokens=cached,
+                    logprobs=lp_entries,
+                )
+                return
+            if parts or ids:
+                yield BackendOutput(
+                    request_id=request.request_id,
+                    text="".join(parts),
                     token_ids=ids,
                     cumulative_tokens=count,
                     cached_tokens=cached,

@@ -547,6 +547,7 @@ class DeepseekModel:
         offsets: jnp.ndarray,
         gather_tables: jnp.ndarray,  # [max_pages] or [B, max_pages] flat ids
         moe: bool,
+        verify_T: int = 0,  # >0: B-lane speculative verify, T queries per lane
     ):
         c = self.config
         T = hidden.shape[0]
@@ -555,7 +556,27 @@ class DeepseekModel:
         rows = self._cache_rows(lp, h, positions)
         pool = pool.at[flat_phys, offsets].set(rows)
 
-        if gather_tables.ndim == 1:
+        if verify_T:
+            # speculative verify: each lane attends its own paged context with
+            # verify_T query positions (absorbed-attention reference path; the
+            # chunk is a handful of rows, so the per-lane gather is cheap)
+            Bv = gather_tables.shape[0]
+            ps = pool.shape[1]
+            qn = q_nope.reshape(Bv, verify_T, *q_nope.shape[1:])
+            qr = q_rope.reshape(Bv, verify_T, *q_rope.shape[1:])
+            pos2 = positions.reshape(Bv, verify_T)
+            outs = [
+                self._absorbed_attention(
+                    lp, qn[j], qr[j],
+                    pool[gather_tables[j]].reshape(
+                        gather_tables.shape[1] * ps, c.latent_dim_padded
+                    ),
+                    pos2[j],
+                )
+                for j in range(Bv)
+            ]
+            attn = jnp.concatenate(outs, axis=0)
+        elif gather_tables.ndim == 1:
             if _use_pallas_mla() and T % 128 == 0:
                 attn = self._mla_prefill_pallas(
                     lp, q_nope, q_rope, pool, gather_tables, positions
@@ -618,6 +639,7 @@ class DeepseekModel:
         offsets: jnp.ndarray,
         tables: jnp.ndarray,  # [max_pages] or [B, max_pages] logical ids
         num_pages: int,
+        verify_T: int = 0,
     ):
         c = self.config
         Ld = c.first_k_dense_replace
@@ -629,7 +651,8 @@ class DeepseekModel:
                 h, pl = carry
                 lp, off = xs
                 h, pl = self._layer(
-                    lp, h, pl, positions, off + phys, offsets, off + tables, moe
+                    lp, h, pl, positions, off + phys, offsets, off + tables, moe,
+                    verify_T=verify_T,
                 )
                 return (h, pl), None
 
@@ -680,3 +703,28 @@ class DeepseekModel:
         )
         logits = self._unembed(params, hidden)
         return logits, {"ckv": pool}
+
+    def verify(self, params, kv_cache, tokens, positions, page_tables, valid):
+        """Speculative verification (ModelRunner contract, see
+        LlamaModel.verify): [B, T] anchor+draft tokens at consecutive
+        positions, one weight pass, logits at ALL rows. Latent rows for
+        invalid positions scatter to the trash page; each lane's attention
+        runs the absorbed-MLA reference path against its own page table.
+
+        Returns (logits [B, T, V], updated kv_cache)."""
+        c = self.config
+        pool = kv_cache["ckv"]
+        page_size = pool.shape[1]
+        num_pages = pool.shape[0] // c.num_layers
+        B, T = tokens.shape
+        lane = jnp.arange(B)
+        phys = jnp.where(valid, page_tables[lane[:, None], positions // page_size], 0)
+        offsets = jnp.where(valid, positions % page_size, 0)
+        hidden = params["embed"][tokens.reshape(B * T)].astype(c.dtype)
+        hidden, pool = self._forward(
+            params, pool, hidden, positions.reshape(B * T),
+            phys.reshape(B * T), offsets.reshape(B * T), page_tables, num_pages,
+            verify_T=T,
+        )
+        logits = self._unembed(params, hidden)  # [B*T, V]
+        return logits.reshape(B, T, -1), {"ckv": pool}

@@ -490,6 +490,26 @@ class LlamaModel:
         same-sequence chunks go in consecutive calls, never one call).
 
         Returns (logits [N, V] at each lane's last_idx, updated kv_cache)."""
+        N, T = tokens.shape
+        hidden, kv_cache = self._packed_forward(
+            params, kv_cache, tokens, positions, page_tables, valid
+        )
+        rows = hidden[jnp.arange(N) * T + last_idx]  # [N, D]
+        logits = self._unembed(params, rows)  # [N, V]
+        return logits, kv_cache
+
+    def _packed_forward(
+        self,
+        params: dict,
+        kv_cache: dict,
+        tokens: jnp.ndarray,  # [N, T]
+        positions: jnp.ndarray,  # [N, T]
+        page_tables: jnp.ndarray,  # [N, max_pages]
+        valid: jnp.ndarray,  # [N, T]
+    ) -> tuple[jnp.ndarray, dict]:
+        """Shared N-lane layer stack for prefill_packed and verify: one weight
+        pass over the flattened [N*T] token stream, per-lane paged attention.
+        Returns (hidden [N*T, D], updated kv_cache)."""
         c = self.config
         k_pool, v_pool = kv_cache["k"], kv_cache["v"]
         page_size = k_pool.shape[1]
@@ -531,9 +551,33 @@ class LlamaModel:
             (hidden, k_pool, v_pool),
             (params["layers"], self._layer_offsets(num_pages)),
         )
-        rows = hidden[lane * T + last_idx]  # [N, D]
-        logits = self._unembed(params, rows)  # [N, V]
-        return logits, {"k": k_pool, "v": v_pool}
+        return hidden, {"k": k_pool, "v": v_pool}
+
+    def verify(
+        self,
+        params: dict,
+        kv_cache: dict,  # {"k","v"} flat pools (donated)
+        tokens: jnp.ndarray,  # [B, T] anchor + draft tokens per slot
+        positions: jnp.ndarray,  # [B, T] consecutive fed positions per slot
+        page_tables: jnp.ndarray,  # [B, max_pages] logical page ids per slot
+        valid: jnp.ndarray,  # [B, T] bool (invalid rows -> trash page)
+    ) -> tuple[jnp.ndarray, dict]:
+        """Speculative verification: every slot feeds T = k+1 tokens at
+        consecutive positions through the paged context in ONE weight pass
+        (the multi-query-position generalization of decode — structurally the
+        packed-prefill path with tiny chunks, so causal masking against the
+        page table comes for free) and unembeds ALL rows.
+
+        Returns (logits [B, T, V], updated kv_cache): logits[:, i] is the
+        next-token distribution after the token fed at positions[:, i]. KV
+        rows for invalid/rejected positions land on the trash page or are
+        overwritten by the next pass at the advanced anchor."""
+        B, T = tokens.shape
+        hidden, kv_cache = self._packed_forward(
+            params, kv_cache, tokens, positions, page_tables, valid
+        )
+        logits = self._unembed(params, hidden)  # [B*T, V]
+        return logits.reshape(B, T, -1), kv_cache
 
     def prefill_sp(
         self,

@@ -1,0 +1,75 @@
+"""Speculative decoding subsystem.
+
+Draft-free speculation for the paged engine: a per-sequence ``Proposer``
+guesses up to k continuation tokens from the sequence's own prompt+output
+history (n-gram / prompt-lookup decoding — Saxena et al.; the interface also
+admits a draft-model proposer later), and the engine verifies all k guesses
+plus samples one bonus token in ONE multi-query forward pass against the
+existing page table (Leviathan et al., "Fast Inference from Transformers via
+Speculative Decoding"). Greedy requests advance token-identically to the
+non-speculative engine; temperature>0 requests use distribution-exact
+rejection sampling (engine/sampling.py:accept_speculative).
+
+Config surface: ``EngineConfig.speculative`` / ``--speculative ngram:k``
+parses through :func:`parse_speculative`; the scheduler builds the proposer
+via :func:`make_proposer`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from dynamo_tpu.spec.proposer import NgramProposer, Proposer
+
+__all__ = [
+    "NgramProposer",
+    "Proposer",
+    "SpecConfig",
+    "make_proposer",
+    "parse_speculative",
+]
+
+#: proposer kinds accepted by ``--speculative`` (a draft-model proposer slots
+#: in here without touching the engine: it only has to implement Proposer)
+SPEC_KINDS = ("ngram",)
+
+
+@dataclass(frozen=True)
+class SpecConfig:
+    """Parsed speculative-decoding settings."""
+
+    kind: str = "ngram"
+    k: int = 4  # draft tokens proposed (and verified) per engine round
+    max_ngram: int = 4  # longest history suffix the n-gram proposer matches
+    min_ngram: int = 1  # shortest suffix worth matching
+
+
+def parse_speculative(spec) -> SpecConfig | None:
+    """``None``/"off" -> None; "ngram" / "ngram:4" -> SpecConfig.
+
+    One parser shared by EngineConfig validation, the CLIs, and the runner's
+    warmup so a bad spec string fails at config time, not mid-serving.
+    """
+    if spec is None or isinstance(spec, SpecConfig):
+        return spec
+    s = str(spec).strip()
+    if s in ("", "none", "off"):
+        return None
+    parts = s.split(":")
+    kind = parts[0]
+    if kind not in SPEC_KINDS:
+        raise ValueError(
+            f"unknown speculative kind {kind!r} (supported: {SPEC_KINDS})"
+        )
+    k = 4
+    if len(parts) > 1 and parts[1]:
+        k = int(parts[1])
+    if not 1 <= k <= 16:
+        raise ValueError(f"speculative k must be in [1, 16]; got {k}")
+    return SpecConfig(kind=kind, k=k)
+
+
+def make_proposer(cfg: SpecConfig) -> Proposer:
+    if cfg.kind == "ngram":
+        return NgramProposer(max_ngram=cfg.max_ngram, min_ngram=cfg.min_ngram)
+    raise ValueError(f"no proposer for speculative kind {cfg.kind!r}")

@@ -1,0 +1,70 @@
+"""Draft proposers for speculative decoding.
+
+The engine asks a proposer for up to k likely continuation tokens given a
+sequence's full token history (prompt + generated so far). Proposals are
+free-form guesses: a wrong draft costs only its share of one verification
+pass, never output quality (the verifier accepts/rejects exactly).
+
+``NgramProposer`` implements prompt-lookup decoding (Saxena et al.): match
+the longest recent suffix of the history against an earlier occurrence and
+propose the tokens that followed it. On repetition-heavy text (code,
+summarization, multi-turn chat quoting context) acceptance rates are high
+enough that one verify pass regularly advances k+1 tokens.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, Sequence, runtime_checkable
+
+import numpy as np
+
+
+@runtime_checkable
+class Proposer(Protocol):
+    """Pluggable draft source (n-gram today; a draft model fits the same
+    contract: stateless per call, history in, <= k token ids out)."""
+
+    def propose(self, token_ids: Sequence[int], k: int) -> list[int]: ...
+
+
+class NgramProposer:
+    """Prompt-lookup proposer: longest-suffix n-gram match over the history.
+
+    For n from ``max_ngram`` down to ``min_ngram``: take the history's last n
+    tokens, find the MOST RECENT earlier occurrence of that n-gram, and
+    propose k tokens by copying forward with the match's lag — extended
+    periodically past the history's end, so a generation loop of period d
+    yields full-k drafts that follow the loop exactly. Stateless — the
+    history arrives fresh each call, so multi-token advances, preemption,
+    and disagg adoption need no index maintenance.
+    """
+
+    def __init__(self, max_ngram: int = 4, min_ngram: int = 1):
+        if min_ngram < 1 or max_ngram < min_ngram:
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram; got {min_ngram}..{max_ngram}"
+            )
+        self.max_ngram = max_ngram
+        self.min_ngram = min_ngram
+
+    def propose(self, token_ids: Sequence[int], k: int) -> list[int]:
+        L = len(token_ids)
+        if k <= 0 or L < self.min_ngram + 1:
+            return []
+        arr = np.asarray(token_ids, np.int64)
+        for n in range(min(self.max_ngram, L - 1), self.min_ngram - 1, -1):
+            suffix = arr[L - n :]
+            # windows over arr[:-1] so the suffix's own position never
+            # self-matches; any match therefore has >= 1 continuation token
+            windows = np.lib.stride_tricks.sliding_window_view(arr[:-1], n)
+            matches = np.nonzero((windows == suffix).all(axis=1))[0]
+            if matches.size == 0:
+                continue
+            # most recent match wins (closest context); predict by copying
+            # with its lag d, extending PERIODICALLY past the history's end —
+            # a looping chain's latest match sits one period back, and plain
+            # arr[start:start+k] would truncate the draft at the loop period,
+            # wasting the verify pass's remaining rows
+            d = (L - n) - int(matches[-1])
+            return [int(arr[L - d + (i % d)]) for i in range(k)]
+        return []

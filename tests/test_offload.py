@@ -152,3 +152,58 @@ def test_offload_batched_restore_odd_block_count():
             await eng.shutdown()
 
     asyncio.run(body())
+
+
+def test_load_many_device_roundtrip_with_bucket_padding():
+    """HostKvPool.load_many against the REAL jitted scatter: 3 blocks pad to
+    a 4-bucket whose pad id is far out of range — the donated scatter must
+    drop it (no live page clobbered) while the 3 real blocks restore
+    byte-exact. Also covers the contiguous-leading-run cutoff when a block
+    is LRU-dropped between the membership check and the injection."""
+    import numpy as np
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.model_runner import ModelRunner
+    from dynamo_tpu.engine.offload import HostKvPool
+    from dynamo_tpu.models.registry import load_model
+
+    cfg = EngineConfig(
+        model_id="tiny", page_size=4, num_pages=16, max_seqs=2,
+        max_model_len=32, prefill_buckets=(8,),
+    )
+    model, params = load_model("tiny")
+    runner = ModelRunner(cfg, model, params)
+    pool = HostKvPool(runner, capacity_blocks=8)
+
+    src = np.array([1, 2, 3], np.int32)
+    rng = np.random.default_rng(3)
+    data = rng.normal(size=runner.extract_pages(src).shape).astype(np.float32)
+    runner.inject_pages(src, data)
+    src_data = runner.extract_pages(src)
+    for h, p in ((901, 1), (902, 2), (903, 3)):
+        pool.save(h, p)
+
+    sentinel = runner.extract_pages(np.array([12], np.int32)).copy()
+    hits = pool.load_many([(901, 7), (902, 8), (903, 9)])
+    assert hits == {901, 902, 903}
+    np.testing.assert_array_equal(
+        runner.extract_pages(np.array([7, 8, 9], np.int32)), src_data
+    )
+    # the pad id (bucket 4 > 3 hits) was dropped by the scatter: untouched
+    # pages keep their bytes
+    np.testing.assert_array_equal(
+        runner.extract_pages(np.array([12], np.int32)), sentinel
+    )
+
+    # leading-run cutoff: 902 dropped between membership check and injection
+    pool.discard(902)
+    before_11 = runner.extract_pages(np.array([11], np.int32)).copy()
+    hits = pool.load_many([(901, 10), (902, 11), (903, 12)])
+    assert hits == {901}
+    np.testing.assert_array_equal(
+        runner.extract_pages(np.array([10], np.int32)), src_data[:, :, :1]
+    )
+    # pages past the first miss were never written
+    np.testing.assert_array_equal(
+        runner.extract_pages(np.array([11], np.int32)), before_11
+    )

@@ -287,8 +287,11 @@ def test_engine_penalties_survive_preemption():
 def test_fold_seed_out_of_range():
     from dynamo_tpu.engine.sampling import fold_seed
 
-    assert fold_seed(0) == 0 and fold_seed(None) == 0
-    for s in (3_000_000_000, -5, 2**63 - 1, -(2**31)):
+    # only None means unseeded; an explicit seed=0 is a real deterministic
+    # seed (it used to fall through `if not seed` into the engine's shared
+    # stream — tests/test_spec_decode.py holds the regression)
+    assert fold_seed(None) == 0
+    for s in (0, 3_000_000_000, -5, 2**63 - 1, -(2**31)):
         v = fold_seed(s)
         assert 0 < v < 2**31
     assert fold_seed(42) == fold_seed(42)

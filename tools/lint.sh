@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Lint gate, wired next to the tier-1 test command (ROADMAP.md):
+#
+#   bash tools/lint.sh
+#
+# Runs ruff with the minimal repo config from pyproject.toml ([tool.ruff]:
+# syntax errors, comparison/f-string misuse, undefined names). The hermetic
+# CI image has no egress, so when ruff isn't installed the gate degrades to
+# a byte-compile pass — syntax rot is still caught, and installing ruff
+# upgrades the gate with no script change.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if command -v ruff >/dev/null 2>&1; then
+    exec ruff check dynamo_tpu tests tools bench.py
+fi
+if python -c "import ruff" >/dev/null 2>&1; then
+    exec python -m ruff check dynamo_tpu tests tools bench.py
+fi
+echo "lint: ruff unavailable (no-egress image); falling back to the" \
+     "compileall syntax gate" >&2
+exec python -m compileall -q dynamo_tpu tests tools bench.py
