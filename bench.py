@@ -839,6 +839,144 @@ async def run_disagg_parity(
     }
 
 
+async def run_disagg_stream(
+    n_requests: int = 5, plen: int = 2600, osl: int = 24, page_size: int = 128,
+) -> dict:
+    """Streamed (chunk-pipelined, multi-lane) vs monolithic KV transfer on the
+    cross-process socket path, long multi-chunk prompts.
+
+    ici.is_local is forced off so the bulk KV really rides the TCP data plane
+    (same-process workers would otherwise take the device handoff). Both arms
+    run the identical two-worker fleet; only the prefill engine's kv_stream
+    flag differs. Reports per-arm TTFT, exact token parity between arms, and
+    the measured compute/transfer overlap fraction from the prefill worker's
+    counters — the pipelining win the v2 wire protocol exists for (on this
+    single-host loopback the transfer leg is cheap, so the TTFT delta is a
+    lower bound on what a real DCN hop would recover)."""
+    import gc
+    import time as _time  # noqa: F401 — parity with sibling sections
+
+    from dynamo_tpu.cplane.broker import Broker
+    from dynamo_tpu.disagg import ici as _ici
+    from dynamo_tpu.disagg.decode_worker import DisaggDecodeEngine
+    from dynamo_tpu.disagg.prefill_worker import PrefillWorker
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.llm.disagg_router import DisaggregatedRouter, DisaggRouterConf
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    rng = np.random.default_rng(17)
+    prompts = [rng.integers(1, 31000, plen).tolist() for _ in range(n_requests)]
+    warm_prompt = rng.integers(1, 31000, plen).tolist()
+    pages_per_seq = -(-(plen + osl) // page_size) + 2
+    orig_is_local = _ici.is_local
+    _ici.is_local = lambda worker_id: False  # force the socket data plane
+    arms: dict[str, dict] = {}
+    try:
+        for arm, stream in (("monolithic", False), ("streamed", True)):
+            cleanups = []
+            try:
+                broker = Broker()
+                port = await broker.start()
+                cleanups.append(broker.stop)
+                addr = f"127.0.0.1:{port}"
+                decode_rt = DistributedRuntime(cplane_address=addr)
+                await decode_rt.connect()
+                cleanups.append(decode_rt._shutdown_hook)
+                prefill_rt = DistributedRuntime(cplane_address=addr)
+                await prefill_rt.connect()
+                cleanups.append(prefill_rt._shutdown_hook)
+                decode_inner = AsyncJaxEngine(_parity_config(
+                    page_size=page_size, max_seqs=4, max_model_len=4096,
+                    num_pages=6 * pages_per_seq + 8,
+                    prefill_buckets=(512, 1024), decode_steps=16,
+                    pipeline_depth=2,
+                ))
+                await decode_inner.start()
+                cleanups.append(decode_inner.shutdown)
+                prefill_engine = AsyncJaxEngine(_parity_config(
+                    page_size=page_size, max_seqs=4, max_model_len=4096,
+                    num_pages=6 * pages_per_seq + 8,
+                    prefill_buckets=(512, 1024), decode_steps=8,
+                    pipeline_depth=2, kv_stream=stream, kv_stream_lanes=2,
+                ))
+                await prefill_engine.start()
+                cleanups.append(prefill_engine.shutdown)
+                router = DisaggregatedRouter(
+                    "bench", conf=DisaggRouterConf(max_local_prefill_length=256)
+                )
+                decode = DisaggDecodeEngine(
+                    decode_inner, decode_rt, "bstream", "decoder", "bench",
+                    disagg_router=router,
+                )
+                await decode.start()
+                cleanups.append(decode.shutdown)
+                pw = PrefillWorker(prefill_engine, prefill_rt, "bstream", "bench")
+                await pw.start()
+                cleanups.append(pw.stop)
+
+                await _request(decode, f"warm-{arm}", warm_prompt, max_tokens=2)
+                ttfts, tokens = [], []
+                # sequential requests: the TTFT signal must not mix queueing
+                for i, p in enumerate(prompts):
+                    toks, ttft, _ = await _request(
+                        decode, f"{arm}-{i}", p, max_tokens=osl
+                    )
+                    ttfts.append(ttft)
+                    tokens.append(toks)
+                arms[arm] = {
+                    "ttft_p50_ms": round(float(np.percentile(ttfts, 50)) * 1e3, 1),
+                    "ttft_mean_ms": round(float(np.mean(ttfts)) * 1e3, 1),
+                    "remote_prefills": decode.remote_prefills,
+                    "parts_scattered": decode.parts_scattered,
+                    "stream_parts": pw.stream_parts,
+                    "stream_bytes": pw.stream_bytes,
+                    "stream_send_s": round(pw.stream_send_s, 4),
+                    "stream_overlap_s": round(pw.stream_overlap_s, 4),
+                    "_tokens": tokens,
+                }
+            finally:
+                for stop in reversed(cleanups):
+                    try:
+                        await stop()
+                    except Exception:
+                        import traceback
+
+                        traceback.print_exc()
+                dropped = _ici.drain_all()
+                if dropped:
+                    import sys as _sys
+
+                    print(f"[bench] disagg_stream teardown dropped {dropped} "
+                          "parked ICI transfers", file=_sys.stderr, flush=True)
+            gc.collect()
+    finally:
+        _ici.is_local = orig_is_local
+
+    parity = arms["streamed"].pop("_tokens") == arms["monolithic"].pop("_tokens")
+    send_s = arms["streamed"]["stream_send_s"]
+    overlap_fraction = (
+        round(arms["streamed"]["stream_overlap_s"] / send_s, 3) if send_s else 0.0
+    )
+    return {
+        "workload": {
+            "isl": plen, "osl": osl, "requests": n_requests,
+            "chunks_per_prompt": -(-plen // 1024), "lanes": 2,
+        },
+        "monolithic": arms["monolithic"],
+        "streamed": arms["streamed"],
+        "token_parity": parity,
+        "overlap_fraction": overlap_fraction,
+        "ttft_ratio_streamed_over_monolithic": round(
+            arms["streamed"]["ttft_p50_ms"]
+            / max(arms["monolithic"]["ttft_p50_ms"], 1e-9), 3,
+        ),
+        "target": (
+            "token_parity exact; overlap_fraction > 0; streamed TTFT <= "
+            "monolithic on multi-chunk prompts (ratio <= 1.0)"
+        ),
+    }
+
+
 async def run_quant_int8_parity(decode_tokens: int = 72) -> dict:
     """Weight-only int8 vs bf16 on the headline llama-1.3b config: decode
     throughput (the weight-bound roofline argument — int8 weights halve the
@@ -1338,6 +1476,9 @@ async def run() -> dict:
         # greedy/logit parity (the round-6 tentpole)
         await _section("parity_quant_int8", run_quant_int8_parity, 2400)
         await _section("parity_disagg", run_disagg_parity, 2400)
+        # streamed vs monolithic KV transfer on the socket path: TTFT on
+        # multi-chunk prompts, token parity, compute/transfer overlap
+        await _section("disagg_stream", run_disagg_stream, 1800)
         await _section("parity_kv_routing", run_routing_parity, 1500)
         await _section("parity_host_offload", run_offload_parity, 1200)
     return _result()
@@ -1382,6 +1523,7 @@ def _summary(errors: dict) -> dict:
     mla = DETAIL.get("mla_decode")
     moe = DETAIL.get("moe_decode")
     dis = DETAIL.get("parity_disagg")
+    dstream = DETAIL.get("disagg_stream")
     rout = DETAIL.get("parity_kv_routing")
     off = DETAIL.get("parity_host_offload")
     quant = DETAIL.get("parity_quant_int8")
@@ -1423,6 +1565,13 @@ def _summary(errors: dict) -> dict:
         "parity_disagg": {
             "ratio_measured_1chip": _get(dis, "ratio_measured_1chip"),
             "ratio_projected": _get(dis, "ratio_projected"),
+        },
+        "disagg_stream": {
+            "ttft_streamed_ms": _get(dstream, "streamed", "ttft_p50_ms"),
+            "ttft_monolithic_ms": _get(dstream, "monolithic", "ttft_p50_ms"),
+            "ttft_ratio": _get(dstream, "ttft_ratio_streamed_over_monolithic"),
+            "overlap_fraction": _get(dstream, "overlap_fraction"),
+            "token_parity": _get(dstream, "token_parity"),
         },
         "parity_kv_routing": {
             "ratio_measured": _get(rout, "ttft_insitu_ratio_measured"),

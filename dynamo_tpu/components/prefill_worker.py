@@ -47,6 +47,8 @@ async def _main(args) -> None:
             max_seqs=args.max_seqs,
             page_size=args.page_size,
             max_model_len=args.max_model_len,
+            kv_stream=not args.no_kv_stream,
+            kv_stream_lanes=args.kv_stream_lanes,
         )
     )
     await engine.start()
@@ -70,6 +72,12 @@ def main(argv=None) -> None:
     p.add_argument("--max-seqs", type=int, default=8)
     p.add_argument("--page-size", type=int, default=16)
     p.add_argument("--max-model-len", type=int, default=2048)
+    p.add_argument("--kv-stream-lanes", type=int, default=2,
+                   help="parallel KV data-plane connections per decode worker "
+                        "(chunk-streamed parts stripe across lanes)")
+    p.add_argument("--no-kv-stream", action="store_true",
+                   help="disable chunk-streamed KV transfer (one monolithic "
+                        "post-prefill send per request)")
     p.add_argument("--cplane", default=None)
     asyncio.run(_main(p.parse_args(argv)))
 

@@ -133,10 +133,16 @@ class WorkerService:
                 "local_prefills": self.engine.local_prefills,
             }
             if self.engine.kv_server is not None:
+                kv = self.engine.kv_server
                 stats["disagg"]["kv_dataplane"] = {
-                    "received": self.engine.kv_server.received,
-                    "dropped": self.engine.kv_server.dropped,
-                    "address": self.engine.kv_server.address,
+                    "received": kv.received,
+                    "parts_received": kv.parts_received,
+                    "bytes_received": kv.bytes_received,
+                    "dropped": kv.dropped,
+                    "rejected": kv.rejected,
+                    "checksum_failures": kv.checksum_failures,
+                    "parts_scattered": self.engine.parts_scattered,
+                    "address": kv.address,
                 }
         return stats
 
@@ -186,6 +192,8 @@ async def _main(args) -> None:
             max_model_len=args.max_model_len,
             quantize=getattr(args, "quantize", None),
             speculative=getattr(args, "speculative", None),
+            kv_stream=not getattr(args, "no_kv_stream", False),
+            kv_stream_lanes=getattr(args, "kv_stream_lanes", None) or 2,
         ),
         enable_disagg_decode=args.disagg,
     )
@@ -223,6 +231,12 @@ def main(argv=None) -> None:
                    help="speculative decoding: n-gram draft proposals + "
                         "batched multi-token verification (e.g. ngram:4)")
     p.add_argument("--disagg", action="store_true", help="wrap in the disagg decode path")
+    p.add_argument("--kv-stream-lanes", type=int, default=2,
+                   help="parallel KV data-plane connections per destination "
+                        "(disagg; parts stripe across lanes)")
+    p.add_argument("--no-kv-stream", action="store_true",
+                   help="disable chunk-streamed KV transfer (fall back to one "
+                        "monolithic post-prefill send)")
     args = p.parse_args(argv)
     asyncio.run(_main(args))
 

@@ -4,18 +4,32 @@ The reference moves KV blocks between engine processes with NIXL RDMA WRITEs
 plus completion notifications, off the control plane (reference: container/
 deps/vllm/vllm_v0.7.2-dynamo-kv-disagg-patch.patch ``nixl.py`` —
 ``read_blocks``/``get_notifs``; docs/disagg_serving.md:83 non-blocking
-property). The TPU-native analogue: bulk KV bytes ride a dedicated TCP
-socket between the prefill and decode processes — never inside the
+property). The TPU-native analogue: bulk KV bytes ride dedicated TCP
+sockets between the prefill and decode processes — never inside the
 control-plane response message — and land in a per-request mailbox whose
 future IS the completion notification. On-pod (same-process) transfers keep
 using the device-array hub (dynamo_tpu/disagg/ici.py); this module is the
 cross-process / cross-host path.
 
-Wire format per transfer (one stream, sequential transfers per connection):
+Wire format (v2, streamed): one request's KV travels as 1..N *parts*, each a
+self-contained frame
 
     u32 header_len | msgpack header | payload bytes
 
-    header = {request_id, shape, dtype, xxh3}  (xxh3 of the payload)
+    header = {request_id, shape, dtype, xxh3, token,
+              part_seq, part_total, page_from, page_to, cat_axis}
+
+``xxh3`` covers the payload of THIS part only, so a corrupt frame kills one
+transfer, not the shared connection. ``page_from``/``page_to`` are logical
+page indices within the sequence (the decode side maps them onto its own
+page ids and scatters each part as it lands); ``cat_axis`` is the page axis
+of the wire layout (models differ: llama [L,2,n,ps,H,D] -> 2, MLA latent
+[L,n,ps,latent] -> 1) so a consumer-less receiver can reassemble. A v1
+monolithic send is exactly a v2 transfer with ``part_total == 1``.
+
+The client keeps N parallel *lanes* (connections) per destination and
+stripes parts across them, so one long prompt's multi-MB parts never
+head-of-line-block every other request behind a single per-destination lock.
 
 The server never blocks the sender on the consumer: payloads for requests
 nobody expects (cancelled, duplicate) are received and dropped.
@@ -24,38 +38,115 @@ nobody expects (cancelled, duplicate) are received and dropped.
 from __future__ import annotations
 
 import asyncio
+import secrets
 import struct
-from typing import Optional
+from dataclasses import dataclass, field
+from typing import Callable, Optional
 
 import msgpack
 import numpy as np
 import xxhash
 
 from dynamo_tpu.utils import get_logger
+from dynamo_tpu.utils.prometheus import Histogram, render_family
 
 log = get_logger("disagg.dataplane")
 
 _LEN = struct.Struct("<I")
 MAX_HEADER = 1 << 20
 
+# part payload sizes: a tiny-model part is KBs, a serving-geometry chunk part
+# is tens of MB
+_PART_BYTES_BUCKETS = (
+    4096.0, 65536.0, 262144.0, 1048576.0, 4194304.0,
+    16777216.0, 67108864.0, 268435456.0,
+)
+
+
+def stream_part_plan(
+    start_page: int, cached_len: int, prompt_len: int, page_size: int,
+    max_chunk: int,
+) -> list[tuple[int, int]]:
+    """Page ranges ``[(page_from, page_to), ...]`` a chunk-streamed prefill
+    emits, in order. Deterministic from the chunk ladder, so both the part
+    count (``part_total`` in every header) and each part's range are known
+    before the first chunk runs:
+
+      - pages already valid from the prefill-side prefix cache are final
+        immediately (one leading part)
+      - after chunk ``[start, end)`` completes, pages fully covered by
+        ``end`` tokens are final; the possibly-partial tail page ships with
+        the last chunk
+
+    Pages below ``start_page`` (the decode side's shared prefix) are never
+    sent at all."""
+    n_pages = -(-prompt_len // page_size)
+    parts: list[tuple[int, int]] = []
+    sent = start_page
+    cached_pages = min(cached_len // page_size, n_pages)
+    if cached_pages > sent:
+        parts.append((sent, cached_pages))
+        sent = cached_pages
+    start = cached_len
+    while start < prompt_len:
+        end = min(start + max_chunk, prompt_len)
+        final = n_pages if end == prompt_len else end // page_size
+        if final > sent:
+            parts.append((sent, final))
+            sent = final
+        start = end
+    return parts
+
+
+@dataclass
+class KvPart:
+    """One received KV part, handed to the incremental consumer (or parked
+    for reassembly) as it arrives."""
+
+    seq: int
+    total: int
+    page_from: int  # logical page index within the sequence; -1 = unknown (v1)
+    page_to: int
+    cat_axis: int
+    data: np.ndarray
+
+
+@dataclass
+class _Pending:
+    fut: asyncio.Future
+    token: str
+    total: int = 1
+    received: set = field(default_factory=set)
+    parts: dict = field(default_factory=dict)  # seq -> KvPart (no consumer)
+    consumer: Optional[Callable[[KvPart], None]] = None
+
 
 class KvDataPlaneServer:
-    """Decode-side listener: framed KV payloads -> per-request futures."""
+    """Decode-side listener: framed KV parts -> incremental consumers (or a
+    per-request reassembly future)."""
 
     def __init__(self, host: str = "0.0.0.0", advertise_host: Optional[str] = None):
         self.host = host
         self.advertise_host = advertise_host
         self.port: Optional[int] = None
         self._server: Optional[asyncio.AbstractServer] = None
-        self._expected: dict[str, asyncio.Future] = {}
-        # per-request nonces: a payload must carry the token expect() minted
-        # (travels to the prefill side inside RemotePrefillRequest), so a
-        # peer that guesses an in-flight request_id can't poison the cache
-        self._tokens: dict[str, str] = {}
+        # per-request nonces live on the pending entry: a payload must carry
+        # the token expect() minted (travels to the prefill side inside
+        # RemotePrefillRequest), so a peer that guesses an in-flight
+        # request_id can't poison the cache
+        self._expected: dict[str, _Pending] = {}
         self._writers: set[asyncio.StreamWriter] = set()
-        self.received = 0
-        self.dropped = 0
+        self.received = 0  # completed transfers (all parts)
+        self.parts_received = 0
+        self.bytes_received = 0
+        self.dropped = 0  # unexpected / duplicate frames
         self.rejected = 0  # bad/missing token
+        self.checksum_failures = 0
+        self.part_bytes_hist = Histogram(
+            "dynamo_kv_stream_part_bytes",
+            "received KV part payload size in bytes",
+            _PART_BYTES_BUCKETS,
+        )
 
     @property
     def address(self) -> str:
@@ -86,9 +177,12 @@ class KvDataPlaneServer:
             for w in list(self._writers):
                 w.close()
             await self._server.wait_closed()
-        for fut in self._expected.values():
-            if not fut.done():
-                fut.cancel()
+        for pend in self._expected.values():
+            if pend.fut.done():
+                if not pend.fut.cancelled():
+                    pend.fut.exception()  # mark retrieved
+            else:
+                pend.fut.cancel()
         self._expected.clear()
 
     # ---------------- consumer API ----------------
@@ -96,32 +190,78 @@ class KvDataPlaneServer:
     def expect(self, request_id: str) -> str:
         """Register interest BEFORE the remote prefill is requested, so an
         early-arriving payload parks instead of being dropped. Returns the
-        per-request nonce the sender must echo in its payload header."""
-        if request_id not in self._expected:
-            import secrets
+        per-request nonce the sender must echo in its part headers."""
+        pend = self._expected.get(request_id)
+        if pend is None:
+            pend = _Pending(
+                fut=asyncio.get_running_loop().create_future(),
+                token=secrets.token_hex(16),
+            )
+            self._expected[request_id] = pend
+        return pend.token
 
-            self._expected[request_id] = asyncio.get_running_loop().create_future()
-            self._tokens[request_id] = secrets.token_hex(16)
-        return self._tokens[request_id]
+    def set_consumer(self, request_id: str, consumer: Callable[[KvPart], None]) -> None:
+        """Attach an incremental per-part consumer (called on the server's
+        event loop as each part lands); parts that arrived before attachment
+        are flushed to it immediately, in seq order. With a consumer the
+        ``receive()`` future resolves to None — the parts were already handed
+        over, the future is purely the all-parts-arrived completion gate. A
+        transfer that completed before attachment keeps its assembled-array
+        result (``receive()`` returns it; the consumer is never called)."""
+        pend = self._expected.get(request_id)
+        if pend is None:
+            raise RuntimeError(f"set_consumer() without expect() for {request_id}")
+        if pend.fut.done():
+            return
+        pend.consumer = consumer
+        for seq in sorted(pend.parts):
+            if not self._feed(request_id, pend, pend.parts[seq]):
+                break
+        pend.parts.clear()
 
-    async def receive(self, request_id: str, timeout: float = 120.0) -> np.ndarray:
-        fut = self._expected.get(request_id)
-        if fut is None:
+    async def receive(self, request_id: str, timeout: float = 120.0):
+        """Await transfer completion. Returns the (re)assembled host array,
+        or None when an incremental consumer already took the parts."""
+        pend = self._expected.get(request_id)
+        if pend is None:
             raise RuntimeError(f"receive() without expect() for {request_id}")
         try:
-            return await asyncio.wait_for(fut, timeout)
+            return await asyncio.wait_for(pend.fut, timeout)
         finally:
             self._expected.pop(request_id, None)
-            self._tokens.pop(request_id, None)
 
     def abandon(self, request_id: str) -> None:
         """Cancellation: stop waiting; a late payload is received and dropped."""
-        fut = self._expected.pop(request_id, None)
-        self._tokens.pop(request_id, None)
-        if fut is not None and not fut.done():
-            fut.cancel()
+        pend = self._expected.pop(request_id, None)
+        if pend is None:
+            return
+        if pend.fut.done():
+            if not pend.fut.cancelled():
+                pend.fut.exception()  # mark retrieved (checksum-failed transfers)
+        else:
+            pend.fut.cancel()
 
     # ---------------- wire ----------------
+
+    def _fail(self, pend: _Pending, exc: Exception) -> None:
+        pend.parts.clear()
+        if not pend.fut.done():
+            pend.fut.set_exception(exc)
+
+    def _feed(self, rid: str, pend: _Pending, part: KvPart) -> bool:
+        try:
+            pend.consumer(part)
+            return True
+        except Exception as e:
+            log.exception("kv part consumer failed for %s", rid)
+            self._fail(pend, e)
+            return False
+
+    def _assemble(self, pend: _Pending):
+        parts = [pend.parts[seq] for seq in sorted(pend.parts)]
+        if len(parts) == 1:
+            return parts[0].data
+        return np.concatenate([p.data for p in parts], axis=parts[0].cat_axis)
 
     async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         peer = writer.get_extra_info("peername")
@@ -139,25 +279,60 @@ class KvDataPlaneServer:
                 shape = tuple(header["shape"])
                 nbytes = dtype.itemsize * int(np.prod(shape))
                 payload = await reader.readexactly(nbytes)
-                if xxhash.xxh3_64_intdigest(payload) != header["xxh3"]:
-                    raise ValueError("kv payload checksum mismatch")
                 rid = header["request_id"]
-                fut = self._expected.get(rid)
-                want = self._tokens.get(rid)
-                if fut is not None and want is not None and header.get("token") != want:
+                seq = int(header.get("part_seq", 0))
+                pend = self._expected.get(rid)
+                if xxhash.xxh3_64_intdigest(payload) != header["xxh3"]:
+                    # the length prefix still framed this payload correctly,
+                    # so only the offending transfer dies — unrelated
+                    # transfers sharing the connection keep flowing
+                    self.checksum_failures += 1
+                    log.warning("kv payload checksum mismatch for %s part %d", rid, seq)
+                    if pend is not None:
+                        self._fail(pend, RuntimeError(
+                            f"kv payload checksum mismatch for {rid}"
+                        ))
+                    continue
+                if pend is None or pend.fut.done():
+                    self.dropped += 1
+                    log.debug("dropping unexpected kv payload for %s", rid)
+                    continue
+                if header.get("token") != pend.token:
                     # wrong/missing nonce: never fulfil the future from an
                     # unauthenticated peer (checksum is sender-supplied).
-                    # Enforcement is unconditional: tokenless senders (pre-nonce
-                    # peers) are rejected — both sides of a disagg pair must run
+                    # Enforcement is unconditional: tokenless senders must run
                     # the same protocol version (no mixed-version rollout)
                     self.rejected += 1
                     log.warning("rejecting kv payload with bad token for %s", rid)
-                elif fut is not None and not fut.done():
-                    fut.set_result(np.frombuffer(payload, dtype).reshape(shape))
-                    self.received += 1
-                else:
+                    continue
+                if seq in pend.received:
                     self.dropped += 1
-                    log.debug("dropping unexpected kv payload for %s", rid)
+                    log.debug("dropping duplicate kv part %d for %s", seq, rid)
+                    continue
+                part = KvPart(
+                    seq=seq,
+                    total=max(1, int(header.get("part_total", 1))),
+                    page_from=int(header.get("page_from", -1)),
+                    page_to=int(header.get("page_to", -1)),
+                    cat_axis=int(header.get("cat_axis", 2)),
+                    data=np.frombuffer(payload, dtype).reshape(shape),
+                )
+                pend.received.add(seq)
+                pend.total = max(pend.total, part.total)
+                self.parts_received += 1
+                self.bytes_received += nbytes
+                self.part_bytes_hist.observe(float(nbytes))
+                if pend.consumer is not None:
+                    if not self._feed(rid, pend, part):
+                        continue
+                else:
+                    pend.parts[seq] = part
+                if len(pend.received) >= pend.total and not pend.fut.done():
+                    pend.fut.set_result(
+                        None if pend.consumer is not None else self._assemble(pend)
+                    )
+                    pend.parts.clear()
+                    self.received += 1
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         except Exception:
@@ -166,50 +341,131 @@ class KvDataPlaneServer:
             self._writers.discard(writer)
             writer.close()
 
+    # ---------------- metrics ----------------
+
+    def render_metrics(self) -> str:
+        """Prometheus exposition for the receive side of the stream."""
+        out = [
+            render_family(
+                "dynamo_kv_stream_transfers_received_total", "counter",
+                "completed KV transfers (all parts arrived)",
+                [({}, self.received)],
+            ),
+            render_family(
+                "dynamo_kv_stream_parts_received_total", "counter",
+                "KV parts accepted off the data plane",
+                [({}, self.parts_received)],
+            ),
+            render_family(
+                "dynamo_kv_stream_bytes_received_total", "counter",
+                "KV payload bytes accepted off the data plane",
+                [({}, self.bytes_received)],
+            ),
+            render_family(
+                "dynamo_kv_stream_rejected_total", "counter",
+                "KV payloads rejected for a bad/missing nonce",
+                [({}, self.rejected)],
+            ),
+            render_family(
+                "dynamo_kv_stream_dropped_total", "counter",
+                "unexpected or duplicate KV payloads received and dropped",
+                [({}, self.dropped)],
+            ),
+            render_family(
+                "dynamo_kv_stream_checksum_failures_total", "counter",
+                "KV payloads failing the per-part xxh3 check",
+                [({}, self.checksum_failures)],
+            ),
+            self.part_bytes_hist.render(),
+        ]
+        return "".join(out)
+
 
 class KvDataPlaneClient:
-    """Prefill-side sender with pooled connections per destination."""
+    """Prefill-side sender: N parallel lanes per destination, parts striped
+    round-robin across them."""
 
-    def __init__(self):
-        self._conns: dict[str, tuple[asyncio.StreamReader, asyncio.StreamWriter]] = {}
-        self._locks: dict[str, asyncio.Lock] = {}
-        self.sent = 0
+    def __init__(self, lanes: int = 1):
+        self.lanes = max(1, int(lanes))
+        self._conns: dict[tuple, tuple[asyncio.StreamReader, asyncio.StreamWriter]] = {}
+        self._locks: dict[tuple, asyncio.Lock] = {}
+        self._rr: dict[str, int] = {}
+        self.sent = 0  # payload frames written (every part counts)
+        self.bytes_sent = 0
 
     async def send(
-        self, address: str, request_id: str, array: np.ndarray, token: str = ""
+        self, address: str, request_id: str, array: np.ndarray, token: str = "",
+        page_from: int = -1, page_to: int = -1, cat_axis: int = 2,
     ) -> None:
-        lock = self._locks.setdefault(address, asyncio.Lock())
-        async with lock:  # one in-flight transfer per destination connection
-            # zero-copy payload: write a memoryview of the contiguous array
-            # (KV payloads are tens of MB; bytes-concatenation would copy them
-            # again and stall the event loop)
-            arr = np.ascontiguousarray(array)
-            payload = memoryview(arr.view(np.uint8).reshape(-1))
-            header = msgpack.packb(
-                {
-                    "request_id": request_id,
-                    "shape": list(array.shape),
-                    "dtype": str(array.dtype),
-                    "xxh3": xxhash.xxh3_64_intdigest(payload),
-                    "token": token,
-                }
-            )
+        """Monolithic (single-part) transfer — a v2 frame with part_total=1."""
+        await self.send_part(
+            address, request_id, array, token=token,
+            part_seq=0, part_total=1,
+            page_from=page_from, page_to=page_to, cat_axis=cat_axis,
+        )
+
+    async def send_part(
+        self, address: str, request_id: str, array: np.ndarray, token: str = "",
+        part_seq: int = 0, part_total: int = 1,
+        page_from: int = -1, page_to: int = -1, cat_axis: int = 2,
+    ) -> None:
+        # zero-copy payload: write a memoryview of the contiguous array
+        # (KV parts are tens of MB; bytes-concatenation would copy them
+        # again and stall the event loop)
+        arr = np.ascontiguousarray(array)
+        payload = memoryview(arr.view(np.uint8).reshape(-1))
+        # hash BEFORE taking the lane lock: xxh3 over a multi-MB part blocks
+        # the event loop either way, but must never extend the window in
+        # which every other sender to this lane is stalled behind us —
+        # per-part hashing also bounds each stall to one part, not one prompt
+        digest = xxhash.xxh3_64_intdigest(payload)
+        header = msgpack.packb(
+            {
+                "request_id": request_id,
+                "shape": list(array.shape),
+                "dtype": str(array.dtype),
+                "xxh3": digest,
+                "token": token,
+                "part_seq": part_seq,
+                "part_total": part_total,
+                "page_from": page_from,
+                "page_to": page_to,
+                "cat_axis": cat_axis,
+            }
+        )
+        lane = self._rr.get(address, 0) % self.lanes
+        self._rr[address] = lane + 1
+        key = (address, lane)
+        lock = self._locks.setdefault(key, asyncio.Lock())
+        async with lock:  # one in-flight frame per lane
             for attempt in (0, 1):  # one reconnect on a stale pooled socket
                 try:
-                    conn = self._conns.get(address)
+                    conn = self._conns.get(key)
+                    if conn is not None and (conn[0].at_eof() or conn[1].is_closing()):
+                        # peer already hung up (server restart): a write here
+                        # would be silently buffered into a dead socket —
+                        # detect it up front instead of losing the frame
+                        conn[1].close()
+                        self._conns.pop(key, None)
+                        conn = None
                     if conn is None:
                         host, _, port = address.rpartition(":")
                         conn = await asyncio.open_connection(host, int(port))
-                        self._conns[address] = conn
+                        self._conns[key] = conn
                     _, writer = conn
                     writer.write(_LEN.pack(len(header)))
                     writer.write(header)
                     writer.write(payload)
                     await writer.drain()
                     self.sent += 1
+                    self.bytes_sent += payload.nbytes
                     return
                 except (ConnectionError, OSError):
-                    self._conns.pop(address, None)
+                    stale = self._conns.pop(key, None)
+                    if stale is not None:
+                        # close the dead transport before retrying — popping
+                        # alone leaks the socket fd until GC
+                        stale[1].close()
                     if attempt:
                         raise
 
@@ -217,3 +473,22 @@ class KvDataPlaneClient:
         for _, writer in self._conns.values():
             writer.close()
         self._conns.clear()
+
+    def render_metrics(self) -> str:
+        return "".join([
+            render_family(
+                "dynamo_kv_stream_parts_sent_total", "counter",
+                "KV payload frames written to the data plane",
+                [({}, self.sent)],
+            ),
+            render_family(
+                "dynamo_kv_stream_bytes_sent_total", "counter",
+                "KV payload bytes written to the data plane",
+                [({}, self.bytes_sent)],
+            ),
+            render_family(
+                "dynamo_kv_stream_lanes", "gauge",
+                "parallel data-plane connections per destination",
+                [({}, self.lanes)],
+            ),
+        ])

@@ -16,6 +16,8 @@ import asyncio
 import time
 from typing import AsyncIterator, Optional
 
+import numpy as np
+
 from dynamo_tpu.engine.engine import AsyncJaxEngine
 from dynamo_tpu.engine.scheduler import EngineRequest, StepOutput
 from dynamo_tpu.llm.disagg_router import DisaggregatedRouter
@@ -59,6 +61,7 @@ class DisaggDecodeEngine:
         self.remote_prefills = 0
         self.local_prefills = 0
         self.remote_prefill_wait_s = 0.0  # queue push -> KV adopted (transfer leg)
+        self.parts_scattered = 0  # streamed KV parts injected before adoption
 
     # ---------------- lifecycle ----------------
 
@@ -99,6 +102,22 @@ class DisaggDecodeEngine:
 
     def metrics(self):
         return self.engine.metrics()
+
+    def stage_snapshot(self) -> dict:
+        snap = getattr(self.engine, "stage_snapshot", dict)()
+        return snap
+
+    def render_stage_metrics(self) -> str:
+        """Inner engine stage histograms + the KV data-plane stream counters
+        (parts/bytes/checksums on the receive side) — one exposition blob for
+        whichever /metrics surface hosts this engine."""
+        parts = []
+        inner = getattr(self.engine, "render_stage_metrics", None)
+        if inner is not None:
+            parts.append(inner())
+        if self.kv_server is not None:
+            parts.append(self.kv_server.render_metrics())
+        return "".join(parts)
 
     # ---------------- prefill result ingestion ----------------
 
@@ -178,12 +197,19 @@ class DisaggDecodeEngine:
         self.engine._register_stream(rid)
         adopted = False
         pool_full = False
+        # streamed (v2) transfers: every part that lands on the data plane is
+        # scattered into this sequence's pages while later parts (and the
+        # prefill itself) are still in flight — the final adopt only waits on
+        # the tail part. scatter_tasks orders those engine-thread writes
+        # before adoption/abort.
+        scatter_tasks: list[asyncio.Task] = []
+        injected_pages = [0]
         try:
             # inside the protected region: the engine thread allocates pages
             # even if this coroutine is cancelled mid-await, and the abort in
             # the finally is queued behind it (FIFO), so it always cleans up
             try:
-                cached_len, shared_pages = await self.engine.run_on_engine(
+                cached_len, shared_pages, page_ids = await self.engine.run_on_engine(
                     lambda: self.engine.sync_allocate_remote(rid, prompt)
                 )
             except MemoryError:
@@ -194,6 +220,30 @@ class DisaggDecodeEngine:
                 # instead of failing it
                 pool_full = True
             if not pool_full:
+                ps = self.engine.config.page_size
+                n_pages = -(-len(prompt) // ps)
+                start_page = shared_pages
+
+                def on_kv_part(part):
+                    # runs on the event loop as each part lands; sentinel
+                    # ranges (v1 monolithic frames) cover everything pending
+                    pf = part.page_from if part.page_from >= 0 else start_page
+                    pt = part.page_to if part.page_to >= 0 else n_pages
+                    ids = np.asarray(page_ids[pf:pt], np.int32)
+                    if len(ids) == 0:
+                        return
+                    data, axis = part.data, part.cat_axis
+                    self.parts_scattered += 1
+                    scatter_tasks.append(asyncio.create_task(
+                        self.engine.run_on_engine(
+                            lambda: self.engine.runner.inject_pages_bucketed(
+                                ids, data, axis=axis
+                            )
+                        )
+                    ))
+                    injected_pages[0] += len(ids)
+
+                self.kv_server.set_consumer(rid, on_kv_part)
                 rp = RemotePrefillRequest(
                     request_id=rid,
                     token_ids=prompt,
@@ -216,18 +266,26 @@ class DisaggDecodeEngine:
                 deadline = asyncio.get_running_loop().time() + self.remote_prefill_timeout
                 result: PrefillResult = await asyncio.wait_for(fut, self.remote_prefill_timeout)
                 kv_data = None
-                if result.kv_mode == "socket" and result.kv_shape:
+                if result.kv_mode == "socket" and (result.kv_shape or result.kv_parts):
                     # the result message is the notification; the payload
-                    # rides the dedicated socket and may land just after it
+                    # rides the dedicated socket and may land just after it.
+                    # Streamed transfers resolve to None here (the parts were
+                    # consumed on arrival) — this await is the tail-part gate.
                     remaining = max(0.05, deadline - asyncio.get_running_loop().time())
                     with tracing.span(
                         "disagg.kv_receive", request_id=rid,
                         trace_id=request.trace_id, mode="socket",
+                        parts=result.kv_parts,
                     ):
                         kv_data = await self.kv_server.receive(rid, timeout=remaining)
+                if scatter_tasks:
+                    # every incremental scatter must be on the page table
+                    # before adoption enters the sequence into decode
+                    await asyncio.gather(*scatter_tasks)
                 await self.engine.run_on_engine(
                     lambda: self.engine.sync_adopt_prefilled(
-                        request, result, cached_len, kv_data=kv_data
+                        request, result, cached_len, kv_data=kv_data,
+                        injected_pages=injected_pages[0],
                     )
                 )
                 adopted = True
@@ -245,6 +303,11 @@ class DisaggDecodeEngine:
             # the scheduler, since adoption may have completed on the engine
             # thread even though our await was cancelled
             self.kv_server.abandon(rid)
+            if scatter_tasks and not adopted:
+                # flush in-flight part scatters BEFORE freeing the pages: a
+                # scatter landing after the abort would write into pages the
+                # allocator may already have handed to another sequence
+                await asyncio.gather(*scatter_tasks, return_exceptions=True)
             if not adopted:
                 self._pending.pop(rid, None)
                 ici.discard_transfer(tkey)

@@ -2,18 +2,24 @@
 engine, and pushes KV + first token to the decode worker.
 
 Mirrors the reference prefill worker loop (reference: examples/llm/components/
-prefill_worker.py:84-137 prefill_queue_handler).
+prefill_worker.py:84-137 prefill_queue_handler). Cross-process KV rides the
+dedicated data plane; with streaming enabled (EngineConfig.kv_stream, the
+default) each prefill chunk's finalized pages are staged to host and put on
+the wire while the next chunk computes — so by the time the completion
+notification lands on the decode worker most KV bytes are already there.
 """
 
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Optional
 
 from dynamo_tpu.engine.engine import AsyncJaxEngine
 from dynamo_tpu.llm.remote_prefill import RemotePrefillRequest, prefill_queue_name
 from dynamo_tpu.runtime.context import RequestContext, use_context
 from dynamo_tpu.utils import get_logger, tracing
+from dynamo_tpu.utils.prometheus import render_family
 
 log = get_logger("disagg.prefill")
 
@@ -25,6 +31,8 @@ class PrefillWorker:
         drt,
         namespace: str,
         model: str,
+        kv_stream: Optional[bool] = None,
+        kv_stream_lanes: Optional[int] = None,
     ):
         self.engine = engine
         self.drt = drt
@@ -34,9 +42,24 @@ class PrefillWorker:
         self._task: Optional[asyncio.Task] = None
         self._clients: dict[str, object] = {}
         self.completed = 0
+        cfg = getattr(engine, "config", None)
+        if kv_stream is None:
+            kv_stream = getattr(cfg, "kv_stream", True)
+        if kv_stream_lanes is None:
+            kv_stream_lanes = getattr(cfg, "kv_stream_lanes", 2)
+        self.kv_stream = bool(kv_stream)
         from dynamo_tpu.disagg.dataplane import KvDataPlaneClient
 
-        self.kv_client = KvDataPlaneClient()
+        self.kv_client = KvDataPlaneClient(lanes=max(1, int(kv_stream_lanes or 1)))
+        # streamed-transfer observability: wall seconds a part spent on the
+        # wire (D2H complete -> drain), and the portion of that which
+        # overlapped the request's remaining prefill compute — the pipelining
+        # win the streamed protocol exists for
+        self.stream_requests = 0
+        self.stream_parts = 0
+        self.stream_bytes = 0
+        self.stream_send_s = 0.0
+        self.stream_overlap_s = 0.0
 
     async def start(self) -> "PrefillWorker":
         self._task = asyncio.create_task(self._loop())
@@ -115,6 +138,7 @@ class PrefillWorker:
         # notification. Neither: legacy inline bytes in the result.
         device = ici.is_local(rp.decode_worker_id)
         mode = "ici" if device else ("socket" if rp.kv_addr else "inline")
+        stream = mode == "socket" and self.kv_stream
         tkey = ici.transfer_key(rp.decode_worker_id, rp.request_id) if device else ""
         if tkey:
             # a redelivered message must not be swallowed by a tombstone a
@@ -123,17 +147,44 @@ class PrefillWorker:
             ici.clear_tombstone(tkey)
         result = None
         delivered = False
+        send_tasks: list[asyncio.Task] = []
+        loop = asyncio.get_running_loop()
+        cat_axis = getattr(self.engine.runner.model, "wire_n_axis", 2)
+
+        async def _ship(seq: int, total: int, pf: int, pt: int, d2h_fut):
+            arr = await asyncio.wrap_future(d2h_fut)  # D2H staged off-thread
+            t0 = time.monotonic()
+            await self.kv_client.send_part(
+                rp.kv_addr, rp.request_id, arr, token=rp.kv_token,
+                part_seq=seq, part_total=total,
+                page_from=pf, page_to=pt, cat_axis=cat_axis,
+            )
+            return t0, time.monotonic(), arr.nbytes
+
+        def on_part(seq, total, pf, pt, d2h_fut):
+            # engine thread -> event loop; tasks created in emission order so
+            # the send_tasks list is complete before run_on_engine resolves
+            # (both ride call_soon_threadsafe on the same loop, FIFO)
+            loop.call_soon_threadsafe(
+                lambda: send_tasks.append(
+                    asyncio.create_task(_ship(seq, total, pf, pt, d2h_fut))
+                )
+            )
+
         try:
             result, host_data = await self.engine.run_on_engine(
-                lambda: self.engine.sync_remote_prefill(rp, mode=mode)
+                lambda: self.engine.sync_remote_prefill(
+                    rp, mode=mode, on_part=on_part if stream else None
+                )
             )
+            t_compute_end = time.monotonic()
             client = await self._client_for(rp.decode_endpoint)
 
             async def deliver():
                 # deliver directly to the requesting decode worker (the
                 # RDMA-WRITE + notify analogue)
-                stream = await client.direct(result.to_wire(), rp.decode_worker_id)
-                async for ack in stream:
+                stream_out = await client.direct(result.to_wire(), rp.decode_worker_id)
+                async for ack in stream_out:
                     if not ack.get("ok"):
                         # permanent rejection (request cancelled/unknown on
                         # the decode side): drop the work — nacking would
@@ -145,17 +196,35 @@ class PrefillWorker:
                         return False
                 return True
 
+            # every payload part BEFORE the notification: a delivered result
+            # then implies the payload is on the wire, so a socket failure
+            # surfaces here (-> nack + redelivery) instead of stranding the
+            # decode side in a full receive() timeout after a notification
+            # whose payload will never arrive
+            if send_tasks:
+                with tracing.span(
+                    "disagg.kv_stream", parts=len(send_tasks), mode="socket"
+                ):
+                    spans = await asyncio.gather(*send_tasks)
+                send_s = sum(t1 - t0 for t0, t1, _ in spans)
+                overlap = sum(
+                    max(0.0, min(t1, t_compute_end) - t0) for t0, t1, _ in spans
+                )
+                self.stream_requests += 1
+                self.stream_parts += len(spans)
+                self.stream_bytes += sum(b for _, _, b in spans)
+                self.stream_send_s += send_s
+                self.stream_overlap_s += overlap
             if host_data is not None:
-                # payload BEFORE notification: a delivered result then implies
-                # the payload is on the wire, so a socket failure surfaces
-                # here (-> nack + redelivery) instead of stranding the decode
-                # side in a full receive() timeout after a notification whose
-                # payload will never arrive
+                ps = self.engine.config.page_size
                 with tracing.span(
                     "disagg.kv_send", bytes=int(host_data.nbytes), mode="socket"
                 ):
                     await self.kv_client.send(
-                        rp.kv_addr, rp.request_id, host_data, token=rp.kv_token
+                        rp.kv_addr, rp.request_id, host_data, token=rp.kv_token,
+                        page_from=result.skip_leading_tokens // ps,
+                        page_to=-(-result.prompt_len // ps),
+                        cat_axis=cat_axis,
                     )
             ok = await deliver()
             if not ok:
@@ -170,6 +239,34 @@ class PrefillWorker:
                 ici.discard_transfer(tkey)
             raise
         finally:
+            if send_tasks and not delivered:
+                # a failed/cancelled request must not leave part sends (or
+                # their D2H waits) dangling into the next queue item
+                for t in send_tasks:
+                    t.cancel()
+                await asyncio.gather(*send_tasks, return_exceptions=True)
             if not delivered and result is not None and result.kv_transfer_id:
                 # park happened but delivery/ack failed: drop the real array
                 ici.pop_transfer(result.kv_transfer_id)
+
+    def render_metrics(self) -> str:
+        """Prometheus exposition for the send side of the KV stream: the
+        client frame/byte/lane counters plus the measured compute/transfer
+        overlap the chunk pipelining buys."""
+        return self.kv_client.render_metrics() + "".join([
+            render_family(
+                "dynamo_kv_stream_requests_total", "counter",
+                "remote prefills whose KV was chunk-streamed",
+                [({}, self.stream_requests)],
+            ),
+            render_family(
+                "dynamo_kv_stream_send_seconds_total", "counter",
+                "wall seconds KV parts spent on the wire (D2H done -> drained)",
+                [({}, round(self.stream_send_s, 6))],
+            ),
+            render_family(
+                "dynamo_kv_stream_overlap_seconds_total", "counter",
+                "portion of part send seconds overlapped with prefill compute",
+                [({}, round(self.stream_overlap_s, 6))],
+            ),
+        ])

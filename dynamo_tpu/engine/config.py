@@ -44,6 +44,17 @@ class EngineConfig:
     # Requests with penalties, logprobs, min_tokens, or images fall back to
     # the classic decode windows automatically.
     speculative: str | None = None
+    # cross-process disaggregation data plane (dynamo_tpu/disagg/dataplane.py):
+    # stream KV to the decode worker per finished prefill chunk (v2 multi-part
+    # wire protocol) instead of one monolithic post-prefill send. Streaming
+    # overlaps the D2H staging + socket transfer of chunk i with chunk i+1's
+    # compute, so the decode side holds most KV bytes by the time the
+    # completion notification lands. False = legacy single-payload send.
+    kv_stream: bool = True
+    # parallel data-plane connections per destination; parts stripe across
+    # lanes so one long prompt's multi-MB parts never head-of-line-block
+    # other requests' transfers behind a single per-destination socket
+    kv_stream_lanes: int = 2
     worker_id: str = "worker-0"
     # fraction of pages that must stay free for decode growth before admitting
     # a new sequence (simple admission control)
@@ -96,6 +107,10 @@ class EngineConfig:
                 raise ValueError(
                     f"quantize must be None or one of {QUANT_MODES}; got {self.quantize!r}"
                 )
+        if self.kv_stream_lanes < 1:
+            raise ValueError(
+                f"kv_stream_lanes must be >= 1; got {self.kv_stream_lanes}"
+            )
         # a bad speculative spec must fail at config time, not mid-serving
         self.spec  # noqa: B018 — parse_speculative raises on invalid input
 

@@ -255,11 +255,15 @@ class AsyncJaxEngine:
     def sync_lookup_prefix(self, token_ids: list[int]) -> int:
         return self.allocator.lookup_prefix(token_ids)
 
-    def sync_allocate_remote(self, request_id: str, token_ids: list[int]) -> tuple[int, int]:
+    def sync_allocate_remote(
+        self, request_id: str, token_ids: list[int]
+    ) -> tuple[int, int, list[int]]:
         """Decode side: allocate pages for a remote-prefill sequence.
-        Returns (cached_len, shared_prefix_pages)."""
+        Returns (cached_len, shared_prefix_pages, page_ids) — the page ids in
+        logical order, so the caller can scatter streamed KV parts into them
+        as the parts land, before adoption."""
         cached_len, state = self.allocator.allocate_sequence(request_id, token_ids)
-        return cached_len, state.shared_prefix_pages
+        return cached_len, state.shared_prefix_pages, list(state.pages)
 
     def sync_abort_remote(self, request_id: str) -> None:
         """Abort a remote-prefill request at ANY stage: adoption may already
@@ -271,7 +275,9 @@ class AsyncJaxEngine:
             if request_id in self.allocator._seqs:
                 self.allocator.free_sequence(request_id)
 
-    def sync_remote_prefill(self, rp, device: bool = False, mode: str | None = None):
+    def sync_remote_prefill(
+        self, rp, device: bool = False, mode: str | None = None, on_part=None
+    ):
         """Prefill side: full chunked prefill in our own cache (prefix cache
         applies), then extract the requested block range.
 
@@ -283,8 +289,17 @@ class AsyncJaxEngine:
           - "socket" — KV staged to host and RETURNED alongside the result;
             the caller ships it over the dedicated data plane
             (disagg/dataplane.py) while the result message becomes the
-            completion notification"""
+            completion notification
+
+        ``on_part`` (socket mode only) switches to the CHUNK-STREAMED export:
+        instead of one monolithic post-prefill pull, pages finalized by each
+        prefill chunk are gathered immediately (D2H resolved off this thread,
+        see ModelRunner.extract_pages_async) and handed to
+        ``on_part(part_seq, part_total, page_from, page_to, host_future)``
+        while the next chunk computes. The result then carries
+        ``kv_parts == part_total`` and no host_data."""
         from dynamo_tpu.disagg import ici
+        from dynamo_tpu.disagg.dataplane import stream_part_plan
         from dynamo_tpu.engine.sampling import SamplingParams
         from dynamo_tpu.llm.remote_prefill import PrefillResult
 
@@ -293,6 +308,16 @@ class AsyncJaxEngine:
         rid = f"rp-{rp.request_id}"
         prompt_len = len(rp.token_ids)
         cached_len, state = self.allocator.allocate_sequence(rid, list(rp.token_ids))
+        ps = self.config.page_size
+        start_page = rp.skip_leading_tokens // ps
+        n_pages = -(-prompt_len // ps)
+        plan = (
+            stream_part_plan(
+                start_page, cached_len, prompt_len, ps, self.config.max_prefill_chunk
+            )
+            if (mode == "socket" and on_part is not None)
+            else []
+        )
         try:
             page_table = self._page_table_for(state)
             req = EngineRequest(
@@ -303,23 +328,48 @@ class AsyncJaxEngine:
                 ),
                 trace_id=rp.trace_id or None,
             )
-            first_token = self.scheduler.run_prefill_chunks(req, page_table, cached_len, prompt_len)
-            self.allocator.commit_prefilled(rid, prompt_len)
-
-            ps = self.config.page_size
-            start_page = rp.skip_leading_tokens // ps
-            n_pages = -(-prompt_len // ps)
-            ids = state.pages[start_page:n_pages]
             data = None
-            if ids:
-                with tracing.span(
-                    "disagg.kv_extract", request_id=rp.request_id,
-                    trace_id=req.trace_id, pages=len(ids), mode=mode,
-                ):
-                    if mode == "ici":
-                        data = self.runner.extract_pages_device(np.asarray(ids, np.int32))
-                    else:
-                        data = self.runner.extract_pages(np.asarray(ids, np.int32))
+            if plan:
+                total = len(plan)
+                next_part = [0]
+
+                def flush(tokens_final: int, last: bool) -> None:
+                    limit = n_pages if last else tokens_final // ps
+                    while next_part[0] < total and plan[next_part[0]][1] <= limit:
+                        pf, pt = plan[next_part[0]]
+                        ids = np.asarray(state.pages[pf:pt], np.int32)
+                        with tracing.span(
+                            "disagg.kv_extract", request_id=rp.request_id,
+                            trace_id=req.trace_id, pages=len(ids), mode="socket",
+                            part=next_part[0],
+                        ):
+                            fut = self.runner.extract_pages_async(ids)
+                        on_part(next_part[0], total, pf, pt, fut)
+                        next_part[0] += 1
+
+                # prefix-cached pages below cached_len are final already;
+                # everything else ships as its finalizing chunk completes
+                flush(cached_len, False)
+                first_token = self.scheduler.run_prefill_chunks(
+                    req, page_table, cached_len, prompt_len,
+                    on_chunk=lambda s, e: flush(e, e == prompt_len),
+                )
+                self.allocator.commit_prefilled(rid, prompt_len)
+            else:
+                first_token = self.scheduler.run_prefill_chunks(
+                    req, page_table, cached_len, prompt_len
+                )
+                self.allocator.commit_prefilled(rid, prompt_len)
+                ids = state.pages[start_page:n_pages]
+                if ids:
+                    with tracing.span(
+                        "disagg.kv_extract", request_id=rp.request_id,
+                        trace_id=req.trace_id, pages=len(ids), mode=mode,
+                    ):
+                        if mode == "ici":
+                            data = self.runner.extract_pages_device(np.asarray(ids, np.int32))
+                        else:
+                            data = self.runner.extract_pages(np.asarray(ids, np.int32))
         finally:
             self.allocator.free_sequence(rid)  # full blocks stay cached for reuse
 
@@ -337,16 +387,22 @@ class AsyncJaxEngine:
             kv_dtype=str(data.dtype) if data is not None else "",
             kv_bytes=data.tobytes() if (data is not None and mode == "inline") else b"",
             kv_transfer_id=transfer_id,
-            kv_mode=mode if data is not None else "inline",
+            kv_mode="socket" if plan else (mode if data is not None else "inline"),
+            kv_parts=len(plan),
         )
         return result, (data if mode == "socket" else None)
 
-    def sync_adopt_prefilled(self, req: EngineRequest, result, cached_len: int, kv_data=None):
+    def sync_adopt_prefilled(
+        self, req: EngineRequest, result, cached_len: int, kv_data=None,
+        injected_pages: int = 0,
+    ):
         """Decode side: inject received KV blocks into the pre-allocated pages
         and enter the sequence into decode. KV arrives as wire bytes (inline),
-        as a device array via the ici hub (same-pod path), or as a host array
+        as a device array via the ici hub (same-pod path), as a host array
         the caller already pulled off the dedicated data-plane socket
-        (``kv_data``)."""
+        (``kv_data``), or — the streamed path — scattered incrementally as
+        parts landed, in which case ``injected_pages`` says how many pages
+        the caller already wrote and this adopt only validates the count."""
         from dynamo_tpu.disagg import ici
 
         state = self.allocator._seqs[req.request_id]
@@ -369,6 +425,15 @@ class AsyncJaxEngine:
                 trace_id=req.trace_id, pages=len(ids), mode=result.kv_mode,
             ):
                 self.runner.inject_pages(np.asarray(ids, np.int32), data)
+        elif injected_pages:
+            # streamed adoption: every part was scattered on arrival; a count
+            # mismatch means a part was lost — decoding from the hole's
+            # uninitialized pages would be silent corruption
+            if injected_pages != len(ids):
+                raise RuntimeError(
+                    f"streamed KV for {req.request_id} injected "
+                    f"{injected_pages} pages, expected {len(ids)}"
+                )
         elif ids:
             # pages were expected to be filled remotely but the result carried
             # no KV (e.g. a swallowed transfer): adopting would decode from

@@ -1221,6 +1221,48 @@ class ModelRunner:
         """
         return np.asarray(jax.device_get(self.extract_pages_device(page_ids)))
 
+    def extract_pages_async(self, page_ids: np.ndarray):
+        """Chunk-streamed export: dispatch the device gather NOW (on the
+        engine thread, so it enqueues right behind the prefill chunk that
+        finalized these pages) and resolve the blocking device->host copy on
+        a two-worker side pool. Returns a concurrent.futures.Future of the
+        host numpy array. Double-buffered by construction: the engine thread
+        is free to dispatch chunk i+1's compute while chunk i's pages drain
+        to host, and at most two pulls are ever in flight."""
+        dev = self.extract_pages_device(page_ids)
+        pool = getattr(self, "_d2h_pool", None)
+        if pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            pool = self._d2h_pool = ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="kv-d2h"
+            )
+        return pool.submit(lambda: np.asarray(jax.device_get(dev)))
+
+    def inject_pages_bucketed(self, page_ids: np.ndarray, data, axis=None) -> None:
+        """Scatter a PARTIAL run of pages, padded to a power-of-two id count
+        (the HostKvPool.load_many trick): pad ids are out of range so the
+        donated scatter drops them. Streamed KV parts and prefix restores
+        arrive in arbitrary sizes; without bucketing every distinct size
+        would compile its own scatter executable."""
+        if axis is None:
+            axis = getattr(self.model, "wire_n_axis", 2)
+        ids = np.asarray(page_ids, np.int32)
+        n = len(ids)
+        if n == 0:
+            return
+        bucket = 1 << (n - 1).bit_length()
+        if bucket > n:
+            padded = np.full(bucket, np.iinfo(np.int32).max // 2, np.int32)
+            padded[:n] = ids
+            ids = padded
+            pad_shape = list(data.shape)
+            pad_shape[axis] = bucket - n
+            data = np.concatenate(
+                [data, np.zeros(pad_shape, data.dtype)], axis=axis
+            )
+        self.inject_pages(ids, data)
+
     def inject_pages(self, page_ids: np.ndarray, data) -> None:
         """Write KV blocks received from a peer into our pages (donated
         scatter). ``data`` may be host numpy (DCN path) or a device array from

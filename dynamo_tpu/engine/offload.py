@@ -96,20 +96,14 @@ class HostKvPool:
         if not hits:
             return set()
         axis = getattr(self.runner.model, "wire_n_axis", 2)
-        # pad the batch to a power of two so the donated scatter compiles a
-        # handful of shapes, not one per distinct prefix length; pad ids are
-        # out of range -> dropped by the scatter
+        # the batch is padded to a power of two inside inject_pages_bucketed
+        # (shared with the streamed-disagg part scatter) so the donated
+        # scatter compiles a handful of shapes, not one per prefix length
         n = len(hits)
-        bucket = 1 << (n - 1).bit_length()
         t0 = time.monotonic()
         data = np.concatenate([self._blocks[h] for h, _ in hits], axis=axis)
-        ids = np.full(bucket, np.iinfo(np.int32).max // 2, np.int32)
-        ids[:n] = [p for _, p in hits]
-        if bucket > n:
-            pad_shape = list(data.shape)
-            pad_shape[axis] = bucket - n
-            data = np.concatenate([data, np.zeros(pad_shape, data.dtype)], axis=axis)
-        self.runner.inject_pages(ids, data)
+        ids = np.asarray([p for _, p in hits], np.int32)
+        self.runner.inject_pages_bucketed(ids, data, axis=axis)
         dt = time.monotonic() - t0
         self.transfer_s += dt
         tracing.record_span("engine.kv_offload.restore", t0, duration=dt,

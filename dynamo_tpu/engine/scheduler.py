@@ -655,11 +655,15 @@ class Scheduler:
         sync: bool = True,
         want_logprobs: bool = False,
         prep: bool = True,
+        on_chunk=None,
     ):
         """Bucket-chunked prefill, skipping the cached prefix; samples the first
         output token on the final chunk. sync=True (disagg prefill-worker path)
         returns it as a host int; sync=False returns the device scalar.
-        prep=False skips _prep_prefill (already run at packed-path admission)."""
+        prep=False skips _prep_prefill (already run at packed-path admission).
+        on_chunk(start, end) fires after each chunk's dispatch — the streamed
+        disagg export hook: pages finalized by the chunk can be exported (and
+        put on the wire) while the next chunk computes."""
         rows = max(0, prompt_len - cached_len)
         self.local_prefill_rows += rows
         s = req.sampling
@@ -693,6 +697,8 @@ class Scheduler:
             )
             if is_last:
                 first_token = tok
+            if on_chunk is not None:
+                on_chunk(start, end)
             start = end
         dt = time.monotonic() - t0
         self.stage.prefill_s += dt

@@ -44,6 +44,10 @@ def engine_config_for(args):
         if isinstance(overrides, dict) and "speculative" in overrides:
             speculative = speculative or overrides.pop("speculative")
             model_path = fam + (":" + json.dumps(overrides) if overrides else "")
+    # disagg data-plane knobs (graph yaml / CLI): default chunk-streamed
+    ks = getattr(args, "kv_stream", None)
+    kv_stream = True if ks is None else bool(ks)
+    kv_stream_lanes = getattr(args, "kv_stream_lanes", None) or 2
     if is_tiny:
         return EngineConfig(
             model_id=model_path,
@@ -56,6 +60,8 @@ def engine_config_for(args):
             pp=getattr(args, "pp", None) or 1,
             quantize=getattr(args, "quantize", None),
             speculative=speculative,
+            kv_stream=kv_stream,
+            kv_stream_lanes=kv_stream_lanes,
         )
     return EngineConfig(
         model_id=model_path,
@@ -67,6 +73,8 @@ def engine_config_for(args):
         pp=getattr(args, "pp", None) or 1,
         quantize=getattr(args, "quantize", None),
         speculative=speculative,
+        kv_stream=kv_stream,
+        kv_stream_lanes=kv_stream_lanes,
         # serve as soon as the core traces compile; feature variants land in
         # the background (halves cold first-deploy readiness time)
         warmup="background",

@@ -70,6 +70,11 @@ class PrefillResult:
     # (dedicated data-plane TCP stream; this message is the completion
     # notification for a payload arriving on the decode worker's kv_addr)
     kv_mode: str = "inline"
+    # streamed socket transfers: how many v2 parts the payload was split into
+    # (dataplane.py stream_part_plan); 0 = monolithic (kv_shape describes the
+    # single payload). With parts > 0 the decode side scatters each part as
+    # it lands and the final adopt only waits on the tail part.
+    kv_parts: int = 0
 
     def to_wire(self) -> dict:
         return {
@@ -82,6 +87,7 @@ class PrefillResult:
             "kv_bytes": self.kv_bytes,
             "kv_transfer_id": self.kv_transfer_id,
             "kv_mode": self.kv_mode,
+            "kv_parts": self.kv_parts,
         }
 
     @classmethod
@@ -96,6 +102,7 @@ class PrefillResult:
             kv_bytes=d["kv_bytes"],
             kv_transfer_id=d.get("kv_transfer_id", ""),
             kv_mode=d.get("kv_mode", "ici" if d.get("kv_transfer_id") else "inline"),
+            kv_parts=int(d.get("kv_parts", 0)),
         )
 
     def kv_array(self) -> np.ndarray:

@@ -48,11 +48,16 @@ LONG_PROMPT = [5, 9, 2, 77, 31, 8, 100, 42, 17, 3, 60, 61]  # 12 tokens > thresh
 SHORT_PROMPT = [5, 9, 2]
 
 
-@pytest.mark.parametrize("force_dcn", [False, True], ids=["ici", "dcn"])
-def test_disagg_matches_local(force_dcn, monkeypatch):
+@pytest.mark.parametrize(
+    "force_dcn,stream",
+    [(False, True), (True, True), (True, False)],
+    ids=["ici", "dcn-streamed", "dcn-monolithic"],
+)
+def test_disagg_matches_local(force_dcn, stream, monkeypatch):
     """force_dcn=False: same-process workers use the device (ICI) KV handoff.
-    force_dcn=True: the decode engine looks remote, so KV is host-staged and
-    shipped as bytes over the data plane (the cross-pod DCN path)."""
+    force_dcn=True: the decode engine looks remote, so KV rides the data
+    plane — chunk-streamed v2 parts by default (stream=True), or the legacy
+    monolithic single payload (stream=False); both must stay token-exact."""
     if force_dcn:
         from dynamo_tpu.disagg import ici
 
@@ -70,7 +75,7 @@ def test_disagg_matches_local(force_dcn, monkeypatch):
 
         decode_inner = AsyncJaxEngine(tiny_engine_config())
         await decode_inner.start()
-        prefill_engine = AsyncJaxEngine(tiny_engine_config())
+        prefill_engine = AsyncJaxEngine(tiny_engine_config(kv_stream=stream))
         await prefill_engine.start()
         local_engine = AsyncJaxEngine(tiny_engine_config())
         await local_engine.start()
@@ -104,6 +109,15 @@ def test_disagg_matches_local(force_dcn, monkeypatch):
             else:
                 assert ici.total_transfers() == transfers_before + 1
             assert ici.transfer_count() == 0
+            if force_dcn and stream:
+                # v2 streamed transfer actually ran: parts on the wire from
+                # the prefill side, incremental scatters on the decode side
+                assert prefill_worker.stream_parts >= 1
+                assert prefill_worker.stream_requests == 1
+                assert decode.parts_scattered >= 1
+                assert decode.kv_server.parts_received >= 1
+            elif force_dcn:
+                assert prefill_worker.stream_parts == 0
 
             # short prompt stays local
             expected_s, _ = await collect(local_engine, req_for("ref2", SHORT_PROMPT))
@@ -117,6 +131,77 @@ def test_disagg_matches_local(force_dcn, monkeypatch):
             got2, _ = await collect(decode, req_for("d3", LONG_PROMPT))
             assert got2 == expected
             assert decode.remote_prefills == 1  # unchanged: went local via cache
+        finally:
+            await prefill_worker.stop()
+            await decode.shutdown()
+            await prefill_engine.shutdown()
+            await local_engine.shutdown()
+            await decode_rt._shutdown_hook()
+            await prefill_rt._shutdown_hook()
+            await broker.stop()
+
+    asyncio.run(body())
+
+
+def test_disagg_streamed_multichunk_parity(monkeypatch):
+    """A prompt spanning several prefill chunks exercises the real pipelined
+    path: multiple parts per request (one per chunk boundary), striped across
+    2 client lanes, scattered incrementally on the decode side — and the
+    output must stay token-exact vs a purely local engine."""
+    from dynamo_tpu.disagg import ici
+
+    monkeypatch.setattr(ici, "is_local", lambda worker_id: False)
+    # 44 tokens over (8,16) buckets -> chunks [0,16),[16,32),[32,44) -> 3
+    # parts at page_size 4 (pages 0-4, 4-8, 8-11)
+    prompt = [(7 * i + 3) % 90 + 1 for i in range(44)]
+
+    async def body():
+        broker = Broker()
+        port = await broker.start()
+        addr = f"127.0.0.1:{port}"
+        decode_rt = DistributedRuntime(cplane_address=addr)
+        await decode_rt.connect()
+        prefill_rt = DistributedRuntime(cplane_address=addr)
+        await prefill_rt.connect()
+
+        cfg = dict(prefill_buckets=(8, 16), num_pages=128, max_model_len=64)
+        decode_inner = AsyncJaxEngine(tiny_engine_config(**cfg))
+        await decode_inner.start()
+        prefill_engine = AsyncJaxEngine(
+            tiny_engine_config(**cfg, kv_stream=True, kv_stream_lanes=2)
+        )
+        await prefill_engine.start()
+        local_engine = AsyncJaxEngine(tiny_engine_config(**cfg))
+        await local_engine.start()
+
+        router = DisaggregatedRouter(
+            "tiny", conf=DisaggRouterConf(max_local_prefill_length=6)
+        )
+        decode = DisaggDecodeEngine(
+            decode_inner, decode_rt, "nsc", "decoder", "tiny", disagg_router=router
+        )
+        await decode.start()
+        prefill_worker = PrefillWorker(prefill_engine, prefill_rt, "nsc", "tiny")
+        await prefill_worker.start()
+
+        try:
+            expected, _ = await collect(local_engine, req_for("ref", prompt, n=6))
+            got, _ = await collect(decode, req_for("d1", prompt, n=6))
+            assert got == expected, f"streamed disagg {got} != local {expected}"
+            assert decode.remote_prefills == 1
+            # the multi-chunk prompt split into several parts, all scattered
+            # before adoption; the client really striped across both lanes
+            assert prefill_worker.stream_parts == 3
+            assert decode.parts_scattered == 3
+            assert decode.kv_server.parts_received == 3
+            assert decode.kv_server.received == 1
+            assert len(prefill_worker.kv_client._conns) == 2
+            # the transfer actually moved wall-clock transfer time, and the
+            # overlap accounting is bounded by it
+            assert prefill_worker.stream_send_s >= 0.0
+            assert 0.0 <= prefill_worker.stream_overlap_s <= (
+                prefill_worker.stream_send_s + 1e-9
+            )
         finally:
             await prefill_worker.stop()
             await decode.shutdown()

@@ -1,0 +1,324 @@
+"""KV data-plane failure paths + the v2 streamed wire protocol, over loopback
+sockets only — no engines, no device code, so this file rides the fast tier.
+
+Covers the ISSUE-4 satellite list: bad-nonce rejection, duplicate-payload
+drop, abandon() followed by a late payload, client reconnect after a server
+restart, multi-part reassembly (out-of-order lanes), and a missing tail part
+timing out — plus the checksum-mismatch isolation fix and the deterministic
+chunk->part plan the streamed prefill export uses."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.disagg.dataplane import (
+    KvDataPlaneClient,
+    KvDataPlaneServer,
+    stream_part_plan,
+)
+
+
+def _arr(n: int, seed: int = 0) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(2, n, 4)).astype(np.float32)
+
+
+async def _fleet(lanes: int = 1):
+    server = await KvDataPlaneServer(host="127.0.0.1").start()
+    client = KvDataPlaneClient(lanes=lanes)
+    return server, client
+
+
+def test_monolithic_roundtrip():
+    async def body():
+        server, client = await _fleet()
+        try:
+            token = server.expect("r1")
+            payload = _arr(3)
+            await client.send(server.address, "r1", payload, token=token)
+            got = await server.receive("r1", timeout=5)
+            np.testing.assert_array_equal(got, payload)
+            assert server.received == 1
+            assert server.parts_received == 1
+            assert server.bytes_received == payload.nbytes
+            assert client.sent == 1
+            assert client.bytes_sent == payload.nbytes
+        finally:
+            await client.close()
+            await server.stop()
+
+    asyncio.run(body())
+
+
+def test_bad_nonce_rejected_then_good_payload_lands():
+    async def body():
+        server, client = await _fleet()
+        try:
+            token = server.expect("r1")
+            payload = _arr(2)
+            await client.send(server.address, "r1", payload, token="forged")
+            for _ in range(100):
+                if server.rejected:
+                    break
+                await asyncio.sleep(0.01)
+            # the rejected frame must count AND must not poison the transfer:
+            # the legitimate sender's payload still lands afterwards
+            assert server.rejected == 1
+            assert server.received == 0
+            await client.send(server.address, "r1", payload, token=token)
+            got = await server.receive("r1", timeout=5)
+            np.testing.assert_array_equal(got, payload)
+            assert server.received == 1
+        finally:
+            await client.close()
+            await server.stop()
+
+    asyncio.run(body())
+
+
+def test_duplicate_part_dropped():
+    async def body():
+        server, client = await _fleet()
+        try:
+            token = server.expect("r2")
+            p0, p1 = _arr(2, seed=1), _arr(3, seed=2)
+            await client.send_part(server.address, "r2", p0, token=token,
+                                   part_seq=0, part_total=2, page_from=0,
+                                   page_to=2, cat_axis=1)
+            # duplicate of part 0 (a redelivered/retried frame)
+            await client.send_part(server.address, "r2", p0, token=token,
+                                   part_seq=0, part_total=2, page_from=0,
+                                   page_to=2, cat_axis=1)
+            await client.send_part(server.address, "r2", p1, token=token,
+                                   part_seq=1, part_total=2, page_from=2,
+                                   page_to=5, cat_axis=1)
+            got = await server.receive("r2", timeout=5)
+            np.testing.assert_array_equal(got, np.concatenate([p0, p1], axis=1))
+            assert server.dropped == 1
+            assert server.received == 1
+            assert server.parts_received == 2  # the duplicate never counted
+        finally:
+            await client.close()
+            await server.stop()
+
+    asyncio.run(body())
+
+
+def test_abandon_then_late_payload_dropped():
+    async def body():
+        server, client = await _fleet()
+        try:
+            token = server.expect("r3")
+            server.abandon("r3")
+            await client.send(server.address, "r3", _arr(2), token=token)
+            for _ in range(50):
+                if server.dropped:
+                    break
+                await asyncio.sleep(0.01)
+            assert server.dropped == 1
+            assert server.received == 0
+        finally:
+            await client.close()
+            await server.stop()
+
+    asyncio.run(body())
+
+
+def test_client_reconnects_after_server_restart():
+    async def body():
+        server, client = await _fleet()
+        port = server.port
+        token = server.expect("warm")
+        await client.send(server.address, "warm", _arr(1), token=token)
+        await server.receive("warm", timeout=5)
+        await server.stop()
+        # same port, fresh server: the pooled socket is now stale
+        server2 = await KvDataPlaneServer(host="127.0.0.1").start(port=port)
+        try:
+            await asyncio.sleep(0.2)  # let the FIN reach the pooled reader
+            token2 = server2.expect("r4")
+            payload = _arr(4)
+            await client.send(server2.address, "r4", payload, token=token2)
+            got = await server2.receive("r4", timeout=5)
+            np.testing.assert_array_equal(got, payload)
+        finally:
+            await client.close()
+            await server2.stop()
+
+    asyncio.run(body())
+
+
+def test_multipart_reassembly_out_of_order_lanes():
+    async def body():
+        server, client = await _fleet(lanes=3)
+        try:
+            token = server.expect("r5")
+            parts = [_arr(2, seed=i) for i in range(3)]
+            # arrival order scrambled across the 3 lanes: 2, 0, 1
+            for seq in (2, 0, 1):
+                await client.send_part(
+                    server.address, "r5", parts[seq], token=token,
+                    part_seq=seq, part_total=3,
+                    page_from=2 * seq, page_to=2 * seq + 2, cat_axis=1,
+                )
+            got = await server.receive("r5", timeout=5)
+            np.testing.assert_array_equal(got, np.concatenate(parts, axis=1))
+            assert server.received == 1
+            assert server.parts_received == 3
+            # all three lanes actually opened
+            assert len(client._conns) == 3
+        finally:
+            await client.close()
+            await server.stop()
+
+    asyncio.run(body())
+
+
+def test_missing_tail_part_times_out():
+    async def body():
+        server, client = await _fleet()
+        try:
+            token = server.expect("r6")
+            await client.send_part(server.address, "r6", _arr(2), token=token,
+                                   part_seq=0, part_total=2, page_from=0,
+                                   page_to=2, cat_axis=1)
+            with pytest.raises(asyncio.TimeoutError):
+                await server.receive("r6", timeout=0.3)
+            assert server.parts_received == 1
+            assert server.received == 0
+        finally:
+            await client.close()
+            await server.stop()
+
+    asyncio.run(body())
+
+
+def test_checksum_mismatch_kills_one_transfer_not_the_connection():
+    import msgpack
+    import struct
+
+    async def body():
+        server = await KvDataPlaneServer(host="127.0.0.1").start()
+        try:
+            token_bad = server.expect("corrupt")
+            token_good = server.expect("clean")
+            reader, writer = await asyncio.open_connection("127.0.0.1", server.port)
+            bad_payload = _arr(2)
+            raw = np.ascontiguousarray(bad_payload).view(np.uint8).reshape(-1)
+            header = msgpack.packb({
+                "request_id": "corrupt", "shape": list(bad_payload.shape),
+                "dtype": str(bad_payload.dtype), "xxh3": 12345,  # wrong
+                "token": token_bad,
+            })
+            writer.write(struct.pack("<I", len(header)))
+            writer.write(header)
+            writer.write(raw.tobytes())
+            await writer.drain()
+            # the SAME connection must keep working for an unrelated transfer
+            client = KvDataPlaneClient()
+            good = _arr(3, seed=7)
+            await client.send(server.address, "clean", good, token=token_good)
+            got = await server.receive("clean", timeout=5)
+            np.testing.assert_array_equal(got, good)
+            assert server.checksum_failures == 1
+            # the corrupt transfer failed fast instead of timing out
+            with pytest.raises(RuntimeError, match="checksum"):
+                await server.receive("corrupt", timeout=5)
+            writer.close()
+            await client.close()
+        finally:
+            await server.stop()
+
+    asyncio.run(body())
+
+
+def test_incremental_consumer_and_late_attach_flush():
+    async def body():
+        server, client = await _fleet(lanes=2)
+        try:
+            token = server.expect("r7")
+            parts = [_arr(2, seed=i + 10) for i in range(3)]
+            # part 0 arrives BEFORE the consumer attaches: it parks
+            await client.send_part(server.address, "r7", parts[0], token=token,
+                                   part_seq=0, part_total=3, page_from=0,
+                                   page_to=2, cat_axis=1)
+            for _ in range(100):
+                if server.parts_received:
+                    break
+                await asyncio.sleep(0.01)
+            seen = []
+            server.set_consumer("r7", lambda part: seen.append(part))
+            assert [p.seq for p in seen] == [0]  # parked part flushed
+            for seq in (2, 1):
+                await client.send_part(
+                    server.address, "r7", parts[seq], token=token,
+                    part_seq=seq, part_total=3,
+                    page_from=2 * seq, page_to=2 * seq + 2, cat_axis=1,
+                )
+            # consumer mode: receive() is only the completion gate
+            assert await server.receive("r7", timeout=5) is None
+            assert sorted(p.seq for p in seen) == [0, 1, 2]
+            for p in seen:
+                np.testing.assert_array_equal(p.data, parts[p.seq])
+            assert [(p.page_from, p.page_to) for p in sorted(seen, key=lambda p: p.seq)] == \
+                [(0, 2), (2, 4), (4, 6)]
+        finally:
+            await client.close()
+            await server.stop()
+
+    asyncio.run(body())
+
+
+def test_metrics_exposition_conformant():
+    from dynamo_tpu.utils.prometheus import check_exposition
+
+    async def body():
+        server, client = await _fleet(lanes=2)
+        try:
+            token = server.expect("m1")
+            await client.send(server.address, "m1", _arr(2), token=token)
+            await server.receive("m1", timeout=5)
+            text = server.render_metrics() + client.render_metrics()
+            assert "dynamo_kv_stream_parts_received_total 1" in text
+            assert "dynamo_kv_stream_lanes 2" in text
+            check_exposition(text)
+        finally:
+            await client.close()
+            await server.stop()
+
+    asyncio.run(body())
+
+
+def test_stream_part_plan_shapes():
+    # no cache, 3 chunks of 8 over 20 tokens, page_size 4 -> parts at each
+    # chunk boundary's full pages, tail part closes the ragged last page
+    assert stream_part_plan(0, 0, 20, 4, 8) == [(0, 2), (2, 4), (4, 5)]
+    # prefill-side prefix cache: cached pages ship immediately as one part
+    assert stream_part_plan(0, 8, 20, 4, 8) == [(0, 2), (2, 4), (4, 5)]
+    # decode-side shared prefix (skip_leading): pages below start_page never ship
+    assert stream_part_plan(2, 0, 20, 4, 8) == [(2, 4), (4, 5)]
+    # cache beyond the skip: leading cached part starts at start_page
+    assert stream_part_plan(1, 8, 20, 4, 8) == [(1, 2), (2, 4), (4, 5)]
+    # single chunk -> single part
+    assert stream_part_plan(0, 0, 8, 4, 32) == [(0, 2)]
+    # fully covered by the decode side's shared prefix -> nothing to send
+    assert stream_part_plan(5, 0, 20, 4, 8) == []
+    # non-page-aligned cache (cached_len = prompt_len - 1 style): the
+    # partially-cached page ships with the chunk that finalizes it
+    assert stream_part_plan(0, 7, 20, 4, 8) == [(0, 1), (1, 3), (3, 5)]
+
+
+def test_prefill_result_kv_parts_wire_roundtrip():
+    from dynamo_tpu.llm.remote_prefill import PrefillResult
+
+    r = PrefillResult(
+        request_id="x", first_token=5, prompt_len=20, skip_leading_tokens=0,
+        kv_shape=(), kv_dtype="", kv_bytes=b"", kv_mode="socket", kv_parts=3,
+    )
+    rt = PrefillResult.from_wire(r.to_wire())
+    assert rt.kv_parts == 3 and rt.kv_mode == "socket"
+    # pre-v2 senders omit the field entirely
+    legacy = r.to_wire()
+    legacy.pop("kv_parts")
+    assert PrefillResult.from_wire(legacy).kv_parts == 0

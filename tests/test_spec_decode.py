@@ -302,6 +302,13 @@ class _FakeRunner:
     def inject_pages(self, ids, data):
         self.injected.append((np.asarray(ids).copy(), np.asarray(data).copy()))
 
+    # the REAL pow2-padding path (shared with the streamed-disagg part
+    # scatter), so these tests keep proving the actual bucketing logic
+    from dynamo_tpu.engine.model_runner import ModelRunner as _MR
+
+    inject_pages_bucketed = _MR.inject_pages_bucketed
+    del _MR
+
 
 def _pool_with_blocks(hashes):
     from dynamo_tpu.engine.offload import HostKvPool
