@@ -35,11 +35,19 @@ class RemoteBackend:
         self.endpoint = endpoint
         self._client = None
 
-    async def _ensure_client(self):
+    async def _ensure_client(self, wait: bool = True):
         if self._client is None:
             self._client = await self.drt.endpoint_client(self.endpoint)
+        if wait and not self._client.instance_ids():
             await self._client.wait_for_instances(timeout=10)
         return self._client
+
+    async def live_instances(self) -> int:
+        """Instance count behind this backend's endpoint right now (starts
+        the discovery watcher without blocking on instances appearing) — the
+        frontend readiness probe's downstream-health signal."""
+        client = await self._ensure_client(wait=False)
+        return len(client.instance_ids())
 
     async def generate(self, request: PreprocessedRequest) -> AsyncIterator[BackendOutput]:
         client = await self._ensure_client()
@@ -59,10 +67,32 @@ class RemoteBackend:
 class FrontendService:
     def __init__(self, drt, host: str = "0.0.0.0", port: int = 8080):
         self.drt = drt
-        self.service = HttpService(host=host, port=port)
+        self.service = HttpService(host=host, port=port, readiness=self._readiness)
         self._watcher = None
         self._watch_task: Optional[asyncio.Task] = None
         self._entries: dict[str, ModelEntry] = {}
+        self._backends: dict[str, RemoteBackend] = {}
+
+    async def _readiness(self) -> tuple:
+        """/ready provider: a frontend is ready when at least one attached
+        model has a live worker instance behind its endpoint. /live stays a
+        static 200 regardless — a frontend whose whole pool died is alive
+        but must be pulled from rotation."""
+        if not self._backends:
+            return False, {"reason": "no models attached"}
+        per_model = {}
+        any_live = False
+        for name, backend in sorted(self._backends.items()):
+            try:
+                n = await backend.live_instances()
+            except Exception:
+                n = 0
+            per_model[name] = n
+            any_live = any_live or n > 0
+        detail = {"instances": per_model}
+        if not any_live:
+            detail["reason"] = "no live worker instances for any model"
+        return any_live, detail
 
     async def start(self) -> int:
         port = await self.service.start()
@@ -90,6 +120,7 @@ class FrontendService:
                 elif ev.kind == "delete":
                     name = ev.key.rsplit("/", 1)[1]
                     entry = self._entries.pop(name, None)
+                    self._backends.pop(name, None)
                     if entry is not None:
                         self.service.manager.remove(entry.name)
                         log.info("model detached: %s", name)
@@ -111,6 +142,7 @@ class FrontendService:
             ModelPipeline(entry.name, preprocessor, backend, model_type="both")
         )
         self._entries[entry.name] = entry
+        self._backends[entry.name] = backend
         log.info("model attached: %s -> %s", entry.name, entry.endpoint)
 
 

@@ -1,10 +1,18 @@
 """Standalone metrics component: scrapes ForwardPassMetrics from a component's
 workers, aggregates (avg/min/max + KV-hit-rate percent), and exposes
-Prometheus.
+Prometheus plus the fleet-health view.
 
 Mirrors the reference metrics binary (reference: components/metrics/src/
 {main.rs:115-272,lib.rs:125-633}); the mock worker analogue lives in
 tests (reference: components/metrics/src/bin/mock_worker.rs).
+
+Endpoints:
+  - ``/metrics``          federated Prometheus exposition: pool aggregates +
+                          per-worker families labeled with worker_id (health
+                          state, staleness, resource gauges, stage seconds)
+  - ``/cluster/status``   JSON fleet view: per-worker health snapshot,
+                          last-seen staleness, gauges, SLO state — the
+                          ``tools/dynotop.py`` data source
 
     python -m dynamo_tpu.components.metrics --namespace dynamo --component backend --port 9091
 """
@@ -13,6 +21,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import time
 from typing import Optional
 
 from aiohttp import web
@@ -20,6 +29,7 @@ from aiohttp import web
 from dynamo_tpu.llm.kv_router.metrics_aggregator import KvMetricsAggregator
 from dynamo_tpu.llm.kv_router.router import KV_HIT_RATE_SUBJECT
 from dynamo_tpu.utils import get_logger
+from dynamo_tpu.utils.health import STATES, is_snapshot_servable
 from dynamo_tpu.utils.prometheus import render_family
 
 log = get_logger("components.metrics")
@@ -34,6 +44,7 @@ class MetricsService:
         host: str = "0.0.0.0",
         port: int = 9091,
         interval: float = 2.0,
+        max_missed_scrapes: int = 3,
     ):
         self.drt = drt
         self.namespace = namespace
@@ -41,7 +52,8 @@ class MetricsService:
         self.host = host
         self.port = port
         self.aggregator = KvMetricsAggregator(
-            drt.cplane, namespace, component, interval=interval
+            drt.cplane, namespace, component, interval=interval,
+            max_missed_scrapes=max_missed_scrapes,
         )
         # cumulative KV hit-rate from router events
         self._isl_blocks = 0
@@ -55,6 +67,7 @@ class MetricsService:
         )
         app = web.Application()
         app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/cluster/status", self._cluster_status)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
@@ -73,11 +86,60 @@ class MetricsService:
         self._isl_blocks += p.get("isl_blocks", 0)
         self._overlap_blocks += p.get("overlap_blocks", 0)
 
+    # ---------------- fleet status (JSON) ----------------
+
+    def cluster_status(self) -> dict:
+        """The ``/cluster/status`` document: per-worker health, staleness,
+        gauges, and SLO state plus a fleet summary (dynotop's data source)."""
+        now = time.monotonic()
+        workers = []
+        summary = {"workers": 0, "servable": 0, "stale": 0, "unservable": 0}
+        for view in self.aggregator.worker_views():
+            health = view.health
+            entry = {
+                "worker_id": f"{view.instance_id:x}",
+                "last_seen_s": round(view.age_s(now), 3),
+                "last_seen_wall": view.last_seen_wall,
+                "missed_scrapes": view.missed_scrapes,
+                "stale": view.stale,
+                "servable": view.servable,
+                "health": health,
+                "kv_metrics": view.data.get("kv_metrics"),
+                "resources": view.data.get("resources"),
+                "slo": view.data.get("slo"),
+                "stage_seconds": view.data.get("stage_seconds"),
+                "disagg": view.data.get("disagg"),
+            }
+            workers.append(entry)
+            summary["workers"] += 1
+            summary["servable"] += 1 if view.servable else 0
+            summary["stale"] += 1 if view.stale else 0
+            summary["unservable"] += 0 if is_snapshot_servable(health) else 1
+        return {
+            "namespace": self.namespace,
+            "component": self.component,
+            "ts": time.time(),
+            "scrape_interval_s": self.aggregator.interval,
+            "max_missed_scrapes": self.aggregator.max_missed_scrapes,
+            "summary": summary,
+            "kv_hit_rate": {
+                "isl_blocks": self._isl_blocks,
+                "overlap_blocks": self._overlap_blocks,
+            },
+            "workers": workers,
+        }
+
+    async def _cluster_status(self, request: web.Request) -> web.Response:
+        return web.json_response(self.cluster_status())
+
+    # ---------------- Prometheus ----------------
+
     def render(self) -> str:
         """Conformant Prometheus exposition: every metric family carries its
         own HELP/TYPE pair ahead of its samples (promtool-checkable — one
         free-text comment covering everything is not)."""
         loads = self.aggregator.get_metrics()
+        views = self.aggregator.worker_views()
         base = {"namespace": self.namespace, "component": self.component}
         out = render_family(
             "llm_kv_workers", "gauge",
@@ -129,6 +191,53 @@ class MetricsService:
             "cumulative cached-prefix blocks matched by the router",
             [(base, self._overlap_blocks)],
         )
+        # ---- fleet health: per-worker instance-labeled families ----
+        now = time.monotonic()
+        state_samples, seen_samples, missed_samples, hb_samples = [], [], [], []
+        resource_samples: dict[str, list] = {}
+        for view in views:
+            wlabels = {**base, "worker_id": f"{view.instance_id:x}"}
+            health = view.health or {}
+            state = health.get("state", "unknown")
+            for s in STATES:
+                state_samples.append(({**wlabels, "state": s}, 1 if s == state else 0))
+            seen_samples.append((wlabels, round(view.age_s(now), 3)))
+            missed_samples.append((wlabels, view.missed_scrapes))
+            if "heartbeat_age_s" in health:
+                hb_samples.append((wlabels, health["heartbeat_age_s"]))
+            resources = view.data.get("resources") or {}
+            for key, value in sorted(resources.items()):
+                if isinstance(value, bool) or not isinstance(value, (int, float)):
+                    continue  # nested dicts/strings ride /cluster/status only
+                resource_samples.setdefault(key, []).append((wlabels, value))
+        if views:
+            out += render_family(
+                "llm_worker_health_state", "gauge",
+                "scraped worker lifecycle state (one-hot over the state label)",
+                state_samples,
+            )
+            out += render_family(
+                "llm_worker_last_seen_seconds", "gauge",
+                "seconds since the worker last answered a stats scrape",
+                seen_samples,
+            )
+            out += render_family(
+                "llm_worker_missed_scrapes", "gauge",
+                "consecutive scrape rounds the worker has missed",
+                missed_samples,
+            )
+            if hb_samples:
+                out += render_family(
+                    "llm_worker_heartbeat_age_seconds", "gauge",
+                    "engine-loop heartbeat age reported in the worker's last stats",
+                    hb_samples,
+                )
+        for key, samples in sorted(resource_samples.items()):
+            out += render_family(
+                f"llm_worker_resource_{key}", "gauge",
+                f"worker resource gauge {key} (from engine resource snapshot)",
+                samples,
+            )
         # per-stage engine-time attribution scraped from worker stats
         # (engine StageStats -> worker stats_handler -> this component)
         stage_samples = []
@@ -158,7 +267,10 @@ async def _main(args) -> None:
 
     drt = DistributedRuntime(cplane_address=args.cplane)
     await drt.connect()
-    svc = MetricsService(drt, args.namespace, args.component, args.host, args.port)
+    svc = MetricsService(
+        drt, args.namespace, args.component, args.host, args.port,
+        interval=args.interval, max_missed_scrapes=args.max_missed_scrapes,
+    )
     await svc.start()
     while True:
         await asyncio.sleep(3600)
@@ -170,6 +282,10 @@ def main(argv=None) -> None:
     p.add_argument("--component", default="backend")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=9091)
+    p.add_argument("--interval", type=float, default=2.0)
+    p.add_argument("--max-missed-scrapes", type=int, default=3,
+                   help="scrape rounds a silent worker survives before it is "
+                        "aged out of the fleet view")
     p.add_argument("--cplane", default=None)
     asyncio.run(_main(p.parse_args(argv)))
 

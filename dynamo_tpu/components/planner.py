@@ -204,6 +204,11 @@ class PlannerService:
             return 1
 
     async def step(self) -> list[ScaleDecision]:
+        # scrape_once returns the SERVABLE fleet view only: workers aged out
+        # after max_missed_scrapes silent rounds, or whose scraped health is
+        # draining/dead, never feed the pressure signals — a dead worker's
+        # frozen "all slots free" snapshot would otherwise hold scale-down
+        # decisions open forever (see llm/kv_router/metrics_aggregator.py)
         loads = await self.aggregator.scrape_once()
         try:
             depth = await self.drt.cplane.queue_depth(self.prefill_queue)

@@ -49,16 +49,44 @@ async def _main(args) -> None:
             max_model_len=args.max_model_len,
             kv_stream=not args.no_kv_stream,
             kv_stream_lanes=args.kv_stream_lanes,
+            slo_ttft_ms=args.slo_ttft_ms,
+            slo_itl_ms=args.slo_itl_ms,
         )
     )
     await engine.start()
     worker = PrefillWorker(engine, drt, args.namespace, card.display_name)
     await worker.start()
+
+    # fleet-health visibility: the queue consumer itself has no RPC surface,
+    # so serve a status endpoint whose stats broadcast carries the engine's
+    # health/resource/SLO snapshots. This puts the prefill pool on the same
+    # scrape plane as decode workers (/cluster/status, planner replica
+    # counting via the instance key this registration creates).
+    def _stats() -> dict:
+        stats = {
+            "kv_metrics": engine.metrics().to_wire(),
+            "health": engine.health.snapshot(),
+            "resources": engine.resource_snapshot(),
+            "slo": engine.slo_snapshot(),
+            "prefill": {"completed": worker.completed},
+        }
+        stage = engine.stage_snapshot()
+        if stage:
+            stats["stage_seconds"] = stage
+        return stats
+
+    async def _status(request: dict):
+        yield {"ok": True, "health": engine.health.snapshot()}
+
+    ep = drt.namespace(args.namespace).component(args.component).endpoint("status")
+    served = await ep.serve_endpoint(_status, metrics=_stats)
+
     log.info("prefill worker up: model=%s namespace=%s", card.display_name, args.namespace)
     try:
         while True:
             await asyncio.sleep(3600)
     finally:
+        await served.stop()
         await worker.stop()
         await engine.shutdown()
 
@@ -67,6 +95,9 @@ def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("model", help="model path or tiny:{...} spec")
     p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--component", default="prefill-worker",
+                   help="component name for the status endpoint (matches the "
+                        "planner's prefill pool and /cluster/status scraping)")
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--num-pages", type=int, default=512)
     p.add_argument("--max-seqs", type=int, default=8)
@@ -78,6 +109,11 @@ def main(argv=None) -> None:
     p.add_argument("--no-kv-stream", action="store_true",
                    help="disable chunk-streamed KV transfer (one monolithic "
                         "post-prefill send per request)")
+    p.add_argument("--slo-ttft-ms", type=float, default=None,
+                   help="TTFT SLO target in ms (env DYNTPU_SLO_TTFT_MS)")
+    p.add_argument("--slo-itl-ms", type=float, default=None,
+                   help="inter-token-latency SLO target in ms (env "
+                        "DYNTPU_SLO_ITL_MS)")
     p.add_argument("--cplane", default=None)
     asyncio.run(_main(p.parse_args(argv)))
 

@@ -127,6 +127,21 @@ class WorkerService:
             snap = stage()
             if snap:
                 stats["stage_seconds"] = snap
+        # fleet health plane: lifecycle state + heartbeat age (routers and the
+        # planner skip draining/dead workers), resource gauges (page pool,
+        # HBM, compile churn), and the rolling SLO state — all ride the same
+        # stats broadcast the aggregator already scrapes
+        health = getattr(self._inner_engine, "health", None)
+        if health is not None:
+            stats["health"] = health.snapshot()
+        resources = getattr(self._inner_engine, "resource_snapshot", None)
+        if resources is not None:
+            snap = resources()
+            if snap:
+                stats["resources"] = snap
+        slo = getattr(self._inner_engine, "slo_snapshot", None)
+        if slo is not None:
+            stats["slo"] = slo()
         if self.enable_disagg_decode and self.engine is not None:
             stats["disagg"] = {
                 "remote_prefills": self.engine.remote_prefills,
@@ -194,6 +209,8 @@ async def _main(args) -> None:
             speculative=getattr(args, "speculative", None),
             kv_stream=not getattr(args, "no_kv_stream", False),
             kv_stream_lanes=getattr(args, "kv_stream_lanes", None) or 2,
+            slo_ttft_ms=getattr(args, "slo_ttft_ms", None),
+            slo_itl_ms=getattr(args, "slo_itl_ms", None),
         ),
         enable_disagg_decode=args.disagg,
     )
@@ -231,6 +248,13 @@ def main(argv=None) -> None:
                    help="speculative decoding: n-gram draft proposals + "
                         "batched multi-token verification (e.g. ngram:4)")
     p.add_argument("--disagg", action="store_true", help="wrap in the disagg decode path")
+    p.add_argument("--slo-ttft-ms", type=float, default=None,
+                   help="TTFT SLO target in ms (rolling percentiles + error "
+                        "budget ride stats and /metrics; env "
+                        "DYNTPU_SLO_TTFT_MS)")
+    p.add_argument("--slo-itl-ms", type=float, default=None,
+                   help="inter-token-latency SLO target in ms (env "
+                        "DYNTPU_SLO_ITL_MS)")
     p.add_argument("--kv-stream-lanes", type=int, default=2,
                    help="parallel KV data-plane connections per destination "
                         "(disagg; parts stripe across lanes)")

@@ -56,6 +56,13 @@ class EngineConfig:
     # other requests' transfers behind a single per-destination socket
     kv_stream_lanes: int = 2
     worker_id: str = "worker-0"
+    # SLO targets (milliseconds; None = untargeted). With any target set the
+    # engine attaches an SloTracker (utils/slo.py) to the scheduler: rolling
+    # TTFT/queue-wait percentiles + error-budget gauges ride worker stats and
+    # /metrics. The DYNTPU_SLO_TTFT_MS / DYNTPU_SLO_ITL_MS /
+    # DYNTPU_SLO_QUEUE_WAIT_MS env knobs fill unset fields.
+    slo_ttft_ms: float | None = None
+    slo_itl_ms: float | None = None
     # fraction of pages that must stay free for decode growth before admitting
     # a new sequence (simple admission control)
     watermark: float = 0.05

@@ -24,8 +24,13 @@ from dynamo_tpu.engine.scheduler import EngineRequest, Scheduler, StepOutput
 from dynamo_tpu.llm.kv_events import KvCacheEvent
 from dynamo_tpu.runtime.context import current_context
 from dynamo_tpu.utils import get_logger, tracing
+from dynamo_tpu.utils.health import HealthMonitor
+from dynamo_tpu.utils.slo import SloTracker, targets_from_env
 
 log = get_logger("engine")
+
+# engine-loop watchdog cadence: cheap checks, no need to run per step
+_WATCHDOG_INTERVAL_S = 1.0
 
 
 def _resolve(fut: asyncio.Future, result, exc) -> None:
@@ -74,6 +79,14 @@ class AsyncJaxEngine:
         self.runner = None
         self.model = None
         self.step_count = 0
+        # fleet health plane: lifecycle state + engine-loop heartbeats +
+        # stuck-request watchdog (utils/health.py); rolling SLO percentiles
+        # for queue-wait/TTFT (utils/slo.py, attached to the scheduler)
+        self.health = HealthMonitor("engine")
+        self.slo = SloTracker(
+            targets_from_env({"ttft": config.slo_ttft_ms, "itl": config.slo_itl_ms})
+        )
+        self._next_watchdog = 0.0
 
     # ---------------- lifecycle ----------------
 
@@ -131,6 +144,7 @@ class AsyncJaxEngine:
             offload=offload,
         )
         self.scheduler = Scheduler(self.config, self.runner, self.allocator)
+        self.scheduler.slo = self.slo
         if self.config.warmup == "background":
             # readiness waits only for the traces first requests need; the
             # feature variants (logprobs/penalties, extras prefill) compile
@@ -148,8 +162,10 @@ class AsyncJaxEngine:
             self.config.num_pages,
             time.monotonic() - t0,
         )
+        self.health.set_state("ready", "engine initialized")
 
     async def shutdown(self, join_timeout: float = 120.0) -> None:
+        self.health.set_state("draining", "shutdown requested")
         self._stopping.set()
         task = getattr(self, "_warmup_task", None)
         if task is not None and not task.done():
@@ -167,6 +183,7 @@ class AsyncJaxEngine:
                 # relay): it's a daemon thread, so give up on it rather than
                 # hanging the caller's teardown forever
                 log.error("engine loop did not exit within %.0fs; abandoning thread", join_timeout)
+        self.health.set_state("dead", "shutdown complete")
 
     # ---------------- request API ----------------
 
@@ -472,6 +489,56 @@ class AsyncJaxEngine:
             gpu_prefix_cache_hit_rate=hit_rate,
         )
 
+    def resource_snapshot(self) -> dict:
+        """Engine resource gauges for stats broadcasts + Prometheus: KV
+        page-pool occupancy/high-watermark, prefix-cache hit/miss,
+        preemption/offload counters, device HBM live/peak bytes, and the
+        monitored-jit compile churn (count + cumulative seconds)."""
+        alloc, sched, runner = self.allocator, self.scheduler, self.runner
+        if alloc is None or sched is None:
+            return {}
+        snap = {
+            "kv_pages_total": self.config.num_pages - 1,
+            "kv_pages_used": alloc.used_pages,
+            "kv_pages_active": alloc.active_pages,
+            "kv_pages_free": alloc.free_pages,
+            "kv_pages_peak": alloc.peak_used_pages,
+            "prefix_cache_hit_blocks": alloc.cache_hit_blocks,
+            "prefix_cache_miss_blocks": max(
+                0, alloc.cache_query_blocks - alloc.cache_hit_blocks
+            ),
+            "prefix_cache_query_blocks": alloc.cache_query_blocks,
+            "preemptions": sched.preempt_count,
+            "pressure_drains": sched.pressure_drain_count,
+            "requests_waiting": len(sched.waiting),
+            "oldest_waiting_age_s": round(sched.oldest_waiting_age(), 3),
+            "engine_steps": self.step_count,
+            # graceful zeros when no runner reports (CPU, or pre-init)
+            "hbm_bytes_in_use": 0,
+            "hbm_peak_bytes_in_use": 0,
+            "hbm_bytes_limit": 0,
+            "hbm_reporting_devices": 0,
+        }
+        offload = getattr(self, "offload", None)
+        if offload is not None:
+            snap.update(
+                offload_saves=offload.saves,
+                offload_loads=offload.loads,
+                offload_drops=offload.drops,
+                offload_blocks_resident=len(offload),
+            )
+        if runner is not None:
+            snap.update(runner.hbm_stats())
+            cm = getattr(runner, "compile_monitor", None)
+            if cm is not None:
+                c = cm.snapshot()
+                snap["xla_compiles"] = c["compiles"]
+                snap["xla_compile_s"] = c["compile_s"]
+        return snap
+
+    def slo_snapshot(self) -> dict:
+        return self.slo.snapshot()
+
     def stage_snapshot(self) -> dict:
         """Per-stage latency attribution totals (scheduler StageStats plus the
         host-KV-offload transfer leg) — the bench artifact's breakdown source."""
@@ -521,6 +588,73 @@ class AsyncJaxEngine:
                 "proposed draft tokens accepted by batched verification",
                 [({}, st.spec_accepted)],
             ))
+        parts.append(self._render_resource_metrics())
+        parts.append(self.health.render_metrics())
+        # engine-scoped prefix: a colocated HTTP frontend renders its own
+        # tracker under dynamo_slo_*; sharing that name here would emit
+        # duplicate families in the combined exposition
+        parts.append(self.slo.render_metrics(prefix="dynamo_engine_slo"))
+        return "".join(parts)
+
+    def _render_resource_metrics(self) -> str:
+        """Resource gauge families from resource_snapshot(): page pool,
+        prefix cache, preemptions, offload, HBM, compile churn."""
+        from dynamo_tpu.utils.prometheus import render_family
+
+        r = self.resource_snapshot()
+        if not r:
+            return ""
+        parts = [
+            render_family(
+                "dynamo_engine_kv_pages", "gauge",
+                "KV page-pool occupancy by state (total excludes the null page)",
+                [({"state": s}, r[f"kv_pages_{s}"])
+                 for s in ("total", "used", "active", "free", "peak")],
+            ),
+            render_family(
+                "dynamo_engine_prefix_cache_blocks_total", "counter",
+                "prefix-cache lookups by result (block granularity)",
+                [({"result": "hit"}, r["prefix_cache_hit_blocks"]),
+                 ({"result": "miss"}, r["prefix_cache_miss_blocks"])],
+            ),
+            render_family(
+                "dynamo_engine_preemptions_total", "counter",
+                "sequences bounced back to the waiting queue by page pressure",
+                [({}, r["preemptions"])],
+            ),
+            render_family(
+                "dynamo_engine_pressure_drains_total", "counter",
+                "pipeline drains forced by ensure_capacity misses",
+                [({}, r["pressure_drains"])],
+            ),
+            render_family(
+                "dynamo_engine_hbm_bytes", "gauge",
+                "device memory summed over local devices (zeros on CPU)",
+                [({"kind": "live"}, r["hbm_bytes_in_use"]),
+                 ({"kind": "peak"}, r["hbm_peak_bytes_in_use"]),
+                 ({"kind": "limit"}, r["hbm_bytes_limit"])],
+            ),
+        ]
+        if "xla_compiles" in r:
+            parts.append(render_family(
+                "dynamo_engine_xla_compiles_total", "counter",
+                "XLA compilations observed by the monitored-jit wrappers "
+                "(a climbing value mid-serving is a recompile storm)",
+                [({}, r["xla_compiles"])],
+            ))
+            parts.append(render_family(
+                "dynamo_engine_xla_compile_seconds_total", "counter",
+                "cumulative seconds engine calls spent tracing + compiling",
+                [({}, round(r["xla_compile_s"], 4))],
+            ))
+        if "offload_saves" in r:
+            parts.append(render_family(
+                "dynamo_engine_offload_blocks_total", "counter",
+                "host-DRAM KV tier block movement by operation",
+                [({"op": "save"}, r["offload_saves"]),
+                 ({"op": "load"}, r["offload_loads"]),
+                 ({"op": "drop"}, r["offload_drops"])],
+            ))
         return "".join(parts)
 
     def _on_kv_event(self, event: KvCacheEvent) -> None:
@@ -531,6 +665,18 @@ class AsyncJaxEngine:
 
     def _run_loop(self) -> None:
         while not self._stopping.is_set():
+            self.health.beat()
+            now = time.monotonic()
+            if now >= self._next_watchdog:
+                # stuck-request watchdog: degrade (and auto-recover) on a
+                # too-old waiting queue or a frozen progress marker while
+                # work exists — the signals a wedged device op produces
+                self._next_watchdog = now + _WATCHDOG_INTERVAL_S
+                self.health.check(
+                    oldest_waiting_age=self.scheduler.oldest_waiting_age(now),
+                    has_work=self.scheduler.has_work(),
+                    progress_marker=self.scheduler.progress_marker(),
+                )
             did_work = self._drain_inboxes()
             if self.scheduler.has_work():
                 try:

@@ -207,38 +207,49 @@ class ModelRunner:
         # never pays the HBM or donation traffic.
         self.slot_state = {"tokens": jnp.zeros(config.max_seqs, jnp.int32)}
 
-        self._prefill = jax.jit(
+        # compile-churn telemetry: every serving-path jit is wrapped so a
+        # recompile storm (the top TPU serving hazard — a stray dynamic shape
+        # mid-traffic) shows up as a climbing compile counter + seconds in the
+        # engine resource gauges, not as unexplained latency
+        from dynamo_tpu.utils.compile_monitor import CompileMonitor, monitored_jit
+
+        self.compile_monitor = CompileMonitor()
+
+        def _mjit(label, fn):
+            return monitored_jit(fn, label, self.compile_monitor)
+
+        self._prefill = _mjit("prefill", jax.jit(
             self._prefill_impl, donate_argnums=(1, 2),
             static_argnames=("want_lp", "want_pen", "want_seed", "want_eos_mask"),
-        )
+        ))
         # cross-request packed prefill (one weight pass for N lanes); one
         # executable per (N, bucket) actually used
-        self._prefill_packed = jax.jit(
+        self._prefill_packed = _mjit("prefill_packed", jax.jit(
             self._prefill_packed_impl, donate_argnums=(1, 2),
             static_argnames=("want_lp", "want_pen", "want_seed", "want_eos_mask"),
-        )
+        ))
         # multimodal vision encode (compiled lazily; text-only models never
         # pay for it — the mm prefill variant is _prefill traced with embeds)
-        self._encode_images = jax.jit(
+        self._encode_images = _mjit("encode_images", jax.jit(
             lambda params, patches, rows, cols, valid, segments: self.model.encode_images(
                 params, patches, rows, cols, valid, segments=segments
             )
-        )
+        ))
         if config.sp > 1:
             # sequence-parallel whole-prompt prefill (ring attention over sp)
-            self._prefill_sp = jax.jit(
+            self._prefill_sp = _mjit("prefill_sp", jax.jit(
                 self._prefill_sp_impl, donate_argnums=(1, 2),
                 static_argnames=("want_lp", "want_pen", "want_seed", "want_eos_mask"),
-            )
-        self._decode_window = jax.jit(
+            ))
+        self._decode_window = _mjit("decode_window", jax.jit(
             self._decode_window_impl, donate_argnums=(1, 2),
             static_argnames=("num_steps", "want_lp", "want_pen", "want_seed", "want_eos_mask"),
-        )
+        ))
         # speculative verify step (spec subsystem): ONE trace regardless of
         # sampling features — seeds/filters are neutral-input no-ops, and
         # penalties/logprobs requests never ride this path (the scheduler
         # routes them through classic windows)
-        self._verify = jax.jit(self._verify_impl, donate_argnums=(1,))
+        self._verify = _mjit("verify", jax.jit(self._verify_impl, donate_argnums=(1,)))
         def _write_tokens_impl(st, idx, vals):
             return dict(st, tokens=st["tokens"].at[idx].set(vals, mode="drop"))
 
@@ -265,13 +276,13 @@ class ModelRunner:
         def _flat_ids(ids):  # [n] logical -> [L, n] flat
             return ids[None, :] + (jnp.arange(L, dtype=jnp.int32) * Pn)[:, None]
 
-        self._gather_pages = jax.jit(
+        self._gather_pages = _mjit("gather_pages", jax.jit(
             lambda kv, ids: model.gather_pages_wire(kv, _flat_ids(ids))
-        )
-        self._scatter_pages = jax.jit(
+        ))
+        self._scatter_pages = _mjit("scatter_pages", jax.jit(
             lambda kv, ids, data: model.scatter_pages_wire(kv, _flat_ids(ids), data),
             donate_argnums=(0,),
-        )
+        ))
 
     # ---------------- jitted bodies ----------------
 
@@ -1276,6 +1287,30 @@ class ModelRunner:
         self.kv_cache = self._scatter_pages(
             self.kv_cache, jnp.asarray(page_ids, jnp.int32), data
         )
+
+    def hbm_stats(self) -> dict:
+        """Device memory gauges: live/peak bytes summed over local devices via
+        ``jax.Device.memory_stats()`` (TPU/GPU); graceful zeros on CPU, where
+        the runtime reports nothing."""
+        live = peak = limit = 0
+        devices = 0
+        for d in jax.local_devices():
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            if not stats:
+                continue
+            devices += 1
+            live += int(stats.get("bytes_in_use", 0))
+            peak += int(stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0)))
+            limit += int(stats.get("bytes_limit", 0))
+        return {
+            "hbm_bytes_in_use": live,
+            "hbm_peak_bytes_in_use": peak,
+            "hbm_bytes_limit": limit,
+            "hbm_reporting_devices": devices,
+        }
 
     def decode_steps(
         self,

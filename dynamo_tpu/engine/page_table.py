@@ -75,6 +75,7 @@ class PageAllocator:
         # stats
         self.cache_hit_blocks = 0
         self.cache_query_blocks = 0
+        self.peak_used_pages = 0  # page-pool occupancy high-watermark
 
     # ------------- capacity -------------
 
@@ -94,7 +95,10 @@ class PageAllocator:
 
     def _pop_free_page(self) -> int:
         if self._free:
-            return self._free.pop()
+            page = self._free.pop()
+            if self.used_pages > self.peak_used_pages:
+                self.peak_used_pages = self.used_pages
+            return page
         # Reclaim the least-recently-used refcount-0 cached block; with a host
         # tier configured its KV is offloaded instead of dropped.
         if self._reusable:

@@ -289,6 +289,9 @@ class Scheduler:
         # Prometheus histograms (rendered by the worker's /metrics)
         self.stage = StageStats()
         self.stage_hist = _stage_histograms()
+        # optional SLO sink (utils/slo.SloTracker): queue-wait and TTFT
+        # observations feed rolling-window percentiles when attached
+        self.slo = None
         # speculative decoding: parsed config + the draft proposer (history
         # in, <= k token ids out). None when --speculative is unset.
         self.spec = config.spec
@@ -305,6 +308,23 @@ class Scheduler:
             or bool(self.adopted_waiting)
             or bool(self.in_flight)
             or any(s is not None for s in self.slots)
+        )
+
+    def oldest_waiting_age(self, now: Optional[float] = None) -> float:
+        """Age of the oldest queued request (the watchdog's stuck-queue
+        signal). 0 when the queue is empty or unstamped."""
+        for req in self.waiting:
+            if req.enqueue_ts:
+                return max(0.0, (now or time.monotonic()) - req.enqueue_ts)
+        return 0.0
+
+    def progress_marker(self) -> int:
+        """Monotonic count of completed engine work; a frozen marker while
+        has_work() holds means the loop is wedged (watchdog no-progress)."""
+        st = self.stage
+        return (
+            st.prefill_calls + st.decode_windows + st.spec_rounds
+            + st.reconcile_waits + self.finished_count
         )
 
     @property
@@ -436,6 +456,8 @@ class Scheduler:
             self.stage.queue_wait_s += wait
             self.stage.queue_wait_n += 1
             self.stage_hist["queue_wait"].observe(wait)
+            if self.slo is not None:
+                self.slo.observe("queue_wait", wait)
             tracing.record_span(
                 "engine.queue_wait", now - wait, end=now,
                 request_id=req.request_id, trace_id=req.trace_id,
@@ -729,6 +751,8 @@ class Scheduler:
             self.stage.queue_wait_s += wait
             self.stage.queue_wait_n += 1
             self.stage_hist["queue_wait"].observe(wait)
+            if self.slo is not None:
+                self.slo.observe("queue_wait", wait)
             tracing.record_span(
                 "engine.queue_wait", now - wait, end=now,
                 request_id=req.request_id, trace_id=req.trace_id,
@@ -1140,6 +1164,8 @@ class Scheduler:
             self.stage.ttft_s += ttft
             self.stage.ttft_n += 1
             self.stage_hist["ttft"].observe(ttft)
+            if self.slo is not None:
+                self.slo.observe("ttft", ttft)
             tracing.record_span(
                 "engine.ttft", req.enqueue_ts, duration=ttft,
                 request_id=req.request_id, trace_id=req.trace_id,

@@ -30,12 +30,38 @@ def engine_metrics_text(engine) -> str:
     return "".join(parts)
 
 
+def engine_readiness(engine):
+    """/ready provider for a colocated engine: reflects the engine's
+    HealthMonitor state (serving requires ready/degraded, not
+    starting/draining/dead). Engines without the health plane (external
+    token engines) stay ready."""
+
+    def provider() -> tuple:
+        health = getattr(engine, "health", None)
+        if health is None:
+            return True, {}
+        snap = health.snapshot()
+        ok = snap["state"] in ("ready", "degraded")
+        return ok, {"engine": snap}
+
+    return provider
+
+
 async def run_http(engine, args) -> None:
+    from dynamo_tpu.utils.slo import SloTracker, targets_from_env
+
     card = card_for_model(args.model, getattr(args, "max_model_len", None))
     pipeline = build_pipeline(engine, card)
 
+    slo = SloTracker(targets_from_env({
+        "ttft": getattr(args, "slo_ttft_ms", None),
+        "itl": getattr(args, "slo_itl_ms", None),
+    }))
     service = HttpService(
-        port=args.http_port, extra_metrics=lambda: engine_metrics_text(engine)
+        port=args.http_port,
+        extra_metrics=lambda: engine_metrics_text(engine),
+        slo=slo,
+        readiness=engine_readiness(engine),
     )
     service.manager.add(pipeline)
     await service.run_forever()

@@ -62,6 +62,8 @@ def engine_config_for(args):
             speculative=speculative,
             kv_stream=kv_stream,
             kv_stream_lanes=kv_stream_lanes,
+            slo_ttft_ms=getattr(args, "slo_ttft_ms", None),
+            slo_itl_ms=getattr(args, "slo_itl_ms", None),
         )
     return EngineConfig(
         model_id=model_path,
@@ -75,6 +77,8 @@ def engine_config_for(args):
         speculative=speculative,
         kv_stream=kv_stream,
         kv_stream_lanes=kv_stream_lanes,
+        slo_ttft_ms=getattr(args, "slo_ttft_ms", None),
+        slo_itl_ms=getattr(args, "slo_itl_ms", None),
         # serve as soon as the core traces compile; feature variants land in
         # the background (halves cold first-deploy readiness time)
         warmup="background",

@@ -37,6 +37,16 @@ def build_parser() -> argparse.ArgumentParser:
              "batched forward pass (e.g. ngram:4)",
     )
     run.add_argument("--max-tokens", type=int, default=None, help="batch mode default max_tokens")
+    run.add_argument(
+        "--slo-ttft-ms", type=float, default=None,
+        help="TTFT SLO target in ms: the engine and HTTP frontend track "
+             "rolling-window percentiles + an error-budget gauge against it "
+             "(/metrics, /ready; env DYNTPU_SLO_TTFT_MS)",
+    )
+    run.add_argument(
+        "--slo-itl-ms", type=float, default=None,
+        help="inter-token-latency SLO target in ms (env DYNTPU_SLO_ITL_MS)",
+    )
     # serve/build/deploy are dispatched on argv[0] in main() (their argv is
     # forwarded verbatim — argparse REMAINDER can't capture leading options);
     # registered here so they show in --help

@@ -83,11 +83,27 @@ class HttpService:
         host: str = "0.0.0.0",
         port: int = 8080,
         extra_metrics: Optional[Callable[[], str]] = None,
+        slo=None,  # Optional[SloTracker]: rolling TTFT/ITL SLO state
+        readiness: Optional[Callable[[], tuple]] = None,
     ):
         self.manager = manager or ModelManager()
         self.host = host
         self.port = port
         self.metrics = Metrics()
+        # SLO tracker (utils/slo.py): fed TTFT/ITL alongside the histograms,
+        # rendered into /metrics, and surfaced on /ready. Default tracker has
+        # targets from the DYNTPU_SLO_*_MS env knobs (untargeted metrics
+        # still report percentiles).
+        if slo is None:
+            from dynamo_tpu.utils.slo import SloTracker, targets_from_env
+
+            slo = SloTracker(targets_from_env())
+        self.slo = slo
+        # readiness provider: () -> (ok: bool, detail: dict). None = always
+        # ready (a bare service with no downstream dependency to gate on).
+        # FrontendService wires downstream-worker liveness through this; the
+        # colocated engine frontend wires the engine's HealthMonitor.
+        self._readiness = readiness
         self._extra_metrics = extra_metrics
         self._runner: Optional[web.AppRunner] = None
         self.app = web.Application()
@@ -97,7 +113,11 @@ class HttpService:
         self.app.router.add_get("/metrics", self._metrics)
         self.app.router.add_get("/trace", self._trace)
         self.app.router.add_get("/health", self._health)
-        self.app.router.add_get("/live", self._health)
+        # probe split: /live answers "is this process running" and must never
+        # block on (or 503 because of) the model manager or any downstream;
+        # /ready answers "should a load balancer send traffic here"
+        self.app.router.add_get("/live", self._live)
+        self.app.router.add_get("/ready", self._ready)
 
     # ---------------- lifecycle ----------------
 
@@ -124,6 +144,35 @@ class HttpService:
     async def _health(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "ok", "models": self.manager.list_models()})
 
+    async def _live(self, request: web.Request) -> web.Response:
+        # static by design: liveness must stay 200 while readiness flaps
+        return web.json_response({"status": "live"})
+
+    def set_readiness(self, provider: Callable[[], tuple]) -> None:
+        self._readiness = provider
+
+    async def _ready(self, request: web.Request) -> web.Response:
+        ok, detail = True, {}
+        if self._readiness is not None:
+            try:
+                result = self._readiness()
+                if asyncio.iscoroutine(result):
+                    result = await result
+                ok, detail = result
+            except Exception as e:
+                ok, detail = False, {"error": str(e)}
+        slo = self.slo.snapshot()
+        body = {
+            "status": "ready" if ok else "unready",
+            "models": self.manager.list_models(),
+            # informational: an exhausted error budget degrades, it does not
+            # pull the pod out of rotation (that would shed the very traffic
+            # the SLO exists for)
+            "slo_ok": slo["ok"],
+            **detail,
+        }
+        return web.json_response(body, status=200 if ok else 503)
+
     async def _models(self, request: web.Request) -> web.Response:
         return web.json_response(
             {
@@ -136,7 +185,9 @@ class HttpService:
         )
 
     async def _metrics(self, request: web.Request) -> web.Response:
-        extra = self._extra_metrics() if self._extra_metrics else ""
+        extra = self.slo.render_metrics()
+        if self._extra_metrics:
+            extra += self._extra_metrics()
         return web.Response(text=self.metrics.render(extra), content_type="text/plain")
 
     async def _trace(self, request: web.Request) -> web.Response:
@@ -346,6 +397,7 @@ class HttpService:
             if t_first is None and out.token_ids:
                 t_first = t_prev = time.monotonic()
                 self.metrics.observe_ttft(model, t_first - t_start)
+                self.slo.observe("ttft", t_first - t_start)
                 # OpenAI semantics: the role delta leads the stream at first-
                 # token time. Also the client's only honest TTFT signal — the
                 # first CONTENT delta can lag several tokens behind while the
@@ -358,6 +410,7 @@ class HttpService:
                 # per-token number is the chunk gap amortized over its tokens
                 now = time.monotonic()
                 self.metrics.observe_itl(model, (now - t_prev) / len(out.token_ids))
+                self.slo.observe("itl", (now - t_prev) / len(out.token_ids))
                 t_prev = now
             if tool_matcher is not None:
                 if out.text:

@@ -1,20 +1,65 @@
 """Router-side metrics aggregation: periodically scrape every worker's
-ForwardPassMetrics via the stats broadcast.
+ForwardPassMetrics via the stats broadcast, and keep a fleet view with
+per-worker freshness and health.
 
 Mirrors the reference aggregator (reference: lib/llm/src/kv_router/
-metrics_aggregator.rs:1-171 collect_endpoints_task).
+metrics_aggregator.rs:1-171 collect_endpoints_task), with the fleet-health
+layer on top:
+
+  - workers that stop replying are aged out after ``max_missed_scrapes``
+    rounds instead of living in ``_latest`` forever; a worker missing >= 1
+    round is *stale* (still listed in ``worker_views`` for status surfaces,
+    excluded from routing/scaling once aged or unservable)
+  - workers whose scraped ``health.state`` is draining/dead are excluded from
+    ``get_metrics``/``get_raw`` immediately — routers and planners must not
+    hand them new work even while their stats keep flowing
+  - scrape failures are logged once per state change (fail -> recover), not
+    a full exception stack every interval
 """
 
 from __future__ import annotations
 
 import asyncio
+import time
+from dataclasses import dataclass, field
 from typing import Optional
 
 from dynamo_tpu.llm.kv_router.scheduler import WorkerLoad
 from dynamo_tpu.runtime.service import collect_service_stats
 from dynamo_tpu.utils import get_logger
+from dynamo_tpu.utils.health import is_snapshot_servable
 
 log = get_logger("kv_router.metrics")
+
+
+@dataclass
+class WorkerView:
+    """One worker's last-known stats + freshness, for fleet status surfaces."""
+
+    instance_id: int
+    data: dict = field(default_factory=dict)
+    load: Optional[WorkerLoad] = None
+    last_seen: float = 0.0  # monotonic, aggregator clock
+    last_seen_wall: float = 0.0  # wall clock, for cross-process display
+    missed_scrapes: int = 0
+
+    @property
+    def stale(self) -> bool:
+        return self.missed_scrapes > 0
+
+    @property
+    def health(self) -> Optional[dict]:
+        h = self.data.get("health")
+        return h if isinstance(h, dict) else None
+
+    @property
+    def servable(self) -> bool:
+        """Eligible for new work: fresh enough AND not draining/dead."""
+        return is_snapshot_servable(self.health)
+
+    def age_s(self, now: Optional[float] = None) -> float:
+        now = time.monotonic() if now is None else now
+        return max(0.0, now - self.last_seen)
 
 
 class KvMetricsAggregator:
@@ -25,16 +70,18 @@ class KvMetricsAggregator:
         component: str,
         interval: float = 1.0,
         scrape_timeout: float = 0.3,
+        max_missed_scrapes: int = 3,
     ):
         self.cplane = cplane
         self.namespace = namespace
         self.component = component
         self.interval = interval
         self.scrape_timeout = scrape_timeout
-        self._latest: list[WorkerLoad] = []
-        self._latest_raw: list[tuple[int, dict]] = []  # (instance_id, stats data)
+        self.max_missed_scrapes = max_missed_scrapes
+        self._workers: dict[int, WorkerView] = {}
         self._task: Optional[asyncio.Task] = None
         self._on_update = None
+        self._scrape_failing = False  # log once per state change, not per round
 
     def on_update(self, cb) -> None:
         self._on_update = cb
@@ -46,36 +93,100 @@ class KvMetricsAggregator:
         if self._task:
             self._task.cancel()
 
+    # ---------------- scraping ----------------
+
     async def scrape_once(self) -> list[WorkerLoad]:
+        """One scrape round. Returns the servable loads (the routing view)."""
         stats = await collect_service_stats(
             self.cplane, self.namespace, self.component, timeout=self.scrape_timeout
         )
-        loads = []
+        now = time.monotonic()
+        wall = time.time()
+        seen: set[int] = set()
         for ep in stats.endpoints:
+            seen.add(ep.instance_id)
+            view = self._workers.get(ep.instance_id)
+            if view is None:
+                view = self._workers[ep.instance_id] = WorkerView(ep.instance_id)
+            view.data = ep.data
+            view.last_seen = now
+            view.last_seen_wall = wall
+            view.missed_scrapes = 0
             kv = ep.data.get("kv_metrics")
-            if kv is not None:
-                loads.append(WorkerLoad.from_wire(ep.instance_id, kv))
-        self._latest = loads
-        self._latest_raw = [(ep.instance_id, ep.data) for ep in stats.endpoints]
+            view.load = (
+                WorkerLoad.from_wire(ep.instance_id, kv) if kv is not None else None
+            )
+        self._age_unseen(seen)
+        loads = self.get_metrics()
         if self._on_update is not None:
             self._on_update(loads)
         return loads
 
+    def _age_unseen(self, seen: set[int]) -> None:
+        """Bump the miss counter of every known worker absent from this round
+        and drop the ones past the age-out threshold."""
+        for instance_id in list(self._workers):
+            if instance_id in seen:
+                continue
+            view = self._workers[instance_id]
+            view.missed_scrapes += 1
+            if view.missed_scrapes > self.max_missed_scrapes:
+                log.info(
+                    "worker %x aged out after %d missed scrapes",
+                    instance_id, view.missed_scrapes,
+                )
+                del self._workers[instance_id]
+
+    # ---------------- views ----------------
+
     def get_metrics(self) -> list[WorkerLoad]:
-        return list(self._latest)
+        """Loads of workers eligible for new work: not aged out, not
+        draining/dead. Routers and the planner consume this view."""
+        return [
+            v.load
+            for v in self._workers.values()
+            if v.load is not None and v.servable
+        ]
 
     def get_raw(self) -> list[tuple[int, dict]]:
-        """Full stats payloads of the last scrape, beyond kv_metrics — e.g.
+        """Full stats payloads of servable workers, beyond kv_metrics — e.g.
         per-stage latency attribution (stage_seconds) and disagg counters."""
-        return list(self._latest_raw)
+        return [
+            (v.instance_id, v.data) for v in self._workers.values() if v.servable
+        ]
+
+    def worker_views(self) -> list[WorkerView]:
+        """Every tracked worker including stale ones — the ``/cluster/status``
+        source (status surfaces must SHOW a dying worker, not hide it)."""
+        return sorted(self._workers.values(), key=lambda v: v.instance_id)
+
+    # ---------------- loop ----------------
 
     async def _loop(self) -> None:
         try:
             while True:
                 try:
                     await self.scrape_once()
-                except Exception:
-                    log.exception("metrics scrape failed")
+                    if self._scrape_failing:
+                        self._scrape_failing = False
+                        log.info(
+                            "metrics scrape recovered for %s/%s",
+                            self.namespace, self.component,
+                        )
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    # a failed round means nobody was seen: age everyone so a
+                    # dead control plane can't freeze the last snapshot in
+                    # place forever
+                    self._age_unseen(set())
+                    if not self._scrape_failing:
+                        self._scrape_failing = True
+                        log.warning(
+                            "metrics scrape failing for %s/%s: %s "
+                            "(suppressing until recovery)",
+                            self.namespace, self.component, e,
+                        )
                 await asyncio.sleep(self.interval)
         except asyncio.CancelledError:
             pass

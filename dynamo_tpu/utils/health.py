@@ -1,0 +1,218 @@
+"""Per-process health monitor: lifecycle states, heartbeats, stuck watchdog.
+
+The fleet-level signal the ROADMAP's "heavy traffic" target needs on every
+serving process (engine, worker, prefill worker): the planner scales on
+ForwardPassMetrics and the router targets workers, but neither can tell a
+healthy quiet worker from a wedged one — stats broadcasts keep flowing from
+the asyncio thread even when the engine loop is stuck on a dead device op.
+
+``HealthMonitor`` closes that gap:
+
+  - explicit lifecycle states: ``starting -> ready`` (engine initialized),
+    ``degraded`` (watchdog alarm), ``draining`` (operator-initiated
+    scale-down; routers skip it but in-flight work finishes), ``dead``
+    (shutdown / loop exit)
+  - monotonic heartbeats stamped by the engine loop (``beat()``); every stats
+    broadcast carries ``heartbeat_age_s`` so aggregators can spot a process
+    whose asyncio side answers scrapes while its engine thread is wedged
+  - a stuck-request watchdog (``check()``): oldest-queued-age and no-progress
+    alarms computed from scheduler signals. Alarms degrade the state and
+    auto-clear — an operator-set ``draining`` is never overridden.
+
+Thread-safety: ``beat()`` runs on the engine thread, ``snapshot()`` on the
+asyncio thread; a single lock guards transitions, scalar stamps ride the GIL.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("utils.health")
+
+STATES = ("starting", "ready", "degraded", "draining", "dead")
+
+# states a router / planner must not hand new work to
+UNSERVABLE_STATES = ("draining", "dead")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+class HealthMonitor:
+    def __init__(
+        self,
+        component: str = "engine",
+        stuck_queue_s: Optional[float] = None,
+        no_progress_s: Optional[float] = None,
+        clock=time.monotonic,
+    ):
+        self.component = component
+        # a request older than this in the waiting queue while the engine has
+        # capacity signals admission livelock; a wedged device op shows up as
+        # no-progress instead
+        self.stuck_queue_s = (
+            stuck_queue_s
+            if stuck_queue_s is not None
+            else _env_float("DYNTPU_STUCK_QUEUE_S", 120.0)
+        )
+        self.no_progress_s = (
+            no_progress_s
+            if no_progress_s is not None
+            else _env_float("DYNTPU_NO_PROGRESS_S", 60.0)
+        )
+        self._clock = clock
+        self._lock = threading.Lock()
+        now = clock()
+        self._state = "starting"
+        self._since = now
+        self._started = now
+        self._reason = "initializing"
+        self._beat_ts = now
+        self._beats = 0
+        self._transitions: list[dict] = []
+        # watchdog bookkeeping
+        self._alarm: Optional[str] = None
+        self._progress_marker: Optional[int] = None
+        self._progress_ts = now
+
+    # ---------------- state ----------------
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    @property
+    def alarm(self) -> Optional[str]:
+        return self._alarm
+
+    def is_servable(self) -> bool:
+        """May new work be routed here? degraded still serves (best effort)."""
+        return self._state not in UNSERVABLE_STATES
+
+    def set_state(self, state: str, reason: str = "") -> None:
+        if state not in STATES:
+            raise ValueError(f"unknown health state {state!r}")
+        with self._lock:
+            if state == self._state:
+                return
+            if self._state == "dead":
+                return  # dead is terminal
+            now = self._clock()
+            self._transitions.append(
+                {"from": self._state, "to": state, "reason": reason,
+                 "at_s": round(now - self._started, 3)}
+            )
+            del self._transitions[:-8]  # bounded history
+            self._state = state
+            self._since = now
+            self._reason = reason
+        log.info("%s health: %s (%s)", self.component, state, reason or "-")
+
+    # ---------------- heartbeat ----------------
+
+    def beat(self) -> None:
+        """Stamp liveness from the serving loop. Cheap enough per step."""
+        self._beat_ts = self._clock()
+        self._beats += 1
+
+    def heartbeat_age(self) -> float:
+        return max(0.0, self._clock() - self._beat_ts)
+
+    # ---------------- watchdog ----------------
+
+    def check(
+        self,
+        oldest_waiting_age: float = 0.0,
+        has_work: bool = False,
+        progress_marker: int = 0,
+    ) -> Optional[str]:
+        """Evaluate the stuck-request alarms; returns the active alarm name.
+
+        ``progress_marker`` is any monotonically increasing count of completed
+        engine work (prefill calls + decode windows + finished requests): a
+        marker frozen for ``no_progress_s`` while ``has_work`` means the loop
+        is spinning without the device completing anything. Alarms flip a
+        ready engine to degraded and auto-clear; explicit draining/dead
+        states are never touched.
+        """
+        now = self._clock()
+        if self._progress_marker != progress_marker or not has_work:
+            self._progress_marker = progress_marker
+            self._progress_ts = now
+
+        alarm: Optional[str] = None
+        if has_work and (now - self._progress_ts) > self.no_progress_s:
+            alarm = "no-progress"
+        elif oldest_waiting_age > self.stuck_queue_s:
+            alarm = "stuck-queue"
+
+        if alarm is not None:
+            self._alarm = alarm
+            if self._state == "ready":
+                self.set_state("degraded", f"watchdog: {alarm}")
+        elif self._alarm is not None:
+            self._alarm = None
+            if self._state == "degraded":
+                self.set_state("ready", "watchdog alarm cleared")
+        return self._alarm
+
+    # ---------------- exposition ----------------
+
+    def snapshot(self) -> dict:
+        """Wire form for stats broadcasts / ``/cluster/status``."""
+        now = self._clock()
+        with self._lock:
+            return {
+                "component": self.component,
+                "state": self._state,
+                "reason": self._reason,
+                "state_age_s": round(now - self._since, 3),
+                "uptime_s": round(now - self._started, 3),
+                "heartbeat_age_s": round(now - self._beat_ts, 3),
+                "beats": self._beats,
+                "alarm": self._alarm,
+                "transitions": list(self._transitions),
+            }
+
+    def render_metrics(self, prefix: str = "dynamo_health") -> str:
+        """Prometheus exposition: one-hot state gauge + heartbeat age."""
+        from dynamo_tpu.utils.prometheus import render_family
+
+        snap = self.snapshot()
+        out = render_family(
+            f"{prefix}_state", "gauge",
+            "process lifecycle state (one-hot over the state label)",
+            [({"component": self.component, "state": s}, 1 if s == snap["state"] else 0)
+             for s in STATES],
+        )
+        out += render_family(
+            f"{prefix}_heartbeat_age_seconds", "gauge",
+            "seconds since the serving loop last stamped liveness",
+            [({"component": self.component}, snap["heartbeat_age_s"])],
+        )
+        out += render_family(
+            f"{prefix}_uptime_seconds", "gauge",
+            "seconds since this monitor was created",
+            [({"component": self.component}, snap["uptime_s"])],
+        )
+        return out
+
+
+def is_snapshot_servable(health: Optional[dict]) -> bool:
+    """Router/planner-side predicate over a scraped health snapshot dict.
+
+    Workers that never report health (older builds, mock workers) stay
+    servable — absence of the plane must not take traffic down.
+    """
+    if not health:
+        return True
+    return health.get("state") not in UNSERVABLE_STATES

@@ -237,3 +237,143 @@ def check_exposition(text: str) -> list[str]:
         if fam in first_sample_seen and missing:
             problems.append(f"histogram {fam} missing {sorted(missing)} samples")
     return problems
+
+
+# ---------------- self-check (python -m dynamo_tpu.utils.prometheus --check) ----
+
+
+def _sample_surfaces() -> list[tuple[str, str]]:
+    """Build every exposition surface with representative samples, WITHOUT a
+    cluster: (name, rendered text) pairs. The CI lint gate and the
+    conformance test both run check_exposition over these, so a new metric
+    family can't regress HELP/TYPE/label format unnoticed."""
+    import time as _time
+
+    surfaces: list[tuple[str, str]] = []
+
+    # HTTP service metrics (request counters + latency histograms)
+    from dynamo_tpu.llm.http.metrics import Metrics
+
+    m = Metrics()
+    m.inc_request("tiny", "chat_completions", "stream", "200")
+    m.inflight("tiny", 1)
+    m.observe_duration("tiny", "chat_completions", 0.25)
+    m.observe_ttft("tiny", 0.05)
+    m.observe_itl("tiny", 0.004)
+    surfaces.append(("llm.http.metrics", m.render()))
+
+    # SLO tracker + health monitor (fleet health plane)
+    from dynamo_tpu.utils.health import HealthMonitor
+    from dynamo_tpu.utils.slo import SloTracker
+
+    slo = SloTracker({"ttft": 0.5, "itl": 0.05})
+    for v in (0.1, 0.2, 0.7):
+        slo.observe("ttft", v)
+        slo.observe("itl", v / 20)
+    surfaces.append(("utils.slo", slo.render_metrics()))
+    hm = HealthMonitor("selfcheck")
+    hm.set_state("ready", "self-check")
+    hm.beat()
+    surfaces.append(("utils.health", hm.render_metrics()))
+
+    # engine stage histograms + resource gauges (scheduler built directly on
+    # a real allocator; no model/runner/device needed)
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.engine.page_table import PageAllocator
+    from dynamo_tpu.engine.scheduler import Scheduler
+
+    cfg = EngineConfig(model_id="tiny", page_size=4, num_pages=8, max_seqs=2,
+                       prefill_buckets=(16,))
+    eng = AsyncJaxEngine(cfg)
+    eng.allocator = PageAllocator(cfg.num_pages, cfg.page_size)
+    eng.scheduler = Scheduler(cfg, None, eng.allocator)
+    for name in ("queue_wait", "ttft", "prefill", "decode_window", "reconcile"):
+        eng.scheduler.stage_hist[name].observe(0.01)
+    eng.scheduler.stage.prefill_s = 0.5
+    surfaces.append(("engine.render_stage_metrics", eng.render_stage_metrics()))
+
+    # disagg KV data-plane server/client + prefill worker send side
+    from dynamo_tpu.disagg.dataplane import KvDataPlaneClient, KvDataPlaneServer
+    from dynamo_tpu.disagg.prefill_worker import PrefillWorker
+
+    surfaces.append(("disagg.dataplane.server", KvDataPlaneServer().render_metrics()))
+    surfaces.append(("disagg.dataplane.client", KvDataPlaneClient(lanes=2).render_metrics()))
+
+    class _Eng:
+        config = None
+
+    surfaces.append(("disagg.prefill_worker", PrefillWorker(_Eng(), None, "ns", "m").render_metrics()))
+
+    # standalone metrics component: pool aggregates + federated per-worker
+    # health/resource families, off an injected fleet view
+    from dynamo_tpu.components.metrics import MetricsService
+    from dynamo_tpu.llm.kv_router.metrics_aggregator import WorkerView
+    from dynamo_tpu.llm.kv_router.scheduler import WorkerLoad
+
+    class _Drt:
+        cplane = None
+
+    svc = MetricsService(_Drt(), "ns", "backend")
+    kv = {
+        "request_active_slots": 1, "request_total_slots": 8,
+        "kv_active_blocks": 5, "kv_total_blocks": 100,
+        "num_requests_waiting": 0, "gpu_cache_usage_perc": 0.05,
+        "gpu_prefix_cache_hit_rate": 0.5,
+    }
+    svc.aggregator._workers[0xAB] = WorkerView(
+        0xAB,
+        data={
+            "kv_metrics": kv,
+            "health": {"state": "ready", "heartbeat_age_s": 0.01},
+            "resources": {"kv_pages_used": 5, "kv_pages_total": 100,
+                          "xla_compiles": 3, "hbm_bytes_in_use": 0},
+            "stage_seconds": {"prefill_s": 1.0, "queue_wait_n": 2},
+        },
+        load=WorkerLoad.from_wire(0xAB, kv),
+        last_seen=_time.monotonic(),
+    )
+    svc._isl_blocks, svc._overlap_blocks = 10, 4
+    surfaces.append(("components.metrics", svc.render()))
+    return surfaces
+
+
+def self_check() -> list[str]:
+    """check_exposition over every cluster-free sample surface; returns the
+    flattened problem list (empty = all conformant)."""
+    problems: list[str] = []
+    for name, text in _sample_surfaces():
+        problems.extend(f"{name}: {p}" for p in check_exposition(text))
+        if not text.strip():
+            problems.append(f"{name}: rendered empty exposition")
+    return problems
+
+
+def _main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="Prometheus exposition helpers; --check validates every "
+                    "metrics surface without a cluster (the CI lint step)."
+    )
+    p.add_argument("--check", action="store_true")
+    args = p.parse_args(argv)
+    if not args.check:
+        p.print_help()
+        return 2
+    surfaces = _sample_surfaces()
+    problems: list[str] = []
+    for name, text in surfaces:
+        problems.extend(f"{name}: {p}" for p in check_exposition(text))
+        if not text.strip():
+            problems.append(f"{name}: rendered empty exposition")
+    for prob in problems:
+        print(f"FAIL {prob}")
+    if problems:
+        return 1
+    print(f"ok: {len(surfaces)} exposition surfaces conformant")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

@@ -1,0 +1,201 @@
+"""SLO tracking: rolling-window latency percentiles against configured targets.
+
+Serving SLOs for an LLM fleet are latency-shaped — TTFT (time to first
+token), ITL (inter-token latency), and engine queue wait — and operators
+reason about them as *objectives* ("p99 TTFT under 500 ms, 99% of the time"),
+not raw histograms. ``SloTracker`` keeps a bounded rolling window of raw
+observations per metric, computes percentiles on demand, and derives an
+error-budget gauge: the fraction of the allowed violation quota still
+unspent inside the window. Budget 1.0 = no violations; 0.0 = the objective
+is exactly burned; negative = actively out of SLO.
+
+Targets come from CLI flags (``--slo-ttft-ms`` / ``--slo-itl-ms``) or the
+``DYNTPU_SLO_TTFT_MS`` / ``DYNTPU_SLO_ITL_MS`` / ``DYNTPU_SLO_QUEUE_WAIT_MS``
+environment knobs; a metric without a target still tracks percentiles but
+never violates.
+
+Thread-safe: the HTTP asyncio thread and the engine loop both observe.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+# canonical metric names (any name is accepted; these get env-knob defaults)
+TTFT = "ttft"
+ITL = "itl"
+QUEUE_WAIT = "queue_wait"
+
+_ENV_KNOBS = {
+    TTFT: "DYNTPU_SLO_TTFT_MS",
+    ITL: "DYNTPU_SLO_ITL_MS",
+    QUEUE_WAIT: "DYNTPU_SLO_QUEUE_WAIT_MS",
+}
+
+PERCENTILES = (50, 90, 99)
+
+
+def targets_from_env(overrides: Optional[dict] = None) -> dict:
+    """Metric -> target seconds, from env knobs overlaid with explicit
+    ms-valued overrides (CLI flags; None values are ignored)."""
+    targets: dict[str, float] = {}
+    for metric, env in _ENV_KNOBS.items():
+        raw = os.environ.get(env)
+        if raw:
+            try:
+                targets[metric] = float(raw) / 1e3
+            except ValueError:
+                pass
+    for metric, ms in (overrides or {}).items():
+        if ms is not None:
+            targets[metric] = float(ms) / 1e3
+    return targets
+
+
+def _percentile(sorted_vals: list, p: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    k = max(0, min(len(sorted_vals) - 1, int(round(p / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[k]
+
+
+class SloTracker:
+    def __init__(
+        self,
+        targets: Optional[dict] = None,
+        window_s: float = 300.0,
+        objective: float = 0.99,
+        max_samples: int = 4096,
+        clock=time.monotonic,
+    ):
+        self.targets = dict(targets or {})  # metric -> target SECONDS
+        self.window_s = window_s
+        self.objective = objective
+        self.max_samples = max_samples
+        self._clock = clock
+        self._lock = threading.Lock()
+        # metric -> deque[(ts, seconds)]
+        self._samples: dict[str, deque] = {}
+        # lifetime counters (survive window pruning)
+        self._observed: dict[str, int] = {}
+        self._violated: dict[str, int] = {}
+
+    # ---------------- ingest ----------------
+
+    def observe(self, metric: str, seconds: float) -> None:
+        now = self._clock()
+        with self._lock:
+            q = self._samples.get(metric)
+            if q is None:
+                q = self._samples[metric] = deque(maxlen=self.max_samples)
+            q.append((now, seconds))
+            self._observed[metric] = self._observed.get(metric, 0) + 1
+            target = self.targets.get(metric)
+            if target is not None and seconds > target:
+                self._violated[metric] = self._violated.get(metric, 0) + 1
+
+    def _window(self, metric: str, now: float) -> list:
+        q = self._samples.get(metric)
+        if not q:
+            return []
+        cutoff = now - self.window_s
+        while q and q[0][0] < cutoff:
+            q.popleft()
+        return [v for _, v in q]
+
+    # ---------------- evaluation ----------------
+
+    def metric_state(self, metric: str) -> dict:
+        """Window percentiles + target compliance + error budget for one metric."""
+        now = self._clock()
+        with self._lock:
+            vals = sorted(self._window(metric, now))
+            target = self.targets.get(metric)
+            n = len(vals)
+            state = {
+                "count": n,
+                "target_ms": round(target * 1e3, 3) if target is not None else None,
+                "observed_total": self._observed.get(metric, 0),
+                "violations_total": self._violated.get(metric, 0),
+            }
+            for p in PERCENTILES:
+                state[f"p{p}_ms"] = round(_percentile(vals, p) * 1e3, 3)
+            if target is None or n == 0:
+                state["violations"] = 0
+                state["compliance"] = 1.0
+                state["error_budget"] = 1.0
+                state["ok"] = True
+                return state
+            violations = sum(1 for v in vals if v > target)
+            compliance = 1.0 - violations / n
+            allowed = (1.0 - self.objective) * n
+            # budget remaining: 1 with zero violations, 0 when the quota is
+            # exactly spent, negative when the objective is blown
+            budget = 1.0 - (violations / allowed if allowed > 0 else float(violations))
+            state["violations"] = violations
+            state["compliance"] = round(compliance, 5)
+            state["error_budget"] = round(budget, 5)
+            state["ok"] = budget > 0.0
+            return state
+
+    def snapshot(self) -> dict:
+        """Wire form: per-metric state + the overall verdict."""
+        with self._lock:
+            metrics = sorted(set(self._samples) | set(self.targets))
+        per = {m: self.metric_state(m) for m in metrics}
+        return {
+            "objective": self.objective,
+            "window_s": self.window_s,
+            "metrics": per,
+            "ok": all(s["ok"] for s in per.values()) if per else True,
+        }
+
+    def ok(self) -> bool:
+        return self.snapshot()["ok"]
+
+    # ---------------- exposition ----------------
+
+    def render_metrics(self, prefix: str = "dynamo_slo") -> str:
+        from dynamo_tpu.utils.prometheus import render_family
+
+        snap = self.snapshot()
+        quantile_samples, target_samples, budget_samples, compliance_samples = [], [], [], []
+        violation_samples = []
+        for metric, s in sorted(snap["metrics"].items()):
+            for p in PERCENTILES:
+                quantile_samples.append(
+                    ({"metric": metric, "quantile": f"0.{p}"}, s[f"p{p}_ms"] / 1e3)
+                )
+            if s["target_ms"] is not None:
+                target_samples.append(({"metric": metric}, s["target_ms"] / 1e3))
+                budget_samples.append(({"metric": metric}, s["error_budget"]))
+                compliance_samples.append(({"metric": metric}, s["compliance"]))
+            violation_samples.append(({"metric": metric}, s["violations_total"]))
+        out = render_family(
+            f"{prefix}_latency_seconds", "gauge",
+            "rolling-window latency percentile per SLO metric",
+            quantile_samples,
+        )
+        if target_samples:
+            out += render_family(
+                f"{prefix}_target_seconds", "gauge",
+                "configured SLO target per metric", target_samples,
+            )
+            out += render_family(
+                f"{prefix}_error_budget_remaining", "gauge",
+                "fraction of the allowed violation quota unspent in the window "
+                "(negative = out of SLO)", budget_samples,
+            )
+            out += render_family(
+                f"{prefix}_compliance_ratio", "gauge",
+                "fraction of window samples meeting the target", compliance_samples,
+            )
+        out += render_family(
+            f"{prefix}_violations_total", "counter",
+            "lifetime observations exceeding their SLO target", violation_samples,
+        )
+        return out

@@ -1,0 +1,80 @@
+"""Exposition conformance: every render_metrics / render_stage_metrics
+surface must pass utils.prometheus.check_exposition, so new metric families
+can't regress HELP/TYPE/label format.
+
+The surfaces come from utils.prometheus._sample_surfaces — the same builders
+`python -m dynamo_tpu.utils.prometheus --check` (the lint-gate self-check)
+runs, so CI and pytest enforce one list. A composition test additionally
+checks the combined colocated exposition (HTTP metrics + SLO + engine stage +
+resource families in ONE document) for cross-surface family collisions.
+"""
+
+import pytest
+
+from dynamo_tpu.utils.prometheus import _sample_surfaces, check_exposition, self_check
+
+_SURFACES = _sample_surfaces()
+
+
+@pytest.mark.parametrize(
+    "name,text", _SURFACES, ids=[name for name, _ in _SURFACES]
+)
+def test_surface_exposition_conformant(name, text):
+    assert text.strip(), f"{name} rendered empty exposition"
+    problems = check_exposition(text)
+    assert problems == [], f"{name}: {problems}"
+
+
+def test_self_check_green():
+    assert self_check() == []
+
+
+def test_surfaces_cover_every_layer():
+    """The list must keep covering dataplane client/server, prefill worker,
+    engine, http metrics, and components.metrics (the satellite's contract);
+    shrinking it silently would hollow the gate out."""
+    names = {name for name, _ in _SURFACES}
+    for required in (
+        "llm.http.metrics",
+        "utils.slo",
+        "utils.health",
+        "engine.render_stage_metrics",
+        "disagg.dataplane.server",
+        "disagg.dataplane.client",
+        "disagg.prefill_worker",
+        "components.metrics",
+    ):
+        assert required in names, f"missing exposition surface {required}"
+
+
+def test_colocated_composition_has_no_family_collisions():
+    """The in=http serving path concatenates HTTP metrics + frontend SLO +
+    engine stage/resource/health/SLO families into one /metrics document;
+    duplicate families across surfaces (e.g. two dynamo_slo_* trackers)
+    would be a conformance break only visible in composition."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.engine.page_table import PageAllocator
+    from dynamo_tpu.engine.scheduler import Scheduler
+    from dynamo_tpu.llm.http.metrics import Metrics
+    from dynamo_tpu.utils.slo import SloTracker
+
+    cfg = EngineConfig(model_id="tiny", page_size=4, num_pages=8, max_seqs=2,
+                       prefill_buckets=(16,))
+    eng = AsyncJaxEngine(cfg)
+    eng.allocator = PageAllocator(cfg.num_pages, cfg.page_size)
+    eng.scheduler = Scheduler(cfg, None, eng.allocator)
+    eng.slo.observe("ttft", 0.1)
+
+    m = Metrics()
+    m.inc_request("tiny", "chat_completions", "unary", "200")
+    m.observe_ttft("tiny", 0.1)
+    front_slo = SloTracker({"ttft": 0.5})
+    front_slo.observe("ttft", 0.1)
+
+    combined = m.render(front_slo.render_metrics() + eng.render_stage_metrics())
+    problems = check_exposition(combined)
+    assert problems == [], problems
+    # both trackers present, under distinct prefixes
+    assert "# TYPE dynamo_slo_latency_seconds gauge" in combined
+    assert "# TYPE dynamo_engine_slo_latency_seconds gauge" in combined

@@ -1,0 +1,349 @@
+"""Fleet health plane end-to-end: two-worker loopback kill test (acceptance),
+engine health/resource integration on a tiny engine, and the /live vs /ready
+probe split on the HTTP service."""
+
+import asyncio
+import time
+
+import aiohttp
+
+from dynamo_tpu.cplane.broker import Broker
+from dynamo_tpu.components.frontend import FrontendService
+from dynamo_tpu.components.metrics import MetricsService
+from dynamo_tpu.components.planner import PlannerService
+from dynamo_tpu.frontends.pipeline import card_for_model
+from dynamo_tpu.llm.kv_router.router import KvRouter
+from dynamo_tpu.llm.model_registry import ModelEntry, register_model
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.utils.prometheus import check_exposition
+
+NS = "fh"
+
+
+async def _poll(predicate, timeout=8.0, interval=0.1, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        result = predicate()
+        if asyncio.iscoroutine(result):
+            result = await result
+        if result:
+            return
+        await asyncio.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _worker_stats(state="ready"):
+    return {
+        "kv_metrics": {
+            "request_active_slots": 1, "request_total_slots": 100,
+            "kv_active_blocks": 10, "kv_total_blocks": 1000,
+            "num_requests_waiting": 0, "gpu_cache_usage_perc": 0.01,
+            "gpu_prefix_cache_hit_rate": 0.0,
+        },
+        "health": {"state": state, "heartbeat_age_s": 0.01},
+        "resources": {"kv_pages_used": 10, "kv_pages_total": 1000,
+                      "xla_compiles": 2, "hbm_bytes_in_use": 0},
+        "stage_seconds": {"prefill_s": 0.1},
+    }
+
+
+def test_two_worker_kill_health_plane():
+    """Acceptance: kill one of two workers and assert its health goes
+    stale/dead in /cluster/status, the router stops selecting it, the
+    planner's observe() excludes it, and /ready on a frontend pointed only
+    at the dead pool flips to 503 while /live stays 200."""
+
+    async def body():
+        broker = Broker()
+        bport = await broker.start()
+        addr = f"127.0.0.1:{bport}"
+
+        async def handler(req):
+            yield {"ok": True}
+
+        # two mock decode workers on the "backend" component; worker 0 ALSO
+        # exclusively serves the "deadpool" component the frontend points at
+        rts = []
+        for i in range(2):
+            rt = DistributedRuntime(cplane_address=addr)
+            await rt.connect()
+            ep = rt.namespace(NS).component("backend").endpoint("generate")
+            await ep.serve_endpoint(handler, metrics=_worker_stats)
+            rts.append(rt)
+        dead_ep = rts[0].namespace(NS).component("deadpool").endpoint("generate")
+        await dead_ep.serve_endpoint(handler, metrics=_worker_stats)
+        id0 = rts[0].primary_lease.lease_id
+        id1 = rts[1].primary_lease.lease_id
+
+        mon_rt = DistributedRuntime(cplane_address=addr)
+        await mon_rt.connect()
+        svc = MetricsService(
+            mon_rt, NS, "backend", host="127.0.0.1", port=0,
+            interval=0.15, max_missed_scrapes=2,
+        )
+        mport = await svc.start()
+
+        router_rt = DistributedRuntime(cplane_address=addr)
+        await router_rt.connect()
+        router = KvRouter(router_rt, NS, "backend", kv_block_size=4,
+                          metrics_interval=0.15)
+        await router.start()
+
+        planner_rt = DistributedRuntime(cplane_address=addr)
+        await planner_rt.connect()
+        planner = PlannerService(planner_rt, NS, decode_component="backend",
+                                 interval=3600.0)
+        planner.aggregator.max_missed_scrapes = 2
+
+        # frontend pointed ONLY at the deadpool component (worker 0)
+        front_rt = DistributedRuntime(cplane_address=addr)
+        await front_rt.connect()
+        card = card_for_model("tiny")
+        await register_model(front_rt.cplane, ModelEntry(
+            name="tiny", endpoint=f"dyn://{NS}.deadpool.generate",
+            model_type="chat", card=card,
+        ))
+        frontend = FrontendService(front_rt, host="127.0.0.1", port=0)
+        fport = await frontend.start()
+        base = f"http://127.0.0.1:{fport}"
+
+        try:
+            async with aiohttp.ClientSession() as http:
+                # ---- healthy fleet baseline ----
+                await _poll(
+                    lambda: len(router.aggregator.get_metrics()) == 2,
+                    what="router sees both workers",
+                )
+                picked = {await router.schedule([1, 2, 3, 4]) for _ in range(6)}
+                assert picked <= {id0, id1} and picked
+
+                await planner.step()
+                loads = planner.aggregator.get_metrics()
+                assert {w.worker_id for w in loads} == {id0, id1}
+
+                async with http.get(f"{base}/ready") as resp:
+                    assert resp.status == 200
+                    body = await resp.json()
+                    assert body["status"] == "ready"
+
+                async def status_doc():
+                    async with http.get(
+                        f"http://127.0.0.1:{mport}/cluster/status"
+                    ) as resp:
+                        assert resp.status == 200
+                        return await resp.json()
+
+                await _poll(
+                    lambda: status_doc(), what="cluster status up",
+                )
+                doc = await status_doc()
+                assert doc["summary"]["workers"] == 2
+                assert all(w["servable"] for w in doc["workers"])
+                assert all(w["health"]["state"] == "ready" for w in doc["workers"])
+
+                # federated /metrics carries per-worker labeled families
+                async with http.get(f"http://127.0.0.1:{mport}/metrics") as resp:
+                    text = await resp.text()
+                assert check_exposition(text) == []
+                assert "llm_worker_health_state" in text
+                assert f'worker_id="{id0:x}"' in text and f'worker_id="{id1:x}"' in text
+                assert "llm_worker_resource_kv_pages_used" in text
+
+                # ---- kill worker 0 (lease revoke + stats stop) ----
+                await rts[0]._shutdown_hook()
+
+                # /cluster/status: worker 0 goes stale, then ages out entirely
+                async def dead_in_status():
+                    doc = await status_doc()
+                    entry = {w["worker_id"]: w for w in doc["workers"]}.get(f"{id0:x}")
+                    return entry is None or (entry["stale"] and not entry["servable"])
+
+                async def aged_out():
+                    doc = await status_doc()
+                    return f"{id0:x}" not in {w["worker_id"] for w in doc["workers"]}
+
+                await _poll(dead_in_status, what="worker 0 stale/dead in status")
+                await _poll(aged_out, what="worker 0 aged out of status")
+
+                # router stops selecting the dead worker
+                await _poll(
+                    lambda: [w.worker_id for w in router.aggregator.get_metrics()] == [id1],
+                    what="router fleet view drops worker 0",
+                )
+                for _ in range(8):
+                    assert await router.schedule([1, 2, 3, 4]) == id1
+
+                # planner observe() excludes it once its own aggregator ages
+                # the silent worker out (max_missed_scrapes rounds)
+                for _ in range(planner.aggregator.max_missed_scrapes + 1):
+                    await planner.step()
+                loads = planner.aggregator.get_metrics()
+                assert {w.worker_id for w in loads} == {id1}
+                # and the decode replica count reflects the surviving instance
+                assert await planner._replica_count("backend") == 1
+
+                # frontend pointed only at the dead pool: /ready 503, /live 200
+                async def front_unready():
+                    async with http.get(f"{base}/ready") as resp:
+                        return resp.status == 503
+                await _poll(front_unready, what="/ready flips to 503")
+                async with http.get(f"{base}/ready") as resp:
+                    body = await resp.json()
+                    assert body["status"] == "unready"
+                async with http.get(f"{base}/live") as resp:
+                    assert resp.status == 200
+                    assert (await resp.json())["status"] == "live"
+        finally:
+            await frontend.stop()
+            await router.stop()
+            await planner.stop()
+            await svc.stop()
+            for rt in (rts[1], mon_rt, router_rt, planner_rt, front_rt):
+                await rt._shutdown_hook()
+            await broker.stop()
+
+    asyncio.run(body())
+
+
+# ---------------- tiny-engine integration ----------------
+
+
+def test_engine_health_resources_and_slo():
+    """A real (tiny) engine reports ready after start, resource gauges and
+    compile counts after serving, SLO observations, and dead after shutdown;
+    its /metrics exposition stays conformant throughout."""
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.engine.scheduler import EngineRequest
+
+    from tests.test_engine import tiny_engine_config
+
+    async def body():
+        cfg = tiny_engine_config(slo_ttft_ms=60_000.0)
+        engine = AsyncJaxEngine(cfg)
+        assert engine.health.state == "starting"
+        await engine.start()
+        assert engine.health.state == "ready"
+
+        outs = []
+        async for out in engine.generate(
+            EngineRequest(request_id="r1", token_ids=[1, 2, 3, 4, 5])
+        ):
+            outs.append(out)
+        assert outs
+
+        r = engine.resource_snapshot()
+        assert r["kv_pages_total"] == cfg.num_pages - 1
+        assert r["kv_pages_peak"] >= 1  # watermark moved during serving
+        assert r["xla_compiles"] >= 1 and r["xla_compile_s"] > 0
+        assert r["hbm_bytes_in_use"] == 0  # CPU: graceful zeros
+        assert r["prefix_cache_miss_blocks"] >= 0
+
+        # heartbeat is live while the loop runs
+        await asyncio.sleep(0.05)
+        assert engine.health.heartbeat_age() < 5.0
+
+        slo = engine.slo_snapshot()
+        assert slo["metrics"]["ttft"]["count"] >= 1
+        assert slo["ok"]  # 60s target: comfortably met
+
+        text = engine.render_stage_metrics()
+        assert check_exposition(text) == []
+        assert "dynamo_engine_kv_pages" in text
+        assert "dynamo_engine_xla_compiles_total" in text
+        assert 'dynamo_health_state{component="engine",state="ready"} 1' in text
+        assert "dynamo_engine_slo_latency_seconds" in text
+
+        await engine.shutdown()
+        assert engine.health.state == "dead"
+
+    asyncio.run(body())
+
+
+def test_worker_stats_carry_health_plane():
+    """WorkerService._stats: kv_metrics + health + resources + slo ride one
+    stats broadcast (what the aggregator scrapes)."""
+    from dynamo_tpu.components.worker import WorkerService
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+
+    from tests.test_engine import tiny_engine_config
+
+    async def body():
+        broker = Broker()
+        bport = await broker.start()
+        rt = DistributedRuntime(cplane_address=f"127.0.0.1:{bport}")
+        await rt.connect()
+        svc = WorkerService(
+            rt, NS, "backend", ModelDeploymentCard.for_tiny("tiny"),
+            tiny_engine_config(), register=False,
+        )
+        await svc.start()
+        try:
+            stats = svc._stats()
+            assert stats["health"]["state"] == "ready"
+            assert stats["resources"]["kv_pages_total"] > 0
+            assert "slo" in stats and "kv_metrics" in stats
+        finally:
+            await svc.stop()
+            await rt._shutdown_hook()
+            await broker.stop()
+
+    asyncio.run(body())
+
+
+# ---------------- /live vs /ready probe split ----------------
+
+
+def test_http_live_ready_split():
+    from dynamo_tpu.llm.http.service import HttpService
+
+    async def body():
+        state = {"ok": True}
+        svc = HttpService(
+            host="127.0.0.1", port=0,
+            readiness=lambda: (state["ok"], {"detail": "x"}),
+        )
+        port = await svc.start()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with aiohttp.ClientSession() as http:
+                async with http.get(f"{base}/ready") as resp:
+                    assert resp.status == 200
+                state["ok"] = False
+                async with http.get(f"{base}/ready") as resp:
+                    assert resp.status == 503
+                    assert (await resp.json())["status"] == "unready"
+                # /live is static: stays 200 regardless of readiness, and its
+                # payload never touches the model manager
+                async with http.get(f"{base}/live") as resp:
+                    assert resp.status == 200
+                    assert await resp.json() == {"status": "live"}
+                # /health keeps the legacy model-listing behavior
+                async with http.get(f"{base}/health") as resp:
+                    assert resp.status == 200
+                    assert "models" in await resp.json()
+                # SLO families render on /metrics
+                svc.slo.observe("ttft", 0.01)
+                async with http.get(f"{base}/metrics") as resp:
+                    text = await resp.text()
+                assert check_exposition(text) == []
+                assert "dynamo_slo_latency_seconds" in text
+        finally:
+            await svc.stop()
+
+    asyncio.run(body())
+
+
+def test_http_ready_defaults_to_200_without_provider():
+    from dynamo_tpu.llm.http.service import HttpService
+
+    async def body():
+        svc = HttpService(host="127.0.0.1", port=0)
+        port = await svc.start()
+        try:
+            async with aiohttp.ClientSession() as http:
+                async with http.get(f"http://127.0.0.1:{port}/ready") as resp:
+                    assert resp.status == 200
+        finally:
+            await svc.stop()
+
+    asyncio.run(body())

@@ -158,27 +158,32 @@ def test_http_metrics_render_conformant():
 def test_metrics_component_render_conformant():
     """Satellite: components/metrics.py must emit one HELP/TYPE pair per
     family (the old render had a single free-text comment for everything)."""
+    import time
+
     from dynamo_tpu.components.metrics import MetricsService
+    from dynamo_tpu.llm.kv_router.metrics_aggregator import WorkerView
     from dynamo_tpu.llm.kv_router.scheduler import WorkerLoad
 
     class _Drt:
         cplane = None
 
     svc = MetricsService(_Drt(), "ns", "backend")
-    svc.aggregator._latest = [
-        WorkerLoad.from_wire(0xAB, {
-            "request_active_slots": 1, "request_total_slots": 8,
-            "kv_active_blocks": 5, "kv_total_blocks": 100,
-            "num_requests_waiting": 0, "gpu_cache_usage_perc": 0.05,
-            "gpu_prefix_cache_hit_rate": 0.5,
-        })
-    ]
-    svc.aggregator._latest_raw = [
-        (0xAB, {"stage_seconds": {
-            "queue_wait_s": 0.5, "prefill_s": 1.25, "decode_dispatch_s": 3.0,
-            "reconcile_wait_s": 0.1, "queue_wait_n": 4,
-        }}),
-    ]
+    kv = {
+        "request_active_slots": 1, "request_total_slots": 8,
+        "kv_active_blocks": 5, "kv_total_blocks": 100,
+        "num_requests_waiting": 0, "gpu_cache_usage_perc": 0.05,
+        "gpu_prefix_cache_hit_rate": 0.5,
+    }
+    stage = {
+        "queue_wait_s": 0.5, "prefill_s": 1.25, "decode_dispatch_s": 3.0,
+        "reconcile_wait_s": 0.1, "queue_wait_n": 4,
+    }
+    svc.aggregator._workers[0xAB] = WorkerView(
+        0xAB,
+        data={"kv_metrics": kv, "stage_seconds": stage},
+        load=WorkerLoad.from_wire(0xAB, kv),
+        last_seen=time.monotonic(),
+    )
     svc._isl_blocks, svc._overlap_blocks = 10, 4
     text = svc.render()
     assert check_exposition(text) == [], check_exposition(text)

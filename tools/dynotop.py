@@ -1,0 +1,167 @@
+#!/usr/bin/env python3
+"""dynotop: live fleet dashboard over the metrics component's /cluster/status.
+
+    python tools/dynotop.py --url http://127.0.0.1:9091
+    python tools/dynotop.py --url http://127.0.0.1:9091 --once   # one snapshot
+
+Renders one row per worker: health state, heartbeat/staleness, slot and KV
+page occupancy, waiting queue, HBM, compile churn, and SLO state — the
+operator view of the signals the router/planner consume machine-side.
+No third-party deps (urllib + optional curses), so it runs on a bare TPU VM.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+STATE_GLYPH = {
+    "ready": "●", "degraded": "◐", "starting": "○", "draining": "◌",
+    "dead": "✗", "unknown": "?",
+}
+
+
+def fetch_status(url: str, timeout: float = 2.0) -> dict:
+    with urllib.request.urlopen(url.rstrip("/") + "/cluster/status", timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def _fmt_bytes(n) -> str:
+    n = float(n or 0)
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if n < 1024 or unit == "TB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return "?"
+
+
+def _slo_cell(slo: dict | None) -> str:
+    if not slo or not slo.get("metrics"):
+        return "-"
+    worst = None
+    for name, s in slo["metrics"].items():
+        if s.get("target_ms") is None:
+            continue
+        b = s.get("error_budget", 1.0)
+        if worst is None or b < worst[1]:
+            worst = (name, b)
+    if worst is None:
+        return "untargeted"
+    name, budget = worst
+    flag = "OK" if budget > 0 else "BLOWN"
+    return f"{name} budget {budget:+.2f} {flag}"
+
+
+def render_status(doc: dict) -> str:
+    """Pure renderer: /cluster/status JSON -> the dashboard text (testable
+    without a cluster; curses and plain mode both draw this)."""
+    s = doc.get("summary", {})
+    lines = [
+        f"dynotop — {doc.get('namespace')}/{doc.get('component')}  "
+        f"workers={s.get('workers', 0)} servable={s.get('servable', 0)} "
+        f"stale={s.get('stale', 0)} unservable={s.get('unservable', 0)}  "
+        f"scrape={doc.get('scrape_interval_s', '?')}s",
+        "",
+    ]
+    header = (
+        f"{'WORKER':<12} {'STATE':<10} {'HB':>6} {'SEEN':>6} {'MISS':>4} "
+        f"{'SLOTS':>7} {'KV%':>6} {'WAIT':>5} {'HBM':>9} {'CMPL':>5}  SLO"
+    )
+    lines.append(header)
+    lines.append("-" * len(header))
+    for w in doc.get("workers", []):
+        health = w.get("health") or {}
+        state = health.get("state", "unknown")
+        glyph = STATE_GLYPH.get(state, "?")
+        kv = w.get("kv_metrics") or {}
+        res = w.get("resources") or {}
+        slots = f"{kv.get('request_active_slots', 0)}/{kv.get('request_total_slots', 0)}"
+        kv_pct = 100.0 * kv.get("kv_active_blocks", 0) / max(1, kv.get("kv_total_blocks", 1))
+        hb = health.get("heartbeat_age_s")
+        stale_mark = " STALE" if w.get("stale") else ""
+        lines.append(
+            f"{w.get('worker_id', '?'):<12} {glyph} {state:<8} "
+            f"{(f'{hb:.1f}s' if hb is not None else '-'):>6} "
+            f"{w.get('last_seen_s', 0):>5.1f}s {w.get('missed_scrapes', 0):>4} "
+            f"{slots:>7} {kv_pct:>5.1f}% "
+            f"{kv.get('num_requests_waiting', 0):>5} "
+            f"{_fmt_bytes(res.get('hbm_bytes_in_use', 0)):>9} "
+            f"{res.get('xla_compiles', 0):>5}  {_slo_cell(w.get('slo'))}"
+            f"{stale_mark}"
+        )
+    if not doc.get("workers"):
+        lines.append("(no workers reporting)")
+    hit = doc.get("kv_hit_rate") or {}
+    if hit.get("isl_blocks"):
+        pct = 100.0 * hit.get("overlap_blocks", 0) / hit["isl_blocks"]
+        lines.append("")
+        lines.append(f"router prefix-cache hit rate: {pct:.1f}% "
+                     f"({hit.get('overlap_blocks', 0)}/{hit['isl_blocks']} blocks)")
+    return "\n".join(lines)
+
+
+def _plain_loop(url: str, interval: float) -> None:
+    while True:
+        try:
+            doc = fetch_status(url)
+            out = render_status(doc)
+        except Exception as e:
+            out = f"dynotop: fetch failed: {e}"
+        print("\x1b[2J\x1b[H" + out, flush=True)
+        time.sleep(interval)
+
+
+def _curses_loop(url: str, interval: float) -> None:
+    import curses
+
+    def body(stdscr):
+        curses.curs_set(0)
+        stdscr.timeout(int(interval * 1000))
+        while True:
+            try:
+                text = render_status(fetch_status(url))
+            except Exception as e:
+                text = f"dynotop: fetch failed: {e}"
+            stdscr.erase()
+            maxy, maxx = stdscr.getmaxyx()
+            for i, line in enumerate(text.splitlines()[: maxy - 1]):
+                stdscr.addnstr(i, 0, line, maxx - 1)
+            stdscr.refresh()
+            if stdscr.getch() in (ord("q"), 27):
+                return
+
+    curses.wrapper(body)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--url", default="http://127.0.0.1:9091",
+                   help="metrics component base URL (serves /cluster/status)")
+    p.add_argument("--interval", type=float, default=2.0)
+    p.add_argument("--once", action="store_true", help="print one snapshot and exit")
+    p.add_argument("--plain", action="store_true",
+                   help="plain-text refresh loop instead of curses")
+    args = p.parse_args(argv)
+
+    if args.once:
+        try:
+            print(render_status(fetch_status(args.url)))
+            return 0
+        except Exception as e:
+            print(f"dynotop: fetch failed: {e}", file=sys.stderr)
+            return 1
+    if args.plain or not sys.stdout.isatty():
+        _plain_loop(args.url, args.interval)
+        return 0
+    try:
+        _curses_loop(args.url, args.interval)
+    except ImportError:
+        _plain_loop(args.url, args.interval)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

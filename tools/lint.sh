@@ -11,6 +11,10 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# metrics self-check: import and validate every Prometheus exposition
+# surface without a cluster (promtool-style conformance; no egress needed)
+JAX_PLATFORMS=cpu python -m dynamo_tpu.utils.prometheus --check
+
 if command -v ruff >/dev/null 2>&1; then
     exec ruff check dynamo_tpu tests tools bench.py
 fi
