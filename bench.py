@@ -1113,6 +1113,184 @@ async def run_quant_int8_parity(decode_tokens: int = 72) -> dict:
     }
 
 
+def kv_int8_model_id(base: str | None = None) -> str:
+    """A tiny:{...} model id with the int8 KV cache turned on — identical
+    shapes/seed to its base, so the int8-vs-bf16 KV comparison isolates the
+    cache quantization itself (the weight-int8 section's trick, applied to
+    the cache)."""
+    base = base or json_model_id()
+    fam, js = base.split(":", 1)
+    cfg = json.loads(js)
+    cfg["kv_cache_dtype"] = "int8"
+    return fam + ":" + json.dumps(cfg)
+
+
+async def run_prefill_kv_int8(decode_tokens: int = 64) -> dict:
+    """Int8 KV cache vs bf16 KV on the prefill-bound reference workload
+    shape (3K ISL / 150 OSL — the config that has been flat for three judge
+    rounds): TTFT p50 + tok/s with the cache as the only delta, the
+    page-capacity ratio at an equal HBM budget (the ~2x claim, computed from
+    the real per-page byte cost including scale planes), and teacher-forced
+    greedy agreement over 64 steps (the acceptance bar: >= 0.9 — KV
+    quantization error is per-row absmax/127, far gentler than weight
+    quantization, so flips only happen on near-degenerate margins).
+
+    On CPU (no TPU in the build container) the section scales the geometry
+    down and forces DYNTPU_PALLAS=1 so the int8 decode + lookahead-prefill
+    kernels execute in interpret mode — the smoke proves the whole
+    config -> engine -> kernel path, the driver's TPU run prices it."""
+    import gc
+    import os
+
+    import jax
+
+    from dynamo_tpu.quant.kv import pages_for_hbm_budget
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu:
+        # interpret-mode kernels at a CPU-tractable geometry; D=128 keeps
+        # the non-folded flash kernels (incl. the lookahead prefill) engaged
+        geom = {
+            "vocab_size": 512, "hidden_size": 256, "intermediate_size": 512,
+            "num_layers": 2, "num_heads": 2, "num_kv_heads": 2,
+            "head_dim": 128, "dtype": "f32",
+        }
+        base_id = "tiny:" + json.dumps(geom)
+        run_kw = dict(
+            rounds=1, prompt_len=192, decode_tokens=8, max_model_len=512,
+            vocab=500,
+        )
+        batch, page_size = 2, 16
+        tf_steps = min(decode_tokens, 16)  # interpret decode is slow
+        tf_prompt = 64
+        prev_pallas = os.environ.get("DYNTPU_PALLAS")
+        os.environ["DYNTPU_PALLAS"] = "1"
+    else:
+        geom = json.loads(json_model_id().split(":", 1)[1])
+        base_id = json_model_id()
+        run_kw = dict(
+            rounds=2, prompt_len=3072, decode_tokens=150, max_model_len=4096,
+        )
+        batch, page_size = 16, 128
+        tf_steps = decode_tokens
+        tf_prompt = PROMPT_LEN
+        prev_pallas = None
+    int8_id = kv_int8_model_id(base_id)
+
+    try:
+        # ---- throughput/TTFT: bf16-KV leg then int8-KV leg, same harness
+        # shapes back-to-back so tunnel drift hits both ----
+        bf16 = await run_config(batch, page_size, model_id=base_id, **run_kw)
+        int8 = await run_config(batch, page_size, model_id=int8_id, **run_kw)
+        speedup = int8["tok_s"] / bf16["tok_s"] if bf16["tok_s"] else None
+        ttft_ratio = (
+            int8["ttft_p50_ms"] / bf16["ttft_p50_ms"]
+            if bf16["ttft_p50_ms"]
+            else None
+        )
+
+        # ---- page capacity at an equal HBM budget (deterministic
+        # arithmetic from the real per-page cost incl. int8 scale planes;
+        # page 0 is the allocator's reserved trash page either way) ----
+        budget = 1 << 30  # 1 GiB nominal; the RATIO is budget-independent
+        cap_args = (
+            page_size, geom["num_kv_heads"], geom["head_dim"],
+            geom["num_layers"],
+        )
+        pages_bf16 = pages_for_hbm_budget(budget, *cap_args, None)
+        pages_int8 = pages_for_hbm_budget(budget, *cap_args, "int8")
+        capacity_ratio = pages_int8 / max(1, pages_bf16)
+
+        # ---- greedy-agreement parity: teacher-forced per-step argmax with
+        # the int8 cache replaying the bf16 chain's fed tokens ----
+        import jax.numpy as jnp
+
+        from dynamo_tpu.models.registry import load_model
+
+        rng = np.random.default_rng(23)
+        probe = rng.integers(1, run_kw["vocab"] if "vocab" in run_kw else 31000, tf_prompt)
+        positions = np.arange(tf_prompt, dtype=np.int32)
+        tf_ps = 64 if not on_cpu else 16
+        n_pages = -(-(tf_prompt + tf_steps) // tf_ps) + 1
+        page_table = np.arange(1, n_pages + 1, dtype=np.int32)
+
+        def greedy_chain(model_id: str, forced=None):
+            model, params = load_model(model_id)
+            kv = model.init_kv_cache(n_pages + 2, tf_ps)
+            pts = np.zeros((1, n_pages + 2), np.int32)
+            pts[0, : len(page_table)] = page_table
+            logits, kv = jax.jit(model.prefill)(
+                params, kv, jnp.asarray(probe, jnp.int32), jnp.asarray(positions),
+                jnp.asarray(page_table), jnp.ones(tf_prompt, bool),
+                jnp.asarray(tf_prompt - 1),
+            )
+            all_logits = [np.asarray(jax.device_get(logits), np.float32)]
+            decode = jax.jit(model.decode)
+            out = [int(all_logits[0].argmax())]
+            feed = out[0] if forced is None else forced[0]
+            for i in range(tf_steps - 1):
+                logits, kv = decode(
+                    params, kv, jnp.asarray([feed], jnp.int32),
+                    jnp.asarray([tf_prompt + i], jnp.int32), jnp.asarray(pts),
+                    jnp.asarray([True]),
+                )
+                row = np.asarray(jax.device_get(logits), np.float32)[0]
+                all_logits.append(row)
+                tok = int(row.argmax())
+                out.append(tok)
+                feed = tok if forced is None else forced[i + 1]
+            return out, np.stack(all_logits)
+
+        ref_chain, l_bf16 = greedy_chain(base_id)
+        tf_chain, l_int8 = greedy_chain(int8_id, forced=ref_chain)
+        agree = sum(int(a == b) for a, b in zip(ref_chain, tf_chain)) / len(ref_chain)
+        max_delta = float(np.max(np.abs(l_bf16[0] - l_int8[0])))
+        logit_std = float(np.std(l_bf16[0]))
+    finally:
+        if prev_pallas is None:
+            os.environ.pop("DYNTPU_PALLAS", None)
+        else:
+            os.environ["DYNTPU_PALLAS"] = prev_pallas
+        gc.collect()
+
+    return {
+        "kv_cache_dtype": "int8",
+        "cpu_smoke": on_cpu,
+        "workload": {
+            "batch": batch, "page_size": page_size,
+            "prompt_len": run_kw["prompt_len"],
+            "decode_tokens": run_kw["decode_tokens"],
+        },
+        "tok_s_bf16_kv": bf16["tok_s"],
+        "tok_s_int8_kv": int8["tok_s"],
+        "speedup_int8_over_bf16_kv": round(speedup, 3) if speedup else None,
+        "ttft_p50_ms": {"bf16": bf16["ttft_p50_ms"], "int8": int8["ttft_p50_ms"]},
+        "ttft_ratio_int8_over_bf16": round(ttft_ratio, 3) if ttft_ratio else None,
+        "stage_breakdown": {"bf16": bf16.get("stage_breakdown"),
+                            "int8": int8.get("stage_breakdown")},
+        "page_capacity_equal_hbm": {
+            "budget_bytes": budget,
+            "pages_bf16": pages_bf16,
+            "pages_int8": pages_int8,
+            "ratio": round(capacity_ratio, 3),
+        },
+        "teacher_forced_steps": tf_steps,
+        "teacher_forced_agreement": round(agree, 4),
+        "max_abs_logit_delta": round(max_delta, 4),
+        "logit_std_bf16_kv": round(logit_std, 4),
+        "target": (
+            "greedy agreement >= 0.9 over the teacher-forced steps; "
+            "capacity ratio ~2x (1.94 at ps=128 after scale planes); on TPU "
+            "the prefill-bound TTFT should finally move (halved context "
+            "stream + lookahead-prefetch flash prefill)"
+        ),
+        "pass": {
+            "greedy_agreement": bool(agree >= 0.9),
+            "page_capacity_2x": bool(capacity_ratio >= 1.8),
+        },
+    }
+
+
 async def run_spec_ngram(
     batch: int = 8, page_size: int = 64, prompt_len: int = 192,
     decode_tokens: int = 128, model_id: str | None = None,
@@ -1475,6 +1653,10 @@ async def run() -> dict:
         # weight-only int8 vs bf16 on the headline config: throughput ratio +
         # greedy/logit parity (the round-6 tentpole)
         await _section("parity_quant_int8", run_quant_int8_parity, 2400)
+        # int8 KV cache vs bf16 KV on the prefill-bound ref-workload shape:
+        # TTFT/tok_s, ~2x page capacity at equal HBM, greedy parity (the
+        # round-7 tentpole; composes with the int8 weights above)
+        await _section("prefill_kv_int8", run_prefill_kv_int8, 2400)
         await _section("parity_disagg", run_disagg_parity, 2400)
         # streamed vs monolithic KV transfer on the socket path: TTFT on
         # multi-chunk prompts, token parity, compute/transfer overlap
@@ -1527,6 +1709,7 @@ def _summary(errors: dict) -> dict:
     rout = DETAIL.get("parity_kv_routing")
     off = DETAIL.get("parity_host_offload")
     quant = DETAIL.get("parity_quant_int8")
+    kvq = DETAIL.get("prefill_kv_int8")
     spec = DETAIL.get("spec_ngram")
     return {
         "headline_tok_s": _get(head, "tok_s"),
@@ -1552,6 +1735,14 @@ def _summary(errors: dict) -> dict:
             "teacher_forced_agreement_64": _get(quant, "teacher_forced_agreement_64"),
             "agree_or_near_tie_64": _get(quant, "teacher_forced_agree_or_near_tie_64"),
             "max_abs_logit_delta": _get(quant, "max_abs_logit_delta"),
+        },
+        "prefill_kv_int8": {
+            "kv_cache_dtype": _get(kvq, "kv_cache_dtype"),
+            "tok_s_int8_kv": _get(kvq, "tok_s_int8_kv"),
+            "tok_s_bf16_kv": _get(kvq, "tok_s_bf16_kv"),
+            "ttft_ratio": _get(kvq, "ttft_ratio_int8_over_bf16"),
+            "page_capacity_ratio": _get(kvq, "page_capacity_equal_hbm", "ratio"),
+            "teacher_forced_agreement": _get(kvq, "teacher_forced_agreement"),
         },
         "spec_ngram": {
             "tok_s_spec": _get(spec, "tok_s_spec"),

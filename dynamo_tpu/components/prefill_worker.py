@@ -47,6 +47,7 @@ async def _main(args) -> None:
             max_seqs=args.max_seqs,
             page_size=args.page_size,
             max_model_len=args.max_model_len,
+            kv_cache_dtype=getattr(args, "kv_cache_dtype", None),
             kv_stream=not args.no_kv_stream,
             kv_stream_lanes=args.kv_stream_lanes,
             slo_ttft_ms=args.slo_ttft_ms,
@@ -103,6 +104,10 @@ def main(argv=None) -> None:
     p.add_argument("--max-seqs", type=int, default=8)
     p.add_argument("--page-size", type=int, default=16)
     p.add_argument("--max-model-len", type=int, default=2048)
+    p.add_argument("--kv-cache-dtype", choices=["bf16", "int8"], default=None,
+                   help="KV cache storage dtype: int8 halves attention HBM "
+                        "traffic, page capacity, and disagg wire bytes "
+                        "(per-page scales ride the part headers)")
     p.add_argument("--kv-stream-lanes", type=int, default=2,
                    help="parallel KV data-plane connections per decode worker "
                         "(chunk-streamed parts stripe across lanes)")

@@ -206,6 +206,7 @@ async def _main(args) -> None:
             max_seqs=args.max_seqs,
             max_model_len=args.max_model_len,
             quantize=getattr(args, "quantize", None),
+            kv_cache_dtype=getattr(args, "kv_cache_dtype", None),
             speculative=getattr(args, "speculative", None),
             kv_stream=not getattr(args, "no_kv_stream", False),
             kv_stream_lanes=getattr(args, "kv_stream_lanes", None) or 2,
@@ -244,6 +245,10 @@ def main(argv=None) -> None:
     p.add_argument("--max-model-len", type=int, default=2048)
     p.add_argument("--quantize", choices=["int8_wo"], default=None,
                    help="weight-only quantization applied at load time")
+    p.add_argument("--kv-cache-dtype", choices=["bf16", "int8"], default=None,
+                   help="KV cache storage dtype: int8 halves attention HBM "
+                        "traffic and ~doubles page capacity (per-page "
+                        "scales; composes with --quantize)")
     p.add_argument("--speculative", default=None, metavar="ngram:k",
                    help="speculative decoding: n-gram draft proposals + "
                         "batched multi-token verification (e.g. ngram:4)")

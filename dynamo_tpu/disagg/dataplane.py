@@ -17,7 +17,8 @@ self-contained frame
     u32 header_len | msgpack header | payload bytes
 
     header = {request_id, shape, dtype, xxh3, token,
-              part_seq, part_total, page_from, page_to, cat_axis}
+              part_seq, part_total, page_from, page_to, cat_axis
+              [, scales, scales_shape, scales_dtype]}
 
 ``xxh3`` covers the payload of THIS part only, so a corrupt frame kills one
 transfer, not the shared connection. ``page_from``/``page_to`` are logical
@@ -26,6 +27,13 @@ page ids and scatters each part as it lands); ``cat_axis`` is the page axis
 of the wire layout (models differ: llama [L,2,n,ps,H,D] -> 2, MLA latent
 [L,n,ps,latent] -> 1) so a consumer-less receiver can reassemble. A v1
 monolithic send is exactly a v2 transfer with ``part_total == 1``.
+
+Int8 KV caches (quant/kv.py) ship the int8 page data as the payload — half
+the bytes of the bf16 wire — with the per-page scale plane riding IN the
+part header (``scales`` raw bytes + shape/dtype: ~ps f32 per page, a
+rounding error of the payload). The receiver hands the consumer a
+``KvPart`` whose ``scales`` field carries the decoded plane; reassembly
+without a consumer yields the {"q","s"} wire dict.
 
 The client keeps N parallel *lanes* (connections) per destination and
 stripes parts across them, so one long prompt's multi-MB parts never
@@ -53,7 +61,11 @@ from dynamo_tpu.utils.prometheus import Histogram, render_family
 log = get_logger("disagg.dataplane")
 
 _LEN = struct.Struct("<I")
-MAX_HEADER = 1 << 20
+# frame-sanity bound, not a budget: int8 transfers carry their scale plane
+# in the header (L * 2 * n * ps * 4 bytes — a monolithic small-page-size
+# send can reach a few MB), so the cap sits well above any legitimate
+# header while still rejecting a corrupt length prefix
+MAX_HEADER = 8 << 20
 
 # part payload sizes: a tiny-model part is KBs, a serving-geometry chunk part
 # is tens of MB
@@ -109,6 +121,16 @@ class KvPart:
     page_to: int
     cat_axis: int
     data: np.ndarray
+    # int8 transfers: the per-page f32 scale plane decoded from the part
+    # header (None on full-precision wire). data is then the int8 page data.
+    scales: Optional[np.ndarray] = None
+
+    def wire_data(self):
+        """What inject_pages_bucketed consumes: the plain array, or the
+        {"q","s"} dict when this part carries an int8 block + scales."""
+        if self.scales is None:
+            return self.data
+        return {"q": self.data, "s": self.scales}
 
 
 @dataclass
@@ -260,8 +282,14 @@ class KvDataPlaneServer:
     def _assemble(self, pend: _Pending):
         parts = [pend.parts[seq] for seq in sorted(pend.parts)]
         if len(parts) == 1:
-            return parts[0].data
-        return np.concatenate([p.data for p in parts], axis=parts[0].cat_axis)
+            return parts[0].wire_data()
+        axis = parts[0].cat_axis
+        if parts[0].scales is not None:
+            return {
+                "q": np.concatenate([p.data for p in parts], axis=axis),
+                "s": np.concatenate([p.scales for p in parts], axis=axis),
+            }
+        return np.concatenate([p.data for p in parts], axis=axis)
 
     async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         peer = writer.get_extra_info("peername")
@@ -309,6 +337,11 @@ class KvDataPlaneServer:
                     self.dropped += 1
                     log.debug("dropping duplicate kv part %d for %s", seq, rid)
                     continue
+                scales = None
+                if header.get("scales") is not None:
+                    scales = np.frombuffer(
+                        header["scales"], _np_dtype(header["scales_dtype"])
+                    ).reshape(tuple(header["scales_shape"]))
                 part = KvPart(
                     seq=seq,
                     total=max(1, int(header.get("part_total", 1))),
@@ -316,6 +349,7 @@ class KvDataPlaneServer:
                     page_to=int(header.get("page_to", -1)),
                     cat_axis=int(header.get("cat_axis", 2)),
                     data=np.frombuffer(payload, dtype).reshape(shape),
+                    scales=scales,
                 )
                 pend.received.add(seq)
                 pend.total = max(pend.total, part.total)
@@ -394,10 +428,11 @@ class KvDataPlaneClient:
         self.bytes_sent = 0
 
     async def send(
-        self, address: str, request_id: str, array: np.ndarray, token: str = "",
+        self, address: str, request_id: str, array, token: str = "",
         page_from: int = -1, page_to: int = -1, cat_axis: int = 2,
     ) -> None:
-        """Monolithic (single-part) transfer — a v2 frame with part_total=1."""
+        """Monolithic (single-part) transfer — a v2 frame with part_total=1.
+        ``array`` may be the int8 {"q","s"} wire dict (quant/kv.py)."""
         await self.send_part(
             address, request_id, array, token=token,
             part_seq=0, part_total=1,
@@ -405,10 +440,14 @@ class KvDataPlaneClient:
         )
 
     async def send_part(
-        self, address: str, request_id: str, array: np.ndarray, token: str = "",
+        self, address: str, request_id: str, array, token: str = "",
         part_seq: int = 0, part_total: int = 1,
         page_from: int = -1, page_to: int = -1, cat_axis: int = 2,
+        scales: np.ndarray | None = None,
     ) -> None:
+        if isinstance(array, dict):  # int8 wire dict: q = payload, s = header
+            scales = array["s"] if scales is None else scales
+            array = array["q"]
         # zero-copy payload: write a memoryview of the contiguous array
         # (KV parts are tens of MB; bytes-concatenation would copy them
         # again and stall the event loop)
@@ -419,20 +458,27 @@ class KvDataPlaneClient:
         # which every other sender to this lane is stalled behind us —
         # per-part hashing also bounds each stall to one part, not one prompt
         digest = xxhash.xxh3_64_intdigest(payload)
-        header = msgpack.packb(
-            {
-                "request_id": request_id,
-                "shape": list(array.shape),
-                "dtype": str(array.dtype),
-                "xxh3": digest,
-                "token": token,
-                "part_seq": part_seq,
-                "part_total": part_total,
-                "page_from": page_from,
-                "page_to": page_to,
-                "cat_axis": cat_axis,
-            }
-        )
+        fields = {
+            "request_id": request_id,
+            "shape": list(array.shape),
+            "dtype": str(array.dtype),
+            "xxh3": digest,
+            "token": token,
+            "part_seq": part_seq,
+            "part_total": part_total,
+            "page_from": page_from,
+            "page_to": page_to,
+            "cat_axis": cat_axis,
+        }
+        if scales is not None:
+            # int8 transfers: the per-page scale plane rides in the header
+            # (~page_size f32 per page — noise next to the int8 payload,
+            # which itself is HALF the bf16 wire bytes)
+            s = np.ascontiguousarray(scales)
+            fields["scales"] = s.tobytes()
+            fields["scales_shape"] = list(s.shape)
+            fields["scales_dtype"] = str(s.dtype)
+        header = msgpack.packb(fields)
         lane = self._rr.get(address, 0) % self.lanes
         self._rr[address] = lane + 1
         key = (address, lane)

@@ -232,7 +232,9 @@ class DisaggDecodeEngine:
                     ids = np.asarray(page_ids[pf:pt], np.int32)
                     if len(ids) == 0:
                         return
-                    data, axis = part.data, part.cat_axis
+                    # int8 parts carry their scale plane; wire_data() is the
+                    # {"q","s"} dict inject_pages_bucketed scatters directly
+                    data, axis = part.wire_data(), part.cat_axis
                     self.parts_scattered += 1
                     scatter_tasks.append(asyncio.create_task(
                         self.engine.run_on_engine(

@@ -152,14 +152,18 @@ class PrefillWorker:
         cat_axis = getattr(self.engine.runner.model, "wire_n_axis", 2)
 
         async def _ship(seq: int, total: int, pf: int, pt: int, d2h_fut):
+            from dynamo_tpu.quant.kv import wire_nbytes
+
             arr = await asyncio.wrap_future(d2h_fut)  # D2H staged off-thread
             t0 = time.monotonic()
+            # int8 caches stage the {"q","s"} wire dict: the int8 payload is
+            # half the bf16 bytes and the scale plane rides the part header
             await self.kv_client.send_part(
                 rp.kv_addr, rp.request_id, arr, token=rp.kv_token,
                 part_seq=seq, part_total=total,
                 page_from=pf, page_to=pt, cat_axis=cat_axis,
             )
-            return t0, time.monotonic(), arr.nbytes
+            return t0, time.monotonic(), wire_nbytes(arr)
 
         def on_part(seq, total, pf, pt, d2h_fut):
             # engine thread -> event loop; tasks created in emission order so
@@ -216,9 +220,11 @@ class PrefillWorker:
                 self.stream_send_s += send_s
                 self.stream_overlap_s += overlap
             if host_data is not None:
+                from dynamo_tpu.quant.kv import wire_nbytes
+
                 ps = self.engine.config.page_size
                 with tracing.span(
-                    "disagg.kv_send", bytes=int(host_data.nbytes), mode="socket"
+                    "disagg.kv_send", bytes=wire_nbytes(host_data), mode="socket"
                 ):
                     await self.kv_client.send(
                         rp.kv_addr, rp.request_id, host_data, token=rp.kv_token,

@@ -36,6 +36,17 @@ class EngineConfig:
     #               HBM stream the decode roofline is made of
     #               (dynamo_tpu/quant/int8.py).
     quantize: str | None = None
+    # KV cache storage dtype:
+    #   None / "bf16" — pages at the model's native dtype
+    #   "int8"        — pages stored int8 with one f32 scale per (page,
+    #                   token row) (dynamo_tpu/quant/kv.py): halves the
+    #                   attention HBM stream on both kernel families, ~2x
+    #                   pages at the same HBM budget, half the disagg wire /
+    #                   host-offload bytes. Composes with `quantize` (weights
+    #                   and cache quantize independently). Llama-family
+    #                   pools only (MLA's latent cache raises); not yet
+    #                   composable with pp (the stage-sharded pool split).
+    kv_cache_dtype: str | None = None
     # speculative decoding ("ngram:k", e.g. "ngram:4"): the scheduler proposes
     # k draft tokens per sequence from its own prompt+output history
     # (prompt-lookup) and verifies all of them plus one bonus token in ONE
@@ -118,6 +129,18 @@ class EngineConfig:
             raise ValueError(
                 f"kv_stream_lanes must be >= 1; got {self.kv_stream_lanes}"
             )
+        if self.kv_cache_dtype is not None:
+            from dynamo_tpu.quant import KV_CACHE_DTYPES
+
+            if self.kv_cache_dtype not in KV_CACHE_DTYPES:
+                raise ValueError(
+                    f"kv_cache_dtype must be None or one of {KV_CACHE_DTYPES}; "
+                    f"got {self.kv_cache_dtype!r}"
+                )
+            if self.kv_cache_dtype == "int8" and self.pp > 1:
+                # the stage-sharded pool split (parallel/pipeline.py) has no
+                # QuantizedPages wiring yet; fail at config time
+                raise ValueError("kv_cache_dtype='int8' does not compose with pp > 1 yet")
         # a bad speculative spec must fail at config time, not mid-serving
         self.spec  # noqa: B018 — parse_speculative raises on invalid input
 
@@ -127,6 +150,10 @@ class EngineConfig:
         from dynamo_tpu.spec import parse_speculative
 
         return parse_speculative(self.speculative)
+
+    @property
+    def kv_quantized(self) -> bool:
+        return self.kv_cache_dtype == "int8"
 
     @property
     def max_pages_per_seq(self) -> int:

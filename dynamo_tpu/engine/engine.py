@@ -128,7 +128,8 @@ class AsyncJaxEngine:
 
         t0 = time.monotonic()
         self.model, params = load_model(
-            self.config.model_id, quantize=self.config.quantize
+            self.config.model_id, quantize=self.config.quantize,
+            kv_cache_dtype=self.config.kv_cache_dtype,
         )
         self.runner = ModelRunner(self.config, self.model, params)
         offload = None
@@ -153,9 +154,10 @@ class AsyncJaxEngine:
         elif self.config.warmup:
             self.runner.warmup()
         log.info(
-            "engine ready: model=%s quantize=%s tp=%d pp=%d sp=%d pages=%d (%.1fs)",
+            "engine ready: model=%s quantize=%s kv_dtype=%s tp=%d pp=%d sp=%d pages=%d (%.1fs)",
             self.config.model_id,
             self.config.quantize or "none",
+            self.config.kv_cache_dtype or "bf16",
             self.config.tp,
             self.config.pp,
             self.config.sp,
@@ -395,17 +397,28 @@ class AsyncJaxEngine:
             transfer_id = ici.transfer_key(rp.decode_worker_id, rp.request_id)
             if not ici.put_transfer(transfer_id, data):
                 transfer_id = ""  # consumer abandoned the request already
+        # int8 caches export the {"q","s"} wire dict: shape/dtype describe
+        # the int8 payload; the scale plane rides its own result fields on
+        # the inline path (sockets carry it in part headers instead)
+        from dynamo_tpu.quant.kv import is_quantized_wire
+
+        payload = data["q"] if is_quantized_wire(data) else data
+        inline = data is not None and mode == "inline"
+        scales = data["s"] if (inline and is_quantized_wire(data)) else None
         result = PrefillResult(
             request_id=rp.request_id,
             first_token=int(first_token),
             prompt_len=prompt_len,
             skip_leading_tokens=start_page * ps,
-            kv_shape=tuple(data.shape) if data is not None else (),
-            kv_dtype=str(data.dtype) if data is not None else "",
-            kv_bytes=data.tobytes() if (data is not None and mode == "inline") else b"",
+            kv_shape=tuple(payload.shape) if data is not None else (),
+            kv_dtype=str(payload.dtype) if data is not None else "",
+            kv_bytes=payload.tobytes() if inline else b"",
             kv_transfer_id=transfer_id,
             kv_mode="socket" if plan else (mode if data is not None else "inline"),
             kv_parts=len(plan),
+            kv_scales_bytes=scales.tobytes() if scales is not None else b"",
+            kv_scales_shape=tuple(scales.shape) if scales is not None else (),
+            kv_scales_dtype=str(scales.dtype) if scales is not None else "",
         )
         return result, (data if mode == "socket" else None)
 
@@ -497,7 +510,16 @@ class AsyncJaxEngine:
         alloc, sched, runner = self.allocator, self.scheduler, self.runner
         if alloc is None or sched is None:
             return {}
+        # actual-dtype KV byte accounting: the page-size arithmetic everyone
+        # downstream (dynotop, capacity planning) used to do assuming bf16
+        page_bytes = 0
+        if runner is not None and hasattr(runner.model, "kv_page_bytes"):
+            page_bytes = runner.model.kv_page_bytes(self.config.page_size)
         snap = {
+            "kv_cache_dtype": self.config.kv_cache_dtype or "bf16",
+            "kv_page_bytes": page_bytes,
+            "kv_pool_bytes_total": page_bytes * (self.config.num_pages - 1),
+            "kv_pool_bytes_used": page_bytes * alloc.used_pages,
             "kv_pages_total": self.config.num_pages - 1,
             "kv_pages_used": alloc.used_pages,
             "kv_pages_active": alloc.active_pages,
@@ -633,6 +655,20 @@ class AsyncJaxEngine:
                 [({"kind": "live"}, r["hbm_bytes_in_use"]),
                  ({"kind": "peak"}, r["hbm_peak_bytes_in_use"]),
                  ({"kind": "limit"}, r["hbm_bytes_limit"])],
+            ),
+            # KV cache bytes at the ACTUAL storage dtype (int8 pages cost
+            # half + scale planes; pre-r6 consumers assumed bf16)
+            render_family(
+                "dynamo_engine_kv_cache_bytes", "gauge",
+                "KV page-pool bytes at the configured kv_cache_dtype",
+                [({"kind": "total"}, r["kv_pool_bytes_total"]),
+                 ({"kind": "used"}, r["kv_pool_bytes_used"])],
+            ),
+            render_family(
+                "dynamo_engine_kv_cache_page_bytes", "gauge",
+                "bytes one KV page costs across all layers (K+V, incl. int8 "
+                "scale planes), labeled with the cache storage dtype",
+                [({"dtype": r["kv_cache_dtype"]}, r["kv_page_bytes"])],
             ),
         ]
         if "xla_compiles" in r:

@@ -72,6 +72,16 @@ class ModelRunner:
                     f"tp={config.tp} must divide num_heads={h} and "
                     f"num_kv_heads={hkv} for the composed sp x tp mesh"
                 )
+        if getattr(model.config, "kv_quantized", False):
+            if not getattr(model, "SUPPORTS_KV_INT8", False):
+                raise ValueError(
+                    f"model {type(model).__name__} does not support the int8 KV cache"
+                )
+            if config.pp > 1:
+                # the stage-sharded pool split has no QuantizedPages wiring
+                # yet (EngineConfig also gates this; a tiny:{...} override
+                # JSON could otherwise sneak the combination past it)
+                raise ValueError("int8 KV cache does not compose with pp > 1 yet")
         if config.pp > 1:
             if model.config.num_layers % config.pp:
                 raise ValueError(
@@ -1224,20 +1234,23 @@ class ModelRunner:
         hardware the blocks ride the interconnect, never host DRAM."""
         return self._gather_pages(self.kv_cache, jnp.asarray(page_ids, jnp.int32))
 
-    def extract_pages(self, page_ids: np.ndarray) -> np.ndarray:
-        """Pull KV blocks to host: [L, 2, n, page_size, Hkv, D] numpy.
+    def extract_pages(self, page_ids: np.ndarray):
+        """Pull KV blocks to host: [L, 2, n, page_size, Hkv, D] numpy — or,
+        with an int8 cache, the {"q", "s"} wire dict (quant/kv.py): int8
+        page data plus its per-row scale plane, half the host bytes.
 
         The device gather runs jitted; the host copy is the DCN-transfer
         staging step (same-pod ICI transfers use extract_pages_device).
         """
-        return np.asarray(jax.device_get(self.extract_pages_device(page_ids)))
+        return jax.tree.map(np.asarray, jax.device_get(self.extract_pages_device(page_ids)))
 
     def extract_pages_async(self, page_ids: np.ndarray):
         """Chunk-streamed export: dispatch the device gather NOW (on the
         engine thread, so it enqueues right behind the prefill chunk that
         finalized these pages) and resolve the blocking device->host copy on
         a two-worker side pool. Returns a concurrent.futures.Future of the
-        host numpy array. Double-buffered by construction: the engine thread
+        host numpy array (or {"q","s"} wire dict for int8 caches).
+        Double-buffered by construction: the engine thread
         is free to dispatch chunk i+1's compute while chunk i's pages drain
         to host, and at most two pulls are ever in flight."""
         dev = self.extract_pages_device(page_ids)
@@ -1248,7 +1261,7 @@ class ModelRunner:
             pool = self._d2h_pool = ThreadPoolExecutor(
                 max_workers=2, thread_name_prefix="kv-d2h"
             )
-        return pool.submit(lambda: np.asarray(jax.device_get(dev)))
+        return pool.submit(lambda: jax.tree.map(np.asarray, jax.device_get(dev)))
 
     def inject_pages_bucketed(self, page_ids: np.ndarray, data, axis=None) -> None:
         """Scatter a PARTIAL run of pages, padded to a power-of-two id count
@@ -1256,6 +1269,8 @@ class ModelRunner:
         donated scatter drops them. Streamed KV parts and prefix restores
         arrive in arbitrary sizes; without bucketing every distinct size
         would compile its own scatter executable."""
+        from dynamo_tpu.quant.kv import wire_pad
+
         if axis is None:
             axis = getattr(self.model, "wire_n_axis", 2)
         ids = np.asarray(page_ids, np.int32)
@@ -1267,23 +1282,35 @@ class ModelRunner:
             padded = np.full(bucket, np.iinfo(np.int32).max // 2, np.int32)
             padded[:n] = ids
             ids = padded
-            pad_shape = list(data.shape)
-            pad_shape[axis] = bucket - n
-            data = np.concatenate(
-                [data, np.zeros(pad_shape, data.dtype)], axis=axis
-            )
+            data = wire_pad(data, axis, bucket - n)
         self.inject_pages(ids, data)
 
     def inject_pages(self, page_ids: np.ndarray, data) -> None:
         """Write KV blocks received from a peer into our pages (donated
-        scatter). ``data`` may be host numpy (DCN path) or a device array from
-        a peer engine (ICI path) — device_put reshards it onto our mesh."""
-        dt = jax.tree.leaves(self.kv_cache)[0].dtype
-        if isinstance(data, jax.Array):
-            data = jax.device_put(data, self.model.wire_sharding(self.mesh))
-            data = data.astype(dt)
+        scatter). ``data`` may be host numpy (DCN path), a device array from
+        a peer engine (ICI path) — device_put reshards it onto our mesh —
+        or the int8 {"q","s"} wire dict (host or device leaves). Dtype
+        conversion happens inside the model's scatter_pages_wire: a
+        full-precision wire block quantizes into an int8 cache and an int8
+        block dequantizes into a full-precision one, so mixed-dtype disagg
+        pairs stay interoperable."""
+        if isinstance(data, dict):
+            leaves = list(data.values())
+            if any(isinstance(x, jax.Array) for x in leaves):
+                ws = self.model.wire_sharding(self.mesh)
+                if not isinstance(ws, dict):
+                    # int8 wire from a peer into a full-precision cache
+                    ws = {"q": ws, "s": NamedSharding(self.mesh, P())}
+                data = jax.device_put(data, ws)
+            else:
+                data = {k: jnp.asarray(v) for k, v in data.items()}
+        elif isinstance(data, jax.Array):
+            ws = self.model.wire_sharding(self.mesh)
+            if isinstance(ws, dict):
+                ws = ws["q"]  # plain-array wire into an int8 cache
+            data = jax.device_put(data, ws)
         else:
-            data = jnp.asarray(data, dt)
+            data = jnp.asarray(data)
         self.kv_cache = self._scatter_pages(
             self.kv_cache, jnp.asarray(page_ids, jnp.int32), data
         )

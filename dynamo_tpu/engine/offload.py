@@ -95,13 +95,17 @@ class HostKvPool:
             hits.append((h, p))
         if not hits:
             return set()
+        from dynamo_tpu.quant.kv import wire_concat
+
         axis = getattr(self.runner.model, "wire_n_axis", 2)
         # the batch is padded to a power of two inside inject_pages_bucketed
         # (shared with the streamed-disagg part scatter) so the donated
         # scatter compiles a handful of shapes, not one per prefix length
         n = len(hits)
         t0 = time.monotonic()
-        data = np.concatenate([self._blocks[h] for h, _ in hits], axis=axis)
+        # int8 caches store {"q","s"} wire dicts (page data + scale plane,
+        # half the host bytes per block); wire_concat maps over both leaves
+        data = wire_concat([self._blocks[h] for h, _ in hits], axis=axis)
         ids = np.asarray([p for _, p in hits], np.int32)
         self.runner.inject_pages_bucketed(ids, data, axis=axis)
         dt = time.monotonic() - t0

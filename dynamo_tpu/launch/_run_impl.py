@@ -59,6 +59,7 @@ def engine_config_for(args):
             tp=getattr(args, "tp", None) or 1,
             pp=getattr(args, "pp", None) or 1,
             quantize=getattr(args, "quantize", None),
+            kv_cache_dtype=getattr(args, "kv_cache_dtype", None),
             speculative=speculative,
             kv_stream=kv_stream,
             kv_stream_lanes=kv_stream_lanes,
@@ -74,6 +75,7 @@ def engine_config_for(args):
         tp=getattr(args, "tp", None) or 1,
         pp=getattr(args, "pp", None) or 1,
         quantize=getattr(args, "quantize", None),
+        kv_cache_dtype=getattr(args, "kv_cache_dtype", None),
         speculative=speculative,
         kv_stream=kv_stream,
         kv_stream_lanes=kv_stream_lanes,

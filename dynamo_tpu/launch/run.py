@@ -31,6 +31,12 @@ def build_parser() -> argparse.ArgumentParser:
              "per-channel scales; embeddings/norms stay bf16)",
     )
     run.add_argument(
+        "--kv-cache-dtype", choices=["bf16", "int8"], default=None,
+        help="KV cache storage dtype: int8 stores pages as int8 + per-page "
+             "scales — half the attention HBM stream, ~2x page capacity at "
+             "the same budget (composes with --quantize)",
+    )
+    run.add_argument(
         "--speculative", default=None, metavar="ngram:k",
         help="speculative decoding: propose k draft tokens per step from the "
              "sequence's own history (prompt-lookup) and verify them in one "

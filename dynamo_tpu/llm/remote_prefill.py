@@ -75,6 +75,13 @@ class PrefillResult:
     # single payload). With parts > 0 the decode side scatters each part as
     # it lands and the final adopt only waits on the tail part.
     kv_parts: int = 0
+    # int8 KV caches on the legacy inline path: kv_bytes holds the int8 page
+    # data (half the bf16 bytes) and the per-page f32 scale plane travels in
+    # these fields; kv_array() then reconstructs the {"q","s"} wire dict
+    # (quant/kv.py). Empty on full-precision transfers.
+    kv_scales_bytes: bytes = b""
+    kv_scales_shape: tuple = ()
+    kv_scales_dtype: str = ""
 
     def to_wire(self) -> dict:
         return {
@@ -88,6 +95,9 @@ class PrefillResult:
             "kv_transfer_id": self.kv_transfer_id,
             "kv_mode": self.kv_mode,
             "kv_parts": self.kv_parts,
+            "kv_scales_bytes": self.kv_scales_bytes,
+            "kv_scales_shape": list(self.kv_scales_shape),
+            "kv_scales_dtype": self.kv_scales_dtype,
         }
 
     @classmethod
@@ -103,10 +113,19 @@ class PrefillResult:
             kv_transfer_id=d.get("kv_transfer_id", ""),
             kv_mode=d.get("kv_mode", "ici" if d.get("kv_transfer_id") else "inline"),
             kv_parts=int(d.get("kv_parts", 0)),
+            kv_scales_bytes=d.get("kv_scales_bytes", b""),
+            kv_scales_shape=tuple(d.get("kv_scales_shape", ())),
+            kv_scales_dtype=d.get("kv_scales_dtype", ""),
         )
 
-    def kv_array(self) -> np.ndarray:
-        return np.frombuffer(self.kv_bytes, dtype=_np_dtype(self.kv_dtype)).reshape(self.kv_shape)
+    def kv_array(self):
+        data = np.frombuffer(self.kv_bytes, dtype=_np_dtype(self.kv_dtype)).reshape(self.kv_shape)
+        if self.kv_scales_bytes:
+            scales = np.frombuffer(
+                self.kv_scales_bytes, dtype=_np_dtype(self.kv_scales_dtype)
+            ).reshape(self.kv_scales_shape)
+            return {"q": data, "s": scales}
+        return data
 
 
 def _np_dtype(name: str) -> np.dtype:

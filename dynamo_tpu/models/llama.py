@@ -38,10 +38,13 @@ from dynamo_tpu.ops.norms import rms_norm
 from dynamo_tpu.ops.rotary import apply_mrope, apply_rope
 from dynamo_tpu.quant import (
     QUANT_MODES,
+    QuantizedPages,
+    init_quantized_pages,
     qlinear,
     quantize_shardings_int8,
     quantize_tree_int8,
 )
+from dynamo_tpu.quant.kv import kv_page_bytes as _kv_page_bytes, quantize_kv_rows
 
 
 def _resolve_tp_axis(mesh: Mesh, tp_axis: str):
@@ -88,7 +91,17 @@ class LlamaConfig:
     # load time; embeddings/lm_head/norms/biases stay at `dtype`
     # (dynamo_tpu/quant/int8.py)
     quantize: Any = None
+    # KV cache storage dtype: None / "bf16" (the model dtype) or "int8" —
+    # pages stored int8 with one f32 scale per (page, token row)
+    # (dynamo_tpu/quant/kv.py QuantizedPages). Halves attention HBM traffic
+    # and doubles page capacity at the same HBM budget; composes with
+    # `quantize` (weights and cache quantize independently).
+    kv_cache_dtype: Any = None
     dtype: Any = jnp.bfloat16
+
+    @property
+    def kv_quantized(self) -> bool:
+        return self.kv_cache_dtype == "int8"
 
     @property
     def kv_folded(self) -> bool:
@@ -266,12 +279,35 @@ class LlamaModel:
             return (c.num_layers * num_pages, page_size, c.num_kv_heads * c.head_dim)
         return (c.num_layers * num_pages, page_size, c.num_kv_heads, c.head_dim)
 
+    #: llama-family pools support the int8 KV cache (deepseek's latent cache
+    #: does not — its compression IS its cache optimization)
+    SUPPORTS_KV_INT8 = True
+
     def init_kv_cache(self, num_pages: int, page_size: int) -> dict:
         shape = self.kv_cache_shape(num_pages, page_size)
+        if self.config.kv_quantized:
+            # int8 pools + per-(page, token-row) f32 scale planes; the dict
+            # keeps its {"k","v"} structure — QuantizedPages is a pytree
+            # node, so the scan carry / donation / device_put paths are
+            # unchanged (quant/kv.py)
+            return {
+                "k": init_quantized_pages(shape),
+                "v": init_quantized_pages(shape),
+            }
         return {
             "k": jnp.zeros(shape, self.config.dtype),
             "v": jnp.zeros(shape, self.config.dtype),
         }
+
+    def kv_page_bytes(self, page_size: int) -> int:
+        """HBM bytes one allocator page costs across all layers (K + V and,
+        for int8, the scale planes) — the capacity/telemetry number."""
+        c = self.config
+        return _kv_page_bytes(
+            page_size, c.num_kv_heads, c.head_dim, c.num_layers,
+            "int8" if c.kv_quantized else None,
+            itemsize=jnp.dtype(c.dtype).itemsize,
+        )
 
     def kv_cache_sharding(self, mesh: Mesh, tp_axis: str = "tp") -> dict:
         tp_axis = _resolve_tp_axis(mesh, tp_axis)
@@ -281,6 +317,9 @@ class LlamaModel:
             ns = NamedSharding(mesh, P(None, None, tp_axis))
         else:
             ns = NamedSharding(mesh, P(None, None, tp_axis, None))
+        if self.config.kv_quantized:
+            # per-row scales are head-independent: replicated over tp
+            ns = QuantizedPages(ns, NamedSharding(mesh, P(None, None)))
         return {"k": ns, "v": ns}
 
     def _layer_offsets(self, num_pages: int) -> jnp.ndarray:
@@ -295,23 +334,66 @@ class LlamaModel:
     # host-tier restores concatenate single-page blocks along it
     wire_n_axis = 2
 
-    def gather_pages_wire(self, kv: dict, flat_ids: jnp.ndarray) -> jnp.ndarray:
+    def gather_pages_wire(self, kv: dict, flat_ids: jnp.ndarray):
         """-> [L, 2, n, page_size, Hkv, D] ([..., Hkv*D] when kv_folded —
-        both disagg sides share the model config, so the layouts agree)."""
+        both disagg sides share the model config, so the layouts agree).
+
+        Int8 caches return ``{"q": int8 [L, 2, n, ps, ...], "s": f32
+        [L, 2, n, ps]}`` — the scale plane travels WITH the pages (half the
+        wire/host bytes; scales ride disagg part headers and host-pool
+        entries, see quant/kv.py wire helpers)."""
+        if isinstance(kv["k"], QuantizedPages):
+            return {
+                "q": jnp.stack([kv["k"].q[flat_ids], kv["v"].q[flat_ids]], axis=1),
+                "s": jnp.stack([kv["k"].s[flat_ids], kv["v"].s[flat_ids]], axis=1),
+            }
         return jnp.stack([kv["k"][flat_ids], kv["v"][flat_ids]], axis=1)
 
-    def scatter_pages_wire(self, kv: dict, flat_ids: jnp.ndarray, data: jnp.ndarray) -> dict:
+    def scatter_pages_wire(self, kv: dict, flat_ids: jnp.ndarray, data) -> dict:
+        if isinstance(kv["k"], QuantizedPages):
+            if isinstance(data, dict):
+                q = data["q"].astype(jnp.int8)
+                s = data["s"].astype(jnp.float32)
+            else:
+                # full-precision wire into an int8 cache (a bf16 peer, the
+                # legacy inline path): quantize per token row on the way in
+                rows = data.reshape(-1, data.shape[-1] if data.ndim == 5 else
+                                    data.shape[-2] * data.shape[-1])
+                qr, sr = quantize_kv_rows(rows)
+                q = qr.reshape(data.shape).astype(jnp.int8)
+                s = sr.reshape(data.shape[:4])
+            return {
+                "k": QuantizedPages(
+                    kv["k"].q.at[flat_ids].set(q[:, 0]),
+                    kv["k"].s.at[flat_ids].set(s[:, 0]),
+                ),
+                "v": QuantizedPages(
+                    kv["v"].q.at[flat_ids].set(q[:, 1]),
+                    kv["v"].s.at[flat_ids].set(s[:, 1]),
+                ),
+            }
         dt = kv["k"].dtype
+        if isinstance(data, dict):
+            # int8 wire into a full-precision cache: dequantize the rows
+            s = data["s"].astype(jnp.float32)
+            data = data["q"].astype(jnp.float32) * s.reshape(
+                s.shape + (1,) * (data["q"].ndim - s.ndim)
+            )
         return {
             "k": kv["k"].at[flat_ids].set(data[:, 0].astype(dt)),
             "v": kv["v"].at[flat_ids].set(data[:, 1].astype(dt)),
         }
 
-    def wire_sharding(self, mesh: Mesh, tp_axis: str = "tp") -> NamedSharding:
+    def wire_sharding(self, mesh: Mesh, tp_axis: str = "tp"):
         tp_axis = _resolve_tp_axis(mesh, tp_axis)
         if self.config.kv_folded:
-            return NamedSharding(mesh, P(None, None, None, None, tp_axis))
-        return NamedSharding(mesh, P(None, None, None, None, tp_axis, None))
+            ns = NamedSharding(mesh, P(None, None, None, None, tp_axis))
+        else:
+            ns = NamedSharding(mesh, P(None, None, None, None, tp_axis, None))
+        if self.config.kv_quantized:
+            # dict wire: int8 data shards like the pool; scales replicate
+            return {"q": ns, "s": NamedSharding(mesh, P())}
+        return ns
 
     # ---------------- forward ----------------
 

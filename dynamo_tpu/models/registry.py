@@ -51,32 +51,57 @@ def is_tiny_family(model_id) -> bool:
 _cacheable = is_tiny_family
 
 
-def load_model(model_id: str, seed: int = 0, quantize: str | None = None):
+def load_model(model_id: str, seed: int = 0, quantize: str | None = None,
+               kv_cache_dtype: str | None = None):
     """Returns (model, params); for tiny-family models params may be shared
     with other engines in this process — treat as immutable.
 
     ``quantize`` ("int8_wo") applies weight-only quantization at load time —
     tiny families quantize their random init, checkpoint models quantize in
-    the loader's _finish step. A quantize mode embedded in a tiny:{...}
-    override JSON works too; the explicit argument wins when both are set."""
+    the loader's _finish step. ``kv_cache_dtype`` ("int8") sets the KV cache
+    storage dtype on the model config (pages are int8 + per-row scales,
+    quant/kv.py) — llama-family pools only; the MLA latent cache raises. A
+    mode embedded in a tiny:{...} override JSON works too; the explicit
+    argument wins when both are set."""
     global _cache
-    key = (model_id, seed, quantize)
+    if kv_cache_dtype == "bf16":
+        kv_cache_dtype = None  # the default storage dtype, spelled out
+    key = (model_id, seed, quantize, kv_cache_dtype)
     entry = _cache
     if entry is not None and entry[0] == key:
         model_cls, cfg, params = entry[1]
         return model_cls(cfg), params  # fresh model object: attn_mesh is per-engine
-    model, params = _load_model_uncached(model_id, seed, quantize)
+    model, params = _load_model_uncached(model_id, seed, quantize, kv_cache_dtype)
+    if kv_cache_dtype and not getattr(model, "SUPPORTS_KV_INT8", False):
+        raise ValueError(
+            f"kv_cache_dtype={kv_cache_dtype!r} is not supported by "
+            f"{type(model).__name__} (the MLA latent cache is its own "
+            "compression; int8 KV covers the k/v page-pool families)"
+        )
     if _cacheable(model_id):
         _cache = (key, (type(model), model.config, params))
     return model, params
 
 
-def _load_model_uncached(model_id: str, seed: int = 0, quantize: str | None = None):
+def _load_model_uncached(model_id: str, seed: int = 0, quantize: str | None = None,
+                         kv_cache_dtype: str | None = None):
     """Returns (model, params) on host (unsharded); caller places onto mesh."""
     import dataclasses
 
     def with_quant(cfg):
-        return dataclasses.replace(cfg, quantize=quantize) if quantize else cfg
+        replace = {}
+        if quantize:
+            replace["quantize"] = quantize
+        if kv_cache_dtype and "kv_cache_dtype" in getattr(
+            cfg, "__dataclass_fields__", {}
+        ):
+            replace["kv_cache_dtype"] = kv_cache_dtype
+        elif kv_cache_dtype:
+            raise ValueError(
+                f"kv_cache_dtype={kv_cache_dtype!r} is not supported by "
+                f"{type(cfg).__name__}"
+            )
+        return dataclasses.replace(cfg, **replace) if replace else cfg
 
     if model_id is not None and (model_id == "tiny-moe" or model_id.startswith("tiny-moe:")):
         from dynamo_tpu.models.mixtral import MixtralConfig, MixtralModel

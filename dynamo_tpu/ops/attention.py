@@ -20,6 +20,15 @@ null/trash page by the allocator (dynamo_tpu/engine/page_table.py): padded
 page-table entries and masked-out scatter rows all target it, so no valid data
 is ever clobbered and no masked-select of old values is needed in the scatter.
 
+Int8 KV cache (EngineConfig.kv_cache_dtype="int8"): the pools arrive as
+``QuantizedPages`` (quant/kv.py) — an int8 pool plus a per-(page, token-row)
+f32 scale plane. ``scatter_kv`` quantizes fresh rows on the way in (one
+absmax per row; fully incremental, decode appends never requantize a page)
+and ``gather_pages`` dequantizes the gathered context on the way out, so
+every reference path below works unchanged. The Pallas kernels instead apply
+the scales to score/prob tiles in VMEM after the int8 DMA — same algebra,
+half the HBM context traffic.
+
 The Pallas TPU kernel with the same contract lives in
 dynamo_tpu/ops/pallas/paged_attention.py; this module is the semantic
 reference and the CPU/test path.
@@ -30,28 +39,45 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from dynamo_tpu.quant.kv import QuantizedPages, quantize_kv_rows
+
 _NEG_INF = -1e30
 
 
 def scatter_kv(
-    k_pages: jnp.ndarray,  # [LP, ps, Hkv, D] flat pool
-    v_pages: jnp.ndarray,  # [LP, ps, Hkv, D]
+    k_pages,  # [LP, ps, Hkv, D] flat pool (plain or QuantizedPages)
+    v_pages,  # [LP, ps, Hkv, D]
     k_new: jnp.ndarray,  # [T, Hkv, D]
     v_new: jnp.ndarray,  # [T, Hkv, D]
     phys_pages: jnp.ndarray,  # [T] int32 flat page per row (trash page for dropped rows)
     offsets: jnp.ndarray,  # [T] int32 offset within page
-) -> tuple[jnp.ndarray, jnp.ndarray]:
+):
     """Scatter new K/V rows into their physical pages.
 
     Unconditional: the caller routes invalid rows to a trash page (see module
     docstring), so no old-value gather/select is needed — the scatter stays a
-    pure in-place write on donated buffers.
+    pure in-place write on donated buffers. Int8 pools quantize each fresh
+    row here (symmetric absmax over its head values) and scatter the int8
+    row + its f32 scale together.
     """
     if k_pages.ndim == 3 and k_new.ndim == 3:
         # folded pool (see LlamaConfig.kv_folded): fold the NEW rows — tiny —
         # never the pool (reshaping a donated, scatter-updated pool copies it)
         k_new = k_new.reshape(k_new.shape[0], -1)
         v_new = v_new.reshape(v_new.shape[0], -1)
+    if isinstance(k_pages, QuantizedPages):
+        kq, ks = quantize_kv_rows(k_new)
+        vq, vs = quantize_kv_rows(v_new)
+        return (
+            QuantizedPages(
+                k_pages.q.at[phys_pages, offsets].set(kq),
+                k_pages.s.at[phys_pages, offsets].set(ks),
+            ),
+            QuantizedPages(
+                v_pages.q.at[phys_pages, offsets].set(vq),
+                v_pages.s.at[phys_pages, offsets].set(vs),
+            ),
+        )
     k_pages = k_pages.at[phys_pages, offsets].set(k_new)
     v_pages = v_pages.at[phys_pages, offsets].set(v_new)
     return k_pages, v_pages
@@ -74,16 +100,23 @@ def write_kv_pages(
     return scatter_kv(k_pages, v_pages, k_new, v_new, phys, offsets)
 
 
-def gather_pages(pages: jnp.ndarray, page_table: jnp.ndarray, head_dim: int | None = None) -> jnp.ndarray:
+def gather_pages(pages, page_table: jnp.ndarray, head_dim: int | None = None) -> jnp.ndarray:
     """[P, ps, Hkv, D] gathered by [max_pages] -> [max_pages * ps, Hkv, D].
 
     Folded pools ([P, ps, Hkv*D], see LlamaConfig.kv_folded) unfold here —
     the GATHERED context is small, so the reshape is cheap, unlike reshaping
-    the pool itself."""
+    the pool itself. Int8 pools dequantize the gathered context (tiny, like
+    the unfold) with their per-row scales — the reference path's analogue of
+    the kernels' in-VMEM dequant."""
     max_pages = page_table.shape[0]
     ps = pages.shape[1]
-    g = pages[page_table]  # [max_pages, ps, ...]
-    out = g.reshape(max_pages * ps, *pages.shape[2:])
+    if isinstance(pages, QuantizedPages):
+        g = pages.q[page_table].astype(jnp.float32)  # [max_pages, ps, ...]
+        s = pages.s[page_table]  # [max_pages, ps]
+        g = g * s.reshape(s.shape + (1,) * (g.ndim - 2))
+    else:
+        g = pages[page_table]  # [max_pages, ps, ...]
+    out = g.reshape(max_pages * ps, *g.shape[2:])
     if out.ndim == 2:  # folded: [S, Hkv*D] -> [S, Hkv, D]
         if head_dim is None:
             raise ValueError("folded pages need head_dim to unfold")
@@ -245,11 +278,17 @@ def dispatch_paged_decode_attention(q, k_pages, v_pages, page_tables, positions,
         # ps 16-128). folded: head_dim < 128 shapes (Mosaic can't DMA-slice
         # sub-128-lane pools; heads live folded into the lane dim).
         folded = k_pages.ndim == 3
+        quantized = isinstance(k_pages, QuantizedPages)
         # lookahead (default since r5): perseq + cross-program DMA
         # prefetch — measured AT the ideal KV-read bandwidth (78.9 us/call
         # vs perseq's 141 at the headline shape); falls back to perseq
         # internally when the prefetch window would blow the VMEM budget
         kernel_choice = os.environ.get("DYNTPU_DECODE_KERNEL", "lookahead")
+        if quantized and kernel_choice in ("chunked", "grouped"):
+            # chunked/grouped never grew int8 support (both lost the bf16
+            # A/B; carrying dead scale plumbing there buys nothing) — an
+            # int8 cache rides the production lookahead/perseq family
+            kernel_choice = "lookahead"
         if folded or q.shape[-1] % 128 != 0:
             paged_decode_attention_pallas = paged_decode_attention_pallas_folded
         elif kernel_choice == "lookahead":
@@ -275,6 +314,10 @@ def dispatch_paged_decode_attention(q, k_pages, v_pages, page_tables, positions,
                 # kernel would face the very sub-128 pool this path avoids
                 return paged_decode_attention(q, k_pages, v_pages, page_tables, positions)
             pool_spec = P(None, None, "tp") if folded else P(None, None, "tp", None)
+            if quantized:
+                # int8 pool shards like the bf16 pool; the per-row scale
+                # plane is head-independent, so it replicates over tp
+                pool_spec = QuantizedPages(pool_spec, P(None, None))
             fn = functools.partial(paged_decode_attention_pallas, interpret=interpret)
             return _tp_shard_map(
                 fn,
@@ -306,30 +349,46 @@ def use_pallas_prefill(head_dim: int, chunk_len: int, block_q: int = 128) -> boo
     return _on_tpu() and head_dim % 128 == 0
 
 
+def prefill_kernel_lookahead() -> bool:
+    """DYNTPU_PREFILL_KERNEL: "lookahead" (default — cross-program context-
+    tile prefetch, the decode lookahead insight ported to the flash prefill
+    grid) or "basic" (the in-program-only double buffer; escape hatch)."""
+    import os
+
+    return os.environ.get("DYNTPU_PREFILL_KERNEL", "lookahead") != "basic"
+
+
 def dispatch_paged_prefill_attention(
     q, k_pages, v_pages, page_table, positions, mesh=None
 ):
     """Chunked-prefill attention: Pallas flash kernel on TPU (context pages
-    streamed HBM->VMEM, online softmax, causal work bound per query block),
-    gather-based pure-JAX reference elsewhere. Under tensor parallelism the
-    kernel runs per-head-shard via shard_map like the decode kernel.
+    streamed HBM->VMEM in double-buffered tiles — with the next query
+    block's tiles prefetched ACROSS grid programs by default, see
+    prefill_attention.py _kernel_lookahead — online softmax, causal work
+    bound per query block), gather-based pure-JAX reference elsewhere. Int8
+    pools (QuantizedPages) ride the same kernels with scale rows DMA'd next
+    to the pages. Under tensor parallelism the kernel runs per-head-shard
+    via shard_map like the decode kernel.
 
     Kernel precondition (stricter than the reference): ``positions`` must be
     UNIT-STRIDE within the chunk (positions[i] = positions[0] + i), which is
     exactly what the engine's bucket-padded chunks provide. The reference
     path only needs monotone positions."""
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    quantized = isinstance(k_pages, QuantizedPages)
     if k_pages.ndim == 3:
         # folded pool (sub-128 head_dim): dedicated folded flash kernel when
         # shapes allow (R = block_q * Hq rows must stay VMEM-sane); the
         # gather reference (which unfolds the small gathered context) covers
         # the rest
-        # tp>1 falls back to the gather reference (GSPMD partitions it; it
-        # cannot partition a pallas_call, and no shard_map wiring exists for
-        # this variant yet).
-        tp1 = mesh is None or mesh.shape.get("tp", 1) == 1
+        tp = 1 if mesh is None else mesh.shape.get("tp", 1)
         block_q = 64
         R = q.shape[1] * block_q  # folded row count per query block
         F = k_pages.shape[2]
+        num_kv_heads = F // q.shape[-1]
         # the kernel's working set is several [R, F] f32 buffers; keep their
         # sum inside the ~16MB scoped-VMEM limit (R*F*4B*~5 buffers)
         shape_ok = (
@@ -337,8 +396,18 @@ def dispatch_paged_prefill_attention(
             and F % 128 == 0
             and R * F * 4 * 5 <= 12 * 1024 * 1024
         )
+        # tp>1: the folded kernel runs per head shard under shard_map (the
+        # decode kernel's pattern — it used to silently fall back to the
+        # gather reference here). The shard's folded lanes must stay
+        # 128-aligned or the shard kernel would face the very sub-128 pool
+        # this layout exists to avoid.
+        shard_ok = tp == 1 or (
+            q.shape[1] % tp == 0
+            and num_kv_heads % tp == 0
+            and (num_kv_heads // tp) * q.shape[-1] % 128 == 0
+        )
         flag = pallas_flag()
-        folded_ok = tp1 and shape_ok and (
+        folded_ok = shard_ok and shape_ok and (
             flag is True or (_on_tpu() and flag is not False)
         )
         if folded_ok:
@@ -346,10 +415,27 @@ def dispatch_paged_prefill_attention(
                 paged_prefill_attention_pallas_folded,
             )
 
-            return paged_prefill_attention_pallas_folded(
-                q, k_pages, v_pages, page_table, positions, block_q=block_q,
+            fn = functools.partial(
+                paged_prefill_attention_pallas_folded, block_q=block_q,
                 interpret=not _on_tpu(),
             )
+            if tp > 1:
+                pool_spec = P(None, None, "tp")
+                if quantized:
+                    pool_spec = QuantizedPages(pool_spec, P(None, None))
+                return _tp_shard_map(
+                    fn,
+                    mesh,
+                    in_specs=(
+                        P(None, "tp", None),  # q: heads sharded
+                        pool_spec,  # folded pools: lane (head-major) sharded
+                        pool_spec,
+                        P(None),  # page table replicated
+                        P(None),  # positions replicated
+                    ),
+                    out_specs=P(None, "tp", None),
+                )(q, k_pages, v_pages, page_table, positions)
+            return fn(q, k_pages, v_pages, page_table, positions)
         return paged_prefill_attention(q, k_pages, v_pages, page_table, positions)
     if use_pallas_prefill(q.shape[-1], q.shape[0]):
         from dynamo_tpu.ops.pallas.prefill_attention import (
@@ -357,28 +443,32 @@ def dispatch_paged_prefill_attention(
         )
 
         interpret = not _on_tpu()
+        lookahead = prefill_kernel_lookahead()
         tp = 1 if mesh is None else mesh.shape.get("tp", 1)
         if tp > 1:
-            import functools
-
-            from jax.sharding import PartitionSpec as P
-
             if q.shape[1] % tp or k_pages.shape[2] % tp:
                 return paged_prefill_attention(q, k_pages, v_pages, page_table, positions)
-            fn = functools.partial(paged_prefill_attention_pallas, interpret=interpret)
+            pool_spec = P(None, None, "tp", None)
+            if quantized:
+                pool_spec = QuantizedPages(pool_spec, P(None, None))
+            fn = functools.partial(
+                paged_prefill_attention_pallas, interpret=interpret,
+                lookahead=lookahead,
+            )
             return _tp_shard_map(
                 fn,
                 mesh,
                 in_specs=(
                     P(None, "tp", None),
-                    P(None, None, "tp", None),
-                    P(None, None, "tp", None),
+                    pool_spec,
+                    pool_spec,
                     P(None),
                     P(None),
                 ),
                 out_specs=P(None, "tp", None),
             )(q, k_pages, v_pages, page_table, positions)
         return paged_prefill_attention_pallas(
-            q, k_pages, v_pages, page_table, positions, interpret=interpret
+            q, k_pages, v_pages, page_table, positions, interpret=interpret,
+            lookahead=lookahead,
         )
     return paged_prefill_attention(q, k_pages, v_pages, page_table, positions)

@@ -75,6 +75,15 @@ DMAs — see _kernel_lookahead below:
 Measured numerically exact, BELOW the null kernel (the boundary latency it
 removes also bounds dmaonly), and worth +14.7%% end-to-end on the serving
 headline (6338 -> 7270 tok/s same session, engine bench).
+
+Int8 KV (r6, quant/kv.py QuantizedPages): perseq, lookahead, and folded
+accept int8 pools plus a per-row f32 scale plane ([P, 1, ps] as passed in).
+Scale rows ride their own tiny DMAs beside the page DMAs (the HBM context
+stream halves — that is the win) and dequantization is applied to the
+score/prob tiles in VMEM: ``scores *= k_s`` / ``probs *= v_s`` is the exact
+per-column algebra, and both are lane-axis broadcasts (Mosaic-legal; no
+sub-128 minor-dim reshapes). chunked/grouped stay bf16-only — they already
+lost the A/B and the dispatcher never routes int8 to them.
 """
 
 from __future__ import annotations
@@ -86,27 +95,44 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dynamo_tpu.quant.kv import QuantizedPages
+
 _NEG_INF = -1e30
 
 
+def _decode_unpack_pools(k_pages, v_pages):
+    """(k, v, k_scale [P,1,ps] | None, v_scale | None, quantized)."""
+    if isinstance(k_pages, QuantizedPages):
+        P, ps = k_pages.s.shape
+        return (
+            k_pages.q, v_pages.q,
+            k_pages.s.reshape(P, 1, ps), v_pages.s.reshape(P, 1, ps),
+            True,
+        )
+    return k_pages, v_pages, None, None, False
+
+
 def _kernel(
-    # scalar prefetch
-    page_tables_ref,  # [B, max_pages] SMEM
-    lengths_ref,  # [B] SMEM
-    # inputs
-    q_ref,  # [1, Hq, D] VMEM (this sequence's query)
-    k_hbm,  # [P, ps, Hkv, D] HBM
-    v_hbm,  # [P, ps, Hkv, D] HBM
-    # output
-    out_ref,  # [1, Hq, D] VMEM
-    # scratch
-    k_scratch,  # [2, ps, Hkv, D] VMEM
-    v_scratch,  # [2, ps, Hkv, D] VMEM
-    sems,  # DMA sems [2, 2]
-    *,
+    *refs,
     page_size: int,
     max_pages: int,
+    quantized: bool = False,
 ):
+    """perseq decode kernel (one sequence per grid program, in-program
+    double buffer). refs: page_tables [B, max_pages] + lengths [B] (SMEM
+    scalar prefetch) | q [1, Hq, D], k/v pools [P, ps, Hkv, D] HBM
+    [, k/v scale planes [P, 1, ps]] | out [1, Hq, D] | k/v scratch
+    [2, ps, Hkv, D] [, scale scratch [2, 1, ps]], sems [2, 2|4]."""
+    if quantized:
+        (page_tables_ref, lengths_ref, q_ref, k_hbm, v_hbm, ks_hbm, vs_hbm,
+         out_ref, k_scratch, v_scratch, ks_scratch, vs_scratch, sems) = refs
+        pools = [(k_hbm, k_scratch), (v_hbm, v_scratch),
+                 (ks_hbm, ks_scratch), (vs_hbm, vs_scratch)]
+    else:
+        (page_tables_ref, lengths_ref, q_ref, k_hbm, v_hbm,
+         out_ref, k_scratch, v_scratch, sems) = refs
+        pools = [(k_hbm, k_scratch), (v_hbm, v_scratch)]
+
     b = pl.program_id(0)
     length = lengths_ref[b]
     n_pages = jnp.maximum(1, pl.cdiv(length, page_size))
@@ -118,19 +144,15 @@ def _kernel(
     q = q_ref[0].astype(jnp.float32).reshape(Hkv, G, D)
     scale = 1.0 / jnp.sqrt(jnp.float32(D))
 
-    def k_dma(slot, i):
+    def dma(slot, i, c):
+        hbm, scratch = pools[c]
         return pltpu.make_async_copy(
-            k_hbm.at[page_tables_ref[b, i]], k_scratch.at[slot], sems.at[slot, 0]
-        )
-
-    def v_dma(slot, i):
-        return pltpu.make_async_copy(
-            v_hbm.at[page_tables_ref[b, i]], v_scratch.at[slot], sems.at[slot, 1]
+            hbm.at[page_tables_ref[b, i]], scratch.at[slot], sems.at[slot, c]
         )
 
     # warm up buffer 0
-    k_dma(0, 0).start()
-    v_dma(0, 0).start()
+    for c in range(len(pools)):
+        dma(0, 0, c).start()
 
     def body(i, carry):
         m, l, acc = carry
@@ -139,11 +161,11 @@ def _kernel(
 
         @pl.when(i + 1 < n_pages)
         def _():
-            k_dma(next_slot, i + 1).start()
-            v_dma(next_slot, i + 1).start()
+            for c in range(len(pools)):
+                dma(next_slot, i + 1, c).start()
 
-        k_dma(slot, i).wait()
-        v_dma(slot, i).wait()
+        for c in range(len(pools)):
+            dma(slot, i, c).wait()
 
         k_page = k_scratch[slot].astype(jnp.float32)  # [ps, Hkv, D]
         v_page = v_scratch[slot].astype(jnp.float32)
@@ -154,6 +176,9 @@ def _kernel(
         scores = jax.lax.dot_general(
             q, kt, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
         ) * scale
+        if quantized:
+            # per-row K scales multiply score COLUMNS: [1, ps] -> [1, 1, ps]
+            scores = scores * ks_scratch[slot][None]
 
         idx = i * page_size + jax.lax.broadcasted_iota(jnp.int32, (1, 1, page_size), 2)
         scores = jnp.where(idx < length, scores, _NEG_INF)
@@ -163,6 +188,8 @@ def _kernel(
         corr = jnp.exp(m - new_m)
         probs = jnp.exp(scores - new_m[..., None])  # [Hkv, G, ps]
         new_l = l * corr + jnp.sum(probs, axis=-1)
+        if quantized:
+            probs = probs * vs_scratch[slot][None]  # V scales fold into probs
         # [Hkv, G, D] = [Hkv, G, ps] x [Hkv, ps, D]
         chunk_out = jax.lax.dot_general(
             probs, vt, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
@@ -337,25 +364,10 @@ def paged_decode_attention_pallas_grouped(
 
 
 def _kernel_lookahead(
-    # scalar prefetch
-    page_tables_ref,  # [B, max_pages] SMEM
-    lengths_ref,  # [B] SMEM
-    # inputs
-    q_ref,  # [1, Hq, D] VMEM (this sequence's query)
-    k_hbm,  # [P, ps, Hkv, D] HBM
-    v_hbm,  # [P, ps, Hkv, D] HBM
-    # output
-    out_ref,  # [1, Hq, D] VMEM
-    # scratch
-    k_pre,  # [2, W, ps, Hkv, D] VMEM — per-parity prefetch window
-    v_pre,
-    k_tail,  # [2, ps, Hkv, D] VMEM — classic double buffer for pages >= W
-    v_tail,
-    sems_pre,  # DMA sems [2, W, 2]
-    sems_tail,  # DMA sems [2, 2]
-    *,
+    *refs,
     page_size: int,
     lookahead: int,
+    quantized: bool = False,
 ):
     """perseq with CROSS-PROGRAM DMA pipelining (r5 A/B: 78.9 us/call vs
     perseq's 141 at the headline shape — below even the dmaonly null kernel,
@@ -368,7 +380,26 @@ def _kernel_lookahead(
     DMA-latency exposure at every program boundary — the entire gap between
     perseq and the measured DMA floor — collapses to one program's worth for
     the whole grid. Pages >= lookahead (long contexts) stream through the
-    classic in-program double buffer."""
+    classic in-program double buffer.
+
+    refs: page_tables + lengths (scalar prefetch) | q, k/v pools [, k/v
+    scale planes [P, 1, ps]] | out | k_pre, v_pre [2, W, ps, Hkv, D]
+    [, scale windows [2, W, 1, ps]], k_tail, v_tail [2, ps, Hkv, D]
+    [, scale tails [2, 1, ps]], sems_pre [2, W, 2|4], sems_tail [2, 2|4]."""
+    if quantized:
+        (page_tables_ref, lengths_ref, q_ref, k_hbm, v_hbm, ks_hbm, vs_hbm,
+         out_ref, k_pre, v_pre, ks_pre, vs_pre, k_tail, v_tail, ks_tail,
+         vs_tail, sems_pre, sems_tail) = refs
+        pre_pools = [(k_hbm, k_pre), (v_hbm, v_pre),
+                     (ks_hbm, ks_pre), (vs_hbm, vs_pre)]
+        tail_pools = [(k_hbm, k_tail), (v_hbm, v_tail),
+                      (ks_hbm, ks_tail), (vs_hbm, vs_tail)]
+    else:
+        (page_tables_ref, lengths_ref, q_ref, k_hbm, v_hbm,
+         out_ref, k_pre, v_pre, k_tail, v_tail, sems_pre, sems_tail) = refs
+        pre_pools = [(k_hbm, k_pre), (v_hbm, v_pre)]
+        tail_pools = [(k_hbm, k_tail), (v_hbm, v_tail)]
+
     b = pl.program_id(0)
     nb = pl.num_programs(0)
     par = jax.lax.rem(b, 2)
@@ -382,20 +413,20 @@ def _kernel_lookahead(
     q = q_ref[0].astype(jnp.float32).reshape(Hkv, G, D)
     scale = 1.0 / jnp.sqrt(jnp.float32(D))
 
-    def pre_dma(parity, j, seq_idx, which):
-        hbm, scratch = (k_hbm, k_pre) if which == 0 else (v_hbm, v_pre)
+    def pre_dma(parity, j, seq_idx, c):
+        hbm, scratch = pre_pools[c]
         return pltpu.make_async_copy(
             hbm.at[page_tables_ref[seq_idx, j]],
             scratch.at[parity, j],
-            sems_pre.at[parity, j, which],
+            sems_pre.at[parity, j, c],
         )
 
-    def tail_dma(slot, i, which):
-        hbm, scratch = (k_hbm, k_tail) if which == 0 else (v_hbm, v_tail)
+    def tail_dma(slot, i, c):
+        hbm, scratch = tail_pools[c]
         return pltpu.make_async_copy(
             hbm.at[page_tables_ref[b, i]],
             scratch.at[slot],
-            sems_tail.at[slot, which],
+            sems_tail.at[slot, c],
         )
 
     def issue_pre(seq_idx, parity):
@@ -404,8 +435,8 @@ def _kernel_lookahead(
 
             @pl.when(j < npg)
             def _(j=j):
-                pre_dma(parity, j, seq_idx, 0).start()
-                pre_dma(parity, j, seq_idx, 1).start()
+                for c in range(len(pre_pools)):
+                    pre_dma(parity, j, seq_idx, c).start()
 
     # program 0 has no predecessor: prefetch its own window
     @pl.when(b == 0)
@@ -420,16 +451,18 @@ def _kernel_lookahead(
     # long-context tail: warm the in-program double buffer for page W
     @pl.when(W < n_pages)
     def _():
-        tail_dma(W % 2, W, 0).start()
-        tail_dma(W % 2, W, 1).start()
+        for c in range(len(tail_pools)):
+            tail_dma(W % 2, W, c).start()
 
-    def merge(carry, k_page, v_page, j):
+    def merge(carry, k_page, v_page, j, k_s, v_s):
         m, l, acc = carry
         kt = jnp.transpose(k_page, (1, 0, 2))  # [Hkv, ps, D]
         vt = jnp.transpose(v_page, (1, 0, 2))
         scores = jax.lax.dot_general(
             q, kt, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
         ) * scale
+        if quantized:
+            scores = scores * k_s[None]  # [1, 1, ps] per-row K scales
         idx = j * page_size + jax.lax.broadcasted_iota(jnp.int32, (1, 1, page_size), 2)
         scores = jnp.where(idx < length, scores, _NEG_INF)
         chunk_max = jnp.max(scores, axis=-1)
@@ -437,19 +470,23 @@ def _kernel_lookahead(
         corr = jnp.exp(m - new_m)
         probs = jnp.exp(scores - new_m[..., None])
         new_l = l * corr + jnp.sum(probs, axis=-1)
+        if quantized:
+            probs = probs * v_s[None]
         chunk_out = jax.lax.dot_general(
             probs, vt, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
         )
         return new_m, new_l, acc * corr[..., None] + chunk_out
 
     def pre_body(j, carry):
-        pre_dma(par, j, b, 0).wait()
-        pre_dma(par, j, b, 1).wait()
+        for c in range(len(pre_pools)):
+            pre_dma(par, j, b, c).wait()
         return merge(
             carry,
             k_pre[par, j].astype(jnp.float32),
             v_pre[par, j].astype(jnp.float32),
             j,
+            ks_pre[par, j] if quantized else None,
+            vs_pre[par, j] if quantized else None,
         )
 
     def tail_body(j, carry):
@@ -458,16 +495,18 @@ def _kernel_lookahead(
 
         @pl.when(j + 1 < n_pages)
         def _():
-            tail_dma(next_slot, j + 1, 0).start()
-            tail_dma(next_slot, j + 1, 1).start()
+            for c in range(len(tail_pools)):
+                tail_dma(next_slot, j + 1, c).start()
 
-        tail_dma(slot, j, 0).wait()
-        tail_dma(slot, j, 1).wait()
+        for c in range(len(tail_pools)):
+            tail_dma(slot, j, c).wait()
         return merge(
             carry,
             k_tail[slot].astype(jnp.float32),
             v_tail[slot].astype(jnp.float32),
             j,
+            ks_tail[slot] if quantized else None,
+            vs_tail[slot] if quantized else None,
         )
 
     m0 = jnp.full((Hkv, G), _NEG_INF, jnp.float32)
@@ -496,41 +535,59 @@ def lookahead_window(page_size: int, num_kv_heads: int, head_dim: int,
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def paged_decode_attention_pallas_lookahead(
     q: jnp.ndarray,  # [B, Hq, D]
-    k_pages: jnp.ndarray,  # [P, ps, Hkv, D]
-    v_pages: jnp.ndarray,
+    k_pages,  # [P, ps, Hkv, D] plain or QuantizedPages
+    v_pages,
     page_tables: jnp.ndarray,  # [B, max_pages] int32
     positions: jnp.ndarray,  # [B] int32 query positions
     interpret: bool = False,
 ) -> jnp.ndarray:
     B, Hq, D = q.shape
-    P, ps, Hkv, _ = k_pages.shape
+    kq, vq, ks, vs, quantized = _decode_unpack_pools(k_pages, v_pages)
+    P, ps, Hkv, _ = kq.shape
     lengths = positions.astype(jnp.int32) + 1
-    W = lookahead_window(ps, Hkv, D, k_pages.dtype.itemsize)
+    W = lookahead_window(ps, Hkv, D, kq.dtype.itemsize)
     if W < 1:
         return paged_decode_attention_pallas(
             q, k_pages, v_pages, page_tables, positions, interpret=interpret
         )
 
+    scratch_shapes = [
+        pltpu.VMEM((2, W, ps, Hkv, D), kq.dtype),
+        pltpu.VMEM((2, W, ps, Hkv, D), vq.dtype),
+    ]
+    if quantized:
+        scratch_shapes += [
+            pltpu.VMEM((2, W, 1, ps), jnp.float32),
+            pltpu.VMEM((2, W, 1, ps), jnp.float32),
+        ]
+    scratch_shapes += [
+        pltpu.VMEM((2, ps, Hkv, D), kq.dtype),
+        pltpu.VMEM((2, ps, Hkv, D), vq.dtype),
+    ]
+    if quantized:
+        scratch_shapes += [
+            pltpu.VMEM((2, 1, ps), jnp.float32),
+            pltpu.VMEM((2, 1, ps), jnp.float32),
+        ]
+    C = 4 if quantized else 2
+    scratch_shapes += [
+        pltpu.SemaphoreType.DMA((2, W, C)),
+        pltpu.SemaphoreType.DMA((2, C)),
+    ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B,),
         in_specs=[
             pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
+            *[pl.BlockSpec(memory_space=pl.ANY) for _ in range(C)],
         ],
         out_specs=pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((2, W, ps, Hkv, D), k_pages.dtype),
-            pltpu.VMEM((2, W, ps, Hkv, D), v_pages.dtype),
-            pltpu.VMEM((2, ps, Hkv, D), k_pages.dtype),
-            pltpu.VMEM((2, ps, Hkv, D), v_pages.dtype),
-            pltpu.SemaphoreType.DMA((2, W, 2)),
-            pltpu.SemaphoreType.DMA((2, 2)),
-        ],
+        scratch_shapes=scratch_shapes,
     )
     kernel = pl.pallas_call(
-        functools.partial(_kernel_lookahead, page_size=ps, lookahead=W),
+        functools.partial(
+            _kernel_lookahead, page_size=ps, lookahead=W, quantized=quantized
+        ),
         out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
         grid_spec=grid_spec,
         # cross-program scratch persistence (program b prefetches b+1's pages
@@ -539,27 +596,16 @@ def paged_decode_attention_pallas_lookahead(
         compiler_params=pltpu.TPUCompilerParams(dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )
-    return kernel(page_tables.astype(jnp.int32), lengths, q, k_pages, v_pages)
+    args = (kq, vq, ks, vs) if quantized else (kq, vq)
+    return kernel(page_tables.astype(jnp.int32), lengths, q, *args)
 
 
 def _kernel_folded(
-    # scalar prefetch
-    page_tables_ref,  # [B, max_pages] SMEM
-    lengths_ref,  # [B] SMEM
-    # inputs
-    q_ref,  # [1, Hq, D] VMEM (this sequence's query)
-    k_hbm,  # [P, ps, Hkv*D] HBM (heads folded into lanes)
-    v_hbm,  # [P, ps, Hkv*D] HBM
-    # output
-    out_ref,  # [1, Hq, D] VMEM
-    # scratch
-    k_scratch,  # [2, ps, Hkv*D] VMEM
-    v_scratch,  # [2, ps, Hkv*D] VMEM
-    sems,  # DMA sems [2, 2]
-    *,
+    *refs,
     page_size: int,
     num_kv_heads: int,
     head_dim: int,
+    quantized: bool = False,
 ):
     """Decode attention for head_dim < 128 (e.g. TinyLlama/Qwen2-small: 64).
 
@@ -574,7 +620,23 @@ def _kernel_folded(
       - output: probs @ V_folded gives [Hq, Hkv*D]; each head's true output
         sits in its kv head's slice, selected with a one-hot contraction in
         f32 (32-bit ops may reshape the minor dim; bf16 may not).
+
+    refs: page_tables + lengths (scalar prefetch) | q [1, Hq, D], k/v pools
+    [P, ps, Hkv*D] [, k/v scale planes [P, 1, ps]] | out | k/v scratch
+    [2, ps, Hkv*D] [, scale scratch [2, 1, ps]], sems [2, 2|4]. The per-row
+    int8 scale is head-independent, so the folded scores/probs scale with
+    the same [1, ps] rows as the unfolded kernels.
     """
+    if quantized:
+        (page_tables_ref, lengths_ref, q_ref, k_hbm, v_hbm, ks_hbm, vs_hbm,
+         out_ref, k_scratch, v_scratch, ks_scratch, vs_scratch, sems) = refs
+        pools = [(k_hbm, k_scratch), (v_hbm, v_scratch),
+                 (ks_hbm, ks_scratch), (vs_hbm, vs_scratch)]
+    else:
+        (page_tables_ref, lengths_ref, q_ref, k_hbm, v_hbm,
+         out_ref, k_scratch, v_scratch, sems) = refs
+        pools = [(k_hbm, k_scratch), (v_hbm, v_scratch)]
+
     b = pl.program_id(0)
     length = lengths_ref[b]
     n_pages = jnp.maximum(1, pl.cdiv(length, page_size))
@@ -597,14 +659,14 @@ def _kernel_folded(
     qf = (qtile * mask).astype(q_ref.dtype)
     scale = 1.0 / jnp.sqrt(jnp.float32(D))
 
-    def dma(slot, i, which):
-        hbm, scratch = (k_hbm, k_scratch) if which == 0 else (v_hbm, v_scratch)
+    def dma(slot, i, c):
+        hbm, scratch = pools[c]
         return pltpu.make_async_copy(
-            hbm.at[page_tables_ref[b, i]], scratch.at[slot], sems.at[slot, which]
+            hbm.at[page_tables_ref[b, i]], scratch.at[slot], sems.at[slot, c]
         )
 
-    dma(0, 0, 0).start()
-    dma(0, 0, 1).start()
+    for c in range(len(pools)):
+        dma(0, 0, c).start()
 
     def body(i, carry):
         m, l, acc = carry  # [Hq], [Hq], [Hq, F] f32
@@ -613,13 +675,13 @@ def _kernel_folded(
 
         @pl.when(i + 1 < n_pages)
         def _():
-            dma(next_slot, i + 1, 0).start()
-            dma(next_slot, i + 1, 1).start()
+            for c in range(len(pools)):
+                dma(next_slot, i + 1, c).start()
 
-        dma(slot, i, 0).wait()
-        dma(slot, i, 1).wait()
+        for c in range(len(pools)):
+            dma(slot, i, c).wait()
 
-        k_page = k_scratch[slot]  # [ps, F] bf16
+        k_page = k_scratch[slot]  # [ps, F] bf16 (or int8)
         v_page = v_scratch[slot]
         idx = i * page_size + jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
         vidx = i * page_size + jax.lax.broadcasted_iota(
@@ -627,9 +689,14 @@ def _kernel_folded(
         )
 
         # [Hq, ps] exact per-head scores via the folded contraction
+        # (int8 pages upcast to f32 for the dot — operand dtypes must match)
         scores = jax.lax.dot_general(
-            qf, k_page, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            qf.astype(jnp.float32) if quantized else qf,
+            k_page.astype(jnp.float32) if quantized else k_page,
+            (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
+        if quantized:
+            scores = scores * ks_scratch[slot]  # [1, ps] per-row K scales
         scores = jnp.where(idx < length, scores, _NEG_INF)
         v_page = jnp.where(vidx < length, v_page, 0)
 
@@ -639,11 +706,19 @@ def _kernel_folded(
         probs = jnp.exp(scores - new_m[:, None])  # [Hq, ps]
         new_l = l * corr + jnp.sum(probs, axis=-1)
         # [Hq, F] = [Hq, ps] x [ps, F]
-        chunk_out = jax.lax.dot_general(
-            probs.astype(v_page.dtype), v_page,
-            (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        if quantized:
+            probs = probs * vs_scratch[slot]  # V scales fold into probs
+            chunk_out = jax.lax.dot_general(
+                probs, v_page.astype(jnp.float32),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            chunk_out = jax.lax.dot_general(
+                probs.astype(v_page.dtype), v_page,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
         new_acc = acc * corr[:, None] + chunk_out
         return new_m, new_l, new_acc
 
@@ -665,8 +740,8 @@ def _kernel_folded(
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def paged_decode_attention_pallas_folded(
     q: jnp.ndarray,  # [B, Hq, D]
-    k_pages: jnp.ndarray,  # [P, ps, Hkv*D] folded, or [P, ps, Hkv, D]
-    v_pages: jnp.ndarray,
+    k_pages,  # [P, ps, Hkv*D] folded (plain or QuantizedPages), or [P, ps, Hkv, D]
+    v_pages,
     page_tables: jnp.ndarray,  # [B, max_pages] int32
     positions: jnp.ndarray,  # [B] int32 query positions
     interpret: bool = False,
@@ -678,36 +753,48 @@ def paged_decode_attention_pallas_folded(
         # ALREADY folded (LlamaConfig.kv_folded) — reshaping a donated,
         # scatter-updated pool at attention time copies the whole pool.
         P, ps, Hkv, _ = k_pages.shape
-        k_pages = k_pages.reshape(P, ps, Hkv * D)
-        v_pages = v_pages.reshape(P, ps, Hkv * D)
-    P, ps, F = k_pages.shape
+        if isinstance(k_pages, QuantizedPages):
+            k_pages = QuantizedPages(k_pages.q.reshape(P, ps, Hkv * D), k_pages.s)
+            v_pages = QuantizedPages(v_pages.q.reshape(P, ps, Hkv * D), v_pages.s)
+        else:
+            k_pages = k_pages.reshape(P, ps, Hkv * D)
+            v_pages = v_pages.reshape(P, ps, Hkv * D)
+    kf, vf, ks, vs, quantized = _decode_unpack_pools(k_pages, v_pages)
+    P, ps, F = kf.shape
     Hkv = F // D
-    kf, vf = k_pages, v_pages
 
+    scratch_shapes = [
+        pltpu.VMEM((2, ps, Hkv * D), kf.dtype),
+        pltpu.VMEM((2, ps, Hkv * D), vf.dtype),
+    ]
+    if quantized:
+        scratch_shapes += [
+            pltpu.VMEM((2, 1, ps), jnp.float32),
+            pltpu.VMEM((2, 1, ps), jnp.float32),
+        ]
+    C = 4 if quantized else 2
+    scratch_shapes.append(pltpu.SemaphoreType.DMA((2, C)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B,),
         in_specs=[
             pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
+            *[pl.BlockSpec(memory_space=pl.ANY) for _ in range(C)],
         ],
         out_specs=pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((2, ps, Hkv * D), k_pages.dtype),
-            pltpu.VMEM((2, ps, Hkv * D), v_pages.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
-        ],
+        scratch_shapes=scratch_shapes,
     )
     kernel = pl.pallas_call(
         functools.partial(
-            _kernel_folded, page_size=ps, num_kv_heads=Hkv, head_dim=D
+            _kernel_folded, page_size=ps, num_kv_heads=Hkv, head_dim=D,
+            quantized=quantized,
         ),
         out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
     )
-    return kernel(page_tables.astype(jnp.int32), lengths, q, kf, vf)
+    args = (kf, vf, ks, vs) if quantized else (kf, vf)
+    return kernel(page_tables.astype(jnp.int32), lengths, q, *args)
 
 
 def _kernel_chunked(
@@ -864,36 +951,47 @@ def paged_decode_attention_pallas_chunked(
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def paged_decode_attention_pallas(
     q: jnp.ndarray,  # [B, Hq, D]
-    k_pages: jnp.ndarray,  # [P, ps, Hkv, D]
-    v_pages: jnp.ndarray,  # [P, ps, Hkv, D]
+    k_pages,  # [P, ps, Hkv, D] plain or QuantizedPages
+    v_pages,
     page_tables: jnp.ndarray,  # [B, max_pages] int32
     positions: jnp.ndarray,  # [B] int32 query positions
     interpret: bool = False,
 ) -> jnp.ndarray:
     B, Hq, D = q.shape
-    P, ps, Hkv, _ = k_pages.shape
+    kq, vq, ks, vs, quantized = _decode_unpack_pools(k_pages, v_pages)
+    P, ps, Hkv, _ = kq.shape
     max_pages = page_tables.shape[1]
     lengths = positions.astype(jnp.int32) + 1
 
+    scratch_shapes = [
+        pltpu.VMEM((2, ps, Hkv, D), kq.dtype),
+        pltpu.VMEM((2, ps, Hkv, D), vq.dtype),
+    ]
+    if quantized:
+        scratch_shapes += [
+            pltpu.VMEM((2, 1, ps), jnp.float32),
+            pltpu.VMEM((2, 1, ps), jnp.float32),
+        ]
+    C = 4 if quantized else 2
+    scratch_shapes.append(pltpu.SemaphoreType.DMA((2, C)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B,),
         in_specs=[
             pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),  # k pages stay in HBM
-            pl.BlockSpec(memory_space=pl.ANY),  # v pages stay in HBM
+            # k/v pages (and int8 scale planes) stay in HBM
+            *[pl.BlockSpec(memory_space=pl.ANY) for _ in range(C)],
         ],
         out_specs=pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((2, ps, Hkv, D), k_pages.dtype),
-            pltpu.VMEM((2, ps, Hkv, D), v_pages.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
-        ],
+        scratch_shapes=scratch_shapes,
     )
     kernel = pl.pallas_call(
-        functools.partial(_kernel, page_size=ps, max_pages=max_pages),
+        functools.partial(
+            _kernel, page_size=ps, max_pages=max_pages, quantized=quantized
+        ),
         out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
     )
-    return kernel(page_tables.astype(jnp.int32), lengths, q, k_pages, v_pages)
+    args = (kq, vq, ks, vs) if quantized else (kq, vq)
+    return kernel(page_tables.astype(jnp.int32), lengths, q, *args)

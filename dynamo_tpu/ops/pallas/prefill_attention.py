@@ -1,14 +1,41 @@
-"""Pallas TPU kernel: chunked-prefill flash attention over the paged KV pool.
+"""Pallas TPU kernels: chunked-prefill flash attention over the paged KV pool.
 
 A prefill chunk's queries attend causally over the sequence's paged context
 (which already contains the chunk's own rows — the model scatters before
 attending). The XLA reference path (ops/attention.py paged_prefill_attention)
 materializes the whole gathered context ``[max_pages * ps, Hkv, D]`` plus a
-``[Hq, T, S]`` score tensor per layer; this kernel streams context pages
-HBM -> VMEM in multi-page tiles with double buffering and keeps the online
+``[Hq, T, S]`` score tensor per layer; these kernels stream context pages
+HBM -> VMEM in multi-page tiles with double buffering and keep the online
 softmax in VMEM, so HBM traffic is one pass over the needed pages and no
 score/gather materialization at all. Causality additionally bounds work per
 query block: block b only loops over tiles up to its last query position.
+
+Two scheduling variants share the math:
+
+  - ``_kernel`` (basic): the r4 design point — per-program double buffer
+    only. Every grid program (query block) pays the full first-tile DMA
+    latency at its boundary before any compute can start.
+  - ``_kernel_lookahead`` (default on TPU): the decode ``_kernel_lookahead``
+    insight ported to prefill. Grid programs run serially on the core and
+    scratch PERSISTS across them; the page table and positions are
+    scalar-prefetched, so query block b issues block b+1's first
+    ``lookahead`` context-tile DMAs into the opposite parity's window while
+    it runs its own online softmax — the same cross-program pipelining that
+    put the decode kernel AT ideal KV-read bandwidth (r5 A/B,
+    paged_attention.py). Prefill re-reads the context from tile 0 for every
+    query block, so the boundary exposure repeats T/block_q times per chunk
+    per layer; hiding it matters most exactly on the prefill-bound
+    ref-workload shape (3K ISL). Tiles >= lookahead stream through the
+    classic in-program double buffer. DYNTPU_PREFILL_KERNEL=basic is the
+    escape hatch.
+
+Int8 KV (quant/kv.py QuantizedPages): the pools arrive as int8 plus a
+per-row f32 scale plane reshaped to ``[P, 1, ps]``. Scale rows ride their
+own tiny DMAs next to the page DMAs (HBM reads stay int8 — that is the
+point: the context stream halves), and dequantization happens on the score/
+prob TILES in VMEM: ``scores *= k_scale_row`` and ``probs *= v_scale_row``
+are exact per-column algebra (see quant/kv.py) and touch only lane-axis
+broadcasts/concats — the same Mosaic-legal idioms the folded kernels use.
 
 Contract: q [T, Hq, D] (bucket-padded chunk), k/v pages [P, ps, Hkv, D],
 page_table [max_pages] (this sequence's logical pages, trash page 0 padding),
@@ -27,67 +54,116 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dynamo_tpu.quant.kv import QuantizedPages
+
 _NEG_INF = -1e30
 
 
-def _tile_dma_helpers(page_table_ref, k_hbm, v_hbm, k_scratch, v_scratch, sems,
+def _unpack_pools(k_pages, v_pages):
+    """(k, v, k_scale [P,1,ps] | None, v_scale | None, quantized) from plain
+    or QuantizedPages pools. The [P, ps] -> [P, 1, ps] scale reshape is a
+    zero-cost leading-dim split; it gives the per-page DMA slice a 2D
+    ([1, ps]) destination."""
+    if isinstance(k_pages, QuantizedPages):
+        P, ps = k_pages.s.shape
+        return (
+            k_pages.q, v_pages.q,
+            k_pages.s.reshape(P, 1, ps), v_pages.s.reshape(P, 1, ps),
+            True,
+        )
+    return k_pages, v_pages, None, None, False
+
+
+def _scale_tile_row(scratch_tile):
+    """[TP, 1, ps] VMEM scale tiles -> one [1, S] row via lane-axis concat
+    (the folded kernels' q lane-tiling idiom; leading/lane ops only)."""
+    TP = scratch_tile.shape[0]
+    if TP == 1:
+        return scratch_tile[0]
+    return jnp.concatenate([scratch_tile[p] for p in range(TP)], axis=-1)
+
+
+def _tile_dma_helpers(page_table_ref, hbm_scratch_pairs, sems,
                       tile_pages: int, max_pages: int):
     """Shared double-buffered context-tile DMA scaffolding for the prefill
-    kernels: returns (start, wait), each taking (buf, tile). The final tile
-    clamps page indices to max_pages - 1 (aliased content is masked by the
-    callers' ctx-bound check)."""
+    kernels: ``hbm_scratch_pairs`` is [(hbm_pool, scratch)] — k/v and, when
+    quantized, their scale planes — each scratch indexed ``[buf, p]`` and
+    ``sems`` channel c matching pair c (``[2, C, TP]``). Returns (start,
+    wait), each taking (buf, tile). The final tile clamps page indices to
+    max_pages - 1 (aliased content is masked by the callers' ctx-bound
+    check)."""
 
     def tile_dma(buf, tile):
         copies = []
         for p in range(tile_pages):
             idx = jnp.minimum(tile * tile_pages + p, max_pages - 1)
-            copies.append(
-                (
+            for c, (hbm, scratch) in enumerate(hbm_scratch_pairs):
+                copies.append(
                     pltpu.make_async_copy(
-                        k_hbm.at[page_table_ref[idx]], k_scratch.at[buf, p],
-                        sems.at[buf, 0, p],
-                    ),
-                    pltpu.make_async_copy(
-                        v_hbm.at[page_table_ref[idx]], v_scratch.at[buf, p],
-                        sems.at[buf, 1, p],
-                    ),
+                        hbm.at[page_table_ref[idx]], scratch.at[buf, p],
+                        sems.at[buf, c, p],
+                    )
                 )
-            )
         return copies
 
     def start(buf, tile):
-        for kc, vc in tile_dma(buf, tile):
-            kc.start()
-            vc.start()
+        for cp in tile_dma(buf, tile):
+            cp.start()
 
     def wait(buf, tile):
-        for kc, vc in tile_dma(buf, tile):
-            kc.wait()
-            vc.wait()
+        for cp in tile_dma(buf, tile):
+            cp.wait()
 
     return start, wait
 
 
+def _flash_merge(carry, q, kt, vt, scores_extra, mask, ks_row, vs_row):
+    """One online-softmax merge step shared by every non-folded prefill
+    kernel. kt/vt are [Hkv, S, D] f32 context tiles; ks_row/vs_row are
+    [1, S] f32 scale rows (None on bf16 pools); mask [G*Bq, S]."""
+    m, l, acc = carry
+    scores = jax.lax.dot_general(
+        q, kt, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
+    ) * scores_extra
+    if ks_row is not None:
+        scores = scores * ks_row[None]  # [1, 1, S] column scales (exact)
+    scores = jnp.where(mask[None], scores, _NEG_INF)
+    chunk_max = jnp.max(scores, axis=-1)
+    new_m = jnp.maximum(m, chunk_max)
+    corr = jnp.exp(m - new_m)
+    probs = jnp.exp(scores - new_m[..., None])
+    new_l = l * corr + jnp.sum(probs, axis=-1)
+    if vs_row is not None:
+        probs = probs * vs_row[None]  # scale probs, not V: stays one multiply
+    chunk_out = jax.lax.dot_general(
+        probs, vt, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
+    )
+    return new_m, new_l, acc * corr[..., None] + chunk_out
+
+
 def _kernel(
-    # scalar prefetch
-    page_table_ref,  # [max_pages] SMEM
-    positions_ref,  # [T] SMEM
-    # inputs
-    q_ref,  # [Bq, Hq, D] VMEM (this query block)
-    k_hbm,  # [P, ps, Hkv, D] HBM
-    v_hbm,  # [P, ps, Hkv, D] HBM
-    # output
-    out_ref,  # [Bq, Hq, D] VMEM
-    # scratch
-    k_scratch,  # [2, TP, ps, Hkv, D] VMEM
-    v_scratch,  # [2, TP, ps, Hkv, D] VMEM
-    sems,  # DMA sems [2, 2, TP]
-    *,
+    *refs,
     page_size: int,
     max_pages: int,
     tile_pages: int,
     block_q: int,
+    quantized: bool,
 ):
+    """Basic (in-program double buffer) flash prefill; see module docstring.
+
+    refs layout: page_table, positions (scalar prefetch) | q, k_hbm, v_hbm
+    [, ks_hbm, vs_hbm] | out | k_scratch, v_scratch [, ks_scratch,
+    vs_scratch], sems."""
+    if quantized:
+        (page_table_ref, positions_ref, q_ref, k_hbm, v_hbm, ks_hbm, vs_hbm,
+         out_ref, k_scratch, v_scratch, ks_scratch, vs_scratch, sems) = refs
+        pairs = [(k_hbm, k_scratch), (v_hbm, v_scratch),
+                 (ks_hbm, ks_scratch), (vs_hbm, vs_scratch)]
+    else:
+        (page_table_ref, positions_ref, q_ref, k_hbm, v_hbm,
+         out_ref, k_scratch, v_scratch, sems) = refs
+        pairs = [(k_hbm, k_scratch), (v_hbm, v_scratch)]
+
     qb = pl.program_id(0)
     Bq, Hq, D = q_ref.shape
     Hkv = k_hbm.shape[2]
@@ -114,9 +190,7 @@ def _kernel(
     )
     scale = 1.0 / jnp.sqrt(jnp.float32(D))
 
-    start, wait = _tile_dma_helpers(
-        page_table_ref, k_hbm, v_hbm, k_scratch, v_scratch, sems, TP, max_pages
-    )
+    start, wait = _tile_dma_helpers(page_table_ref, pairs, sems, TP, max_pages)
     start(0, 0)
 
     # causal mask geometry, built directly in 2D [G*Bq, S] (Mosaic rejects 1D
@@ -128,7 +202,6 @@ def _kernel(
     q_pos_2d = pos0 + jax.lax.rem(iota_row, Bq)  # [G*Bq, S]
 
     def body(t, carry):
-        m, l, acc = carry
         buf = jax.lax.rem(t, 2)
 
         @pl.when(t + 1 < n_tiles)
@@ -149,30 +222,14 @@ def _kernel(
             .reshape(S, Hkv, D)
             .transpose(1, 0, 2)
         )
+        ks_row = _scale_tile_row(ks_scratch[buf]) if quantized else None
+        vs_row = _scale_tile_row(vs_scratch[buf]) if quantized else None
 
-        # [Hkv, G*Bq, S]
-        scores = (
-            jax.lax.dot_general(
-                q, kt, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
-            )
-            * scale
-        )
         ctx_idx = t * S + iota_col
         # causal, and never beyond the page table (the final tile clamps its
         # page indices to max_pages - 1, which would alias earlier content)
         mask = (ctx_idx <= q_pos_2d) & (ctx_idx < max_pages * page_size)
-        scores = jnp.where(mask[None], scores, _NEG_INF)
-
-        chunk_max = jnp.max(scores, axis=-1)  # [Hkv, G*Bq]
-        new_m = jnp.maximum(m, chunk_max)
-        corr = jnp.exp(m - new_m)
-        probs = jnp.exp(scores - new_m[..., None])
-        new_l = l * corr + jnp.sum(probs, axis=-1)
-        chunk_out = jax.lax.dot_general(
-            probs, vt, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
-        )
-        new_acc = acc * corr[..., None] + chunk_out
-        return new_m, new_l, new_acc
+        return _flash_merge(carry, q, kt, vt, scale, mask, ks_row, vs_row)
 
     m0 = jnp.full((Hkv, G * Bq), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((Hkv, G * Bq), jnp.float32)
@@ -185,27 +242,176 @@ def _kernel(
     ).astype(out_ref.dtype)
 
 
+def _kernel_lookahead(
+    *refs,
+    page_size: int,
+    max_pages: int,
+    tile_pages: int,
+    block_q: int,
+    lookahead: int,
+    quantized: bool,
+):
+    """Flash prefill with CROSS-PROGRAM context-tile prefetch (the decode
+    lookahead kernel's scheduling applied to the query-block grid; see the
+    module docstring for why the boundary exposure matters more here).
+
+    refs layout: page_table, positions | q, k_hbm, v_hbm [, ks_hbm, vs_hbm]
+    | out | k_pre, v_pre [, ks_pre, vs_pre], k_tail, v_tail [, ks_tail,
+    vs_tail], sems_pre, sems_tail."""
+    if quantized:
+        (page_table_ref, positions_ref, q_ref, k_hbm, v_hbm, ks_hbm, vs_hbm,
+         out_ref, k_pre, v_pre, ks_pre, vs_pre, k_tail, v_tail, ks_tail,
+         vs_tail, sems_pre, sems_tail) = refs
+        pre_pools = [(k_hbm, k_pre), (v_hbm, v_pre),
+                     (ks_hbm, ks_pre), (vs_hbm, vs_pre)]
+        tail_pairs = [(k_hbm, k_tail), (v_hbm, v_tail),
+                      (ks_hbm, ks_tail), (vs_hbm, vs_tail)]
+    else:
+        (page_table_ref, positions_ref, q_ref, k_hbm, v_hbm,
+         out_ref, k_pre, v_pre, k_tail, v_tail, sems_pre, sems_tail) = refs
+        pre_pools = [(k_hbm, k_pre), (v_hbm, v_pre)]
+        tail_pairs = [(k_hbm, k_tail), (v_hbm, v_tail)]
+
+    qb = pl.program_id(0)
+    nb = pl.num_programs(0)
+    par = jax.lax.rem(qb, 2)
+    W = lookahead
+    Bq, Hq, D = q_ref.shape
+    Hkv = k_hbm.shape[2]
+    G = Hq // Hkv
+    TP = tile_pages
+    S = TP * page_size
+    ctx_cap = jnp.int32(max_pages * page_size)
+
+    def block_tiles(block_idx):
+        """Causal tile count for query block ``block_idx`` (its last row's
+        position is scalar-prefetched, so any program can compute it)."""
+        last_pos = positions_ref[block_idx * block_q + Bq - 1]
+        return jnp.minimum(pl.cdiv(last_pos + 1, S), pl.cdiv(ctx_cap, S))
+
+    n_tiles = block_tiles(qb)
+
+    q = (
+        q_ref[...]
+        .astype(jnp.float32)
+        .reshape(Bq, Hkv, G, D)
+        .transpose(1, 2, 0, 3)
+        .reshape(Hkv, G * Bq, D)
+    )
+    scale = 1.0 / jnp.sqrt(jnp.float32(D))
+
+    def pre_dma(parity, j, p, c):
+        hbm, scratch = pre_pools[c]
+        idx = jnp.minimum(j * TP + p, max_pages - 1)
+        return pltpu.make_async_copy(
+            hbm.at[page_table_ref[idx]],
+            scratch.at[parity, j, p],
+            sems_pre.at[parity, j, c, p],
+        )
+
+    def tail_dma(slot, tile, p, c):
+        hbm, scratch = tail_pairs[c]
+        idx = jnp.minimum(tile * TP + p, max_pages - 1)
+        return pltpu.make_async_copy(
+            hbm.at[page_table_ref[idx]],
+            scratch.at[slot, p],
+            sems_tail.at[slot, c, p],
+        )
+
+    def issue_pre(block_idx, parity):
+        # context pages are shared by every query block of the chunk, so the
+        # NEXT block's first W tiles are known from the page table alone;
+        # only how many it needs (its causal bound) depends on the block
+        npg = block_tiles(block_idx)
+        for j in range(W):  # static unroll: DMA issues only
+
+            @pl.when(j < npg)
+            def _(j=j):
+                for p in range(TP):
+                    for c in range(len(pre_pools)):
+                        pre_dma(parity, j, p, c).start()
+
+    # program 0 has no predecessor: prefetch its own window
+    @pl.when(qb == 0)
+    def _():
+        issue_pre(0, 0)
+
+    # prefetch the NEXT query block's window while this one computes
+    @pl.when(qb + 1 < nb)
+    def _():
+        issue_pre(qb + 1, 1 - par)
+
+    # long-context tail: warm the in-program double buffer for tile W
+    @pl.when(W < n_tiles)
+    def _():
+        for p in range(TP):
+            for c in range(len(tail_pairs)):
+                tail_dma(W % 2, W, p, c).start()
+
+    pos0 = positions_ref[qb * block_q]
+    iota_row = jax.lax.broadcasted_iota(jnp.int32, (G * Bq, S), 0)
+    iota_col = jax.lax.broadcasted_iota(jnp.int32, (G * Bq, S), 1)
+    q_pos_2d = pos0 + jax.lax.rem(iota_row, Bq)
+
+    def merge_tile(carry, t, k_tile, v_tile, ks_tile, vs_tile):
+        kt = k_tile.astype(jnp.float32).reshape(S, Hkv, D).transpose(1, 0, 2)
+        vt = v_tile.astype(jnp.float32).reshape(S, Hkv, D).transpose(1, 0, 2)
+        ks_row = _scale_tile_row(ks_tile) if quantized else None
+        vs_row = _scale_tile_row(vs_tile) if quantized else None
+        ctx_idx = t * S + iota_col
+        mask = (ctx_idx <= q_pos_2d) & (ctx_idx < ctx_cap)
+        return _flash_merge(carry, q, kt, vt, scale, mask, ks_row, vs_row)
+
+    def pre_body(j, carry):
+        for p in range(TP):
+            for c in range(len(pre_pools)):
+                pre_dma(par, j, p, c).wait()
+        return merge_tile(
+            carry, j, k_pre[par, j], v_pre[par, j],
+            ks_pre[par, j] if quantized else None,
+            vs_pre[par, j] if quantized else None,
+        )
+
+    def tail_body(t, carry):
+        slot = jax.lax.rem(t, 2)
+        next_slot = jax.lax.rem(t + 1, 2)
+
+        @pl.when(t + 1 < n_tiles)
+        def _():
+            for p in range(TP):
+                for c in range(len(tail_pairs)):
+                    tail_dma(next_slot, t + 1, p, c).start()
+
+        for p in range(TP):
+            for c in range(len(tail_pairs)):
+                tail_dma(slot, t, p, c).wait()
+        return merge_tile(
+            carry, t, k_tail[slot], v_tail[slot],
+            ks_tail[slot] if quantized else None,
+            vs_tail[slot] if quantized else None,
+        )
+
+    m0 = jnp.full((Hkv, G * Bq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((Hkv, G * Bq), jnp.float32)
+    acc0 = jnp.zeros((Hkv, G * Bq, D), jnp.float32)
+    carry = jax.lax.fori_loop(0, jnp.minimum(W, n_tiles), pre_body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(W, n_tiles, tail_body, carry)
+
+    out = acc / jnp.maximum(l, 1e-20)[..., None]
+    out_ref[...] = (
+        out.reshape(Hkv, G, Bq, D).transpose(2, 0, 1, 3).reshape(Bq, Hq, D)
+    ).astype(out_ref.dtype)
+
+
 def _kernel_folded(
-    # scalar prefetch
-    page_table_ref,  # [max_pages] SMEM
-    positions_ref,  # [T] SMEM
-    # inputs
-    q_ref,  # [Bq, Hq, D] VMEM (this query block)
-    k_hbm,  # [P, ps, Hkv*D] HBM (heads folded into lanes)
-    v_hbm,  # [P, ps, Hkv*D] HBM
-    # output
-    out_ref,  # [Bq, Hq, D] VMEM
-    # scratch
-    k_scratch,  # [2, TP, ps, Hkv*D] VMEM
-    v_scratch,  # [2, TP, ps, Hkv*D] VMEM
-    sems,  # DMA sems [2, 2, TP]
-    *,
+    *refs,
     page_size: int,
     max_pages: int,
     tile_pages: int,
     block_q: int,
     num_kv_heads: int,
     head_dim: int,
+    quantized: bool,
 ):
     """Folded-lane flash prefill for head_dim < 128 (see the decode
     _kernel_folded in paged_attention.py for the trick): every (query row,
@@ -213,7 +419,19 @@ def _kernel_folded(
     single [R, F] x [S, F] matmul yields exact per-head scores — the zero
     slices kill cross-head terms and cost only Hkv x extra MACs on an op
     that is a rounding error of prefill FLOPs. All shape changes are
-    leading-dim merges/splits (minor dim untouched: Mosaic-legal)."""
+    leading-dim merges/splits (minor dim untouched: Mosaic-legal). Int8
+    pools: the per-row scale is head-INDEPENDENT, so one [1, S] scale row
+    applies to the folded scores/probs exactly like the unfolded case."""
+    if quantized:
+        (page_table_ref, positions_ref, q_ref, k_hbm, v_hbm, ks_hbm, vs_hbm,
+         out_ref, k_scratch, v_scratch, ks_scratch, vs_scratch, sems) = refs
+        pairs = [(k_hbm, k_scratch), (v_hbm, v_scratch),
+                 (ks_hbm, ks_scratch), (vs_hbm, vs_scratch)]
+    else:
+        (page_table_ref, positions_ref, q_ref, k_hbm, v_hbm,
+         out_ref, k_scratch, v_scratch, sems) = refs
+        pairs = [(k_hbm, k_scratch), (v_hbm, v_scratch)]
+
     qb = pl.program_id(0)
     Bq, Hq, D = q_ref.shape
     Hkv, F = num_kv_heads, num_kv_heads * head_dim
@@ -239,9 +457,7 @@ def _kernel_folded(
     qf = (qtile * own).astype(q_ref.dtype)
     scale = 1.0 / jnp.sqrt(jnp.float32(D))
 
-    start, wait = _tile_dma_helpers(
-        page_table_ref, k_hbm, v_hbm, k_scratch, v_scratch, sems, TP, max_pages
-    )
+    start, wait = _tile_dma_helpers(page_table_ref, pairs, sems, TP, max_pages)
     start(0, 0)
 
     # causal geometry: row r's query position = positions[q_start] + r // Hq
@@ -260,13 +476,18 @@ def _kernel_folded(
 
         wait(buf, t)
 
-        kf = k_scratch[buf].reshape(S, F)  # leading merge, bf16
+        kf = k_scratch[buf].reshape(S, F)  # leading merge
         vf = v_scratch[buf].reshape(S, F)
 
         # [R, S] exact per-(row, head) scores via the folded contraction
+        # (int8 pages upcast to f32 for the dot — operand dtypes must match)
         scores = jax.lax.dot_general(
-            qf, kf, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            qf.astype(jnp.float32) if quantized else qf,
+            kf.astype(jnp.float32) if quantized else kf,
+            (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
+        if quantized:
+            scores = scores * _scale_tile_row(ks_scratch[buf])  # [1, S]
         ctx_idx = t * S + iota_col
         mask = (ctx_idx <= q_pos_2d) & (ctx_idx < max_pages * page_size)
         scores = jnp.where(mask, scores, _NEG_INF)
@@ -277,10 +498,17 @@ def _kernel_folded(
         probs = jnp.exp(scores - new_m[:, None])
         new_l = l * corr + jnp.sum(probs, axis=-1)
         # [R, F] = [R, S] x [S, F]
-        chunk_out = jax.lax.dot_general(
-            probs.astype(kf.dtype), vf, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        if quantized:
+            probs = probs * _scale_tile_row(vs_scratch[buf])
+            chunk_out = jax.lax.dot_general(
+                probs, vf.astype(jnp.float32), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            chunk_out = jax.lax.dot_general(
+                probs.astype(kf.dtype), vf, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
         new_acc = acc * corr[:, None] + chunk_out
         return new_m, new_l, new_acc
 
@@ -298,11 +526,33 @@ def _kernel_folded(
     out_ref[...] = out2.reshape(Bq, Hq, D).astype(out_ref.dtype)  # leading split
 
 
+def _pool_in_specs(quantized: bool):
+    """in_specs for [q_block, k_pool, v_pool (, k_scale, v_scale)]."""
+    pools = 4 if quantized else 2
+    return [pl.BlockSpec(memory_space=pl.ANY) for _ in range(pools)]
+
+
+#: scoped-VMEM budget for the lookahead prefill window (the decode kernel's
+#: rationale, prefill tile sizes; ~16 MB/core scoped limit)
+_PREFILL_LOOKAHEAD_SCRATCH_BYTES = 8 * 1024 * 1024
+
+
+def prefill_lookahead_window(page_size: int, tile_pages: int,
+                             num_kv_heads: int, head_dim: int,
+                             itemsize: int = 2) -> int:
+    """Prefetch window W in context TILES that fits the scratch budget
+    (0 = lookahead not applicable at this geometry). Scratch = 2 parities x
+    W tiles x (k+v) + the 2-slot tail; int8 scale tiles are noise."""
+    tile_bytes = 2 * tile_pages * page_size * num_kv_heads * head_dim * itemsize
+    budget = _PREFILL_LOOKAHEAD_SCRATCH_BYTES - 2 * tile_bytes  # tail buffers
+    return max(0, min(4, budget // (2 * tile_bytes)))
+
+
 @functools.partial(jax.jit, static_argnames=("interpret", "block_q"))
 def paged_prefill_attention_pallas_folded(
     q: jnp.ndarray,  # [T, Hq, D] bucket-padded chunk
-    k_pages: jnp.ndarray,  # [P, ps, Hkv*D] folded, or [P, ps, Hkv, D]
-    v_pages: jnp.ndarray,
+    k_pages,  # [P, ps, Hkv*D] folded (plain or QuantizedPages), or [P, ps, Hkv, D]
+    v_pages,
     page_table: jnp.ndarray,  # [max_pages] int32
     positions: jnp.ndarray,  # [T] int32 absolute positions (unit-stride)
     block_q: int = 128,
@@ -311,28 +561,40 @@ def paged_prefill_attention_pallas_folded(
     T, Hq, D = q.shape
     if k_pages.ndim == 4:  # direct-call convenience (tests)
         P, ps, Hkv, _ = k_pages.shape
-        k_pages = k_pages.reshape(P, ps, Hkv * D)
-        v_pages = v_pages.reshape(P, ps, Hkv * D)
-    P, ps, F = k_pages.shape
+        if isinstance(k_pages, QuantizedPages):
+            k_pages = QuantizedPages(k_pages.q.reshape(P, ps, Hkv * D), k_pages.s)
+            v_pages = QuantizedPages(v_pages.q.reshape(P, ps, Hkv * D), v_pages.s)
+        else:
+            k_pages = k_pages.reshape(P, ps, Hkv * D)
+            v_pages = v_pages.reshape(P, ps, Hkv * D)
+    kq, vq, ks, vs, quantized = _unpack_pools(k_pages, v_pages)
+    P, ps, F = kq.shape
     Hkv = F // D
     max_pages = page_table.shape[0]
     assert T % block_q == 0, f"chunk {T} % block_q {block_q}"
     tile_pages = max(1, 128 // ps)
 
+    scratch_shapes = [
+        pltpu.VMEM((2, tile_pages, ps, F), kq.dtype),
+        pltpu.VMEM((2, tile_pages, ps, F), vq.dtype),
+    ]
+    if quantized:
+        scratch_shapes += [
+            pltpu.VMEM((2, tile_pages, 1, ps), jnp.float32),
+            pltpu.VMEM((2, tile_pages, 1, ps), jnp.float32),
+        ]
+    scratch_shapes.append(
+        pltpu.SemaphoreType.DMA((2, 4 if quantized else 2, tile_pages))
+    )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(T // block_q,),
         in_specs=[
             pl.BlockSpec((block_q, Hq, D), lambda qb, *_: (qb, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
+            *_pool_in_specs(quantized),
         ],
         out_specs=pl.BlockSpec((block_q, Hq, D), lambda qb, *_: (qb, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((2, tile_pages, ps, F), k_pages.dtype),
-            pltpu.VMEM((2, tile_pages, ps, F), v_pages.dtype),
-            pltpu.SemaphoreType.DMA((2, 2, tile_pages)),
-        ],
+        scratch_shapes=scratch_shapes,
     )
     kernel = pl.pallas_call(
         functools.partial(
@@ -343,55 +605,126 @@ def paged_prefill_attention_pallas_folded(
             block_q=block_q,
             num_kv_heads=Hkv,
             head_dim=D,
+            quantized=quantized,
         ),
         out_shape=jax.ShapeDtypeStruct((T, Hq, D), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
     )
-    return kernel(page_table.astype(jnp.int32), positions.astype(jnp.int32), q, k_pages, v_pages)
+    args = (kq, vq, ks, vs) if quantized else (kq, vq)
+    return kernel(
+        page_table.astype(jnp.int32), positions.astype(jnp.int32), q, *args
+    )
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "block_q"))
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "block_q", "lookahead")
+)
 def paged_prefill_attention_pallas(
     q: jnp.ndarray,  # [T, Hq, D] bucket-padded chunk
-    k_pages: jnp.ndarray,  # [P, ps, Hkv, D]
-    v_pages: jnp.ndarray,  # [P, ps, Hkv, D]
+    k_pages,  # [P, ps, Hkv, D] plain or QuantizedPages
+    v_pages,
     page_table: jnp.ndarray,  # [max_pages] int32
     positions: jnp.ndarray,  # [T] int32 absolute positions (unit-stride)
     block_q: int = 128,
     interpret: bool = False,
+    lookahead: bool = True,
 ) -> jnp.ndarray:
+    """Flash prefill dispatcher: lookahead (cross-program tile prefetch)
+    when the window fits VMEM, else the basic in-program double buffer."""
     T, Hq, D = q.shape
-    P, ps, Hkv, _ = k_pages.shape
+    kq, vq, ks, vs, quantized = _unpack_pools(k_pages, v_pages)
+    P, ps, Hkv, _ = kq.shape
     max_pages = page_table.shape[0]
     assert T % block_q == 0, f"chunk {T} % block_q {block_q}"
     tile_pages = max(1, 128 // ps)
+    W = (
+        prefill_lookahead_window(ps, tile_pages, Hkv, D, kq.dtype.itemsize)
+        if lookahead
+        else 0
+    )
+
+    if W >= 1:
+        scratch_shapes = [
+            pltpu.VMEM((2, W, tile_pages, ps, Hkv, D), kq.dtype),
+            pltpu.VMEM((2, W, tile_pages, ps, Hkv, D), vq.dtype),
+        ]
+        if quantized:
+            scratch_shapes += [
+                pltpu.VMEM((2, W, tile_pages, 1, ps), jnp.float32),
+                pltpu.VMEM((2, W, tile_pages, 1, ps), jnp.float32),
+            ]
+        scratch_shapes += [
+            pltpu.VMEM((2, tile_pages, ps, Hkv, D), kq.dtype),
+            pltpu.VMEM((2, tile_pages, ps, Hkv, D), vq.dtype),
+        ]
+        if quantized:
+            scratch_shapes += [
+                pltpu.VMEM((2, tile_pages, 1, ps), jnp.float32),
+                pltpu.VMEM((2, tile_pages, 1, ps), jnp.float32),
+            ]
+        C = 4 if quantized else 2
+        scratch_shapes += [
+            pltpu.SemaphoreType.DMA((2, W, C, tile_pages)),
+            pltpu.SemaphoreType.DMA((2, C, tile_pages)),
+        ]
+        body = functools.partial(
+            _kernel_lookahead,
+            page_size=ps,
+            max_pages=max_pages,
+            tile_pages=tile_pages,
+            block_q=block_q,
+            lookahead=W,
+            quantized=quantized,
+        )
+        # cross-program scratch persistence (query block b prefetches b+1's
+        # context tiles into the opposite parity) requires the grid to run
+        # SERIALLY — pin it, as the decode lookahead kernel does
+        compiler_params = pltpu.TPUCompilerParams(dimension_semantics=("arbitrary",))
+    else:
+        scratch_shapes = [
+            pltpu.VMEM((2, tile_pages, ps, Hkv, D), kq.dtype),
+            pltpu.VMEM((2, tile_pages, ps, Hkv, D), vq.dtype),
+        ]
+        if quantized:
+            scratch_shapes += [
+                pltpu.VMEM((2, tile_pages, 1, ps), jnp.float32),
+                pltpu.VMEM((2, tile_pages, 1, ps), jnp.float32),
+            ]
+        scratch_shapes.append(
+            pltpu.SemaphoreType.DMA((2, 4 if quantized else 2, tile_pages))
+        )
+        body = functools.partial(
+            _kernel,
+            page_size=ps,
+            max_pages=max_pages,
+            tile_pages=tile_pages,
+            block_q=block_q,
+            quantized=quantized,
+        )
+        compiler_params = None
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(T // block_q,),
         in_specs=[
             pl.BlockSpec((block_q, Hq, D), lambda qb, *_: (qb, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
+            *_pool_in_specs(quantized),
         ],
         out_specs=pl.BlockSpec((block_q, Hq, D), lambda qb, *_: (qb, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((2, tile_pages, ps, Hkv, D), k_pages.dtype),
-            pltpu.VMEM((2, tile_pages, ps, Hkv, D), v_pages.dtype),
-            pltpu.SemaphoreType.DMA((2, 2, tile_pages)),
-        ],
+        scratch_shapes=scratch_shapes,
     )
+    kwargs = {}
+    if compiler_params is not None:
+        kwargs["compiler_params"] = compiler_params
     kernel = pl.pallas_call(
-        functools.partial(
-            _kernel,
-            page_size=ps,
-            max_pages=max_pages,
-            tile_pages=tile_pages,
-            block_q=block_q,
-        ),
+        body,
         out_shape=jax.ShapeDtypeStruct((T, Hq, D), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
+        **kwargs,
     )
-    return kernel(page_table.astype(jnp.int32), positions.astype(jnp.int32), q, k_pages, v_pages)
+    args = (kq, vq, ks, vs) if quantized else (kq, vq)
+    return kernel(
+        page_table.astype(jnp.int32), positions.astype(jnp.int32), q, *args
+    )

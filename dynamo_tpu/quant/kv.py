@@ -1,0 +1,188 @@
+"""Int8 KV-cache quantization: per-page (per token row) symmetric scales.
+
+Why quantize the CACHE and not just the weights: the prefill-bound reference
+workload reads the whole paged context once per layer per chunk, and decode
+re-reads every live sequence's pages each step — with the weights already
+int8 (quant/int8.py) the KV stream is the next-largest HBM term. Storing the
+pools as int8 halves that traffic and DOUBLES page capacity at the same HBM
+budget (bigger batches, deeper prefix cache, cheaper host offload and disagg
+transfer). KIVI (Liu et al., 2024) and KVQuant show int8/low-bit KV with
+per-block scales preserves generation quality.
+
+Scale placement — one f32 scale per (page, token-row), i.e. a ``[pages,
+page_size]`` scale plane next to each ``[pages, page_size, ...]`` int8 pool:
+
+  - quantization is INCREMENTAL: decode appends one row at a time, and a
+    per-row scale means a new token never forces requantizing the rows
+    already in its page (a single scalar per page would);
+  - the scale multiplies factor out of the attention algebra exactly:
+    ``q . (s_j * k_j) == s_j * (q . k_j)`` scales the score column and
+    ``sum_j p_j * (s_j * v_j) == (p_j * s_j) . v_j`` scales the prob column,
+    so the Pallas kernels apply scales to score/prob TILES after the int8
+    DMA (HBM reads stay int8; dequant never touches HBM) with lane-axis
+    broadcasts only — no sub-128 minor-dim reshapes (Mosaic-safe);
+  - a page's scales travel WITH the page: the disagg dataplane ships them in
+    the part header and the host offload tier stores them beside the block.
+
+``QuantizedPages`` mirrors ``QuantizedLinear``: a registered pytree node
+that rides everywhere the plain pool rode — the layer-scan carry, jit
+donation (both leaves alias in place), device_put with a mirrored sharding
+tree, and shard_map in_specs. It proxies ``shape``/``dtype``/``ndim`` to the
+int8 pool so the geometry probes sprinkled through the engine
+(``k_pool.shape[1]``, ``k_pages.ndim == 3``) keep working unchanged.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: EngineConfig.kv_cache_dtype values (None means bf16 == the model dtype)
+KV_CACHE_DTYPES = ("bf16", "int8")
+
+_INT8_MAX = 127.0
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedPages:
+    """One int8 KV page pool + its per-row f32 scale plane.
+
+    q: int8 ``[pages, page_size, Hkv, D]`` (or ``[pages, page_size, Hkv*D]``
+       folded — same layouts as the bf16 pool it replaces)
+    s: f32 ``[pages, page_size]`` — one scale per token row (absmax over the
+       row's head values / 127)
+    """
+
+    __slots__ = ("q", "s")
+
+    def __init__(self, q, s):
+        self.q = q
+        self.s = s
+
+    def tree_flatten(self):
+        return (self.q, self.s), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    # geometry proxies: engine/model code probes the POOL's shape
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return (
+            f"QuantizedPages(q={getattr(self.q, 'shape', None)}, "
+            f"s={getattr(self.s, 'shape', None)})"
+        )
+
+
+def quantize_kv_rows(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """``[T, ...]`` fresh K or V rows -> (int8 rows, f32 per-row scales [T]).
+
+    Symmetric per-row absmax over every non-leading axis; the floor keeps an
+    all-zero row (padding) dividing cleanly to zeros."""
+    x32 = jnp.asarray(x, jnp.float32)
+    absmax = jnp.max(jnp.abs(x32), axis=tuple(range(1, x32.ndim)))  # [T]
+    scale = jnp.maximum(absmax, 1e-12) / _INT8_MAX
+    bshape = (x32.shape[0],) + (1,) * (x32.ndim - 1)
+    q = jnp.clip(jnp.round(x32 / scale.reshape(bshape)), -_INT8_MAX, _INT8_MAX)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_rows(q: jnp.ndarray, s: jnp.ndarray, dtype=jnp.float32) -> jnp.ndarray:
+    """Inverse of quantize_kv_rows over any leading batch of rows: ``s``
+    broadcasts from the leading axes (q.ndim - s.ndim trailing dims added)."""
+    s_b = jnp.asarray(s, jnp.float32).reshape(s.shape + (1,) * (q.ndim - s.ndim))
+    return (q.astype(jnp.float32) * s_b).astype(dtype)
+
+
+def init_quantized_pages(shape: tuple[int, ...]) -> QuantizedPages:
+    """Zeroed int8 pool + zeroed scale plane for ``kv_cache_shape`` output."""
+    return QuantizedPages(
+        q=jnp.zeros(shape, jnp.int8),
+        s=jnp.zeros(shape[:2], jnp.float32),
+    )
+
+
+def kv_page_bytes(page_size: int, num_kv_heads: int, head_dim: int,
+                  num_layers: int, kv_cache_dtype: str | None,
+                  itemsize: int = 2) -> int:
+    """HBM bytes ONE allocator page costs across all layers (K and V,
+    including the int8 scale planes). The capacity arithmetic behind the
+    "~2x pages at the same HBM budget" claim — and the number dynotop and
+    the resource gauges render instead of assuming bf16. ``itemsize`` is the
+    full-precision element size (2 = bf16 serving; tiny test models run
+    f32)."""
+    row_vals = num_kv_heads * head_dim
+    if kv_cache_dtype == "int8":
+        per_row = row_vals * 1 + 4  # int8 values + one f32 scale
+    else:
+        per_row = row_vals * itemsize
+    return 2 * num_layers * page_size * per_row  # x2: K and V
+
+
+def pages_for_hbm_budget(budget_bytes: int, page_size: int, num_kv_heads: int,
+                         head_dim: int, num_layers: int,
+                         kv_cache_dtype: str | None, itemsize: int = 2) -> int:
+    """How many KV pages fit a device-memory budget at a given cache dtype
+    (page 0 is the allocator's reserved trash page, so usable pages are one
+    fewer)."""
+    return budget_bytes // max(
+        1, kv_page_bytes(page_size, num_kv_heads, head_dim, num_layers,
+                         kv_cache_dtype, itemsize)
+    )
+
+
+# ---------------- wire helpers ----------------
+# Quantized KV travels as {"q": int8 [L, 2, n, ps, ...], "s": f32
+# [L, 2, n, ps]} — the scale plane rides next to the data with the SAME page
+# axis, so every per-page slicing/concat path (host offload, streamed disagg
+# parts, bucketed scatter padding) maps one helper call over both leaves.
+
+
+def is_quantized_wire(data) -> bool:
+    return isinstance(data, dict) and "q" in data and "s" in data
+
+
+def wire_nbytes(data) -> int:
+    """Payload bytes of a wire block (dict or plain ndarray)."""
+    if is_quantized_wire(data):
+        return int(data["q"].nbytes) + int(data["s"].nbytes)
+    return int(data.nbytes)
+
+
+def wire_concat(blocks: list, axis: int):
+    """Concatenate wire blocks along the page axis (dict-aware)."""
+    if is_quantized_wire(blocks[0]):
+        return {
+            "q": np.concatenate([b["q"] for b in blocks], axis=axis),
+            "s": np.concatenate([b["s"] for b in blocks], axis=axis),
+        }
+    return np.concatenate(blocks, axis=axis)
+
+
+def wire_pad(data, axis: int, pad: int):
+    """Zero-pad ``pad`` pages onto the page axis (dict-aware). Pad pages are
+    scatter-dropped by out-of-range ids, so zeros are never read."""
+    if pad <= 0:
+        return data
+
+    def _pad(a):
+        shape = list(a.shape)
+        shape[axis] = pad
+        return np.concatenate([a, np.zeros(shape, a.dtype)], axis=axis)
+
+    if is_quantized_wire(data):
+        return {"q": _pad(data["q"]), "s": _pad(data["s"])}
+    return _pad(data)

@@ -47,6 +47,17 @@ def test_surfaces_cover_every_layer():
         assert required in names, f"missing exposition surface {required}"
 
 
+def test_engine_surface_carries_kv_dtype_bytes_gauges():
+    """The int8-KV telemetry families must stay on the conformance-checked
+    engine surface: actual-dtype pool bytes + the dtype-labeled per-page
+    cost (tools like dynotop render KV bytes from these instead of assuming
+    bf16)."""
+    text = dict(_SURFACES)["engine.render_stage_metrics"]
+    assert "# TYPE dynamo_engine_kv_cache_bytes gauge" in text
+    assert "# TYPE dynamo_engine_kv_cache_page_bytes gauge" in text
+    assert 'dynamo_engine_kv_cache_page_bytes{dtype="' in text
+
+
 def test_colocated_composition_has_no_family_collisions():
     """The in=http serving path concatenates HTTP metrics + frontend SLO +
     engine stage/resource/health/SLO families into one /metrics document;

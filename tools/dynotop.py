@@ -68,7 +68,8 @@ def render_status(doc: dict) -> str:
     ]
     header = (
         f"{'WORKER':<12} {'STATE':<10} {'HB':>6} {'SEEN':>6} {'MISS':>4} "
-        f"{'SLOTS':>7} {'KV%':>6} {'WAIT':>5} {'HBM':>9} {'CMPL':>5}  SLO"
+        f"{'SLOTS':>7} {'KV%':>6} {'KVMEM':>11} {'WAIT':>5} {'HBM':>9} "
+        f"{'CMPL':>5}  SLO"
     )
     lines.append(header)
     lines.append("-" * len(header))
@@ -80,13 +81,25 @@ def render_status(doc: dict) -> str:
         res = w.get("resources") or {}
         slots = f"{kv.get('request_active_slots', 0)}/{kv.get('request_total_slots', 0)}"
         kv_pct = 100.0 * kv.get("kv_active_blocks", 0) / max(1, kv.get("kv_total_blocks", 1))
+        # KV pool bytes at the worker's ACTUAL cache dtype (resource gauges
+        # carry kv_pool_bytes_*/kv_cache_dtype since the int8 KV cache —
+        # the old render assumed bf16 and over-reported int8 workers 2x);
+        # workers predating the gauges show "-"
+        kv_used = res.get("kv_pool_bytes_used")
+        if kv_used is None and res.get("kv_page_bytes"):
+            kv_used = res.get("kv_pages_used", 0) * res["kv_page_bytes"]
+        dt = str(res.get("kv_cache_dtype", "") or "")
+        kv_mem = (
+            f"{_fmt_bytes(kv_used)}:{dt[:4]}" if kv_used is not None and dt
+            else (_fmt_bytes(kv_used) if kv_used is not None else "-")
+        )
         hb = health.get("heartbeat_age_s")
         stale_mark = " STALE" if w.get("stale") else ""
         lines.append(
             f"{w.get('worker_id', '?'):<12} {glyph} {state:<8} "
             f"{(f'{hb:.1f}s' if hb is not None else '-'):>6} "
             f"{w.get('last_seen_s', 0):>5.1f}s {w.get('missed_scrapes', 0):>4} "
-            f"{slots:>7} {kv_pct:>5.1f}% "
+            f"{slots:>7} {kv_pct:>5.1f}% {kv_mem:>11} "
             f"{kv.get('num_requests_waiting', 0):>5} "
             f"{_fmt_bytes(res.get('hbm_bytes_in_use', 0)):>9} "
             f"{res.get('xla_compiles', 0):>5}  {_slo_cell(w.get('slo'))}"
