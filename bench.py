@@ -272,13 +272,14 @@ async def run_config(
     }
 
 
-async def _request(eng, rid, prompt, max_tokens=8):
+async def _request(eng, rid, prompt, max_tokens=8, holder="", holder_blocks=0):
     from dynamo_tpu.engine.sampling import SamplingParams
     from dynamo_tpu.engine.scheduler import EngineRequest
 
     req = EngineRequest(
         request_id=rid, token_ids=list(prompt),
         sampling=SamplingParams(temperature=0.0, max_tokens=max_tokens, ignore_eos=True),
+        kv_holder_addr=holder, kv_holder_blocks=holder_blocks,
     )
     t0 = time.monotonic()
     ttft, toks, cached = None, [], 0
@@ -977,6 +978,182 @@ async def run_disagg_stream(
     }
 
 
+async def run_fleet_prefix(sessions: int = 3, osl: int = 8) -> dict:
+    """Fleet-wide prefix cache: cross-worker KV pull vs full recompute on a
+    shared-system-prompt workload (the millions-of-users chat shape: many
+    sessions share a long system prompt, the router can't always land them
+    on the worker that already holds it).
+
+    Three engines per KV dtype: a HOLDER seeded with every session's shared
+    prefix (and serving a KvPullServer), a HIT engine whose requests carry
+    the holder as kv_holder (admission pulls the prefix over the wire —
+    FETCHING_KV), and a COLD engine running the identical requests with no
+    holder (full prefix recompute). Reports the cross-worker-hit vs
+    recompute TTFT ratio (< 1.0 is the win), the fleet recompute-token
+    ratio, pulled bytes at the ACTUAL wire KV dtype (int8 payloads are half
+    the bf16 bytes), and exact token parity between the arms.
+
+    On CPU (no TPU in the build container) the section scales the geometry
+    down; parity and the recompute-ratio are exact either way, the driver's
+    TPU run prices the TTFT ratio at serving geometry."""
+    import gc
+
+    import jax
+
+    from dynamo_tpu.disagg.prefix_fetch import KvPullServer, PrefixFetchClient
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu:
+        geom = {
+            "vocab_size": 512, "hidden_size": 512, "intermediate_size": 1024,
+            "num_layers": 4, "num_heads": 4, "num_kv_heads": 2,
+            "head_dim": 128, "dtype": "f32",
+        }
+        base_id = "tiny:" + json.dumps(geom)
+        page_size, shared_len, tail_len, vocab = 16, 448, 31, 500
+        prefill_buckets = (64, 128, 256, 512)
+        max_model_len = 1024
+    else:
+        base_id = json_model_id()
+        page_size, shared_len, tail_len, vocab = 64, 1536, 127, 31000
+        prefill_buckets = (512, 1024, 2048)
+        max_model_len = 4096
+
+    ps = page_size
+    prefix_blocks = shared_len // ps
+    plen = shared_len + tail_len
+    pages_per_seq = -(-(plen + osl) // ps) + 2
+    num_pages = (sessions + 4) * pages_per_seq + 8
+
+    rng = np.random.default_rng(41)
+    # one shared system prompt per session (warm session included), so every
+    # measured request is a genuine first-placement miss that must pull
+    all_prompts = [
+        rng.integers(1, vocab, shared_len).tolist()
+        + rng.integers(1, vocab, tail_len).tolist()
+        for _ in range(sessions + 1)
+    ]
+    warm_prompt, prompts = all_prompts[0], all_prompts[1:]
+
+    results: dict[str, dict] = {}
+    for dtype in (None, "int8"):
+        label = dtype or "bf16"
+
+        def cfg():
+            return EngineConfig(
+                model_id=base_id, page_size=ps, num_pages=num_pages,
+                max_seqs=4, max_model_len=max_model_len,
+                prefill_buckets=prefill_buckets, decode_steps=4,
+                pipeline_depth=2, kv_cache_dtype=dtype,
+                prefix_fetch_timeout_s=60.0,
+            )
+
+        cleanups = []
+        try:
+            holder = AsyncJaxEngine(cfg())
+            await holder.start()
+            cleanups.append(holder.shutdown)
+            hit_eng = AsyncJaxEngine(cfg())
+            await hit_eng.start()
+            cleanups.append(hit_eng.shutdown)
+            cold_eng = AsyncJaxEngine(cfg())
+            await cold_eng.start()
+            cleanups.append(cold_eng.shutdown)
+            srv = await KvPullServer(holder, host="127.0.0.1").start()
+            cleanups.append(srv.stop)
+            fetcher = PrefixFetchClient(asyncio.get_running_loop(), timeout_s=60.0)
+            hit_eng.attach_prefix_fetch(fetcher)
+
+            # fleet state: the holder computed (and cached) every session's
+            # shared prefix
+            for i, p in enumerate(all_prompts):
+                await _request(holder, f"seed-{label}-{i}", p, max_tokens=2)
+            # warm both serving arms on the warm session: compiles prefill
+            # buckets, decode windows, and the fetch-scatter executables out
+            # of the measurement (the warm hit request exercises a real pull)
+            await _request(hit_eng, f"warm-hit-{label}", warm_prompt,
+                           max_tokens=2, holder=srv.address,
+                           holder_blocks=prefix_blocks)
+            await _request(cold_eng, f"warm-cold-{label}", warm_prompt, max_tokens=2)
+
+            hit_ttfts, hit_tokens, hit_recompute = [], [], 0
+            for i, p in enumerate(prompts):
+                toks, ttft, cached = await _request(
+                    hit_eng, f"hit-{label}-{i}", p, max_tokens=osl,
+                    holder=srv.address, holder_blocks=prefix_blocks,
+                )
+                hit_ttfts.append(ttft)
+                hit_tokens.append(toks)
+                hit_recompute += plen - cached
+            cold_ttfts, cold_tokens, cold_recompute = [], [], 0
+            for i, p in enumerate(prompts):
+                toks, ttft, cached = await _request(
+                    cold_eng, f"cold-{label}-{i}", p, max_tokens=osl,
+                )
+                cold_ttfts.append(ttft)
+                cold_tokens.append(toks)
+                cold_recompute += plen - cached
+
+            sched = hit_eng.scheduler
+            results[label] = {
+                "ttft_hit_p50_ms": round(float(np.percentile(hit_ttfts, 50)) * 1e3, 1),
+                "ttft_recompute_p50_ms": round(
+                    float(np.percentile(cold_ttfts, 50)) * 1e3, 1
+                ),
+                "ttft_ratio_hit_over_recompute": round(
+                    float(np.percentile(hit_ttfts, 50))
+                    / max(float(np.percentile(cold_ttfts, 50)), 1e-9), 3
+                ),
+                "token_parity": hit_tokens == cold_tokens,
+                "prefix_fetch_hits": sched.prefix_fetch_hits,
+                "prefix_fetch_fallbacks": sched.prefix_fetch_fallbacks,
+                "pulled_blocks": sched.prefix_fetch_blocks,
+                # at the ACTUAL wire KV dtype: int8 payloads are half the
+                # bf16 bytes (scale planes ride part headers, uncounted)
+                "pulled_bytes": sched.prefix_fetch_bytes,
+                "recompute_tokens_hit_arm": hit_recompute,
+                "recompute_tokens_cold_arm": cold_recompute,
+                "recompute_ratio": round(
+                    hit_recompute / max(1, cold_recompute), 4
+                ),
+                "served_blocks": dict(srv.served_blocks),
+            }
+        finally:
+            for stop in reversed(cleanups):
+                try:
+                    await stop()
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc()
+            gc.collect()
+
+    assert results["bf16"]["token_parity"], "cross-worker pull broke token parity"
+    assert results["int8"]["token_parity"], "int8 cross-worker pull broke parity"
+    return {
+        "cpu_smoke": on_cpu,
+        "workload": {
+            "sessions": sessions, "shared_prefix_len": shared_len,
+            "prompt_len": plen, "osl": osl, "page_size": ps,
+            "prefix_blocks": prefix_blocks,
+        },
+        "bf16": results["bf16"],
+        "int8": results["int8"],
+        "wire_bytes_ratio_int8_over_bf16": round(
+            results["int8"]["pulled_bytes"]
+            / max(1, results["bf16"]["pulled_bytes"]), 3
+        ),
+        "target": (
+            "token parity exact both dtypes; hit-arm TTFT ratio < 1.0; "
+            "recompute_ratio ~= tail/plen (the fleet stops recomputing "
+            "shared prefixes); int8 wire bytes = itemsize ratio (0.5x vs "
+            "bf16 on TPU, 0.25x vs the f32 CPU-smoke geometry)"
+        ),
+    }
+
+
 async def run_quant_int8_parity(decode_tokens: int = 72) -> dict:
     """Weight-only int8 vs bf16 on the headline llama-1.3b config: decode
     throughput (the weight-bound roofline argument — int8 weights halve the
@@ -1662,6 +1839,9 @@ async def run() -> dict:
         # multi-chunk prompts, token parity, compute/transfer overlap
         await _section("disagg_stream", run_disagg_stream, 1800)
         await _section("parity_kv_routing", run_routing_parity, 1500)
+        # fleet-wide prefix cache: cross-worker KV pull vs recompute on a
+        # shared-system-prompt workload (exact parity + TTFT ratio)
+        await _section("fleet_prefix", run_fleet_prefix, 1800)
         await _section("parity_host_offload", run_offload_parity, 1200)
     return _result()
 
@@ -1707,6 +1887,7 @@ def _summary(errors: dict) -> dict:
     dis = DETAIL.get("parity_disagg")
     dstream = DETAIL.get("disagg_stream")
     rout = DETAIL.get("parity_kv_routing")
+    fleet = DETAIL.get("fleet_prefix")
     off = DETAIL.get("parity_host_offload")
     quant = DETAIL.get("parity_quant_int8")
     kvq = DETAIL.get("prefill_kv_int8")
@@ -1767,6 +1948,14 @@ def _summary(errors: dict) -> dict:
         "parity_kv_routing": {
             "ratio_measured": _get(rout, "ttft_insitu_ratio_measured"),
             "ratio_derived": _get(rout, "ttft_insitu_ratio_derived"),
+        },
+        "fleet_prefix": {
+            "ttft_ratio_bf16": _get(fleet, "bf16", "ttft_ratio_hit_over_recompute"),
+            "ttft_ratio_int8": _get(fleet, "int8", "ttft_ratio_hit_over_recompute"),
+            "recompute_ratio": _get(fleet, "bf16", "recompute_ratio"),
+            "token_parity": _get(fleet, "bf16", "token_parity"),
+            "pulled_bytes_bf16": _get(fleet, "bf16", "pulled_bytes"),
+            "wire_bytes_ratio_int8": _get(fleet, "wire_bytes_ratio_int8_over_bf16"),
         },
         "parity_host_offload": {
             "ratio_projected": _get(off, "projection", "ttft_ratio_projected"),

@@ -69,7 +69,20 @@ class ProcessorService:
             try:
                 # routing-decision time is hop overhead a trace should see
                 with tracing.span("processor.schedule", tokens=len(token_ids)):
-                    instance_id = await self.router.schedule(token_ids)
+                    instance_id, overlap = await self.router.schedule_with_overlap(
+                        token_ids
+                    )
+                # fleet-wide prefix cache: when a peer's cached prefix beats
+                # the chosen worker's, attach it so the worker can PULL the
+                # pages over the dataplane instead of recomputing them — the
+                # same OverlapScores the placement used, no second radix walk
+                holder = self.router.best_remote_holder(overlap, instance_id)
+                if holder is not None:
+                    addr = self.router.pull_address(holder[0])
+                    if addr:
+                        request = dict(request)
+                        request["kv_holder_addr"] = addr
+                        request["kv_holder_blocks"] = holder[1]
             except (NoWorkersError, AllWorkersBusyError) as e:
                 log.warning("kv scheduling failed (%s); falling back to random", e)
 

@@ -57,6 +57,10 @@ class WorkerService:
         self.backend: Optional[Backend] = None
         self._served = None
         self._kv_publisher: Optional[KvEventPublisher] = None
+        # fleet-wide prefix cache: peers pull OUR cached prefixes from this
+        # export server; its address rides the stats broadcast so the KV
+        # router can attach us as a holder (disagg/prefix_fetch.py)
+        self.kv_pull_server = None
 
     async def start(self) -> "WorkerService":
         loop = asyncio.get_running_loop()
@@ -74,6 +78,17 @@ class WorkerService:
         else:
             inner = AsyncJaxEngine(self.engine_config, kv_event_sink=self._kv_publisher.publish)
             await inner.start()
+        if self.engine_config.prefix_fetch and isinstance(inner, AsyncJaxEngine):
+            from dynamo_tpu.disagg.prefix_fetch import KvPullServer, PrefixFetchClient
+
+            # both directions of the fleet prefix cache: serve our prefixes
+            # to pulling peers, and pull theirs when the router attaches a
+            # holder to an incoming request
+            self.kv_pull_server = await KvPullServer(inner).start()
+            inner.kv_pull_server = self.kv_pull_server
+            inner.attach_prefix_fetch(PrefixFetchClient(
+                loop, timeout_s=self.engine_config.prefix_fetch_timeout_s
+            ))
         engine = inner
         if self.enable_disagg_decode:
             from dynamo_tpu.disagg.decode_worker import DisaggDecodeEngine
@@ -115,6 +130,8 @@ class WorkerService:
             await self._registration.stop(unregister=False)
         if self._served is not None:
             await self._served.stop()
+        if self.kv_pull_server is not None:
+            await self.kv_pull_server.stop()
         if self.engine is not None:
             await self.engine.shutdown()
 
@@ -142,6 +159,17 @@ class WorkerService:
         slo = getattr(self._inner_engine, "slo_snapshot", None)
         if slo is not None:
             stats["slo"] = slo()
+        if self.kv_pull_server is not None:
+            # the fleet prefix cache's discovery channel: routers read the
+            # pull address out of this broadcast to attach us as a holder
+            srv = self.kv_pull_server
+            stats["kv_pull"] = {
+                "address": srv.address,
+                "served": srv.served,
+                "gone": srv.gone,
+                "served_blocks": dict(srv.served_blocks),
+                "bytes_sent": srv.bytes_sent,
+            }
         if self.enable_disagg_decode and self.engine is not None:
             stats["disagg"] = {
                 "remote_prefills": self.engine.remote_prefills,
@@ -210,6 +238,9 @@ async def _main(args) -> None:
             speculative=getattr(args, "speculative", None),
             kv_stream=not getattr(args, "no_kv_stream", False),
             kv_stream_lanes=getattr(args, "kv_stream_lanes", None) or 2,
+            prefix_fetch=not getattr(args, "no_prefix_fetch", False),
+            prefix_fetch_timeout_s=getattr(args, "prefix_fetch_timeout_s", None) or 5.0,
+            prefix_fetch_min_blocks=getattr(args, "prefix_fetch_min_blocks", None) or 1,
             slo_ttft_ms=getattr(args, "slo_ttft_ms", None),
             slo_itl_ms=getattr(args, "slo_itl_ms", None),
         ),
@@ -266,6 +297,15 @@ def main(argv=None) -> None:
     p.add_argument("--no-kv-stream", action="store_true",
                    help="disable chunk-streamed KV transfer (fall back to one "
                         "monolithic post-prefill send)")
+    p.add_argument("--no-prefix-fetch", action="store_true",
+                   help="disable the fleet-wide prefix cache (don't serve KV "
+                        "pulls or fetch remote prefixes from peers)")
+    p.add_argument("--prefix-fetch-timeout-s", type=float, default=5.0,
+                   help="remote prefix pull deadline; on expiry the request "
+                        "degrades to recompute (never an error)")
+    p.add_argument("--prefix-fetch-min-blocks", type=int, default=1,
+                   help="minimum holder advantage (blocks) over the local "
+                        "prefix cache before a pull is worth issuing")
     args = p.parse_args(argv)
     asyncio.run(_main(args))
 

@@ -1,0 +1,417 @@
+"""Fleet-wide prefix cache: the cross-worker KV **pull** protocol.
+
+The push dataplane (disagg/dataplane.py) moves KV the prefill worker just
+computed to the decode worker that asked for it. This module is the other
+direction: when the KV router places a request on a worker that does NOT
+hold its prefix, that worker pulls the matching pages from the peer the
+radix indexer says has them — instead of recomputing the whole prefix.
+This extends the reference's single-node KVBM reuse across the fleet
+(mooncake-style disaggregated KV pooling; SURVEY capability 5).
+
+Two halves:
+
+  - ``KvPullServer`` — worker-side export service. A peer connects and
+    sends a fetch frame naming the *chained sequence hashes* (llm/tokens.py
+    — the engine block identity carried in KV events) of the prefix blocks
+    it wants. The server walks the contiguous leading run of those hashes
+    down the tier ladder — HBM pages first (``ModelRunner.
+    extract_pages_async``, dispatched on the engine thread), then
+    ``HostKvPool`` blocks — and streams the data back as checksummed parts
+    on the same connection. A leading miss returns a clean ``gone`` frame,
+    never a timeout: the requester must fall back to recompute immediately,
+    not stall admission behind a dead wait.
+
+  - ``PrefixFetchClient`` — requester side, driven by the engine scheduler
+    (a thread without an event loop): ``fetch()`` schedules the coroutine
+    onto the serving loop via ``run_coroutine_threadsafe`` and hands back a
+    concurrent Future the scheduler polls each step while the sequence
+    waits in its FETCHING_KV state. Every failure mode (timeout, refused
+    connection, holder death mid-stream, checksum mismatch, ``gone``)
+    resolves the future with a non-hit result — the scheduler then
+    recomputes; a fetch can never error a request.
+
+Wire format (shared framing with the push plane: ``u32 len | msgpack
+header [| payload]``):
+
+    request:  {kind: "prefix_fetch", hashes: [u64, ...]}
+    response: 1..N part frames, each
+              {status: "ok", part_seq, part_total, block_from, block_to,
+               tier: "hbm"|"host", shape, dtype, xxh3, cat_axis
+               [, scales, scales_shape, scales_dtype]} | payload
+              — or a single payload-less {status: "gone"} / {status:
+              "error", error} frame.
+
+``block_from``/``block_to`` index into the REQUESTED hash list, so the
+requester maps parts onto its own freshly-allocated pages. Int8 KV caches
+ship int8 page data (half the wire bytes) with the per-row scale plane
+riding the part header, exactly like the push protocol — and because the
+parts land in ``ModelRunner.inject_pages_bucketed``, mixed-dtype peers
+interoperate (scatter_pages_wire re/de-quantizes).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import msgpack
+import numpy as np
+import xxhash
+
+from dynamo_tpu.disagg.dataplane import _LEN, MAX_HEADER
+from dynamo_tpu.utils import get_logger
+from dynamo_tpu.utils.prometheus import Histogram, render_family
+
+log = get_logger("disagg.prefix_fetch")
+
+# whole-fetch latency: localhost pulls are ms-scale, a cross-host pull of a
+# long prefix reaches seconds
+_FETCH_SECONDS_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                          0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+def _np_dtype(name: str) -> np.dtype:
+    from dynamo_tpu.llm.remote_prefill import _np_dtype as _impl
+
+    return _impl(name)
+
+
+def _pack_part(
+    seq: int, total: int, block_from: int, block_to: int, tier: str,
+    data, axis: int,
+) -> tuple[bytes, memoryview]:
+    """One response part -> (header bytes, payload memoryview). ``data`` may
+    be the int8 {"q","s"} wire dict — the scale plane rides the header."""
+    scales = None
+    if isinstance(data, dict):
+        scales = data["s"]
+        data = data["q"]
+    arr = np.ascontiguousarray(data)
+    payload = memoryview(arr.view(np.uint8).reshape(-1))
+    fields = {
+        "status": "ok",
+        "part_seq": seq,
+        "part_total": total,
+        "block_from": block_from,
+        "block_to": block_to,
+        "tier": tier,
+        "shape": list(arr.shape),
+        "dtype": str(arr.dtype),
+        "xxh3": xxhash.xxh3_64_intdigest(payload),
+        "cat_axis": axis,
+    }
+    if scales is not None:
+        s = np.ascontiguousarray(scales)
+        fields["scales"] = s.tobytes()
+        fields["scales_shape"] = list(s.shape)
+        fields["scales_dtype"] = str(s.dtype)
+    return msgpack.packb(fields), payload
+
+
+@dataclass
+class FetchedPart:
+    """One pulled prefix range, ready for inject_pages_bucketed."""
+
+    block_from: int  # indices into the requested hash list
+    block_to: int
+    data: object  # np array, or the int8 {"q","s"} wire dict
+    cat_axis: int
+    tier: str = ""
+
+
+@dataclass
+class PrefixFetchResult:
+    """Terminal state of one fetch; every failure mode is a status, never an
+    exception — the scheduler's fallback ladder keys off it."""
+
+    status: str  # "hit" | "gone" | "timeout" | "error"
+    blocks: int = 0  # contiguous leading blocks received
+    bytes: int = 0  # payload bytes received
+    parts: list = field(default_factory=list)  # [FetchedPart], block order
+    error: str = ""
+
+
+class KvPullServer:
+    """Worker-side KV export service: serves prefix pulls from this engine's
+    HBM pages and host-pool blocks."""
+
+    def __init__(self, engine, host: str = "0.0.0.0", advertise_host: Optional[str] = None):
+        self.engine = engine
+        self.host = host
+        self.advertise_host = advertise_host
+        self.port: Optional[int] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._writers: set[asyncio.StreamWriter] = set()
+        self.served = 0  # fetches answered with >= 1 block
+        self.gone = 0  # clean leading-miss responses
+        self.errors = 0
+        self.served_blocks = {"hbm": 0, "host": 0}
+        self.bytes_sent = 0
+
+    @property
+    def address(self) -> str:
+        host = self.advertise_host
+        if host is None:
+            if self.host in ("0.0.0.0", "::"):
+                import socket
+
+                host = socket.gethostname()
+            else:
+                host = self.host
+        return f"{host}:{self.port}"
+
+    async def start(self, port: int = 0) -> "KvPullServer":
+        self._server = await asyncio.start_server(self._on_conn, self.host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.info("kv pull server listening on %s", self.address)
+        return self
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            for w in list(self._writers):
+                w.close()
+            await self._server.wait_closed()
+
+    # ---------------- wire ----------------
+
+    async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        peer = writer.get_extra_info("peername")
+        self._writers.add(writer)
+        try:
+            while True:
+                raw = await reader.readexactly(_LEN.size)
+                (hlen,) = _LEN.unpack(raw)
+                if hlen > MAX_HEADER:
+                    raise ValueError(f"prefix fetch header too large: {hlen}")
+                header = msgpack.unpackb(await reader.readexactly(hlen))
+                if header.get("kind") != "prefix_fetch":
+                    raise ValueError(f"unexpected frame kind {header.get('kind')!r}")
+                await self._serve_fetch(writer, list(header.get("hashes", ())))
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        except Exception:
+            log.exception("kv pull connection from %s failed", peer)
+        finally:
+            self._writers.discard(writer)
+            writer.close()
+
+    async def _write_status(self, writer, status: str, error: str = "") -> None:
+        fields = {"status": status, "part_total": 0}
+        if error:
+            fields["error"] = error
+        header = msgpack.packb(fields)
+        writer.write(_LEN.pack(len(header)))
+        writer.write(header)
+        await writer.drain()
+
+    async def _serve_fetch(self, writer, hashes: list[int]) -> None:
+        engine = self.engine
+        export = None
+        if hashes and engine is not None:
+            try:
+                export = await engine.run_on_engine(
+                    lambda: engine.sync_export_prefix(hashes)
+                )
+            except Exception:
+                log.exception("prefix export failed")
+                self.errors += 1
+                await self._write_status(writer, "error", "export failed")
+                return
+        if export is None:
+            # leading block in no tier: a clean miss the requester can act on
+            # immediately (it recomputes), never a timeout
+            self.gone += 1
+            await self._write_status(writer, "gone")
+            return
+        n_dev, dev_future, host_blocks, axis = export
+        try:
+            parts = []
+            if n_dev:
+                # resolve the D2H staging off-loop; the gather itself was
+                # dispatched on the engine thread inside sync_export_prefix
+                data = await asyncio.wrap_future(dev_future)
+                parts.append((0, n_dev, "hbm", data))
+            if host_blocks:
+                from dynamo_tpu.quant.kv import wire_concat
+
+                hdata = (
+                    wire_concat(host_blocks, axis=axis)
+                    if len(host_blocks) > 1
+                    else host_blocks[0]
+                )
+                parts.append((n_dev, n_dev + len(host_blocks), "host", hdata))
+        except Exception:
+            log.exception("prefix export staging failed")
+            self.errors += 1
+            await self._write_status(writer, "error", "staging failed")
+            return
+        total = len(parts)
+        for seq, (b0, b1, tier, data) in enumerate(parts):
+            header, payload = _pack_part(seq, total, b0, b1, tier, data, axis)
+            writer.write(_LEN.pack(len(header)))
+            writer.write(header)
+            writer.write(payload)
+            await writer.drain()
+            self.served_blocks[tier] = self.served_blocks.get(tier, 0) + (b1 - b0)
+            self.bytes_sent += payload.nbytes
+        self.served += 1
+
+    # ---------------- metrics ----------------
+
+    def render_metrics(self) -> str:
+        return "".join([
+            render_family(
+                "dynamo_prefix_fetch_served_total", "counter",
+                "prefix pulls answered, by result",
+                [({"result": "hit"}, self.served),
+                 ({"result": "gone"}, self.gone),
+                 ({"result": "error"}, self.errors)],
+            ),
+            render_family(
+                "dynamo_prefix_fetch_served_blocks_total", "counter",
+                "KV blocks exported to pulling peers, by tier",
+                [({"tier": t}, n) for t, n in sorted(self.served_blocks.items())],
+            ),
+            render_family(
+                "dynamo_prefix_fetch_served_bytes_total", "counter",
+                "KV payload bytes exported to pulling peers",
+                [({}, self.bytes_sent)],
+            ),
+        ])
+
+
+class PrefixFetchClient:
+    """Requester side: pulls a prefix's blocks from a peer's KvPullServer.
+
+    ``fetch()`` is thread-safe (the engine scheduler calls it from the
+    engine thread); the returned concurrent Future ALWAYS resolves to a
+    PrefixFetchResult — timeouts, dead peers, and protocol errors become
+    statuses, so a fetch can never wedge or error admission."""
+
+    def __init__(self, loop: Optional[asyncio.AbstractEventLoop], timeout_s: float = 5.0):
+        self._loop = loop
+        self.timeout_s = timeout_s
+        self.requests = 0
+        self.results: dict[str, int] = {}
+        self.blocks_received = 0
+        self.bytes_received = 0
+        self.fetch_seconds = Histogram(
+            "dynamo_prefix_fetch_client_seconds",
+            "wall time of one prefix pull, connection to last part",
+            _FETCH_SECONDS_BUCKETS,
+        )
+
+    def fetch(self, addr: str, hashes: list[int], timeout_s: Optional[float] = None):
+        """Start a pull; returns a concurrent.futures.Future[PrefixFetchResult]."""
+        if self._loop is None or self._loop.is_closed():
+            raise RuntimeError("prefix fetch client has no running event loop")
+        return asyncio.run_coroutine_threadsafe(
+            self._fetch(addr, list(hashes), timeout_s or self.timeout_s), self._loop
+        )
+
+    async def _fetch(self, addr: str, hashes: list[int], timeout_s: float) -> PrefixFetchResult:
+        self.requests += 1
+        t0 = time.monotonic()
+        try:
+            res = await asyncio.wait_for(self._fetch_inner(addr, hashes), timeout_s)
+        except asyncio.TimeoutError:
+            res = PrefixFetchResult(status="timeout")
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            res = PrefixFetchResult(status="error", error=f"{type(e).__name__}: {e}")
+        self.results[res.status] = self.results.get(res.status, 0) + 1
+        self.blocks_received += res.blocks
+        self.bytes_received += res.bytes
+        self.fetch_seconds.observe(time.monotonic() - t0)
+        if res.status != "hit":
+            log.debug("prefix fetch from %s: %s %s", addr, res.status, res.error)
+        return res
+
+    async def _fetch_inner(self, addr: str, hashes: list[int]) -> PrefixFetchResult:
+        host, _, port = addr.rpartition(":")
+        reader, writer = await asyncio.open_connection(host, int(port))
+        try:
+            req = msgpack.packb({"kind": "prefix_fetch", "hashes": hashes})
+            writer.write(_LEN.pack(len(req)))
+            writer.write(req)
+            await writer.drain()
+            parts: list[FetchedPart] = []
+            total: Optional[int] = None
+            nbytes_total = 0
+            while total is None or len(parts) < total:
+                raw = await reader.readexactly(_LEN.size)
+                (hlen,) = _LEN.unpack(raw)
+                if hlen > MAX_HEADER:
+                    raise ValueError(f"prefix fetch header too large: {hlen}")
+                header = msgpack.unpackb(await reader.readexactly(hlen))
+                status = header.get("status")
+                if status == "gone":
+                    return PrefixFetchResult(status="gone")
+                if status != "ok":
+                    return PrefixFetchResult(
+                        status="error", error=str(header.get("error", "bad status"))
+                    )
+                dtype = _np_dtype(header["dtype"])
+                shape = tuple(header["shape"])
+                nbytes = dtype.itemsize * int(np.prod(shape))
+                payload = await reader.readexactly(nbytes)
+                if xxhash.xxh3_64_intdigest(payload) != header["xxh3"]:
+                    return PrefixFetchResult(status="error", error="checksum mismatch")
+                data: object = np.frombuffer(payload, dtype).reshape(shape)
+                if header.get("scales") is not None:
+                    scales = np.frombuffer(
+                        header["scales"], _np_dtype(header["scales_dtype"])
+                    ).reshape(tuple(header["scales_shape"]))
+                    data = {"q": data, "s": scales}
+                parts.append(FetchedPart(
+                    block_from=int(header["block_from"]),
+                    block_to=int(header["block_to"]),
+                    data=data,
+                    cat_axis=int(header.get("cat_axis", 2)),
+                    tier=str(header.get("tier", "")),
+                ))
+                total = max(1, int(header["part_total"]))
+                nbytes_total += nbytes
+            parts.sort(key=lambda p: p.block_from)
+            # only the contiguous leading run is usable as cached prefix
+            blocks = 0
+            usable = []
+            for p in parts:
+                if p.block_from != blocks:
+                    break
+                usable.append(p)
+                blocks = p.block_to
+            if blocks == 0:
+                return PrefixFetchResult(status="gone")
+            return PrefixFetchResult(
+                status="hit", blocks=blocks, bytes=nbytes_total, parts=usable
+            )
+        finally:
+            writer.close()
+
+    # ---------------- metrics ----------------
+
+    def render_metrics(self) -> str:
+        results = {s: self.results.get(s, 0) for s in ("hit", "gone", "timeout", "error")}
+        return "".join([
+            render_family(
+                "dynamo_prefix_fetch_client_requests_total", "counter",
+                "prefix pulls issued to peers, by terminal result",
+                [({"result": s}, n) for s, n in sorted(results.items())],
+            ),
+            render_family(
+                "dynamo_prefix_fetch_client_blocks_total", "counter",
+                "KV blocks pulled off peers (contiguous usable runs)",
+                [({}, self.blocks_received)],
+            ),
+            render_family(
+                "dynamo_prefix_fetch_client_bytes_total", "counter",
+                "KV payload bytes pulled off peers (at the wire KV dtype)",
+                [({}, self.bytes_received)],
+            ),
+            self.fetch_seconds.render(),
+        ])

@@ -66,6 +66,17 @@ class EngineConfig:
     # lanes so one long prompt's multi-MB parts never head-of-line-block
     # other requests' transfers behind a single per-destination socket
     kv_stream_lanes: int = 2
+    # fleet-wide prefix cache (disagg/prefix_fetch.py): when the KV router
+    # attaches a remote prefix holder to a request (kv_holder_addr/blocks),
+    # pull the matching KV pages from that peer over the dataplane instead of
+    # recomputing them. The sequence waits in a FETCHING_KV state bounded by
+    # prefix_fetch_timeout_s; any failure (timeout, dead peer, "gone")
+    # degrades to recompute — never an error to the client.
+    prefix_fetch: bool = True
+    prefix_fetch_timeout_s: float = 5.0
+    # only fetch when the holder's advantage over the local prefix cache is at
+    # least this many blocks (a one-block pull rarely beats its own overhead)
+    prefix_fetch_min_blocks: int = 1
     worker_id: str = "worker-0"
     # SLO targets (milliseconds; None = untargeted). With any target set the
     # engine attaches an SloTracker (utils/slo.py) to the scheduler: rolling
@@ -125,6 +136,10 @@ class EngineConfig:
                 raise ValueError(
                     f"quantize must be None or one of {QUANT_MODES}; got {self.quantize!r}"
                 )
+        if self.prefix_fetch_timeout_s <= 0:
+            raise ValueError(
+                f"prefix_fetch_timeout_s must be > 0; got {self.prefix_fetch_timeout_s}"
+            )
         if self.kv_stream_lanes < 1:
             raise ValueError(
                 f"kv_stream_lanes must be >= 1; got {self.kv_stream_lanes}"

@@ -87,6 +87,11 @@ class AsyncJaxEngine:
             targets_from_env({"ttft": config.slo_ttft_ms, "itl": config.slo_itl_ms})
         )
         self._next_watchdog = 0.0
+        # fleet-wide prefix cache (disagg/prefix_fetch.py): the pull client
+        # the scheduler fetches remote prefixes with, and the export server
+        # peers pull OUR prefixes from — both attached by the hosting worker
+        self.prefix_fetcher = None
+        self.kv_pull_server = None
 
     # ---------------- lifecycle ----------------
 
@@ -146,6 +151,7 @@ class AsyncJaxEngine:
         )
         self.scheduler = Scheduler(self.config, self.runner, self.allocator)
         self.scheduler.slo = self.slo
+        self.scheduler.prefix_fetcher = self.prefix_fetcher
         if self.config.warmup == "background":
             # readiness waits only for the traces first requests need; the
             # feature variants (logprobs/penalties, extras prefill) compile
@@ -273,6 +279,49 @@ class AsyncJaxEngine:
 
     def sync_lookup_prefix(self, token_ids: list[int]) -> int:
         return self.allocator.lookup_prefix(token_ids)
+
+    def attach_prefix_fetch(self, fetcher) -> None:
+        """Wire the fleet prefix-cache pull client into the scheduler (safe
+        before or after start — _initialize copies it through)."""
+        self.prefix_fetcher = fetcher
+        if self.scheduler is not None:
+            self.scheduler.prefix_fetcher = fetcher
+
+    def sync_export_prefix(self, hashes: list[int]):
+        """Engine thread: serve a peer's prefix pull (disagg/prefix_fetch.py
+        KvPullServer). Walks the contiguous leading run of the requested
+        chained block hashes down the tier ladder — HBM pages (the device
+        gather is dispatched HERE, atomically with the lookup, so a later
+        scatter can't reuse a page before the gather captured it; XLA orders
+        the buffers), then host-pool blocks. Returns ``(n_dev_blocks,
+        dev_host_future_or_None, host_blocks, cat_axis)``; None = leading
+        block in no tier (the server answers with a clean "gone")."""
+        alloc, runner = self.allocator, self.runner
+        if alloc is None or runner is None:
+            return None
+        pages: list[int] = []
+        for h in hashes:
+            page = alloc.cached_page(h)
+            if page is None:
+                break
+            pages.append(page)
+        host_blocks: list = []
+        offload = getattr(self, "offload", None)
+        if offload is not None:
+            for h in hashes[len(pages):]:
+                data = offload.peek(h)
+                if data is None:
+                    break
+                host_blocks.append(data)
+        if not pages and not host_blocks:
+            return None
+        fut = (
+            runner.extract_pages_async(np.asarray(pages, np.int32))
+            if pages
+            else None
+        )
+        axis = getattr(runner.model, "wire_n_axis", 2)
+        return len(pages), fut, host_blocks, axis
 
     def sync_allocate_remote(
         self, request_id: str, token_ids: list[int]
@@ -530,6 +579,13 @@ class AsyncJaxEngine:
                 0, alloc.cache_query_blocks - alloc.cache_hit_blocks
             ),
             "prefix_cache_query_blocks": alloc.cache_query_blocks,
+            # fleet prefix cache: remote pulls this engine issued (requester
+            # side; the pull SERVER's counters ride the worker's kv_pull stats)
+            "prefix_fetch_hits": sched.prefix_fetch_hits,
+            "prefix_fetch_fallbacks": sched.prefix_fetch_fallbacks,
+            "prefix_fetch_blocks": sched.prefix_fetch_blocks,
+            "prefix_fetch_bytes": sched.prefix_fetch_bytes,
+            "prefix_fetch_tokens": sched.prefix_fetch_tokens,
             "preemptions": sched.preempt_count,
             "pressure_drains": sched.pressure_drain_count,
             "requests_waiting": len(sched.waiting),
@@ -611,6 +667,12 @@ class AsyncJaxEngine:
                 [({}, st.spec_accepted)],
             ))
         parts.append(self._render_resource_metrics())
+        # fleet prefix cache: wire-side client/server families join the
+        # engine surface when the hosting worker attached them
+        if self.prefix_fetcher is not None:
+            parts.append(self.prefix_fetcher.render_metrics())
+        if self.kv_pull_server is not None:
+            parts.append(self.kv_pull_server.render_metrics())
         parts.append(self.health.render_metrics())
         # engine-scoped prefix: a colocated HTTP frontend renders its own
         # tracker under dynamo_slo_*; sharing that name here would emit
@@ -638,6 +700,29 @@ class AsyncJaxEngine:
                 "prefix-cache lookups by result (block granularity)",
                 [({"result": "hit"}, r["prefix_cache_hit_blocks"]),
                  ({"result": "miss"}, r["prefix_cache_miss_blocks"])],
+            ),
+            render_family(
+                "dynamo_prefix_fetch_requests_total", "counter",
+                "remote prefix pulls resolved by this engine, by outcome "
+                "(hit = blocks scattered and recompute skipped; fallback = "
+                "timeout/gone/error degraded to recompute)",
+                [({"result": "hit"}, r["prefix_fetch_hits"]),
+                 ({"result": "fallback"}, r["prefix_fetch_fallbacks"])],
+            ),
+            render_family(
+                "dynamo_prefix_fetch_blocks_total", "counter",
+                "KV blocks pulled from peers and scattered into local pages",
+                [({}, r["prefix_fetch_blocks"])],
+            ),
+            render_family(
+                "dynamo_prefix_fetch_bytes_total", "counter",
+                "KV payload bytes pulled from peers (at the wire KV dtype)",
+                [({}, r["prefix_fetch_bytes"])],
+            ),
+            render_family(
+                "dynamo_prefix_fetch_tokens_total", "counter",
+                "prompt tokens whose prefill recompute a remote pull skipped",
+                [({}, r["prefix_fetch_tokens"])],
             ),
             render_family(
                 "dynamo_engine_preemptions_total", "counter",

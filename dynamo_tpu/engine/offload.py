@@ -117,5 +117,16 @@ class HostKvPool:
         self.loads += n
         return {h for h, _ in hits}
 
+    def peek(self, seq_hash: int):
+        """Read a host block without device movement (the fleet prefix-cache
+        pull server's host-tier leg). Bumps LRU recency — a block peers keep
+        pulling is a block worth keeping. The returned array is stored-once /
+        never mutated, so handing out the reference is safe even if the pool
+        later LRU-drops the entry mid-serialization."""
+        data = self._blocks.get(seq_hash)
+        if data is not None:
+            self._blocks.move_to_end(seq_hash)
+        return data
+
     def discard(self, seq_hash: int) -> None:
         self._blocks.pop(seq_hash, None)

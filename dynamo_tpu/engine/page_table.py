@@ -142,6 +142,13 @@ class PageAllocator:
                 break
         return hits * self.page_size
 
+    def cached_page(self, seq_hash: int) -> Optional[int]:
+        """Physical page holding a cached block, or None. Blocks parked in the
+        refcount-0 reusable pool still serve reads (the fleet prefix-cache
+        pull server looks blocks up here; callers run on the engine thread,
+        so lookup and the subsequent gather dispatch are atomic)."""
+        return self._cache.get(seq_hash)
+
     def allocate_sequence(self, seq_id: str, prompt_tokens: list[int]) -> tuple[int, SequencePages]:
         """Allocate pages for a prompt, reusing cached prefix blocks.
 
@@ -217,6 +224,13 @@ class PageAllocator:
                     self._cache[seq_hash] = page
                     self._cache_meta[seq_hash] = meta
                     state.registered_hashes.append(seq_hash)
+                else:
+                    # a host block with no tracked meta just left its LAST
+                    # tier via discard() without re-registering on device:
+                    # advertise the removal so no router ever points a fetch
+                    # at a block this worker no longer holds (the block's
+                    # engine identity IS its chained sequence hash)
+                    self._emit(KvCacheEvent.removed([seq_hash]))
 
             cached_len = (len(device_hits) + restored) * self.page_size
 

@@ -75,6 +75,13 @@ class EngineRequest:
     # filled by AsyncJaxEngine at submission
     enqueue_ts: float = 0.0
     trace_id: Optional[str] = None
+    # fleet-wide prefix cache: the KV router's best remote holder for this
+    # prompt — that worker's pull-server address and its matched prefix
+    # length in blocks. When the holder's advantage over the local prefix
+    # cache clears prefix_fetch_min_blocks, admission pulls the pages over
+    # the dataplane (FETCHING_KV) instead of recomputing them.
+    kv_holder_addr: str = ""
+    kv_holder_blocks: int = 0
 
 
 @dataclass
@@ -108,6 +115,10 @@ class RunningSeq:
     # switches mid-stream between the sync (materialized) and dispatch-ahead
     # (scheduled) position-tracking regimes.
     spec_mode: bool = False
+    # FETCHING_KV: an in-flight remote-prefix pull (_PrefixFetch). While set,
+    # no prefill chunk dispatches for this sequence; resolution either
+    # advances prefill_pos past the pulled prefix or falls back to recompute.
+    fetch: Optional["_PrefixFetch"] = None
 
     @property
     def pos(self) -> int:
@@ -118,6 +129,18 @@ class RunningSeq:
     def next_fed_pos(self) -> int:
         """Position where the next scheduled window's first KV write lands."""
         return self.prompt_len + self.sched_len - 1
+
+
+@dataclass
+class _PrefixFetch:
+    """Handle for one sequence's FETCHING_KV wait."""
+
+    fut: object  # concurrent.futures.Future[PrefixFetchResult]
+    base_block: int  # first requested block's index in the sequence
+    t0: float
+    # belt over the client's own wait_for: if the fetcher's loop dies and the
+    # future never resolves, the scheduler still unwedges admission here
+    belt_deadline: float
 
 
 @dataclass
@@ -256,6 +279,13 @@ def _stage_histograms() -> dict[str, Histogram]:
             "host time blocked waiting on in-flight device results",
             _STAGE_BUCKETS,
         ),
+        # fleet prefix cache: admission -> pulled-prefix-scattered (or
+        # fallback) per remote fetch; the FETCHING_KV dwell time
+        "prefix_fetch": Histogram(
+            "dynamo_prefix_fetch_seconds",
+            "remote prefix pull wall time, fetch start to scatter/fallback",
+            _WAIT_BUCKETS,
+        ),
         # per-round acceptance: how many draft tokens each participating
         # request had accepted in one speculative verify round (0 = only the
         # correction token advanced; k = the whole proposal held)
@@ -296,6 +326,15 @@ class Scheduler:
         # in, <= k token ids out). None when --speculative is unset.
         self.spec = config.spec
         self.proposer = make_proposer(self.spec) if self.spec is not None else None
+        # fleet-wide prefix cache: the pull client (disagg/prefix_fetch.py
+        # PrefixFetchClient) the worker attaches; None = fetch disabled and
+        # kv_holder hints on requests are ignored
+        self.prefix_fetcher = None
+        self.prefix_fetch_hits = 0  # fetches that landed >= 1 remote block
+        self.prefix_fetch_fallbacks = 0  # timeout/gone/error -> recompute
+        self.prefix_fetch_blocks = 0  # blocks pulled and scattered
+        self.prefix_fetch_bytes = 0  # payload bytes pulled (wire KV dtype)
+        self.prefix_fetch_tokens = 0  # prompt tokens whose recompute was skipped
 
     # ---------------- queue ----------------
 
@@ -354,13 +393,19 @@ class Scheduler:
         outputs: list[StepOutput] = []
         outputs.extend(self._reconcile(block=False))
         outputs.extend(self._admit())
-        dispatched = self._dispatch_prefill_batches(outputs)
+        dispatched = self._poll_fetches(outputs)
+        dispatched += self._dispatch_prefill_batches(outputs)
         if self.spec is not None:
             dispatched += self._dispatch_spec_round(outputs)
         dispatched += self._dispatch_windows(outputs)
         pipeline_full = self._windows_in_flight() >= max(1, self.config.pipeline_depth)
         if pipeline_full or (self.in_flight and not dispatched and not outputs):
             outputs.extend(self._reconcile(block=True))
+        elif not outputs and not dispatched and not self.in_flight and self._fetching():
+            # FETCHING_KV is the only live work: the remote pull resolves on
+            # another thread's event loop, so don't hot-spin the engine loop
+            # while waiting for it
+            time.sleep(0.001)
         return outputs
 
     def _windows_in_flight(self) -> int:
@@ -479,12 +524,22 @@ class Scheduler:
         )
         self._admit_counter += 1
 
+        fetch = self._maybe_start_fetch(req, cached_len, prompt_len)
         if self.runner.packed_prefill_mode and not req.images:
             # packed path: per-request prep now, chunk dispatch deferred to
             # _dispatch_prefill_batches so chunks of DIFFERENT sequences can
             # share one weight pass
             self._prep_prefill(req, slot, prompt_len)
             seq.prefill_pos = cached_len
+            seq.fetch = fetch
+            self.slots[slot] = seq
+            return
+        if fetch is not None:
+            # FETCHING_KV on the per-request path: hold the chunk dispatch
+            # until the pull resolves (hit -> prefill only the tail past the
+            # pulled prefix; miss -> prefill from cached_len as if no holder)
+            seq.prefill_pos = cached_len
+            seq.fetch = fetch
             self.slots[slot] = seq
             return
 
@@ -499,6 +554,167 @@ class Scheduler:
         self.in_flight.append(
             _InFlight(kind="first", dev=tok_dev, seqs=[seq], cached_len=cached_len, lp=lp)
         )
+
+    # ---------------- fleet-wide prefix fetch (FETCHING_KV) ----------------
+
+    def _maybe_start_fetch(
+        self, req: EngineRequest, cached_len: int, prompt_len: int
+    ) -> Optional[_PrefixFetch]:
+        """Kick a remote-prefix pull when the router attached a holder whose
+        matched prefix beats our local cache by >= prefix_fetch_min_blocks.
+        Returns the FETCHING_KV handle, or None (prefill proceeds normally)."""
+        if (
+            self.prefix_fetcher is None
+            or not self.config.prefix_fetch
+            or not req.kv_holder_addr
+            or req.kv_holder_blocks <= 0
+        ):
+            return None
+        ps = self.config.page_size
+        base = cached_len // ps
+        # never consume the entire prompt from cache: the final token must
+        # prefill so the model produces next-token logits (same rule the
+        # local prefix cache applies in allocate_sequence)
+        want_to = min(req.kv_holder_blocks, (prompt_len - 1) // ps)
+        if want_to - base < max(1, self.config.prefix_fetch_min_blocks):
+            return None
+        state = self.allocator._seqs[req.request_id]
+        hashes = [b.sequence_hash for b in state.token_seq.blocks[base:want_to]]
+        if not hashes:
+            return None
+        timeout = self.config.prefix_fetch_timeout_s
+        try:
+            fut = self.prefix_fetcher.fetch(
+                req.kv_holder_addr, hashes, timeout_s=timeout
+            )
+        except Exception:
+            log.exception("prefix fetch start failed for %s", req.request_id)
+            return None
+        now = time.monotonic()
+        log.debug(
+            "prefix fetch for %s: blocks [%d, %d) from %s",
+            req.request_id, base, want_to, req.kv_holder_addr,
+        )
+        return _PrefixFetch(
+            fut=fut, base_block=base, t0=now, belt_deadline=now + timeout + 2.0
+        )
+
+    def _fetching(self) -> bool:
+        return any(
+            s is not None and not s.finished and s.fetch is not None
+            for s in self.slots
+        )
+
+    def _poll_fetches(self, outputs: list[StepOutput]) -> int:
+        """Resolve FETCHING_KV sequences: scatter pulled pages and advance
+        prefill_pos past them on a hit, fall back to recompute on anything
+        else. Returns the number of sequences released (dispatch count for
+        the step loop)."""
+        resolved = 0
+        for seq in list(self.slots):
+            if seq is None or seq.finished or seq.fetch is None:
+                continue
+            f = seq.fetch
+            res = None
+            if f.fut.done():
+                try:
+                    res = f.fut.result()
+                except Exception:
+                    log.exception(
+                        "prefix fetch future failed for %s", seq.req.request_id
+                    )
+            elif time.monotonic() >= f.belt_deadline:
+                # the client's own timeout should have fired long ago — its
+                # loop is gone; a dead fetcher must never wedge admission
+                f.fut.cancel()
+                log.warning(
+                    "prefix fetch for %s missed the belt deadline; recomputing",
+                    seq.req.request_id,
+                )
+            else:
+                continue
+            seq.fetch = None
+            resolved += 1
+            dt = time.monotonic() - f.t0
+            self.stage_hist["prefix_fetch"].observe(dt)
+            applied = 0
+            if res is not None and getattr(res, "status", "") == "hit" and res.blocks:
+                applied = self._scatter_fetched(seq, f, res)
+            if applied:
+                ps = self.config.page_size
+                new_cached = (f.base_block + applied) * ps
+                self.prefix_fetch_hits += 1
+                self.prefix_fetch_blocks += applied
+                self.prefix_fetch_bytes += res.bytes
+                self.prefix_fetch_tokens += max(0, new_cached - seq.prefill_pos)
+                seq.prefill_pos = max(seq.prefill_pos, new_cached)
+                seq.cached_len = max(seq.cached_len, new_cached)
+                tracing.record_span(
+                    "engine.prefix_fetch", f.t0, duration=dt,
+                    request_id=seq.req.request_id, trace_id=seq.req.trace_id,
+                    attrs={"blocks": applied, "bytes": res.bytes,
+                           "holder": seq.req.kv_holder_addr},
+                )
+            else:
+                self.prefix_fetch_fallbacks += 1
+                status = getattr(res, "status", "dead") if res is not None else "dead"
+                log.info(
+                    "prefix fetch for %s fell back to recompute (%s)",
+                    seq.req.request_id, status,
+                )
+            self._resume_after_fetch(seq, outputs)
+        return resolved
+
+    def _scatter_fetched(self, seq: RunningSeq, f: _PrefixFetch, res) -> int:
+        """Inject pulled parts into the sequence's pre-allocated pages.
+        Returns the contiguous block count applied (0 on any failure — the
+        recompute simply overwrites whatever partially landed)."""
+        state = self.allocator._seqs.get(seq.req.request_id)
+        if state is None:
+            return 0
+        try:
+            applied = 0
+            for part in res.parts:
+                if part.block_from != applied:
+                    break  # hole: only the contiguous leading run is cached
+                ids = np.asarray(
+                    state.pages[f.base_block + part.block_from:
+                                f.base_block + part.block_to],
+                    np.int32,
+                )
+                if len(ids) != part.block_to - part.block_from:
+                    break
+                self.runner.inject_pages_bucketed(ids, part.data, axis=part.cat_axis)
+                applied = part.block_to
+            return applied
+        except Exception:
+            log.exception(
+                "scatter of fetched prefix failed for %s; recomputing",
+                seq.req.request_id,
+            )
+            return 0
+
+    def _resume_after_fetch(self, seq: RunningSeq, outputs: list[StepOutput]) -> None:
+        """Release a sequence from FETCHING_KV into its prefill path."""
+        if seq.finished or self.slots[seq.slot] is not seq:
+            return
+        req = seq.req
+        if self.runner.packed_prefill_mode and not req.images:
+            return  # prefill_pos is live again; the packed dispatcher takes over
+        try:
+            result = self._dispatch_prefill_chunks(
+                req, seq.page_table, seq.prefill_pos, seq.prompt_len, slot=seq.slot
+            )
+        except Exception:
+            log.exception("prefill after prefix fetch failed for %s", req.request_id)
+            outputs.extend(self._finish(seq, "error"))
+            return
+        tok_dev, lp = result if isinstance(result, tuple) else (result, None)
+        self.allocator.commit_prefilled(req.request_id, seq.prompt_len)
+        seq.prefill_pos = None
+        self.in_flight.append(_InFlight(
+            kind="first", dev=tok_dev, seqs=[seq], cached_len=seq.cached_len, lp=lp
+        ))
 
     def _dispatch_prefill_batches(self, outputs: list[StepOutput]) -> int:
         """Pack pending prefill chunks of distinct sequences into shared
@@ -525,7 +741,8 @@ class Scheduler:
                 return count
             pending = sorted(
                 (s for s in self.slots
-                 if s is not None and not s.finished and s.prefill_pos is not None),
+                 if s is not None and not s.finished and s.prefill_pos is not None
+                 and s.fetch is None),  # FETCHING_KV: hold until the pull resolves
                 key=lambda s: s.admitted_order,
             )
             if not pending:
@@ -1204,8 +1421,21 @@ class Scheduler:
         self._release(seq)
         return [StepOutput(seq.req.request_id, finished=True, finish_reason=reason)]
 
+    def _cancel_fetch(self, seq: RunningSeq) -> None:
+        """Drop an in-flight remote-prefix pull. The fetch coroutine only
+        RETURNS data (the scatter happens in _poll_fetches, which skips
+        finished/evicted sequences), so cancelling here can never leave a
+        write racing the pages' next owner."""
+        if seq.fetch is not None:
+            try:
+                seq.fetch.fut.cancel()
+            except Exception:
+                pass
+            seq.fetch = None
+
     def _release(self, seq: RunningSeq, count_finished: bool = True) -> None:
         seq.finished = True
+        self._cancel_fetch(seq)
         self.allocator.free_sequence(seq.req.request_id)
         if seq.slot >= 0 and self.slots[seq.slot] is seq:
             self.slots[seq.slot] = None
@@ -1227,6 +1457,7 @@ class Scheduler:
         log.info("preempting %s (page pressure)", seq.req.request_id)
         self.preempt_count += 1
         seq.finished = True  # stray in-flight snapshots must skip it
+        self._cancel_fetch(seq)
         self.allocator.free_sequence(seq.req.request_id)
         if seq.slot >= 0 and self.slots[seq.slot] is seq:
             self.slots[seq.slot] = None
@@ -1256,5 +1487,10 @@ class Scheduler:
                 min_tokens=max(0, seq.req.sampling.min_tokens - len(seq.generated)),
             ),
             eos_token_ids=seq.req.eos_token_ids,
+            # the holder hint survives preemption: the matched prefix is a
+            # prefix of the UNCHANGED original prompt, and if our own cache
+            # kept the pages the min-advantage gate skips the re-fetch anyway
+            kv_holder_addr=seq.req.kv_holder_addr,
+            kv_holder_blocks=seq.req.kv_holder_blocks,
         )
         self.waiting.appendleft(new_req)

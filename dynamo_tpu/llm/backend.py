@@ -88,6 +88,8 @@ class Backend:
             eos_token_ids=eos_ids,
             images=list(request.images),
             logprobs=request.logprobs,
+            kv_holder_addr=getattr(request, "kv_holder_addr", ""),
+            kv_holder_blocks=getattr(request, "kv_holder_blocks", 0),
         )
         decoder = DecodeStream(
             self.tokenizer,

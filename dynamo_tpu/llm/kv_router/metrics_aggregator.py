@@ -155,6 +155,15 @@ class KvMetricsAggregator:
             (v.instance_id, v.data) for v in self._workers.values() if v.servable
         ]
 
+    def raw_for(self, instance_id: int) -> Optional[dict]:
+        """One servable worker's full stats payload (e.g. its ``kv_pull``
+        advertisement for the fleet prefix cache); None when unknown or
+        draining/dead — a fetch must never target a worker routing skips."""
+        view = self._workers.get(instance_id)
+        if view is None or not view.servable:
+            return None
+        return view.data
+
     def worker_views(self) -> list[WorkerView]:
         """Every tracked worker including stale ones — the ``/cluster/status``
         source (status surfaces must SHOW a dying worker, not hide it)."""

@@ -12,9 +12,10 @@ from __future__ import annotations
 import asyncio
 from typing import Optional, Sequence
 
-from dynamo_tpu.llm.kv_router.indexer import KvIndexer, RouterEvent
+from dynamo_tpu.llm.kv_router.indexer import KvIndexer, OverlapScores, RouterEvent
 from dynamo_tpu.llm.kv_router.metrics_aggregator import KvMetricsAggregator
 from dynamo_tpu.llm.kv_router.scheduler import KVHitRateEvent, KvScheduler, WorkerLoad
+from dynamo_tpu.llm.tokens import compute_block_hash
 from dynamo_tpu.runtime.component import INSTANCE_PREFIX
 from dynamo_tpu.utils import get_logger
 
@@ -44,6 +45,11 @@ class KvRouter:
         self.aggregator.on_update(self.scheduler.update_endpoints)
         self._watcher = None
         self._watch_task: Optional[asyncio.Task] = None
+        # one-entry overlap memo: schedule() and the callers that want the
+        # same prompt's prefix-hit/remote-holder view right after it used to
+        # each walk the radix tree again — cache the OverlapScores keyed by a
+        # cheap prompt fingerprint and reuse it
+        self._last_overlap: Optional[tuple[tuple[int, int], OverlapScores]] = None
 
     # ---------------- lifecycle ----------------
 
@@ -72,6 +78,8 @@ class KvRouter:
     def _on_kv_event(self, msg: dict) -> None:
         try:
             self.indexer.apply_event(RouterEvent.from_wire(msg["payload"]))
+            # the tree changed: the overlap memo is only exact while it hasn't
+            self._last_overlap = None
         except Exception:
             log.exception("bad kv event")
 
@@ -82,6 +90,7 @@ class KvRouter:
                     worker_id = int(ev.key.rsplit(":", 1)[1], 16)
                     log.info("worker %x gone; pruning index", worker_id)
                     self.indexer.remove_worker(worker_id)
+                    self._last_overlap = None
         except asyncio.CancelledError:
             pass
 
@@ -99,14 +108,77 @@ class KvRouter:
 
     # ---------------- scheduling ----------------
 
+    @staticmethod
+    def _overlap_key(token_ids: Sequence[int]) -> tuple[int, int]:
+        return (len(token_ids), compute_block_hash(token_ids))
+
+    def _find_overlap(self, token_ids: Sequence[int]) -> OverlapScores:
+        """Radix walk with a one-entry memo: back-to-back calls for the same
+        prompt (schedule -> prefix_hit_tokens / remote-holder selection)
+        reuse ONE tree walk instead of recomputing it."""
+        key = self._overlap_key(token_ids)
+        if self._last_overlap is not None and self._last_overlap[0] == key:
+            return self._last_overlap[1]
+        overlap = self.indexer.find_matches_for_request(token_ids)
+        self._last_overlap = (key, overlap)
+        return overlap
+
     async def schedule(self, token_ids: Sequence[int]) -> int:
         """Pick the best worker for these prompt tokens
         (reference: kv_router.rs:131 schedule)."""
-        overlap = self.indexer.find_matches_for_request(token_ids)
+        worker_id, _ = await self.schedule_with_overlap(token_ids)
+        return worker_id
+
+    async def schedule_with_overlap(
+        self, token_ids: Sequence[int]
+    ) -> tuple[int, OverlapScores]:
+        """schedule() that also returns the OverlapScores the decision used,
+        so callers can derive prefix-hit and remote-holder metadata without a
+        second radix walk."""
+        overlap = self._find_overlap(token_ids)
         if not self.scheduler.endpoints.workers:
             await self.aggregator.scrape_once()
-        return self.scheduler.schedule(len(token_ids), overlap)
+        return self.scheduler.schedule(len(token_ids), overlap), overlap
 
-    def prefix_hit_tokens(self, token_ids: Sequence[int], worker_id: int) -> int:
-        overlap = self.indexer.find_matches_for_request(token_ids)
+    def prefix_hit_tokens(
+        self,
+        token_ids: Sequence[int],
+        worker_id: int,
+        overlap: Optional[OverlapScores] = None,
+    ) -> int:
+        overlap = overlap if overlap is not None else self._find_overlap(token_ids)
         return overlap.scores.get(worker_id, 0) * self.kv_block_size
+
+    # ---------------- fleet-wide prefix cache ----------------
+
+    def best_remote_holder(
+        self,
+        overlap: OverlapScores,
+        chosen_worker: int,
+        min_advantage_blocks: int = 1,
+    ) -> Optional[tuple[int, int]]:
+        """The peer whose cached prefix most exceeds the chosen worker's —
+        the pull target for a placement miss. Returns (holder_worker_id,
+        holder_blocks) or None when no peer clears the advantage bar."""
+        local = overlap.scores.get(chosen_worker, 0)
+        best: Optional[tuple[int, int]] = None
+        for wid, blocks in overlap.scores.items():
+            if wid == chosen_worker:
+                continue
+            if best is None or blocks > best[1]:
+                best = (wid, blocks)
+        if best is None or best[1] - local < max(1, min_advantage_blocks):
+            return None
+        return best
+
+    def pull_address(self, worker_id: int) -> str:
+        """The holder's KV pull-server address, from its stats broadcast
+        (workers advertise it under ``kv_pull.address``). Empty when the
+        worker is unknown, unservable, or runs without a pull server."""
+        data = self.aggregator.raw_for(worker_id)
+        if not data:
+            return ""
+        kv_pull = data.get("kv_pull")
+        if not isinstance(kv_pull, dict):
+            return ""
+        return str(kv_pull.get("address", "") or "")

@@ -32,6 +32,11 @@ class PreprocessedRequest:
     logprobs: Optional[int] = None
     # output option: detokenize with special tokens hidden (default) or kept
     skip_special_tokens: bool = True
+    # fleet-wide prefix cache: the KV router's best remote prefix holder for
+    # this prompt (pull-server address + matched blocks), attached by the
+    # processor when a peer's cached prefix beats the routed worker's
+    kv_holder_addr: str = ""
+    kv_holder_blocks: int = 0
 
     def to_wire(self) -> dict:
         out = {
@@ -57,6 +62,9 @@ class PreprocessedRequest:
             "logprobs": self.logprobs,
             "skip_special_tokens": self.skip_special_tokens,
         }
+        if self.kv_holder_addr:
+            out["kv_holder_addr"] = self.kv_holder_addr
+            out["kv_holder_blocks"] = self.kv_holder_blocks
         if self.images:
             out["images"] = [im.to_wire() for im in self.images]
         return out
@@ -73,6 +81,8 @@ class PreprocessedRequest:
             images=images,
             logprobs=d.get("logprobs"),
             skip_special_tokens=d.get("skip_special_tokens", True),
+            kv_holder_addr=d.get("kv_holder_addr", ""),
+            kv_holder_blocks=int(d.get("kv_holder_blocks", 0) or 0),
             request_id=d["request_id"],
             token_ids=list(d["token_ids"]),
             sampling=SamplingParams(

@@ -300,6 +300,20 @@ def _sample_surfaces() -> list[tuple[str, str]]:
     surfaces.append(("disagg.dataplane.server", KvDataPlaneServer().render_metrics()))
     surfaces.append(("disagg.dataplane.client", KvDataPlaneClient(lanes=2).render_metrics()))
 
+    # fleet prefix cache: pull server (export side) + fetch client (requester
+    # wire side); the engine-side dynamo_prefix_fetch_* counters/histogram
+    # ride the engine.render_stage_metrics surface above
+    from dynamo_tpu.disagg.prefix_fetch import KvPullServer, PrefixFetchClient
+
+    pull = KvPullServer(None)
+    pull.served = 2
+    pull.served_blocks["hbm"] = 8
+    surfaces.append(("disagg.prefix_fetch.server", pull.render_metrics()))
+    pf = PrefixFetchClient(None)
+    pf.results["hit"] = 1
+    pf.fetch_seconds.observe(0.02)
+    surfaces.append(("disagg.prefix_fetch.client", pf.render_metrics()))
+
     class _Eng:
         config = None
 

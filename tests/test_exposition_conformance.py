@@ -41,6 +41,8 @@ def test_surfaces_cover_every_layer():
         "engine.render_stage_metrics",
         "disagg.dataplane.server",
         "disagg.dataplane.client",
+        "disagg.prefix_fetch.server",
+        "disagg.prefix_fetch.client",
         "disagg.prefill_worker",
         "components.metrics",
     ):
@@ -56,6 +58,20 @@ def test_engine_surface_carries_kv_dtype_bytes_gauges():
     assert "# TYPE dynamo_engine_kv_cache_bytes gauge" in text
     assert "# TYPE dynamo_engine_kv_cache_page_bytes gauge" in text
     assert 'dynamo_engine_kv_cache_page_bytes{dtype="' in text
+
+
+def test_engine_surface_carries_prefix_fetch_families():
+    """The fleet-prefix-cache requester families must stay on the
+    conformance-checked engine surface: pull outcomes, pulled blocks/bytes/
+    tokens, and the FETCHING_KV dwell histogram."""
+    text = dict(_SURFACES)["engine.render_stage_metrics"]
+    assert "# TYPE dynamo_prefix_fetch_requests_total counter" in text
+    assert 'dynamo_prefix_fetch_requests_total{result="hit"}' in text
+    assert 'dynamo_prefix_fetch_requests_total{result="fallback"}' in text
+    assert "# TYPE dynamo_prefix_fetch_blocks_total counter" in text
+    assert "# TYPE dynamo_prefix_fetch_bytes_total counter" in text
+    assert "# TYPE dynamo_prefix_fetch_tokens_total counter" in text
+    assert "# TYPE dynamo_prefix_fetch_seconds histogram" in text
 
 
 def test_colocated_composition_has_no_family_collisions():

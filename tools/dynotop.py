@@ -68,8 +68,8 @@ def render_status(doc: dict) -> str:
     ]
     header = (
         f"{'WORKER':<12} {'STATE':<10} {'HB':>6} {'SEEN':>6} {'MISS':>4} "
-        f"{'SLOTS':>7} {'KV%':>6} {'KVMEM':>11} {'WAIT':>5} {'HBM':>9} "
-        f"{'CMPL':>5}  SLO"
+        f"{'SLOTS':>7} {'KV%':>6} {'KVMEM':>11} {'PREFIX':>9} {'WAIT':>5} "
+        f"{'HBM':>9} {'CMPL':>5}  SLO"
     )
     lines.append(header)
     lines.append("-" * len(header))
@@ -93,13 +93,24 @@ def render_status(doc: dict) -> str:
             f"{_fmt_bytes(kv_used)}:{dt[:4]}" if kv_used is not None and dt
             else (_fmt_bytes(kv_used) if kv_used is not None else "-")
         )
+        # prefix-cache effectiveness, local vs remote: % of queried blocks
+        # served by this worker's own cache vs pulled off fleet peers
+        # (prefix_fetch_* counters ride resource_snapshot since the
+        # fleet-wide prefix cache; older workers show "-")
+        q = res.get("prefix_cache_query_blocks", 0)
+        if q:
+            lpct = 100.0 * res.get("prefix_cache_hit_blocks", 0) / q
+            rpct = 100.0 * res.get("prefix_fetch_blocks", 0) / q
+            prefix = f"{lpct:.0f}/{rpct:.0f}%"
+        else:
+            prefix = "-"
         hb = health.get("heartbeat_age_s")
         stale_mark = " STALE" if w.get("stale") else ""
         lines.append(
             f"{w.get('worker_id', '?'):<12} {glyph} {state:<8} "
             f"{(f'{hb:.1f}s' if hb is not None else '-'):>6} "
             f"{w.get('last_seen_s', 0):>5.1f}s {w.get('missed_scrapes', 0):>4} "
-            f"{slots:>7} {kv_pct:>5.1f}% {kv_mem:>11} "
+            f"{slots:>7} {kv_pct:>5.1f}% {kv_mem:>11} {prefix:>9} "
             f"{kv.get('num_requests_waiting', 0):>5} "
             f"{_fmt_bytes(res.get('hbm_bytes_in_use', 0)):>9} "
             f"{res.get('xla_compiles', 0):>5}  {_slo_cell(w.get('slo'))}"
