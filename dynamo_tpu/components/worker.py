@@ -243,6 +243,12 @@ async def _main(args) -> None:
             prefix_fetch_min_blocks=getattr(args, "prefix_fetch_min_blocks", None) or 1,
             slo_ttft_ms=getattr(args, "slo_ttft_ms", None),
             slo_itl_ms=getattr(args, "slo_itl_ms", None),
+            prefill_buckets=tuple(
+                int(b) for b in getattr(args, "prefill_buckets", "").split(",") if b
+            ) or EngineConfig.prefill_buckets,
+            prefill_flat_depth=getattr(args, "prefill_flat_depth", None) or 8192,
+            host_cache_blocks=getattr(args, "host_cache_blocks", None) or 0,
+            offload_watermark=getattr(args, "offload_watermark", None) or 0.90,
         ),
         enable_disagg_decode=args.disagg,
     )
@@ -306,6 +312,22 @@ def main(argv=None) -> None:
     p.add_argument("--prefix-fetch-min-blocks", type=int, default=1,
                    help="minimum holder advantage (blocks) over the local "
                         "prefix cache before a pull is worth issuing")
+    p.add_argument("--prefill-buckets", default="",
+                   help="comma-separated padded prefill chunk lengths (e.g. "
+                        "512,1024,2048 for long-context configs); empty = "
+                        "the engine default")
+    p.add_argument("--prefill-flat-depth", type=int, default=8192,
+                   help="context depth past which the scheduler shrinks "
+                        "prefill chunks to keep per-chunk latency flat "
+                        "(0 disables)")
+    p.add_argument("--host-cache-blocks", type=int, default=0,
+                   help="host-DRAM KV offload tier capacity in blocks "
+                        "(0 disables; long-context cold KV drains here "
+                        "under page pressure)")
+    p.add_argument("--offload-watermark", type=float, default=0.90,
+                   help="page-pool occupancy fraction that triggers the "
+                        "batched cold-block drain to the host tier "
+                        "(>= 1.0 disables the proactive drain)")
     args = p.parse_args(argv)
     asyncio.run(_main(args))
 

@@ -16,6 +16,26 @@ class EngineConfig:
     max_seqs: int = 8  # decode batch slots
     max_model_len: int = 2048
     prefill_buckets: tuple = (64, 128, 256, 512)  # padded prefill chunk lengths
+    # long context — page-table width ladder (in PAGES). Every dispatch used
+    # to pad page tables to the dense max_pages_per_seq width; at 128K/page 16
+    # that is 8192 entries of H2D + gather per call even for a 200-token
+    # chat. With a ladder, each sequence's table is padded only to its
+    # current pow2 bucket, so short sequences keep their narrow traces and
+    # only deep sequences pay wide gathers (one jit variant per width,
+    # compiled via the warmup machinery). () = auto: min(128,
+    # max_pages_per_seq) doubling up to max_pages_per_seq — a single width
+    # (the pre-ladder behavior) whenever max_pages_per_seq <= 128.
+    page_table_buckets: tuple = ()
+    # depth-aware chunked prefill: a chunk's attention work scales with
+    # chunk_len * context_depth, so fixed-size chunks get linearly slower as
+    # prefill advances into a long prompt — starving colocated decode windows
+    # and bloating per-chunk latency. The planner shrinks the chunk bucket
+    # once depth * chunk would exceed max_prefill_chunk * prefill_flat_depth
+    # (keeping per-chunk work roughly flat past that point, floored at the
+    # smallest bucket). The default holds full-size chunks through the first
+    # ~8K of context, so short-context configs chunk exactly as before.
+    # 0 disables (always max_prefill_chunk).
+    prefill_flat_depth: int = 8192
     tp: int = 1  # tensor-parallel degree over the mesh
     # sequence-parallel degree: >1 runs whole-prompt prefill as ring attention
     # over an "sp" mesh axis (long-context path; decode is unaffected).
@@ -90,6 +110,15 @@ class EngineConfig:
     watermark: float = 0.05
     # host-DRAM KV offload tier capacity in blocks (0 = disabled)
     host_cache_blocks: int = 0
+    # pressure-driven host offload (host_cache_blocks > 0 only): once page-
+    # pool occupancy crosses this fraction, the scheduler proactively drains
+    # the coldest refcount-0 cached blocks to the host tier in BATCHED saves
+    # (one device gather per batch) — keeping the free list ahead of decode
+    # growth so long-running sequences hit batched restores instead of
+    # per-block reclaim round trips or whole-sequence preempt+recompute.
+    # >= 1.0 disables the proactive drain (reclaim still batches on demand).
+    offload_watermark: float = 0.90
+    offload_drain_batch: int = 32
     # decode steps fused into one device call (lax.scan over steps with the
     # sampled-token feedback kept on device); amortizes dispatch + host<->device
     # transfer overhead. 1 = classic one-step decode. Streaming granularity and
@@ -156,6 +185,14 @@ class EngineConfig:
                 # the stage-sharded pool split (parallel/pipeline.py) has no
                 # QuantizedPages wiring yet; fail at config time
                 raise ValueError("kv_cache_dtype='int8' does not compose with pp > 1 yet")
+        if self.offload_drain_batch < 1:
+            raise ValueError(
+                f"offload_drain_batch must be >= 1; got {self.offload_drain_batch}"
+            )
+        if any(b <= 0 for b in self.page_table_buckets):
+            raise ValueError(
+                f"page_table_buckets must be positive; got {self.page_table_buckets}"
+            )
         # a bad speculative spec must fail at config time, not mid-serving
         self.spec  # noqa: B018 — parse_speculative raises on invalid input
 
@@ -175,8 +212,53 @@ class EngineConfig:
         return -(-self.max_model_len // self.page_size)
 
     @property
+    def table_buckets(self) -> tuple:
+        """Resolved page-table width ladder (ascending, last ==
+        max_pages_per_seq). Explicit ``page_table_buckets`` entries clamp to
+        the dense width; auto mode doubles from min(128, max_pages_per_seq),
+        which degenerates to the single dense width for short contexts."""
+        mp = self.max_pages_per_seq
+        if self.page_table_buckets:
+            ladder = sorted({min(int(b), mp) for b in self.page_table_buckets if b > 0})
+            if not ladder or ladder[-1] != mp:
+                ladder.append(mp)
+            return tuple(ladder)
+        widths = []
+        w = min(128, mp)
+        while w < mp:
+            widths.append(w)
+            w *= 2
+        widths.append(mp)
+        return tuple(widths)
+
+    def table_bucket_for(self, n_pages: int) -> int:
+        """Smallest ladder width holding ``n_pages`` page-table entries."""
+        for w in self.table_buckets:
+            if n_pages <= w:
+                return w
+        raise ValueError(
+            f"{n_pages} pages exceed max_pages_per_seq {self.max_pages_per_seq}"
+        )
+
+    @property
     def max_prefill_chunk(self) -> int:
         return max(self.prefill_buckets)
+
+    def chunk_len_for(self, depth: int) -> int:
+        """Depth-aware prefill chunk bucket for a chunk starting at context
+        ``depth`` tokens: the largest bucket b with b * (depth + b) within
+        the flat-depth work budget, floored at the smallest bucket — so
+        per-chunk latency stays roughly flat as prefill advances into a long
+        prompt instead of growing linearly with context."""
+        top = self.max_prefill_chunk
+        if self.prefill_flat_depth <= 0:
+            return top
+        budget = top * max(self.prefill_flat_depth, top)
+        best = min(self.prefill_buckets)
+        for b in self.prefill_buckets:
+            if b * (depth + b) <= budget:
+                best = max(best, b)
+        return best
 
     def lanes_for(self, bucket: int) -> int:
         """Packed-prefill lane count for a bucket: bounded by prefill_lanes

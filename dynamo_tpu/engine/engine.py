@@ -526,7 +526,11 @@ class AsyncJaxEngine:
         return None, outputs  # (value, stream outputs) convention
 
     def _page_table_for(self, state) -> "np.ndarray":
-        page_table = np.zeros(self.config.max_pages_per_seq, np.int32)
+        # sized to the sequence's ladder rung, not the dense width — the
+        # remote-prefill path dispatches the same bucketed traces the local
+        # scheduler does
+        width = self.config.table_bucket_for(max(1, len(state.pages)))
+        page_table = np.zeros(width, np.int32)
         page_table[: len(state.pages)] = state.pages
         return page_table
 
@@ -588,6 +592,16 @@ class AsyncJaxEngine:
             "prefix_fetch_tokens": sched.prefix_fetch_tokens,
             "preemptions": sched.preempt_count,
             "pressure_drains": sched.pressure_drain_count,
+            # long-context: table-width ladder + depth-aware chunking +
+            # watermark-driven cold-KV drain (str keys: JSON-safe on the wire)
+            "context_table_promotions": sched.table_promotions,
+            "context_table_dispatches": {
+                str(w): n for w, n in sorted(sched.table_dispatches.items())
+            },
+            "context_chunk_dispatches": {
+                str(b): n for b, n in sorted(sched.chunk_dispatches.items())
+            },
+            "offload_pressure_blocks": sched.offload_pressure_blocks,
             "requests_waiting": len(sched.waiting),
             "oldest_waiting_age_s": round(sched.oldest_waiting_age(), 3),
             "engine_steps": self.step_count,
@@ -733,6 +747,39 @@ class AsyncJaxEngine:
                 "dynamo_engine_pressure_drains_total", "counter",
                 "pipeline drains forced by ensure_capacity misses",
                 [({}, r["pressure_drains"])],
+            ),
+            # long-context families: the page-table width ladder (dispatches
+            # by width + mid-flight rung promotions), depth-aware prefill
+            # chunk buckets, and the watermark-driven cold-KV host drain
+            render_family(
+                "dynamo_engine_context_table_dispatch_total", "counter",
+                "engine dispatches by page-table width (the pow2 ladder "
+                "rung the call's widest sequence needed)",
+                [({"width": w}, n)
+                 for w, n in sorted(r["context_table_dispatches"].items(),
+                                    key=lambda kv: int(kv[0]))]
+                or [({"width": str(self.config.table_buckets[0])}, 0)],
+            ),
+            render_family(
+                "dynamo_engine_context_table_promotions_total", "counter",
+                "sequences promoted to a wider page-table ladder rung "
+                "mid-flight (decode growth past their current width)",
+                [({}, r["context_table_promotions"])],
+            ),
+            render_family(
+                "dynamo_engine_context_chunk_total", "counter",
+                "prefill chunks by padded bucket length (the depth-aware "
+                "planner shrinks chunks as context deepens)",
+                [({"len": b}, n)
+                 for b, n in sorted(r["context_chunk_dispatches"].items(),
+                                    key=lambda kv: int(kv[0]))]
+                or [({"len": str(min(self.config.prefill_buckets))}, 0)],
+            ),
+            render_family(
+                "dynamo_engine_offload_pressure_blocks_total", "counter",
+                "cold refcount-0 KV blocks drained to the host tier by the "
+                "occupancy-watermark pressure path (batched gathers)",
+                [({}, r["offload_pressure_blocks"])],
             ),
             render_family(
                 "dynamo_engine_hbm_bytes", "gauge",

@@ -230,13 +230,13 @@ class ModelRunner:
 
         self._prefill = _mjit("prefill", jax.jit(
             self._prefill_impl, donate_argnums=(1, 2),
-            static_argnames=("want_lp", "want_pen", "want_seed", "want_eos_mask"),
+            static_argnames=("want_lp", "want_pen", "want_seed", "want_eos_mask", "mp"),
         ))
         # cross-request packed prefill (one weight pass for N lanes); one
-        # executable per (N, bucket) actually used
+        # executable per (N, bucket, table width) actually used
         self._prefill_packed = _mjit("prefill_packed", jax.jit(
             self._prefill_packed_impl, donate_argnums=(1, 2),
-            static_argnames=("want_lp", "want_pen", "want_seed", "want_eos_mask"),
+            static_argnames=("want_lp", "want_pen", "want_seed", "want_eos_mask", "mp"),
         ))
         # multimodal vision encode (compiled lazily; text-only models never
         # pay for it — the mm prefill variant is _prefill traced with embeds)
@@ -249,7 +249,7 @@ class ModelRunner:
             # sequence-parallel whole-prompt prefill (ring attention over sp)
             self._prefill_sp = _mjit("prefill_sp", jax.jit(
                 self._prefill_sp_impl, donate_argnums=(1, 2),
-                static_argnames=("want_lp", "want_pen", "want_seed", "want_eos_mask"),
+                static_argnames=("want_lp", "want_pen", "want_seed", "want_eos_mask", "mp"),
             ))
         self._decode_window = _mjit("decode_window", jax.jit(
             self._decode_window_impl, donate_argnums=(1, 2),
@@ -323,8 +323,8 @@ class ModelRunner:
             params, kv, tokens, positions, page_tables, active, rope_deltas=rope_deltas
         )
 
-    def _prefill_impl(self, params, kv, slot_state, ints, flts, key, embeds=None, emask=None, rope_pos=None, want_lp=False, want_pen=False, want_seed=False, want_eos_mask=False):
-        """ints [bucket + max_pages + 5 + MAX_EOS_IDS] = token buf, page
+    def _prefill_impl(self, params, kv, slot_state, ints, flts, key, embeds=None, emask=None, rope_pos=None, want_lp=False, want_pen=False, want_seed=False, want_eos_mask=False, mp=None):
+        """ints [bucket + mp + 5 + MAX_EOS_IDS] = token buf, page
         table, (start_pos, n_real, top_k, slot, seed), then the request's EOS
         ids (V-padded); flts [6] = (temperature, top_p, min_p, presence,
         frequency, repetition). Positions and the valid mask derive on device
@@ -332,11 +332,14 @@ class ModelRunner:
         ``slot_state["tokens"][slot]`` (slot >= max_seqs drops the write) so a
         following decode window can consume it without any host round trip.
 
+        ``mp`` is the page-table width this trace is compiled for — a rung
+        of the config's table-width ladder, not the dense max_pages_per_seq.
         Multimodal chunks pass ``embeds`` [bucket, D] + ``emask`` [bucket];
         want_lp/want_pen/want_seed/want_eos_mask gate logprobs, penalties,
         seeded streams, and min_tokens EOS suppression out of the default
         trace."""
-        mp = self.config.max_pages_per_seq
+        if mp is None:
+            mp = self.config.max_pages_per_seq
         bucket = ints.shape[0] - mp - 5 - MAX_EOS_IDS
         tokens = ints[:bucket]
         page_table = ints[bucket : bucket + mp]
@@ -398,13 +401,15 @@ class ModelRunner:
             slot_state = dict(slot_state, counts=counts, seen=seen)
         return tok, lp, slot_state
 
-    def _prefill_packed_impl(self, params, kv, slot_state, ints, flts, key, want_lp=False, want_pen=False, want_seed=False, want_eos_mask=False):
-        """Cross-request packed prefill: ints [N, bucket + max_pages + 5 +
+    def _prefill_packed_impl(self, params, kv, slot_state, ints, flts, key, want_lp=False, want_pen=False, want_seed=False, want_eos_mask=False, mp=None):
+        """Cross-request packed prefill: ints [N, bucket + mp + 5 +
         MAX_EOS_IDS] — N lanes of the SAME per-lane row layout as
-        _prefill_impl; flts [6, N]. Every lane's last-row logits are sampled
+        _prefill_impl (``mp`` = the call's ladder table width); flts [6, N].
+        Every lane's last-row logits are sampled
         ([N] tokens); the host ignores tokens of lanes that weren't a final
         chunk (their slot is out-of-range so the feedback write drops too)."""
-        mp = self.config.max_pages_per_seq
+        if mp is None:
+            mp = self.config.max_pages_per_seq
         N = ints.shape[0]
         bucket = ints.shape[1] - mp - 5 - MAX_EOS_IDS
         tokens = ints[:, :bucket]
@@ -463,11 +468,15 @@ class ModelRunner:
         sequences (pad lanes are all-invalid). Returns the [N] device token
         array (async copy started) — callers read only final-chunk lanes —
         plus the logprob arrays when requested."""
-        mp = self.config.max_pages_per_seq
         V = self.model.config.vocab_size
         bucket = self.config.bucket_for(max(len(l[0]) for l in lanes))
+        # table width for THIS call: the widest lane's ladder bucket (narrow
+        # lanes zero-pad into the trash page) — short packs keep their
+        # narrow executable; only packs containing a deep sequence go wide
+        mp = self.config.table_bucket_for(max(len(l[2]) for l in lanes))
         ints = np.full((N, bucket + mp + 5 + MAX_EOS_IDS), V, np.int32)
         ints[:, :bucket] = 0
+        ints[:, bucket : bucket + mp] = 0
         flts = np.zeros((6, N), np.float32)
         flts[1] = 1.0  # top_p neutral
         flts[5] = 1.0  # repetition neutral
@@ -475,7 +484,7 @@ class ModelRunner:
         for j, (tokens, start_pos, page_table, slot, sampling, eos_ids, is_final) in enumerate(lanes):
             n = len(tokens)
             ints[j, :n] = tokens
-            ints[j, bucket : bucket + mp] = page_table[:mp]
+            ints[j, bucket : bucket + len(page_table[:mp])] = page_table[:mp]
             ints[j, bucket + mp] = start_pos
             ints[j, bucket + mp + 1] = n
             ints[j, bucket + mp + 2] = sampling.top_k
@@ -522,6 +531,7 @@ class ModelRunner:
             want_pen=want_extras,
             want_seed=want_extras,
             want_eos_mask=want_extras,
+            mp=mp,
         )
         try:
             toks.copy_to_host_async()
@@ -532,11 +542,12 @@ class ModelRunner:
             pass
         return (toks, lp) if want_logprobs else toks
 
-    def _prefill_sp_impl(self, params, kv, slot_state, ints, flts, key, want_lp=False, want_pen=False, want_seed=False, want_eos_mask=False):
+    def _prefill_sp_impl(self, params, kv, slot_state, ints, flts, key, want_lp=False, want_pen=False, want_seed=False, want_eos_mask=False, mp=None):
         """Same packed-ints contract as _prefill_impl, but the whole-prompt
         chunk runs sequence-parallel (model.prefill_sp: ring attention over
         the sp mesh axis). Only called with start_pos == 0."""
-        mp = self.config.max_pages_per_seq
+        if mp is None:
+            mp = self.config.max_pages_per_seq
         bucket = ints.shape[0] - mp - 5 - MAX_EOS_IDS
         tokens = ints[:bucket]
         page_table = ints[bucket : bucket + mp]
@@ -654,8 +665,15 @@ class ModelRunner:
         executable per configured k). ``flts`` [3, B] = temps, top_ps, min_ps.
         Rows beyond a slot's n_drafts scatter their KV to the trash page, so a
         slot proposing fewer than K drafts never writes past its pages."""
-        mp = self.config.max_pages_per_seq
-        K1 = ints.shape[0] - 5 - mp
+        # K is config-static (one executable per configured k), so the page-
+        # table width — which now varies with the ladder — falls out of the
+        # array shape instead of being pinned to the dense max_pages_per_seq
+        spec = self.config.spec
+        K1 = (
+            spec.k + 1
+            if spec is not None
+            else ints.shape[0] - 5 - self.config.max_pages_per_seq
+        )
         positions = ints[0]
         active = ints[1].astype(bool)
         top_ks = ints[2]
@@ -710,7 +728,9 @@ class ModelRunner:
         can start without waiting for the host to see it."""
         n = len(tokens)
         bucket = self.config.bucket_for(n)
-        mp = self.config.max_pages_per_seq
+        # the caller's table is already sized to a ladder bucket (scheduler/
+        # engine build them via table_bucket_for); its width picks the trace
+        mp = len(page_table)
         V = self.model.config.vocab_size
         ints = np.full(bucket + mp + 5 + MAX_EOS_IDS, V, np.int32)  # tail = eos pad
         ints[:bucket] = 0
@@ -802,6 +822,7 @@ class ModelRunner:
             want_pen=want_extras,
             want_seed=want_extras,
             want_eos_mask=want_extras,
+            mp=mp,
         )
         if not sample:
             return None
@@ -1064,9 +1085,12 @@ class ModelRunner:
             and hasattr(self.model, "prefill_packed")
         )
 
-    def _warmup_shapes(self):
+    def _warmup_shapes(self, table_width: Optional[int] = None):
         B = self.config.max_seqs
-        mp = self.config.max_pages_per_seq
+        # narrow (first-rung) tables are the hot path for a fresh engine —
+        # deep sequences promote into the wider ladder variants, which
+        # compile via warmup_extra_thunks
+        mp = table_width or self.config.table_buckets[0]
         return {
             "zeros_i": np.zeros(B, np.int32),
             "pt": np.zeros((B, mp), np.int32),
@@ -1225,6 +1249,43 @@ class ModelRunner:
                 ):
                     thunks.append(packed(b, n, sampling, want_lp))
                 n *= 2
+        # page-table ladder: wider-table variants for the traces a DEEP
+        # sequence promotes into mid-serving — the default decode window and
+        # the prefill bucket the depth-aware planner runs at that depth
+        # (chunk_len_for shrinks chunks as context grows, so the (chunk,
+        # width) pairs compiled here are the ones live traffic reaches)
+        def wide_window(width):
+            shw = self._warmup_shapes(table_width=width)
+
+            def run():
+                out = self.dispatch_decode_window(
+                    shw["zeros_i"], shw["pt"], shw["inactive"], shw["zeros_i"],
+                    shw["temps"], shw["zeros_i"], shw["ones_f"], K,
+                )
+                jax.block_until_ready(out)
+            return run
+
+        def wide_chunk(width, b):
+            def run():
+                pt = np.zeros(width, np.int32)
+                if self.packed_prefill_mode:
+                    lane = (
+                        np.zeros(b, np.int32), 0, pt, -1,
+                        SamplingParams(temperature=0.0), (), False,
+                    )
+                    out = self.prefill_chunk_batch([lane], N=1)
+                    jax.block_until_ready(out)
+                else:
+                    self.prefill_chunk(
+                        np.zeros(b, np.int32), 0, pt, sample=True,
+                        temperature=0.0, top_k=0, top_p=1.0, slot=-1, sync=True,
+                    )
+            return run
+
+        for w in self.config.table_buckets[1:]:
+            thunks.append(wide_window(w))
+            depth = (w // 2) * self.config.page_size  # where this rung starts
+            thunks.append(wide_chunk(w, self.config.chunk_len_for(depth)))
         return thunks
 
     def extract_pages_device(self, page_ids: np.ndarray) -> jax.Array:

@@ -66,6 +66,38 @@ class HostKvPool:
             self.drops += 1
         return dropped
 
+    def save_many(self, pairs: list[tuple[int, int]]) -> list[int]:
+        """Copy a batch of device pages to host with ONE device gather (the
+        pressure-eviction path: per-block save() pays a dispatch + D2H round
+        trip per page, serialized into whatever allocation needed the pages).
+        Returns seq hashes dropped from the pool (removed-event emission)."""
+        if self.capacity_blocks <= 0:
+            return [h for h, _ in pairs]
+        if not pairs:
+            return []
+        from dynamo_tpu.quant.kv import wire_split
+
+        axis = getattr(getattr(self.runner, "model", None), "wire_n_axis", 2)
+        t0 = time.monotonic()
+        data = self.runner.extract_pages(
+            np.asarray([p for _, p in pairs], np.int32)
+        )
+        blocks = wire_split(data, axis, len(pairs))
+        dt = time.monotonic() - t0
+        self.transfer_s += dt
+        tracing.record_span("engine.kv_offload.save", t0, duration=dt,
+                            attrs={"blocks": len(pairs)})
+        for (seq_hash, _), block in zip(pairs, blocks):
+            self._blocks[seq_hash] = block
+            self._blocks.move_to_end(seq_hash)
+        self.saves += len(pairs)
+        dropped = []
+        while len(self._blocks) > self.capacity_blocks:
+            victim, _ = self._blocks.popitem(last=False)
+            dropped.append(victim)
+            self.drops += 1
+        return dropped
+
     def load(self, seq_hash: int, page_id: int) -> bool:
         """Inject a host block into a device page. True on hit."""
         data = self._blocks.get(seq_hash)

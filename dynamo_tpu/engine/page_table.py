@@ -94,32 +94,67 @@ class PageAllocator:
         return (self.num_pages - 1) - len(self._free) - len(self._reusable)
 
     def _pop_free_page(self) -> int:
-        if self._free:
-            page = self._free.pop()
-            if self.used_pages > self.peak_used_pages:
-                self.peak_used_pages = self.used_pages
-            return page
-        # Reclaim the least-recently-used refcount-0 cached block; with a host
-        # tier configured its KV is offloaded instead of dropped.
-        if self._reusable:
+        return self._pop_free_pages(1)[0]
+
+    def _pop_free_pages(self, n: int) -> list[int]:
+        """Take ``n`` pages: the free list first, then LRU reclaim from the
+        refcount-0 reusable pool — with the whole reclaim batch offloaded to
+        the host tier in ONE device gather (the per-block save path pays a
+        dispatch + D2H round trip per page, which serializes directly into
+        TTFT when a deep prompt allocates thousands of pages). Raises
+        MemoryError (nothing taken) when both sources run dry."""
+        if n <= len(self._free):
+            out = [self._free.pop() for _ in range(n)]
+        else:
+            if n > len(self._free) + len(self._reusable):
+                raise MemoryError("out of KV pages")
+            out = [self._free.pop() for _ in range(len(self._free))]
+            out.extend(self._reclaim_reusable(n - len(out)))
+        if self.used_pages > self.peak_used_pages:
+            self.peak_used_pages = self.used_pages
+        return out
+
+    def _reclaim_reusable(self, n: int) -> list[int]:
+        """Evict up to ``n`` LRU refcount-0 cached blocks; with a host tier
+        configured their KV is offloaded (one batched gather) instead of
+        dropped. Returns the freed pages."""
+        victims: list[tuple[int, object, int]] = []  # (seq_hash, meta, page)
+        while self._reusable and len(victims) < n:
             seq_hash, page = self._reusable.popitem(last=False)
             del self._cache[seq_hash]
-            meta = self._cache_meta.pop(seq_hash)
-            if self.offload is not None:
-                dropped = self.offload.save(seq_hash, page)
-                if seq_hash not in dropped:
-                    self._offloaded_meta[seq_hash] = meta
-                removed = []
-                for victim in dropped:
-                    vm = meta if victim == seq_hash else self._offloaded_meta.pop(victim, None)
-                    if vm is not None:
-                        removed.append(vm.block_hash)
-                if removed:
-                    self._emit(KvCacheEvent.removed(removed))
-            else:
-                self._emit(KvCacheEvent.removed([meta.block_hash]))
-            return page
-        raise MemoryError("out of KV pages")
+            victims.append((seq_hash, self._cache_meta.pop(seq_hash), page))
+        if not victims:
+            return []
+        removed = []
+        if self.offload is not None:
+            dropped = set(
+                self.offload.save_many([(h, p) for h, _, p in victims])
+            )
+            meta_by_hash = {h: m for h, m, _ in victims}
+            for h, m, _ in victims:
+                if h not in dropped:
+                    self._offloaded_meta[h] = m
+            for victim in dropped:
+                vm = meta_by_hash.get(victim) or self._offloaded_meta.pop(victim, None)
+                if vm is not None:
+                    removed.append(vm.block_hash)
+        else:
+            removed = [m.block_hash for _, m, _ in victims]
+        if removed:
+            self._emit(KvCacheEvent.removed(removed))
+        return [p for _, _, p in victims]
+
+    def drain_to_host(self, n: int) -> int:
+        """Pressure-driven offload: move up to ``n`` of the coldest
+        refcount-0 cached blocks to the host tier (one batched gather) and
+        return their pages to the free list — so allocation bursts find
+        fresh pages instead of paying the reclaim transfer at the moment of
+        exhaustion. Returns the number of pages freed."""
+        if self.offload is None or not self._reusable:
+            return 0
+        pages = self._reclaim_reusable(n)
+        self._free.extend(pages)
+        return len(pages)
 
     # ------------- events -------------
 
@@ -202,11 +237,12 @@ class PageAllocator:
             # transfer round trip per block, serialized into TTFT);
             # re-registered on-device so later sequences share them again
             host_pairs: list[tuple[int, int]] = []
-            for seq_hash in host_hit_hashes:
-                page = self._pop_free_page()
-                self._refcount[page] = 1
-                state.pages.append(page)
-                host_pairs.append((seq_hash, page))
+            if host_hit_hashes:
+                fresh = self._pop_free_pages(len(host_hit_hashes))
+                for seq_hash, page in zip(host_hit_hashes, fresh):
+                    self._refcount[page] = 1
+                    state.pages.append(page)
+                    host_pairs.append((seq_hash, page))
             hit_hashes = self.offload.load_many(host_pairs) if host_pairs else set()
             # only the contiguous restored prefix counts as cached: a block may
             # have been LRU-dropped from the host pool while its destination
@@ -234,12 +270,14 @@ class PageAllocator:
 
             cached_len = (len(device_hits) + restored) * self.page_size
 
-            # 3. fresh pages for the rest of the prompt
+            # 3. fresh pages for the rest of the prompt — one batched take
+            # (the reclaim leg offloads its whole victim batch in one gather)
             total_pages_needed = -(-len(prompt_tokens) // self.page_size)
-            while len(state.pages) < total_pages_needed:
-                page = self._pop_free_page()
-                self._refcount[page] = 1
-                state.pages.append(page)
+            need = total_pages_needed - len(state.pages)
+            if need > 0:
+                for page in self._pop_free_pages(need):
+                    self._refcount[page] = 1
+                    state.pages.append(page)
         except MemoryError:
             self._rollback(state)
             raise
@@ -272,11 +310,13 @@ class PageAllocator:
         """Make sure pages exist to hold `length` tokens. False if OOM."""
         state = self._seqs[seq_id]
         needed = -(-length // self.page_size)
-        while state.num_pages < needed:
-            try:
-                page = self._pop_free_page()
-            except MemoryError:
-                return False
+        if state.num_pages >= needed:
+            return True
+        try:
+            fresh = self._pop_free_pages(needed - state.num_pages)
+        except MemoryError:
+            return False
+        for page in fresh:
             self._refcount[page] = 1
             state.pages.append(page)
         return True

@@ -335,6 +335,13 @@ class Scheduler:
         self.prefix_fetch_blocks = 0  # blocks pulled and scattered
         self.prefix_fetch_bytes = 0  # payload bytes pulled (wire KV dtype)
         self.prefix_fetch_tokens = 0  # prompt tokens whose recompute was skipped
+        # long-context telemetry (dynamo_engine_context_* families): the
+        # page-table width ladder, depth-aware chunk planner, and the
+        # watermark-driven cold-block drain to the host tier
+        self.table_promotions = 0  # sequences promoted to a wider table rung
+        self.table_dispatches: dict[int, int] = {}  # table width -> dispatches
+        self.chunk_dispatches: dict[int, int] = {}  # chunk bucket -> chunks
+        self.offload_pressure_blocks = 0  # cold blocks drained to host by watermark
 
     # ---------------- queue ----------------
 
@@ -391,6 +398,7 @@ class Scheduler:
 
     def step(self) -> list[StepOutput]:
         outputs: list[StepOutput] = []
+        self._drain_cold_to_host()
         outputs.extend(self._reconcile(block=False))
         outputs.extend(self._admit())
         dispatched = self._poll_fetches(outputs)
@@ -410,6 +418,47 @@ class Scheduler:
 
     def _windows_in_flight(self) -> int:
         return sum(1 for e in self.in_flight if e.kind == "window")
+
+    def _drain_cold_to_host(self) -> None:
+        """Pressure-driven host offload: once page-pool occupancy crosses
+        ``offload_watermark``, move the coldest refcount-0 cached blocks —
+        the deep KV of long sequences nothing is actively decoding — to the
+        host tier in batches (one device gather each), returning their pages
+        to the free list. Allocation bursts and decode growth then find
+        fresh pages instead of paying per-block reclaim round trips, or
+        preempting whole sequences, at the moment of exhaustion."""
+        alloc, cfg = self.allocator, self.config
+        if alloc.offload is None or cfg.offload_watermark >= 1.0:
+            return
+        total = max(1, cfg.num_pages - 1)
+        while alloc.used_pages / total > cfg.offload_watermark and alloc._reusable:
+            moved = alloc.drain_to_host(cfg.offload_drain_batch)
+            if not moved:
+                break
+            self.offload_pressure_blocks += moved
+
+    # ---------------- page-table ladder ----------------
+
+    def _new_table(self, pages: list[int]) -> np.ndarray:
+        """Page table at the sequence's CURRENT ladder width (pow2 bucket of
+        its page count) — not the dense max_pages_per_seq width, so a short
+        request in a 128K-capable engine dispatches a narrow table."""
+        table = np.zeros(self.config.table_bucket_for(max(1, len(pages))), np.int32)
+        table[: len(pages)] = pages
+        return table
+
+    def _refresh_table(self, seq: RunningSeq) -> None:
+        """Re-sync a sequence's table from the allocator, promoting it to
+        the next ladder rung when its pages outgrew the current width."""
+        state = self.allocator._seqs[seq.req.request_id]
+        n = len(state.pages)
+        if n > len(seq.page_table):
+            seq.page_table = np.zeros(self.config.table_bucket_for(n), np.int32)
+            self.table_promotions += 1
+        seq.page_table[:n] = state.pages
+
+    def _count_table_dispatch(self, width: int) -> None:
+        self.table_dispatches[width] = self.table_dispatches.get(width, 0) + 1
 
     # ---------------- admission + prefill ----------------
 
@@ -509,8 +558,7 @@ class Scheduler:
             )
         cached_len, state = self.allocator.allocate_sequence(req.request_id, req.token_ids)
         prompt_len = len(req.token_ids)
-        page_table = np.zeros(self.config.max_pages_per_seq, np.int32)
-        page_table[: len(state.pages)] = state.pages
+        page_table = self._new_table(state.pages)
 
         seq = RunningSeq(
             req=req,
@@ -747,15 +795,17 @@ class Scheduler:
             )
             if not pending:
                 return count
-            max_chunk = self.config.max_prefill_chunk
             # greedy bucket-aware packing in admission order: grow the lane
             # set while every taken lane still fits the (possibly enlarged)
             # bucket's row budget — one long head chunk goes alone, short
-            # chunks pack together
+            # chunks pack together. Each lane's chunk length is depth-aware:
+            # chunk_len_for shrinks it as that sequence's prefill advances
+            # into a long prompt, keeping per-chunk latency roughly flat.
             chunks = []
             bucket = 0
             for s in pending:
-                end = min(s.prefill_pos + max_chunk, s.prompt_len)
+                limit = self.config.chunk_len_for(s.prefill_pos)
+                end = min(s.prefill_pos + limit, s.prompt_len)
                 cand = self.config.bucket_for(max(bucket, end - s.prefill_pos))
                 if chunks and len(chunks) + 1 > self.config.lanes_for(cand):
                     break
@@ -786,6 +836,12 @@ class Scheduler:
                     want_lp = want_lp or seq.req.logprobs is not None
             rows = sum(end - start for _, start, end in chunks)
             self.local_prefill_rows += rows
+            for _, start, end in chunks:
+                cb = self.config.bucket_for(end - start)
+                self.chunk_dispatches[cb] = self.chunk_dispatches.get(cb, 0) + 1
+            self._count_table_dispatch(self.config.table_bucket_for(
+                max(len(s.page_table) for s, _, _ in chunks)
+            ))
             N = min(lanes_max, 1 << (len(chunks) - 1).bit_length())
             t0 = time.monotonic()
             try:
@@ -905,16 +961,23 @@ class Scheduler:
         put on the wire) while the next chunk computes."""
         rows = max(0, prompt_len - cached_len)
         self.local_prefill_rows += rows
+        if rows:
+            self._count_table_dispatch(
+                self.config.table_bucket_for(len(page_table))
+            )
         s = req.sampling
         first_token = None
         start = cached_len
-        max_chunk = self.config.max_prefill_chunk
         t0 = time.monotonic()
         if prep:
             self._prep_prefill(req, slot, prompt_len, cached_len=cached_len)
         while start < prompt_len:
-            end = min(start + max_chunk, prompt_len)
+            # depth-aware chunk sizing: shrink the chunk as the context
+            # deepens so per-chunk latency stays roughly flat at depth
+            end = min(start + self.config.chunk_len_for(start), prompt_len)
             is_last = end == prompt_len
+            cb = self.config.bucket_for(end - start)
+            self.chunk_dispatches[cb] = self.chunk_dispatches.get(cb, 0) + 1
             embeds, embeds_mask = _mm_chunk_overrides(req, start, end)
             rope_pos = req.mrope_pos[start:end] if req.mrope_pos is not None else None
             tok = self.runner.prefill_chunk(
@@ -976,8 +1039,7 @@ class Scheduler:
                 attrs={"adopted": True},
             )
         state = self.allocator._seqs[req.request_id]
-        page_table = np.zeros(self.config.max_pages_per_seq, np.int32)
-        page_table[: len(state.pages)] = state.pages
+        page_table = self._new_table(state.pages)
         seq = RunningSeq(
             req=req,
             slot=-1,
@@ -1074,8 +1136,7 @@ class Scheduler:
                 self._preempt(victim)
             if self.slots[seq.slot] is not seq or seq.finished:
                 continue
-            state = self.allocator._seqs[seq.req.request_id]
-            seq.page_table[: len(state.pages)] = state.pages
+            self._refresh_table(seq)
             candidates.append((seq, p, drafts))
         # a later candidate's page-pressure preemption can evict an earlier
         # one mid-pass; only still-live slots ride the verify call
@@ -1087,8 +1148,14 @@ class Scheduler:
             return 0
 
         B = self.config.max_seqs
+        # per-round table width: the widest participant's ladder rung (narrow
+        # sequences zero-pad into the trash page)
+        W = self.config.table_bucket_for(
+            max(len(s.page_table) for s, _, _ in candidates)
+        )
+        self._count_table_dispatch(W)
         positions = np.zeros(B, np.int32)
-        page_tables = np.zeros((B, self.config.max_pages_per_seq), np.int32)
+        page_tables = np.zeros((B, W), np.int32)
         active = np.zeros(B, bool)
         fed = np.zeros((B, K + 1), np.int32)
         n_drafts = np.zeros(B, np.int32)
@@ -1101,7 +1168,7 @@ class Scheduler:
         for seq, p, drafts in candidates:
             i = seq.slot
             positions[i] = p
-            page_tables[i] = seq.page_table
+            page_tables[i, : len(seq.page_table)] = seq.page_table
             active[i] = True
             fed[i, 0] = seq.generated[-1]
             if drafts:
@@ -1207,8 +1274,7 @@ class Scheduler:
                     break
                 self._preempt(victim)
             if self.slots[seq.slot] is seq:
-                state = self.allocator._seqs[seq.req.request_id]
-                seq.page_table[: len(state.pages)] = state.pages
+                self._refresh_table(seq)
 
         participants = []
         for seq in self.slots:
@@ -1226,8 +1292,15 @@ class Scheduler:
             return False
 
         B = self.config.max_seqs
+        # per-window table width: the widest participant's ladder rung —
+        # short-sequence batches keep their narrow H2D + gather, and only
+        # windows containing a deep sequence dispatch the wide executable
+        W = self.config.table_bucket_for(
+            max(len(seq.page_table) for seq, _ in participants)
+        )
+        self._count_table_dispatch(W)
         positions = np.zeros(B, np.int32)
-        page_tables = np.zeros((B, self.config.max_pages_per_seq), np.int32)
+        page_tables = np.zeros((B, W), np.int32)
         active = np.zeros(B, bool)
         limits = np.zeros(B, np.int32)
         temps = np.zeros(B, np.float32)
@@ -1245,7 +1318,7 @@ class Scheduler:
         for seq, steps in participants:
             i = seq.slot
             positions[i] = seq.next_fed_pos
-            page_tables[i] = seq.page_table
+            page_tables[i, : len(seq.page_table)] = seq.page_table
             active[i] = True
             limits[i] = seq.next_fed_pos + steps - 1  # max fed position
             temps[i] = seq.req.sampling.temperature

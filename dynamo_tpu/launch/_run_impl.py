@@ -48,14 +48,29 @@ def engine_config_for(args):
     ks = getattr(args, "kv_stream", None)
     kv_stream = True if ks is None else bool(ks)
     kv_stream_lanes = getattr(args, "kv_stream_lanes", None) or 2
+    # long-context knobs (graph yaml / CLI): prefill buckets arrive as a
+    # comma string (CLI) or a list (yaml)
+    pb = getattr(args, "prefill_buckets", None)
+    if isinstance(pb, str):
+        pb = tuple(int(x) for x in pb.split(",") if x)
+    elif pb:
+        pb = tuple(int(x) for x in pb)
+    long_ctx = dict(
+        prefill_flat_depth=getattr(args, "prefill_flat_depth", None) or 8192,
+        host_cache_blocks=getattr(args, "host_cache_blocks", None) or 0,
+        offload_watermark=getattr(args, "offload_watermark", None) or 0.90,
+    )
+    if pb:
+        long_ctx["prefill_buckets"] = pb
     if is_tiny:
+        tiny_ctx = dict(long_ctx)
+        tiny_ctx.setdefault("prefill_buckets", (16, 32))
         return EngineConfig(
             model_id=model_path,
             page_size=card.kv_block_size,
             num_pages=getattr(args, "num_pages", None) or 128,
             max_seqs=getattr(args, "max_seqs", None) or 4,
             max_model_len=card.context_length,
-            prefill_buckets=(16, 32),
             tp=getattr(args, "tp", None) or 1,
             pp=getattr(args, "pp", None) or 1,
             quantize=getattr(args, "quantize", None),
@@ -65,6 +80,7 @@ def engine_config_for(args):
             kv_stream_lanes=kv_stream_lanes,
             slo_ttft_ms=getattr(args, "slo_ttft_ms", None),
             slo_itl_ms=getattr(args, "slo_itl_ms", None),
+            **tiny_ctx,
         )
     return EngineConfig(
         model_id=model_path,
@@ -84,6 +100,7 @@ def engine_config_for(args):
         # serve as soon as the core traces compile; feature variants land in
         # the background (halves cold first-deploy readiness time)
         warmup="background",
+        **long_ctx,
     )
 
 

@@ -202,10 +202,11 @@ class HttpService:
             doc["traceEvents"] = tracing.events(trace_id=tid, request_id=rid)
         return web.json_response(doc)
 
-    def _error(self, status: int, message: str) -> web.Response:
-        return web.json_response(
-            {"error": {"message": message, "type": "invalid_request_error"}}, status=status
-        )
+    def _error(self, status: int, message: str, code: str | None = None) -> web.Response:
+        err = {"message": message, "type": "invalid_request_error"}
+        if code:
+            err["code"] = code  # e.g. context_length_exceeded
+        return web.json_response({"error": err}, status=status)
 
     async def _chat(self, request: web.Request) -> web.StreamResponse:
         return await self._handle(request, kind="chat")
@@ -229,7 +230,7 @@ class HttpService:
             )
         except ProtocolError as e:
             self.metrics.inc_request(str(body.get("model")), endpoint, "unary", "400")
-            return self._error(400, str(e))
+            return self._error(400, str(e), code=e.code)
 
         pipeline = self.manager.get(req.model)
         if pipeline is None:
@@ -265,8 +266,12 @@ class HttpService:
                 )
             t_pre_end = time.monotonic()
         except ProtocolError as e:
+            # includes the preprocessor's context-length rejection: the
+            # client gets a structured 400 with error.code
+            # "context_length_exceeded", not a 500 or an SSE abort (the
+            # check runs before any stream response starts)
             self.metrics.inc_request(model, endpoint, rtype, "400")
-            return self._error(400, str(e))
+            return self._error(400, str(e), code=e.code)
 
         tool_matcher = None
         if kind == "chat" and req.tool_choice not in (None, "none") and not req.tools:

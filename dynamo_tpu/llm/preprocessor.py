@@ -16,6 +16,7 @@ from dynamo_tpu.llm.protocols.common import PreprocessedRequest
 from dynamo_tpu.llm.protocols.openai import (
     ChatCompletionRequest,
     CompletionRequest,
+    ContextLengthError,
     ProtocolError,
 )
 from dynamo_tpu.llm.tokenizer import Tokenizer
@@ -79,7 +80,9 @@ class OpenAIPreprocessor:
         if not token_ids:
             raise ProtocolError("prompt tokenized to zero tokens")
         if len(token_ids) >= self.max_model_len:
-            raise ProtocolError(
+            # a client error with the OpenAI code, mapped to a structured
+            # 400 on the HTTP path (never a 500 or a mid-stream abort)
+            raise ContextLengthError(
                 f"prompt length {len(token_ids)} exceeds model context {self.max_model_len}"
             )
         annotations = {}

@@ -16,7 +16,18 @@ from typing import Any, Optional
 
 
 class ProtocolError(ValueError):
-    """400-level request validation error."""
+    """400-level request validation error. ``code`` (when set) rides the
+    OpenAI error envelope as ``error.code`` so clients can match on it."""
+
+    code: Optional[str] = None
+
+
+class ContextLengthError(ProtocolError):
+    """Prompt exceeds the model's context window — the OpenAI
+    ``context_length_exceeded`` client error (a structured 400, never a
+    500/stream abort: the check runs before any stream starts)."""
+
+    code = "context_length_exceeded"
 
 
 @dataclass

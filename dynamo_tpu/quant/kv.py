@@ -172,6 +172,22 @@ def wire_concat(blocks: list, axis: int):
     return np.concatenate(blocks, axis=axis)
 
 
+def wire_split(data, axis: int, n: int) -> list:
+    """Split a wire block of ``n`` pages into per-page blocks (dict-aware).
+    Each block is copied out so dropping one later frees its bytes instead
+    of pinning the whole parent gather."""
+
+    def _split(a):
+        return [np.ascontiguousarray(b) for b in np.split(a, n, axis=axis)]
+
+    if is_quantized_wire(data):
+        return [
+            {"q": q, "s": s}
+            for q, s in zip(_split(data["q"]), _split(data["s"]))
+        ]
+    return _split(data)
+
+
 def wire_pad(data, axis: int, pad: int):
     """Zero-pad ``pad`` pages onto the page axis (dict-aware). Pad pages are
     scatter-dropped by out-of-range ids, so zeros are never read."""

@@ -58,6 +58,12 @@ def _fill_representative(bench):
     bench.DETAIL["parity_host_offload"] = {
         "projection": {"ttft_ratio_projected": 8.82, "restore_bw_source": "measured"},
     }
+    bench.DETAIL["long_context"] = {
+        "16k": {"ttft_ms": 13956.5, "decode_tok_s": 123.4, "kv_pages_peak": 1088},
+        "64k": {"ttft_ms": 57321.8, "decode_tok_s": 98.7, "kv_pages_peak": 4160},
+        "parity_64k_ladder_vs_dense": True,
+        "short_ttft_ratio_ladder_over_dense": 0.169,
+    }
 
 
 def test_summary_line_fits_truncation_budget(bench_mod, tmp_path, monkeypatch):

@@ -74,6 +74,21 @@ def test_engine_surface_carries_prefix_fetch_families():
     assert "# TYPE dynamo_prefix_fetch_seconds histogram" in text
 
 
+def test_engine_surface_carries_long_context_families():
+    """The long-context telemetry must stay on the conformance-checked
+    engine surface: page-table ladder dispatches by width + rung
+    promotions, depth-aware prefill chunk buckets, and the watermark-driven
+    cold-KV host drain counter (all validated by `tools/lint.sh --check`
+    through the same surface list)."""
+    text = dict(_SURFACES)["engine.render_stage_metrics"]
+    assert "# TYPE dynamo_engine_context_table_dispatch_total counter" in text
+    assert 'dynamo_engine_context_table_dispatch_total{width="' in text
+    assert "# TYPE dynamo_engine_context_table_promotions_total counter" in text
+    assert "# TYPE dynamo_engine_context_chunk_total counter" in text
+    assert 'dynamo_engine_context_chunk_total{len="' in text
+    assert "# TYPE dynamo_engine_offload_pressure_blocks_total counter" in text
+
+
 def test_colocated_composition_has_no_family_collisions():
     """The in=http serving path concatenates HTTP metrics + frontend SLO +
     engine stage/resource/health/SLO families into one /metrics document;

@@ -142,6 +142,32 @@ def test_bad_request(server):
     assert status == 400
 
 
+def test_context_length_exceeded_is_structured_400(server):
+    """An over-long prompt is a CLIENT error: a structured 400 with the
+    OpenAI error.code, not a 500 (the tiny card's context is 64 tokens)."""
+    loop, url, _ = server
+    status, body = _post(loop, url, "/v1/completions", {
+        "model": "tiny", "prompt": list(range(1, 101)), "max_tokens": 4,
+    })
+    assert status == 400
+    err = body["error"]
+    assert err["type"] == "invalid_request_error"
+    assert err["code"] == "context_length_exceeded"
+    assert "context" in err["message"]
+
+
+def test_context_length_exceeded_stream_mode_still_400(server):
+    """stream=true must reject BEFORE any SSE bytes go out: a JSON 400 with
+    the same structured code, never a 200 + mid-stream abort."""
+    loop, url, _ = server
+    status, body = _post(loop, url, "/v1/completions", {
+        "model": "tiny", "prompt": list(range(1, 101)), "max_tokens": 4,
+        "stream": True,
+    })
+    assert status == 400
+    assert body["error"]["code"] == "context_length_exceeded"
+
+
 def test_models_and_metrics(server):
     loop, url, _ = server
     status, text = _get(loop, url, "/v1/models")

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.engine.engine import AsyncJaxEngine
 from dynamo_tpu.engine.sampling import SamplingParams
 from dynamo_tpu.engine.scheduler import EngineRequest
@@ -172,6 +173,167 @@ def test_engine_sp_prefill_token_exact():
     assert t2_sp == t2_ref, f"sp {t2_sp} != ref {t2_ref}"
     assert c1_sp == c1_ref == 0
     assert c2_sp == c2_ref > 0  # prefix written by SP prefill is reusable
+
+
+def test_prefill_sp_deep_context_parity_T1024():
+    """T=1024 ring parity (ISSUE 8: ring/sp prefill was only ever exercised
+    at T=64): sp=4 whole-prompt ring prefill matches the paged prefill on
+    logits AND pool contents at real long-context depth."""
+    from jax.sharding import Mesh
+
+    cfg = LlamaConfig.tiny()
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.key(0))
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+
+    T, PAGE_SIZE = 1024, 16
+    NUM_PAGES = T // PAGE_SIZE + 8
+    rng = np.random.default_rng(7)
+    prompt = rng.integers(1, 200, T).tolist()
+    pt = np.arange(1, T // PAGE_SIZE + 1, dtype=np.int32)
+    pt_full = np.zeros(T // PAGE_SIZE + 4, np.int32)
+    pt_full[: len(pt)] = pt
+    tokens = jnp.asarray(prompt, jnp.int32)
+    positions = jnp.arange(T, dtype=jnp.int32)
+    valid = jnp.ones(T, bool)
+
+    kv_a = model.init_kv_cache(NUM_PAGES, PAGE_SIZE)
+    logits_a, kv_a = model.prefill(
+        params, kv_a, tokens, positions, jnp.asarray(pt_full), valid,
+        jnp.asarray(T - 1),
+    )
+    kv_b = model.init_kv_cache(NUM_PAGES, PAGE_SIZE)
+    logits_b, kv_b = jax.jit(lambda *a: model.prefill_sp(*a, mesh=mesh))(
+        params, kv_b, tokens, positions, jnp.asarray(pt_full), valid,
+        jnp.asarray(T - 1),
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_a), np.asarray(logits_b), atol=2e-3, rtol=2e-3
+    )
+    flat = (pt[None, :] + np.arange(cfg.num_layers)[:, None] * NUM_PAGES).ravel()
+    for leaf in ("k", "v"):
+        np.testing.assert_allclose(
+            np.asarray(kv_a[leaf][flat]), np.asarray(kv_b[leaf][flat]),
+            atol=2e-3, rtol=2e-3,
+        )
+
+
+def test_engine_sp_deep_prompt_token_exact():
+    """Engine e2e at T=1025 — deliberately NOT bucket-aligned, so the ring
+    runs with padded rows AND a 1-token paged follow-up chunk rides behind
+    the sp whole-prefix chunk — sp=4 greedy tokens match sp=1."""
+
+    def run(sp):
+        async def body():
+            eng = AsyncJaxEngine(EngineConfig(
+                model_id="tiny", page_size=16, num_pages=200, max_seqs=2,
+                max_model_len=4096, prefill_buckets=(256, 512, 1024), sp=sp,
+            ))
+            await eng.start()
+            try:
+                rng = np.random.default_rng(5)
+                prompt = [int(x) for x in rng.integers(1, 200, 1025)]
+                toks, _, _ = await _collect(
+                    eng,
+                    EngineRequest(
+                        request_id="deep",
+                        token_ids=prompt,
+                        sampling=SamplingParams(temperature=0.0, max_tokens=6),
+                    ),
+                )
+                return toks
+            finally:
+                await eng.shutdown()
+
+        return asyncio.run(body())
+
+    assert run(4) == run(1)
+
+
+def test_sp_prefill_composes_with_kv_stream():
+    """An sp=2 prefill engine streams its KV per chunk (the kv_stream export
+    path: sync_remote_prefill(on_part=...)), a plain decode engine scatters
+    the parts and adopts — the adopted decode must be token-identical to a
+    local sp=1 engine serving the same prompt."""
+    from dynamo_tpu.llm.remote_prefill import RemotePrefillRequest
+
+    def cfg(sp):
+        return EngineConfig(
+            model_id="tiny", page_size=16, num_pages=160, max_seqs=2,
+            max_model_len=2048, prefill_buckets=(256, 512, 1024), sp=sp,
+        )
+
+    rng = np.random.default_rng(3)
+    prompt = [int(x) for x in rng.integers(1, 200, 1024)]
+
+    async def local():
+        eng = AsyncJaxEngine(cfg(1))
+        await eng.start()
+        try:
+            toks, _, _ = await _collect(
+                eng,
+                EngineRequest(
+                    request_id="local", token_ids=prompt,
+                    sampling=SamplingParams(temperature=0.0, max_tokens=8),
+                ),
+            )
+            return toks
+        finally:
+            await eng.shutdown()
+
+    async def disagg():
+        pre = AsyncJaxEngine(cfg(2))
+        await pre.start()
+        dec = AsyncJaxEngine(cfg(1))
+        await dec.start()
+        try:
+            rp = RemotePrefillRequest(
+                request_id="x", token_ids=prompt, temperature=0.0,
+                top_k=0, top_p=1.0, decode_worker_id="w",
+            )
+            parts = []
+            result, _ = await pre.run_on_engine(
+                lambda: pre.sync_remote_prefill(
+                    rp, mode="socket",
+                    on_part=lambda *a: parts.append(a),
+                )
+            )
+            assert result.kv_parts == len(parts) and parts, \
+                "sp prefill produced no streamed parts"
+            cached, _, pages = await dec.run_on_engine(
+                lambda: dec.sync_allocate_remote("x", prompt)
+            )
+            injected = 0
+            for _seq, _total, pf, pt, fut in parts:
+                data = fut.result()
+                ids = np.asarray(pages[pf:pt], np.int32)
+                await dec.run_on_engine(
+                    lambda ids=ids, data=data:
+                        dec.runner.inject_pages_bucketed(ids, data)
+                )
+                injected += len(ids)
+            req = EngineRequest(
+                request_id="x", token_ids=prompt,
+                sampling=SamplingParams(temperature=0.0, max_tokens=8),
+            )
+            dec._register_stream("x")
+            await dec.run_on_engine(
+                lambda: dec.sync_adopt_prefilled(
+                    req, result, cached, injected_pages=injected
+                )
+            )
+            toks = []
+            async for out in dec._drain_stream("x"):
+                if out.token is not None:
+                    toks.append(out.token)
+            return toks
+        finally:
+            await pre.shutdown()
+            await dec.shutdown()
+
+    expected = asyncio.run(local())
+    got = asyncio.run(disagg())
+    assert got == expected, f"sp x kv_stream {got} != local {expected}"
 
 
 def test_prefill_pipelined_ring_matches_prefill():
