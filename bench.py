@@ -1719,6 +1719,170 @@ async def run_spec_ngram(
     }
 
 
+async def run_spec_draft(osl: int | None = None) -> dict:
+    """Draft-model speculation vs n-gram vs the classic decode path on a
+    NON-repetitive workload — the regime n-gram acceptance collapses in and
+    the draft-model proposer exists for (Leviathan/Chen: a small draft
+    recovers multi-token rounds on arbitrary text).
+
+    Prompts are pure random token streams (no tiling), so prompt-lookup
+    finds no suffix match while the draft model keeps proposing. The draft
+    IS the target model here (the only honestly-available draft in a
+    synthetic-weights bench), which makes two things exact: greedy token
+    parity vs the classic engine (asserted per request) and ~full
+    acceptance of every proposed token. It also means the draft leg runs
+    the target twice per round — on equal-size models wall-clock CANNOT
+    beat classic by construction, so the gates are parity + acceptance +
+    draft-pages-visible; the TPU run with a 5-10x smaller draft is where
+    the tok/s win appears, and the three tok/s legs reported here price
+    the dispatch overhead that win must clear.
+
+    On CPU (no TPU in the build container) the section scales the geometry
+    down like fleet_prefix does."""
+    import dataclasses
+
+    import jax
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.engine.sampling import SamplingParams
+    from dynamo_tpu.engine.scheduler import EngineRequest
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu:
+        geom = {
+            "vocab_size": 512, "hidden_size": 512, "intermediate_size": 1024,
+            "num_layers": 4, "num_heads": 4, "num_kv_heads": 2,
+            "head_dim": 128, "dtype": "f32",
+        }
+        base_id = "tiny:" + json.dumps(geom)
+        batch, page_size, prompt_len, vocab = 6, 16, 128, 500
+        decode_tokens = osl or 64
+        prefill_buckets = (64, 128, 256)
+    else:
+        base_id = json_model_id()
+        batch, page_size, prompt_len, vocab = 8, 64, 192, 31000
+        decode_tokens = osl or 128
+        prefill_buckets = (128, 256, 512)
+    K = 4
+    pages_per_seq = -(-(prompt_len + decode_tokens + K + 1) // page_size) + 2
+    num_pages = batch * pages_per_seq + 8
+
+    rng = np.random.default_rng(17)
+    # pure random streams: no token pair repeats by construction of the draw
+    # (vocab >> prompt_len), so n-gram's longest-suffix match comes up empty
+    prompts = [rng.integers(1, vocab, prompt_len).tolist() for _ in range(batch)]
+
+    def cfg(speculative):
+        return EngineConfig(
+            model_id=base_id, page_size=page_size, num_pages=num_pages,
+            max_seqs=batch, max_model_len=prompt_len + decode_tokens + 2 * K,
+            prefill_buckets=prefill_buckets, decode_steps=8, pipeline_depth=2,
+            speculative=speculative,
+        )
+
+    async def leg(speculative: str | None):
+        eng = AsyncJaxEngine(cfg(speculative))
+        await eng.start()
+
+        async def one(i: int, rnd: int):
+            req = EngineRequest(
+                request_id=f"d{(speculative or 'base').split(':')[0]}-{rnd}-{i}",
+                token_ids=list(prompts[i]),
+                sampling=SamplingParams(
+                    temperature=0.0, max_tokens=decode_tokens, ignore_eos=True
+                ),
+            )
+            toks = []
+            async for out in eng.generate(req):
+                if out.token is not None:
+                    toks.append(out.token)
+            return toks
+
+        try:
+            await asyncio.gather(*[one(i, 0) for i in range(batch)])  # warmup
+            best, streams = None, None
+            for rnd in (1, 2):
+                t0 = time.monotonic()
+                results = await asyncio.gather(*[one(i, rnd) for i in range(batch)])
+                elapsed = time.monotonic() - t0
+                total = sum(len(t) for t in results)
+                if best is None or total / elapsed > best:
+                    best = total / elapsed
+                    streams = results
+            stage = eng.stage_snapshot()
+            snap = eng.resource_snapshot()
+        finally:
+            await eng.shutdown()
+        return round(best, 2), streams, stage, snap
+
+    base_tok_s, base_streams, _, _ = await leg(None)
+    ngram_tok_s, ngram_streams, ngram_stage, _ = await leg(f"ngram:{K}")
+    draft_spec = f"draft:{base_id}:{K}"
+    draft_tok_s, draft_streams, draft_stage, draft_snap = await leg(draft_spec)
+
+    parity = sum(
+        int(a == b) for a, b in zip(base_streams, draft_streams)
+    ) / max(1, batch)
+    ngram_parity = sum(
+        int(a == b) for a, b in zip(base_streams, ngram_streams)
+    ) / max(1, batch)
+
+    def rate(stage):
+        return stage.get("spec_accepted", 0) / max(1, stage.get("spec_proposed", 0))
+
+    draft_rate, ngram_rate = rate(draft_stage), rate(ngram_stage)
+    assert parity == 1.0, "draft==target greedy must be token-identical"
+    assert draft_rate > ngram_rate, (
+        f"draft acceptance {draft_rate} must beat n-gram's {ngram_rate} on "
+        "non-repetitive text"
+    )
+    assert draft_snap.get("spec_draft_pages_total", 0) > 0, (
+        "draft KV pages must be visible in resource_snapshot()"
+    )
+    return {
+        "tok_s_draft": draft_tok_s,
+        "tok_s_ngram": ngram_tok_s,
+        "tok_s_classic": base_tok_s,
+        "speedup_draft_over_classic": round(draft_tok_s / base_tok_s, 3),
+        "speedup_ngram_over_classic": round(ngram_tok_s / base_tok_s, 3),
+        "acceptance_rate_draft": round(draft_rate, 4),
+        "acceptance_rate_ngram": round(ngram_rate, 4),
+        "greedy_parity_draft": round(parity, 4),
+        "greedy_parity_ngram": round(ngram_parity, 4),
+        "spec_proposed_draft": draft_stage.get("spec_proposed", 0),
+        "spec_accepted_draft": draft_stage.get("spec_accepted", 0),
+        "spec_proposed_ngram": ngram_stage.get("spec_proposed", 0),
+        "spec_draft_calls": draft_stage.get("spec_draft_calls", 0),
+        "spec_draft_dispatch_s": draft_stage.get("spec_draft_s", 0.0),
+        "spec_draft_prefills": draft_stage.get("spec_draft_prefills", 0),
+        "draft_pages_total": draft_snap.get("spec_draft_pages_total", 0),
+        "draft_model": "== target (exact-parity smoke; TPU uses a smaller draft)",
+        "k": K,
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "decode_tokens": decode_tokens,
+        "workload_note": (
+            "pure random token streams — prompt-lookup finds no match "
+            "(acceptance ~0) while the draft model proposes every round"
+        ),
+        "target": (
+            "greedy_parity_draft == 1.0; acceptance_rate_draft > "
+            "acceptance_rate_ngram; draft pages visible. tok/s legs price "
+            "dispatch overhead: a same-size draft can't beat classic on "
+            "wall clock (runs the target twice) — the TPU win needs a "
+            "5-10x smaller draft"
+        ),
+        "pass": {
+            "greedy_parity": parity == 1.0,
+            "draft_acceptance_above_ngram": bool(draft_rate > ngram_rate),
+            "draft_pages_visible": bool(
+                draft_snap.get("spec_draft_pages_total", 0) > 0
+            ),
+        },
+    }
+
+
 async def run_http_serving(batch: int = 32, page_size: int = 64) -> dict:
     """HTTP-level serving numbers through /v1/chat/completions — the
     reference's published numbers are serving-stack numbers, not engine-loop
@@ -1974,6 +2138,10 @@ async def run() -> dict:
         # speculative decoding vs classic decode on a repetition-heavy
         # workload: speedup + exact greedy parity + acceptance counters
         await _section("spec_ngram", run_spec_ngram, 1800)
+        # draft-model speculation vs n-gram vs classic on a NON-repetitive
+        # workload (exact greedy parity draft==target; acceptance must beat
+        # n-gram's where prompt-lookup collapses)
+        await _section("spec_draft", run_spec_draft, 1800)
         # weight-only int8 vs bf16 on the headline config: throughput ratio +
         # greedy/logit parity (the round-6 tentpole)
         await _section("parity_quant_int8", run_quant_int8_parity, 2400)
@@ -2044,6 +2212,7 @@ def _summary(errors: dict) -> dict:
     quant = DETAIL.get("parity_quant_int8")
     kvq = DETAIL.get("prefill_kv_int8")
     spec = DETAIL.get("spec_ngram")
+    sdraft = DETAIL.get("spec_draft")
     return {
         "headline_tok_s": _get(head, "tok_s"),
         "continuity_bs8_tok_s": _get(cont, "tok_s"),
@@ -2079,13 +2248,23 @@ def _summary(errors: dict) -> dict:
             "teacher_forced_agreement": _get(kvq, "teacher_forced_agreement"),
         },
         "spec_ngram": {
-            "tok_s_spec": _get(spec, "tok_s_spec"),
-            # tok_s_base lives in bench_detail.json (speedup carries it)
+            # tok_s_spec/tok_s_base live in bench_detail.json (the speedup
+            # ratio carries them; summary-line truncation budget)
             "speedup": _get(spec, "speedup_spec_over_base"),
             "acceptance_rate": _get(spec, "acceptance_rate"),
             # raw proposed/accepted counters live in bench_detail.json
             # (summary-line truncation budget; the rate carries the signal)
             "greedy_parity": _get(spec, "greedy_parity"),
+        },
+        # draft-model speculation on NON-repetitive text: acceptance is the
+        # headline signal (the draft proposes where n-gram can't; a
+        # same-size CPU-smoke draft can't win wall clock by construction).
+        # tok_s legs, speedups, raw counters, and the draft-pool gauges all
+        # ride bench_detail.json under spec_draft.
+        "spec_draft": {
+            "accept_draft": _get(sdraft, "acceptance_rate_draft"),
+            "accept_ngram": _get(sdraft, "acceptance_rate_ngram"),
+            "greedy_parity": _get(sdraft, "greedy_parity_draft"),
         },
         "parity_disagg": {
             "ratio_measured_1chip": _get(dis, "ratio_measured_1chip"),

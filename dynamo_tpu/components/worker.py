@@ -248,6 +248,7 @@ async def _main(args) -> None:
             ) or EngineConfig.prefill_buckets,
             prefill_flat_depth=getattr(args, "prefill_flat_depth", None) or 8192,
             host_cache_blocks=getattr(args, "host_cache_blocks", None) or 0,
+            host_cache_bytes=getattr(args, "host_cache_bytes", None) or 0,
             offload_watermark=getattr(args, "offload_watermark", None) or 0.90,
         ),
         enable_disagg_decode=args.disagg,
@@ -286,9 +287,12 @@ def main(argv=None) -> None:
                    help="KV cache storage dtype: int8 halves attention HBM "
                         "traffic and ~doubles page capacity (per-page "
                         "scales; composes with --quantize)")
-    p.add_argument("--speculative", default=None, metavar="ngram:k",
-                   help="speculative decoding: n-gram draft proposals + "
-                        "batched multi-token verification (e.g. ngram:4)")
+    p.add_argument("--speculative", default=None, metavar="KIND:...",
+                   help="speculative decoding: ngram:<k> (prompt-lookup "
+                        "proposals) or draft:<model>:<k> (a second, smaller "
+                        "registry model with its own paged KV drafts k "
+                        "tokens per round; composes with --quantize / "
+                        "--kv-cache-dtype)")
     p.add_argument("--disagg", action="store_true", help="wrap in the disagg decode path")
     p.add_argument("--slo-ttft-ms", type=float, default=None,
                    help="TTFT SLO target in ms (rolling percentiles + error "
@@ -324,6 +328,11 @@ def main(argv=None) -> None:
                    help="host-DRAM KV offload tier capacity in blocks "
                         "(0 disables; long-context cold KV drains here "
                         "under page pressure)")
+    p.add_argument("--host-cache-bytes", type=int, default=0,
+                   help="host-DRAM KV tier budget in bytes, resolved to "
+                        "blocks at the model's ACTUAL per-page wire cost "
+                        "(an int8 KV cache fits ~2x the blocks of bf16 in "
+                        "the same budget; the larger of the two knobs wins)")
     p.add_argument("--offload-watermark", type=float, default=0.90,
                    help="page-pool occupancy fraction that triggers the "
                         "batched cold-block drain to the host tier "

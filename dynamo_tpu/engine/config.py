@@ -67,13 +67,23 @@ class EngineConfig:
     #                   pools only (MLA's latent cache raises); not yet
     #                   composable with pp (the stage-sharded pool split).
     kv_cache_dtype: str | None = None
-    # speculative decoding ("ngram:k", e.g. "ngram:4"): the scheduler proposes
-    # k draft tokens per sequence from its own prompt+output history
-    # (prompt-lookup) and verifies all of them plus one bonus token in ONE
-    # multi-query forward pass, advancing 1..k+1 tokens per round with no
-    # quality change (dynamo_tpu/spec/). None = classic one-token decode.
-    # Requests with penalties, logprobs, min_tokens, or images fall back to
-    # the classic decode windows automatically.
+    # speculative decoding (dynamo_tpu/spec/): verify k draft tokens plus
+    # one bonus token in ONE multi-query forward pass, advancing 1..k+1
+    # tokens per round with no quality change. Two proposer kinds:
+    #   "ngram:k"            — prompt-lookup over the sequence's own history
+    #                          (incremental suffix index; repetition-heavy
+    #                          workloads only)
+    #   "draft:<model>:<k>"  — a second, smaller registry model drafts k
+    #                          tokens per round in one batched on-device
+    #                          dispatch with its own paged KV pool; real
+    #                          draft probabilities make temperature>0
+    #                          acceptance the exact Leviathan/Chen rule.
+    #                          The draft loads with this engine's quantize /
+    #                          kv_cache_dtype (int8 weights + int8 KV
+    #                          compose).
+    # None = classic one-token decode. Requests with penalties, logprobs,
+    # min_tokens, or images fall back to the classic decode windows
+    # automatically.
     speculative: str | None = None
     # cross-process disaggregation data plane (dynamo_tpu/disagg/dataplane.py):
     # stream KV to the decode worker per finished prefill chunk (v2 multi-part
@@ -110,6 +120,13 @@ class EngineConfig:
     watermark: float = 0.05
     # host-DRAM KV offload tier capacity in blocks (0 = disabled)
     host_cache_blocks: int = 0
+    # host-DRAM KV tier budget in BYTES (0 = unset): resolved to blocks at
+    # engine init using the model's ACTUAL per-page wire cost
+    # (model.kv_page_bytes — an int8 cache's host blocks are int8 pages +
+    # scale planes, ~half the bf16 bytes, so the same DRAM budget holds ~2x
+    # blocks). When both knobs are set the larger resolved capacity wins;
+    # sizing by bytes is the one that stays truthful across kv_cache_dtype.
+    host_cache_bytes: int = 0
     # pressure-driven host offload (host_cache_blocks > 0 only): once page-
     # pool occupancy crosses this fraction, the scheduler proactively drains
     # the coldest refcount-0 cached blocks to the host tier in BATCHED saves
@@ -188,6 +205,11 @@ class EngineConfig:
         if self.offload_drain_batch < 1:
             raise ValueError(
                 f"offload_drain_batch must be >= 1; got {self.offload_drain_batch}"
+            )
+        if self.host_cache_bytes < 0 or self.host_cache_blocks < 0:
+            raise ValueError(
+                "host cache capacity must be >= 0; got "
+                f"blocks={self.host_cache_blocks} bytes={self.host_cache_bytes}"
             )
         if any(b <= 0 for b in self.page_table_buckets):
             raise ValueError(

@@ -138,10 +138,30 @@ class AsyncJaxEngine:
         )
         self.runner = ModelRunner(self.config, self.model, params)
         offload = None
-        if self.config.host_cache_blocks > 0:
-            from dynamo_tpu.engine.offload import HostKvPool
+        if self.config.host_cache_blocks > 0 or self.config.host_cache_bytes > 0:
+            from dynamo_tpu.engine.offload import (
+                HostKvPool,
+                resolve_host_capacity_blocks,
+            )
 
-            offload = HostKvPool(self.runner, self.config.host_cache_blocks)
+            # byte budgets resolve at the model's ACTUAL per-page wire cost
+            # (int8 host blocks are ~half the bf16 bytes -> ~2x blocks for
+            # the same DRAM budget); the drain watermarks then operate on a
+            # truthful block capacity
+            page_bytes = (
+                self.model.kv_page_bytes(self.config.page_size)
+                if hasattr(self.model, "kv_page_bytes")
+                else 0
+            )
+            blocks = resolve_host_capacity_blocks(
+                self.config.host_cache_blocks,
+                # a model without page-cost accounting can't honor a byte
+                # budget — fall back to the explicit block knob only
+                self.config.host_cache_bytes if page_bytes else 0,
+                page_bytes,
+            )
+            if blocks > 0:
+                offload = HostKvPool(self.runner, blocks, block_bytes=page_bytes)
         self.offload = offload
         self.allocator = PageAllocator(
             self.config.num_pages,
@@ -618,7 +638,25 @@ class AsyncJaxEngine:
                 offload_loads=offload.loads,
                 offload_drops=offload.drops,
                 offload_blocks_resident=len(offload),
+                offload_capacity_blocks=offload.capacity_blocks,
+                # at the ACTUAL wire dtype (int8 host blocks ~half of bf16)
+                offload_block_bytes=offload.block_bytes,
+                offload_bytes_resident=offload.bytes_resident,
             )
+        spec = self.config.spec
+        if spec is not None:
+            st = sched.stage
+            snap["spec_proposer"] = spec.kind
+            snap["spec_acceptance_rate"] = round(
+                st.spec_accepted / max(1, st.spec_proposed), 4
+            )
+            draft = getattr(runner, "draft", None) if runner is not None else None
+            if draft is not None:
+                # the draft model's OWN paged pool (acceptance criterion:
+                # draft KV pages visible in resource_snapshot)
+                snap["spec_draft_pages_total"] = draft.pages_total
+                snap["spec_draft_pages_used"] = draft.pages_used
+                snap["spec_draft_model"] = spec.model
         if runner is not None:
             snap.update(runner.hbm_stats())
             cm = getattr(runner, "compile_monitor", None)
@@ -680,6 +718,36 @@ class AsyncJaxEngine:
                 "proposed draft tokens accepted by batched verification",
                 [({}, st.spec_accepted)],
             ))
+            spec = self.config.spec
+            # acceptance labeled by proposer kind: dashboards comparing an
+            # ngram fleet against a draft-model fleet read ONE family
+            parts.append(render_family(
+                "dynamo_spec_acceptance_ratio", "gauge",
+                "accepted/proposed draft tokens, labeled by proposer kind",
+                [({"proposer": spec.kind},
+                  round(st.spec_accepted / max(1, st.spec_proposed), 4))],
+            ))
+            if spec.kind == "draft":
+                parts.append(render_family(
+                    "dynamo_spec_draft_seconds_total", "counter",
+                    "engine-thread seconds in the draft model, by phase "
+                    "(dispatch = the batched per-round drafting call; "
+                    "prefill = draft-cache builds at admission/resume)",
+                    [({"phase": "dispatch"}, round(st.spec_draft_s, 4)),
+                     ({"phase": "prefill"}, round(st.spec_draft_prefill_s, 4))],
+                ))
+                parts.append(render_family(
+                    "dynamo_spec_draft_dispatch_total", "counter",
+                    "batched draft-model drafting dispatches (one per spec "
+                    "round with >= 1 live draft lane)",
+                    [({}, st.spec_draft_calls)],
+                ))
+                parts.append(render_family(
+                    "dynamo_spec_draft_prefill_total", "counter",
+                    "draft-cache prefills (admission, preemption resume, "
+                    "offload restore, and catch-up rebuilds)",
+                    [({}, st.spec_draft_prefills)],
+                ))
         parts.append(self._render_resource_metrics())
         # fleet prefix cache: wire-side client/server families join the
         # engine surface when the hosting worker attached them
@@ -822,6 +890,23 @@ class AsyncJaxEngine:
                 [({"op": "save"}, r["offload_saves"]),
                  ({"op": "load"}, r["offload_loads"]),
                  ({"op": "drop"}, r["offload_drops"])],
+            ))
+            parts.append(render_family(
+                "dynamo_engine_offload_bytes_resident", "gauge",
+                "host-DRAM KV tier bytes resident at the ACTUAL wire dtype "
+                "(int8 blocks cost ~half of bf16)",
+                [({}, r["offload_bytes_resident"])],
+            ))
+        if "spec_draft_pages_total" in r:
+            # the draft model's OWN paged pool — separate from the target's
+            # dynamo_engine_kv_pages (acceptance criterion: draft KV pages
+            # visible alongside the target pool's occupancy)
+            parts.append(render_family(
+                "dynamo_spec_draft_pages", "gauge",
+                "draft-model KV page-pool occupancy (its own pool, separate "
+                "from the target cache; total excludes the trash page)",
+                [({"state": "total"}, r["spec_draft_pages_total"]),
+                 ({"state": "used"}, r["spec_draft_pages_used"])],
             ))
         return "".join(parts)
 

@@ -260,6 +260,18 @@ class ModelRunner:
         # penalties/logprobs requests never ride this path (the scheduler
         # routes them through classic windows)
         self._verify = _mjit("verify", jax.jit(self._verify_impl, donate_argnums=(1,)))
+        # draft-model speculation: a second model with its own paged KV pool
+        # and a batched k-token drafting dispatch (spec/draft.py). Loaded
+        # through the registry with THIS engine's quantize/kv_cache_dtype so
+        # the draft composes with int8 weights and the int8 KV cache.
+        self.draft = None
+        spec = config.spec
+        if spec is not None and spec.kind == "draft":
+            from dynamo_tpu.spec.draft import DraftModelRunner
+
+            self.draft = DraftModelRunner(
+                config, spec, compile_monitor=self.compile_monitor
+            )
         def _write_tokens_impl(st, idx, vals):
             return dict(st, tokens=st["tokens"].at[idx].set(vals, mode="drop"))
 
@@ -653,7 +665,7 @@ class ModelRunner:
         # [num_steps, B] tokens (+ ([num_steps, B], [num_steps, B, K] x2) lp)
         return all_toks, lp, kv, slot_state
 
-    def _verify_impl(self, params, kv, ints, flts, key):
+    def _verify_impl(self, params, kv, ints, flts, key, draft_probs=None):
         """Speculative verify step: every slot feeds its anchor token plus up
         to K drafts at consecutive positions through the model's multi-query
         ``verify`` pass, then acceptance runs on device so only the tiny
@@ -663,6 +675,9 @@ class ModelRunner:
         active, top_ks, seeds, n_drafts, the K+1 fed-token rows, then the
         transposed page tables (K is derived from the array shape — one
         executable per configured k). ``flts`` [3, B] = temps, top_ps, min_ps.
+        ``draft_probs`` ([B, K, V] device array from dispatch_draft, never
+        staged through the host): the real draft distributions temperature>0
+        acceptance divides by; None = one-hot (n-gram) proposals.
         Rows beyond a slot's n_drafts scatter their KV to the trash page, so a
         slot proposing fewer than K drafts never writes past its pages."""
         # K is config-static (one executable per configured k), so the page-
@@ -691,6 +706,7 @@ class ModelRunner:
         out, n_emit = accept_speculative(
             logits, fed[:, 1:], n_drafts, key, temps, top_ks, top_ps,
             min_p=min_ps, seeds=seeds, positions=positions,
+            draft_probs=draft_probs,
         )
         n_emit = jnp.where(active, n_emit, 0)
         return out, n_emit, kv
@@ -1029,12 +1045,15 @@ class ModelRunner:
         top_ps: np.ndarray,
         min_ps: np.ndarray | None = None,
         seeds: np.ndarray | None = None,  # [B] int32 (0 = unseeded)
+        draft_probs=None,  # [B, K, V] device array from dispatch_draft
     ):
         """Dispatch one speculative verify pass; returns the (tokens [B, K+1],
         n_emit [B]) device arrays with async host copies already started. The
         caller materializes both (the proposer needs the accepted tokens
         before it can draft the next round, so verify rounds are synchronous
-        per slot — the win is k+1 tokens per weight pass, not dispatch-ahead)."""
+        per slot — the win is k+1 tokens per weight pass, not dispatch-ahead).
+        ``draft_probs`` rides through to the on-device acceptance untouched
+        (draft-model rounds); None keeps the one-hot (n-gram) rule."""
         B = positions.shape[0]
         K1 = fed_tokens.shape[1]
         ints = np.empty((5 + K1 + page_tables.shape[1], B), np.int32)
@@ -1055,6 +1074,7 @@ class ModelRunner:
             jnp.asarray(ints),
             jnp.asarray(flts),
             self._next_key(),
+            draft_probs,
         )
         try:
             out.copy_to_host_async()
@@ -1062,6 +1082,14 @@ class ModelRunner:
         except Exception:
             pass
         return out, n_emit
+
+    def dispatch_draft(self, *args, **kwargs):
+        """One batched draft round across every spec-mode lane (draft-model
+        speculation only; see spec/draft.py DraftModelRunner.dispatch_draft).
+        Returns (draft tokens [B, K] dev, draft probs [B, K, V] dev)."""
+        if self.draft is None:
+            raise RuntimeError("dispatch_draft requires speculative='draft:...'")
+        return self.draft.dispatch_draft(*args, **kwargs)
 
     def warmup(self) -> None:
         """Pre-compile every trace variant synchronously (core + extras)."""
@@ -1130,12 +1158,19 @@ class ModelRunner:
         spec = self.config.spec
         if spec is not None:
             # one verify executable per configured k (all slots inactive, KV
-            # rows land on the trash page — harmless, compiles the trace)
+            # rows land on the trash page — harmless, compiles the trace);
+            # draft mode compiles the draft-probs-bearing variant plus the
+            # draft runner's own step/prefill executables
             B = self.config.max_seqs
+            dp = None
+            if self.draft is not None:
+                self.draft.warmup()
+                V = self.model.config.vocab_size
+                dp = jnp.zeros((B, spec.k, V), jnp.float32)
             out = self.dispatch_verify(
                 sh["zeros_i"], sh["pt"], sh["inactive"],
                 np.zeros((B, spec.k + 1), np.int32), sh["zeros_i"],
-                sh["temps"], sh["zeros_i"], sh["ones_f"],
+                sh["temps"], sh["zeros_i"], sh["ones_f"], draft_probs=dp,
             )
             jax.block_until_ready(out)
         for b in self.config.prefill_buckets:

@@ -30,17 +30,39 @@ from dynamo_tpu.utils import get_logger, tracing
 log = get_logger("engine.offload")
 
 
+def resolve_host_capacity_blocks(
+    blocks: int, budget_bytes: int, page_bytes: int
+) -> int:
+    """Host-tier capacity in blocks from the two config knobs.
+
+    ``budget_bytes`` divides by the model's ACTUAL per-page wire cost
+    (model.kv_page_bytes — int8 caches store int8 pages + scale planes on
+    the host too, ~half the bf16 bytes), so the same DRAM budget holds ~2x
+    blocks under an int8 KV cache instead of silently assuming bf16. When
+    both knobs are set the larger capacity wins. Pure arithmetic — the
+    PR-8-follow-up unit tests pin it down."""
+    from_bytes = budget_bytes // max(1, page_bytes) if budget_bytes > 0 else 0
+    return max(blocks, from_bytes)
+
+
 class HostKvPool:
     """LRU pool of KV blocks in host DRAM, keyed by chained sequence hash."""
 
-    def __init__(self, runner, capacity_blocks: int = 0):
+    def __init__(self, runner, capacity_blocks: int = 0, block_bytes: int = 0):
         self.runner = runner
         self.capacity_blocks = capacity_blocks
+        # per-block wire bytes at the ACTUAL cache dtype (telemetry: the
+        # resident-bytes gauge; 0 = unknown, gauges render zero)
+        self.block_bytes = block_bytes
         self._blocks: OrderedDict[int, np.ndarray] = OrderedDict()  # seq_hash -> [L,2,1,ps,H,D]
         self.saves = 0
         self.loads = 0
         self.drops = 0
         self.transfer_s = 0.0  # device<->host block movement (both directions)
+
+    @property
+    def bytes_resident(self) -> int:
+        return len(self._blocks) * self.block_bytes
 
     def __len__(self) -> int:
         return len(self._blocks)

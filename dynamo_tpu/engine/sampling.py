@@ -217,6 +217,7 @@ def accept_speculative(
     min_p: jnp.ndarray | None = None,  # [B] (0 = off)
     seeds: jnp.ndarray | None = None,  # [B] int32, 0 = unseeded
     positions: jnp.ndarray | None = None,  # [B] anchor fed position per slot
+    draft_probs: jnp.ndarray | None = None,  # [B, K, V] real draft dists q
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Speculative acceptance over one verify pass: (tokens [B, K+1], n_emit [B]).
 
@@ -232,10 +233,14 @@ def accept_speculative(
     (accepted drafts == their argmax; the row after the last acceptance is
     the correction/bonus token).
 
-    Sampling slots: distribution-exact rejection sampling (Leviathan et al.)
-    against the degenerate (one-hot) n-gram proposal: accept d_i with
-    probability min(1, p(d_i)); on rejection resample from p with d_i removed
-    (the residual distribution max(0, p - q) renormalized); when every draft
+    Sampling slots: distribution-exact rejection sampling (Leviathan et al. /
+    Chen et al.). Without ``draft_probs`` the proposal is treated as
+    degenerate (one-hot q, the n-gram case): accept d_i with probability
+    min(1, p(d_i)); on rejection resample from p with d_i removed. With
+    ``draft_probs`` (a draft model's real distributions, q[:, i] being the
+    filtered distribution d_{i+1} was sampled from) the full rule runs:
+    accept d_i with probability min(1, p(d_i)/q(d_i)), and on rejection
+    resample from the residual max(0, p - q) renormalized; when every draft
     is accepted, the bonus token samples from the last row unmodified. p is
     the FULL filtered distribution (temperature/top-k/top-p/min-p) via
     filter_keep_mask, so the emitted marginal matches sample_tokens exactly.
@@ -293,26 +298,55 @@ def accept_speculative(
     u = jax.vmap(jax.vmap(lambda k_: jax.random.uniform(jax.random.fold_in(k_, 0))))(
         row_keys[:, :K]
     )
-    s_match = (u < p_draft) & draft_valid
+    if draft_probs is None:
+        s_match = (u < p_draft) & draft_valid
+    else:
+        # real proposal: accept with probability min(1, p(d)/q(d)); q > 0
+        # wherever the draft actually sampled, the floor only guards pads
+        q_draft = jnp.take_along_axis(
+            draft_probs, drafts[..., None].astype(jnp.int32), axis=-1
+        )[..., 0]  # [B, K]
+        s_match = (u * jnp.maximum(q_draft, 1e-20) < p_draft) & draft_valid
     s_acc = jnp.cumprod(s_match.astype(jnp.int32), axis=1).sum(axis=1)  # [B]
 
     a = jnp.where(temperature > 0, s_acc, g_acc)  # [B] accepted drafts
 
-    # final token: row a's filtered logits; on a rejection (a < n_drafts) the
-    # rejected draft is removed — the residual max(0, p - q) for a one-hot q
     b_idx = jnp.arange(B)
-    row_logits = jnp.where(keep, flat, _NEG_INF).reshape(B, K1, V)[b_idx, a]
     rejected = a < n_drafts
-    d_rej = jnp.take_along_axis(
-        drafts, jnp.clip(a, 0, max(K - 1, 0))[:, None], axis=1
-    )[:, 0]
-    row_logits = row_logits.at[b_idx, d_rej].add(
-        jnp.where(rejected, _NEG_INF, 0.0)
-    )
     final_keys = jax.vmap(lambda k_: jax.random.fold_in(k_, 1))(row_keys[b_idx, a])
-    final = jax.vmap(
-        lambda k_, row, t: jax.random.categorical(k_, row / jnp.where(t > 0, t, 1.0))
-    )(final_keys, row_logits, temperature).astype(jnp.int32)
+    if draft_probs is None:
+        # final token: row a's filtered logits; on a rejection the rejected
+        # draft is removed — the residual max(0, p - q) for a one-hot q
+        row_logits = jnp.where(keep, flat, _NEG_INF).reshape(B, K1, V)[b_idx, a]
+        d_rej = jnp.take_along_axis(
+            drafts, jnp.clip(a, 0, max(K - 1, 0))[:, None], axis=1
+        )[:, 0]
+        row_logits = row_logits.at[b_idx, d_rej].add(
+            jnp.where(rejected, _NEG_INF, 0.0)
+        )
+        final = jax.vmap(
+            lambda k_, row, t: jax.random.categorical(k_, row / jnp.where(t > 0, t, 1.0))
+        )(final_keys, row_logits, temperature).astype(jnp.int32)
+    else:
+        # final token in probability space (temperature already applied by
+        # the softmax above): rejection -> the renormalized residual
+        # max(0, p - q) at row a; all-accepted -> the bonus row's p itself.
+        # categorical(log p) == categorical(logits/temp) bit for bit (the
+        # gumbel draw is shift-invariant), so the q -> one-hot limit matches
+        # the branch above exactly.
+        p_rows = probs[b_idx, a]  # [B, V]
+        q_rows = draft_probs[b_idx, jnp.clip(a, 0, max(K - 1, 0))]
+        res = jnp.maximum(p_rows - q_rows, 0.0)
+        # a residual can only be empty through float cancellation (p == q
+        # rejects with probability 0); fall back to p rather than NaN
+        has_res = jnp.sum(res, axis=-1, keepdims=True) > 0
+        use_res = rejected[:, None] & has_res
+        dist = jnp.where(use_res, res, p_rows)
+        final = jax.vmap(
+            lambda k_, row: jax.random.categorical(
+                k_, jnp.where(row > 0, jnp.log(jnp.maximum(row, 1e-38)), _NEG_INF)
+            )
+        )(final_keys, dist).astype(jnp.int32)
 
     drafts_pad = jnp.concatenate(
         [drafts.astype(jnp.int32), jnp.zeros((B, 1), jnp.int32)], axis=1

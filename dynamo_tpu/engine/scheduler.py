@@ -115,6 +115,17 @@ class RunningSeq:
     # switches mid-stream between the sync (materialized) and dispatch-ahead
     # (scheduled) position-tracking regimes.
     spec_mode: bool = False
+    # n-gram speculation: the sequence's incremental suffix index
+    # (spec/proposer.py NgramIndex), built lazily at its first round and
+    # extended with ACCEPTED tokens only — proposing costs O(new tokens),
+    # not a full history rescan per round
+    ngram: Optional[object] = None
+    # draft-model speculation: how many tokens of this sequence's history
+    # the draft model's KV has fed (None = draft cache not built yet);
+    # draft_dead = the draft pool couldn't hold this sequence — it keeps
+    # verifying (correct, 1 token/round) with no proposals
+    draft_pos: Optional[int] = None
+    draft_dead: bool = False
     # FETCHING_KV: an in-flight remote-prefix pull (_PrefixFetch). While set,
     # no prefill chunk dispatches for this sequence; resolution either
     # advances prefill_pos past the pulled prefix or falls back to recompute.
@@ -214,6 +225,13 @@ class StageStats:
     spec_proposed: int = 0
     spec_accepted: int = 0
     spec_emitted: int = 0
+    # draft-model speculation: the batched on-device drafting dispatches and
+    # the per-sequence draft-cache prefills (both separate from the verify
+    # pass's spec_dispatch_s so the round's cost splits draft vs verify)
+    spec_draft_calls: int = 0
+    spec_draft_s: float = 0.0
+    spec_draft_prefills: int = 0
+    spec_draft_prefill_s: float = 0.0
 
     def snapshot(self) -> dict:
         snap = {
@@ -240,6 +258,13 @@ class StageStats:
                 spec_acceptance_rate=round(
                     self.spec_accepted / max(1, self.spec_proposed), 4
                 ),
+            )
+        if self.spec_draft_calls or self.spec_draft_prefills:
+            snap.update(
+                spec_draft_calls=self.spec_draft_calls,
+                spec_draft_s=round(self.spec_draft_s, 4),
+                spec_draft_prefills=self.spec_draft_prefills,
+                spec_draft_prefill_s=round(self.spec_draft_prefill_s, 4),
             )
         return snap
 
@@ -1080,19 +1105,157 @@ class Scheduler:
             and s.min_tokens <= 0
         )
 
+    def _propose_ngram(self, seq: RunningSeq, max_d: int) -> list[int]:
+        """Propose via the sequence's incremental suffix index: built once
+        from the prompt at the first round, extended with ACCEPTED tokens
+        only — each round costs O(tokens accepted since the last round), not
+        a full prompt+output rescan."""
+        idx = seq.ngram
+        if idx is None:
+            idx = seq.ngram = self.proposer.index(seq.req.token_ids)
+        for t in seq.generated[len(idx) - seq.prompt_len :]:
+            idx.append(t)
+        return idx.propose(max_d)
+
+    # ---------------- draft-model speculation ----------------
+
+    def _free_draft(self, seq: RunningSeq) -> None:
+        draft = getattr(self.runner, "draft", None) if self.runner else None
+        if draft is not None and seq.draft_pos is not None:
+            draft.free_sequence(seq.req.request_id)
+        seq.draft_pos = None
+
+    def _drop_draft(self, seq: RunningSeq, why: str) -> None:
+        """Draft pool can't serve this sequence: it keeps verifying (1 token
+        per round, still exact) with no proposals for the rest of its life."""
+        log.warning("draft cache dropped for %s (%s)", seq.req.request_id, why)
+        self._free_draft(seq)
+        seq.draft_dead = True
+
+    def _draft_sync(self, seq: RunningSeq, K: int) -> bool:
+        """Bring the draft model's KV up to the sequence's history: the
+        steady state just extends capacity for this round's k draft rows;
+        a fresh (or fallen-behind) sequence chunk-prefills everything but
+        the newest token — admission, preemption resume, host-offload
+        restores, and remote-prefill adoption all land here, so the draft
+        cache is rebuilt from the authoritative token history in every case.
+        Returns True when the lane can draft this round."""
+        if seq.draft_dead:
+            return False
+        draft = self.runner.draft
+        rid = seq.req.request_id
+        behind = None if seq.draft_pos is None else seq.pos - seq.draft_pos
+        if behind is not None and not 1 <= behind <= K + 1:
+            # catch-up wider than the dispatch's K+1 rows (can't happen in
+            # steady state; belt for exotic resume paths): rebuild
+            self._free_draft(seq)
+            behind = None
+        if behind is None:
+            hist = list(seq.req.token_ids) + seq.generated
+            t0 = time.monotonic()
+            if not draft.prefill_sequence(rid, hist[:-1]):
+                self._drop_draft(seq, "draft page pool exhausted at prefill")
+                return False
+            dt = time.monotonic() - t0
+            self.stage.spec_draft_prefills += 1
+            self.stage.spec_draft_prefill_s += dt
+            tracing.record_span(
+                "engine.spec.draft_prefill", t0, duration=dt,
+                request_id=rid, trace_id=seq.req.trace_id,
+                attrs={"tokens": len(hist) - 1},
+            )
+            seq.draft_pos = len(hist) - 1
+            return True
+        # fed positions this round reach seq.pos + K - 1
+        if not draft.ensure_capacity(rid, seq.pos + K):
+            self._drop_draft(seq, "draft page pool exhausted")
+            return False
+        return True
+
+    def _dispatch_draft_phase(self, candidates: list, K: int):
+        """Batched drafting for a draft-model round: one
+        ``runner.dispatch_draft`` across every lane whose draft cache is
+        live. Fills each candidate's draft list in place (candidates are
+        [seq, p, drafts, max_d] records) and returns the [B, K, V] draft-
+        probability device array for the verify pass (None when no lane
+        drafted)."""
+        live = []
+        for cand in candidates:
+            seq, p, _, max_d = cand
+            if max_d > 0 and self._draft_sync(seq, K):
+                live.append(cand)
+        if not live:
+            return None
+        B = self.config.max_seqs
+        draft = self.runner.draft
+        W = self.config.table_bucket_for(max(
+            len(draft.table_for(s.req.request_id)) for s, _, _, _ in live
+        ))
+        V = self.runner.model.config.vocab_size
+        positions = np.zeros(B, np.int32)
+        tables = np.zeros((B, W), np.int32)
+        active = np.zeros(B, bool)
+        fed = np.full((B, K + 1), V, np.int32)
+        n_feed = np.ones(B, np.int32)
+        temps = np.zeros(B, np.float32)
+        top_ks = np.zeros(B, np.int32)
+        top_ps = np.ones(B, np.float32)
+        min_ps = np.zeros(B, np.float32)
+        seeds = np.zeros(B, np.int32)
+        for seq, p, _, max_d in live:
+            i = seq.slot
+            rid = seq.req.request_id
+            table = draft.table_for(rid)
+            positions[i] = seq.draft_pos
+            tables[i, : len(table)] = table
+            active[i] = True
+            pending = seq.generated[seq.draft_pos - seq.prompt_len :]
+            n_feed[i] = len(pending)
+            fed[i, : len(pending)] = pending
+            s = seq.req.sampling
+            temps[i] = s.temperature
+            top_ks[i] = s.top_k
+            top_ps[i] = s.top_p
+            min_ps[i] = s.min_p
+            seeds[i] = fold_seed(s.seed)
+        t0 = time.monotonic()
+        toks_dev, qs_dev = self.runner.dispatch_draft(
+            positions, tables, active, fed, n_feed, temps, top_ks, top_ps,
+            min_ps=min_ps, seeds=seeds if np.any(seeds) else None,
+        )
+        toks = np.asarray(toks_dev)
+        dt = time.monotonic() - t0
+        self.stage.spec_draft_calls += 1
+        self.stage.spec_draft_s += dt
+        if tracing.enabled():
+            tracing.record_span(
+                "engine.spec.draft", t0, duration=dt,
+                request_id=live[0][0].req.request_id,
+                trace_id=live[0][0].req.trace_id,
+                attrs={"participants": len(live), "k": K},
+            )
+        for cand in live:
+            seq, _, _, max_d = cand
+            cand[2] = toks[seq.slot, :max_d].tolist()
+        return qs_dev
+
     def _dispatch_spec_round(self, outputs: list[StepOutput]) -> int:
         """One speculative verify round over every spec-mode decode slot.
 
-        Per slot: propose up to k draft tokens from the sequence's own
-        prompt+output history, feed [anchor, drafts...] at consecutive fed
-        positions through ONE multi-query verify pass, and emit the accepted
-        prefix plus the correction/bonus token (1..k+1 tokens). Rounds are
-        synchronous — the next proposal needs this round's accepted tokens —
-        so the host tracks materialized positions exactly; KV written for
-        rejected drafts is overwritten by the next round at the advanced
-        anchor. Returns 1 when a round ran (the step loop's dispatch count)."""
+        Per slot: propose up to k draft tokens — from the sequence's own
+        history (n-gram suffix index) or, in draft-model mode, from one
+        batched on-device drafting dispatch shared by every lane — then feed
+        [anchor, drafts...] at consecutive fed positions through ONE
+        multi-query verify pass, and emit the accepted prefix plus the
+        correction/bonus token (1..k+1 tokens). Rounds are synchronous — the
+        next proposal needs this round's accepted tokens — so the host
+        tracks materialized positions exactly; KV written for rejected
+        drafts (in the target AND the draft cache) is overwritten by the
+        next round at the advanced anchor. Returns 1 when a round ran (the
+        step loop's dispatch count)."""
         K = self.spec.k
-        candidates = []
+        draft_mode = self.spec.kind == "draft"
+        candidates = []  # mutable [seq, p, drafts, max_d] records
         for seq in sorted(
             [s for s in self.slots if s is not None], key=lambda s: s.admitted_order
         ):
@@ -1107,16 +1270,16 @@ class Scheduler:
             p = seq.prompt_len + len(seq.generated) - 1  # anchor fed position
             if budget <= 0 or p >= self.config.max_model_len:
                 continue
-            max_d = min(K, budget - 1, self.config.max_model_len - 1 - p)
-            drafts = (
-                self.proposer.propose(seq.req.token_ids + seq.generated, max_d)
-                if max_d > 0
-                else []
-            )
-            # page capacity for the fed rows (anchor..anchor+len(drafts));
+            max_d = max(0, min(K, budget - 1, self.config.max_model_len - 1 - p))
+            if draft_mode:
+                drafts = None  # filled by the batched draft dispatch below
+            else:
+                drafts = self._propose_ngram(seq, max_d) if max_d > 0 else []
+                max_d = len(drafts)
+            # page capacity for the fed rows (anchor..anchor+max_d);
             # same pressure ladder as the window path: drain the pipeline,
             # then preempt, then shrink the proposal to the allocated pages
-            need = p + len(drafts) + 1
+            need = p + max_d + 1
             while self.slots[seq.slot] is seq and not self.allocator.ensure_capacity(
                 seq.req.request_id, need
             ):
@@ -1129,7 +1292,9 @@ class Scheduler:
                     cap = self.allocator._seqs[seq.req.request_id].num_pages * \
                         self.config.page_size
                     if cap > p:
-                        drafts = drafts[: cap - 1 - p]
+                        max_d = min(max_d, cap - 1 - p)
+                        if drafts is not None:
+                            drafts = drafts[:max_d]
                         break
                     outputs.extend(self._finish(seq, "error"))
                     break
@@ -1137,21 +1302,30 @@ class Scheduler:
             if self.slots[seq.slot] is not seq or seq.finished:
                 continue
             self._refresh_table(seq)
-            candidates.append((seq, p, drafts))
+            candidates.append([seq, p, drafts, max_d])
         # a later candidate's page-pressure preemption can evict an earlier
         # one mid-pass; only still-live slots ride the verify call
         candidates = [
-            (s, p, d) for s, p, d in candidates
-            if not s.finished and self.slots[s.slot] is s
+            c for c in candidates
+            if not c[0].finished and self.slots[c[0].slot] is c[0]
         ]
         if not candidates:
             return 0
+
+        draft_probs = None
+        if draft_mode:
+            draft_probs = self._dispatch_draft_phase(candidates, K)
+            for c in candidates:
+                if c[2] is None:  # lane did not draft (dead/empty budget)
+                    c[2], c[3] = [], 0
+                else:
+                    c[3] = len(c[2])
 
         B = self.config.max_seqs
         # per-round table width: the widest participant's ladder rung (narrow
         # sequences zero-pad into the trash page)
         W = self.config.table_bucket_for(
-            max(len(s.page_table) for s, _, _ in candidates)
+            max(len(s.page_table) for s, _, _, _ in candidates)
         )
         self._count_table_dispatch(W)
         positions = np.zeros(B, np.int32)
@@ -1165,7 +1339,7 @@ class Scheduler:
         min_ps = np.zeros(B, np.float32)
         seeds = np.zeros(B, np.int32)
         snapshot = []
-        for seq, p, drafts in candidates:
+        for seq, p, drafts, _ in candidates:
             i = seq.slot
             positions[i] = p
             page_tables[i, : len(seq.page_table)] = seq.page_table
@@ -1180,12 +1354,13 @@ class Scheduler:
             top_ps[i] = s.top_p
             min_ps[i] = s.min_p
             seeds[i] = fold_seed(s.seed)
-            snapshot.append((seq, i, len(drafts)))
+            snapshot.append((seq, i, len(drafts), p))
 
         t0 = time.monotonic()
         out_dev, n_emit_dev = self.runner.dispatch_verify(
             positions, page_tables, active, fed, n_drafts, temps, top_ks,
             top_ps, min_ps=min_ps, seeds=seeds if np.any(seeds) else None,
+            draft_probs=draft_probs,
         )
         tokens = np.asarray(out_dev)
         n_emit = np.asarray(n_emit_dev)
@@ -1194,7 +1369,7 @@ class Scheduler:
         st.spec_rounds += 1
         st.spec_dispatch_s += dt
         round_proposed = round_accepted = 0
-        for seq, i, proposed in snapshot:
+        for seq, i, proposed, p in snapshot:
             if seq.finished:
                 continue  # EOS/cancel raced in via a drain above
             emitted = int(n_emit[i])
@@ -1205,6 +1380,11 @@ class Scheduler:
             round_proposed += proposed
             round_accepted += accepted
             self.stage_hist["spec_accept"].observe(accepted)
+            if draft_mode and seq.draft_pos is not None:
+                # accepted draft rows are already fed in the draft cache;
+                # the correction/bonus token is next round's catch-up feed,
+                # and rejected rows get overwritten at the advanced anchor
+                seq.draft_pos = p + 1 + accepted
             for j in range(emitted):
                 outputs.extend(self._emit_token(seq, int(tokens[i, j])))
                 if seq.finished:
@@ -1217,7 +1397,7 @@ class Scheduler:
                 attrs={
                     "participants": len(snapshot), "k": K,
                     "proposed": round_proposed, "accepted": round_accepted,
-                    "requests": [s.req.request_id for s, _, _ in snapshot],
+                    "requests": [s.req.request_id for s, _, _, _ in snapshot],
                 },
             )
         return 1
@@ -1509,6 +1689,7 @@ class Scheduler:
     def _release(self, seq: RunningSeq, count_finished: bool = True) -> None:
         seq.finished = True
         self._cancel_fetch(seq)
+        self._free_draft(seq)
         self.allocator.free_sequence(seq.req.request_id)
         if seq.slot >= 0 and self.slots[seq.slot] is seq:
             self.slots[seq.slot] = None
@@ -1531,6 +1712,9 @@ class Scheduler:
         self.preempt_count += 1
         seq.finished = True  # stray in-flight snapshots must skip it
         self._cancel_fetch(seq)
+        # the draft cache dies with the slot; re-admission rebuilds it from
+        # the (prompt + generated) resume prompt at the first spec round
+        self._free_draft(seq)
         self.allocator.free_sequence(seq.req.request_id)
         if seq.slot >= 0 and self.slots[seq.slot] is seq:
             self.slots[seq.slot] = None

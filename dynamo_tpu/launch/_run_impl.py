@@ -58,6 +58,7 @@ def engine_config_for(args):
     long_ctx = dict(
         prefill_flat_depth=getattr(args, "prefill_flat_depth", None) or 8192,
         host_cache_blocks=getattr(args, "host_cache_blocks", None) or 0,
+        host_cache_bytes=getattr(args, "host_cache_bytes", None) or 0,
         offload_watermark=getattr(args, "offload_watermark", None) or 0.90,
     )
     if pb:

@@ -37,10 +37,12 @@ def build_parser() -> argparse.ArgumentParser:
              "the same budget (composes with --quantize)",
     )
     run.add_argument(
-        "--speculative", default=None, metavar="ngram:k",
-        help="speculative decoding: propose k draft tokens per step from the "
-             "sequence's own history (prompt-lookup) and verify them in one "
-             "batched forward pass (e.g. ngram:4)",
+        "--speculative", default=None, metavar="KIND:...",
+        help="speculative decoding: ngram:<k> proposes from the sequence's "
+             "own history (prompt-lookup); draft:<model>:<k> loads a second, "
+             "smaller registry model that drafts k tokens per round in one "
+             "batched on-device dispatch (composes with --quantize / "
+             "--kv-cache-dtype); both verify in one batched forward pass",
     )
     run.add_argument("--max-tokens", type=int, default=None, help="batch mode default max_tokens")
     run.add_argument(

@@ -1,0 +1,316 @@
+"""Draft-model speculative decoding: the device side.
+
+``--speculative draft:<model>:<k>`` loads a second, smaller model through the
+registry (with the engine's ``quantize`` / ``kv_cache_dtype``, so the draft
+composes with int8 weights and the int8 KV cache) and drafts k tokens per
+spec round for EVERY spec-mode lane in one batched, donated, jit'd dispatch —
+no per-sequence Python in the round's hot path.
+
+The draft keeps its own paged KV:
+
+  - a separate page pool (same page_size / num_pages geometry as the target,
+    page 0 reserved as the trash page) with a minimal per-sequence free-list
+    allocator — no prefix cache: draft KV is cheap to recompute and its only
+    reader is the next draft round;
+  - per-sequence draft page tables sized by the SAME width ladder as the
+    target (config.table_bucket_for), so a short chat dispatches a narrow
+    draft table and only deep sequences pay wide gathers;
+  - rejected draft rows are simply overwritten by the next round's feeds at
+    the advanced anchor — exactly the target verify pass's KV discipline.
+
+Per round, one ``draft_step`` dispatch does BOTH phases on device:
+
+  1. catch-up: feed the tokens the target emitted since the draft's last fed
+     position (always the single correction/bonus token in steady state)
+     through the draft model's multi-query ``verify`` pass, landing on the
+     logits for the next position;
+  2. drafting: a ``lax.scan`` of k single-token decode steps, each sampling
+     a draft token from the draft's FILTERED distribution (the request's
+     temperature/top-k/top-p/min-p — the q the acceptance rule needs) and
+     feeding it back. The full q rows ride back as a [B, K, V] device array
+     that flows straight into the verify pass's acceptance — they never
+     touch the host.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.sampling import _NEG_INF, filter_keep_mask
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("spec.draft")
+
+#: fold base for draft-token sampling streams. MUST differ from the
+#: acceptance stream's base (sampling.accept_speculative, 0x5EC5) and the
+#: window sampler's (0x5EED): rejection sampling is exact only when the
+#: accept/reject uniforms are independent of the draws that produced the
+#: drafts.
+_DRAFT_KEY_BASE = 0xD4AF
+
+
+class DraftModelRunner:
+    """Owns the draft model's params, paged KV pool, page bookkeeping, and
+    the jitted prefill / draft-step dispatches. Built by ModelRunner when
+    ``config.spec.kind == "draft"``; every method runs on the engine thread.
+    """
+
+    def __init__(self, config, spec, compile_monitor=None):
+        from dynamo_tpu.models.registry import load_model
+
+        self.config = config
+        self.spec = spec
+        self.model, self.params = load_model(
+            spec.model, quantize=config.quantize,
+            kv_cache_dtype=config.kv_cache_dtype,
+        )
+        self.kv = self.model.init_kv_cache(config.num_pages, config.page_size)
+        # minimal page allocator: page 0 is the trash page, everything else
+        # free-listed per sequence (no sharing, no prefix cache)
+        self._free: list[int] = list(range(config.num_pages - 1, 0, -1))
+        self._pages: dict[str, list[int]] = {}
+        self._key = jax.random.key(_DRAFT_KEY_BASE)
+        # telemetry (dynamo_spec_draft_*): dispatch seconds land in the
+        # scheduler's StageStats; pool occupancy is read from here
+        self.prefills = 0
+
+        from dynamo_tpu.utils.compile_monitor import monitored_jit
+
+        def _mjit(label, fn, **kw):
+            # monitor=None is a passthrough; otherwise draft compiles land in
+            # the same compile-churn gauges as the target runner's
+            return monitored_jit(jax.jit(fn, **kw), label, compile_monitor)
+
+        self._prefill = _mjit(
+            "draft_prefill", self._prefill_impl,
+            donate_argnums=(1,), static_argnames=("mp",),
+        )
+        self._draft = _mjit("draft_step", self._draft_impl, donate_argnums=(1,))
+
+    # ---------------- page bookkeeping ----------------
+
+    @property
+    def pages_total(self) -> int:
+        return self.config.num_pages - 1
+
+    @property
+    def pages_used(self) -> int:
+        return self.pages_total - len(self._free)
+
+    def pages_of(self, seq_id: str) -> list[int] | None:
+        return self._pages.get(seq_id)
+
+    def ensure_capacity(self, seq_id: str, length: int) -> bool:
+        """Pages to hold ``length`` draft-timeline tokens. False on OOM
+        (nothing partially taken — the caller drops the sequence's draft
+        state and the round degrades to verify-only)."""
+        pages = self._pages.setdefault(seq_id, [])
+        need = -(-length // self.config.page_size) - len(pages)
+        if need <= 0:
+            return True
+        if need > len(self._free):
+            return False
+        for _ in range(need):
+            pages.append(self._free.pop())
+        return True
+
+    def free_sequence(self, seq_id: str) -> None:
+        pages = self._pages.pop(seq_id, None)
+        if pages:
+            self._free.extend(pages)
+
+    def table_for(self, seq_id: str) -> np.ndarray:
+        """Page table at the sequence's current width-ladder rung."""
+        pages = self._pages.get(seq_id, [])
+        table = np.zeros(self.config.table_bucket_for(max(1, len(pages))), np.int32)
+        table[: len(pages)] = pages
+        return table
+
+    # ---------------- jitted bodies ----------------
+
+    def _prefill_impl(self, params, kv, ints, mp=None):
+        """One draft prefill chunk: ints [bucket + mp + 2] = token buf, page
+        table, (start_pos, n_real). KV-write only — the chunk's logits are
+        dead (XLA DCEs the unembed); the first draft round's catch-up feed
+        re-feeds the last prompt token and samples from there."""
+        if mp is None:
+            mp = self.config.max_pages_per_seq
+        bucket = ints.shape[0] - mp - 2
+        tokens = ints[:bucket]
+        page_table = ints[bucket : bucket + mp]
+        start = ints[bucket + mp]
+        n = ints[bucket + mp + 1]
+        positions = start + jnp.arange(bucket, dtype=jnp.int32)
+        valid = jnp.arange(bucket) < n
+        _, kv = self.model.prefill(
+            params, kv, tokens, positions, page_table, valid, n - 1
+        )
+        return kv
+
+    def _draft_impl(self, params, kv, ints, flts, key):
+        """Catch-up feed + k-step autoregressive drafting for all lanes.
+
+        ``ints`` [5 + (K+1) + W, B] = positions (first catch-up fed position),
+        active, n_feed, top_ks, seeds, the K+1 catch-up token rows, then the
+        transposed draft page tables (W = the round's ladder width, static
+        via shape; K is config-static). ``flts`` [3, B] = temps, top_ps,
+        min_ps. Returns (draft tokens [B, K], draft probs q [B, K, V], kv):
+        q[:, j] is the filtered distribution token j+1 was sampled from —
+        the exact q the rejection-sampling acceptance divides by."""
+        K = self.spec.k
+        K1 = K + 1
+        positions = ints[0]
+        active = ints[1].astype(bool)
+        n_feed = ints[2]
+        top_ks = ints[3]
+        seeds = ints[4]
+        fed = ints[5 : 5 + K1].T  # [B, K1]
+        page_tables = ints[5 + K1 :].T  # [B, W]
+        temps, top_ps, min_ps = flts[0], flts[1], flts[2]
+        B = positions.shape[0]
+
+        # phase 1: multi-query catch-up (rows past n_feed land on the trash
+        # page); logits at row n_feed-1 predict the first draft token
+        t_idx = jnp.arange(K1, dtype=jnp.int32)
+        pos_mat = positions[:, None] + t_idx[None, :]
+        row_valid = active[:, None] & (t_idx[None, :] < n_feed[:, None])
+        logits_all, kv = self.model.verify(
+            params, kv, fed, pos_mat, page_tables, row_valid
+        )
+        b_idx = jnp.arange(B)
+        logits = logits_all[b_idx, jnp.maximum(n_feed - 1, 0)]  # [B, V]
+
+        # per-slot sampling keys: seeded slots fold (seed, anchor position)
+        # off the draft base so their drafts are deterministic across retries
+        # (and INDEPENDENT of the acceptance stream — different base);
+        # unseeded fold the slot index off this round's key
+        base = jax.random.key(_DRAFT_KEY_BASE)
+
+        def slot_key(i, seed, p):
+            seeded = jax.random.fold_in(jax.random.fold_in(base, seed), p)
+            unseeded = jax.random.fold_in(key, i)
+            return jax.lax.cond(seed != 0, lambda: seeded, lambda: unseeded)
+
+        slot_keys = jax.vmap(slot_key)(
+            jnp.arange(B, dtype=jnp.int32), seeds, positions
+        )
+        temp = jnp.where(temps > 0, temps, 1.0)[:, None]
+
+        def body(carry, j):
+            kv, logits, pos = carry
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            keep = filter_keep_mask(logits, temps, top_ks, top_ps, min_p=min_ps)
+            masked = jnp.where(keep, logits, _NEG_INF) / temp
+            q = jax.nn.softmax(masked, axis=-1)  # [B, V]
+            keys_j = jax.vmap(lambda k_: jax.random.fold_in(k_, j))(slot_keys)
+            sampled = jax.vmap(
+                lambda k_, row: jax.random.categorical(k_, row)
+            )(keys_j, masked).astype(jnp.int32)
+            tok = jnp.where(temps > 0, sampled, greedy)
+            # feed the draft token (writes its KV row; the row is correct for
+            # as long as the token survives acceptance, overwritten at the
+            # advanced anchor otherwise — same discipline as verify KV)
+            logits, kv = self.model.decode(
+                params, kv, tok, pos, page_tables, active
+            )
+            return (kv, logits, pos + 1), (tok, q)
+
+        (kv, _, _), (toks, qs) = jax.lax.scan(
+            body, (kv, logits, positions + n_feed), jnp.arange(K)
+        )
+        # scan stacks on the leading axis: [K, B] / [K, B, V] -> lane-major
+        return toks.T, jnp.swapaxes(qs, 0, 1), kv
+
+    # ---------------- host API (engine thread) ----------------
+
+    def _next_key(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def prefill_sequence(self, seq_id: str, tokens: list[int]) -> bool:
+        """Chunked draft prefill of a sequence's full history (no prefix
+        cache: the draft always recomputes — coherent by construction across
+        the target's host-offload restores and remote-prefill adoptions).
+        Returns False when the draft pool can't hold the history + one
+        round's drafts; nothing is left allocated on failure."""
+        self.free_sequence(seq_id)  # always a fresh build: no stale pages
+        n = len(tokens)
+        if not self.ensure_capacity(seq_id, n + self.spec.k + 1):
+            self.free_sequence(seq_id)
+            return False
+        table = self.table_for(seq_id)
+        mp = len(table)
+        start = 0
+        while start < n:
+            end = min(start + self.config.chunk_len_for(start), n)
+            bucket = self.config.bucket_for(end - start)
+            ints = np.zeros(bucket + mp + 2, np.int32)
+            ints[: end - start] = tokens[start:end]
+            ints[bucket : bucket + mp] = table
+            ints[bucket + mp] = start
+            ints[bucket + mp + 1] = end - start
+            self.kv = self._prefill(self.params, self.kv, jnp.asarray(ints), mp=mp)
+            start = end
+        self.prefills += 1
+        return True
+
+    def dispatch_draft(
+        self,
+        positions: np.ndarray,  # [B] first catch-up fed position per lane
+        page_tables: np.ndarray,  # [B, W] draft page tables at the round's rung
+        active: np.ndarray,  # [B] bool
+        fed_tokens: np.ndarray,  # [B, K+1] catch-up tokens (V-padded tail)
+        n_feed: np.ndarray,  # [B] real catch-up token count (>= 1 when active)
+        temps: np.ndarray,
+        top_ks: np.ndarray,
+        top_ps: np.ndarray,
+        min_ps: np.ndarray | None = None,
+        seeds: np.ndarray | None = None,
+    ):
+        """One batched draft round over every lane. Returns (draft tokens
+        [B, K] device array, draft probs [B, K, V] device array). The caller
+        materializes the tokens (it must build the verify feed) and passes
+        the prob rows STRAIGHT into dispatch_verify — they stay on device."""
+        B = positions.shape[0]
+        K1 = self.spec.k + 1
+        ints = np.empty((5 + K1 + page_tables.shape[1], B), np.int32)
+        ints[0] = positions
+        ints[1] = active
+        ints[2] = np.maximum(n_feed, 1)
+        ints[3] = top_ks
+        ints[4] = seeds if seeds is not None else 0
+        ints[5 : 5 + K1] = fed_tokens.T
+        ints[5 + K1 :] = page_tables.T
+        flts = np.empty((3, B), np.float32)
+        flts[0] = temps
+        flts[1] = top_ps
+        flts[2] = min_ps if min_ps is not None else 0.0
+        toks, qs, self.kv = self._draft(
+            self.params, self.kv, jnp.asarray(ints), jnp.asarray(flts),
+            self._next_key(),
+        )
+        try:
+            toks.copy_to_host_async()
+        except Exception:
+            pass
+        return toks, qs
+
+    def warmup(self) -> None:
+        """Compile the draft-step executable (first-rung width) and the
+        smallest prefill bucket; all lanes inactive / writes on the trash
+        page, so the calls execute harmlessly."""
+        B = self.config.max_seqs
+        W = self.config.table_buckets[0]
+        out = self.dispatch_draft(
+            np.zeros(B, np.int32), np.zeros((B, W), np.int32),
+            np.zeros(B, bool), np.zeros((B, self.spec.k + 1), np.int32),
+            np.ones(B, np.int32), np.zeros(B, np.float32),
+            np.zeros(B, np.int32), np.ones(B, np.float32),
+        )
+        jax.block_until_ready(out[0])
+        b = self.config.prefill_buckets[0]
+        ints = np.zeros(b + W + 2, np.int32)
+        ints[b + W + 1] = 1
+        self.kv = self._prefill(self.params, self.kv, jnp.asarray(ints), mp=W)

@@ -283,14 +283,34 @@ def _sample_surfaces() -> list[tuple[str, str]]:
     from dynamo_tpu.engine.page_table import PageAllocator
     from dynamo_tpu.engine.scheduler import Scheduler
 
+    # speculative = draft so the dynamo_spec_* and dynamo_spec_draft_*
+    # families render (the draft runner itself is faked below — building a
+    # real one would load a model, which the cluster-free gate must not do)
     cfg = EngineConfig(model_id="tiny", page_size=4, num_pages=8, max_seqs=2,
-                       prefill_buckets=(16,))
+                       prefill_buckets=(16,), speculative="draft:tiny:2")
     eng = AsyncJaxEngine(cfg)
     eng.allocator = PageAllocator(cfg.num_pages, cfg.page_size)
     eng.scheduler = Scheduler(cfg, None, eng.allocator)
     for name in ("queue_wait", "ttft", "prefill", "decode_window", "reconcile"):
         eng.scheduler.stage_hist[name].observe(0.01)
     eng.scheduler.stage.prefill_s = 0.5
+    eng.scheduler.stage.spec_proposed = 8
+    eng.scheduler.stage.spec_accepted = 6
+    eng.scheduler.stage.spec_draft_calls = 2
+    eng.scheduler.stage.spec_draft_s = 0.01
+
+    class _DraftPool:
+        pages_total, pages_used = 7, 3
+
+    class _SpecRunner:  # shape resource_snapshot actually reads
+        draft = _DraftPool()
+        model = None
+        compile_monitor = None
+
+        def hbm_stats(self):
+            return {}
+
+    eng.runner = _SpecRunner()
     surfaces.append(("engine.render_stage_metrics", eng.render_stage_metrics()))
 
     # disagg KV data-plane server/client + prefill worker send side

@@ -64,6 +64,11 @@ def _fill_representative(bench):
         "parity_64k_ladder_vs_dense": True,
         "short_ttft_ratio_ladder_over_dense": 0.169,
     }
+    bench.DETAIL["spec_draft"] = {
+        "tok_s_draft": 4123.45, "tok_s_ngram": 3356.71, "tok_s_classic": 3310.02,
+        "speedup_draft_over_classic": 1.246, "acceptance_rate_draft": 0.9873,
+        "acceptance_rate_ngram": 0.0512, "greedy_parity_draft": 1.0,
+    }
 
 
 def test_summary_line_fits_truncation_budget(bench_mod, tmp_path, monkeypatch):

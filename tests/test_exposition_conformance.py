@@ -89,6 +89,25 @@ def test_engine_surface_carries_long_context_families():
     assert "# TYPE dynamo_engine_offload_pressure_blocks_total counter" in text
 
 
+def test_engine_surface_carries_spec_draft_families():
+    """The draft-model speculation telemetry must stay on the conformance-
+    checked engine surface: drafting seconds by phase, dispatch/prefill
+    counters, the draft model's own KV page pool, and acceptance labeled by
+    proposer kind (all validated by `tools/lint.sh --check` through the same
+    surface list)."""
+    text = dict(_SURFACES)["engine.render_stage_metrics"]
+    assert "# TYPE dynamo_spec_draft_seconds_total counter" in text
+    assert 'dynamo_spec_draft_seconds_total{phase="dispatch"}' in text
+    assert 'dynamo_spec_draft_seconds_total{phase="prefill"}' in text
+    assert "# TYPE dynamo_spec_draft_dispatch_total counter" in text
+    assert "# TYPE dynamo_spec_draft_prefill_total counter" in text
+    assert "# TYPE dynamo_spec_draft_pages gauge" in text
+    assert 'dynamo_spec_draft_pages{state="total"}' in text
+    assert 'dynamo_spec_draft_pages{state="used"}' in text
+    assert "# TYPE dynamo_spec_acceptance_ratio gauge" in text
+    assert 'dynamo_spec_acceptance_ratio{proposer="draft"}' in text
+
+
 def test_colocated_composition_has_no_family_collisions():
     """The in=http serving path concatenates HTTP metrics + frontend SLO +
     engine stage/resource/health/SLO families into one /metrics document;

@@ -88,6 +88,41 @@ def test_page_capacity_doubles_at_equal_hbm_budget():
     assert kv_page_bytes(*args, "bf16") == bf16
 
 
+def test_host_capacity_blocks_resolve_at_actual_wire_dtype():
+    """PR-8 follow-up satellite: the host tier's byte budget divides by the
+    model's ACTUAL per-page wire cost, not an assumed-bf16 page — so the
+    same DRAM budget holds ~2x blocks under an int8 KV cache, and the
+    watermark-drain targets operate on a truthful capacity."""
+    from dynamo_tpu.engine.offload import resolve_host_capacity_blocks
+
+    args = (128, 8, 128, 24)  # ps, Hkv, D, L
+    bf16, int8 = kv_page_bytes(*args, None), kv_page_bytes(*args, "int8")
+    budget = 1 << 30
+    blocks_bf16 = resolve_host_capacity_blocks(0, budget, bf16)
+    blocks_int8 = resolve_host_capacity_blocks(0, budget, int8)
+    assert blocks_bf16 == budget // bf16
+    assert blocks_int8 == budget // int8
+    assert 1.9 <= blocks_int8 / blocks_bf16 <= 2.0
+    # when both knobs are set the LARGER resolved capacity wins, either way
+    assert resolve_host_capacity_blocks(10, budget, int8) == blocks_int8
+    assert resolve_host_capacity_blocks(blocks_int8 + 7, budget, int8) \
+        == blocks_int8 + 7
+    # a model without page-cost accounting can't honor a byte budget: the
+    # engine passes budget_bytes=0 and the explicit block knob stands alone
+    assert resolve_host_capacity_blocks(16, 0, 0) == 16
+    assert resolve_host_capacity_blocks(0, 0, bf16) == 0
+
+
+def test_engine_config_validates_host_cache_bytes():
+    from dynamo_tpu.engine.config import EngineConfig
+
+    assert EngineConfig(host_cache_bytes=1 << 30).host_cache_bytes == 1 << 30
+    with pytest.raises(ValueError, match="host cache"):
+        EngineConfig(host_cache_bytes=-1)
+    with pytest.raises(ValueError, match="host cache"):
+        EngineConfig(host_cache_blocks=-2)
+
+
 def test_engine_config_validates_kv_cache_dtype():
     from dynamo_tpu.engine.config import EngineConfig
 

@@ -154,6 +154,43 @@ def test_offload_batched_restore_odd_block_count():
     asyncio.run(body())
 
 
+def test_host_cache_bytes_budget_resolves_at_model_page_cost():
+    """A byte-budget host tier resolves capacity from the model's ACTUAL
+    kv_page_bytes at engine init (the PR-8 follow-up): the pool's block
+    capacity, the per-block bytes, and the resident-bytes gauge all ride
+    resource_snapshot — and the same budget holds ~2x blocks under int8."""
+    async def capacity(**cfg_over):
+        eng = AsyncJaxEngine(tiny_engine_config(num_pages=13, max_seqs=2,
+                                                **cfg_over))
+        await eng.start()
+        try:
+            page_bytes = eng.model.kv_page_bytes(eng.config.page_size)
+            snap = eng.resource_snapshot()
+            assert eng.offload is not None
+            assert eng.offload.block_bytes == page_bytes
+            assert snap["offload_capacity_blocks"] == eng.offload.capacity_blocks
+            assert snap["offload_block_bytes"] == page_bytes
+            assert snap["offload_bytes_resident"] == 0  # nothing drained yet
+            return eng.offload.capacity_blocks, page_bytes
+        finally:
+            await eng.shutdown()
+
+    async def body():
+        budget = 1 << 20
+        blocks, page_bytes = await capacity(host_cache_bytes=budget)
+        assert blocks == budget // page_bytes
+        blocks8, page8 = await capacity(host_cache_bytes=budget,
+                                        kv_cache_dtype="int8")
+        assert blocks8 == budget // page8
+        assert blocks8 > blocks  # same budget, cheaper int8 pages
+        # both knobs set: the larger resolved capacity wins
+        big, _ = await capacity(host_cache_bytes=budget,
+                                host_cache_blocks=blocks + 1000)
+        assert big == blocks + 1000
+
+    asyncio.run(body())
+
+
 def test_load_many_device_roundtrip_with_bucket_padding():
     """HostKvPool.load_many against the REAL jitted scatter: 3 blocks pad to
     a 4-bucket whose pad id is far out of range — the donated scatter must

@@ -30,11 +30,29 @@ def test_parse_speculative():
     assert parse_speculative("ngram").k == 4
     assert parse_speculative("ngram:2").k == 2
     with pytest.raises(ValueError):
-        parse_speculative("draft:4")
+        parse_speculative("draft:4")  # a bare numeric segment is k, not a model
     with pytest.raises(ValueError):
         parse_speculative("ngram:0")
     with pytest.raises(ValueError):
         parse_speculative("ngram:99")
+
+
+def test_parse_speculative_draft():
+    cfg = parse_speculative("draft:tiny:3")
+    assert (cfg.kind, cfg.model, cfg.k) == ("draft", "tiny", 3)
+    assert parse_speculative("draft:tiny").k == 4  # default k
+    # model ids may themselves contain colons (tiny-override JSON, abs
+    # paths): only a purely-numeric LAST segment is k
+    js = 'tiny:{"num_layers": 2, "hidden_size": 64}'
+    cfg = parse_speculative(f"draft:{js}:2")
+    assert (cfg.model, cfg.k) == (js, 2)
+    assert parse_speculative(f"draft:{js}").model == js
+    cfg = parse_speculative("draft:/ckpt/dir:8")
+    assert (cfg.model, cfg.k) == ("/ckpt/dir", 8)
+    with pytest.raises(ValueError):
+        parse_speculative("draft")  # model id is mandatory
+    with pytest.raises(ValueError):
+        parse_speculative("draft:tiny:0")
 
 
 def test_engine_config_validates_speculative():
@@ -78,8 +96,11 @@ def test_ngram_proposer_most_recent_match_wins():
 
 def test_make_proposer_dispatch():
     assert isinstance(make_proposer(SpecConfig(kind="ngram")), NgramProposer)
+    # draft proposals are a batched device dispatch (ModelRunner.dispatch_
+    # draft), not a host-side Proposer — the scheduler gets None here
+    assert make_proposer(SpecConfig(kind="draft", model="tiny")) is None
     with pytest.raises(ValueError):
-        make_proposer(SpecConfig(kind="draft"))
+        make_proposer(SpecConfig(kind="eagle"))
 
 
 # ---------------- fold_seed regression (satellite) ----------------
@@ -109,7 +130,7 @@ def _one_hot_logits(rows, V, hi=9.0, lo=-9.0):
 
 
 def _accept(logits, drafts, n_drafts, temps, key=0, seeds=None, positions=None,
-            top_k=None, top_p=None, min_p=None):
+            top_k=None, top_p=None, min_p=None, draft_probs=None):
     B = logits.shape[0]
     out, n_emit = accept_speculative(
         jnp.asarray(logits), jnp.asarray(drafts, jnp.int32),
@@ -120,6 +141,9 @@ def _accept(logits, drafts, n_drafts, temps, key=0, seeds=None, positions=None,
         min_p=jnp.asarray(min_p if min_p is not None else np.zeros(B), jnp.float32),
         seeds=jnp.asarray(seeds if seeds is not None else np.zeros(B), jnp.int32),
         positions=jnp.asarray(positions if positions is not None else np.zeros(B), jnp.int32),
+        draft_probs=(
+            jnp.asarray(draft_probs, jnp.float32) if draft_probs is not None else None
+        ),
     )
     return np.asarray(out), np.asarray(n_emit)
 
@@ -201,6 +225,165 @@ def test_accept_seeded_streams_deterministic():
     np.testing.assert_array_equal(a_n, b_n)
     freq = np.bincount(a_out[:, 0], minlength=V) / B
     np.testing.assert_allclose(freq, target, atol=0.05)
+
+
+# ---------------- real-draft-prob acceptance (draft-model tentpole) --------
+
+
+#: chi-square critical value at alpha = 0.001 for df = 7 (V=8 bins - 1);
+#: a seeded run sits far below it when the marginal is the target p
+_CHI2_CRIT_DF7_P001 = 24.322
+
+
+def test_accept_draft_probs_greedy_stays_argmax_prefix():
+    """temperature == 0 must ignore draft_probs entirely: acceptance is the
+    argmax-prefix rule, token-identical to the one-hot (n-gram) path."""
+    V = 16
+    logits = np.stack([_one_hot_logits([3, 4, 5, 6], V)] * 2)
+    drafts = np.array([[3, 4, 5], [3, 0, 5]], np.int32)
+    n_drafts = np.array([3, 3], np.int32)
+    rng = np.random.default_rng(0)
+    q = rng.random((2, 3, V)).astype(np.float32)
+    q /= q.sum(-1, keepdims=True)
+    out, n_emit = _accept(logits, drafts, n_drafts, temps=np.zeros(2),
+                          draft_probs=q)
+    ref_out, ref_n = _accept(logits, drafts, n_drafts, temps=np.zeros(2))
+    np.testing.assert_array_equal(out, ref_out)
+    np.testing.assert_array_equal(n_emit, ref_n)
+    assert n_emit.tolist() == [4, 2]
+
+
+def test_accept_draft_probs_q_equals_p_always_accepts():
+    """When the draft distribution equals the target's, min(1, p/q) == 1:
+    every draft sampled from q is accepted and the bonus row samples — the
+    draft==target regime the greedy-parity e2e rides."""
+    V = 8
+    B = 512
+    row = np.linspace(1.5, -1.5, V).astype(np.float32)
+    p = np.exp(row) / np.exp(row).sum()
+    logits = np.tile(row, (B, 2, 1))
+    rng = np.random.default_rng(3)
+    drafts = rng.choice(V, size=(B, 1), p=p).astype(np.int32)
+    q = np.tile(p.astype(np.float32), (B, 1, 1))
+    _, n_emit = _accept(logits, drafts, np.ones(B, np.int32),
+                        temps=np.ones(B), draft_probs=q)
+    assert n_emit.tolist() == [2] * B
+
+
+def test_accept_draft_probs_distribution_exact_chi_square():
+    """Satellite: the full Leviathan/Chen rule against a REAL (non-one-hot)
+    draft distribution q must leave the emitted first token's marginal
+    exactly the target p. Drafts are sampled from q (as the draft model
+    does), acceptance divides by q, rejections resample the residual —
+    chi-square over a tiny vocab, seeded end to end."""
+    V = 8
+    B = 4096
+    row = np.array([2.0, 1.0, 0.5, 0.0, -0.5, -1.0, -1.5, -2.0], np.float32)
+    p = np.exp(row) / np.exp(row).sum()
+    # a deliberately mismatched draft: sharper AND shifted vs the target, so
+    # both accept (p/q < 1 and > 1) and residual branches get real traffic
+    q_row = np.roll(np.exp(2.0 * row), 2)
+    q_row /= q_row.sum()
+    rng = np.random.default_rng(11)
+    drafts = rng.choice(V, size=(B, 1), p=q_row).astype(np.int32)
+    logits = np.tile(row, (B, 2, 1))
+    q = np.tile(q_row.astype(np.float32), (B, 1, 1))
+    out, n_emit = _accept(logits, drafts, np.ones(B, np.int32),
+                          temps=np.ones(B), key=5, draft_probs=q)
+    counts = np.bincount(out[:, 0], minlength=V)
+    chi2 = float((((counts - B * p) ** 2) / (B * p)).sum())
+    assert chi2 < _CHI2_CRIT_DF7_P001, (
+        f"chi2 {chi2:.1f} vs crit {_CHI2_CRIT_DF7_P001} — emitted marginal "
+        f"deviates from the target distribution: {counts / B} vs {p}"
+    )
+    # both paths exercised: some drafts accepted, some rejected
+    assert 0 < int((n_emit == 2).sum()) < B
+
+
+def test_accept_draft_probs_residual_renormalizes():
+    """On rejection the resample comes from max(0, p - q) renormalized: mass
+    q covers is excluded, so a draft with q == p on its argmax never re-emits
+    the rejected token from the residual branch."""
+    V = 8
+    B = 2048
+    row = np.array([1.0, 1.0, -9.0, -9.0, -9.0, -9.0, -9.0, -9.0], np.float32)
+    p = np.exp(row) / np.exp(row).sum()  # ~[.5, .5, ~0...]
+    # q puts ALL its mass on token 0: p/q = .5 -> token-0 drafts accepted
+    # half the time; the residual max(0, p - q) zeroes token 0 entirely, so
+    # every rejection must emit token 1
+    q_row = np.zeros(V, np.float32)
+    q_row[0] = 1.0
+    drafts = np.zeros((B, 1), np.int32)
+    logits = np.tile(row, (B, 2, 1))
+    q = np.tile(q_row, (B, 1, 1))
+    out, n_emit = _accept(logits, drafts, np.ones(B, np.int32),
+                          temps=np.ones(B), key=9, draft_probs=q)
+    rejected = n_emit == 1
+    assert rejected.any() and (~rejected).any()
+    assert set(np.unique(out[rejected, 0]).tolist()) == {1}
+    # accept rate ~ p(0)/q(0) = 0.5 (4-sigma band at B=2048: +-0.044)
+    accept_rate = float((~rejected).mean())
+    assert abs(accept_rate - 0.5) < 0.05
+
+
+# ---------------- incremental n-gram index (satellite) ----------------
+
+
+def test_ngram_index_matches_stateless_propose():
+    """The incremental index must propose exactly what the stateless
+    full-history scan proposes, at every prefix, for histories that loop,
+    drift, and repeat with different continuations."""
+    from dynamo_tpu.spec.proposer import NgramIndex
+
+    rng = np.random.default_rng(42)
+    hist = rng.integers(0, 6, 400).tolist()  # small vocab -> dense matches
+    p = NgramProposer(max_ngram=3, min_ngram=1)
+    idx = NgramIndex([], max_ngram=3, min_ngram=1)
+    for i, t in enumerate(hist):
+        idx.append(t)
+        if i % 7 == 0:
+            assert idx.propose(4) == p.propose(hist[: i + 1], 4), f"prefix {i+1}"
+
+
+def test_ngram_index_seeded_matches_incremental():
+    from dynamo_tpu.spec.proposer import NgramIndex
+
+    hist = [1, 2, 3, 4, 1, 2, 3, 4, 1, 2]
+    seeded = NgramIndex(hist, max_ngram=4, min_ngram=1)
+    grown = NgramIndex(hist[:3], max_ngram=4, min_ngram=1)
+    grown.extend(hist[3:])
+    assert seeded.propose(5) == grown.propose(5) == NgramProposer().propose(hist, 5)
+
+
+def test_ngram_index_propose_cost_o_new_tokens():
+    """Satellite micro-benchmark: a spec round's propose cost must depend on
+    the tokens ACCEPTED since the last round, not the history length. The
+    index's ``work`` counter counts dict registrations + lookups — the round
+    cost at 8000 tokens of history must equal the round cost at 80."""
+    from dynamo_tpu.spec.proposer import NgramIndex
+
+    def round_cost(history_len: int, new_tokens: int) -> int:
+        rng = np.random.default_rng(history_len)
+        idx = NgramIndex(rng.integers(0, 50, history_len).tolist(),
+                         max_ngram=4, min_ngram=1)
+        before = idx.work
+        idx.extend(rng.integers(0, 50, new_tokens).tolist())  # accepted tokens
+        idx.propose(4)
+        return idx.work - before
+
+    # the hard bound: max_ngram registrations per new token + max_ngram
+    # propose lookups, INDEPENDENT of history length (the old stateless scan
+    # cost ~history * max_ngram window comparisons per round)
+    for hist_len in (80, 8000, 40000):
+        for new in (1, 5, 10):
+            cost = round_cost(hist_len, new)
+            assert cost <= 4 * new + 4, (
+                f"round cost {cost} at history={hist_len} new={new} exceeds "
+                f"the O(new tokens) bound {4 * new + 4}"
+            )
+    # 100x the history, same round cost (up to the <=max_ngram propose
+    # lookup variance from which n-gram length matches first)
+    assert abs(round_cost(8000, 5) - round_cost(80, 5)) <= 4
 
 
 # ---------------- stop strings over multi-token chunks (satellite) ----------
@@ -489,3 +672,177 @@ def test_spec_max_tokens_exact():
     toks, fin = results[0]
     assert len(toks) == 5
     assert fin == "length"
+
+
+# ---------------- draft-model speculation e2e (tentpole) ----------------
+
+#: NON-repetitive prompt: no token pair repeats, so prompt-lookup never
+#: matches and n-gram speculation degenerates to 1 token/round — the regime
+#: the draft-model proposer exists for
+ARBITRARY = [5, 9, 2, 7, 13, 3, 11, 17, 6, 1]
+
+
+def _run_engine_snap(cfg, requests):
+    """_run_engine + a resource_snapshot taken while the engine is live."""
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.engine.scheduler import EngineRequest
+
+    async def go():
+        eng = AsyncJaxEngine(cfg)
+        await eng.start()
+        try:
+            results = await asyncio.gather(*[
+                _collect(eng, EngineRequest(request_id=f"r{i}", **kw))
+                for i, kw in enumerate(requests)
+            ])
+            stage = eng.scheduler.stage
+            metrics_text = eng.render_stage_metrics()
+            snap = eng.resource_snapshot()
+        finally:
+            await eng.shutdown()
+        return results, stage, metrics_text, snap
+
+    return asyncio.run(go())
+
+
+@pytest.mark.slow
+def test_spec_draft_greedy_token_identical_nonrepetitive():
+    """draft == target model: every draft argmax equals the target argmax,
+    so greedy output must be token-identical to the classic engine AND
+    acceptance must be full — on a prompt where n-gram proposes nothing."""
+    greedy = dict(token_ids=list(ARBITRARY),
+                  sampling=SamplingParams(temperature=0.0, max_tokens=16))
+    base_results, _, _ = _run_engine(_tiny_cfg(), [greedy])
+    ref = base_results[0][0]
+    results, stage, text, snap = _run_engine_snap(
+        _tiny_cfg(speculative="draft:tiny:4"), [greedy]
+    )
+    got, _ = results[0]
+    assert got == ref, f"draft spec {got} != base {ref}"
+    assert stage.spec_rounds > 0 and stage.spec_draft_calls > 0
+    # draft==target accepts everything the budget allows
+    assert stage.spec_accepted == stage.spec_proposed > 0
+    # the draft families ride the engine exposition
+    assert 'dynamo_spec_draft_seconds_total{phase="dispatch"}' in text
+    assert 'dynamo_spec_acceptance_ratio{proposer="draft"}' in text
+    assert "dynamo_spec_draft_pages" in text
+    # acceptance criterion: draft KV pages visible in resource_snapshot();
+    # all sequences finished, so the pool drained back to empty
+    assert snap["spec_draft_pages_total"] > 0
+    assert snap["spec_draft_pages_used"] == 0
+    assert snap["spec_proposer"] == "draft"
+    assert snap["spec_acceptance_rate"] == 1.0
+
+
+@pytest.mark.slow
+def test_spec_draft_beats_ngram_acceptance_on_arbitrary_text():
+    """On non-repetitive text the n-gram proposer finds no suffix match
+    (zero proposals); the draft model keeps proposing and the verify pass
+    keeps accepting — the tentpole's reason to exist, pinned as a test."""
+    greedy = dict(token_ids=list(ARBITRARY),
+                  sampling=SamplingParams(temperature=0.0, max_tokens=12))
+    _, ngram_stage, _ = _run_engine(_tiny_cfg(speculative="ngram:4"), [greedy])
+    _, draft_stage, _, _ = _run_engine_snap(
+        _tiny_cfg(speculative="draft:tiny:4"), [greedy]
+    )
+    ngram_rate = ngram_stage.spec_accepted / max(1, ngram_stage.spec_proposed)
+    draft_rate = draft_stage.spec_accepted / max(1, draft_stage.spec_proposed)
+    assert draft_stage.spec_proposed > ngram_stage.spec_accepted
+    assert draft_rate > ngram_rate
+    assert draft_rate == 1.0  # draft == target
+
+
+@pytest.mark.slow
+def test_spec_draft_concurrent_and_seeded_reproducible():
+    # concurrent greedy requests stay isolated and classic-identical
+    reqs = [
+        dict(token_ids=[10 + 3 * i, 11, 25 + i, 7, 13 + 2 * i, 3, 19 + i],
+             sampling=SamplingParams(temperature=0.0, max_tokens=10))
+        for i in range(3)
+    ]
+    base_results, _, _ = _run_engine(_tiny_cfg(), reqs)
+    draft_results, _, _, _ = _run_engine_snap(
+        _tiny_cfg(speculative="draft:tiny:4"), reqs
+    )
+    for (b, _), (s, _) in zip(base_results, draft_results):
+        assert b == s
+    # temperature>0 + seed: the full (draft sampling + acceptance) pipeline
+    # must be deterministic end to end
+    req = dict(token_ids=list(ARBITRARY),
+               sampling=SamplingParams(temperature=0.9, seed=7, max_tokens=12))
+    a, _, _, _ = _run_engine_snap(_tiny_cfg(speculative="draft:tiny:4"), [req])
+    b, _, _, _ = _run_engine_snap(_tiny_cfg(speculative="draft:tiny:4"), [req])
+    assert a[0][0] == b[0][0]
+
+
+@pytest.mark.slow
+def test_spec_draft_eos_and_max_tokens_exact():
+    greedy = dict(token_ids=list(ARBITRARY),
+                  sampling=SamplingParams(temperature=0.0, max_tokens=16))
+    results, _, _ = _run_engine(_tiny_cfg(), [greedy])
+    ref = results[0][0]
+    eos = ref[5]
+    stop_req = dict(
+        token_ids=list(ARBITRARY), eos_token_ids=(eos,),
+        sampling=SamplingParams(temperature=0.0, max_tokens=16),
+    )
+    results, _, _, _ = _run_engine_snap(
+        _tiny_cfg(speculative="draft:tiny:4"), [stop_req]
+    )
+    got, fin = results[0]
+    assert fin == "stop"
+    assert got == ref[: ref.index(eos) + 1]
+    short = dict(token_ids=list(ARBITRARY),
+                 sampling=SamplingParams(temperature=0.0, max_tokens=5))
+    results, _, _, _ = _run_engine_snap(
+        _tiny_cfg(speculative="draft:tiny:4"), [short]
+    )
+    toks, fin = results[0]
+    assert len(toks) == 5 and fin == "length"
+
+
+@pytest.mark.slow
+def test_spec_draft_composes_with_int8_kv():
+    """The draft model loads with the engine's kv_cache_dtype: int8 KV on
+    BOTH caches must stay token-identical to the classic engine at the same
+    dtype (greedy, draft == target)."""
+    greedy = dict(token_ids=list(ARBITRARY),
+                  sampling=SamplingParams(temperature=0.0, max_tokens=12))
+    base_results, _, _ = _run_engine(_tiny_cfg(kv_cache_dtype="int8"), [greedy])
+    results, stage, _, _ = _run_engine_snap(
+        _tiny_cfg(kv_cache_dtype="int8", speculative="draft:tiny:4"), [greedy]
+    )
+    assert results[0][0] == base_results[0][0]
+    assert stage.spec_accepted == stage.spec_proposed > 0
+
+
+def test_dynotop_spec_column():
+    import importlib.util
+    from pathlib import Path
+
+    spec_mod = importlib.util.spec_from_file_location(
+        "dynotop", Path(__file__).resolve().parent.parent / "tools" / "dynotop.py"
+    )
+    dynotop = importlib.util.module_from_spec(spec_mod)
+    spec_mod.loader.exec_module(dynotop)
+
+    doc = {
+        "namespace": "ns", "component": "backend", "summary": {"workers": 1},
+        "workers": [{
+            "worker_id": "ab", "last_seen_s": 0.1, "missed_scrapes": 0,
+            "health": {"state": "ready", "heartbeat_age_s": 0.01},
+            "kv_metrics": {"request_active_slots": 1, "request_total_slots": 4,
+                           "kv_active_blocks": 1, "kv_total_blocks": 10},
+            "resources": {"spec_proposer": "draft",
+                          "spec_acceptance_rate": 0.872},
+        }],
+    }
+    text = dynotop.render_status(doc)
+    assert "SPEC" in text
+    assert "draft 87%" in text
+    doc["workers"][0]["resources"]["spec_proposer"] = "ngram"
+    assert "ngram 87%" in dynotop.render_status(doc)
+    # non-spec workers render a dash, not a crash
+    doc["workers"][0]["resources"] = {}
+    text = dynotop.render_status(doc)
+    assert "draft" not in text

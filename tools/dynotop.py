@@ -68,8 +68,8 @@ def render_status(doc: dict) -> str:
     ]
     header = (
         f"{'WORKER':<12} {'STATE':<10} {'HB':>6} {'SEEN':>6} {'MISS':>4} "
-        f"{'SLOTS':>7} {'KV%':>6} {'KVMEM':>11} {'PREFIX':>9} {'WAIT':>5} "
-        f"{'HBM':>9} {'CMPL':>5}  SLO"
+        f"{'SLOTS':>7} {'KV%':>6} {'KVMEM':>11} {'PREFIX':>9} {'SPEC':>10} "
+        f"{'WAIT':>5} {'HBM':>9} {'CMPL':>5}  SLO"
     )
     lines.append(header)
     lines.append("-" * len(header))
@@ -104,13 +104,21 @@ def render_status(doc: dict) -> str:
             prefix = f"{lpct:.0f}/{rpct:.0f}%"
         else:
             prefix = "-"
+        # speculative decoding: proposer kind + acceptance rate (what the
+        # verify passes actually keep), riding resource_snapshot's
+        # spec_proposer / spec_acceptance_rate; non-spec workers show "-"
+        kind = res.get("spec_proposer")
+        if kind:
+            spec = f"{str(kind)[:5]} {100.0 * res.get('spec_acceptance_rate', 0):.0f}%"
+        else:
+            spec = "-"
         hb = health.get("heartbeat_age_s")
         stale_mark = " STALE" if w.get("stale") else ""
         lines.append(
             f"{w.get('worker_id', '?'):<12} {glyph} {state:<8} "
             f"{(f'{hb:.1f}s' if hb is not None else '-'):>6} "
             f"{w.get('last_seen_s', 0):>5.1f}s {w.get('missed_scrapes', 0):>4} "
-            f"{slots:>7} {kv_pct:>5.1f}% {kv_mem:>11} {prefix:>9} "
+            f"{slots:>7} {kv_pct:>5.1f}% {kv_mem:>11} {prefix:>9} {spec:>10} "
             f"{kv.get('num_requests_waiting', 0):>5} "
             f"{_fmt_bytes(res.get('hbm_bytes_in_use', 0)):>9} "
             f"{res.get('xla_compiles', 0):>5}  {_slo_cell(w.get('slo'))}"
