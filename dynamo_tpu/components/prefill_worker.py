@@ -55,6 +55,17 @@ async def _main(args) -> None:
         )
     )
     await engine.start()
+    if engine.config.prefix_fetch and not getattr(args, "no_prefix_fetch", False):
+        # fleet prefix cache, prefill side (ROADMAP item 3 follow-up): when a
+        # queued request carries a router-attached holder, the prefill engine
+        # PULLS the prefix over the dataplane before recomputing it (same
+        # timeout -> recompute fallback as decode-side FETCHING_KV)
+        from dynamo_tpu.disagg.prefix_fetch import PrefixFetchClient
+
+        engine.attach_prefix_fetch(PrefixFetchClient(
+            asyncio.get_running_loop(),
+            timeout_s=engine.config.prefix_fetch_timeout_s,
+        ))
     worker = PrefillWorker(engine, drt, args.namespace, card.display_name)
     await worker.start()
 
@@ -114,6 +125,10 @@ def main(argv=None) -> None:
     p.add_argument("--no-kv-stream", action="store_true",
                    help="disable chunk-streamed KV transfer (one monolithic "
                         "post-prefill send per request)")
+    p.add_argument("--no-prefix-fetch", action="store_true",
+                   help="disable the prefill-side fleet prefix pull (always "
+                        "recompute instead of pulling a holder's cached "
+                        "prefix over the dataplane)")
     p.add_argument("--slo-ttft-ms", type=float, default=None,
                    help="TTFT SLO target in ms (env DYNTPU_SLO_TTFT_MS)")
     p.add_argument("--slo-itl-ms", type=float, default=None,

@@ -65,12 +65,20 @@ class ProcessorService:
     async def _handle(self, request: dict):
         token_ids = request.get("token_ids", [])
         instance_id = None
+        # multi-LoRA: the adapter uid salts every hash the routing decision
+        # uses, mirroring the engines' salted block identity — an adapter's
+        # requests only score overlap against that adapter's cached blocks
+        salt = 0
+        if request.get("lora_name"):
+            from dynamo_tpu.lora.adapter import lora_uid
+
+            salt = lora_uid(str(request["lora_name"]))
         if self.router is not None:
             try:
                 # routing-decision time is hop overhead a trace should see
                 with tracing.span("processor.schedule", tokens=len(token_ids)):
                     instance_id, overlap = await self.router.schedule_with_overlap(
-                        token_ids
+                        token_ids, salt=salt
                     )
                 # fleet-wide prefix cache: when a peer's cached prefix beats
                 # the chosen worker's, attach it so the worker can PULL the

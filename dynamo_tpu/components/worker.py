@@ -119,9 +119,30 @@ class WorkerService:
             self._registration = await ModelRegistration(
                 self.drt.cplane, entry, lease_id=self.drt.primary_lease.lease_id
             ).start()
+            # multi-LoRA: every configured adapter registers as its own
+            # servable model name <base>:<adapter> (same endpoint + card;
+            # frontends list and route them like any model; the worker
+            # resolves the suffix back to lora_name in _handle)
+            self._lora_registrations = []
+            if getattr(self.engine_config, "lora_adapters", ()):
+                from dynamo_tpu.lora.adapter import parse_adapter_specs
+
+                for name in parse_adapter_specs(self.engine_config.lora_adapters):
+                    a_entry = ModelEntry(
+                        name=f"{self.card.display_name}:{name}",
+                        endpoint=entry.endpoint,
+                        model_type="chat",
+                        card=self.card,
+                    )
+                    self._lora_registrations.append(await ModelRegistration(
+                        self.drt.cplane, a_entry,
+                        lease_id=self.drt.primary_lease.lease_id,
+                    ).start())
         return self
 
     async def stop(self) -> None:
+        for reg in getattr(self, "_lora_registrations", ()):
+            await reg.stop(unregister=False)
         if getattr(self, "_registration", None) is not None:
             # unregister=False: the card key is lease-tied, so OUR lease revoke
             # (DRT shutdown) removes it if we were the owner — while a clean
@@ -191,6 +212,20 @@ class WorkerService:
 
     async def _handle(self, request: dict):
         pre = PreprocessedRequest.from_wire(request)
+        # distributed-path base:adapter resolution: the frontend routes by
+        # registered model NAME; the worker maps the suffix back to the
+        # adapter it configured (exact display-name prefix match, so a tiny
+        # override JSON containing ':' can't misparse)
+        if not pre.lora_name and pre.model:
+            base_prefix = self.card.display_name + ":"
+            if str(pre.model).startswith(base_prefix):
+                suffix = str(pre.model)[len(base_prefix):]
+                from dynamo_tpu.lora.adapter import parse_adapter_specs
+
+                if suffix in parse_adapter_specs(
+                    getattr(self.engine_config, "lora_adapters", ())
+                ):
+                    pre.lora_name = suffix
         async for out in self.backend.generate(pre):
             yield {
                 "request_id": out.request_id,
@@ -236,6 +271,12 @@ async def _main(args) -> None:
             quantize=getattr(args, "quantize", None),
             kv_cache_dtype=getattr(args, "kv_cache_dtype", None),
             speculative=getattr(args, "speculative", None),
+            lora_adapters=tuple(
+                s.strip() for s in (getattr(args, "lora_adapters", "") or "").split(",")
+                if s.strip()
+            ),
+            max_loras=getattr(args, "max_loras", None) or 4,
+            lora_rank=getattr(args, "lora_rank", None) or 8,
             kv_stream=not getattr(args, "no_kv_stream", False),
             kv_stream_lanes=getattr(args, "kv_stream_lanes", None) or 2,
             prefix_fetch=not getattr(args, "no_prefix_fetch", False),
@@ -293,6 +334,17 @@ def main(argv=None) -> None:
                         "registry model with its own paged KV drafts k "
                         "tokens per round; composes with --quantize / "
                         "--kv-cache-dtype)")
+    p.add_argument("--lora-adapters", default="",
+                   help="comma-separated LoRA adapter specs served as "
+                        "<model>:<name> (name | name=<dir> | "
+                        "name=random:<seed>); a mixed-adapter batch decodes "
+                        "in one gathered dispatch (dynamo_tpu/lora/)")
+    p.add_argument("--max-loras", type=int, default=4,
+                   help="device adapter slots; more adapters than slots "
+                        "multiplex via LRU eviction/hot-swap")
+    p.add_argument("--lora-rank", type=int, default=8,
+                   help="adapter pool rank (smaller adapters zero-pad; "
+                        "larger are rejected at load)")
     p.add_argument("--disagg", action="store_true", help="wrap in the disagg decode path")
     p.add_argument("--slo-ttft-ms", type=float, default=None,
                    help="TTFT SLO target in ms (rolling percentiles + error "

@@ -146,8 +146,13 @@ class DisaggDecodeEngine:
         # engine.generate_batched, yet its queue-wait/TTFT/spans must exist
         AsyncJaxEngine._stamp_submission(request)
         prompt = list(request.token_ids)
+        salt = 0
+        if getattr(request, "lora_name", ""):
+            from dynamo_tpu.lora.adapter import lora_uid
+
+            salt = lora_uid(request.lora_name)
         prefix_hit = await self.engine.run_on_engine(
-            lambda: self.engine.sync_lookup_prefix(prompt)
+            lambda: self.engine.sync_lookup_prefix(prompt, salt=salt)
         )
         try:
             queue_depth = await self.drt.cplane.queue_depth(self.queue_name)
@@ -170,6 +175,10 @@ class DisaggDecodeEngine:
                 and not request.sampling.ignore_eos
                 and bool(request.eos_token_ids)
             )
+            # LoRA requests prefill locally: the remote engine would need the
+            # same adapter pinned and the salted block identity carried over
+            # the wire — the local scheduler already has both
+            or bool(getattr(request, "lora_name", ""))
             or not self.router.prefill_remote(len(prompt), prefix_hit, queue_depth)
         ):
             self.local_prefills += 1
@@ -258,6 +267,11 @@ class DisaggDecodeEngine:
                     kv_addr=self.kv_server.address,
                     kv_token=kv_token,
                     trace_id=request.trace_id or "",
+                    # the router's holder hint rides along: the prefill
+                    # worker pulls the prefix from the holder before
+                    # recomputing (its own min-advantage gate applies)
+                    kv_holder_addr=getattr(request, "kv_holder_addr", ""),
+                    kv_holder_blocks=getattr(request, "kv_holder_blocks", 0),
                 )
                 t_hop = time.monotonic()
                 await self.drt.cplane.queue_push(self.queue_name, rp.to_wire())

@@ -85,6 +85,22 @@ class EngineConfig:
     # min_tokens, or images fall back to the classic decode windows
     # automatically.
     speculative: str | None = None
+    # multi-LoRA multiplexing (dynamo_tpu/lora/): adapter specs served by
+    # this engine as ``<base>:<name>`` model names. Each spec is ``name``
+    # (deterministic synthetic adapter — tests/bench), ``name=<dir>`` (the
+    # canonical npz layer-stacked format), or ``name=random:<seed>``.
+    # Adapters load into device-resident stacked pools [L, max_loras+1, ...]
+    # and a mixed-adapter batch decodes in ONE gathered dispatch
+    # (y += scale * (x @ A[ids]) @ B[ids]; slot 0 = the zero adapter for
+    # base-only lanes). Non-resident adapters load asynchronously (their
+    # requests wait; everyone else keeps serving) and LRU-evict to host.
+    # () = LoRA disabled (no pool, traces unchanged).
+    lora_adapters: tuple = ()
+    # device adapter slots (excluding the reserved zero slot): more adapters
+    # than slots multiplex through LRU eviction/hot-swap
+    max_loras: int = 4
+    # pool rank: adapters with smaller r zero-pad (exact); larger r rejected
+    lora_rank: int = 8
     # cross-process disaggregation data plane (dynamo_tpu/disagg/dataplane.py):
     # stream KV to the decode worker per finished prefill chunk (v2 multi-part
     # wire protocol) instead of one monolithic post-prefill send. Streaming
@@ -215,6 +231,26 @@ class EngineConfig:
             raise ValueError(
                 f"page_table_buckets must be positive; got {self.page_table_buckets}"
             )
+        if self.lora_adapters:
+            if isinstance(self.lora_adapters, str):
+                # yaml/CLI comma form normalizes here so every consumer sees
+                # a tuple of specs
+                self.lora_adapters = tuple(
+                    s.strip() for s in self.lora_adapters.split(",") if s.strip()
+                )
+            else:
+                self.lora_adapters = tuple(self.lora_adapters)
+            if self.max_loras < 1:
+                raise ValueError(f"max_loras must be >= 1; got {self.max_loras}")
+            if self.lora_rank < 1:
+                raise ValueError(f"lora_rank must be >= 1; got {self.lora_rank}")
+            if self.pp > 1:
+                # the pipeline shard_map's explicit _layer path has no LoRA
+                # threading yet; fail at config time
+                raise ValueError("lora_adapters do not compose with pp > 1 yet")
+            from dynamo_tpu.lora.adapter import parse_adapter_specs
+
+            parse_adapter_specs(self.lora_adapters)  # bad specs fail HERE
         # a bad speculative spec must fail at config time, not mid-serving
         self.spec  # noqa: B018 — parse_speculative raises on invalid input
 
@@ -228,6 +264,10 @@ class EngineConfig:
     @property
     def kv_quantized(self) -> bool:
         return self.kv_cache_dtype == "int8"
+
+    @property
+    def lora_enabled(self) -> bool:
+        return bool(self.lora_adapters)
 
     @property
     def max_pages_per_seq(self) -> int:

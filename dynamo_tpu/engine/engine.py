@@ -297,8 +297,8 @@ class AsyncJaxEngine:
     # The decode side allocates pages and adopts; the prefill side computes KV
     # in its own cache and extracts blocks. See dynamo_tpu/disagg/.
 
-    def sync_lookup_prefix(self, token_ids: list[int]) -> int:
-        return self.allocator.lookup_prefix(token_ids)
+    def sync_lookup_prefix(self, token_ids: list[int], salt: int = 0) -> int:
+        return self.allocator.lookup_prefix(token_ids, salt=salt)
 
     def attach_prefix_fetch(self, fetcher) -> None:
         """Wire the fleet prefix-cache pull client into the scheduler (safe
@@ -396,6 +396,17 @@ class AsyncJaxEngine:
         rid = f"rp-{rp.request_id}"
         prompt_len = len(rp.token_ids)
         cached_len, state = self.allocator.allocate_sequence(rid, list(rp.token_ids))
+        # fleet prefix pull BEFORE recomputing (ROADMAP item 3 follow-up):
+        # when the router attached a holder whose cached prefix beats ours,
+        # pull the missing leading blocks over the dataplane — the same
+        # timeout -> recompute fallback the decode-side FETCHING_KV path
+        # uses, synchronous here because the prefill worker's engine thread
+        # has nothing to interleave with this request anyway
+        if getattr(rp, "kv_holder_addr", ""):
+            cached_len = self._pull_remote_prefix(
+                rp.kv_holder_addr, int(getattr(rp, "kv_holder_blocks", 0) or 0),
+                state, cached_len, prompt_len, trace_id=rp.trace_id or None,
+            )
         ps = self.config.page_size
         start_page = rp.skip_leading_tokens // ps
         n_pages = -(-prompt_len // ps)
@@ -490,6 +501,70 @@ class AsyncJaxEngine:
             kv_scales_dtype=str(scales.dtype) if scales is not None else "",
         )
         return result, (data if mode == "socket" else None)
+
+    def _pull_remote_prefix(
+        self, holder_addr: str, holder_blocks: int, state, cached_len: int,
+        prompt_len: int, trace_id=None,
+    ) -> int:
+        """Prefill-side fleet prefix pull: fetch the contiguous leading
+        blocks past our local cache from ``holder_addr`` and scatter them
+        into the sequence's pre-allocated pages. Returns the new cached_len;
+        ANY failure (no fetcher, timeout, gone, partial scatter) returns the
+        original — the caller recomputes, never errors."""
+        sched, cfg = self.scheduler, self.config
+        fetcher = self.prefix_fetcher
+        if fetcher is None or not cfg.prefix_fetch or holder_blocks <= 0:
+            return cached_len
+        ps = cfg.page_size
+        base = cached_len // ps
+        # the final prompt token must prefill so the model emits logits
+        want_to = min(holder_blocks, (prompt_len - 1) // ps)
+        if want_to - base < max(1, cfg.prefix_fetch_min_blocks):
+            return cached_len
+        hashes = [b.sequence_hash for b in state.token_seq.blocks[base:want_to]]
+        if not hashes:
+            return cached_len
+        t0 = time.monotonic()
+        try:
+            fut = fetcher.fetch(holder_addr, hashes, timeout_s=cfg.prefix_fetch_timeout_s)
+            res = fut.result(timeout=cfg.prefix_fetch_timeout_s + 2.0)
+        except Exception:
+            log.exception("prefill-side prefix pull from %s failed", holder_addr)
+            sched.prefix_fetch_fallbacks += 1
+            return cached_len
+        dt = time.monotonic() - t0
+        sched.stage_hist["prefix_fetch"].observe(dt)
+        applied = 0
+        if getattr(res, "status", "") == "hit" and res.blocks:
+            try:
+                for part in res.parts:
+                    if part.block_from != applied:
+                        break  # hole: only the contiguous leading run counts
+                    ids = np.asarray(
+                        state.pages[base + part.block_from : base + part.block_to],
+                        np.int32,
+                    )
+                    if len(ids) != part.block_to - part.block_from:
+                        break
+                    self.runner.inject_pages_bucketed(ids, part.data, axis=part.cat_axis)
+                    applied = part.block_to
+            except Exception:
+                log.exception("scatter of pulled prefix failed; recomputing")
+                applied = 0
+        if not applied:
+            sched.prefix_fetch_fallbacks += 1
+            return cached_len
+        new_cached = (base + applied) * ps
+        sched.prefix_fetch_hits += 1
+        sched.prefix_fetch_blocks += applied
+        sched.prefix_fetch_bytes += res.bytes
+        sched.prefix_fetch_tokens += max(0, new_cached - cached_len)
+        tracing.record_span(
+            "engine.prefix_fetch", t0, duration=dt, trace_id=trace_id,
+            attrs={"blocks": applied, "bytes": res.bytes, "holder": holder_addr,
+                   "side": "prefill"},
+        )
+        return max(cached_len, new_cached)
 
     def sync_adopt_prefilled(
         self, req: EngineRequest, result, cached_len: int, kv_data=None,
@@ -657,6 +732,18 @@ class AsyncJaxEngine:
                 snap["spec_draft_pages_total"] = draft.pages_total
                 snap["spec_draft_pages_used"] = draft.pages_used
                 snap["spec_draft_model"] = spec.model
+        store = getattr(runner, "lora_store", None) if runner is not None else None
+        if store is not None:
+            # multi-LoRA: device slot occupancy, eviction/load churn, and
+            # per-adapter demand (dynotop's LORA column + dynamo_lora_*)
+            ls = store.metrics_snapshot()
+            snap["lora_resident"] = ls["resident"]
+            snap["lora_capacity"] = ls["capacity"]
+            snap["lora_evictions"] = ls["evictions"]
+            snap["lora_loads"] = ls["loads"]
+            snap["lora_load_seconds"] = ls["load_seconds"]
+            snap["lora_requests"] = ls["requests"]
+            snap["lora_hot"] = ls["hot"]
         if runner is not None:
             snap.update(runner.hbm_stats())
             cm = getattr(runner, "compile_monitor", None)
@@ -896,6 +983,40 @@ class AsyncJaxEngine:
                 "host-DRAM KV tier bytes resident at the ACTUAL wire dtype "
                 "(int8 blocks cost ~half of bf16)",
                 [({}, r["offload_bytes_resident"])],
+            ))
+        if "lora_resident" in r:
+            # multi-LoRA adapter pool: slot occupancy, LRU eviction and
+            # host-load churn, and per-adapter request demand
+            parts.append(render_family(
+                "dynamo_lora_slots", "gauge",
+                "LoRA adapter device slots (resident = adapters currently "
+                "holding a slot; capacity excludes the reserved zero slot)",
+                [({"state": "resident"}, r["lora_resident"]),
+                 ({"state": "capacity"}, r["lora_capacity"])],
+            ))
+            parts.append(render_family(
+                "dynamo_lora_evictions_total", "counter",
+                "adapters LRU-evicted from device slots (host copy kept; a "
+                "hot-swap back costs one scatter, not a reload)",
+                [({}, r["lora_evictions"])],
+            ))
+            parts.append(render_family(
+                "dynamo_lora_loads_total", "counter",
+                "adapter host-weight loads (async; requests wait without "
+                "blocking other traffic)",
+                [({}, r["lora_loads"])],
+            ))
+            parts.append(render_family(
+                "dynamo_lora_load_seconds_total", "counter",
+                "cumulative seconds spent loading adapter host weights",
+                [({}, round(r["lora_load_seconds"], 4))],
+            ))
+            parts.append(render_family(
+                "dynamo_lora_requests_total", "counter",
+                "sequences admitted per adapter (slot acquisitions)",
+                [({"adapter": name}, n)
+                 for name, n in sorted(r["lora_requests"].items())]
+                or [({"adapter": ""}, 0)],
             ))
         if "spec_draft_pages_total" in r:
             # the draft model's OWN paged pool — separate from the target's

@@ -216,6 +216,46 @@ class ModelRunner:
         # bundle is just the token feedback buffer and penalty-free traffic
         # never pays the HBM or donation traffic.
         self.slot_state = {"tokens": jnp.zeros(config.max_seqs, jnp.int32)}
+        # multi-LoRA multiplexing (dynamo_tpu/lora/): device-resident stacked
+        # adapter pools + the LRU slot store. The pool rides every forward as
+        # a read-only (never donated) pytree; per-slot adapter ids live in
+        # slot_state["lora"] next to the token-feedback buffer so decode
+        # windows read them on device with no extra H2D. None = disabled and
+        # every trace is byte-identical to the pre-LoRA engine.
+        self.lora = None
+        self.lora_store = None
+        if config.lora_adapters:
+            from dynamo_tpu.lora import LoraStore, init_lora_pool
+
+            if not getattr(model, "SUPPORTS_LORA", False):
+                raise ValueError(
+                    f"model {type(model).__name__} does not support LoRA adapters"
+                )
+            if config.pp > 1:
+                # config gates this too; a tiny:{...} override JSON must not
+                # sneak the combination past it
+                raise ValueError("lora_adapters do not compose with pp > 1 yet")
+            pool = init_lora_pool(model, config.max_loras, config.lora_rank)
+            self.lora = jax.device_put(pool, NamedSharding(mesh, P()))
+            self.slot_state["lora"] = jnp.zeros(config.max_seqs, jnp.int32)
+
+            def _lora_write_impl(pool, slot, tree, scale):
+                mods = {
+                    m: {
+                        "a": pool["mods"][m]["a"].at[:, slot].set(tree[m]["a"]),
+                        "b": pool["mods"][m]["b"].at[:, slot].set(tree[m]["b"]),
+                    }
+                    for m in pool["mods"]
+                }
+                return {"scales": pool["scales"].at[slot].set(scale), "mods": mods}
+
+            self._lora_write = jax.jit(_lora_write_impl, donate_argnums=(0,))
+
+            def _set_lora_impl(st, slot, val):
+                return dict(st, lora=st["lora"].at[slot].set(val, mode="drop"))
+
+            self._set_lora = jax.jit(_set_lora_impl, donate_argnums=(0,))
+            self.lora_store = LoraStore(config, model, self.load_lora_slot)
 
         # compile-churn telemetry: every serving-path jit is wrapped so a
         # recompile storm (the top TPU serving hazard — a stray dynamic shape
@@ -308,8 +348,9 @@ class ModelRunner:
 
     # ---------------- jitted bodies ----------------
 
-    def _model_prefill(self, params, kv, tokens, positions, page_table, valid, last, embeds=None, emask=None, rope_pos=None):
-        """model.prefill, or its GPipe-pipelined form when pp > 1."""
+    def _model_prefill(self, params, kv, tokens, positions, page_table, valid, last, embeds=None, emask=None, rope_pos=None, lora=None, lora_id=None):
+        """model.prefill, or its GPipe-pipelined form when pp > 1 (which has
+        no LoRA threading — the lora+pp combination is gated at init)."""
         if self.config.pp > 1:
             from dynamo_tpu.parallel.pipeline import prefill_pipelined
 
@@ -318,12 +359,13 @@ class ModelRunner:
                 self.mesh, input_embeds=embeds, embeds_mask=emask,
                 rope_positions=rope_pos,
             )
+        lkw = {} if lora is None else dict(lora=lora, lora_id=lora_id)
         return self.model.prefill(
             params, kv, tokens, positions, page_table, valid, last,
-            input_embeds=embeds, embeds_mask=emask, rope_positions=rope_pos,
+            input_embeds=embeds, embeds_mask=emask, rope_positions=rope_pos, **lkw,
         )
 
-    def _model_decode(self, params, kv, tokens, positions, page_tables, active, rope_deltas=None):
+    def _model_decode(self, params, kv, tokens, positions, page_tables, active, rope_deltas=None, lora=None, lora_ids=None):
         if self.config.pp > 1:
             from dynamo_tpu.parallel.pipeline import decode_pipelined
 
@@ -331,28 +373,32 @@ class ModelRunner:
                 self.model, params, kv, tokens, positions, page_tables, active,
                 self.mesh, rope_deltas=rope_deltas,
             )
+        lkw = {} if lora is None else dict(lora=lora, lora_ids=lora_ids)
         return self.model.decode(
-            params, kv, tokens, positions, page_tables, active, rope_deltas=rope_deltas
+            params, kv, tokens, positions, page_tables, active,
+            rope_deltas=rope_deltas, **lkw,
         )
 
-    def _prefill_impl(self, params, kv, slot_state, ints, flts, key, embeds=None, emask=None, rope_pos=None, want_lp=False, want_pen=False, want_seed=False, want_eos_mask=False, mp=None):
-        """ints [bucket + mp + 5 + MAX_EOS_IDS] = token buf, page
-        table, (start_pos, n_real, top_k, slot, seed), then the request's EOS
-        ids (V-padded); flts [6] = (temperature, top_p, min_p, presence,
-        frequency, repetition). Positions and the valid mask derive on device
-        — one packed H2D per chunk. The sampled token is written into
+    def _prefill_impl(self, params, kv, slot_state, ints, flts, key, embeds=None, emask=None, rope_pos=None, lora=None, want_lp=False, want_pen=False, want_seed=False, want_eos_mask=False, mp=None):
+        """ints [bucket + mp + 6 + MAX_EOS_IDS] = token buf, page
+        table, (start_pos, n_real, top_k, slot, seed, lora_slot), then the
+        request's EOS ids (V-padded); flts [6] = (temperature, top_p, min_p,
+        presence, frequency, repetition). Positions and the valid mask derive
+        on device — one packed H2D per chunk. The sampled token is written into
         ``slot_state["tokens"][slot]`` (slot >= max_seqs drops the write) so a
         following decode window can consume it without any host round trip.
 
         ``mp`` is the page-table width this trace is compiled for — a rung
         of the config's table-width ladder, not the dense max_pages_per_seq.
         Multimodal chunks pass ``embeds`` [bucket, D] + ``emask`` [bucket];
+        ``lora`` (the adapter pool; chunk's slot id rides the ints) applies
+        one adapter's delta to the whole chunk — slot 0 is the zero adapter;
         want_lp/want_pen/want_seed/want_eos_mask gate logprobs, penalties,
         seeded streams, and min_tokens EOS suppression out of the default
         trace."""
         if mp is None:
             mp = self.config.max_pages_per_seq
-        bucket = ints.shape[0] - mp - 5 - MAX_EOS_IDS
+        bucket = ints.shape[0] - mp - 6 - MAX_EOS_IDS
         tokens = ints[:bucket]
         page_table = ints[bucket : bucket + mp]
         start_pos = ints[bucket + mp]
@@ -360,12 +406,14 @@ class ModelRunner:
         top_k = ints[bucket + mp + 2]
         slot = ints[bucket + mp + 3]
         seed = ints[bucket + mp + 4]
-        eos_ids = ints[bucket + mp + 5 :]
+        lora_id = ints[bucket + mp + 5]
+        eos_ids = ints[bucket + mp + 6 :]
         positions = start_pos + jnp.arange(bucket, dtype=jnp.int32)
         valid = jnp.arange(bucket) < n
         logits, kv = self._model_prefill(
             params, kv, tokens, positions, page_table, valid, n - 1,
             embeds=embeds, emask=emask, rope_pos=rope_pos,
+            lora=lora, lora_id=lora_id,
         )
         tok, lp, slot_state = self._sample_one(
             logits, key, flts, top_k, slot, seed, start_pos + n - 1, slot_state,
@@ -413,17 +461,19 @@ class ModelRunner:
             slot_state = dict(slot_state, counts=counts, seen=seen)
         return tok, lp, slot_state
 
-    def _prefill_packed_impl(self, params, kv, slot_state, ints, flts, key, want_lp=False, want_pen=False, want_seed=False, want_eos_mask=False, mp=None):
-        """Cross-request packed prefill: ints [N, bucket + mp + 5 +
+    def _prefill_packed_impl(self, params, kv, slot_state, ints, flts, key, lora=None, want_lp=False, want_pen=False, want_seed=False, want_eos_mask=False, mp=None):
+        """Cross-request packed prefill: ints [N, bucket + mp + 6 +
         MAX_EOS_IDS] — N lanes of the SAME per-lane row layout as
         _prefill_impl (``mp`` = the call's ladder table width); flts [6, N].
         Every lane's last-row logits are sampled
         ([N] tokens); the host ignores tokens of lanes that weren't a final
-        chunk (their slot is out-of-range so the feedback write drops too)."""
+        chunk (their slot is out-of-range so the feedback write drops too).
+        A mixed-adapter pack stays ONE dispatch: each lane's lora slot id
+        gathers its adapter planes inside the shared weight pass."""
         if mp is None:
             mp = self.config.max_pages_per_seq
         N = ints.shape[0]
-        bucket = ints.shape[1] - mp - 5 - MAX_EOS_IDS
+        bucket = ints.shape[1] - mp - 6 - MAX_EOS_IDS
         tokens = ints[:, :bucket]
         page_tables = ints[:, bucket : bucket + mp]
         start_pos = ints[:, bucket + mp]
@@ -431,11 +481,13 @@ class ModelRunner:
         top_ks = ints[:, bucket + mp + 2]
         slots = ints[:, bucket + mp + 3]
         seeds = ints[:, bucket + mp + 4]
-        eos_ids = ints[:, bucket + mp + 5 :]  # [N, MAX_EOS_IDS] V-padded
+        lora_ids = ints[:, bucket + mp + 5]
+        eos_ids = ints[:, bucket + mp + 6 :]  # [N, MAX_EOS_IDS] V-padded
         positions = start_pos[:, None] + jnp.arange(bucket, dtype=jnp.int32)[None, :]
         valid = jnp.arange(bucket)[None, :] < n[:, None]
+        lkw = {} if lora is None else dict(lora=lora, lora_ids=lora_ids)
         logits, kv = self.model.prefill_packed(
-            params, kv, tokens, positions, page_tables, valid, n - 1
+            params, kv, tokens, positions, page_tables, valid, n - 1, **lkw
         )
         raw_b = logits  # [N, V]
         if want_eos_mask:
@@ -472,7 +524,7 @@ class ModelRunner:
 
     def prefill_chunk_batch(
         self,
-        lanes: list,  # [(tokens np[int32], start_pos, page_table, slot_or_-1, sampling, eos_ids, is_final)]
+        lanes: list,  # [(tokens np[int32], start_pos, page_table, slot_or_-1, sampling, eos_ids, is_final[, lora_slot])]
         N: int,  # lane count the executable is compiled for (>= len(lanes))
         want_logprobs: bool = False,
     ):
@@ -486,14 +538,16 @@ class ModelRunner:
         # lanes zero-pad into the trash page) — short packs keep their
         # narrow executable; only packs containing a deep sequence go wide
         mp = self.config.table_bucket_for(max(len(l[2]) for l in lanes))
-        ints = np.full((N, bucket + mp + 5 + MAX_EOS_IDS), V, np.int32)
+        ints = np.full((N, bucket + mp + 6 + MAX_EOS_IDS), V, np.int32)
         ints[:, :bucket] = 0
         ints[:, bucket : bucket + mp] = 0
         flts = np.zeros((6, N), np.float32)
         flts[1] = 1.0  # top_p neutral
         flts[5] = 1.0  # repetition neutral
         want_extras = False
-        for j, (tokens, start_pos, page_table, slot, sampling, eos_ids, is_final) in enumerate(lanes):
+        for j, lane in enumerate(lanes):
+            tokens, start_pos, page_table, slot, sampling, eos_ids, is_final = lane[:7]
+            lora_slot = lane[7] if len(lane) > 7 else 0
             n = len(tokens)
             ints[j, :n] = tokens
             ints[j, bucket : bucket + len(page_table[:mp])] = page_table[:mp]
@@ -502,6 +556,7 @@ class ModelRunner:
             ints[j, bucket + mp + 2] = sampling.top_k
             ints[j, bucket + mp + 3] = slot if (is_final and slot >= 0) else self.config.max_seqs
             ints[j, bucket + mp + 4] = fold_seed(sampling.seed)
+            ints[j, bucket + mp + 5] = lora_slot
             want_eos = bool(
                 is_final and eos_ids and sampling.min_tokens >= 1
                 and not sampling.ignore_eos
@@ -514,7 +569,7 @@ class ModelRunner:
                         len(eos_ids), MAX_EOS_IDS,
                     )
                 ids = np.asarray(eos_ids, np.int32)[:MAX_EOS_IDS]
-                ints[j, bucket + mp + 5 : bucket + mp + 5 + len(ids)] = ids
+                ints[j, bucket + mp + 6 : bucket + mp + 6 + len(ids)] = ids
             flts[0, j] = sampling.temperature
             flts[1, j] = sampling.top_p
             flts[2, j] = sampling.min_p
@@ -526,9 +581,10 @@ class ModelRunner:
             )
         # pad lanes: n=0 (valid all-False), start 0, page table 0 (every read
         # lands in the in-bounds trash page — the V fill would DMA out of the
-        # pool), slot out-of-range so the feedback write drops
+        # pool), slot out-of-range so the feedback write drops, lora slot 0
+        # (the zero adapter)
         for j in range(len(lanes), N):
-            ints[j, bucket : bucket + mp + 5] = 0
+            ints[j, bucket : bucket + mp + 6] = 0
             ints[j, bucket + mp + 3] = self.config.max_seqs
         if want_extras:
             self._ensure_penalty_state()
@@ -539,6 +595,7 @@ class ModelRunner:
             jnp.asarray(ints),
             jnp.asarray(flts),
             self._next_key(),
+            lora=self.lora,
             want_lp=want_logprobs,
             want_pen=want_extras,
             want_seed=want_extras,
@@ -554,20 +611,21 @@ class ModelRunner:
             pass
         return (toks, lp) if want_logprobs else toks
 
-    def _prefill_sp_impl(self, params, kv, slot_state, ints, flts, key, want_lp=False, want_pen=False, want_seed=False, want_eos_mask=False, mp=None):
+    def _prefill_sp_impl(self, params, kv, slot_state, ints, flts, key, lora=None, want_lp=False, want_pen=False, want_seed=False, want_eos_mask=False, mp=None):
         """Same packed-ints contract as _prefill_impl, but the whole-prompt
         chunk runs sequence-parallel (model.prefill_sp: ring attention over
         the sp mesh axis). Only called with start_pos == 0."""
         if mp is None:
             mp = self.config.max_pages_per_seq
-        bucket = ints.shape[0] - mp - 5 - MAX_EOS_IDS
+        bucket = ints.shape[0] - mp - 6 - MAX_EOS_IDS
         tokens = ints[:bucket]
         page_table = ints[bucket : bucket + mp]
         n = ints[bucket + mp + 1]
         top_k = ints[bucket + mp + 2]
         slot = ints[bucket + mp + 3]
         seed = ints[bucket + mp + 4]
-        eos_ids = ints[bucket + mp + 5 :]
+        lora_id = ints[bucket + mp + 5]
+        eos_ids = ints[bucket + mp + 6 :]
         positions = jnp.arange(bucket, dtype=jnp.int32)
         valid = positions < n
         if self.config.pp > 1:
@@ -579,8 +637,10 @@ class ModelRunner:
                 n - 1, self.mesh,
             )
         else:
+            lkw = {} if lora is None else dict(lora=lora, lora_id=lora_id)
             logits, kv = self.model.prefill_sp(
-                params, kv, tokens, positions, page_table, valid, n - 1, mesh=self.mesh
+                params, kv, tokens, positions, page_table, valid, n - 1,
+                mesh=self.mesh, **lkw,
             )
         tok, lp, slot_state = self._sample_one(
             logits, key, flts, top_k, slot, seed, n - 1, slot_state,
@@ -589,7 +649,7 @@ class ModelRunner:
         )
         return tok, lp, kv, slot_state
 
-    def _decode_window_impl(self, params, kv, slot_state, ints, flts, key, num_steps=1, want_lp=False, want_pen=False, want_seed=False, want_eos_mask=False):
+    def _decode_window_impl(self, params, kv, slot_state, ints, flts, key, lora=None, num_steps=1, want_lp=False, want_pen=False, want_seed=False, want_eos_mask=False):
         """num_steps fused decode steps; the sampled-token feedback loop starts
         from the device-resident ``slot_state["tokens"]`` buffer, so the host can
         dispatch windows back-to-back without reading any results in between.
@@ -621,6 +681,11 @@ class ModelRunner:
             logits, kv = self._model_decode(
                 params, kv, st["tokens"], positions, page_tables, act,
                 rope_deltas=rope_deltas if getattr(self.model.config, "mrope_section", None) is not None else None,
+                # per-slot adapter ids live in the donated slot_state bundle
+                # (written once at admission), so a mixed-adapter window
+                # reads them on device with zero extra H2D per dispatch
+                lora=lora,
+                lora_ids=st["lora"] if lora is not None else None,
             )
             raw_logits = logits
             if want_pen:
@@ -665,19 +730,23 @@ class ModelRunner:
         # [num_steps, B] tokens (+ ([num_steps, B], [num_steps, B, K] x2) lp)
         return all_toks, lp, kv, slot_state
 
-    def _verify_impl(self, params, kv, ints, flts, key, draft_probs=None):
+    def _verify_impl(self, params, kv, ints, flts, key, draft_probs=None, lora=None):
         """Speculative verify step: every slot feeds its anchor token plus up
         to K drafts at consecutive positions through the model's multi-query
         ``verify`` pass, then acceptance runs on device so only the tiny
         [B, K+1] token matrix and [B] emit counts cross back to the host.
 
-        ``ints`` [5 + (K+1) + max_pages, B] = positions (anchor fed position),
-        active, top_ks, seeds, n_drafts, the K+1 fed-token rows, then the
-        transposed page tables (K is derived from the array shape — one
-        executable per configured k). ``flts`` [3, B] = temps, top_ps, min_ps.
+        ``ints`` [6 + (K+1) + max_pages, B] = positions (anchor fed position),
+        active, top_ks, seeds, n_drafts, lora slot ids, the K+1 fed-token
+        rows, then the transposed page tables (K is derived from the array
+        shape — one executable per configured k). ``flts`` [3, B] = temps,
+        top_ps, min_ps.
         ``draft_probs`` ([B, K, V] device array from dispatch_draft, never
         staged through the host): the real draft distributions temperature>0
         acceptance divides by; None = one-hot (n-gram) proposals.
+        ``lora``: a mixed-adapter verify round gathers each slot's adapter
+        inside the one shared pass (the verify side must see the same
+        adapter the sequence decodes with, or acceptance silently drops).
         Rows beyond a slot's n_drafts scatter their KV to the trash page, so a
         slot proposing fewer than K drafts never writes past its pages."""
         # K is config-static (one executable per configured k), so the page-
@@ -687,21 +756,23 @@ class ModelRunner:
         K1 = (
             spec.k + 1
             if spec is not None
-            else ints.shape[0] - 5 - self.config.max_pages_per_seq
+            else ints.shape[0] - 6 - self.config.max_pages_per_seq
         )
         positions = ints[0]
         active = ints[1].astype(bool)
         top_ks = ints[2]
         seeds = ints[3]
         n_drafts = ints[4]
-        fed = ints[5 : 5 + K1].T  # [B, K1]
-        page_tables = ints[5 + K1 :].T  # [B, max_pages]
+        lora_ids = ints[5]
+        fed = ints[6 : 6 + K1].T  # [B, K1]
+        page_tables = ints[6 + K1 :].T  # [B, max_pages]
         temps, top_ps, min_ps = flts[0], flts[1], flts[2]
         t_idx = jnp.arange(K1, dtype=jnp.int32)
         pos_mat = positions[:, None] + t_idx[None, :]
         row_valid = active[:, None] & (t_idx[None, :] <= n_drafts[:, None])
+        lkw = {} if lora is None else dict(lora=lora, lora_ids=lora_ids)
         logits, kv = self.model.verify(
-            params, kv, fed, pos_mat, page_tables, row_valid
+            params, kv, fed, pos_mat, page_tables, row_valid, **lkw
         )
         out, n_emit = accept_speculative(
             logits, fed[:, 1:], n_drafts, key, temps, top_ks, top_ps,
@@ -734,6 +805,7 @@ class ModelRunner:
         want_logprobs: bool = False,  # sync=False only: also return lp arrays
         sampling=None,  # SamplingParams: penalties / min_p / seed (optional)
         eos_ids=None,  # request EOS ids (min_tokens device-side suppression)
+        lora_slot: int = 0,  # adapter slot for this chunk (0 = base/zero)
     ):
         """Run one prefill chunk.
 
@@ -748,7 +820,7 @@ class ModelRunner:
         # engine build them via table_bucket_for); its width picks the trace
         mp = len(page_table)
         V = self.model.config.vocab_size
-        ints = np.full(bucket + mp + 5 + MAX_EOS_IDS, V, np.int32)  # tail = eos pad
+        ints = np.full(bucket + mp + 6 + MAX_EOS_IDS, V, np.int32)  # tail = eos pad
         ints[:bucket] = 0
         ints[:n] = tokens
         ints[bucket : bucket + mp] = page_table[:mp]
@@ -758,6 +830,7 @@ class ModelRunner:
         # out-of-bounds slot => scatter mode="drop" skips the token write
         ints[bucket + mp + 3] = slot if (sample and slot >= 0) else self.config.max_seqs
         ints[bucket + mp + 4] = fold_seed(sampling.seed) if sampling is not None else 0
+        ints[bucket + mp + 5] = lora_slot
         want_pen = sampling is not None and sampling.needs_penalties
         want_seed = sampling is not None and sampling.seed is not None
         # min_tokens >= 1: the first sampled token (generation #1) must not be
@@ -780,7 +853,7 @@ class ModelRunner:
                     len(eos_ids), MAX_EOS_IDS,
                 )
             ids = np.asarray(eos_ids, np.int32)[:MAX_EOS_IDS]
-            ints[bucket + mp + 5 : bucket + mp + 5 + len(ids)] = ids
+            ints[bucket + mp + 6 : bucket + mp + 6 + len(ids)] = ids
         flts = np.array(
             [
                 temperature,
@@ -833,6 +906,7 @@ class ModelRunner:
             jnp.asarray(flts),
             self._next_key(),
             *mm_args,
+            lora=self.lora,
             # only the sampling (final) chunk's outputs are ever consumed
             want_lp=want_logprobs and sample,
             want_pen=want_extras,
@@ -919,6 +993,33 @@ class ModelRunner:
         """Host-known tokens (e.g. disagg adoption) -> slot token feedback."""
         self.slot_state = self._write_tokens(
             self.slot_state, jnp.asarray(slots, jnp.int32), jnp.asarray(tokens, jnp.int32)
+        )
+
+    def set_slot_lora(self, slot: int, lora_slot: int) -> None:
+        """Pin a decode slot's adapter id in the device-resident slot_state
+        (written once at admission; decode windows gather it per step).
+        No-op on a LoRA-disabled engine."""
+        if self.lora is None:
+            return
+        self.slot_state = self._set_lora(
+            self.slot_state,
+            jnp.asarray(slot, jnp.int32),
+            jnp.asarray(lora_slot, jnp.int32),
+        )
+
+    def load_lora_slot(self, slot: int, host_tree: dict, scale: float) -> None:
+        """Scatter one adapter's A/B planes into pool slot ``slot`` (donated
+        in-place update; one executable total — every adapter arrives padded
+        to the pool rank, so the shapes never vary)."""
+        tree = {
+            m: {"a": jnp.asarray(e["a"]), "b": jnp.asarray(e["b"])}
+            for m, e in host_tree.items()
+        }
+        self.lora = self._lora_write(
+            self.lora,
+            jnp.asarray(slot, jnp.int32),
+            tree,
+            jnp.asarray(scale, jnp.float32),
         )
 
     def _ensure_penalty_state(self) -> None:
@@ -1018,6 +1119,7 @@ class ModelRunner:
             jnp.asarray(ints),
             jnp.asarray(flts),
             self._next_key(),
+            lora=self.lora,
             num_steps=num_steps,
             want_lp=want_logprobs,
             want_pen=want_extras,
@@ -1046,6 +1148,7 @@ class ModelRunner:
         min_ps: np.ndarray | None = None,
         seeds: np.ndarray | None = None,  # [B] int32 (0 = unseeded)
         draft_probs=None,  # [B, K, V] device array from dispatch_draft
+        lora_slots: np.ndarray | None = None,  # [B] adapter slot ids
     ):
         """Dispatch one speculative verify pass; returns the (tokens [B, K+1],
         n_emit [B]) device arrays with async host copies already started. The
@@ -1056,14 +1159,15 @@ class ModelRunner:
         (draft-model rounds); None keeps the one-hot (n-gram) rule."""
         B = positions.shape[0]
         K1 = fed_tokens.shape[1]
-        ints = np.empty((5 + K1 + page_tables.shape[1], B), np.int32)
+        ints = np.empty((6 + K1 + page_tables.shape[1], B), np.int32)
         ints[0] = positions
         ints[1] = active
         ints[2] = top_ks
         ints[3] = seeds if seeds is not None else 0
         ints[4] = n_drafts
-        ints[5 : 5 + K1] = fed_tokens.T
-        ints[5 + K1 :] = page_tables.T
+        ints[5] = lora_slots if lora_slots is not None else 0
+        ints[6 : 6 + K1] = fed_tokens.T
+        ints[6 + K1 :] = page_tables.T
         flts = np.empty((3, B), np.float32)
         flts[0] = temps
         flts[1] = top_ps
@@ -1075,6 +1179,7 @@ class ModelRunner:
             jnp.asarray(flts),
             self._next_key(),
             draft_probs,
+            lora=self.lora,
         )
         try:
             out.copy_to_host_async()

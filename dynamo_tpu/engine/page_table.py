@@ -164,10 +164,12 @@ class PageAllocator:
 
     # ------------- sequence lifecycle -------------
 
-    def lookup_prefix(self, prompt_tokens: list[int]) -> int:
+    def lookup_prefix(self, prompt_tokens: list[int], salt: int = 0) -> int:
         """Number of leading tokens already cached in ANY tier (block
-        granularity), without allocating. Disagg routing's prefix-hit estimate."""
-        ts = TokenSequence(prompt_tokens, self.page_size)
+        granularity), without allocating. Disagg routing's prefix-hit estimate.
+        ``salt`` = the request's LoRA adapter uid (0 = base): adapter-specific
+        prefixes live under salted chained hashes and never cross-hit."""
+        ts = TokenSequence(prompt_tokens, self.page_size, salt=salt)
         hits = 0
         for block in ts.blocks:
             h = block.sequence_hash
@@ -184,16 +186,21 @@ class PageAllocator:
         so lookup and the subsequent gather dispatch are atomic)."""
         return self._cache.get(seq_hash)
 
-    def allocate_sequence(self, seq_id: str, prompt_tokens: list[int]) -> tuple[int, SequencePages]:
+    def allocate_sequence(
+        self, seq_id: str, prompt_tokens: list[int], salt: int = 0
+    ) -> tuple[int, SequencePages]:
         """Allocate pages for a prompt, reusing cached prefix blocks.
 
         Returns (cached_len, seq_state): the first cached_len tokens already
         have KV in shared pages and must NOT be recomputed (except the last
         token if the full prompt hits, so there is always something to prefill).
+        ``salt`` folds a LoRA adapter uid into the chained block identity, so
+        an adapter's KV (its k/v projections carry the adapter delta) never
+        serves — or is served by — another adapter's identical token prefix.
         """
         if seq_id in self._seqs:
             raise ValueError(f"sequence {seq_id} already allocated")
-        ts = TokenSequence(prompt_tokens, self.page_size)
+        ts = TokenSequence(prompt_tokens, self.page_size, salt=salt)
         state = SequencePages(seq_id=seq_id, token_seq=ts)
 
         # 1. device-tier prefix hits: chain of full blocks present in cache

@@ -82,6 +82,11 @@ class EngineRequest:
     # the dataplane (FETCHING_KV) instead of recomputing them.
     kv_holder_addr: str = ""
     kv_holder_blocks: int = 0
+    # multi-LoRA: the adapter this request serves ("" = base model). The
+    # scheduler pins a device pool slot at admission (waiting while the
+    # adapter loads — never blocking other requests) and salts the
+    # sequence's KV block identity with the adapter uid.
+    lora_name: str = ""
 
 
 @dataclass
@@ -130,6 +135,11 @@ class RunningSeq:
     # no prefill chunk dispatches for this sequence; resolution either
     # advances prefill_pos past the pulled prefix or falls back to recompute.
     fetch: Optional["_PrefixFetch"] = None
+    # multi-LoRA: the device pool slot this sequence's adapter is pinned in
+    # (0 = base / no adapter). >0 implies one LoraStore ref held until the
+    # sequence releases or is preempted — a pinned slot is never hot-swapped
+    # under an in-flight sequence.
+    lora_slot: int = 0
 
     @property
     def pos(self) -> int:
@@ -509,6 +519,7 @@ class Scheduler:
             self.runner.write_token_slots(
                 np.array([slot], np.int32), np.array([seq.generated[-1]], np.int32)
             )
+            self.runner.set_slot_lora(slot, seq.lora_slot)
         # admission fairness for the PER-REQUEST prefill path (packed path
         # disabled: pp/sp meshes, multimodal, prefill_lanes=1): starting a
         # sequence there dispatches its whole prefill chain immediately, so
@@ -522,53 +533,107 @@ class Scheduler:
         )
         packed_mode = self.runner.packed_prefill_mode
         started = 0
-        while self.waiting:
-            slot = self._free_slot()
-            if slot is None:
-                break
-            req = self.waiting[0]
-            # reject oversized prompts BEFORE the fairness-cap break: the
-            # rejection is pure host work (no chip time), so an oversized
-            # prompt at the queue head must fail now, not stall behind the
-            # per-step prefill cap (and stall everything queued behind it)
-            if len(req.token_ids) > self.config.max_model_len:
+        # multi-LoRA: requests whose adapter is still loading (or whose slots
+        # are all pinned) step aside WITHOUT blocking the queue behind them —
+        # they re-enter at the queue front next step, so FIFO holds among
+        # ready requests and an async adapter load never stalls the engine
+        deferred: list[EngineRequest] = []
+        try:
+            while self.waiting:
+                slot = self._free_slot()
+                if slot is None:
+                    break
+                req = self.waiting[0]
+                # reject oversized prompts BEFORE the fairness-cap break: the
+                # rejection is pure host work (no chip time), so an oversized
+                # prompt at the queue head must fail now, not stall behind the
+                # per-step prefill cap (and stall everything queued behind it)
+                if len(req.token_ids) > self.config.max_model_len:
+                    self.waiting.popleft()
+                    outputs.append(
+                        StepOutput(req.request_id, finished=True, finish_reason="error")
+                    )
+                    continue
+                if (
+                    cap
+                    and decode_running
+                    and started >= cap
+                    and not (packed_mode and not req.images)
+                ):
+                    break
+                pages_needed = -(-len(req.token_ids) // self.config.page_size)
+                if self.allocator.free_pages < pages_needed + watermark_pages:
+                    break
+                lora_slot = 0
+                if req.lora_name:
+                    store = getattr(self.runner, "lora_store", None)
+                    try:
+                        if store is None:
+                            raise KeyError("engine has no LoRA adapters configured")
+                        lora_slot = store.acquire(req.lora_name)
+                    except Exception as e:
+                        # unknown adapter / broken source: this request can
+                        # never serve — fail it, don't wedge the queue
+                        log.warning(
+                            "rejecting %s: %s", req.request_id, e
+                        )
+                        self.waiting.popleft()
+                        outputs.append(StepOutput(
+                            req.request_id, finished=True, finish_reason="error"
+                        ))
+                        continue
+                    if lora_slot is None:
+                        self.waiting.popleft()
+                        deferred.append(req)
+                        continue
                 self.waiting.popleft()
-                outputs.append(
-                    StepOutput(req.request_id, finished=True, finish_reason="error")
-                )
-                continue
-            if (
-                cap
-                and decode_running
-                and started >= cap
-                and not (packed_mode and not req.images)
-            ):
-                break
-            pages_needed = -(-len(req.token_ids) // self.config.page_size)
-            if self.allocator.free_pages < pages_needed + watermark_pages:
-                break
-            self.waiting.popleft()
-            try:
-                self._start_sequence(req, slot)
-                started += 1
-            except MemoryError:
-                self.waiting.appendleft(req)
-                break
-            except Exception:
-                # admission died mid-flight (e.g. a trace error on the first
-                # prefill): fail THIS request — it is in no queue or slot
-                # anymore, so nothing else would ever answer its caller
-                log.exception("admission failed for %s", req.request_id)
-                if req.request_id in self.allocator._seqs:
-                    self.allocator.free_sequence(req.request_id)
-                if self.slots[slot] is not None and self.slots[slot].req is req:
-                    self.slots[slot] = None
-                outputs.append(
-                    StepOutput(req.request_id, finished=True, finish_reason="error")
-                )
+                try:
+                    self._start_sequence(req, slot, lora_slot=lora_slot)
+                    started += 1
+                except MemoryError:
+                    self._release_lora_name(req.lora_name, lora_slot)
+                    self.waiting.appendleft(req)
+                    break
+                except Exception:
+                    # admission died mid-flight (e.g. a trace error on the first
+                    # prefill): fail THIS request — it is in no queue or slot
+                    # anymore, so nothing else would ever answer its caller
+                    log.exception("admission failed for %s", req.request_id)
+                    self._release_lora_name(req.lora_name, lora_slot)
+                    if req.request_id in self.allocator._seqs:
+                        self.allocator.free_sequence(req.request_id)
+                    if self.slots[slot] is not None and self.slots[slot].req is req:
+                        self.slots[slot] = None
+                    outputs.append(
+                        StepOutput(req.request_id, finished=True, finish_reason="error")
+                    )
+        finally:
+            self.waiting.extendleft(reversed(deferred))
         return outputs
 
-    def _start_sequence(self, req: EngineRequest, slot: int) -> None:
+    # ---------------- multi-LoRA helpers ----------------
+
+    def _lora_salt(self, req: EngineRequest) -> int:
+        """Adapter uid folded into this request's KV block identity (0 =
+        base): adapter-specific prefixes never cross-hit — locally, in the
+        router radix, or over the fleet pull path."""
+        if not req.lora_name:
+            return 0
+        from dynamo_tpu.lora.adapter import lora_uid
+
+        return lora_uid(req.lora_name)
+
+    def _release_lora_name(self, name: str, lora_slot) -> None:
+        if name and lora_slot:
+            store = getattr(self.runner, "lora_store", None)
+            if store is not None:
+                store.release(name)
+
+    def _release_lora(self, seq: RunningSeq) -> None:
+        self._release_lora_name(seq.req.lora_name, seq.lora_slot)
+        seq.lora_slot = 0
+
+    def _start_sequence(self, req: EngineRequest, slot: int, lora_slot: int = 0) -> None:
         if req.enqueue_ts:
             now = time.monotonic()
             wait = max(0.0, now - req.enqueue_ts)
@@ -581,7 +646,9 @@ class Scheduler:
                 "engine.queue_wait", now - wait, end=now,
                 request_id=req.request_id, trace_id=req.trace_id,
             )
-        cached_len, state = self.allocator.allocate_sequence(req.request_id, req.token_ids)
+        cached_len, state = self.allocator.allocate_sequence(
+            req.request_id, req.token_ids, salt=self._lora_salt(req)
+        )
         prompt_len = len(req.token_ids)
         page_table = self._new_table(state.pages)
 
@@ -594,8 +661,12 @@ class Scheduler:
             admitted_order=self._admit_counter,
             sched_len=1,  # the prefill's sampled token enters the timeline now
             spec_mode=self._spec_eligible(req),
+            lora_slot=lora_slot,
         )
         self._admit_counter += 1
+        # decode windows read each slot's adapter id from the device-resident
+        # slot_state vector; write it once here (no per-window H2D)
+        self.runner.set_slot_lora(slot, lora_slot)
 
         fetch = self._maybe_start_fetch(req, cached_len, prompt_len)
         if self.runner.packed_prefill_mode and not req.images:
@@ -619,7 +690,7 @@ class Scheduler:
         # dispatch-ahead: chunks run without any host sync; the final chunk
         # samples, seeds tokens_dev[slot] on device, and async-copies the token
         result = self._dispatch_prefill_chunks(
-            req, page_table, cached_len, prompt_len, slot=slot
+            req, page_table, cached_len, prompt_len, slot=slot, lora_slot=lora_slot
         )
         tok_dev, lp = result if isinstance(result, tuple) else (result, None)
         self.allocator.commit_prefilled(req.request_id, prompt_len)
@@ -776,7 +847,8 @@ class Scheduler:
             return  # prefill_pos is live again; the packed dispatcher takes over
         try:
             result = self._dispatch_prefill_chunks(
-                req, seq.page_table, seq.prefill_pos, seq.prompt_len, slot=seq.slot
+                req, seq.page_table, seq.prefill_pos, seq.prompt_len, slot=seq.slot,
+                lora_slot=seq.lora_slot,
             )
         except Exception:
             log.exception("prefill after prefix fetch failed for %s", req.request_id)
@@ -855,6 +927,7 @@ class Scheduler:
                     seq.req.sampling,
                     () if seq.req.sampling.ignore_eos else seq.req.eos_token_ids,
                     is_final,
+                    seq.lora_slot,
                 ))
                 if is_final:
                     finals.append((seq, j))
@@ -956,13 +1029,13 @@ class Scheduler:
 
     def _dispatch_prefill_chunks(
         self, req: EngineRequest, page_table: np.ndarray, cached_len: int,
-        prompt_len: int, slot: int, prep: bool = True,
+        prompt_len: int, slot: int, prep: bool = True, lora_slot: int = 0,
     ):
         """Dispatch-ahead chunked prefill: no host sync; the final chunk seeds
         tokens_dev[slot] and returns the token as a device scalar."""
         return self.run_prefill_chunks(
             req, page_table, cached_len, prompt_len, slot=slot, sync=False,
-            want_logprobs=req.logprobs is not None, prep=prep,
+            want_logprobs=req.logprobs is not None, prep=prep, lora_slot=lora_slot,
         )
 
     def run_prefill_chunks(
@@ -976,6 +1049,7 @@ class Scheduler:
         want_logprobs: bool = False,
         prep: bool = True,
         on_chunk=None,
+        lora_slot: int = 0,
     ):
         """Bucket-chunked prefill, skipping the cached prefix; samples the first
         output token on the final chunk. sync=True (disagg prefill-worker path)
@@ -1021,6 +1095,7 @@ class Scheduler:
                 want_logprobs=want_logprobs and not sync,
                 sampling=s,
                 eos_ids=() if s.ignore_eos else req.eos_token_ids,
+                lora_slot=lora_slot,
             )
             if is_last:
                 first_token = tok
@@ -1065,6 +1140,24 @@ class Scheduler:
             )
         state = self.allocator._seqs[req.request_id]
         page_table = self._new_table(state.pages)
+        lora_slot = 0
+        if req.lora_name:
+            # adopted sequences arrive with their KV already computed; the
+            # adapter must be pinned before any decode window. Blocking here
+            # is acceptable: adoption runs rarely and the host copy is
+            # usually cached (disagg routes lora requests down the local
+            # path, so this is a belt for direct adopters).
+            store = getattr(self.runner, "lora_store", None)
+            if store is None:
+                raise RuntimeError(
+                    f"adopted request {req.request_id} names adapter "
+                    f"{req.lora_name!r} but the engine has no LoRA adapters"
+                )
+            lora_slot = store.acquire_blocking(req.lora_name)
+            if lora_slot is None:
+                raise RuntimeError(
+                    f"no free LoRA slot for adopted request {req.request_id}"
+                )
         seq = RunningSeq(
             req=req,
             slot=-1,
@@ -1074,6 +1167,7 @@ class Scheduler:
             admitted_order=self._admit_counter,
             sched_len=1,
             spec_mode=self._spec_eligible(req),
+            lora_slot=lora_slot,
         )
         self._admit_counter += 1
         slot = self._free_slot()
@@ -1083,6 +1177,7 @@ class Scheduler:
             self.runner.write_token_slots(
                 np.array([slot], np.int32), np.array([first_token], np.int32)
             )
+            self.runner.set_slot_lora(slot, lora_slot)
         else:
             self.adopted_waiting.append(seq)
         return self._emit_token(seq, first_token, cached=cached_len)
@@ -1338,6 +1433,7 @@ class Scheduler:
         top_ps = np.ones(B, np.float32)
         min_ps = np.zeros(B, np.float32)
         seeds = np.zeros(B, np.int32)
+        lora_slots = np.zeros(B, np.int32)
         snapshot = []
         for seq, p, drafts, _ in candidates:
             i = seq.slot
@@ -1354,6 +1450,7 @@ class Scheduler:
             top_ps[i] = s.top_p
             min_ps[i] = s.min_p
             seeds[i] = fold_seed(s.seed)
+            lora_slots[i] = seq.lora_slot
             snapshot.append((seq, i, len(drafts), p))
 
         t0 = time.monotonic()
@@ -1361,6 +1458,7 @@ class Scheduler:
             positions, page_tables, active, fed, n_drafts, temps, top_ks,
             top_ps, min_ps=min_ps, seeds=seeds if np.any(seeds) else None,
             draft_probs=draft_probs,
+            lora_slots=lora_slots if np.any(lora_slots) else None,
         )
         tokens = np.asarray(out_dev)
         n_emit = np.asarray(n_emit_dev)
@@ -1690,6 +1788,7 @@ class Scheduler:
         seq.finished = True
         self._cancel_fetch(seq)
         self._free_draft(seq)
+        self._release_lora(seq)
         self.allocator.free_sequence(seq.req.request_id)
         if seq.slot >= 0 and self.slots[seq.slot] is seq:
             self.slots[seq.slot] = None
@@ -1715,6 +1814,9 @@ class Scheduler:
         # the draft cache dies with the slot; re-admission rebuilds it from
         # the (prompt + generated) resume prompt at the first spec round
         self._free_draft(seq)
+        # the adapter pin dies with the slot too — re-admission re-acquires
+        # (the host copy is cached, so a hot-swap back is one scatter)
+        self._release_lora(seq)
         self.allocator.free_sequence(seq.req.request_id)
         if seq.slot >= 0 and self.slots[seq.slot] is seq:
             self.slots[seq.slot] = None
@@ -1749,5 +1851,6 @@ class Scheduler:
             # kept the pages the min-advantage gate skips the re-fetch anyway
             kv_holder_addr=seq.req.kv_holder_addr,
             kv_holder_blocks=seq.req.kv_holder_blocks,
+            lora_name=seq.req.lora_name,
         )
         self.waiting.appendleft(new_req)

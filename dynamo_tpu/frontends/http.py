@@ -64,4 +64,13 @@ async def run_http(engine, args) -> None:
         readiness=engine_readiness(engine),
     )
     service.manager.add(pipeline)
+    # multi-LoRA: each configured adapter serves as its own OpenAI model name
+    # (<base>:<adapter>) through a lora_name-stamping preprocessor wrapper;
+    # everything downstream (backend, engine) is shared
+    adapters = getattr(getattr(engine, "config", None), "lora_adapters", ())
+    if adapters:
+        from dynamo_tpu.frontends.pipeline import lora_pipelines
+
+        for lp in lora_pipelines(pipeline, adapters):
+            service.manager.add(lp)
     await service.run_forever()

@@ -28,6 +28,49 @@ def build_pipeline(engine, card: ModelDeploymentCard) -> ModelPipeline:
     return ModelPipeline(card.display_name, preprocessor, backend, model_type="both")
 
 
+class LoraPreprocessor:
+    """Preprocessor wrapper that pins one adapter name onto every request it
+    produces — the colocated-serving half of ``base:adapter`` model-name
+    resolution (the distributed worker resolves the suffix itself in
+    WorkerService._handle)."""
+
+    def __init__(self, inner, adapter: str):
+        self._inner = inner
+        self.adapter = adapter
+
+    @property
+    def tokenizer(self):
+        return self._inner.tokenizer
+
+    def preprocess_chat(self, req):
+        pre, annotations = self._inner.preprocess_chat(req)
+        pre.lora_name = self.adapter
+        return pre, annotations
+
+    def preprocess_completion(self, req):
+        pre, annotations = self._inner.preprocess_completion(req)
+        pre.lora_name = self.adapter
+        return pre, annotations
+
+
+def lora_pipelines(base: ModelPipeline, adapter_specs) -> list[ModelPipeline]:
+    """One servable ModelPipeline per configured adapter, named
+    ``<base>:<adapter>`` — shares the base pipeline's backend/tokenizer; only
+    the preprocessor differs (it stamps lora_name). Unknown adapter names
+    then 404 (model_not_found) at the HTTP edge like any unknown model."""
+    from dynamo_tpu.lora.adapter import parse_adapter_specs
+
+    return [
+        ModelPipeline(
+            f"{base.name}:{name}",
+            LoraPreprocessor(base.preprocessor, name),
+            base.backend,
+            base.model_type,
+        )
+        for name in parse_adapter_specs(adapter_specs)
+    ]
+
+
 def card_for_model(model_id: str | None, max_model_len: int | None = None) -> ModelDeploymentCard:
     from dynamo_tpu.models.registry import is_tiny_family
 

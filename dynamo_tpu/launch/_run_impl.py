@@ -63,6 +63,15 @@ def engine_config_for(args):
     )
     if pb:
         long_ctx["prefill_buckets"] = pb
+    # multi-LoRA knobs (graph yaml / CLI): adapters arrive as a comma string
+    # (CLI) or a list (yaml); EngineConfig normalizes either to a tuple
+    la = getattr(args, "lora_adapters", None)
+    if la:
+        long_ctx["lora_adapters"] = (
+            la if isinstance(la, str) else tuple(str(x) for x in la)
+        )
+        long_ctx["max_loras"] = getattr(args, "max_loras", None) or 4
+        long_ctx["lora_rank"] = getattr(args, "lora_rank", None) or 8
     if is_tiny:
         tiny_ctx = dict(long_ctx)
         tiny_ctx.setdefault("prefill_buckets", (16, 32))

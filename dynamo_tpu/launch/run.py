@@ -44,6 +44,22 @@ def build_parser() -> argparse.ArgumentParser:
              "batched on-device dispatch (composes with --quantize / "
              "--kv-cache-dtype); both verify in one batched forward pass",
     )
+    run.add_argument(
+        "--lora-adapters", default=None, metavar="SPECS",
+        help="comma-separated LoRA adapter specs served as <model>:<name> "
+             "(name | name=<dir> | name=random:<seed>): adapters load into "
+             "device-resident stacked pools and a mixed-adapter batch "
+             "decodes in ONE gathered dispatch (dynamo_tpu/lora/)",
+    )
+    run.add_argument(
+        "--max-loras", type=int, default=None,
+        help="device LoRA slots; more adapters than slots multiplex via LRU "
+             "eviction/hot-swap (in-flight sequences pin their slot)",
+    )
+    run.add_argument(
+        "--lora-rank", type=int, default=None,
+        help="LoRA pool rank (adapters with smaller r zero-pad exactly)",
+    )
     run.add_argument("--max-tokens", type=int, default=None, help="batch mode default max_tokens")
     run.add_argument(
         "--slo-ttft-ms", type=float, default=None,

@@ -90,6 +90,7 @@ class Backend:
             logprobs=request.logprobs,
             kv_holder_addr=getattr(request, "kv_holder_addr", ""),
             kv_holder_blocks=getattr(request, "kv_holder_blocks", 0),
+            lora_name=getattr(request, "lora_name", ""),
         )
         decoder = DecodeStream(
             self.tokenizer,

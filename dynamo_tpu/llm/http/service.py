@@ -234,8 +234,14 @@ class HttpService:
 
         pipeline = self.manager.get(req.model)
         if pipeline is None:
+            # structured OpenAI 404 (error.code model_not_found) on BOTH
+            # unary and stream paths: the model/adapter check runs before any
+            # SSE response starts, so a stream=true request naming an unknown
+            # LoRA adapter gets a plain JSON error, never SSE bytes
             self.metrics.inc_request(str(req.model), endpoint, "unary", "404")
-            return self._error(404, f"model {req.model!r} not found")
+            return self._error(
+                404, f"model {req.model!r} not found", code="model_not_found"
+            )
         if kind == "chat" and not pipeline.serves_chat:
             return self._error(400, f"model {req.model!r} does not serve chat")
         if kind == "completion" and not pipeline.serves_completion:

@@ -183,8 +183,14 @@ class KvIndexer:
     def find_matches(self, sequence: Sequence[int], early_exit: bool = False) -> OverlapScores:
         return self.tree.find_matches(sequence, early_exit)
 
-    def find_matches_for_request(self, token_ids: Sequence[int], early_exit: bool = False) -> OverlapScores:
+    def find_matches_for_request(
+        self, token_ids: Sequence[int], early_exit: bool = False, salt: int = 0
+    ) -> OverlapScores:
         """Token ids -> local block hashes -> radix walk
-        (reference: indexer.rs:648 find_matches_for_request)."""
-        hashes = compute_block_hash_for_seq(token_ids, self.kv_block_size)
+        (reference: indexer.rs:648 find_matches_for_request). ``salt`` (LoRA
+        adapter uid) folds into the first chunk hash exactly like the engine
+        side does, so adapter-specific prefix lines diverge at the radix
+        root and never cross-match another adapter's (or the base model's)
+        cached blocks."""
+        hashes = compute_block_hash_for_seq(token_ids, self.kv_block_size, salt)
         return self.find_matches(hashes, early_exit)

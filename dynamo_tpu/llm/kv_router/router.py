@@ -109,33 +109,35 @@ class KvRouter:
     # ---------------- scheduling ----------------
 
     @staticmethod
-    def _overlap_key(token_ids: Sequence[int]) -> tuple[int, int]:
-        return (len(token_ids), compute_block_hash(token_ids))
+    def _overlap_key(token_ids: Sequence[int], salt: int = 0) -> tuple[int, int, int]:
+        return (len(token_ids), compute_block_hash(token_ids), salt)
 
-    def _find_overlap(self, token_ids: Sequence[int]) -> OverlapScores:
+    def _find_overlap(self, token_ids: Sequence[int], salt: int = 0) -> OverlapScores:
         """Radix walk with a one-entry memo: back-to-back calls for the same
         prompt (schedule -> prefix_hit_tokens / remote-holder selection)
-        reuse ONE tree walk instead of recomputing it."""
-        key = self._overlap_key(token_ids)
+        reuse ONE tree walk instead of recomputing it. ``salt`` = the
+        request's LoRA adapter uid (0 = base): it keys the memo AND the walk,
+        so an adapter's overlap never reads another adapter's blocks."""
+        key = self._overlap_key(token_ids, salt)
         if self._last_overlap is not None and self._last_overlap[0] == key:
             return self._last_overlap[1]
-        overlap = self.indexer.find_matches_for_request(token_ids)
+        overlap = self.indexer.find_matches_for_request(token_ids, salt=salt)
         self._last_overlap = (key, overlap)
         return overlap
 
-    async def schedule(self, token_ids: Sequence[int]) -> int:
+    async def schedule(self, token_ids: Sequence[int], salt: int = 0) -> int:
         """Pick the best worker for these prompt tokens
         (reference: kv_router.rs:131 schedule)."""
-        worker_id, _ = await self.schedule_with_overlap(token_ids)
+        worker_id, _ = await self.schedule_with_overlap(token_ids, salt=salt)
         return worker_id
 
     async def schedule_with_overlap(
-        self, token_ids: Sequence[int]
+        self, token_ids: Sequence[int], salt: int = 0
     ) -> tuple[int, OverlapScores]:
         """schedule() that also returns the OverlapScores the decision used,
         so callers can derive prefix-hit and remote-holder metadata without a
         second radix walk."""
-        overlap = self._find_overlap(token_ids)
+        overlap = self._find_overlap(token_ids, salt)
         if not self.scheduler.endpoints.workers:
             await self.aggregator.scrape_once()
         return self.scheduler.schedule(len(token_ids), overlap), overlap
@@ -145,8 +147,9 @@ class KvRouter:
         token_ids: Sequence[int],
         worker_id: int,
         overlap: Optional[OverlapScores] = None,
+        salt: int = 0,
     ) -> int:
-        overlap = overlap if overlap is not None else self._find_overlap(token_ids)
+        overlap = overlap if overlap is not None else self._find_overlap(token_ids, salt)
         return overlap.scores.get(worker_id, 0) * self.kv_block_size
 
     # ---------------- fleet-wide prefix cache ----------------

@@ -37,6 +37,10 @@ class PreprocessedRequest:
     # processor when a peer's cached prefix beats the routed worker's
     kv_holder_addr: str = ""
     kv_holder_blocks: int = 0
+    # multi-LoRA: adapter name resolved from the OpenAI ``model`` field
+    # (``base:adapter``); "" = base model. Salts routing hashes and the
+    # engine's KV block identity; the worker pins the adapter's device slot.
+    lora_name: str = ""
 
     def to_wire(self) -> dict:
         out = {
@@ -65,6 +69,8 @@ class PreprocessedRequest:
         if self.kv_holder_addr:
             out["kv_holder_addr"] = self.kv_holder_addr
             out["kv_holder_blocks"] = self.kv_holder_blocks
+        if self.lora_name:
+            out["lora_name"] = self.lora_name
         if self.images:
             out["images"] = [im.to_wire() for im in self.images]
         return out
@@ -83,6 +89,7 @@ class PreprocessedRequest:
             skip_special_tokens=d.get("skip_special_tokens", True),
             kv_holder_addr=d.get("kv_holder_addr", ""),
             kv_holder_blocks=int(d.get("kv_holder_blocks", 0) or 0),
+            lora_name=str(d.get("lora_name", "") or ""),
             request_id=d["request_id"],
             token_ids=list(d["token_ids"]),
             sampling=SamplingParams(

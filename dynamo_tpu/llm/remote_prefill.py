@@ -43,6 +43,12 @@ class RemotePrefillRequest:
     # prefill worker re-enters the request context from it — stitching both
     # workers' spans (and logs) of one request onto one timeline
     trace_id: str = ""
+    # fleet prefix cache: the router-attached remote holder for this prompt.
+    # The PREFILL worker pulls the matching leading blocks from the holder
+    # before recomputing (same timeout -> recompute fallback as the decode
+    # side's FETCHING_KV path); empty = recompute as always.
+    kv_holder_addr: str = ""
+    kv_holder_blocks: int = 0
 
     def to_wire(self) -> dict:
         return self.__dict__.copy()

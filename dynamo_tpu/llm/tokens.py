@@ -36,17 +36,29 @@ def _tokens_bytes(tokens: Sequence[int]) -> bytes:
     return struct.pack(f"<{len(tokens)}I", *tokens)
 
 
-def compute_block_hash(tokens: Sequence[int]) -> BlockHash:
-    return compute_hash(_tokens_bytes(tokens))
+def compute_block_hash(tokens: Sequence[int], salt: int = 0) -> BlockHash:
+    """``salt`` (e.g. a LoRA adapter uid, lora/adapter.py lora_uid) prefixes
+    the hashed bytes so salted identities never collide with unsalted ones;
+    0 = the classic unsalted hash (bit-compatible with the reference)."""
+    data = _tokens_bytes(tokens)
+    if salt:
+        data = struct.pack("<Q", salt & 0xFFFFFFFFFFFFFFFF) + data
+    return compute_hash(data)
 
 
-def compute_block_hash_for_seq(tokens: Sequence[int], kv_block_size: int) -> list[BlockHash]:
+def compute_block_hash_for_seq(
+    tokens: Sequence[int], kv_block_size: int, salt: int = 0
+) -> list[BlockHash]:
     """Unchained per-chunk hashes of complete chunks (router matching identity).
 
-    Reference: lib/llm/src/kv_router/indexer.rs:123-133.
+    Reference: lib/llm/src/kv_router/indexer.rs:123-133. ``salt`` folds into
+    the FIRST chunk's hash only: every later chunk is reachable solely
+    through its salted ancestor in the radix tree, so one diverged root
+    isolates the whole adapter-specific prefix line while deeper chunk
+    hashes stay shared-computation-friendly.
     """
     return [
-        compute_block_hash(tokens[i : i + kv_block_size])
+        compute_block_hash(tokens[i : i + kv_block_size], salt if i == 0 else 0)
         for i in range(0, len(tokens) - kv_block_size + 1, kv_block_size)
     ]
 
@@ -78,12 +90,18 @@ class TokenSequence:
 
     Mirrors reference TokenSequence/split_tokens (lib/llm/src/tokens.rs:180-260):
     the first block's sequence hash equals its block hash; later blocks chain.
+
+    ``salt`` (LoRA adapter uid) folds into the first block's BLOCK hash, so
+    the whole chained line — and therefore every engine block identity, KV
+    event, and fleet pull key derived from it — is adapter-specific without
+    changing the chain structure (parent of block 0 stays None).
     """
 
-    def __init__(self, tokens: Sequence[int] = (), block_size: int = 16):
+    def __init__(self, tokens: Sequence[int] = (), block_size: int = 16, salt: int = 0):
         if block_size < 1:
             raise ValueError("block_size must be >= 1")
         self.block_size = block_size
+        self.salt = salt
         self.blocks: list[TokenBlock] = []
         self.current = PartialTokenBlock()
         self.extend(tokens)
@@ -105,7 +123,9 @@ class TokenSequence:
         cur.tokens.append(token)
         if len(cur.tokens) < self.block_size:
             return None
-        block_hash = compute_block_hash(cur.tokens)
+        block_hash = compute_block_hash(
+            cur.tokens, self.salt if cur.parent_sequence_hash is None else 0
+        )
         if cur.parent_sequence_hash is None:
             sequence_hash = block_hash
         else:

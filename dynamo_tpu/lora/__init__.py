@@ -1,0 +1,36 @@
+"""Multi-LoRA multiplexing: serve M fine-tunes of one base model from one
+engine.
+
+Punica (Chen et al., 2023) / S-LoRA (Sheng et al., 2023) style serving: LoRA
+A/B pairs for every target module live in device-resident stacked pools
+``[L, max_loras+1, ...]`` (slot 0 = the zero adapter, so base-only lanes ride
+the same gathered dispatch), and a mixed-adapter batch applies
+``y += scale * (x @ A[ids]) @ B[ids]`` per module in ONE dispatch — no
+per-adapter matmuls, no trace branches. Adapter-specific KV identity comes
+from salting the chained block hash with the adapter's stable uid
+(llm/tokens.py), so prefixes never cross-hit between adapters locally, in the
+router's radix view, or over the fleet pull path.
+"""
+
+from dynamo_tpu.lora.adapter import (
+    LORA_MODULES,
+    load_adapter,
+    lora_uid,
+    merge_adapter_into_params,
+    module_dims,
+    parse_adapter_specs,
+    synth_adapter,
+)
+from dynamo_tpu.lora.store import LoraStore, init_lora_pool
+
+__all__ = [
+    "LORA_MODULES",
+    "LoraStore",
+    "init_lora_pool",
+    "load_adapter",
+    "lora_uid",
+    "merge_adapter_into_params",
+    "module_dims",
+    "parse_adapter_specs",
+    "synth_adapter",
+]

@@ -1,0 +1,209 @@
+"""Device-resident adapter slot pool with LRU eviction and async host loads.
+
+The pool is one pytree of stacked per-module A/B planes,
+``[L, max_loras + 1, in, r]`` / ``[L, max_loras + 1, r, out]`` (f32, so the
+delta algebra is exact against a merged-weight f32 reference), plus a
+``scales [S]`` vector. Slot 0 is the reserved ZERO adapter: base-only lanes
+gather it like any other id — one gather, no branch in the trace.
+
+The store (engine-thread owner) maps adapter names to slots:
+
+  - ``acquire`` on a resident adapter pins its slot (refcounted; a slot is
+    never swapped under an in-flight sequence)
+  - a non-resident adapter kicks an ASYNC host load (side thread) and
+    returns None — the scheduler keeps the request waiting and keeps
+    serving everyone else; once host weights are ready, the next acquire
+    scatters them into a free (or LRU-evicted refcount-0) slot in one
+    donated device call
+  - eviction only drops the DEVICE slot; host weights stay cached, so a
+    hot-swap back in costs one scatter, not a reload (the S-LoRA
+    host-spill behavior — here the host tier is the load cache itself)
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+import numpy as np
+
+from dynamo_tpu.lora.adapter import (
+    LORA_MODULES,
+    load_adapter,
+    module_dims,
+    parse_adapter_specs,
+)
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("lora.store")
+
+
+def init_lora_pool(model, max_loras: int, rank: int) -> dict:
+    """Host zeros for the stacked pool: {"scales": [S], "mods": {m: {"a":
+    [L, S, in, r], "b": [L, S, r, out]}}} with S = max_loras + 1 (slot 0 =
+    base/zero)."""
+    c = model.config
+    S = max_loras + 1
+    dims = module_dims(c)
+    mods = {}
+    for m in LORA_MODULES:
+        din, dout = dims[m]
+        mods[m] = {
+            "a": np.zeros((c.num_layers, S, din, rank), np.float32),
+            "b": np.zeros((c.num_layers, S, rank, dout), np.float32),
+        }
+    return {"scales": np.zeros(S, np.float32), "mods": mods}
+
+
+class LoraStore:
+    """Adapter name -> device slot bookkeeping (engine thread only, except
+    the host-load worker which touches nothing but ``_host``/futures)."""
+
+    def __init__(self, config, model, scatter_fn):
+        self.max_loras = config.max_loras
+        self.rank = config.lora_rank
+        self.sources = parse_adapter_specs(config.lora_adapters)
+        self.model_config = model.config
+        self._scatter = scatter_fn  # (slot, host_tree, scale) -> device write
+        self.slot_of: dict[str, int] = {}
+        self._slot_name: dict[int, str] = {}
+        self._free_slots = list(range(config.max_loras, 0, -1))  # 1..max
+        self.refs: dict[str, int] = {}
+        self._lru: OrderedDict[str, None] = OrderedDict()  # ref-0 residents
+        self._host: dict[str, tuple[dict, float]] = {}
+        self._loading: dict[str, object] = {}
+        self._failed: dict[str, str] = {}
+        self._pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="lora-load")
+        # metrics
+        self.evictions = 0
+        self.loads = 0
+        self.load_seconds = 0.0
+        self.requests: dict[str, int] = {name: 0 for name in self.sources}
+
+    # ---------------- queries ----------------
+
+    def known(self, name: str) -> bool:
+        return name in self.sources
+
+    @property
+    def resident_count(self) -> int:
+        return len(self.slot_of)
+
+    def hot_adapter(self) -> str:
+        if not any(self.requests.values()):
+            return ""
+        return max(self.requests, key=lambda n: self.requests[n])
+
+    # ---------------- host load ----------------
+
+    def _load_host(self, name: str) -> tuple[dict, float]:
+        t0 = time.monotonic()
+        tree, scale = load_adapter(self.sources[name], self.model_config, self.rank)
+        self.load_seconds += time.monotonic() - t0
+        self.loads += 1
+        return tree, scale
+
+    def _poll_host(self, name: str) -> Optional[tuple[dict, float]]:
+        """Host weights if ready; kicks/polls the async load otherwise."""
+        got = self._host.get(name)
+        if got is not None:
+            return got
+        if name in self._failed:
+            raise RuntimeError(f"LoRA adapter {name!r} failed to load: {self._failed[name]}")
+        fut = self._loading.get(name)
+        if fut is None:
+            self._loading[name] = self._pool.submit(self._load_host, name)
+            return None
+        if not fut.done():
+            return None
+        del self._loading[name]
+        try:
+            got = fut.result()
+        except Exception as e:
+            log.exception("LoRA adapter %s load failed", name)
+            self._failed[name] = str(e)
+            raise RuntimeError(f"LoRA adapter {name!r} failed to load: {e}") from e
+        self._host[name] = got
+        return got
+
+    # ---------------- slot lifecycle ----------------
+
+    def acquire(self, name: str) -> Optional[int]:
+        """Pin ``name``'s slot for one sequence. Returns the slot id, or
+        None while the adapter is still loading / all slots are pinned (the
+        caller keeps the request waiting — never an error). Raises KeyError
+        for an unknown adapter and RuntimeError for a broken source."""
+        if name not in self.sources:
+            raise KeyError(f"unknown LoRA adapter {name!r}")
+        slot = self.slot_of.get(name)
+        if slot is not None:
+            self.refs[name] = self.refs.get(name, 0) + 1
+            self._lru.pop(name, None)
+            self.requests[name] = self.requests.get(name, 0) + 1
+            return slot
+        host = self._poll_host(name)
+        if host is None:
+            return None
+        slot = self._take_slot()
+        if slot is None:
+            return None  # every slot pinned by in-flight sequences
+        tree, scale = host
+        self._scatter(slot, tree, scale)
+        self.slot_of[name] = slot
+        self._slot_name[slot] = name
+        self.refs[name] = 1
+        self.requests[name] = self.requests.get(name, 0) + 1
+        return slot
+
+    def acquire_blocking(self, name: str, timeout_s: float = 30.0) -> Optional[int]:
+        """Synchronous acquire for paths with no retry loop (remote
+        prefill): waits for the host load, then takes a slot. None only when
+        every slot stays pinned for the whole timeout."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            slot = self.acquire(name)
+            if slot is not None or time.monotonic() >= deadline:
+                return slot
+            time.sleep(0.01)
+
+    def release(self, name: str) -> None:
+        """Unpin one sequence's hold; a refcount-0 slot stays resident (LRU
+        tail) until a new adapter needs it."""
+        rc = self.refs.get(name, 0) - 1
+        if rc > 0:
+            self.refs[name] = rc
+            return
+        self.refs.pop(name, None)
+        if name in self.slot_of:
+            self._lru[name] = None
+            self._lru.move_to_end(name)
+
+    def _take_slot(self) -> Optional[int]:
+        if self._free_slots:
+            return self._free_slots.pop()
+        if not self._lru:
+            return None
+        victim, _ = self._lru.popitem(last=False)
+        slot = self.slot_of.pop(victim)
+        self._slot_name.pop(slot, None)
+        self.evictions += 1
+        log.info("evicting LoRA adapter %s from slot %d (host copy kept)", victim, slot)
+        # the slot's pool plane is overwritten by the incoming scatter; no
+        # zeroing write needed (nothing dispatches slot ids without a live
+        # slot_of entry)
+        return slot
+
+    # ---------------- telemetry ----------------
+
+    def metrics_snapshot(self) -> dict:
+        return {
+            "resident": self.resident_count,
+            "capacity": self.max_loras,
+            "evictions": self.evictions,
+            "loads": self.loads,
+            "load_seconds": round(self.load_seconds, 4),
+            "requests": dict(self.requests),
+            "hot": self.hot_adapter(),
+        }

@@ -57,6 +57,27 @@ def _resolve_tp_axis(mesh: Mesh, tp_axis: str):
     return tp_axis  # unknown axis -> NamedSharding raises
 
 
+def lora_delta(x: jnp.ndarray, entry: dict, ids, scales: jnp.ndarray) -> jnp.ndarray:
+    """Gathered per-slot LoRA pass: ``scale[ids] * (x @ A[ids]) @ B[ids]``.
+
+    ``entry`` is one module's slot-stacked planes {"a": [S, in, r], "b":
+    [S, r, out]} (slot 0 = the zero adapter, so base-only lanes ride the same
+    gather instead of a trace branch). ``ids`` is a per-token [T] vector (a
+    mixed-adapter batch) or a scalar (a whole single-sequence chunk shares
+    one adapter — the gather degenerates to a slice and the two einsums to
+    plain matmuls). The f32 pool keeps the delta algebra exact against a
+    merged-weight f32 reference; the result casts back to x's dtype."""
+    xf = x.astype(jnp.float32)
+    a = entry["a"][ids]
+    b = entry["b"][ids]
+    if jnp.ndim(ids) == 0:
+        d = ((xf @ a) @ b) * scales[ids]
+    else:
+        xr = jnp.einsum("ti,tir->tr", xf, a)
+        d = jnp.einsum("tr,tro->to", xr, b) * scales[ids][:, None]
+    return d.astype(x.dtype)
+
+
 def parse_dtype(value) -> Any:
     """Accept a jnp dtype or its string alias in tiny:{...} config overrides."""
     if isinstance(value, str):
@@ -157,6 +178,12 @@ class LlamaModel:
     #: hot path's big matmuls; norms/biases (and embed/lm_head outside the
     #: layer stack) stay at config.dtype
     QUANT_WEIGHT_NAMES = frozenset({"wq", "wk", "wv", "wo", "gate", "up", "down"})
+
+    #: llama-family layers take the gathered LoRA pass (dynamo_tpu/lora/):
+    #: q/k/v/o + gated-MLP deltas ride slot-stacked pools through every
+    #: forward. Subclasses with their own _layer (mixtral's MoE block,
+    #: deepseek's absorbed attention) opt out until they thread it.
+    SUPPORTS_LORA = True
 
     def __init__(self, config: LlamaConfig):
         self.config = config
@@ -420,6 +447,9 @@ class LlamaModel:
         rope_positions: jnp.ndarray | None = None,  # [T, 3] M-RoPE components
         tp_axis: str | None = None,  # set inside an explicit (pp, tp) shard_map
         sp_axis: str | None = None,  # set inside a composed (pp, sp[, tp]) shard_map
+        lora_mods: dict | None = None,  # this layer's slot-stacked LoRA planes
+        lora_ids=None,  # [T] per-token adapter slot ids (or scalar)
+        lora_scales: jnp.ndarray | None = None,  # [S] per-slot alpha/r
     ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         """One transformer layer. Under GSPMD (pp == 1) the tp sharding is
         handled by the compiler; inside an explicit shard_map over a composed
@@ -441,6 +471,14 @@ class LlamaModel:
         q_flat = qlinear(h, lp["wq"])
         k_flat = qlinear(h, lp["wk"])
         v_flat = qlinear(h, lp["wv"])
+        if lora_mods is not None:
+            # the adapter delta rides ON TOP of qlinear unchanged (int8 base
+            # weights compose: dequant-in-matmul below, f32 delta here); k/v
+            # deltas land BEFORE rope + the pool scatter, so cached pages are
+            # adapter-specific — which the lora-salted block identity encodes
+            q_flat = q_flat + lora_delta(h, lora_mods["wq"], lora_ids, lora_scales)
+            k_flat = k_flat + lora_delta(h, lora_mods["wk"], lora_ids, lora_scales)
+            v_flat = v_flat + lora_delta(h, lora_mods["wv"], lora_ids, lora_scales)
         if c.attention_bias:
             q_flat = q_flat + lp["bq"]
             k_flat = k_flat + lp["bk"]
@@ -473,12 +511,25 @@ class LlamaModel:
         # attn_fn sees both the updated pools (paged paths) and the chunk's
         # fresh rows (ring/SP path, which never reads the pool)
         attn = attn_fn(q, k, v, k_pool, v_pool)
-        attn_out = qlinear(attn.reshape(T, -1), lp["wo"])
+        attn_flat = attn.reshape(T, -1)
+        attn_out = qlinear(attn_flat, lp["wo"])
+        if lora_mods is not None:
+            attn_out = attn_out + lora_delta(
+                attn_flat, lora_mods["wo"], lora_ids, lora_scales
+            )
         if tp_axis is not None:
             attn_out = jax.lax.psum(attn_out, tp_axis)
         hidden = hidden + attn_out
         h = rms_norm(hidden, lp["post_norm"], c.rms_norm_eps)
-        mlp = qlinear(jax.nn.silu(qlinear(h, lp["gate"])) * qlinear(h, lp["up"]), lp["down"])
+        g = qlinear(h, lp["gate"])
+        u = qlinear(h, lp["up"])
+        if lora_mods is not None:
+            g = g + lora_delta(h, lora_mods["gate"], lora_ids, lora_scales)
+            u = u + lora_delta(h, lora_mods["up"], lora_ids, lora_scales)
+        prod = jax.nn.silu(g) * u
+        mlp = qlinear(prod, lp["down"])
+        if lora_mods is not None:
+            mlp = mlp + lora_delta(prod, lora_mods["down"], lora_ids, lora_scales)
         if tp_axis is not None:
             mlp = jax.lax.psum(mlp, tp_axis)
         hidden = hidden + mlp
@@ -487,11 +538,15 @@ class LlamaModel:
     def _prefill_common(
         self, params, kv_cache, tokens, positions, page_table, valid, last_idx, make_attn_fn,
         input_embeds=None, embeds_mask=None, rope_positions=None,
+        lora=None, lora_id=None,
     ) -> tuple[jnp.ndarray, dict]:
         """Shared prefill machinery; make_attn_fn(off) -> attn_fn for a layer
         (off = the layer's flat-pool offset). input_embeds [T, D] + embeds_mask
         [T] override the token embeddings where the mask is set (multimodal:
-        vision-tower outputs replace image-slot virtual tokens)."""
+        vision-tower outputs replace image-slot virtual tokens). ``lora``
+        (the slot-stacked adapter pool) + scalar ``lora_id`` apply one
+        adapter's delta to the whole chunk (a chunk belongs to one sequence;
+        id 0 gathers the zero adapter)."""
         c = self.config
         k_pool, v_pool = kv_cache["k"], kv_cache["v"]
         page_size = k_pool.shape[1]
@@ -505,17 +560,23 @@ class LlamaModel:
 
         def body(carry, xs):
             h, kp, vp = carry
-            lp, off = xs
+            lp, off = xs[0], xs[1]
+            lkw = {}
+            if lora is not None:
+                lkw = dict(
+                    lora_mods=xs[2], lora_ids=lora_id, lora_scales=lora["scales"]
+                )
             h, kp, vp = self._layer(
                 lp, h, kp, vp, positions, off + phys, offsets, make_attn_fn(off),
-                rope_positions=rope_positions,
+                rope_positions=rope_positions, **lkw,
             )
             return (h, kp, vp), None
 
+        xs_all = (params["layers"], self._layer_offsets(num_pages))
+        if lora is not None:
+            xs_all = xs_all + (lora["mods"],)
         (hidden, k_pool, v_pool), _ = jax.lax.scan(
-            body,
-            (hidden, k_pool, v_pool),
-            (params["layers"], self._layer_offsets(num_pages)),
+            body, (hidden, k_pool, v_pool), xs_all
         )
         logits = self._unembed(params, hidden[last_idx][None, :])[0]
         return logits, {"k": k_pool, "v": v_pool}
@@ -532,6 +593,8 @@ class LlamaModel:
         input_embeds: jnp.ndarray | None = None,  # [T, D] mm embedding overrides
         embeds_mask: jnp.ndarray | None = None,  # [T] bool
         rope_positions: jnp.ndarray | None = None,  # [T, 3] M-RoPE components
+        lora: dict | None = None,  # slot-stacked adapter pool (lora/store.py)
+        lora_id=None,  # scalar adapter slot for this chunk (0 = base)
     ) -> tuple[jnp.ndarray, dict]:
         """One (possibly chunked) prefill pass for a single sequence.
 
@@ -549,7 +612,7 @@ class LlamaModel:
         return self._prefill_common(
             params, kv_cache, tokens, positions, page_table, valid, last_idx, make_attn_fn,
             input_embeds=input_embeds, embeds_mask=embeds_mask,
-            rope_positions=rope_positions,
+            rope_positions=rope_positions, lora=lora, lora_id=lora_id,
         )
 
     def prefill_packed(
@@ -561,6 +624,8 @@ class LlamaModel:
         page_tables: jnp.ndarray,  # [N, max_pages] logical page ids per lane
         valid: jnp.ndarray,  # [N, T] bool
         last_idx: jnp.ndarray,  # [N] index of each lane's final real token
+        lora: dict | None = None,  # slot-stacked adapter pool
+        lora_ids: jnp.ndarray | None = None,  # [N] per-lane adapter slots
     ) -> tuple[jnp.ndarray, dict]:
         """Cross-request packed prefill: N lanes (chunks of N DIFFERENT
         sequences) flattened into one [N*T] token stream so the layer matmuls
@@ -574,7 +639,8 @@ class LlamaModel:
         Returns (logits [N, V] at each lane's last_idx, updated kv_cache)."""
         N, T = tokens.shape
         hidden, kv_cache = self._packed_forward(
-            params, kv_cache, tokens, positions, page_tables, valid
+            params, kv_cache, tokens, positions, page_tables, valid,
+            lora=lora, lora_ids=lora_ids,
         )
         rows = hidden[jnp.arange(N) * T + last_idx]  # [N, D]
         logits = self._unembed(params, rows)  # [N, V]
@@ -588,9 +654,13 @@ class LlamaModel:
         positions: jnp.ndarray,  # [N, T]
         page_tables: jnp.ndarray,  # [N, max_pages]
         valid: jnp.ndarray,  # [N, T]
+        lora: dict | None = None,
+        lora_ids: jnp.ndarray | None = None,  # [N] per-lane adapter slots
     ) -> tuple[jnp.ndarray, dict]:
         """Shared N-lane layer stack for prefill_packed and verify: one weight
         pass over the flattened [N*T] token stream, per-lane paged attention.
+        A mixed-adapter pack broadcasts each lane's slot id over its tokens —
+        one gathered dispatch, not N per-adapter calls.
         Returns (hidden [N*T, D], updated kv_cache)."""
         c = self.config
         k_pool, v_pool = kv_cache["k"], kv_cache["v"]
@@ -617,21 +687,35 @@ class LlamaModel:
 
         num_pages = k_pool.shape[0] // c.num_layers
         hidden = params["embed"][tokens.reshape(N * T)].astype(c.dtype)
+        ids_flat = None
+        if lora is not None:
+            ids_flat = jnp.repeat(
+                lora_ids.astype(jnp.int32)
+                if lora_ids is not None
+                else jnp.zeros(N, jnp.int32),
+                T,
+            )
 
         def body(carry, xs):
             h, kp, vp = carry
-            lp, off = xs
+            lp, off = xs[0], xs[1]
+            lkw = {}
+            if lora is not None:
+                lkw = dict(
+                    lora_mods=xs[2], lora_ids=ids_flat, lora_scales=lora["scales"]
+                )
             h, kp, vp = self._layer(
                 lp, h, kp, vp, pos_flat,
                 off + phys.reshape(N * T), offsets.reshape(N * T),
-                make_attn_fn(off),
+                make_attn_fn(off), **lkw,
             )
             return (h, kp, vp), None
 
+        xs_all = (params["layers"], self._layer_offsets(num_pages))
+        if lora is not None:
+            xs_all = xs_all + (lora["mods"],)
         (hidden, k_pool, v_pool), _ = jax.lax.scan(
-            body,
-            (hidden, k_pool, v_pool),
-            (params["layers"], self._layer_offsets(num_pages)),
+            body, (hidden, k_pool, v_pool), xs_all
         )
         return hidden, {"k": k_pool, "v": v_pool}
 
@@ -643,6 +727,8 @@ class LlamaModel:
         positions: jnp.ndarray,  # [B, T] consecutive fed positions per slot
         page_tables: jnp.ndarray,  # [B, max_pages] logical page ids per slot
         valid: jnp.ndarray,  # [B, T] bool (invalid rows -> trash page)
+        lora: dict | None = None,  # slot-stacked adapter pool
+        lora_ids: jnp.ndarray | None = None,  # [B] per-slot adapter slots
     ) -> tuple[jnp.ndarray, dict]:
         """Speculative verification: every slot feeds T = k+1 tokens at
         consecutive positions through the paged context in ONE weight pass
@@ -656,7 +742,8 @@ class LlamaModel:
         overwritten by the next pass at the advanced anchor."""
         B, T = tokens.shape
         hidden, kv_cache = self._packed_forward(
-            params, kv_cache, tokens, positions, page_tables, valid
+            params, kv_cache, tokens, positions, page_tables, valid,
+            lora=lora, lora_ids=lora_ids,
         )
         logits = self._unembed(params, hidden)  # [B*T, V]
         return logits.reshape(B, T, -1), kv_cache
@@ -672,6 +759,8 @@ class LlamaModel:
         last_idx: jnp.ndarray,
         mesh: Mesh,
         sp_axis: str = "sp",
+        lora: dict | None = None,
+        lora_id=None,  # scalar adapter slot for this whole-prompt chunk
     ) -> tuple[jnp.ndarray, dict]:
         """Sequence-parallel prefill: the chunk's attention runs as ring
         attention over the ``sp`` mesh axis (K/V shards rotate via ppermute on
@@ -694,7 +783,8 @@ class LlamaModel:
             return attn_fn
 
         return self._prefill_common(
-            params, kv_cache, tokens, positions, page_table, valid, last_idx, make_attn_fn
+            params, kv_cache, tokens, positions, page_table, valid, last_idx,
+            make_attn_fn, lora=lora, lora_id=lora_id,
         )
 
     def decode(
@@ -706,6 +796,8 @@ class LlamaModel:
         page_tables: jnp.ndarray,  # [B, max_pages] logical (per-layer) page ids
         active: jnp.ndarray,  # [B] bool
         rope_deltas: jnp.ndarray | None = None,  # [B] M-RoPE position offsets
+        lora: dict | None = None,  # slot-stacked adapter pool
+        lora_ids: jnp.ndarray | None = None,  # [B] per-slot adapter ids
     ) -> tuple[jnp.ndarray, dict]:
         """One decode step for the whole batch. Returns (logits[B, V], kv_cache).
 
@@ -730,23 +822,33 @@ class LlamaModel:
 
         def body(carry, xs):
             h, kp, vp = carry
-            lp, off = xs
+            lp, off = xs[0], xs[1]
 
             def attn_fn(q, k_new, v_new, kp_, vp_):
                 return dispatch_paged_decode_attention(
                     q, kp_, vp_, off + page_tables, positions, mesh=self.attn_mesh
                 )
 
+            lkw = {}
+            if lora is not None:
+                lkw = dict(
+                    lora_mods=xs[2],
+                    lora_ids=lora_ids
+                    if lora_ids is not None
+                    else jnp.zeros(B, jnp.int32),
+                    lora_scales=lora["scales"],
+                )
             h, kp, vp = self._layer(
                 lp, h, kp, vp, positions, off + phys, offsets, attn_fn,
-                rope_positions=rope_pos3,
+                rope_positions=rope_pos3, **lkw,
             )
             return (h, kp, vp), None
 
+        xs_all = (params["layers"], self._layer_offsets(num_pages))
+        if lora is not None:
+            xs_all = xs_all + (lora["mods"],)
         (hidden, k_pool, v_pool), _ = jax.lax.scan(
-            body,
-            (hidden, k_pool, v_pool),
-            (params["layers"], self._layer_offsets(num_pages)),
+            body, (hidden, k_pool, v_pool), xs_all
         )
         logits = self._unembed(params, hidden)
         return logits, {"k": k_pool, "v": v_pool}

@@ -54,6 +54,10 @@ class MixtralConfig(LlamaConfig):
 
 
 class MixtralModel(LlamaModel):
+    #: the MoE _layer override predates the gathered LoRA pass; expert-bank
+    #: adapter deltas need their own routing-aware treatment
+    SUPPORTS_LORA = False
+
     #: attention matmuls + the per-expert FFN banks quantize; the router
     #: stays f32 (routing decisions are precision-sensitive and tiny)
     QUANT_WEIGHT_NAMES = frozenset(

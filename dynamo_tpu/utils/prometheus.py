@@ -302,8 +302,17 @@ def _sample_surfaces() -> list[tuple[str, str]]:
     class _DraftPool:
         pages_total, pages_used = 7, 3
 
+    class _LoraStore:  # shape resource_snapshot actually reads
+        def metrics_snapshot(self):
+            return {
+                "resident": 2, "capacity": 4, "evictions": 1, "loads": 3,
+                "load_seconds": 0.42, "requests": {"a1": 5, "a2": 2},
+                "hot": "a1",
+            }
+
     class _SpecRunner:  # shape resource_snapshot actually reads
         draft = _DraftPool()
+        lora_store = _LoraStore()
         model = None
         compile_monitor = None
 

@@ -372,9 +372,9 @@ def test_router_overlap_memo_and_remote_holder():
     calls = [0]
     orig = router.indexer.find_matches_for_request
 
-    def counting(token_ids, early_exit=False):
+    def counting(token_ids, early_exit=False, salt=0):
         calls[0] += 1
-        return orig(token_ids, early_exit)
+        return orig(token_ids, early_exit, salt=salt)
 
     router.indexer.find_matches_for_request = counting
 

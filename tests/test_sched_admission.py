@@ -13,7 +13,12 @@ class _StubRunner:
 
     packed_prefill_mode = False
 
+    lora_store = None
+
     def write_token_slots(self, slots, tokens):  # pragma: no cover
+        pass
+
+    def set_slot_lora(self, slot, lora_slot):  # pragma: no cover
         pass
 
 
@@ -44,7 +49,7 @@ def test_oversized_prompt_rejected_before_fairness_cap(monkeypatch):
     # the prefill dispatch it triggers
     started = []
 
-    def fake_start(req, slot):
+    def fake_start(req, slot, lora_slot=0):
         sched.slots[slot] = RunningSeq(
             req=req, slot=slot, prompt_len=len(req.token_ids), cached_len=0,
             prefill_pos=None,
@@ -72,7 +77,7 @@ def test_oversized_rejection_does_not_consume_the_cap(monkeypatch):
     _occupy_decode_slot(sched)
     started = []
 
-    def fake_start(req, slot):
+    def fake_start(req, slot, lora_slot=0):
         sched.slots[slot] = RunningSeq(
             req=req, slot=slot, prompt_len=len(req.token_ids), cached_len=0,
             prefill_pos=None,

@@ -69,7 +69,7 @@ def render_status(doc: dict) -> str:
     header = (
         f"{'WORKER':<12} {'STATE':<10} {'HB':>6} {'SEEN':>6} {'MISS':>4} "
         f"{'SLOTS':>7} {'KV%':>6} {'KVMEM':>11} {'PREFIX':>9} {'SPEC':>10} "
-        f"{'WAIT':>5} {'HBM':>9} {'CMPL':>5}  SLO"
+        f"{'LORA':>11} {'WAIT':>5} {'HBM':>9} {'CMPL':>5}  SLO"
     )
     lines.append(header)
     lines.append("-" * len(header))
@@ -112,6 +112,16 @@ def render_status(doc: dict) -> str:
             spec = f"{str(kind)[:5]} {100.0 * res.get('spec_acceptance_rate', 0):.0f}%"
         else:
             spec = "-"
+        # multi-LoRA: resident/capacity device slots + the hottest adapter
+        # by admitted sequences (lora_* resource gauges; base-only workers
+        # show "-")
+        if res.get("lora_capacity"):
+            hot = str(res.get("lora_hot", "") or "")[:6]
+            lora = f"{res.get('lora_resident', 0)}/{res['lora_capacity']}"
+            if hot:
+                lora = f"{lora} {hot}"
+        else:
+            lora = "-"
         hb = health.get("heartbeat_age_s")
         stale_mark = " STALE" if w.get("stale") else ""
         lines.append(
@@ -119,6 +129,7 @@ def render_status(doc: dict) -> str:
             f"{(f'{hb:.1f}s' if hb is not None else '-'):>6} "
             f"{w.get('last_seen_s', 0):>5.1f}s {w.get('missed_scrapes', 0):>4} "
             f"{slots:>7} {kv_pct:>5.1f}% {kv_mem:>11} {prefix:>9} {spec:>10} "
+            f"{lora:>11} "
             f"{kv.get('num_requests_waiting', 0):>5} "
             f"{_fmt_bytes(res.get('hbm_bytes_in_use', 0)):>9} "
             f"{res.get('xla_compiles', 0):>5}  {_slo_cell(w.get('slo'))}"
