@@ -2221,6 +2221,196 @@ async def run_http_serving(batch: int = 32, page_size: int = 64) -> dict:
     }
 
 
+async def run_replay() -> dict:
+    """Trace-replay bench spine (dynamo_tpu/loadgen): seeded scenario traces
+    replayed against in-process engines, producing per-scenario
+    goodput/TTFT-p99/ITL-p99/tok_s — one arm per post-r05 subsystem:
+
+      bursty_chat            base engine (the chat shape)
+      int8_kv                bursty chat on an int8 KV cache
+      long_context_sessions  shared-prefix sessions (table ladder / prefix cache)
+      lora_churn             zipf hot/cold adapters over multiple tenants
+      spec_draft             bursty chat under draft-model speculation
+      fleet_prefix           session prefixes pulled from a peer holder
+      mm_vl                  Qwen2-VL image requests (first perf numbers)
+
+    On CPU (no TPU in the build container) geometry and budgets scale down —
+    numbers are labeled cpu_smoke; the driver's TPU run prices the same
+    scenarios at serving geometry. Every arm records the replay report's
+    goodput verdict against the scenario's (platform-scaled) SLO budgets."""
+    import gc
+
+    import jax
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.loadgen import compile_trace, load_scenario
+    from dynamo_tpu.loadgen.replay import ReplayMetrics, replay_engine
+    from dynamo_tpu.utils.goodput import GoodputTracker
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu:
+        base_id = "tiny"  # registry tiny (64-hidden f32): CPU-fast
+        n, speed = 12, 2.0
+        # CPU smoke budgets: generous enough that the verdict measures the
+        # serving stack, not the absence of a TPU
+        budgets = {"slo_ttft_ms": 30000.0, "slo_itl_ms": 5000.0}
+        eng_kw = dict(
+            page_size=4, num_pages=1024, max_seqs=4, max_model_len=640,
+            prefill_buckets=(16, 32, 64, 128, 256), decode_steps=4,
+            pipeline_depth=2,
+        )
+        scale = dict(
+            isl_mean=24, isl_max=96, osl_dist="fixed", osl_mean=8, osl_max=8,
+            rate_rps=8.0, vocab=256, **budgets,
+        )
+        lctx_scale = dict(
+            shared_prefix_len=128, isl_mean=32, isl_max=96, osl_dist="fixed",
+            osl_mean=8, osl_max=8, vocab=256, **budgets,
+        )
+    else:
+        base_id = json_model_id()
+        n, speed = 48, 1.0
+        budgets = {"slo_ttft_ms": 2000.0, "slo_itl_ms": 100.0}
+        eng_kw = dict(
+            page_size=16, num_pages=8192, max_seqs=16, max_model_len=2048,
+            prefill_buckets=(128, 256, 512), decode_steps=16,
+            pipeline_depth=3,
+        )
+        scale = dict(isl_mean=128, isl_max=512, osl_mean=48, osl_max=128,
+                     rate_rps=16.0, vocab=31000, **budgets)
+        lctx_scale = dict(shared_prefix_len=512, isl_mean=128, isl_max=512,
+                          osl_mean=32, osl_max=64, vocab=31000, **budgets)
+
+    lora_names = ("a1", "a2", "a3", "a4", "a5", "a6")
+    arms = [
+        # (scenario key, spec, engine-config overrides, model id)
+        ("bursty_chat",
+         load_scenario("bursty_chat", num_requests=n).replace(**scale),
+         {}, base_id),
+        ("int8_kv",
+         load_scenario("bursty_chat", num_requests=n, seed=1).replace(
+             name="int8_kv", **scale),
+         {"kv_cache_dtype": "int8"}, base_id),
+        ("long_context_sessions",
+         load_scenario("long_context_sessions", num_requests=max(8, n // 2))
+         .replace(**lctx_scale),
+         {}, base_id),
+        ("lora_churn",
+         load_scenario("lora_churn", num_requests=n).replace(
+             adapters=lora_names, **scale),
+         {"lora_adapters": lora_names, "max_loras": 4, "lora_rank": 4},
+         base_id),
+        ("spec_draft",
+         load_scenario("bursty_chat", num_requests=max(8, n // 2), seed=2)
+         .replace(name="spec_draft", **scale),
+         {"speculative": f"draft:{base_id}:2"}, base_id),
+        ("mm_vl",
+         load_scenario("mm_vl", num_requests=max(6, n // 4)).replace(
+             vocab=250, image_hw=(16, 16), **budgets),
+         {"max_model_len": 640}, "tiny-vl"),
+    ]
+
+    out: dict = {
+        "cpu_smoke": on_cpu,
+        "platform": jax.devices()[0].platform,
+        "speed": speed,
+        "budgets": budgets,
+        "scenarios": {},
+    }
+    goodput = GoodputTracker()
+    for key, spec, over, model_id in arms:
+        eng = AsyncJaxEngine(EngineConfig(model_id=model_id, **{**eng_kw, **over}))
+        try:
+            await eng.start()
+            # warm the executables out of the measurement (a cold XLA compile
+            # inside the replay would blow every budget on its own)
+            warm = compile_trace(spec.replace(seed=spec.seed + 97,
+                                              num_requests=2, images=spec.images))
+            await replay_engine(eng, warm, spec=spec, speed=100.0)
+            report = await replay_engine(
+                eng, compile_trace(spec), spec=spec, speed=speed,
+                goodput=goodput, metrics=ReplayMetrics(),
+            )
+            report.pop("outcomes", None)
+            report["engine_stage"] = eng.stage_snapshot()
+            out["scenarios"][key] = report
+        finally:
+            await eng.shutdown()
+            gc.collect()
+
+    # fleet_prefix arm: a holder engine computes (and serves) every session's
+    # shared prefix; the replay engine's requests carry the holder hint, so
+    # admission PULLS the prefix over the dataplane instead of recomputing
+    from dynamo_tpu.disagg.prefix_fetch import KvPullServer, PrefixFetchClient
+
+    spec = load_scenario(
+        "long_context_sessions", num_requests=max(8, n // 2), seed=3,
+    ).replace(name="fleet_prefix", **lctx_scale)
+    trace = compile_trace(spec)
+    ps = eng_kw["page_size"]
+    prefix_blocks = spec.shared_prefix_len // ps
+    cfg = dict(eng_kw, prefix_fetch_timeout_s=60.0)
+    cleanups = []
+    try:
+        holder = AsyncJaxEngine(EngineConfig(model_id=base_id, **cfg))
+        await holder.start()
+        cleanups.append(holder.shutdown)
+        puller = AsyncJaxEngine(EngineConfig(model_id=base_id, **cfg))
+        await puller.start()
+        cleanups.append(puller.shutdown)
+        srv = await KvPullServer(holder, host="127.0.0.1").start()
+        cleanups.append(srv.stop)
+        fetcher = PrefixFetchClient(asyncio.get_running_loop(), timeout_s=60.0)
+        puller.attach_prefix_fetch(fetcher)
+        # seed the holder's cache with each session's shared prefix
+        seen = set()
+        for tr in trace:
+            if tr.session not in seen:
+                seen.add(tr.session)
+                await _request(holder, f"seed-{tr.session}",
+                               tr.token_ids[: spec.shared_prefix_len],
+                               max_tokens=2)
+
+        def attach_holder(req, tr):
+            req.kv_holder_addr = srv.address
+            req.kv_holder_blocks = prefix_blocks
+
+        warm = compile_trace(spec.replace(seed=spec.seed + 97, num_requests=2))
+        await replay_engine(puller, warm, spec=spec, speed=100.0,
+                            request_hook=attach_holder)
+        report = await replay_engine(
+            puller, trace, spec=spec, speed=speed, goodput=goodput,
+            metrics=ReplayMetrics(), request_hook=attach_holder,
+        )
+        report.pop("outcomes", None)
+        sched = puller.scheduler
+        report["prefix_fetch"] = {
+            "hits": sched.prefix_fetch_hits,
+            "fallbacks": sched.prefix_fetch_fallbacks,
+            "pulled_blocks": sched.prefix_fetch_blocks,
+            "pulled_bytes": sched.prefix_fetch_bytes,
+        }
+        out["scenarios"]["fleet_prefix"] = report
+        assert sched.prefix_fetch_hits > 0, "fleet_prefix replay never pulled"
+    finally:
+        for stop in reversed(cleanups):
+            try:
+                await stop()
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+        gc.collect()
+
+    out["overall_goodput"] = goodput.snapshot()["goodput"]
+    # every scenario must have produced the acceptance keys
+    for key, rep in out["scenarios"].items():
+        for field in ("goodput", "ttft_p99_ms", "tok_s"):
+            assert rep.get(field) is not None, f"replay.{key}.{field} missing"
+    return out
+
+
 #: filled section-by-section so a crash in section N never erases sections
 #: 1..N-1 — __main__ prints whatever landed here even on a fatal error
 DETAIL: dict = {}
@@ -2236,8 +2426,19 @@ async def _section(name: str, thunk, timeout_s: float) -> None:
     zero the whole artifact (r3 post-mortem: one aiohttp timeout discarded 10
     minutes of measured results)."""
     import gc
+    import os
     import sys
     import traceback
+
+    wanted = {
+        s.strip()
+        for s in os.environ.get("DYNTPU_BENCH_SECTIONS", "").split(",")
+        if s.strip()
+    }
+    if wanted and name not in wanted:
+        print(f"[bench] section {name} skipped (DYNTPU_BENCH_SECTIONS)",
+              file=sys.stderr, flush=True)
+        return
 
     t0 = time.monotonic()
     try:
@@ -2260,6 +2461,11 @@ async def _section(name: str, thunk, timeout_s: float) -> None:
 async def run() -> dict:
     import os
 
+    import jax
+
+    # the artifact must say what it measured on (CPU smoke numbers are
+    # labeled; the driver's TPU run carries the priced numbers)
+    DETAIL["platform"] = jax.devices()[0].platform
     _probe_pallas(HEADLINE[1])
     await _section("headline_bs%d_ps%d" % HEADLINE,
                    lambda: run_config(*HEADLINE), 1500)
@@ -2342,7 +2548,23 @@ async def run() -> dict:
         # short-prompt no-regression ratio (CPU smoke scales down 16x)
         await _section("long_context", run_long_context, 2400)
         await _section("parity_host_offload", run_offload_parity, 1200)
+    # trace-replay spine (ROADMAP item 2): seeded scenarios re-price the
+    # post-r05 subsystems in goodput/TTFT-p99/ITL-p99 terms per scenario
+    await _section("replay", run_replay, 2400)
     return _result()
+
+
+#: summary-line aliases for the replay scenarios (tail-budget compression);
+#: bench_detail.json keeps the full names
+_REPLAY_ALIASES = {
+    "bursty_chat": "bursty",
+    "int8_kv": "int8",
+    "long_context_sessions": "lctx",
+    "lora_churn": "lora",
+    "spec_draft": "spec",
+    "fleet_prefix": "fleet",
+    "mm_vl": "mm",
+}
 
 
 def _get(d: dict | None, *path, default=None):
@@ -2394,7 +2616,31 @@ def _summary(errors: dict) -> dict:
     spec = DETAIL.get("spec_ngram")
     sdraft = DETAIL.get("spec_draft")
     mlora = DETAIL.get("multi_lora")
+    replay = DETAIL.get("replay")
+    # per-scenario acceptance keys (replay.{scenario}.{goodput,ttft_p99_ms,
+    # itl_p99_ms,tok_s}); wall/lag/stage detail rides bench_detail.json
+    replay_summary = None
+    if replay:
+        # compact aliased-array form against the driver's hard 2000-char
+        # stdout-tail cap (BENCH_r02..r05 all recorded exactly 2000):
+        # replay_cols names the columns, _REPLAY_ALIASES maps the keys; the
+        # full named-key reports (replay.{scenario}.{goodput,ttft_p99_ms,
+        # itl_p99_ms,tok_s} + wall/lag/stage breakdowns) ride
+        # bench_detail.json under their full scenario names
+        def ims(v):  # integer ms: sub-ms precision is noise at p99
+            return round(v) if isinstance(v, float) else v
+
+        replay_summary = {
+            _REPLAY_ALIASES.get(sc, sc): [
+                _get(rep, "goodput"),
+                ims(_get(rep, "ttft_p99_ms")),
+                ims(_get(rep, "itl_p99_ms")),
+                ims(_get(rep, "tok_s")),
+            ]
+            for sc, rep in sorted(replay.get("scenarios", {}).items())
+        }
     return {
+        "platform": DETAIL.get("platform"),
         "headline_tok_s": _get(head, "tok_s"),
         "continuity_bs8_tok_s": _get(cont, "tok_s"),
         "r01_value_bs8": R01_VALUE_BS8,
@@ -2493,6 +2739,12 @@ def _summary(errors: dict) -> dict:
         "parity_host_offload": {
             "ratio_projected": _get(off, "projection", "ttft_ratio_projected"),
         },
+        # the trace-replay spine: goodput under per-scenario SLO budgets,
+        # columns per replay_cols (budgets + cpu_smoke flag + full named
+        # reports in bench_detail.json)
+        "replay_cols": "goodput,ttft_p99_ms,itl_p99_ms,tok_s"
+        if replay_summary else None,
+        "replay": replay_summary,
         # 120-char cap per error: a raw XLA error repr is routinely thousands
         # of chars and would re-trigger the very tail truncation this summary
         # exists to survive (full text lands in bench_detail.json)
@@ -2555,6 +2807,9 @@ if __name__ == "__main__":
         else:
             label = f"{type(e).__name__}: {e}"
         result = _result(extra_errors={"__run__": {"error": label}})
-        print(json.dumps(result))
+        print(json.dumps(result, separators=(",", ":")))
         sys.exit(0 if result["value"] else 1)
-    print(json.dumps(result))
+    # compact separators: the driver keeps only the last 2000 chars of
+    # stdout, and the default ", " formatting alone costs ~200 chars on a
+    # full summary line
+    print(json.dumps(result, separators=(",", ":")))

@@ -107,6 +107,7 @@ class MetricsService:
                 "kv_metrics": view.data.get("kv_metrics"),
                 "resources": view.data.get("resources"),
                 "slo": view.data.get("slo"),
+                "goodput": view.data.get("goodput"),
                 "stage_seconds": view.data.get("stage_seconds"),
                 "disagg": view.data.get("disagg"),
             }

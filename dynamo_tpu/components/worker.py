@@ -180,6 +180,11 @@ class WorkerService:
         slo = getattr(self._inner_engine, "slo_snapshot", None)
         if slo is not None:
             stats["slo"] = slo()
+        goodput = getattr(self._inner_engine, "goodput_snapshot", None)
+        if goodput is not None:
+            # windowed per-scenario/tenant SLO-met fraction (dynotop GOODPUT
+            # column; item-5 QoS scheduling reads the per-tenant view)
+            stats["goodput"] = goodput()
         if self.kv_pull_server is not None:
             # the fleet prefix cache's discovery channel: routers read the
             # pull address out of this broadcast to attach us as a holder

@@ -24,6 +24,7 @@ from dynamo_tpu.engine.scheduler import EngineRequest, Scheduler, StepOutput
 from dynamo_tpu.llm.kv_events import KvCacheEvent
 from dynamo_tpu.runtime.context import current_context
 from dynamo_tpu.utils import get_logger, tracing
+from dynamo_tpu.utils.goodput import GoodputTracker
 from dynamo_tpu.utils.health import HealthMonitor
 from dynamo_tpu.utils.slo import SloTracker, targets_from_env
 
@@ -85,6 +86,13 @@ class AsyncJaxEngine:
         self.health = HealthMonitor("engine")
         self.slo = SloTracker(
             targets_from_env({"ttft": config.slo_ttft_ms, "itl": config.slo_itl_ms})
+        )
+        # goodput plane (utils/goodput.py): every naturally-finished request
+        # emits one RequestOutcome from the scheduler; budgets default to the
+        # engine's SLO targets (untargeted engines still count errors)
+        self.goodput = GoodputTracker(
+            ttft_budget_s=self.slo.targets.get("ttft"),
+            itl_budget_s=self.slo.targets.get("itl"),
         )
         self._next_watchdog = 0.0
         # fleet-wide prefix cache (disagg/prefix_fetch.py): the pull client
@@ -171,6 +179,7 @@ class AsyncJaxEngine:
         )
         self.scheduler = Scheduler(self.config, self.runner, self.allocator)
         self.scheduler.slo = self.slo
+        self.scheduler.outcome_sink = self.goodput.observe
         self.scheduler.prefix_fetcher = self.prefix_fetcher
         if self.config.warmup == "background":
             # readiness waits only for the traces first requests need; the
@@ -756,6 +765,11 @@ class AsyncJaxEngine:
     def slo_snapshot(self) -> dict:
         return self.slo.snapshot()
 
+    def goodput_snapshot(self) -> dict:
+        """Windowed goodput per scenario/tenant (worker stats broadcasts +
+        dynotop's GOODPUT column)."""
+        return self.goodput.snapshot()
+
     def stage_snapshot(self) -> dict:
         """Per-stage latency attribution totals (scheduler StageStats plus the
         host-KV-offload transfer leg) — the bench artifact's breakdown source."""
@@ -847,6 +861,8 @@ class AsyncJaxEngine:
         # tracker under dynamo_slo_*; sharing that name here would emit
         # duplicate families in the combined exposition
         parts.append(self.slo.render_metrics(prefix="dynamo_engine_slo"))
+        # goodput plane, same prefix logic (the frontend owns dynamo_goodput_*)
+        parts.append(self.goodput.render_metrics(prefix="dynamo_engine_goodput"))
         return "".join(parts)
 
     def _render_resource_metrics(self) -> str:

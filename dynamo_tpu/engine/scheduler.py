@@ -40,6 +40,7 @@ from dynamo_tpu.engine.page_table import PageAllocator
 from dynamo_tpu.engine.sampling import MAX_EOS_IDS, SamplingParams, fold_seed
 from dynamo_tpu.spec import make_proposer
 from dynamo_tpu.utils import get_logger, tracing
+from dynamo_tpu.utils.goodput import MAX_ITL_SAMPLES, RequestOutcome
 from dynamo_tpu.utils.prometheus import Histogram
 
 log = get_logger("engine.sched")
@@ -87,6 +88,12 @@ class EngineRequest:
     # adapter loads — never blocking other requests) and salts the
     # sequence's KV block identity with the adapter uid.
     lora_name: str = ""
+    # goodput accounting tags (utils/goodput.py): the tenant this request
+    # bills to and the replay scenario that generated it — both ride the
+    # per-request RequestOutcome and the tenant-labeled SLO series ("" =
+    # untagged organic traffic)
+    tenant: str = ""
+    scenario: str = ""
 
 
 @dataclass
@@ -140,6 +147,15 @@ class RunningSeq:
     # sequence releases or is preempted — a pinned slot is never hot-swapped
     # under an in-flight sequence.
     lora_slot: int = 0
+    # goodput outcome accounting (utils/goodput.py): admission queue wait,
+    # first/last materialized-token walls, and the per-token inter-arrival
+    # gaps after the first token. The gaps are client-shaped — a decode
+    # window's tokens materialize together, so the series is bursty and its
+    # per-request p99 is the honest stall signal the SLO verdict uses.
+    queue_wait_s: Optional[float] = None
+    first_token_wall: float = 0.0
+    last_token_wall: float = 0.0
+    itl_gaps: list = field(default_factory=list)
 
     @property
     def pos(self) -> int:
@@ -357,6 +373,10 @@ class Scheduler:
         # optional SLO sink (utils/slo.SloTracker): queue-wait and TTFT
         # observations feed rolling-window percentiles when attached
         self.slo = None
+        # optional per-request outcome sink (utils/goodput.GoodputTracker
+        # .observe, attached by the engine): every naturally-finished
+        # sequence emits ONE RequestOutcome — the goodput plane's input
+        self.outcome_sink = None
         # speculative decoding: parsed config + the draft proposer (history
         # in, <= k token ids out). None when --speculative is unset.
         self.spec = config.spec
@@ -550,6 +570,7 @@ class Scheduler:
                 # per-step prefill cap (and stall everything queued behind it)
                 if len(req.token_ids) > self.config.max_model_len:
                     self.waiting.popleft()
+                    self._record_request_error(req)
                     outputs.append(
                         StepOutput(req.request_id, finished=True, finish_reason="error")
                     )
@@ -578,6 +599,7 @@ class Scheduler:
                             "rejecting %s: %s", req.request_id, e
                         )
                         self.waiting.popleft()
+                        self._record_request_error(req)
                         outputs.append(StepOutput(
                             req.request_id, finished=True, finish_reason="error"
                         ))
@@ -604,6 +626,7 @@ class Scheduler:
                         self.allocator.free_sequence(req.request_id)
                     if self.slots[slot] is not None and self.slots[slot].req is req:
                         self.slots[slot] = None
+                    self._record_request_error(req)
                     outputs.append(
                         StepOutput(req.request_id, finished=True, finish_reason="error")
                     )
@@ -634,6 +657,7 @@ class Scheduler:
         seq.lora_slot = 0
 
     def _start_sequence(self, req: EngineRequest, slot: int, lora_slot: int = 0) -> None:
+        wait = None
         if req.enqueue_ts:
             now = time.monotonic()
             wait = max(0.0, now - req.enqueue_ts)
@@ -641,7 +665,7 @@ class Scheduler:
             self.stage.queue_wait_n += 1
             self.stage_hist["queue_wait"].observe(wait)
             if self.slo is not None:
-                self.slo.observe("queue_wait", wait)
+                self.slo.observe("queue_wait", wait, tenant=req.tenant)
             tracing.record_span(
                 "engine.queue_wait", now - wait, end=now,
                 request_id=req.request_id, trace_id=req.trace_id,
@@ -662,6 +686,7 @@ class Scheduler:
             sched_len=1,  # the prefill's sampled token enters the timeline now
             spec_mode=self._spec_eligible(req),
             lora_slot=lora_slot,
+            queue_wait_s=wait,
         )
         self._admit_counter += 1
         # decode windows read each slot's adapter id from the device-resident
@@ -1123,6 +1148,7 @@ class Scheduler:
         and the KV injected; this emits the first token and queues the sequence
         for a decode slot.
         """
+        wait = None
         if req.enqueue_ts:
             # the adopted analogue of admission queue wait: submission (on the
             # decode worker) -> remote KV adopted into a decode slot
@@ -1132,7 +1158,7 @@ class Scheduler:
             self.stage.queue_wait_n += 1
             self.stage_hist["queue_wait"].observe(wait)
             if self.slo is not None:
-                self.slo.observe("queue_wait", wait)
+                self.slo.observe("queue_wait", wait, tenant=req.tenant)
             tracing.record_span(
                 "engine.queue_wait", now - wait, end=now,
                 request_id=req.request_id, trace_id=req.trace_id,
@@ -1168,6 +1194,7 @@ class Scheduler:
             sched_len=1,
             spec_mode=self._spec_eligible(req),
             lora_slot=lora_slot,
+            queue_wait_s=wait,
         )
         self._admit_counter += 1
         slot = self._free_slot()
@@ -1727,18 +1754,31 @@ class Scheduler:
             return []
         req = seq.req
         seq.generated.append(token)
-        if len(seq.generated) == 1 and req.enqueue_ts:
-            ttft = max(0.0, time.monotonic() - req.enqueue_ts)
-            self.stage.ttft_s += ttft
-            self.stage.ttft_n += 1
-            self.stage_hist["ttft"].observe(ttft)
+        now = time.monotonic()
+        if len(seq.generated) == 1:
+            seq.first_token_wall = now
+            if req.enqueue_ts:
+                ttft = max(0.0, now - req.enqueue_ts)
+                self.stage.ttft_s += ttft
+                self.stage.ttft_n += 1
+                self.stage_hist["ttft"].observe(ttft)
+                if self.slo is not None:
+                    self.slo.observe("ttft", ttft, tenant=req.tenant)
+                tracing.record_span(
+                    "engine.ttft", req.enqueue_ts, duration=ttft,
+                    request_id=req.request_id, trace_id=req.trace_id,
+                    attrs={"cached": cached} if cached else None,
+                )
+        else:
+            # per-token inter-arrival gap at materialization time (a window's
+            # tokens land together — the bursty series IS the client view);
+            # capped so a 100K-token stream can't grow the record unbounded
+            gap = max(0.0, now - seq.last_token_wall)
+            if len(seq.itl_gaps) < MAX_ITL_SAMPLES:
+                seq.itl_gaps.append(gap)
             if self.slo is not None:
-                self.slo.observe("ttft", ttft)
-            tracing.record_span(
-                "engine.ttft", req.enqueue_ts, duration=ttft,
-                request_id=req.request_id, trace_id=req.trace_id,
-                attrs={"cached": cached} if cached else None,
-            )
+                self.slo.observe("itl", gap, tenant=req.tenant)
+        seq.last_token_wall = now
         seq.sched_len = max(seq.sched_len, len(seq.generated))
         self.allocator.append_token(req.request_id, token)
         finish: Optional[str] = None
@@ -1765,12 +1805,67 @@ class Scheduler:
         if finish is not None:
             out.finished = True
             out.finish_reason = finish
+            self._record_outcome(seq, finish)
             self._release(seq)
         return [out]
 
     def _finish(self, seq: RunningSeq, reason: str) -> list[StepOutput]:
+        self._record_outcome(seq, reason, error=(reason == "error"))
         self._release(seq)
         return [StepOutput(seq.req.request_id, finished=True, finish_reason=reason)]
+
+    def _record_request_error(self, req: EngineRequest) -> None:
+        """Outcome for a request that failed BEFORE a sequence existed
+        (oversized prompt, unknown adapter, admission crash): an error is an
+        SLO miss, so it must reach the goodput plane like any finish."""
+        sink = self.outcome_sink
+        if sink is None:
+            return
+        now = time.monotonic()
+        try:
+            sink(RequestOutcome(
+                request_id=req.request_id,
+                scenario=req.scenario,
+                tenant=req.tenant,
+                adapter=req.lora_name,
+                prompt_tokens=len(req.token_ids),
+                duration_s=max(0.0, now - req.enqueue_ts) if req.enqueue_ts else 0.0,
+                finish_reason="error",
+                error=True,
+            ))
+        except Exception:
+            log.exception("outcome sink failed for %s", req.request_id)
+
+    def _record_outcome(self, seq: RunningSeq, reason: str, error: bool = False) -> None:
+        """Fold one finished sequence into the goodput plane (one
+        RequestOutcome per natural finish; cancels and preemption re-queues
+        never reach here). Sink failures must never fail the engine step."""
+        sink = self.outcome_sink
+        if sink is None:
+            return
+        req = seq.req
+        now = time.monotonic()
+        ttft = None
+        if seq.first_token_wall and req.enqueue_ts:
+            ttft = max(0.0, seq.first_token_wall - req.enqueue_ts)
+        try:
+            sink(RequestOutcome(
+                request_id=req.request_id,
+                scenario=req.scenario,
+                tenant=req.tenant,
+                adapter=req.lora_name,
+                queue_wait_s=seq.queue_wait_s,
+                ttft_s=ttft,
+                itl_s=tuple(seq.itl_gaps),
+                prompt_tokens=seq.prompt_len,
+                output_tokens=len(seq.generated),
+                cached_tokens=seq.cached_len,
+                duration_s=max(0.0, now - req.enqueue_ts) if req.enqueue_ts else 0.0,
+                finish_reason=reason,
+                error=error,
+            ))
+        except Exception:
+            log.exception("outcome sink failed for %s", req.request_id)
 
     def _cancel_fetch(self, seq: RunningSeq) -> None:
         """Drop an in-flight remote-prefix pull. The fetch coroutine only

@@ -91,6 +91,8 @@ class Backend:
             kv_holder_addr=getattr(request, "kv_holder_addr", ""),
             kv_holder_blocks=getattr(request, "kv_holder_blocks", 0),
             lora_name=getattr(request, "lora_name", ""),
+            tenant=getattr(request, "tenant", ""),
+            scenario=getattr(request, "scenario", ""),
         )
         decoder = DecodeStream(
             self.tokenizer,

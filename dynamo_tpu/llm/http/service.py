@@ -30,6 +30,7 @@ from dynamo_tpu.llm.protocols.openai import (
     Usage,
 )
 from dynamo_tpu.llm.http.metrics import Metrics
+from dynamo_tpu.utils.goodput import MAX_ITL_SAMPLES
 from dynamo_tpu.llm.protocols import sse
 from dynamo_tpu.llm.tools import ToolCallError, ToolCallingMatcher
 from dynamo_tpu.utils import get_logger, tracing
@@ -99,6 +100,16 @@ class HttpService:
 
             slo = SloTracker(targets_from_env())
         self.slo = slo
+        # goodput plane (utils/goodput.py): one RequestOutcome per served
+        # request — TTFT + the per-chunk ITL series + tenant/adapter tags —
+        # rendered as dynamo_goodput_* on /metrics. Budgets default to the
+        # SLO targets; untargeted frontends still count errors.
+        from dynamo_tpu.utils.goodput import GoodputTracker
+
+        self.goodput = GoodputTracker(
+            ttft_budget_s=self.slo.targets.get("ttft"),
+            itl_budget_s=self.slo.targets.get("itl"),
+        )
         # readiness provider: () -> (ok: bool, detail: dict). None = always
         # ready (a bare service with no downstream dependency to gate on).
         # FrontendService wires downstream-worker liveness through this; the
@@ -185,7 +196,7 @@ class HttpService:
         )
 
     async def _metrics(self, request: web.Request) -> web.Response:
-        extra = self.slo.render_metrics()
+        extra = self.slo.render_metrics() + self.goodput.render_metrics()
         if self._extra_metrics:
             extra += self._extra_metrics()
         return web.Response(text=self.metrics.render(extra), content_type="text/plain")
@@ -345,9 +356,15 @@ class HttpService:
                             pre.token_ids,
                             skip_special_tokens=pre.skip_special_tokens,
                         )
+                # goodput tags: tenant/scenario headers ride the
+                # PreprocessedRequest to the engine so BOTH trackers (this
+                # frontend's and the engine's) attribute the request
+                pre.tenant = request.headers.get("x-tenant", "")
+                pre.scenario = request.headers.get("x-scenario", "")
                 chunks = self._generate_chunks(
                     pipeline, pre, kind, model, annotations, tool_matcher,
                     echo_text=echo_text,
+                    tenant=pre.tenant,
                 )
                 if req.stream:
                     return await self._stream_response(request, chunks, model, endpoint, t0)
@@ -383,6 +400,7 @@ class HttpService:
         annotations: dict,
         tool_matcher: Optional[ToolCallingMatcher] = None,
         echo_text: Optional[str] = None,
+        tenant: str = "",
     ) -> AsyncIterator[dict]:
         gen = (
             ChatDeltaGenerator(model) if kind == "chat" else CompletionDeltaGenerator(model)
@@ -398,6 +416,11 @@ class HttpService:
         t_start = time.monotonic()
         t_first = None
         t_prev = None  # last output-chunk arrival, for inter-token latency
+        # goodput outcome accounting: the per-token gap series (amortized
+        # over each chunk's tokens, same as the ITL histogram) + the
+        # adapter suffix of a base:adapter LoRA model name
+        itl_gaps: list = []
+        adapter = model.split(":", 1)[1] if ":" in model and "{" not in model else ""
         # With tools active the full text must be buffered so a tool-call JSON
         # response never leaks as content deltas (tool calls are matched on
         # complete messages, llm/tools.py).
@@ -408,7 +431,7 @@ class HttpService:
             if t_first is None and out.token_ids:
                 t_first = t_prev = time.monotonic()
                 self.metrics.observe_ttft(model, t_first - t_start)
-                self.slo.observe("ttft", t_first - t_start)
+                self.slo.observe("ttft", t_first - t_start, tenant=tenant)
                 # OpenAI semantics: the role delta leads the stream at first-
                 # token time. Also the client's only honest TTFT signal — the
                 # first CONTENT delta can lag several tokens behind while the
@@ -420,8 +443,13 @@ class HttpService:
                 # engine windows arrive as multi-token chunks: the honest
                 # per-token number is the chunk gap amortized over its tokens
                 now = time.monotonic()
-                self.metrics.observe_itl(model, (now - t_prev) / len(out.token_ids))
-                self.slo.observe("itl", (now - t_prev) / len(out.token_ids))
+                gap = (now - t_prev) / len(out.token_ids)
+                self.metrics.observe_itl(model, gap)
+                self.slo.observe("itl", gap, tenant=tenant)
+                if len(itl_gaps) < MAX_ITL_SAMPLES:
+                    itl_gaps.extend([gap] * min(
+                        len(out.token_ids), MAX_ITL_SAMPLES - len(itl_gaps)
+                    ))
                 t_prev = now
             if tool_matcher is not None:
                 if out.text:
@@ -432,6 +460,10 @@ class HttpService:
                 yield gen.text_chunk(out.text, logprobs=out.logprobs)
             if out.finished:
                 finish = out.finish_reason or "stop"
+                self._record_outcome(
+                    pre, model, tenant, adapter, finish, t_start, t_first,
+                    itl_gaps, usage, out.cached_tokens,
+                )
                 if tool_matcher is not None:
                     text = "".join(buffered)
                     calls = tool_matcher.get_calls(text)
@@ -460,6 +492,32 @@ class HttpService:
                     }
                 yield gen.finish_chunk(finish, usage)
                 return
+
+    def _record_outcome(
+        self, pre, model: str, tenant: str, adapter: str, finish: str,
+        t_start: float, t_first, itl_gaps: list, usage, cached_tokens: int,
+    ) -> None:
+        """One RequestOutcome per served request into the frontend goodput
+        plane (error finishes count as SLO misses)."""
+        from dynamo_tpu.utils.goodput import RequestOutcome
+
+        try:
+            self.goodput.observe(RequestOutcome(
+                request_id=getattr(pre, "request_id", "") or "",
+                scenario=getattr(pre, "scenario", "") or "",
+                tenant=tenant,
+                adapter=adapter,
+                ttft_s=(t_first - t_start) if t_first is not None else None,
+                itl_s=tuple(itl_gaps),
+                prompt_tokens=usage.prompt_tokens,
+                output_tokens=usage.completion_tokens,
+                cached_tokens=cached_tokens,
+                duration_s=time.monotonic() - t_start,
+                finish_reason=finish,
+                error=finish == "error",
+            ))
+        except Exception:
+            log.exception("goodput outcome failed")
 
     async def _stream_response(
         self, request: web.Request, chunks: AsyncIterator[dict], model: str, endpoint: str, t0: float

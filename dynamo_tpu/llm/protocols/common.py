@@ -41,6 +41,12 @@ class PreprocessedRequest:
     # (``base:adapter``); "" = base model. Salts routing hashes and the
     # engine's KV block identity; the worker pins the adapter's device slot.
     lora_name: str = ""
+    # goodput accounting tags (utils/goodput.py): tenant from the frontend's
+    # ``x-tenant`` header, scenario from the replay harness's ``x-scenario``
+    # header — ride to the engine so its per-request outcomes and
+    # tenant-labeled SLO series attribute correctly ("" = untagged)
+    tenant: str = ""
+    scenario: str = ""
 
     def to_wire(self) -> dict:
         out = {
@@ -71,6 +77,10 @@ class PreprocessedRequest:
             out["kv_holder_blocks"] = self.kv_holder_blocks
         if self.lora_name:
             out["lora_name"] = self.lora_name
+        if self.tenant:
+            out["tenant"] = self.tenant
+        if self.scenario:
+            out["scenario"] = self.scenario
         if self.images:
             out["images"] = [im.to_wire() for im in self.images]
         return out
@@ -90,6 +100,8 @@ class PreprocessedRequest:
             kv_holder_addr=d.get("kv_holder_addr", ""),
             kv_holder_blocks=int(d.get("kv_holder_blocks", 0) or 0),
             lora_name=str(d.get("lora_name", "") or ""),
+            tenant=str(d.get("tenant", "") or ""),
+            scenario=str(d.get("scenario", "") or ""),
             request_id=d["request_id"],
             token_ids=list(d["token_ids"]),
             sampling=SamplingParams(

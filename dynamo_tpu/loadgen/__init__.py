@@ -1,0 +1,26 @@
+"""Trace-replay load harness: seeded scenario specs -> deterministic traces
+-> replay against the in-process engine or the HTTP frontend, with
+per-request SLO outcomes flowing into the goodput plane (utils/goodput.py).
+
+    python -m dynamo_tpu.loadgen --scenario bursty_chat --dry-run
+    python -m dynamo_tpu.loadgen --scenario lora_churn --out trace.jsonl
+
+Scenario/trace modules are pure stdlib (no jax) — compiling and inspecting
+traces is sub-second; only replay imports the engine.
+"""
+
+from dynamo_tpu.loadgen.scenarios import (  # noqa: F401
+    BUILTIN_SCENARIOS,
+    ScenarioSpec,
+    load_scenario,
+    load_scenarios_yaml,
+)
+from dynamo_tpu.loadgen.trace import (  # noqa: F401
+    TraceRequest,
+    compile_trace,
+    dumps_jsonl,
+    read_jsonl,
+    trace_digest,
+    trace_summary,
+    write_jsonl,
+)

@@ -1,0 +1,158 @@
+"""Loadgen CLI.
+
+    # compile + inspect (no engine, no jax — sub-second; the tier-1 smoke)
+    python -m dynamo_tpu.loadgen --scenario bursty_chat --dry-run
+
+    # write the replayable trace
+    python -m dynamo_tpu.loadgen --scenario lora_churn --seed 7 --out t.jsonl
+
+    # replay against a tiny in-process engine (CPU smoke) or a frontend
+    python -m dynamo_tpu.loadgen --scenario bursty_chat --replay-engine tiny
+    python -m dynamo_tpu.loadgen --trace t.jsonl --replay-url http://h:8080 \
+        --model tiny
+
+    # a YAML scenario set (examples/configs/replay_smoke.yaml)
+    python -m dynamo_tpu.loadgen --config examples/configs/replay_smoke.yaml \
+        --dry-run
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _specs_from_args(args) -> list:
+    from dynamo_tpu.loadgen.scenarios import (
+        BUILTIN_SCENARIOS,
+        load_scenario,
+        load_scenarios_yaml,
+    )
+
+    over = {}
+    if args.seed is not None:
+        over["seed"] = args.seed
+    if args.num_requests is not None:
+        over["num_requests"] = args.num_requests
+    if args.config:
+        specs = load_scenarios_yaml(args.config)
+        return [s.replace(**over) if over else s for s in specs]
+    names = args.scenario or sorted(BUILTIN_SCENARIOS)
+    return [load_scenario(n, **over) for n in names]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m dynamo_tpu.loadgen", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("--scenario", action="append",
+                   help="builtin scenario name (repeatable; default: all)")
+    p.add_argument("--config", help="YAML scenario set (scenarios: [...])")
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--num-requests", type=int, default=None)
+    p.add_argument("--list", action="store_true", help="list builtin scenarios")
+    p.add_argument("--dry-run", action="store_true",
+                   help="compile traces and print summaries; no engine, no jax")
+    p.add_argument("--out", help="write the compiled trace JSONL here "
+                                 "(single scenario only)")
+    p.add_argument("--trace", help="replay an existing trace JSONL instead "
+                                   "of compiling one")
+    p.add_argument("--replay-engine", metavar="MODEL",
+                   help="replay against an in-process engine on this model id")
+    p.add_argument("--replay-url", metavar="URL",
+                   help="replay against an OpenAI HTTP frontend")
+    p.add_argument("--model", default="tiny",
+                   help="model name for --replay-url requests")
+    p.add_argument("--speed", type=float, default=1.0,
+                   help="schedule compression factor (2 = replay 2x faster)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="print reports as JSON instead of the table")
+    args = p.parse_args(argv)
+
+    from dynamo_tpu.loadgen.scenarios import BUILTIN_SCENARIOS
+
+    if args.list:
+        for name, spec in sorted(BUILTIN_SCENARIOS.items()):
+            print(f"{name:<24} arrival={spec.arrival:<8} "
+                  f"n={spec.num_requests:<4} rate={spec.rate_rps}rps "
+                  f"isl~{spec.isl_mean} osl~{spec.osl_mean}"
+                  f"{' images' if spec.images else ''}"
+                  f"{' adapters=%d' % len(spec.adapters) if spec.adapters else ''}")
+        return 0
+
+    from dynamo_tpu.loadgen.trace import (
+        compile_trace,
+        read_jsonl,
+        trace_summary,
+        write_jsonl,
+    )
+
+    specs = _specs_from_args(args)
+    if args.trace:
+        traces = [(None, read_jsonl(args.trace))]
+    else:
+        traces = [(spec, compile_trace(spec)) for spec in specs]
+
+    if args.out:
+        if len(traces) != 1:
+            print("--out needs exactly one scenario", file=sys.stderr)
+            return 2
+        write_jsonl(traces[0][1], args.out)
+        print(f"wrote {len(traces[0][1])} requests to {args.out}")
+
+    if args.dry_run or not (args.replay_engine or args.replay_url):
+        for spec, trace in traces:
+            if spec is not None:
+                print(json.dumps(trace_summary(spec, trace), indent=1))
+            else:
+                print(json.dumps({"requests": len(trace)}, indent=1))
+        return 0
+
+    # ---------------- replay (imports jax / aiohttp lazily) ----------------
+    import asyncio
+
+    from dynamo_tpu.loadgen.replay import ReplayMetrics, replay_engine, replay_http
+    from dynamo_tpu.loadgen.report import render_report
+    from dynamo_tpu.utils.goodput import GoodputTracker
+
+    async def run() -> list:
+        reports = []
+        metrics = ReplayMetrics()
+        goodput = GoodputTracker()
+        if args.replay_engine:
+            from dynamo_tpu.engine.config import EngineConfig
+            from dynamo_tpu.engine.engine import AsyncJaxEngine
+
+            eng = AsyncJaxEngine(EngineConfig(model_id=args.replay_engine))
+            await eng.start()
+            try:
+                for spec, trace in traces:
+                    reports.append(await replay_engine(
+                        eng, trace, spec=spec, speed=args.speed,
+                        goodput=goodput, metrics=metrics,
+                    ))
+            finally:
+                await eng.shutdown()
+        else:
+            for spec, trace in traces:
+                reports.append(await replay_http(
+                    args.replay_url, args.model, trace, spec=spec,
+                    speed=args.speed, goodput=goodput, metrics=metrics,
+                ))
+        return reports
+
+    reports = asyncio.run(run())
+    if args.as_json:
+        for r in reports:
+            r = dict(r)
+            r.pop("outcomes", None)
+            print(json.dumps(r, indent=1))
+    else:
+        print(render_report(reports))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,397 @@
+"""Trace replay: drive a compiled trace against a real serving surface.
+
+Two drivers share one measurement contract:
+
+  - ``replay_engine``: submits EngineRequests straight into an in-process
+    ``AsyncJaxEngine`` at the trace's timestamps (optionally time-scaled),
+    measuring the client view — TTFT at first-token arrival, per-token
+    inter-arrival gaps amortized over each decode window's tokens (exactly
+    how the HTTP frontend prices ITL), finish reason, token counts.
+  - ``replay_http``: POSTs the same trace as streaming OpenAI completions
+    against a frontend URL (token-id prompts, ``ext.ignore_eos``), measuring
+    SSE chunk arrivals.
+
+Every request produces one ``RequestOutcome`` (utils/goodput.py) stamped
+with the scenario's SLO budgets; the report is ``summarize_outcomes`` plus
+replay-side counters (schedule lag — how late submissions ran vs the trace
+schedule — is the replay harness's own health signal: a lagging generator
+under-delivers the offered load and silently flatters the system).
+
+``ReplayMetrics`` renders the ``dynamo_replay_*`` Prometheus families
+(conformance-checked via utils/prometheus._sample_surfaces).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from typing import Callable, Optional
+
+from dynamo_tpu.loadgen.scenarios import ScenarioSpec
+from dynamo_tpu.utils.goodput import (
+    GoodputTracker,
+    RequestOutcome,
+    summarize_outcomes,
+)
+from dynamo_tpu.utils.prometheus import Histogram, render_family
+
+# how late a submission may run behind its trace timestamp before the replay
+# flags itself as lagging in the report
+_LAG_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+
+
+class ReplayMetrics:
+    """dynamo_replay_* exposition for a replay run (thread-safe)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # (scenario, result) -> count; result in ok|error
+        self._requests: dict = {}
+        self._tokens: dict = {}  # scenario -> output tokens
+        self._inflight = 0
+        self.schedule_lag = Histogram(
+            "dynamo_replay_schedule_lag_seconds",
+            "how late each replayed submission ran vs its trace timestamp "
+            "(a lagging generator under-delivers the offered load)",
+            _LAG_BUCKETS,
+        )
+        self.max_lag_s = 0.0
+
+    def observe_lag(self, lag_s: float) -> None:
+        lag_s = max(0.0, lag_s)
+        self.schedule_lag.observe(lag_s)
+        with self._lock:
+            self.max_lag_s = max(self.max_lag_s, lag_s)
+
+    def submitted(self) -> None:
+        with self._lock:
+            self._inflight += 1
+
+    def finished(self, scenario: str, tokens: int, error: bool) -> None:
+        with self._lock:
+            self._inflight -= 1
+            key = (scenario, "error" if error else "ok")
+            self._requests[key] = self._requests.get(key, 0) + 1
+            self._tokens[scenario] = self._tokens.get(scenario, 0) + tokens
+
+    def render_metrics(self) -> str:
+        with self._lock:
+            requests = sorted(self._requests.items())
+            tokens = sorted(self._tokens.items())
+            inflight = self._inflight
+        out = render_family(
+            "dynamo_replay_requests_total", "counter",
+            "replayed requests by scenario and result",
+            [({"scenario": sc, "result": r}, n) for (sc, r), n in requests]
+            or [({"scenario": "", "result": "ok"}, 0)],
+        )
+        out += render_family(
+            "dynamo_replay_tokens_total", "counter",
+            "output tokens received by the replay client, by scenario",
+            [({"scenario": sc}, n) for sc, n in tokens]
+            or [({"scenario": ""}, 0)],
+        )
+        out += render_family(
+            "dynamo_replay_inflight_requests", "gauge",
+            "replayed requests currently in flight", [({}, inflight)],
+        )
+        out += self.schedule_lag.render()
+        return out
+
+
+# ---------------- trace -> engine request ----------------
+
+
+def _build_image_input(image: dict, model, offset: int):
+    """Deterministic ImageInput from a trace image spec: pixels from the
+    recorded seed, patchified at the model's vision geometry."""
+    import numpy as np
+
+    from dynamo_tpu.llm.multimodal import (
+        ImageInput,
+        image_content_hash,
+        patchify,
+        virtual_token_ids,
+    )
+
+    vision = model.config.vision
+    rng = np.random.RandomState(image["seed"])
+    pixels = rng.rand(image["h"], image["w"], 3).astype(np.float32)
+    patches, rows, cols, grid = patchify(
+        pixels, vision.patch_size, vision.spatial_merge_size
+    )
+    n_tok = patches.shape[0] // vision.spatial_merge_size ** 2
+    chash = image_content_hash(pixels)
+    toks = virtual_token_ids(chash, n_tok, model.config.vocab_size)
+    im = ImageInput(
+        offset=offset, patches=patches, rows=rows, cols=cols, grid=grid,
+        num_tokens=n_tok, content_hash=chash,
+    )
+    return im, toks
+
+
+def to_engine_request(tr, engine=None):
+    """TraceRequest -> EngineRequest (lazy imports: trace/scenario modules
+    stay jax-free). Image specs materialize against the engine's model."""
+    from dynamo_tpu.engine.sampling import SamplingParams
+    from dynamo_tpu.engine.scheduler import EngineRequest
+
+    token_ids = list(tr.token_ids)
+    images = []
+    if tr.image is not None:
+        if engine is None or getattr(engine.model.config, "vision", None) is None:
+            raise ValueError(
+                f"trace request {tr.request_id} carries an image but the "
+                "engine's model has no vision tower"
+            )
+        im, vtoks = _build_image_input(tr.image, engine.model, len(token_ids))
+        token_ids = token_ids + vtoks + [1]
+        images = [im]
+    return EngineRequest(
+        request_id=tr.request_id,
+        token_ids=token_ids,
+        sampling=SamplingParams(
+            temperature=tr.temperature, max_tokens=tr.max_tokens,
+            ignore_eos=True,  # OSL is the workload's output budget, exactly
+        ),
+        images=images,
+        tenant=tr.tenant,
+        scenario=tr.scenario,
+        lora_name=tr.adapter,
+    )
+
+
+# ---------------- engine replay ----------------
+
+
+async def replay_engine(
+    engine,
+    trace: list,
+    spec: Optional[ScenarioSpec] = None,
+    speed: float = 1.0,
+    goodput: Optional[GoodputTracker] = None,
+    metrics: Optional[ReplayMetrics] = None,
+    request_hook: Optional[Callable] = None,
+) -> dict:
+    """Replay a trace against an in-process engine at its recorded
+    timestamps (``speed`` > 1 compresses the schedule). ``request_hook(req,
+    tr)`` may mutate each EngineRequest before submission (e.g. attach a
+    fleet prefix holder). Returns the replay report."""
+    metrics = metrics or ReplayMetrics()
+    budgets = {}
+    if spec is not None:
+        budgets = {
+            "ttft_budget_s": (
+                spec.slo_ttft_ms / 1e3 if spec.slo_ttft_ms is not None else None
+            ),
+            "itl_budget_s": (
+                spec.slo_itl_ms / 1e3 if spec.slo_itl_ms is not None else None
+            ),
+        }
+    outcomes: list[RequestOutcome] = []
+    t0 = time.monotonic()
+
+    async def one(tr) -> None:
+        planned = tr.at_s / speed
+        delay = planned - (time.monotonic() - t0)
+        if delay > 0:
+            await asyncio.sleep(delay)
+        metrics.observe_lag(time.monotonic() - t0 - planned)
+        req = to_engine_request(tr, engine)
+        if request_hook is not None:
+            request_hook(req, tr)
+        metrics.submitted()
+        sub = time.monotonic()
+        t_first = t_prev = None
+        gaps: list[float] = []
+        toks = cached = 0
+        error, reason = False, ""
+        try:
+            async for batch in engine.generate_batched(req):
+                now = time.monotonic()
+                ntok = sum(1 for o in batch if o.token is not None)
+                if ntok:
+                    if t_first is None:
+                        t_first = now
+                    else:
+                        # amortize the window gap over its tokens — the same
+                        # honest per-token number the HTTP frontend reports
+                        gaps.extend([(now - t_prev) / ntok] * ntok)
+                    t_prev = now
+                    toks += ntok
+                for o in batch:
+                    cached = max(cached, o.cached_tokens)
+                    if o.finished:
+                        reason = o.finish_reason or "stop"
+                        error = reason == "error"
+        except Exception:
+            error, reason = True, "error"
+        outcome = RequestOutcome(
+            request_id=tr.request_id,
+            scenario=tr.scenario,
+            tenant=tr.tenant,
+            adapter=tr.adapter,
+            ttft_s=(t_first - sub) if t_first is not None else None,
+            itl_s=tuple(gaps),
+            prompt_tokens=len(req.token_ids),
+            output_tokens=toks,
+            cached_tokens=cached,
+            duration_s=time.monotonic() - sub,
+            finish_reason=reason,
+            error=error,
+            **budgets,
+        )
+        outcomes.append(outcome)
+        if goodput is not None:
+            goodput.observe(outcome)
+        metrics.finished(tr.scenario, toks, error)
+
+    await asyncio.gather(*(one(tr) for tr in trace))
+    wall = time.monotonic() - t0
+    return _report(spec, trace, outcomes, wall, speed, metrics)
+
+
+# ---------------- http replay ----------------
+
+
+async def replay_http(
+    base_url: str,
+    model: str,
+    trace: list,
+    spec: Optional[ScenarioSpec] = None,
+    speed: float = 1.0,
+    goodput: Optional[GoodputTracker] = None,
+    metrics: Optional[ReplayMetrics] = None,
+) -> dict:
+    """Replay a trace as streaming OpenAI completions against an HTTP
+    frontend: token-id prompts, ``ext.ignore_eos`` for exact OSL, tenant in
+    the ``x-tenant`` header, ``<model>:<adapter>`` names for LoRA requests.
+    Image traces are engine-replay only (the HTTP image path ships real
+    payloads, not seeds)."""
+    import aiohttp
+
+    from dynamo_tpu.llm.protocols import sse
+
+    metrics = metrics or ReplayMetrics()
+    budgets = {}
+    if spec is not None:
+        budgets = {
+            "ttft_budget_s": (
+                spec.slo_ttft_ms / 1e3 if spec.slo_ttft_ms is not None else None
+            ),
+            "itl_budget_s": (
+                spec.slo_itl_ms / 1e3 if spec.slo_itl_ms is not None else None
+            ),
+        }
+    outcomes: list[RequestOutcome] = []
+    t0 = time.monotonic()
+    url = base_url.rstrip("/") + "/v1/completions"
+
+    async def one(session, tr) -> None:
+        planned = tr.at_s / speed
+        delay = planned - (time.monotonic() - t0)
+        if delay > 0:
+            await asyncio.sleep(delay)
+        metrics.observe_lag(time.monotonic() - t0 - planned)
+        body = {
+            "model": f"{model}:{tr.adapter}" if tr.adapter else model,
+            "prompt": list(tr.token_ids),
+            "stream": True,
+            "max_tokens": tr.max_tokens,
+            "temperature": tr.temperature,
+            "ext": {"ignore_eos": True},
+        }
+        # tags ride headers -> PreprocessedRequest -> EngineRequest, so the
+        # frontend AND engine goodput planes attribute the replayed request
+        headers = {"x-scenario": tr.scenario}
+        if tr.tenant:
+            headers["x-tenant"] = tr.tenant
+        metrics.submitted()
+        sub = time.monotonic()
+        t_first = t_prev = None
+        gaps: list[float] = []
+        toks = 0
+        error, reason = False, ""
+        try:
+            async with session.post(url, json=body, headers=headers) as resp:
+                if resp.status != 200:
+                    error, reason = True, f"http_{resp.status}"
+                    await resp.read()
+                else:
+                    async for msg in sse.decode_stream(resp.content.iter_any()):
+                        if msg.is_done:
+                            break
+                        doc = msg.json()
+                        if not isinstance(doc, dict):
+                            continue
+                        if "error" in doc:
+                            error, reason = True, "error"
+                            continue
+                        choice = (doc.get("choices") or [{}])[0]
+                        delta = choice.get("text") or (
+                            choice.get("delta") or {}
+                        ).get("content")
+                        now = time.monotonic()
+                        if delta:
+                            if t_first is None:
+                                t_first = now
+                            else:
+                                gaps.append(now - t_prev)
+                            t_prev = now
+                            toks += 1
+                        usage = doc.get("usage")
+                        if usage and usage.get("completion_tokens"):
+                            toks = max(toks, usage["completion_tokens"])
+                        if choice.get("finish_reason"):
+                            reason = choice["finish_reason"]
+        except Exception:
+            error, reason = True, "error"
+        outcome = RequestOutcome(
+            request_id=tr.request_id,
+            scenario=tr.scenario,
+            tenant=tr.tenant,
+            adapter=tr.adapter,
+            ttft_s=(t_first - sub) if t_first is not None else None,
+            itl_s=tuple(gaps),
+            prompt_tokens=len(tr.token_ids),
+            output_tokens=toks,
+            duration_s=time.monotonic() - sub,
+            finish_reason=reason,
+            error=error,
+            **budgets,
+        )
+        outcomes.append(outcome)
+        if goodput is not None:
+            goodput.observe(outcome)
+        metrics.finished(tr.scenario, toks, error)
+
+    async with aiohttp.ClientSession() as session:
+        await asyncio.gather(*(one(session, tr) for tr in trace))
+    wall = time.monotonic() - t0
+    return _report(spec, trace, outcomes, wall, speed, metrics)
+
+
+# ---------------- reporting ----------------
+
+
+def _report(spec, trace, outcomes, wall_s, speed, metrics) -> dict:
+    budgets = {}
+    if spec is not None:
+        budgets = {
+            "ttft_budget_s": (
+                spec.slo_ttft_ms / 1e3 if spec.slo_ttft_ms is not None else None
+            ),
+            "itl_budget_s": (
+                spec.slo_itl_ms / 1e3 if spec.slo_itl_ms is not None else None
+            ),
+        }
+    summary = summarize_outcomes(outcomes, wall_s=wall_s, **budgets)
+    return {
+        "scenario": spec.name if spec is not None else "",
+        "speed": speed,
+        "wall_s": round(wall_s, 3),
+        "schedule_lag_max_s": round(metrics.max_lag_s, 4),
+        **summary,
+        "outcomes": [o.to_wire() for o in outcomes],
+    }

@@ -1,0 +1,42 @@
+"""replaytop-style text report over replay results.
+
+Pure renderer (testable without an engine): one row per scenario with the
+goodput verdict, latency percentiles against their budgets, throughput, and
+the replay harness's own health (schedule lag, errors). The same dict shape
+the bench artifact's ``replay.{scenario}.*`` keys compress from.
+"""
+
+from __future__ import annotations
+
+
+def _ms(v) -> str:
+    return f"{v:.1f}" if isinstance(v, (int, float)) else "-"
+
+
+def _pct(v) -> str:
+    return f"{100.0 * v:.1f}%" if isinstance(v, (int, float)) else "-"
+
+
+def render_report(reports: list, title: str = "replay") -> str:
+    """reports: list of replay report dicts (loadgen/replay.py _report)."""
+    header = (
+        f"{'SCENARIO':<24} {'REQS':>5} {'ERR':>4} {'GOODPUT':>8} "
+        f"{'TTFT p50/p99':>14} {'ITL p50/p99':>13} {'TOK/S':>8} "
+        f"{'LAG':>7}  BUDGET(ttft/itl ms)"
+    )
+    lines = [f"{title} — {len(reports)} scenario(s)", "", header, "-" * len(header)]
+    for r in reports:
+        budget = (
+            f"{_ms(r.get('ttft_budget_ms'))}/{_ms(r.get('itl_budget_ms'))}"
+        )
+        lines.append(
+            f"{r.get('scenario', '?'):<24} {r.get('requests', 0):>5} "
+            f"{r.get('errors', 0):>4} {_pct(r.get('goodput')):>8} "
+            f"{_ms(r.get('ttft_p50_ms')):>6}/{_ms(r.get('ttft_p99_ms')):<7} "
+            f"{_ms(r.get('itl_p50_ms')):>5}/{_ms(r.get('itl_p99_ms')):<7} "
+            f"{r.get('tok_s') if r.get('tok_s') is not None else '-':>8} "
+            f"{_ms(1e3 * r.get('schedule_lag_max_s', 0.0)):>7}  {budget}"
+        )
+    if not reports:
+        lines.append("(no scenarios replayed)")
+    return "\n".join(lines)

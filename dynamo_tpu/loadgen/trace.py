@@ -1,0 +1,235 @@
+"""Deterministic trace compilation: ScenarioSpec -> replayable request list.
+
+The determinism contract (tested): the same spec + seed compiles to a
+byte-identical JSONL trace and an identical per-request schedule, on any
+platform. Everything derives from one ``random.Random(seed)`` stream in a
+fixed draw order; timestamps round to microseconds before serialization so
+float formatting can never wobble a byte.
+
+A ``TraceRequest`` is engine-agnostic: token ids, arrival offset, output
+budget, tenant/adapter/scenario tags, and (for multimodal scenarios) a
+compact image spec (seed + shape — the replay side regenerates the pixels
+deterministically instead of shipping them). The same trace drives the
+in-process engine or the OpenAI HTTP frontend (loadgen/replay.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import math
+import random
+from dataclasses import dataclass
+from typing import Optional
+
+from dynamo_tpu.loadgen.scenarios import ScenarioSpec
+
+
+@dataclass
+class TraceRequest:
+    at_s: float  # arrival offset from trace start (seconds, µs-rounded)
+    request_id: str
+    scenario: str
+    token_ids: list
+    max_tokens: int
+    tenant: str = ""
+    adapter: str = ""
+    temperature: float = 0.0
+    session: str = ""  # session group id ("" = independent request)
+    # multimodal: {"seed": int, "h": int, "w": int} — the replay runner
+    # regenerates the image deterministically (llm/multimodal patchify)
+    image: Optional[dict] = None
+
+    def to_json(self) -> str:
+        d = dataclasses.asdict(self)
+        if d["image"] is None:
+            del d["image"]
+        for k in ("tenant", "adapter", "session"):
+            if not d[k]:
+                del d[k]
+        return json.dumps(d, sort_keys=True, separators=(",", ":"))
+
+    @classmethod
+    def from_json(cls, line: str) -> "TraceRequest":
+        return cls(**json.loads(line))
+
+
+# ---------------- arrival processes ----------------
+
+
+def _rate_at(spec: ScenarioSpec, t: float) -> float:
+    """Instantaneous arrival rate at offset t (the thinning envelope)."""
+    if spec.arrival == "bursty":
+        on = (t % spec.burst_period_s) < spec.burst_duty * spec.burst_period_s
+        # scale so the duty-weighted mean stays rate_rps
+        off_rate = spec.rate_rps * (1.0 - spec.burst_duty * spec.burst_factor) / max(
+            1e-9, 1.0 - spec.burst_duty
+        )
+        return spec.rate_rps * spec.burst_factor if on else max(0.0, off_rate)
+    if spec.arrival == "diurnal":
+        return spec.rate_rps * (
+            1.0 + spec.diurnal_amplitude
+            * math.sin(2.0 * math.pi * t / spec.diurnal_period_s)
+        )
+    return spec.rate_rps
+
+
+def _arrivals(spec: ScenarioSpec, rng: random.Random) -> list:
+    """num_requests arrival offsets via Lewis thinning against the rate
+    envelope (uniform spreads evenly; poisson is the constant envelope)."""
+    if spec.arrival == "uniform":
+        gap = 1.0 / spec.rate_rps
+        return [i * gap for i in range(spec.num_requests)]
+    peak = spec.rate_rps * max(
+        1.0,
+        spec.burst_factor if spec.arrival == "bursty" else 1.0 + spec.diurnal_amplitude,
+    )
+    out, t = [], 0.0
+    while len(out) < spec.num_requests:
+        t += rng.expovariate(peak)
+        if rng.random() * peak <= _rate_at(spec, t):
+            out.append(t)
+    return out
+
+
+# ---------------- length distributions ----------------
+
+
+def _length(dist: str, mean: int, sigma: float, lo: int, hi: int,
+            alpha: float, rng: random.Random) -> int:
+    if dist == "fixed":
+        n = mean
+    elif dist == "pareto":
+        # Pareto with the body anchored near the configured median
+        n = int(mean * rng.paretovariate(alpha) / (2 ** (1.0 / alpha)))
+    else:  # lognormal: median = mean knob, sigma controls the tail
+        n = int(round(rng.lognormvariate(math.log(max(1, mean)), sigma)))
+    return max(lo, min(hi, n))
+
+
+def _zipf_pick(items: tuple, alpha: float, rng: random.Random):
+    weights = [1.0 / (k + 1) ** alpha for k in range(len(items))]
+    return rng.choices(items, weights=weights, k=1)[0]
+
+
+# ---------------- compilation ----------------
+
+
+def compile_trace(spec: ScenarioSpec) -> list:
+    """Pure function: ScenarioSpec -> [TraceRequest] sorted by arrival.
+
+    Draw order is fixed (arrivals first, then per-request fields in field
+    order) so any spec change perturbs exactly the draws after it — and the
+    same spec can never produce two different traces."""
+    rng = random.Random(spec.seed)
+    arrivals = _arrivals(spec, rng)
+    # session shared prefixes: one sub-generator per group, seeded from the
+    # main stream so group contents are independent of group count order
+    prefixes = []
+    for g in range(spec.session_groups):
+        grng = random.Random(rng.randrange(1 << 62))
+        prefixes.append(
+            [grng.randrange(1, spec.vocab) for _ in range(spec.shared_prefix_len)]
+        )
+    out = []
+    for i, at in enumerate(arrivals):
+        isl = _length(spec.isl_dist, spec.isl_mean, spec.isl_sigma,
+                      spec.isl_min, spec.isl_max, spec.tail_alpha, rng)
+        osl = _length(spec.osl_dist, spec.osl_mean, spec.osl_sigma,
+                      spec.osl_min, spec.osl_max, spec.tail_alpha, rng)
+        tenant = rng.choice(spec.tenants) if spec.tenants else ""
+        adapter = ""
+        if spec.adapters and rng.random() >= spec.base_model_share:
+            adapter = _zipf_pick(spec.adapters, spec.zipf_alpha, rng)
+        session = ""
+        token_ids = []
+        if prefixes:
+            g = rng.randrange(len(prefixes))
+            session = f"s{g}"
+            token_ids = list(prefixes[g])
+        token_ids += [rng.randrange(1, spec.vocab) for _ in range(isl)]
+        image = None
+        if spec.images:
+            image = {
+                "seed": rng.randrange(1 << 31),
+                "h": spec.image_hw[0],
+                "w": spec.image_hw[1],
+            }
+        out.append(TraceRequest(
+            at_s=round(at, 6),
+            request_id=f"{spec.name}-{spec.seed}-{i:05d}",
+            scenario=spec.name,
+            token_ids=token_ids,
+            max_tokens=osl,
+            tenant=tenant,
+            adapter=adapter,
+            temperature=spec.temperature,
+            session=session,
+            image=image,
+        ))
+    return out
+
+
+# ---------------- serialization ----------------
+
+
+def dumps_jsonl(trace: list) -> str:
+    """Canonical JSONL (sorted keys, compact separators, µs timestamps):
+    the byte-identity surface the determinism test hashes."""
+    return "".join(t.to_json() + "\n" for t in trace)
+
+
+def write_jsonl(trace: list, path) -> None:
+    with open(path, "w") as f:
+        f.write(dumps_jsonl(trace))
+
+
+def read_jsonl(path) -> list:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(TraceRequest.from_json(line))
+    return out
+
+
+def trace_digest(trace: list) -> str:
+    return hashlib.sha256(dumps_jsonl(trace).encode()).hexdigest()
+
+
+def trace_summary(spec: ScenarioSpec, trace: list) -> dict:
+    """The --dry-run report: schedule span, length percentiles, tag
+    histograms, and the determinism digest."""
+    from dynamo_tpu.utils.goodput import percentile
+
+    isls = [len(t.token_ids) for t in trace]
+    osls = [t.max_tokens for t in trace]
+    adapters: dict = {}
+    tenants: dict = {}
+    for t in trace:
+        if t.adapter:
+            adapters[t.adapter] = adapters.get(t.adapter, 0) + 1
+        if t.tenant:
+            tenants[t.tenant] = tenants.get(t.tenant, 0) + 1
+    return {
+        "scenario": spec.name,
+        "seed": spec.seed,
+        "requests": len(trace),
+        "span_s": round(trace[-1].at_s, 3) if trace else 0.0,
+        "arrival": spec.arrival,
+        "rate_rps": spec.rate_rps,
+        "isl_p50": percentile(isls, 50),
+        "isl_p99": percentile(isls, 99),
+        "osl_p50": percentile(osls, 50),
+        "osl_p99": percentile(osls, 99),
+        "prompt_tokens": sum(isls),
+        "output_budget_tokens": sum(osls),
+        "tenants": tenants,
+        "adapters": adapters,
+        "sessions": len({t.session for t in trace if t.session}),
+        "images": sum(1 for t in trace if t.image),
+        "slo": {"ttft_ms": spec.slo_ttft_ms, "itl_p99_ms": spec.slo_itl_ms},
+        "digest": trace_digest(trace),
+    }

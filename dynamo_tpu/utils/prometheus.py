@@ -270,11 +270,37 @@ def _sample_surfaces() -> list[tuple[str, str]]:
     for v in (0.1, 0.2, 0.7):
         slo.observe("ttft", v)
         slo.observe("itl", v / 20)
+    # tenant-labeled series must render conformantly alongside the aggregate
+    slo.observe("ttft", 0.15, tenant="tenant-a")
     surfaces.append(("utils.slo", slo.render_metrics()))
     hm = HealthMonitor("selfcheck")
     hm.set_state("ready", "self-check")
     hm.beat()
     surfaces.append(("utils.health", hm.render_metrics()))
+
+    # goodput plane: per-request SLO outcomes -> windowed goodput families
+    # (dynamo_goodput_*), incl. a missed request and a tenant breakdown
+    from dynamo_tpu.utils.goodput import GoodputTracker, RequestOutcome
+
+    gp = GoodputTracker(ttft_budget_s=0.5, itl_budget_s=0.05)
+    gp.observe(RequestOutcome(
+        "r1", scenario="bursty_chat", tenant="tenant-a", ttft_s=0.1,
+        itl_s=(0.004, 0.006), output_tokens=16,
+    ))
+    gp.observe(RequestOutcome(
+        "r2", scenario="bursty_chat", ttft_s=0.9, output_tokens=4,
+    ))
+    gp.observe(RequestOutcome("r3", scenario="lora_churn", error=True))
+    surfaces.append(("utils.goodput", gp.render_metrics()))
+
+    # trace-replay harness: the dynamo_replay_* client-side families
+    from dynamo_tpu.loadgen.replay import ReplayMetrics
+
+    rm = ReplayMetrics()
+    rm.submitted()
+    rm.observe_lag(0.002)
+    rm.finished("bursty_chat", 16, error=False)
+    surfaces.append(("loadgen.replay", rm.render_metrics()))
 
     # engine stage histograms + resource gauges (scheduler built directly on
     # a real allocator; no model/runner/device needed)
@@ -320,6 +346,12 @@ def _sample_surfaces() -> list[tuple[str, str]]:
             return {}
 
     eng.runner = _SpecRunner()
+    # the engine-scoped goodput families (dynamo_engine_goodput_*) need a
+    # sample outcome to render their gauges
+    eng.goodput.observe(RequestOutcome(
+        "e1", scenario="bursty_chat", ttft_s=0.05, itl_s=(0.004,),
+        output_tokens=8,
+    ))
     surfaces.append(("engine.render_stage_metrics", eng.render_stage_metrics()))
 
     # disagg KV data-plane server/client + prefill worker send side

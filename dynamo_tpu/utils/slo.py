@@ -14,6 +14,15 @@ Targets come from CLI flags (``--slo-ttft-ms`` / ``--slo-itl-ms``) or the
 environment knobs; a metric without a target still tracks percentiles but
 never violates.
 
+Empty-window semantics: percentiles are ``None`` when the window holds no
+samples (never a fake 0.0 p99 — that reads as a *great* latency), and the
+rendered exposition simply omits the quantile samples (NaN-free).
+
+Per-tenant breakdown: ``observe(metric, seconds, tenant="a")`` feeds BOTH the
+aggregate series (every existing consumer sees every sample) and a
+tenant-keyed series rendered with a ``tenant=`` label on the same families —
+the view multi-tenant QoS scheduling consumes.
+
 Thread-safe: the HTTP asyncio thread and the engine loop both observe.
 """
 
@@ -56,9 +65,11 @@ def targets_from_env(overrides: Optional[dict] = None) -> dict:
     return targets
 
 
-def _percentile(sorted_vals: list, p: float) -> float:
+def _percentile(sorted_vals: list, p: float) -> Optional[float]:
+    """Nearest-rank percentile; None on an empty window (a single sample IS
+    every percentile — no interpolation against a phantom neighbor)."""
     if not sorted_vals:
-        return 0.0
+        return None
     k = max(0, min(len(sorted_vals) - 1, int(round(p / 100.0 * (len(sorted_vals) - 1)))))
     return sorted_vals[k]
 
@@ -78,28 +89,33 @@ class SloTracker:
         self.max_samples = max_samples
         self._clock = clock
         self._lock = threading.Lock()
-        # metric -> deque[(ts, seconds)]
-        self._samples: dict[str, deque] = {}
-        # lifetime counters (survive window pruning)
-        self._observed: dict[str, int] = {}
-        self._violated: dict[str, int] = {}
+        # (metric, tenant) -> deque[(ts, seconds)]; tenant "" = the aggregate
+        # series every tenant observation ALSO lands in
+        self._samples: dict[tuple, deque] = {}
+        # lifetime counters (survive window pruning), keyed like _samples
+        self._observed: dict[tuple, int] = {}
+        self._violated: dict[tuple, int] = {}
 
     # ---------------- ingest ----------------
 
-    def observe(self, metric: str, seconds: float) -> None:
+    def observe(self, metric: str, seconds: float, tenant: str = "") -> None:
         now = self._clock()
+        keys = [(metric, "")]
+        if tenant:
+            keys.append((metric, tenant))
         with self._lock:
-            q = self._samples.get(metric)
-            if q is None:
-                q = self._samples[metric] = deque(maxlen=self.max_samples)
-            q.append((now, seconds))
-            self._observed[metric] = self._observed.get(metric, 0) + 1
             target = self.targets.get(metric)
-            if target is not None and seconds > target:
-                self._violated[metric] = self._violated.get(metric, 0) + 1
+            for key in keys:
+                q = self._samples.get(key)
+                if q is None:
+                    q = self._samples[key] = deque(maxlen=self.max_samples)
+                q.append((now, seconds))
+                self._observed[key] = self._observed.get(key, 0) + 1
+                if target is not None and seconds > target:
+                    self._violated[key] = self._violated.get(key, 0) + 1
 
-    def _window(self, metric: str, now: float) -> list:
-        q = self._samples.get(metric)
+    def _window(self, key: tuple, now: float) -> list:
+        q = self._samples.get(key)
         if not q:
             return []
         cutoff = now - self.window_s
@@ -109,21 +125,26 @@ class SloTracker:
 
     # ---------------- evaluation ----------------
 
-    def metric_state(self, metric: str) -> dict:
-        """Window percentiles + target compliance + error budget for one metric."""
+    def metric_state(self, metric: str, tenant: str = "") -> dict:
+        """Window percentiles + target compliance + error budget for one
+        metric (optionally one tenant's series). An empty window reports
+        ``None`` percentiles — never a misleading 0.0 — and spends no
+        budget."""
         now = self._clock()
+        key = (metric, tenant)
         with self._lock:
-            vals = sorted(self._window(metric, now))
+            vals = sorted(self._window(key, now))
             target = self.targets.get(metric)
             n = len(vals)
             state = {
                 "count": n,
                 "target_ms": round(target * 1e3, 3) if target is not None else None,
-                "observed_total": self._observed.get(metric, 0),
-                "violations_total": self._violated.get(metric, 0),
+                "observed_total": self._observed.get(key, 0),
+                "violations_total": self._violated.get(key, 0),
             }
             for p in PERCENTILES:
-                state[f"p{p}_ms"] = round(_percentile(vals, p) * 1e3, 3)
+                v = _percentile(vals, p)
+                state[f"p{p}_ms"] = round(v * 1e3, 3) if v is not None else None
             if target is None or n == 0:
                 state["violations"] = 0
                 state["compliance"] = 1.0
@@ -143,16 +164,29 @@ class SloTracker:
             return state
 
     def snapshot(self) -> dict:
-        """Wire form: per-metric state + the overall verdict."""
+        """Wire form: per-metric aggregate state + per-tenant breakdown +
+        the overall verdict (aggregate series only — one noisy tenant blows
+        its own view, the fleet verdict stays the pooled objective)."""
         with self._lock:
-            metrics = sorted(set(self._samples) | set(self.targets))
+            metrics = sorted(
+                {m for m, t in self._samples if not t} | set(self.targets)
+            )
+            tenant_keys = sorted((t, m) for m, t in self._samples if t)
         per = {m: self.metric_state(m) for m in metrics}
-        return {
+        out = {
             "objective": self.objective,
             "window_s": self.window_s,
             "metrics": per,
             "ok": all(s["ok"] for s in per.values()) if per else True,
         }
+        if tenant_keys:
+            tenants: dict[str, dict] = {}
+            for tenant, metric in tenant_keys:
+                tenants.setdefault(tenant, {})[metric] = self.metric_state(
+                    metric, tenant
+                )
+            out["tenants"] = tenants
+        return out
 
     def ok(self) -> bool:
         return self.snapshot()["ok"]
@@ -165,19 +199,30 @@ class SloTracker:
         snap = self.snapshot()
         quantile_samples, target_samples, budget_samples, compliance_samples = [], [], [], []
         violation_samples = []
-        for metric, s in sorted(snap["metrics"].items()):
+        series = [({}, m, s) for m, s in sorted(snap["metrics"].items())]
+        for tenant, metrics in sorted(snap.get("tenants", {}).items()):
+            series.extend(
+                ({"tenant": tenant}, m, s) for m, s in sorted(metrics.items())
+            )
+        for base, metric, s in series:
             for p in PERCENTILES:
-                quantile_samples.append(
-                    ({"metric": metric, "quantile": f"0.{p}"}, s[f"p{p}_ms"] / 1e3)
-                )
+                # empty windows render NO quantile sample (None must never
+                # reach the exposition as NaN or a fake 0.0)
+                if s[f"p{p}_ms"] is not None:
+                    quantile_samples.append((
+                        {**base, "metric": metric, "quantile": f"0.{p}"},
+                        s[f"p{p}_ms"] / 1e3,
+                    ))
             if s["target_ms"] is not None:
-                target_samples.append(({"metric": metric}, s["target_ms"] / 1e3))
-                budget_samples.append(({"metric": metric}, s["error_budget"]))
-                compliance_samples.append(({"metric": metric}, s["compliance"]))
-            violation_samples.append(({"metric": metric}, s["violations_total"]))
+                if not base:
+                    target_samples.append(({"metric": metric}, s["target_ms"] / 1e3))
+                budget_samples.append(({**base, "metric": metric}, s["error_budget"]))
+                compliance_samples.append(({**base, "metric": metric}, s["compliance"]))
+            violation_samples.append(({**base, "metric": metric}, s["violations_total"]))
         out = render_family(
             f"{prefix}_latency_seconds", "gauge",
-            "rolling-window latency percentile per SLO metric",
+            "rolling-window latency percentile per SLO metric "
+            "(tenant-labeled series = one tenant's breakdown)",
             quantile_samples,
         )
         if target_samples:

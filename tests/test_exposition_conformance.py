@@ -38,6 +38,8 @@ def test_surfaces_cover_every_layer():
         "llm.http.metrics",
         "utils.slo",
         "utils.health",
+        "utils.goodput",
+        "loadgen.replay",
         "engine.render_stage_metrics",
         "disagg.dataplane.server",
         "disagg.dataplane.client",
@@ -47,6 +49,44 @@ def test_surfaces_cover_every_layer():
         "components.metrics",
     ):
         assert required in names, f"missing exposition surface {required}"
+
+
+def test_goodput_and_replay_families_on_surface():
+    """The goodput/replay planes must stay on the conformance-checked
+    surface list: windowed goodput by scenario + lifetime verdict counters +
+    the per-tenant breakdown (dynamo_goodput_*), and the replay client's
+    request/token/schedule-lag families (dynamo_replay_*)."""
+    text = dict(_SURFACES)["utils.goodput"]
+    assert "# TYPE dynamo_goodput_ratio gauge" in text
+    assert 'dynamo_goodput_ratio{scenario="bursty_chat"}' in text
+    assert "# TYPE dynamo_goodput_requests_total counter" in text
+    assert 'dynamo_goodput_requests_total{result="met",scenario="bursty_chat"}' in text
+    assert 'dynamo_goodput_requests_total{result="error",scenario="lora_churn"}' in text
+    assert "# TYPE dynamo_goodput_tenant_ratio gauge" in text
+    assert 'dynamo_goodput_tenant_ratio{tenant="tenant-a"}' in text
+    replay = dict(_SURFACES)["loadgen.replay"]
+    assert "# TYPE dynamo_replay_requests_total counter" in replay
+    assert 'dynamo_replay_requests_total{result="ok",scenario="bursty_chat"}' in replay
+    assert "# TYPE dynamo_replay_tokens_total counter" in replay
+    assert "# TYPE dynamo_replay_schedule_lag_seconds histogram" in replay
+    assert "# TYPE dynamo_replay_inflight_requests gauge" in replay
+
+
+def test_engine_surface_carries_goodput_families():
+    """The engine-scoped goodput families (colocated compositions keep
+    dynamo_goodput_* for the frontend tracker) must stay on the engine
+    surface."""
+    text = dict(_SURFACES)["engine.render_stage_metrics"]
+    assert "# TYPE dynamo_engine_goodput_ratio gauge" in text
+    assert "# TYPE dynamo_engine_goodput_requests_total counter" in text
+
+
+def test_slo_surface_carries_tenant_series():
+    """Per-tenant SLO breakdown (item 5's input) must render tenant-labeled
+    samples on the same dynamo_slo_* families as the aggregate."""
+    text = dict(_SURFACES)["utils.slo"]
+    assert 'tenant="tenant-a"' in text
+    assert 'dynamo_slo_latency_seconds{metric="ttft",quantile="0.99"}' in text
 
 
 def test_engine_surface_carries_kv_dtype_bytes_gauges():

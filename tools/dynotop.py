@@ -69,7 +69,7 @@ def render_status(doc: dict) -> str:
     header = (
         f"{'WORKER':<12} {'STATE':<10} {'HB':>6} {'SEEN':>6} {'MISS':>4} "
         f"{'SLOTS':>7} {'KV%':>6} {'KVMEM':>11} {'PREFIX':>9} {'SPEC':>10} "
-        f"{'LORA':>11} {'WAIT':>5} {'HBM':>9} {'CMPL':>5}  SLO"
+        f"{'LORA':>11} {'GOODPUT':>9} {'WAIT':>5} {'HBM':>9} {'CMPL':>5}  SLO"
     )
     lines.append(header)
     lines.append("-" * len(header))
@@ -122,6 +122,14 @@ def render_status(doc: dict) -> str:
                 lora = f"{lora} {hot}"
         else:
             lora = "-"
+        # goodput: windowed fraction of finished requests meeting their
+        # TTFT/ITL-p99 budgets (utils/goodput.py via worker stats); workers
+        # with an empty window (or predating the plane) show "-"
+        gp = w.get("goodput") or {}
+        if gp.get("goodput") is not None:
+            goodput = f"{100.0 * gp['goodput']:.0f}% ({gp.get('requests', 0)})"
+        else:
+            goodput = "-"
         hb = health.get("heartbeat_age_s")
         stale_mark = " STALE" if w.get("stale") else ""
         lines.append(
@@ -129,7 +137,7 @@ def render_status(doc: dict) -> str:
             f"{(f'{hb:.1f}s' if hb is not None else '-'):>6} "
             f"{w.get('last_seen_s', 0):>5.1f}s {w.get('missed_scrapes', 0):>4} "
             f"{slots:>7} {kv_pct:>5.1f}% {kv_mem:>11} {prefix:>9} {spec:>10} "
-            f"{lora:>11} "
+            f"{lora:>11} {goodput:>9} "
             f"{kv.get('num_requests_waiting', 0):>5} "
             f"{_fmt_bytes(res.get('hbm_bytes_in_use', 0)):>9} "
             f"{res.get('xla_compiles', 0):>5}  {_slo_cell(w.get('slo'))}"
