@@ -709,6 +709,10 @@ class AsyncJaxEngine:
             "requests_waiting": len(sched.waiting),
             "oldest_waiting_age_s": round(sched.oldest_waiting_age(), 3),
             "engine_steps": self.step_count,
+            # step-anatomy plane (utils/step_anatomy.py): per-kind phase
+            # seconds, host/roofline fractions, decode dispatch cadence —
+            # nested dict rides /cluster/status + dynotop STEP/ROOF columns
+            "step_anatomy": sched.anatomy.snapshot(),
             # graceful zeros when no runner reports (CPU, or pre-init)
             "hbm_bytes_in_use": 0,
             "hbm_peak_bytes_in_use": 0,
@@ -764,6 +768,18 @@ class AsyncJaxEngine:
 
     def slo_snapshot(self) -> dict:
         return self.slo.snapshot()
+
+    def debug_steps(self, limit: int = 128, kind: Optional[str] = None) -> dict:
+        """The ``/debug/steps`` payload: recent per-dispatch StepRecords
+        (newest last) + the summary fractions — where the milliseconds of a
+        live engine's steps went, inspectable without tracing enabled."""
+        if self.scheduler is None:
+            return {"records": [], "summary": {}}
+        anatomy = self.scheduler.anatomy
+        return {
+            "records": anatomy.records(limit=limit, kind=kind),
+            "summary": anatomy.snapshot(),
+        }
 
     def goodput_snapshot(self) -> dict:
         """Windowed goodput per scenario/tenant (worker stats broadcasts +
@@ -849,6 +865,9 @@ class AsyncJaxEngine:
                     "offload restore, and catch-up rebuilds)",
                     [({}, st.spec_draft_prefills)],
                 ))
+        # step-anatomy families: dynamo_step_seconds_total{phase,kind} +
+        # dynamo_step_dispatch_total{kind} + dynamo_engine_roofline_fraction
+        parts.append(self.scheduler.anatomy.render_metrics())
         parts.append(self._render_resource_metrics())
         # fleet prefix cache: wire-side client/server families join the
         # engine surface when the hosting worker attached them

@@ -42,6 +42,7 @@ from dynamo_tpu.spec import make_proposer
 from dynamo_tpu.utils import get_logger, tracing
 from dynamo_tpu.utils.goodput import MAX_ITL_SAMPLES, RequestOutcome
 from dynamo_tpu.utils.prometheus import Histogram
+from dynamo_tpu.utils.step_anatomy import StepAnatomy, roofline_for_runner
 
 log = get_logger("engine.sched")
 
@@ -188,6 +189,9 @@ class _InFlight:
     seqs: list = field(default_factory=list)
     cached_len: int = 0
     lp: object = None  # (chosen, top_ids, top_lps) device arrays, if requested
+    # step-anatomy record of the dispatch that produced this entry: the
+    # reconcile's device-wait/emission time attributes back to it
+    rec: object = None
 
 
 def _mm_chunk_overrides(req: EngineRequest, start: int, end: int):
@@ -370,6 +374,21 @@ class Scheduler:
         # Prometheus histograms (rendered by the worker's /metrics)
         self.stage = StageStats()
         self.stage_hist = _stage_histograms()
+        # step-anatomy plane (utils/step_anatomy.py): per-dispatch host/device
+        # phase attribution in a bounded ring + the live roofline estimator
+        # priced from this runner's actual param bytes and KV page cost
+        self.anatomy = StepAnatomy(
+            roofline=roofline_for_runner(runner, config) if runner is not None
+            else None,
+        )
+        store = getattr(runner, "lora_store", None) if runner is not None else None
+        if store is not None:
+            # slot loads (device scatters) record as lora_slot_load dispatches
+            store.anatomy = self.anatomy
+        # run_prefill_chunks' most recent record: the dispatch-ahead callers
+        # attach it to their _InFlight entry so the reconcile's device-wait
+        # attributes back to the producing prefill chain
+        self._last_prefill_rec = None
         # optional SLO sink (utils/slo.SloTracker): queue-wait and TTFT
         # observations feed rolling-window percentiles when attached
         self.slo = None
@@ -486,11 +505,23 @@ class Scheduler:
         if alloc.offload is None or cfg.offload_watermark >= 1.0:
             return
         total = max(1, cfg.num_pages - 1)
+        drained = 0
+        t0 = time.monotonic()
         while alloc.used_pages / total > cfg.offload_watermark and alloc._reusable:
             moved = alloc.drain_to_host(cfg.offload_drain_batch)
             if not moved:
                 break
             self.offload_pressure_blocks += moved
+            drained += moved
+        if drained:
+            dt = time.monotonic() - t0
+            self.anatomy.record(
+                "offload_drain", dispatch_s=dt, tokens=drained, ts=t0,
+            )
+            tracing.record_span(
+                "engine.offload.drain", t0, duration=dt,
+                attrs={"blocks": drained},
+            )
 
     # ---------------- page-table ladder ----------------
 
@@ -721,7 +752,8 @@ class Scheduler:
         self.allocator.commit_prefilled(req.request_id, prompt_len)
         self.slots[slot] = seq
         self.in_flight.append(
-            _InFlight(kind="first", dev=tok_dev, seqs=[seq], cached_len=cached_len, lp=lp)
+            _InFlight(kind="first", dev=tok_dev, seqs=[seq], cached_len=cached_len,
+                      lp=lp, rec=self._last_prefill_rec)
         )
 
     # ---------------- fleet-wide prefix fetch (FETCHING_KV) ----------------
@@ -841,6 +873,7 @@ class Scheduler:
         state = self.allocator._seqs.get(seq.req.request_id)
         if state is None:
             return 0
+        t0 = time.monotonic()
         try:
             applied = 0
             for part in res.parts:
@@ -855,6 +888,11 @@ class Scheduler:
                     break
                 self.runner.inject_pages_bucketed(ids, part.data, axis=part.cat_axis)
                 applied = part.block_to
+            if applied:
+                self.anatomy.record(
+                    "prefix_fetch_scatter", dispatch_s=time.monotonic() - t0,
+                    tokens=applied, ts=t0,
+                )
             return applied
         except Exception:
             log.exception(
@@ -883,7 +921,8 @@ class Scheduler:
         self.allocator.commit_prefilled(req.request_id, seq.prompt_len)
         seq.prefill_pos = None
         self.in_flight.append(_InFlight(
-            kind="first", dev=tok_dev, seqs=[seq], cached_len=seq.cached_len, lp=lp
+            kind="first", dev=tok_dev, seqs=[seq], cached_len=seq.cached_len,
+            lp=lp, rec=self._last_prefill_rec,
         ))
 
     def _dispatch_prefill_batches(self, outputs: list[StepOutput]) -> int:
@@ -909,6 +948,7 @@ class Scheduler:
         while True:
             if cap and decode_running and count >= cap:
                 return count
+            t_prep = time.monotonic()
             pending = sorted(
                 (s for s in self.slots
                  if s is not None and not s.finished and s.prefill_pos is not None
@@ -967,6 +1007,8 @@ class Scheduler:
             ))
             N = min(lanes_max, 1 << (len(chunks) - 1).bit_length())
             t0 = time.monotonic()
+            rec = self.anatomy.begin("prefill_packed", ts=t_prep)
+            self.anatomy.add_phase(rec, "host_prep", t0 - t_prep)
             try:
                 result = self.runner.prefill_chunk_batch(
                     lanes, N=N, want_logprobs=want_lp
@@ -984,6 +1026,8 @@ class Scheduler:
             self.stage.prefill_calls += 1
             self.stage.prefill_rows += rows
             self.stage_hist["prefill"].observe(dt)
+            self.anatomy.add_phase(rec, "dispatch", dt)
+            self.anatomy.note_steps(rec, tokens=rows, participants=len(chunks))
             if tracing.enabled():
                 tracing.record_span(
                     "engine.prefill", t0, duration=dt,
@@ -1005,6 +1049,7 @@ class Scheduler:
                 self.in_flight.append(_InFlight(
                     kind="first_batch", dev=toks_dev, lp=lp,
                     seqs=[(seq, j, seq.cached_len) for seq, j in finals],
+                    rec=rec,
                 ))
             count += 1
 
@@ -1093,8 +1138,10 @@ class Scheduler:
         first_token = None
         start = cached_len
         t0 = time.monotonic()
+        rec = self._last_prefill_rec = self.anatomy.begin("prefill_chunk", ts=t0)
         if prep:
             self._prep_prefill(req, slot, prompt_len, cached_len=cached_len)
+        self.anatomy.add_phase(rec, "host_prep", time.monotonic() - t0)
         while start < prompt_len:
             # depth-aware chunk sizing: shrink the chunk as the context
             # deepens so per-chunk latency stays roughly flat at depth
@@ -1132,6 +1179,10 @@ class Scheduler:
         self.stage.prefill_calls += 1
         self.stage.prefill_rows += rows
         self.stage_hist["prefill"].observe(dt)
+        # everything past host_prep is dispatch time (sync=True chains block
+        # per chunk, so device wait folds into the same phase here)
+        self.anatomy.add_phase(rec, "dispatch", dt - rec.host_prep_s)
+        self.anatomy.note_steps(rec, tokens=rows, participants=1)
         tracing.record_span(
             "engine.prefill", t0, duration=dt,
             request_id=req.request_id, trace_id=req.trace_id,
@@ -1345,10 +1396,17 @@ class Scheduler:
             positions, tables, active, fed, n_feed, temps, top_ks, top_ps,
             min_ps=min_ps, seeds=seeds if np.any(seeds) else None,
         )
+        t_disp = time.monotonic()
         toks = np.asarray(toks_dev)
         dt = time.monotonic() - t0
         self.stage.spec_draft_calls += 1
         self.stage.spec_draft_s += dt
+        self.anatomy.record(
+            "spec_draft", dispatch_s=t_disp - t0,
+            device_wait_s=time.monotonic() - t_disp,
+            steps=K, tokens=int(sum(c[3] for c in live)),
+            participants=len(live), ts=t0,
+        )
         if tracing.enabled():
             tracing.record_span(
                 "engine.spec.draft", t0, duration=dt,
@@ -1443,6 +1501,7 @@ class Scheduler:
                 else:
                     c[3] = len(c[2])
 
+        t_prep = time.monotonic()
         B = self.config.max_seqs
         # per-round table width: the widest participant's ladder rung (narrow
         # sequences zero-pad into the trash page)
@@ -1487,13 +1546,29 @@ class Scheduler:
             draft_probs=draft_probs,
             lora_slots=lora_slots if np.any(lora_slots) else None,
         )
+        t_disp = time.monotonic()
         tokens = np.asarray(out_dev)
         n_emit = np.asarray(n_emit_dev)
         dt = time.monotonic() - t0
         st = self.stage
         st.spec_rounds += 1
         st.spec_dispatch_s += dt
-        round_proposed = round_accepted = 0
+        # step anatomy: one verify round reads weights + every participant's
+        # live pages once (a multi-query pass, not one read per row), so the
+        # floor prices like a single decode step at the round's occupancy
+        live_pages = sum(
+            self.allocator._seqs[s.req.request_id].num_pages
+            for s, _, _, _ in candidates
+            if s.req.request_id in self.allocator._seqs
+        )
+        rec = self.anatomy.record(
+            "spec_verify", host_prep_s=t0 - t_prep, dispatch_s=t_disp - t0,
+            device_wait_s=time.monotonic() - t_disp, steps=1,
+            participants=len(candidates),
+            floor_bytes=self.anatomy.decode_floor_bytes(live_pages, 1), ts=t_prep,
+        )
+        t_rec = time.monotonic()
+        round_proposed = round_accepted = round_emitted = 0
         for seq, i, proposed, p in snapshot:
             if seq.finished:
                 continue  # EOS/cancel raced in via a drain above
@@ -1504,6 +1579,7 @@ class Scheduler:
             st.spec_emitted += emitted
             round_proposed += proposed
             round_accepted += accepted
+            round_emitted += emitted
             self.stage_hist["spec_accept"].observe(accepted)
             if draft_mode and seq.draft_pos is not None:
                 # accepted draft rows are already fed in the draft cache;
@@ -1514,6 +1590,8 @@ class Scheduler:
                 outputs.extend(self._emit_token(seq, int(tokens[i, j])))
                 if seq.finished:
                     break  # stop/length mid-chunk: the tail tokens are dead
+        self.anatomy.add_phase(rec, "reconcile", time.monotonic() - t_rec)
+        self.anatomy.note_steps(rec, tokens=round_emitted)
         if tracing.enabled():
             tracing.record_span(
                 "engine.spec.verify", t0, duration=dt,
@@ -1581,6 +1659,10 @@ class Scheduler:
             if self.slots[seq.slot] is seq:
                 self._refresh_table(seq)
 
+        # host-prep timing starts AFTER the capacity pass: a pressure drain
+        # up there blocks in _reconcile, and that wait is already attributed
+        # as device_wait on the drained entries' own records
+        t_prep = time.monotonic()
         participants = []
         for seq in self.slots:
             if seq is None or seq.finished:
@@ -1651,7 +1733,16 @@ class Scheduler:
 
         want_lp = any(seq.req.logprobs is not None for seq, _ in participants)
         want_pen = any(seq.req.sampling.needs_penalties for seq, _ in participants)
+        # step anatomy: every scanned step reads the weights + each live
+        # participant's KV pages — the bytes-moved floor at this occupancy
+        live_pages = sum(
+            self.allocator._seqs[seq.req.request_id].num_pages
+            for seq, _ in participants
+            if seq.req.request_id in self.allocator._seqs
+        )
+        rec = self.anatomy.begin("decode_window", ts=t_prep)
         t0 = time.monotonic()
+        self.anatomy.add_phase(rec, "host_prep", t0 - t_prep)
         result = self.runner.dispatch_decode_window(
             positions, page_tables, active, limits, temps, top_ks, top_ps, K,
             want_logprobs=want_lp, rope_deltas=rope_deltas, min_ps=min_ps,
@@ -1666,6 +1757,11 @@ class Scheduler:
         self.stage.decode_windows += 1
         self.stage.decode_steps += K
         self.stage_hist["decode_window"].observe(dt)
+        self.anatomy.add_phase(rec, "dispatch", dt)
+        self.anatomy.note_steps(
+            rec, steps=K, tokens=steps_total, participants=len(snapshot),
+            floor_bytes=self.anatomy.decode_floor_bytes(live_pages, K),
+        )
         if tracing.enabled():
             tracing.record_span(
                 "engine.decode.window", t0, duration=dt,
@@ -1678,7 +1774,9 @@ class Scheduler:
                 },
             )
         toks_dev, lp = result if want_lp else (result, None)
-        self.in_flight.append(_InFlight(kind="window", dev=toks_dev, seqs=snapshot, lp=lp))
+        self.in_flight.append(_InFlight(
+            kind="window", dev=toks_dev, seqs=snapshot, lp=lp, rec=rec,
+        ))
         return True
 
     def _reconcile(self, block: bool, drain: bool = False) -> list[StepOutput]:
@@ -1701,11 +1799,13 @@ class Scheduler:
                 self.stage.reconcile_wait_s += dt
                 self.stage.reconcile_waits += 1
                 self.stage_hist["reconcile"].observe(dt)
+                self.anatomy.add_phase(entry.rec, "device_wait", dt)
                 if tracing.enabled():
                     tracing.record_span(
                         "engine.decode.sync", t0, duration=dt,
                         attrs={"kind": entry.kind, "drain": drain},
                     )
+            t_rec = time.monotonic()
             lp = None
             if entry.lp is not None:
                 lp = tuple(np.asarray(a) for a in entry.lp)
@@ -1743,6 +1843,9 @@ class Scheduler:
                         )
                         if seq.finished:
                             break
+            # host-side materialization (token emission, stop scanning) of
+            # this entry attributes back to the dispatch that produced it
+            self.anatomy.add_phase(entry.rec, "reconcile", time.monotonic() - t_rec)
         return outputs
 
     # ---------------- helpers ----------------

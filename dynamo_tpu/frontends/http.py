@@ -62,6 +62,9 @@ async def run_http(engine, args) -> None:
         extra_metrics=lambda: engine_metrics_text(engine),
         slo=slo,
         readiness=engine_readiness(engine),
+        # step-anatomy debug plane (/debug/steps): recent per-dispatch
+        # host/device phase records off the colocated engine's ring
+        step_source=getattr(engine, "debug_steps", None),
     )
     service.manager.add(pipeline)
     # multi-LoRA: each configured adapter serves as its own OpenAI model name

@@ -86,6 +86,7 @@ class HttpService:
         extra_metrics: Optional[Callable[[], str]] = None,
         slo=None,  # Optional[SloTracker]: rolling TTFT/ITL SLO state
         readiness: Optional[Callable[[], tuple]] = None,
+        step_source: Optional[Callable[..., dict]] = None,
     ):
         self.manager = manager or ModelManager()
         self.host = host
@@ -116,6 +117,9 @@ class HttpService:
         # colocated engine frontend wires the engine's HealthMonitor.
         self._readiness = readiness
         self._extra_metrics = extra_metrics
+        # step-anatomy source for a colocated engine: (limit=, kind=) ->
+        # {"records": [...], "summary": {...}} (AsyncJaxEngine.debug_steps)
+        self._step_source = step_source
         self._runner: Optional[web.AppRunner] = None
         self.app = web.Application()
         self.app.router.add_post("/v1/chat/completions", self._chat)
@@ -123,6 +127,7 @@ class HttpService:
         self.app.router.add_get("/v1/models", self._models)
         self.app.router.add_get("/metrics", self._metrics)
         self.app.router.add_get("/trace", self._trace)
+        self.app.router.add_get("/debug/steps", self._debug_steps)
         self.app.router.add_get("/health", self._health)
         # probe split: /live answers "is this process running" and must never
         # block on (or 503 because of) the model manager or any downstream;
@@ -212,6 +217,22 @@ class HttpService:
         if tid or rid:
             doc["traceEvents"] = tracing.events(trace_id=tid, request_id=rid)
         return web.json_response(doc)
+
+    async def _debug_steps(self, request: web.Request) -> web.Response:
+        """Debug endpoint: the colocated engine's recent step-anatomy records
+        (utils/step_anatomy.py) — per-dispatch host-prep/dispatch/device-wait/
+        reconcile milliseconds plus the host/roofline summary fractions.
+        ``?limit=`` caps the record count, ``?kind=`` filters to one dispatch
+        kind (decode_window, prefill_packed, ...). Frontends with no engine
+        attached answer with an empty record list."""
+        if self._step_source is None:
+            return web.json_response({"records": [], "summary": {}})
+        try:
+            limit = int(request.query.get("limit", 128))
+        except ValueError:
+            limit = 128
+        kind = request.query.get("kind") or None
+        return web.json_response(self._step_source(limit=limit, kind=kind))
 
     def _error(self, status: int, message: str, code: str | None = None) -> web.Response:
         err = {"message": message, "type": "invalid_request_error"}

@@ -12,8 +12,8 @@ and the ``--dry-run`` CLI stay sub-second and importable anywhere (the
 determinism contract rides ``random.Random(seed)``, whose generators are
 stable across platforms).
 
-Builtin scenarios (``BUILTIN_SCENARIOS``) are the bench spine's five
-workload shapes; YAML/dict overrides layer on top via ``load_scenario``.
+Builtin scenarios (``BUILTIN_SCENARIOS``) are the bench spine's workload
+shapes; YAML/dict overrides layer on top via ``load_scenario``.
 """
 
 from __future__ import annotations
@@ -141,6 +141,23 @@ BUILTIN_SCENARIOS: dict = {
         num_requests=24, session_groups=4, shared_prefix_len=192,
         isl_mean=64, isl_sigma=0.4, isl_min=16, isl_max=256,
         osl_mean=16, osl_max=48, slo_ttft_ms=5000.0,
+    ),
+    # the 128K deep end (standing PR 8/11 follow-up): few, enormous prompts
+    # with a shared document prefix — the page-table ladder's widest rung,
+    # depth-aware chunking, and pressure-driven host offload all under the
+    # SAME goodput verdict as every other scenario. Sized for the serving
+    # ladder's 131072 max_model_len (isl_max leaves OSL headroom); CPU smoke
+    # replays it scaled down (tests/test_loadgen.py), the driver's TPU run
+    # prices it at full depth.
+    "long_context_128k": _spec(
+        name="long_context_128k", arrival="poisson", rate_rps=0.5,
+        num_requests=6, session_groups=2, shared_prefix_len=65536,
+        # isl is the per-request TAIL past the shared 64K prefix: total
+        # prompt tops out at 65536 + 65024 + OSL < 131072
+        isl_dist="lognormal", isl_mean=32768, isl_sigma=0.3,
+        isl_min=4096, isl_max=65024,
+        osl_dist="fixed", osl_mean=32, osl_max=64,
+        vocab=32000, slo_ttft_ms=120000.0, slo_itl_ms=2000.0,
     ),
     # multimodal: Qwen2-VL image requests (deterministic random images) —
     # the capability that had zero perf numbers before this harness

@@ -35,7 +35,7 @@ from dynamo_tpu.lora.adapter import (
     module_dims,
     parse_adapter_specs,
 )
-from dynamo_tpu.utils import get_logger
+from dynamo_tpu.utils import get_logger, tracing
 
 log = get_logger("lora.store")
 
@@ -76,6 +76,9 @@ class LoraStore:
         self._loading: dict[str, object] = {}
         self._failed: dict[str, str] = {}
         self._pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="lora-load")
+        # step-anatomy sink (utils/step_anatomy.StepAnatomy), attached by the
+        # scheduler: device slot scatters record as lora_slot_load dispatches
+        self.anatomy = None
         # metrics
         self.evictions = 0
         self.loads = 0
@@ -100,7 +103,8 @@ class LoraStore:
 
     def _load_host(self, name: str) -> tuple[dict, float]:
         t0 = time.monotonic()
-        tree, scale = load_adapter(self.sources[name], self.model_config, self.rank)
+        with tracing.span("lora.host_load", adapter=name):
+            tree, scale = load_adapter(self.sources[name], self.model_config, self.rank)
         self.load_seconds += time.monotonic() - t0
         self.loads += 1
         return tree, scale
@@ -150,7 +154,19 @@ class LoraStore:
         if slot is None:
             return None  # every slot pinned by in-flight sequences
         tree, scale = host
+        t0 = time.monotonic()
         self._scatter(slot, tree, scale)
+        dt = time.monotonic() - t0
+        # the device-slot load was invisible to the tracing/anatomy planes
+        # before this span: a cold adapter's one-scatter hot-swap now shows
+        # up per request timeline AND in dynamo_step_seconds_total
+        tracing.record_span(
+            "lora.slot_load", t0, duration=dt,
+            attrs={"adapter": name, "slot": slot},
+        )
+        if self.anatomy is not None:
+            self.anatomy.record("lora_slot_load", dispatch_s=dt,
+                                participants=1, ts=t0)
         self.slot_of[name] = slot
         self._slot_name[slot] = name
         self.refs[name] = 1

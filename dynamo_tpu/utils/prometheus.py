@@ -346,6 +346,24 @@ def _sample_surfaces() -> list[tuple[str, str]]:
             return {}
 
     eng.runner = _SpecRunner()
+    # step-anatomy families (dynamo_step_* + dynamo_engine_roofline_fraction):
+    # seed one priced decode window + a LoRA slot load so every family —
+    # including the roofline gauge, which only renders once a floor-priced
+    # dispatch completed — is on the conformance surface
+    from dynamo_tpu.utils.step_anatomy import RooflineModel
+
+    anat = eng.scheduler.anatomy
+    anat.roofline = RooflineModel(
+        param_bytes=2_600_000_000, page_bytes=4096, page_size=4
+    )
+    rec = anat.begin("decode_window")
+    anat.add_phase(rec, "host_prep", 0.0004)
+    anat.add_phase(rec, "dispatch", 0.0021)
+    anat.add_phase(rec, "device_wait", 0.0049)
+    anat.add_phase(rec, "reconcile", 0.0003)
+    anat.note_steps(rec, steps=4, tokens=8, participants=2,
+                    floor_bytes=anat.decode_floor_bytes(64, 4))
+    anat.record("lora_slot_load", dispatch_s=0.0031)
     # the engine-scoped goodput families (dynamo_engine_goodput_*) need a
     # sample outcome to render their gauges
     eng.goodput.observe(RequestOutcome(

@@ -1,0 +1,414 @@
+"""Step-anatomy profiler: per-dispatch host/device time attribution with a
+live roofline accounting plane.
+
+The r5 judge decomposition put decode at 7.24 ms/step — 69.8% of the 5.05 ms
+weight+KV HBM floor — with ~30% of every step lost to host dispatch/reconcile
+overhead, but that number came from a one-off ``tools/profile_decode.py`` run.
+This module makes the split a *standing* measurement: every engine dispatch
+(decode window, packed prefill, per-request chunk, spec draft, spec verify,
+LoRA slot load, prefix-fetch scatter, offload drain) records one
+:class:`StepRecord` into a bounded ring, decomposed into four phases:
+
+  host_prep    host time building the dispatch (numpy control arrays,
+               capacity passes, table refreshes) before the runner call
+  dispatch     host time inside the runner call (trace lookup, H2D, XLA
+               dispatch — device may already be busy underneath)
+  device_wait  host time *blocked* on device results (the reconcile sync the
+               dispatch-ahead pipeline exists to hide)
+  reconcile    host time materializing results back into scheduler state
+               (token emission, EOS/stop scanning, stream posting)
+
+Since the engine loop is single-threaded, the sum of all phases over all
+kinds is the engine thread's wall time; ``host_frac`` (everything except
+device_wait, over the total) is the fraction of a serving step the host
+spends NOT waiting on the chip — the overhead the planned multi-step fused
+decode (ROADMAP item 3) must drive down, and this plane is its before/after
+instrument.
+
+The roofline estimator prices the bytes-moved floor of a decode step from
+live state: every step re-reads the full parameter set plus each live
+sequence's KV pages (``quant/kv.kv_page_bytes`` at the ACTUAL cache dtype,
+so int8 KV lowers the floor exactly as it lowers HBM traffic). Dividing by
+the device's HBM bandwidth (``DYNTPU_HBM_GBPS``, default v5e's 819) gives a
+floor time; ``roofline_fraction`` = floor / measured decode seconds — the
+69.8% number as a gauge (``dynamo_engine_roofline_fraction``). On CPU the
+bandwidth constant is fiction, but the *bytes* are exact and the fraction
+still moves with the same code changes, so CPU smoke runs record it labeled
+with the platform.
+
+Exposed everywhere the repo already has rails: ``render_metrics`` emits
+``dynamo_step_seconds_total{phase,kind}`` / ``dynamo_step_dispatch_total
+{kind}`` / ``dynamo_engine_roofline_fraction`` on the engine's conformance
+surface, ``snapshot()`` rides ``resource_snapshot`` -> worker stats ->
+dynotop STEP/ROOF columns, ``records()`` backs the ``/debug/steps`` JSON
+endpoint, and the bench ``step_anatomy`` section prices
+``host_frac``/``roofline_frac``/``dispatch_gap_ms_p50`` per arm.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+#: dispatch kinds (the label vocabulary of dynamo_step_seconds_total{kind=})
+KINDS = (
+    "decode_window",
+    "prefill_packed",
+    "prefill_chunk",
+    "spec_draft",
+    "spec_verify",
+    "lora_slot_load",
+    "prefix_fetch_scatter",
+    "offload_drain",
+)
+
+PHASES = ("host_prep", "dispatch", "device_wait", "reconcile")
+
+#: default ring capacity: at ms-scale steps this is a few seconds of recent
+#: history — enough for dynotop/debug inspection without unbounded growth
+DEFAULT_RING = 512
+
+#: v5e HBM bandwidth; override with DYNTPU_HBM_GBPS for other parts
+DEFAULT_HBM_GBPS = 819.0
+
+
+def hbm_bandwidth_bytes_s() -> float:
+    try:
+        return float(os.environ.get("DYNTPU_HBM_GBPS", DEFAULT_HBM_GBPS)) * 1e9
+    except ValueError:
+        return DEFAULT_HBM_GBPS * 1e9
+
+
+@dataclass
+class RooflineModel:
+    """Bytes-moved floor arithmetic for one engine's decode step.
+
+    param_bytes: every decode step reads the full parameter set once (the
+    weight-bound term; int8 weights are 1 byte/element automatically because
+    the bytes come from the actual leaves).
+    page_bytes: HBM cost of ONE allocator page across all layers, K and V,
+    at the ACTUAL kv_cache_dtype (``quant/kv.kv_page_bytes`` — int8 pages
+    include their f32 scale planes).
+    """
+
+    param_bytes: int
+    page_bytes: int
+    page_size: int
+    hbm_bw: float = field(default_factory=hbm_bandwidth_bytes_s)
+
+    def step_floor_bytes(self, live_pages: int) -> int:
+        """Bytes one decode step must move: weights + the live KV pages the
+        batch's attention re-reads."""
+        return self.param_bytes + live_pages * self.page_bytes
+
+    def step_floor_seconds(self, live_pages: int) -> float:
+        return self.step_floor_bytes(live_pages) / max(1.0, self.hbm_bw)
+
+    def to_dict(self) -> dict:
+        return {
+            "param_bytes": self.param_bytes,
+            "page_bytes": self.page_bytes,
+            "page_size": self.page_size,
+            "hbm_bw_bytes_s": self.hbm_bw,
+        }
+
+
+def roofline_for_runner(runner, config) -> Optional[RooflineModel]:
+    """Build the estimator from a live ModelRunner: parameter bytes from the
+    actual leaves, page bytes from the model's own accounting (the same
+    ``kv_page_bytes`` the resource gauges and dynotop render). None when the
+    runner/model can't price pages (external engines, test fakes)."""
+    model = getattr(runner, "model", None)
+    params = getattr(runner, "params", None)
+    if model is None or params is None or not hasattr(model, "kv_page_bytes"):
+        return None
+    try:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(params)
+        param_bytes = int(sum(
+            leaf.size * leaf.dtype.itemsize
+            for leaf in leaves
+            if hasattr(leaf, "size") and hasattr(leaf, "dtype")
+        ))
+        page_bytes = int(model.kv_page_bytes(config.page_size))
+    except Exception:
+        return None
+    if param_bytes <= 0 or page_bytes <= 0:
+        return None
+    return RooflineModel(
+        param_bytes=param_bytes, page_bytes=page_bytes,
+        page_size=config.page_size,
+    )
+
+
+@dataclass
+class StepRecord:
+    """One engine dispatch, decomposed. Mutated in place as phases land
+    (device_wait/reconcile arrive at the pipelined reconcile, possibly
+    several windows after the dispatch)."""
+
+    seq: int  # monotonic record id (eviction-stable ordering)
+    ts: float  # time.monotonic() at dispatch start
+    kind: str
+    host_prep_s: float = 0.0
+    dispatch_s: float = 0.0
+    device_wait_s: float = 0.0
+    reconcile_s: float = 0.0
+    steps: int = 0  # decode steps / verify rows this dispatch advances
+    tokens: int = 0  # tokens scheduled (decode) or rows computed (prefill)
+    participants: int = 0
+    floor_bytes: int = 0  # bytes-moved floor estimate (decode kinds only)
+
+    @property
+    def total_s(self) -> float:
+        return (self.host_prep_s + self.dispatch_s + self.device_wait_s
+                + self.reconcile_s)
+
+    @property
+    def host_s(self) -> float:
+        """Host time NOT blocked on the device."""
+        return self.host_prep_s + self.dispatch_s + self.reconcile_s
+
+    def to_dict(self) -> dict:
+        return {
+            "seq": self.seq,
+            "ts": round(self.ts, 6),
+            "kind": self.kind,
+            "host_prep_ms": round(self.host_prep_s * 1e3, 4),
+            "dispatch_ms": round(self.dispatch_s * 1e3, 4),
+            "device_wait_ms": round(self.device_wait_s * 1e3, 4),
+            "reconcile_ms": round(self.reconcile_s * 1e3, 4),
+            "steps": self.steps,
+            "tokens": self.tokens,
+            "participants": self.participants,
+            "floor_bytes": self.floor_bytes,
+        }
+
+
+class StepAnatomy:
+    """Bounded ring of StepRecords + cumulative per-(phase, kind) counters.
+
+    The engine thread is the only writer of records; ``snapshot``/
+    ``render_metrics``/``records`` run on the asyncio/scrape threads, so the
+    ring append and the counter updates take a lock (a handful of float adds
+    per dispatch against ms-scale stages — same budget as StageStats).
+    """
+
+    def __init__(self, ring_size: int = DEFAULT_RING,
+                 roofline: Optional[RooflineModel] = None):
+        self._lock = threading.Lock()
+        self.ring: deque[StepRecord] = deque(maxlen=ring_size)
+        self._seq = 0
+        # (phase, kind) -> cumulative seconds; kind -> dispatch count
+        self.phase_seconds: dict[tuple[str, str], float] = {}
+        self.dispatch_counts: dict[str, int] = {}
+        self.steps_total: dict[str, int] = {}
+        self.floor_bytes_total = 0  # cumulative priced floors
+        self._floor_kinds: set[str] = set()  # kinds that recorded a floor
+        self.roofline = roofline
+
+    # ---------------- recording (engine thread) ----------------
+
+    def begin(self, kind: str, ts: Optional[float] = None) -> StepRecord:
+        """Open one dispatch record and append it to the ring (it fills in
+        place as phases complete)."""
+        with self._lock:
+            self._seq += 1
+            rec = StepRecord(seq=self._seq, ts=ts or time.monotonic(), kind=kind)
+            self.ring.append(rec)
+            self.dispatch_counts[kind] = self.dispatch_counts.get(kind, 0) + 1
+        return rec
+
+    def add_phase(self, rec: Optional[StepRecord], phase: str, dt: float) -> None:
+        """Attribute ``dt`` seconds of ``phase`` to a record (None-safe: a
+        reconcile for an untracked dispatch still lands in the totals)."""
+        if dt < 0:
+            dt = 0.0
+        kind = rec.kind if rec is not None else "decode_window"
+        with self._lock:
+            key = (phase, kind)
+            self.phase_seconds[key] = self.phase_seconds.get(key, 0.0) + dt
+            if rec is not None:
+                setattr(rec, phase + "_s", getattr(rec, phase + "_s") + dt)
+
+    def record(self, kind: str, dispatch_s: float, host_prep_s: float = 0.0,
+               device_wait_s: float = 0.0, reconcile_s: float = 0.0,
+               steps: int = 0, tokens: int = 0, participants: int = 0,
+               floor_bytes: int = 0, ts: Optional[float] = None) -> StepRecord:
+        """One-shot record for synchronous dispatch kinds (spec rounds, LoRA
+        slot loads, scatters, drains): all phases known at the call site."""
+        rec = self.begin(kind, ts=ts)
+        for phase, dt in (("host_prep", host_prep_s), ("dispatch", dispatch_s),
+                          ("device_wait", device_wait_s),
+                          ("reconcile", reconcile_s)):
+            if dt:
+                self.add_phase(rec, phase, dt)
+        self.note_steps(rec, steps=steps, tokens=tokens,
+                        participants=participants, floor_bytes=floor_bytes)
+        return rec
+
+    def note_steps(self, rec: StepRecord, steps: int = 0, tokens: int = 0,
+                   participants: int = 0, floor_bytes: int = 0) -> None:
+        with self._lock:
+            rec.steps += steps
+            rec.tokens += tokens
+            rec.participants = max(rec.participants, participants)
+            rec.floor_bytes += floor_bytes
+            if steps:
+                self.steps_total[rec.kind] = (
+                    self.steps_total.get(rec.kind, 0) + steps
+                )
+            if floor_bytes:
+                self.floor_bytes_total += floor_bytes
+                self._floor_kinds.add(rec.kind)
+
+    def decode_floor_bytes(self, live_pages: int, steps: int) -> int:
+        """Floor bytes for a K-step decode window at the current occupancy
+        (0 when no roofline model is attached)."""
+        if self.roofline is None:
+            return 0
+        return self.roofline.step_floor_bytes(live_pages) * max(1, steps)
+
+    # ---------------- derived views (any thread) ----------------
+
+    def _ring_snapshot(self) -> list[StepRecord]:
+        with self._lock:
+            return list(self.ring)
+
+    def host_fraction(self, kinds: Optional[tuple] = None) -> Optional[float]:
+        """Host-side share of engine time over the cumulative counters:
+        (host_prep + dispatch + reconcile) / total. None before any data."""
+        with self._lock:
+            items = list(self.phase_seconds.items())
+        host = wait = 0.0
+        for (phase, kind), s in items:
+            if kinds is not None and kind not in kinds:
+                continue
+            if phase == "device_wait":
+                wait += s
+            else:
+                host += s
+        total = host + wait
+        if total <= 0:
+            return None
+        return host / total
+
+    def roofline_fraction(self) -> Optional[float]:
+        """floor / measured over the priced decode-regime kinds (decode
+        windows; spec verify rounds on spec engines): the fraction of the
+        decode regime's engine time the HBM floor accounts for. None until a
+        priced dispatch completes."""
+        if self.roofline is None:
+            return None
+        with self._lock:
+            floor_bytes = self.floor_bytes_total
+            measured = sum(
+                s for (phase, kind), s in self.phase_seconds.items()
+                if kind in self._floor_kinds
+            )
+        if floor_bytes <= 0 or measured <= 0:
+            return None
+        return (floor_bytes / self.roofline.hbm_bw) / measured
+
+    def dispatch_gap_ms(self, kind: str = "decode_window",
+                        q: float = 0.5) -> Optional[float]:
+        """Quantile of gaps between consecutive same-kind dispatch starts in
+        the ring — the host-side cadence (a fused-decode win shows up here
+        as the gap growing while tokens/gap grows faster)."""
+        ts = [r.ts for r in self._ring_snapshot() if r.kind == kind]
+        if len(ts) < 2:
+            return None
+        gaps = sorted(b - a for a, b in zip(ts, ts[1:]))
+        idx = min(len(gaps) - 1, max(0, int(q * (len(gaps) - 1))))
+        return gaps[idx] * 1e3
+
+    def records(self, limit: int = 128, kind: Optional[str] = None) -> list[dict]:
+        """Most-recent records (newest last) as JSON-safe dicts — the
+        ``/debug/steps`` payload."""
+        snap = self._ring_snapshot()
+        if kind is not None:
+            snap = [r for r in snap if r.kind == kind]
+        return [r.to_dict() for r in snap[-max(0, limit):]]
+
+    def snapshot(self) -> dict:
+        """Wire-safe summary for resource_snapshot -> worker stats ->
+        dynotop: per-kind second totals, the two headline fractions, and the
+        decode dispatch cadence."""
+        with self._lock:
+            phase_seconds = {
+                f"{phase}.{kind}": round(s, 6)
+                for (phase, kind), s in sorted(self.phase_seconds.items())
+            }
+            counts = dict(self.dispatch_counts)
+            steps = dict(self.steps_total)
+            floor_bytes = self.floor_bytes_total
+        gap = self.dispatch_gap_ms("decode_window")
+        snap = {
+            "phase_seconds": phase_seconds,
+            "dispatches": counts,
+            "steps": steps,
+            "host_frac": _round_opt(self.host_fraction()),
+            "decode_host_frac": _round_opt(
+                self.host_fraction(kinds=("decode_window",))
+            ),
+            "roofline_frac": _round_opt(self.roofline_fraction()),
+            "dispatch_gap_ms_p50": round(gap, 3) if gap is not None else None,
+            "floor_bytes_total": floor_bytes,
+            "records": len(self.ring),
+        }
+        if self.roofline is not None:
+            snap["roofline"] = self.roofline.to_dict()
+        return snap
+
+    def render_metrics(self) -> str:
+        """Prometheus families for the engine exposition surface."""
+        from dynamo_tpu.utils.prometheus import render_family
+
+        with self._lock:
+            phase_items = sorted(self.phase_seconds.items())
+            counts = sorted(self.dispatch_counts.items())
+        parts = [
+            render_family(
+                "dynamo_step_seconds_total", "counter",
+                "engine-thread seconds per step-anatomy phase and dispatch "
+                "kind (host_prep/dispatch/reconcile = host overhead; "
+                "device_wait = host blocked on the chip)",
+                [({"kind": kind, "phase": phase}, round(s, 6))
+                 for (phase, kind), s in phase_items]
+                or [({"kind": "decode_window", "phase": "dispatch"}, 0)],
+            ),
+            render_family(
+                "dynamo_step_dispatch_total", "counter",
+                "engine dispatches by step-anatomy kind",
+                [({"kind": k}, n) for k, n in counts]
+                or [({"kind": "decode_window"}, 0)],
+            ),
+        ]
+        frac = self.roofline_fraction()
+        if frac is not None:
+            parts.append(render_family(
+                "dynamo_engine_roofline_fraction", "gauge",
+                "HBM bytes-moved floor over measured decode-window engine "
+                "seconds (1.0 = running at the roofline; the r5 69.8% "
+                "decomposition as a standing gauge)",
+                [({}, round(frac, 4))],
+            ))
+        host = self.host_fraction()
+        if host is not None:
+            parts.append(render_family(
+                "dynamo_step_host_fraction", "gauge",
+                "host-side share of attributed engine time (1 - device_wait "
+                "share): the per-token overhead multi-step fused decode "
+                "exists to shrink",
+                [({}, round(host, 4))],
+            ))
+        return "".join(parts)
+
+
+def _round_opt(v: Optional[float], nd: int = 4) -> Optional[float]:
+    return round(v, nd) if v is not None else None

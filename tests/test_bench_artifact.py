@@ -70,6 +70,14 @@ def _fill_representative(bench):
         "acceptance_rate_ngram": 0.0512, "greedy_parity_draft": 1.0,
     }
     bench.DETAIL["platform"] = "tpu"
+    bench.DETAIL["step_anatomy"] = {
+        "cpu_smoke": False,
+        "decode": {"host_frac": 0.3124, "roofline_frac": 0.6981,
+                   "dispatch_gap_ms_p50": 231.456,
+                   "dispatches": {"decode_window": 240}},
+        "spec_draft": {"host_frac": 0.4123},
+        "multi_lora": {"host_frac": 0.3852},
+    }
     bench.DETAIL["replay"] = {
         "cpu_smoke": False,
         "scenarios": {
@@ -112,6 +120,12 @@ def test_summary_line_fits_truncation_budget(bench_mod, tmp_path, monkeypatch):
         "offload": 0.0,
     }
     assert s["http_serving"]["http_over_engine_ratio"] == 0.96
+    # step-anatomy acceptance keys ride the compact line (decode arm only;
+    # the spec/LoRA arm breakdowns stay in bench_detail.json)
+    assert s["step_anatomy"] == {
+        "host_frac": 0.3124, "roofline_frac": 0.6981,
+        "dispatch_gap_ms_p50": 231.5,  # 0.1 ms precision on the line
+    }
     assert s["mla_decode_tok_s"] == 4658.33
     assert s["moe_decode_tok_s"] == 5425.87
     assert s["parity_kv_routing"]["ratio_derived"] == 16.14

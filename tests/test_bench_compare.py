@@ -1,0 +1,133 @@
+"""tools/bench_compare.py: the bench regression gate. Acceptance: nonzero
+exit on a synthetic regression fixture, clean exit on identical artifacts,
+both artifact shapes (bench line / driver record) accepted, missing keys
+skipped (not regressions), direction + tolerance semantics honored."""
+
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+_spec = importlib.util.spec_from_file_location(
+    "bench_compare", Path(__file__).resolve().parent.parent / "tools" / "bench_compare.py"
+)
+bench_compare = importlib.util.module_from_spec(_spec)
+# dataclasses resolve their defining module through sys.modules at class
+# creation time, so the module must be registered before exec
+sys.modules["bench_compare"] = bench_compare
+_spec.loader.exec_module(bench_compare)
+
+
+def _artifact(headline=6000.0, host_frac=0.30, ttft_64k=57000.0):
+    return {
+        "metric": "engine_decode_throughput_llama1.3b_bf16",
+        "value": headline,
+        "summary": {
+            "headline_tok_s": headline,
+            "continuity_bs8_tok_s": round(headline / 4.5, 2),
+            "long_context": {"ttft_ms_64k": ttft_64k},
+            "step_anatomy": {"host_frac": host_frac, "roofline_frac": 0.7,
+                             "dispatch_gap_ms_p50": 231.4},
+            "replay": {"bursty": [0.98, 2600, 140, 33.6],
+                       "lctx": [1.0, 1200, 105, 26.6],
+                       "lora": [1.0, 1700, 6, 45.7],
+                       "spec": [1.0, 1250, 165, 46.1]},
+        },
+    }
+
+
+def _write(tmp_path, name, doc):
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def test_identical_artifacts_exit_clean(tmp_path):
+    old = _write(tmp_path, "old.json", _artifact())
+    new = _write(tmp_path, "new.json", _artifact())
+    assert bench_compare.main([old, new]) == 0
+
+
+def test_synthetic_regression_exits_nonzero(tmp_path):
+    """The acceptance fixture: a 1/3 headline drop must fail the gate."""
+    old = _write(tmp_path, "old.json", _artifact())
+    new = _write(tmp_path, "new.json", _artifact(headline=4000.0))
+    assert bench_compare.main([old, new]) != 0
+
+
+def test_lower_better_direction(tmp_path):
+    # host overhead creeping UP is the regression for a lower-better key
+    old = _write(tmp_path, "old.json", _artifact())
+    worse = _write(tmp_path, "worse.json", _artifact(host_frac=0.45))
+    assert bench_compare.main([old, worse]) != 0
+    # and 64K TTFT regressing is caught through a nested path
+    slow = _write(tmp_path, "slow.json", _artifact(ttft_64k=90000.0))
+    assert bench_compare.main([old, slow]) != 0
+    # improvement in a lower-better key passes
+    better = _write(tmp_path, "better.json", _artifact(host_frac=0.20))
+    assert bench_compare.main([old, better]) == 0
+
+
+def test_driver_record_shape_accepted(tmp_path):
+    """BENCH_rXX.json driver records nest the bench line under `parsed`."""
+    old = _write(tmp_path, "old.json", {"n": 6, "parsed": _artifact()})
+    new = _write(tmp_path, "new.json",
+                 {"n": 7, "parsed": _artifact(headline=3000.0)})
+    assert bench_compare.main([old, new]) != 0
+    same = _write(tmp_path, "same.json", {"n": 7, "parsed": _artifact()})
+    assert bench_compare.main([old, same]) == 0
+
+
+def test_missing_keys_skip_unless_strict(tmp_path):
+    """Sections come and go between rounds: absence is reported, not a
+    regression — unless --strict."""
+    old = _write(tmp_path, "old.json", _artifact())
+    partial = _write(
+        tmp_path, "partial.json",
+        {"summary": {"headline_tok_s": 6000.0}},
+    )
+    assert bench_compare.main([old, partial]) == 0
+    assert bench_compare.main([old, partial, "--strict"]) != 0
+
+
+def test_explicit_keys_and_tolerance(tmp_path):
+    old = _write(tmp_path, "old.json", _artifact())
+    new = _write(tmp_path, "new.json", _artifact(headline=5500.0))
+    # an 8.3% drop passes at 15% tolerance but fails at 5%
+    assert bench_compare.main([old, new, "--key", "headline_tok_s:0.15"]) == 0
+    assert bench_compare.main([old, new, "--key", "headline_tok_s:0.05"]) != 0
+
+
+def test_lookup_paths_and_list_indexing():
+    s = _artifact()["summary"]
+    assert bench_compare.lookup(s, "headline_tok_s") == 6000.0
+    assert bench_compare.lookup(s, "long_context.ttft_ms_64k") == 57000.0
+    assert bench_compare.lookup(s, "replay.bursty.0") == 0.98
+    assert bench_compare.lookup(s, "replay.bursty.9") is None
+    assert bench_compare.lookup(s, "nope.deeper") is None
+    assert bench_compare.lookup({"b": True}, "b") is None  # bool is not a metric
+
+
+def test_parse_key_spec():
+    assert bench_compare.parse_key_spec("a.b", 0.1) == ("a.b", "higher", 0.1)
+    assert bench_compare.parse_key_spec("a:0.2:lower", 0.1) == ("a", "lower", 0.2)
+    with pytest.raises(ValueError):
+        bench_compare.parse_key_spec("a:0.2:sideways", 0.1)
+
+
+def test_self_check_healthy():
+    assert bench_compare.self_check() == []
+
+
+def test_current_repo_artifact_parses():
+    """The real BENCH_r06 driver record must be readable by the gate (its
+    summary rides `parsed`), so cross-round comparison works on day one."""
+    repo = Path(__file__).resolve().parent.parent
+    r06 = repo / "BENCH_r06.json"
+    if not r06.exists():
+        pytest.skip("no BENCH_r06.json in repo root")
+    doc = json.loads(r06.read_text())
+    summary = bench_compare.extract_summary(doc)
+    assert isinstance(summary, dict) and summary

@@ -408,3 +408,65 @@ def test_replay_tenant_outcomes_reach_engine_slo(replay_engine_fixture):
     assert set(slo_snap.get("tenants", {})) >= {t.tenant for t in trace if t.tenant}
     gp_snap = eng.goodput.snapshot()
     assert set(gp_snap["tenants"]) >= {t.tenant for t in trace if t.tenant}
+
+
+# ---------------- 128K deep-end arm (PR 8/11 follow-up) ----------------
+
+
+def test_long_context_128k_builtin_depth():
+    """The 128K builtin compiles to genuinely deep, session-grouped prompts
+    that fit the 131072 serving ceiling with OSL headroom (the byte-identity
+    determinism contract is covered by the parametrized builtin tests)."""
+    spec = load_scenario("long_context_128k")
+    trace = compile_trace(spec)
+    lens = [len(r.token_ids) for r in trace]
+    assert max(lens) + spec.osl_max <= 131072
+    assert max(lens) >= 65536 + spec.isl_min  # the deep end is actually deep
+    assert all(r.session for r in trace)
+    by_session: dict = {}
+    for r in trace:
+        by_session.setdefault(r.session, []).append(r.token_ids)
+    for prompts in by_session.values():
+        prefix = prompts[0][: spec.shared_prefix_len]
+        assert all(p[: spec.shared_prefix_len] == prefix for p in prompts)
+
+
+@pytest.mark.slow
+def test_long_context_128k_scaled_replay():
+    """The deep end priced by the SAME goodput plane as every other
+    scenario: a depth-scaled (1/32) replay of the 128K builtin against a
+    tiny engine meets its budgets and engages the wide table rungs. The
+    driver's TPU run replays the builtin at full depth via
+    `python -m dynamo_tpu.loadgen --scenario long_context_128k`."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.loadgen.replay import replay_engine
+
+    spec = load_scenario("long_context_128k", num_requests=3, rate_rps=2.0).replace(
+        shared_prefix_len=2048, isl_mean=1024, isl_sigma=0.3, isl_min=128,
+        isl_max=2032, vocab=256, slo_ttft_ms=60000.0, slo_itl_ms=60000.0,
+    )
+    trace = compile_trace(spec)
+    assert max(len(r.token_ids) for r in trace) > 2048  # still the deep shape
+    cfg = EngineConfig(
+        model_id="tiny", page_size=4, num_pages=4096, max_seqs=2,
+        max_model_len=4608, prefill_buckets=(16, 32, 64, 128, 256),
+        decode_steps=4, pipeline_depth=2,
+    )
+    eng = AsyncJaxEngine(cfg)
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(eng.start())
+        gp = GoodputTracker()
+        report = loop.run_until_complete(
+            replay_engine(eng, trace, spec=spec, speed=8.0, goodput=gp)
+        )
+        assert report["requests"] == 3 and report["errors"] == 0
+        assert report["goodput"] == 1.0
+        # deep prompts dispatched on wide page-table ladder rungs
+        assert max(eng.scheduler.table_dispatches) >= 512
+        # priced under its own scenario key in the goodput plane
+        assert "long_context_128k" in gp.snapshot()["scenarios"]
+    finally:
+        loop.run_until_complete(eng.shutdown())
+        loop.close()

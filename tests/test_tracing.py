@@ -402,3 +402,113 @@ def test_disagg_two_hop_trace_and_stage_histograms():
     assert "dynamo_engine_ttft_seconds_bucket" in text
     snap = decode_inner.stage_snapshot()
     assert snap["queue_wait_n"] >= 1 and snap["decode_windows"] >= 1
+
+
+# ---------------- post-PR-1 subsystem spans (tracing gap fix) ----------------
+# Subsystems added after the tracing PR emitted no spans: draft-model
+# speculation, LoRA slot loads, and the pressure-driven offload drain. These
+# tests pin their spans so a future subsystem can't silently regress the
+# per-request timeline again.
+
+
+def test_lora_slot_load_span_and_anatomy():
+    """A cold adapter's device-slot scatter emits lora.slot_load and records
+    a lora_slot_load step-anatomy dispatch."""
+    from types import SimpleNamespace
+
+    from dynamo_tpu.lora.store import LoraStore
+    from dynamo_tpu.utils.step_anatomy import StepAnatomy
+
+    tracing.enable()
+    cfg = SimpleNamespace(max_loras=2, lora_rank=2, lora_adapters=("a1",))
+    store = LoraStore(cfg, SimpleNamespace(config=None),
+                      scatter_fn=lambda slot, tree, scale: None)
+    store.anatomy = StepAnatomy()
+    store._host["a1"] = ({}, 1.0)  # host weights already cached
+    slot = store.acquire("a1")
+    assert slot is not None
+    evs = [e for e in tracing.events() if e["name"] == "lora.slot_load"]
+    assert len(evs) == 1
+    assert evs[0]["args"]["adapter"] == "a1"
+    assert evs[0]["args"]["slot"] == slot
+    assert store.anatomy.dispatch_counts.get("lora_slot_load") == 1
+    # a warm re-acquire pins the resident slot: no second scatter span
+    store.release("a1")
+    assert store.acquire("a1") == slot
+    assert len([e for e in tracing.events() if e["name"] == "lora.slot_load"]) == 1
+
+
+def test_offload_drain_span_and_anatomy():
+    """The watermark-driven cold-block drain emits engine.offload.drain with
+    the drained block count and records an offload_drain dispatch."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.scheduler import Scheduler
+
+    tracing.enable()
+
+    class _Alloc:  # page pool past the watermark with drainable cold blocks
+        offload = object()
+
+        def __init__(self):
+            self.used_pages = 14
+            self._reusable = [1, 2, 3]
+
+        def drain_to_host(self, batch):
+            self.used_pages -= 8
+            return 3
+
+    cfg = EngineConfig(model_id="tiny", page_size=4, num_pages=16, max_seqs=2,
+                       prefill_buckets=(16,), offload_watermark=0.5)
+    sched = Scheduler(cfg, None, _Alloc())
+    sched._drain_cold_to_host()
+    evs = [e for e in tracing.events() if e["name"] == "engine.offload.drain"]
+    assert len(evs) == 1
+    assert evs[0]["args"]["blocks"] == 3
+    assert sched.offload_pressure_blocks == 3
+    assert sched.anatomy.dispatch_counts.get("offload_drain") == 1
+    # below the watermark: no span, no record
+    tracing.clear()
+    sched._drain_cold_to_host()
+    assert tracing.events() == []
+
+
+def test_spec_draft_span_emitted():
+    """A draft-model engine's drafting dispatch emits engine.spec.draft
+    (alongside the verify pass's engine.spec.verify) — the draft phase was
+    invisible in traces before this."""
+    import numpy as np
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.engine.sampling import SamplingParams
+    from dynamo_tpu.engine.scheduler import EngineRequest
+
+    tracing.enable()
+
+    async def body():
+        eng = AsyncJaxEngine(EngineConfig(
+            model_id="tiny", page_size=4, num_pages=128, max_seqs=2,
+            max_model_len=96, prefill_buckets=(16, 32),
+            speculative="draft:tiny:1",
+        ))
+        await eng.start()
+        try:
+            rng = np.random.default_rng(0)
+            req = EngineRequest(
+                request_id="sd-1", token_ids=rng.integers(1, 200, 12).tolist(),
+                sampling=SamplingParams(temperature=0.0, max_tokens=6,
+                                        ignore_eos=True),
+            )
+            async for _ in eng.generate(req):
+                pass
+            return eng.scheduler.anatomy.snapshot()
+        finally:
+            await eng.shutdown()
+
+    snap = asyncio.run(body())
+    names = {e["name"] for e in tracing.events()}
+    assert "engine.spec.draft" in names
+    assert "engine.spec.verify" in names
+    # the step-anatomy plane saw the same dispatches
+    assert snap["dispatches"].get("spec_draft", 0) >= 1
+    assert snap["dispatches"].get("spec_verify", 0) >= 1

@@ -1,0 +1,247 @@
+#!/usr/bin/env python3
+"""bench_compare: diff two BENCH_*.json artifacts and gate on regressions.
+
+The bench trajectory (BENCH_r01..r0N) had no automated regression gate: a
+round could silently lose 20% of headline throughput and nothing but a human
+reading two JSON files would notice. This tool compares named summary keys
+between an OLD and NEW artifact, flags any key that moved past its tolerance
+in the *bad* direction, and exits nonzero on regression — wire it between a
+bench run and the artifact commit, or across rounds:
+
+    python tools/bench_compare.py BENCH_r06.json BENCH_r07.json
+    python tools/bench_compare.py old.json new.json \
+        --key headline_tok_s:0.10 --key step_anatomy.host_frac:0.05:lower
+
+Artifacts are accepted in either shape: the bench's own stdout line
+({"metric", "value", "summary": {...}}) or the driver's round record
+({"parsed": {...}, ...}). Keys are dotted paths into the summary (numeric
+components index into lists, e.g. ``replay.bursty.0`` = that scenario's
+goodput column). Keys missing from EITHER artifact are reported and skipped
+— sections come and go between rounds; absence is not a regression (pass
+``--strict`` to make it one).
+
+``--self-check`` runs the tool against built-in synthetic artifacts (a clean
+identical pair must pass, an injected regression must fail) — the lint-gate
+wiring, so the gate can't itself rot.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from dataclasses import dataclass
+from typing import Optional
+
+#: default per-key tolerance: relative move in the bad direction that flags
+DEFAULT_TOL = 0.15
+
+#: (summary path, direction, tolerance): the standing cross-round gate set.
+#: direction "higher" = bigger is better (throughput, ratios, goodput);
+#: "lower" = smaller is better (TTFT, host overhead fraction).
+DEFAULT_KEYS: tuple = (
+    ("headline_tok_s", "higher", DEFAULT_TOL),
+    ("continuity_bs8_tok_s", "higher", DEFAULT_TOL),
+    ("ref_workload_isl3k_osl150.tok_s", "higher", DEFAULT_TOL),
+    ("ref_workload_isl3k_osl150.ttft_p50_ms", "lower", DEFAULT_TOL),
+    ("http_serving.http_over_engine_ratio", "higher", DEFAULT_TOL),
+    ("mla_decode_tok_s", "higher", DEFAULT_TOL),
+    ("moe_decode_tok_s", "higher", DEFAULT_TOL),
+    ("parity_quant_int8.speedup", "higher", DEFAULT_TOL),
+    ("prefill_kv_int8.ttft_ratio", "lower", DEFAULT_TOL),
+    ("spec_ngram.speedup", "higher", DEFAULT_TOL),
+    ("multi_lora.mixed_tok_s_ratio", "higher", DEFAULT_TOL),
+    ("fleet_prefix.ttft_ratio_bf16", "lower", DEFAULT_TOL),
+    ("long_context.ttft_ms_64k", "lower", DEFAULT_TOL),
+    ("disagg_stream.ttft_ratio", "lower", DEFAULT_TOL),
+    # step anatomy (r7+): host overhead must not creep back up, and the
+    # roofline fraction must not fall (the fused-decode before/after gate)
+    ("step_anatomy.host_frac", "lower", DEFAULT_TOL),
+    ("step_anatomy.roofline_frac", "higher", DEFAULT_TOL),
+    # replay goodput columns (aliased arrays; index 0 = goodput)
+    ("replay.bursty.0", "higher", DEFAULT_TOL),
+    ("replay.lctx.0", "higher", DEFAULT_TOL),
+    ("replay.lora.0", "higher", DEFAULT_TOL),
+    ("replay.spec.0", "higher", DEFAULT_TOL),
+)
+
+
+@dataclass
+class KeyResult:
+    path: str
+    old: Optional[float]
+    new: Optional[float]
+    direction: str
+    tolerance: float
+    status: str  # ok | regression | missing
+
+    def line(self) -> str:
+        def f(v):
+            return "absent" if v is None else f"{v:g}"
+
+        arrow = {"ok": "  ", "regression": "✗ ", "missing": "? "}[self.status]
+        return (
+            f"{arrow}{self.path}: {f(self.old)} -> {f(self.new)} "
+            f"({self.direction} better, tol {self.tolerance:.0%}) {self.status}"
+        )
+
+
+def extract_summary(artifact: dict) -> dict:
+    """Summary dict from either artifact shape (bench line or driver
+    record); an artifact with no summary compares as all-absent."""
+    if not isinstance(artifact, dict):
+        return {}
+    if isinstance(artifact.get("summary"), dict):
+        return artifact["summary"]
+    parsed = artifact.get("parsed")
+    if isinstance(parsed, dict) and isinstance(parsed.get("summary"), dict):
+        return parsed["summary"]
+    return {}
+
+
+def lookup(summary: dict, path: str) -> Optional[float]:
+    """Resolve a dotted path; numeric components index lists. None for any
+    miss or a non-numeric leaf."""
+    cur = summary
+    for part in path.split("."):
+        if isinstance(cur, dict):
+            if part not in cur:
+                return None
+            cur = cur[part]
+        elif isinstance(cur, (list, tuple)):
+            try:
+                cur = cur[int(part)]
+            except (ValueError, IndexError):
+                return None
+        else:
+            return None
+    if isinstance(cur, bool) or not isinstance(cur, (int, float)):
+        return None
+    return float(cur)
+
+
+def compare_one(
+    old: dict, new: dict, path: str, direction: str, tolerance: float
+) -> KeyResult:
+    a, b = lookup(old, path), lookup(new, path)
+    if a is None or b is None:
+        return KeyResult(path, a, b, direction, tolerance, "missing")
+    if direction == "lower":
+        bad = b > a * (1.0 + tolerance) + 1e-12
+    else:
+        bad = b < a * (1.0 - tolerance) - 1e-12
+    return KeyResult(path, a, b, direction, tolerance,
+                     "regression" if bad else "ok")
+
+
+def compare(old: dict, new: dict, keys=DEFAULT_KEYS) -> list[KeyResult]:
+    o, n = extract_summary(old), extract_summary(new)
+    return [compare_one(o, n, path, direction, tol)
+            for path, direction, tol in keys]
+
+
+def parse_key_spec(spec: str, default_tol: float) -> tuple:
+    """``path[:tol[:direction]]`` -> (path, direction, tol)."""
+    parts = spec.split(":")
+    path = parts[0]
+    tol = float(parts[1]) if len(parts) > 1 and parts[1] else default_tol
+    direction = parts[2] if len(parts) > 2 and parts[2] else "higher"
+    if direction not in ("higher", "lower"):
+        raise ValueError(f"direction must be higher|lower, got {direction!r}")
+    return (path, direction, tol)
+
+
+def _synthetic(headline: float = 6000.0, host_frac: float = 0.30) -> dict:
+    """A minimal bench-line-shaped artifact for the self-check."""
+    return {
+        "metric": "engine_decode_throughput_llama1.3b_bf16",
+        "value": headline,
+        "summary": {
+            "headline_tok_s": headline,
+            "continuity_bs8_tok_s": headline / 4.5,
+            "step_anatomy": {"host_frac": host_frac, "roofline_frac": 0.7},
+            "replay": {"bursty": [0.98, 2600, 140, 33.6]},
+        },
+    }
+
+
+def self_check() -> list[str]:
+    """Built-in conformance of the gate itself: identical artifacts must
+    pass; an injected throughput drop and a host-overhead creep must each
+    flag. Returns problems (empty = healthy)."""
+    problems = []
+    clean = compare(_synthetic(), _synthetic())
+    if any(r.status == "regression" for r in clean):
+        problems.append("identical artifacts flagged a regression")
+    worse = compare(_synthetic(), _synthetic(headline=4000.0))
+    if not any(r.status == "regression" and r.path == "headline_tok_s"
+               for r in worse):
+        problems.append("33% headline drop not flagged")
+    crept = compare(_synthetic(), _synthetic(host_frac=0.45))
+    if not any(r.status == "regression" and r.path == "step_anatomy.host_frac"
+               for r in crept):
+        problems.append("host_frac creep (lower-better key) not flagged")
+    better = compare(_synthetic(headline=4000.0), _synthetic(headline=6000.0))
+    if any(r.status == "regression" for r in better):
+        problems.append("an improvement was flagged as a regression")
+    return problems
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="diff two BENCH_*.json artifacts; exit 1 on regression"
+    )
+    p.add_argument("old", nargs="?", help="baseline artifact path")
+    p.add_argument("new", nargs="?", help="candidate artifact path")
+    p.add_argument("--key", action="append", default=[],
+                   metavar="PATH[:TOL[:higher|lower]]",
+                   help="summary key to gate (replaces the default set; "
+                        "repeatable)")
+    p.add_argument("--tol", type=float, default=DEFAULT_TOL,
+                   help="default relative tolerance for --key specs")
+    p.add_argument("--strict", action="store_true",
+                   help="treat keys missing from either artifact as failures")
+    p.add_argument("--quiet", action="store_true",
+                   help="print regressions only")
+    p.add_argument("--self-check", action="store_true",
+                   help="validate the gate against built-in synthetic "
+                        "artifacts (the lint-gate wiring)")
+    args = p.parse_args(argv)
+
+    if args.self_check:
+        problems = self_check()
+        for prob in problems:
+            print(f"FAIL bench_compare self-check: {prob}")
+        if not problems:
+            print("ok: bench_compare self-check passed")
+        return 1 if problems else 0
+
+    if not args.old or not args.new:
+        p.error("OLD and NEW artifact paths are required (or --self-check)")
+    with open(args.old) as f:
+        old = json.load(f)
+    with open(args.new) as f:
+        new = json.load(f)
+    keys = (
+        tuple(parse_key_spec(s, args.tol) for s in args.key)
+        if args.key else DEFAULT_KEYS
+    )
+    results = compare(old, new, keys)
+    regressions = [r for r in results if r.status == "regression"]
+    missing = [r for r in results if r.status == "missing"]
+    for r in results:
+        if args.quiet and r.status == "ok":
+            continue
+        print(r.line())
+    compared = len(results) - len(missing)
+    print(f"compared {compared}/{len(results)} keys: "
+          f"{len(regressions)} regression(s), {len(missing)} missing")
+    if regressions:
+        return 1
+    if args.strict and missing:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

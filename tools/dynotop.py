@@ -69,7 +69,8 @@ def render_status(doc: dict) -> str:
     header = (
         f"{'WORKER':<12} {'STATE':<10} {'HB':>6} {'SEEN':>6} {'MISS':>4} "
         f"{'SLOTS':>7} {'KV%':>6} {'KVMEM':>11} {'PREFIX':>9} {'SPEC':>10} "
-        f"{'LORA':>11} {'GOODPUT':>9} {'WAIT':>5} {'HBM':>9} {'CMPL':>5}  SLO"
+        f"{'LORA':>11} {'GOODPUT':>9} {'STEP':>11} {'ROOF':>5} {'WAIT':>5} "
+        f"{'HBM':>9} {'CMPL':>5}  SLO"
     )
     lines.append(header)
     lines.append("-" * len(header))
@@ -130,6 +131,21 @@ def render_status(doc: dict) -> str:
             goodput = f"{100.0 * gp['goodput']:.0f}% ({gp.get('requests', 0)})"
         else:
             goodput = "-"
+        # step anatomy (utils/step_anatomy.py via resource_snapshot): STEP =
+        # host-side fraction of attributed engine time + the decode-window
+        # dispatch cadence p50; ROOF = HBM floor over measured decode seconds
+        # (the r5 "69.8% of roofline" number, live). Pre-plane workers: "-"
+        anat = res.get("step_anatomy") or {}
+        step = "-"
+        if anat.get("host_frac") is not None:
+            step = f"h{100.0 * anat['host_frac']:.0f}%"
+            gap = anat.get("dispatch_gap_ms_p50")
+            if gap is not None:
+                step = f"{step} {gap:.1f}ms"
+        roof = (
+            f"{100.0 * anat['roofline_frac']:.0f}%"
+            if anat.get("roofline_frac") is not None else "-"
+        )
         hb = health.get("heartbeat_age_s")
         stale_mark = " STALE" if w.get("stale") else ""
         lines.append(
@@ -137,7 +153,7 @@ def render_status(doc: dict) -> str:
             f"{(f'{hb:.1f}s' if hb is not None else '-'):>6} "
             f"{w.get('last_seen_s', 0):>5.1f}s {w.get('missed_scrapes', 0):>4} "
             f"{slots:>7} {kv_pct:>5.1f}% {kv_mem:>11} {prefix:>9} {spec:>10} "
-            f"{lora:>11} {goodput:>9} "
+            f"{lora:>11} {goodput:>9} {step:>11} {roof:>5} "
             f"{kv.get('num_requests_waiting', 0):>5} "
             f"{_fmt_bytes(res.get('hbm_bytes_in_use', 0)):>9} "
             f"{res.get('xla_compiles', 0):>5}  {_slo_cell(w.get('slo'))}"

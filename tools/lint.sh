@@ -15,6 +15,10 @@ cd "$(dirname "$0")/.."
 # surface without a cluster (promtool-style conformance; no egress needed)
 JAX_PLATFORMS=cpu python -m dynamo_tpu.utils.prometheus --check
 
+# bench regression gate self-check: the compare tool must flag a synthetic
+# regression and pass an identical pair (pure stdlib, no cluster)
+python tools/bench_compare.py --self-check
+
 if command -v ruff >/dev/null 2>&1; then
     exec ruff check dynamo_tpu tests tools bench.py
 fi

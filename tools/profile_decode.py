@@ -109,13 +109,17 @@ def main():
     tB = best_wall(lambda: model_only(64))
     per_model = (tB - tA) / 56
 
-    flat = jax.tree_util.tree_leaves(runner.params)
-    total_bytes = sum(l.size * l.dtype.itemsize for l in flat)
-    kv_bytes = (
-        B * pages_per_seq * PS * model.config.num_kv_heads * model.config.head_dim
-        * 2 * 2 * model.config.num_layers
-    )
-    floor = (total_bytes + kv_bytes) / 819e9
+    # bytes-moved floor from the SHARED estimator (utils/step_anatomy.py) —
+    # the same arithmetic the live dynamo_engine_roofline_fraction gauge and
+    # the bench step_anatomy section use, so this one-off tool and the
+    # standing plane can never disagree on what "the roofline" means
+    from dynamo_tpu.utils.step_anatomy import roofline_for_runner
+
+    roof = roofline_for_runner(runner, cfg)
+    if roof is None:
+        raise SystemExit("runner/model cannot price the roofline")
+    live_pages = B * pages_per_seq
+    floor = roof.step_floor_seconds(live_pages)
     out = {
         "B": B, "page_size": PS, "ctx": ctx,
         "per_step_ms": {
@@ -126,8 +130,9 @@ def main():
         "window_tok_s": round(B / per_window, 1),
         "hbm_floor_ms": round(floor * 1e3, 3),
         "pct_of_roofline": round(100 * floor / per_window, 1),
-        "param_bytes": total_bytes,
-        "kv_bytes_per_step": kv_bytes,
+        "param_bytes": roof.param_bytes,
+        "kv_bytes_per_step": live_pages * roof.page_bytes,
+        "hbm_bw_bytes_s": roof.hbm_bw,
     }
     print(out)
 
