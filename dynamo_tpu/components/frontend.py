@@ -15,7 +15,6 @@ from __future__ import annotations
 import asyncio
 from typing import AsyncIterator, Optional
 
-import msgpack
 
 from dynamo_tpu.llm.http.service import HttpService, ModelPipeline
 from dynamo_tpu.llm.model_registry import MODELS_PREFIX, ModelEntry

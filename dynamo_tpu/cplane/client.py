@@ -12,7 +12,7 @@ import asyncio
 import itertools
 import secrets
 import uuid
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, AsyncIterator, Callable, Optional
 
 from dynamo_tpu.cplane.wire import read_frame, write_frame

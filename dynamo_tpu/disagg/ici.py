@@ -20,7 +20,6 @@ adopts it.
 from __future__ import annotations
 
 import threading
-from typing import Optional
 
 import time
 

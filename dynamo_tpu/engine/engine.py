@@ -15,7 +15,7 @@ import threading
 import time
 
 import numpy as np
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import AsyncIterator, Callable, Optional
 
 from dynamo_tpu.engine.config import EngineConfig

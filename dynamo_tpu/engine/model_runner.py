@@ -20,7 +20,6 @@ TPU execution notes:
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax
@@ -917,7 +916,7 @@ class ModelRunner:
         if not sample:
             return None
         if sync:
-            return int(jax.device_get(tok))
+            return int(jax.device_get(tok))  # graftlint: sync-ok sync chunk path: caller asked for the token synchronously
         try:
             tok.copy_to_host_async()
             if lp is not None:
@@ -970,7 +969,7 @@ class ModelRunner:
                 segments[offset : offset + n] = idx
             spans.append((offset, n))
             offset += n
-        emb = np.asarray(
+        emb = np.asarray(  # graftlint: sync-ok vision embeds materialize once per request at admission
             jax.device_get(
                 self._encode_images(
                     self.params,
@@ -1259,7 +1258,7 @@ class ModelRunner:
             sh["zeros_i"], sh["pt"], sh["inactive"], sh["zeros_i"],
             sh["temps"], sh["zeros_i"], sh["ones_f"], K,
         )
-        jax.block_until_ready(out)
+        jax.block_until_ready(out)  # graftlint: sync-ok warmup: compile gate, not serving traffic
         spec = self.config.spec
         if spec is not None:
             # one verify executable per configured k (all slots inactive, KV
@@ -1277,7 +1276,7 @@ class ModelRunner:
                 np.zeros((B, spec.k + 1), np.int32), sh["zeros_i"],
                 sh["temps"], sh["zeros_i"], sh["ones_f"], draft_probs=dp,
             )
-            jax.block_until_ready(out)
+            jax.block_until_ready(out)  # graftlint: sync-ok warmup: compile gate, not serving traffic
         for b in self.config.prefill_buckets:
             if not self.packed_prefill_mode:
                 self.prefill_chunk(
@@ -1296,7 +1295,7 @@ class ModelRunner:
             )
             for N in {1, self.config.lanes_for(b)}:
                 out = self.prefill_chunk_batch([lane], N=N)
-                jax.block_until_ready(out)
+                jax.block_until_ready(out)  # graftlint: sync-ok warmup: compile gate, not serving traffic
         log.info("warmup(core): compiled in %.1fs", _time.monotonic() - t0)
 
     def warmup_extra_thunks(self) -> list:
@@ -1315,7 +1314,7 @@ class ModelRunner:
                     sh["zeros_i"], sh["pt"], sh["inactive"], sh["zeros_i"],
                     sh["temps"], sh["zeros_i"], sh["ones_f"], K, **kwargs,
                 )
-                jax.block_until_ready(out)
+                jax.block_until_ready(out)  # graftlint: sync-ok warmup: compile gate, not serving traffic
             return run
 
         for kwargs in (
@@ -1334,7 +1333,7 @@ class ModelRunner:
                     eos_ids=(0,) if sampling is not None else None,
                 )
                 if want_lp:
-                    jax.block_until_ready(out)
+                    jax.block_until_ready(out)  # graftlint: sync-ok warmup: compile gate, not serving traffic
             return run
 
         def packed(bucket, N, sampling, want_lp):
@@ -1346,7 +1345,7 @@ class ModelRunner:
                     sampling is not None,
                 )
                 out = self.prefill_chunk_batch([lane], N=N, want_logprobs=want_lp)
-                jax.block_until_ready(out)
+                jax.block_until_ready(out)  # graftlint: sync-ok warmup: compile gate, not serving traffic
             return run
 
         bucket = self.config.prefill_buckets[0]
@@ -1402,7 +1401,7 @@ class ModelRunner:
                     shw["zeros_i"], shw["pt"], shw["inactive"], shw["zeros_i"],
                     shw["temps"], shw["zeros_i"], shw["ones_f"], K,
                 )
-                jax.block_until_ready(out)
+                jax.block_until_ready(out)  # graftlint: sync-ok warmup: compile gate, not serving traffic
             return run
 
         def wide_chunk(width, b):
@@ -1414,7 +1413,7 @@ class ModelRunner:
                         SamplingParams(temperature=0.0), (), False,
                     )
                     out = self.prefill_chunk_batch([lane], N=1)
-                    jax.block_until_ready(out)
+                    jax.block_until_ready(out)  # graftlint: sync-ok warmup: compile gate, not serving traffic
                 else:
                     self.prefill_chunk(
                         np.zeros(b, np.int32), 0, pt, sample=True,
@@ -1443,7 +1442,7 @@ class ModelRunner:
         The device gather runs jitted; the host copy is the DCN-transfer
         staging step (same-pod ICI transfers use extract_pages_device).
         """
-        return jax.tree.map(np.asarray, jax.device_get(self.extract_pages_device(page_ids)))
+        return jax.tree.map(np.asarray, jax.device_get(self.extract_pages_device(page_ids)))  # graftlint: sync-ok DCN staging: deliberate D2H export priced by kv_stream metrics
 
     def extract_pages_async(self, page_ids: np.ndarray):
         """Chunk-streamed export: dispatch the device gather NOW (on the
@@ -1462,7 +1461,7 @@ class ModelRunner:
             pool = self._d2h_pool = ThreadPoolExecutor(
                 max_workers=2, thread_name_prefix="kv-d2h"
             )
-        return pool.submit(lambda: jax.tree.map(np.asarray, jax.device_get(dev)))
+        return pool.submit(lambda: jax.tree.map(np.asarray, jax.device_get(dev)))  # graftlint: sync-ok D2H resolved on the side pool, engine thread stays free
 
     def inject_pages_bucketed(self, page_ids: np.ndarray, data, axis=None) -> None:
         """Scatter a PARTIAL run of pages, padded to a power-of-two id count
@@ -1577,4 +1576,4 @@ class ModelRunner:
         toks = self.dispatch_decode_window(
             positions, page_tables, active, limits, temps, top_ks, top_ps, num_steps
         )
-        return np.asarray(jax.device_get(toks))[:, :B]
+        return np.asarray(jax.device_get(toks))[:, :B]  # graftlint: sync-ok sync decode helper for bench/tests, not the serving loop

@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import time
 from collections import OrderedDict
-from typing import Optional
 
 import numpy as np
 

@@ -6,7 +6,7 @@ of requests (no recompiles on parameter changes, XLA-friendly static shapes).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional, Sequence
 
 import jax

@@ -1397,7 +1397,7 @@ class Scheduler:
             min_ps=min_ps, seeds=seeds if np.any(seeds) else None,
         )
         t_disp = time.monotonic()
-        toks = np.asarray(toks_dev)
+        toks = np.asarray(toks_dev)  # graftlint: sync-ok draft reconcile point priced by step_anatomy device_wait
         dt = time.monotonic() - t0
         self.stage.spec_draft_calls += 1
         self.stage.spec_draft_s += dt
@@ -1547,8 +1547,8 @@ class Scheduler:
             lora_slots=lora_slots if np.any(lora_slots) else None,
         )
         t_disp = time.monotonic()
-        tokens = np.asarray(out_dev)
-        n_emit = np.asarray(n_emit_dev)
+        tokens = np.asarray(out_dev)  # graftlint: sync-ok verify reconcile point priced by step_anatomy device_wait
+        n_emit = np.asarray(n_emit_dev)  # graftlint: sync-ok verify reconcile: n_emit rides the same resolved dispatch
         dt = time.monotonic() - t0
         st = self.stage
         st.spec_rounds += 1
@@ -1791,7 +1791,7 @@ class Scheduler:
                 break
             self.in_flight.popleft()
             t0 = time.monotonic()
-            data = np.asarray(entry.dev)
+            data = np.asarray(entry.dev)  # graftlint: sync-ok THE priced reconcile point: step_anatomy device_wait source
             if not ready:
                 # host actually blocked on the device: the sync wait the
                 # dispatch-ahead pipeline exists to hide

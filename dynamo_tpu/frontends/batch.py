@@ -19,7 +19,10 @@ async def run_batch(engine, args, input_path: str) -> None:
     card = card_for_model(args.model, getattr(args, "max_model_len", None))
     pipeline = build_pipeline(engine, card)
     prompts = []
-    for line in Path(input_path).read_text().splitlines():
+    # file I/O off-loop: a colocated engine shares this event loop, and a
+    # multi-MB batch file read would stall its dispatch cadence
+    text = await asyncio.to_thread(Path(input_path).read_text)
+    for line in text.splitlines():
         line = line.strip()
         if line:
             prompts.append(json.loads(line))
@@ -58,9 +61,8 @@ async def run_batch(engine, args, input_path: str) -> None:
     elapsed = time.monotonic() - t_start
 
     out_path = Path(input_path).with_suffix(".out.jsonl")
-    with out_path.open("w") as f:
-        for r in results:
-            f.write(json.dumps(r) + "\n")
+    payload = "".join(json.dumps(r) + "\n" for r in results)
+    await asyncio.to_thread(out_path.write_text, payload)
 
     total_out = sum(r["tokens_out"] for r in results)
     lat = np.array([r["latency_s"] for r in results])

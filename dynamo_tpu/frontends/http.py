@@ -2,7 +2,6 @@
 
 from __future__ import annotations
 
-import asyncio
 
 from dynamo_tpu.frontends.pipeline import build_pipeline, card_for_model
 from dynamo_tpu.llm.http.service import HttpService

@@ -14,7 +14,6 @@ Mirrors the reference launcher's dyn:// in/out modes
 
 from __future__ import annotations
 
-import asyncio
 from typing import AsyncIterator
 
 from dynamo_tpu.engine.scheduler import EngineRequest, StepOutput

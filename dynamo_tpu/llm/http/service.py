@@ -11,7 +11,6 @@ from __future__ import annotations
 import asyncio
 import json
 import time
-from dataclasses import dataclass
 from typing import AsyncIterator, Callable, Optional
 
 from aiohttp import web

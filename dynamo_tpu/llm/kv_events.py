@@ -10,7 +10,7 @@ identity (we use the chained sequence hash).
 from __future__ import annotations
 
 import itertools
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
 _event_counter = itertools.count()

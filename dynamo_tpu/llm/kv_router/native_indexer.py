@@ -12,7 +12,6 @@ import ctypes
 import os
 from typing import Optional, Sequence
 
-from dynamo_tpu.llm.kv_events import KvCacheEvent
 from dynamo_tpu.llm.kv_router.indexer import OverlapScores, RouterEvent, WorkerId
 from dynamo_tpu.utils import get_logger
 

@@ -14,7 +14,7 @@ from typing import Optional, Sequence
 
 from dynamo_tpu.llm.kv_router.indexer import KvIndexer, OverlapScores, RouterEvent
 from dynamo_tpu.llm.kv_router.metrics_aggregator import KvMetricsAggregator
-from dynamo_tpu.llm.kv_router.scheduler import KVHitRateEvent, KvScheduler, WorkerLoad
+from dynamo_tpu.llm.kv_router.scheduler import KVHitRateEvent, KvScheduler
 from dynamo_tpu.llm.tokens import compute_block_hash
 from dynamo_tpu.runtime.component import INSTANCE_PREFIX
 from dynamo_tpu.utils import get_logger

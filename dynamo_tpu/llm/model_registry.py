@@ -10,7 +10,7 @@ service/discovery.rs:1-145). Keys:
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
 import msgpack

@@ -9,8 +9,7 @@ the ICI/DCN replacement for NIXL RDMA WRITE + notification.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Optional
+from dataclasses import dataclass
 
 import numpy as np
 

@@ -155,7 +155,7 @@ def merge_adapter_into_params(model, params: dict, tree: dict, scale: float) -> 
     mixed-vs-alone instead."""
     import jax
 
-    params = jax.tree.map(np.asarray, jax.device_get(params))
+    params = jax.tree.map(np.asarray, jax.device_get(params))  # graftlint: sync-ok test/bench reference merge on host, not the serving loop
     layers = dict(params["layers"])
     for m, entry in tree.items():
         w = np.asarray(layers[m], np.float32)

@@ -32,7 +32,7 @@ position-rotated shared rope key, i.e. exactly what the absorbed score needs.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, replace
 from typing import Any, Optional
 
 import jax

@@ -10,7 +10,6 @@ single source of truth.
 
 from __future__ import annotations
 
-import json
 from pathlib import Path
 
 import numpy as np

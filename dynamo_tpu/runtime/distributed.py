@@ -8,7 +8,6 @@ expiry => shutdown, shutdown => lease revoke) + lazy TCP response-plane server
 
 from __future__ import annotations
 
-import asyncio
 import os
 from typing import Optional
 

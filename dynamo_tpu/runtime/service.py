@@ -10,7 +10,6 @@ from __future__ import annotations
 import asyncio
 import uuid
 from dataclasses import dataclass, field
-from typing import Any
 
 from dynamo_tpu.utils import get_logger
 

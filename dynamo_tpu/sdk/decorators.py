@@ -7,7 +7,7 @@ lib/decorators.py:27-59 (@dynamo_endpoint, @async_on_start).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Callable, Optional
+from typing import Callable, Optional
 
 
 @dataclass

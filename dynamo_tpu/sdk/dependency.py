@@ -5,7 +5,7 @@ reference: deploy/dynamo/sdk/src/dynamo/sdk/lib/dependency.py:28-80.
 
 from __future__ import annotations
 
-from typing import Any, AsyncIterator, Optional
+from typing import Any, AsyncIterator
 
 
 class DynamoClient:

@@ -8,7 +8,6 @@ methods, runs @async_on_start hooks, then parks until shutdown.
 from __future__ import annotations
 
 import argparse
-import asyncio
 import importlib
 import inspect
 

@@ -309,7 +309,7 @@ class DraftModelRunner:
             np.ones(B, np.int32), np.zeros(B, np.float32),
             np.zeros(B, np.int32), np.ones(B, np.float32),
         )
-        jax.block_until_ready(out[0])
+        jax.block_until_ready(out[0])  # graftlint: sync-ok warmup: compile gate, not serving traffic
         b = self.config.prefill_buckets[0]
         ints = np.zeros(b + W + 2, np.int32)
         ints[b + W + 1] = 1

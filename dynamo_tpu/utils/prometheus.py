@@ -14,9 +14,110 @@ new producer can't drift from what the checker enforces.
 from __future__ import annotations
 
 import threading
-from typing import Iterable, Optional, Sequence
+from typing import Iterable, Sequence
 
 _TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
+
+#: The conformance surface for every ``dynamo_*`` metric family this system
+#: exposes. Three planes pin each other through this one tuple:
+#:   - ``--check`` (the lint gate) asserts the families RENDERED by
+#:     ``_sample_surfaces()`` equal this set exactly — a new emitter must
+#:     declare itself here, a removed one must be deleted here;
+#:   - ``tools/graftlint`` (metric-conformance detector) statically checks
+#:     every ``dynamo_*`` string literal at an emitting site against this
+#:     tuple, and that every name here is referenced by some emitter;
+#:   - the exposition tests ride the same ``_sample_surfaces()`` list.
+#: So a metric-name typo, a family renamed on one side only, or a dead
+#: declaration all fail CI before any cluster exists. Keep one name per line
+#: (graftlint suppressions are per-line).
+DECLARED_METRIC_FAMILIES: tuple = (
+    "dynamo_engine_context_chunk_total",
+    "dynamo_engine_context_table_dispatch_total",
+    "dynamo_engine_context_table_promotions_total",
+    "dynamo_engine_decode_window_dispatch_seconds",
+    "dynamo_engine_goodput_itl_p99_seconds",
+    "dynamo_engine_goodput_ratio",
+    "dynamo_engine_goodput_requests_total",
+    "dynamo_engine_goodput_ttft_p99_seconds",
+    "dynamo_engine_hbm_bytes",
+    "dynamo_engine_kv_cache_bytes",
+    "dynamo_engine_kv_cache_page_bytes",
+    "dynamo_engine_kv_pages",
+    "dynamo_engine_offload_blocks_total",
+    "dynamo_engine_offload_bytes_resident",
+    "dynamo_engine_offload_pressure_blocks_total",
+    "dynamo_engine_preemptions_total",
+    "dynamo_engine_prefill_seconds",
+    "dynamo_engine_prefix_cache_blocks_total",
+    "dynamo_engine_pressure_drains_total",
+    "dynamo_engine_queue_wait_seconds",
+    "dynamo_engine_reconcile_wait_seconds",
+    "dynamo_engine_roofline_fraction",
+    "dynamo_engine_slo_latency_seconds",
+    "dynamo_engine_slo_violations_total",
+    "dynamo_engine_stage_seconds_total",
+    "dynamo_engine_ttft_seconds",
+    "dynamo_engine_xla_compile_seconds_total",
+    "dynamo_engine_xla_compiles_total",
+    "dynamo_goodput_itl_p99_seconds",
+    "dynamo_goodput_ratio",
+    "dynamo_goodput_requests_total",
+    "dynamo_goodput_tenant_ratio",
+    "dynamo_goodput_ttft_p99_seconds",
+    "dynamo_health_heartbeat_age_seconds",
+    "dynamo_health_state",
+    "dynamo_health_uptime_seconds",
+    "dynamo_kv_stream_bytes_received_total",
+    "dynamo_kv_stream_bytes_sent_total",
+    "dynamo_kv_stream_checksum_failures_total",
+    "dynamo_kv_stream_dropped_total",
+    "dynamo_kv_stream_lanes",
+    "dynamo_kv_stream_overlap_seconds_total",
+    "dynamo_kv_stream_part_bytes",
+    "dynamo_kv_stream_parts_received_total",
+    "dynamo_kv_stream_parts_sent_total",
+    "dynamo_kv_stream_rejected_total",
+    "dynamo_kv_stream_requests_total",
+    "dynamo_kv_stream_send_seconds_total",
+    "dynamo_kv_stream_transfers_received_total",
+    "dynamo_lora_evictions_total",
+    "dynamo_lora_load_seconds_total",
+    "dynamo_lora_loads_total",
+    "dynamo_lora_requests_total",
+    "dynamo_lora_slots",
+    "dynamo_prefix_fetch_blocks_total",
+    "dynamo_prefix_fetch_bytes_total",
+    "dynamo_prefix_fetch_client_blocks_total",
+    "dynamo_prefix_fetch_client_bytes_total",
+    "dynamo_prefix_fetch_client_requests_total",
+    "dynamo_prefix_fetch_client_seconds",
+    "dynamo_prefix_fetch_requests_total",
+    "dynamo_prefix_fetch_seconds",
+    "dynamo_prefix_fetch_served_blocks_total",
+    "dynamo_prefix_fetch_served_bytes_total",
+    "dynamo_prefix_fetch_served_total",
+    "dynamo_prefix_fetch_tokens_total",
+    "dynamo_replay_inflight_requests",
+    "dynamo_replay_requests_total",
+    "dynamo_replay_schedule_lag_seconds",
+    "dynamo_replay_tokens_total",
+    "dynamo_slo_compliance_ratio",
+    "dynamo_slo_error_budget_remaining",
+    "dynamo_slo_latency_seconds",
+    "dynamo_slo_target_seconds",
+    "dynamo_slo_violations_total",
+    "dynamo_spec_acceptance_ratio",
+    "dynamo_spec_accepted_per_round",
+    "dynamo_spec_accepted_total",
+    "dynamo_spec_draft_dispatch_total",
+    "dynamo_spec_draft_pages",
+    "dynamo_spec_draft_prefill_total",
+    "dynamo_spec_draft_seconds_total",
+    "dynamo_spec_proposed_total",
+    "dynamo_step_dispatch_total",
+    "dynamo_step_host_fraction",
+    "dynamo_step_seconds_total",
+)
 
 
 def fmt_value(v) -> str:
@@ -336,16 +437,31 @@ def _sample_surfaces() -> list[tuple[str, str]]:
                 "hot": "a1",
             }
 
+    class _CompileMonitor:  # shape resource_snapshot actually reads
+        def snapshot(self):
+            return {"compiles": 3, "compile_s": 0.82}
+
     class _SpecRunner:  # shape resource_snapshot actually reads
         draft = _DraftPool()
         lora_store = _LoraStore()
         model = None
-        compile_monitor = None
+        compile_monitor = _CompileMonitor()
 
         def hbm_stats(self):
             return {}
 
     eng.runner = _SpecRunner()
+
+    class _Offload:  # shape resource_snapshot actually reads: puts the
+        # dynamo_engine_offload_* families on the conformance surface
+        saves, loads, drops = 4, 2, 1
+        capacity_blocks, block_bytes, bytes_resident = 64, 4096, 8192
+        transfer_s = 0.003
+
+        def __len__(self):
+            return 2
+
+    eng.offload = _Offload()
     # step-anatomy families (dynamo_step_* + dynamo_engine_roofline_fraction):
     # seed one priced decode window + a LoRA slot load so every family —
     # including the roofline gauge, which only renders once a floor-priced
@@ -431,14 +547,42 @@ def _sample_surfaces() -> list[tuple[str, str]]:
     return surfaces
 
 
+def _declaration_problems(surfaces: list[tuple[str, str]]) -> list[str]:
+    """Cross-validate DECLARED_METRIC_FAMILIES against the families actually
+    RENDERED by the sample surfaces: exact set equality, both directions.
+    This is the runtime half of the metric-conformance contract; the static
+    half (literals at emitting sites vs the same tuple) is graftlint's
+    metric-conformance detector."""
+    rendered: set[str] = set()
+    for _, text in surfaces:
+        for line in text.splitlines():
+            if line.startswith("# TYPE dynamo_"):
+                rendered.add(line.split()[2])
+    declared = set(DECLARED_METRIC_FAMILIES)
+    problems = []
+    for fam in sorted(rendered - declared):
+        problems.append(
+            f"rendered family {fam} is not in DECLARED_METRIC_FAMILIES"
+        )
+    for fam in sorted(declared - rendered):
+        problems.append(
+            f"declared family {fam} is rendered by no sample surface — "
+            "seed it in _sample_surfaces or delete the declaration"
+        )
+    return problems
+
+
 def self_check() -> list[str]:
-    """check_exposition over every cluster-free sample surface; returns the
-    flattened problem list (empty = all conformant)."""
+    """check_exposition over every cluster-free sample surface, plus the
+    declared-vs-rendered family cross-validation; returns the flattened
+    problem list (empty = all conformant)."""
     problems: list[str] = []
-    for name, text in _sample_surfaces():
+    surfaces = _sample_surfaces()
+    for name, text in surfaces:
         problems.extend(f"{name}: {p}" for p in check_exposition(text))
         if not text.strip():
             problems.append(f"{name}: rendered empty exposition")
+    problems.extend(_declaration_problems(surfaces))
     return problems
 
 
@@ -454,17 +598,16 @@ def _main(argv=None) -> int:
     if not args.check:
         p.print_help()
         return 2
-    surfaces = _sample_surfaces()
-    problems: list[str] = []
-    for name, text in surfaces:
-        problems.extend(f"{name}: {p}" for p in check_exposition(text))
-        if not text.strip():
-            problems.append(f"{name}: rendered empty exposition")
+    problems = self_check()
     for prob in problems:
         print(f"FAIL {prob}")
     if problems:
         return 1
-    print(f"ok: {len(surfaces)} exposition surfaces conformant")
+    print(
+        f"ok: exposition surfaces conformant; "
+        f"{len(DECLARED_METRIC_FAMILIES)} declared dynamo_* families match "
+        "the rendered set"
+    )
     return 0
 
 

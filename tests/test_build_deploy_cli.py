@@ -5,7 +5,6 @@ import asyncio
 import json
 import threading
 
-import pytest
 import yaml
 
 from dynamo_tpu.deploy.api_server import DeployApiServer

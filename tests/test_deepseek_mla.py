@@ -7,7 +7,6 @@ Also checks the headline property: the latent cache is an order of magnitude
 smaller per token than an equivalent full-KV cache.
 """
 
-import json
 
 import jax
 import jax.numpy as jnp

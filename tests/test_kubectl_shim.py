@@ -13,7 +13,6 @@ import asyncio
 import json
 import os
 import stat
-import sys
 
 import pytest
 

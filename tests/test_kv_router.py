@@ -15,7 +15,6 @@ from dynamo_tpu.llm.kv_router.scheduler import (
     WorkerLoad,
     select_worker,
 )
-from dynamo_tpu.llm.tokens import compute_block_hash_for_seq
 
 BS = 4  # kv block size
 

@@ -6,7 +6,6 @@ NATS kv_events -> indexer, SURVEY.md §3.4)."""
 
 import asyncio
 import ctypes
-import subprocess
 import sys
 from pathlib import Path
 

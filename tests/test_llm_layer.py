@@ -5,7 +5,6 @@ import asyncio
 
 import pytest
 
-from dynamo_tpu.engine.sampling import SamplingParams
 from dynamo_tpu.engine.scheduler import EngineRequest, StepOutput
 from dynamo_tpu.llm.backend import Backend
 from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor

@@ -7,18 +7,7 @@ import json
 
 import pytest
 
-from dynamo_tpu.loadgen import (
-    BUILTIN_SCENARIOS,
-    ScenarioSpec,
-    compile_trace,
-    dumps_jsonl,
-    load_scenario,
-    load_scenarios_yaml,
-    read_jsonl,
-    trace_digest,
-    trace_summary,
-    write_jsonl,
-)
+from dynamo_tpu.loadgen import BUILTIN_SCENARIOS, ScenarioSpec, compile_trace, dumps_jsonl, load_scenario, load_scenarios_yaml, read_jsonl, trace_digest, write_jsonl
 from dynamo_tpu.loadgen.__main__ import main as loadgen_main
 from dynamo_tpu.loadgen.replay import ReplayMetrics
 from dynamo_tpu.loadgen.report import render_report

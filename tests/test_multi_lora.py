@@ -25,15 +25,7 @@ import jax.numpy as jnp
 
 from dynamo_tpu.engine.sampling import SamplingParams
 from dynamo_tpu.engine.scheduler import EngineRequest
-from dynamo_tpu.lora import (
-    LORA_MODULES,
-    init_lora_pool,
-    lora_uid,
-    merge_adapter_into_params,
-    module_dims,
-    parse_adapter_specs,
-    synth_adapter,
-)
+from dynamo_tpu.lora import init_lora_pool, lora_uid, merge_adapter_into_params, module_dims, parse_adapter_specs, synth_adapter
 
 from tests.test_engine import tiny_engine_config
 

@@ -4,12 +4,7 @@
 import asyncio
 import json
 
-from dynamo_tpu.components.planner import (
-    Planner,
-    PlannerService,
-    PoolPolicy,
-    desired_replicas_key,
-)
+from dynamo_tpu.components.planner import Planner, PlannerService, PoolPolicy
 from dynamo_tpu.llm.kv_router.scheduler import WorkerLoad
 
 

@@ -10,16 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from dynamo_tpu.llm.multimodal import (
-    ImageInput,
-    extract_content_parts,
-    image_content_hash,
-    mrope_positions,
-    patchify,
-    smart_resize,
-    tokenize_with_images,
-    virtual_token_ids,
-)
+from dynamo_tpu.llm.multimodal import ImageInput, image_content_hash, mrope_positions, patchify, smart_resize, virtual_token_ids
 from dynamo_tpu.models.qwen2_vl import Qwen2VLConfig, Qwen2VLModel
 from dynamo_tpu.ops.norms import rms_norm
 from dynamo_tpu.ops.rotary import apply_mrope, apply_rope

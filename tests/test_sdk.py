@@ -132,7 +132,6 @@ def test_serve_supervisor_agg_graph(tmp_path):
 
 
 def test_build_mesh_axes():
-    import jax
 
     from dynamo_tpu.parallel.mesh import MeshConfig, build_mesh
 

@@ -1,0 +1,21 @@
+"""graftlint: JAX/asyncio-aware static analysis that gates the hot path.
+
+The runtime planes (``utils/step_anatomy.py``, ``utils/compile_monitor.py``,
+``utils/slo.py``) *price* host syncs, recompile storms and event-loop stalls
+after they cost milliseconds; graftlint makes the same hazard classes
+machine-checked on every PR, before they ship. Stdlib-only (ast + json + re)
+so the no-egress CI image runs it with a bare interpreter; wired into
+``tools/lint.sh`` between the prometheus conformance check and ruff.
+
+    python -m tools.graftlint               # repo scan (exit 1 on findings)
+    python -m tools.graftlint --self-check  # detectors vs seeded fixtures
+
+See ``tools/graftlint/detectors/__init__.py`` for the catalogue and
+ARCHITECTURE.md ("The lint gate") for how this relates to the runtime
+measurement planes.
+"""
+
+from tools.graftlint.cli import main, run_scan
+from tools.graftlint.core import Finding, ScanContext, SourceFile
+
+__all__ = ["Finding", "ScanContext", "SourceFile", "main", "run_scan"]

@@ -1,0 +1,5 @@
+"""``python -m tools.graftlint`` — see cli.py for flags and exit codes."""
+
+from tools.graftlint.cli import main
+
+raise SystemExit(main())

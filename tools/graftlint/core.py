@@ -1,0 +1,222 @@
+"""graftlint core: source model, findings, suppressions, baseline.
+
+The runtime planes (``utils/step_anatomy.py``, ``utils/compile_monitor.py``)
+discover host syncs and recompile storms *after* they cost milliseconds;
+graftlint makes the same hazard classes machine-checked before merge. This
+module is the rule-agnostic substrate: parsed source files with a per-line
+suppression index, the Finding record every detector emits, and the baseline
+(acknowledged-debt) bookkeeping. Pure stdlib — the no-egress CI image runs it
+with nothing but a Python interpreter.
+
+Suppression syntax (one hazard class per token, reason REQUIRED):
+
+    np.asarray(toks_dev)  # graftlint: sync-ok priced reconcile point
+
+A suppression on the flagged line, the line above it, or any line of a
+multi-line expression covers that expression. A suppression without a reason
+does not suppress — it becomes its own finding, so the allowlist stays
+self-documenting.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+
+#: rule id -> suppression token (``# graftlint: <token>-ok <reason>``)
+SUPPRESS_TOKENS = {
+    "host-sync": "sync",
+    "use-after-donation": "donation",
+    "recompile-hazard": "recompile",
+    "async-blocking": "blocking",
+    "metric-conformance": "metric",
+}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*(sync|donation|recompile|blocking|metric)-ok"
+    r"(?:[ \t]+(\S.*?))?\s*$"
+)
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str  # repo-relative posix path
+    line: int
+    message: str
+    func: str = "<module>"  # enclosing function qualname-ish, for fingerprints
+    suppressed: bool = False
+    suppress_reason: str = ""
+    baselined: bool = False
+
+    @property
+    def fingerprint(self) -> str:
+        # line numbers drift with every edit; (rule, file, function, message)
+        # survives unrelated churn, which is what a baseline entry needs
+        return f"{self.rule}|{self.path}|{self.func}|{self.message}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class SourceFile:
+    path: str
+    abspath: Path
+    text: str
+    tree: ast.AST
+    lines: list[str]
+    #: 1-based line -> (token, reason) for every graftlint suppression comment
+    suppressions: dict[int, tuple[str, str]] = field(default_factory=dict)
+    parents: dict[int, ast.AST] = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, abspath: Path, root: Path) -> "SourceFile":
+        text = abspath.read_text()
+        tree = ast.parse(text, filename=str(abspath))
+        sf = cls(
+            path=abspath.relative_to(root).as_posix(),
+            abspath=abspath,
+            text=text,
+            tree=tree,
+            lines=text.splitlines(),
+        )
+        for lineno, line in enumerate(sf.lines, 1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                sf.suppressions[lineno] = (m.group(1), (m.group(2) or "").strip())
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                sf.parents[id(child)] = node
+        return sf
+
+    def suppression_for(self, rule: str, node: ast.AST) -> tuple[bool, str]:
+        """(suppressed, reason) for ``rule`` at ``node``: the token may sit on
+        the line above the expression or on any of its own lines."""
+        token = SUPPRESS_TOKENS[rule]
+        first = getattr(node, "lineno", 1)
+        last = getattr(node, "end_lineno", first) or first
+        for lineno in range(first - 1, last + 1):
+            entry = self.suppressions.get(lineno)
+            if entry and entry[0] == token:
+                return True, entry[1]
+        return False, ""
+
+    def stmt_of(self, node: ast.AST) -> ast.stmt:
+        """Smallest statement containing ``node`` (node itself if a stmt)."""
+        cur = node
+        while cur is not None and not isinstance(cur, ast.stmt):
+            cur = self.parents.get(id(cur))
+        return cur if cur is not None else node
+
+
+@dataclass
+class ScanContext:
+    """Shared scan state handed to every detector."""
+
+    root: Path
+    #: treat every scanned file as hot-path (the self-check fixtures opt in;
+    #: the repo scan scopes host-sync to HOT_DIRS)
+    force_hot: bool = False
+
+
+def make_finding(
+    sf: SourceFile, rule: str, node: ast.AST, message: str, func: str = "<module>"
+) -> list[Finding]:
+    """One finding at ``node``, honoring suppressions. A suppression with an
+    empty reason yields a replacement finding instead of silence."""
+    suppressed, reason = sf.suppression_for(rule, node)
+    f = Finding(
+        rule=rule,
+        path=sf.path,
+        line=getattr(node, "lineno", 1),
+        message=message,
+        func=func,
+        suppressed=suppressed,
+        suppress_reason=reason,
+    )
+    if suppressed and not reason:
+        return [
+            Finding(
+                rule=rule,
+                path=sf.path,
+                line=f.line,
+                message=f"suppression without a reason (was: {message})",
+                func=func,
+            )
+        ]
+    return [f]
+
+
+def enclosing_func(sf: SourceFile, node: ast.AST) -> str:
+    parts: list[str] = []
+    cur = sf.parents.get(id(node))
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            parts.append(cur.name)
+        cur = sf.parents.get(id(cur))
+    return ".".join(reversed(parts)) or "<module>"
+
+
+# ---------------- file walking ----------------
+
+EXCLUDE_DIR_NAMES = {"__pycache__", ".git", "fixtures"}
+
+
+def iter_python_files(paths: list[Path], root: Path) -> list[Path]:
+    """Every .py under ``paths`` (files or directories), excluding pycache and
+    the graftlint fixtures tree (seeded violations must not fail the repo
+    scan)."""
+    out: list[Path] = []
+    for p in paths:
+        if p.is_file() and p.suffix == ".py":
+            out.append(p)
+        elif p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if any(part in EXCLUDE_DIR_NAMES for part in f.parts):
+                    continue
+                out.append(f)
+    seen: set[Path] = set()
+    uniq = []
+    for f in out:
+        if f not in seen:
+            seen.add(f)
+            uniq.append(f)
+    return uniq
+
+
+# ---------------- baseline ----------------
+
+
+def load_baseline(path: Path) -> set[str]:
+    """Fingerprint set from the acknowledged-debt baseline file. Missing file
+    = empty baseline (the gate starts strict)."""
+    if not path.exists():
+        return set()
+    data = json.loads(path.read_text())
+    return {e["fingerprint"] for e in data.get("findings", [])}
+
+
+def write_baseline(path: Path, findings: list[Finding]) -> None:
+    data = {
+        "_comment": (
+            "graftlint acknowledged-debt baseline: findings listed here are "
+            "reported as 'baselined' and do not fail the gate. Fingerprints "
+            "are (rule|path|function|message) — stable across line drift. "
+            "Regenerate with: python -m tools.graftlint --write-baseline"
+        ),
+        "findings": [
+            {"fingerprint": f.fingerprint, "line": f.line}
+            for f in sorted(findings, key=lambda f: f.fingerprint)
+        ],
+    }
+    path.write_text(json.dumps(data, indent=2) + "\n")
+
+
+def apply_baseline(findings: list[Finding], baseline: set[str]) -> None:
+    for f in findings:
+        if not f.suppressed and f.fingerprint in baseline:
+            f.baselined = True

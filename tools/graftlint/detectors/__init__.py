@@ -1,0 +1,32 @@
+"""graftlint detector registry.
+
+Five detectors, each owning one hazard class the runtime planes only see
+after it costs milliseconds (step_anatomy / compile_monitor / slo) or a
+conformance test fails (prometheus exposition):
+
+  host-sync           .item()/coercions/np.asarray/block_until_ready on
+                      device values inside hot modules
+  use-after-donation  donated buffers referenced after the jit call
+  recompile-hazard    literal args at non-static jit positions; static/donate
+                      specs that drifted from the wrapped signature
+  async-blocking      blocking calls in async def; await under a sync lock
+  metric-conformance  dynamo_* literals <-> DECLARED_METRIC_FAMILIES
+"""
+
+from tools.graftlint.detectors.async_hazards import AsyncHazardDetector
+from tools.graftlint.detectors.donation import DonationDetector
+from tools.graftlint.detectors.host_sync import HostSyncDetector
+from tools.graftlint.detectors.metrics_conformance import MetricsConformanceDetector
+from tools.graftlint.detectors.recompile import RecompileDetector
+
+ALL_DETECTORS = (
+    HostSyncDetector,
+    DonationDetector,
+    RecompileDetector,
+    AsyncHazardDetector,
+    MetricsConformanceDetector,
+)
+
+RULES = tuple(d.rule for d in ALL_DETECTORS)
+
+__all__ = ["ALL_DETECTORS", "RULES"]

@@ -1,0 +1,191 @@
+"""Detector 4: blocking calls and lock misuse inside ``async def``.
+
+One ``time.sleep`` in a coroutine stalls the whole event loop — every
+in-flight request's TTFT absorbs it, which is exactly the failure mode the
+SLO plane (``utils/slo.py``) can see but not attribute. Flagged inside
+``async def`` bodies (nested *sync* defs are skipped — they run wherever the
+caller schedules them, e.g. ``run_in_executor``):
+
+  - ``time.sleep`` (resolved through import aliasing)
+  - ``subprocess.run/call/check_call/check_output/Popen/getoutput``,
+    ``os.system``/``os.popen``
+  - ``requests.*`` / ``urllib.request.urlopen`` / sync ``httpx`` verbs
+  - ``socket.create_connection`` / ``socket.getaddrinfo`` (blocking DNS)
+  - sync file I/O: builtin ``open(...)`` and the pathlib surface
+    (``.open/.read_text/.write_text/.read_bytes/.write_bytes``)
+  - ``await`` while holding a *sync* ``threading.Lock`` (a ``with <lock>:``
+    block whose body awaits): the lock is held across a suspension point, so
+    any thread contending on it — e.g. the engine loop — deadlocks against
+    the event loop.
+
+Intentional blocking (tiny bounded reads at startup, etc.) carries
+``# graftlint: blocking-ok <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import (
+    Finding,
+    ScanContext,
+    SourceFile,
+    enclosing_func,
+    make_finding,
+)
+
+RULE = "async-blocking"
+
+#: canonical dotted call -> why it blocks
+_BLOCKING_CALLS = {
+    "time.sleep": "sleeps the whole event loop — use `await asyncio.sleep`",
+    "subprocess.run": "blocks on the child — use `asyncio.create_subprocess_exec`",
+    "subprocess.call": "blocks on the child — use `asyncio.create_subprocess_exec`",
+    "subprocess.check_call": "blocks on the child process",
+    "subprocess.check_output": "blocks on the child process",
+    "subprocess.getoutput": "blocks on the child process",
+    "subprocess.Popen": "spawns a child the loop then waits on synchronously",
+    "os.system": "blocks on a shell",
+    "os.popen": "blocks on a shell",
+    "urllib.request.urlopen": "sync HTTP — use aiohttp",
+    "socket.create_connection": "sync connect — use loop.sock_connect/aiohttp",
+    "socket.getaddrinfo": "blocking DNS — use loop.getaddrinfo",
+}
+
+_BLOCKING_ROOT_MODULES = {"requests": "sync HTTP — use aiohttp"}
+
+_SYNC_IO_METHODS = {"read_text", "write_text", "read_bytes", "write_bytes"}
+
+
+class _ImportMap(ast.NodeVisitor):
+    """local name -> canonical dotted module path."""
+
+    def __init__(self) -> None:
+        self.names: dict[str, str] = {}
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            self.names[a.asname or a.name.split(".")[0]] = (
+                a.name if a.asname else a.name.split(".")[0]
+            )
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module:
+            for a in node.names:
+                self.names[a.asname or a.name] = f"{node.module}.{a.name}"
+
+
+def _canonical_call(func: ast.AST, imports: dict[str, str]) -> str | None:
+    """Dotted canonical name of a call target, through import aliases."""
+    parts: list[str] = []
+    cur = func
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if not isinstance(cur, ast.Name):
+        return None
+    root = imports.get(cur.id, cur.id)
+    return ".".join([root] + list(reversed(parts)))
+
+
+class _AsyncVisitor(ast.NodeVisitor):
+    def __init__(self, sf: SourceFile, imports: dict[str, str]) -> None:
+        self.sf = sf
+        self.imports = imports
+        self.findings: list[Finding] = []
+        self.async_depth = 0
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self.async_depth += 1
+        self.generic_visit(node)
+        self.async_depth -= 1
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # a nested sync def does not run on the loop by construction; its
+        # body is the caller's problem (run_in_executor / thread target)
+        saved, self.async_depth = self.async_depth, 0
+        self.generic_visit(node)
+        self.async_depth = saved
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        saved, self.async_depth = self.async_depth, 0
+        self.generic_visit(node)
+        self.async_depth = saved
+
+    def _flag(self, node: ast.AST, message: str) -> None:
+        self.findings.extend(
+            make_finding(self.sf, RULE, node, message, enclosing_func(self.sf, node))
+        )
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.async_depth > 0:
+            canon = _canonical_call(node.func, self.imports)
+            if canon is not None:
+                why = _BLOCKING_CALLS.get(canon)
+                if why is None:
+                    root = canon.split(".")[0]
+                    if root in _BLOCKING_ROOT_MODULES and "." in canon:
+                        why = _BLOCKING_ROOT_MODULES[root]
+                if why is not None:
+                    self._flag(node, f"blocking `{canon}` inside async def: {why}")
+                elif canon == "open":
+                    self._flag(
+                        node,
+                        "sync file I/O (builtin open) inside async def blocks "
+                        "the event loop",
+                    )
+            if isinstance(node.func, ast.Attribute) and node.func.attr in (
+                _SYNC_IO_METHODS | {"open"}
+            ):
+                self._flag(
+                    node,
+                    f"sync file I/O (.{node.func.attr}) inside async def "
+                    "blocks the event loop",
+                )
+        self.generic_visit(node)
+
+    def visit_With(self, node: ast.With) -> None:
+        if self.async_depth > 0:
+            lockish = None
+            for item in node.items:
+                try:
+                    expr = ast.unparse(item.context_expr)
+                except Exception:
+                    continue
+                seg = expr.split("(")[0].split(".")[-1]
+                if "lock" in seg.lower():
+                    lockish = expr
+                    break
+            if lockish is not None and self._has_await(node):
+                self._flag(
+                    node,
+                    f"`await` while holding sync lock `{lockish}` — the lock "
+                    "is held across a suspension point; use asyncio.Lock or "
+                    "release before awaiting",
+                )
+        self.generic_visit(node)
+
+    def _has_await(self, node: ast.With) -> bool:
+        def walk(n: ast.AST) -> bool:
+            for child in ast.iter_child_nodes(n):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                    continue  # a nested def's awaits are not under this lock
+                if isinstance(child, ast.Await) or walk(child):
+                    return True
+            return False
+
+        return any(isinstance(s, ast.Await) or walk(s) for s in node.body)
+
+
+class AsyncHazardDetector:
+    rule = RULE
+
+    def scan(self, sf: SourceFile, ctx: ScanContext) -> list[Finding]:
+        imp = _ImportMap()
+        imp.visit(sf.tree)
+        v = _AsyncVisitor(sf, imp.names)
+        v.visit(sf.tree)
+        return v.findings
+
+    def finalize(self, files: list[SourceFile], ctx: ScanContext) -> list[Finding]:
+        return []

@@ -1,0 +1,201 @@
+"""Detector 2: use-after-donation.
+
+``donate_argnums`` hands a buffer to XLA for in-place reuse — referencing the
+Python binding afterwards reads a deleted buffer and raises (or worse, on some
+backends, silently reads garbage). The runtime discovers this as a crash in
+the engine loop; statically it is a dataflow check:
+
+    self.kv_cache = self._prefill(self.params, self.slot_state, self.kv_cache)
+    #                 donate_argnums=(1, 2): slot_state donated, NOT rebound
+    x = self.slot_state  # <- use-after-donation
+
+Two shapes are flagged, both scoped to a single function body (linear,
+lineno-ordered — branch-sensitive dataflow is out of scope for a lint):
+
+  1. a donated Name/Attribute is loaded after the jit call without being
+     rebound in between (the call statement's own assignment targets count
+     as an immediate rebind);
+  2. the call sits in a loop and the donated binding is never rebound inside
+     that loop body — the next iteration re-donates a consumed buffer.
+
+Deliberate exceptions (e.g. a buffer provably dead afterwards that the
+scheduler re-creates) carry ``# graftlint: donation-ok <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import (
+    Finding,
+    ScanContext,
+    SourceFile,
+    enclosing_func,
+    make_finding,
+)
+from tools.graftlint.jitspec import JitSpec, collect_jit_specs
+
+RULE = "use-after-donation"
+
+
+def _assign_target_keys(stmt: ast.stmt) -> set[str]:
+    """Unparse keys of every simple binding target in ``stmt``."""
+    out: set[str] = set()
+
+    def add(t: ast.AST) -> None:
+        if isinstance(t, (ast.Name, ast.Attribute)):
+            try:
+                out.add(ast.unparse(t))
+            except Exception:
+                pass
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                add(e)
+        elif isinstance(t, ast.Starred):
+            add(t.value)
+
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            add(t)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        add(stmt.target)
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        add(stmt.target)
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                add(item.optional_vars)
+    return out
+
+
+def _donated_args(call: ast.Call, spec: JitSpec) -> list[ast.AST]:
+    out: list[ast.AST] = []
+    for i in spec.donated_positions():
+        if i < len(call.args) and not isinstance(call.args[i], ast.Starred):
+            out.append(call.args[i])
+    donate_kw = set(spec.donate_names)
+    if spec.params is not None:
+        donate_kw |= {
+            spec.params[i] for i in spec.donate_nums if i < len(spec.params)
+        }
+    for kw in call.keywords:
+        if kw.arg in donate_kw:
+            out.append(kw.value)
+    return out
+
+
+class DonationDetector:
+    rule = RULE
+
+    def scan(self, sf: SourceFile, ctx: ScanContext) -> list[Finding]:
+        specs = collect_jit_specs(sf.tree)
+        if not specs:
+            return []
+        findings: list[Finding] = []
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(self._scan_function(sf, node, specs))
+        return findings
+
+    def finalize(self, files: list[SourceFile], ctx: ScanContext) -> list[Finding]:
+        return []
+
+    # ---- per-function linear dataflow ----
+
+    def _scan_function(
+        self, sf: SourceFile, fn: ast.AST, specs: dict[str, JitSpec]
+    ) -> list[Finding]:
+        findings: list[Finding] = []
+        calls: list[tuple[ast.Call, JitSpec]] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                try:
+                    key = ast.unparse(node.func)
+                except Exception:
+                    continue
+                spec = specs.get(key)
+                if spec is not None and (spec.donate_nums or spec.donate_names):
+                    calls.append((node, spec))
+        if not calls:
+            return findings
+
+        # precompute, in source order: every load and every rebind of every
+        # Name/Attribute key in this function
+        loads: dict[str, list[ast.AST]] = {}
+        rebinds: dict[str, list[int]] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Name, ast.Attribute)) and isinstance(
+                getattr(node, "ctx", None), ast.Load
+            ):
+                try:
+                    loads.setdefault(ast.unparse(node), []).append(node)
+                except Exception:
+                    pass
+            if isinstance(node, ast.stmt):
+                for key in _assign_target_keys(node):
+                    rebinds.setdefault(key, []).append(node.lineno)
+
+        for call, spec in calls:
+            stmt = sf.stmt_of(call)
+            stmt_targets = _assign_target_keys(stmt)
+            call_end = stmt.end_lineno or stmt.lineno
+            for arg in _donated_args(call, spec):
+                if not isinstance(arg, (ast.Name, ast.Attribute)):
+                    continue
+                try:
+                    key = ast.unparse(arg)
+                except Exception:
+                    continue
+                if key in stmt_targets:
+                    continue  # result immediately rebinds the donated name
+                qual = enclosing_func(sf, call)
+                # shape 1: later load without an intervening rebind
+                for use in loads.get(key, []):
+                    if use.lineno <= call_end:
+                        continue
+                    if any(
+                        call_end < rl <= use.lineno
+                        for rl in rebinds.get(key, [])
+                    ):
+                        continue
+                    findings.extend(
+                        make_finding(
+                            sf,
+                            RULE,
+                            use,
+                            f"`{key}` donated to `{spec.key}` (line "
+                            f"{call.lineno}, donate_argnums/argnames) is "
+                            "referenced after the call — the buffer is gone",
+                            qual,
+                        )
+                    )
+                    break  # one finding per donated arg is enough
+                else:
+                    # shape 2: re-donation on the next loop iteration
+                    loop = self._enclosing_loop(sf, stmt, fn)
+                    if loop is not None and not any(
+                        loop.lineno <= rl <= (loop.end_lineno or loop.lineno)
+                        for rl in rebinds.get(key, [])
+                    ):
+                        findings.extend(
+                            make_finding(
+                                sf,
+                                RULE,
+                                call,
+                                f"`{key}` is donated to `{spec.key}` inside a "
+                                "loop but never rebound in the loop body — "
+                                "the next iteration donates a consumed buffer",
+                                qual,
+                            )
+                        )
+        return findings
+
+    def _enclosing_loop(
+        self, sf: SourceFile, stmt: ast.stmt, fn: ast.AST
+    ) -> ast.stmt | None:
+        cur = sf.parents.get(id(stmt))
+        while cur is not None and cur is not fn:
+            if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+                return cur
+            cur = sf.parents.get(id(cur))
+        return None

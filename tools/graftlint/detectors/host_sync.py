@@ -1,0 +1,198 @@
+"""Detector 1: host-sync-in-hot-path.
+
+The r5 judge decomposition puts ~30% of every decode step in host overhead;
+``utils/step_anatomy.py`` prices that cost at runtime, but a new ``.item()``
+or ``np.asarray(device_value)`` only shows up after it ships. This detector
+flags host-synchronizing operations inside the modules tagged hot (engine/,
+spec/, lora/, quant/, ops/):
+
+  - ``x.item()`` — always a device->host round trip on an Array
+  - ``jax.block_until_ready(...)`` / ``x.block_until_ready()``
+  - ``jax.device_get(...)``
+  - ``np.asarray(x)`` / ``np.array(x)`` where ``x`` is a *device* value
+  - ``float(x)`` / ``int(x)`` / ``bool(x)`` coercions of a device value
+
+"Device value" is resolved by a codebase-tuned intra-function taint: direct
+``jnp.*``/``jax.*``/``lax.*`` call results, names assigned from them, the
+``*_dev`` naming convention the scheduler uses for in-flight device handles
+(``toks_dev``, ``out_dev``), and ``.dev`` attributes (the pipelined-window
+handle). Host-side ``np.asarray(token_id_list)`` staging therefore does NOT
+flag — only materializations that can stall the engine loop do.
+
+Deliberate reconcile points (the ones step_anatomy already prices) carry
+``# graftlint: sync-ok <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import (
+    Finding,
+    ScanContext,
+    SourceFile,
+    enclosing_func,
+    make_finding,
+)
+
+RULE = "host-sync"
+
+#: modules whose engine-loop code must stay on the roofline
+HOT_DIRS = (
+    "dynamo_tpu/engine/",
+    "dynamo_tpu/spec/",
+    "dynamo_tpu/lora/",
+    "dynamo_tpu/quant/",
+    "dynamo_tpu/ops/",
+)
+
+_DEVICE_ROOTS = {"jnp", "lax"}
+#: jax.* namespaces that produce device values. Allowlist, not blocklist:
+#: jax.devices()/jax.tree.map()/jax.jit() return device handles, host trees
+#: and callables — tainting them flags mesh construction
+#: (np.array(jax.devices())) and similar host-side plumbing
+_JAX_DEVICE_ATTRS = {"device_put", "numpy", "random", "nn", "lax", "eval_shape"}
+
+_NP_ROOTS = {"np", "numpy"}
+_NP_SYNC_FNS = {"asarray", "array"}
+_COERCIONS = {"float", "int", "bool"}
+
+
+def _attr_root(node: ast.AST) -> str | None:
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def is_device_expr(node: ast.AST, tainted: set[str]) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id in tainted or node.id.endswith("_dev")
+    if isinstance(node, ast.Attribute):
+        if node.attr == "dev" or node.attr.endswith("_dev"):
+            return True
+        return is_device_expr(node.value, tainted)
+    if isinstance(node, ast.Call):
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            root = _attr_root(func)
+            if root in _DEVICE_ROOTS:
+                return True
+            if root == "jax":
+                # jax.<x>.<y>(...): first attr segment after the root decides
+                seg = func
+                while isinstance(seg.value, ast.Attribute):
+                    seg = seg.value
+                return seg.attr in _JAX_DEVICE_ATTRS
+            # method on a device value stays on device (x.astype(...), x.sum())
+            return is_device_expr(func.value, tainted)
+        if isinstance(func, ast.Name):
+            return func.id in tainted
+        return False
+    if isinstance(node, ast.Subscript):
+        return is_device_expr(node.value, tainted)
+    if isinstance(node, (ast.BinOp,)):
+        return is_device_expr(node.left, tainted) or is_device_expr(node.right, tainted)
+    if isinstance(node, ast.UnaryOp):
+        return is_device_expr(node.operand, tainted)
+    if isinstance(node, ast.IfExp):
+        return is_device_expr(node.body, tainted) or is_device_expr(node.orelse, tainted)
+    return False
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, sf: SourceFile) -> None:
+        self.sf = sf
+        self.findings: list[Finding] = []
+        self.taint_stack: list[set[str]] = [set()]
+
+    @property
+    def tainted(self) -> set[str]:
+        return self.taint_stack[-1]
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.taint_stack.append(set())
+        self.generic_visit(node)
+        self.taint_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def _flag(self, node: ast.AST, message: str) -> None:
+        self.findings.extend(
+            make_finding(self.sf, RULE, node, message, enclosing_func(self.sf, node))
+        )
+
+    def _taint_targets(self, targets: list[ast.AST]) -> None:
+        for t in targets:
+            if isinstance(t, ast.Name):
+                self.tainted.add(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                self._taint_targets(list(t.elts))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if is_device_expr(node.value, self.tainted):
+            self._taint_targets(list(node.targets))
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None and is_device_expr(node.value, self.tainted):
+            self._taint_targets([node.target])
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr == "item" and not node.args:
+                self._flag(
+                    node,
+                    f"`{ast.unparse(node)}`: .item() forces a device->host sync "
+                    "in a hot module",
+                )
+            elif func.attr == "block_until_ready":
+                self._flag(
+                    node,
+                    "block_until_ready blocks the engine loop on device work "
+                    "in a hot module",
+                )
+            elif func.attr == "device_get" and _attr_root(func) == "jax":
+                self._flag(
+                    node,
+                    "jax.device_get materializes device values on host in a "
+                    "hot module",
+                )
+            elif (
+                func.attr in _NP_SYNC_FNS
+                and _attr_root(func) in _NP_ROOTS
+                and node.args
+                and is_device_expr(node.args[0], self.tainted)
+            ):
+                self._flag(
+                    node,
+                    f"np.{func.attr}() on a device value transfers it to host "
+                    "in a hot module",
+                )
+        elif (
+            isinstance(func, ast.Name)
+            and func.id in _COERCIONS
+            and len(node.args) == 1
+            and is_device_expr(node.args[0], self.tainted)
+        ):
+            self._flag(
+                node,
+                f"{func.id}() coercion of a device value forces a host sync "
+                "in a hot module",
+            )
+        self.generic_visit(node)
+
+
+class HostSyncDetector:
+    rule = RULE
+
+    def scan(self, sf: SourceFile, ctx: ScanContext) -> list[Finding]:
+        if not ctx.force_hot and not sf.path.startswith(HOT_DIRS):
+            return []
+        v = _Visitor(sf)
+        v.visit(sf.tree)
+        return v.findings
+
+    def finalize(self, files: list[SourceFile], ctx: ScanContext) -> list[Finding]:
+        return []

@@ -1,0 +1,178 @@
+"""Detector 5: dynamo_* metric-name conformance.
+
+Every ``dynamo_*`` family this system exposes is declared once, in
+``dynamo_tpu/utils/prometheus.py`` (``DECLARED_METRIC_FAMILIES``), the same
+module whose ``--check`` renders every exposition surface. This detector is
+the *static* half of that contract:
+
+  - every ``dynamo_*`` string literal at an emitting site must be a declared
+    family, or an underscore-boundary prefix of one (the engine renames
+    ``dynamo_slo_*`` -> ``dynamo_engine_slo_*`` via prefix literals like
+    ``"dynamo_slo"`` / ``"dynamo_goodput_"`` — those are references to every
+    family they cover);
+  - vice versa, every declared family must be reachable from some literal in
+    the scanned code (exact or via such a prefix) — a family nobody emits is
+    exposition-test drift waiting to happen.
+
+The runtime half lives in ``python -m dynamo_tpu.utils.prometheus --check``,
+which asserts the *rendered* family set equals the declared set — so the
+declaration list is pinned from both sides and the exposition tests can never
+drift from the emitting sites.
+
+Docstrings are skipped (prose mentions are not emitting sites). Non-metric
+strings that happen to match (k8s label keys etc.) carry
+``# graftlint: metric-ok <reason>``; the vice-versa direction only runs when
+the declaring module is part of the scan.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+
+from tools.graftlint.core import (
+    Finding,
+    ScanContext,
+    SourceFile,
+    enclosing_func,
+    make_finding,
+)
+
+RULE = "metric-conformance"
+
+DECLARATION_NAME = "DECLARED_METRIC_FAMILIES"
+DECLARING_MODULE = "dynamo_tpu/utils/prometheus.py"
+
+#: a family name or boundary-prefix reference ("dynamo_slo" is the SloTracker
+#: render prefix covering dynamo_slo_*), no trailing underscore
+_FULL_RE = re.compile(r"^dynamo_[a-z0-9]+(?:_[a-z0-9]+)*$")
+#: an explicit prefix reference ("dynamo_goodput_", "dynamo_engine_context_")
+_PREFIX_RE = re.compile(r"^dynamo_[a-z0-9_]*_$")
+
+
+@dataclass
+class _Literal:
+    sf: SourceFile
+    node: ast.Constant
+    value: str
+
+
+def _docstring_nodes(tree: ast.AST) -> set[int]:
+    """ids of Constant nodes that are module/class/function docstrings."""
+    out: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef)):
+            body = node.body
+            if (
+                body
+                and isinstance(body[0], ast.Expr)
+                and isinstance(body[0].value, ast.Constant)
+                and isinstance(body[0].value.value, str)
+            ):
+                out.add(id(body[0].value))
+    return out
+
+
+def _find_declaration(tree: ast.AST) -> tuple[list[tuple[str, ast.Constant]], set[int]]:
+    """(declared (name, node) pairs, ids of every Constant inside the
+    declaration assignment) — declaration literals are not usages."""
+    declared: list[tuple[str, ast.Constant]] = []
+    decl_ids: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            if not any(
+                isinstance(t, ast.Name) and t.id == DECLARATION_NAME for t in targets
+            ):
+                continue
+            if node.value is None:
+                continue
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Constant):
+                    decl_ids.add(id(sub))
+                    if isinstance(sub.value, str) and sub.value.startswith("dynamo_"):
+                        declared.append((sub.value, sub))
+    return declared, decl_ids
+
+
+class MetricsConformanceDetector:
+    """Whole-scan detector: literals are collected per file, cross-checked in
+    finalize (both directions need the full file set)."""
+
+    rule = RULE
+
+    def scan(self, sf: SourceFile, ctx: ScanContext) -> list[Finding]:
+        return []
+
+    def finalize(self, files: list[SourceFile], ctx: ScanContext) -> list[Finding]:
+        findings: list[Finding] = []
+        declared: dict[str, tuple[SourceFile, ast.Constant]] = {}
+        declaring_file_scanned = False
+        usages: list[_Literal] = []
+
+        for sf in files:
+            decl_pairs, decl_ids = _find_declaration(sf.tree)
+            if decl_pairs:
+                declaring_file_scanned = True
+            for name, node in decl_pairs:
+                declared.setdefault(name, (sf, node))
+            doc_ids = _docstring_nodes(sf.tree)
+            for node in ast.walk(sf.tree):
+                if (
+                    isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and node.value.startswith("dynamo_")
+                    and id(node) not in decl_ids
+                    and id(node) not in doc_ids
+                    and (_FULL_RE.match(node.value) or _PREFIX_RE.match(node.value))
+                    and not node.value.startswith("dynamo_tpu")
+                ):
+                    usages.append(_Literal(sf, node, node.value))
+
+        names = set(declared)
+
+        def covered_by(lit: str) -> set[str]:
+            """Declared families a literal refers to (exact or prefix)."""
+            if lit.endswith("_"):
+                return {d for d in names if d.startswith(lit)}
+            if lit in names:
+                return {lit}
+            return {d for d in names if d.startswith(lit + "_")}
+
+        referenced: set[str] = set()
+        for use in usages:
+            hits = covered_by(use.value)
+            if hits:
+                referenced |= hits
+            elif names:  # with no declaration in scope, skip direction 1
+                kind = "prefix" if use.value.endswith("_") else "family"
+                findings.extend(
+                    make_finding(
+                        use.sf,
+                        RULE,
+                        use.node,
+                        f"metric {kind} literal {use.value!r} matches no "
+                        f"declared dynamo_* family — declare it in "
+                        f"{DECLARATION_NAME} (utils/prometheus.py) or mark "
+                        "it metric-ok if it is not a metric",
+                        enclosing_func(use.sf, use.node),
+                    )
+                )
+
+        # vice versa: only meaningful when the declaring module was scanned
+        if declaring_file_scanned:
+            for name in sorted(names - referenced):
+                sf, node = declared[name]
+                findings.extend(
+                    make_finding(
+                        sf,
+                        RULE,
+                        node,
+                        f"declared metric family {name!r} is never referenced "
+                        "by any emitting site in the scanned code — dead "
+                        "declaration or missing emitter",
+                        DECLARATION_NAME,
+                    )
+                )
+        return findings

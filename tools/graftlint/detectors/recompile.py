@@ -1,0 +1,165 @@
+"""Detector 3: recompile hazards at jit call sites.
+
+``utils/compile_monitor.py`` counts cache growth at runtime
+(``dynamo_engine_xla_compiles_total``) — a recompile storm shows up as a
+counter after it already burned seconds of serving time. The static
+complement flags the two call-shape mistakes that cause silent retraces:
+
+  1. literal Python scalars / f-strings / dict/list/set displays passed at
+     NON-static positions of a jit'd callable. Scalars weak-type the trace
+     (a second call site with an array retraces), strings are outright trace
+     errors unless static, and display literals rebuild a fresh pytree
+     structure per call site. The fix is almost always ``static_argnames`` or
+     a prebuilt ``jnp.asarray`` staged once.
+  2. ``static_argnames``/``donate_argnames`` entries that do not name a
+     parameter of the wrapped function, and ``static_argnums``/
+     ``donate_argnums`` past the end of its positional signature — the
+     classic drift bug after a signature refactor: the intended-static arg
+     silently becomes traced and every distinct value compiles a variant.
+
+Intentional cases (e.g. a literal 0 seed traced on purpose) carry
+``# graftlint: recompile-ok <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import (
+    Finding,
+    ScanContext,
+    SourceFile,
+    enclosing_func,
+    make_finding,
+)
+from tools.graftlint.jitspec import collect_jit_specs
+
+RULE = "recompile-hazard"
+
+
+def _literal_kind(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant):
+        if node.value is None:
+            return None  # None is an empty pytree leaf slot — harmless
+        if isinstance(node.value, bool):
+            return "bool literal"
+        if isinstance(node.value, (int, float, complex)):
+            return "scalar literal"
+        if isinstance(node.value, str):
+            return "string literal"
+        return None
+    if isinstance(node, ast.JoinedStr):
+        return "f-string"
+    if isinstance(node, ast.Dict):
+        return "dict display"
+    if isinstance(node, (ast.List, ast.Set)):
+        return f"{type(node).__name__.lower()} display"
+    if isinstance(node, ast.UnaryOp) and isinstance(node.operand, ast.Constant):
+        return _literal_kind(node.operand)
+    return None
+
+
+class RecompileDetector:
+    rule = RULE
+
+    def scan(self, sf: SourceFile, ctx: ScanContext) -> list[Finding]:
+        specs = collect_jit_specs(sf.tree)
+        if not specs:
+            return []
+        findings: list[Finding] = []
+
+        # signature validation at the wrapper site
+        for spec in specs.values():
+            if spec.fn is None or spec.params is None or spec.has_varargs:
+                continue
+            valid = set(spec.params) | set(spec.kwonly)
+            qual = enclosing_func(sf, spec.site)
+            for label, names in (
+                ("static_argnames", spec.static_names),
+                ("donate_argnames", spec.donate_names),
+            ):
+                for name in sorted(names - valid):
+                    findings.extend(
+                        make_finding(
+                            sf,
+                            RULE,
+                            spec.site,
+                            f"{label} entry {name!r} on `{spec.key}` does not "
+                            f"match the wrapped signature of "
+                            f"`{spec.fn.name}` — the argument is silently "
+                            "traced and every distinct value recompiles",
+                            qual,
+                        )
+                    )
+            for label, nums in (
+                ("static_argnums", spec.static_nums),
+                ("donate_argnums", spec.donate_nums),
+            ):
+                for i in sorted(nums):
+                    if i >= len(spec.params):
+                        findings.extend(
+                            make_finding(
+                                sf,
+                                RULE,
+                                spec.site,
+                                f"{label} index {i} on `{spec.key}` is past "
+                                f"the wrapped signature of `{spec.fn.name}` "
+                                f"({len(spec.params)} positional params)",
+                                qual,
+                            )
+                        )
+
+        # literal arguments at non-static positions of known jit callables
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            try:
+                key = ast.unparse(node.func)
+            except Exception:
+                continue
+            spec = specs.get(key)
+            if spec is None or spec.site is node:
+                continue
+            qual = enclosing_func(sf, node)
+            for i, arg in enumerate(node.args):
+                if spec.is_static_pos(i):
+                    continue
+                kind = _literal_kind(arg)
+                if kind is not None:
+                    where = (
+                        f"param `{spec.params[i]}`"
+                        if spec.params is not None and i < len(spec.params)
+                        else f"position {i}"
+                    )
+                    findings.extend(
+                        make_finding(
+                            sf,
+                            RULE,
+                            arg,
+                            f"{kind} passed to jit'd `{spec.key}` at "
+                            f"non-static {where} — weak-typed retrace/"
+                            "per-call-site variant; make it static or stage "
+                            "an array once",
+                            qual,
+                        )
+                    )
+            for kw in node.keywords:
+                if kw.arg is None or spec.is_static_kw(kw.arg):
+                    continue
+                kind = _literal_kind(kw.value)
+                if kind is not None:
+                    findings.extend(
+                        make_finding(
+                            sf,
+                            RULE,
+                            kw.value,
+                            f"{kind} passed to jit'd `{spec.key}` at "
+                            f"non-static keyword `{kw.arg}` — make it static "
+                            "or stage an array once",
+                            qual,
+                        )
+                    )
+        return findings
+
+    def finalize(self, files: list[SourceFile], ctx: ScanContext) -> list[Finding]:
+        return []

@@ -1,0 +1,22 @@
+# graftlint-fixture: async-blocking expect=0
+"""Seeded NEGATIVE fixture: awaited sleeps, asyncio.Lock, sync I/O in a sync
+helper, and an annotated bounded block."""
+import asyncio
+import time
+
+
+def snapshot(path):
+    with open(path) as f:  # sync def: runs wherever the caller put it
+        return f.read()
+
+
+class Poller:
+    def __init__(self):
+        self._lock = asyncio.Lock()
+
+    async def tick(self, path):
+        await asyncio.sleep(0.1)
+        async with self._lock:  # async lock across await: correct idiom
+            await asyncio.sleep(0)
+        time.sleep(0)  # graftlint: blocking-ok fixture: documented bounded spin
+        return snapshot

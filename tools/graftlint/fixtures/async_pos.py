@@ -1,0 +1,19 @@
+# graftlint-fixture: async-blocking expect=3
+"""Seeded POSITIVE fixture: blocking sleep, sync file I/O, and an await
+while holding a sync threading.Lock."""
+import asyncio
+import threading
+import time
+
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    async def tick(self, path):
+        time.sleep(0.5)  # [1] stalls the event loop
+        with open(path) as f:  # [2] sync file I/O on the loop
+            data = f.read()
+        with self._lock:  # [3] lock held across a suspension point
+            await asyncio.sleep(0)
+        return data

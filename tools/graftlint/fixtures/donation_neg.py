@@ -1,0 +1,23 @@
+# graftlint-fixture: use-after-donation expect=0
+"""Seeded NEGATIVE fixture: the immediate-rebind idiom is safe, and an
+annotated deliberate exception suppresses."""
+import jax
+
+
+def _step_impl(state, x):
+    return state * x
+
+
+class Runner:
+    def __init__(self):
+        self._step = jax.jit(_step_impl, donate_argnums=(0,))
+
+    def run(self, state, xs):
+        for x in xs:
+            state = self._step(state, x)  # rebound each iteration: safe
+        return state
+
+    def peek(self, state, x):
+        out = self._step(state, x)
+        shape = state.shape  # graftlint: donation-ok fixture: metadata only
+        return out, shape

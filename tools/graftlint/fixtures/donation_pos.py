@@ -1,0 +1,23 @@
+# graftlint-fixture: use-after-donation expect=2
+"""Seeded POSITIVE fixture: both use-after-donation shapes."""
+import jax
+
+
+def _step_impl(state, x):
+    return state + x
+
+
+class Runner:
+    def __init__(self):
+        self._step = jax.jit(_step_impl, donate_argnums=(0,))
+
+    def run(self, state, x):
+        out = self._step(state, x)
+        stale = state.shape  # [1] donated `state` referenced after the call
+        return out, stale
+
+    def loop(self, state, xs):
+        acc = []
+        for x in xs:
+            acc.append(self._step(state, x))  # [2] re-donated every iteration
+        return acc

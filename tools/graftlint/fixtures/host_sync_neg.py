@@ -1,0 +1,19 @@
+# graftlint-fixture: host-sync expect=0
+"""Seeded NEGATIVE fixture: host-side staging must NOT flag, and annotated
+reconcile points must suppress (with a reason)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def reconcile(runner, token_list, out_dev):
+    ids = np.asarray(token_list, np.int32)  # host->device staging: fine
+    toks = np.asarray(out_dev)  # graftlint: sync-ok priced reconcile point
+    depth = int(len(token_list))  # host int: fine
+    return ids, toks, depth
+
+
+def warmup(x):
+    out = jnp.exp(x)
+    jax.block_until_ready(out)  # graftlint: sync-ok warmup compile gate
+    return out

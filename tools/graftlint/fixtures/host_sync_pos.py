@@ -1,0 +1,19 @@
+# graftlint-fixture: host-sync expect=5
+"""Seeded POSITIVE fixture: every host-sync shape the detector must catch.
+
+Never imported — parsed only (the self-check runs the detector over this
+file with --force-hot and asserts exactly the seeded finding count)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def hot_loop(runner, table):
+    logits = jnp.dot(table, table)  # taints `logits` as a device value
+    toks_dev = runner.dispatch(table)
+    a = float(logits[0])  # [1] float() coercion of a device value
+    b = int(jnp.argmax(logits))  # [2] int() of a direct jnp call result
+    host = np.asarray(toks_dev)  # [3] np.asarray on a *_dev handle
+    n = logits.sum().item()  # [4] .item() round trip
+    jax.block_until_ready(logits)  # [5] explicit blocking sync
+    return a, b, host, n

@@ -1,0 +1,18 @@
+# graftlint-fixture: metric-conformance expect=0
+"""Seeded NEGATIVE fixture: exact references, underscore-boundary prefix
+references (the engine's dynamo_slo_* -> dynamo_engine_slo_* rename idiom),
+and an annotated non-metric string."""
+
+DECLARED_METRIC_FAMILIES = (
+    "dynamo_fixture_requests_total",
+    "dynamo_fixture_latency_seconds",
+    "dynamo_fixture_goodput_ratio",
+)
+
+
+def render():
+    fams = ["dynamo_fixture_requests_total"]  # exact reference
+    prefix = "dynamo_fixture_latency_"  # trailing-underscore prefix reference
+    rename = "dynamo_fixture_goodput"  # boundary prefix reference
+    label = "dynamo_fixture_k8s_label"  # graftlint: metric-ok k8s selector
+    return fams, prefix, rename, label

@@ -1,0 +1,16 @@
+# graftlint-fixture: metric-conformance expect=2
+"""Seeded POSITIVE fixture: one undeclared emitting literal, one declared
+family nobody emits. Scanned standalone, so this module carries its own
+declaration surface."""
+
+DECLARED_METRIC_FAMILIES = (
+    "dynamo_fixture_requests_total",
+    "dynamo_fixture_orphan_seconds",  # [1] declared, never emitted
+)
+
+
+def render():
+    out = []
+    out.append(("dynamo_fixture_requests_total", 1))  # declared: fine
+    out.append(("dynamo_fixture_rogue_total", 2))  # [2] undeclared family
+    return out

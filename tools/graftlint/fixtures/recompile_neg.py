@@ -1,0 +1,18 @@
+# graftlint-fixture: recompile-hazard expect=0
+"""Seeded NEGATIVE fixture: literals at static positions are fine; an
+annotated deliberate constant-fold suppresses."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("mp",))
+def decode(x, table, mp=8):
+    return jnp.sum(x) * mp + jnp.sum(table)
+
+
+def drive(x, table):
+    good = decode(x, table, 16)  # 16 binds static `mp`: one variant, fine
+    bias = decode(x, 0.5, mp=4)  # graftlint: recompile-ok constant table folds
+    return good, bias

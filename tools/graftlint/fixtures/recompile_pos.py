@@ -1,0 +1,18 @@
+# graftlint-fixture: recompile-hazard expect=3
+"""Seeded POSITIVE fixture: a static_argnames typo (signature drift) plus the
+literal-at-traced-position retraces it causes."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("mp", "widht"))  # [1] typo drift
+def decode(x, table, mp=8, width=16):
+    return jnp.sum(x) + mp + width
+
+
+def drive(x, table):
+    a = decode(x, 3.0, 4)  # [2] scalar literal at non-static `table`
+    b = decode(x, table, 4, width=32)  # [3] `width` is traced (typo!) + literal
+    return a, b

@@ -1,0 +1,251 @@
+"""Static model of the module's jit-wrapped callables.
+
+The donation and recompile detectors both need the same facts about every
+jit call site: which bound name is a jit'd callable, which argument positions
+are donated, which are static, and (when the wrapped function is defined in
+the same module) its parameter list. This codebase binds jit three ways:
+
+    self._prefill = monitored_jit("prefill", self._prefill_impl,
+                                  donate_argnums=(1, 2), static_argnames=("mp",))
+    self._lora_write = jax.jit(_lora_write_impl, donate_argnums=(0,))
+
+    @functools.partial(jax.jit, static_argnames=("interpret",))
+    def paged_attention(...): ...
+
+All three are collected. Resolution is intentionally same-module-only: a
+wrapper around an imported function still yields a spec (donation/static sets
+from the wrapper kwargs), just without a parameter list, so positional static
+mapping and signature validation degrade gracefully instead of guessing.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+
+@dataclass
+class JitSpec:
+    key: str  # bound-name unparse at call sites ("self._prefill", "fn")
+    site: ast.AST  # where the wrapper was declared
+    fn: ast.FunctionDef | None = None  # wrapped def, when resolved
+    params: list[str] | None = None  # positional params as seen by callers
+    kwonly: list[str] = field(default_factory=list)
+    has_varargs: bool = False
+    donate_nums: set[int] = field(default_factory=set)
+    donate_names: set[str] = field(default_factory=set)
+    static_nums: set[int] = field(default_factory=set)
+    static_names: set[str] = field(default_factory=set)
+
+    def is_static_pos(self, i: int) -> bool:
+        if i in self.static_nums:
+            return True
+        return (
+            self.params is not None
+            and i < len(self.params)
+            and self.params[i] in self.static_names
+        )
+
+    def is_static_kw(self, name: str) -> bool:
+        if name in self.static_names:
+            return True
+        if self.params is not None and name in self.params:
+            return self.params.index(name) in self.static_nums
+        return False
+
+    def donated_positions(self) -> set[int]:
+        out = set(self.donate_nums)
+        if self.params is not None:
+            out |= {self.params.index(n) for n in self.donate_names if n in self.params}
+        return out
+
+
+_WRAPPER_TAILS = ("jit",)  # jax.jit, jit, compile_monitor-monitored variants
+_NAMED_WRAPPERS = {"monitored_jit", "_mjit"}  # (name, fn, **jit_kwargs)
+
+
+def _int_tuple(node: ast.AST) -> set[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return {
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)
+        }
+    return set()
+
+
+def _str_tuple(node: ast.AST) -> set[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return {
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        }
+    return set()
+
+
+def _jit_kwargs(call: ast.Call) -> dict[str, set]:
+    out: dict[str, set] = {}
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "static_argnums"):
+            out[kw.arg] = _int_tuple(kw.value)
+        elif kw.arg in ("donate_argnames", "static_argnames"):
+            out[kw.arg] = _str_tuple(kw.value)
+    return out
+
+
+def _is_jit_func(func: ast.AST) -> bool:
+    s = _unparse(func)
+    return s is not None and (
+        s == "jit" or s.endswith(".jit") or s.split(".")[-1] in _NAMED_WRAPPERS
+        or s in _NAMED_WRAPPERS
+    )
+
+
+def _unparse(node: ast.AST | None) -> str | None:
+    if node is None:
+        return None
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return None
+
+
+def _wrapped_expr(call: ast.Call) -> ast.AST | None:
+    """The function being wrapped. jit-likes take it at args[0]; the named
+    monitored wrappers exist in both (label, fn, ...) and (fn, label, ...)
+    orders across this codebase, so for those the first non-Constant arg is
+    the function."""
+    s = _unparse(call.func) or ""
+    if s in _NAMED_WRAPPERS or s.split(".")[-1] in _NAMED_WRAPPERS:
+        for a in call.args:
+            if not isinstance(a, ast.Constant):
+                return a
+        return None
+    return call.args[0] if call.args else None
+
+
+def _params_of(fn: ast.FunctionDef, drop_self: bool) -> tuple[list[str], list[str], bool]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+    if drop_self and names and names[0] in ("self", "cls"):
+        names = names[1:]
+    # kwonly params are addressable by static_argnames too; callers index
+    # positionally only over ``names``
+    kwonly = [p.arg for p in a.kwonlyargs]
+    return names, kwonly, a.vararg is not None or a.kwarg is not None
+
+
+class _DefIndex(ast.NodeVisitor):
+    """function defs by module-level name and by (class, method) name."""
+
+    def __init__(self) -> None:
+        self.module_fns: dict[str, ast.FunctionDef] = {}
+        self.methods: dict[str, ast.FunctionDef] = {}  # any-class method index
+        self.local_fns: dict[str, ast.FunctionDef] = {}  # nested defs too
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.local_fns.setdefault(node.name, node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+
+def _index_defs(tree: ast.AST) -> _DefIndex:
+    idx = _DefIndex()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Module):
+            for ch in node.body:
+                if isinstance(ch, ast.FunctionDef):
+                    idx.module_fns[ch.name] = ch
+        elif isinstance(node, ast.ClassDef):
+            for ch in node.body:
+                if isinstance(ch, ast.FunctionDef):
+                    idx.methods[ch.name] = ch
+    idx.visit(tree)
+    return idx
+
+
+def _resolve_fn(expr: ast.AST | None, idx: _DefIndex) -> tuple[ast.FunctionDef | None, bool]:
+    """(def node, drop_self) for the wrapped-function expression."""
+    if isinstance(expr, ast.Name):
+        fn = idx.module_fns.get(expr.id) or idx.local_fns.get(expr.id)
+        return fn, False
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+        if expr.value.id in ("self", "cls"):
+            return idx.methods.get(expr.attr), True
+        return None, False
+    return None, False
+
+
+def _spec_from_wrapper(key: str, call: ast.Call, site: ast.AST, idx: _DefIndex) -> JitSpec:
+    # peel nested wrappers — `_mjit("prefill", jax.jit(fn, donate_argnums=...))`
+    # carries the jit config on the INNER call — merging kwargs outermost-wins
+    kw: dict[str, set] = {}
+    wrapped = call
+    depth = 0
+    while (
+        isinstance(wrapped, ast.Call) and _is_jit_func(wrapped.func) and depth < 4
+    ):
+        for k, v in _jit_kwargs(wrapped).items():
+            kw.setdefault(k, v)
+        wrapped = _wrapped_expr(wrapped)
+        depth += 1
+    spec = JitSpec(
+        key=key,
+        site=site,
+        donate_nums=kw.get("donate_argnums", set()),
+        donate_names=kw.get("donate_argnames", set()),
+        static_nums=kw.get("static_argnums", set()),
+        static_names=kw.get("static_argnames", set()),
+    )
+    fn, drop_self = _resolve_fn(wrapped, idx)
+    if fn is not None:
+        spec.fn = fn
+        spec.params, spec.kwonly, spec.has_varargs = _params_of(fn, drop_self)
+    return spec
+
+
+def collect_jit_specs(tree: ast.AST) -> dict[str, JitSpec]:
+    """Every jit-wrapped callable bound to a name in this module."""
+    idx = _index_defs(tree)
+    specs: dict[str, JitSpec] = {}
+
+    for node in ast.walk(tree):
+        # form 1/2: <target> = jit-wrapper(fn, **kw)
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            val = node.value
+            if isinstance(val, ast.Call) and _is_jit_func(val.func):
+                key = _unparse(node.targets[0])
+                if key:
+                    specs[key] = _spec_from_wrapper(key, val, node, idx)
+        # form 3: @functools.partial(jax.jit, **kw) / bare @jax.jit decorator
+        elif isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                call = None
+                if isinstance(dec, ast.Call):
+                    fname = _unparse(dec.func) or ""
+                    if fname.split(".")[-1] == "partial" and dec.args and _is_jit_func(dec.args[0]):
+                        call = dec
+                    elif _is_jit_func(dec.func):
+                        call = dec
+                if call is not None:
+                    kw = _jit_kwargs(call)
+                    params, kwonly, varargs = _params_of(node, drop_self=False)
+                    specs[node.name] = JitSpec(
+                        key=node.name,
+                        site=node,
+                        fn=node,
+                        params=params,
+                        kwonly=kwonly,
+                        has_varargs=varargs,
+                        donate_nums=kw.get("donate_argnums", set()),
+                        donate_names=kw.get("donate_argnames", set()),
+                        static_nums=kw.get("static_argnums", set()),
+                        static_names=kw.get("static_argnames", set()),
+                    )
+    return specs

@@ -12,8 +12,17 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 # metrics self-check: import and validate every Prometheus exposition
-# surface without a cluster (promtool-style conformance; no egress needed)
+# surface without a cluster (promtool-style conformance; no egress needed),
+# plus DECLARED_METRIC_FAMILIES == the rendered family set (the runtime half
+# of the metric-conformance contract graftlint checks statically below)
 JAX_PLATFORMS=cpu python -m dynamo_tpu.utils.prometheus --check
+
+# graftlint: JAX/asyncio-aware static analysis gating the hot path (pure
+# stdlib AST — runs on the no-egress image with a bare interpreter). First
+# the detectors prove themselves against their seeded fixtures, then the
+# repo scan must come back with zero unsuppressed findings.
+python -m tools.graftlint --self-check
+python -m tools.graftlint
 
 # bench regression gate self-check: the compare tool must flag a synthetic
 # regression and pass an identical pair (pure stdlib, no cluster)

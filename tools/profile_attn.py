@@ -15,7 +15,6 @@ Usage: python tools/profile_attn.py [B] [ps] [ctx]
 
 from __future__ import annotations
 
-import functools
 import sys
 import time
 
