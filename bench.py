@@ -1154,6 +1154,236 @@ async def run_fleet_prefix(sessions: int = 3, osl: int = 8) -> dict:
     }
 
 
+async def run_migration(sessions: int = 3, osl: int = 24) -> dict:
+    """Live sequence migration vs kill+resume (the round-14 tentpole):
+    migrated-vs-killed request outcome on identical mid-decode interrupts.
+
+    Three engines: a BASELINE serving each prompt uninterrupted (the parity
+    reference and the no-interrupt gap distribution), a SOURCE + DEST pair
+    for the migrated arm (requests start on SOURCE, migrate mid-decode over
+    the seq_handoff pull dataplane, finish on DEST with the stream relayed),
+    and a kill+resume arm on SOURCE (cancel at the same point + preempt-
+    style resume — today's alternative). Reports exact token parity for the
+    migrated arm, the client-visible pause p99 (freeze -> first continuation
+    token), tokens salvaged by the KV pull, and the goodput delta between
+    the arms under a shared per-token ITL budget.
+
+    On CPU (no TPU in the build container) the section scales the geometry
+    down; parity and the salvage counters are exact either way, the
+    driver's TPU run prices pause/goodput at serving geometry."""
+    import gc
+
+    import jax
+
+    from dynamo_tpu.disagg.prefix_fetch import KvPullServer, PrefixFetchClient
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.engine.sampling import SamplingParams
+    from dynamo_tpu.engine.scheduler import EngineRequest
+    from dynamo_tpu.utils.goodput import RequestOutcome, outcome_meets
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu:
+        geom = {
+            "vocab_size": 512, "hidden_size": 256, "intermediate_size": 512,
+            "num_layers": 2, "num_heads": 4, "num_kv_heads": 2,
+            "head_dim": 64, "dtype": "f32",
+        }
+        base_id = "tiny:" + json.dumps(geom)
+        page_size, plen, vocab = 16, 96, 500
+        prefill_buckets = (32, 64, 128)
+        max_model_len = 256
+    else:
+        base_id = json_model_id()
+        page_size, plen, vocab = 64, 1536, 31000
+        prefill_buckets = (512, 1024, 2048)
+        max_model_len = 4096
+
+    half = osl // 2
+    pages_per_seq = -(-(plen + osl) // page_size) + 2
+    num_pages = (sessions + 2) * pages_per_seq + 8
+
+    def cfg():
+        return EngineConfig(
+            model_id=base_id, page_size=page_size, num_pages=num_pages,
+            max_seqs=4, max_model_len=max_model_len,
+            prefill_buckets=prefill_buckets, decode_steps=2,
+            pipeline_depth=2, migration_timeout_s=60.0,
+            # pre-compile every prefill-bucket/window variant: a cold XLA
+            # compile landing inside one measured handoff would otherwise
+            # dominate the pause percentiles (the warm migration below still
+            # covers the handoff-only executables like the part scatter)
+            warmup=True,
+        )
+
+    rng = np.random.default_rng(47)
+    mig_prompts = [rng.integers(1, vocab, plen).tolist() for _ in range(sessions)]
+    kill_prompts = [rng.integers(1, vocab, plen).tolist() for _ in range(sessions)]
+
+    def req_for(rid, prompt, max_tokens=osl):
+        return EngineRequest(
+            request_id=rid, token_ids=list(prompt),
+            sampling=SamplingParams(
+                temperature=0.0, max_tokens=max_tokens, ignore_eos=True
+            ),
+        )
+
+    async def collect(eng, req, stop_after=None):
+        """(tokens, arrival walls). stop_after=n breaks the stream after n
+        tokens (the kill arm's client walking through a worker death)."""
+        toks, walls = [], []
+        async for out in eng.generate(req):
+            if out.token is not None:
+                toks.append(out.token)
+                walls.append(time.monotonic())
+            if stop_after is not None and len(toks) >= stop_after:
+                break
+            if out.finished:
+                break
+        return toks, walls
+
+    async def wait_generated(eng, rid, n, timeout=120.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            seq = next(
+                (s for s in eng.scheduler.slots
+                 if s is not None and s.req.request_id == rid), None,
+            )
+            if seq is not None and len(seq.generated) >= n:
+                return True
+            await asyncio.sleep(0.005)
+        return False
+
+    cleanups = []
+    try:
+        baseline = AsyncJaxEngine(cfg())
+        await baseline.start()
+        cleanups.append(baseline.shutdown)
+        source = AsyncJaxEngine(cfg())
+        await source.start()
+        cleanups.append(source.shutdown)
+        dest = AsyncJaxEngine(cfg())
+        await dest.start()
+        cleanups.append(dest.shutdown)
+        srv = await KvPullServer(source, host="127.0.0.1").start()
+        cleanups.append(srv.stop)
+        source.kv_pull_server = srv
+        dest.attach_prefix_fetch(
+            PrefixFetchClient(asyncio.get_running_loop(), timeout_s=60.0)
+        )
+
+        # baseline arm: uninterrupted runs = the parity reference + the
+        # undisturbed per-token gap distribution (warm run compiles first)
+        await collect(baseline, req_for("warm-base", mig_prompts[0], 4))
+        expected, base_gaps = [], []
+        for i, p in enumerate(mig_prompts):
+            toks, walls = await collect(baseline, req_for(f"base-{i}", p))
+            expected.append(toks)
+            base_gaps.extend(np.diff(walls).tolist())
+
+        # migrated arm: start on SOURCE, freeze+handoff at `half` tokens,
+        # finish on DEST with the stream relayed through the source. Warm
+        # the WHOLE handoff path first (manifest, seq_handoff pull, scatter,
+        # adoption prefill executables) with a throwaway migration so the
+        # measured pauses price the handoff, not cold XLA compiles.
+        warm_prompt = rng.integers(1, vocab, plen).tolist()
+        wt = asyncio.ensure_future(collect(source, req_for("warm-mig", warm_prompt)))
+        if await wait_generated(source, "warm-mig", half):
+            await source.migrate_out("warm-mig", dest.adopt_migrated)
+        await wt
+        mig_tokens, mig_pauses, mig_gap_series = [], [], []
+        for i, p in enumerate(mig_prompts):
+            rid = f"mig-{i}"
+            task = asyncio.ensure_future(collect(source, req_for(rid, p)))
+            assert await wait_generated(source, rid, half), "migration arm stalled"
+            res = await source.migrate_out(rid, dest.adopt_migrated)
+            assert res["status"] == "ok", f"handoff failed: {res}"
+            toks, walls = await task
+            mig_tokens.append(toks)
+            mig_pauses.append(res["pause_s"])
+            mig_gap_series.append(np.diff(walls).tolist())
+
+        # kill arm: the worker DIES at the same point — the client's retry
+        # lands on the peer with the history as its prompt and NO KV to
+        # pull (the dead worker's pages are gone), so the whole history
+        # re-prefills cold. This is the outcome migration must beat; a
+        # same-worker resume would instead model preemption (its local
+        # prefix cache recovers the blocks, which a dead worker cannot).
+        kill_gap_series, kill_pauses = [], []
+        for i, p in enumerate(kill_prompts):
+            rid = f"kill-{i}"
+            got, walls = await collect(source, req_for(rid, p), stop_after=half)
+            rest, walls2 = await collect(
+                dest, req_for(f"{rid}-retry", list(p) + got, osl - len(got))
+            )
+            kill_pauses.append(walls2[0] - walls[-1] if walls2 else 0.0)
+            kill_gap_series.append(
+                np.diff(walls).tolist()
+                + ([walls2[0] - walls[-1]] if walls2 else [])
+                + np.diff(walls2).tolist()
+            )
+
+        # shared per-token ITL budget: generous over the undisturbed gap
+        # distribution, so only the interrupt stall can miss it
+        itl_budget = max(
+            float(np.percentile(base_gaps, 95)) * 3.0 if base_gaps else 0.05,
+            0.05,
+        )
+
+        def arm_goodput(series):
+            met = 0
+            for gaps in series:
+                out = RequestOutcome(
+                    "x", itl_s=tuple(gaps), output_tokens=len(gaps) + 1,
+                )
+                met += 1 if outcome_meets(out, None, itl_budget) else 0
+            return met / max(1, len(series))
+
+        gp_mig = arm_goodput(mig_gap_series)
+        gp_kill = arm_goodput(kill_gap_series)
+        parity = sum(
+            1 for got, want in zip(mig_tokens, expected) if got == want
+        ) / max(1, sessions)
+        dsched = dest.scheduler
+        assert parity == 1.0, (
+            f"migration broke token parity: {mig_tokens} != {expected}"
+        )
+        assert dsched.migration_in_pulled >= 1, "no handoff pull landed"
+        return {
+            "cpu_smoke": on_cpu,
+            "workload": {"sessions": sessions, "prompt_len": plen,
+                         "osl": osl, "migrate_at": half,
+                         "page_size": page_size},
+            "parity": parity,
+            "pause_ms_p50": round(float(np.percentile(mig_pauses, 50)) * 1e3, 1),
+            "pause_ms_p99": round(float(np.percentile(mig_pauses, 99)) * 1e3, 1),
+            "kill_pause_ms_p99": round(
+                float(np.percentile(kill_pauses, 99)) * 1e3, 1
+            ),
+            "tokens_salvaged": dsched.migration_tokens_salvaged,
+            "migrations_pulled": dsched.migration_in_pulled,
+            "migrations_recomputed": dsched.migration_in_recomputed,
+            "itl_budget_ms": round(itl_budget * 1e3, 1),
+            "goodput_migrated": round(gp_mig, 4),
+            "goodput_killed": round(gp_kill, 4),
+            "goodput_delta": round(gp_mig - gp_kill, 4),
+            "target": (
+                "parity exact; pause p99 under the kill+resume stall; "
+                "goodput_delta >= 0 (migrating a sequence must beat killing "
+                "it); salvaged tokens ~= sessions * committed history"
+            ),
+        }
+    finally:
+        for stop in reversed(cleanups):
+            try:
+                await stop()
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+        gc.collect()
+
+
 async def run_long_context(osl: int = 32) -> dict:
     """Long-context serving (round-8 tentpole): 16K/64K-token prompts
     end-to-end through the page-table width ladder + depth-aware chunked
@@ -2680,6 +2910,9 @@ async def run() -> dict:
         # fleet-wide prefix cache: cross-worker KV pull vs recompute on a
         # shared-system-prompt workload (exact parity + TTFT ratio)
         await _section("fleet_prefix", run_fleet_prefix, 1800)
+        # live migration: migrated-vs-killed mid-decode interrupts (exact
+        # parity, client-visible pause p99, tokens salvaged, goodput delta)
+        await _section("migration", run_migration, 1800)
         # long-context serving: 16K/64K TTFT + tok/s + KV high-watermark
         # through the page-table ladder, exact parity vs the dense path,
         # short-prompt no-regression ratio (CPU smoke scales down 16x)
@@ -2749,6 +2982,7 @@ def _summary(errors: dict) -> dict:
     dstream = DETAIL.get("disagg_stream")
     rout = DETAIL.get("parity_kv_routing")
     fleet = DETAIL.get("fleet_prefix")
+    mig = DETAIL.get("migration")
     lctx = DETAIL.get("long_context")
     off = DETAIL.get("parity_host_offload")
     quant = DETAIL.get("parity_quant_int8")
@@ -2861,11 +3095,17 @@ def _summary(errors: dict) -> dict:
         },
         "fleet_prefix": {
             "ttft_ratio_bf16": _get(fleet, "bf16", "ttft_ratio_hit_over_recompute"),
-            "ttft_ratio_int8": _get(fleet, "int8", "ttft_ratio_hit_over_recompute"),
-            # recompute_ratio + token_parity + raw pulled_bytes ride
-            # bench_detail.json (the section asserts parity itself; the wire
-            # ratio is the signal: int8 pulls half the bytes per page)
-            "wire_bytes_ratio_int8": _get(fleet, "wire_bytes_ratio_int8_over_bf16"),
+            # ttft_ratio_int8 + wire_bytes_ratio_int8 moved to
+            # bench_detail.json (summary-line truncation budget needed the
+            # bytes for the migration keys; the bf16 ratio is the gated one)
+        },
+        # live migration: exact-parity flag, client-visible pause p99, and
+        # the migrated-minus-killed goodput delta (salvage counters, kill
+        # pause, and the budget ride bench_detail.json)
+        "migration": {
+            "parity": _get(mig, "parity"),
+            "pause_ms_p99": _get(mig, "pause_ms_p99"),
+            "goodput_delta": _get(mig, "goodput_delta"),
         },
         # 16K/64K TTFT + KV high-watermark (acceptance keys; tok/s and the
         # dispatch histograms ride bench_detail.json)
@@ -2873,7 +3113,7 @@ def _summary(errors: dict) -> dict:
             "ttft_ms_16k": _get(lctx, "16k", "ttft_ms"),
             "ttft_ms_64k": _get(lctx, "64k", "ttft_ms"),
             "tok_s_64k": _get(lctx, "64k", "decode_tok_s"),
-            "kv_peak_64k": _get(lctx, "64k", "kv_pages_peak"),
+            # kv_peak_64k moved to bench_detail.json (truncation budget)
             "parity_64k": _get(lctx, "parity_64k_ladder_vs_dense"),
             "short_ratio": _get(lctx, "short_ttft_ratio_ladder_over_dense"),
         },

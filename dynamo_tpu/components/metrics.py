@@ -93,7 +93,12 @@ class MetricsService:
         gauges, and SLO state plus a fleet summary (dynotop's data source)."""
         now = time.monotonic()
         workers = []
-        summary = {"workers": 0, "servable": 0, "stale": 0, "unservable": 0}
+        summary = {
+            "workers": 0, "servable": 0, "stale": 0, "unservable": 0,
+            # workers mid-drain with live migration in flight (their
+            # sequences are moving to peers — disagg/migrate.py)
+            "migrating": 0,
+        }
         for view in self.aggregator.worker_views():
             health = view.health
             entry = {
@@ -116,6 +121,8 @@ class MetricsService:
             summary["servable"] += 1 if view.servable else 0
             summary["stale"] += 1 if view.stale else 0
             summary["unservable"] += 0 if is_snapshot_servable(health) else 1
+            if (health or {}).get("state") == "migrating":
+                summary["migrating"] += 1
         return {
             "namespace": self.namespace,
             "component": self.component,

@@ -68,6 +68,33 @@ class _PoolState:
     last_change: float = float("-inf")  # no cooldown before the first change
 
 
+@dataclass
+class RebalancePolicy:
+    """Hot-spot live-migration thresholds (disagg/migrate.py). A worker is
+    HOT when its KV occupancy crosses ``occupancy_hot`` or its windowed
+    goodput burns below ``goodput_floor`` while a COLD peer (occupancy under
+    ``occupancy_cold``) has headroom; sustained-signal + cooldown gating
+    mirrors the scaling policy so load noise can't thrash sequences around
+    the fleet."""
+
+    occupancy_hot: float = 0.85
+    occupancy_cold: float = 0.55
+    goodput_floor: float = 0.90
+    sustain: int = 3
+    cooldown_s: float = 60.0
+
+
+@dataclass
+class RebalanceDecision:
+    """One migrate-from-hot-to-cold recommendation, published to the
+    control-plane KV for the supervisor/operator to act on (the source
+    worker's /admin/drain with the target instance executes it)."""
+
+    source: str  # hot worker id (hex)
+    target: str  # cold worker id (hex)
+    reason: str
+
+
 class Planner:
     """Pure scaling policy. Feed observations; get decisions."""
 
@@ -77,12 +104,15 @@ class Planner:
         prefill_policy: PoolPolicy | None = None,
         # queue depth that saturates the prefill pressure signal per replica
         prefill_queue_per_worker: int = 4,
+        rebalance_policy: RebalancePolicy | None = None,
     ):
         self.decode_policy = decode_policy or PoolPolicy()
         self.prefill_policy = prefill_policy or PoolPolicy()
         self.prefill_queue_per_worker = prefill_queue_per_worker
+        self.rebalance_policy = rebalance_policy or RebalancePolicy()
         self._decode = _PoolState()
         self._prefill = _PoolState()
+        self._rebalance = _PoolState()
 
     # ---------------- signals ----------------
 
@@ -134,6 +164,58 @@ class Planner:
             state.above = state.below = 0
         return ScaleDecision(component, current, desired, reason)
 
+    def rebalance(
+        self, workers: list, now: Optional[float] = None
+    ) -> Optional[RebalanceDecision]:
+        """Hot-spot rebalancing off the /cluster/status signals: pick the
+        hottest and coldest migration-capable workers and, when the skew
+        sustains past the thresholds, recommend migrating load hot -> cold.
+
+        ``workers``: dicts with ``worker_id`` (hex str), ``occupancy``
+        (KV page-pool fraction), ``goodput`` (windowed SLO-met fraction or
+        None), ``servable`` (bool), ``migration`` (bool, adopts handoffs).
+        Pure policy — testable without a cluster."""
+        now = time.monotonic() if now is None else now
+        pol = self.rebalance_policy
+        state = self._rebalance
+        eligible = [
+            w for w in workers
+            if w.get("servable", True) and w.get("migration", True)
+        ]
+        decision = None
+        if len(eligible) >= 2:
+            hot = max(eligible, key=lambda w: w.get("occupancy", 0.0))
+            cold = min(eligible, key=lambda w: w.get("occupancy", 0.0))
+            occ_hot = hot.get("occupancy", 0.0)
+            occ_cold = cold.get("occupancy", 0.0)
+            gp = hot.get("goodput")
+            burning = gp is not None and gp < pol.goodput_floor
+            if (
+                hot is not cold
+                and occ_cold <= pol.occupancy_cold
+                and (occ_hot >= pol.occupancy_hot
+                     or (burning and occ_hot > occ_cold))
+            ):
+                reason = (
+                    f"occupancy {occ_hot:.2f}->{occ_cold:.2f}"
+                    + (f", goodput {gp:.2f} < {pol.goodput_floor}" if burning else "")
+                )
+                decision = RebalanceDecision(
+                    source=str(hot.get("worker_id")),
+                    target=str(cold.get("worker_id")),
+                    reason=reason,
+                )
+        if decision is None:
+            state.above = 0
+            return None
+        state.above += 1
+        in_cooldown = (now - state.last_change) < pol.cooldown_s
+        if state.above < pol.sustain or in_cooldown:
+            return None
+        state.last_change = now
+        state.above = 0
+        return decision
+
     def observe(
         self,
         decode_loads,  # list[WorkerLoad] scraped from the decode pool
@@ -159,6 +241,13 @@ class Planner:
 
 def desired_replicas_key(namespace: str, component: str) -> str:
     return f"planner/{namespace}/desired/{component}"
+
+
+def migrate_key(namespace: str, component: str) -> str:
+    """Control-plane KV key the planner publishes hot-spot rebalance
+    decisions under; the supervisor/operator executes them by POSTing the
+    source worker's /admin/drain with the target instance."""
+    return f"planner/{namespace}/migrate/{component}"
 
 
 class PlannerService:
@@ -187,6 +276,7 @@ class PlannerService:
         self.aggregator = KvMetricsAggregator(drt.cplane, namespace, decode_component)
         self._task: Optional[asyncio.Task] = None
         self.decisions: list[ScaleDecision] = []  # latest round
+        self.rebalance_decision: Optional[RebalanceDecision] = None
 
     async def start(self) -> None:
         self._task = asyncio.create_task(self._loop())
@@ -234,7 +324,44 @@ class PlannerService:
                 log.info(
                     "scale %s: %d -> %d (%s)", d.component, d.current, d.desired, d.reason
                 )
+        # hot-spot rebalancing (live migration): occupancy/goodput-burn skew
+        # across the decode pool becomes a migrate-hot-to-cold decision
+        rebalance = self.planner.rebalance(self._rebalance_inputs())
+        self.rebalance_decision = rebalance
+        if rebalance is not None:
+            await self.drt.cplane.kv_put(
+                migrate_key(self.namespace, self.decode_component),
+                json.dumps({
+                    "source": rebalance.source, "target": rebalance.target,
+                    "reason": rebalance.reason, "ts": time.time(),
+                }).encode(),
+            )
+            log.info(
+                "rebalance %s: migrate %s -> %s (%s)",
+                self.decode_component, rebalance.source, rebalance.target,
+                rebalance.reason,
+            )
         return decisions
+
+    def _rebalance_inputs(self) -> list[dict]:
+        """Per-worker rebalance signals from the scraped fleet view: KV
+        occupancy, windowed goodput, servability, migration capability."""
+        out = []
+        for view in self.aggregator.worker_views():
+            res = view.data.get("resources") or {}
+            total = res.get("kv_pages_total") or 0
+            used = res.get("kv_pages_used", 0)
+            gp = view.data.get("goodput") or {}
+            out.append({
+                "worker_id": f"{view.instance_id:x}",
+                "occupancy": (used / total) if total else 0.0,
+                "goodput": gp.get("goodput"),
+                "servable": view.servable,
+                "migration": bool(
+                    (view.data.get("migration") or {}).get("enabled", False)
+                ),
+            })
+        return out
 
     async def _loop(self) -> None:
         try:

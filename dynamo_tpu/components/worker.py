@@ -28,6 +28,7 @@ from dynamo_tpu.utils import get_logger
 log = get_logger("components.worker")
 
 GENERATE_ENDPOINT = "generate"
+MIGRATE_ENDPOINT = "migrate"
 
 
 class WorkerService:
@@ -41,6 +42,7 @@ class WorkerService:
         enable_disagg_decode: bool = False,
         register: bool = True,
         engine_factory=None,
+        admin_port: int | None = None,
     ):
         self.drt = drt
         self.namespace = namespace
@@ -61,6 +63,13 @@ class WorkerService:
         # export server; its address rides the stats broadcast so the KV
         # router can attach us as a holder (disagg/prefix_fetch.py)
         self.kv_pull_server = None
+        # live migration (disagg/migrate.py): the peer-facing `migrate`
+        # runtime endpoint adopts manifests; /admin/drain on the admin HTTP
+        # port triggers the migrate-then-die drain of THIS worker
+        self.admin_port = admin_port
+        self._admin_runner = None
+        self._migrate_served = None
+        self._migrate_client = None
 
     async def start(self) -> "WorkerService":
         loop = asyncio.get_running_loop()
@@ -106,6 +115,21 @@ class WorkerService:
         ep = self.drt.namespace(self.namespace).component(self.component).endpoint(GENERATE_ENDPOINT)
         self._served = await ep.serve_endpoint(self._handle, metrics=self._stats)
 
+        # live migration: adopt peers' manifests on `migrate`, and keep a
+        # client to the same endpoint so OUR drain can hand sequences out
+        if self.engine_config.migration and isinstance(inner, AsyncJaxEngine):
+            mep = (
+                self.drt.namespace(self.namespace)
+                .component(self.component)
+                .endpoint(MIGRATE_ENDPOINT)
+            )
+            self._migrate_served = await mep.serve_endpoint(self._handle_migrate)
+            self._migrate_client = await self.drt.client(
+                self.namespace, self.component, MIGRATE_ENDPOINT
+            )
+        if self.admin_port is not None:
+            await self._start_admin(self.admin_port)
+
         if self.register:
             entry = ModelEntry(
                 name=self.card.display_name,
@@ -141,6 +165,12 @@ class WorkerService:
         return self
 
     async def stop(self) -> None:
+        if self._admin_runner is not None:
+            await self._admin_runner.cleanup()
+        if self._migrate_served is not None:
+            await self._migrate_served.stop()
+        if self._migrate_client is not None:
+            await self._migrate_client.stop()
         for reg in getattr(self, "_lora_registrations", ()):
             await reg.stop(unregister=False)
         if getattr(self, "_registration", None) is not None:
@@ -185,6 +215,12 @@ class WorkerService:
             # windowed per-scenario/tenant SLO-met fraction (dynotop GOODPUT
             # column; item-5 QoS scheduling reads the per-tenant view)
             stats["goodput"] = goodput()
+        # live migration: whether this worker adopts peers' sequences (the
+        # planner's rebalance decisions only target migration-enabled pairs)
+        stats["migration"] = {
+            "enabled": bool(getattr(self.engine_config, "migration", False))
+            and self._migrate_client is not None,
+        }
         if self.kv_pull_server is not None:
             # the fleet prefix cache's discovery channel: routers read the
             # pull address out of this broadcast to attach us as a holder
@@ -214,6 +250,117 @@ class WorkerService:
                     "address": kv.address,
                 }
         return stats
+
+    # ---------------- live migration (disagg/migrate.py) ----------------
+
+    async def _handle_migrate(self, request: dict):
+        """Peer-facing adoption endpoint: a draining/hot peer ships one
+        sequence's manifest here; we adopt it (seq_handoff KV pull with
+        recompute fallback) and stream the continuation tokens back — the
+        peer relays them into its still-open client stream."""
+        from dynamo_tpu.disagg.migrate import SequenceManifest
+
+        manifest = SequenceManifest.from_wire(request)
+        async for out in self._inner_engine.adopt_migrated(manifest):
+            yield {
+                "request_id": out.request_id,
+                "token": out.token,
+                "finished": out.finished,
+                "finish_reason": out.finish_reason,
+                "cached_tokens": out.cached_tokens,
+            }
+
+    def _peer_adopter(self, instance_id: int):
+        """Adapter from the peer's `migrate` stream to the StepOutput shape
+        AsyncJaxEngine.migrate_out relays."""
+        from dynamo_tpu.engine.scheduler import StepOutput
+
+        async def adopter(manifest):
+            stream = await self._migrate_client.direct(
+                manifest.to_wire(), instance_id
+            )
+            async for item in stream:
+                yield StepOutput(
+                    request_id=item.get("request_id", manifest.request_id),
+                    token=item.get("token"),
+                    finished=bool(item.get("finished")),
+                    finish_reason=item.get("finish_reason"),
+                    cached_tokens=int(item.get("cached_tokens", 0) or 0),
+                )
+
+        return adopter
+
+    async def drain(self, target_instance: int | None = None) -> dict:
+        """Operator drain, migrate-then-die instead of drain-by-attrition:
+        mark this worker draining (routers/planner stop sending work), hand
+        every in-flight sequence to a peer worker of the same component, and
+        report what moved. Sequences whose handoff fails keep decoding here
+        (never worse than attrition). The caller shuts the worker down once
+        this returns."""
+        eng = self._inner_engine
+        health = getattr(eng, "health", None)
+        if health is not None:
+            health.set_state("draining", "operator drain requested")
+        results = {"migrated": 0, "resumed": 0, "failed": 0, "skipped": 0}
+        if not getattr(self.engine_config, "migration", True) or self._migrate_client is None:
+            return {**results, "migration": "disabled"}
+        if target_instance is None:
+            me = self.drt.primary_lease.lease_id
+            peers = [i for i in self._migrate_client.instance_ids() if i != me]
+            target_instance = peers[0] if peers else None
+        if target_instance is None:
+            log.warning("drain: no migration peer available; draining by attrition")
+            return {**results, "migration": "no-peer"}
+        if health is not None:
+            health.set_state("migrating", "drain: handing sequences to peer")
+        adopter = self._peer_adopter(target_instance)
+        sched = eng.scheduler
+        rids = [
+            s.req.request_id for s in sched.slots
+            if s is not None and not s.finished
+        ]
+        for rid in rids:
+            try:
+                res = await eng.migrate_out(rid, adopter)
+            except Exception:
+                log.exception("drain: migration of %s crashed", rid)
+                results["failed"] += 1
+                continue
+            status = res.get("status", "failed")
+            results["migrated" if status == "ok" else
+                    status if status in results else "failed"] += 1
+        if health is not None:
+            health.set_state("draining", "drain: migration pass complete")
+        results["migration"] = "done"
+        results["target_instance"] = f"{target_instance:x}"
+        log.info("drain complete: %s", results)
+        return results
+
+    async def _start_admin(self, port: int) -> None:
+        """Tiny operator-facing HTTP plane: POST /admin/drain {target?:
+        "<instance hex>"} triggers the migrate-then-die drain."""
+        from aiohttp import web
+
+        app = web.Application()
+
+        async def _drain(request: web.Request) -> web.Response:
+            target = None
+            try:
+                body = await request.json()
+            except Exception:
+                body = {}
+            if isinstance(body, dict) and body.get("target"):
+                target = int(str(body["target"]), 16)
+            result = await self.drain(target_instance=target)
+            return web.json_response(result)
+
+        app.router.add_post("/admin/drain", _drain)
+        self._admin_runner = web.AppRunner(app, access_log=None)
+        await self._admin_runner.setup()
+        site = web.TCPSite(self._admin_runner, "127.0.0.1", port)
+        await site.start()
+        self.admin_port = site._server.sockets[0].getsockname()[1]
+        log.info("worker admin endpoint on 127.0.0.1:%d", self.admin_port)
 
     async def _handle(self, request: dict):
         pre = PreprocessedRequest.from_wire(request)
@@ -287,6 +434,8 @@ async def _main(args) -> None:
             prefix_fetch=not getattr(args, "no_prefix_fetch", False),
             prefix_fetch_timeout_s=getattr(args, "prefix_fetch_timeout_s", None) or 5.0,
             prefix_fetch_min_blocks=getattr(args, "prefix_fetch_min_blocks", None) or 1,
+            migration=not getattr(args, "no_migration", False),
+            migration_timeout_s=getattr(args, "migration_timeout_s", None) or 10.0,
             slo_ttft_ms=getattr(args, "slo_ttft_ms", None),
             slo_itl_ms=getattr(args, "slo_itl_ms", None),
             prefill_buckets=tuple(
@@ -298,6 +447,7 @@ async def _main(args) -> None:
             offload_watermark=getattr(args, "offload_watermark", None) or 0.90,
         ),
         enable_disagg_decode=args.disagg,
+        admin_port=getattr(args, "admin_port", None),
     )
     await svc.start()
     log.info(
@@ -373,6 +523,18 @@ def main(argv=None) -> None:
     p.add_argument("--prefix-fetch-min-blocks", type=int, default=1,
                    help="minimum holder advantage (blocks) over the local "
                         "prefix cache before a pull is worth issuing")
+    p.add_argument("--no-migration", action="store_true",
+                   help="disable live sequence migration (drain degrades to "
+                        "attrition and the frontend answers retriable 503s "
+                        "while draining)")
+    p.add_argument("--migration-timeout-s", type=float, default=10.0,
+                   help="deadline belt on one sequence handoff (KV pull + "
+                        "first continuation token); on expiry the sequence "
+                        "resumes decoding locally")
+    p.add_argument("--admin-port", type=int, default=None,
+                   help="operator admin HTTP port on 127.0.0.1 (0 = "
+                        "ephemeral): POST /admin/drain migrates in-flight "
+                        "sequences to a peer and marks this worker draining")
     p.add_argument("--prefill-buckets", default="",
                    help="comma-separated padded prefill chunk lengths (e.g. "
                         "512,1024,2048 for long-context configs); empty = "

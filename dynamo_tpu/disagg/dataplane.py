@@ -46,6 +46,7 @@ nobody expects (cancelled, duplicate) are received and dropped.
 from __future__ import annotations
 
 import asyncio
+import random
 import secrets
 import struct
 from dataclasses import dataclass, field
@@ -417,7 +418,19 @@ class KvDataPlaneServer:
 
 class KvDataPlaneClient:
     """Prefill-side sender: N parallel lanes per destination, parts striped
-    round-robin across them."""
+    round-robin across them.
+
+    Reconnects use bounded exponential backoff with jitter: a restarting
+    receiver briefly refuses connections, and the old immediate-retry
+    behavior either lost the frame (second attempt also refused) or — at
+    fleet scale — hammered the recovering peer with synchronized retries.
+    Each reconnect counts into ``dynamo_kv_stream_reconnects_total``."""
+
+    #: reconnect backoff envelope: base * 2^attempt, jittered to [0.5, 1.0]x,
+    #: capped — worst case ~0.35 s of extra latency across all retries
+    BACKOFF_BASE_S = 0.05
+    BACKOFF_MAX_S = 1.0
+    MAX_ATTEMPTS = 3
 
     def __init__(self, lanes: int = 1):
         self.lanes = max(1, int(lanes))
@@ -426,6 +439,7 @@ class KvDataPlaneClient:
         self._rr: dict[str, int] = {}
         self.sent = 0  # payload frames written (every part counts)
         self.bytes_sent = 0
+        self.reconnects = 0  # lane re-opens after a stale/refused socket
 
     async def send(
         self, address: str, request_id: str, array, token: str = "",
@@ -445,6 +459,18 @@ class KvDataPlaneClient:
         page_from: int = -1, page_to: int = -1, cat_axis: int = 2,
         scales: np.ndarray | None = None,
     ) -> None:
+        from dynamo_tpu.disagg.faults import active_plan
+
+        plan = active_plan()
+        if plan is not None:
+            delay = plan.delay_s("push")
+            if delay > 0:
+                await asyncio.sleep(delay)
+            if plan.should_drop("push"):
+                # injected part loss: the receiver's transfer stays
+                # incomplete and ITS timeout/fallback path must fire
+                log.warning("fault: dropping push part %d for %s", part_seq, request_id)
+                return
         if isinstance(array, dict):  # int8 wire dict: q = payload, s = header
             scales = array["s"] if scales is None else scales
             array = array["q"]
@@ -458,6 +484,8 @@ class KvDataPlaneClient:
         # which every other sender to this lane is stalled behind us —
         # per-part hashing also bounds each stall to one part, not one prompt
         digest = xxhash.xxh3_64_intdigest(payload)
+        if plan is not None and plan.should_corrupt("push"):
+            digest = (digest ^ 1) & 0xFFFFFFFFFFFFFFFF
         fields = {
             "request_id": request_id,
             "shape": list(array.shape),
@@ -484,7 +512,7 @@ class KvDataPlaneClient:
         key = (address, lane)
         lock = self._locks.setdefault(key, asyncio.Lock())
         async with lock:  # one in-flight frame per lane
-            for attempt in (0, 1):  # one reconnect on a stale pooled socket
+            for attempt in range(self.MAX_ATTEMPTS):
                 try:
                     conn = self._conns.get(key)
                     if conn is not None and (conn[0].at_eof() or conn[1].is_closing()):
@@ -493,6 +521,7 @@ class KvDataPlaneClient:
                         # detect it up front instead of losing the frame
                         conn[1].close()
                         self._conns.pop(key, None)
+                        self.reconnects += 1
                         conn = None
                     if conn is None:
                         host, _, port = address.rpartition(":")
@@ -512,8 +541,17 @@ class KvDataPlaneClient:
                         # close the dead transport before retrying — popping
                         # alone leaks the socket fd until GC
                         stale[1].close()
-                    if attempt:
+                    if attempt == self.MAX_ATTEMPTS - 1:
                         raise
+                    # bounded exponential backoff with jitter before the
+                    # reconnect: a recovering receiver must not eat a
+                    # synchronized immediate-retry stampede, and the jitter
+                    # ([0.5, 1.0]x) decorrelates lanes that failed together
+                    delay = min(self.BACKOFF_MAX_S,
+                                self.BACKOFF_BASE_S * (1 << attempt))
+                    delay *= 0.5 + 0.5 * random.random()
+                    self.reconnects += 1
+                    await asyncio.sleep(delay)
 
     async def close(self) -> None:
         for _, writer in self._conns.values():
@@ -536,5 +574,11 @@ class KvDataPlaneClient:
                 "dynamo_kv_stream_lanes", "gauge",
                 "parallel data-plane connections per destination",
                 [({}, self.lanes)],
+            ),
+            render_family(
+                "dynamo_kv_stream_reconnects_total", "counter",
+                "data-plane lane re-opens after a stale or refused socket "
+                "(each retried with bounded exponential backoff + jitter)",
+                [({}, self.reconnects)],
             ),
         ])

@@ -1,0 +1,132 @@
+"""Deterministic fault injection for the disagg dataplane wires.
+
+The migration failure ladder and the prefix-fetch fallback tests used to
+need real socket blackholes (an accepting server that never answers) to
+exercise the timeout arms. Those are slow (the test eats the whole timeout),
+racy across platforms, and can't target one wire *kind* at a time. This
+module replaces them with seeded, per-kind chaos knobs every dataplane
+producer honors:
+
+    DYNTPU_FAULT_DATAPLANE="seq_handoff=drop-part,push=delay-ms:50"
+    DYNTPU_FAULT_SEED=7
+
+Grammar: comma-separated ``<kind>=<fault>[:<arg>]`` rules.
+
+  kinds:   ``push``         — the KV stream client (dataplane.send_part)
+           ``prefix_fetch`` — the pull server's shared-prefix export
+           ``seq_handoff``  — the pull server's per-sequence migration export
+           ``*``            — every kind
+  faults:  ``drop-part[:p]``        — silently skip sending a part (the
+                                      receiver's own timeout must fire; the
+                                      frame is never written, exactly what a
+                                      blackholed socket looks like)
+           ``delay-ms:<ms>``        — sleep before each frame (latency
+                                      injection; async sites await it)
+           ``corrupt-checksum[:p]`` — send a wrong xxh3 so the receiver's
+                                      per-part integrity check must reject
+
+``p`` is a probability in [0, 1] (default 1.0 = every part): probabilistic
+faults draw from a per-(kind, fault) ``random.Random`` seeded from
+DYNTPU_FAULT_SEED, so a given seed produces the same drop pattern on every
+run — chaos tests are replayable, not flaky.
+
+The plan is re-resolved from the environment on each lookup (cached by spec
+string), so tests can monkeypatch the env per-arm without reimporting
+producers. An empty/unset env means zero overhead: one dict.get and out.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from typing import Optional
+
+FAULT_KINDS = ("push", "prefix_fetch", "seq_handoff")
+FAULTS = ("drop-part", "delay-ms", "corrupt-checksum")
+
+ENV_SPEC = "DYNTPU_FAULT_DATAPLANE"
+ENV_SEED = "DYNTPU_FAULT_SEED"
+
+
+class FaultPlan:
+    """Parsed fault rules: per-kind drop/delay/corrupt decisions."""
+
+    def __init__(self, spec: str, seed: int = 0):
+        self.spec = spec
+        self.seed = seed
+        # (kind, fault) -> arg (probability or milliseconds)
+        self._rules: dict[tuple[str, str], float] = {}
+        self._rngs: dict[tuple[str, str], random.Random] = {}
+        for rule in filter(None, (r.strip() for r in spec.split(","))):
+            kind, _, fault_spec = rule.partition("=")
+            kind = kind.strip()
+            if kind != "*" and kind not in FAULT_KINDS:
+                raise ValueError(
+                    f"unknown dataplane fault kind {kind!r} "
+                    f"(expected one of {FAULT_KINDS} or '*')"
+                )
+            fault, _, arg = fault_spec.partition(":")
+            fault = fault.strip()
+            if fault not in FAULTS:
+                raise ValueError(
+                    f"unknown dataplane fault {fault!r} (expected one of {FAULTS})"
+                )
+            if arg:
+                value = float(arg)
+            else:
+                if fault == "delay-ms":
+                    raise ValueError("delay-ms requires a milliseconds arg")
+                value = 1.0
+            kinds = FAULT_KINDS if kind == "*" else (kind,)
+            for k in kinds:
+                self._rules[(k, fault)] = value
+
+    def _hit(self, kind: str, fault: str) -> bool:
+        p = self._rules.get((kind, fault))
+        if p is None:
+            return False
+        if p >= 1.0:
+            return True
+        key = (kind, fault)
+        rng = self._rngs.get(key)
+        if rng is None:
+            # per-(kind, fault) stream off the plan seed: deterministic per
+            # process for a given seed, independent across rules
+            rng = self._rngs[key] = random.Random(
+                (self.seed << 8) ^ hash(key) & 0x7FFFFFFF
+            )
+        return rng.random() < p
+
+    def should_drop(self, kind: str) -> bool:
+        return self._hit(kind, "drop-part")
+
+    def should_corrupt(self, kind: str) -> bool:
+        return self._hit(kind, "corrupt-checksum")
+
+    def delay_s(self, kind: str) -> float:
+        ms = self._rules.get((kind, "delay-ms"), 0.0)
+        return ms / 1000.0
+
+
+_CACHE: dict[tuple[str, int], FaultPlan] = {}
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The fault plan the environment currently asks for (None = no faults).
+
+    Parsed plans cache by (spec, seed) so the per-part cost of a configured
+    plan is one dict lookup; a malformed spec raises at the first part — a
+    chaos knob typo must fail the test loudly, not silently inject nothing.
+    """
+    spec = os.environ.get(ENV_SPEC, "").strip()
+    if not spec:
+        return None
+    try:
+        seed = int(os.environ.get(ENV_SEED, "0") or 0)
+    except ValueError:
+        seed = 0
+    key = (spec, seed)
+    plan = _CACHE.get(key)
+    if plan is None:
+        plan = _CACHE[key] = FaultPlan(spec, seed)
+    return plan

@@ -1,0 +1,169 @@
+"""Live sequence migration: hand an in-flight sequence to a peer mid-decode.
+
+The composition ROADMAP item 4 names: chained block identity any worker can
+recompute (llm/tokens.py), the peer-to-peer KV pull protocol with its
+timeout->recompute fallback (disagg/prefix_fetch.py, extended with a
+``seq_handoff`` kind that exports *per-sequence* page runs), and the
+remote-adopt scheduler rebuild from authoritative token history. A draining
+or hot worker snapshots a sequence's authoritative state into the small
+msgpack ``SequenceManifest`` below, ships it to the destination, and the
+destination re-enters the sequence through its normal admission path:
+
+  - the manifest's token history (prompt + every generated token) IS the
+    sequence — sampling state is positional (fold_seed keys draws by
+    (seed, position)), penalties restore from ``penalty_output_from``, the
+    draft-model cache and LoRA slot pins rebuild at admission exactly like a
+    preemption resume, so the continuation is token-identical for greedy and
+    seeded lanes;
+  - committed KV pages ship over the pull dataplane (``kv_handoff_seq``
+    drives the scheduler's FETCHING_KV state at the destination with the
+    ``seq_handoff`` fetch kind); a timeout, a dead source, or corrupt parts
+    degrade to chunked recompute from the same history — migration is
+    *never worse* than today's preempt+recompute;
+  - the source relays the destination's continuation tokens into the
+    original output stream (AsyncJaxEngine.migrate_out), so the client sees
+    ONE uninterrupted stream, re-pinned to the new worker.
+
+The manifest is deliberately tiny (tokens + sampling scalars, no KV): a
+128-token conversation manifests in ~1 KB of msgpack; the KV rides the
+existing bulk dataplane where it belongs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Optional
+
+import msgpack
+
+from dynamo_tpu.engine.sampling import SamplingParams
+from dynamo_tpu.engine.scheduler import EngineRequest
+from dynamo_tpu.utils import get_logger
+
+log = get_logger("disagg.migrate")
+
+
+@dataclass
+class SequenceManifest:
+    """Authoritative snapshot of one in-flight sequence, small enough to
+    ship in a control-plane message. Everything the destination needs to
+    continue the stream token-identically — and nothing it can recompute
+    from the history itself (block hashes, for instance, derive from
+    tokens + salt on either side)."""
+
+    request_id: str
+    prompt_tokens: list = field(default_factory=list)
+    generated: list = field(default_factory=list)  # tokens already emitted
+    sampling: dict = field(default_factory=dict)  # asdict(SamplingParams)
+    eos_token_ids: list = field(default_factory=list)
+    lora_name: str = ""
+    logprobs: Optional[int] = None
+    # prior-output split for presence/frequency penalties (the ORIGINAL
+    # prompt end; earlier preemptions/migrations carry their split forward)
+    penalty_output_from: Optional[int] = None
+    trace_id: Optional[str] = None
+    tenant: str = ""
+    scenario: str = ""
+    # KV handoff: the source worker's pull-server address and how many full
+    # committed blocks of the history it can export via ``seq_handoff``
+    source_addr: str = ""
+    kv_blocks: int = 0
+    # request age at snapshot time (seconds): the destination back-dates
+    # enqueue_ts so goodput/duration accounting spans the whole request
+    age_s: float = 0.0
+
+    # ---------------- wire ----------------
+
+    def to_wire(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_wire(cls, data: dict) -> "SequenceManifest":
+        known = {f.name for f in dataclasses.fields(cls)}
+        m = cls(**{k: v for k, v in data.items() if k in known})
+        if "stop" in m.sampling:
+            # msgpack flattens tuples to lists; SamplingParams.stop is a
+            # tuple — normalize so roundtrips are byte-stable
+            m.sampling = {**m.sampling, "stop": tuple(m.sampling["stop"])}
+        return m
+
+    def pack(self) -> bytes:
+        """Compact msgpack form (the cross-worker wire payload)."""
+        return msgpack.packb(self.to_wire())
+
+    @classmethod
+    def unpack(cls, raw: bytes) -> "SequenceManifest":
+        return cls.from_wire(msgpack.unpackb(raw))
+
+    # ---------------- reconstruction ----------------
+
+    @property
+    def history(self) -> list:
+        return list(self.prompt_tokens) + list(self.generated)
+
+    def to_engine_request(self, now: Optional[float] = None) -> EngineRequest:
+        """The destination's admission request: the preemption-resume shape
+        (history as prompt, budgets reduced by what already streamed) plus
+        the seq_handoff pull hints so admission fetches the committed KV
+        instead of recomputing it."""
+        s = SamplingParams(**self.sampling)
+        done = len(self.generated)
+        sampling = dataclasses.replace(
+            s,
+            max_tokens=max(1, s.max_tokens - done),
+            min_tokens=max(0, s.min_tokens - done),
+        )
+        return EngineRequest(
+            request_id=self.request_id,
+            token_ids=self.history,
+            sampling=sampling,
+            eos_token_ids=tuple(self.eos_token_ids),
+            logprobs=self.logprobs,
+            penalty_output_from=(
+                self.penalty_output_from
+                if self.penalty_output_from is not None
+                else len(self.prompt_tokens)
+            ),
+            enqueue_ts=max(0.0, now - self.age_s) if now else 0.0,
+            trace_id=self.trace_id,
+            tenant=self.tenant,
+            scenario=self.scenario,
+            lora_name=self.lora_name,
+            kv_holder_addr=self.source_addr,
+            kv_holder_blocks=self.kv_blocks,
+            kv_handoff_seq=self.request_id,
+        )
+
+    def to_resume_request(self, relayed: list, now: float) -> EngineRequest:
+        """The source's local-resume request after a FAILED handoff that
+        already relayed ``relayed`` destination tokens into the client
+        stream: history + relayed tokens become the prompt (their KV
+        recomputes; the prefix cache usually still holds the committed
+        blocks), budgets shrink by everything already delivered. Exactly the
+        preemption-resume contract — the failure arm of the ladder is
+        literally today's preempt+recompute."""
+        s = SamplingParams(**self.sampling)
+        done = len(self.generated) + len(relayed)
+        sampling = dataclasses.replace(
+            s,
+            max_tokens=max(1, s.max_tokens - done),
+            min_tokens=max(0, s.min_tokens - done),
+        )
+        return EngineRequest(
+            request_id=self.request_id,
+            token_ids=self.history + list(relayed),
+            sampling=sampling,
+            eos_token_ids=tuple(self.eos_token_ids),
+            logprobs=self.logprobs,
+            penalty_output_from=(
+                self.penalty_output_from
+                if self.penalty_output_from is not None
+                else len(self.prompt_tokens)
+            ),
+            enqueue_ts=now,
+            trace_id=self.trace_id,
+            tenant=self.tenant,
+            scenario=self.scenario,
+            lora_name=self.lora_name,
+        )

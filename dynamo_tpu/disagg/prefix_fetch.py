@@ -34,6 +34,7 @@ Wire format (shared framing with the push plane: ``u32 len | msgpack
 header [| payload]``):
 
     request:  {kind: "prefix_fetch", hashes: [u64, ...]}
+              | {kind: "seq_handoff", seq_id, hashes: [u64, ...]}
     response: 1..N part frames, each
               {status: "ok", part_seq, part_total, block_from, block_to,
                tier: "hbm"|"host", shape, dtype, xxh3, cat_axis
@@ -47,6 +48,18 @@ ship int8 page data (half the wire bytes) with the per-row scale plane
 riding the part header, exactly like the push protocol — and because the
 parts land in ``ModelRunner.inject_pages_bucketed``, mixed-dtype peers
 interoperate (scatter_pages_wire re/de-quantizes).
+
+``seq_handoff`` (live migration, disagg/migrate.py) is the same response
+wire driven by a different export: instead of walking the *shared prefix
+cache*, the server exports the named live sequence's OWN page run — full
+committed blocks of a mid-decode sequence, including decode-written blocks
+whose cache registration deduped onto another page — so a migrating
+sequence's KV follows it to the destination worker.
+
+Both serve paths honor the seeded chaos knobs in ``disagg/faults.py``
+(DYNTPU_FAULT_DATAPLANE: drop-part / delay-ms / corrupt-checksum), so the
+failure ladder tests drive real timeout/corruption arms deterministically
+instead of standing up socket blackholes.
 """
 
 from __future__ import annotations
@@ -149,6 +162,7 @@ class KvPullServer:
         self.errors = 0
         self.served_blocks = {"hbm": 0, "host": 0}
         self.bytes_sent = 0
+        self.handoffs_served = 0  # seq_handoff exports answered with blocks
 
     @property
     def address(self) -> str:
@@ -187,9 +201,13 @@ class KvPullServer:
                 if hlen > MAX_HEADER:
                     raise ValueError(f"prefix fetch header too large: {hlen}")
                 header = msgpack.unpackb(await reader.readexactly(hlen))
-                if header.get("kind") != "prefix_fetch":
-                    raise ValueError(f"unexpected frame kind {header.get('kind')!r}")
-                await self._serve_fetch(writer, list(header.get("hashes", ())))
+                kind = header.get("kind")
+                if kind not in ("prefix_fetch", "seq_handoff"):
+                    raise ValueError(f"unexpected frame kind {kind!r}")
+                await self._serve_fetch(
+                    writer, list(header.get("hashes", ())), kind=kind,
+                    seq_id=str(header.get("seq_id", "") or ""),
+                )
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         except Exception:
@@ -207,14 +225,33 @@ class KvPullServer:
         writer.write(header)
         await writer.drain()
 
-    async def _serve_fetch(self, writer, hashes: list[int]) -> None:
+    async def _serve_fetch(
+        self, writer, hashes: list[int], kind: str = "prefix_fetch",
+        seq_id: str = "",
+    ) -> None:
+        from dynamo_tpu.disagg.faults import active_plan
+
         engine = self.engine
+        plan = active_plan()
+        if plan is not None:
+            delay = plan.delay_s(kind)
+            if delay > 0:
+                await asyncio.sleep(delay)
         export = None
         if hashes and engine is not None:
             try:
-                export = await engine.run_on_engine(
-                    lambda: engine.sync_export_prefix(hashes)
-                )
+                if kind == "seq_handoff":
+                    # live migration: export the named sequence's OWN page
+                    # run (committed blocks of a mid-decode sequence),
+                    # falling back to the shared prefix cache when the
+                    # sequence has already been released
+                    export = await engine.run_on_engine(
+                        lambda: engine.sync_export_sequence(seq_id, hashes)
+                    )
+                else:
+                    export = await engine.run_on_engine(
+                        lambda: engine.sync_export_prefix(hashes)
+                    )
             except Exception:
                 log.exception("prefix export failed")
                 self.errors += 1
@@ -250,7 +287,17 @@ class KvPullServer:
             return
         total = len(parts)
         for seq, (b0, b1, tier, data) in enumerate(parts):
+            if plan is not None and plan.should_drop(kind):
+                # injected blackhole: the frame is never written, so the
+                # requester's own timeout must unwedge it (the exact failure
+                # a dead socket produces, without a real dead socket)
+                log.warning("fault: dropping %s part %d for test", kind, seq)
+                continue
             header, payload = _pack_part(seq, total, b0, b1, tier, data, axis)
+            if plan is not None and plan.should_corrupt(kind):
+                fields = msgpack.unpackb(header)
+                fields["xxh3"] = (fields["xxh3"] ^ 1) & 0xFFFFFFFFFFFFFFFF
+                header = msgpack.packb(fields)
             writer.write(_LEN.pack(len(header)))
             writer.write(header)
             writer.write(payload)
@@ -258,6 +305,8 @@ class KvPullServer:
             self.served_blocks[tier] = self.served_blocks.get(tier, 0) + (b1 - b0)
             self.bytes_sent += payload.nbytes
         self.served += 1
+        if kind == "seq_handoff":
+            self.handoffs_served += 1
 
     # ---------------- metrics ----------------
 
@@ -304,19 +353,32 @@ class PrefixFetchClient:
             _FETCH_SECONDS_BUCKETS,
         )
 
-    def fetch(self, addr: str, hashes: list[int], timeout_s: Optional[float] = None):
-        """Start a pull; returns a concurrent.futures.Future[PrefixFetchResult]."""
+    def fetch(
+        self, addr: str, hashes: list[int], timeout_s: Optional[float] = None,
+        kind: str = "prefix_fetch", seq_id: str = "",
+    ):
+        """Start a pull; returns a concurrent.futures.Future[PrefixFetchResult].
+        ``kind="seq_handoff"`` + ``seq_id`` pulls a live sequence's own page
+        run off a migrating source instead of the shared prefix cache."""
         if self._loop is None or self._loop.is_closed():
             raise RuntimeError("prefix fetch client has no running event loop")
         return asyncio.run_coroutine_threadsafe(
-            self._fetch(addr, list(hashes), timeout_s or self.timeout_s), self._loop
+            self._fetch(addr, list(hashes), timeout_s or self.timeout_s,
+                        kind=kind, seq_id=seq_id),
+            self._loop,
         )
 
-    async def _fetch(self, addr: str, hashes: list[int], timeout_s: float) -> PrefixFetchResult:
+    async def _fetch(
+        self, addr: str, hashes: list[int], timeout_s: float,
+        kind: str = "prefix_fetch", seq_id: str = "",
+    ) -> PrefixFetchResult:
         self.requests += 1
         t0 = time.monotonic()
         try:
-            res = await asyncio.wait_for(self._fetch_inner(addr, hashes), timeout_s)
+            res = await asyncio.wait_for(
+                self._fetch_inner(addr, hashes, kind=kind, seq_id=seq_id),
+                timeout_s,
+            )
         except asyncio.TimeoutError:
             res = PrefixFetchResult(status="timeout")
         except asyncio.CancelledError:
@@ -331,11 +393,17 @@ class PrefixFetchClient:
             log.debug("prefix fetch from %s: %s %s", addr, res.status, res.error)
         return res
 
-    async def _fetch_inner(self, addr: str, hashes: list[int]) -> PrefixFetchResult:
+    async def _fetch_inner(
+        self, addr: str, hashes: list[int], kind: str = "prefix_fetch",
+        seq_id: str = "",
+    ) -> PrefixFetchResult:
         host, _, port = addr.rpartition(":")
         reader, writer = await asyncio.open_connection(host, int(port))
         try:
-            req = msgpack.packb({"kind": "prefix_fetch", "hashes": hashes})
+            fields = {"kind": kind, "hashes": hashes}
+            if seq_id:
+                fields["seq_id"] = seq_id
+            req = msgpack.packb(fields)
             writer.write(_LEN.pack(len(req)))
             writer.write(req)
             await writer.drain()

@@ -118,6 +118,18 @@ class EngineConfig:
     # degrades to recompute — never an error to the client.
     prefix_fetch: bool = True
     prefix_fetch_timeout_s: float = 5.0
+    # live sequence migration (disagg/migrate.py): this engine may hand its
+    # in-flight sequences to a peer mid-decode (drain/rebalance) and adopt a
+    # peer's. The committed KV rides the pull dataplane via the seq_handoff
+    # kind; a failed handoff resumes locally / recomputes from history, so
+    # migration is never worse than preempt+recompute. False = the engine
+    # refuses adoptions and drain degrades to attrition (and a draining
+    # frontend answers a retriable 503 instead).
+    migration: bool = True
+    # deadline belt on one handoff: the destination's KV pull AND the
+    # source's wait for the destination's first continuation token are both
+    # bounded by this — on expiry the source resumes decoding locally
+    migration_timeout_s: float = 10.0
     # only fetch when the holder's advantage over the local prefix cache is at
     # least this many blocks (a one-block pull rarely beats its own overhead)
     prefix_fetch_min_blocks: int = 1
@@ -199,6 +211,10 @@ class EngineConfig:
         if self.prefix_fetch_timeout_s <= 0:
             raise ValueError(
                 f"prefix_fetch_timeout_s must be > 0; got {self.prefix_fetch_timeout_s}"
+            )
+        if self.migration_timeout_s <= 0:
+            raise ValueError(
+                f"migration_timeout_s must be > 0; got {self.migration_timeout_s}"
             )
         if self.kv_stream_lanes < 1:
             raise ValueError(

@@ -26,12 +26,18 @@ from dynamo_tpu.runtime.context import current_context
 from dynamo_tpu.utils import get_logger, tracing
 from dynamo_tpu.utils.goodput import GoodputTracker
 from dynamo_tpu.utils.health import HealthMonitor
+from dynamo_tpu.utils.prometheus import Histogram
 from dynamo_tpu.utils.slo import SloTracker, targets_from_env
 
 log = get_logger("engine")
 
 # engine-loop watchdog cadence: cheap checks, no need to run per step
 _WATCHDOG_INTERVAL_S = 1.0
+
+# migration pause (freeze -> first continuation token): localhost handoffs
+# are tens of ms; a cross-host pull of a deep sequence reaches seconds
+_MIGRATION_PAUSE_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                            1.0, 2.5, 5.0, 10.0, 30.0)
 
 
 def _resolve(fut: asyncio.Future, result, exc) -> None:
@@ -100,6 +106,14 @@ class AsyncJaxEngine:
         # peers pull OUR prefixes from — both attached by the hosting worker
         self.prefix_fetcher = None
         self.kv_pull_server = None
+        # live migration (disagg/migrate.py): pause = freeze -> the
+        # destination's first continuation token reaches the client stream
+        self.migration_pause_hist = Histogram(
+            "dynamo_migration_pause_seconds",
+            "client-visible stream pause of one live migration, sequence "
+            "freeze to the destination's first relayed token",
+            _MIGRATION_PAUSE_BUCKETS,
+        )
 
     # ---------------- lifecycle ----------------
 
@@ -351,6 +365,276 @@ class AsyncJaxEngine:
         )
         axis = getattr(runner.model, "wire_n_axis", 2)
         return len(pages), fut, host_blocks, axis
+
+    # ---------------- live migration (disagg/migrate.py) ----------------
+    # MIGRATING_OUT: the source freezes a sequence, ships its manifest, and
+    # relays the destination's continuation tokens into the original output
+    # stream. ADOPTING: the destination re-enters the sequence through
+    # normal admission, pulling committed KV via the seq_handoff fetch kind
+    # (FETCHING_KV) with chunked recompute from history as the fallback.
+
+    def sync_export_sequence(self, seq_id: str, hashes: list[int]):
+        """Engine thread: serve a ``seq_handoff`` pull — the named LIVE
+        sequence's own page run for the requested chained hashes. Unlike
+        ``sync_export_prefix`` this walks the sequence's pages directly, so
+        decode-written blocks whose cache registration deduped onto another
+        sequence's page still export OUR copy; a sequence already released
+        (source raced ahead) falls back to the shared prefix cache, which
+        usually still holds the committed blocks."""
+        alloc, runner = self.allocator, self.runner
+        if alloc is None or runner is None or not hashes:
+            return None
+        state = alloc._seqs.get(seq_id)
+        if state is None or state.token_seq is None:
+            return self.sync_export_prefix(hashes)
+        chain = [b.sequence_hash for b in state.token_seq.blocks]
+        try:
+            start = chain.index(hashes[0])
+        except ValueError:
+            # the requested run is not in this sequence's chain (destination
+            # cached a different leading run): the prefix cache may still
+            # resolve it
+            return self.sync_export_prefix(hashes)
+        pages: list[int] = []
+        for i, h in enumerate(hashes):
+            j = start + i
+            if j >= len(chain) or chain[j] != h or j >= len(state.pages):
+                break
+            pages.append(state.pages[j])
+        if not pages:
+            return None
+        fut = runner.extract_pages_async(np.asarray(pages, np.int32))
+        axis = getattr(runner.model, "wire_n_axis", 2)
+        return len(pages), fut, [], axis
+
+    def sync_snapshot_for_migration(self, request_id: str):
+        """Engine thread: freeze one in-flight decode sequence
+        (MIGRATING_OUT) and build its authoritative manifest. Returns
+        ``(manifest_or_None, drained_outputs)``; None = not migratable right
+        now (unknown/finished/already migrating/still prefilling/fetching/
+        multimodal) — including the double-migration race, where the second
+        caller simply gets None."""
+        sched = self.scheduler
+        seq = next(
+            (s for s in sched.slots
+             if s is not None and s.req.request_id == request_id),
+            None,
+        )
+        if (
+            seq is None or seq.finished or seq.migrating
+            or seq.prefill_pos is not None or seq.fetch is not None
+            or seq.req.images or not seq.generated
+        ):
+            return None, []
+        # drain the dispatch-ahead pipeline: seq.generated must be the
+        # complete materialized history before it becomes the manifest
+        outputs = sched._reconcile(block=True, drain=True)
+        if seq.finished:
+            return None, outputs  # EOS/length landed during the drain
+        seq.migrating = True
+        return self._build_manifest(seq), outputs
+
+    def _build_manifest(self, seq):
+        import dataclasses
+
+        from dynamo_tpu.disagg.migrate import SequenceManifest
+
+        req = seq.req
+        ps = self.config.page_size
+        hist_len = seq.prompt_len + len(seq.generated)
+        state = self.allocator._seqs.get(req.request_id)
+        kv_blocks = 0
+        if state is not None and state.token_seq is not None:
+            # exportable = full blocks whose KV is complete (the newest
+            # token's KV is not written — it is the next decode input)
+            kv_blocks = min(
+                (hist_len - 1) // ps,
+                len(state.token_seq.blocks),
+                len(state.pages),
+            )
+        addr = self.kv_pull_server.address if self.kv_pull_server is not None else ""
+        age = (
+            max(0.0, time.monotonic() - req.enqueue_ts) if req.enqueue_ts else 0.0
+        )
+        return SequenceManifest(
+            request_id=req.request_id,
+            prompt_tokens=list(req.token_ids),
+            generated=list(seq.generated),
+            sampling=dataclasses.asdict(req.sampling),
+            eos_token_ids=list(req.eos_token_ids),
+            lora_name=req.lora_name,
+            logprobs=req.logprobs,
+            penalty_output_from=(
+                req.penalty_output_from
+                if req.penalty_output_from is not None
+                else seq.prompt_len
+            ),
+            trace_id=req.trace_id,
+            tenant=req.tenant,
+            scenario=req.scenario,
+            source_addr=addr if kv_blocks > 0 else "",
+            kv_blocks=kv_blocks,
+            age_s=age,
+        )
+
+    def sync_commit_migration(self, request_id: str):
+        """Engine thread: the destination's continuation is live — release
+        the frozen local sequence WITHOUT a finish output or a goodput
+        outcome (the destination records the request's one outcome).
+        Returns False when the sequence already ended locally (cancel/EOS
+        raced the handoff) — the caller must drop the destination stream."""
+        sched = self.scheduler
+        seq = next(
+            (s for s in sched.slots
+             if s is not None and s.req.request_id == request_id),
+            None,
+        )
+        if seq is None or seq.finished or not seq.migrating:
+            return False, []
+        sched._release(seq, count_finished=False)
+        return True, []
+
+    def sync_abort_migration(self, request_id: str):
+        """Engine thread: the handoff failed before any continuation token —
+        un-freeze the sequence so local decode resumes (never worse than
+        preempt+recompute; here not even that)."""
+        sched = self.scheduler
+        seq = next(
+            (s for s in sched.slots
+             if s is not None and s.req.request_id == request_id),
+            None,
+        )
+        if seq is None or seq.finished or not seq.migrating:
+            return False, []
+        seq.migrating = False
+        return True, []
+
+    def sync_resume_migration(self, manifest, relayed: list):
+        """Engine thread: the destination died AFTER continuation tokens
+        were already relayed to the client — requeue a preempt-style resume
+        request over history + relayed tokens, so the stream continues
+        locally, token-identically (the prefix cache usually still holds
+        the committed blocks)."""
+        req = manifest.to_resume_request(list(relayed), time.monotonic())
+        self.scheduler.waiting.appendleft(req)
+        return True, []
+
+    async def migrate_out(self, request_id: str, adopter, timeout_s=None) -> dict:
+        """Hand one in-flight sequence to a peer mid-decode and re-pin its
+        output stream to the peer's continuation.
+
+        ``adopter(manifest)`` is an async iterator of StepOutputs — the
+        in-process form is another engine's ``adopt_migrated``; the worker
+        wraps its peer's ``migrate`` endpoint in the same shape. The failure
+        ladder: a handoff that dies before the first continuation token
+        un-freezes the sequence (local decode resumes); one that dies after
+        relaying tokens requeues a preempt-style resume over history +
+        relayed tokens. Returns a status dict; "ok" means the stream now
+        lives on the destination."""
+        timeout = timeout_s or self.config.migration_timeout_s
+        sched = self.scheduler
+        if not self.config.migration:
+            return {"status": "skipped", "reason": "migration disabled"}
+        manifest = await self.run_on_engine(
+            lambda: self.sync_snapshot_for_migration(request_id)
+        )
+        if manifest is None:
+            return {"status": "skipped", "reason": "not migratable"}
+        t0 = time.monotonic()
+        gen = None
+        first = None
+        try:
+            gen = adopter(manifest).__aiter__()
+            first = await asyncio.wait_for(gen.__anext__(), timeout)
+            if first.finished and first.finish_reason == "error":
+                raise RuntimeError("destination rejected the adoption")
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            await self._aclose(gen)
+            await self.run_on_engine(
+                lambda: self.sync_abort_migration(request_id)
+            )
+            sched.migration_out_failed += 1
+            log.warning("migration of %s failed before handoff: %s", request_id, e)
+            return {"status": "failed", "error": f"{type(e).__name__}: {e}"}
+        pause = time.monotonic() - t0
+        committed = await self.run_on_engine(
+            lambda: self.sync_commit_migration(request_id)
+        )
+        if not committed:
+            # cancel/EOS raced the handoff: the local stream already ended;
+            # the destination's adopted copy is orphaned — drop it
+            await self._aclose(gen)
+            return {"status": "skipped", "reason": "sequence ended locally"}
+        self.migration_pause_hist.observe(pause)
+        tracing.record_span(
+            "engine.migrate_out", t0, duration=pause,
+            request_id=request_id, trace_id=manifest.trace_id,
+            attrs={"kv_blocks": manifest.kv_blocks,
+                   "generated": len(manifest.generated)},
+        )
+        relayed: list[int] = []
+        item = first
+        try:
+            while True:
+                if item.finished and item.finish_reason == "error":
+                    raise RuntimeError("destination errored mid-continuation")
+                if item.token is not None:
+                    relayed.append(item.token)
+                self._post(request_id, item)
+                if item.finished:
+                    sched.migration_out += 1
+                    return {
+                        "status": "ok", "pause_s": pause,
+                        "tokens_relayed": len(relayed),
+                        "kv_blocks": manifest.kv_blocks,
+                    }
+                item = await gen.__anext__()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # incl. StopAsyncIteration without a finish
+            await self._aclose(gen)
+            sched.migration_out_failed += 1
+            if request_id in self._outputs:
+                # destination died mid-stream: continue locally from
+                # history + everything already relayed (never worse than
+                # preempt+recompute)
+                log.warning(
+                    "migration of %s lost the destination after %d relayed "
+                    "tokens (%s); resuming locally",
+                    request_id, len(relayed), e,
+                )
+                await self.run_on_engine(
+                    lambda: self.sync_resume_migration(manifest, relayed)
+                )
+                return {"status": "resumed", "tokens_relayed": len(relayed)}
+            # the client is gone too: nothing to resume for
+            return {"status": "failed", "error": f"{type(e).__name__}: {e}"}
+
+    @staticmethod
+    async def _aclose(gen) -> None:
+        if gen is not None:
+            try:
+                await gen.aclose()
+            except Exception:
+                pass
+
+    async def adopt_migrated(self, manifest) -> AsyncIterator[StepOutput]:
+        """ADOPTING side: re-enter a migrated sequence through the normal
+        admission path. The manifest's history is the prompt; committed KV
+        pulls from the source via seq_handoff (FETCHING_KV) with chunked
+        recompute as the fallback; sampling continues positionally, so the
+        continuation is token-identical for greedy and seeded lanes."""
+        if not self.config.migration:
+            raise RuntimeError("migration is disabled on this engine")
+        req = manifest.to_engine_request(now=time.monotonic())
+        self._stamp_submission(req)
+        self._register_stream(req.request_id)
+        self._inbox.put(req)
+        async for batch in self._drain_stream_batched(req.request_id):
+            for item in batch:
+                yield item
 
     def sync_allocate_remote(
         self, request_id: str, token_ids: list[int]
@@ -694,6 +978,14 @@ class AsyncJaxEngine:
             "prefix_fetch_blocks": sched.prefix_fetch_blocks,
             "prefix_fetch_bytes": sched.prefix_fetch_bytes,
             "prefix_fetch_tokens": sched.prefix_fetch_tokens,
+            # live migration (disagg/migrate.py): both roles' counters ride
+            # worker stats -> /cluster/status -> dynotop's MIG column
+            "migration_out": sched.migration_out,
+            "migration_out_failed": sched.migration_out_failed,
+            "migration_in": sched.migration_in,
+            "migration_in_pulled": sched.migration_in_pulled,
+            "migration_in_recomputed": sched.migration_in_recomputed,
+            "migration_tokens_salvaged": sched.migration_tokens_salvaged,
             "preemptions": sched.preempt_count,
             "pressure_drains": sched.pressure_drain_count,
             # long-context: table-width ladder + depth-aware chunking +
@@ -928,6 +1220,25 @@ class AsyncJaxEngine:
                 "prompt tokens whose prefill recompute a remote pull skipped",
                 [({}, r["prefix_fetch_tokens"])],
             ),
+            # live migration: handoffs out (ok = stream re-pinned to the
+            # destination; failed = resumed locally) and adoptions in
+            # (pulled = committed KV arrived over seq_handoff; recomputed =
+            # timeout/gone/corrupt degraded to chunked recompute)
+            render_family(
+                "dynamo_migration_requests_total", "counter",
+                "live sequence migrations by role and terminal result",
+                [({"role": "out", "result": "ok"}, r["migration_out"]),
+                 ({"role": "out", "result": "failed"}, r["migration_out_failed"]),
+                 ({"role": "in", "result": "pulled"}, r["migration_in_pulled"]),
+                 ({"role": "in", "result": "recomputed"}, r["migration_in_recomputed"])],
+            ),
+            render_family(
+                "dynamo_migration_tokens_salvaged_total", "counter",
+                "history tokens whose prefill recompute a seq_handoff KV "
+                "pull skipped at adoption",
+                [({}, r["migration_tokens_salvaged"])],
+            ),
+            self.migration_pause_hist.render(),
             render_family(
                 "dynamo_engine_preemptions_total", "counter",
                 "sequences bounced back to the waiting queue by page pressure",

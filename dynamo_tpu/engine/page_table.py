@@ -329,13 +329,32 @@ class PageAllocator:
         return True
 
     def append_token(self, seq_id: str, token: int) -> None:
-        """Track a decoded token; registers its block in the cache when full."""
+        """Track a decoded token; registers blocks ONE TOKEN AFTER they fill.
+
+        A decode-written block's last row's KV only exists once the
+        block-following token has been fed (token ``p`` is sampled from fed
+        position ``p-1``, so appending ``p`` proves KV through ``p-1``).
+        Registering at fill time used to advertise — locally and through KV
+        events to the radix/fleet caches — a block whose final position
+        reads garbage to any sequence extending past it: forever if the
+        writer finished exactly at the block boundary (a multi-turn
+        conversation extending a cached response, a migrated history being
+        re-admitted), or transiently if a reader raced the writer's next
+        window. Deferring by one token makes every advertised block's KV
+        actually complete; a sequence that ends at a block boundary simply
+        never registers its final block (its KV is incomplete by
+        construction and the prefill recompute is one block)."""
         state = self._seqs[seq_id]
-        block = state.token_seq.push_token(token)
-        if block is not None:
-            idx = len(state.token_seq.blocks) - 1
+        state.token_seq.push_token(token)
+        n = len(state.token_seq)
+        # the newest token (index n-1) proves KV through n-2: the last block
+        # fully below that bound is safe to register
+        if (n - 1) % self.page_size == 0 and n > self.page_size:
+            idx = (n - 1) // self.page_size - 1
             if idx < len(state.pages):
-                self._register_block(state, block, state.pages[idx])
+                self._register_block(
+                    state, state.token_seq.blocks[idx], state.pages[idx]
+                )
 
     def free_sequence(self, seq_id: str) -> None:
         """Release a sequence. Full cached blocks become reusable (LRU);

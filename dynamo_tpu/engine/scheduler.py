@@ -84,6 +84,12 @@ class EngineRequest:
     # the dataplane (FETCHING_KV) instead of recomputing them.
     kv_holder_addr: str = ""
     kv_holder_blocks: int = 0
+    # live migration (disagg/migrate.py): non-empty = this request is the
+    # ADOPTING side of a handoff — token_ids are a migrated sequence's full
+    # history, and admission pulls its committed KV from kv_holder_addr via
+    # the seq_handoff fetch kind (naming the source sequence here) instead
+    # of the shared-prefix kind. Any pull failure recomputes from history.
+    kv_handoff_seq: str = ""
     # multi-LoRA: the adapter this request serves ("" = base model). The
     # scheduler pins a device pool slot at admission (waiting while the
     # adapter loads — never blocking other requests) and salts the
@@ -143,6 +149,12 @@ class RunningSeq:
     # no prefill chunk dispatches for this sequence; resolution either
     # advances prefill_pos past the pulled prefix or falls back to recompute.
     fetch: Optional["_PrefixFetch"] = None
+    # MIGRATING_OUT (disagg/migrate.py): frozen for handoff — no window,
+    # spec round, or prefill dispatch touches it; pages stay resident so the
+    # destination's seq_handoff pull can export them. Cleared if the handoff
+    # fails (decode resumes locally); released without a finish when the
+    # destination's continuation stream takes over.
+    migrating: bool = False
     # multi-LoRA: the device pool slot this sequence's adapter is pinned in
     # (0 = base / no adapter). >0 implies one LoraStore ref held until the
     # sequence releases or is preempted — a pinned slot is never hot-swapped
@@ -179,6 +191,9 @@ class _PrefixFetch:
     # belt over the client's own wait_for: if the fetcher's loop dies and the
     # future never resolves, the scheduler still unwedges admission here
     belt_deadline: float
+    # seq_handoff pull of a migrated sequence's pages (ADOPTING side):
+    # resolution feeds the migration counters instead of the prefix ones
+    handoff: bool = False
 
 
 @dataclass
@@ -409,6 +424,14 @@ class Scheduler:
         self.prefix_fetch_blocks = 0  # blocks pulled and scattered
         self.prefix_fetch_bytes = 0  # payload bytes pulled (wire KV dtype)
         self.prefix_fetch_tokens = 0  # prompt tokens whose recompute was skipped
+        # live migration (disagg/migrate.py): both roles' counters live here
+        # so resource_snapshot / dynamo_migration_* render from one place
+        self.migration_out = 0  # sequences handed to a peer (stream re-pinned)
+        self.migration_out_failed = 0  # handoffs that resumed locally instead
+        self.migration_in = 0  # migrated sequences admitted (ADOPTING)
+        self.migration_in_pulled = 0  # adoptions whose seq_handoff pull landed
+        self.migration_in_recomputed = 0  # adoptions that rebuilt KV from history
+        self.migration_tokens_salvaged = 0  # history tokens whose recompute a pull skipped
         # long-context telemetry (dynamo_engine_context_* families): the
         # page-table width ladder, depth-aware chunk planner, and the
         # watermark-driven cold-block drain to the host tier
@@ -483,10 +506,12 @@ class Scheduler:
         pipeline_full = self._windows_in_flight() >= max(1, self.config.pipeline_depth)
         if pipeline_full or (self.in_flight and not dispatched and not outputs):
             outputs.extend(self._reconcile(block=True))
-        elif not outputs and not dispatched and not self.in_flight and self._fetching():
-            # FETCHING_KV is the only live work: the remote pull resolves on
-            # another thread's event loop, so don't hot-spin the engine loop
-            # while waiting for it
+        elif not outputs and not dispatched and not self.in_flight and (
+            self._fetching() or self._migrating()
+        ):
+            # FETCHING_KV / MIGRATING_OUT is the only live work: both resolve
+            # on another thread's event loop, so don't hot-spin the engine
+            # loop while waiting
             time.sleep(0.001)
         return outputs
 
@@ -724,6 +749,8 @@ class Scheduler:
         # slot_state vector; write it once here (no per-window H2D)
         self.runner.set_slot_lora(slot, lora_slot)
 
+        if req.kv_handoff_seq:
+            self.migration_in += 1
         fetch = self._maybe_start_fetch(req, cached_len, prompt_len)
         if self.runner.packed_prefill_mode and not req.images:
             # packed path: per-request prep now, chunk dispatch deferred to
@@ -763,13 +790,23 @@ class Scheduler:
     ) -> Optional[_PrefixFetch]:
         """Kick a remote-prefix pull when the router attached a holder whose
         matched prefix beats our local cache by >= prefix_fetch_min_blocks.
-        Returns the FETCHING_KV handle, or None (prefill proceeds normally)."""
+        Returns the FETCHING_KV handle, or None (prefill proceeds normally).
+
+        A migration adoption (req.kv_handoff_seq) rides the same machinery
+        with the ``seq_handoff`` fetch kind, its own deadline belt
+        (migration_timeout_s), and a 1-block advantage bar — any committed
+        block the source still holds beats recomputing it."""
+        handoff = bool(req.kv_handoff_seq)
         if (
             self.prefix_fetcher is None
-            or not self.config.prefix_fetch
             or not req.kv_holder_addr
             or req.kv_holder_blocks <= 0
         ):
+            if handoff and (prompt_len - 1) // self.config.page_size > cached_len // self.config.page_size:
+                # no pull possible: the adoption rebuilds KV from history
+                self.migration_in_recomputed += 1
+            return None
+        if not handoff and not self.config.prefix_fetch:
             return None
         ps = self.config.page_size
         base = cached_len // ps
@@ -777,32 +814,48 @@ class Scheduler:
         # prefill so the model produces next-token logits (same rule the
         # local prefix cache applies in allocate_sequence)
         want_to = min(req.kv_holder_blocks, (prompt_len - 1) // ps)
-        if want_to - base < max(1, self.config.prefix_fetch_min_blocks):
+        min_blocks = 1 if handoff else max(1, self.config.prefix_fetch_min_blocks)
+        if want_to - base < min_blocks:
             return None
         state = self.allocator._seqs[req.request_id]
         hashes = [b.sequence_hash for b in state.token_seq.blocks[base:want_to]]
         if not hashes:
             return None
-        timeout = self.config.prefix_fetch_timeout_s
+        timeout = (
+            self.config.migration_timeout_s if handoff
+            else self.config.prefix_fetch_timeout_s
+        )
         try:
             fut = self.prefix_fetcher.fetch(
-                req.kv_holder_addr, hashes, timeout_s=timeout
+                req.kv_holder_addr, hashes, timeout_s=timeout,
+                kind="seq_handoff" if handoff else "prefix_fetch",
+                seq_id=req.kv_handoff_seq,
             )
         except Exception:
             log.exception("prefix fetch start failed for %s", req.request_id)
+            if handoff:
+                self.migration_in_recomputed += 1
             return None
         now = time.monotonic()
         log.debug(
-            "prefix fetch for %s: blocks [%d, %d) from %s",
+            "%s for %s: blocks [%d, %d) from %s",
+            "seq handoff pull" if handoff else "prefix fetch",
             req.request_id, base, want_to, req.kv_holder_addr,
         )
         return _PrefixFetch(
-            fut=fut, base_block=base, t0=now, belt_deadline=now + timeout + 2.0
+            fut=fut, base_block=base, t0=now, belt_deadline=now + timeout + 2.0,
+            handoff=handoff,
         )
 
     def _fetching(self) -> bool:
         return any(
             s is not None and not s.finished and s.fetch is not None
+            for s in self.slots
+        )
+
+    def _migrating(self) -> bool:
+        return any(
+            s is not None and not s.finished and s.migrating
             for s in self.slots
         )
 
@@ -848,19 +901,28 @@ class Scheduler:
                 self.prefix_fetch_blocks += applied
                 self.prefix_fetch_bytes += res.bytes
                 self.prefix_fetch_tokens += max(0, new_cached - seq.prefill_pos)
+                if f.handoff:
+                    self.migration_in_pulled += 1
+                    self.migration_tokens_salvaged += max(
+                        0, new_cached - seq.prefill_pos
+                    )
                 seq.prefill_pos = max(seq.prefill_pos, new_cached)
                 seq.cached_len = max(seq.cached_len, new_cached)
                 tracing.record_span(
                     "engine.prefix_fetch", f.t0, duration=dt,
                     request_id=seq.req.request_id, trace_id=seq.req.trace_id,
                     attrs={"blocks": applied, "bytes": res.bytes,
-                           "holder": seq.req.kv_holder_addr},
+                           "holder": seq.req.kv_holder_addr,
+                           "handoff": f.handoff},
                 )
             else:
                 self.prefix_fetch_fallbacks += 1
+                if f.handoff:
+                    self.migration_in_recomputed += 1
                 status = getattr(res, "status", "dead") if res is not None else "dead"
                 log.info(
-                    "prefix fetch for %s fell back to recompute (%s)",
+                    "%s for %s fell back to recompute (%s)",
+                    "seq handoff pull" if f.handoff else "prefix fetch",
                     seq.req.request_id, status,
                 )
             self._resume_after_fetch(seq, outputs)
@@ -1443,6 +1505,7 @@ class Scheduler:
                 seq.finished
                 or not seq.spec_mode
                 or seq.prefill_pos is not None
+                or seq.migrating  # MIGRATING_OUT: frozen for handoff
                 or not seq.generated  # first token still in flight
             ):
                 continue
@@ -1619,6 +1682,8 @@ class Scheduler:
         """Steps this window can run for `seq` before budget/length bounds."""
         if seq.prefill_pos is not None:
             return 0  # prefill chunks still pending; no sampled token yet
+        if seq.migrating:
+            return 0  # MIGRATING_OUT: frozen for handoff, pages stay resident
         if seq.spec_mode:
             return 0  # advances via speculative verify rounds, never windows
         budget = seq.req.sampling.max_tokens - seq.sched_len
@@ -1996,7 +2061,13 @@ class Scheduler:
             self.finished_count += 1
 
     def _pick_victim(self, exclude: RunningSeq) -> Optional[RunningSeq]:
-        candidates = [s for s in self.slots if s is not None and s is not exclude]
+        # a MIGRATING_OUT sequence is never a preemption victim: requeueing
+        # it locally while the destination continues the same stream would
+        # fork the request into two generators
+        candidates = [
+            s for s in self.slots
+            if s is not None and s is not exclude and not s.migrating
+        ]
         if not candidates:
             return None
         return max(candidates, key=lambda s: s.admitted_order)

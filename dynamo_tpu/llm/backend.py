@@ -62,6 +62,29 @@ class Backend:
         self.engine = engine
         self.tokenizer = tokenizer
 
+    def availability(self) -> dict:
+        """Pre-admission serving probe the HTTP layer consults BEFORE any
+        response bytes (so a stream=true request still gets a plain JSON
+        status). A draining engine that cannot migrate its load is a
+        *retriable* condition — the client should back off and retry once
+        the drain completes or a replacement registers — not a hard error.
+        With migration enabled the engine keeps serving through its drain
+        (in-flight sequences move to peers; the router stops sending new
+        work), so no 503 is needed."""
+        health = getattr(self.engine, "health", None)
+        cfg = getattr(self.engine, "config", None)
+        if health is None or cfg is None:
+            return {"servable": True}
+        state = getattr(health, "state", "ready")
+        if state in ("draining", "migrating") and not getattr(cfg, "migration", True):
+            return {
+                "servable": False,
+                "retriable": True,
+                "reason": f"engine is {state} and live migration is disabled",
+                "retry_after_s": 10,
+            }
+        return {"servable": True, "state": state}
+
     def _token_repr(self, token_id: int) -> tuple[str, list[int]]:
         text = self.tokenizer.decode([token_id], skip_special_tokens=False)
         return text, list(text.encode("utf-8"))

@@ -233,11 +233,14 @@ class HttpService:
         kind = request.query.get("kind") or None
         return web.json_response(self._step_source(limit=limit, kind=kind))
 
-    def _error(self, status: int, message: str, code: str | None = None) -> web.Response:
+    def _error(
+        self, status: int, message: str, code: str | None = None,
+        headers: dict | None = None,
+    ) -> web.Response:
         err = {"message": message, "type": "invalid_request_error"}
         if code:
             err["code"] = code  # e.g. context_length_exceeded
-        return web.json_response({"error": err}, status=status)
+        return web.json_response({"error": err}, status=status, headers=headers)
 
     async def _chat(self, request: web.Request) -> web.StreamResponse:
         return await self._handle(request, kind="chat")
@@ -280,6 +283,29 @@ class HttpService:
 
         model = pipeline.name
         rtype = "stream" if req.stream else "unary"
+
+        # pre-admission availability: a draining backend that cannot migrate
+        # its load answers a RETRIABLE 503 with Retry-After — on both the
+        # unary and stream paths, and always BEFORE any SSE bytes (the check
+        # runs ahead of preprocessing and the stream response), so clients
+        # and load balancers can re-dispatch instead of surfacing an error
+        avail_fn = getattr(pipeline.backend, "availability", None)
+        if avail_fn is not None:
+            try:
+                avail = avail_fn()
+                if asyncio.iscoroutine(avail):
+                    avail = await avail
+            except Exception:
+                avail = None
+            if avail and not avail.get("servable", True) and avail.get("retriable"):
+                self.metrics.inc_request(model, endpoint, rtype, "503")
+                retry_after = int(avail.get("retry_after_s", 10))
+                return self._error(
+                    503,
+                    avail.get("reason", f"model {model!r} is draining; retry"),
+                    code="model_draining",
+                    headers={"Retry-After": str(retry_after)},
+                )
         try:
             # off the event loop: chat-template render + BPE encode are
             # CPU-bound (the tokenizer's Rust encode releases the GIL), and a

@@ -42,7 +42,10 @@ class KvRouter:
         self.aggregator = KvMetricsAggregator(
             drt.cplane, namespace, component, interval=metrics_interval
         )
-        self.aggregator.on_update(self.scheduler.update_endpoints)
+        self.aggregator.on_update(self._on_loads)
+        # workers already pruned from the radix for being unservable: prune
+        # once per transition, not every scrape round
+        self._pruned_unservable: set[int] = set()
         self._watcher = None
         self._watch_task: Optional[asyncio.Task] = None
         # one-entry overlap memo: schedule() and the callers that want the
@@ -93,6 +96,26 @@ class KvRouter:
                     self._last_overlap = None
         except asyncio.CancelledError:
             pass
+
+    def _on_loads(self, loads) -> None:
+        """Scrape-round hook: feed the scheduler its endpoint view, then make
+        the radix index FOLLOW migrating sequences — a worker that reports
+        draining/migrating/dead stops being a prefix holder immediately, so
+        new placements (and fleet pulls) land on the peers its sequences are
+        moving to. The destinations' own ``stored`` KV events re-advertise
+        the migrated blocks there; a pruned worker that later returns to
+        ready re-advertises as it re-caches."""
+        self.scheduler.update_endpoints(loads)
+        for view in self.aggregator.worker_views():
+            wid = view.instance_id
+            if not view.servable:
+                if wid not in self._pruned_unservable:
+                    log.info("worker %x unservable; pruning radix index", wid)
+                    self.indexer.remove_worker(wid)
+                    self._last_overlap = None
+                    self._pruned_unservable.add(wid)
+            else:
+                self._pruned_unservable.discard(wid)
 
     def _emit_hit_rate(self, event: KVHitRateEvent) -> None:
         asyncio.ensure_future(

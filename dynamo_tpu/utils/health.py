@@ -10,8 +10,10 @@ the asyncio thread even when the engine loop is stuck on a dead device op.
 
   - explicit lifecycle states: ``starting -> ready`` (engine initialized),
     ``degraded`` (watchdog alarm), ``draining`` (operator-initiated
-    scale-down; routers skip it but in-flight work finishes), ``dead``
-    (shutdown / loop exit)
+    scale-down; routers skip it but in-flight work finishes),
+    ``migrating`` (drain with live migration: in-flight sequences are being
+    handed to peers — disagg/migrate.py — instead of finishing by
+    attrition), ``dead`` (shutdown / loop exit)
   - monotonic heartbeats stamped by the engine loop (``beat()``); every stats
     broadcast carries ``heartbeat_age_s`` so aggregators can spot a process
     whose asyncio side answers scrapes while its engine thread is wedged
@@ -34,10 +36,11 @@ from dynamo_tpu.utils.logging import get_logger
 
 log = get_logger("utils.health")
 
-STATES = ("starting", "ready", "degraded", "draining", "dead")
+STATES = ("starting", "ready", "degraded", "draining", "migrating", "dead")
 
-# states a router / planner must not hand new work to
-UNSERVABLE_STATES = ("draining", "dead")
+# states a router / planner must not hand new work to (a MIGRATING worker is
+# mid-drain: its in-flight sequences are leaving, new ones must not arrive)
+UNSERVABLE_STATES = ("draining", "migrating", "dead")
 
 
 def _env_float(name: str, default: float) -> float:

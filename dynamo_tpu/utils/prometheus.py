@@ -76,6 +76,7 @@ DECLARED_METRIC_FAMILIES: tuple = (
     "dynamo_kv_stream_part_bytes",
     "dynamo_kv_stream_parts_received_total",
     "dynamo_kv_stream_parts_sent_total",
+    "dynamo_kv_stream_reconnects_total",
     "dynamo_kv_stream_rejected_total",
     "dynamo_kv_stream_requests_total",
     "dynamo_kv_stream_send_seconds_total",
@@ -85,6 +86,9 @@ DECLARED_METRIC_FAMILIES: tuple = (
     "dynamo_lora_loads_total",
     "dynamo_lora_requests_total",
     "dynamo_lora_slots",
+    "dynamo_migration_pause_seconds",
+    "dynamo_migration_requests_total",
+    "dynamo_migration_tokens_salvaged_total",
     "dynamo_prefix_fetch_blocks_total",
     "dynamo_prefix_fetch_bytes_total",
     "dynamo_prefix_fetch_client_blocks_total",
@@ -425,6 +429,13 @@ def _sample_surfaces() -> list[tuple[str, str]]:
     eng.scheduler.stage.spec_accepted = 6
     eng.scheduler.stage.spec_draft_calls = 2
     eng.scheduler.stage.spec_draft_s = 0.01
+    # live migration: both roles' counters + a sample pause so the
+    # dynamo_migration_* families render on the conformance surface
+    eng.scheduler.migration_out = 2
+    eng.scheduler.migration_in = 1
+    eng.scheduler.migration_in_pulled = 1
+    eng.scheduler.migration_tokens_salvaged = 24
+    eng.migration_pause_hist.observe(0.04)
 
     class _DraftPool:
         pages_total, pages_used = 7, 3

@@ -69,6 +69,10 @@ def _fill_representative(bench):
         "speedup_draft_over_classic": 1.246, "acceptance_rate_draft": 0.9873,
         "acceptance_rate_ngram": 0.0512, "greedy_parity_draft": 1.0,
     }
+    bench.DETAIL["migration"] = {
+        "parity": 1.0, "pause_ms_p99": 1234.5, "kill_pause_ms_p99": 4567.8,
+        "goodput_delta": 0.0417, "tokens_salvaged": 4096,
+    }
     bench.DETAIL["platform"] = "tpu"
     bench.DETAIL["step_anatomy"] = {
         "cpu_smoke": False,
@@ -128,6 +132,11 @@ def test_summary_line_fits_truncation_budget(bench_mod, tmp_path, monkeypatch):
     }
     assert s["mla_decode_tok_s"] == 4658.33
     assert s["moe_decode_tok_s"] == 5425.87
+    # live-migration acceptance keys ride the compact line (salvage counters
+    # and the kill-arm pause stay in bench_detail.json)
+    assert s["migration"] == {
+        "parity": 1.0, "pause_ms_p99": 1234.5, "goodput_delta": 0.0417,
+    }
     assert s["parity_kv_routing"]["ratio_derived"] == 16.14
     assert s["parity_host_offload"]["ratio_projected"] == 8.82
     # errors land compactly (no tracebacks) in the summary itself

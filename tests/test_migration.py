@@ -1,0 +1,875 @@
+"""Live sequence migration (disagg/migrate.py): drain, rebalance, and
+survive worker loss without killing requests.
+
+Correctness bar: a sequence migrated mid-decode must finish with tokens
+byte-identical to an unmigrated run (greedy AND seeded sampling, including
+spec-draft and LoRA-bound lanes), and every arm of the failure ladder —
+handoff pull timeout (injected part drop), corrupt parts, destination death
+before/after the first continuation token, source death after the manifest,
+double-migration races — must degrade to recompute/local-resume with
+identical final output: no request error, no hang past the deadline belts.
+The chaos arms drive the seeded DYNTPU_FAULT_DATAPLANE knobs instead of
+real socket blackholes.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from dynamo_tpu.engine.sampling import SamplingParams
+from dynamo_tpu.engine.scheduler import EngineRequest, StepOutput
+
+PROMPT = [5, 9, 2, 77, 31, 8, 100, 42, 17, 3, 60, 61,
+          7, 13, 19, 23, 29, 37, 41, 43, 47, 53, 59, 67]
+
+
+def _req(rid, prompt=PROMPT, n=32, temp=0.0, seed=None, lora=""):
+    return EngineRequest(
+        request_id=rid, token_ids=list(prompt),
+        sampling=SamplingParams(temperature=temp, max_tokens=n, seed=seed,
+                                ignore_eos=True),
+        lora_name=lora,
+    )
+
+
+def _engine(**over):
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+
+    from tests.test_engine import tiny_engine_config
+
+    defaults = dict(decode_steps=2, pipeline_depth=1, num_pages=96)
+    defaults.update(over)
+    return AsyncJaxEngine(tiny_engine_config(**defaults))
+
+
+async def _collect(engine, req):
+    toks, finish = [], None
+    async for out in engine.generate(req):
+        if out.token is not None:
+            toks.append(out.token)
+        if out.finished:
+            finish = out.finish_reason
+    return toks, finish
+
+
+async def _wait_generated(eng, rid, n, timeout=60.0):
+    """Poll until the sequence has materialized >= n tokens (mid-decode)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        seq = next((s for s in eng.scheduler.slots
+                    if s is not None and s.req.request_id == rid), None)
+        if seq is not None and not seq.finished and len(seq.generated) >= n:
+            return True
+        await asyncio.sleep(0.005)
+    return False
+
+
+async def _wire_pair(src, dst, timeout_s=30.0):
+    """Attach a pull server to src and a fetch client to dst (the handoff
+    dataplane); returns the server for cleanup."""
+    from dynamo_tpu.disagg.prefix_fetch import KvPullServer, PrefixFetchClient
+
+    srv = await KvPullServer(src, host="127.0.0.1").start()
+    src.kv_pull_server = srv
+    dst.attach_prefix_fetch(
+        PrefixFetchClient(asyncio.get_running_loop(), timeout_s=timeout_s)
+    )
+    return srv
+
+
+# ---------------- manifest (fast, no engine) ----------------
+
+
+def test_manifest_roundtrip_and_request_arithmetic():
+    import dataclasses
+
+    from dynamo_tpu.disagg.migrate import SequenceManifest
+
+    m = SequenceManifest(
+        request_id="r1",
+        prompt_tokens=[1, 2, 3, 4],
+        generated=[10, 11, 12],
+        sampling=dataclasses.asdict(
+            SamplingParams(temperature=0.7, max_tokens=16, min_tokens=5,
+                           seed=42, ignore_eos=True)
+        ),
+        eos_token_ids=[0],
+        lora_name="a1",
+        penalty_output_from=4,
+        tenant="t1", scenario="bursty_chat",
+        source_addr="127.0.0.1:4040", kv_blocks=6, age_s=1.5,
+    )
+    # wire + msgpack byte-stability
+    m2 = SequenceManifest.from_wire(m.to_wire())
+    assert m2 == m
+    assert SequenceManifest.unpack(m.pack()) == m
+    assert m.pack() == SequenceManifest.unpack(m.pack()).pack()
+    assert len(m.pack()) < 1024  # "small msgpack manifest"
+
+    req = m.to_engine_request(now=100.0)
+    assert req.token_ids == [1, 2, 3, 4, 10, 11, 12]
+    assert req.sampling.max_tokens == 13  # 16 - 3 already streamed
+    assert req.sampling.min_tokens == 2  # 5 - 3
+    assert req.sampling.seed == 42 and req.sampling.temperature == 0.7
+    assert req.kv_handoff_seq == "r1"
+    assert req.kv_holder_addr == "127.0.0.1:4040" and req.kv_holder_blocks == 6
+    assert req.lora_name == "a1" and req.tenant == "t1"
+    assert req.penalty_output_from == 4
+    assert req.enqueue_ts == pytest.approx(98.5)
+
+    # resume after a failed handoff that relayed 2 destination tokens
+    res = m.to_resume_request([20, 21], now=50.0)
+    assert res.token_ids == [1, 2, 3, 4, 10, 11, 12, 20, 21]
+    assert res.sampling.max_tokens == 11  # 16 - 5 delivered
+    assert res.kv_handoff_seq == "" and res.kv_holder_addr == ""
+    assert res.enqueue_ts == 50.0
+
+
+# ---------------- fault knobs (fast) ----------------
+
+
+def test_fault_plan_parsing_and_determinism(monkeypatch):
+    from dynamo_tpu.disagg import faults
+
+    plan = faults.FaultPlan("seq_handoff=drop-part,push=delay-ms:50", seed=3)
+    assert plan.should_drop("seq_handoff")
+    assert not plan.should_drop("push")
+    assert plan.delay_s("push") == pytest.approx(0.05)
+    assert plan.delay_s("seq_handoff") == 0.0
+    assert not plan.should_corrupt("seq_handoff")
+
+    # '*' fans a rule to every kind
+    allp = faults.FaultPlan("*=corrupt-checksum")
+    for kind in faults.FAULT_KINDS:
+        assert allp.should_corrupt(kind)
+
+    # probabilistic drops are seeded: same seed => same decision sequence
+    a = faults.FaultPlan("push=drop-part:0.5", seed=9)
+    b = faults.FaultPlan("push=drop-part:0.5", seed=9)
+    seq_a = [a.should_drop("push") for _ in range(32)]
+    seq_b = [b.should_drop("push") for _ in range(32)]
+    assert seq_a == seq_b
+    assert any(seq_a) and not all(seq_a)
+
+    with pytest.raises(ValueError):
+        faults.FaultPlan("bogus-kind=drop-part")
+    with pytest.raises(ValueError):
+        faults.FaultPlan("push=explode")
+    with pytest.raises(ValueError):
+        faults.FaultPlan("push=delay-ms")  # delay needs its arg
+
+    # env resolution: unset => None, set => parsed + cached
+    monkeypatch.delenv(faults.ENV_SPEC, raising=False)
+    assert faults.active_plan() is None
+    monkeypatch.setenv(faults.ENV_SPEC, "prefix_fetch=drop-part")
+    p1 = faults.active_plan()
+    assert p1 is not None and p1.should_drop("prefix_fetch")
+    assert faults.active_plan() is p1  # cached by (spec, seed)
+
+
+# ---------------- reconnect backoff (fast) ----------------
+
+
+def test_dataplane_reconnect_backoff_with_jitter(monkeypatch):
+    """A refused destination retries MAX_ATTEMPTS times with growing,
+    jittered, bounded delays — and the reconnect counter + exposition
+    family record it."""
+    from dynamo_tpu.disagg import dataplane
+    from dynamo_tpu.disagg.dataplane import KvDataPlaneClient
+
+    sleeps = []
+
+    async def body():
+        real_sleep = asyncio.sleep
+
+        async def spy_sleep(delay):
+            sleeps.append(delay)
+            await real_sleep(0)
+
+        monkeypatch.setattr(dataplane.asyncio, "sleep", spy_sleep)
+        client = KvDataPlaneClient(lanes=1)
+        import numpy as np
+
+        with pytest.raises(OSError):
+            await client.send("127.0.0.1:9", "r1", np.zeros(4, np.float32))
+        assert client.reconnects == client.MAX_ATTEMPTS - 1
+        assert len(sleeps) == client.MAX_ATTEMPTS - 1
+        for i, d in enumerate(sleeps):
+            base = min(client.BACKOFF_MAX_S, client.BACKOFF_BASE_S * (1 << i))
+            assert base * 0.5 <= d <= base  # jittered into [0.5, 1.0]x
+        text = client.render_metrics()
+        assert "dynamo_kv_stream_reconnects_total 2" in text
+        from dynamo_tpu.utils.prometheus import check_exposition
+
+        assert check_exposition(text) == []
+
+    asyncio.run(body())
+
+
+# ---------------- planner rebalance policy (fast) ----------------
+
+
+def test_planner_rebalance_policy_sustain_and_cooldown():
+    from dynamo_tpu.components.planner import Planner, RebalancePolicy
+
+    planner = Planner(rebalance_policy=RebalancePolicy(
+        occupancy_hot=0.8, occupancy_cold=0.5, goodput_floor=0.9,
+        sustain=2, cooldown_s=30.0,
+    ))
+
+    def workers(hot_occ=0.9, cold_occ=0.2, hot_gp=None, **over):
+        hot = {"worker_id": "aa", "occupancy": hot_occ, "goodput": hot_gp,
+               "servable": True, "migration": True}
+        cold = {"worker_id": "bb", "occupancy": cold_occ, "goodput": 1.0,
+                "servable": True, "migration": True}
+        hot.update(over.get("hot", {}))
+        cold.update(over.get("cold", {}))
+        return [hot, cold]
+
+    # sustained-signal gating: the first observation never fires
+    assert planner.rebalance(workers(), now=0.0) is None
+    d = planner.rebalance(workers(), now=1.0)
+    assert d is not None and d.source == "aa" and d.target == "bb"
+    assert "occupancy" in d.reason
+
+    # cooldown: an immediate re-trigger is suppressed
+    assert planner.rebalance(workers(), now=2.0) is None
+    assert planner.rebalance(workers(), now=3.0) is None
+
+    # after cooldown the signal must sustain again
+    planner2 = Planner(rebalance_policy=RebalancePolicy(sustain=1, cooldown_s=0.0))
+    # goodput burn below the floor triggers even under the occupancy bar
+    d2 = planner2.rebalance(workers(hot_occ=0.7, cold_occ=0.3, hot_gp=0.5), now=100.0)
+    assert d2 is not None and "goodput" in d2.reason
+    # balanced pool: no decision, and the sustain counter resets
+    assert planner2.rebalance(workers(hot_occ=0.5, cold_occ=0.45), now=101.0) is None
+    # non-migratable or unservable peers are never targets
+    ws = workers()
+    ws[1]["migration"] = False
+    assert planner2.rebalance(ws, now=102.0) is None
+    ws = workers()
+    ws[1]["servable"] = False
+    assert planner2.rebalance(ws, now=103.0) is None
+
+
+# ---------------- health + router pruning (fast) ----------------
+
+
+def test_migrating_health_state_is_unservable():
+    from dynamo_tpu.utils.health import (
+        STATES,
+        UNSERVABLE_STATES,
+        HealthMonitor,
+        is_snapshot_servable,
+    )
+
+    assert "migrating" in STATES
+    assert "migrating" in UNSERVABLE_STATES
+    assert not is_snapshot_servable({"state": "migrating"})
+    hm = HealthMonitor("w")
+    hm.set_state("ready", "up")
+    hm.set_state("draining", "drain")
+    hm.set_state("migrating", "handing off")
+    assert not hm.is_servable()
+    hm.set_state("draining", "pass complete")
+    hm.set_state("dead", "gone")
+    assert hm.state == "dead"
+
+
+def test_router_prunes_radix_for_unservable_workers():
+    """The radix/fleet caches follow the sequence: a worker that reports
+    draining/migrating stops being a prefix holder on the next scrape
+    round, without waiting for its instance key to disappear."""
+    import time as _time
+
+    from dynamo_tpu.llm.kv_events import KvCacheEvent, StoredBlock
+    from dynamo_tpu.llm.kv_router.indexer import RouterEvent
+    from dynamo_tpu.llm.kv_router.metrics_aggregator import WorkerView
+    from dynamo_tpu.llm.kv_router.router import KvRouter
+    from dynamo_tpu.llm.tokens import compute_block_hash_for_seq
+
+    class _Drt:
+        cplane = None
+
+    router = KvRouter(_Drt(), "ns", "backend", kv_block_size=4)
+    prompt = list(range(1, 13))
+    hashes = compute_block_hash_for_seq(prompt, 4)
+    blocks, parent = [], None
+    for th in hashes:
+        bh = th ^ 0xA
+        blocks.append(StoredBlock(block_hash=bh, tokens_hash=th, parent_hash=parent))
+        parent = bh
+    router._on_kv_event({"payload": RouterEvent(
+        worker_id=0xA, event=KvCacheEvent.stored(parent_hash=None, blocks=blocks),
+    ).to_wire()})
+    assert router._find_overlap(prompt).scores.get(0xA) == 3
+
+    view = WorkerView(
+        0xA,
+        data={"health": {"state": "migrating", "heartbeat_age_s": 0.01}},
+        last_seen=_time.monotonic(),
+    )
+    router.aggregator._workers[0xA] = view
+    router._on_loads([])  # the scrape-round hook
+    assert router._find_overlap(prompt).scores.get(0xA) is None
+    assert 0xA in router._pruned_unservable
+    # back to ready: eligible again (blocks re-advertise via kv events)
+    view.data["health"]["state"] = "ready"
+    router._on_loads([])
+    assert 0xA not in router._pruned_unservable
+
+
+# ---------------- frontend 503 (fast, aiohttp) ----------------
+
+
+CHAT_BODY = {
+    "model": "tiny",
+    "messages": [{"role": "user", "content": "hello"}],
+    "max_tokens": 4,
+}
+
+
+def test_frontend_retriable_503_while_draining_without_migration():
+    """A draining backend with migration disabled answers 503 + Retry-After
+    on BOTH the unary and the stream path — and the stream path gets plain
+    JSON, never SSE bytes."""
+    import aiohttp
+
+    from dynamo_tpu.llm.http.service import HttpService, ModelPipeline
+
+    class _Backend:
+        def availability(self):
+            return {
+                "servable": False, "retriable": True,
+                "reason": "engine is draining and live migration is disabled",
+                "retry_after_s": 7,
+            }
+
+        async def generate(self, pre):  # pragma: no cover - must not be hit
+            raise AssertionError("draining backend must not be asked to generate")
+            yield
+
+    async def body():
+        service = HttpService(host="127.0.0.1", port=0)
+        service.manager.add(ModelPipeline("tiny", None, _Backend(), "both"))
+        port = await service.start()
+        url = f"http://127.0.0.1:{port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                # unary
+                async with s.post(f"{url}/v1/chat/completions", json=CHAT_BODY) as r:
+                    assert r.status == 503
+                    assert r.headers.get("Retry-After") == "7"
+                    assert r.content_type == "application/json"
+                    doc = await r.json()
+                    assert doc["error"]["code"] == "model_draining"
+                # stream=true: still a pre-SSE JSON 503
+                async with s.post(
+                    f"{url}/v1/chat/completions",
+                    json={**CHAT_BODY, "stream": True},
+                ) as r:
+                    assert r.status == 503
+                    assert r.headers.get("Retry-After") == "7"
+                    assert r.content_type == "application/json"
+                    raw = await r.read()
+                    assert not raw.startswith(b"data:")
+                    import json as _json
+
+                    assert _json.loads(raw)["error"]["code"] == "model_draining"
+        finally:
+            await service.stop()
+
+    asyncio.run(body())
+
+
+def test_backend_availability_draining_vs_migration():
+    from dynamo_tpu.llm.backend import Backend
+    from dynamo_tpu.utils.health import HealthMonitor
+
+    class _Cfg:
+        migration = False
+
+    class _Eng:
+        health = HealthMonitor("t")
+        config = _Cfg()
+
+    b = Backend(_Eng(), tokenizer=None)
+    _Eng.health.set_state("ready", "up")
+    assert b.availability()["servable"]
+    _Eng.health.set_state("draining", "drain")
+    a = b.availability()
+    assert not a["servable"] and a["retriable"] and a["retry_after_s"] > 0
+    # with migration enabled the engine keeps serving through its drain
+    _Cfg.migration = True
+    assert b.availability()["servable"]
+
+
+# ---------------- metrics surfaces (fast) ----------------
+
+
+def test_migration_metric_families_render_conformantly():
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.engine.page_table import PageAllocator
+    from dynamo_tpu.engine.scheduler import Scheduler
+    from dynamo_tpu.utils.prometheus import check_exposition
+
+    cfg = EngineConfig(model_id="tiny", page_size=4, num_pages=8, max_seqs=2,
+                       prefill_buckets=(16,))
+    eng = AsyncJaxEngine(cfg)
+    eng.allocator = PageAllocator(cfg.num_pages, cfg.page_size)
+    eng.scheduler = Scheduler(cfg, None, eng.allocator)
+    eng.runner = None
+    eng.scheduler.migration_out = 3
+    eng.scheduler.migration_out_failed = 1
+    eng.scheduler.migration_in_pulled = 2
+    eng.scheduler.migration_in_recomputed = 1
+    eng.scheduler.migration_tokens_salvaged = 40
+    eng.migration_pause_hist.observe(0.03)
+    text = eng.render_stage_metrics()
+    assert check_exposition(text) == []
+    assert 'dynamo_migration_requests_total{result="ok",role="out"} 3' in text
+    assert 'dynamo_migration_requests_total{result="failed",role="out"} 1' in text
+    assert 'dynamo_migration_requests_total{result="pulled",role="in"} 2' in text
+    assert "dynamo_migration_tokens_salvaged_total 40" in text
+    assert "dynamo_migration_pause_seconds_bucket" in text
+    snap = eng.resource_snapshot()
+    assert snap["migration_out"] == 3
+    assert snap["migration_tokens_salvaged"] == 40
+
+
+def test_dynotop_migration_column():
+    import importlib.util
+    from pathlib import Path
+
+    spec = importlib.util.spec_from_file_location(
+        "dynotop", Path(__file__).resolve().parent.parent / "tools" / "dynotop.py"
+    )
+    dynotop = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(dynotop)
+
+    doc = {
+        "namespace": "ns", "component": "backend", "summary": {"workers": 1},
+        "workers": [{
+            "worker_id": "ab", "last_seen_s": 0.1, "missed_scrapes": 0,
+            "health": {"state": "migrating", "heartbeat_age_s": 0.01},
+            "kv_metrics": {"request_active_slots": 1, "request_total_slots": 4,
+                           "kv_active_blocks": 1, "kv_total_blocks": 10},
+            "resources": {"migration_out": 3, "migration_in": 1,
+                          "migration_out_failed": 1},
+        }],
+    }
+    text = dynotop.render_status(doc)
+    assert "MIG" in text
+    assert "3>1!1" in text  # out>in with failed flag
+    assert "migrating" in text
+    doc["workers"][0]["resources"] = {}
+    assert "3>1" not in dynotop.render_status(doc)  # pre-plane workers: "-"
+
+
+# ---------------- two-engine loopback: migrate mid-decode ----------------
+
+
+@pytest.mark.parametrize(
+    "temp,seed", [(0.0, None), (0.8, 11)], ids=["greedy", "seeded"]
+)
+def test_migrate_mid_decode_token_parity(temp, seed):
+    """The acceptance bar: a sequence migrated mid-decode finishes with
+    tokens byte-identical to an unmigrated run, the committed KV arrives
+    over the seq_handoff pull (no recompute), and the source frees its
+    slot without emitting a finish of its own."""
+
+    async def body():
+        base = _engine()
+        await base.start()
+        src = _engine()
+        await src.start()
+        dst = _engine()
+        await dst.start()
+        srv = None
+        try:
+            srv = await _wire_pair(src, dst)
+            expected, finish = await _collect(
+                base, _req("b1", temp=temp, seed=seed)
+            )
+            assert finish == "length" and len(expected) == 32
+            # warm the destination's executables so the pause measures the
+            # handoff, not a cold XLA compile
+            await _collect(dst, _req("warm", n=4))
+
+            task = asyncio.ensure_future(
+                _collect(src, _req("m1", temp=temp, seed=seed))
+            )
+            assert await _wait_generated(src, "m1", 8)
+            res = await src.migrate_out("m1", dst.adopt_migrated)
+            assert res["status"] == "ok", res
+            assert res["kv_blocks"] >= 6  # committed history shipped
+            got, finish = await task
+            assert finish == "length"
+            assert got == expected, f"migrated {got} != baseline {expected}"
+
+            ssched, dsched = src.scheduler, dst.scheduler
+            assert ssched.migration_out == 1
+            assert ssched.migration_out_failed == 0
+            assert ssched.num_running == 0  # source slot + pages released
+            assert src.allocator.active_pages == 0
+            assert dsched.migration_in == 1
+            assert dsched.migration_in_pulled == 1  # KV pulled, not recomputed
+            assert dsched.migration_in_recomputed == 0
+            assert dsched.migration_tokens_salvaged > 0
+            assert srv.handoffs_served == 1
+            assert src.migration_pause_hist.count == 1
+        finally:
+            if srv is not None:
+                await srv.stop()
+            await base.shutdown()
+            await src.shutdown()
+            await dst.shutdown()
+
+    asyncio.run(body())
+
+
+@pytest.mark.slow
+def test_migrate_spec_draft_lane_token_parity():
+    """A draft-model speculative sequence migrates mid-decode: the
+    destination rebuilds the draft cache from the authoritative history at
+    its first spec round and the continuation stays token-identical."""
+
+    async def body():
+        over = dict(speculative="draft:tiny:2", num_pages=128)
+        base = _engine(**over)
+        await base.start()
+        src = _engine(**over)
+        await src.start()
+        dst = _engine(**over)
+        await dst.start()
+        srv = None
+        try:
+            srv = await _wire_pair(src, dst)
+            expected, _ = await _collect(base, _req("b1", n=24))
+            await _collect(dst, _req("warm", n=4))
+            task = asyncio.ensure_future(_collect(src, _req("m1", n=24)))
+            assert await _wait_generated(src, "m1", 6)
+            res = await src.migrate_out("m1", dst.adopt_migrated)
+            assert res["status"] == "ok", res
+            got, finish = await task
+            assert finish == "length"
+            assert got == expected, f"spec-draft migrated {got} != {expected}"
+            assert dst.scheduler.migration_in_pulled == 1
+        finally:
+            if srv is not None:
+                await srv.stop()
+            await base.shutdown()
+            await src.shutdown()
+            await dst.shutdown()
+
+    asyncio.run(body())
+
+
+@pytest.mark.slow
+def test_migrate_lora_lane_token_parity():
+    """A LoRA-bound sequence migrates: the manifest carries the adapter
+    binding, the destination pins its own slot at admission, and the salted
+    block identity lines up so the handoff pull still lands."""
+
+    async def body():
+        over = dict(lora_adapters=("a1",), max_loras=2, num_pages=128)
+        base = _engine(**over)
+        await base.start()
+        src = _engine(**over)
+        await src.start()
+        dst = _engine(**over)
+        await dst.start()
+        srv = None
+        try:
+            srv = await _wire_pair(src, dst)
+            expected, _ = await _collect(base, _req("b1", n=24, lora="a1"))
+            expected_base, _ = await _collect(base, _req("b2", n=24))
+            assert expected != expected_base  # the adapter actually bites
+            await _collect(dst, _req("warm", n=4, lora="a1"))
+            task = asyncio.ensure_future(_collect(src, _req("m1", n=24, lora="a1")))
+            assert await _wait_generated(src, "m1", 6)
+            res = await src.migrate_out("m1", dst.adopt_migrated)
+            assert res["status"] == "ok", res
+            got, finish = await task
+            assert finish == "length"
+            assert got == expected, f"LoRA migrated {got} != {expected}"
+            assert dst.scheduler.migration_in_pulled == 1
+        finally:
+            if srv is not None:
+                await srv.stop()
+            await base.shutdown()
+            await src.shutdown()
+            await dst.shutdown()
+
+    asyncio.run(body())
+
+
+# ---------------- failure ladder ----------------
+
+
+def test_failure_ladder_pull_faults_degrade_to_recompute(monkeypatch):
+    """Injected handoff-pull faults (part drop => timeout; corrupt
+    checksum => integrity reject) both degrade the ADOPTION to chunked
+    recompute from history — final tokens identical, no request error, no
+    hang past the deadline belt."""
+
+    async def body():
+        base = _engine()
+        await base.start()
+        src = _engine()
+        await src.start()
+        dst = _engine(migration_timeout_s=1.0)
+        await dst.start()
+        srv = None
+        try:
+            srv = await _wire_pair(src, dst, timeout_s=30.0)
+            await _collect(dst, _req("warm", n=4))
+
+            arms = [
+                ("seq_handoff=drop-part", "timeout"),
+                ("seq_handoff=corrupt-checksum", "error"),
+            ]
+            for i, (fault, _expected_mode) in enumerate(arms):
+                prompt = [(i * 131 + j * 7) % 400 + 1 for j in range(24)]
+                expected, _ = await _collect(base, _req(f"b{i}", prompt, n=24))
+                monkeypatch.setenv("DYNTPU_FAULT_DATAPLANE", fault)
+                try:
+                    rid = f"m{i}"
+                    task = asyncio.ensure_future(
+                        _collect(src, _req(rid, prompt, n=24))
+                    )
+                    assert await _wait_generated(src, rid, 6)
+                    t0 = time.monotonic()
+                    res = await src.migrate_out(rid, dst.adopt_migrated)
+                    # the handoff itself still succeeds — only the KV pull
+                    # degraded to recompute on the destination
+                    assert res["status"] == "ok", (fault, res)
+                    got, finish = await task
+                    assert finish == "length"
+                    assert got == expected, (fault, got, expected)
+                    assert time.monotonic() - t0 < 30.0  # belt held
+                finally:
+                    monkeypatch.delenv("DYNTPU_FAULT_DATAPLANE", raising=False)
+            dsched = dst.scheduler
+            assert dsched.migration_in == 2
+            assert dsched.migration_in_recomputed == 2
+            assert dsched.migration_in_pulled == 0
+        finally:
+            if srv is not None:
+                await srv.stop()
+            await base.shutdown()
+            await src.shutdown()
+            await dst.shutdown()
+
+    asyncio.run(body())
+
+
+def test_failure_ladder_dest_death_and_double_migration():
+    """Destination dies before the first continuation token -> the source
+    un-freezes and finishes locally; destination dies mid-stream -> the
+    source resumes from history + relayed tokens; a concurrent second
+    migrate_out of the same sequence is refused. Tokens identical in every
+    arm."""
+
+    async def body():
+        base = _engine()
+        await base.start()
+        src = _engine()
+        await src.start()
+        srv = None
+        try:
+            # arm 1: adopter dies before yielding anything
+            expected, _ = await _collect(base, _req("b1"))
+
+            async def dead_adopter(manifest):
+                raise ConnectionError("destination gone")
+                yield  # pragma: no cover
+
+            task = asyncio.ensure_future(_collect(src, _req("m1")))
+            assert await _wait_generated(src, "m1", 8)
+            res = await src.migrate_out("m1", dead_adopter)
+            assert res["status"] == "failed"
+            got, finish = await task
+            assert finish == "length" and got == expected
+            assert src.scheduler.migration_out_failed == 1
+            assert src.scheduler.migration_out == 0
+
+            # arm 2: adopter yields 2 continuation tokens, then dies — the
+            # relayed tokens must NOT be re-emitted by the local resume
+            prompt2 = [(j * 13 + 5) % 400 + 1 for j in range(24)]
+            expected2, _ = await _collect(base, _req("b2", prompt2))
+
+            dst = _engine()
+            await dst.start()
+            srv = await _wire_pair(src, dst)
+
+            async def flaky_adopter(manifest):
+                n = 0
+                async for out in dst.adopt_migrated(manifest):
+                    yield out
+                    n += 1 if out.token is not None else 0
+                    if n >= 2:
+                        raise ConnectionError("destination crashed mid-stream")
+
+            task = asyncio.ensure_future(_collect(src, _req("m2", prompt2)))
+            assert await _wait_generated(src, "m2", 8)
+            res = await src.migrate_out("m2", flaky_adopter)
+            assert res["status"] == "resumed"
+            assert res["tokens_relayed"] == 2
+            got2, finish2 = await task
+            assert finish2 == "length"
+            assert got2 == expected2, f"resumed {got2} != baseline {expected2}"
+            assert src.scheduler.migration_out_failed == 2
+
+            # arm 3: double-migration race — two concurrent migrate_out
+            # calls; exactly one snapshot wins, the other is skipped
+            prompt3 = [(j * 29 + 3) % 400 + 1 for j in range(24)]
+            expected3, _ = await _collect(base, _req("b3", prompt3))
+            await _collect(dst, _req("warm", n=4))
+            task = asyncio.ensure_future(_collect(src, _req("m3", prompt3)))
+            assert await _wait_generated(src, "m3", 8)
+            r1, r2 = await asyncio.gather(
+                src.migrate_out("m3", dst.adopt_migrated),
+                src.migrate_out("m3", dst.adopt_migrated),
+            )
+            statuses = sorted([r1["status"], r2["status"]])
+            assert statuses == ["ok", "skipped"], (r1, r2)
+            got3, finish3 = await task
+            assert finish3 == "length" and got3 == expected3
+        finally:
+            if srv is not None:
+                await srv.stop()
+                await dst.shutdown()
+            await base.shutdown()
+            await src.shutdown()
+
+    asyncio.run(body())
+
+
+def test_failure_ladder_source_death_after_manifest():
+    """The source vanishes right after shipping the manifest (pull server
+    down): the destination's seq_handoff pull fails fast and the adoption
+    recomputes the whole history — the continuation completes with the
+    exact baseline tokens."""
+
+    async def body():
+        base = _engine()
+        await base.start()
+        src = _engine()
+        await src.start()
+        dst = _engine(migration_timeout_s=2.0)
+        await dst.start()
+        srv = None
+        try:
+            srv = await _wire_pair(src, dst)
+            expected, _ = await _collect(base, _req("b1"))
+            await _collect(dst, _req("warm", n=4))
+
+            task = asyncio.ensure_future(_collect(src, _req("m1")))
+            assert await _wait_generated(src, "m1", 8)
+            manifest = await src.run_on_engine(
+                lambda: src.sync_snapshot_for_migration("m1")
+            )
+            assert manifest is not None and manifest.kv_blocks > 0
+            k = len(manifest.generated)
+            # the source dies: its pull server goes away mid-handoff
+            await srv.stop()
+            srv = None
+            cont = [
+                out async for out in dst.adopt_migrated(manifest)
+            ]
+            cont_toks = [o.token for o in cont if o.token is not None]
+            assert cont_toks == expected[k:], "recompute continuation diverged"
+            assert cont[-1].finished and cont[-1].finish_reason == "length"
+            assert dst.scheduler.migration_in_recomputed == 1
+            assert dst.scheduler.migration_in_pulled == 0
+            # local cleanup: the frozen source sequence resumes on abort
+            await src.run_on_engine(lambda: src.sync_abort_migration("m1"))
+            got, finish = await task
+            assert finish == "length" and got == expected
+        finally:
+            if srv is not None:
+                await srv.stop()
+            await base.shutdown()
+            await src.shutdown()
+            await dst.shutdown()
+
+    asyncio.run(body())
+
+
+# ---------------- rolling restart under replay load ----------------
+
+
+@pytest.mark.slow
+def test_rolling_restart_replay_goodput():
+    """bursty_chat replay against a worker that drains mid-run: every live
+    sequence migrates to the peer, the streams keep flowing, zero request
+    errors — and goodput stays within budget of the no-restart baseline."""
+    from dynamo_tpu.loadgen.scenarios import load_scenario
+    from dynamo_tpu.loadgen.trace import compile_trace
+
+    async def body():
+        from dynamo_tpu.loadgen.replay import replay_engine
+
+        spec = load_scenario("bursty_chat", num_requests=8).replace(
+            isl_max=48, osl_dist="fixed", osl_mean=12, osl_max=12,
+            rate_rps=24.0, slo_ttft_ms=60000.0, slo_itl_ms=60000.0,
+        )
+        trace = compile_trace(spec)
+
+        base = _engine(max_seqs=4, num_pages=192, max_model_len=128)
+        await base.start()
+        src = _engine(max_seqs=4, num_pages=192, max_model_len=128)
+        await src.start()
+        dst = _engine(max_seqs=4, num_pages=192, max_model_len=128)
+        await dst.start()
+        srv = None
+        try:
+            srv = await _wire_pair(src, dst)
+            warm = compile_trace(spec.replace(seed=99, num_requests=2))
+            await replay_engine(base, warm, spec=spec, speed=100.0)
+            await replay_engine(src, warm, spec=spec, speed=100.0)
+            await replay_engine(dst, warm, spec=spec, speed=100.0)
+
+            baseline = await replay_engine(base, trace, spec=spec, speed=4.0)
+            assert baseline["errors"] == 0
+
+            # rolling restart: a drainer migrates every mid-decode sequence
+            # off the source while the replay keeps submitting to it
+            stop = asyncio.Event()
+
+            async def drainer():
+                while not stop.is_set():
+                    rids = [
+                        s.req.request_id for s in src.scheduler.slots
+                        if s is not None and not s.finished and not s.migrating
+                        and s.prefill_pos is None and len(s.generated) >= 4
+                    ]
+                    for rid in rids:
+                        await src.migrate_out(rid, dst.adopt_migrated)
+                    await asyncio.sleep(0.02)
+
+            drain_task = asyncio.ensure_future(drainer())
+            try:
+                restarted = await replay_engine(src, trace, spec=spec, speed=4.0)
+            finally:
+                stop.set()
+                await drain_task
+            assert restarted["errors"] == 0, restarted
+            assert src.scheduler.migration_out >= 1  # sequences really moved
+            assert dst.scheduler.migration_in >= 1
+            # goodput within budget of the uninterrupted baseline (one
+            # request's worth of slack on the 8-request CPU smoke)
+            assert restarted["goodput"] >= baseline["goodput"] - 0.125, (
+                restarted["goodput"], baseline["goodput"],
+            )
+        finally:
+            if srv is not None:
+                await srv.stop()
+            await base.shutdown()
+            await src.shutdown()
+            await dst.shutdown()
+
+    asyncio.run(body())

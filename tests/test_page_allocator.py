@@ -81,13 +81,19 @@ def test_lru_eviction_emits_removed():
         a.allocate_sequence("s3", [1, 2, 3, 4])
 
 
-def test_decode_block_completion_registers():
+def test_decode_block_completion_registers_one_token_late():
     events = []
     a = make(events=events)
     a.allocate_sequence("s1", [1, 2, 3])  # partial block
     a.commit_prefilled("s1", 3)
     assert not [e for e in events if e.kind == "stored"]
-    a.append_token("s1", 4)  # completes block 0
+    # completing block 0 must NOT register it yet: the block's last row's
+    # KV is only written once token 4 is FED, which the appearance of token
+    # 5 proves — registering at fill time advertised a block whose final
+    # position read garbage to any sequence extending past it
+    a.append_token("s1", 4)
+    assert not [e for e in events if e.kind == "stored"]
+    a.append_token("s1", 5)
     stored = [e for e in events if e.kind == "stored"]
     assert len(stored) == 1
     ts = TokenSequence([1, 2, 3, 4], PS)

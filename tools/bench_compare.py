@@ -57,6 +57,12 @@ DEFAULT_KEYS: tuple = (
     # roofline fraction must not fall (the fused-decode before/after gate)
     ("step_anatomy.host_frac", "lower", DEFAULT_TOL),
     ("step_anatomy.roofline_frac", "higher", DEFAULT_TOL),
+    # live migration (r8+): token parity is binary (any drop is a break),
+    # the client-visible pause must not balloon, and migrating must keep
+    # beating kill+recompute on goodput
+    ("migration.parity", "higher", 0.001),
+    ("migration.pause_ms_p99", "lower", 0.5),
+    ("migration.goodput_delta", "higher", 1.0),
     # replay goodput columns (aliased arrays; index 0 = goodput)
     ("replay.bursty.0", "higher", DEFAULT_TOL),
     ("replay.lctx.0", "higher", DEFAULT_TOL),

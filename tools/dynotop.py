@@ -20,7 +20,7 @@ import urllib.request
 
 STATE_GLYPH = {
     "ready": "●", "degraded": "◐", "starting": "○", "draining": "◌",
-    "dead": "✗", "unknown": "?",
+    "migrating": "◎", "dead": "✗", "unknown": "?",
 }
 
 
@@ -69,8 +69,8 @@ def render_status(doc: dict) -> str:
     header = (
         f"{'WORKER':<12} {'STATE':<10} {'HB':>6} {'SEEN':>6} {'MISS':>4} "
         f"{'SLOTS':>7} {'KV%':>6} {'KVMEM':>11} {'PREFIX':>9} {'SPEC':>10} "
-        f"{'LORA':>11} {'GOODPUT':>9} {'STEP':>11} {'ROOF':>5} {'WAIT':>5} "
-        f"{'HBM':>9} {'CMPL':>5}  SLO"
+        f"{'LORA':>11} {'GOODPUT':>9} {'MIG':>7} {'STEP':>11} {'ROOF':>5} "
+        f"{'WAIT':>5} {'HBM':>9} {'CMPL':>5}  SLO"
     )
     lines.append(header)
     lines.append("-" * len(header))
@@ -131,6 +131,17 @@ def render_status(doc: dict) -> str:
             goodput = f"{100.0 * gp['goodput']:.0f}% ({gp.get('requests', 0)})"
         else:
             goodput = "-"
+        # live migration (disagg/migrate.py via resource_snapshot): handoffs
+        # OUT of this worker / adoptions IN, with failed handoffs flagged;
+        # workers predating the plane (or with no migrations) show "-"
+        m_out = res.get("migration_out")
+        m_in = res.get("migration_in")
+        if m_out or m_in or res.get("migration_out_failed"):
+            mig = f"{m_out or 0}>{m_in or 0}"
+            if res.get("migration_out_failed"):
+                mig = f"{mig}!{res['migration_out_failed']}"
+        else:
+            mig = "-"
         # step anatomy (utils/step_anatomy.py via resource_snapshot): STEP =
         # host-side fraction of attributed engine time + the decode-window
         # dispatch cadence p50; ROOF = HBM floor over measured decode seconds
@@ -153,7 +164,7 @@ def render_status(doc: dict) -> str:
             f"{(f'{hb:.1f}s' if hb is not None else '-'):>6} "
             f"{w.get('last_seen_s', 0):>5.1f}s {w.get('missed_scrapes', 0):>4} "
             f"{slots:>7} {kv_pct:>5.1f}% {kv_mem:>11} {prefix:>9} {spec:>10} "
-            f"{lora:>11} {goodput:>9} {step:>11} {roof:>5} "
+            f"{lora:>11} {goodput:>9} {mig:>7} {step:>11} {roof:>5} "
             f"{kv.get('num_requests_waiting', 0):>5} "
             f"{_fmt_bytes(res.get('hbm_bytes_in_use', 0)):>9} "
             f"{res.get('xla_compiles', 0):>5}  {_slo_cell(w.get('slo'))}"
