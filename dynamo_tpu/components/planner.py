@@ -263,6 +263,8 @@ class PlannerService:
         prefill_queue: Optional[str] = None,
         planner: Optional[Planner] = None,
         interval: float = 5.0,
+        execute_rebalance: bool = True,
+        execute_cooldown_s: float = 120.0,
     ):
         from dynamo_tpu.llm.kv_router.metrics_aggregator import KvMetricsAggregator
 
@@ -277,6 +279,16 @@ class PlannerService:
         self._task: Optional[asyncio.Task] = None
         self.decisions: list[ScaleDecision] = []  # latest round
         self.rebalance_decision: Optional[RebalanceDecision] = None
+        # the supervisor leg (PR 14 follow-up): ACT on our own published
+        # migrate decisions by POSTing the source worker's /admin/drain
+        # (address from its stats broadcast) instead of waiting for an
+        # external operator loop; its own cooldown on top of the policy's so
+        # a republished decision can't re-drain the same worker back-to-back
+        self.execute_rebalance = execute_rebalance
+        self.execute_cooldown_s = execute_cooldown_s
+        self._last_execute = float("-inf")
+        self.rebalance_executed = 0
+        self.rebalance_execute_failures = 0
 
     async def start(self) -> None:
         self._task = asyncio.create_task(self._loop())
@@ -341,7 +353,69 @@ class PlannerService:
                 self.decode_component, rebalance.source, rebalance.target,
                 rebalance.reason,
             )
+            if self.execute_rebalance:
+                await self._execute(rebalance)
         return decisions
+
+    async def _execute(self, decision: RebalanceDecision) -> None:
+        """Act on a published rebalance decision: POST the source worker's
+        /admin/drain naming the target instance (migrate-then-die; the
+        worker's drain handles peers/failure arms). Cooldown-guarded so a
+        decision republished across scrape rounds drains once; a source
+        with no admin surface in its stats broadcast is skipped (logged) —
+        the decision stays published for an operator to act on."""
+        now = time.monotonic()
+        if (now - self._last_execute) < self.execute_cooldown_s:
+            return
+        addr = None
+        for view in self.aggregator.worker_views():
+            if f"{view.instance_id:x}" == decision.source:
+                addr = (view.data.get("admin") or {}).get("address")
+                break
+        if not addr:
+            log.warning(
+                "rebalance execute skipped: source %s advertises no admin "
+                "address (run the worker with --admin-port, or drain it "
+                "manually)", decision.source,
+            )
+            return
+        self._last_execute = now
+        import aiohttp
+
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                    f"http://{addr}/admin/drain",
+                    json={"target": decision.target},
+                    timeout=aiohttp.ClientTimeout(total=300),
+                ) as resp:
+                    body = await resp.json()
+                    if resp.status != 200:
+                        raise RuntimeError(f"drain answered {resp.status}: {body}")
+            self.rebalance_executed += 1
+            log.info(
+                "rebalance executed: drained %s -> %s (%s)",
+                decision.source, decision.target, body,
+            )
+        except Exception:
+            self.rebalance_execute_failures += 1
+            log.exception(
+                "rebalance execute failed for %s -> %s",
+                decision.source, decision.target,
+            )
+
+    def render_metrics(self) -> str:
+        """Planner-plane exposition (the rebalance executor's audit trail)."""
+        from dynamo_tpu.utils.prometheus import render_family
+
+        return render_family(
+            "dynamo_planner_rebalance_executed_total", "counter",
+            "planner-published rebalance decisions the supervisor executed "
+            "by POSTing the source worker's /admin/drain (result=error = "
+            "the drain call failed; the decision stays published)",
+            [({"result": "ok"}, self.rebalance_executed),
+             ({"result": "error"}, self.rebalance_execute_failures)],
+        )
 
     def _rebalance_inputs(self) -> list[dict]:
         """Per-worker rebalance signals from the scraped fleet view: KV

@@ -127,6 +127,31 @@ class WorkerService:
             self._migrate_client = await self.drt.client(
                 self.namespace, self.component, MIGRATE_ENDPOINT
             )
+            # QoS shed hook (engine-thread callable): when a waiting
+            # critical request must evict a lower-class lane, hand the
+            # victim to a servable peer via live migration instead of
+            # preempt+recompute — the batch request survives elsewhere and
+            # this worker's slot frees when the relay takes over
+            me = self.drt.primary_lease.lease_id
+            eng_loop = loop
+
+            def _shed_via_migration(request_id: str) -> bool:
+                try:
+                    peers = [
+                        i for i in self._migrate_client.instance_ids() if i != me
+                    ]
+                except Exception:
+                    return False
+                if not peers:
+                    return False
+                adopter = self._peer_adopter(peers[0])
+                asyncio.run_coroutine_threadsafe(
+                    inner.migrate_out(request_id, adopter), eng_loop
+                )
+                return True
+
+            if inner.scheduler is not None:
+                inner.scheduler.migrate_shed = _shed_via_migration
         if self.admin_port is not None:
             await self._start_admin(self.admin_port)
 
@@ -221,6 +246,10 @@ class WorkerService:
             "enabled": bool(getattr(self.engine_config, "migration", False))
             and self._migrate_client is not None,
         }
+        if self.admin_port is not None and self._admin_runner is not None:
+            # the planner's rebalance EXECUTOR reads this out of the stats
+            # broadcast to POST /admin/drain on the decided source worker
+            stats["admin"] = {"address": f"127.0.0.1:{self.admin_port}"}
         if self.kv_pull_server is not None:
             # the fleet prefix cache's discovery channel: routers read the
             # pull address out of this broadcast to attach us as a holder
@@ -436,6 +465,8 @@ async def _main(args) -> None:
             prefix_fetch_min_blocks=getattr(args, "prefix_fetch_min_blocks", None) or 1,
             migration=not getattr(args, "no_migration", False),
             migration_timeout_s=getattr(args, "migration_timeout_s", None) or 10.0,
+            qos=not getattr(args, "no_qos", False),
+            qos_preempt_wait_ms=getattr(args, "qos_preempt_wait_ms", None) or 250.0,
             slo_ttft_ms=getattr(args, "slo_ttft_ms", None),
             slo_itl_ms=getattr(args, "slo_itl_ms", None),
             prefill_buckets=tuple(
@@ -531,6 +562,14 @@ def main(argv=None) -> None:
                    help="deadline belt on one sequence handoff (KV pull + "
                         "first continuation token); on expiry the sequence "
                         "resumes decoding locally")
+    p.add_argument("--no-qos", action="store_true",
+                   help="disable multi-tenant QoS scheduling (priority "
+                        "classes ignored: FIFO admission, recency-only "
+                        "preemption victims)")
+    p.add_argument("--qos-preempt-wait-ms", type=float, default=250.0,
+                   help="how long a critical request waits with no free "
+                        "slot before the scheduler evicts a lower-class "
+                        "lane for it (anti-thrash gate)")
     p.add_argument("--admin-port", type=int, default=None,
                    help="operator admin HTTP port on 127.0.0.1 (0 = "
                         "ephemeral): POST /admin/drain migrates in-flight "

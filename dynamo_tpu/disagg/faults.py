@@ -108,7 +108,77 @@ class FaultPlan:
         return ms / 1000.0
 
 
+class AdmissionFaultPlan:
+    """Seeded chaos knobs for the ADMISSION plane (the QoS 429/shed path),
+    mirroring the dataplane grammar so client retry/backoff behavior and the
+    shed path are testable deterministically:
+
+        DYNTPU_FAULT_ADMISSION="reject-rate:0.3,delay-ms:20"
+        DYNTPU_FAULT_SEED=7
+
+      ``reject-rate:<p>`` — answer a structured retriable 429 for a seeded
+                            fraction p of requests BEFORE any SSE bytes
+                            (exactly the budget-exhausted wire behavior)
+      ``delay-ms:<ms>``   — sleep before the admission verdict (latency
+                            injection; the async handler awaits it)
+    """
+
+    FAULTS = ("reject-rate", "delay-ms")
+
+    def __init__(self, spec: str, seed: int = 0):
+        self.spec = spec
+        self.seed = seed
+        self._rules: dict[str, float] = {}
+        for rule in filter(None, (r.strip() for r in spec.split(","))):
+            fault, _, arg = rule.partition(":")
+            fault = fault.strip()
+            if fault not in self.FAULTS:
+                raise ValueError(
+                    f"unknown admission fault {fault!r} "
+                    f"(expected one of {self.FAULTS})"
+                )
+            if not arg:
+                raise ValueError(f"admission fault {fault} requires an arg")
+            self._rules[fault] = float(arg)
+        # one stream per plan: a given seed produces the same reject pattern
+        # on every run (replayable chaos, not flakiness)
+        self._rng = random.Random((seed << 8) ^ 0x0AD)
+
+    def should_reject(self) -> bool:
+        p = self._rules.get("reject-rate", 0.0)
+        if p <= 0.0:
+            return False
+        if p >= 1.0:
+            return True
+        return self._rng.random() < p
+
+    def delay_s(self) -> float:
+        return self._rules.get("delay-ms", 0.0) / 1000.0
+
+
 _CACHE: dict[tuple[str, int], FaultPlan] = {}
+_ADMISSION_CACHE: dict[tuple[str, int], AdmissionFaultPlan] = {}
+
+ENV_ADMISSION = "DYNTPU_FAULT_ADMISSION"
+
+
+def admission_plan() -> Optional[AdmissionFaultPlan]:
+    """The admission-plane fault plan the environment asks for (None = no
+    faults). Cached by (spec, seed) like the dataplane plan; note the RNG
+    lives on the cached plan, so one process's reject sequence is one
+    deterministic stream per (spec, seed)."""
+    spec = os.environ.get(ENV_ADMISSION, "").strip()
+    if not spec:
+        return None
+    try:
+        seed = int(os.environ.get(ENV_SEED, "0") or 0)
+    except ValueError:
+        seed = 0
+    key = (spec, seed)
+    plan = _ADMISSION_CACHE.get(key)
+    if plan is None:
+        plan = _ADMISSION_CACHE[key] = AdmissionFaultPlan(spec, seed)
+    return plan
 
 
 def active_plan() -> Optional[FaultPlan]:

@@ -65,6 +65,9 @@ class SequenceManifest:
     trace_id: Optional[str] = None
     tenant: str = ""
     scenario: str = ""
+    # QoS priority class ("" = standard): the destination serves the
+    # migrated sequence at the same class it held on the source
+    priority: str = ""
     # KV handoff: the source worker's pull-server address and how many full
     # committed blocks of the history it can export via ``seq_handoff``
     source_addr: str = ""
@@ -129,6 +132,7 @@ class SequenceManifest:
             trace_id=self.trace_id,
             tenant=self.tenant,
             scenario=self.scenario,
+            priority=self.priority,
             lora_name=self.lora_name,
             kv_holder_addr=self.source_addr,
             kv_holder_blocks=self.kv_blocks,
@@ -165,5 +169,6 @@ class SequenceManifest:
             trace_id=self.trace_id,
             tenant=self.tenant,
             scenario=self.scenario,
+            priority=self.priority,
             lora_name=self.lora_name,
         )

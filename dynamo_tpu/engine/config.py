@@ -133,6 +133,17 @@ class EngineConfig:
     # only fetch when the holder's advantage over the local prefix cache is at
     # least this many blocks (a one-block pull rarely beats its own overhead)
     prefix_fetch_min_blocks: int = 1
+    # multi-tenant QoS (utils/qos.py): priority-class scheduling — admission
+    # order by class, priority weights composed with the prefill fairness
+    # cap, preemption victims lowest-class-first, and a waiting critical
+    # request may evict a lower-class lane (preferring live migration over
+    # preempt+recompute when a peer can adopt). False = classes ignored:
+    # pure FIFO admission and recency-only victims (the pre-QoS behavior,
+    # and the bench's isolation-off arm).
+    qos: bool = True
+    # how long a critical request must sit queued with no free slot before
+    # the scheduler evicts a lower-class lane for it (the anti-thrash gate)
+    qos_preempt_wait_ms: float = 250.0
     worker_id: str = "worker-0"
     # SLO targets (milliseconds; None = untargeted). With any target set the
     # engine attaches an SloTracker (utils/slo.py) to the scheduler: rolling
@@ -215,6 +226,10 @@ class EngineConfig:
         if self.migration_timeout_s <= 0:
             raise ValueError(
                 f"migration_timeout_s must be > 0; got {self.migration_timeout_s}"
+            )
+        if self.qos_preempt_wait_ms < 0:
+            raise ValueError(
+                f"qos_preempt_wait_ms must be >= 0; got {self.qos_preempt_wait_ms}"
             )
         if self.kv_stream_lanes < 1:
             raise ValueError(

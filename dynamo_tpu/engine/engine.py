@@ -100,6 +100,13 @@ class AsyncJaxEngine:
             ttft_budget_s=self.slo.targets.get("ttft"),
             itl_budget_s=self.slo.targets.get("itl"),
         )
+        # multi-tenant QoS (utils/qos.py): measured queue-drain rate — every
+        # finished request feeds it via the outcome sink, and both retriable
+        # status paths (draining 503, backpressure 429) price Retry-After
+        # from it instead of a constant
+        from dynamo_tpu.utils.qos import DrainRateEstimator
+
+        self.drain_estimator = DrainRateEstimator()
         self._next_watchdog = 0.0
         # fleet-wide prefix cache (disagg/prefix_fetch.py): the pull client
         # the scheduler fetches remote prefixes with, and the export server
@@ -193,7 +200,7 @@ class AsyncJaxEngine:
         )
         self.scheduler = Scheduler(self.config, self.runner, self.allocator)
         self.scheduler.slo = self.slo
-        self.scheduler.outcome_sink = self.goodput.observe
+        self.scheduler.outcome_sink = self._observe_outcome
         self.scheduler.prefix_fetcher = self.prefix_fetcher
         if self.config.warmup == "background":
             # readiness waits only for the traces first requests need; the
@@ -472,6 +479,7 @@ class AsyncJaxEngine:
             trace_id=req.trace_id,
             tenant=req.tenant,
             scenario=req.scenario,
+            priority=req.priority,
             source_addr=addr if kv_blocks > 0 else "",
             kv_blocks=kv_blocks,
             age_s=age,
@@ -988,6 +996,16 @@ class AsyncJaxEngine:
             "migration_tokens_salvaged": sched.migration_tokens_salvaged,
             "preemptions": sched.preempt_count,
             "pressure_drains": sched.pressure_drain_count,
+            # multi-tenant QoS: running lanes per priority class, per-class
+            # preemption victims, and critical-triggered sheds (dynotop QOS
+            # column + the enforcement audit trail)
+            "qos": {
+                "enabled": self.config.qos,
+                "running": self._qos_running_classes(sched),
+                "preempted": dict(sched.qos_preempted),
+                "sheds": sched.qos_sheds,
+                "shed_migrations": sched.qos_shed_migrations,
+            },
             # long-context: table-width ladder + depth-aware chunking +
             # watermark-driven cold-KV drain (str keys: JSON-safe on the wire)
             "context_table_promotions": sched.table_promotions,
@@ -1058,6 +1076,15 @@ class AsyncJaxEngine:
                 snap["xla_compile_s"] = c["compile_s"]
         return snap
 
+    @staticmethod
+    def _qos_running_classes(sched) -> dict:
+        out: dict = {}
+        for s in sched.slots:
+            if s is not None and not s.finished:
+                cls = s.req.priority or "standard"
+                out[cls] = out.get(cls, 0) + 1
+        return out
+
     def slo_snapshot(self) -> dict:
         return self.slo.snapshot()
 
@@ -1077,6 +1104,30 @@ class AsyncJaxEngine:
         """Windowed goodput per scenario/tenant (worker stats broadcasts +
         dynotop's GOODPUT column)."""
         return self.goodput.snapshot()
+
+    def _observe_outcome(self, outcome) -> None:
+        """Scheduler outcome sink: goodput accounting + the drain-rate
+        sample every Retry-After estimate prices from."""
+        self.drain_estimator.note_finish()
+        self.goodput.observe(outcome)
+
+    def backpressure_snapshot(self) -> dict:
+        """The frontend's engine-backpressure view (utils/qos.py): queue
+        depth, measured drain rate, and the estimated wait a NEW request
+        faces — the shed check compares est_wait_s against the TTFT budget
+        and sheds batch-class load first. est_wait_s is None until anything
+        has finished (a cold engine must not shed on a fake rate)."""
+        sched = self.scheduler
+        depth = len(sched.waiting) if sched is not None else 0
+        rate = self.drain_estimator.rate_rps()
+        return {
+            "queue_depth": depth,
+            "drain_rps": round(rate, 4) if rate is not None else None,
+            "est_wait_s": (
+                round(depth / rate, 4) if rate and rate > 0 else None
+            ),
+            "retry_after_s": self.drain_estimator.retry_after_s(depth),
+        }
 
     def stage_snapshot(self) -> dict:
         """Per-stage latency attribution totals (scheduler StageStats plus the
@@ -1243,6 +1294,20 @@ class AsyncJaxEngine:
                 "dynamo_engine_preemptions_total", "counter",
                 "sequences bounced back to the waiting queue by page pressure",
                 [({}, r["preemptions"])],
+            ),
+            # multi-tenant QoS: victims by priority class (page pressure AND
+            # critical-triggered sheds; result=migrated = the victim went via
+            # live migration instead of preempt+recompute)
+            render_family(
+                "dynamo_qos_preemptions_total", "counter",
+                "preemption/shed victims by priority class (multi-tenant "
+                "QoS: batch lanes pay before standard, standard before "
+                "critical; migrated = victim handed to a peer instead of "
+                "recomputed)",
+                [({"class": c, "result": "preempted"}, n)
+                 for c, n in sorted(r["qos"]["preempted"].items())]
+                + [({"class": "any", "result": "migrated"},
+                    r["qos"]["shed_migrations"])],
             ),
             render_family(
                 "dynamo_engine_pressure_drains_total", "counter",

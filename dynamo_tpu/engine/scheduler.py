@@ -42,6 +42,7 @@ from dynamo_tpu.spec import make_proposer
 from dynamo_tpu.utils import get_logger, tracing
 from dynamo_tpu.utils.goodput import MAX_ITL_SAMPLES, RequestOutcome
 from dynamo_tpu.utils.prometheus import Histogram
+from dynamo_tpu.utils.qos import priority_rank, priority_weight
 from dynamo_tpu.utils.step_anatomy import StepAnatomy, roofline_for_runner
 
 log = get_logger("engine.sched")
@@ -101,6 +102,10 @@ class EngineRequest:
     # untagged organic traffic)
     tenant: str = ""
     scenario: str = ""
+    # multi-tenant QoS (utils/qos.py): priority class — critical | standard
+    # | batch ("" = standard). Orders admission, weights the prefill
+    # fairness cap, and orders preemption victims (batch lanes go first).
+    priority: str = ""
 
 
 @dataclass
@@ -439,6 +444,20 @@ class Scheduler:
         self.table_dispatches: dict[int, int] = {}  # table width -> dispatches
         self.chunk_dispatches: dict[int, int] = {}  # chunk bucket -> chunks
         self.offload_pressure_blocks = 0  # cold blocks drained to host by watermark
+        # multi-tenant QoS (utils/qos.py): per-class preemption victims and
+        # critical-triggered sheds (a waiting critical request evicting a
+        # lower-class lane); migrate_shed is the hosting worker's hook —
+        # (request_id) -> bool — that hands the victim to a peer via live
+        # migration instead of preempt+recompute when a servable peer exists
+        self.qos_preempted: dict[str, int] = {}
+        self.qos_sheds = 0
+        self.qos_shed_migrations = 0
+        self.migrate_shed = None
+        # last time a shed went via migration: the handoff is async (the
+        # victim only freezes once migrate_out reaches the engine thread),
+        # so without a cooldown every scheduler step until then would
+        # migrate ANOTHER lane for the same waiting critical request
+        self._last_shed_migration = 0.0
 
     # ---------------- queue ----------------
 
@@ -618,14 +637,21 @@ class Scheduler:
             while self.waiting:
                 slot = self._free_slot()
                 if slot is None:
-                    break
-                req = self.waiting[0]
+                    # a waiting critical request may evict a lower-class lane
+                    # (preferring live migration when a peer can adopt it)
+                    if not self._shed_for_critical(outputs):
+                        break
+                    slot = self._free_slot()
+                    if slot is None:
+                        break  # shed went via async migration; slot frees later
+                idx = self._next_waiting_index()
+                req = self.waiting[idx]
                 # reject oversized prompts BEFORE the fairness-cap break: the
                 # rejection is pure host work (no chip time), so an oversized
                 # prompt at the queue head must fail now, not stall behind the
                 # per-step prefill cap (and stall everything queued behind it)
                 if len(req.token_ids) > self.config.max_model_len:
-                    self.waiting.popleft()
+                    del self.waiting[idx]
                     self._record_request_error(req)
                     outputs.append(
                         StepOutput(req.request_id, finished=True, finish_reason="error")
@@ -654,20 +680,28 @@ class Scheduler:
                         log.warning(
                             "rejecting %s: %s", req.request_id, e
                         )
-                        self.waiting.popleft()
+                        del self.waiting[idx]
                         self._record_request_error(req)
                         outputs.append(StepOutput(
                             req.request_id, finished=True, finish_reason="error"
                         ))
                         continue
                     if lora_slot is None:
-                        self.waiting.popleft()
+                        del self.waiting[idx]
                         deferred.append(req)
                         continue
-                self.waiting.popleft()
+                del self.waiting[idx]
                 try:
                     self._start_sequence(req, slot, lora_slot=lora_slot)
-                    started += 1
+                    # priority weights compose with the fairness cap: one
+                    # start consumes 1/weight cap units, so a critical burst
+                    # starts more prefill chains per step than batch work at
+                    # the same configured cap (all-standard traffic consumes
+                    # exactly 1 each — the pre-QoS behavior)
+                    started += (
+                        1.0 / priority_weight(req.priority)
+                        if self.config.qos else 1.0
+                    )
                 except MemoryError:
                     self._release_lora_name(req.lora_name, lora_slot)
                     self.waiting.appendleft(req)
@@ -689,6 +723,88 @@ class Scheduler:
         finally:
             self.waiting.extendleft(reversed(deferred))
         return outputs
+
+    # ---------------- multi-tenant QoS (utils/qos.py) ----------------
+
+    def _next_waiting_index(self) -> int:
+        """Admission order under QoS: the first waiting request of the
+        highest priority class present (FIFO within a class — all-standard
+        traffic admits in exactly the pre-QoS order). QoS disabled = plain
+        FIFO."""
+        if not self.config.qos or len(self.waiting) < 2:
+            return 0
+        best_i, best_rank = 0, priority_rank(self.waiting[0].priority)
+        for i, req in enumerate(self.waiting):
+            if i == 0:
+                continue
+            r = priority_rank(req.priority)
+            if r < best_rank:
+                best_i, best_rank = i, r
+                if r == 0:
+                    break
+        return best_i
+
+    def _shed_for_critical(self, outputs: list[StepOutput]) -> bool:
+        """A critical request stuck waiting (no free slot) past the
+        qos_preempt_wait gate evicts the lowest-class, most-recent running
+        lane. The victim goes via live migration when the hosting worker
+        wired a peer hook (``migrate_shed`` — the request survives on
+        another worker and the slot frees when the relay takes over),
+        otherwise preempt+requeue (never worse than page-pressure
+        preemption). Returns True only when a slot was freed NOW."""
+        if not self.config.qos or not self.waiting:
+            return False
+        req = self.waiting[self._next_waiting_index()]
+        if priority_rank(req.priority) != 0:
+            return False
+        if req.enqueue_ts and (
+            time.monotonic() - req.enqueue_ts
+            < self.config.qos_preempt_wait_ms / 1e3
+        ):
+            return False  # transient full house: don't thrash lanes
+        victims = [
+            s for s in self.slots
+            if s is not None and not s.finished and not s.migrating
+            and priority_rank(s.req.priority) > 0
+        ]
+        if not victims:
+            return False  # never shed critical for critical
+        victim = max(
+            victims,
+            key=lambda s: (priority_rank(s.req.priority), s.admitted_order),
+        )
+        now = time.monotonic()
+        if self.migrate_shed is not None and (
+            now - self._last_shed_migration
+            < max(0.05, self.config.qos_preempt_wait_ms / 1e3)
+        ):
+            return False  # a shed handoff is already in flight; let it land
+        self.qos_sheds += 1
+        if self.migrate_shed is not None:
+            try:
+                if self.migrate_shed(victim.req.request_id):
+                    self._last_shed_migration = now
+                    self.qos_shed_migrations += 1
+                    log.info(
+                        "QoS shed: migrating %s (%s) for waiting critical %s",
+                        victim.req.request_id,
+                        victim.req.priority or "standard", req.request_id,
+                    )
+                    return False  # slot frees when the handoff completes
+            except Exception:
+                log.exception("migrate_shed hook failed; preempting instead")
+        # preempt contract: drain so victim.generated is authoritative
+        if self.in_flight:
+            outputs.extend(self._reconcile(block=True, drain=True))
+        if victim.finished or self.slots[victim.slot] is not victim:
+            return self._free_slot() is not None  # drain finished it anyway
+        log.info(
+            "QoS shed: preempting %s (%s) for waiting critical %s",
+            victim.req.request_id, victim.req.priority or "standard",
+            req.request_id,
+        )
+        self._preempt(victim)
+        return True
 
     # ---------------- multi-LoRA helpers ----------------
 
@@ -2070,6 +2186,15 @@ class Scheduler:
         ]
         if not candidates:
             return None
+        if self.config.qos:
+            # QoS victim order: lowest priority class first (batch lanes pay
+            # for page pressure before standard, standard before critical),
+            # most-recently-admitted within a class — so a noisy batch burst
+            # can never preempt a critical stream while any lower lane runs
+            return max(
+                candidates,
+                key=lambda s: (priority_rank(s.req.priority), s.admitted_order),
+            )
         return max(candidates, key=lambda s: s.admitted_order)
 
     def _preempt(self, seq: RunningSeq) -> None:
@@ -2078,6 +2203,8 @@ class Scheduler:
         pipeline first so seq.generated is complete."""
         log.info("preempting %s (page pressure)", seq.req.request_id)
         self.preempt_count += 1
+        cls = seq.req.priority or "standard"
+        self.qos_preempted[cls] = self.qos_preempted.get(cls, 0) + 1
         seq.finished = True  # stray in-flight snapshots must skip it
         self._cancel_fetch(seq)
         # the draft cache dies with the slot; re-admission rebuilds it from
@@ -2121,5 +2248,10 @@ class Scheduler:
             kv_holder_addr=seq.req.kv_holder_addr,
             kv_holder_blocks=seq.req.kv_holder_blocks,
             lora_name=seq.req.lora_name,
+            # QoS/goodput attribution must survive the requeue: the resumed
+            # request bills the same tenant at the same priority class
+            tenant=seq.req.tenant,
+            scenario=seq.req.scenario,
+            priority=seq.req.priority,
         )
         self.waiting.appendleft(new_req)

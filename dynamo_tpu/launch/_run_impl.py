@@ -60,6 +60,9 @@ def engine_config_for(args):
         host_cache_blocks=getattr(args, "host_cache_blocks", None) or 0,
         host_cache_bytes=getattr(args, "host_cache_bytes", None) or 0,
         offload_watermark=getattr(args, "offload_watermark", None) or 0.90,
+        # multi-tenant QoS knobs (graph yaml / CLI)
+        qos=not getattr(args, "no_qos", False),
+        qos_preempt_wait_ms=getattr(args, "qos_preempt_wait_ms", None) or 250.0,
     )
     if pb:
         long_ctx["prefill_buckets"] = pb

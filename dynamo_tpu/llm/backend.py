@@ -9,7 +9,7 @@ sequence, and truncates at the stop match.
 
 from __future__ import annotations
 
-from typing import AsyncIterator
+from typing import AsyncIterator, Optional
 
 from dynamo_tpu.engine.scheduler import EngineRequest
 from dynamo_tpu.llm.protocols.common import BackendOutput, PreprocessedRequest
@@ -77,13 +77,37 @@ class Backend:
             return {"servable": True}
         state = getattr(health, "state", "ready")
         if state in ("draining", "migrating") and not getattr(cfg, "migration", True):
+            # Retry-After from the MEASURED queue drain rate when the engine
+            # exposes it (utils/qos.DrainRateEstimator, clamped [1, 30] s) —
+            # the same estimator the QoS 429 path prices from; engines
+            # without one keep the old constant
+            retry_after = 10
+            bp_fn = getattr(self.engine, "backpressure_snapshot", None)
+            if bp_fn is not None:
+                try:
+                    retry_after = bp_fn().get("retry_after_s", retry_after)
+                except Exception:
+                    pass
             return {
                 "servable": False,
                 "retriable": True,
                 "reason": f"engine is {state} and live migration is disabled",
-                "retry_after_s": 10,
+                "retry_after_s": retry_after,
             }
         return {"servable": True, "state": state}
+
+    def backpressure(self) -> Optional[dict]:
+        """Engine queue pressure for the frontend's QoS shed check: queue
+        depth x measured drain rate -> estimated wait for a NEW request
+        (utils/qos.py). None when the engine has no backpressure surface
+        (remote/external engines)."""
+        bp_fn = getattr(self.engine, "backpressure_snapshot", None)
+        if bp_fn is None:
+            return None
+        try:
+            return bp_fn()
+        except Exception:
+            return None
 
     def _token_repr(self, token_id: int) -> tuple[str, list[int]]:
         text = self.tokenizer.decode([token_id], skip_special_tokens=False)
@@ -116,6 +140,7 @@ class Backend:
             lora_name=getattr(request, "lora_name", ""),
             tenant=getattr(request, "tenant", ""),
             scenario=getattr(request, "scenario", ""),
+            priority=getattr(request, "priority", ""),
         )
         decoder = DecodeStream(
             self.tokenizer,

@@ -86,6 +86,7 @@ class HttpService:
         slo=None,  # Optional[SloTracker]: rolling TTFT/ITL SLO state
         readiness: Optional[Callable[[], tuple]] = None,
         step_source: Optional[Callable[..., dict]] = None,
+        qos=None,  # Optional[AdmissionController]: multi-tenant QoS plane
     ):
         self.manager = manager or ModelManager()
         self.host = host
@@ -110,6 +111,18 @@ class HttpService:
             ttft_budget_s=self.slo.targets.get("ttft"),
             itl_budget_s=self.slo.targets.get("itl"),
         )
+        # multi-tenant QoS plane (utils/qos.py): priority classes from the
+        # x-priority header or per-tenant/adapter policy, per-tenant token
+        # budgets answering retriable 429 + Retry-After BEFORE any SSE
+        # bytes, and an engine-backpressure check that sheds batch-class
+        # load first. Default controller comes from the DYNTPU_QOS_BUDGETS /
+        # DYNTPU_QOS_PRIORITIES env specs; with neither set it carries no
+        # budgets (nothing throttles) but still classifies and counts.
+        if qos is None:
+            from dynamo_tpu.utils.qos import AdmissionController, QosPolicy
+
+            qos = AdmissionController(QosPolicy.from_env())
+        self.qos = qos
         # readiness provider: () -> (ok: bool, detail: dict). None = always
         # ready (a bare service with no downstream dependency to gate on).
         # FrontendService wires downstream-worker liveness through this; the
@@ -200,7 +213,8 @@ class HttpService:
         )
 
     async def _metrics(self, request: web.Request) -> web.Response:
-        extra = self.slo.render_metrics() + self.goodput.render_metrics()
+        extra = (self.slo.render_metrics() + self.goodput.render_metrics()
+                 + self.qos.render_metrics())
         if self._extra_metrics:
             extra += self._extra_metrics()
         return web.Response(text=self.metrics.render(extra), content_type="text/plain")
@@ -306,6 +320,68 @@ class HttpService:
                     code="model_draining",
                     headers={"Retry-After": str(retry_after)},
                 )
+
+        # ---------- multi-tenant QoS admission (utils/qos.py) ----------
+        # priority class: explicit x-priority header wins (strict parse — an
+        # unknown class is a 400, not a silent downgrade), else the policy's
+        # per-tenant/adapter default
+        tenant = request.headers.get("x-tenant", "")
+        adapter = model.split(":", 1)[1] if ":" in model and "{" not in model else ""
+        from dynamo_tpu.utils.qos import parse_priority
+
+        try:
+            priority = parse_priority(request.headers.get("x-priority"))
+        except ValueError as e:
+            self.metrics.inc_request(model, endpoint, rtype, "400")
+            return self._error(400, str(e), code="invalid_priority")
+        if not request.headers.get("x-priority"):
+            priority = self.qos.policy.priority_for(tenant, adapter)
+
+        # seeded admission chaos knob (DYNTPU_FAULT_ADMISSION): deterministic
+        # retriable 429s / injected delays so client retry/backoff and the
+        # shed path are testable without real overload
+        from dynamo_tpu.disagg.faults import admission_plan
+
+        fault = admission_plan()
+        if fault is not None:
+            delay = fault.delay_s()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            if fault.should_reject():
+                self.qos.record_shed(tenant, priority)
+                self.metrics.inc_request(model, endpoint, rtype, "429")
+                return self._error(
+                    429, "admission fault injected (DYNTPU_FAULT_ADMISSION)",
+                    code="rate_limited", headers={"Retry-After": "1"},
+                )
+
+        # engine backpressure: estimated queue wait (depth x measured drain
+        # rate) against the TTFT budget — batch-class load sheds FIRST with
+        # a retriable 429, always before any SSE bytes, so interactive
+        # classes keep their budgets through an overload
+        if priority == "batch":
+            bp_fn = getattr(pipeline.backend, "backpressure", None)
+            bp = None
+            if bp_fn is not None:
+                try:
+                    bp = bp_fn()
+                    if asyncio.iscoroutine(bp):
+                        bp = await bp
+                except Exception:
+                    bp = None
+            if bp and bp.get("est_wait_s") is not None:
+                budget = self.slo.targets.get("ttft") or self.qos.policy.shed_wait_s
+                if bp["est_wait_s"] > budget:
+                    self.qos.record_shed(tenant, priority)
+                    self.metrics.inc_request(model, endpoint, rtype, "429")
+                    return self._error(
+                        429,
+                        f"engine overloaded (estimated wait "
+                        f"{bp['est_wait_s']:.1f}s exceeds the "
+                        f"{budget:.1f}s budget); batch-class load shed",
+                        code="overloaded",
+                        headers={"Retry-After": str(bp.get("retry_after_s", 10))},
+                    )
         try:
             # off the event loop: chat-template render + BPE encode are
             # CPU-bound (the tokenizer's Rust encode releases the GIL), and a
@@ -335,6 +411,19 @@ class HttpService:
             # check runs before any stream response starts)
             self.metrics.inc_request(model, endpoint, rtype, "400")
             return self._error(400, str(e), code=e.code)
+
+        # per-tenant token-rate budget: charge prompt tokens + the output
+        # budget against the tenant's bucket; an exhausted budget answers a
+        # structured retriable 429 whose Retry-After says when the bucket
+        # will hold this request's cost — before any SSE bytes
+        cost = len(pre.token_ids) + max(0, pre.sampling.max_tokens)
+        decision = self.qos.admit(tenant, priority, cost)
+        if not decision.admitted:
+            self.metrics.inc_request(model, endpoint, rtype, "429")
+            return self._error(
+                429, decision.reason + "; retry later", code="rate_limited",
+                headers={"Retry-After": str(decision.retry_after_s)},
+            )
 
         tool_matcher = None
         if kind == "chat" and req.tool_choice not in (None, "none") and not req.tools:
@@ -402,11 +491,13 @@ class HttpService:
                             pre.token_ids,
                             skip_special_tokens=pre.skip_special_tokens,
                         )
-                # goodput tags: tenant/scenario headers ride the
+                # goodput/QoS tags: tenant/scenario/priority ride the
                 # PreprocessedRequest to the engine so BOTH trackers (this
-                # frontend's and the engine's) attribute the request
-                pre.tenant = request.headers.get("x-tenant", "")
+                # frontend's and the engine's) attribute the request and the
+                # scheduler serves it at the admitted class
+                pre.tenant = tenant
                 pre.scenario = request.headers.get("x-scenario", "")
+                pre.priority = priority
                 chunks = self._generate_chunks(
                     pipeline, pre, kind, model, annotations, tool_matcher,
                     echo_text=echo_text,

@@ -47,6 +47,10 @@ class PreprocessedRequest:
     # tenant-labeled SLO series attribute correctly ("" = untagged)
     tenant: str = ""
     scenario: str = ""
+    # multi-tenant QoS (utils/qos.py): priority class stamped by the
+    # frontend (x-priority header or per-tenant/adapter policy) — rides to
+    # the engine the same way tenant tags do; "" = standard
+    priority: str = ""
 
     def to_wire(self) -> dict:
         out = {
@@ -81,6 +85,8 @@ class PreprocessedRequest:
             out["tenant"] = self.tenant
         if self.scenario:
             out["scenario"] = self.scenario
+        if self.priority:
+            out["priority"] = self.priority
         if self.images:
             out["images"] = [im.to_wire() for im in self.images]
         return out
@@ -102,6 +108,7 @@ class PreprocessedRequest:
             lora_name=str(d.get("lora_name", "") or ""),
             tenant=str(d.get("tenant", "") or ""),
             scenario=str(d.get("scenario", "") or ""),
+            priority=str(d.get("priority", "") or ""),
             request_id=d["request_id"],
             token_ids=list(d["token_ids"]),
             sampling=SamplingParams(

@@ -89,6 +89,7 @@ DECLARED_METRIC_FAMILIES: tuple = (
     "dynamo_migration_pause_seconds",
     "dynamo_migration_requests_total",
     "dynamo_migration_tokens_salvaged_total",
+    "dynamo_planner_rebalance_executed_total",
     "dynamo_prefix_fetch_blocks_total",
     "dynamo_prefix_fetch_bytes_total",
     "dynamo_prefix_fetch_client_blocks_total",
@@ -101,6 +102,9 @@ DECLARED_METRIC_FAMILIES: tuple = (
     "dynamo_prefix_fetch_served_bytes_total",
     "dynamo_prefix_fetch_served_total",
     "dynamo_prefix_fetch_tokens_total",
+    "dynamo_qos_budget_fill",
+    "dynamo_qos_preemptions_total",
+    "dynamo_qos_requests_total",
     "dynamo_replay_inflight_requests",
     "dynamo_replay_requests_total",
     "dynamo_replay_schedule_lag_seconds",
@@ -398,6 +402,31 @@ def _sample_surfaces() -> list[tuple[str, str]]:
     gp.observe(RequestOutcome("r3", scenario="lora_churn", error=True))
     surfaces.append(("utils.goodput", gp.render_metrics()))
 
+    # multi-tenant QoS admission plane (utils/qos.py): budgets + classes ->
+    # the dynamo_qos_requests_total / dynamo_qos_budget_fill families
+    from dynamo_tpu.utils.qos import AdmissionController, QosPolicy
+
+    qos = AdmissionController(QosPolicy.from_specs(
+        "tenant-a=500,tenant-b=4000", "tenant-a=batch,tenant-b=critical",
+    ))
+    qos.admit("tenant-a", "batch", 120)
+    qos.admit("tenant-b", "critical", 64)
+    for _ in range(8):  # exhaust tenant-a's burst so a throttle renders
+        qos.admit("tenant-a", "batch", 400)
+    qos.record_shed("tenant-a", "batch")
+    surfaces.append(("utils.qos", qos.render_metrics()))
+
+    # planner rebalance executor (components/planner.py)
+    from dynamo_tpu.components.planner import PlannerService
+
+    class _PlannerDrt:
+        cplane = None
+
+    psvc = PlannerService(_PlannerDrt(), "ns")
+    psvc.rebalance_executed = 2
+    psvc.rebalance_execute_failures = 1
+    surfaces.append(("components.planner", psvc.render_metrics()))
+
     # trace-replay harness: the dynamo_replay_* client-side families
     from dynamo_tpu.loadgen.replay import ReplayMetrics
 
@@ -436,6 +465,11 @@ def _sample_surfaces() -> list[tuple[str, str]]:
     eng.scheduler.migration_in_pulled = 1
     eng.scheduler.migration_tokens_salvaged = 24
     eng.migration_pause_hist.observe(0.04)
+    # multi-tenant QoS: per-class victims so dynamo_qos_preemptions_total
+    # renders class-labeled samples on the engine surface
+    eng.scheduler.qos_preempted = {"batch": 3, "standard": 1}
+    eng.scheduler.qos_sheds = 2
+    eng.scheduler.qos_shed_migrations = 1
 
     class _DraftPool:
         pages_total, pages_used = 7, 3

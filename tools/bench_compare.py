@@ -63,6 +63,12 @@ DEFAULT_KEYS: tuple = (
     ("migration.parity", "higher", 0.001),
     ("migration.pause_ms_p99", "lower", 0.5),
     ("migration.goodput_delta", "higher", 1.0),
+    # multi-tenant QoS (r8+): the isolation ratio must not creep toward 1
+    # (B's ITL under burst, QoS on vs off), the token budget must keep
+    # biting on the burst arm, and critical goodput under burst must hold
+    ("qos.tenant_b_itl_ratio", "lower", 0.5),
+    ("qos.shed_fraction", "higher", 0.5),
+    ("qos.critical_goodput", "higher", 0.1),
     # replay goodput columns (aliased arrays; index 0 = goodput)
     ("replay.bursty.0", "higher", DEFAULT_TOL),
     ("replay.lctx.0", "higher", DEFAULT_TOL),

@@ -69,8 +69,8 @@ def render_status(doc: dict) -> str:
     header = (
         f"{'WORKER':<12} {'STATE':<10} {'HB':>6} {'SEEN':>6} {'MISS':>4} "
         f"{'SLOTS':>7} {'KV%':>6} {'KVMEM':>11} {'PREFIX':>9} {'SPEC':>10} "
-        f"{'LORA':>11} {'GOODPUT':>9} {'MIG':>7} {'STEP':>11} {'ROOF':>5} "
-        f"{'WAIT':>5} {'HBM':>9} {'CMPL':>5}  SLO"
+        f"{'LORA':>11} {'GOODPUT':>9} {'MIG':>7} {'QOS':>9} {'STEP':>11} "
+        f"{'ROOF':>5} {'WAIT':>5} {'HBM':>9} {'CMPL':>5}  SLO"
     )
     lines.append(header)
     lines.append("-" * len(header))
@@ -142,6 +142,21 @@ def render_status(doc: dict) -> str:
                 mig = f"{mig}!{res['migration_out_failed']}"
         else:
             mig = "-"
+        # multi-tenant QoS (utils/qos.py via resource_snapshot): running
+        # lanes per priority class (c/s/b) with cumulative shed count
+        # flagged; workers predating the plane (or with QoS disabled and no
+        # activity) show "-"
+        qos_res = res.get("qos") or {}
+        running = qos_res.get("running") or {}
+        if qos_res:
+            qos = "/".join(
+                f"{running.get(c, 0)}{c[0]}"
+                for c in ("critical", "standard", "batch")
+            )
+            if qos_res.get("sheds"):
+                qos = f"{qos}!{qos_res['sheds']}"
+        else:
+            qos = "-"
         # step anatomy (utils/step_anatomy.py via resource_snapshot): STEP =
         # host-side fraction of attributed engine time + the decode-window
         # dispatch cadence p50; ROOF = HBM floor over measured decode seconds
@@ -164,7 +179,7 @@ def render_status(doc: dict) -> str:
             f"{(f'{hb:.1f}s' if hb is not None else '-'):>6} "
             f"{w.get('last_seen_s', 0):>5.1f}s {w.get('missed_scrapes', 0):>4} "
             f"{slots:>7} {kv_pct:>5.1f}% {kv_mem:>11} {prefix:>9} {spec:>10} "
-            f"{lora:>11} {goodput:>9} {mig:>7} {step:>11} {roof:>5} "
+            f"{lora:>11} {goodput:>9} {mig:>7} {qos:>9} {step:>11} {roof:>5} "
             f"{kv.get('num_requests_waiting', 0):>5} "
             f"{_fmt_bytes(res.get('hbm_bytes_in_use', 0)):>9} "
             f"{res.get('xla_compiles', 0):>5}  {_slo_cell(w.get('slo'))}"
