@@ -2993,6 +2993,109 @@ async def run_step_anatomy() -> dict:
     return out
 
 
+async def run_events() -> dict:
+    """Flight-recorder overhead (observability tentpole): the journal must be
+    effectively free on the hot path, so price one emit() against the MEASURED
+    decode step wall on this platform and assert the fraction stays under 1%.
+    Also price the forensic read side — timeline() reconstruction against a
+    full 4096-event ring with a loaded capture set — since /debug/requests
+    runs on the serving event loop."""
+    import jax
+
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.engine.sampling import SamplingParams
+    from dynamo_tpu.engine.scheduler import EngineRequest
+    from dynamo_tpu.utils.events import CAPACITY, EventJournal
+
+    from tests.test_engine import tiny_engine_config  # CPU-smoke config
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    osl = 32
+    if on_cpu:
+        eng = AsyncJaxEngine(tiny_engine_config(decode_steps=4, pipeline_depth=2))
+        prompt = list(range(1, 33))
+    else:
+        eng = AsyncJaxEngine(bench_config(8, 64))
+        prompt = np.random.default_rng(7).integers(1, 31000, 256).tolist()
+
+    # ---- decode step wall: bs=1 so tokens map 1:1 to model steps; measure
+    # first-token..last-token (decode only, prefill excluded). Also count the
+    # journal events the run ACTUALLY emitted for the measured request — the
+    # journal's hot-path contract is a handful of emits per request, not per
+    # token, so the per-step overhead is (emits/request) amortized over the
+    # request's decode steps.
+    from dynamo_tpu.utils import events as events_mod
+
+    async def one(rid):
+        req = EngineRequest(
+            request_id=rid, token_ids=list(prompt),
+            sampling=SamplingParams(temperature=0.0, max_tokens=osl,
+                                    ignore_eos=True),
+        )
+        stamps = []
+        async for out in eng.generate(req):
+            if out.token is not None:
+                stamps.append(time.perf_counter())
+        return stamps
+
+    try:
+        await eng.start()
+        await one("warm")  # executables out of the measurement
+        stamps = await one("measured")
+    finally:
+        await eng.shutdown()
+    assert len(stamps) == osl
+    step_wall_s = (stamps[-1] - stamps[0]) / (osl - 1)
+    emits_per_request = len(events_mod.JOURNAL.events_for("measured"))
+    assert emits_per_request >= 3  # enqueued/admitted/first_token/finished
+
+    # ---- emit cost: a dedicated journal (same code path as the global one),
+    # realistic payload, mean over enough rounds to dominate timer noise
+    j = EventJournal()
+    n_emit = 20000
+    t0 = time.perf_counter()
+    for i in range(n_emit):
+        j.emit("sched.admitted", request_id="bench-r%d" % (i % 64),
+               tenant="bench", priority="standard", slot=i % 8, tokens=256)
+    emit_s = (time.perf_counter() - t0) / n_emit
+
+    # ---- forensic reconstruction: full ring + loaded capture set, read the
+    # way /debug/requests/{id} does (pinned chain wins over ring scan)
+    full = EventJournal()
+    n_req = 256
+    for i in range(CAPACITY):
+        full.emit("request.first_token", request_id="r%d" % (i % n_req))
+    for i in range(32):
+        full.pin("r%d" % i, "ttft_over_budget")
+    reads = 200
+    t0 = time.perf_counter()
+    for i in range(reads):
+        tl = full.timeline("r%d" % (i % n_req))
+        assert tl["found"]
+    reconstruct_ms = (time.perf_counter() - t0) / reads * 1e3
+
+    # the request's whole journal cost amortized over its decode steps, as a
+    # fraction of one measured step: the honest per-step price at the REAL
+    # emit rate (the planes emit on lifecycle decisions, not per token)
+    overhead_frac = (emit_s * emits_per_request / osl) / step_wall_s
+    out = {
+        "cpu_smoke": on_cpu,
+        "decode_step_wall_ms": round(step_wall_s * 1e3, 4),
+        "emit_us": round(emit_s * 1e6, 3),
+        "emits_per_request": emits_per_request,
+        "emit_overhead_frac": round(overhead_frac, 6),
+        "journal_events": CAPACITY,
+        "reconstruct_ms": round(reconstruct_ms, 4),
+    }
+    # acceptance: the journal costs <1% of decode step wall at the measured
+    # emit rate — even against the CPU-smoke toy model's sub-ms steps
+    assert overhead_frac < 0.01, out
+    # the forensic read must be interactive-debugging cheap (it runs on the
+    # serving loop); 50 ms is generous even for CPU-smoke machines
+    assert reconstruct_ms < 50.0, out
+    return out
+
+
 #: filled section-by-section so a crash in section N never erases sections
 #: 1..N-1 — __main__ prints whatever landed here even on a fatal error
 DETAIL: dict = {}
@@ -3143,6 +3246,9 @@ async def run() -> dict:
     # step-anatomy plane (r7 tentpole): host-overhead + roofline fractions
     # from the standing per-dispatch attribution, across decode/spec/LoRA
     await _section("step_anatomy", run_step_anatomy, 1500)
+    # flight recorder: emit cost vs the measured decode step wall (<1%
+    # asserted) + forensic timeline-reconstruction latency
+    await _section("events", run_events, 900)
     return _result()
 
 
@@ -3212,6 +3318,7 @@ def _summary(errors: dict) -> dict:
     mlora = DETAIL.get("multi_lora")
     replay = DETAIL.get("replay")
     sanat = DETAIL.get("step_anatomy")
+    evts = DETAIL.get("events")
     # per-scenario acceptance keys (replay.{scenario}.{goodput,ttft_p99_ms,
     # itl_p99_ms,tok_s}); wall/lag/stage detail rides bench_detail.json
     replay_summary = None
@@ -3289,7 +3396,8 @@ def _summary(errors: dict) -> dict:
         # ride bench_detail.json under spec_draft.
         "spec_draft": {
             "accept_draft": _get(sdraft, "acceptance_rate_draft"),
-            "accept_ngram": _get(sdraft, "acceptance_rate_ngram"),
+            # accept_ngram (the control arm) moved to bench_detail.json
+            # (truncation budget; the draft acceptance is the gated signal)
             "greedy_parity": _get(sdraft, "greedy_parity_draft"),
         },
         # M=4 adapters mixed-batch vs base at the same shape: the throughput
@@ -3298,7 +3406,8 @@ def _summary(errors: dict) -> dict:
         "multi_lora": {
             "mixed_tok_s_ratio": _get(mlora, "mixed_tok_s_ratio"),
             "parity": _get(mlora, "parity_mixed_vs_alone"),
-            "resident_evictions": _get(mlora, "resident_evictions"),
+            # resident_evictions moved to bench_detail.json (truncation
+            # budget; the LRU-churn proof is asserted inside the section)
         },
         "parity_disagg": {
             "ratio_measured_1chip": _get(dis, "ratio_measured_1chip"),
@@ -3365,6 +3474,16 @@ def _summary(errors: dict) -> dict:
                 if _get(sanat, "decode", "dispatch_gap_ms_p50") is not None
                 else None
             ),
+        },
+        # flight recorder: the journal's per-step cost fraction at the
+        # measured emit rate (the section asserts <1% itself) and the
+        # forensic timeline-reconstruction latency against a full ring.
+        # Short keys for the truncation budget — the full-named report
+        # (emit_us, decode_step_wall_ms, emits_per_request,
+        # emit_overhead_frac, reconstruct_ms) rides bench_detail.json
+        "events": {
+            "emit_frac": _get(evts, "emit_overhead_frac"),
+            "rec_ms": _get(evts, "reconstruct_ms"),
         },
         # the trace-replay spine: goodput under per-scenario SLO budgets,
         # columns per replay_cols (budgets + cpu_smoke flag + full named

@@ -13,6 +13,10 @@ Endpoints:
   - ``/cluster/status``   JSON fleet view: per-worker health snapshot,
                           last-seen staleness, gauges, SLO state — the
                           ``tools/dynotop.py`` data source
+  - ``/cluster/events``   fleet flight-recorder timeline: every worker's
+                          recent journal events merged in (wall, seq) order,
+                          filterable with ``?kind=``/``?tenant=``/
+                          ``?request=`` query params (utils/events.py)
 
     python -m dynamo_tpu.components.metrics --namespace dynamo --component backend --port 9091
 """
@@ -68,6 +72,7 @@ class MetricsService:
         app = web.Application()
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/cluster/status", self._cluster_status)
+        app.router.add_get("/cluster/events", self._cluster_events)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
@@ -115,6 +120,7 @@ class MetricsService:
                 "goodput": view.data.get("goodput"),
                 "stage_seconds": view.data.get("stage_seconds"),
                 "disagg": view.data.get("disagg"),
+                "events": view.data.get("events"),
             }
             workers.append(entry)
             summary["workers"] += 1
@@ -135,10 +141,57 @@ class MetricsService:
                 "overlap_blocks": self._overlap_blocks,
             },
             "workers": workers,
+            # merged fleet timeline tail (dynotop's events pane reads this
+            # off the one /cluster/status fetch it already makes)
+            "recent_events": self.cluster_events(limit=64),
         }
 
     async def _cluster_status(self, request: web.Request) -> web.Response:
         return web.json_response(self.cluster_status())
+
+    def cluster_events(
+        self,
+        kind: str = "",
+        tenant: str = "",
+        request_id: str = "",
+        limit: int = 200,
+    ) -> list[dict]:
+        """The fleet flight-recorder timeline: every worker's recent journal
+        events merged in (wall, seq) order, worker-labeled, filterable."""
+        from dynamo_tpu.utils import events as events_mod
+
+        merged = events_mod.merge_recent(
+            [
+                (f"{view.instance_id:x}", view.data.get("events") or {})
+                for view in self.aggregator.worker_views()
+            ],
+            # over-fetch before filtering so a filtered view still fills up
+            limit=max(limit, 1000) if (kind or tenant or request_id) else limit,
+        )
+        if kind:
+            merged = [e for e in merged if e.get("kind", "").startswith(kind)]
+        if tenant:
+            merged = [e for e in merged if e.get("tenant") == tenant]
+        if request_id:
+            merged = [e for e in merged if e.get("request_id") == request_id]
+        return merged[-limit:]
+
+    async def _cluster_events(self, request: web.Request) -> web.Response:
+        q = request.query
+        try:
+            limit = max(1, min(2000, int(q.get("limit", "200"))))
+        except ValueError:
+            limit = 200
+        events = self.cluster_events(
+            kind=q.get("kind", ""),
+            tenant=q.get("tenant", ""),
+            request_id=q.get("request", q.get("request_id", "")),
+            limit=limit,
+        )
+        return web.json_response({
+            "count": len(events),
+            "events": events,
+        })
 
     # ---------------- Prometheus ----------------
 

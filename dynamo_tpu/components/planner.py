@@ -30,7 +30,7 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
-from dynamo_tpu.utils import get_logger
+from dynamo_tpu.utils import events, get_logger
 
 log = get_logger("components.planner")
 
@@ -189,7 +189,12 @@ class Planner:
             occ_hot = hot.get("occupancy", 0.0)
             occ_cold = cold.get("occupancy", 0.0)
             gp = hot.get("goodput")
-            burning = gp is not None and gp < pol.goodput_floor
+            # burning = the hot worker is actively spending SLO budget: its
+            # windowed goodput sits under the floor, OR its own two-window
+            # burn-rate alert is firing (the flight-recorder signal; absent
+            # key = False, so pre-burn-rate fleets behave unchanged)
+            burn_alert = bool(hot.get("burn_alert"))
+            burning = (gp is not None and gp < pol.goodput_floor) or burn_alert
             if (
                 hot is not cold
                 and occ_cold <= pol.occupancy_cold
@@ -198,7 +203,13 @@ class Planner:
             ):
                 reason = (
                     f"occupancy {occ_hot:.2f}->{occ_cold:.2f}"
-                    + (f", goodput {gp:.2f} < {pol.goodput_floor}" if burning else "")
+                    + (f", goodput {gp:.2f} < {pol.goodput_floor}"
+                       if gp is not None and gp < pol.goodput_floor else "")
+                    + (
+                        ", burn-rate alert "
+                        + ",".join(hot.get("burn_alerting") or ("?",))
+                        if burn_alert else ""
+                    )
                 )
                 decision = RebalanceDecision(
                     source=str(hot.get("worker_id")),
@@ -316,6 +327,13 @@ class PlannerService:
             depth = await self.drt.cplane.queue_depth(self.prefill_queue)
         except Exception:
             depth = 0
+        events.emit(
+            "planner.observe", request_id="",
+            workers=len(loads), prefill_queue_depth=depth,
+            burn_alerts=sum(
+                1 for w in self._rebalance_inputs() if w.get("burn_alert")
+            ),
+        )
         decisions = self.planner.observe(
             loads,
             depth,
@@ -336,6 +354,11 @@ class PlannerService:
                 log.info(
                     "scale %s: %d -> %d (%s)", d.component, d.current, d.desired, d.reason
                 )
+                events.emit(
+                    "planner.decide", request_id="",
+                    action="scale", component=d.component,
+                    current=d.current, desired=d.desired, reason=d.reason,
+                )
         # hot-spot rebalancing (live migration): occupancy/goodput-burn skew
         # across the decode pool becomes a migrate-hot-to-cold decision
         rebalance = self.planner.rebalance(self._rebalance_inputs())
@@ -352,6 +375,11 @@ class PlannerService:
                 "rebalance %s: migrate %s -> %s (%s)",
                 self.decode_component, rebalance.source, rebalance.target,
                 rebalance.reason,
+            )
+            events.emit(
+                "planner.decide", request_id="",
+                action="rebalance", source=rebalance.source,
+                target=rebalance.target, reason=rebalance.reason,
             )
             if self.execute_rebalance:
                 await self._execute(rebalance)
@@ -380,6 +408,10 @@ class PlannerService:
             )
             return
         self._last_execute = now
+        events.emit(
+            "planner.execute", request_id="",
+            action="drain", source=decision.source, target=decision.target,
+        )
         import aiohttp
 
         try:
@@ -426,6 +458,9 @@ class PlannerService:
             total = res.get("kv_pages_total") or 0
             used = res.get("kv_pages_used", 0)
             gp = view.data.get("goodput") or {}
+            # burn-rate verdict off the worker's SLO broadcast (read-only:
+            # the planner consumes the two-window alert, never recomputes it)
+            burn = (view.data.get("slo") or {}).get("burn") or {}
             out.append({
                 "worker_id": f"{view.instance_id:x}",
                 "occupancy": (used / total) if total else 0.0,
@@ -434,6 +469,8 @@ class PlannerService:
                 "migration": bool(
                     (view.data.get("migration") or {}).get("enabled", False)
                 ),
+                "burn_alert": bool(burn.get("alerting")),
+                "burn_alerting": list(burn.get("alerting") or ()),
             })
         return out
 

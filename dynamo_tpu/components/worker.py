@@ -235,6 +235,12 @@ class WorkerService:
         slo = getattr(self._inner_engine, "slo_snapshot", None)
         if slo is not None:
             stats["slo"] = slo()
+        ev = getattr(self._inner_engine, "events_snapshot", None)
+        if ev is not None:
+            # flight-recorder summary: newest events + per-kind counts (the
+            # metrics component's /cluster/events merges the recent lists;
+            # dynotop's EVT column reads the counts)
+            stats["events"] = ev()
         goodput = getattr(self._inner_engine, "goodput_snapshot", None)
         if goodput is not None:
             # windowed per-scenario/tenant SLO-met fraction (dynotop GOODPUT

@@ -48,6 +48,20 @@ ENV_SPEC = "DYNTPU_FAULT_DATAPLANE"
 ENV_SEED = "DYNTPU_FAULT_SEED"
 
 
+def _journal(kind: str, fault: str) -> None:
+    """Flight-recorder breadcrumb for every fault that actually fires:
+    seeded chaos runs become self-documenting (`/debug/requests/{id}` shows
+    the injection next to the fallback arm it triggered). Never raises —
+    fault bookkeeping must not change the failure being injected."""
+    try:
+        from dynamo_tpu.utils import events
+
+        # "plane" not "kind": the journal's own kind parameter owns that name
+        events.emit("fault.injected", plane=kind, fault=fault)
+    except Exception:
+        pass
+
+
 class FaultPlan:
     """Parsed fault rules: per-kind drop/delay/corrupt decisions."""
 
@@ -98,10 +112,16 @@ class FaultPlan:
         return rng.random() < p
 
     def should_drop(self, kind: str) -> bool:
-        return self._hit(kind, "drop-part")
+        hit = self._hit(kind, "drop-part")
+        if hit:
+            _journal(kind, "drop-part")
+        return hit
 
     def should_corrupt(self, kind: str) -> bool:
-        return self._hit(kind, "corrupt-checksum")
+        hit = self._hit(kind, "corrupt-checksum")
+        if hit:
+            _journal(kind, "corrupt-checksum")
+        return hit
 
     def delay_s(self, kind: str) -> float:
         ms = self._rules.get((kind, "delay-ms"), 0.0)
@@ -148,9 +168,10 @@ class AdmissionFaultPlan:
         p = self._rules.get("reject-rate", 0.0)
         if p <= 0.0:
             return False
-        if p >= 1.0:
-            return True
-        return self._rng.random() < p
+        hit = True if p >= 1.0 else self._rng.random() < p
+        if hit:
+            _journal("admission", "reject")
+        return hit
 
     def delay_s(self) -> float:
         return self._rules.get("delay-ms", 0.0) / 1000.0

@@ -165,7 +165,10 @@ class SequenceManifest:
                 if self.penalty_output_from is not None
                 else len(self.prompt_tokens)
             ),
-            enqueue_ts=now,
+            # same back-dating as to_engine_request: the resumed request
+            # bills queue wait / TTFT / duration from the ORIGINAL
+            # submission, not from the moment the handoff failed
+            enqueue_ts=max(0.0, now - self.age_s) if now else 0.0,
             trace_id=self.trace_id,
             tenant=self.tenant,
             scenario=self.scenario,

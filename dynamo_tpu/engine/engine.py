@@ -23,7 +23,7 @@ from dynamo_tpu.engine.page_table import PageAllocator
 from dynamo_tpu.engine.scheduler import EngineRequest, Scheduler, StepOutput
 from dynamo_tpu.llm.kv_events import KvCacheEvent
 from dynamo_tpu.runtime.context import current_context
-from dynamo_tpu.utils import get_logger, tracing
+from dynamo_tpu.utils import events, get_logger, tracing
 from dynamo_tpu.utils.goodput import GoodputTracker
 from dynamo_tpu.utils.health import HealthMonitor
 from dynamo_tpu.utils.prometheus import Histogram
@@ -275,6 +275,12 @@ class AsyncJaxEngine:
             ctx = current_context()
             if ctx is not None:
                 request.trace_id = ctx.trace_id
+        events.emit(
+            "request.enqueued",
+            request_id=request.request_id, trace_id=request.trace_id,
+            tenant=request.tenant, priority=request.priority or "",
+            prompt_tokens=len(request.token_ids),
+        )
 
     def _register_stream(self, request_id: str) -> None:
         """Open the output channel for a request without scheduling it (the
@@ -439,6 +445,12 @@ class AsyncJaxEngine:
         if seq.finished:
             return None, outputs  # EOS/length landed during the drain
         seq.migrating = True
+        events.emit(
+            "migration.freeze",
+            request_id=seq.req.request_id, trace_id=seq.req.trace_id,
+            tenant=seq.req.tenant, priority=seq.req.priority or "",
+            generated=len(seq.generated),
+        )
         return self._build_manifest(seq), outputs
 
     def _build_manifest(self, seq):
@@ -565,6 +577,12 @@ class AsyncJaxEngine:
             )
             sched.migration_out_failed += 1
             log.warning("migration of %s failed before handoff: %s", request_id, e)
+            events.emit(
+                "migration.fallback",
+                request_id=request_id, trace_id=manifest.trace_id,
+                tenant=manifest.tenant, priority=manifest.priority or "",
+                arm="abort_unfreeze", error=type(e).__name__,
+            )
             return {"status": "failed", "error": f"{type(e).__name__}: {e}"}
         pause = time.monotonic() - t0
         committed = await self.run_on_engine(
@@ -581,6 +599,12 @@ class AsyncJaxEngine:
             request_id=request_id, trace_id=manifest.trace_id,
             attrs={"kv_blocks": manifest.kv_blocks,
                    "generated": len(manifest.generated)},
+        )
+        events.emit(
+            "migration.handoff",
+            request_id=request_id, trace_id=manifest.trace_id,
+            tenant=manifest.tenant, priority=manifest.priority or "",
+            pause_ms=round(pause * 1e3, 3), kv_blocks=manifest.kv_blocks,
         )
         relayed: list[int] = []
         item = first
@@ -616,8 +640,21 @@ class AsyncJaxEngine:
                 await self.run_on_engine(
                     lambda: self.sync_resume_migration(manifest, relayed)
                 )
+                events.emit(
+                    "migration.fallback",
+                    request_id=request_id, trace_id=manifest.trace_id,
+                    tenant=manifest.tenant, priority=manifest.priority or "",
+                    arm="resume_relayed", tokens_relayed=len(relayed),
+                    error=type(e).__name__,
+                )
                 return {"status": "resumed", "tokens_relayed": len(relayed)}
             # the client is gone too: nothing to resume for
+            events.emit(
+                "migration.fallback",
+                request_id=request_id, trace_id=manifest.trace_id,
+                tenant=manifest.tenant, priority=manifest.priority or "",
+                arm="client_gone", error=type(e).__name__,
+            )
             return {"status": "failed", "error": f"{type(e).__name__}: {e}"}
 
     @staticmethod
@@ -637,6 +674,13 @@ class AsyncJaxEngine:
         if not self.config.migration:
             raise RuntimeError("migration is disabled on this engine")
         req = manifest.to_engine_request(now=time.monotonic())
+        events.emit(
+            "migration.adopted",
+            request_id=req.request_id, trace_id=req.trace_id,
+            tenant=req.tenant, priority=req.priority or "",
+            kv_blocks=manifest.kv_blocks, generated=len(manifest.generated),
+            age_ms=round(manifest.age_s * 1e3, 3),
+        )
         self._stamp_submission(req)
         self._register_stream(req.request_id)
         self._inbox.put(req)
@@ -1088,6 +1132,11 @@ class AsyncJaxEngine:
     def slo_snapshot(self) -> dict:
         return self.slo.snapshot()
 
+    def events_snapshot(self, limit: int = 32) -> dict:
+        """Flight-recorder summary for worker stats broadcasts (the fleet
+        /cluster/events merge + dynotop's EVT column read this)."""
+        return events.JOURNAL.snapshot(limit=limit)
+
     def debug_steps(self, limit: int = 128, kind: Optional[str] = None) -> dict:
         """The ``/debug/steps`` payload: recent per-dispatch StepRecords
         (newest last) + the summary fractions — where the milliseconds of a
@@ -1469,6 +1518,21 @@ class AsyncJaxEngine:
                     self.step_count += 1
                 except Exception as e:  # engine-step failure: fail all running
                     log.exception("engine step failed")
+                    # the black box: record the crash, then dump the journal
+                    # ring to a JSONL post-mortem BEFORE failing requests, so
+                    # the dump holds the events that led here
+                    try:
+                        events.emit(
+                            "engine.crash", request_id="",
+                            error=type(e).__name__, step=self.step_count,
+                        )
+                        path = events.JOURNAL.dump_post_mortem(
+                            f"engine step failed: {type(e).__name__}: {e}"
+                        )
+                        if path:
+                            log.error("flight-recorder post-mortem: %s", path)
+                    except Exception:
+                        log.exception("post-mortem dump failed")
                     self._fail_all(e)
                     continue
                 self._post_grouped(outputs)

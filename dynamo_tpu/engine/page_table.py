@@ -275,6 +275,14 @@ class PageAllocator:
                     # engine identity IS its chained sequence hash)
                     self._emit(KvCacheEvent.removed([seq_hash]))
 
+            if restored:
+                from dynamo_tpu.utils import events
+
+                events.emit(
+                    "offload.restore", request_id=seq_id,
+                    blocks=restored, host_hits=len(host_pairs),
+                )
+
             cached_len = (len(device_hits) + restored) * self.page_size
 
             # 3. fresh pages for the rest of the prompt — one batched take

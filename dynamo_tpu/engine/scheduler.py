@@ -39,7 +39,7 @@ from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.engine.page_table import PageAllocator
 from dynamo_tpu.engine.sampling import MAX_EOS_IDS, SamplingParams, fold_seed
 from dynamo_tpu.spec import make_proposer
-from dynamo_tpu.utils import get_logger, tracing
+from dynamo_tpu.utils import events, get_logger, tracing
 from dynamo_tpu.utils.goodput import MAX_ITL_SAMPLES, RequestOutcome
 from dynamo_tpu.utils.prometheus import Histogram
 from dynamo_tpu.utils.qos import priority_rank, priority_weight
@@ -566,6 +566,10 @@ class Scheduler:
                 "engine.offload.drain", t0, duration=dt,
                 attrs={"blocks": drained},
             )
+            events.emit(
+                "offload.drain", request_id="", blocks=drained,
+                occupancy=round(alloc.used_pages / total, 4),
+            )
 
     # ---------------- page-table ladder ----------------
 
@@ -652,6 +656,12 @@ class Scheduler:
                 # per-step prefill cap (and stall everything queued behind it)
                 if len(req.token_ids) > self.config.max_model_len:
                     del self.waiting[idx]
+                    events.emit(
+                        "sched.admission_rejected",
+                        request_id=req.request_id, trace_id=req.trace_id,
+                        tenant=req.tenant, priority=req.priority or "",
+                        reason="oversized_prompt", prompt_tokens=len(req.token_ids),
+                    )
                     self._record_request_error(req)
                     outputs.append(
                         StepOutput(req.request_id, finished=True, finish_reason="error")
@@ -681,6 +691,12 @@ class Scheduler:
                             "rejecting %s: %s", req.request_id, e
                         )
                         del self.waiting[idx]
+                        events.emit(
+                            "sched.admission_rejected",
+                            request_id=req.request_id, trace_id=req.trace_id,
+                            tenant=req.tenant, priority=req.priority or "",
+                            reason="lora_unavailable", adapter=req.lora_name,
+                        )
                         self._record_request_error(req)
                         outputs.append(StepOutput(
                             req.request_id, finished=True, finish_reason="error"
@@ -689,6 +705,12 @@ class Scheduler:
                     if lora_slot is None:
                         del self.waiting[idx]
                         deferred.append(req)
+                        events.emit(
+                            "sched.admission_deferred",
+                            request_id=req.request_id, trace_id=req.trace_id,
+                            tenant=req.tenant, priority=req.priority or "",
+                            reason="lora_loading", adapter=req.lora_name,
+                        )
                         continue
                 del self.waiting[idx]
                 try:
@@ -711,6 +733,12 @@ class Scheduler:
                     # prefill): fail THIS request — it is in no queue or slot
                     # anymore, so nothing else would ever answer its caller
                     log.exception("admission failed for %s", req.request_id)
+                    events.emit(
+                        "sched.admission_rejected",
+                        request_id=req.request_id, trace_id=req.trace_id,
+                        tenant=req.tenant, priority=req.priority or "",
+                        reason="admission_error",
+                    )
                     self._release_lora_name(req.lora_name, lora_slot)
                     if req.request_id in self.allocator._seqs:
                         self.allocator.free_sequence(req.request_id)
@@ -780,6 +808,13 @@ class Scheduler:
         ):
             return False  # a shed handoff is already in flight; let it land
         self.qos_sheds += 1
+        events.emit(
+            "qos.shed",
+            request_id=victim.req.request_id, trace_id=victim.req.trace_id,
+            tenant=victim.req.tenant, priority=victim.req.priority or "",
+            site="engine", waiting_critical=req.request_id,
+            via="migration" if self.migrate_shed is not None else "preempt",
+        )
         if self.migrate_shed is not None:
             try:
                 if self.migrate_shed(victim.req.request_id):
@@ -837,11 +872,20 @@ class Scheduler:
             self.stage.queue_wait_n += 1
             self.stage_hist["queue_wait"].observe(wait)
             if self.slo is not None:
-                self.slo.observe("queue_wait", wait, tenant=req.tenant)
+                self.slo.observe(
+                    "queue_wait", wait, tenant=req.tenant,
+                    priority=req.priority or "",
+                )
             tracing.record_span(
                 "engine.queue_wait", now - wait, end=now,
                 request_id=req.request_id, trace_id=req.trace_id,
             )
+        events.emit(
+            "sched.admitted",
+            request_id=req.request_id, trace_id=req.trace_id,
+            tenant=req.tenant, priority=req.priority or "",
+            slot=slot, queue_wait_ms=round(wait * 1e3, 3) if wait else 0.0,
+        )
         cached_len, state = self.allocator.allocate_sequence(
             req.request_id, req.token_ids, salt=self._lora_salt(req)
         )
@@ -986,6 +1030,7 @@ class Scheduler:
                 continue
             f = seq.fetch
             res = None
+            timed_out = False
             if f.fut.done():
                 try:
                     res = f.fut.result()
@@ -997,6 +1042,7 @@ class Scheduler:
                 # the client's own timeout should have fired long ago — its
                 # loop is gone; a dead fetcher must never wedge admission
                 f.fut.cancel()
+                timed_out = True
                 log.warning(
                     "prefix fetch for %s missed the belt deadline; recomputing",
                     seq.req.request_id,
@@ -1031,6 +1077,13 @@ class Scheduler:
                            "holder": seq.req.kv_holder_addr,
                            "handoff": f.handoff},
                 )
+                events.emit(
+                    "prefix_fetch.hit",
+                    request_id=seq.req.request_id, trace_id=seq.req.trace_id,
+                    tenant=seq.req.tenant, priority=seq.req.priority or "",
+                    blocks=applied, bytes=res.bytes, handoff=f.handoff,
+                    holder=seq.req.kv_holder_addr,
+                )
             else:
                 self.prefix_fetch_fallbacks += 1
                 if f.handoff:
@@ -1040,6 +1093,14 @@ class Scheduler:
                     "%s for %s fell back to recompute (%s)",
                     "seq handoff pull" if f.handoff else "prefix fetch",
                     seq.req.request_id, status,
+                )
+                events.emit(
+                    "prefix_fetch.timeout"
+                    if timed_out or status == "timeout"
+                    else "prefix_fetch.fallback",
+                    request_id=seq.req.request_id, trace_id=seq.req.trace_id,
+                    tenant=seq.req.tenant, priority=seq.req.priority or "",
+                    status=status, handoff=f.handoff, waited_ms=round(dt * 1e3, 3),
                 )
             self._resume_after_fetch(seq, outputs)
         return resolved
@@ -1387,12 +1448,21 @@ class Scheduler:
             self.stage.queue_wait_n += 1
             self.stage_hist["queue_wait"].observe(wait)
             if self.slo is not None:
-                self.slo.observe("queue_wait", wait, tenant=req.tenant)
+                self.slo.observe(
+                    "queue_wait", wait, tenant=req.tenant,
+                    priority=req.priority or "",
+                )
             tracing.record_span(
                 "engine.queue_wait", now - wait, end=now,
                 request_id=req.request_id, trace_id=req.trace_id,
                 attrs={"adopted": True},
             )
+        events.emit(
+            "sched.admitted",
+            request_id=req.request_id, trace_id=req.trace_id,
+            tenant=req.tenant, priority=req.priority or "",
+            adopted=True, cached_tokens=cached_len,
+        )
         state = self.allocator._seqs[req.request_id]
         page_table = self._new_table(state.pages)
         lora_slot = 0
@@ -1651,7 +1721,20 @@ class Scheduler:
                     cap = self.allocator._seqs[seq.req.request_id].num_pages * \
                         self.config.page_size
                     if cap > p:
-                        max_d = min(max_d, cap - 1 - p)
+                        shrunk = min(max_d, cap - 1 - p)
+                        if shrunk < max_d:
+                            # page pressure with no victim left: the round
+                            # still runs, at a truncated proposal depth
+                            events.emit(
+                                "sched.spec_degraded",
+                                request_id=seq.req.request_id,
+                                trace_id=seq.req.trace_id,
+                                tenant=seq.req.tenant,
+                                priority=seq.req.priority or "",
+                                proposed=max_d, degraded_to=shrunk,
+                                reason="page_pressure",
+                            )
+                        max_d = shrunk
                         if drafts is not None:
                             drafts = drafts[:max_d]
                         break
@@ -2047,11 +2130,20 @@ class Scheduler:
                 self.stage.ttft_n += 1
                 self.stage_hist["ttft"].observe(ttft)
                 if self.slo is not None:
-                    self.slo.observe("ttft", ttft, tenant=req.tenant)
+                    self.slo.observe(
+                        "ttft", ttft, tenant=req.tenant,
+                        priority=req.priority or "",
+                    )
                 tracing.record_span(
                     "engine.ttft", req.enqueue_ts, duration=ttft,
                     request_id=req.request_id, trace_id=req.trace_id,
                     attrs={"cached": cached} if cached else None,
+                )
+                events.emit(
+                    "request.first_token",
+                    request_id=req.request_id, trace_id=req.trace_id,
+                    tenant=req.tenant, priority=req.priority or "",
+                    ttft_ms=round(ttft * 1e3, 3), cached_tokens=cached,
                 )
         else:
             # per-token inter-arrival gap at materialization time (a window's
@@ -2061,7 +2153,9 @@ class Scheduler:
             if len(seq.itl_gaps) < MAX_ITL_SAMPLES:
                 seq.itl_gaps.append(gap)
             if self.slo is not None:
-                self.slo.observe("itl", gap, tenant=req.tenant)
+                self.slo.observe(
+                    "itl", gap, tenant=req.tenant, priority=req.priority or ""
+                )
         seq.last_token_wall = now
         seq.sched_len = max(seq.sched_len, len(seq.generated))
         self.allocator.append_token(req.request_id, token)
@@ -2102,6 +2196,13 @@ class Scheduler:
         """Outcome for a request that failed BEFORE a sequence existed
         (oversized prompt, unknown adapter, admission crash): an error is an
         SLO miss, so it must reach the goodput plane like any finish."""
+        events.emit(
+            "request.failed",
+            request_id=req.request_id, trace_id=req.trace_id,
+            tenant=req.tenant, priority=req.priority or "",
+            reason="rejected",
+        )
+        events.JOURNAL.pin(req.request_id, "error")
         sink = self.outcome_sink
         if sink is None:
             return
@@ -2124,14 +2225,27 @@ class Scheduler:
         """Fold one finished sequence into the goodput plane (one
         RequestOutcome per natural finish; cancels and preemption re-queues
         never reach here). Sink failures must never fail the engine step."""
-        sink = self.outcome_sink
-        if sink is None:
-            return
         req = seq.req
         now = time.monotonic()
         ttft = None
         if seq.first_token_wall and req.enqueue_ts:
             ttft = max(0.0, seq.first_token_wall - req.enqueue_ts)
+        events.emit(
+            "request.failed" if error else "request.finished",
+            request_id=req.request_id, trace_id=req.trace_id,
+            tenant=req.tenant, priority=req.priority or "",
+            reason=reason, output_tokens=len(seq.generated),
+            ttft_ms=round(ttft * 1e3, 3) if ttft is not None else None,
+        )
+        # forensics auto-pin: a request that errored or blew its TTFT/ITL
+        # budget gets its event chain copied to the capture ring NOW, so
+        # /debug/requests/{id} still reconstructs it after ring eviction
+        pin_reason = "error" if error else self._slo_pin_reason(seq, ttft)
+        if pin_reason:
+            events.JOURNAL.pin(req.request_id, pin_reason)
+        sink = self.outcome_sink
+        if sink is None:
+            return
         try:
             sink(RequestOutcome(
                 request_id=req.request_id,
@@ -2150,6 +2264,19 @@ class Scheduler:
             ))
         except Exception:
             log.exception("outcome sink failed for %s", req.request_id)
+
+    def _slo_pin_reason(self, seq: RunningSeq, ttft: Optional[float]) -> Optional[str]:
+        """Did this finished sequence blow a configured TTFT/ITL budget?
+        (the auto-pin verdict for the forensic capture ring)"""
+        if self.slo is None:
+            return None
+        ttft_target = self.slo.targets.get("ttft")
+        if ttft is not None and ttft_target is not None and ttft > ttft_target:
+            return "ttft_over_budget"
+        itl_target = self.slo.targets.get("itl")
+        if itl_target is not None and any(g > itl_target for g in seq.itl_gaps):
+            return "itl_over_budget"
+        return None
 
     def _cancel_fetch(self, seq: RunningSeq) -> None:
         """Drop an in-flight remote-prefix pull. The fetch coroutine only
@@ -2191,11 +2318,19 @@ class Scheduler:
             # for page pressure before standard, standard before critical),
             # most-recently-admitted within a class — so a noisy batch burst
             # can never preempt a critical stream while any lower lane runs
-            return max(
+            victim = max(
                 candidates,
                 key=lambda s: (priority_rank(s.req.priority), s.admitted_order),
             )
-        return max(candidates, key=lambda s: s.admitted_order)
+        else:
+            victim = max(candidates, key=lambda s: s.admitted_order)
+        events.emit(
+            "sched.victim_picked",
+            request_id=victim.req.request_id, trace_id=victim.req.trace_id,
+            tenant=victim.req.tenant, priority=victim.req.priority or "",
+            candidates=len(candidates), qos=bool(self.config.qos),
+        )
+        return victim
 
     def _preempt(self, seq: RunningSeq) -> None:
         """Return a sequence to the waiting queue; its work restarts later
@@ -2205,6 +2340,12 @@ class Scheduler:
         self.preempt_count += 1
         cls = seq.req.priority or "standard"
         self.qos_preempted[cls] = self.qos_preempted.get(cls, 0) + 1
+        events.emit(
+            "sched.preempted",
+            request_id=seq.req.request_id, trace_id=seq.req.trace_id,
+            tenant=seq.req.tenant, priority=seq.req.priority or "",
+            generated=len(seq.generated), slot=seq.slot,
+        )
         seq.finished = True  # stray in-flight snapshots must skip it
         self._cancel_fetch(seq)
         # the draft cache dies with the slot; re-admission rebuilds it from
@@ -2219,8 +2360,11 @@ class Scheduler:
         new_req = EngineRequest(
             request_id=seq.req.request_id,
             token_ids=list(seq.req.token_ids) + seq.generated,
-            # the resumed wait is a fresh queue-wait period on the same trace
-            enqueue_ts=time.monotonic(),
+            # queue-entry clock carries the ORIGINAL submission forward: the
+            # resumed wait, TTFT, and goodput duration all bill from when the
+            # client first enqueued — a preemption must never make a request
+            # look FASTER than an uninterrupted run of the same work
+            enqueue_ts=seq.req.enqueue_ts or time.monotonic(),
             trace_id=seq.req.trace_id,
             images=seq.req.images,
             mm_embeds=seq.req.mm_embeds,  # offsets are prompt-relative: still valid

@@ -32,7 +32,7 @@ from dynamo_tpu.llm.http.metrics import Metrics
 from dynamo_tpu.utils.goodput import MAX_ITL_SAMPLES
 from dynamo_tpu.llm.protocols import sse
 from dynamo_tpu.llm.tools import ToolCallError, ToolCallingMatcher
-from dynamo_tpu.utils import get_logger, tracing
+from dynamo_tpu.utils import events, get_logger, tracing
 
 log = get_logger("http")
 
@@ -140,6 +140,7 @@ class HttpService:
         self.app.router.add_get("/metrics", self._metrics)
         self.app.router.add_get("/trace", self._trace)
         self.app.router.add_get("/debug/steps", self._debug_steps)
+        self.app.router.add_get("/debug/requests/{rid}", self._debug_request)
         self.app.router.add_get("/health", self._health)
         # probe split: /live answers "is this process running" and must never
         # block on (or 503 because of) the model manager or any downstream;
@@ -213,8 +214,9 @@ class HttpService:
         )
 
     async def _metrics(self, request: web.Request) -> web.Response:
-        extra = (self.slo.render_metrics() + self.goodput.render_metrics()
-                 + self.qos.render_metrics())
+        extra = (self.slo.render_metrics() + self.slo.render_burn_metrics()
+                 + self.goodput.render_metrics() + self.qos.render_metrics()
+                 + events.JOURNAL.render_metrics())
         if self._extra_metrics:
             extra += self._extra_metrics()
         return web.Response(text=self.metrics.render(extra), content_type="text/plain")
@@ -246,6 +248,16 @@ class HttpService:
             limit = 128
         kind = request.query.get("kind") or None
         return web.json_response(self._step_source(limit=limit, kind=kind))
+
+    async def _debug_request(self, request: web.Request) -> web.Response:
+        """Per-request forensics: the flight recorder's causally ordered
+        event chain for one request id, with inter-event durations
+        (``dt_ms``) and the pin verdict. Served from the live journal merged
+        with the capture ring, so over-budget/erroring requests stay
+        reconstructable after ring eviction (utils/events.py)."""
+        return web.json_response(
+            events.JOURNAL.timeline(request.match_info["rid"])
+        )
 
     def _error(
         self, status: int, message: str, code: str | None = None,
@@ -348,7 +360,13 @@ class HttpService:
             if delay > 0:
                 await asyncio.sleep(delay)
             if fault.should_reject():
-                self.qos.record_shed(tenant, priority)
+                # shed happens before the preprocessor stamps a request id:
+                # a client-supplied x-request-id keeps the shed chain
+                # reconstructable via /debug/requests/{id}
+                rid = request.headers.get("x-request-id", "")
+                self.qos.record_shed(tenant, priority, request_id=rid)
+                if rid:
+                    events.JOURNAL.pin(rid, "shed")
                 self.metrics.inc_request(model, endpoint, rtype, "429")
                 return self._error(
                     429, "admission fault injected (DYNTPU_FAULT_ADMISSION)",
@@ -372,7 +390,10 @@ class HttpService:
             if bp and bp.get("est_wait_s") is not None:
                 budget = self.slo.targets.get("ttft") or self.qos.policy.shed_wait_s
                 if bp["est_wait_s"] > budget:
-                    self.qos.record_shed(tenant, priority)
+                    rid = request.headers.get("x-request-id", "")
+                    self.qos.record_shed(tenant, priority, request_id=rid)
+                    if rid:
+                        events.JOURNAL.pin(rid, "shed")
                     self.metrics.inc_request(model, endpoint, rtype, "429")
                     return self._error(
                         429,
@@ -417,7 +438,10 @@ class HttpService:
         # structured retriable 429 whose Retry-After says when the bucket
         # will hold this request's cost — before any SSE bytes
         cost = len(pre.token_ids) + max(0, pre.sampling.max_tokens)
-        decision = self.qos.admit(tenant, priority, cost)
+        decision = self.qos.admit(
+            tenant, priority, cost,
+            request_id=getattr(pre, "request_id", "") or "",
+        )
         if not decision.admitted:
             self.metrics.inc_request(model, endpoint, rtype, "429")
             return self._error(
@@ -502,6 +526,7 @@ class HttpService:
                     pipeline, pre, kind, model, annotations, tool_matcher,
                     echo_text=echo_text,
                     tenant=pre.tenant,
+                    priority=priority,
                 )
                 if req.stream:
                     return await self._stream_response(request, chunks, model, endpoint, t0)
@@ -538,6 +563,7 @@ class HttpService:
         tool_matcher: Optional[ToolCallingMatcher] = None,
         echo_text: Optional[str] = None,
         tenant: str = "",
+        priority: str = "",
     ) -> AsyncIterator[dict]:
         gen = (
             ChatDeltaGenerator(model) if kind == "chat" else CompletionDeltaGenerator(model)
@@ -568,7 +594,9 @@ class HttpService:
             if t_first is None and out.token_ids:
                 t_first = t_prev = time.monotonic()
                 self.metrics.observe_ttft(model, t_first - t_start)
-                self.slo.observe("ttft", t_first - t_start, tenant=tenant)
+                self.slo.observe(
+                    "ttft", t_first - t_start, tenant=tenant, priority=priority
+                )
                 # OpenAI semantics: the role delta leads the stream at first-
                 # token time. Also the client's only honest TTFT signal — the
                 # first CONTENT delta can lag several tokens behind while the
@@ -582,7 +610,7 @@ class HttpService:
                 now = time.monotonic()
                 gap = (now - t_prev) / len(out.token_ids)
                 self.metrics.observe_itl(model, gap)
-                self.slo.observe("itl", gap, tenant=tenant)
+                self.slo.observe("itl", gap, tenant=tenant, priority=priority)
                 if len(itl_gaps) < MAX_ITL_SAMPLES:
                     itl_gaps.extend([gap] * min(
                         len(out.token_ids), MAX_ITL_SAMPLES - len(itl_gaps)

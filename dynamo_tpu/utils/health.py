@@ -115,10 +115,18 @@ class HealthMonitor:
                  "at_s": round(now - self._started, 3)}
             )
             del self._transitions[:-8]  # bounded history
+            prev = self._transitions[-1]["from"]
             self._state = state
             self._since = now
             self._reason = reason
         log.info("%s health: %s (%s)", self.component, state, reason or "-")
+        from dynamo_tpu.utils import events
+
+        events.emit(
+            "health.transition", request_id="",
+            component=self.component, from_state=prev, to_state=state,
+            reason=reason,
+        )
 
     # ---------------- heartbeat ----------------
 

@@ -31,6 +31,7 @@ _TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
 #: declaration all fail CI before any cluster exists. Keep one name per line
 #: (graftlint suppressions are per-line).
 DECLARED_METRIC_FAMILIES: tuple = (
+    "dynamo_alert_state",
     "dynamo_engine_context_chunk_total",
     "dynamo_engine_context_table_dispatch_total",
     "dynamo_engine_context_table_promotions_total",
@@ -59,6 +60,9 @@ DECLARED_METRIC_FAMILIES: tuple = (
     "dynamo_engine_ttft_seconds",
     "dynamo_engine_xla_compile_seconds_total",
     "dynamo_engine_xla_compiles_total",
+    "dynamo_event_captures_pinned_total",
+    "dynamo_event_emitted_total",
+    "dynamo_event_journal_size",
     "dynamo_goodput_itl_p99_seconds",
     "dynamo_goodput_ratio",
     "dynamo_goodput_requests_total",
@@ -109,6 +113,7 @@ DECLARED_METRIC_FAMILIES: tuple = (
     "dynamo_replay_requests_total",
     "dynamo_replay_schedule_lag_seconds",
     "dynamo_replay_tokens_total",
+    "dynamo_slo_burn_rate",
     "dynamo_slo_compliance_ratio",
     "dynamo_slo_error_budget_remaining",
     "dynamo_slo_latency_seconds",
@@ -379,9 +384,25 @@ def _sample_surfaces() -> list[tuple[str, str]]:
     for v in (0.1, 0.2, 0.7):
         slo.observe("ttft", v)
         slo.observe("itl", v / 20)
-    # tenant-labeled series must render conformantly alongside the aggregate
+    # tenant- and priority-class-labeled series must render conformantly
+    # alongside the aggregate
     slo.observe("ttft", 0.15, tenant="tenant-a")
+    slo.observe("ttft", 0.12, priority="critical")
     surfaces.append(("utils.slo", slo.render_metrics()))
+    # burn-rate alerting surface (dynamo_slo_burn_rate + dynamo_alert_state):
+    # a separate render method because the engine re-renders the same tracker
+    # under its dynamo_engine_slo prefix — burn/alert families appear exactly
+    # once, on the frontend /metrics
+    surfaces.append(("utils.slo.burn", slo.render_burn_metrics()))
+
+    # flight-recorder journal exposition (utils/events.py)
+    from dynamo_tpu.utils.events import EventJournal
+
+    ej = EventJournal()
+    ej.emit("request.enqueued", request_id="r-check", prompt_tokens=16)
+    ej.emit("request.finished", request_id="r-check", output_tokens=4)
+    ej.pin("r-check", "ttft_over_budget")
+    surfaces.append(("utils.events", ej.render_metrics()))
     hm = HealthMonitor("selfcheck")
     hm.set_state("ready", "self-check")
     hm.beat()

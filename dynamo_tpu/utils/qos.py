@@ -313,30 +313,56 @@ class AdmissionController:
         key = (cls, tenant, action)
         self._counts[key] = self._counts.get(key, 0) + 1
 
-    def admit(self, tenant: str, cls: str, tokens: int) -> AdmissionDecision:
+    def admit(
+        self, tenant: str, cls: str, tokens: int, request_id: str = ""
+    ) -> AdmissionDecision:
         """Charge ``tokens`` (prompt + output budget) against the tenant's
         bucket. A throttle is a *retriable* verdict: Retry-After says when
         the bucket will hold this request's cost."""
+        from dynamo_tpu.utils import events
+
         with self._lock:
             bucket = self._bucket_for(tenant)
             if bucket is None or bucket.try_consume(tokens):
                 self._count(cls, tenant, "admitted")
-                return AdmissionDecision(True, "admitted")
-            wait = bucket.seconds_until(tokens)
-            self._count(cls, tenant, "throttled")
-            return AdmissionDecision(
-                False, "throttled",
-                retry_after_s=int(round(
-                    min(RETRY_AFTER_MAX_S, max(RETRY_AFTER_MIN_S, wait))
-                )),
-                reason=f"tenant {tenant or 'default'!r} token budget exhausted",
+                decision = AdmissionDecision(True, "admitted")
+            else:
+                wait = bucket.seconds_until(tokens)
+                self._count(cls, tenant, "throttled")
+                decision = AdmissionDecision(
+                    False, "throttled",
+                    retry_after_s=int(round(
+                        min(RETRY_AFTER_MAX_S, max(RETRY_AFTER_MIN_S, wait))
+                    )),
+                    reason=f"tenant {tenant or 'default'!r} token budget exhausted",
+                )
+        # journal outside the lock (explicit id when the caller has one —
+        # HTTP admission runs before the RequestContext is established —
+        # else the ambient context's)
+        if decision.admitted:
+            events.emit(
+                "qos.admitted", request_id=request_id or None,
+                tenant=tenant, priority=cls, tokens=tokens,
             )
+        else:
+            events.emit(
+                "qos.throttled", request_id=request_id or None,
+                tenant=tenant, priority=cls, tokens=tokens,
+                retry_after_s=decision.retry_after_s,
+            )
+        return decision
 
-    def record_shed(self, tenant: str, cls: str) -> None:
+    def record_shed(self, tenant: str, cls: str, request_id: str = "") -> None:
         """One request shed by the engine-backpressure check (counted here so
         sheds and throttles read off one family)."""
         with self._lock:
             self._count(cls, tenant, "shed")
+        from dynamo_tpu.utils import events
+
+        events.emit(
+            "qos.shed", request_id=request_id or None,
+            tenant=tenant, priority=cls, site="frontend",
+        )
 
     def snapshot(self) -> dict:
         with self._lock:

@@ -18,10 +18,22 @@ Empty-window semantics: percentiles are ``None`` when the window holds no
 samples (never a fake 0.0 p99 — that reads as a *great* latency), and the
 rendered exposition simply omits the quantile samples (NaN-free).
 
-Per-tenant breakdown: ``observe(metric, seconds, tenant="a")`` feeds BOTH the
-aggregate series (every existing consumer sees every sample) and a
-tenant-keyed series rendered with a ``tenant=`` label on the same families —
-the view multi-tenant QoS scheduling consumes.
+Per-tenant and per-class breakdown: ``observe(metric, seconds, tenant="a",
+priority="critical")`` feeds the aggregate series (every existing consumer
+sees every sample) AND a tenant-keyed AND a priority-class-keyed series,
+rendered with ``tenant=`` / ``priority=`` labels on the same families — the
+views multi-tenant QoS scheduling and per-class dashboards consume.
+
+Burn-rate alerting (the SRE multi-window rule): the *burn rate* of a window
+is the fraction of its violation quota spent per unit of quota — observed
+violation ratio divided by the allowed ratio ``1 - objective``. Burn 1.0
+spends the budget exactly at the sustainable pace; burn 10 exhausts a
+300-second budget in 30 seconds. An alert fires only when BOTH a short
+window (fast detection) and the long window (burst de-noising) burn above
+the threshold, and clears when either drops back — the standard two-window
+trade of detection latency vs. flappiness. Rendered as
+``dynamo_slo_burn_rate{metric,window}`` and ``dynamo_alert_state{alert}``;
+PlannerService reads the same verdict (read-only) off worker stats.
 
 Thread-safe: the HTTP asyncio thread and the engine loop both observe.
 """
@@ -46,6 +58,15 @@ _ENV_KNOBS = {
 }
 
 PERCENTILES = (50, 90, 99)
+
+#: two-window burn-rate rule: the long window is the tracker's full window,
+#: the short window is this fraction of it (300 s -> 60 s)
+BURN_SHORT_FRACTION = 0.2
+#: both windows must burn above this to fire (DYNTPU_SLO_BURN_THRESHOLD
+#: overrides; 2.0 = spending budget at twice the sustainable pace)
+BURN_THRESHOLD = 2.0
+
+BURN_THRESHOLD_ENV = "DYNTPU_SLO_BURN_THRESHOLD"
 
 
 def targets_from_env(overrides: Optional[dict] = None) -> dict:
@@ -82,6 +103,7 @@ class SloTracker:
         objective: float = 0.99,
         max_samples: int = 4096,
         clock=time.monotonic,
+        burn_threshold: Optional[float] = None,
     ):
         self.targets = dict(targets or {})  # metric -> target SECONDS
         self.window_s = window_s
@@ -89,20 +111,33 @@ class SloTracker:
         self.max_samples = max_samples
         self._clock = clock
         self._lock = threading.Lock()
-        # (metric, tenant) -> deque[(ts, seconds)]; tenant "" = the aggregate
-        # series every tenant observation ALSO lands in
+        # (metric, tenant, priority) -> deque[(ts, seconds)]. Tenant and
+        # priority are breakdown DIMENSIONS, not a cross product: every
+        # observation lands in the aggregate ("", "") series, plus at most
+        # one tenant series and one priority-class series.
         self._samples: dict[tuple, deque] = {}
         # lifetime counters (survive window pruning), keyed like _samples
         self._observed: dict[tuple, int] = {}
         self._violated: dict[tuple, int] = {}
+        if burn_threshold is None:
+            raw = os.environ.get(BURN_THRESHOLD_ENV)
+            try:
+                burn_threshold = float(raw) if raw else BURN_THRESHOLD
+            except ValueError:
+                burn_threshold = BURN_THRESHOLD
+        self.burn_threshold = burn_threshold
 
     # ---------------- ingest ----------------
 
-    def observe(self, metric: str, seconds: float, tenant: str = "") -> None:
+    def observe(
+        self, metric: str, seconds: float, tenant: str = "", priority: str = ""
+    ) -> None:
         now = self._clock()
-        keys = [(metric, "")]
+        keys = [(metric, "", "")]
         if tenant:
-            keys.append((metric, tenant))
+            keys.append((metric, tenant, ""))
+        if priority:
+            keys.append((metric, "", priority))
         with self._lock:
             target = self.targets.get(metric)
             for key in keys:
@@ -125,13 +160,13 @@ class SloTracker:
 
     # ---------------- evaluation ----------------
 
-    def metric_state(self, metric: str, tenant: str = "") -> dict:
+    def metric_state(self, metric: str, tenant: str = "", priority: str = "") -> dict:
         """Window percentiles + target compliance + error budget for one
-        metric (optionally one tenant's series). An empty window reports
-        ``None`` percentiles — never a misleading 0.0 — and spends no
-        budget."""
+        metric (optionally one tenant's or one priority class's series). An
+        empty window reports ``None`` percentiles — never a misleading 0.0 —
+        and spends no budget."""
         now = self._clock()
-        key = (metric, tenant)
+        key = (metric, tenant, priority)
         with self._lock:
             vals = sorted(self._window(key, now))
             target = self.targets.get(metric)
@@ -163,15 +198,63 @@ class SloTracker:
             state["ok"] = budget > 0.0
             return state
 
+    # ---------------- burn-rate alerting ----------------
+
+    def _burn(self, key: tuple, target: float, horizon_s: float, now: float) -> float:
+        """Burn rate over the trailing ``horizon_s``: violation ratio divided
+        by the allowed ratio (1 - objective). Empty horizon burns nothing.
+        Caller holds the lock."""
+        q = self._samples.get(key)
+        if not q:
+            return 0.0
+        cutoff = now - horizon_s
+        vals = [v for ts, v in q if ts >= cutoff]
+        if not vals:
+            return 0.0
+        ratio = sum(1 for v in vals if v > target) / len(vals)
+        allowed = 1.0 - self.objective
+        return ratio / allowed if allowed > 0 else float(ratio > 0)
+
+    def burn_snapshot(self) -> dict:
+        """Two-window burn per targeted metric plus the alert verdicts —
+        the wire form worker stats broadcast and the planner reads."""
+        now = self._clock()
+        short_s = max(1.0, self.window_s * BURN_SHORT_FRACTION)
+        with self._lock:
+            metrics = {}
+            for metric, target in sorted(self.targets.items()):
+                key = (metric, "", "")
+                self._window(key, now)  # prune so the long horizon is exact
+                short = self._burn(key, target, short_s, now)
+                long = self._burn(key, target, self.window_s, now)
+                metrics[metric] = {
+                    "short": round(short, 4),
+                    "long": round(long, 4),
+                    # two-window rule: short gives detection speed, long
+                    # keeps a lone burst from paging
+                    "alert": short >= self.burn_threshold
+                    and long >= self.burn_threshold,
+                }
+        return {
+            "threshold": self.burn_threshold,
+            "short_window_s": short_s,
+            "long_window_s": self.window_s,
+            "metrics": metrics,
+            "alerting": sorted(m for m, s in metrics.items() if s["alert"]),
+        }
+
     def snapshot(self) -> dict:
-        """Wire form: per-metric aggregate state + per-tenant breakdown +
-        the overall verdict (aggregate series only — one noisy tenant blows
-        its own view, the fleet verdict stays the pooled objective)."""
+        """Wire form: per-metric aggregate state + per-tenant and per-class
+        breakdowns + burn verdicts + the overall ok (aggregate series only —
+        one noisy tenant blows its own view, the fleet verdict stays the
+        pooled objective)."""
         with self._lock:
             metrics = sorted(
-                {m for m, t in self._samples if not t} | set(self.targets)
+                {m for m, t, p in self._samples if not t and not p}
+                | set(self.targets)
             )
-            tenant_keys = sorted((t, m) for m, t in self._samples if t)
+            tenant_keys = sorted((t, m) for m, t, p in self._samples if t)
+            priority_keys = sorted((p, m) for m, t, p in self._samples if p)
         per = {m: self.metric_state(m) for m in metrics}
         out = {
             "objective": self.objective,
@@ -186,6 +269,15 @@ class SloTracker:
                     metric, tenant
                 )
             out["tenants"] = tenants
+        if priority_keys:
+            priorities: dict[str, dict] = {}
+            for priority, metric in priority_keys:
+                priorities.setdefault(priority, {})[metric] = self.metric_state(
+                    metric, priority=priority
+                )
+            out["priorities"] = priorities
+        if self.targets:
+            out["burn"] = self.burn_snapshot()
         return out
 
     def ok(self) -> bool:
@@ -204,6 +296,10 @@ class SloTracker:
             series.extend(
                 ({"tenant": tenant}, m, s) for m, s in sorted(metrics.items())
             )
+        for priority, metrics in sorted(snap.get("priorities", {}).items()):
+            series.extend(
+                ({"priority": priority}, m, s) for m, s in sorted(metrics.items())
+            )
         for base, metric, s in series:
             for p in PERCENTILES:
                 # empty windows render NO quantile sample (None must never
@@ -221,8 +317,8 @@ class SloTracker:
             violation_samples.append(({**base, "metric": metric}, s["violations_total"]))
         out = render_family(
             f"{prefix}_latency_seconds", "gauge",
-            "rolling-window latency percentile per SLO metric "
-            "(tenant-labeled series = one tenant's breakdown)",
+            "rolling-window latency percentile per SLO metric (tenant-/"
+            "priority-labeled series = one tenant's or class's breakdown)",
             quantile_samples,
         )
         if target_samples:
@@ -242,5 +338,36 @@ class SloTracker:
         out += render_family(
             f"{prefix}_violations_total", "counter",
             "lifetime observations exceeding their SLO target", violation_samples,
+        )
+        return out
+
+    def render_burn_metrics(self, prefix: str = "dynamo_slo") -> str:
+        """Burn-rate + alert-state exposition. A SEPARATE method from
+        render_metrics on purpose: the engine re-renders the SLO families
+        under its ``dynamo_engine_slo`` prefix (the colocated frontend owns
+        the bare names), but burn alerts are a fleet-level verdict rendered
+        exactly once — by the frontend /metrics and the conformance
+        surface."""
+        from dynamo_tpu.utils.prometheus import render_family
+
+        burn = self.burn_snapshot()
+        burn_samples, alert_samples = [], []
+        for metric, s in sorted(burn["metrics"].items()):
+            for window in ("short", "long"):
+                burn_samples.append(({"metric": metric, "window": window}, s[window]))
+            alert_samples.append(
+                ({"alert": f"slo_burn_{metric}"}, 1 if s["alert"] else 0)
+            )
+        out = render_family(
+            f"{prefix}_burn_rate", "gauge",
+            "error-budget burn rate per SLO metric and window (1.0 = spending "
+            "the violation quota exactly at the sustainable pace)",
+            burn_samples or [({"metric": "ttft", "window": "short"}, 0.0)],
+        )
+        out += render_family(
+            "dynamo_alert_state", "gauge",
+            "multi-window burn-rate alert verdict (1 = firing: both windows "
+            "burn above threshold; 0 = ok)",
+            alert_samples or [({"alert": "slo_burn_ttft"}, 0)],
         )
         return out

@@ -79,6 +79,11 @@ def _fill_representative(bench):
         "tenant_b_on": {"itl_p99_ms": 3.432}, "tenant_b_off": {"itl_p99_ms": 654.4},
     }
     bench.DETAIL["platform"] = "tpu"
+    bench.DETAIL["events"] = {
+        "cpu_smoke": False, "decode_step_wall_ms": 5.0521, "emit_us": 8.271,
+        "emits_per_request": 7, "emit_overhead_frac": 0.002803,
+        "journal_events": 4096, "reconstruct_ms": 0.2905,
+    }
     bench.DETAIL["step_anatomy"] = {
         "cpu_smoke": False,
         "decode": {"host_frac": 0.3124, "roofline_frac": 0.6981,
@@ -148,6 +153,9 @@ def test_summary_line_fits_truncation_budget(bench_mod, tmp_path, monkeypatch):
         "tenant_b_itl_ratio": 0.0052, "shed_fraction": 0.8333,
         "critical_goodput": 0.9873,
     }
+    # flight recorder: short keys on the line (full-named report in
+    # bench_detail.json)
+    assert s["events"] == {"emit_frac": 0.002803, "rec_ms": 0.2905}
     # ratio_derived moved to bench_detail.json (truncation budget)
     assert s["parity_kv_routing"] == {"ratio_measured": 2.79}
     assert s["parity_host_offload"]["ratio_projected"] == 8.82

@@ -1,0 +1,500 @@
+"""Flight recorder (utils/events.py): journal semantics, forensic captures,
+burn-rate alerting, fleet merge, and the conformance pins.
+
+Tier-1, CPU, fast: everything here is pure-Python journal/tracker work plus
+one scheduler built without a runner (the prometheus --check idiom). The
+replay-driven e2e (shed + migrated request chains over a real socket) lives
+in test_events_e2e.py under the slow marker.
+"""
+
+import ast
+import json
+from pathlib import Path
+
+import pytest
+
+from dynamo_tpu.utils import events as events_mod
+from dynamo_tpu.utils.events import (
+    CAPTURE_EVENTS,
+    DECLARED_EVENT_KINDS,
+    EventJournal,
+    merge_recent,
+)
+from dynamo_tpu.utils.prometheus import check_exposition
+from dynamo_tpu.utils.slo import SloTracker
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+# ---------------- journal semantics ----------------
+
+
+def test_emit_assigns_causal_seq_and_bounded_ring():
+    j = EventJournal(capacity=8)
+    for i in range(20):
+        j.emit("request.enqueued", request_id=f"r{i}")
+    snap = j.snapshot(limit=100)
+    assert snap["emitted"] == 20
+    assert len(snap["recent"]) == 8  # ring bound, oldest evicted
+    seqs = [e["seq"] for e in snap["recent"]]
+    assert seqs == sorted(seqs) and seqs[-1] == 19
+
+
+def test_undeclared_kind_raises():
+    j = EventJournal()
+    with pytest.raises(ValueError, match="undeclared event kind"):
+        j.emit("sched.admited")  # typo must fail loudly, not journal garbage
+
+
+def test_explicit_ids_win_over_ambient_context():
+    from dynamo_tpu.runtime.context import new_context, use_context
+
+    j = EventJournal()
+    ctx = new_context(request_id="ambient-r")
+    ctx.ensure_trace_id()
+    with use_context(ctx):
+        amb = j.emit("qos.admitted", tenant="t1")
+        exp = j.emit("sched.admitted", request_id="explicit-r")
+    assert amb.request_id == "ambient-r"
+    assert amb.trace_id  # stamped from the context
+    assert exp.request_id == "explicit-r"
+    assert exp.trace_id == "explicit-r"  # falls back to the request id
+
+
+def test_pin_survives_ring_eviction_and_is_idempotent():
+    j = EventJournal(capacity=4, capture_capacity=2)
+    j.emit("request.enqueued", request_id="slow-1")
+    j.emit("request.first_token", request_id="slow-1")
+    assert j.pin("slow-1", "ttft_over_budget") is True
+    assert j.pin("slow-1", "error") is False  # first reason wins
+    assert j.capture_reason("slow-1") == "ttft_over_budget"
+    # flood the ring: the live entries evict, the capture does not
+    for i in range(16):
+        j.emit("request.enqueued", request_id=f"noise-{i}")
+    tl = j.timeline("slow-1")
+    assert tl["found"] and tl["pinned"] == "ttft_over_budget"
+    assert [e["kind"] for e in tl["events"]] == [
+        "request.enqueued", "request.first_token",
+    ]
+    # LRU bound: two more captures push the oldest out
+    assert j.pin("noise-14", "error") and j.pin("noise-15", "error")
+    assert j.capture_reason("slow-1") is None
+    assert j.pinned_total == 3
+
+
+def test_capture_is_bounded_per_request():
+    j = EventJournal(capacity=2048)
+    for _ in range(CAPTURE_EVENTS + 50):
+        j.emit("request.first_token", request_id="chatty")
+    j.pin("chatty", "itl_over_budget")
+    for i in range(3000):  # evict the ring so only the capture answers
+        j.emit("request.enqueued", request_id=f"n{i}")
+    tl = j.timeline("chatty")
+    assert len(tl["events"]) == CAPTURE_EVENTS
+
+
+def test_timeline_durations_are_causal():
+    t = {"now": 100.0}
+    j = EventJournal(clock=lambda: t["now"])
+    j.emit("request.enqueued", request_id="r1")
+    t["now"] = 100.25
+    j.emit("sched.admitted", request_id="r1", slot=0)
+    t["now"] = 100.3
+    j.emit("request.first_token", request_id="r1")
+    tl = j.timeline("r1")
+    assert [e["dt_ms"] for e in tl["events"]] == [0.0, 250.0, 50.0]
+    assert tl["span_ms"] == 300.0
+    assert tl["pinned"] is None
+    assert j.timeline("ghost")["found"] is False
+
+
+def test_merge_recent_orders_across_workers():
+    a, b = EventJournal(), EventJournal()
+    clock = {"now": 0.0}
+    a._clock = b._clock = lambda: clock["now"]
+    clock["now"] = 1.0
+    a.emit("request.enqueued", request_id="ra")
+    clock["now"] = 2.0
+    b.emit("request.enqueued", request_id="rb")
+    clock["now"] = 3.0
+    a.emit("request.finished", request_id="ra")
+    merged = merge_recent([
+        ("worker-a", a.snapshot()), ("worker-b", b.snapshot()),
+    ])
+    assert [e["worker_id"] for e in merged] == ["worker-a", "worker-b", "worker-a"]
+    assert merge_recent([("w", a.snapshot())], limit=1)[0]["kind"] == "request.finished"
+    assert merge_recent([("w", None)]) == []  # workers predating the plane
+
+
+def test_post_mortem_dump_jsonl(tmp_path, monkeypatch):
+    monkeypatch.setenv(events_mod.POSTMORTEM_DIR_ENV, str(tmp_path))
+    j = EventJournal()
+    j.emit("request.enqueued", request_id="r1")
+    j.emit("engine.crash", request_id="", error="Boom", step=7)
+    path = j.dump_post_mortem("engine step failed: Boom")
+    assert path is not None and path.startswith(str(tmp_path))
+    lines = [json.loads(ln) for ln in Path(path).read_text().splitlines()]
+    assert lines[0]["postmortem"].startswith("engine step failed")
+    assert lines[0]["events"] == 2
+    assert [ev["kind"] for ev in lines[1:]] == ["request.enqueued", "engine.crash"]
+    # never-raises contract: an unwritable directory returns None
+    assert j.dump_post_mortem("x", path="/nonexistent-dir/pm.jsonl") is None
+
+
+def test_event_exposition_is_conformant():
+    j = EventJournal()
+    j.emit("qos.shed", request_id="r1", tenant="t")
+    j.pin("r1", "shed")
+    text = j.render_metrics()
+    assert check_exposition(text) == []
+    assert 'dynamo_event_emitted_total{kind="qos.shed"} 1' in text
+    assert "dynamo_event_journal_size 1" in text
+    assert "dynamo_event_captures_pinned_total 1" in text
+    # an empty journal still renders every declared family (placeholders)
+    empty = EventJournal().render_metrics()
+    assert check_exposition(empty) == []
+    for fam in ("dynamo_event_emitted_total", "dynamo_event_journal_size",
+                "dynamo_event_captures_pinned_total"):
+        assert f"# TYPE {fam}" in empty
+
+
+def test_emit_records_exemplar_span_when_tracing(monkeypatch):
+    from dynamo_tpu.utils import tracing
+
+    monkeypatch.setattr(tracing, "enabled", lambda: True)
+    recorded = []
+    monkeypatch.setattr(
+        tracing, "record_span",
+        lambda name, *a, **kw: recorded.append((name, kw)),
+    )
+    j = EventJournal()
+    ev = j.emit("sched.preempted", request_id="r9", generated=4)
+    assert recorded and recorded[0][0] == "event.sched.preempted"
+    assert recorded[0][1]["attrs"]["event_seq"] == ev.seq
+    assert recorded[0][1]["trace_id"] == "r9"
+
+
+# ---------------- conformance: static tuple vs runtime tuple ----------------
+
+
+def test_static_event_declaration_matches_runtime_tuple():
+    """The event-conformance detector's AST view of DECLARED_EVENT_KINDS must
+    equal the tuple Python imports (same file, two readers) — the mirror of
+    the metric-conformance cross-check."""
+    from tools.graftlint.detectors.event_conformance import (
+        DECLARING_MODULE,
+        _find_declaration,
+    )
+
+    tree = ast.parse((ROOT / DECLARING_MODULE).read_text())
+    declared, _ = _find_declaration(tree)
+    assert {kind for kind, _ in declared} == set(DECLARED_EVENT_KINDS)
+    assert len(DECLARED_EVENT_KINDS) == len(set(DECLARED_EVENT_KINDS))
+
+
+def test_event_kind_typo_is_caught_statically(tmp_path):
+    from tools.graftlint.cli import run_scan
+
+    mod = tmp_path / "emitter.py"
+    mod.write_text(
+        "DECLARED_EVENT_KINDS = (\n"
+        '    "demo.admitted",\n'
+        ")\n\n\n"
+        "def instrument(journal):\n"
+        '    journal.emit("demo.admited")\n'  # transposed letters
+    )
+    findings, _ = run_scan([mod], root=tmp_path)
+    msgs = [f.message for f in findings if not f.suppressed]
+    assert any("demo.admited" in m for m in msgs), msgs
+    assert any("emitted by no site" in m for m in msgs), msgs
+
+
+# ---------------- burn-rate alerting (utils/slo.py) ----------------
+
+
+def _burn_tracker(clk):
+    return SloTracker(
+        {"ttft": 0.1}, window_s=100.0, objective=0.9,
+        clock=lambda: clk["now"], burn_threshold=2.0,
+    )
+
+
+def test_burn_rate_fires_on_sustained_violation_and_clears():
+    clk = {"now": 1000.0}
+    slo = _burn_tracker(clk)
+    # sustained violations across the whole window: both windows burn hot
+    for i in range(50):
+        clk["now"] += 1.0
+        slo.observe("ttft", 0.5)  # 5x the 100 ms target
+    burn = slo.burn_snapshot()
+    st = burn["metrics"]["ttft"]
+    # violation ratio 1.0 against allowed 0.1 -> burn 10x in both windows
+    assert st["short"] == pytest.approx(10.0)
+    assert st["long"] == pytest.approx(10.0)
+    assert st["alert"] is True
+    assert burn["alerting"] == ["ttft"]
+    assert burn["short_window_s"] == pytest.approx(20.0)  # 0.2 * window
+    # recovery: fast samples push the SHORT window under threshold -> the
+    # two-window rule clears even while the long window is still digesting
+    for i in range(200):
+        clk["now"] += 0.1
+        slo.observe("ttft", 0.01)
+    burn2 = slo.burn_snapshot()
+    assert burn2["metrics"]["ttft"]["short"] < 2.0
+    assert burn2["metrics"]["ttft"]["alert"] is False
+    assert burn2["alerting"] == []
+
+
+def test_burn_requires_both_windows():
+    """A short burst alone must not page: the long window de-noises it."""
+    clk = {"now": 0.0}
+    slo = _burn_tracker(clk)
+    # a long healthy history...
+    for _ in range(80):
+        clk["now"] += 1.0
+        slo.observe("ttft", 0.01)
+    # ...then a violent 10-sample burst inside the short window only
+    for _ in range(10):
+        clk["now"] += 0.5
+        slo.observe("ttft", 0.9)
+    burn = slo.burn_snapshot()
+    st = burn["metrics"]["ttft"]
+    assert st["short"] >= 2.0  # the burst dominates the short window
+    assert st["long"] < 2.0  # diluted across the long window
+    assert st["alert"] is False
+
+
+def test_burn_exposition_and_snapshot_surface():
+    clk = {"now": 0.0}
+    slo = _burn_tracker(clk)
+    for _ in range(20):
+        clk["now"] += 1.0
+        slo.observe("ttft", 0.5)
+    text = slo.render_burn_metrics()
+    assert check_exposition(text) == []
+    assert 'dynamo_slo_burn_rate{metric="ttft",window="short"}' in text
+    assert 'dynamo_slo_burn_rate{metric="ttft",window="long"}' in text
+    assert 'dynamo_alert_state{alert="slo_burn_ttft"} 1' in text
+    # the burn verdict rides snapshot() for worker stats -> planner
+    snap = slo.snapshot()
+    assert snap["burn"]["alerting"] == ["ttft"]
+    # untargeted tracker: no burn block, placeholder exposition stays
+    # conformant (families must render for the --check gate regardless)
+    bare = SloTracker()
+    assert "burn" not in bare.snapshot()
+    bare_text = bare.render_burn_metrics()
+    assert check_exposition(bare_text) == []
+    assert "# TYPE dynamo_slo_burn_rate gauge" in bare_text
+    assert "# TYPE dynamo_alert_state gauge" in bare_text
+
+
+def test_slo_priority_class_series():
+    """Satellite: observe(priority=) feeds a class-keyed series on the same
+    families, surfaced in snapshot()['priorities'] and rendered with a
+    priority label."""
+    slo = SloTracker({"ttft": 0.1})
+    slo.observe("ttft", 0.05, tenant="t-a", priority="critical")
+    slo.observe("ttft", 0.3, priority="batch")
+    snap = slo.snapshot()
+    assert set(snap["priorities"]) == {"critical", "batch"}
+    assert snap["priorities"]["batch"]["ttft"]["violations"] == 1
+    assert snap["tenants"]["t-a"]["ttft"]["count"] == 1
+    # the aggregate series sees every sample (breakdowns are views, not splits)
+    assert snap["metrics"]["ttft"]["count"] == 2
+    text = slo.render_metrics()
+    assert check_exposition(text) == []
+    assert 'priority="critical"' in text and 'priority="batch"' in text
+
+
+def test_planner_rebalance_honors_burn_alert():
+    """The planner consumes the burn verdict read-only: a hot worker whose
+    burn-rate alert fires counts as burning even with healthy goodput."""
+    from dynamo_tpu.components.planner import Planner, RebalancePolicy
+
+    planner = Planner(rebalance_policy=RebalancePolicy(
+        occupancy_hot=0.8, occupancy_cold=0.5, goodput_floor=0.9,
+        sustain=1, cooldown_s=0.0,
+    ))
+    workers = [
+        {"worker_id": "aa", "occupancy": 0.9, "goodput": 1.0,
+         "servable": True, "migration": True, "burn_alert": True,
+         "burn_alerting": ["ttft"]},
+        {"worker_id": "bb", "occupancy": 0.2, "goodput": 1.0,
+         "servable": True, "migration": True},
+    ]
+    d = planner.rebalance(workers, now=10.0)
+    assert d is not None and d.source == "aa"
+    assert "burn-rate alert ttft" in d.reason
+
+
+# ---------------- satellite: preempt keeps the original queue clock --------
+
+
+def test_preempt_requeue_preserves_original_enqueue_clock():
+    """A preempted-and-requeued request must bill queue wait / TTFT /
+    duration from its ORIGINAL submission, not the requeue instant."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.page_table import PageAllocator
+    from dynamo_tpu.engine.sampling import SamplingParams
+    from dynamo_tpu.engine.scheduler import EngineRequest, RunningSeq, Scheduler
+
+    cfg = EngineConfig(model_id="tiny", page_size=4, num_pages=8, max_seqs=2,
+                       prefill_buckets=(16,))
+    alloc = PageAllocator(cfg.num_pages, cfg.page_size)
+    sched = Scheduler(cfg, None, alloc)
+    req = EngineRequest(
+        request_id="pre-1", token_ids=[1, 2, 3, 4],
+        sampling=SamplingParams(temperature=0.0, max_tokens=8),
+        enqueue_ts=123.456, trace_id="tr-1", tenant="t-a", priority="standard",
+    )
+    _, st = alloc.allocate_sequence("pre-1", req.token_ids)
+    seq = RunningSeq(req=req, slot=0, prompt_len=4, cached_len=0,
+                     generated=[7, 8], page_table=st.pages)
+    sched.slots[0] = seq
+    sched._preempt(seq)
+    requeued = sched.waiting[0]
+    assert requeued.request_id == "pre-1"
+    assert requeued.enqueue_ts == 123.456  # the original clock, not now()
+    assert requeued.token_ids == [1, 2, 3, 4, 7, 8]
+    assert requeued.tenant == "t-a" and requeued.priority == "standard"
+
+
+def test_resume_request_backdates_enqueue_clock():
+    """The migration twin of the preempt fix: to_resume_request back-dates
+    by the manifest's recorded age so the destination's recompute path also
+    bills from the original submission."""
+    from dynamo_tpu.disagg.migrate import SequenceManifest
+
+    man = SequenceManifest(
+        request_id="m-1", prompt_tokens=[1, 2, 3], generated=[4],
+        sampling={"temperature": 0.0, "max_tokens": 8}, age_s=2.5,
+    )
+    res = man.to_resume_request([], now=50.0)
+    assert res.enqueue_ts == pytest.approx(47.5)
+    eng = man.to_engine_request(now=50.0)
+    assert eng.enqueue_ts == pytest.approx(47.5)
+    # a degenerate clock never produces a negative timestamp
+    assert man.to_resume_request([], now=1.0).enqueue_ts == 0.0
+
+
+# ---------------- chaos breadcrumbs ----------------
+
+
+def test_fault_injection_journals_breadcrumbs(monkeypatch):
+    from dynamo_tpu.disagg import faults
+
+    monkeypatch.setenv(faults.ENV_ADMISSION, "reject-rate:1.0")
+    monkeypatch.setenv(faults.ENV_SEED, "3")
+    before = events_mod.JOURNAL.snapshot()["counts"].get("fault.injected", 0)
+    plan = faults.admission_plan()
+    assert plan.should_reject() is True
+    after = events_mod.JOURNAL.snapshot()["counts"].get("fault.injected", 0)
+    assert after == before + 1
+
+
+# ---------------- fleet timeline (components/metrics) ----------------
+
+
+def _metrics_service_with_events():
+    import time as _time
+
+    from dynamo_tpu.components.metrics import MetricsService
+    from dynamo_tpu.llm.kv_router.metrics_aggregator import WorkerView
+    from dynamo_tpu.llm.kv_router.scheduler import WorkerLoad
+
+    class _Drt:
+        cplane = None
+
+    svc = MetricsService(_Drt(), "ns", "backend")
+    clock = {"now": 0.0}
+    journals = []
+    for wid, rid, tenant in ((0xA1, "r-a", "t1"), (0xB2, "r-b", "t2")):
+        j = EventJournal(clock=lambda: clock["now"])
+        clock["now"] += 1.0
+        j.emit("request.enqueued", request_id=rid, tenant=tenant)
+        clock["now"] += 1.0
+        j.emit("qos.shed", request_id=rid, tenant=tenant, site="frontend")
+        journals.append((wid, j))
+        kv = {"request_active_slots": 1, "request_total_slots": 8,
+              "kv_active_blocks": 1, "kv_total_blocks": 10}
+        svc.aggregator._workers[wid] = WorkerView(
+            wid,
+            data={"kv_metrics": kv, "events": j.snapshot()},
+            load=WorkerLoad.from_wire(wid, kv),
+            last_seen=_time.monotonic(),
+        )
+    return svc
+
+
+def test_cluster_events_merge_and_filters():
+    svc = _metrics_service_with_events()
+    merged = svc.cluster_events()
+    assert len(merged) == 4
+    # (wall, seq)-ordered across workers, each labeled with its worker
+    assert [e["worker_id"] for e in merged] == ["a1", "a1", "b2", "b2"]
+    walls = [e["wall"] for e in merged]
+    assert walls == sorted(walls)
+    # filters: kind is a startswith match (plane-level), tenant/request exact
+    assert {e["kind"] for e in svc.cluster_events(kind="qos.")} == {"qos.shed"}
+    assert all(e["tenant"] == "t2" for e in svc.cluster_events(tenant="t2"))
+    by_req = svc.cluster_events(request_id="r-a")
+    assert len(by_req) == 2 and all(e["request_id"] == "r-a" for e in by_req)
+    assert svc.cluster_events(kind="migration.") == []
+    assert len(svc.cluster_events(limit=1)) == 1
+
+
+def test_cluster_status_carries_recent_events_and_worker_counts():
+    svc = _metrics_service_with_events()
+    doc = svc.cluster_status()
+    assert [e["kind"] for e in doc["recent_events"][-2:]] == [
+        "request.enqueued", "qos.shed",
+    ]
+    for w in doc["workers"]:
+        assert w["events"]["emitted"] == 2
+        assert w["events"]["counts"]["qos.shed"] == 1
+
+
+# ---------------- dynotop rendering ----------------
+
+
+def test_dynotop_evt_column_and_events_pane():
+    from tools.dynotop import render_status
+
+    doc = {
+        "namespace": "ns", "component": "backend",
+        "summary": {"workers": 1, "servable": 1, "stale": 0, "unservable": 0},
+        "scrape_interval_s": 2.0,
+        "workers": [{
+            "worker_id": "a1", "stale": False,
+            "health": {"state": "ready", "heartbeat_age_s": 0.1},
+            "kv_metrics": {"request_active_slots": 1, "request_total_slots": 8,
+                           "kv_active_blocks": 2, "kv_total_blocks": 10,
+                           "num_requests_waiting": 0},
+            "resources": {"qos": {"running": {"critical": 1}, "sheds": 2}},
+            "events": {"emitted": 321, "captures": 3},
+            "slo": {"priorities": {"critical": {
+                "ttft": {"target_ms": 100.0, "error_budget": -0.5},
+            }}},
+        }],
+        "recent_events": [
+            {"wall": 1e9, "seq": 1, "kind": "sched.preempted", "worker_id": "a1",
+             "request_id": "r-1", "detail": {"generated": 5}},
+            {"wall": 1e9 + 1, "seq": 2, "kind": "qos.shed", "worker_id": "a1",
+             "request_id": "r-2", "tenant": "t1", "detail": {"site": "frontend"}},
+        ],
+    }
+    out = render_status(doc)
+    assert "EVT" in out
+    assert "321!3p" in out  # emitted count + pinned captures
+    assert "1c*" in out  # critical class blew its error budget
+    assert "recent events" in out
+    assert "sched.preempted" in out and "qos.shed" in out and "[t1]" in out
+    # scrolled view drops the newest line and says so
+    scrolled = render_status(doc, events_rows=1, events_offset=1)
+    assert "sched.preempted" in scrolled and "qos.shed" not in scrolled
+    assert "scrolled 1 back" in scrolled
+    # workers predating the plane render the placeholder, pane is absent
+    doc["workers"][0].pop("events")
+    doc.pop("recent_events")
+    bare = render_status(doc)
+    assert "recent events" not in bare

@@ -1,5 +1,5 @@
 """tools/graftlint: the static-analysis half of the lint gate. Acceptance:
-each of the five detectors catches its seeded positive fixture and stays
+each of the six detectors catches its seeded positive fixture and stays
 silent on its negative fixture (which includes reasoned suppressions, so the
 allowlist machinery is exercised), the whole-repo scan comes back with zero
 unsuppressed findings, the suppression/baseline plumbing behaves, exit codes
@@ -46,6 +46,7 @@ def test_fixture_inventory_covers_all_detectors():
         "recompile-hazard",
         "async-blocking",
         "metric-conformance",
+        "event-conformance",
     }
     # a positive AND a negative per rule
     by_rule = {}
@@ -73,7 +74,7 @@ def test_self_check_green():
 
 
 def test_repo_scan_zero_unsuppressed_findings():
-    """The acceptance criterion: the shipped tree is clean under all five
+    """The acceptance criterion: the shipped tree is clean under all six
     detectors (modulo reasoned suppressions and the checked-in baseline)."""
     findings, errors = run_scan([ROOT / p for p in DEFAULT_SCAN_ROOTS], root=ROOT)
     assert not errors

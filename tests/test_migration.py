@@ -123,7 +123,8 @@ def test_manifest_roundtrip_and_request_arithmetic():
     assert res.token_ids == [1, 2, 3, 4, 10, 11, 12, 20, 21]
     assert res.sampling.max_tokens == 11  # 16 - 5 delivered
     assert res.kv_handoff_seq == "" and res.kv_holder_addr == ""
-    assert res.enqueue_ts == 50.0
+    # back-dated by age_s: resume must bill from the ORIGINAL submission
+    assert res.enqueue_ts == pytest.approx(48.5)
 
 
 # ---------------- fault knobs (fast) ----------------

@@ -69,6 +69,12 @@ DEFAULT_KEYS: tuple = (
     ("qos.tenant_b_itl_ratio", "lower", 0.5),
     ("qos.shed_fraction", "higher", 0.5),
     ("qos.critical_goodput", "higher", 0.1),
+    # flight recorder (r16+): the journal's hot-path cost must stay a
+    # rounding error of a decode step, and the forensic read must stay
+    # interactive (generous tolerances: both are timer-noise-prone on
+    # shared CPU-smoke machines)
+    ("events.emit_frac", "lower", 1.0),
+    ("events.rec_ms", "lower", 1.0),
     # replay goodput columns (aliased arrays; index 0 = goodput)
     ("replay.bursty.0", "higher", DEFAULT_TOL),
     ("replay.lctx.0", "higher", DEFAULT_TOL),

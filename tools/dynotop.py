@@ -55,9 +55,26 @@ def _slo_cell(slo: dict | None) -> str:
     return f"{name} budget {budget:+.2f} {flag}"
 
 
-def render_status(doc: dict) -> str:
+def _format_event(ev: dict) -> str:
+    """One recent-events pane line: wall clock, worker, kind, ids, detail."""
+    wall = ev.get("wall")
+    clock = time.strftime("%H:%M:%S", time.localtime(wall)) if wall else "--:--:--"
+    rid = ev.get("request_id", "")
+    detail = ev.get("detail") or {}
+    kv = " ".join(f"{k}={v}" for k, v in list(detail.items())[:4])
+    tenant = ev.get("tenant", "")
+    tag = f" [{tenant}]" if tenant else ""
+    return (
+        f"{clock} {str(ev.get('worker_id', '?')):<10} "
+        f"{ev.get('kind', '?'):<26} {rid:<14}{tag} {kv}".rstrip()
+    )
+
+
+def render_status(doc: dict, events_rows: int = 8, events_offset: int = 0) -> str:
     """Pure renderer: /cluster/status JSON -> the dashboard text (testable
-    without a cluster; curses and plain mode both draw this)."""
+    without a cluster; curses and plain mode both draw this).
+    ``events_rows``/``events_offset`` size and scroll the recent-events pane
+    (offset counts lines back from the newest event)."""
     s = doc.get("summary", {})
     lines = [
         f"dynotop — {doc.get('namespace')}/{doc.get('component')}  "
@@ -69,8 +86,8 @@ def render_status(doc: dict) -> str:
     header = (
         f"{'WORKER':<12} {'STATE':<10} {'HB':>6} {'SEEN':>6} {'MISS':>4} "
         f"{'SLOTS':>7} {'KV%':>6} {'KVMEM':>11} {'PREFIX':>9} {'SPEC':>10} "
-        f"{'LORA':>11} {'GOODPUT':>9} {'MIG':>7} {'QOS':>9} {'STEP':>11} "
-        f"{'ROOF':>5} {'WAIT':>5} {'HBM':>9} {'CMPL':>5}  SLO"
+        f"{'LORA':>11} {'GOODPUT':>9} {'MIG':>7} {'QOS':>9} {'EVT':>8} "
+        f"{'STEP':>11} {'ROOF':>5} {'WAIT':>5} {'HBM':>9} {'CMPL':>5}  SLO"
     )
     lines.append(header)
     lines.append("-" * len(header))
@@ -148,15 +165,39 @@ def render_status(doc: dict) -> str:
         # activity) show "-"
         qos_res = res.get("qos") or {}
         running = qos_res.get("running") or {}
+        # per-class SLO state (utils/slo.py priority-keyed series): a class
+        # letter gains "*" when any of its targeted metrics blew its error
+        # budget — one glance says WHICH class is hurting, not just that
+        # the aggregate is
+        prio_slo = (w.get("slo") or {}).get("priorities") or {}
+
+        def _blown(cls: str) -> str:
+            states = prio_slo.get(cls) or {}
+            return "*" if any(
+                s.get("target_ms") is not None
+                and s.get("error_budget", 1.0) <= 0
+                for s in states.values()
+            ) else ""
+
         if qos_res:
             qos = "/".join(
-                f"{running.get(c, 0)}{c[0]}"
+                f"{running.get(c, 0)}{c[0]}{_blown(c)}"
                 for c in ("critical", "standard", "batch")
             )
             if qos_res.get("sheds"):
                 qos = f"{qos}!{qos_res['sheds']}"
         else:
             qos = "-"
+        # flight recorder (utils/events.py via worker stats): lifetime events
+        # journaled, with pinned forensic captures flagged; workers predating
+        # the plane show "-"
+        ev = w.get("events") or {}
+        if ev.get("emitted") is not None:
+            evt = str(ev["emitted"])
+            if ev.get("captures"):
+                evt = f"{evt}!{ev['captures']}p"
+        else:
+            evt = "-"
         # step anatomy (utils/step_anatomy.py via resource_snapshot): STEP =
         # host-side fraction of attributed engine time + the decode-window
         # dispatch cadence p50; ROOF = HBM floor over measured decode seconds
@@ -179,8 +220,8 @@ def render_status(doc: dict) -> str:
             f"{(f'{hb:.1f}s' if hb is not None else '-'):>6} "
             f"{w.get('last_seen_s', 0):>5.1f}s {w.get('missed_scrapes', 0):>4} "
             f"{slots:>7} {kv_pct:>5.1f}% {kv_mem:>11} {prefix:>9} {spec:>10} "
-            f"{lora:>11} {goodput:>9} {mig:>7} {qos:>9} {step:>11} {roof:>5} "
-            f"{kv.get('num_requests_waiting', 0):>5} "
+            f"{lora:>11} {goodput:>9} {mig:>7} {qos:>9} {evt:>8} {step:>11} "
+            f"{roof:>5} {kv.get('num_requests_waiting', 0):>5} "
             f"{_fmt_bytes(res.get('hbm_bytes_in_use', 0)):>9} "
             f"{res.get('xla_compiles', 0):>5}  {_slo_cell(w.get('slo'))}"
             f"{stale_mark}"
@@ -193,6 +234,22 @@ def render_status(doc: dict) -> str:
         lines.append("")
         lines.append(f"router prefix-cache hit rate: {pct:.1f}% "
                      f"({hit.get('overlap_blocks', 0)}/{hit['isl_blocks']} blocks)")
+    # recent-events pane: the fleet timeline (merged per-worker flight
+    # recorder tails riding /cluster/status), newest last; j/k scroll it in
+    # curses mode
+    recent = doc.get("recent_events") or []
+    if recent and events_rows > 0:
+        total = len(recent)
+        offset = max(0, min(events_offset, total - events_rows))
+        end = total - offset
+        window = recent[max(0, end - events_rows):end]
+        lines.append("")
+        pos = "" if offset == 0 else f" (scrolled {offset} back)"
+        lines.append(
+            f"recent events — {total} merged, newest last{pos} (j/k scroll):"
+        )
+        for ev in window:
+            lines.append("  " + _format_event(ev))
     return "\n".join(lines)
 
 
@@ -213,9 +270,15 @@ def _curses_loop(url: str, interval: float) -> None:
     def body(stdscr):
         curses.curs_set(0)
         stdscr.timeout(int(interval * 1000))
+        offset = 0
         while True:
             try:
-                text = render_status(fetch_status(url))
+                doc = fetch_status(url)
+                maxy, _ = stdscr.getmaxyx()
+                # the pane gets whatever vertical room the worker table
+                # leaves (floor 4 rows so it never vanishes entirely)
+                rows = max(4, maxy - len(doc.get("workers", ())) - 10)
+                text = render_status(doc, events_rows=rows, events_offset=offset)
             except Exception as e:
                 text = f"dynotop: fetch failed: {e}"
             stdscr.erase()
@@ -223,8 +286,15 @@ def _curses_loop(url: str, interval: float) -> None:
             for i, line in enumerate(text.splitlines()[: maxy - 1]):
                 stdscr.addnstr(i, 0, line, maxx - 1)
             stdscr.refresh()
-            if stdscr.getch() in (ord("q"), 27):
+            ch = stdscr.getch()
+            if ch in (ord("q"), 27):
                 return
+            if ch in (ord("j"), curses.KEY_DOWN):
+                offset = max(0, offset - 1)
+            elif ch in (ord("k"), curses.KEY_UP):
+                offset += 1
+            elif ch in (ord("g"),):
+                offset = 0
 
     curses.wrapper(body)
 

@@ -118,7 +118,7 @@ def main(argv=None) -> int:
         for prob in problems:
             print(f"FAIL graftlint self-check: {prob}")
         if not problems:
-            print("ok: graftlint self-check passed (5 detectors)")
+            print("ok: graftlint self-check passed (6 detectors)")
         return 1 if problems else 0
 
     root = Path(args.root).resolve()

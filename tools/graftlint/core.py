@@ -33,10 +33,11 @@ SUPPRESS_TOKENS = {
     "recompile-hazard": "recompile",
     "async-blocking": "blocking",
     "metric-conformance": "metric",
+    "event-conformance": "event",
 }
 
 _SUPPRESS_RE = re.compile(
-    r"#\s*graftlint:\s*(sync|donation|recompile|blocking|metric)-ok"
+    r"#\s*graftlint:\s*(sync|donation|recompile|blocking|metric|event)-ok"
     r"(?:[ \t]+(\S.*?))?\s*$"
 )
 

@@ -1,6 +1,6 @@
 """graftlint detector registry.
 
-Five detectors, each owning one hazard class the runtime planes only see
+Six detectors, each owning one hazard class the runtime planes only see
 after it costs milliseconds (step_anatomy / compile_monitor / slo) or a
 conformance test fails (prometheus exposition):
 
@@ -11,10 +11,12 @@ conformance test fails (prometheus exposition):
                       specs that drifted from the wrapped signature
   async-blocking      blocking calls in async def; await under a sync lock
   metric-conformance  dynamo_* literals <-> DECLARED_METRIC_FAMILIES
+  event-conformance   .emit("<kind>") literals <-> DECLARED_EVENT_KINDS
 """
 
 from tools.graftlint.detectors.async_hazards import AsyncHazardDetector
 from tools.graftlint.detectors.donation import DonationDetector
+from tools.graftlint.detectors.event_conformance import EventConformanceDetector
 from tools.graftlint.detectors.host_sync import HostSyncDetector
 from tools.graftlint.detectors.metrics_conformance import MetricsConformanceDetector
 from tools.graftlint.detectors.recompile import RecompileDetector
@@ -25,6 +27,7 @@ ALL_DETECTORS = (
     RecompileDetector,
     AsyncHazardDetector,
     MetricsConformanceDetector,
+    EventConformanceDetector,
 )
 
 RULES = tuple(d.rule for d in ALL_DETECTORS)

@@ -1,0 +1,19 @@
+# graftlint-fixture: event-conformance expect=2
+"""Seeded POSITIVE fixture: one undeclared emitting kind literal, one
+declared kind nobody emits. Scanned standalone, so this module carries its
+own declaration surface."""
+
+DECLARED_EVENT_KINDS = (
+    "fixture.admitted",
+    "fixture.orphan_kind",  # [1] declared, never emitted
+)
+
+
+class _Journal:
+    def emit(self, kind, **detail):
+        return kind
+
+
+def instrument(journal: _Journal):
+    journal.emit("fixture.admitted", slot=3)  # declared: fine
+    journal.emit("fixture.rogue_kind", slot=4)  # [2] undeclared kind

@@ -67,6 +67,7 @@ def self_check() -> list[str]:
         "recompile-hazard",
         "async-blocking",
         "metric-conformance",
+        "event-conformance",
     } - seen_rules
     if missing:
         problems.append(f"no fixtures cover rule(s): {sorted(missing)}")
