@@ -30,6 +30,7 @@ from typing import Optional
 
 from aiohttp import web
 
+from dynamo_tpu.llm.kv_router.indexer import render_radix_metrics
 from dynamo_tpu.llm.kv_router.metrics_aggregator import KvMetricsAggregator
 from dynamo_tpu.llm.kv_router.router import KV_HIT_RATE_SUBJECT
 from dynamo_tpu.utils import get_logger
@@ -62,6 +63,9 @@ class MetricsService:
         # cumulative KV hit-rate from router events
         self._isl_blocks = 0
         self._overlap_blocks = 0
+        # latest radix-index health the router piggybacked on its hit-rate
+        # broadcast (nodes/bytes/evictions/lookup hit counters)
+        self._router_radix: Optional[dict] = None
         self._runner: Optional[web.AppRunner] = None
 
     async def start(self) -> int:
@@ -90,6 +94,9 @@ class MetricsService:
         p = msg["payload"]
         self._isl_blocks += p.get("isl_blocks", 0)
         self._overlap_blocks += p.get("overlap_blocks", 0)
+        radix = p.get("radix")
+        if isinstance(radix, dict):
+            self._router_radix = radix
 
     # ---------------- fleet status (JSON) ----------------
 
@@ -140,6 +147,7 @@ class MetricsService:
                 "isl_blocks": self._isl_blocks,
                 "overlap_blocks": self._overlap_blocks,
             },
+            "router_radix": self._router_radix,
             "workers": workers,
             # merged fleet timeline tail (dynotop's events pane reads this
             # off the one /cluster/status fetch it already makes)
@@ -252,6 +260,48 @@ class MetricsService:
             "cumulative cached-prefix blocks matched by the router",
             [(base, self._overlap_blocks)],
         )
+        if self._router_radix is not None:
+            # composed from the indexer's own renderer so the family names
+            # have exactly one emitting site
+            out += render_radix_metrics(
+                self._router_radix, namespace=self.namespace, component=self.component
+            )
+        # ---- fleet-wide per-priority-class SLO view: the per-frontend
+        # dynamo_slo_* series aggregate here across every scraped worker, so
+        # "is the critical class inside budget FLEET-wide" is one query ----
+        prio_comp: dict[tuple, tuple] = {}  # (class, metric) -> (weighted, n)
+        prio_viol: dict[tuple, int] = {}
+        for view in views:
+            prios = (view.data.get("slo") or {}).get("priorities") or {}
+            for pcls, metrics in prios.items():
+                for metric, s in metrics.items():
+                    if not isinstance(s, dict):
+                        continue
+                    key = (pcls, metric)
+                    cnt = s.get("count") or 0
+                    comp = s.get("compliance")
+                    if comp is not None and cnt:
+                        wsum, n = prio_comp.get(key, (0.0, 0))
+                        prio_comp[key] = (wsum + float(comp) * cnt, n + cnt)
+                    prio_viol[key] = prio_viol.get(key, 0) + int(
+                        s.get("violations_total") or 0
+                    )
+        if prio_comp:
+            out += render_family(
+                "dynamo_slo_compliance_ratio", "gauge",
+                "fleet-wide fraction of window samples meeting the target, "
+                "per priority class (sample-weighted across scraped workers)",
+                [({**base, "priority": pcls, "metric": m}, round(w / n, 5))
+                 for (pcls, m), (w, n) in sorted(prio_comp.items())],
+            )
+        if prio_viol:
+            out += render_family(
+                "dynamo_slo_violations_total", "counter",
+                "fleet-wide SLO violations per priority class, summed across "
+                "scraped workers",
+                [({**base, "priority": pcls, "metric": m}, v)
+                 for (pcls, m), v in sorted(prio_viol.items())],
+            )
         # ---- fleet health: per-worker instance-labeled families ----
         now = time.monotonic()
         state_samples, seen_samples, missed_samples, hb_samples = [], [], [], []

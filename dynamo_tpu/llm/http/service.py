@@ -198,6 +198,9 @@ class HttpService:
             # pull the pod out of rotation (that would shed the very traffic
             # the SLO exists for)
             "slo_ok": slo["ok"],
+            # how many frontend replicas this door's admission buckets are
+            # split across (1 = it holds the whole fleet budget itself)
+            "qos_fleet_replicas": max(1, int(self.qos.policy.fleet_replicas)),
             **detail,
         }
         return web.json_response(body, status=200 if ok else 503)

@@ -4,13 +4,33 @@ events.
 Semantics mirror the reference indexer (reference: lib/llm/src/kv_router/
 indexer.rs:187-560):
   - tree children are keyed by the *unchained* tokens hash (LocalBlockHash);
-    worker sets live on each node
+    worker claims live on each node
   - a per-worker lookup table block_hash -> node allows events to attach
     children at any depth in O(1)
   - ``find_matches`` walks a sequence of local hashes accumulating
     OverlapScores {worker_id -> matched block count}, with optional early exit
     and optional frequency tracking with expiry
   - ``remove_worker`` drops a worker from every node it appears on
+
+Beyond the reference, this tree is **bounded**: every node carries a
+last-hit LRU position, node/entry counts are maintained incrementally, and
+when a configured cap (``max_nodes`` / ``max_bytes``) is exceeded the
+least-recently-hit *leaves* are deleted until the tree fits — parents become
+evictable as their children go, so cold subtrees drain bottom-up while a hot
+prefix spine survives arbitrary churn (the RadixAttention eviction order).
+Eviction and ``removed``/``remove_worker`` pruning actually delete nodes (the
+unbounded ancestor of this file only discarded worker ids, leaking childless
+worker-less chains forever), and every structural deletion bumps a
+``generation`` counter so the router's one-entry overlap memo can never
+return a score for an evicted subtree.
+
+The ``KvIndexer`` facade optionally splits the index into N independent
+pure-Python shards keyed by the *first* block's tokens hash
+(``shard_index``): event application and lookups touch exactly one shard,
+each shard bounds independently, and — because the block hash is a seeded
+xxh3 of the token bytes — the same request lands on the same shard in every
+process. The native C++ tree knows neither caps nor shards, so requesting
+either forces the pure-Python path.
 
 The reference pins its Rc/RefCell tree to a dedicated single-threaded runtime;
 here the tree is plain Python owned by the asyncio loop (single-threaded by
@@ -19,8 +39,9 @@ construction) — same concurrency-by-isolation property.
 
 from __future__ import annotations
 
+import os
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
@@ -31,6 +52,26 @@ from dynamo_tpu.utils import get_logger
 log = get_logger("kv_router.indexer")
 
 WorkerId = int
+
+#: resident-size accounting constants: a slotted node with its two dicts and
+#: an LRU slot costs ~320 bytes, each (worker claim + reverse-lookup) entry
+#: ~200 bytes on CPython 3.11/x86-64. Estimates, not measurements — the cap
+#: is a budget knob, not an allocator contract.
+_NODE_BYTES = 320
+_ENTRY_BYTES = 200
+
+#: eviction hysteresis: when a cap trips, evict down to this fraction of it
+#: so the O(resident) leaf sweep amortizes over many inserts instead of
+#: firing on every stored event at the boundary
+_EVICT_TO = 0.875
+
+
+def shard_index(tokens_hash: int, num_shards: int) -> int:
+    """Shard owning a prefix line, from its FIRST block's tokens hash. The
+    hash is a seeded xxh3 of the token bytes (tokens.py XXH3_SEED), so this
+    is deterministic across processes and restarts — every frontend routes
+    the same request to the same shard without coordination."""
+    return tokens_hash % num_shards
 
 
 @dataclass
@@ -53,44 +94,85 @@ class OverlapScores:
     scores: dict[WorkerId, int] = field(default_factory=dict)
     frequencies: list[int] = field(default_factory=list)
 
-    def update(self, workers: set[WorkerId]) -> None:
+    def update(self, workers) -> None:
         for w in workers:
             self.scores[w] = self.scores.get(w, 0) + 1
 
 
 class _Node:
-    __slots__ = ("children", "workers", "recent_uses")
+    # refs maps worker -> the block_hash it claims this node under (the
+    # back-reference that lets eviction clear the per-worker lookup tables);
+    # parent/key let pruning walk upward; recent_uses is allocated lazily —
+    # only frequency-tracking trees pay for the deque
+    __slots__ = ("children", "refs", "recent_uses", "parent", "key")
 
-    def __init__(self):
+    def __init__(self, parent: Optional["_Node"] = None, key: int = 0):
         self.children: dict[int, _Node] = {}  # tokens_hash -> node
-        self.workers: set[WorkerId] = set()
-        self.recent_uses: deque[float] = deque()
+        self.refs: dict[WorkerId, int] = {}  # worker -> block_hash
+        self.recent_uses: Optional[deque[float]] = None
+        self.parent = parent
+        self.key = key
+
+    @property
+    def workers(self):
+        return self.refs.keys()
 
 
 class RadixTree:
-    def __init__(self, expiration_duration: Optional[float] = None):
+    def __init__(
+        self,
+        expiration_duration: Optional[float] = None,
+        max_nodes: Optional[int] = None,
+        max_bytes: Optional[int] = None,
+    ):
         self.root = _Node()
         # worker -> block_hash (engine identity) -> node
         self.lookup: dict[WorkerId, dict[int, _Node]] = {}
         self.expiration_duration = expiration_duration
+        self.max_nodes = max_nodes
+        self.max_bytes = max_bytes
+        # incremental counters — stats() must be O(1), not a health-probe tax
+        self.node_count = 0
+        self.entry_count = 0
+        self.evictions_total = 0
+        # bumped on ANY structural deletion (eviction, removed-event prune,
+        # remove_worker): consumers that memoize walk results key on this
+        self.generation = 0
+        # last-hit LRU over every non-root node, oldest first; nodes hash by
+        # identity so the OrderedDict doubles as the recency list
+        self._lru: OrderedDict[_Node, None] = OrderedDict()
+
+    @property
+    def byte_count(self) -> int:
+        return self.node_count * _NODE_BYTES + self.entry_count * _ENTRY_BYTES
+
+    def stats(self) -> tuple[int, int]:
+        """(indexed block entries, workers) in O(1)."""
+        return (self.entry_count, len(self.lookup))
 
     # ---------------- matching ----------------
 
     def find_matches(self, sequence: Sequence[int], early_exit: bool = False) -> OverlapScores:
         scores = OverlapScores()
         current = self.root
-        now = time.monotonic()
+        tracking = self.expiration_duration is not None
+        now = time.monotonic() if tracking else 0.0
+        lru = self._lru
         for tokens_hash in sequence:
             node = current.children.get(tokens_hash)
             if node is None:
                 break
-            scores.update(node.workers)
-            if self.expiration_duration is not None:
-                while node.recent_uses and now - node.recent_uses[0] > self.expiration_duration:
-                    node.recent_uses.popleft()
-                scores.frequencies.append(len(node.recent_uses))
-                node.recent_uses.append(now)
-            if early_exit and len(node.workers) == 1:
+            scores.update(node.refs)
+            lru.move_to_end(node)
+            if tracking:
+                uses = node.recent_uses
+                if uses is None:
+                    uses = node.recent_uses = deque()
+                while uses and now - uses[0] > self.expiration_duration:
+                    uses.popleft()
+                scores.frequencies.append(len(uses))
+                uses.append(now)
+            if early_exit and len(node.refs) == 1:
                 break
             current = node
         return scores
@@ -116,30 +198,125 @@ class RadixTree:
             for block in ev.blocks:
                 node = parent.children.get(block.tokens_hash)
                 if node is None:
-                    node = _Node()
+                    node = _Node(parent, block.tokens_hash)
                     parent.children[block.tokens_hash] = node
-                node.workers.add(worker)
+                    self.node_count += 1
+                    self._lru[node] = None
+                else:
+                    self._lru.move_to_end(node)
+                old = node.refs.get(worker)
+                if old is None:
+                    self.entry_count += 1
+                elif old != block.block_hash:
+                    # re-stored under a new engine identity: retire the stale
+                    # reverse-lookup entry instead of leaking it
+                    worker_lookup.pop(old, None)
+                node.refs[worker] = block.block_hash
                 worker_lookup[block.block_hash] = node
                 parent = node
+            self._maybe_evict()
         elif ev.kind == "removed":
+            changed = False
             for block_hash in ev.block_hashes:
                 node = worker_lookup.pop(block_hash, None)
-                if node is not None:
-                    node.workers.discard(worker)
+                if node is None:
+                    continue
+                if node.refs.get(worker) == block_hash:
+                    del node.refs[worker]
+                    self.entry_count -= 1
+                    changed = True
+                    self._prune_chain(node)
+            if not worker_lookup:
+                self.lookup.pop(worker, None)
+            if changed:
+                self.generation += 1
 
     def remove_worker(self, worker: WorkerId) -> None:
         table = self.lookup.pop(worker, None)
         if not table:
             return
         for node in table.values():
-            node.workers.discard(worker)
+            if node.refs.pop(worker, None) is not None:
+                self.entry_count -= 1
+                self._prune_chain(node)
+        self.generation += 1
+
+    # ---------------- deletion / bounding ----------------
+
+    def _prune_chain(self, node: _Node) -> None:
+        """Delete a chain of childless, claim-less nodes bottom-up. A node
+        that still has children stays even with no claims — a deeper block
+        some worker still owns must stay reachable from the root."""
+        while node is not self.root and not node.children and not node.refs:
+            parent = node.parent
+            self._unlink(node)
+            node = parent
+
+    def _unlink(self, node: _Node) -> None:
+        parent = node.parent
+        if parent is not None and parent.children.get(node.key) is node:
+            del parent.children[node.key]
+        for w, bh in node.refs.items():
+            t = self.lookup.get(w)
+            if t is not None and t.get(bh) is node:
+                del t[bh]
+                if not t:
+                    del self.lookup[w]
+        self.entry_count -= len(node.refs)
+        node.refs.clear()
+        node.parent = None
+        self._lru.pop(node, None)
+        self.node_count -= 1
+
+    def _over_cap(self, slack: float = 1.0) -> bool:
+        if self.max_nodes is not None and self.node_count > self.max_nodes * slack:
+            return True
+        if self.max_bytes is not None and self.byte_count > self.max_bytes * slack:
+            return True
+        return False
+
+    def _maybe_evict(self) -> None:
+        if (self.max_nodes is None and self.max_bytes is None) or not self._over_cap():
+            return
+        # oldest-first over the LRU, leaves only: deleting a leaf exposes its
+        # parent, so cold chains drain bottom-up across passes while anything
+        # recently walked by find_matches/apply_event survives
+        while self._over_cap(_EVICT_TO):
+            progressed = False
+            for node in list(self._lru):
+                if not self._over_cap(_EVICT_TO):
+                    break
+                if node.children:
+                    continue
+                self._unlink(node)
+                self.evictions_total += 1
+                progressed = True
+            if not progressed:  # pathological all-interior tree; give up
+                break
+        self.generation += 1
+
+    def radix_stats(self) -> dict:
+        return {
+            "nodes": self.node_count,
+            "bytes": self.byte_count,
+            "entries": self.entry_count,
+            "workers": len(self.lookup),
+            "max_nodes": self.max_nodes,
+            "max_bytes": self.max_bytes,
+            "evictions_total": self.evictions_total,
+            "generation": self.generation,
+        }
 
 
 class KvIndexer:
     """Event-driven index facade (reference: indexer.rs:499 KvIndexer).
 
     Uses the native C++ tree (native/src/radix_tree.cc via ctypes) when built
-    and frequency tracking is off; the pure-Python tree otherwise.
+    and no bounding/sharding/frequency tracking is requested; otherwise one
+    pure-Python ``RadixTree`` per shard. Caps and shard count default from
+    DYNTPU_ROUTER_RADIX_{MAX_NODES,MAX_BYTES,SHARDS} (0/unset = unbounded,
+    single shard — the historical behavior). Lookup hit/miss accounting lives
+    here so both backends price the same way.
     """
 
     def __init__(
@@ -147,16 +324,52 @@ class KvIndexer:
         kv_block_size: int,
         expiration_duration: Optional[float] = None,
         use_native: Optional[bool] = None,
+        max_nodes: Optional[int] = None,
+        max_bytes: Optional[int] = None,
+        num_shards: Optional[int] = None,
     ):
         self.kv_block_size = kv_block_size
+        env = os.environ
+        if max_nodes is None:
+            max_nodes = int(env.get("DYNTPU_ROUTER_RADIX_MAX_NODES", "0") or 0) or None
+        if max_bytes is None:
+            max_bytes = int(env.get("DYNTPU_ROUTER_RADIX_MAX_BYTES", "0") or 0) or None
+        if num_shards is None:
+            num_shards = max(1, int(env.get("DYNTPU_ROUTER_RADIX_SHARDS", "1") or 1))
+        bounded = max_nodes is not None or max_bytes is not None
         if use_native is None:
-            use_native = expiration_duration is None and self._native_available()
+            use_native = (
+                expiration_duration is None
+                and not bounded
+                and num_shards == 1
+                and self._native_available()
+            )
+        self.lookups_total = 0
+        self.hits_total = 0
         if use_native:
             from dynamo_tpu.llm.kv_router.native_indexer import NativeRadixTree
 
-            self.tree = NativeRadixTree()
+            self.shards: list = [NativeRadixTree()]
         else:
-            self.tree = RadixTree(expiration_duration)
+            per_nodes = max(1, max_nodes // num_shards) if max_nodes else None
+            per_bytes = max(1, max_bytes // num_shards) if max_bytes else None
+            self.shards = [
+                RadixTree(expiration_duration, max_nodes=per_nodes, max_bytes=per_bytes)
+                for _ in range(num_shards)
+            ]
+        self.num_shards = len(self.shards)
+
+    @property
+    def tree(self):
+        """Back-compat single-tree view (tests/tools reach for ``.tree``)."""
+        return self.shards[0]
+
+    @property
+    def generation(self) -> int:
+        """Sum of shard generations: changes whenever ANY shard deleted
+        nodes, so memoized walk results can be keyed eviction-truthfully.
+        The native tree never evicts and reports no generation (0)."""
+        return sum(getattr(t, "generation", 0) for t in self.shards)
 
     @staticmethod
     def _native_available() -> bool:
@@ -167,21 +380,76 @@ class KvIndexer:
         except Exception:
             return False
 
+    def _shard_for(self, tokens_hash: int):
+        return self.shards[shard_index(tokens_hash, self.num_shards)]
+
+    def _shard_holding(self, worker: WorkerId, block_hash: int):
+        """The shard whose per-worker lookup knows this engine block hash
+        (O(shards); shard counts are single-digit)."""
+        for t in self.shards:
+            if block_hash in t.lookup.get(worker, {}):
+                return t
+        return None
+
     def stats(self) -> tuple[int, int]:
-        """(approx nodes, workers) — emptiness/health probe."""
-        if hasattr(self.tree, "stats"):
-            return self.tree.stats()
-        tree = self.tree
-        return (sum(len(d) for d in tree.lookup.values()), len(tree.lookup))
+        """(approx indexed blocks, workers) — emptiness/health probe, O(1)
+        per shard via the incremental counters."""
+        if self.num_shards == 1:
+            return self.shards[0].stats()
+        entries = 0
+        workers: set[WorkerId] = set()
+        for t in self.shards:
+            entries += t.entry_count
+            workers.update(t.lookup)
+        return (entries, len(workers))
 
     def apply_event(self, event: RouterEvent) -> None:
-        self.tree.apply_event(event)
+        if self.num_shards == 1:
+            self.shards[0].apply_event(event)
+            return
+        ev = event.event
+        if ev.kind == "stored":
+            if ev.parent_hash is not None:
+                shard = self._shard_holding(event.worker_id, ev.parent_hash)
+                if shard is not None:
+                    shard.apply_event(event)
+                    return
+                # unknown parent: fall through to first-block routing; the
+                # owning shard logs the root-attach exactly like before
+            if ev.blocks:
+                self._shard_for(ev.blocks[0].tokens_hash).apply_event(event)
+        elif ev.kind == "removed":
+            # a removed batch may span shards (chains split at eviction
+            # boundaries); group the hashes by owning shard
+            by_shard: dict[int, tuple] = {}
+            for bh in ev.block_hashes:
+                shard = self._shard_holding(event.worker_id, bh)
+                if shard is None:
+                    continue
+                by_shard.setdefault(id(shard), (shard, []))[1].append(bh)
+            for shard, hashes in by_shard.values():
+                shard.apply_event(
+                    RouterEvent(
+                        worker_id=event.worker_id,
+                        event=KvCacheEvent(
+                            event_id=ev.event_id, kind="removed", block_hashes=tuple(hashes)
+                        ),
+                    )
+                )
 
     def remove_worker(self, worker: WorkerId) -> None:
-        self.tree.remove_worker(worker)
+        for t in self.shards:
+            t.remove_worker(worker)
 
     def find_matches(self, sequence: Sequence[int], early_exit: bool = False) -> OverlapScores:
-        return self.tree.find_matches(sequence, early_exit)
+        self.lookups_total += 1
+        if not sequence:
+            return OverlapScores()
+        tree = self.shards[0] if self.num_shards == 1 else self._shard_for(sequence[0])
+        scores = tree.find_matches(sequence, early_exit)
+        if scores.scores:
+            self.hits_total += 1
+        return scores
 
     def find_matches_for_request(
         self, token_ids: Sequence[int], early_exit: bool = False, salt: int = 0
@@ -194,3 +462,90 @@ class KvIndexer:
         cached blocks."""
         hashes = compute_block_hash_for_seq(token_ids, self.kv_block_size, salt)
         return self.find_matches(hashes, early_exit)
+
+    def radix_stats(self) -> dict:
+        """Aggregated index health across shards — the payload the router
+        piggybacks on its hit-rate broadcast and dynotop/Prometheus render."""
+        nodes = nbytes = entries = evictions = generation = workers = 0
+        max_nodes = max_bytes = 0
+        per_worker: dict[str, int] = {}
+        for t in self.shards:
+            if isinstance(t, RadixTree):
+                s = t.radix_stats()
+                nodes += s["nodes"]
+                nbytes += s["bytes"]
+                entries += s["entries"]
+                evictions += s["evictions_total"]
+                generation += s["generation"]
+                max_nodes += s["max_nodes"] or 0
+                max_bytes += s["max_bytes"] or 0
+                for w, table in t.lookup.items():
+                    key = f"{w:x}"
+                    per_worker[key] = per_worker.get(key, 0) + len(table)
+            else:  # native: (nodes, workers) only; bytes are estimated
+                n, w = t.stats()
+                nodes += n
+                entries += n
+                workers += w
+                nbytes += n * (_NODE_BYTES + _ENTRY_BYTES)
+        if per_worker:
+            workers = len(per_worker)
+        return {
+            "nodes": nodes,
+            "workers": workers,
+            "bytes": nbytes,
+            "entries": entries,
+            "max_nodes": max_nodes or None,
+            "max_bytes": max_bytes or None,
+            "evictions_total": evictions,
+            "hits_total": self.hits_total,
+            "lookups_total": self.lookups_total,
+            "shards": self.num_shards,
+            "generation": generation,
+            "per_worker": per_worker,
+        }
+
+
+def render_radix_metrics(stats: dict, namespace: str = "", component: str = "") -> str:
+    """The ``dynamo_router_radix_*`` exposition block from a
+    ``KvIndexer.radix_stats()`` dict (possibly relayed over the hit-rate
+    subject). The single emitting site for these families — callers
+    (components.metrics) compose it rather than re-spelling the names."""
+    from dynamo_tpu.utils.prometheus import render_family
+
+    base: dict = {}
+    if namespace:
+        base["namespace"] = namespace
+    if component:
+        base["component"] = component
+    out = render_family(
+        "dynamo_router_radix_nodes",
+        "gauge",
+        "Resident radix-index nodes across shards (cap: DYNTPU_ROUTER_RADIX_MAX_NODES)",
+        [({**base, "shards": stats.get("shards", 1)}, int(stats.get("nodes", 0)))],
+    )
+    out += render_family(
+        "dynamo_router_radix_bytes",
+        "gauge",
+        "Estimated resident bytes of the radix index (cap: DYNTPU_ROUTER_RADIX_MAX_BYTES)",
+        [({**base, "shards": stats.get("shards", 1)}, int(stats.get("bytes", 0)))],
+    )
+    out += render_family(
+        "dynamo_router_radix_evictions_total",
+        "counter",
+        "Radix nodes deleted by LRU eviction to stay under the configured cap",
+        [(base, int(stats.get("evictions_total", 0)))],
+    )
+    out += render_family(
+        "dynamo_router_radix_hits_total",
+        "counter",
+        "Radix lookups that matched at least one cached block (vs lookups_total)",
+        [
+            ({**base, "result": "hit"}, int(stats.get("hits_total", 0))),
+            (
+                {**base, "result": "miss"},
+                max(0, int(stats.get("lookups_total", 0)) - int(stats.get("hits_total", 0))),
+            ),
+        ],
+    )
+    return out

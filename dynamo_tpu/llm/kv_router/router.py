@@ -125,15 +125,22 @@ class KvRouter:
                     "worker_id": event.worker_id,
                     "isl_blocks": event.isl_blocks,
                     "overlap_blocks": event.overlap_blocks,
+                    # index health rides along so the metrics plane sees
+                    # resident nodes/bytes/evictions without a second subject
+                    "radix": self.indexer.radix_stats(),
                 },
             )
         )
 
     # ---------------- scheduling ----------------
 
-    @staticmethod
-    def _overlap_key(token_ids: Sequence[int], salt: int = 0) -> tuple[int, int, int]:
-        return (len(token_ids), compute_block_hash(token_ids), salt)
+    def _overlap_key(self, token_ids: Sequence[int], salt: int = 0) -> tuple[int, int, int, int]:
+        # the indexer generation makes the memo eviction-truthful: any
+        # structural deletion (LRU eviction, removed-event prune,
+        # remove_worker) bumps it, so a memoized score for a now-evicted
+        # subtree can never be returned — even when the deletion happened
+        # outside the explicit invalidation sites below
+        return (len(token_ids), compute_block_hash(token_ids), salt, self.indexer.generation)
 
     def _find_overlap(self, token_ids: Sequence[int], salt: int = 0) -> OverlapScores:
         """Radix walk with a one-entry memo: back-to-back calls for the same

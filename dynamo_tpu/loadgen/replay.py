@@ -256,7 +256,7 @@ async def replay_engine(
 
 
 async def replay_http(
-    base_url: str,
+    base_url,
     model: str,
     trace: list,
     spec: Optional[ScenarioSpec] = None,
@@ -267,8 +267,11 @@ async def replay_http(
     """Replay a trace as streaming OpenAI completions against an HTTP
     frontend: token-id prompts, ``ext.ignore_eos`` for exact OSL, tenant in
     the ``x-tenant`` header, ``<model>:<adapter>`` names for LoRA requests.
-    Image traces are engine-replay only (the HTTP image path ships real
-    payloads, not seeds)."""
+    ``base_url`` may be one URL or a sequence of frontend URLs — requests
+    round-robin across them by trace position, which is how the fleet
+    multi-frontend scenarios drive 2+ front doors with ONE merged trace and
+    get ONE fleet-wide report back. Image traces are engine-replay only (the
+    HTTP image path ships real payloads, not seeds)."""
     import aiohttp
 
     from dynamo_tpu.llm.protocols import sse
@@ -286,9 +289,11 @@ async def replay_http(
         }
     outcomes: list[RequestOutcome] = []
     t0 = time.monotonic()
-    url = base_url.rstrip("/") + "/v1/completions"
+    url_list = [base_url] if isinstance(base_url, str) else list(base_url)
+    urls = [u.rstrip("/") + "/v1/completions" for u in url_list]
 
-    async def one(session, tr) -> None:
+    async def one(session, index, tr) -> None:
+        url = urls[index % len(urls)]
         planned = tr.at_s / speed
         delay = planned - (time.monotonic() - t0)
         if delay > 0:
@@ -367,7 +372,7 @@ async def replay_http(
         metrics.finished(tr.scenario, toks, error)
 
     async with aiohttp.ClientSession() as session:
-        await asyncio.gather(*(one(session, tr) for tr in trace))
+        await asyncio.gather(*(one(session, i, tr) for i, tr in enumerate(trace)))
     wall = time.monotonic() - t0
     return _report(spec, trace, outcomes, wall, speed, metrics)
 

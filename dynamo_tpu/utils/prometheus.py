@@ -113,6 +113,10 @@ DECLARED_METRIC_FAMILIES: tuple = (
     "dynamo_replay_requests_total",
     "dynamo_replay_schedule_lag_seconds",
     "dynamo_replay_tokens_total",
+    "dynamo_router_radix_bytes",
+    "dynamo_router_radix_evictions_total",
+    "dynamo_router_radix_hits_total",
+    "dynamo_router_radix_nodes",
     "dynamo_slo_burn_rate",
     "dynamo_slo_compliance_ratio",
     "dynamo_slo_error_budget_remaining",
@@ -604,11 +608,30 @@ def _sample_surfaces() -> list[tuple[str, str]]:
             "resources": {"kv_pages_used": 5, "kv_pages_total": 100,
                           "xla_compiles": 3, "hbm_bytes_in_use": 0},
             "stage_seconds": {"prefill_s": 1.0, "queue_wait_n": 2},
+            # fleet per-class SLO aggregation source (one worker's
+            # SloTracker.snapshot()["priorities"] shape)
+            "slo": {"priorities": {"critical": {"itl": {
+                "count": 4, "compliance": 0.75, "violations_total": 1,
+            }}}},
         },
         load=WorkerLoad.from_wire(0xAB, kv),
         last_seen=_time.monotonic(),
     )
     svc._isl_blocks, svc._overlap_blocks = 10, 4
+    # router radix-index health as relayed on the hit-rate subject: a tiny
+    # bounded indexer driven past its cap so evictions/hits are nonzero
+    from dynamo_tpu.llm.kv_events import KvCacheEvent, StoredBlock
+    from dynamo_tpu.llm.kv_router.indexer import KvIndexer, RouterEvent
+
+    idx = KvIndexer(kv_block_size=4, use_native=False, max_nodes=4, num_shards=2)
+    for i in range(8):
+        idx.apply_event(RouterEvent(
+            worker_id=0xAB,
+            event=KvCacheEvent.stored(None, [StoredBlock(1000 + i, 2000 + i)]),
+        ))
+    idx.find_matches([2007])
+    idx.find_matches([1])  # a miss, so both result labels sample
+    svc._router_radix = idx.radix_stats()
     surfaces.append(("components.metrics", svc.render()))
     return surfaces
 

@@ -217,10 +217,18 @@ class QosPolicy:
     # backpressure shed: estimated queue wait beyond which batch-class load
     # sheds when no TTFT SLO target is configured to derive it from
     shed_wait_s: float = 10.0
+    # fleet-shared admission: the number of frontend replicas the budget spec
+    # is split across. Specs name the FLEET budget; each replica enforces
+    # rate/N and burst/N deterministically, so N frontends together admit
+    # exactly one shared budget's worth — no coordination traffic, no 2x
+    # leakage from per-replica buckets (Mooncake's fleet-level admission
+    # plane, done by arithmetic instead of consensus)
+    fleet_replicas: int = 1
 
     @classmethod
     def from_specs(cls, budget_spec: str = "", priority_spec: str = "",
-                   shed_wait_s: float = 10.0) -> "QosPolicy":
+                   shed_wait_s: float = 10.0,
+                   fleet_replicas: int = 1) -> "QosPolicy":
         budgets: dict = {}
         default_budget = None
         for rule in filter(None, (r.strip() for r in (budget_spec or "").split(","))):
@@ -247,9 +255,11 @@ class QosPolicy:
                 adapter_priorities[key[len("adapter:"):]] = pcls
             else:
                 priorities[key] = pcls
+        if fleet_replicas < 1:
+            raise ValueError(f"fleet_replicas must be >= 1; got {fleet_replicas}")
         return cls(budgets=budgets, default_budget=default_budget,
                    priorities=priorities, adapter_priorities=adapter_priorities,
-                   shed_wait_s=shed_wait_s)
+                   shed_wait_s=shed_wait_s, fleet_replicas=fleet_replicas)
 
     @classmethod
     def from_env(cls, environ=None) -> Optional["QosPolicy"]:
@@ -263,8 +273,10 @@ class QosPolicy:
         if not budgets and not prios:
             return None
         shed = env.get("DYNTPU_QOS_SHED_WAIT_S", "").strip()
+        replicas = env.get("DYNTPU_QOS_FLEET_REPLICAS", "").strip()
         return cls.from_specs(budgets, prios,
-                              shed_wait_s=float(shed) if shed else 10.0)
+                              shed_wait_s=float(shed) if shed else 10.0,
+                              fleet_replicas=int(replicas) if replicas else 1)
 
     def priority_for(self, tenant: str = "", adapter: str = "") -> str:
         """Policy default class for a request (header wins at the caller)."""
@@ -296,6 +308,9 @@ class AdmissionController:
         self._buckets: dict[str, TokenBucket] = {}
         # (class, tenant, action) -> count; action in admitted|throttled|shed
         self._counts: dict[tuple, int] = {}
+        # tenant -> tokens actually admitted: the fleet-leakage audit trail
+        # (summing this across replicas must stay inside ONE shared budget)
+        self._admitted_tokens: dict[str, float] = {}
 
     def _bucket_for(self, tenant: str) -> Optional[TokenBucket]:
         b = self._buckets.get(tenant)
@@ -305,7 +320,12 @@ class AdmissionController:
         if spec is None:
             return None  # unbudgeted tenant: never throttled here
         rate, burst = spec
-        b = TokenBucket(rate, burst, clock=self._clock)
+        # fleet split: each of N replicas enforces 1/N of the fleet budget.
+        # burst=None keeps the 2s-of-rate default, which divides with the
+        # rate automatically
+        n = max(1, int(self.policy.fleet_replicas))
+        b = TokenBucket(rate / n, burst / n if burst is not None else None,
+                        clock=self._clock)
         self._buckets[tenant] = b
         return b
 
@@ -325,6 +345,9 @@ class AdmissionController:
             bucket = self._bucket_for(tenant)
             if bucket is None or bucket.try_consume(tokens):
                 self._count(cls, tenant, "admitted")
+                self._admitted_tokens[tenant] = (
+                    self._admitted_tokens.get(tenant, 0.0) + float(tokens)
+                )
                 decision = AdmissionDecision(True, "admitted")
             else:
                 wait = bucket.seconds_until(tokens)
@@ -369,7 +392,10 @@ class AdmissionController:
             counts = dict(self._counts)
             fills = {t: round(b.fill_fraction(), 4)
                      for t, b in self._buckets.items()}
-        out: dict = {"budget_fill": fills, "classes": {}}
+            admitted = dict(self._admitted_tokens)
+        out: dict = {"budget_fill": fills, "classes": {},
+                     "admitted_tokens": admitted,
+                     "fleet_replicas": max(1, int(self.policy.fleet_replicas))}
         for (cls, tenant, action), n in sorted(counts.items()):
             out["classes"].setdefault(cls, {}).setdefault(tenant, {})[action] = n
         return out

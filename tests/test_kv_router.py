@@ -1,7 +1,12 @@
 """KV router: radix indexer, cost scheduler, and the full routed path over the
-broker (engine allocator events -> indexer -> schedule)."""
+broker (engine allocator events -> indexer -> schedule), plus the bounded/
+sharded index plane (LRU eviction, leak pruning, shard determinism, and the
+eviction-truthful overlap memo)."""
 
 import asyncio
+import os
+import subprocess
+import sys
 
 import pytest
 
@@ -210,3 +215,189 @@ def test_kv_router_over_broker():
             await broker.stop()
 
     asyncio.run(body())
+
+# ---------------- bounded / sharded index plane ----------------
+
+
+def py_indexer(**kw):
+    return KvIndexer(BS, use_native=False, **kw)
+
+
+def test_radix_removed_event_prunes_leaked_nodes():
+    """Regression for the node leak: a full store -> remove cycle must leave
+    the node count at baseline (the unbounded ancestor only discarded worker
+    ids, so childless worker-less chains accumulated forever)."""
+    idx = py_indexer()
+    assert idx.radix_stats()["nodes"] == 0
+    stored(1, idx, None, [(100, 10), (101, 11), (102, 12)])
+    assert idx.radix_stats()["nodes"] == 3
+    idx.apply_event(RouterEvent(worker_id=1, event=KvCacheEvent.removed([102, 101, 100])))
+    s = idx.radix_stats()
+    assert s["nodes"] == 0 and s["entries"] == 0
+    # interior removal must NOT prune: a deeper block another claim still
+    # owns has to stay reachable from the root
+    stored(1, idx, None, [(100, 10), (101, 11)])
+    idx.apply_event(RouterEvent(worker_id=1, event=KvCacheEvent.removed([100])))
+    assert idx.find_matches([10, 11]).scores == {1: 1}
+    assert idx.radix_stats()["nodes"] == 2
+    # removing the deep block drains the whole chain
+    idx.apply_event(RouterEvent(worker_id=1, event=KvCacheEvent.removed([101])))
+    assert idx.radix_stats()["nodes"] == 0
+
+
+def test_radix_remove_worker_prunes_unshared_chains():
+    idx = py_indexer()
+    stored(1, idx, None, [(100, 10), (101, 11)])
+    stored(2, idx, None, [(200, 10)])  # shares the depth-1 node
+    idx.remove_worker(1)
+    s = idx.radix_stats()
+    # the shared depth-1 node survives (worker 2 claims it); worker 1's
+    # private depth-2 node is gone
+    assert s["nodes"] == 1 and s["workers"] == 1
+    assert idx.find_matches([10, 11]).scores == {2: 1}
+    idx.remove_worker(2)
+    s = idx.radix_stats()
+    assert s["nodes"] == 0 and s["workers"] == 0 and s["entries"] == 0
+
+
+def test_radix_bounded_lru_eviction_keeps_hot_prefix():
+    idx = py_indexer(max_nodes=8)
+    stored(1, idx, None, [(1000, 500), (1001, 501)])  # the hot chain
+    for i in range(50):
+        stored(1, idx, None, [(2000 + i, 9000 + i)])
+        idx.find_matches([500, 501])  # keep the hot chain recently-hit
+        assert idx.radix_stats()["nodes"] <= 8
+    s = idx.radix_stats()
+    assert s["evictions_total"] >= 40
+    assert s["bytes"] > 0
+    # the hot chain survived arbitrary churn; cold churn nodes were evicted
+    assert idx.find_matches([500, 501]).scores == {1: 2}
+    assert s["generation"] > 0
+
+
+def test_radix_byte_cap_bounds_resident_bytes():
+    idx = py_indexer(max_bytes=8 * 1024)
+    for i in range(200):
+        stored(1, idx, None, [(3000 + i, 7000 + i)])
+    s = idx.radix_stats()
+    assert s["bytes"] <= 8 * 1024
+    assert s["evictions_total"] > 0
+
+
+def test_stats_incremental_counters_match_recount():
+    """stats() is O(1) off incremental counters; they must agree with a full
+    recount of the lookup tables after a mixed store/remove/evict workload."""
+    idx = py_indexer(max_nodes=64)
+    for i in range(100):
+        stored(1 + i % 3, idx, None, [(i * 10, 5000 + i), (i * 10 + 1, 6000 + i)])
+        if i % 7 == 0:
+            idx.apply_event(RouterEvent(
+                worker_id=1 + i % 3, event=KvCacheEvent.removed([i * 10])))
+    idx.remove_worker(2)
+    entries, workers = idx.stats()
+    recount_entries = sum(
+        len(d) for t in idx.shards for d in t.lookup.values()
+    )
+    recount_workers = len({w for t in idx.shards for w in t.lookup})
+    assert entries == recount_entries
+    assert workers == recount_workers
+    # node counter agrees with an actual tree walk too
+    def count(node):
+        return 1 + sum(count(c) for c in node.children.values())
+    assert idx.radix_stats()["nodes"] == sum(count(t.root) - 1 for t in idx.shards)
+
+
+@pytest.mark.parametrize("shards", [1, 3])
+def test_sharded_indexer_matches_single_shard_semantics(shards):
+    """The sharded facade must answer exactly like one tree: parent chaining
+    lands in the owning shard, removed events fan out by owning shard, and
+    remove_worker drops the worker everywhere."""
+    idx = py_indexer(num_shards=shards)
+    assert idx.radix_stats()["shards"] == shards
+    stored(1, idx, None, [(100, 10)])
+    stored(1, idx, 100, [(101, 11)])  # chained via parent block_hash
+    stored(2, idx, None, [(300, 10)])
+    stored(2, idx, 300, [(301, 11)])
+    stored(3, idx, None, [(400, 77), (401, 78)])
+    assert idx.find_matches([10, 11]).scores == {1: 2, 2: 2}
+    assert idx.find_matches([77, 78]).scores == {3: 2}
+    assert idx.stats() == (6, 3)
+    idx.apply_event(RouterEvent(worker_id=3, event=KvCacheEvent.removed([401, 400])))
+    assert idx.find_matches([77, 78]).scores == {}
+    idx.remove_worker(1)
+    assert idx.find_matches([10, 11]).scores == {2: 2}
+    assert idx.stats() == (2, 1)
+
+
+def test_shard_routing_is_deterministic_across_processes():
+    """Same request -> same shard, in every process: the first-block hash is
+    a seeded xxh3 of the token bytes, so shard routing needs no coordination
+    between frontends (and must not depend on PYTHONHASHSEED)."""
+    from dynamo_tpu.llm.kv_router.indexer import shard_index
+    from dynamo_tpu.llm.tokens import compute_block_hash_for_seq
+
+    prompt = list(range(32))
+    local = shard_index(compute_block_hash_for_seq(prompt, BS)[0], 8)
+    code = (
+        "from dynamo_tpu.llm.tokens import compute_block_hash_for_seq\n"
+        "from dynamo_tpu.llm.kv_router.indexer import shard_index\n"
+        f"print(shard_index(compute_block_hash_for_seq(list(range(32)), {BS})[0], 8))\n"
+    )
+    for seed in ("0", "1"):
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "PYTHONHASHSEED": seed, "JAX_PLATFORMS": "cpu"},
+        )
+        assert out.returncode == 0, out.stderr
+        assert int(out.stdout.strip()) == local
+
+
+def _bare_router(**indexer_kw):
+    """A KvRouter with no control plane: only the indexer/memo paths run."""
+    from dynamo_tpu.llm.kv_router.router import KvRouter
+
+    class _Drt:
+        cplane = None
+
+    router = KvRouter(_Drt(), "ns", "backend", kv_block_size=BS)
+    router.indexer = KvIndexer(BS, use_native=False, **indexer_kw)
+    return router
+
+
+def test_overlap_memo_invalidated_by_eviction():
+    """The one-entry overlap memo must never return a score for an evicted
+    subtree — even when the eviction happened OUTSIDE _on_kv_event (direct
+    indexer traffic bypasses the explicit invalidation sites; the generation
+    key in _overlap_key is what catches it)."""
+    from dynamo_tpu.llm.tokens import compute_block_hash_for_seq
+
+    router = _bare_router(max_nodes=4)
+    prompt = list(range(BS * 2))  # 2 blocks
+    hashes = compute_block_hash_for_seq(prompt, BS)
+    stored(1, router.indexer, None, [(900 + i, h) for i, h in enumerate(hashes)])
+    ov1 = router._find_overlap(prompt)
+    assert ov1.scores == {1: 2}
+    assert router._find_overlap(prompt) is ov1  # memo reuse while unchanged
+    # churn unrelated prefixes straight into the indexer until the prompt's
+    # nodes evict (no KV event reaches the router, so only generation works)
+    for i in range(10):
+        stored(1, router.indexer, None, [(5000 + i, 8000 + i)])
+    ov2 = router._find_overlap(prompt)
+    assert ov2 is not ov1
+    assert ov2.scores == {}
+
+
+def test_overlap_memo_invalidated_by_direct_remove_worker():
+    from dynamo_tpu.llm.tokens import compute_block_hash_for_seq
+
+    router = _bare_router()
+    prompt = list(range(BS * 2))
+    hashes = compute_block_hash_for_seq(prompt, BS)
+    stored(7, router.indexer, None, [(900 + i, h) for i, h in enumerate(hashes)])
+    ov1 = router._find_overlap(prompt)
+    assert ov1.scores == {7: 2}
+    router.indexer.remove_worker(7)  # bypasses _watch_instances
+    ov2 = router._find_overlap(prompt)
+    assert ov2 is not ov1
+    assert ov2.scores == {}

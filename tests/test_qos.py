@@ -851,3 +851,157 @@ def test_multi_tenant_isolation_replay():
         assert off > itl_budget_ms, (off, itl_budget_ms)
 
     asyncio.run(body())
+
+
+# ---------------- fleet-shared admission (r17) ----------------
+
+
+def test_fleet_replica_budget_split(monkeypatch):
+    """fleet_replicas=N splits every tenant budget deterministically: two
+    replica controllers at N=2 jointly admit the SAME token volume one
+    shared controller would, while two naive N=1 controllers leak 2x — the
+    multi-frontend hole this knob closes. Refill splits by the same
+    arithmetic, and the admitted-token audit trail rides the snapshot."""
+
+    def mk(n):
+        clock = {"t": 0.0}
+        ctl = AdmissionController(
+            QosPolicy.from_specs("t=10:100", "", fleet_replicas=n),
+            clock=lambda: clock["t"],
+        )
+        return ctl, clock
+
+    def drain(ctl):
+        admitted = 0
+        while ctl.admit("t", "batch", 5).admitted:
+            admitted += 5
+            assert admitted <= 10_000  # runaway guard
+        return admitted
+
+    shared, _ = mk(1)
+    assert drain(shared) == 100
+
+    split = [mk(2) for _ in range(2)]
+    assert sum(drain(c) for c, _ in split) == 100  # no fleet-wide leakage
+    assert sum(drain(mk(1)[0]) for _ in range(2)) == 200  # the naive leak
+
+    # refill splits too: 5s at 10 tok/s = 50 fleet-wide, 25 per replica
+    for _, clock in split:
+        clock["t"] = 5.0
+    assert sum(drain(c) for c, _ in split) == 50
+
+    snap = split[0][0].snapshot()
+    assert snap["fleet_replicas"] == 2
+    assert snap["admitted_tokens"]["t"] == pytest.approx(75.0)
+
+    # env + validation surfaces
+    monkeypatch.setenv("DYNTPU_QOS_BUDGETS", "t=10:100")
+    monkeypatch.setenv("DYNTPU_QOS_FLEET_REPLICAS", "4")
+    p = QosPolicy.from_env()
+    assert p is not None and p.fleet_replicas == 4
+    with pytest.raises(ValueError):
+        QosPolicy.from_specs("t=10:100", "", fleet_replicas=0)
+
+
+@pytest.mark.slow
+def test_fleet_shared_admission_two_frontends():
+    """TWO HTTP front doors over ONE engine, each holding HALF the tenant-a
+    token budget (fleet_replicas=2). A merged bursty trace round-robined
+    across both doors (replay_http multi-URL) sheds tenant-a down to ONE
+    fleet-wide budget envelope — no 2x leakage from running two replicas —
+    while tenant-b (critical, unbudgeted) streams inside its ITL budget."""
+    import aiohttp
+
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.frontends.pipeline import build_pipeline, card_for_model
+    from dynamo_tpu.llm.http.service import HttpService
+    from dynamo_tpu.loadgen.replay import replay_engine, replay_http
+    from dynamo_tpu.loadgen.scenarios import load_scenario
+    from dynamo_tpu.loadgen.trace import compile_trace
+
+    itl_budget_ms = 250.0
+    rate, burst = 20.0, 300.0
+    eng_kw = dict(
+        model_id="tiny", page_size=4, num_pages=64, max_seqs=3,
+        max_model_len=256, prefill_buckets=(16, 32, 64), decode_steps=2,
+        pipeline_depth=1, prefill_batches_per_step=1,
+        qos_preempt_wait_ms=50.0,
+    )
+    spec_a = load_scenario("bursty_chat", seed=5, num_requests=10).replace(
+        name="fleet_a", tenants=("tenant-a",), isl_mean=32, isl_max=64,
+        osl_dist="fixed", osl_mean=96, osl_max=96, vocab=256, rate_rps=24.0,
+        burst_factor=6.0, slo_ttft_ms=30000.0, slo_itl_ms=itl_budget_ms,
+    )
+    spec_b = load_scenario("bursty_chat", seed=6, num_requests=5).replace(
+        name="fleet_b", arrival="poisson", tenants=("tenant-b",), isl_mean=12,
+        isl_max=24, osl_dist="fixed", osl_mean=48, osl_max=48, vocab=256,
+        rate_rps=0.8, slo_ttft_ms=30000.0, slo_itl_ms=itl_budget_ms,
+    )
+    merged = sorted(
+        compile_trace(spec_a) + compile_trace(spec_b), key=lambda tr: tr.at_s
+    )
+
+    def mk_ctl():
+        # priorities ride the POLICY here (replay_http sends no x-priority
+        # header): tenant-b lands critical at BOTH doors
+        return AdmissionController(QosPolicy.from_specs(
+            "tenant-a=20:300", "tenant-a=batch,tenant-b=critical",
+            fleet_replicas=2,
+        ))
+
+    async def body():
+        eng = AsyncJaxEngine(EngineConfig(qos=True, **eng_kw))
+        await eng.start()
+        ctls = [mk_ctl(), mk_ctl()]
+        services = []
+        try:
+            for wspec in (spec_a.replace(seed=98, num_requests=3),
+                          spec_b.replace(seed=99, num_requests=3)):
+                await replay_engine(eng, compile_trace(wspec), spec=wspec,
+                                    speed=100.0)
+            urls = []
+            for ctl in ctls:
+                svc = HttpService(host="127.0.0.1", port=0, qos=ctl)
+                svc.manager.add(build_pipeline(eng, card_for_model("tiny")))
+                port = await svc.start()
+                services.append(svc)
+                urls.append(f"http://127.0.0.1:{port}")
+
+            async with aiohttp.ClientSession() as s:
+                async with s.get(urls[0] + "/ready") as r:
+                    assert (await r.json())["qos_fleet_replicas"] == 2
+
+            t0 = time.monotonic()
+            report = await replay_http(urls, "tiny", merged, spec=spec_b,
+                                       speed=2.0)
+            wall = time.monotonic() - t0
+
+            b_out = [o for o in report["outcomes"]
+                     if o.get("tenant") == "tenant-b"]
+            assert len(b_out) == 5
+            assert not any(o.get("error") for o in b_out), b_out
+            vals = [o["itl_p99_ms"] for o in b_out
+                    if o.get("itl_p99_ms") is not None]
+            assert vals and max(vals) <= itl_budget_ms, vals
+
+            snaps = [c.snapshot() for c in ctls]
+            throttled = sum(
+                s["classes"].get("batch", {}).get("tenant-a", {})
+                .get("throttled", 0) for s in snaps
+            )
+            assert throttled > 0, snaps
+            # the fleet-wide proof: both doors TOGETHER admitted at most one
+            # shared budget envelope (each holds burst/2 and refills at
+            # rate/2, so the sum telescopes to burst + rate*wall; a naive
+            # per-door policy would allow double)
+            admitted_a = sum(
+                s["admitted_tokens"].get("tenant-a", 0.0) for s in snaps
+            )
+            envelope = burst + rate * wall
+            assert 0.0 < admitted_a <= envelope + 1e-6, (admitted_a, envelope)
+        finally:
+            for svc in services:
+                await svc.stop()
+            await eng.shutdown()
+
+    asyncio.run(body())

@@ -75,6 +75,14 @@ DEFAULT_KEYS: tuple = (
     # shared CPU-smoke machines)
     ("events.emit_frac", "lower", 1.0),
     ("events.rec_ms", "lower", 1.0),
+    # router index under prefix churn (r17+): lookup p99 must stay flat
+    # (generous tolerance — single-digit-microsecond timers on shared
+    # CPU-smoke machines), the bounded index must not outgrow its cap
+    # (resident count is the contract), and the hot-working-set hit ratio
+    # must hold
+    ("router_scale.lookup_p99_ms", "lower", 1.0),
+    ("router_scale.resident_nodes", "lower", 0.10),
+    ("router_scale.hot_hit_ratio", "higher", 0.05),
     # replay goodput columns (aliased arrays; index 0 = goodput)
     ("replay.bursty.0", "higher", DEFAULT_TOL),
     ("replay.lctx.0", "higher", DEFAULT_TOL),

@@ -85,10 +85,16 @@ def render_status(doc: dict, events_rows: int = 8, events_offset: int = 0) -> st
     ]
     header = (
         f"{'WORKER':<12} {'STATE':<10} {'HB':>6} {'SEEN':>6} {'MISS':>4} "
-        f"{'SLOTS':>7} {'KV%':>6} {'KVMEM':>11} {'PREFIX':>9} {'SPEC':>10} "
-        f"{'LORA':>11} {'GOODPUT':>9} {'MIG':>7} {'QOS':>9} {'EVT':>8} "
-        f"{'STEP':>11} {'ROOF':>5} {'WAIT':>5} {'HBM':>9} {'CMPL':>5}  SLO"
+        f"{'SLOTS':>7} {'KV%':>6} {'KVMEM':>11} {'PREFIX':>9} {'RADIX':>7} "
+        f"{'SPEC':>10} {'LORA':>11} {'GOODPUT':>9} {'MIG':>7} {'QOS':>9} "
+        f"{'EVT':>8} {'STEP':>11} {'ROOF':>5} {'WAIT':>5} {'HBM':>9} "
+        f"{'CMPL':>5}  SLO"
     )
+    # router radix-index health (router broadcast via /cluster/status):
+    # per-worker indexed-block counts feed the RADIX column; the fleet
+    # totals (nodes vs cap, evictions, lookup hit rate) print as a footer
+    radix = doc.get("router_radix") or {}
+    radix_per_worker = radix.get("per_worker") or {}
     lines.append(header)
     lines.append("-" * len(header))
     for w in doc.get("workers", []):
@@ -213,13 +219,19 @@ def render_status(doc: dict, events_rows: int = 8, events_offset: int = 0) -> st
             f"{100.0 * anat['roofline_frac']:.0f}%"
             if anat.get("roofline_frac") is not None else "-"
         )
+        # RADIX: blocks this worker has indexed in the router's radix tree
+        # (its advertised prefix-cache footprint); "-" until the router has
+        # broadcast index health
+        radix_cell = radix_per_worker.get(str(w.get("worker_id", "")), None)
+        radix_cell = str(radix_cell) if radix_cell is not None else "-"
         hb = health.get("heartbeat_age_s")
         stale_mark = " STALE" if w.get("stale") else ""
         lines.append(
             f"{w.get('worker_id', '?'):<12} {glyph} {state:<8} "
             f"{(f'{hb:.1f}s' if hb is not None else '-'):>6} "
             f"{w.get('last_seen_s', 0):>5.1f}s {w.get('missed_scrapes', 0):>4} "
-            f"{slots:>7} {kv_pct:>5.1f}% {kv_mem:>11} {prefix:>9} {spec:>10} "
+            f"{slots:>7} {kv_pct:>5.1f}% {kv_mem:>11} {prefix:>9} "
+            f"{radix_cell:>7} {spec:>10} "
             f"{lora:>11} {goodput:>9} {mig:>7} {qos:>9} {evt:>8} {step:>11} "
             f"{roof:>5} {kv.get('num_requests_waiting', 0):>5} "
             f"{_fmt_bytes(res.get('hbm_bytes_in_use', 0)):>9} "
@@ -234,6 +246,20 @@ def render_status(doc: dict, events_rows: int = 8, events_offset: int = 0) -> st
         lines.append("")
         lines.append(f"router prefix-cache hit rate: {pct:.1f}% "
                      f"({hit.get('overlap_blocks', 0)}/{hit['isl_blocks']} blocks)")
+    if radix:
+        cap = radix.get("max_nodes")
+        cap_s = f"/{cap}" if cap else " (unbounded)"
+        lookups = radix.get("lookups_total", 0)
+        hitpct = (
+            f", lookup hit {100.0 * radix.get('hits_total', 0) / lookups:.1f}%"
+            if lookups else ""
+        )
+        lines.append(
+            f"router radix index: {radix.get('nodes', 0)}{cap_s} nodes "
+            f"({_fmt_bytes(radix.get('bytes', 0))}, "
+            f"{radix.get('shards', 1)} shard(s)), "
+            f"evictions {radix.get('evictions_total', 0)}{hitpct}"
+        )
     # recent-events pane: the fleet timeline (merged per-worker flight
     # recorder tails riding /cluster/status), newest last; j/k scroll it in
     # curses mode
