@@ -481,6 +481,8 @@ async def _main(args) -> None:
             prefill_flat_depth=getattr(args, "prefill_flat_depth", None) or 8192,
             host_cache_blocks=getattr(args, "host_cache_blocks", None) or 0,
             host_cache_bytes=getattr(args, "host_cache_bytes", None) or 0,
+            disk_cache_bytes=getattr(args, "disk_cache_bytes", None) or 0,
+            disk_cache_dir=getattr(args, "disk_cache_dir", None) or "",
             offload_watermark=getattr(args, "offload_watermark", None) or 0.90,
         ),
         enable_disagg_decode=args.disagg,
@@ -597,6 +599,16 @@ def main(argv=None) -> None:
                         "blocks at the model's ACTUAL per-page wire cost "
                         "(an int8 KV cache fits ~2x the blocks of bf16 in "
                         "the same budget; the larger of the two knobs wins)")
+    p.add_argument("--disk-cache-bytes", type=int, default=0,
+                   help="disk KV tier budget in bytes (0 disables; requires "
+                        "a host tier — host-pool LRU victims demote to disk "
+                        "int8-compressed instead of dropping, and a cold "
+                        "session resume restores disk->host->HBM without a "
+                        "prefill recompute)")
+    p.add_argument("--disk-cache-dir", default="",
+                   help="directory for disk-tier block files (default: the "
+                        "DYNTPU_KV_DISK_DIR env var, else a fresh tempdir "
+                        "the store owns and cleans up)")
     p.add_argument("--offload-watermark", type=float, default=0.90,
                    help="page-pool occupancy fraction that triggers the "
                         "batched cold-block drain to the host tier "

@@ -164,6 +164,15 @@ class EngineConfig:
     # blocks). When both knobs are set the larger resolved capacity wins;
     # sizing by bytes is the one that stays truthful across kv_cache_dtype.
     host_cache_bytes: int = 0
+    # disk KV tier budget in BYTES (0 = disabled; requires a host tier —
+    # the ladder demotes HBM -> host -> disk, never skips a rung). Host-pool
+    # LRU victims spill to disk int8-compressed (engine/kv_store.py), so a
+    # disk byte holds ~2x the bf16 context; restores ride the FETCHING_KV
+    # deferred-admission path and never block the engine loop.
+    disk_cache_bytes: int = 0
+    # where the disk tier's block files live ("" = the DYNTPU_KV_DISK_DIR
+    # env var, else a fresh tempdir owned — and cleaned — by the store)
+    disk_cache_dir: str = ""
     # pressure-driven host offload (host_cache_blocks > 0 only): once page-
     # pool occupancy crosses this fraction, the scheduler proactively drains
     # the coldest refcount-0 cached blocks to the host tier in BATCHED saves
@@ -255,6 +264,18 @@ class EngineConfig:
             raise ValueError(
                 "host cache capacity must be >= 0; got "
                 f"blocks={self.host_cache_blocks} bytes={self.host_cache_bytes}"
+            )
+        if self.disk_cache_bytes < 0:
+            raise ValueError(
+                f"disk_cache_bytes must be >= 0; got {self.disk_cache_bytes}"
+            )
+        if self.disk_cache_bytes > 0 and not (
+            self.host_cache_blocks > 0 or self.host_cache_bytes > 0
+        ):
+            raise ValueError(
+                "disk_cache_bytes requires a host cache tier "
+                "(host_cache_blocks or host_cache_bytes > 0): the KV ladder "
+                "demotes HBM -> host -> disk and never skips a rung"
             )
         if any(b <= 0 for b in self.page_table_buckets):
             raise ValueError(

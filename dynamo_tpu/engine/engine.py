@@ -191,6 +191,31 @@ class AsyncJaxEngine:
             )
             if blocks > 0:
                 offload = HostKvPool(self.runner, blocks, block_bytes=page_bytes)
+        if offload is not None and self.config.disk_cache_bytes > 0:
+            # third tier: host-pool LRU victims demote to disk (int8 wire,
+            # xxh3-checksummed files) instead of dropping; restores ride the
+            # FETCHING_KV deferred-admission path (engine/kv_store.py)
+            from dynamo_tpu.engine.kv_store import DiskKvStore, disk_block_bytes
+
+            mcfg = getattr(self.model, "config", None)
+            block_bytes = (
+                disk_block_bytes(
+                    self.config.page_size, mcfg.num_kv_heads, mcfg.head_dim,
+                    mcfg.num_layers,
+                )
+                if mcfg is not None
+                and all(
+                    hasattr(mcfg, a)
+                    for a in ("num_kv_heads", "head_dim", "num_layers")
+                )
+                else 0
+            )
+            offload.disk = DiskKvStore(
+                directory=self.config.disk_cache_dir or None,
+                budget_bytes=self.config.disk_cache_bytes,
+                page_axis=getattr(self.model, "wire_n_axis", 2),
+                block_bytes=block_bytes,
+            )
         self.offload = offload
         self.allocator = PageAllocator(
             self.config.num_pages,
@@ -241,6 +266,11 @@ class AsyncJaxEngine:
                 # relay): it's a daemon thread, so give up on it rather than
                 # hanging the caller's teardown forever
                 log.error("engine loop did not exit within %.0fs; abandoning thread", join_timeout)
+        disk = getattr(getattr(self, "offload", None), "disk", None)
+        if disk is not None:
+            # drain the disk tier's write queue and stop its worker (a store
+            # that owns its tempdir also cleans it up)
+            await asyncio.get_running_loop().run_in_executor(None, disk.close)
         self.health.set_state("dead", "shutdown complete")
 
     # ---------------- request API ----------------
@@ -436,9 +466,17 @@ class AsyncJaxEngine:
         if (
             seq is None or seq.finished or seq.migrating
             or seq.prefill_pos is not None or seq.fetch is not None
-            or seq.req.images or not seq.generated
+            or not seq.generated
         ):
             return None, []
+        if seq.req.images:
+            # multimodal sequences don't migrate: mm_embeds (device-resident
+            # vision encodings) don't ride the ~1KB manifest, and a silent
+            # handoff would rebuild the prompt WITHOUT them on any KV-pull
+            # miss — wrong tokens, not a slow path. Reject structurally so
+            # the caller (and the planner's rebalancer) can pick another
+            # victim instead of reading "not migratable right now".
+            return "multimodal", []
         # drain the dispatch-ahead pipeline: seq.generated must be the
         # complete materialized history before it becomes the manifest
         outputs = sched._reconcile(block=True, drain=True)
@@ -558,6 +596,20 @@ class AsyncJaxEngine:
         manifest = await self.run_on_engine(
             lambda: self.sync_snapshot_for_migration(request_id)
         )
+        if manifest == "multimodal":
+            # structured VL rejection (PR 14 follow-up): distinct from the
+            # transient "not migratable right now" — this sequence will
+            # NEVER migrate; callers must not retry it
+            events.emit(
+                "migration.fallback", request_id=request_id,
+                arm="multimodal_rejected",
+            )
+            return {
+                "status": "rejected",
+                "reason": "multimodal_sequence",
+                "detail": "mm_embeds do not ride the manifest; "
+                          "migrating would silently drop vision context",
+            }
         if manifest is None:
             return {"status": "skipped", "reason": "not migratable"}
         t0 = time.monotonic()
@@ -1085,6 +1137,22 @@ class AsyncJaxEngine:
                 offload_block_bytes=offload.block_bytes,
                 offload_bytes_resident=offload.bytes_resident,
             )
+            disk = getattr(offload, "disk", None)
+            if disk is not None:
+                snap.update(
+                    disk_spills=disk.spills,
+                    disk_restores=disk.restores,
+                    disk_drops=disk.drops,
+                    disk_io_errors=disk.io_errors,
+                    disk_blocks_resident=len(disk),
+                    disk_bytes_resident=disk.bytes_resident,
+                    disk_budget_bytes=disk.budget_bytes,
+                    disk_restore_s=round(disk.restore_s, 4),
+                    disk_restore_hits=sched.disk_restore_hits,
+                    disk_restore_fallbacks=sched.disk_restore_fallbacks,
+                    disk_restore_blocks=sched.disk_restore_blocks,
+                    disk_restore_tokens=sched.disk_restore_tokens,
+                )
         spec = self.config.spec
         if spec is not None:
             st = sched.stage
@@ -1443,6 +1511,46 @@ class AsyncJaxEngine:
                 "host-DRAM KV tier bytes resident at the ACTUAL wire dtype "
                 "(int8 blocks cost ~half of bf16)",
                 [({}, r["offload_bytes_resident"])],
+            ))
+        if "disk_blocks_resident" in r:
+            # disk KV tier (engine/kv_store.py): the third rung of the
+            # ladder — resident blocks/bytes against the byte budget plus
+            # spill/restore churn and cumulative restore wall time
+            parts.append(render_family(
+                "dynamo_engine_disk_blocks", "gauge",
+                "disk KV tier blocks resident (int8-compressed block files "
+                "keyed by chained sequence hash)",
+                [({}, r["disk_blocks_resident"])],
+            ))
+            parts.append(render_family(
+                "dynamo_engine_disk_bytes", "gauge",
+                "disk KV tier bytes: resident payload vs the configured "
+                "byte budget (disk_cache_bytes)",
+                [({"kind": "resident"}, r["disk_bytes_resident"]),
+                 ({"kind": "budget"}, r["disk_budget_bytes"])],
+            ))
+            parts.append(render_family(
+                "dynamo_engine_disk_spills_total", "counter",
+                "disk KV tier block writes by outcome (spill = host-pool "
+                "victim demoted; drop = budget eviction — the block left "
+                "its last tier)",
+                [({"op": "spill"}, r["disk_spills"]),
+                 ({"op": "drop"}, r["disk_drops"])],
+            ))
+            parts.append(render_family(
+                "dynamo_engine_disk_restores_total", "counter",
+                "disk KV tier blocks restored (ok = verified + promoted to "
+                "device; error = read/checksum failures that fell back to "
+                "recompute)",
+                [({"outcome": "ok"}, r["disk_restores"]),
+                 ({"outcome": "error"}, r["disk_io_errors"])],
+            ))
+            parts.append(render_family(
+                "dynamo_engine_disk_restore_seconds", "counter",
+                "cumulative wall seconds the disk worker spent reading, "
+                "verifying, and dequantizing restore runs (off the engine "
+                "loop — restores park in FETCHING_KV)",
+                [({}, r["disk_restore_s"])],
             ))
         if "lora_resident" in r:
             # multi-LoRA adapter pool: slot occupancy, LRU eviction and

@@ -1,0 +1,433 @@
+"""Disk KV tier: the third rung of the cache ladder (HBM -> host DRAM -> disk).
+
+`HostKvPool` (engine/offload.py) stops at host DRAM: its LRU victims are
+gone, and a multi-turn conversation that parks cold for an hour pays a full
+prefill recompute on resume. This module adds a byte-budgeted disk tier below
+the host pool so eviction DEMOTES instead of dropping:
+
+  - **identity**: blocks are keyed by the same chained sequence hash the
+    prefix cache, KV events, and the fleet router speak — any tier answers
+    the same question, so `lookup_prefix` and router overlap estimates stay
+    honest across all three rungs.
+  - **compression**: blocks land on disk int8-quantized (per-row symmetric
+    scales, the quant/kv.py wire layout), so a disk byte holds ~2x the bf16
+    context. Already-int8 wire blocks (`kv_cache_dtype="int8"`) are stored
+    losslessly — a disk round trip is bit-exact and greedy decoding stays
+    token-identical across a park/resume cycle.
+  - **integrity**: each block file carries a JSON header (shapes, dtype,
+    scale-plane geometry) and an xxh3-64 payload checksum — the same
+    family the disagg dataplane uses. A corrupt or truncated file is a MISS,
+    never a wrong answer: restore stops at the first bad block and the
+    engine falls back to recompute for the tail.
+  - **asynchrony**: the engine thread only touches the in-memory index
+    (membership, LRU, byte budget — synchronous truth); all file I/O runs on
+    one daemon worker over a FIFO queue, so a write enqueued by a spill
+    always lands before a restore or unlink of the same block. Restores
+    return a future shaped like a prefix-fetch result, so the scheduler's
+    existing FETCHING_KV deferred-admission path scatters disk blocks into
+    HBM without a new code path and a cold resume never blocks the loop.
+
+Eviction truthfulness: `spill()` returns the hashes that left the DISK tier
+(budget evictions) — with a disk tier attached, those are the only blocks
+that left their *last* tier, so only they may emit `removed` KV events.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import struct
+import tempfile
+import threading
+import time
+from collections import OrderedDict
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+import xxhash
+
+from dynamo_tpu.quant.kv import is_quantized_wire
+from dynamo_tpu.utils import events
+
+#: environment override for where block files live (else a fresh tempdir)
+DISK_DIR_ENV = "DYNTPU_KV_DISK_DIR"
+
+_MAGIC = b"DKV1"
+_INT8_MAX = 127.0
+
+#: scale-plane rank of the wire layout [L, 2, n, ps, ...]: one f32 scale per
+#: (layer, k/v, page, row) — the same placement quant/kv.py ships on the wire
+_SCALE_AXES = 4
+
+
+def resolve_disk_capacity_blocks(budget_bytes: int, block_bytes: int) -> int:
+    """How many disk blocks a byte budget holds at the int8 on-disk block
+    cost (the disk sibling of ``resolve_host_capacity_blocks`` — used by
+    tests and capacity displays; the store itself enforces the budget on
+    actual file bytes, headers included)."""
+    if budget_bytes <= 0 or block_bytes <= 0:
+        return 0
+    return budget_bytes // block_bytes
+
+
+def disk_block_bytes(page_size: int, num_kv_heads: int, head_dim: int,
+                     num_layers: int) -> int:
+    """Payload bytes one block costs ON DISK: always the int8 wire cost
+    (values + f32 per-row scales), independent of the serving cache dtype —
+    this is why a disk byte holds ~2x the bf16 context."""
+    from dynamo_tpu.quant.kv import kv_page_bytes
+
+    return kv_page_bytes(page_size, num_kv_heads, head_dim, num_layers, "int8")
+
+
+def _quantize_block(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Full-precision wire block [L, 2, n, ps, ...] -> (int8 values, f32
+    per-row scales [L, 2, n, ps]). Numpy twin of quant.kv.quantize_kv_rows:
+    symmetric absmax over each row's head values, floored so all-zero
+    padding rows divide cleanly to zeros."""
+    x32 = np.asarray(x, np.float32)
+    lead = x32.shape[:_SCALE_AXES]
+    absmax = np.max(np.abs(x32.reshape(lead + (-1,))), axis=-1)
+    scale = np.maximum(absmax, 1e-12) / _INT8_MAX
+    s_b = scale.reshape(lead + (1,) * (x32.ndim - _SCALE_AXES))
+    q = np.clip(np.rint(x32 / s_b), -_INT8_MAX, _INT8_MAX).astype(np.int8)
+    return q, scale.astype(np.float32)
+
+
+def _dequantize_block(q: np.ndarray, s: np.ndarray, dtype) -> np.ndarray:
+    s_b = np.asarray(s, np.float32).reshape(s.shape + (1,) * (q.ndim - s.ndim))
+    return (q.astype(np.float32) * s_b).astype(dtype)
+
+
+def _dtype_from_name(name: str):
+    """np.dtype lookup that also resolves the ml_dtypes names (bfloat16) a
+    bf16 serving cache round-trips through the header."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _encode_block(seq_hash: int, data) -> bytes:
+    """Serialize one wire block (ndarray or int8 wire dict) to the on-disk
+    record: magic | u32 header_len | JSON header | q bytes | s bytes."""
+    if is_quantized_wire(data):
+        q = np.ascontiguousarray(data["q"], dtype=np.int8)
+        s = np.ascontiguousarray(data["s"], dtype=np.float32)
+        src_dtype, quantized_src = "int8", True
+    else:
+        arr = np.asarray(data)
+        q, s = _quantize_block(arr)
+        src_dtype, quantized_src = arr.dtype.name, False
+    payload = q.tobytes() + s.tobytes()
+    header = {
+        "v": 1,
+        "hash": int(seq_hash),
+        "dtype": src_dtype,
+        "quantized_src": quantized_src,
+        "q_shape": list(q.shape),
+        "s_shape": list(s.shape),
+        "xxh3": xxhash.xxh3_64_intdigest(payload),
+    }
+    hdr = json.dumps(header, separators=(",", ":")).encode()
+    return _MAGIC + struct.pack("<I", len(hdr)) + hdr + payload
+
+
+def _decode_block(raw: bytes, seq_hash: int):
+    """Inverse of ``_encode_block``; raises ValueError on any corruption
+    (bad magic, truncation, checksum or identity mismatch)."""
+    if len(raw) < len(_MAGIC) + 4 or raw[: len(_MAGIC)] != _MAGIC:
+        raise ValueError("bad magic")
+    (hdr_len,) = struct.unpack_from("<I", raw, len(_MAGIC))
+    off = len(_MAGIC) + 4
+    if len(raw) < off + hdr_len:
+        raise ValueError("truncated header")
+    header = json.loads(raw[off : off + hdr_len])
+    payload = raw[off + hdr_len :]
+    q_shape = tuple(header["q_shape"])
+    s_shape = tuple(header["s_shape"])
+    want = int(np.prod(q_shape)) + 4 * int(np.prod(s_shape))
+    if len(payload) != want:
+        raise ValueError("truncated payload")
+    if xxhash.xxh3_64_intdigest(payload) != header["xxh3"]:
+        raise ValueError("checksum mismatch")
+    if int(header["hash"]) != int(seq_hash):
+        raise ValueError("block identity mismatch")
+    q_bytes = int(np.prod(q_shape))
+    q = np.frombuffer(payload[:q_bytes], np.int8).reshape(q_shape)
+    s = np.frombuffer(payload[q_bytes:], np.float32).reshape(s_shape)
+    if header["quantized_src"]:
+        return {"q": np.array(q), "s": np.array(s)}
+    return _dequantize_block(q, s, _dtype_from_name(header["dtype"]))
+
+
+def _block_disk_nbytes(data) -> int:
+    """Exact int8 payload bytes ``data`` will cost on disk, computed WITHOUT
+    quantizing — the engine-thread side of the byte budget."""
+    if is_quantized_wire(data):
+        return int(data["q"].nbytes) + int(data["s"].nbytes)
+    arr = np.asarray(data)
+    n = int(np.prod(arr.shape))
+    rows = int(np.prod(arr.shape[:_SCALE_AXES]))
+    return n + 4 * rows
+
+
+@dataclass
+class DiskPart:
+    """One contiguous run of restored blocks, shaped like a prefix-fetch
+    part so ``scheduler._scatter_fetched`` consumes it unchanged."""
+
+    block_from: int
+    block_to: int  # exclusive
+    data: object  # wire-concat of the run (ndarray or int8 wire dict)
+    cat_axis: int
+
+
+@dataclass
+class DiskFetchResult:
+    """Worker-thread result of a restore, mirroring the prefix-fetch client
+    result contract the scheduler's poll loop already speaks."""
+
+    status: str  # "hit" | "miss"
+    blocks: int = 0
+    bytes: int = 0
+    parts: list = field(default_factory=list)
+    #: hashes whose files failed verification — left their last tier; the
+    #: engine thread discards them and emits the one truthful ``removed``
+    failed: list = field(default_factory=list)
+
+
+@dataclass
+class _Entry:
+    nbytes: int
+    path: str
+
+
+class DiskKvStore:
+    """Byte-budgeted disk tier below the host pool.
+
+    The in-memory LRU index is the synchronous truth and is only touched
+    from the engine thread; one daemon worker drains a FIFO op queue for
+    every file read/write/unlink, so ordering hazards (restore racing its
+    own spill's write; unlink racing a write) resolve by queue position.
+    """
+
+    def __init__(
+        self,
+        directory: Optional[str] = None,
+        budget_bytes: int = 0,
+        page_axis: int = 2,
+        block_bytes: int = 0,
+    ):
+        env_dir = os.environ.get(DISK_DIR_ENV, "")
+        self._owns_dir = not (directory or env_dir)
+        self.directory = (
+            directory or env_dir or tempfile.mkdtemp(prefix="dyntpu-kv-disk-")
+        )
+        os.makedirs(self.directory, exist_ok=True)
+        self.budget_bytes = int(budget_bytes)
+        self.page_axis = page_axis
+        #: nominal int8 bytes per block (display/capacity arithmetic; the
+        #: budget itself bites on actual per-block payload bytes)
+        self.block_bytes = int(block_bytes)
+        self._index: OrderedDict[int, _Entry] = OrderedDict()
+        self.bytes_resident = 0
+        # counters (worker thread increments restore-side under _lock)
+        self.spills = 0
+        self.restores = 0
+        self.drops = 0
+        self.io_errors = 0
+        self.restore_s = 0.0
+        self._lock = threading.Lock()
+        self._ops: queue.Queue = queue.Queue()
+        self._worker = threading.Thread(
+            target=self._drain, name="dyntpu-kv-disk", daemon=True
+        )
+        self._worker.start()
+
+    # ---------------- engine-thread index surface ----------------
+
+    def __contains__(self, seq_hash: int) -> bool:
+        return seq_hash in self._index
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    def _path(self, seq_hash: int) -> str:
+        return os.path.join(self.directory, f"{seq_hash & (2**64 - 1):016x}.kvb")
+
+    def spill(self, seq_hash: int, data) -> list[int]:
+        """Engine thread: demote one host-pool victim to disk. Serialization
+        and the write happen on the worker; the index and byte budget update
+        here, synchronously. Returns hashes EVICTED from disk to stay under
+        budget — the blocks that just left their last tier."""
+        if self.budget_bytes <= 0:
+            return [seq_hash]
+        if seq_hash in self._index:
+            self._index.move_to_end(seq_hash)
+            return []
+        nbytes = _block_disk_nbytes(data)
+        if nbytes > self.budget_bytes:
+            return [seq_hash]  # a block the budget can never hold
+        path = self._path(seq_hash)
+        self._index[seq_hash] = _Entry(nbytes=nbytes, path=path)
+        self.bytes_resident += nbytes
+        self.spills += 1
+        self._ops.put(("write", path, seq_hash, data))
+        evicted: list[int] = []
+        while self.bytes_resident > self.budget_bytes and self._index:
+            victim, entry = self._index.popitem(last=False)
+            self.bytes_resident -= entry.nbytes
+            self.drops += 1
+            self._ops.put(("unlink", entry.path))
+            evicted.append(victim)
+        if evicted:
+            events.emit(
+                "offload.disk_drop", request_id="", blocks=len(evicted)
+            )
+        return evicted
+
+    def discard(self, seq_hash: int) -> bool:
+        """Engine thread: drop one block from the index (promotion back up
+        the ladder, or a failed restore). Unlink rides the queue."""
+        entry = self._index.pop(seq_hash, None)
+        if entry is None:
+            return False
+        self.bytes_resident -= entry.nbytes
+        self._ops.put(("unlink", entry.path))
+        return True
+
+    def leading_run(self, hashes: list[int]) -> list[int]:
+        """The contiguous leading run of ``hashes`` resident on disk — the
+        only shape a restore can scatter (KV pages chain)."""
+        run: list[int] = []
+        for h in hashes:
+            if h not in self._index:
+                break
+            run.append(h)
+        return run
+
+    def restore_async(self, hashes: list[int]) -> "Future[DiskFetchResult]":
+        """Engine thread: start an async restore of the leading resident run
+        of ``hashes``. Returns a future resolving to a prefix-fetch-shaped
+        result; never blocks (misses resolve immediately)."""
+        run = self.leading_run(hashes)
+        fut: Future = Future()
+        if not run:
+            fut.set_result(DiskFetchResult(status="miss"))
+            return fut
+        for h in run:
+            self._index.move_to_end(h)
+        paths = [self._index[h].path for h in run]
+        self._ops.put(("read", list(run), paths, fut))
+        return fut
+
+    def restore(self, hashes: list[int], timeout: float = 30.0) -> DiskFetchResult:
+        """Synchronous restore (tests, tooling)."""
+        return self.restore_async(hashes).result(timeout)
+
+    def flush(self, timeout: float = 30.0) -> None:
+        """Block until every op enqueued so far has landed on disk."""
+        done = threading.Event()
+        self._ops.put(("barrier", done))
+        done.wait(timeout)
+
+    def close(self) -> None:
+        self.flush()
+        self._ops.put(("stop",))
+        self._worker.join(timeout=5.0)
+        if self._owns_dir:
+            for entry in list(self._index.values()):
+                try:
+                    os.unlink(entry.path)
+                except OSError:
+                    pass
+            try:
+                os.rmdir(self.directory)
+            except OSError:
+                pass
+
+    # ---------------- worker thread ----------------
+
+    def _drain(self) -> None:
+        while True:
+            op = self._ops.get()
+            kind = op[0]
+            if kind == "stop":
+                return
+            if kind == "barrier":
+                op[1].set()
+                continue
+            try:
+                if kind == "write":
+                    _, path, seq_hash, data = op
+                    tmp = path + ".tmp"
+                    with open(tmp, "wb") as f:
+                        f.write(_encode_block(seq_hash, data))
+                    os.replace(tmp, path)
+                elif kind == "unlink":
+                    try:
+                        os.unlink(op[1])
+                    except FileNotFoundError:
+                        pass
+                elif kind == "read":
+                    self._do_read(*op[1:])
+            except Exception:
+                with self._lock:
+                    self.io_errors += 1
+                if kind == "read":
+                    # a failed read op must still resolve its future
+                    _, _, fut = op[1:]
+                    if not fut.done():
+                        fut.set_result(DiskFetchResult(status="miss"))
+
+    def _do_read(self, run: list[int], paths: list[str], fut: Future) -> None:
+        if fut.cancelled():
+            return  # the sequence was preempted while we were queued
+        t0 = time.monotonic()
+        blocks: list = []
+        failed: list[int] = []
+        nbytes = 0
+        for h, path in zip(run, paths):
+            try:
+                with open(path, "rb") as f:
+                    raw = f.read()
+                data = _decode_block(raw, h)
+            except Exception:
+                # corrupt/truncated/missing: stop at the first bad block —
+                # the tail falls back to recompute, never a wrong answer
+                failed.append(h)
+                with self._lock:
+                    self.io_errors += 1
+                break
+            blocks.append(data)
+            nbytes += _block_disk_nbytes(data)
+        dt = time.monotonic() - t0
+        with self._lock:
+            self.restores += len(blocks)
+            self.restore_s += dt
+        if not blocks:
+            result = DiskFetchResult(status="miss", failed=failed)
+        else:
+            from dynamo_tpu.quant.kv import wire_concat
+
+            part = DiskPart(
+                block_from=0,
+                block_to=len(blocks),
+                data=wire_concat(blocks, self.page_axis),
+                cat_axis=self.page_axis,
+            )
+            result = DiskFetchResult(
+                status="hit", blocks=len(blocks), bytes=nbytes,
+                parts=[part], failed=failed,
+            )
+        if not fut.cancelled():
+            try:
+                fut.set_result(result)
+            except Exception:  # pragma: no cover - cancel raced set_result
+                pass

@@ -13,8 +13,12 @@ Flow:
   - allocate_sequence() consults the host pool after device-cache misses:
     hits are injected back into freshly-allocated device pages and count as
     cached prefix (no recompute)
-  - the host pool is LRU-bounded; dropping a block there emits the `removed`
-    KV event (the block is now gone from every tier)
+  - the host pool is LRU-bounded; a victim DEMOTES to the disk tier
+    (engine/kv_store.py) when one is attached, else it is dropped. Either
+    way, `save`/`save_many` return only the hashes that left their LAST
+    tier — the only blocks allowed to emit the `removed` KV event, so the
+    prefix cache, router, and fleet state stay truthful across all three
+    rungs of the ladder.
 """
 
 from __future__ import annotations
@@ -24,7 +28,7 @@ from collections import OrderedDict
 
 import numpy as np
 
-from dynamo_tpu.utils import get_logger, tracing
+from dynamo_tpu.utils import events, get_logger, tracing
 
 log = get_logger("engine.offload")
 
@@ -54,6 +58,9 @@ class HostKvPool:
         # resident-bytes gauge; 0 = unknown, gauges render zero)
         self.block_bytes = block_bytes
         self._blocks: OrderedDict[int, np.ndarray] = OrderedDict()  # seq_hash -> [L,2,1,ps,H,D]
+        #: optional engine/kv_store.DiskKvStore — the tier below this one;
+        #: LRU victims demote into it instead of dropping
+        self.disk = None
         self.saves = 0
         self.loads = 0
         self.drops = 0
@@ -69,9 +76,34 @@ class HostKvPool:
     def __contains__(self, seq_hash: int) -> bool:
         return seq_hash in self._blocks
 
+    def in_any_tier(self, seq_hash: int) -> bool:
+        """Membership across host DRAM AND the disk tier below it — the
+        question ``lookup_prefix`` asks (any tier can still answer)."""
+        return seq_hash in self._blocks or (
+            self.disk is not None and seq_hash in self.disk
+        )
+
+    def _demote(self, victim: int, block) -> list[int]:
+        """One LRU victim leaves host DRAM: spill to disk when a disk tier
+        is attached (returns only the hashes that left their LAST tier —
+        disk-budget evictions), else the victim is simply gone."""
+        if self.disk is None:
+            return [victim]
+        return self.disk.spill(victim, block)
+
+    def _emit_spills(self, spills_before: int) -> None:
+        """Journal the host->disk demotions a save batch caused (one batched
+        event: demotion runs inside the eviction loop, per-victim events
+        would swamp the ring under pressure)."""
+        if self.disk is None:
+            return
+        n = self.disk.spills - spills_before
+        if n > 0:
+            events.emit("offload.disk_spill", request_id="", blocks=n)
+
     def save(self, seq_hash: int, page_id: int) -> list[int]:
-        """Copy a device page to host. Returns seq hashes dropped from the pool
-        (for removed-event emission)."""
+        """Copy a device page to host. Returns seq hashes that left their
+        last tier (for removed-event emission)."""
         if self.capacity_blocks <= 0:
             return [seq_hash]  # offload disabled: block is simply gone
         t0 = time.monotonic()
@@ -81,17 +113,19 @@ class HostKvPool:
         self._blocks.move_to_end(seq_hash)
         self.saves += 1
         dropped = []
+        spills0 = self.disk.spills if self.disk is not None else 0
         while len(self._blocks) > self.capacity_blocks:
-            victim, _ = self._blocks.popitem(last=False)
-            dropped.append(victim)
+            victim, block = self._blocks.popitem(last=False)
+            dropped.extend(self._demote(victim, block))
             self.drops += 1
+        self._emit_spills(spills0)
         return dropped
 
     def save_many(self, pairs: list[tuple[int, int]]) -> list[int]:
         """Copy a batch of device pages to host with ONE device gather (the
         pressure-eviction path: per-block save() pays a dispatch + D2H round
         trip per page, serialized into whatever allocation needed the pages).
-        Returns seq hashes dropped from the pool (removed-event emission)."""
+        Returns seq hashes that left their last tier (removed-event emission)."""
         if self.capacity_blocks <= 0:
             return [h for h, _ in pairs]
         if not pairs:
@@ -113,10 +147,12 @@ class HostKvPool:
             self._blocks.move_to_end(seq_hash)
         self.saves += len(pairs)
         dropped = []
+        spills0 = self.disk.spills if self.disk is not None else 0
         while len(self._blocks) > self.capacity_blocks:
-            victim, _ = self._blocks.popitem(last=False)
-            dropped.append(victim)
+            victim, block = self._blocks.popitem(last=False)
+            dropped.extend(self._demote(victim, block))
             self.drops += 1
+        self._emit_spills(spills0)
         return dropped
 
     def load(self, seq_hash: int, page_id: int) -> bool:

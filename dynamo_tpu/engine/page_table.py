@@ -63,7 +63,9 @@ class PageAllocator:
         self.page_size = page_size
         self.event_sink = event_sink
         self.offload = offload
-        self._offloaded_meta: dict[int, StoredBlock] = {}  # host-tier blocks
+        # off-device blocks (host DRAM *or* disk tier): meta survives until
+        # the block leaves its LAST tier, when the one removed event fires
+        self._offloaded_meta: dict[int, StoredBlock] = {}
         self._free: list[int] = list(range(num_pages - 1, 0, -1))  # stack; page 0 reserved
         # sequence_hash -> physical page holding that full block
         self._cache: dict[int, int] = {}
@@ -173,7 +175,9 @@ class PageAllocator:
         hits = 0
         for block in ts.blocks:
             h = block.sequence_hash
-            if h in self._cache or (self.offload is not None and h in self.offload):
+            if h in self._cache or (
+                self.offload is not None and self.offload.in_any_tier(h)
+            ):
                 hits += 1
             else:
                 break
@@ -302,6 +306,52 @@ class PageAllocator:
         # the scheduler calls commit_prefilled().
         self._seqs[seq_id] = state
         return cached_len, state
+
+    def promote_restored(self, seq_id: str, base_block: int, blocks: int) -> None:
+        """A disk restore scattered ``blocks`` wire blocks into this
+        sequence's pages starting at logical block ``base_block`` — promote
+        them disk->device: drop the disk copies and re-register each block
+        in the device prefix cache under its preserved meta, so later
+        sequences share them again. No ``stored`` event fires (the block
+        never emitted ``removed`` — its advertised identity stayed valid
+        across the whole HBM->host->disk->HBM round trip)."""
+        state = self._seqs.get(seq_id)
+        disk = self.offload.disk if self.offload is not None else None
+        if state is None or disk is None:
+            return
+        for i in range(base_block, base_block + blocks):
+            if i >= len(state.pages) or i >= len(state.token_seq.blocks):
+                break
+            h = state.token_seq.blocks[i].sequence_hash
+            disk.discard(h)
+            if h in self._cache:
+                continue  # another writer registered it while we restored
+            meta = self._offloaded_meta.pop(h, None)
+            if meta is not None:
+                self._cache[h] = state.pages[i]
+                self._cache_meta[h] = meta
+                state.registered_hashes.append(h)
+            else:
+                # restored with no tracked meta: it just left its last tier
+                # without re-registering — advertise the removal (same
+                # contract as the host-restore leg above)
+                self._emit(KvCacheEvent.removed([h]))
+
+    def drop_disk_blocks(self, hashes: list) -> None:
+        """Blocks whose disk files failed verification (corrupt/truncated)
+        just left their last tier: discard the index entries and emit the
+        one truthful ``removed`` per block."""
+        disk = self.offload.disk if self.offload is not None else None
+        if disk is None:
+            return
+        removed = []
+        for h in hashes:
+            disk.discard(h)
+            meta = self._offloaded_meta.pop(h, None)
+            if meta is not None and h not in self._cache:
+                removed.append(meta.block_hash)
+        if removed:
+            self._emit(KvCacheEvent.removed(removed))
 
     def _rollback(self, state: SequencePages) -> None:
         """Undo a failed allocation. Cache-registered pages (shared prefix hits

@@ -199,6 +199,9 @@ class _PrefixFetch:
     # seq_handoff pull of a migrated sequence's pages (ADOPTING side):
     # resolution feeds the migration counters instead of the prefix ones
     handoff: bool = False
+    # disk-tier restore (engine/kv_store.py): same FETCHING_KV parking, but
+    # resolution promotes blocks disk->device and feeds the disk counters
+    disk: bool = False
 
 
 @dataclass
@@ -429,6 +432,12 @@ class Scheduler:
         self.prefix_fetch_blocks = 0  # blocks pulled and scattered
         self.prefix_fetch_bytes = 0  # payload bytes pulled (wire KV dtype)
         self.prefix_fetch_tokens = 0  # prompt tokens whose recompute was skipped
+        # disk KV tier (engine/kv_store.py): scheduler-side resume counters
+        # (the store itself counts spills/restores/drops/io at the file layer)
+        self.disk_restore_hits = 0  # restores that landed >= 1 disk block
+        self.disk_restore_fallbacks = 0  # miss/corrupt head -> recompute
+        self.disk_restore_blocks = 0  # blocks promoted disk -> device
+        self.disk_restore_tokens = 0  # prompt tokens whose recompute was skipped
         # live migration (disagg/migrate.py): both roles' counters live here
         # so resource_snapshot / dynamo_migration_* render from one place
         self.migration_out = 0  # sequences handed to a peer (stream re-pinned)
@@ -912,6 +921,10 @@ class Scheduler:
         if req.kv_handoff_seq:
             self.migration_in += 1
         fetch = self._maybe_start_fetch(req, cached_len, prompt_len)
+        if fetch is None:
+            # no remote holder (or it lost): a cold-parked session's blocks
+            # may still sit on the local disk tier — same FETCHING_KV wait
+            fetch = self._maybe_start_disk_restore(req, cached_len, prompt_len)
         if self.runner.packed_prefill_mode and not req.images:
             # packed path: per-request prep now, chunk dispatch deferred to
             # _dispatch_prefill_batches so chunks of DIFFERENT sequences can
@@ -1007,6 +1020,39 @@ class Scheduler:
             handoff=handoff,
         )
 
+    def _maybe_start_disk_restore(
+        self, req: EngineRequest, cached_len: int, prompt_len: int
+    ) -> Optional[_PrefixFetch]:
+        """Kick an async disk->HBM restore when the disk tier holds the
+        chain past our device+host cached prefix (a cold session resuming).
+        Rides the same FETCHING_KV parking as the fleet prefix pull — the
+        engine loop never blocks on file I/O; the worker thread reads,
+        verifies, and dequantizes, and ``_poll_fetches`` scatters the result
+        exactly like a remote part."""
+        disk = getattr(self.allocator.offload, "disk", None)
+        if disk is None or len(disk) == 0:
+            return None
+        ps = self.config.page_size
+        base = cached_len // ps
+        # same never-consume-the-whole-prompt rule as every other tier
+        want_to = (prompt_len - 1) // ps
+        if want_to <= base:
+            return None
+        state = self.allocator._seqs[req.request_id]
+        hashes = [b.sequence_hash for b in state.token_seq.blocks[base:want_to]]
+        if not hashes or hashes[0] not in disk:
+            return None
+        fut = disk.restore_async(hashes)
+        now = time.monotonic()
+        log.debug(
+            "disk restore for %s: blocks [%d, %d)", req.request_id, base, want_to
+        )
+        return _PrefixFetch(
+            fut=fut, base_block=base, t0=now,
+            belt_deadline=now + self.config.prefix_fetch_timeout_s + 2.0,
+            disk=True,
+        )
+
     def _fetching(self) -> bool:
         return any(
             s is not None and not s.finished and s.fetch is not None
@@ -1052,10 +1098,15 @@ class Scheduler:
             seq.fetch = None
             resolved += 1
             dt = time.monotonic() - f.t0
-            self.stage_hist["prefix_fetch"].observe(dt)
+            if not f.disk:
+                self.stage_hist["prefix_fetch"].observe(dt)
             applied = 0
             if res is not None and getattr(res, "status", "") == "hit" and res.blocks:
                 applied = self._scatter_fetched(seq, f, res)
+            if f.disk:
+                self._resolve_disk_restore(seq, f, res, applied, dt, timed_out)
+                self._resume_after_fetch(seq, outputs)
+                continue
             if applied:
                 ps = self.config.page_size
                 new_cached = (f.base_block + applied) * ps
@@ -1104,6 +1155,53 @@ class Scheduler:
                 )
             self._resume_after_fetch(seq, outputs)
         return resolved
+
+    def _resolve_disk_restore(
+        self, seq: RunningSeq, f: _PrefixFetch, res, applied: int, dt: float,
+        timed_out: bool,
+    ) -> None:
+        """Book a resolved disk restore: promote scattered blocks
+        disk->device (their advertised identity stays valid — no removed
+        event), drop corrupt blocks truthfully, advance prefill past the
+        restored prefix, and journal the outcome."""
+        failed = list(getattr(res, "failed", ()) or ()) if res is not None else []
+        if applied:
+            ps = self.config.page_size
+            new_cached = (f.base_block + applied) * ps
+            self.disk_restore_hits += 1
+            self.disk_restore_blocks += applied
+            self.disk_restore_tokens += max(0, new_cached - seq.prefill_pos)
+            self.allocator.promote_restored(
+                seq.req.request_id, f.base_block, applied
+            )
+            seq.prefill_pos = max(seq.prefill_pos, new_cached)
+            seq.cached_len = max(seq.cached_len, new_cached)
+            tracing.record_span(
+                "engine.disk_restore", f.t0, duration=dt,
+                request_id=seq.req.request_id, trace_id=seq.req.trace_id,
+                attrs={"blocks": applied, "bytes": res.bytes},
+            )
+        else:
+            self.disk_restore_fallbacks += 1
+            log.info(
+                "disk restore for %s fell back to recompute (%s)",
+                seq.req.request_id,
+                "belt_timeout" if timed_out
+                else getattr(res, "status", "dead") if res is not None
+                else "dead",
+            )
+        if failed:
+            # corrupt/truncated files left their last tier: one truthful
+            # removed per block; the tail past them recomputes
+            self.allocator.drop_disk_blocks(failed)
+        events.emit(
+            "offload.disk_restore",
+            request_id=seq.req.request_id, trace_id=seq.req.trace_id,
+            tenant=seq.req.tenant, priority=seq.req.priority or "",
+            blocks=applied, corrupt=len(failed),
+            waited_ms=round(dt * 1e3, 3),
+            outcome="hit" if applied else "fallback",
+        )
 
     def _scatter_fetched(self, seq: RunningSeq, f: _PrefixFetch, res) -> int:
         """Inject pulled parts into the sequence's pre-allocated pages.

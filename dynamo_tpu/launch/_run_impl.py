@@ -59,6 +59,8 @@ def engine_config_for(args):
         prefill_flat_depth=getattr(args, "prefill_flat_depth", None) or 8192,
         host_cache_blocks=getattr(args, "host_cache_blocks", None) or 0,
         host_cache_bytes=getattr(args, "host_cache_bytes", None) or 0,
+        disk_cache_bytes=getattr(args, "disk_cache_bytes", None) or 0,
+        disk_cache_dir=getattr(args, "disk_cache_dir", None) or "",
         offload_watermark=getattr(args, "offload_watermark", None) or 0.90,
         # multi-tenant QoS knobs (graph yaml / CLI)
         qos=not getattr(args, "no_qos", False),

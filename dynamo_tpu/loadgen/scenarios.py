@@ -70,6 +70,15 @@ class ScenarioSpec:
     # the prefix-cache + long-context shape)
     session_groups: int = 0
     shared_prefix_len: int = 0
+    # ---------------- multi-turn parked sessions ----------------
+    # session_turns > 1: each of num_requests arrivals starts a CONVERSATION
+    # of that many turns; turn k's prompt extends turn k-1's prompt with a
+    # fresh tail (the conversation history), and consecutive turns are
+    # spaced park_s seconds apart — the session goes COLD between turns, so
+    # its KV blocks demote down the tier ladder (HBM -> host -> disk,
+    # engine/kv_store.py) and the next turn's TTFT measures the resume path
+    session_turns: int = 1
+    park_s: float = 0.0
     # ---------------- multimodal ----------------
     images: bool = False  # attach one deterministic random image per request
     image_hw: tuple = (32, 32)
@@ -92,6 +101,10 @@ class ScenarioSpec:
             raise ValueError("rate_rps must be > 0")
         if self.session_groups and self.shared_prefix_len <= 0:
             raise ValueError("session_groups needs shared_prefix_len > 0")
+        if self.session_turns < 1:
+            raise ValueError("session_turns must be >= 1")
+        if self.park_s < 0:
+            raise ValueError("park_s must be >= 0")
         # yaml lists arrive as lists; freeze to tuples so the spec hashes
         object.__setattr__(self, "tenants", tuple(self.tenants))
         object.__setattr__(self, "adapters", tuple(self.adapters))
@@ -158,6 +171,17 @@ BUILTIN_SCENARIOS: dict = {
         isl_min=4096, isl_max=65024,
         osl_dist="fixed", osl_mean=32, osl_max=64,
         vocab=32000, slo_ttft_ms=120000.0, slo_itl_ms=2000.0,
+    ),
+    # parked sessions: multi-turn conversations that go cold between turns —
+    # each arrival is a conversation whose turn k prompt is turn k-1's
+    # prompt plus a fresh tail, with park_s of silence in between. While
+    # parked, the session's KV blocks demote HBM -> host -> disk; the
+    # follow-up turn's TTFT is the cold-resume headline (bench kv_tiers)
+    "parked_sessions": _spec(
+        name="parked_sessions", arrival="poisson", rate_rps=2.0,
+        num_requests=8, session_turns=3, park_s=20.0,
+        isl_mean=48, isl_sigma=0.4, isl_min=16, isl_max=128,
+        osl_dist="fixed", osl_mean=8, osl_max=16, slo_ttft_ms=8000.0,
     ),
     # multimodal: Qwen2-VL image requests (deterministic random images) —
     # the capability that had zero perf numbers before this harness

@@ -132,6 +132,10 @@ def compile_trace(spec: ScenarioSpec) -> list:
         prefixes.append(
             [grng.randrange(1, spec.vocab) for _ in range(spec.shared_prefix_len)]
         )
+    if spec.session_turns > 1:
+        # parked sessions take a separate branch so single-turn scenarios
+        # keep their exact historical draw sequence (digest stability)
+        return _compile_parked(spec, rng, arrivals, prefixes)
     out = []
     for i, at in enumerate(arrivals):
         isl = _length(spec.isl_dist, spec.isl_mean, spec.isl_sigma,
@@ -168,6 +172,62 @@ def compile_trace(spec: ScenarioSpec) -> list:
             session=session,
             image=image,
         ))
+    return out
+
+
+def _compile_parked(spec: ScenarioSpec, rng: random.Random,
+                    arrivals: list, prefixes: list) -> list:
+    """Multi-turn conversations that go cold between turns (parked
+    sessions): each arrival starts a conversation of spec.session_turns
+    turns. Turn k's prompt is turn k-1's full prompt plus a fresh tail —
+    the conversation-history shape that makes follow-up turns pure prefix
+    hits — and consecutive turns are spaced park_s seconds apart, long
+    enough for the session's KV blocks to demote down the tier ladder
+    (HBM -> host -> disk) before the resume measures the restore path.
+
+    Draw order is fixed per conversation (tenant, adapter, group pick,
+    then per turn: isl, osl, tail tokens, image) so the determinism
+    contract holds exactly as in the single-turn branch."""
+    out = []
+    for c, at in enumerate(arrivals):
+        tenant = rng.choice(spec.tenants) if spec.tenants else ""
+        adapter = ""
+        if spec.adapters and rng.random() >= spec.base_model_share:
+            adapter = _zipf_pick(spec.adapters, spec.zipf_alpha, rng)
+        session = f"c{c}"
+        history = []
+        if prefixes:
+            g = rng.randrange(len(prefixes))
+            session = f"s{g}-c{c}"
+            history = list(prefixes[g])
+        for k in range(spec.session_turns):
+            isl = _length(spec.isl_dist, spec.isl_mean, spec.isl_sigma,
+                          spec.isl_min, spec.isl_max, spec.tail_alpha, rng)
+            osl = _length(spec.osl_dist, spec.osl_mean, spec.osl_sigma,
+                          spec.osl_min, spec.osl_max, spec.tail_alpha, rng)
+            history = history + [
+                rng.randrange(1, spec.vocab) for _ in range(isl)
+            ]
+            image = None
+            if spec.images:
+                image = {
+                    "seed": rng.randrange(1 << 31),
+                    "h": spec.image_hw[0],
+                    "w": spec.image_hw[1],
+                }
+            out.append(TraceRequest(
+                at_s=round(at + k * spec.park_s, 6),
+                request_id=f"{spec.name}-{spec.seed}-{c:05d}-t{k}",
+                scenario=spec.name,
+                token_ids=list(history),
+                max_tokens=osl,
+                tenant=tenant,
+                adapter=adapter,
+                temperature=spec.temperature,
+                session=session,
+                image=image,
+            ))
+    out.sort(key=lambda t: (t.at_s, t.request_id))
     return out
 
 

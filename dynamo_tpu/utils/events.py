@@ -83,6 +83,9 @@ DECLARED_EVENT_KINDS: tuple = (
     "prefix_fetch.timeout",
     "offload.drain",
     "offload.restore",
+    "offload.disk_spill",
+    "offload.disk_restore",
+    "offload.disk_drop",
     "health.transition",
     "planner.observe",
     "planner.decide",

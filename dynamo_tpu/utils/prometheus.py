@@ -36,6 +36,11 @@ DECLARED_METRIC_FAMILIES: tuple = (
     "dynamo_engine_context_table_dispatch_total",
     "dynamo_engine_context_table_promotions_total",
     "dynamo_engine_decode_window_dispatch_seconds",
+    "dynamo_engine_disk_blocks",
+    "dynamo_engine_disk_bytes",
+    "dynamo_engine_disk_restore_seconds",
+    "dynamo_engine_disk_restores_total",
+    "dynamo_engine_disk_spills_total",
     "dynamo_engine_goodput_itl_p99_seconds",
     "dynamo_engine_goodput_ratio",
     "dynamo_engine_goodput_requests_total",
@@ -522,11 +527,21 @@ def _sample_surfaces() -> list[tuple[str, str]]:
 
     eng.runner = _SpecRunner()
 
+    class _Disk:  # shape resource_snapshot actually reads: puts the
+        # dynamo_engine_disk_* families on the conformance surface
+        spills, restores, drops, io_errors = 5, 3, 1, 1
+        bytes_resident, budget_bytes = 16384, 65536
+        restore_s = 0.012
+
+        def __len__(self):
+            return 4
+
     class _Offload:  # shape resource_snapshot actually reads: puts the
         # dynamo_engine_offload_* families on the conformance surface
         saves, loads, drops = 4, 2, 1
         capacity_blocks, block_bytes, bytes_resident = 64, 4096, 8192
         transfer_s = 0.003
+        disk = _Disk()
 
         def __len__(self):
             return 2

@@ -58,6 +58,17 @@ def _fill_representative(bench):
     bench.DETAIL["parity_host_offload"] = {
         "projection": {"ttft_ratio_projected": 8.82, "restore_bw_source": "measured"},
     }
+    bench.DETAIL["kv_tiers"] = {
+        "resume_ttft_tiered_ms": 123.4, "resume_ttft_recompute_ms": 534.2,
+        "resume_ttft_ratio": 0.231, "restore_parity": 1.0,
+        "resume_tokens_restored_tiered": 1344,
+        "disk": {"spills": 72, "restores": 21, "restore_hits": 3,
+                 "restore_fallbacks": 0, "restore_tokens": 1344,
+                 "io_errors": 0, "blocks_resident": 72,
+                 "bytes_resident": 452984832, "budget_bytes": 905969664},
+        "cap_under_churn": {"budget_bytes": 1048576,
+                            "max_resident_bytes": 1048400, "drops": 12},
+    }
     bench.DETAIL["long_context"] = {
         "16k": {"ttft_ms": 13956.5, "decode_tok_s": 123.4, "kv_pages_peak": 1088},
         "64k": {"ttft_ms": 57321.8, "decode_tok_s": 98.7, "kv_pages_peak": 4160},
@@ -159,6 +170,12 @@ def test_summary_line_fits_truncation_budget(bench_mod, tmp_path, monkeypatch):
     # ratio_derived moved to bench_detail.json (truncation budget)
     assert s["parity_kv_routing"] == {"ratio_measured": 2.79}
     assert s["parity_host_offload"]["ratio_projected"] == 8.82
+    # third KV tier acceptance keys ride the compact line (restore counters
+    # and the cap-under-churn proof stay in bench_detail.json)
+    assert s["kv_tiers"] == {
+        "resume_ttft_ratio": 0.231, "restore_parity": 1.0,
+        "disk_resident_bytes": 452984832,
+    }
     # errors land compactly (no tracebacks) in the summary itself
     assert "TimeoutError" in s["errors"]["parity_disagg"]
     assert "traceback" not in json.dumps(s)

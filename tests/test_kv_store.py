@@ -1,0 +1,245 @@
+"""Disk KV tier (engine/kv_store.py): on-disk record integrity, int8
+compression arithmetic (a disk byte holds ~2x the bf16 context), byte-budget
+LRU enforcement, and corrupt/truncated-file restores degrading to misses —
+never wrong answers."""
+
+import os
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.kv_store import (
+    DiskKvStore,
+    _block_disk_nbytes,
+    _decode_block,
+    _encode_block,
+    _quantize_block,
+    disk_block_bytes,
+    resolve_disk_capacity_blocks,
+)
+from dynamo_tpu.quant.kv import kv_page_bytes
+
+#: wire block layout [L, 2, n, ps, hd] with page axis 2
+SHAPE = (2, 2, 1, 4, 8)
+PAGE_AXIS = 2
+
+
+def _block(seed=0):
+    return np.random.default_rng(seed).standard_normal(SHAPE).astype(np.float32)
+
+
+# ---------------- on-disk record format ----------------
+
+
+def test_encode_decode_roundtrip_float():
+    x = _block(1)
+    dec = _decode_block(_encode_block(77, x), 77)
+    assert isinstance(dec, np.ndarray) and dec.shape == SHAPE
+    # per-row symmetric int8: error bounded by half a quantization step
+    q, s = _quantize_block(x)
+    step = s.reshape(SHAPE[:4] + (1,))
+    assert np.all(np.abs(dec.astype(np.float32) - x) <= step * 0.51)
+
+
+def test_encode_decode_bit_exact_int8_wire():
+    """An already-int8 wire block (kv_cache_dtype="int8") stores losslessly:
+    the park/resume round trip is bit-exact, so greedy decoding stays
+    token-identical across a demote/restore cycle."""
+    rng = np.random.default_rng(2)
+    wire = {
+        "q": rng.integers(-127, 128, SHAPE, dtype=np.int8),
+        "s": rng.standard_normal(SHAPE[:4]).astype(np.float32),
+    }
+    dec = _decode_block(_encode_block(5, wire), 5)
+    assert set(dec) == {"q", "s"}
+    np.testing.assert_array_equal(dec["q"], wire["q"])
+    np.testing.assert_array_equal(dec["s"], wire["s"])
+
+
+def test_decode_rejects_corruption():
+    raw = _encode_block(9, _block(3))
+    with pytest.raises(ValueError):
+        _decode_block(b"XXXX" + raw[4:], 9)  # bad magic
+    with pytest.raises(ValueError):
+        _decode_block(raw[:-3], 9)  # truncated payload
+    flipped = bytearray(raw)
+    flipped[-1] ^= 0xFF
+    with pytest.raises(ValueError):
+        _decode_block(bytes(flipped), 9)  # checksum mismatch
+    with pytest.raises(ValueError):
+        _decode_block(raw, 10)  # identity mismatch
+
+
+def test_quantize_zero_rows_clean():
+    q, s = _quantize_block(np.zeros(SHAPE, np.float32))
+    assert not q.any()
+    assert np.isfinite(s).all()
+
+
+# ---------------- capacity arithmetic ----------------
+
+
+def test_disk_budget_resolves_at_int8_page_cost():
+    """The disk sibling of resolve_host_capacity_blocks: the on-disk block
+    cost is ALWAYS the int8 wire cost, so the same byte budget holds ~2x
+    the blocks a bf16 host tier does (int8 row = hd + 4 scale bytes vs
+    2*hd bf16 bytes)."""
+    ps, heads, hd, layers = 64, 8, 128, 24
+    blk_disk = disk_block_bytes(ps, heads, hd, layers)
+    assert blk_disk == kv_page_bytes(ps, heads, hd, layers, "int8")
+    blk_bf16 = kv_page_bytes(ps, heads, hd, layers, None)
+    budget = 1 << 26
+    n_disk = resolve_disk_capacity_blocks(budget, blk_disk)
+    n_bf16 = budget // blk_bf16
+    assert n_disk == budget // blk_disk
+    assert n_disk > 1.8 * n_bf16  # ~2x at hd=128 (132 vs 256 bytes/row)
+    assert resolve_disk_capacity_blocks(0, blk_disk) == 0
+    assert resolve_disk_capacity_blocks(budget, 0) == 0
+
+
+def test_block_disk_nbytes_matches_encoded_payload():
+    x = _block(4)
+    raw = _encode_block(1, x)
+    q, s = _quantize_block(x)
+    assert _block_disk_nbytes(x) == q.nbytes + s.nbytes
+    # the header rides on top of the payload the budget accounts
+    assert len(raw) > _block_disk_nbytes(x)
+
+
+# ---------------- store: spill / restore / LRU budget ----------------
+
+
+def test_store_spill_restore_roundtrip(tmp_path):
+    store = DiskKvStore(directory=str(tmp_path), budget_bytes=1 << 20,
+                        page_axis=PAGE_AXIS)
+    try:
+        blocks = {h: _block(h) for h in (101, 102, 103)}
+        for h, b in blocks.items():
+            assert store.spill(h, b) == []  # under budget: nothing evicted
+        assert len(store) == 3 and all(h in store for h in blocks)
+        assert store.leading_run([101, 102, 103, 999]) == [101, 102, 103]
+        res = store.restore([101, 102, 103])
+        assert res.status == "hit" and res.blocks == 3 and not res.failed
+        (part,) = res.parts
+        assert (part.block_from, part.block_to) == (0, 3)
+        assert part.cat_axis == PAGE_AXIS
+        # wire-concat along the page axis, per-block values within a quant step
+        assert part.data.shape[PAGE_AXIS] == 3 * SHAPE[PAGE_AXIS]
+        for i, h in enumerate((101, 102, 103)):
+            got = np.take(part.data, [i], axis=PAGE_AXIS)
+            assert np.allclose(got, blocks[h], atol=np.abs(blocks[h]).max() / 64)
+    finally:
+        store.close()
+
+
+def test_store_lru_budget_and_discard(tmp_path):
+    one = _block_disk_nbytes(_block(0))
+    store = DiskKvStore(directory=str(tmp_path), budget_bytes=2 * one,
+                        page_axis=PAGE_AXIS, block_bytes=one)
+    try:
+        evicted = []
+        for h in range(1, 6):
+            evicted += store.spill(h, _block(h))
+        assert evicted == [1, 2, 3]  # LRU order; 4, 5 resident
+        assert len(store) == 2 and store.bytes_resident <= 2 * one
+        assert store.drops == 3 and store.spills == 5
+        store.flush()
+        assert not os.path.exists(store._path(1))
+        assert os.path.exists(store._path(5))
+        # revisit spill refreshes LRU position instead of re-writing
+        assert store.spill(4, _block(4)) == []
+        assert store.spill(6, _block(6)) == [5]  # 4 was refreshed; 5 is LRU
+        # discard (promotion back up the ladder) unlinks and frees budget
+        assert store.discard(4) and not store.discard(4)
+        store.flush()
+        assert not os.path.exists(store._path(4))
+        assert store.bytes_resident == one
+    finally:
+        store.close()
+
+
+def test_store_budget_zero_and_oversize_block():
+    store = DiskKvStore(budget_bytes=0)
+    try:
+        # no budget: the block leaves its last tier immediately
+        assert store.spill(7, _block(7)) == [7]
+        assert len(store) == 0
+    finally:
+        store.close()
+    small = DiskKvStore(budget_bytes=10)
+    try:
+        assert small.spill(8, _block(8)) == [8]  # budget can never hold it
+        assert len(small) == 0
+    finally:
+        small.close()
+
+
+def test_store_corrupt_file_restore_falls_back(tmp_path):
+    """A corrupt/truncated block file is a MISS, never a wrong answer:
+    restore stops at the first bad block (the tail recomputes) and reports
+    the bad hashes so the engine emits their one truthful removed."""
+    store = DiskKvStore(directory=str(tmp_path), budget_bytes=1 << 20,
+                        page_axis=PAGE_AXIS)
+    try:
+        for h in (201, 202, 203):
+            store.spill(h, _block(h))
+        store.flush()
+        with open(store._path(202), "r+b") as f:  # truncate the middle block
+            f.truncate(16)
+        res = store.restore([201, 202, 203])
+        assert res.status == "hit" and res.blocks == 1
+        assert res.failed == [202]
+        assert store.io_errors >= 1
+        # first block bad: the whole restore is a miss
+        with open(store._path(201), "r+b") as f:
+            f.seek(0)
+            f.write(b"JUNK")
+        res = store.restore([201, 203])
+        assert res.status == "miss" and res.failed == [201]
+    finally:
+        store.close()
+
+
+def test_restore_async_miss_is_immediate():
+    store = DiskKvStore(budget_bytes=1 << 20)
+    try:
+        fut = store.restore_async([12345])
+        assert fut.done() and fut.result().status == "miss"
+    finally:
+        store.close()
+
+
+def test_env_dir_override_and_owned_cleanup(tmp_path, monkeypatch):
+    env_dir = tmp_path / "kvdir"
+    monkeypatch.setenv("DYNTPU_KV_DISK_DIR", str(env_dir))
+    store = DiskKvStore(budget_bytes=1 << 20, page_axis=PAGE_AXIS)
+    try:
+        assert store.directory == str(env_dir)
+        store.spill(42, _block(42))
+        store.flush()
+    finally:
+        store.close()
+    # an env-provided directory is the USER'S: close never deletes it
+    assert env_dir.is_dir() and os.path.exists(os.path.join(str(env_dir), f"{42:016x}.kvb"))
+    monkeypatch.delenv("DYNTPU_KV_DISK_DIR")
+    owned = DiskKvStore(budget_bytes=1 << 20)
+    d = owned.directory
+    owned.spill(1, _block(1))
+    owned.close()
+    assert not os.path.exists(d)  # owned tempdir cleaned up
+
+
+# ---------------- config validation ----------------
+
+
+def test_disk_config_requires_host_tier():
+    from dynamo_tpu.engine.config import EngineConfig
+
+    common = dict(model_id="tiny", page_size=4, num_pages=16, max_seqs=2,
+                  max_model_len=32)
+    with pytest.raises(ValueError, match="requires a host cache tier"):
+        EngineConfig(disk_cache_bytes=1 << 20, **common)
+    with pytest.raises(ValueError):
+        EngineConfig(disk_cache_bytes=-1, host_cache_blocks=4, **common)
+    cfg = EngineConfig(disk_cache_bytes=1 << 20, host_cache_blocks=4, **common)
+    assert cfg.disk_cache_bytes == 1 << 20

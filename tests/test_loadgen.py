@@ -88,6 +88,31 @@ def test_shared_prefix_sessions():
     assert len(prefixes) == len(by_session)
 
 
+def test_parked_sessions_turns_extend_history():
+    """Multi-turn parked conversations: each turn's prompt strictly extends
+    the previous turn's (the shape that makes resumes pure prefix hits down
+    the KV tier ladder), and consecutive turns are park_s apart."""
+    spec = load_scenario("parked_sessions", num_requests=4)
+    assert spec.session_turns > 1 and spec.park_s > 0
+    trace = compile_trace(spec)
+    assert len(trace) == 4 * spec.session_turns
+    by_conv: dict = {}
+    for r in trace:
+        assert r.session
+        by_conv.setdefault(r.session, []).append(r)
+    assert len(by_conv) == 4
+    for turns in by_conv.values():
+        turns.sort(key=lambda r: r.at_s)
+        assert len(turns) == spec.session_turns
+        for a, b in zip(turns, turns[1:]):
+            assert b.token_ids[: len(a.token_ids)] == a.token_ids
+            assert len(b.token_ids) > len(a.token_ids)
+            assert b.at_s - a.at_s == pytest.approx(spec.park_s, abs=1e-5)
+    # single-turn scenarios never take the parked branch
+    single = compile_trace(load_scenario("bursty_chat", num_requests=4))
+    assert all("-t" not in r.request_id for r in single)
+
+
 def test_mm_trace_carries_image_specs():
     trace = compile_trace(load_scenario("mm_vl", num_requests=4))
     assert all(r.image is not None for r in trace)

@@ -874,3 +874,71 @@ def test_rolling_restart_replay_goodput():
             await dst.shutdown()
 
     asyncio.run(body())
+
+
+# ---------------- multimodal rejection ----------------
+
+
+def test_multimodal_sequence_migration_rejected():
+    """A VL sequence is REJECTED with a structured error instead of silently
+    migrating without its vision context: mm_embeds do not ride the manifest
+    (the destination would re-prefill the virtual token ids with no image
+    behind them and produce garbage). The rejection happens before the
+    sequence is frozen, so it keeps decoding locally to completion."""
+    import numpy as np
+
+    from dynamo_tpu.llm.multimodal import (
+        ImageInput, image_content_hash, patchify, virtual_token_ids,
+    )
+
+    def mm_req(engine, rid, img, n=96):
+        cfg = engine.model.config
+        patches, rows, cols, grid = patchify(
+            img, cfg.vision.patch_size, cfg.vision.spatial_merge_size
+        )
+        n_tok = patches.shape[0] // cfg.vision.spatial_merge_size**2
+        chash = image_content_hash(img)
+        toks = [1, 2] + virtual_token_ids(chash, n_tok, cfg.vocab_size) + [3]
+        im = ImageInput(
+            offset=2, patches=patches, rows=rows, cols=cols, grid=grid,
+            num_tokens=n_tok, content_hash=chash,
+        )
+        return EngineRequest(
+            request_id=rid, token_ids=toks,
+            sampling=SamplingParams(temperature=0.0, max_tokens=n,
+                                    ignore_eos=True),
+            images=[im],
+        )
+
+    async def body():
+        from dynamo_tpu.engine.config import EngineConfig
+        from dynamo_tpu.engine.engine import AsyncJaxEngine
+
+        cfg = EngineConfig(
+            model_id="tiny-vl", page_size=4, num_pages=128, max_seqs=4,
+            max_model_len=256, prefill_buckets=(32, 64, 128),
+        )
+        src = AsyncJaxEngine(cfg)
+        await src.start()
+        try:
+            img = np.random.default_rng(7).random((24, 16, 3)).astype(np.float32)
+            expected, _ = await _collect(src, mm_req(src, "base", img))
+
+            async def never_adopt(manifest):
+                raise AssertionError("a multimodal sequence reached adoption")
+                yield  # pragma: no cover
+
+            task = asyncio.ensure_future(_collect(src, mm_req(src, "m1", img)))
+            assert await _wait_generated(src, "m1", 6)
+            res = await src.migrate_out("m1", never_adopt)
+            assert res["status"] == "rejected"
+            assert res["reason"] == "multimodal_sequence"
+            assert "mm_embeds" in res["detail"]
+            # not frozen: the sequence finishes locally, token-identical
+            got, finish = await task
+            assert finish == "length"
+            assert got == expected
+        finally:
+            await src.shutdown()
+
+    asyncio.run(body())

@@ -83,6 +83,13 @@ DEFAULT_KEYS: tuple = (
     ("router_scale.lookup_p99_ms", "lower", 1.0),
     ("router_scale.resident_nodes", "lower", 0.10),
     ("router_scale.hot_hit_ratio", "higher", 0.05),
+    # third KV tier (r18+): disk-restore resume must keep beating the
+    # recompute arm, the resumed continuation must stay token-identical
+    # (binary — any drop is a break), and the disk-resident footprint
+    # after the standard churn must not balloon
+    ("kv_tiers.resume_ttft_ratio", "lower", DEFAULT_TOL),
+    ("kv_tiers.restore_parity", "higher", 0.001),
+    ("kv_tiers.disk_resident_bytes", "lower", DEFAULT_TOL),
     # replay goodput columns (aliased arrays; index 0 = goodput)
     ("replay.bursty.0", "higher", DEFAULT_TOL),
     ("replay.lctx.0", "higher", DEFAULT_TOL),

@@ -86,9 +86,9 @@ def render_status(doc: dict, events_rows: int = 8, events_offset: int = 0) -> st
     header = (
         f"{'WORKER':<12} {'STATE':<10} {'HB':>6} {'SEEN':>6} {'MISS':>4} "
         f"{'SLOTS':>7} {'KV%':>6} {'KVMEM':>11} {'PREFIX':>9} {'RADIX':>7} "
-        f"{'SPEC':>10} {'LORA':>11} {'GOODPUT':>9} {'MIG':>7} {'QOS':>9} "
-        f"{'EVT':>8} {'STEP':>11} {'ROOF':>5} {'WAIT':>5} {'HBM':>9} "
-        f"{'CMPL':>5}  SLO"
+        f"{'SPEC':>10} {'LORA':>11} {'TIER':>9} {'GOODPUT':>9} {'MIG':>7} "
+        f"{'QOS':>9} {'EVT':>8} {'STEP':>11} {'ROOF':>5} {'WAIT':>5} "
+        f"{'HBM':>9} {'CMPL':>5}  SLO"
     )
     # router radix-index health (router broadcast via /cluster/status):
     # per-worker indexed-block counts feed the RADIX column; the fleet
@@ -146,6 +146,18 @@ def render_status(doc: dict, events_rows: int = 8, events_offset: int = 0) -> st
                 lora = f"{lora} {hot}"
         else:
             lora = "-"
+        # KV tier ladder below HBM (engine/offload.py + engine/kv_store.py
+        # via resource_snapshot): host-resident and disk-resident block
+        # counts, with disk restore fallbacks flagged; workers without an
+        # offload tier (or predating the plane) show "-"
+        if res.get("offload_capacity_blocks"):
+            tier = f"{res.get('offload_blocks_resident', 0)}h"
+            if res.get("disk_budget_bytes") is not None:
+                tier = f"{tier}/{res.get('disk_blocks_resident', 0)}d"
+                if res.get("disk_io_errors"):
+                    tier = f"{tier}!{res['disk_io_errors']}"
+        else:
+            tier = "-"
         # goodput: windowed fraction of finished requests meeting their
         # TTFT/ITL-p99 budgets (utils/goodput.py via worker stats); workers
         # with an empty window (or predating the plane) show "-"
@@ -232,7 +244,7 @@ def render_status(doc: dict, events_rows: int = 8, events_offset: int = 0) -> st
             f"{w.get('last_seen_s', 0):>5.1f}s {w.get('missed_scrapes', 0):>4} "
             f"{slots:>7} {kv_pct:>5.1f}% {kv_mem:>11} {prefix:>9} "
             f"{radix_cell:>7} {spec:>10} "
-            f"{lora:>11} {goodput:>9} {mig:>7} {qos:>9} {evt:>8} {step:>11} "
+            f"{lora:>11} {tier:>9} {goodput:>9} {mig:>7} {qos:>9} {evt:>8} {step:>11} "
             f"{roof:>5} {kv.get('num_requests_waiting', 0):>5} "
             f"{_fmt_bytes(res.get('hbm_bytes_in_use', 0)):>9} "
             f"{res.get('xla_compiles', 0):>5}  {_slo_cell(w.get('slo'))}"
