@@ -52,6 +52,9 @@ async def _main(args) -> None:
             kv_stream_lanes=args.kv_stream_lanes,
             slo_ttft_ms=args.slo_ttft_ms,
             slo_itl_ms=args.slo_itl_ms,
+            prefill_pipeline_depth=getattr(
+                args, "prefill_pipeline_depth", None
+            ) or EngineConfig.prefill_pipeline_depth,
         )
     )
     await engine.start()
@@ -119,6 +122,11 @@ def main(argv=None) -> None:
                    help="KV cache storage dtype: int8 halves attention HBM "
                         "traffic, page capacity, and disagg wire bytes "
                         "(per-page scales ride the part headers)")
+    p.add_argument("--prefill-pipeline-depth", type=int, default=None,
+                   help="packed prefill calls dispatched ahead of result "
+                        "materialization (1 = strict reconcile per call; "
+                        "default 2 — a dedicated prefill worker is exactly "
+                        "the burst regime dispatch-ahead pays off in)")
     p.add_argument("--kv-stream-lanes", type=int, default=2,
                    help="parallel KV data-plane connections per decode worker "
                         "(chunk-streamed parts stripe across lanes)")

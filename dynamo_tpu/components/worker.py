@@ -479,6 +479,9 @@ async def _main(args) -> None:
                 int(b) for b in getattr(args, "prefill_buckets", "").split(",") if b
             ) or EngineConfig.prefill_buckets,
             prefill_flat_depth=getattr(args, "prefill_flat_depth", None) or 8192,
+            prefill_pipeline_depth=getattr(
+                args, "prefill_pipeline_depth", None
+            ) or EngineConfig.prefill_pipeline_depth,
             host_cache_blocks=getattr(args, "host_cache_blocks", None) or 0,
             host_cache_bytes=getattr(args, "host_cache_bytes", None) or 0,
             disk_cache_bytes=getattr(args, "disk_cache_bytes", None) or 0,
@@ -590,6 +593,11 @@ def main(argv=None) -> None:
                    help="context depth past which the scheduler shrinks "
                         "prefill chunks to keep per-chunk latency flat "
                         "(0 disables)")
+    p.add_argument("--prefill-pipeline-depth", type=int, default=None,
+                   help="packed prefill calls dispatched ahead of result "
+                        "materialization (1 = strict reconcile per call; "
+                        "default 2 overlaps call N+1's host prep with call "
+                        "N's device time — see tools/profile_prefill.py)")
     p.add_argument("--host-cache-blocks", type=int, default=0,
                    help="host-DRAM KV offload tier capacity in blocks "
                         "(0 disables; long-context cold KV drains here "

@@ -196,6 +196,13 @@ class EngineConfig:
     # count per bucket is row-budgeted by lanes_for() (see its r5-measured
     # ~1024-row rationale). 1 = disabled (per-request prefill).
     prefill_lanes: int = 4
+    # packed prefill calls dispatched ahead of result materialization (the
+    # prefill analogue of pipeline_depth): call N+1's host prep + dispatch
+    # overlap call N's device time, so the per-call fixed cost
+    # (tools/profile_prefill.py) stops serializing with the kernel. 1 =
+    # strict reconcile-before-next-dispatch — the old behavior in the mixed
+    # decode+prefill regime, and the bench prefill_anatomy baseline arm.
+    prefill_pipeline_depth: int = 2
     # admission fairness: at most this many (packed) prefill calls dispatch
     # per scheduler step before decode windows get the chip again. A request
     # burst otherwise serializes ALL its prefill passes ahead of any decode
@@ -243,6 +250,11 @@ class EngineConfig:
         if self.kv_stream_lanes < 1:
             raise ValueError(
                 f"kv_stream_lanes must be >= 1; got {self.kv_stream_lanes}"
+            )
+        if self.prefill_pipeline_depth < 1:
+            raise ValueError(
+                f"prefill_pipeline_depth must be >= 1; "
+                f"got {self.prefill_pipeline_depth}"
             )
         if self.kv_cache_dtype is not None:
             from dynamo_tpu.quant import KV_CACHE_DTYPES
@@ -356,16 +368,25 @@ class EngineConfig:
     def max_prefill_chunk(self) -> int:
         return max(self.prefill_buckets)
 
-    def chunk_len_for(self, depth: int) -> int:
+    def chunk_len_for(self, depth: int, backlog_rows: int = 0) -> int:
         """Depth-aware prefill chunk bucket for a chunk starting at context
         ``depth`` tokens: the largest bucket b with b * (depth + b) within
         the flat-depth work budget, floored at the smallest bucket — so
         per-chunk latency stays roughly flat as prefill advances into a long
-        prompt instead of growing linearly with context."""
+        prompt instead of growing linearly with context.
+
+        ``backlog_rows`` (total un-prefilled rows pending across sequences)
+        promotes the bucket under a deep backlog by doubling the work
+        budget: every dispatch pays the same fixed per-call cost, so when
+        far more work is queued than one flat-latency chunk, fewer, larger
+        dispatches win — the chunk-latency flatness the shrink buys is moot
+        while the backlog itself dominates any single stream's TTFT."""
         top = self.max_prefill_chunk
         if self.prefill_flat_depth <= 0:
             return top
         budget = top * max(self.prefill_flat_depth, top)
+        if backlog_rows >= 2 * top:
+            budget *= 2
         best = min(self.prefill_buckets)
         for b in self.prefill_buckets:
             if b * (depth + b) <= budget:

@@ -521,16 +521,16 @@ class ModelRunner:
             slot_state = dict(slot_state, counts=counts, seen=seen)
         return toks, lp, kv, slot_state
 
-    def prefill_chunk_batch(
+    def pack_prefill_lanes(
         self,
         lanes: list,  # [(tokens np[int32], start_pos, page_table, slot_or_-1, sampling, eos_ids, is_final[, lora_slot])]
         N: int,  # lane count the executable is compiled for (>= len(lanes))
-        want_logprobs: bool = False,
     ):
-        """Dispatch ONE packed prefill covering chunks of up to N distinct
-        sequences (pad lanes are all-invalid). Returns the [N] device token
-        array (async copy started) — callers read only final-chunk lanes —
-        plus the logprob arrays when requested."""
+        """Host-prep half of :meth:`prefill_chunk_batch`: build the packed
+        int/float control arrays on the host (no device work). Split out so
+        ``tools/profile_prefill.py`` can time host prep, H2D staging, and
+        dispatch against the SAME arrays production dispatches — returns
+        (ints, flts, want_extras, mp)."""
         V = self.model.config.vocab_size
         bucket = self.config.bucket_for(max(len(l[0]) for l in lanes))
         # table width for THIS call: the widest lane's ladder bucket (narrow
@@ -585,6 +585,20 @@ class ModelRunner:
         for j in range(len(lanes), N):
             ints[j, bucket : bucket + mp + 6] = 0
             ints[j, bucket + mp + 3] = self.config.max_seqs
+        return ints, flts, want_extras, mp
+
+    def prefill_chunk_batch(
+        self,
+        lanes: list,
+        N: int,
+        want_logprobs: bool = False,
+    ):
+        """Dispatch ONE packed prefill covering chunks of up to N distinct
+        sequences (pad lanes are all-invalid; see :meth:`pack_prefill_lanes`
+        for the lane tuple contract). Returns the [N] device token array
+        (async copy started) — callers read only final-chunk lanes — plus
+        the logprob arrays when requested."""
+        ints, flts, want_extras, mp = self.pack_prefill_lanes(lanes, N)
         if want_extras:
             self._ensure_penalty_state()
         toks, lp, self.kv_cache, self.slot_state = self._prefill_packed(

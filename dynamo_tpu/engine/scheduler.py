@@ -267,6 +267,10 @@ class StageStats:
     decode_steps: int = 0
     reconcile_wait_s: float = 0.0  # host blocked on device results
     reconcile_waits: int = 0
+    # blocking reconciles forced by the prefill pipeline gate: with
+    # prefill_pipeline_depth=1 every packed call stalls here before the next
+    # dispatches; dispatch-ahead exists to shrink this count
+    prefill_stalls: int = 0
     ttft_s: float = 0.0  # submission -> first materialized token
     ttft_n: int = 0
     # speculative decoding (spec rounds are synchronous verify passes, so
@@ -298,6 +302,7 @@ class StageStats:
             "decode_steps": self.decode_steps,
             "reconcile_wait_s": round(self.reconcile_wait_s, 4),
             "reconcile_waits": self.reconcile_waits,
+            "prefill_stalls": self.prefill_stalls,
             "ttft_s": round(self.ttft_s, 4),
             "ttft_n": self.ttft_n,
         }
@@ -545,6 +550,11 @@ class Scheduler:
 
     def _windows_in_flight(self) -> int:
         return sum(1 for e in self.in_flight if e.kind == "window")
+
+    def _prefills_in_flight(self) -> int:
+        return sum(
+            1 for e in self.in_flight if e.kind in ("first", "first_batch")
+        )
 
     def _drain_cold_to_host(self) -> None:
         """Pressure-driven host offload: once page-pool occupancy crosses
@@ -1275,9 +1285,18 @@ class Scheduler:
         calls per invocation when decode work is running, so a burst of new
         prompts cannot serialize all its weight passes ahead of the decode
         windows that running streams' ITL depends on (step() alternates back
-        here after the windows dispatch)."""
+        here after the windows dispatch).
+
+        Dispatch-ahead (``config.prefill_pipeline_depth``): every packed
+        call leaves an in-flight entry, and up to depth calls ride
+        unreconciled so call N+1's host prep + dispatch overlap call N's
+        device time — the same pipelining decode windows get from
+        ``pipeline_depth``. depth=1 block-reconciles each call before the
+        next dispatches (the old mixed-regime behavior; every such forced
+        wait counts in ``stage.prefill_stalls``)."""
         count = 0
         cap = self.config.prefill_batches_per_step
+        depth = max(1, self.config.prefill_pipeline_depth)
         decode_running = any(
             s is not None and not s.finished and s.prefill_pos is None
             for s in self.slots
@@ -1285,6 +1304,17 @@ class Scheduler:
         while True:
             if cap and decode_running and count >= cap:
                 return count
+            # prefill pipeline gate: never hold more than depth prefill
+            # dispatches unreconciled. depth>=2 first drains entries whose
+            # results already landed (no stall); depth=1 skips the readiness
+            # poll — its contract is a strict reconcile between calls.
+            while self._prefills_in_flight() >= depth:
+                if depth > 1:
+                    outputs.extend(self._reconcile(block=False))
+                    if self._prefills_in_flight() < depth:
+                        break
+                self.stage.prefill_stalls += 1
+                outputs.extend(self._reconcile(block=True))
             t_prep = time.monotonic()
             pending = sorted(
                 (s for s in self.slots
@@ -1299,11 +1329,16 @@ class Scheduler:
             # bucket's row budget — one long head chunk goes alone, short
             # chunks pack together. Each lane's chunk length is depth-aware:
             # chunk_len_for shrinks it as that sequence's prefill advances
-            # into a long prompt, keeping per-chunk latency roughly flat.
+            # into a long prompt, keeping per-chunk latency roughly flat —
+            # and backlog-aware: a deep pending queue promotes the bucket so
+            # the burst takes fewer, larger dispatches.
+            backlog_rows = sum(s.prompt_len - s.prefill_pos for s in pending)
             chunks = []
             bucket = 0
             for s in pending:
-                limit = self.config.chunk_len_for(s.prefill_pos)
+                limit = self.config.chunk_len_for(
+                    s.prefill_pos, backlog_rows=backlog_rows
+                )
                 end = min(s.prefill_pos + limit, s.prompt_len)
                 cand = self.config.bucket_for(max(bucket, end - s.prefill_pos))
                 if chunks and len(chunks) + 1 > self.config.lanes_for(cand):
@@ -1365,6 +1400,7 @@ class Scheduler:
             self.stage_hist["prefill"].observe(dt)
             self.anatomy.add_phase(rec, "dispatch", dt)
             self.anatomy.note_steps(rec, tokens=rows, participants=len(chunks))
+            self.anatomy.note_prefill_floor(rec, rows)
             if tracing.enabled():
                 tracing.record_span(
                     "engine.prefill", t0, duration=dt,
@@ -1382,12 +1418,15 @@ class Scheduler:
                 else:
                     seq.prefill_pos = end
             toks_dev, lp = result if want_lp else (result, None)
-            if finals:
-                self.in_flight.append(_InFlight(
-                    kind="first_batch", dev=toks_dev, lp=lp,
-                    seqs=[(seq, j, seq.cached_len) for seq, j in finals],
-                    rec=rec,
-                ))
+            # EVERY pack (not just final-bearing ones) rides the in-flight
+            # queue: the pipeline gate above counts it, and its reconcile
+            # attributes the pack's device_wait to the dispatch that caused
+            # it — a non-final pack just has no tokens to emit (empty seqs)
+            self.in_flight.append(_InFlight(
+                kind="first_batch", dev=toks_dev, lp=lp,
+                seqs=[(seq, j, seq.cached_len) for seq, j in finals],
+                rec=rec,
+            ))
             count += 1
 
     def _prep_prefill(
@@ -1520,6 +1559,7 @@ class Scheduler:
         # per chunk, so device wait folds into the same phase here)
         self.anatomy.add_phase(rec, "dispatch", dt - rec.host_prep_s)
         self.anatomy.note_steps(rec, tokens=rows, participants=1)
+        self.anatomy.note_prefill_floor(rec, rows)
         tracing.record_span(
             "engine.prefill", t0, duration=dt,
             request_id=req.request_id, trace_id=req.trace_id,

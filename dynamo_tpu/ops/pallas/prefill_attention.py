@@ -242,6 +242,69 @@ def _kernel(
     ).astype(out_ref.dtype)
 
 
+def _kernel_dmaonly(
+    *refs,
+    page_size: int,
+    max_pages: int,
+    tile_pages: int,
+    block_q: int,
+    quantized: bool,
+):
+    """Null-hypothesis prefill kernel: ``_kernel``'s exact grid, causal tile
+    bound, and double-buffered context-tile DMA stream with NO attention
+    math — the decode ``dmaonly`` methodology (tools/profile_attn.py, r5)
+    ported to the prefill grid. Its wall time is the irreducible per-chunk
+    HBM context traffic; the gap to the real kernel is compute not hidden
+    under DMA. Computes garbage by design — timing only."""
+    if quantized:
+        (page_table_ref, positions_ref, q_ref, k_hbm, v_hbm, ks_hbm, vs_hbm,
+         out_ref, k_scratch, v_scratch, ks_scratch, vs_scratch, sems) = refs
+        pairs = [(k_hbm, k_scratch), (v_hbm, v_scratch),
+                 (ks_hbm, ks_scratch), (vs_hbm, vs_scratch)]
+    else:
+        (page_table_ref, positions_ref, q_ref, k_hbm, v_hbm,
+         out_ref, k_scratch, v_scratch, sems) = refs
+        pairs = [(k_hbm, k_scratch), (v_hbm, v_scratch)]
+
+    qb = pl.program_id(0)
+    Bq = q_ref.shape[0]
+    TP = tile_pages
+    S = TP * page_size
+
+    q_start = qb * block_q
+    last_pos = positions_ref[q_start + Bq - 1]
+    n_tiles = jnp.minimum(
+        pl.cdiv(last_pos + 1, S), pl.cdiv(jnp.int32(max_pages * page_size), S)
+    )
+
+    start, wait = _tile_dma_helpers(page_table_ref, pairs, sems, TP, max_pages)
+    start(0, 0)
+
+    def body(t, acc):
+        buf = jax.lax.rem(t, 2)
+
+        @pl.when(t + 1 < n_tiles)
+        def _():
+            start(jax.lax.rem(t + 1, 2), t + 1)
+
+        wait(buf, t)
+        # consume one row per tile so the waits can't be elided; no matmuls,
+        # no softmax, no casts, no relayouts
+        return (
+            acc
+            + k_scratch[buf, 0, 0].astype(jnp.float32)
+            + v_scratch[buf, 0, 0].astype(jnp.float32)
+        )
+
+    Hkv, D = k_scratch.shape[3], k_scratch.shape[4]
+    acc = jax.lax.fori_loop(
+        0, n_tiles, body, jnp.zeros((Hkv, D), jnp.float32)
+    )
+    out_ref[...] = jnp.broadcast_to(
+        acc[:1] * 1e-6, out_ref.shape
+    ).astype(out_ref.dtype)
+
+
 def _kernel_lookahead(
     *refs,
     page_size: int,
@@ -723,6 +786,69 @@ def paged_prefill_attention_pallas(
         grid_spec=grid_spec,
         interpret=interpret,
         **kwargs,
+    )
+    args = (kq, vq, ks, vs) if quantized else (kq, vq)
+    return kernel(
+        page_table.astype(jnp.int32), positions.astype(jnp.int32), q, *args
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "block_q"))
+def paged_prefill_dmaonly(
+    q: jnp.ndarray,
+    k_pages,
+    v_pages,
+    page_table: jnp.ndarray,
+    positions: jnp.ndarray,
+    block_q: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Null-hypothesis A/B partner of ``paged_prefill_attention_pallas``
+    (basic variant): same grid geometry and DMA stream, no attention math.
+    ``tools/profile_prefill.py`` differences this against the real kernel to
+    split a prefill call's cost into DMA floor vs exposed compute. Output is
+    garbage by design — never dispatch it for serving."""
+    T, Hq, D = q.shape
+    kq, vq, ks, vs, quantized = _unpack_pools(k_pages, v_pages)
+    P, ps, Hkv, _ = kq.shape
+    max_pages = page_table.shape[0]
+    assert T % block_q == 0, f"chunk {T} % block_q {block_q}"
+    tile_pages = max(1, 128 // ps)
+
+    scratch_shapes = [
+        pltpu.VMEM((2, tile_pages, ps, Hkv, D), kq.dtype),
+        pltpu.VMEM((2, tile_pages, ps, Hkv, D), vq.dtype),
+    ]
+    if quantized:
+        scratch_shapes += [
+            pltpu.VMEM((2, tile_pages, 1, ps), jnp.float32),
+            pltpu.VMEM((2, tile_pages, 1, ps), jnp.float32),
+        ]
+    scratch_shapes.append(
+        pltpu.SemaphoreType.DMA((2, 4 if quantized else 2, tile_pages))
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(T // block_q,),
+        in_specs=[
+            pl.BlockSpec((block_q, Hq, D), lambda qb, *_: (qb, 0, 0)),
+            *_pool_in_specs(quantized),
+        ],
+        out_specs=pl.BlockSpec((block_q, Hq, D), lambda qb, *_: (qb, 0, 0)),
+        scratch_shapes=scratch_shapes,
+    )
+    kernel = pl.pallas_call(
+        functools.partial(
+            _kernel_dmaonly,
+            page_size=ps,
+            max_pages=max_pages,
+            tile_pages=tile_pages,
+            block_q=block_q,
+            quantized=quantized,
+        ),
+        out_shape=jax.ShapeDtypeStruct((T, Hq, D), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
     )
     args = (kq, vq, ks, vs) if quantized else (kq, vq)
     return kernel(

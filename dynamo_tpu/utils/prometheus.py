@@ -53,6 +53,7 @@ DECLARED_METRIC_FAMILIES: tuple = (
     "dynamo_engine_offload_bytes_resident",
     "dynamo_engine_offload_pressure_blocks_total",
     "dynamo_engine_preemptions_total",
+    "dynamo_engine_prefill_roofline_fraction",
     "dynamo_engine_prefill_seconds",
     "dynamo_engine_prefix_cache_blocks_total",
     "dynamo_engine_pressure_drains_total",
@@ -555,7 +556,8 @@ def _sample_surfaces() -> list[tuple[str, str]]:
 
     anat = eng.scheduler.anatomy
     anat.roofline = RooflineModel(
-        param_bytes=2_600_000_000, page_bytes=4096, page_size=4
+        param_bytes=2_600_000_000, page_bytes=4096, page_size=4,
+        param_count=1_300_000_000,
     )
     rec = anat.begin("decode_window")
     anat.add_phase(rec, "host_prep", 0.0004)
@@ -565,6 +567,13 @@ def _sample_surfaces() -> list[tuple[str, str]]:
     anat.note_steps(rec, steps=4, tokens=8, participants=2,
                     floor_bytes=anat.decode_floor_bytes(64, 4))
     anat.record("lora_slot_load", dispatch_s=0.0031)
+    # one priced prefill dispatch: dynamo_engine_prefill_roofline_fraction
+    # renders only once note_prefill_floor has priced a packed call
+    prec = anat.begin("prefill_packed")
+    anat.add_phase(prec, "host_prep", 0.0006)
+    anat.add_phase(prec, "dispatch", 0.0102)
+    anat.note_steps(prec, tokens=256, participants=2)
+    anat.note_prefill_floor(prec, 256)
     # the engine-scoped goodput families (dynamo_engine_goodput_*) need a
     # sample outcome to render their gauges
     eng.goodput.observe(RequestOutcome(

@@ -36,13 +36,26 @@ bandwidth constant is fiction, but the *bytes* are exact and the fraction
 still moves with the same code changes, so CPU smoke runs record it labeled
 with the platform.
 
+Prefill gets the same treatment (PR 19): a prefill dispatch is
+compute-bound once the chunk is wide enough, so its floor is
+``max(FLOP bound, bytes bound)`` — ~2·param_count FLOPs per prompt row
+against the MXU peak (``DYNTPU_MXU_TFLOPS``, default v5e's 197 bf16), vs
+one weight read plus the KV the chunk writes against HBM bandwidth. Each
+``prefill_packed``/``prefill_chunk`` record prices its floor at dispatch
+(``note_prefill_floor``); ``prefill_roofline_fraction`` = summed floors /
+measured prefill engine seconds (``dynamo_engine_prefill_roofline_fraction``)
+and ``prefill_fixed_ms`` is the live per-dispatch host cost — the quantity
+``tools/profile_prefill.py`` decomposes into host-prep / H2D / dispatch /
+kernel on hardware.
+
 Exposed everywhere the repo already has rails: ``render_metrics`` emits
 ``dynamo_step_seconds_total{phase,kind}`` / ``dynamo_step_dispatch_total
 {kind}`` / ``dynamo_engine_roofline_fraction`` on the engine's conformance
 surface, ``snapshot()`` rides ``resource_snapshot`` -> worker stats ->
-dynotop STEP/ROOF columns, ``records()`` backs the ``/debug/steps`` JSON
-endpoint, and the bench ``step_anatomy`` section prices
-``host_frac``/``roofline_frac``/``dispatch_gap_ms_p50`` per arm.
+dynotop STEP/ROOF/PREFILL columns, ``records()`` backs the ``/debug/steps``
+JSON endpoint, and the bench ``step_anatomy``/``prefill_anatomy`` sections
+price ``host_frac``/``roofline_frac``/``dispatch_gap_ms_p50`` and the
+prefill dispatch economics per arm.
 """
 
 from __future__ import annotations
@@ -68,6 +81,11 @@ KINDS = (
 
 PHASES = ("host_prep", "dispatch", "device_wait", "reconcile")
 
+#: the prefill-regime dispatch kinds (the packed serving path and the
+#: per-request chain) — the label set prefill_roofline_fraction and the
+#: dynotop PREFILL column aggregate over
+PREFILL_KINDS = ("prefill_packed", "prefill_chunk")
+
 #: default ring capacity: at ms-scale steps this is a few seconds of recent
 #: history — enough for dynotop/debug inspection without unbounded growth
 DEFAULT_RING = 512
@@ -75,12 +93,26 @@ DEFAULT_RING = 512
 #: v5e HBM bandwidth; override with DYNTPU_HBM_GBPS for other parts
 DEFAULT_HBM_GBPS = 819.0
 
+#: v5e bf16 MXU peak; override with DYNTPU_MXU_TFLOPS for other parts (the
+#: FLOP-bound side of the prefill floor — decode never touches it because a
+#: single-token step is bytes-bound by orders of magnitude)
+DEFAULT_MXU_TFLOPS = 197.0
+
 
 def hbm_bandwidth_bytes_s() -> float:
     try:
         return float(os.environ.get("DYNTPU_HBM_GBPS", DEFAULT_HBM_GBPS)) * 1e9
     except ValueError:
         return DEFAULT_HBM_GBPS * 1e9
+
+
+def mxu_flops_s() -> float:
+    try:
+        return float(
+            os.environ.get("DYNTPU_MXU_TFLOPS", DEFAULT_MXU_TFLOPS)
+        ) * 1e12
+    except ValueError:
+        return DEFAULT_MXU_TFLOPS * 1e12
 
 
 @dataclass
@@ -99,6 +131,10 @@ class RooflineModel:
     page_bytes: int
     page_size: int
     hbm_bw: float = field(default_factory=hbm_bandwidth_bytes_s)
+    # parameter COUNT (not bytes): the FLOP side of the prefill floor is
+    # ~2 FLOPs per parameter per row regardless of storage dtype
+    param_count: int = 0
+    mxu_flops: float = field(default_factory=mxu_flops_s)
 
     def step_floor_bytes(self, live_pages: int) -> int:
         """Bytes one decode step must move: weights + the live KV pages the
@@ -108,12 +144,33 @@ class RooflineModel:
     def step_floor_seconds(self, live_pages: int) -> float:
         return self.step_floor_bytes(live_pages) / max(1.0, self.hbm_bw)
 
+    def prefill_floor_bytes(self, rows: int) -> int:
+        """Bytes one prefill dispatch must move: one weight read plus the KV
+        pages the chunk's rows fill (attention re-reads of the context ride
+        on-chip for the chunk widths the engine uses, so they are not priced
+        — the floor stays a floor)."""
+        pages = -(-max(0, rows) // max(1, self.page_size))
+        return self.param_bytes + pages * self.page_bytes
+
+    def prefill_floor_seconds(self, rows: int) -> float:
+        """max(MXU-FLOP bound, bytes-moved bound) for a dispatch computing
+        ``rows`` prompt rows: a dense forward pass is ~2·param_count FLOPs
+        per row, so wide chunks are compute-bound and narrow ones fall back
+        to the same weight-read floor decode pays."""
+        bytes_s = self.prefill_floor_bytes(rows) / max(1.0, self.hbm_bw)
+        flops_s = (
+            2.0 * self.param_count * max(0, rows) / max(1.0, self.mxu_flops)
+        )
+        return max(bytes_s, flops_s)
+
     def to_dict(self) -> dict:
         return {
             "param_bytes": self.param_bytes,
             "page_bytes": self.page_bytes,
             "page_size": self.page_size,
             "hbm_bw_bytes_s": self.hbm_bw,
+            "param_count": self.param_count,
+            "mxu_flops_s": self.mxu_flops,
         }
 
 
@@ -135,6 +192,9 @@ def roofline_for_runner(runner, config) -> Optional[RooflineModel]:
             for leaf in leaves
             if hasattr(leaf, "size") and hasattr(leaf, "dtype")
         ))
+        param_count = int(sum(
+            leaf.size for leaf in leaves if hasattr(leaf, "size")
+        ))
         page_bytes = int(model.kv_page_bytes(config.page_size))
     except Exception:
         return None
@@ -142,7 +202,7 @@ def roofline_for_runner(runner, config) -> Optional[RooflineModel]:
         return None
     return RooflineModel(
         param_bytes=param_bytes, page_bytes=page_bytes,
-        page_size=config.page_size,
+        page_size=config.page_size, param_count=param_count,
     )
 
 
@@ -163,6 +223,7 @@ class StepRecord:
     tokens: int = 0  # tokens scheduled (decode) or rows computed (prefill)
     participants: int = 0
     floor_bytes: int = 0  # bytes-moved floor estimate (decode kinds only)
+    floor_s: float = 0.0  # max(FLOP, bytes) floor seconds (prefill kinds only)
 
     @property
     def total_s(self) -> float:
@@ -187,6 +248,7 @@ class StepRecord:
             "tokens": self.tokens,
             "participants": self.participants,
             "floor_bytes": self.floor_bytes,
+            "floor_ms": round(self.floor_s * 1e3, 4),
         }
 
 
@@ -210,6 +272,11 @@ class StepAnatomy:
         self.steps_total: dict[str, int] = {}
         self.floor_bytes_total = 0  # cumulative priced floors
         self._floor_kinds: set[str] = set()  # kinds that recorded a floor
+        # prefill plane: floors are SECONDS (max of FLOP and bytes bounds,
+        # which don't share a unit) and accumulate separately so they can
+        # never pollute the decode-regime roofline_fraction above
+        self.prefill_floor_s_total = 0.0
+        self._prefill_floor_kinds: set[str] = set()
         self.roofline = roofline
 
     # ---------------- recording (engine thread) ----------------
@@ -274,6 +341,17 @@ class StepAnatomy:
             return 0
         return self.roofline.step_floor_bytes(live_pages) * max(1, steps)
 
+    def note_prefill_floor(self, rec: Optional[StepRecord], rows: int) -> None:
+        """Price one prefill dispatch's max(FLOP, bytes) floor live at the
+        dispatch site (no-op without a roofline model or rows)."""
+        if self.roofline is None or rec is None or rows <= 0:
+            return
+        floor_s = self.roofline.prefill_floor_seconds(rows)
+        with self._lock:
+            rec.floor_s += floor_s
+            self.prefill_floor_s_total += floor_s
+            self._prefill_floor_kinds.add(rec.kind)
+
     # ---------------- derived views (any thread) ----------------
 
     def _ring_snapshot(self) -> list[StepRecord]:
@@ -314,6 +392,39 @@ class StepAnatomy:
         if floor_bytes <= 0 or measured <= 0:
             return None
         return (floor_bytes / self.roofline.hbm_bw) / measured
+
+    def prefill_roofline_fraction(self) -> Optional[float]:
+        """Summed per-dispatch prefill floors over measured prefill engine
+        seconds — how close the prefill regime runs to max(MXU, HBM). The gap
+        (1 - fraction) is per-dispatch fixed cost plus padding, the quantity
+        the dispatch-ahead pipeline and bucket promotion attack. None until a
+        priced prefill dispatch completes."""
+        if self.roofline is None:
+            return None
+        with self._lock:
+            floor_s = self.prefill_floor_s_total
+            measured = sum(
+                s for (phase, kind), s in self.phase_seconds.items()
+                if kind in self._prefill_floor_kinds
+            )
+        if floor_s <= 0 or measured <= 0:
+            return None
+        return floor_s / measured
+
+    def prefill_fixed_ms(self) -> Optional[float]:
+        """Mean host-side (host_prep + dispatch) milliseconds per prefill
+        dispatch — the live proxy for the per-call fixed cost
+        ``tools/profile_prefill.py`` decomposes offline. None before any
+        prefill dispatch."""
+        with self._lock:
+            host = sum(
+                s for (phase, kind), s in self.phase_seconds.items()
+                if kind in PREFILL_KINDS and phase in ("host_prep", "dispatch")
+            )
+            n = sum(self.dispatch_counts.get(k, 0) for k in PREFILL_KINDS)
+        if n <= 0:
+            return None
+        return host / n * 1e3
 
     def dispatch_gap_ms(self, kind: str = "decode_window",
                         q: float = 0.5) -> Optional[float]:
@@ -357,6 +468,13 @@ class StepAnatomy:
                 self.host_fraction(kinds=("decode_window",))
             ),
             "roofline_frac": _round_opt(self.roofline_fraction()),
+            "prefill_host_frac": _round_opt(
+                self.host_fraction(kinds=PREFILL_KINDS)
+            ),
+            "prefill_roofline_frac": _round_opt(
+                self.prefill_roofline_fraction()
+            ),
+            "prefill_fixed_ms": _round_opt(self.prefill_fixed_ms(), 3),
             "dispatch_gap_ms_p50": round(gap, 3) if gap is not None else None,
             "floor_bytes_total": floor_bytes,
             "records": len(self.ring),
@@ -397,6 +515,15 @@ class StepAnatomy:
                 "seconds (1.0 = running at the roofline; the r5 69.8% "
                 "decomposition as a standing gauge)",
                 [({}, round(frac, 4))],
+            ))
+        pfrac = self.prefill_roofline_fraction()
+        if pfrac is not None:
+            parts.append(render_family(
+                "dynamo_engine_prefill_roofline_fraction", "gauge",
+                "summed max(MXU-FLOP, HBM-bytes) prefill dispatch floors "
+                "over measured prefill engine seconds (1.0 = every dispatch "
+                "at the hardware bound; the gap is fixed per-call cost)",
+                [({}, round(pfrac, 4))],
             ))
         host = self.host_fraction()
         if host is not None:

@@ -215,7 +215,7 @@ def test_debug_steps_payload_shape():
         assert set(r) == {
             "seq", "ts", "kind", "host_prep_ms", "dispatch_ms",
             "device_wait_ms", "reconcile_ms", "steps", "tokens",
-            "participants", "floor_bytes",
+            "participants", "floor_bytes", "floor_ms",
         }
     # kind filter reaches through
     only = eng.debug_steps(kind="prefill_packed")
@@ -306,18 +306,22 @@ def test_dynotop_step_roof_columns():
             "resources": {"step_anatomy": {
                 "host_frac": 0.312, "roofline_frac": 0.698,
                 "dispatch_gap_ms_p50": 2.484,
+                "prefill_host_frac": 0.974, "prefill_fixed_ms": 10.23,
+                "prefill_roofline_frac": 0.63,
             }},
             "last_seen_s": 0.2, "missed_scrapes": 0,
         }],
     }
     text = dynotop.render_status(doc)
-    assert "STEP" in text and "ROOF" in text
+    assert "STEP" in text and "ROOF" in text and "PREFILL" in text
     assert "h31% 2.5ms" in text
     assert "70%" in text
+    assert "h97% 10.2ms 63%" in text
     # workers predating the plane render "-" without crashing
     doc["workers"][0]["resources"] = {}
     text = dynotop.render_status(doc)
     assert "h31%" not in text and "70%" not in text
+    assert "h97%" not in text
 
 
 # ---------------- scheduler integration (tiny engine e2e) ----------------

@@ -90,6 +90,13 @@ DEFAULT_KEYS: tuple = (
     ("kv_tiers.resume_ttft_ratio", "lower", DEFAULT_TOL),
     ("kv_tiers.restore_parity", "higher", 0.001),
     ("kv_tiers.disk_resident_bytes", "lower", DEFAULT_TOL),
+    # prefill anatomy (r19+): the pipelined arm's per-call fixed cost and
+    # TTFT must not creep back up, and the dispatch count must not balloon
+    # (fewer, larger packed calls is the whole attack). Generous
+    # tolerances — all three are timer-noise-prone on CPU-smoke machines
+    ("prefill_anatomy.fixed_ms", "lower", 1.0),
+    ("prefill_anatomy.dispatches", "lower", 0.5),
+    ("prefill_anatomy.ttft_p50_ms", "lower", 1.0),
     # replay goodput columns (aliased arrays; index 0 = goodput)
     ("replay.bursty.0", "higher", DEFAULT_TOL),
     ("replay.lctx.0", "higher", DEFAULT_TOL),

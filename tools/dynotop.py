@@ -87,7 +87,7 @@ def render_status(doc: dict, events_rows: int = 8, events_offset: int = 0) -> st
         f"{'WORKER':<12} {'STATE':<10} {'HB':>6} {'SEEN':>6} {'MISS':>4} "
         f"{'SLOTS':>7} {'KV%':>6} {'KVMEM':>11} {'PREFIX':>9} {'RADIX':>7} "
         f"{'SPEC':>10} {'LORA':>11} {'TIER':>9} {'GOODPUT':>9} {'MIG':>7} "
-        f"{'QOS':>9} {'EVT':>8} {'STEP':>11} {'ROOF':>5} {'WAIT':>5} "
+        f"{'QOS':>9} {'EVT':>8} {'STEP':>11} {'ROOF':>5} {'PREFILL':>15} {'WAIT':>5} "
         f"{'HBM':>9} {'CMPL':>5}  SLO"
     )
     # router radix-index health (router broadcast via /cluster/status):
@@ -231,6 +231,20 @@ def render_status(doc: dict, events_rows: int = 8, events_offset: int = 0) -> st
             f"{100.0 * anat['roofline_frac']:.0f}%"
             if anat.get("roofline_frac") is not None else "-"
         )
+        # PREFILL: host-side fraction of prefill dispatch time + the
+        # rows-amortized per-call fixed cost + the prefill roofline fraction
+        # (max(MXU-FLOP, bytes) floor over measured — see
+        # tools/profile_prefill.py for the offline decomposition). Workers
+        # predating the prefill plane (r19) show "-"
+        prefill = "-"
+        if anat.get("prefill_host_frac") is not None:
+            prefill = f"h{100.0 * anat['prefill_host_frac']:.0f}%"
+            fx = anat.get("prefill_fixed_ms")
+            if fx is not None:
+                prefill = f"{prefill} {fx:.1f}ms"
+            pr = anat.get("prefill_roofline_frac")
+            if pr is not None:
+                prefill = f"{prefill} {100.0 * pr:.0f}%"
         # RADIX: blocks this worker has indexed in the router's radix tree
         # (its advertised prefix-cache footprint); "-" until the router has
         # broadcast index health
@@ -245,7 +259,7 @@ def render_status(doc: dict, events_rows: int = 8, events_offset: int = 0) -> st
             f"{slots:>7} {kv_pct:>5.1f}% {kv_mem:>11} {prefix:>9} "
             f"{radix_cell:>7} {spec:>10} "
             f"{lora:>11} {tier:>9} {goodput:>9} {mig:>7} {qos:>9} {evt:>8} {step:>11} "
-            f"{roof:>5} {kv.get('num_requests_waiting', 0):>5} "
+            f"{roof:>5} {prefill:>15} {kv.get('num_requests_waiting', 0):>5} "
             f"{_fmt_bytes(res.get('hbm_bytes_in_use', 0)):>9} "
             f"{res.get('xla_compiles', 0):>5}  {_slo_cell(w.get('slo'))}"
             f"{stale_mark}"
