@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import math
 import time
 
 import numpy as np
@@ -3382,6 +3383,124 @@ async def run_events() -> dict:
     return out
 
 
+async def run_metering() -> dict:
+    """Cost-attribution plane (observability tentpole): drive a real engine
+    with two tagged tenants and check BOTH conservation identities on the
+    live ledger — attributed device-seconds vs the step-anatomy wall totals,
+    and per-tier summed KV byte-seconds vs the occupancy integrals. Then
+    price the hot-path writes (one on_phase split, one KV edge pair) against
+    the MEASURED decode step wall and assert the metering plane costs <1%
+    of a step, same contract as the flight recorder."""
+    import jax
+
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.engine.sampling import SamplingParams
+    from dynamo_tpu.engine.scheduler import EngineRequest
+    from dynamo_tpu.utils.metering import MeterLedger
+    from dynamo_tpu.utils.step_anatomy import StepRecord
+
+    from tests.test_engine import tiny_engine_config  # CPU-smoke config
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    osl = 32
+    if on_cpu:
+        eng = AsyncJaxEngine(tiny_engine_config(decode_steps=4, pipeline_depth=2))
+        prompt = list(range(1, 33))
+    else:
+        eng = AsyncJaxEngine(bench_config(8, 64))
+        prompt = np.random.default_rng(11).integers(1, 31000, 256).tolist()
+
+    async def one(rid, tenant):
+        req = EngineRequest(
+            request_id=rid, token_ids=list(prompt), tenant=tenant,
+            sampling=SamplingParams(temperature=0.0, max_tokens=osl,
+                                    ignore_eos=True),
+        )
+        stamps = []
+        async for out in eng.generate(req):
+            if out.token is not None:
+                stamps.append(time.perf_counter())
+        return stamps
+
+    try:
+        await eng.start()
+        await one("warm", "bench-a")  # executables out of the measurement
+        stamps = await one("measured", "bench-a")
+        # a concurrent two-tenant pair so the split path (multi-row bills,
+        # shared decode windows) is what conservation is checked against
+        await asyncio.gather(one("m2", "bench-a"), one("m3", "bench-b"))
+        cons = eng.meter.conservation(anatomy=eng.scheduler.anatomy)
+        snap = eng.meter.snapshot()
+        anat = eng.scheduler.anatomy
+        with anat._lock:
+            d_steps = anat.steps_total.get("decode_window", 0)
+            d_calls = anat.dispatch_counts.get("decode_window", 0)
+        steps_per_dispatch = max(1.0, d_steps / max(1, d_calls))
+    finally:
+        await eng.shutdown()
+    assert len(stamps) == osl
+    step_wall_s = (stamps[-1] - stamps[0]) / (osl - 1)
+
+    # ---- hot-path price: a dedicated ledger (same code path), a billed
+    # two-row record, mean over enough rounds to dominate timer noise
+    led = MeterLedger()
+    rec = StepRecord(seq=1, ts=0.0, kind="decode_window", bill=[
+        ("bench-r1", "bench-a", "", "standard", 3.0),
+        ("bench-r2", "bench-b", "", "standard", 1.0),
+    ])
+    n = 20000
+    # best-of-3 with a warmup pass: the first repeat absorbs dict sizing
+    # and bytecode-cache first-touch; min strips scheduler noise so the
+    # price reflects the steady state the contract is about
+    on_phase_s = math.inf
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            led.on_phase(rec, "device_wait", 1e-4)
+        on_phase_s = min(on_phase_s, (time.perf_counter() - t0) / n)
+    kv_acq_s = math.inf
+    kv_rel_s = math.inf
+    for r in range(3):
+        t0 = time.perf_counter()
+        for i in range(n):
+            led.kv_acquire("hbm", (r, i), 4096, ("bench-a", "bench-r1"))
+        kv_acq_s = min(kv_acq_s, (time.perf_counter() - t0) / n)
+        t0 = time.perf_counter()
+        for i in range(n):
+            led.kv_release("hbm", (r, i))
+        kv_rel_s = min(kv_rel_s, (time.perf_counter() - t0) / n)
+
+    # per MODEL STEP: 4 phase splits per decode dispatch amortized over the
+    # dispatch's steps, plus ~1/page_size acquire edges per sequence-step (a
+    # fresh page every page_size generated tokens). The matching releases
+    # land in the end-of-life free batch, not inside a decode step, so the
+    # steady-state step pays only the acquire half (release price reported)
+    page_size = eng.config.page_size
+    per_step_s = (4.0 * on_phase_s) / steps_per_dispatch + kv_acq_s / page_size
+    overhead_frac = per_step_s / step_wall_s
+    out = {
+        "cpu_smoke": on_cpu,
+        "decode_step_wall_ms": round(step_wall_s * 1e3, 4),
+        "on_phase_us": round(on_phase_s * 1e6, 3),
+        "kv_acquire_us": round(kv_acq_s * 1e6, 3),
+        "kv_release_us": round(kv_rel_s * 1e6, 3),
+        "overhead_frac": round(overhead_frac, 6),
+        "device_rel_err": cons["device"]["rel_err"],
+        "kv_rel_err": {t: cons["kv"][t]["rel_err"] for t in cons["kv"]},
+        "device_s_total": snap["device_s_total"],
+        "tenants_metered": sorted(t for t in snap["tenants"] if t),
+    }
+    # acceptance: both identities hold on the LIVE ledger (by-construction
+    # exact; tolerance covers float summation order), and the metering
+    # plane prices under 1% of a measured decode step
+    assert cons["device"]["rel_err"] < 1e-6, out
+    for tier, side in cons["kv"].items():
+        assert side["rel_err"] < 1e-6, (tier, out)
+    assert {"bench-a", "bench-b"} <= set(snap["tenants"]), out
+    assert overhead_frac < 0.01, out
+    return out
+
+
 async def run_router_scale() -> dict:
     """Router radix index under internet-scale distinct-prefix churn: the
     bounded/sharded index (PR 17) vs the unbounded baseline.
@@ -3646,6 +3765,10 @@ async def run() -> dict:
     # flight recorder: emit cost vs the measured decode step wall (<1%
     # asserted) + forensic timeline-reconstruction latency
     await _section("events", run_events, 900)
+    # cost attribution: both conservation identities on a live two-tenant
+    # engine ledger + the metering hot-path priced against the measured
+    # decode step wall (<1% asserted inside)
+    await _section("metering", run_metering, 900)
     # router index under >1M distinct-prefix churn: bounded/sharded vs
     # unbounded (pure CPU; resident cap + hot-hit ratio asserted inside)
     await _section("router_scale", run_router_scale, 900)
@@ -3706,6 +3829,7 @@ def _summary(errors: dict) -> dict:
     sanat = DETAIL.get("step_anatomy")
     panat = DETAIL.get("prefill_anatomy")
     evts = DETAIL.get("events")
+    mtr = DETAIL.get("metering")
     rscale = DETAIL.get("router_scale")
     # per-scenario acceptance keys (replay.{scenario}.{goodput,ttft_p99_ms,
     # itl_p99_ms,tok_s}); wall/lag/stage detail rides bench_detail.json
@@ -3840,9 +3964,8 @@ def _summary(errors: dict) -> dict:
         # 16K/64K TTFT + KV high-watermark (acceptance keys; tok/s and the
         # dispatch histograms ride bench_detail.json)
         "long_context": {
-            "ttft_ms_16k": _get(lctx, "16k", "ttft_ms"),
             "ttft_ms_64k": _get(lctx, "64k", "ttft_ms"),
-            # kv_peak_64k, tok_s_64k and parity_64k moved to
+            # ttft_ms_16k, kv_peak_64k, tok_s_64k and parity_64k moved to
             # bench_detail.json (truncation budget; the section asserts
             # parity itself and the gated 64k TTFT carries the signal)
             "short_ratio": _get(lctx, "short_ttft_ratio_ladder_over_dense"),
@@ -3864,14 +3987,11 @@ def _summary(errors: dict) -> dict:
         # HBM-floor fraction of measured decode seconds, and the decode
         # window dispatch cadence — the item-3 fused-decode before/after
         # numbers (per-arm spec/LoRA breakdowns ride bench_detail.json)
+        # dispatch_gap_ms_p50 moved to bench_detail.json (truncation
+        # budget; the gated host_frac/roofline_frac carry the signal)
         "step_anatomy": {
             "host_frac": _get(sanat, "decode", "host_frac"),
             "roofline_frac": _get(sanat, "decode", "roofline_frac"),
-            "dispatch_gap_ms_p50": (
-                round(_get(sanat, "decode", "dispatch_gap_ms_p50"), 1)
-                if _get(sanat, "decode", "dispatch_gap_ms_p50") is not None
-                else None
-            ),
         },
         # prefill anatomy (pipelined arm): measured per-call fixed cost from
         # the standing plane, dispatch count, and TTFT p50 — the r19
@@ -3891,6 +4011,22 @@ def _summary(errors: dict) -> dict:
         "events": {
             "emit_frac": _get(evts, "emit_overhead_frac"),
             "rec_ms": _get(evts, "reconstruct_ms"),
+        },
+        # cost attribution: the WORST conservation residual across both
+        # planes (device vs anatomy, per-tier byte-seconds — each asserted
+        # <1e-6 inside the section) + the metering hot-path's per-step
+        # price fraction (asserted <1% inside). Short keys for the
+        # truncation budget — per-plane residuals, on_phase/kv-edge
+        # prices, and the per-tenant rollup ride bench_detail.json
+        "metering": {
+            "err": max(
+                (v for v in [
+                    _get(mtr, "device_rel_err"),
+                    *(_get(mtr, "kv_rel_err") or {}).values(),
+                ] if v is not None),
+                default=None,
+            ),
+            "frac": _get(mtr, "overhead_frac"),
         },
         # router index under >1M distinct-prefix churn (bounded arm): the
         # gated resident-cap / hot-hit / lookup-latency keys (per-arm

@@ -77,6 +77,7 @@ class MetricsService:
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/cluster/status", self._cluster_status)
         app.router.add_get("/cluster/events", self._cluster_events)
+        app.router.add_get("/cluster/costs", self._cluster_costs)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
@@ -125,6 +126,7 @@ class MetricsService:
                 "resources": view.data.get("resources"),
                 "slo": view.data.get("slo"),
                 "goodput": view.data.get("goodput"),
+                "costs": view.data.get("costs"),
                 "stage_seconds": view.data.get("stage_seconds"),
                 "disagg": view.data.get("disagg"),
                 "events": view.data.get("events"),
@@ -183,6 +185,80 @@ class MetricsService:
         if request_id:
             merged = [e for e in merged if e.get("request_id") == request_id]
         return merged[-limit:]
+
+    def cluster_costs(self) -> dict:
+        """The ``/cluster/costs`` document: every worker's MeterLedger
+        snapshot (utils/metering.py) merged into fleet-wide per-tenant burn —
+        device-seconds (total and by dispatch kind), per-tier KV byte-seconds
+        and residency, queued-seconds, and the admitted-vs-consumed token
+        counters. Additive merge: each field is a cumulative counter or a
+        current level on exactly one worker, so the fleet view is the sum.
+        The planner reads the same merge as its per-tenant demand signal."""
+        tenants: dict[str, dict] = {}
+        adapters: dict[str, float] = {}
+        tiers: dict[str, dict] = {}
+        per_worker = []
+        for view in self.aggregator.worker_views():
+            costs = view.data.get("costs") or {}
+            if not costs:
+                continue
+            per_worker.append({
+                "worker_id": f"{view.instance_id:x}",
+                "device_s_total": costs.get("device_s_total", 0.0),
+                "top_tenant": costs.get("top_tenant", ""),
+            })
+            for tenant, row in (costs.get("tenants") or {}).items():
+                agg = tenants.setdefault(tenant, {
+                    "device_s": 0.0, "by_kind": {}, "kv_byte_s": {},
+                    "kv_resident_bytes": {}, "queued_s": 0.0, "tokens": {},
+                })
+                agg["device_s"] = round(
+                    agg["device_s"] + (row.get("device_s") or 0.0), 6
+                )
+                agg["queued_s"] = round(
+                    agg["queued_s"] + (row.get("queued_s") or 0.0), 6
+                )
+                for k, v in (row.get("by_kind") or {}).items():
+                    agg["by_kind"][k] = round(agg["by_kind"].get(k, 0.0) + v, 6)
+                for t, v in (row.get("kv_byte_s") or {}).items():
+                    agg["kv_byte_s"][t] = round(
+                        agg["kv_byte_s"].get(t, 0.0) + v, 6
+                    )
+                for t, v in (row.get("kv_resident_bytes") or {}).items():
+                    agg["kv_resident_bytes"][t] = (
+                        agg["kv_resident_bytes"].get(t, 0) + int(v)
+                    )
+                for k, v in (row.get("tokens") or {}).items():
+                    agg["tokens"][k] = agg["tokens"].get(k, 0) + int(v)
+            for jk, s in (costs.get("adapters") or {}).items():
+                adapters[jk] = round(adapters.get(jk, 0.0) + s, 6)
+            for tier, row in (costs.get("tiers") or {}).items():
+                agg = tiers.setdefault(
+                    tier, {"resident_bytes": 0, "byte_s": 0.0}
+                )
+                agg["resident_bytes"] += int(row.get("resident_bytes") or 0)
+                agg["byte_s"] = round(
+                    agg["byte_s"] + (row.get("byte_s") or 0.0), 6
+                )
+        total = round(sum(r["device_s"] for r in tenants.values()), 6)
+        shares = {
+            t: round(r["device_s"] / total, 5)
+            for t, r in tenants.items() if total > 0
+        }
+        return {
+            "namespace": self.namespace,
+            "component": self.component,
+            "ts": time.time(),
+            "tenants": tenants,
+            "adapters": adapters,
+            "tiers": tiers,
+            "device_s_total": total,
+            "device_share": shares,
+            "workers": per_worker,
+        }
+
+    async def _cluster_costs(self, request: web.Request) -> web.Response:
+        return web.json_response(self.cluster_costs())
 
     async def _cluster_events(self, request: web.Request) -> web.Response:
         q = request.query

@@ -261,6 +261,14 @@ def migrate_key(namespace: str, component: str) -> str:
     return f"planner/{namespace}/migrate/{component}"
 
 
+def demand_key(namespace: str, component: str) -> str:
+    """Control-plane KV key the planner publishes the per-tenant demand
+    signal under (ROADMAP item 1): windowed device-seconds burn per tenant
+    from the fleet's cost broadcasts (utils/metering.py), the measured-
+    consumption input an SLO-driven profile planner scales from."""
+    return f"planner/{namespace}/demand/{component}"
+
+
 class PlannerService:
     """Scrapes signals, runs the policy, publishes desired replicas to the
     control-plane KV (watchable by the reconciler / serve supervisor)."""
@@ -300,6 +308,12 @@ class PlannerService:
         self._last_execute = float("-inf")
         self.rebalance_executed = 0
         self.rebalance_execute_failures = 0
+        # per-tenant demand signal (ROADMAP item 1): the cost broadcasts
+        # carry CUMULATIVE device-seconds; successive scrapes difference
+        # into a per-interval burn so the planner sees current demand, not
+        # lifetime totals. tenant_demand is the latest window's burn.
+        self._last_burn: dict[str, float] = {}
+        self.tenant_demand: dict[str, float] = {}
 
     async def start(self) -> None:
         self._task = asyncio.create_task(self._loop())
@@ -327,13 +341,21 @@ class PlannerService:
             depth = await self.drt.cplane.queue_depth(self.prefill_queue)
         except Exception:
             depth = 0
+        demand = self.observe_tenant_burn()
         events.emit(
             "planner.observe", request_id="",
             workers=len(loads), prefill_queue_depth=depth,
             burn_alerts=sum(
                 1 for w in self._rebalance_inputs() if w.get("burn_alert")
             ),
+            tenants_burning=len(demand),
+            top_tenant=max(demand, key=demand.get) if demand else "",
         )
+        if demand:
+            await self.drt.cplane.kv_put(
+                demand_key(self.namespace, self.decode_component),
+                json.dumps({"tenants": demand, "ts": time.time()}).encode(),
+            )
         decisions = self.planner.observe(
             loads,
             depth,
@@ -448,6 +470,31 @@ class PlannerService:
             [({"result": "ok"}, self.rebalance_executed),
              ({"result": "error"}, self.rebalance_execute_failures)],
         )
+
+    def observe_tenant_burn(self) -> dict[str, float]:
+        """Per-tenant demand from the scraped cost broadcasts (ROADMAP
+        item 1's measured-consumption input): sum each tenant's CUMULATIVE
+        attributed device-seconds across the fleet, difference against the
+        previous scrape, and return this window's burn. Monotonic-counter
+        discipline: a shrinking sum (worker restarted or aged out) resets
+        that tenant's baseline instead of reporting negative demand."""
+        totals: dict[str, float] = {}
+        for view in self.aggregator.worker_views():
+            costs = view.data.get("costs") or {}
+            for tenant, row in (costs.get("tenants") or {}).items():
+                if not tenant:
+                    continue  # system/untagged work is not tenant demand
+                totals[tenant] = (
+                    totals.get(tenant, 0.0) + (row.get("device_s") or 0.0)
+                )
+        demand = {}
+        for tenant, s in totals.items():
+            prev = self._last_burn.get(tenant, 0.0)
+            if s > prev:
+                demand[tenant] = round(s - prev, 6)
+        self._last_burn = totals
+        self.tenant_demand = demand
+        return demand
 
     def _rebalance_inputs(self) -> list[dict]:
         """Per-worker rebalance signals from the scraped fleet view: KV

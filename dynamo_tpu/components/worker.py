@@ -246,6 +246,15 @@ class WorkerService:
             # windowed per-scenario/tenant SLO-met fraction (dynotop GOODPUT
             # column; item-5 QoS scheduling reads the per-tenant view)
             stats["goodput"] = goodput()
+        costs = getattr(self._inner_engine, "cost_snapshot", None)
+        if costs is not None:
+            # cost-attribution rollup (utils/metering.py): per-tenant device-
+            # seconds and KV byte-seconds — the metrics component's
+            # /cluster/costs merge, dynotop's COST column, and the planner's
+            # per-tenant demand signal all read this broadcast
+            snap = costs()
+            if snap:
+                stats["costs"] = snap
         # live migration: whether this worker adopts peers' sequences (the
         # planner's rebalance decisions only target migration-enabled pairs)
         stats["migration"] = {
@@ -473,6 +482,7 @@ async def _main(args) -> None:
             migration_timeout_s=getattr(args, "migration_timeout_s", None) or 10.0,
             qos=not getattr(args, "no_qos", False),
             qos_preempt_wait_ms=getattr(args, "qos_preempt_wait_ms", None) or 250.0,
+            metering=not getattr(args, "no_metering", False),
             slo_ttft_ms=getattr(args, "slo_ttft_ms", None),
             slo_itl_ms=getattr(args, "slo_itl_ms", None),
             prefill_buckets=tuple(
@@ -577,6 +587,10 @@ def main(argv=None) -> None:
                    help="disable multi-tenant QoS scheduling (priority "
                         "classes ignored: FIFO admission, recency-only "
                         "preemption victims)")
+    p.add_argument("--no-metering", action="store_true",
+                   help="disable per-tenant cost attribution (no ledger: "
+                        "dynamo_cost_* families, /cluster/costs shares and "
+                        "per-request cost footers all go dark)")
     p.add_argument("--qos-preempt-wait-ms", type=float, default=250.0,
                    help="how long a critical request waits with no free "
                         "slot before the scheduler evicts a lower-class "

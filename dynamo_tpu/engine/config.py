@@ -210,6 +210,13 @@ class EngineConfig:
     # the burst's own later requests gain nothing — their prefills still
     # queue). 0 = unbounded (pre-r5 behavior).
     prefill_batches_per_step: int = 2
+    # cost attribution (utils/metering.py): per-(tenant, adapter, priority)
+    # device-seconds at the step-anatomy seams + per-tenant KV byte-seconds
+    # on every tier's allocate/free/demote/restore edges, conservation-
+    # checked against the anatomy wall totals and the pool-occupancy
+    # integrals. False = no MeterLedger anywhere: every hook is a
+    # `meter is None` check, so the off path adds zero work per dispatch.
+    metering: bool = True
     # pre-compile trace variants at startup so the first feature-bearing
     # request never hits a cold multi-second XLA compile mid-serving.
     #   False        — lazy (tests, short-lived engines)

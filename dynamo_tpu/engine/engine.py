@@ -100,6 +100,17 @@ class AsyncJaxEngine:
             ttft_budget_s=self.slo.targets.get("ttft"),
             itl_budget_s=self.slo.targets.get("itl"),
         )
+        # cost-attribution plane (utils/metering.py): ONE ledger per engine —
+        # the scheduler's dispatch bills, every KV tier's residency edges,
+        # and the queued/admitted/consumed token charges all post here.
+        # None when config.metering is off: every hook degrades to a
+        # `meter is None` check (the zero-cost path the tests pin).
+        if config.metering:
+            from dynamo_tpu.utils.metering import MeterLedger
+
+            self.meter = MeterLedger()
+        else:
+            self.meter = None
         # multi-tenant QoS (utils/qos.py): measured queue-drain rate — every
         # finished request feeds it via the outcome sink, and both retriable
         # status paths (draining 503, backpressure 429) price Retry-After
@@ -227,6 +238,23 @@ class AsyncJaxEngine:
         self.scheduler.slo = self.slo
         self.scheduler.outcome_sink = self._observe_outcome
         self.scheduler.prefix_fetcher = self.prefix_fetcher
+        if self.meter is not None:
+            # wire the cost ledger into every plane that generates charges:
+            # anatomy phases split across dispatch bill rows, HBM pages price
+            # at the model's actual per-page wire cost, and the host/disk
+            # tiers meter their own residency edges
+            self.scheduler.meter = self.meter
+            self.scheduler.anatomy.meter = self.meter
+            self.allocator.meter = self.meter
+            self.allocator.meter_page_bytes = (
+                self.model.kv_page_bytes(self.config.page_size)
+                if hasattr(self.model, "kv_page_bytes")
+                else 0
+            )
+            if offload is not None:
+                offload.meter = self.meter
+                if offload.disk is not None:
+                    offload.disk.meter = self.meter
         if self.config.warmup == "background":
             # readiness waits only for the traces first requests need; the
             # feature variants (logprobs/penalties, extras prefill) compile
@@ -1119,6 +1147,11 @@ class AsyncJaxEngine:
             # seconds, host/roofline fractions, decode dispatch cadence —
             # nested dict rides /cluster/status + dynotop STEP/ROOF columns
             "step_anatomy": sched.anatomy.snapshot(),
+            # cost-attribution plane (utils/metering.py): per-tenant device-
+            # seconds / KV byte-seconds / token charges — rides worker stats
+            # -> /cluster/costs, dynotop's COST column, and the planner's
+            # per-tenant demand signal. None-safe: {} when metering is off.
+            "costs": self.meter.snapshot() if self.meter is not None else {},
             # graceful zeros when no runner reports (CPU, or pre-init)
             "hbm_bytes_in_use": 0,
             "hbm_peak_bytes_in_use": 0,
@@ -1221,6 +1254,21 @@ class AsyncJaxEngine:
         """Windowed goodput per scenario/tenant (worker stats broadcasts +
         dynotop's GOODPUT column)."""
         return self.goodput.snapshot()
+
+    def cost_snapshot(self) -> dict:
+        """Cost-attribution rollup (utils/metering.py MeterLedger.snapshot):
+        per-tenant device-seconds by dispatch kind, per-tier KV byte-seconds
+        and residency, queued-seconds, and the admitted-vs-consumed token
+        counters. {} when metering is off."""
+        return self.meter.snapshot() if self.meter is not None else {}
+
+    def request_cost(self, request_id: str) -> Optional[dict]:
+        """Per-request cost footer for /debug/requests/{id}: device-ms by
+        dispatch kind + peak resident KV bytes per tier. None when metering
+        is off or the footer LRU already evicted the request."""
+        if self.meter is None:
+            return None
+        return self.meter.request_cost(request_id)
 
     def _observe_outcome(self, outcome) -> None:
         """Scheduler outcome sink: goodput accounting + the drain-rate
@@ -1328,6 +1376,9 @@ class AsyncJaxEngine:
         # step-anatomy families: dynamo_step_seconds_total{phase,kind} +
         # dynamo_step_dispatch_total{kind} + dynamo_engine_roofline_fraction
         parts.append(self.scheduler.anatomy.render_metrics())
+        # cost-attribution families: the five dynamo_cost_* (utils/metering.py)
+        if self.meter is not None:
+            parts.append(self.meter.render_metrics())
         parts.append(self._render_resource_metrics())
         # fleet prefix cache: wire-side client/server families join the
         # engine surface when the hosting worker attached them

@@ -237,6 +237,10 @@ class DiskKvStore:
         self.block_bytes = int(block_bytes)
         self._index: OrderedDict[int, _Entry] = OrderedDict()
         self.bytes_resident = 0
+        #: optional utils/metering.MeterLedger — disk byte-residency edges
+        #: (spill = acquire under the owner the host pool carries down;
+        #: budget eviction / discard = release)
+        self.meter = None
         # counters (worker thread increments restore-side under _lock)
         self.spills = 0
         self.restores = 0
@@ -261,11 +265,14 @@ class DiskKvStore:
     def _path(self, seq_hash: int) -> str:
         return os.path.join(self.directory, f"{seq_hash & (2**64 - 1):016x}.kvb")
 
-    def spill(self, seq_hash: int, data) -> list[int]:
+    def spill(self, seq_hash: int, data, owner=None) -> list[int]:
         """Engine thread: demote one host-pool victim to disk. Serialization
         and the write happen on the worker; the index and byte budget update
-        here, synchronously. Returns hashes EVICTED from disk to stay under
-        budget — the blocks that just left their last tier."""
+        here, synchronously. ``owner`` is the metering owner the host pool
+        carries down the ladder (the block stores int8-compressed, so the
+        disk tier charges the compressed bytes). Returns hashes EVICTED from
+        disk to stay under budget — the blocks that just left their last
+        tier."""
         if self.budget_bytes <= 0:
             return [seq_hash]
         if seq_hash in self._index:
@@ -277,12 +284,16 @@ class DiskKvStore:
         path = self._path(seq_hash)
         self._index[seq_hash] = _Entry(nbytes=nbytes, path=path)
         self.bytes_resident += nbytes
+        if self.meter is not None:
+            self.meter.kv_acquire("disk", seq_hash, nbytes, owner)
         self.spills += 1
         self._ops.put(("write", path, seq_hash, data))
         evicted: list[int] = []
         while self.bytes_resident > self.budget_bytes and self._index:
             victim, entry = self._index.popitem(last=False)
             self.bytes_resident -= entry.nbytes
+            if self.meter is not None:
+                self.meter.kv_release("disk", victim)
             self.drops += 1
             self._ops.put(("unlink", entry.path))
             evicted.append(victim)
@@ -299,6 +310,8 @@ class DiskKvStore:
         if entry is None:
             return False
         self.bytes_resident -= entry.nbytes
+        if self.meter is not None:
+            self.meter.kv_release("disk", seq_hash)
         self._ops.put(("unlink", entry.path))
         return True
 

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import time
 from collections import OrderedDict
+from typing import Optional
 
 import numpy as np
 
@@ -65,6 +66,10 @@ class HostKvPool:
         self.loads = 0
         self.drops = 0
         self.transfer_s = 0.0  # device<->host block movement (both directions)
+        #: optional utils/metering.MeterLedger — byte-residency edges: blocks
+        #: acquire under the owner the allocator hands down on demote, LRU
+        #: victims release (carrying the owner further down to the disk tier)
+        self.meter = None
 
     @property
     def bytes_resident(self) -> int:
@@ -83,13 +88,14 @@ class HostKvPool:
             self.disk is not None and seq_hash in self.disk
         )
 
-    def _demote(self, victim: int, block) -> list[int]:
+    def _demote(self, victim: int, block, owner=None) -> list[int]:
         """One LRU victim leaves host DRAM: spill to disk when a disk tier
         is attached (returns only the hashes that left their LAST tier —
-        disk-budget evictions), else the victim is simply gone."""
+        disk-budget evictions), else the victim is simply gone. ``owner`` is
+        the metering owner carried down the ladder."""
         if self.disk is None:
             return [victim]
-        return self.disk.spill(victim, block)
+        return self.disk.spill(victim, block, owner=owner)
 
     def _emit_spills(self, spills_before: int) -> None:
         """Journal the host->disk demotions a save batch caused (one batched
@@ -101,7 +107,7 @@ class HostKvPool:
         if n > 0:
             events.emit("offload.disk_spill", request_id="", blocks=n)
 
-    def save(self, seq_hash: int, page_id: int) -> list[int]:
+    def save(self, seq_hash: int, page_id: int, owner=None) -> list[int]:
         """Copy a device page to host. Returns seq hashes that left their
         last tier (for removed-event emission)."""
         if self.capacity_blocks <= 0:
@@ -111,21 +117,30 @@ class HostKvPool:
         self.transfer_s += time.monotonic() - t0
         self._blocks[seq_hash] = data
         self._blocks.move_to_end(seq_hash)
+        if self.meter is not None:
+            self.meter.kv_acquire("host", seq_hash, self.block_bytes, owner)
         self.saves += 1
         dropped = []
         spills0 = self.disk.spills if self.disk is not None else 0
         while len(self._blocks) > self.capacity_blocks:
             victim, block = self._blocks.popitem(last=False)
-            dropped.extend(self._demote(victim, block))
+            victim_owner = (
+                self.meter.kv_release("host", victim)
+                if self.meter is not None else None
+            )
+            dropped.extend(self._demote(victim, block, owner=victim_owner))
             self.drops += 1
         self._emit_spills(spills0)
         return dropped
 
-    def save_many(self, pairs: list[tuple[int, int]]) -> list[int]:
+    def save_many(self, pairs: list[tuple[int, int]],
+                  owners: Optional[dict] = None) -> list[int]:
         """Copy a batch of device pages to host with ONE device gather (the
         pressure-eviction path: per-block save() pays a dispatch + D2H round
         trip per page, serialized into whatever allocation needed the pages).
-        Returns seq hashes that left their last tier (removed-event emission)."""
+        ``owners`` maps seq_hash -> metering owner handed down by the
+        allocator. Returns seq hashes that left their last tier
+        (removed-event emission)."""
         if self.capacity_blocks <= 0:
             return [h for h, _ in pairs]
         if not pairs:
@@ -145,12 +160,21 @@ class HostKvPool:
         for (seq_hash, _), block in zip(pairs, blocks):
             self._blocks[seq_hash] = block
             self._blocks.move_to_end(seq_hash)
+            if self.meter is not None:
+                self.meter.kv_acquire(
+                    "host", seq_hash, self.block_bytes,
+                    (owners or {}).get(seq_hash),
+                )
         self.saves += len(pairs)
         dropped = []
         spills0 = self.disk.spills if self.disk is not None else 0
         while len(self._blocks) > self.capacity_blocks:
             victim, block = self._blocks.popitem(last=False)
-            dropped.extend(self._demote(victim, block))
+            victim_owner = (
+                self.meter.kv_release("host", victim)
+                if self.meter is not None else None
+            )
+            dropped.extend(self._demote(victim, block, owner=victim_owner))
             self.drops += 1
         self._emit_spills(spills0)
         return dropped
@@ -218,4 +242,6 @@ class HostKvPool:
         return data
 
     def discard(self, seq_hash: int) -> None:
-        self._blocks.pop(seq_hash, None)
+        if self._blocks.pop(seq_hash, None) is not None:
+            if self.meter is not None:
+                self.meter.kv_release("host", seq_hash)

@@ -78,6 +78,15 @@ class PageAllocator:
         self.cache_hit_blocks = 0
         self.cache_query_blocks = 0
         self.peak_used_pages = 0  # page-pool occupancy high-watermark
+        #: optional utils/metering.MeterLedger + HBM bytes one page costs —
+        #: set by the engine when metering is on. Ownership model: a page is
+        #: owned by the (tenant, request_id) that first allocated it; prefix
+        #: hits and reusable-pool parking never re-own (residency is the
+        #: benefit the cache sells, so its cost stays attributed); demotions
+        #: to the host tier carry the owner down the ladder.
+        self.meter = None
+        self.meter_page_bytes = 0
+        self._seq_owner: dict[str, tuple] = {}  # seq_id -> (tenant, rid)
 
     # ------------- capacity -------------
 
@@ -116,6 +125,21 @@ class PageAllocator:
             self.peak_used_pages = self.used_pages
         return out
 
+    def _meter_acquire(self, pages: list[int], owner) -> None:
+        """Metering edge: ``pages`` became HBM-resident under ``owner``."""
+        if self.meter is not None and self.meter_page_bytes > 0:
+            for page in pages:
+                self.meter.kv_acquire(
+                    "hbm", page, self.meter_page_bytes, owner
+                )
+
+    def _meter_release(self, page: int):
+        """Metering edge: ``page`` left HBM. Returns the owner (carried down
+        the ladder by demotion sites)."""
+        if self.meter is not None:
+            return self.meter.kv_release("hbm", page)
+        return None
+
     def _reclaim_reusable(self, n: int) -> list[int]:
         """Evict up to ``n`` LRU refcount-0 cached blocks; with a host tier
         configured their KV is offloaded (one batched gather) instead of
@@ -127,10 +151,15 @@ class PageAllocator:
             victims.append((seq_hash, self._cache_meta.pop(seq_hash), page))
         if not victims:
             return []
+        # metering: every victim page leaves HBM here; the owners ride into
+        # the host pool so demoted residency keeps charging its creator
+        owners = {h: self._meter_release(p) for h, _, p in victims}
         removed = []
         if self.offload is not None:
             dropped = set(
-                self.offload.save_many([(h, p) for h, _, p in victims])
+                self.offload.save_many(
+                    [(h, p) for h, _, p in victims], owners=owners
+                )
             )
             meta_by_hash = {h: m for h, m, _ in victims}
             for h, m, _ in victims:
@@ -191,7 +220,8 @@ class PageAllocator:
         return self._cache.get(seq_hash)
 
     def allocate_sequence(
-        self, seq_id: str, prompt_tokens: list[int], salt: int = 0
+        self, seq_id: str, prompt_tokens: list[int], salt: int = 0,
+        owner: Optional[tuple] = None,
     ) -> tuple[int, SequencePages]:
         """Allocate pages for a prompt, reusing cached prefix blocks.
 
@@ -206,6 +236,10 @@ class PageAllocator:
             raise ValueError(f"sequence {seq_id} already allocated")
         ts = TokenSequence(prompt_tokens, self.page_size, salt=salt)
         state = SequencePages(seq_id=seq_id, token_seq=ts)
+        # metering owner for every page this sequence newly acquires (device
+        # prefix hits keep their original owner; restored pages re-own to
+        # the restoring request — its prompt is why the bytes came back up)
+        self._seq_owner[seq_id] = owner
 
         # 1. device-tier prefix hits: chain of full blocks present in cache
         device_hits: list[int] = []
@@ -250,6 +284,7 @@ class PageAllocator:
             host_pairs: list[tuple[int, int]] = []
             if host_hit_hashes:
                 fresh = self._pop_free_pages(len(host_hit_hashes))
+                self._meter_acquire(fresh, owner)
                 for seq_hash, page in zip(host_hit_hashes, fresh):
                     self._refcount[page] = 1
                     state.pages.append(page)
@@ -294,11 +329,14 @@ class PageAllocator:
             total_pages_needed = -(-len(prompt_tokens) // self.page_size)
             need = total_pages_needed - len(state.pages)
             if need > 0:
-                for page in self._pop_free_pages(need):
+                fresh = self._pop_free_pages(need)
+                self._meter_acquire(fresh, owner)
+                for page in fresh:
                     self._refcount[page] = 1
                     state.pages.append(page)
         except MemoryError:
             self._rollback(state)
+            self._seq_owner.pop(seq_id, None)
             raise
 
         # Blocks completed by the prompt itself (all but what the prefix cache
@@ -381,6 +419,7 @@ class PageAllocator:
             fresh = self._pop_free_pages(needed - state.num_pages)
         except MemoryError:
             return False
+        self._meter_acquire(fresh, self._seq_owner.get(seq_id))
         for page in fresh:
             self._refcount[page] = 1
             state.pages.append(page)
@@ -418,6 +457,7 @@ class PageAllocator:
         """Release a sequence. Full cached blocks become reusable (LRU);
         uncached pages return to the free list immediately."""
         state = self._seqs.pop(seq_id)
+        self._seq_owner.pop(seq_id, None)
         page_to_hash = {}
         for i, block in enumerate(state.token_seq.blocks):
             if i < len(state.pages) and block.sequence_hash in self._cache and self._cache[block.sequence_hash] == state.pages[i]:
@@ -444,7 +484,10 @@ class PageAllocator:
         if evictable_hash is not None and self._cache.get(evictable_hash) == page:
             self._reusable[evictable_hash] = page  # cached, reclaimable, LRU tail
             self._reusable.move_to_end(evictable_hash)
+            # metering: a reusable-pool page stays resident and keeps
+            # charging its owner — no edge until reclaim
         else:
+            self._meter_release(page)
             self._free.append(page)
 
     def _register_block(self, state: SequencePages, block: TokenBlock, page: int) -> None:

@@ -106,6 +106,10 @@ class EngineRequest:
     # | batch ("" = standard). Orders admission, weights the prefill
     # fairness cap, and orders preemption victims (batch lanes go first).
     priority: str = ""
+    # cost metering (utils/metering.py): True once this request's admitted-
+    # token charge posted to the ledger — carried through preemption requeues
+    # so re-admission never double-bills the tenant's admitted count
+    cost_admitted: bool = False
 
 
 @dataclass
@@ -413,6 +417,11 @@ class Scheduler:
         if store is not None:
             # slot loads (device scatters) record as lora_slot_load dispatches
             store.anatomy = self.anatomy
+        # cost-attribution ledger (utils/metering.py MeterLedger), attached by
+        # the engine when config.metering: dispatch records carry bill rows
+        # (anatomy.meter splits their phases), queued-seconds and
+        # admitted/consumed token charges post here directly
+        self.meter = None
         # run_prefill_chunks' most recent record: the dispatch-ahead callers
         # attach it to their _InFlight entry so the reconcile's device-wait
         # attributes back to the producing prefill chain
@@ -882,6 +891,31 @@ class Scheduler:
         self._release_lora_name(seq.req.lora_name, seq.lora_slot)
         seq.lora_slot = 0
 
+    def _bill(self, req: EngineRequest, weight: float) -> tuple:
+        """One cost-attribution bill row for a dispatch record: the meter
+        splits the record's phase seconds across its rows proportional to
+        ``weight`` (utils/metering.py MeterLedger.on_phase)."""
+        return (
+            req.request_id, req.tenant, req.lora_name,
+            req.priority or "", weight,
+        )
+
+    def _charge_admission(self, req: EngineRequest, wait) -> None:
+        """Post a newly-admitted request's ledger charges: queued-seconds,
+        plus the SAME admitted-token cost the QoS bucket debited at the front
+        door (prompt + output budget) — once per request, preemption
+        re-admissions excluded (cost_admitted survives the requeue)."""
+        if self.meter is None:
+            return
+        if wait:
+            self.meter.queued(req.tenant, wait)
+        if not req.cost_admitted:
+            req.cost_admitted = True
+            self.meter.charge_tokens(
+                req.tenant, "admitted",
+                len(req.token_ids) + max(0, req.sampling.max_tokens),
+            )
+
     def _start_sequence(self, req: EngineRequest, slot: int, lora_slot: int = 0) -> None:
         wait = None
         if req.enqueue_ts:
@@ -905,8 +939,10 @@ class Scheduler:
             tenant=req.tenant, priority=req.priority or "",
             slot=slot, queue_wait_ms=round(wait * 1e3, 3) if wait else 0.0,
         )
+        self._charge_admission(req, wait)
         cached_len, state = self.allocator.allocate_sequence(
-            req.request_id, req.token_ids, salt=self._lora_salt(req)
+            req.request_id, req.token_ids, salt=self._lora_salt(req),
+            owner=(req.tenant, req.request_id),
         )
         prompt_len = len(req.token_ids)
         page_table = self._new_table(state.pages)
@@ -1238,7 +1274,7 @@ class Scheduler:
             if applied:
                 self.anatomy.record(
                     "prefix_fetch_scatter", dispatch_s=time.monotonic() - t0,
-                    tokens=applied, ts=t0,
+                    tokens=applied, ts=t0, bill=[self._bill(seq.req, 1.0)],
                 )
             return applied
         except Exception:
@@ -1379,7 +1415,11 @@ class Scheduler:
             ))
             N = min(lanes_max, 1 << (len(chunks) - 1).bit_length())
             t0 = time.monotonic()
-            rec = self.anatomy.begin("prefill_packed", ts=t_prep)
+            rec = self.anatomy.begin(
+                "prefill_packed", ts=t_prep,
+                # cost split: each sequence pays for its own rows in the pack
+                bill=[self._bill(s.req, end - start) for s, start, end in chunks],
+            )
             self.anatomy.add_phase(rec, "host_prep", t0 - t_prep)
             try:
                 result = self.runner.prefill_chunk_batch(
@@ -1514,7 +1554,9 @@ class Scheduler:
         first_token = None
         start = cached_len
         t0 = time.monotonic()
-        rec = self._last_prefill_rec = self.anatomy.begin("prefill_chunk", ts=t0)
+        rec = self._last_prefill_rec = self.anatomy.begin(
+            "prefill_chunk", ts=t0, bill=[self._bill(req, max(1, rows))],
+        )
         if prep:
             self._prep_prefill(req, slot, prompt_len, cached_len=cached_len)
         self.anatomy.add_phase(rec, "host_prep", time.monotonic() - t0)
@@ -1601,6 +1643,7 @@ class Scheduler:
             tenant=req.tenant, priority=req.priority or "",
             adopted=True, cached_tokens=cached_len,
         )
+        self._charge_admission(req, wait)
         state = self.allocator._seqs[req.request_id]
         page_table = self._new_table(state.pages)
         lora_slot = 0
@@ -1792,6 +1835,8 @@ class Scheduler:
             device_wait_s=time.monotonic() - t_disp,
             steps=K, tokens=int(sum(c[3] for c in live)),
             participants=len(live), ts=t0,
+            # cost split: each lane pays for the draft tokens it asked for
+            bill=[self._bill(c[0].req, max(1, c[3])) for c in live],
         )
         if tracing.enabled():
             tracing.record_span(
@@ -1966,6 +2011,9 @@ class Scheduler:
             device_wait_s=time.monotonic() - t_disp, steps=1,
             participants=len(candidates),
             floor_bytes=self.anatomy.decode_floor_bytes(live_pages, 1), ts=t_prep,
+            # cost split: each candidate pays for its verify rows (anchor +
+            # drafts); the reconcile phase below rides the same bill
+            bill=[self._bill(s.req, n + 1) for s, _, n, _ in snapshot],
         )
         t_rec = time.monotonic()
         round_proposed = round_accepted = round_emitted = 0
@@ -2142,7 +2190,11 @@ class Scheduler:
             for seq, _ in participants
             if seq.req.request_id in self.allocator._seqs
         )
-        rec = self.anatomy.begin("decode_window", ts=t_prep)
+        rec = self.anatomy.begin(
+            "decode_window", ts=t_prep,
+            # cost split: each participant pays for its scheduled steps
+            bill=[self._bill(s.req, max(1, n)) for s, _, n in snapshot],
+        )
         t0 = time.monotonic()
         self.anatomy.add_phase(rec, "host_prep", t0 - t_prep)
         result = self.runner.dispatch_decode_window(
@@ -2378,6 +2430,11 @@ class Scheduler:
         # forensics auto-pin: a request that errored or blew its TTFT/ITL
         # budget gets its event chain copied to the capture ring NOW, so
         # /debug/requests/{id} still reconstructs it after ring eviction
+        if self.meter is not None:
+            # consumed-vs-admitted delta: what the request ACTUALLY used,
+            # against the (prompt + output budget) the QoS bucket charged
+            self.meter.charge_tokens(req.tenant, "prompt", seq.prompt_len)
+            self.meter.charge_tokens(req.tenant, "output", len(seq.generated))
         pin_reason = "error" if error else self._slo_pin_reason(seq, ttft)
         if pin_reason:
             events.JOURNAL.pin(req.request_id, pin_reason)
@@ -2535,5 +2592,8 @@ class Scheduler:
             tenant=seq.req.tenant,
             scenario=seq.req.scenario,
             priority=seq.req.priority,
+            # admitted tokens were billed at the FIRST admission; the resumed
+            # request must not double-charge the tenant's admitted count
+            cost_admitted=seq.req.cost_admitted,
         )
         self.waiting.appendleft(new_req)

@@ -64,6 +64,9 @@ async def run_http(engine, args) -> None:
         # step-anatomy debug plane (/debug/steps): recent per-dispatch
         # host/device phase records off the colocated engine's ring
         step_source=getattr(engine, "debug_steps", None),
+        # cost footer on /debug/requests/{id}: the colocated engine's
+        # MeterLedger per-request footer (utils/metering.py)
+        cost_source=getattr(engine, "request_cost", None),
     )
     service.manager.add(pipeline)
     # multi-LoRA: each configured adapter serves as its own OpenAI model name

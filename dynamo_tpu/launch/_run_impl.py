@@ -65,6 +65,7 @@ def engine_config_for(args):
         # multi-tenant QoS knobs (graph yaml / CLI)
         qos=not getattr(args, "no_qos", False),
         qos_preempt_wait_ms=getattr(args, "qos_preempt_wait_ms", None) or 250.0,
+        metering=not getattr(args, "no_metering", False),
     )
     if pb:
         long_ctx["prefill_buckets"] = pb

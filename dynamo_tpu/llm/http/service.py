@@ -87,6 +87,7 @@ class HttpService:
         readiness: Optional[Callable[[], tuple]] = None,
         step_source: Optional[Callable[..., dict]] = None,
         qos=None,  # Optional[AdmissionController]: multi-tenant QoS plane
+        cost_source: Optional[Callable[[str], Optional[dict]]] = None,
     ):
         self.manager = manager or ModelManager()
         self.host = host
@@ -132,6 +133,11 @@ class HttpService:
         # step-anatomy source for a colocated engine: (limit=, kind=) ->
         # {"records": [...], "summary": {...}} (AsyncJaxEngine.debug_steps)
         self._step_source = step_source
+        # cost-footer source for a colocated engine: (request_id) -> the
+        # MeterLedger footer (device-ms by dispatch kind + peak KV bytes per
+        # tier) or None (AsyncJaxEngine.request_cost). Merged into
+        # /debug/requests/{id} under a "cost" key.
+        self._cost_source = cost_source
         self._runner: Optional[web.AppRunner] = None
         self.app = web.Application()
         self.app.router.add_post("/v1/chat/completions", self._chat)
@@ -257,10 +263,20 @@ class HttpService:
         event chain for one request id, with inter-event durations
         (``dt_ms``) and the pin verdict. Served from the live journal merged
         with the capture ring, so over-budget/erroring requests stay
-        reconstructable after ring eviction (utils/events.py)."""
-        return web.json_response(
-            events.JOURNAL.timeline(request.match_info["rid"])
-        )
+        reconstructable after ring eviction (utils/events.py). A colocated
+        engine with metering on appends the request's cost footer
+        (utils/metering.py): device-ms by dispatch kind + peak resident KV
+        bytes per tier — what this request COST, alongside what happened."""
+        rid = request.match_info["rid"]
+        doc = events.JOURNAL.timeline(rid)
+        if self._cost_source is not None:
+            try:
+                cost = self._cost_source(rid)
+            except Exception:
+                cost = None
+            if cost is not None:
+                doc["cost"] = cost
+        return web.json_response(doc)
 
     def _error(
         self, status: int, message: str, code: str | None = None,

@@ -190,6 +190,7 @@ async def replay_engine(
             ),
         }
     outcomes: list[RequestOutcome] = []
+    cost_base = _cost_base(engine)
     t0 = time.monotonic()
 
     async def one(tr) -> None:
@@ -249,7 +250,10 @@ async def replay_engine(
 
     await asyncio.gather(*(one(tr) for tr in trace))
     wall = time.monotonic() - t0
-    return _report(spec, trace, outcomes, wall, speed, metrics)
+    return _report(
+        spec, trace, outcomes, wall, speed, metrics,
+        costs=_cost_delta(engine, cost_base),
+    )
 
 
 # ---------------- http replay ----------------
@@ -380,7 +384,77 @@ async def replay_http(
 # ---------------- reporting ----------------
 
 
-def _report(spec, trace, outcomes, wall_s, speed, metrics) -> dict:
+def _cost_base(engine) -> dict:
+    """Per-tenant (device_s, kv_byte_s) baseline off the engine's
+    MeterLedger (utils/metering.py) so the report charges only THIS
+    run's burn; {} when the engine has no metering plane."""
+    snap_fn = getattr(engine, "cost_snapshot", None)
+    if snap_fn is None:
+        return {}
+    base = {}
+    for tenant, row in ((snap_fn() or {}).get("tenants") or {}).items():
+        base[tenant] = (
+            row.get("device_s") or 0.0,
+            sum((row.get("kv_byte_s") or {}).values()),
+        )
+    return base
+
+
+def _cost_delta(engine, base: dict) -> Optional[dict]:
+    snap_fn = getattr(engine, "cost_snapshot", None)
+    if snap_fn is None:
+        return None
+    delta = {}
+    for tenant, row in ((snap_fn() or {}).get("tenants") or {}).items():
+        d0, k0 = base.get(tenant, (0.0, 0.0))
+        delta[tenant] = {
+            "device_s": max(0.0, (row.get("device_s") or 0.0) - d0),
+            "kv_byte_s": max(
+                0.0, sum((row.get("kv_byte_s") or {}).values()) - k0
+            ),
+        }
+    return delta or None
+
+
+def _tenant_rollup(outcomes, costs=None) -> dict:
+    """Per-tenant accounting rows: request/token counts with offered-load
+    shares, joined (when an engine-side meter was reachable) with the run's
+    measured device-ms and KV byte-second shares — the e2e surface for
+    checking that measured burn tracks token share."""
+    rows: dict[str, dict] = {}
+    for o in outcomes:
+        row = rows.setdefault(o.tenant, {
+            "requests": 0, "errors": 0,
+            "prompt_tokens": 0, "output_tokens": 0,
+        })
+        row["requests"] += 1
+        row["errors"] += 1 if o.error else 0
+        row["prompt_tokens"] += o.prompt_tokens
+        row["output_tokens"] += o.output_tokens
+    tok_total = sum(
+        r["prompt_tokens"] + r["output_tokens"] for r in rows.values()
+    )
+    for row in rows.values():
+        toks = row["prompt_tokens"] + row["output_tokens"]
+        row["token_share"] = round(toks / tok_total, 4) if tok_total else 0.0
+    if costs:
+        dev_total = sum(c.get("device_s") or 0.0 for c in costs.values())
+        kv_total = sum(c.get("kv_byte_s") or 0.0 for c in costs.values())
+        for tenant, c in costs.items():
+            row = rows.setdefault(tenant, {
+                "requests": 0, "errors": 0, "prompt_tokens": 0,
+                "output_tokens": 0, "token_share": 0.0,
+            })
+            dev = c.get("device_s") or 0.0
+            kvb = c.get("kv_byte_s") or 0.0
+            row["device_ms"] = round(1e3 * dev, 3)
+            row["device_share"] = round(dev / dev_total, 4) if dev_total else 0.0
+            row["kv_byte_s"] = round(kvb, 3)
+            row["kv_share"] = round(kvb / kv_total, 4) if kv_total else 0.0
+    return rows
+
+
+def _report(spec, trace, outcomes, wall_s, speed, metrics, costs=None) -> dict:
     budgets = {}
     if spec is not None:
         budgets = {
@@ -398,5 +472,6 @@ def _report(spec, trace, outcomes, wall_s, speed, metrics) -> dict:
         "wall_s": round(wall_s, 3),
         "schedule_lag_max_s": round(metrics.max_lag_s, 4),
         **summary,
+        "tenants": _tenant_rollup(outcomes, costs),
         "outcomes": [o.to_wire() for o in outcomes],
     }

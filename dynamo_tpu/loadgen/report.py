@@ -37,6 +37,21 @@ def render_report(reports: list, title: str = "replay") -> str:
             f"{r.get('tok_s') if r.get('tok_s') is not None else '-':>8} "
             f"{_ms(1e3 * r.get('schedule_lag_max_s', 0.0)):>7}  {budget}"
         )
+        # per-tenant cost rollup rows (loadgen/replay.py _tenant_rollup):
+        # shown when the run was multi-tenant or an engine meter priced it
+        tenants = r.get("tenants") or {}
+        metered = any("device_ms" in t for t in tenants.values())
+        if len(tenants) > 1 or metered:
+            for name, t in sorted(tenants.items()):
+                toks = t.get("prompt_tokens", 0) + t.get("output_tokens", 0)
+                lines.append(
+                    f"  tenant {name or '-':<16} req={t.get('requests', 0):>4} "
+                    f"tok={toks:>7} ({_pct(t.get('token_share'))}) "
+                    f"dev_ms={t.get('device_ms', '-')} "
+                    f"({_pct(t.get('device_share'))}) "
+                    f"kv_Bs={t.get('kv_byte_s', '-')} "
+                    f"({_pct(t.get('kv_share'))})"
+                )
     if not reports:
         lines.append("(no scenarios replayed)")
     return "\n".join(lines)

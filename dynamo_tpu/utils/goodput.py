@@ -132,6 +132,7 @@ class _Sample:
     ts: float
     scenario: str
     tenant: str
+    adapter: str
     met: bool
     error: bool
     ttft_s: Optional[float]
@@ -172,7 +173,8 @@ class GoodputTracker:
         now = self._clock()
         with self._lock:
             self._window.append(_Sample(
-                now, outcome.scenario, outcome.tenant, met, outcome.error,
+                now, outcome.scenario, outcome.tenant, outcome.adapter,
+                met, outcome.error,
                 outcome.ttft_s, outcome.itl_p99_s(), outcome.output_tokens,
             ))
             for totals, key in (
@@ -241,6 +243,20 @@ class GoodputTracker:
             "tenants": {
                 t: fold([s for s in window if s.tenant == t]) for t in tenants
             },
+        }
+        # (tenant, adapter)-keyed windows, join key "tenant|adapter" — the
+        # SAME key MeterLedger.snapshot()["adapters"] uses, so /cluster/status
+        # readers join cost (device-seconds) against goodput per adapter
+        # without re-parsing labels. Fully-untagged traffic ("|") is omitted;
+        # base-model requests of a tagged tenant keep their "tenant|" row.
+        pairs = sorted(
+            {(s.tenant, s.adapter) for s in window} - {("", "")}
+        )
+        snap["adapters"] = {
+            f"{t}|{a}": fold(
+                [s for s in window if s.tenant == t and s.adapter == a]
+            )
+            for t, a in pairs
         }
         return snap
 

@@ -32,6 +32,11 @@ _TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
 #: (graftlint suppressions are per-line).
 DECLARED_METRIC_FAMILIES: tuple = (
     "dynamo_alert_state",
+    "dynamo_cost_device_seconds_total",
+    "dynamo_cost_kv_byte_seconds_total",
+    "dynamo_cost_kv_resident_bytes",
+    "dynamo_cost_queued_seconds_total",
+    "dynamo_cost_tokens_total",
     "dynamo_engine_context_chunk_total",
     "dynamo_engine_context_table_dispatch_total",
     "dynamo_engine_context_table_promotions_total",
@@ -574,6 +579,23 @@ def _sample_surfaces() -> list[tuple[str, str]]:
     anat.add_phase(prec, "dispatch", 0.0102)
     anat.note_steps(prec, tokens=256, participants=2)
     anat.note_prefill_floor(prec, 256)
+    # cost-attribution families (dynamo_cost_* via utils/metering.py): the
+    # engine's MeterLedger is their single emitting site, reached through
+    # render_stage_metrics. Wire the anatomy's meter tap and drive one billed
+    # dispatch plus each charge edge (KV residency, queue wait, token
+    # charges) so every family renders labeled samples cluster-free
+    anat.meter = eng.meter
+    crec = anat.begin("decode_window", bill=[
+        ("r-cost", "tenant-a", "a1", "critical", 4.0),
+    ])
+    anat.add_phase(crec, "dispatch", 0.002)
+    anat.add_phase(crec, "device_wait", 0.005)
+    eng.meter.kv_acquire("hbm", ("blk", 1), 4096, owner=("tenant-a", "r-cost"))
+    eng.meter.kv_acquire("host", ("blk", 2), 4096, owner=("tenant-a", "r-cost"))
+    eng.meter.queued("tenant-a", 0.01)
+    eng.meter.charge_tokens("tenant-a", "admitted", 24)
+    eng.meter.charge_tokens("tenant-a", "prompt", 16)
+    eng.meter.charge_tokens("tenant-a", "output", 8)
     # the engine-scoped goodput families (dynamo_engine_goodput_*) need a
     # sample outcome to render their gauges
     eng.goodput.observe(RequestOutcome(

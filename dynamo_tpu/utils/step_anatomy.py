@@ -224,6 +224,9 @@ class StepRecord:
     participants: int = 0
     floor_bytes: int = 0  # bytes-moved floor estimate (decode kinds only)
     floor_s: float = 0.0  # max(FLOP, bytes) floor seconds (prefill kinds only)
+    #: cost-attribution bill: (request_id, tenant, adapter, priority, weight)
+    #: rows the meter splits this record's phases across (None = system work)
+    bill: Optional[list] = None
 
     @property
     def total_s(self) -> float:
@@ -278,15 +281,22 @@ class StepAnatomy:
         self.prefill_floor_s_total = 0.0
         self._prefill_floor_kinds: set[str] = set()
         self.roofline = roofline
+        #: optional utils/metering.MeterLedger — every clamped phase delta is
+        #: forwarded to it with the record's bill, so the attributed cost
+        #: plane shares this plane's samples (the conservation identity)
+        self.meter = None
 
     # ---------------- recording (engine thread) ----------------
 
-    def begin(self, kind: str, ts: Optional[float] = None) -> StepRecord:
+    def begin(self, kind: str, ts: Optional[float] = None,
+              bill: Optional[list] = None) -> StepRecord:
         """Open one dispatch record and append it to the ring (it fills in
-        place as phases complete)."""
+        place as phases complete). ``bill`` must be set before the first
+        ``add_phase`` — phase deltas forward to the meter immediately."""
         with self._lock:
             self._seq += 1
-            rec = StepRecord(seq=self._seq, ts=ts or time.monotonic(), kind=kind)
+            rec = StepRecord(seq=self._seq, ts=ts or time.monotonic(),
+                             kind=kind, bill=bill)
             self.ring.append(rec)
             self.dispatch_counts[kind] = self.dispatch_counts.get(kind, 0) + 1
         return rec
@@ -302,14 +312,17 @@ class StepAnatomy:
             self.phase_seconds[key] = self.phase_seconds.get(key, 0.0) + dt
             if rec is not None:
                 setattr(rec, phase + "_s", getattr(rec, phase + "_s") + dt)
+        if self.meter is not None and dt > 0:
+            self.meter.on_phase(rec, phase, dt)
 
     def record(self, kind: str, dispatch_s: float, host_prep_s: float = 0.0,
                device_wait_s: float = 0.0, reconcile_s: float = 0.0,
                steps: int = 0, tokens: int = 0, participants: int = 0,
-               floor_bytes: int = 0, ts: Optional[float] = None) -> StepRecord:
+               floor_bytes: int = 0, ts: Optional[float] = None,
+               bill: Optional[list] = None) -> StepRecord:
         """One-shot record for synchronous dispatch kinds (spec rounds, LoRA
         slot loads, scatters, drains): all phases known at the call site."""
-        rec = self.begin(kind, ts=ts)
+        rec = self.begin(kind, ts=ts, bill=bill)
         for phase, dt in (("host_prep", host_prep_s), ("dispatch", dispatch_s),
                           ("device_wait", device_wait_s),
                           ("reconcile", reconcile_s)):

@@ -103,6 +103,15 @@ def _fill_representative(bench):
         "spec_draft": {"host_frac": 0.4123},
         "multi_lora": {"host_frac": 0.3852},
     }
+    bench.DETAIL["metering"] = {
+        "cpu_smoke": False, "decode_step_wall_ms": 8.456,
+        "on_phase_us": 1.395, "kv_acquire_us": 2.084,
+        "kv_release_us": 1.586, "overhead_frac": 0.000423,
+        "device_rel_err": 1.3e-09,
+        "kv_rel_err": {"hbm": 2.7e-09, "host": 0.0, "disk": 0.0},
+        "device_s_total": 123.456,
+        "tenants_metered": ["acme", "umbrella"],
+    }
     bench.DETAIL["prefill_anatomy"] = {
         "greedy_parity": "exact", "stall_delta": 7,
         "depth1": {"prefill_stalls": 7, "prefill_calls": 8,
@@ -162,11 +171,13 @@ def test_summary_line_fits_truncation_budget(bench_mod, tmp_path, monkeypatch):
     }
     assert s["http_serving"]["http_over_engine_ratio"] == 0.96
     # step-anatomy acceptance keys ride the compact line (decode arm only;
-    # the spec/LoRA arm breakdowns stay in bench_detail.json)
+    # the dispatch cadence and spec/LoRA arm breakdowns stay in
+    # bench_detail.json)
     assert s["step_anatomy"] == {
         "host_frac": 0.3124, "roofline_frac": 0.6981,
-        "dispatch_gap_ms_p50": 231.5,  # 0.1 ms precision on the line
     }
+    # cost attribution: worst residual across both planes + hot-path price
+    assert s["metering"] == {"err": 2.7e-09, "frac": 0.000423}
     assert s["mla_decode_tok_s"] == 4658.33
     assert s["moe_decode_tok_s"] == 5425.87
     # live-migration acceptance keys ride the compact line (salvage counters

@@ -97,6 +97,13 @@ DEFAULT_KEYS: tuple = (
     ("prefill_anatomy.fixed_ms", "lower", 1.0),
     ("prefill_anatomy.dispatches", "lower", 0.5),
     ("prefill_anatomy.ttft_p50_ms", "lower", 1.0),
+    # cost attribution (r20+): the worst conservation residual across both
+    # planes must stay a rounding error (the identities are by-construction
+    # exact; any growth means an unmetered seam crept in), and the metering
+    # hot-path's per-step price must stay a rounding error of a decode step
+    # (generous tolerance — timer-noise-prone on shared CPU-smoke machines)
+    ("metering.err", "lower", 1.0),
+    ("metering.frac", "lower", 1.0),
     # replay goodput columns (aliased arrays; index 0 = goodput)
     ("replay.bursty.0", "higher", DEFAULT_TOL),
     ("replay.lctx.0", "higher", DEFAULT_TOL),

@@ -87,7 +87,7 @@ def render_status(doc: dict, events_rows: int = 8, events_offset: int = 0) -> st
         f"{'WORKER':<12} {'STATE':<10} {'HB':>6} {'SEEN':>6} {'MISS':>4} "
         f"{'SLOTS':>7} {'KV%':>6} {'KVMEM':>11} {'PREFIX':>9} {'RADIX':>7} "
         f"{'SPEC':>10} {'LORA':>11} {'TIER':>9} {'GOODPUT':>9} {'MIG':>7} "
-        f"{'QOS':>9} {'EVT':>8} {'STEP':>11} {'ROOF':>5} {'PREFILL':>15} {'WAIT':>5} "
+        f"{'QOS':>9} {'EVT':>8} {'COST':>13} {'STEP':>11} {'ROOF':>5} {'PREFILL':>15} {'WAIT':>5} "
         f"{'HBM':>9} {'CMPL':>5}  SLO"
     )
     # router radix-index health (router broadcast via /cluster/status):
@@ -216,6 +216,17 @@ def render_status(doc: dict, events_rows: int = 8, events_offset: int = 0) -> st
                 evt = f"{evt}!{ev['captures']}p"
         else:
             evt = "-"
+        # cost attribution (utils/metering.py via worker stats): attributed
+        # device-seconds total + the hottest tenant by device burn; workers
+        # predating the metering plane (or with it off) show "-"
+        costs = w.get("costs") or {}
+        if costs.get("device_s_total") is not None:
+            cost = f"{costs['device_s_total']:.1f}s"
+            top = str(costs.get("top_tenant", "") or "")[:6]
+            if top:
+                cost = f"{cost} {top}"
+        else:
+            cost = "-"
         # step anatomy (utils/step_anatomy.py via resource_snapshot): STEP =
         # host-side fraction of attributed engine time + the decode-window
         # dispatch cadence p50; ROOF = HBM floor over measured decode seconds
@@ -258,7 +269,8 @@ def render_status(doc: dict, events_rows: int = 8, events_offset: int = 0) -> st
             f"{w.get('last_seen_s', 0):>5.1f}s {w.get('missed_scrapes', 0):>4} "
             f"{slots:>7} {kv_pct:>5.1f}% {kv_mem:>11} {prefix:>9} "
             f"{radix_cell:>7} {spec:>10} "
-            f"{lora:>11} {tier:>9} {goodput:>9} {mig:>7} {qos:>9} {evt:>8} {step:>11} "
+            f"{lora:>11} {tier:>9} {goodput:>9} {mig:>7} {qos:>9} {evt:>8} "
+            f"{cost:>13} {step:>11} "
             f"{roof:>5} {prefill:>15} {kv.get('num_requests_waiting', 0):>5} "
             f"{_fmt_bytes(res.get('hbm_bytes_in_use', 0)):>9} "
             f"{res.get('xla_compiles', 0):>5}  {_slo_cell(w.get('slo'))}"
